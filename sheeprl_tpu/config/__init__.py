@@ -1,0 +1,23 @@
+from sheeprl_tpu.config.compose import (
+    MISSING,
+    ConfigError,
+    MissingValueError,
+    compose,
+    deep_merge,
+    dotdict,
+    instantiate,
+    resolve,
+    validate_no_missing,
+)
+
+__all__ = [
+    "MISSING",
+    "ConfigError",
+    "MissingValueError",
+    "compose",
+    "deep_merge",
+    "dotdict",
+    "instantiate",
+    "resolve",
+    "validate_no_missing",
+]

@@ -1,0 +1,74 @@
+"""Algorithm / evaluation registries.
+
+Same decorator contract as the reference (sheeprl/utils/registry.py:11-108):
+modules self-register at import time via ``@register_algorithm`` /
+``@register_evaluation``, and the CLI resolves ``cfg.algo.name`` to a module
+entrypoint at runtime.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Any, Callable, Dict, List
+
+# {module_root: [{"name": algo_name, "entrypoint": fn_name, "decoupled": bool}]}
+algorithm_registry: Dict[str, List[Dict[str, Any]]] = {}
+evaluation_registry: Dict[str, List[Dict[str, Any]]] = {}
+
+
+def _register_algorithm(fn: Callable, decoupled: bool = False) -> Callable:
+    entrypoint = fn.__name__
+    module = fn.__module__
+    root_module = module.rsplit(".", 1)[0]
+    algo_name = module.rsplit(".", 2)[-2] if module.count(".") >= 2 else module
+    registered = algorithm_registry.setdefault(root_module, [])
+    if any(r["name"] == algo_name for r in registered):
+        # a module can expose several entrypoints (e.g. decoupled player/trainer
+        # share one `main`); only the first registration wins per name
+        pass
+    registered.append({"name": algo_name, "entrypoint": entrypoint, "decoupled": decoupled})
+    return fn
+
+
+def _register_evaluation(fn: Callable, algorithms: Any) -> Callable:
+    module = fn.__module__
+    root_module = module.rsplit(".", 1)[0]
+    if isinstance(algorithms, str):
+        algorithms = [algorithms]
+    registered = evaluation_registry.setdefault(root_module, [])
+    registered.append({"name": algorithms, "entrypoint": fn.__name__})
+    return fn
+
+
+def register_algorithm(decoupled: bool = False) -> Callable:
+    def wrap(fn: Callable) -> Callable:
+        return _register_algorithm(fn, decoupled=decoupled)
+
+    return wrap
+
+
+def register_evaluation(algorithms: Any) -> Callable:
+    def wrap(fn: Callable) -> Callable:
+        return _register_evaluation(fn, algorithms)
+
+    return wrap
+
+
+def find_algorithm(algo_name: str):
+    """Return (module, entrypoint, decoupled) for a registered algo name."""
+    for module, entries in algorithm_registry.items():
+        for e in entries:
+            if e["name"] == algo_name:
+                return module, e["entrypoint"], e["decoupled"]
+    raise RuntimeError(
+        f"Algorithm '{algo_name}' is not registered. Known: "
+        + ", ".join(e["name"] for v in algorithm_registry.values() for e in v)
+    )
+
+
+def find_evaluation(algo_name: str):
+    for module, entries in evaluation_registry.items():
+        for e in entries:
+            if algo_name in e["name"]:
+                return module, e["entrypoint"]
+    raise RuntimeError(f"Evaluation for algorithm '{algo_name}' is not registered")

@@ -1,0 +1,29 @@
+"""Test-global setup: fake an 8-device CPU mesh before jax initializes.
+
+Mirrors the reference test strategy (tests/conftest.py + LT_DEVICES
+parametrization, SURVEY.md §4): algorithms are exercised on CPU with tiny
+configs; multi-device paths run on an XLA host-platform mesh instead of a
+real pod.
+"""
+
+import os
+
+# must be set before jax is imported anywhere
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _no_env_leaks():
+    """Guard against tests leaking SHEEPRL_* env vars (reference conftest.py:20-61)."""
+    before = {k: v for k, v in os.environ.items() if k.startswith("SHEEPRL_")}
+    yield
+    after = {k: v for k, v in os.environ.items() if k.startswith("SHEEPRL_")}
+    for k in after:
+        if k not in before:
+            del os.environ[k]
+    os.environ.update(before)

@@ -1,0 +1,81 @@
+import pytest
+
+from sheeprl_tpu.config import (
+    ConfigError,
+    MissingValueError,
+    compose,
+    dotdict,
+    instantiate,
+    validate_no_missing,
+)
+
+
+def test_compose_requires_exp():
+    with pytest.raises(ConfigError, match="exp"):
+        compose()
+
+
+def test_compose_ppo_defaults():
+    cfg = compose(overrides=["exp=ppo"])
+    assert cfg.algo.name == "ppo"
+    assert cfg.env.id == "CartPole-v1"
+    assert cfg.buffer.size == cfg.algo.rollout_steps == 128
+    assert cfg.algo.optimizer["_target_"] == "optax.adam"
+    assert isinstance(cfg.algo.optimizer.learning_rate, float)
+    assert cfg.exp_name == "ppo_CartPole-v1"
+
+
+def test_cli_value_overrides():
+    cfg = compose(overrides=["exp=ppo", "algo.total_steps=999", "env.num_envs=1", "seed=7"])
+    assert cfg.algo.total_steps == 999
+    assert cfg.env.num_envs == 1
+    assert cfg.seed == 7
+    # interpolation sees the override
+    assert cfg.run_name.endswith("ppo_CartPole-v1_7")
+
+
+def test_cli_group_selection_beats_exp_override():
+    cfg = compose(overrides=["exp=ppo", "env=dummy"])
+    assert cfg.env.id == "dummy_discrete"
+
+
+def test_add_and_delete_overrides():
+    cfg = compose(overrides=["exp=ppo", "+extra.nested=3", "~model_manager.models"])
+    assert cfg.extra.nested == 3
+    assert "models" not in cfg.model_manager
+
+
+def test_interpolation_chain():
+    cfg = compose(overrides=["exp=ppo", "algo.dense_units=32"])
+    assert cfg.algo.encoder.dense_units == 32
+    assert cfg.algo.critic.dense_units == 32
+
+
+def test_missing_marker_access_raises():
+    d = dotdict({"a": "???"})
+    with pytest.raises(MissingValueError):
+        _ = d.a
+    assert validate_no_missing({"x": {"y": "???"}, "z": 1}) == ["x.y"]
+
+
+def test_instantiate_target():
+    node = {"_target_": "collections.OrderedDict", "a": 1}
+    od = instantiate(node)
+    assert od["a"] == 1
+    part = instantiate({"_target_": "operator.add", "_partial_": True})
+    assert part(2, 3) == 5
+
+
+def test_search_path_env(tmp_path, monkeypatch):
+    exp = tmp_path / "exp"
+    exp.mkdir()
+    (exp / "custom.yaml").write_text(
+        "# @package _global_\n"
+        "defaults:\n  - override /algo: ppo\n  - override /env: dummy\n  - _self_\n"
+        "algo:\n  total_steps: 17\n  per_rank_batch_size: 4\n"
+        "buffer:\n  size: 8\n"
+    )
+    monkeypatch.setenv("SHEEPRL_SEARCH_PATH", f"file://{tmp_path}")
+    cfg = compose(overrides=["exp=custom"])
+    assert cfg.algo.total_steps == 17
+    assert cfg.env.id == "dummy_discrete"
