@@ -1,0 +1,279 @@
+"""NN building blocks as flax.linen modules.
+
+TPU-native counterpart of reference sheeprl/models/models.py (MLP:16,
+CNN:122, DeCNN:205, NatureCNN:288, LayerNormGRUCell:331, MultiEncoder:413,
+MultiDecoder:478, LayerNormChannelLast:507, LayerNorm:521).
+
+Idiomatic differences from the torch reference (deliberate, not drift):
+- flax shape inference: no ``input_dims`` arguments;
+- images are **NHWC** end-to-end (XLA's native TPU conv layout); the
+  reference is NCHW;
+- dtype policy: modules compute in ``compute_dtype`` (bf16 on TPU for the
+  MXU) while parameters stay fp32; LayerNorm always reduces in fp32 (the
+  reference's dtype-preserving LayerNorm:521 restores input dtype — same
+  effect here via ``dtype``/``param_dtype`` split).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+# --------------------------------------------------------------------------- #
+# activation / init resolvers
+# --------------------------------------------------------------------------- #
+_ACTIVATIONS: Dict[str, Callable] = {
+    "relu": jax.nn.relu,
+    "tanh": jnp.tanh,
+    "silu": jax.nn.silu,
+    "swish": jax.nn.silu,
+    "elu": jax.nn.elu,
+    "gelu": jax.nn.gelu,
+    "leaky_relu": jax.nn.leaky_relu,
+    "sigmoid": jax.nn.sigmoid,
+    "identity": lambda x: x,
+}
+# accept reference-style names so existing configs run unmodified
+_TORCH_ALIASES = {
+    "torch.nn.relu": "relu",
+    "torch.nn.tanh": "tanh",
+    "torch.nn.silu": "silu",
+    "torch.nn.elu": "elu",
+    "torch.nn.gelu": "gelu",
+    "torch.nn.leakyrelu": "leaky_relu",
+    "torch.nn.sigmoid": "sigmoid",
+    "torch.nn.identity": "identity",
+}
+
+
+def resolve_activation(act: Union[str, Callable, None]) -> Callable:
+    if act is None:
+        return lambda x: x
+    if callable(act):
+        return act
+    key = str(act).lower()
+    key = _TORCH_ALIASES.get(key, key)
+    if key not in _ACTIVATIONS:
+        raise ValueError(f"Unknown activation '{act}'. Known: {sorted(_ACTIVATIONS)}")
+    return _ACTIVATIONS[key]
+
+
+def _per_layer(spec: Any, n: int) -> list:
+    """Broadcast a scalar spec to n layers (reference utils/model.py create_layers)."""
+    if isinstance(spec, (list, tuple)):
+        if len(spec) != n:
+            raise ValueError(f"Per-layer spec length {len(spec)} != num layers {n}")
+        return list(spec)
+    return [spec] * n
+
+
+Dtype = Any
+
+
+class MLP(nn.Module):
+    """MLP with optional per-layer LayerNorm / dropout, pre-activation norm
+    ordering matching the reference miniblock (linear -> dropout -> norm -> act).
+    """
+
+    hidden_sizes: Sequence[int] = ()
+    output_dim: Optional[int] = None
+    activation: Any = "relu"
+    layer_norm: Any = False
+    norm_args: Any = None
+    dropout: Any = 0.0
+    flatten_dim: Optional[int] = None
+    dtype: Dtype = jnp.float32
+    param_dtype: Dtype = jnp.float32
+    kernel_init: Any = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array, deterministic: bool = True) -> jax.Array:
+        n = len(self.hidden_sizes)
+        acts = [resolve_activation(a) for a in _per_layer(self.activation, n)]
+        norms = _per_layer(self.layer_norm, n)
+        norm_args = _per_layer(self.norm_args, n)
+        drops = _per_layer(self.dropout, n)
+        if self.flatten_dim is not None:
+            x = x.reshape(x.shape[: self.flatten_dim] + (-1,))
+        kinit = self.kernel_init or nn.initializers.lecun_normal()
+        for i, size in enumerate(self.hidden_sizes):
+            x = nn.Dense(size, dtype=self.dtype, param_dtype=self.param_dtype, kernel_init=kinit)(x)
+            if drops[i]:
+                x = nn.Dropout(rate=float(drops[i]))(x, deterministic=deterministic)
+            if norms[i]:
+                eps = (norm_args[i] or {}).get("eps", 1e-5) if isinstance(norm_args[i], dict) else 1e-5
+                x = nn.LayerNorm(epsilon=eps, dtype=self.dtype, param_dtype=self.param_dtype)(x)
+            x = acts[i](x)
+        if self.output_dim is not None:
+            x = nn.Dense(self.output_dim, dtype=self.dtype, param_dtype=self.param_dtype, kernel_init=kinit)(x)
+        return x
+
+
+class CNN(nn.Module):
+    """Conv stack over NHWC inputs (reference CNN:122 is NCHW)."""
+
+    channels: Sequence[int]
+    kernel_sizes: Any = 3
+    strides: Any = 1
+    paddings: Any = "SAME"
+    activation: Any = "relu"
+    layer_norm: Any = False
+    dtype: Dtype = jnp.float32
+    param_dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        n = len(self.channels)
+        ks = _per_layer(self.kernel_sizes, n)
+        ss = _per_layer(self.strides, n)
+        ps = _per_layer(self.paddings, n)
+        acts = [resolve_activation(a) for a in _per_layer(self.activation, n)]
+        norms = _per_layer(self.layer_norm, n)
+        for i, ch in enumerate(self.channels):
+            k = ks[i] if isinstance(ks[i], (tuple, list)) else (ks[i], ks[i])
+            s = ss[i] if isinstance(ss[i], (tuple, list)) else (ss[i], ss[i])
+            pad = ps[i] if isinstance(ps[i], str) else [(ps[i], ps[i])] * 2
+            x = nn.Conv(ch, k, strides=s, padding=pad, dtype=self.dtype, param_dtype=self.param_dtype)(x)
+            if norms[i]:
+                # channel-last LayerNorm == reference LayerNormChannelLast:507
+                x = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype)(x)
+            x = acts[i](x)
+        return x
+
+
+class DeCNN(nn.Module):
+    """Transposed-conv stack over NHWC inputs (reference DeCNN:205)."""
+
+    channels: Sequence[int]
+    kernel_sizes: Any = 3
+    strides: Any = 1
+    paddings: Any = "SAME"
+    activation: Any = "relu"
+    layer_norm: Any = False
+    dtype: Dtype = jnp.float32
+    param_dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        n = len(self.channels)
+        ks = _per_layer(self.kernel_sizes, n)
+        ss = _per_layer(self.strides, n)
+        ps = _per_layer(self.paddings, n)
+        acts = [resolve_activation(a) for a in _per_layer(self.activation, n)]
+        norms = _per_layer(self.layer_norm, n)
+        for i, ch in enumerate(self.channels):
+            k = ks[i] if isinstance(ks[i], (tuple, list)) else (ks[i], ks[i])
+            s = ss[i] if isinstance(ss[i], (tuple, list)) else (ss[i], ss[i])
+            pad = ps[i] if isinstance(ps[i], str) else [(ps[i], ps[i])] * 2
+            x = nn.ConvTranspose(ch, k, strides=s, padding=pad, dtype=self.dtype, param_dtype=self.param_dtype)(x)
+            if norms[i]:
+                x = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype)(x)
+            x = acts[i](x)
+        return x
+
+
+class NatureCNN(nn.Module):
+    """DQN 'Nature' conv stack + dense head (reference NatureCNN:288).
+    Input NHWC, output (..., features_dim)."""
+
+    features_dim: int
+    screen_size: int = 64
+    activation: Any = "relu"
+    dtype: Dtype = jnp.float32
+    param_dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        act = resolve_activation(self.activation)
+        kw = dict(dtype=self.dtype, param_dtype=self.param_dtype, padding="VALID")
+        x = act(nn.Conv(32, (8, 8), strides=(4, 4), **kw)(x))
+        x = act(nn.Conv(64, (4, 4), strides=(2, 2), **kw)(x))
+        x = act(nn.Conv(64, (3, 3), strides=(1, 1), **kw)(x))
+        x = x.reshape(x.shape[:-3] + (-1,))
+        x = act(nn.Dense(self.features_dim, dtype=self.dtype, param_dtype=self.param_dtype)(x))
+        return x
+
+
+class LayerNormGRUCell(nn.Module):
+    """Hafner-style GRU cell: one dense over [x, h] -> LayerNorm -> split into
+    reset/candidate/update, with the update-gate ``-1`` bias trick
+    (reference LayerNormGRUCell:331, from danijar/dreamerv2)."""
+
+    hidden_size: int
+    use_bias: bool = False
+    layer_norm: bool = True
+    dtype: Dtype = jnp.float32
+    param_dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, h: jax.Array, x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        inp = jnp.concatenate([h, x], axis=-1)
+        parts = nn.Dense(
+            3 * self.hidden_size,
+            use_bias=self.use_bias,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+        )(inp)
+        if self.layer_norm:
+            parts = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype)(parts)
+        reset, cand, update = jnp.split(parts, 3, axis=-1)
+        reset = jax.nn.sigmoid(reset)
+        cand = jnp.tanh(reset * cand)
+        update = jax.nn.sigmoid(update - 1.0)
+        new_h = update * cand + (1.0 - update) * h
+        return new_h, new_h
+
+
+class MultiEncoder(nn.Module):
+    """Concat features of a CNN encoder (over stacked image keys) and an MLP
+    encoder (over stacked vector keys). Reference MultiEncoder:413.
+
+    Sub-encoders are passed as modules; obs is a dict. CNN keys are
+    concatenated on the channel (last) axis, MLP keys on the feature axis.
+    """
+
+    cnn_encoder: Optional[nn.Module] = None
+    mlp_encoder: Optional[nn.Module] = None
+    cnn_keys: Sequence[str] = ()
+    mlp_keys: Sequence[str] = ()
+
+    def __call__(self, obs: Dict[str, jax.Array]) -> jax.Array:
+        feats = []
+        if self.cnn_encoder is not None and len(self.cnn_keys) > 0:
+            imgs = jnp.concatenate([obs[k] for k in self.cnn_keys], axis=-1)
+            feats.append(self.cnn_encoder(imgs))
+        if self.mlp_encoder is not None and len(self.mlp_keys) > 0:
+            vecs = jnp.concatenate([obs[k] for k in self.mlp_keys], axis=-1)
+            feats.append(self.mlp_encoder(vecs))
+        if not feats:
+            raise ValueError("MultiEncoder needs at least one of cnn/mlp encoders")
+        return jnp.concatenate(feats, axis=-1) if len(feats) > 1 else feats[0]
+
+
+class MultiDecoder(nn.Module):
+    """Union of a CNN decoder (image keys) and MLP decoders (vector keys);
+    returns a dict of reconstructions. Reference MultiDecoder:478."""
+
+    cnn_decoder: Optional[nn.Module] = None
+    mlp_decoder: Optional[nn.Module] = None
+    cnn_keys: Sequence[str] = ()
+    mlp_keys: Sequence[str] = ()
+    cnn_channels: Sequence[int] = ()
+    mlp_dims: Sequence[int] = ()
+
+    def __call__(self, latent: jax.Array) -> Dict[str, jax.Array]:
+        out: Dict[str, jax.Array] = {}
+        if self.cnn_decoder is not None and len(self.cnn_keys) > 0:
+            rec = self.cnn_decoder(latent)
+            splits = list(jnp.cumsum(jnp.asarray(self.cnn_channels))[:-1])
+            chunks = jnp.split(rec, splits, axis=-1) if splits else [rec]
+            out.update(dict(zip(self.cnn_keys, chunks)))
+        if self.mlp_decoder is not None and len(self.mlp_keys) > 0:
+            rec = self.mlp_decoder(latent)
+            splits = list(jnp.cumsum(jnp.asarray(self.mlp_dims))[:-1])
+            chunks = jnp.split(rec, splits, axis=-1) if splits else [rec]
+            out.update(dict(zip(self.mlp_keys, chunks)))
+        return out

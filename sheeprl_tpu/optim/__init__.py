@@ -1,0 +1,67 @@
+"""Optimizers: optax plus a TF-flavoured RMSProp.
+
+``rmsprop_tf`` matches reference sheeprl/optim/rmsprop_tf.py:14 — epsilon
+inside the sqrt, square-average accumulator initialized to ones, and
+learning rate folded into the momentum buffer — which is what Dreamer
+V1/V2 configs expect.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def rmsprop_tf(
+    learning_rate: float,
+    decay: float = 0.9,
+    eps: float = 1e-8,
+    momentum: float = 0.0,
+    centered: bool = False,
+    weight_decay: float = 0.0,
+) -> optax.GradientTransformation:
+    def init_fn(params):
+        acc = jax.tree_util.tree_map(jnp.ones_like, params)  # ones, not zeros
+        mom = jax.tree_util.tree_map(jnp.zeros_like, params) if momentum > 0 else None
+        mg = jax.tree_util.tree_map(jnp.zeros_like, params) if centered else None
+        return {"acc": acc, "mom": mom, "mg": mg}
+
+    def update_fn(updates, state, params=None):
+        grads = updates
+        if weight_decay and params is not None:
+            grads = jax.tree_util.tree_map(lambda g, p: g + weight_decay * p, grads, params)
+        acc = jax.tree_util.tree_map(
+            lambda a, g: a * decay + (1 - decay) * (g * g), state["acc"], grads
+        )
+        if centered:
+            mg = jax.tree_util.tree_map(lambda m, g: m * decay + (1 - decay) * g, state["mg"], grads)
+            denom = jax.tree_util.tree_map(lambda a, m: jnp.sqrt(a - m * m + eps), acc, mg)
+        else:
+            mg = None
+            denom = jax.tree_util.tree_map(lambda a: jnp.sqrt(a + eps), acc)  # eps inside sqrt
+        if momentum > 0:
+            mom = jax.tree_util.tree_map(
+                lambda b, g, d: b * momentum + learning_rate * g / d, state["mom"], grads, denom
+            )
+            new_updates = jax.tree_util.tree_map(lambda m: -m, mom)
+        else:
+            mom = None
+            new_updates = jax.tree_util.tree_map(lambda g, d: -learning_rate * g / d, grads, denom)
+        return new_updates, {"acc": acc, "mom": mom, "mg": mg}
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def build_optimizer(optim_cfg: dict, max_grad_norm: Optional[float] = None) -> optax.GradientTransformation:
+    """Instantiate an optax optimizer from a `_target_` config node, with
+    optional global-norm clipping chained in front (fabric.clip_gradients
+    equivalent)."""
+    from sheeprl_tpu.config import instantiate
+
+    tx = instantiate(dict(optim_cfg))
+    if max_grad_norm is not None and max_grad_norm > 0:
+        tx = optax.chain(optax.clip_by_global_norm(float(max_grad_norm)), tx)
+    return tx

@@ -1,0 +1,397 @@
+"""Probability distributions in pure jax.
+
+Re-implements the distribution zoo of reference sheeprl/utils/distribution.py
+(TruncatedNormal:116, SymlogDistribution:152, MSEDistribution:196,
+TwoHotEncodingDistribution:224, OneHotCategoricalValidateArgs:281,
+OneHotCategoricalStraightThrough:387, BernoulliSafeMode:409) plus the
+torch.distributions primitives the algorithms rely on (Normal, Independent,
+Categorical, TanhNormal for SAC).
+
+Distributions are plain python containers over jnp arrays; they are created
+inside jit-traced functions, so every method must be traceable (no python
+branching on array values). Sampling takes an explicit PRNG key.
+Straight-through gradients use the ``sg(x) + p - sg(p)`` identity instead of
+torch's ``.rsample`` machinery.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from sheeprl_tpu.utils.utils import symexp, symlog
+
+sg = jax.lax.stop_gradient
+_HALF_LOG_2PI = 0.5 * math.log(2 * math.pi)
+
+
+class Distribution:
+    """Minimal distribution interface: log_prob / sample / rsample / mode /
+    mean / entropy. ``sample`` is stop-gradient of ``rsample`` where a
+    reparameterized path exists."""
+
+    def log_prob(self, x: jax.Array) -> jax.Array:
+        raise NotImplementedError
+
+    def rsample(self, key: jax.Array, sample_shape: Tuple[int, ...] = ()) -> jax.Array:
+        raise NotImplementedError
+
+    def sample(self, key: jax.Array, sample_shape: Tuple[int, ...] = ()) -> jax.Array:
+        return sg(self.rsample(key, sample_shape))
+
+    @property
+    def mode(self) -> jax.Array:
+        raise NotImplementedError
+
+    @property
+    def mean(self) -> jax.Array:
+        raise NotImplementedError
+
+    def entropy(self) -> jax.Array:
+        raise NotImplementedError
+
+
+class Normal(Distribution):
+    def __init__(self, loc: jax.Array, scale: jax.Array):
+        self.loc = loc
+        self.scale = scale
+
+    def log_prob(self, x: jax.Array) -> jax.Array:
+        var = self.scale**2
+        return -((x - self.loc) ** 2) / (2 * var) - jnp.log(self.scale) - _HALF_LOG_2PI
+
+    def rsample(self, key: jax.Array, sample_shape: Tuple[int, ...] = ()) -> jax.Array:
+        shape = sample_shape + jnp.broadcast_shapes(self.loc.shape, self.scale.shape)
+        eps = jax.random.normal(key, shape, dtype=jnp.result_type(self.loc))
+        return self.loc + eps * self.scale
+
+    @property
+    def mode(self) -> jax.Array:
+        return self.loc
+
+    @property
+    def mean(self) -> jax.Array:
+        return self.loc
+
+    @property
+    def stddev(self) -> jax.Array:
+        return self.scale
+
+    def entropy(self) -> jax.Array:
+        return 0.5 + _HALF_LOG_2PI + jnp.log(self.scale)
+
+
+class Independent(Distribution):
+    """Sums log_prob/entropy over the last ``reinterpreted_batch_ndims`` dims."""
+
+    def __init__(self, base: Distribution, reinterpreted_batch_ndims: int = 1):
+        self.base = base
+        self.ndims = reinterpreted_batch_ndims
+
+    def _reduce(self, x: jax.Array) -> jax.Array:
+        if self.ndims == 0:
+            return x
+        return x.sum(axis=tuple(range(-self.ndims, 0)))
+
+    def log_prob(self, x: jax.Array) -> jax.Array:
+        return self._reduce(self.base.log_prob(x))
+
+    def rsample(self, key, sample_shape=()):
+        return self.base.rsample(key, sample_shape)
+
+    def sample(self, key, sample_shape=()):
+        return self.base.sample(key, sample_shape)
+
+    @property
+    def mode(self):
+        return self.base.mode
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    def entropy(self) -> jax.Array:
+        return self._reduce(self.base.entropy())
+
+
+class TanhNormal(Distribution):
+    """tanh-squashed diagonal Normal (SAC actor — reference
+    sheeprl/algos/sac/agent.py:57-143 uses TanhTransform on Normal)."""
+
+    def __init__(self, loc: jax.Array, scale: jax.Array, eps: float = 1e-6):
+        self.base = Normal(loc, scale)
+        self.eps = eps
+
+    def rsample_and_log_prob(self, key: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        x = self.base.rsample(key)
+        y = jnp.tanh(x)
+        # log|d tanh / dx| = 2*(log2 - x - softplus(-2x)) — numerically stable
+        log_det = 2.0 * (math.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+        logp = self.base.log_prob(x) - log_det
+        return y, logp
+
+    def log_prob(self, y: jax.Array) -> jax.Array:
+        x = jnp.arctanh(jnp.clip(y, -1.0 + self.eps, 1.0 - self.eps))
+        log_det = 2.0 * (math.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+        return self.base.log_prob(x) - log_det
+
+    def rsample(self, key, sample_shape=()):
+        return jnp.tanh(self.base.rsample(key, sample_shape))
+
+    @property
+    def mode(self):
+        return jnp.tanh(self.base.loc)
+
+    @property
+    def mean(self):
+        return jnp.tanh(self.base.loc)
+
+
+class TruncatedNormal(Distribution):
+    """Normal truncated to [low, high] (reference utils/distribution.py:25-150,
+    used by DreamerV1's action head)."""
+
+    def __init__(self, loc: jax.Array, scale: jax.Array, low: float = -1.0, high: float = 1.0):
+        self.loc, self.scale, self.low, self.high = loc, scale, low, high
+        self._a = (low - loc) / scale
+        self._b = (high - loc) / scale
+        sqrt2 = math.sqrt(2.0)
+        self._phi_a = 0.5 * (1 + jax.scipy.special.erf(self._a / sqrt2))
+        self._phi_b = 0.5 * (1 + jax.scipy.special.erf(self._b / sqrt2))
+        self._z = jnp.clip(self._phi_b - self._phi_a, 1e-8, None)
+
+    def log_prob(self, x: jax.Array) -> jax.Array:
+        xi = (x - self.loc) / self.scale
+        return -(xi**2) / 2 - _HALF_LOG_2PI - jnp.log(self.scale) - jnp.log(self._z)
+
+    def rsample(self, key: jax.Array, sample_shape: Tuple[int, ...] = ()) -> jax.Array:
+        shape = sample_shape + jnp.broadcast_shapes(self.loc.shape, self.scale.shape)
+        u = jax.random.uniform(key, shape, dtype=jnp.result_type(self.loc), minval=1e-6, maxval=1.0 - 1e-6)
+        p = self._phi_a + u * (self._phi_b - self._phi_a)
+        x = self.loc + self.scale * jnp.sqrt(2.0) * jax.scipy.special.erfinv(2 * p - 1)
+        return jnp.clip(x, self.low + 1e-6, self.high - 1e-6)
+
+    @property
+    def mode(self):
+        return jnp.clip(self.loc, self.low, self.high)
+
+    @property
+    def mean(self):
+        # E[X] = loc + scale * (pdf(a) - pdf(b)) / Z
+        pdf = lambda t: jnp.exp(-(t**2) / 2) / math.sqrt(2 * math.pi)  # noqa: E731
+        return self.loc + self.scale * (pdf(self._a) - pdf(self._b)) / self._z
+
+
+class Categorical(Distribution):
+    def __init__(self, logits: Optional[jax.Array] = None, probs: Optional[jax.Array] = None):
+        if logits is None:
+            logits = jnp.log(jnp.clip(probs, 1e-10, None))
+        self.logits = logits - jax.scipy.special.logsumexp(logits, axis=-1, keepdims=True)
+
+    @property
+    def probs(self) -> jax.Array:
+        return jax.nn.softmax(self.logits, axis=-1)
+
+    def log_prob(self, x: jax.Array) -> jax.Array:
+        x = x.astype(jnp.int32)
+        return jnp.take_along_axis(self.logits, x[..., None], axis=-1).squeeze(-1)
+
+    def sample(self, key: jax.Array, sample_shape: Tuple[int, ...] = ()) -> jax.Array:
+        return jax.random.categorical(key, self.logits, shape=sample_shape + self.logits.shape[:-1])
+
+    @property
+    def mode(self):
+        return jnp.argmax(self.logits, axis=-1)
+
+    def entropy(self) -> jax.Array:
+        p = self.probs
+        return -(p * self.logits).sum(-1)
+
+
+class OneHotCategorical(Distribution):
+    """One-hot samples; log_prob of one-hot inputs (reference
+    OneHotCategoricalValidateArgs, utils/distribution.py:281)."""
+
+    def __init__(self, logits: Optional[jax.Array] = None, probs: Optional[jax.Array] = None):
+        self._cat = Categorical(logits=logits, probs=probs)
+
+    @property
+    def logits(self):
+        return self._cat.logits
+
+    @property
+    def probs(self):
+        return self._cat.probs
+
+    def log_prob(self, x: jax.Array) -> jax.Array:
+        return (self._cat.logits * x).sum(-1)
+
+    def sample(self, key: jax.Array, sample_shape: Tuple[int, ...] = ()) -> jax.Array:
+        idx = self._cat.sample(key, sample_shape)
+        return jax.nn.one_hot(idx, self.logits.shape[-1], dtype=self.logits.dtype)
+
+    @property
+    def mode(self):
+        return jax.nn.one_hot(self._cat.mode, self.logits.shape[-1], dtype=self.logits.dtype)
+
+    @property
+    def mean(self):
+        return self.probs
+
+    def entropy(self) -> jax.Array:
+        return self._cat.entropy()
+
+
+class OneHotCategoricalStraightThrough(OneHotCategorical):
+    """One-hot samples with straight-through gradients to ``probs``
+    (reference utils/distribution.py:387-404; Dreamer V2/V3 latents)."""
+
+    def rsample(self, key: jax.Array, sample_shape: Tuple[int, ...] = ()) -> jax.Array:
+        hard = self.sample(key, sample_shape)
+        p = self.probs
+        return sg(hard) + p - sg(p)
+
+
+class Bernoulli(Distribution):
+    def __init__(self, logits: Optional[jax.Array] = None, probs: Optional[jax.Array] = None):
+        if logits is None:
+            logits = jnp.log(jnp.clip(probs, 1e-10, None)) - jnp.log(jnp.clip(1 - probs, 1e-10, None))
+        self.logits = logits
+
+    @property
+    def probs(self) -> jax.Array:
+        return jax.nn.sigmoid(self.logits)
+
+    def log_prob(self, x: jax.Array) -> jax.Array:
+        # -BCEWithLogits
+        return x * jax.nn.log_sigmoid(self.logits) + (1 - x) * jax.nn.log_sigmoid(-self.logits)
+
+    def sample(self, key: jax.Array, sample_shape: Tuple[int, ...] = ()) -> jax.Array:
+        shape = sample_shape + self.logits.shape
+        u = jax.random.uniform(key, shape)
+        return (u < self.probs).astype(self.logits.dtype)
+
+    @property
+    def mean(self):
+        return self.probs
+
+    def entropy(self) -> jax.Array:
+        p = self.probs
+        return -(p * jax.nn.log_sigmoid(self.logits) + (1 - p) * jax.nn.log_sigmoid(-self.logits))
+
+
+class BernoulliSafeMode(Bernoulli):
+    """Bernoulli whose mode is the >0.5 indicator with no NaNs
+    (reference utils/distribution.py:409-416; Dreamer continue model)."""
+
+    @property
+    def mode(self):
+        return (self.probs > 0.5).astype(self.logits.dtype)
+
+
+class SymlogDistribution(Distribution):
+    """'Distribution' whose log_prob is -|symlog(x) - mode|^2 (MSE in symlog
+    space), summed over event dims (reference utils/distribution.py:152-194)."""
+
+    def __init__(self, mode: jax.Array, dims: int = 1, agg: str = "sum"):
+        self._mode = mode
+        self._dims = tuple(range(-dims, 0)) if dims else ()
+        self._agg = agg
+
+    @property
+    def mode(self) -> jax.Array:
+        return symexp(self._mode)
+
+    @property
+    def mean(self) -> jax.Array:
+        return symexp(self._mode)
+
+    def log_prob(self, value: jax.Array) -> jax.Array:
+        distance = -((self._mode - symlog(value)) ** 2)
+        if self._agg == "mean":
+            return distance.mean(self._dims) if self._dims else distance
+        return distance.sum(self._dims) if self._dims else distance
+
+
+class MSEDistribution(Distribution):
+    """-MSE log_prob in raw space (reference utils/distribution.py:196-222)."""
+
+    def __init__(self, mode: jax.Array, dims: int = 1, agg: str = "sum"):
+        self._mode = mode
+        self._dims = tuple(range(-dims, 0)) if dims else ()
+        self._agg = agg
+
+    @property
+    def mode(self) -> jax.Array:
+        return self._mode
+
+    @property
+    def mean(self) -> jax.Array:
+        return self._mode
+
+    def log_prob(self, value: jax.Array) -> jax.Array:
+        distance = -((self._mode - value) ** 2)
+        if self._agg == "mean":
+            return distance.mean(self._dims) if self._dims else distance
+        return distance.sum(self._dims) if self._dims else distance
+
+
+class TwoHotEncodingDistribution(Distribution):
+    """Two-hot categorical over a symexp-spaced support in symlog space —
+    DreamerV3's reward/critic head (reference utils/distribution.py:224-279;
+    255 bins over [-20, 20])."""
+
+    def __init__(self, logits: jax.Array, dims: int = 1, low: float = -20.0, high: float = 20.0):
+        self.logits = logits - jax.scipy.special.logsumexp(logits, -1, keepdims=True)
+        self.probs = jax.nn.softmax(logits, -1)
+        self._dims = tuple(range(-dims, 0))
+        self.bins = jnp.linspace(low, high, logits.shape[-1])
+        self.low, self.high = low, high
+
+    @property
+    def mean(self) -> jax.Array:
+        return symexp((self.probs * self.bins).sum(-1, keepdims=True))
+
+    @property
+    def mode(self) -> jax.Array:
+        return self.mean
+
+    def log_prob(self, x: jax.Array) -> jax.Array:
+        """x: (..., 1) raw-space scalars; returns (...,) summed over event dims."""
+        x = symlog(x)
+        nbins = self.bins.shape[0]
+        below = (self.bins <= x).astype(jnp.int32).sum(-1, keepdims=True) - 1
+        below = jnp.clip(below, 0, nbins - 1)
+        above = jnp.clip(below + 1, 0, nbins - 1)
+        equal = below == above
+        dist_below = jnp.where(equal, 1.0, jnp.abs(jnp.take(self.bins, below.squeeze(-1))[..., None] - x))
+        dist_above = jnp.where(equal, 1.0, jnp.abs(jnp.take(self.bins, above.squeeze(-1))[..., None] - x))
+        total = dist_below + dist_above
+        w_below = dist_above / total
+        w_above = dist_below / total
+        target = (
+            jax.nn.one_hot(below.squeeze(-1), nbins) * w_below
+            + jax.nn.one_hot(above.squeeze(-1), nbins) * w_above
+        )
+        log_pred = self.logits
+        return (target * log_pred).sum(-1, keepdims=True).sum(self._dims)
+
+
+def kl_divergence(p: Distribution, q: Distribution) -> jax.Array:
+    """KL(p || q) for the pairs the algorithms need."""
+    if isinstance(p, Independent) and isinstance(q, Independent):
+        base = kl_divergence(p.base, q.base)
+        return base.sum(axis=tuple(range(-p.ndims, 0))) if p.ndims else base
+    if isinstance(p, (OneHotCategorical, Categorical)) and isinstance(q, (OneHotCategorical, Categorical)):
+        pl = p.logits if isinstance(p, Categorical) else p._cat.logits
+        ql = q.logits if isinstance(q, Categorical) else q._cat.logits
+        pp = jax.nn.softmax(pl, -1)
+        return (pp * (pl - ql)).sum(-1)
+    if isinstance(p, Normal) and isinstance(q, Normal):
+        var_ratio = (p.scale / q.scale) ** 2
+        t1 = ((p.loc - q.loc) / q.scale) ** 2
+        return 0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio))
+    raise NotImplementedError(f"KL({type(p).__name__} || {type(q).__name__})")

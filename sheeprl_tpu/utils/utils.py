@@ -1,0 +1,247 @@
+"""Core math / training utilities (jax).
+
+TPU-native re-implementations of reference sheeprl/utils/utils.py:
+- gae:64 -> reverse ``lax.scan`` (single fused XLA loop instead of a python
+  time loop);
+- symlog:150 / symexp:154, two_hot_encoder:158 / two_hot_decoder:183;
+- polynomial_decay:135, normalize_tensor:122;
+- Ratio:261 (host-side replay-ratio scheduler, identical semantics);
+- dotdict:34 lives in sheeprl_tpu.config.compose.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.config.compose import dotdict  # noqa: F401  (re-export)
+
+# numpy <-> jax dtype maps (reference utils/utils.py:18-33)
+NUMPY_TO_JAX_DTYPE = {
+    np.dtype("bool"): jnp.bool_,
+    np.dtype("uint8"): jnp.uint8,
+    np.dtype("int8"): jnp.int8,
+    np.dtype("int32"): jnp.int32,
+    np.dtype("int64"): jnp.int32,  # TPU has no int64 by default
+    np.dtype("float16"): jnp.float16,
+    np.dtype("float32"): jnp.float32,
+    np.dtype("float64"): jnp.float32,
+}
+
+
+def symlog(x: jax.Array) -> jax.Array:
+    return jnp.sign(x) * jnp.log1p(jnp.abs(x))
+
+
+def symexp(x: jax.Array) -> jax.Array:
+    return jnp.sign(x) * (jnp.exp(jnp.abs(x)) - 1.0)
+
+
+def two_hot_encoder(x: jax.Array, support_range: int = 300, num_buckets: Optional[int] = None) -> jax.Array:
+    """Two-hot encode ``x`` (symlog-compressed) over a symexp-spaced support.
+
+    Equivalent of reference utils/utils.py:158-180: support has
+    ``num_buckets`` bins spanning ``[-support_range, support_range]``.
+    Input shape (..., 1) -> output (..., num_buckets).
+    """
+    if num_buckets is None:
+        num_buckets = support_range * 2 + 1
+    x = jnp.clip(symlog(x), -support_range, support_range)
+    support = jnp.linspace(-support_range, support_range, num_buckets)
+    below = (support <= x).astype(jnp.int32).sum(-1, keepdims=True) - 1
+    below = jnp.clip(below, 0, num_buckets - 1)
+    above = jnp.clip(below + 1, 0, num_buckets - 1)
+    sup_below = jnp.take(support, below.squeeze(-1))[..., None]
+    sup_above = jnp.take(support, above.squeeze(-1))[..., None]
+    equal = below == above
+    dist_below = jnp.where(equal, 1.0, jnp.abs(sup_below - x))
+    dist_above = jnp.where(equal, 1.0, jnp.abs(sup_above - x))
+    total = dist_below + dist_above
+    w_below = dist_above / total
+    w_above = dist_below / total
+    oh_below = jax.nn.one_hot(below.squeeze(-1), num_buckets) * w_below
+    oh_above = jax.nn.one_hot(above.squeeze(-1), num_buckets) * w_above
+    return oh_below + oh_above
+
+
+def two_hot_decoder(probs: jax.Array, support_range: int) -> jax.Array:
+    """Decode a two-hot distribution back to a scalar (..., 1)."""
+    num_buckets = probs.shape[-1]
+    support = jnp.linspace(-support_range, support_range, num_buckets)
+    return symexp((probs * support).sum(-1, keepdims=True))
+
+
+def gae(
+    rewards: jax.Array,
+    values: jax.Array,
+    dones: jax.Array,
+    next_value: jax.Array,
+    gamma: float,
+    gae_lambda: float,
+) -> Tuple[jax.Array, jax.Array]:
+    """Generalized advantage estimation over time-major inputs.
+
+    ``rewards``/``values``/``dones``: (T, B, 1); ``next_value``: (B, 1).
+    Returns (returns, advantages), both (T, B, 1).
+
+    Reference: sheeprl/utils/utils.py:64-102 (python loop over T);
+    here a reverse ``lax.scan`` so the whole thing is one XLA op.
+    """
+    not_done = 1.0 - dones.astype(values.dtype)
+    next_values = jnp.concatenate([values[1:], next_value[None]], axis=0)
+
+    def step(lastgaelam, inp):
+        rew, nd, val, next_val = inp
+        delta = rew + gamma * next_val * nd - val
+        lastgaelam = delta + gamma * gae_lambda * nd * lastgaelam
+        return lastgaelam, lastgaelam
+
+    _, advantages = jax.lax.scan(
+        step,
+        jnp.zeros_like(next_value),
+        (rewards, not_done, values, next_values),
+        reverse=True,
+    )
+    returns = advantages + values
+    return returns, advantages
+
+
+def lambda_values(
+    rewards: jax.Array,
+    values: jax.Array,
+    continues: jax.Array,
+    lmbda: float = 0.95,
+) -> jax.Array:
+    """TD(lambda) returns for Dreamer imagination rollouts.
+
+    Inputs (T, B, 1) where ``continues`` already includes gamma.
+    Reference: sheeprl/algos/dreamer_v3/utils.py:67-79.
+    """
+    vals = jnp.concatenate([values[1:], values[-1:]], axis=0)
+    interm = rewards + continues * vals * (1 - lmbda)
+
+    def step(carry, inp):
+        it, cont = inp
+        carry = it + cont * lmbda * carry
+        return carry, carry
+
+    _, ret = jax.lax.scan(step, values[-1], (interm, continues), reverse=True)
+    return ret
+
+
+def normalize_tensor(x: jax.Array, eps: float = 1e-8, mask: Optional[jax.Array] = None) -> jax.Array:
+    """(Optionally masked) standardization (reference utils/utils.py:122-133)."""
+    if mask is None:
+        return (x - x.mean()) / (x.std() + eps)
+    m = mask.astype(x.dtype)
+    n = m.sum()
+    mean = (x * m).sum() / n
+    var = (((x - mean) ** 2) * m).sum() / n
+    return jnp.where(mask, (x - mean) / (jnp.sqrt(var) + eps), x)
+
+
+def polynomial_decay(
+    current_step: int,
+    *,
+    initial: float = 1.0,
+    final: float = 0.0,
+    max_decay_steps: int = 100,
+    power: float = 1.0,
+) -> float:
+    """Host-side scheduler (reference utils/utils.py:135-147)."""
+    if current_step > max_decay_steps or initial == final:
+        return final
+    return (initial - final) * ((1 - current_step / max_decay_steps) ** power) + final
+
+
+def safetanh(x: jax.Array, eps: float = 1e-6) -> jax.Array:
+    return jnp.clip(jnp.tanh(x), -1.0 + eps, 1.0 - eps)
+
+
+def safeatanh(x: jax.Array, eps: float = 1e-6) -> jax.Array:
+    return jnp.arctanh(jnp.clip(x, -1.0 + eps, 1.0 - eps))
+
+
+class Ratio:
+    """Replay-ratio scheduler: how many gradient steps to run per batch of
+    new policy steps. Host-side, stateful, checkpointable — identical
+    semantics to reference utils/utils.py:261-301 (from Hafner's dreamerv3).
+    """
+
+    def __init__(self, ratio: float, pretrain_steps: int = 0):
+        if pretrain_steps < 0:
+            raise ValueError(f"'pretrain_steps' must be non-negative, got {pretrain_steps}")
+        if ratio < 0:
+            raise ValueError(f"'ratio' must be non-negative, got {ratio}")
+        self._pretrain_steps = pretrain_steps
+        self._ratio = ratio
+        self._prev: Optional[int] = None
+
+    def __call__(self, step: int) -> int:
+        if self._ratio == 0:
+            return 0
+        repeats = 0
+        if self._prev is None:
+            self._prev = step
+            repeats = 1
+            if self._pretrain_steps > 0:
+                if step < self._pretrain_steps:
+                    import warnings
+
+                    warnings.warn(
+                        "on the first step, more steps than pretrain_steps have already been done",
+                        UserWarning,
+                    )
+                repeats = round(self._pretrain_steps * self._ratio)
+        repeats += round((step - self._prev) * self._ratio)
+        self._prev += repeats / self._ratio
+        return int(repeats)
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {"_ratio": self._ratio, "_prev": self._prev, "_pretrain_steps": self._pretrain_steps}
+
+    def load_state_dict(self, state: Dict[str, Any]) -> "Ratio":
+        self._ratio = state["_ratio"]
+        self._prev = state["_prev"]
+        self._pretrain_steps = state["_pretrain_steps"]
+        return self
+
+
+def save_configs(cfg: dotdict, log_dir: str) -> None:
+    """Persist the resolved run config next to the logs (utils/utils.py:257)."""
+    import yaml
+
+    os.makedirs(log_dir, exist_ok=True)
+    with open(os.path.join(log_dir, "config.yaml"), "w") as f:
+        yaml.safe_dump(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg), f)
+
+
+def print_config(cfg: Any) -> None:
+    """rank-0 rich tree dump of the run config (utils/utils.py:211)."""
+    try:
+        import rich.tree
+        import rich.syntax
+        import yaml
+
+        tree = rich.tree.Tree("CONFIG", style="dim", guide_style="dim")
+        for k, v in cfg.items():
+            branch = tree.add(str(k), style="yellow", guide_style="yellow")
+            if isinstance(v, dict):
+                branch.add(rich.syntax.Syntax(yaml.safe_dump(_plain(v)), "yaml"))
+            else:
+                branch.add(str(v))
+        rich.print(tree)
+    except Exception:
+        pass
+
+
+def _plain(v: Any) -> Any:
+    if isinstance(v, dict):
+        return {k: _plain(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_plain(x) for x in v]
+    return v

@@ -1,0 +1,126 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.models.models import (
+    CNN,
+    DeCNN,
+    MLP,
+    LayerNormGRUCell,
+    MultiDecoder,
+    MultiEncoder,
+    NatureCNN,
+    resolve_activation,
+)
+
+KEY = jax.random.PRNGKey(0)
+
+
+def test_resolve_activation_accepts_torch_names():
+    assert resolve_activation("torch.nn.Tanh")(jnp.array(0.5)) == jnp.tanh(0.5)
+    assert resolve_activation("relu")(jnp.array(-1.0)) == 0.0
+    with pytest.raises(ValueError):
+        resolve_activation("nope")
+
+
+def test_mlp_shapes_and_layer_norm():
+    m = MLP(hidden_sizes=(32, 32), output_dim=7, activation="tanh", layer_norm=True)
+    params = m.init(KEY, jnp.ones((4, 5)))
+    out = m.apply(params, jnp.ones((4, 5)))
+    assert out.shape == (4, 7)
+    # LayerNorm params present
+    flat = jax.tree_util.tree_leaves_with_path(params)
+    assert any("LayerNorm" in jax.tree_util.keystr(p) for p, _ in flat)
+
+
+def test_mlp_flatten_dim():
+    m = MLP(hidden_sizes=(8,), output_dim=3, flatten_dim=1)
+    params = m.init(KEY, jnp.ones((4, 2, 5)))
+    assert m.apply(params, jnp.ones((4, 2, 5))).shape == (4, 3)
+
+
+def test_mlp_no_output_head():
+    m = MLP(hidden_sizes=(16,))
+    params = m.init(KEY, jnp.ones((2, 3)))
+    assert m.apply(params, jnp.ones((2, 3))).shape == (2, 16)
+
+
+def test_cnn_nhwc():
+    m = CNN(channels=(16, 32), kernel_sizes=3, strides=2, paddings=1)
+    x = jnp.ones((2, 16, 16, 3))
+    params = m.init(KEY, x)
+    out = m.apply(params, x)
+    assert out.shape == (2, 4, 4, 32)
+
+
+def test_decnn_upsamples():
+    m = DeCNN(channels=(16, 3), kernel_sizes=4, strides=2, paddings="SAME")
+    x = jnp.ones((2, 4, 4, 8))
+    params = m.init(KEY, x)
+    out = m.apply(params, x)
+    assert out.shape == (2, 16, 16, 3)
+
+
+def test_nature_cnn_64():
+    m = NatureCNN(features_dim=512)
+    x = jnp.ones((3, 64, 64, 4))
+    params = m.init(KEY, x)
+    assert m.apply(params, x).shape == (3, 512)
+
+
+def test_layer_norm_gru_cell():
+    cell = LayerNormGRUCell(hidden_size=16)
+    h = jnp.zeros((5, 16))
+    x = jnp.ones((5, 8))
+    params = cell.init(KEY, h, x)
+    new_h, out = cell.apply(params, h, x)
+    assert new_h.shape == (5, 16)
+    np.testing.assert_array_equal(np.asarray(new_h), np.asarray(out))
+    # scan over time must work (TPU-native BPTT path)
+    xs = jnp.ones((7, 5, 8))
+
+    def step(carry, xt):
+        new_c, y = cell.apply(params, carry, xt)
+        return new_c, y
+
+    final, ys = jax.lax.scan(step, h, xs)
+    assert ys.shape == (7, 5, 16)
+
+
+def test_multi_encoder_concat():
+    enc = MultiEncoder(
+        cnn_encoder=NatureCNN(features_dim=32),
+        mlp_encoder=MLP(hidden_sizes=(16,)),
+        cnn_keys=("rgb",),
+        mlp_keys=("state",),
+    )
+    obs = {"rgb": jnp.ones((2, 64, 64, 3)), "state": jnp.ones((2, 4))}
+    params = enc.init(KEY, obs)
+    out = enc.apply(params, obs)
+    assert out.shape == (2, 48)
+
+
+def test_multi_decoder_splits():
+    dec = MultiDecoder(
+        mlp_decoder=MLP(hidden_sizes=(16,), output_dim=7),
+        mlp_keys=("a", "b"),
+        mlp_dims=(3, 4),
+    )
+    params = dec.init(KEY, jnp.ones((2, 8)))
+    out = dec.apply(params, jnp.ones((2, 8)))
+    assert out["a"].shape == (2, 3) and out["b"].shape == (2, 4)
+
+
+def test_rmsprop_tf_step():
+    import optax
+
+    from sheeprl_tpu.optim import rmsprop_tf
+
+    tx = rmsprop_tf(learning_rate=0.1, momentum=0.9)
+    params = {"w": jnp.ones(3)}
+    state = tx.init(params)
+    grads = {"w": jnp.ones(3)}
+    updates, state = tx.update(grads, state, params)
+    params = optax.apply_updates(params, updates)
+    assert np.all(np.asarray(params["w"]) < 1.0)

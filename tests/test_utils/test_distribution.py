@@ -1,0 +1,117 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.utils.distribution import (
+    Bernoulli,
+    BernoulliSafeMode,
+    Categorical,
+    Independent,
+    MSEDistribution,
+    Normal,
+    OneHotCategorical,
+    OneHotCategoricalStraightThrough,
+    SymlogDistribution,
+    TanhNormal,
+    TruncatedNormal,
+    TwoHotEncodingDistribution,
+    kl_divergence,
+)
+
+KEY = jax.random.PRNGKey(0)
+
+
+def test_normal_logprob_matches_scipy():
+    from scipy.stats import norm
+
+    d = Normal(jnp.array(0.5), jnp.array(2.0))
+    x = jnp.array([-1.0, 0.0, 3.0])
+    np.testing.assert_allclose(np.asarray(d.log_prob(x)), norm.logpdf(np.asarray(x), 0.5, 2.0), rtol=1e-5)
+    np.testing.assert_allclose(float(d.entropy()), norm.entropy(0.5, 2.0), rtol=1e-5)
+
+
+def test_independent_sums_event_dims():
+    d = Independent(Normal(jnp.zeros((4, 3)), jnp.ones((4, 3))), 1)
+    lp = d.log_prob(jnp.zeros((4, 3)))
+    assert lp.shape == (4,)
+    np.testing.assert_allclose(np.asarray(lp), 3 * Normal(jnp.array(0.0), jnp.array(1.0)).log_prob(jnp.array(0.0)), rtol=1e-6)
+
+
+def test_tanh_normal_bounds_and_logprob_consistency():
+    d = TanhNormal(jnp.zeros(5), jnp.ones(5) * 2)
+    y, logp = d.rsample_and_log_prob(KEY)
+    assert np.all(np.abs(np.asarray(y)) < 1.0)
+    # arctanh round-trip in fp32 loses a few ulps near |y|->1
+    np.testing.assert_allclose(np.asarray(d.log_prob(y)), np.asarray(logp), rtol=1e-2, atol=1e-2)
+
+
+def test_truncated_normal_support():
+    d = TruncatedNormal(jnp.zeros(1000), jnp.ones(1000) * 3.0)
+    s = d.sample(KEY)
+    assert np.all(np.abs(np.asarray(s)) <= 1.0)
+    # mean of a symmetric truncation is ~0
+    assert abs(float(TruncatedNormal(jnp.array(0.0), jnp.array(1.0)).mean)) < 1e-6
+
+
+def test_categorical_and_onehot():
+    logits = jnp.log(jnp.array([0.1, 0.2, 0.7]))
+    c = Categorical(logits=logits)
+    assert int(c.mode) == 2
+    np.testing.assert_allclose(float(c.log_prob(jnp.array(1))), np.log(0.2), rtol=1e-3)
+    oh = OneHotCategorical(logits=logits)
+    np.testing.assert_allclose(float(oh.log_prob(jax.nn.one_hot(1, 3))), np.log(0.2), rtol=1e-3)
+    samples = oh.sample(KEY, (1000,))
+    freq = np.asarray(samples.mean(0))
+    np.testing.assert_allclose(freq, [0.1, 0.2, 0.7], atol=0.06)
+
+
+def test_onehot_straight_through_grads():
+    def f(logits):
+        d = OneHotCategoricalStraightThrough(logits=logits)
+        s = d.rsample(KEY)
+        return (s * jnp.arange(3.0)).sum()
+
+    g = jax.grad(f)(jnp.zeros(3))
+    assert np.any(np.asarray(g) != 0)  # gradient flows through probs
+
+
+def test_bernoulli_safe_mode():
+    b = BernoulliSafeMode(probs=jnp.array([0.3, 0.7]))
+    np.testing.assert_array_equal(np.asarray(b.mode), [0.0, 1.0])
+
+
+def test_symlog_and_mse_distribution():
+    target = jnp.array([[3.0, -2.0]])
+    d = SymlogDistribution(jnp.asarray(np.log1p([[3.0, 2.0]]) * [[1, -1]]), dims=1)
+    assert float(d.log_prob(target)[0]) == pytest.approx(0.0, abs=1e-6)
+    m = MSEDistribution(jnp.zeros((1, 2)), dims=1)
+    assert float(m.log_prob(jnp.array([[1.0, 1.0]]))[0]) == pytest.approx(-2.0)
+
+
+def test_two_hot_distribution_mean_and_logprob():
+    # logits concentrated at the bin for symlog(5)
+    bins = jnp.linspace(-20, 20, 255)
+    target_val = 5.0
+    idx = int(jnp.argmin(jnp.abs(bins - jnp.log1p(jnp.array(target_val)))))
+    logits = jax.nn.one_hot(idx, 255) * 100.0
+    d = TwoHotEncodingDistribution(logits[None], dims=1)
+    assert float(d.mean) == pytest.approx(target_val, rel=0.1)
+    lp = d.log_prob(jnp.array([[target_val]]))
+    assert lp.shape == (1,)
+
+
+def test_kl_onehot():
+    p = OneHotCategorical(probs=jnp.array([0.5, 0.5]))
+    q = OneHotCategorical(probs=jnp.array([0.9, 0.1]))
+    kl = float(kl_divergence(p, q))
+    expected = 0.5 * np.log(0.5 / 0.9) + 0.5 * np.log(0.5 / 0.1)
+    np.testing.assert_allclose(kl, expected, rtol=1e-4)
+
+
+def test_kl_normal():
+    p = Normal(jnp.array(0.0), jnp.array(1.0))
+    q = Normal(jnp.array(1.0), jnp.array(2.0))
+    kl = float(kl_divergence(p, q))
+    expected = np.log(2.0) + (1 + 1) / (2 * 4) - 0.5
+    np.testing.assert_allclose(kl, expected, rtol=1e-5)

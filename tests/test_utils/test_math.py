@@ -1,0 +1,117 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.utils.utils import (
+    Ratio,
+    gae,
+    lambda_values,
+    normalize_tensor,
+    polynomial_decay,
+    symexp,
+    symlog,
+    two_hot_decoder,
+    two_hot_encoder,
+)
+
+
+def test_symlog_symexp_roundtrip():
+    x = jnp.array([-100.0, -1.0, 0.0, 0.5, 10.0, 1e4])
+    np.testing.assert_allclose(symexp(symlog(x)), x, rtol=1e-3)
+
+
+def test_two_hot_roundtrip():
+    x = jnp.array([[0.0], [1.0], [-3.7], [250.0], [-299.0]])
+    enc = two_hot_encoder(x, support_range=300)
+    assert enc.shape == (5, 601)
+    np.testing.assert_allclose(np.asarray(enc.sum(-1)), 1.0, rtol=1e-5)
+    dec = two_hot_decoder(enc, support_range=300)
+    np.testing.assert_allclose(np.asarray(dec), np.asarray(x), rtol=1e-3, atol=1e-3)
+
+
+def test_two_hot_exact_bin():
+    # integer support hit exactly -> one-hot
+    enc = two_hot_encoder(jnp.array([[symexp(jnp.array(2.0)).item()]]), support_range=300)
+    assert np.isclose(np.asarray(enc).max(), 1.0, atol=1e-5)
+
+
+def test_gae_matches_reference_loop():
+    T, B = 8, 3
+    rng = np.random.default_rng(0)
+    rewards = rng.normal(size=(T, B, 1)).astype(np.float32)
+    values = rng.normal(size=(T, B, 1)).astype(np.float32)
+    dones = (rng.uniform(size=(T, B, 1)) < 0.2).astype(np.float32)
+    next_value = rng.normal(size=(B, 1)).astype(np.float32)
+    gamma, lam = 0.99, 0.95
+
+    # python reference loop (reference utils/utils.py:64-102 semantics)
+    nd = 1.0 - dones
+    adv = np.zeros_like(rewards)
+    lastgaelam = np.zeros((B, 1), dtype=np.float32)
+    nv = np.concatenate([values[1:], next_value[None]], 0)
+    for t in reversed(range(T)):
+        delta = rewards[t] + gamma * nv[t] * nd[t] - values[t]
+        lastgaelam = delta + gamma * lam * nd[t] * lastgaelam
+        adv[t] = lastgaelam
+    ret = adv + values
+
+    jret, jadv = gae(jnp.asarray(rewards), jnp.asarray(values), jnp.asarray(dones), jnp.asarray(next_value), gamma, lam)
+    np.testing.assert_allclose(np.asarray(jadv), adv, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(jret), ret, rtol=1e-5, atol=1e-5)
+
+
+def test_lambda_values_matches_loop():
+    T, B = 6, 2
+    rng = np.random.default_rng(1)
+    rewards = rng.normal(size=(T, B, 1)).astype(np.float32)
+    values = rng.normal(size=(T, B, 1)).astype(np.float32)
+    continues = (rng.uniform(size=(T, B, 1)) < 0.9).astype(np.float32) * 0.997
+    lmbda = 0.95
+
+    vals = np.concatenate([values[1:], values[-1:]], 0)
+    interm = rewards + continues * vals * (1 - lmbda)
+    out = []
+    carry = values[-1]
+    for t in reversed(range(T)):
+        carry = interm[t] + continues[t] * lmbda * carry
+        out.append(carry)
+    expected = np.stack(list(reversed(out)), 0)
+
+    got = lambda_values(jnp.asarray(rewards), jnp.asarray(values), jnp.asarray(continues), lmbda)
+    np.testing.assert_allclose(np.asarray(got), expected, rtol=1e-5, atol=1e-5)
+
+
+def test_normalize_tensor_masked():
+    x = jnp.arange(10.0)
+    mask = x < 5
+    out = normalize_tensor(x, mask=mask)
+    sel = np.asarray(out)[:5]
+    assert abs(sel.mean()) < 1e-5
+
+
+def test_polynomial_decay():
+    assert polynomial_decay(0, initial=1.0, final=0.0, max_decay_steps=10) == 1.0
+    assert polynomial_decay(10, initial=1.0, final=0.0, max_decay_steps=10) == 0.0
+    assert polynomial_decay(11, initial=1.0, final=0.0, max_decay_steps=10) == 0.0
+    assert polynomial_decay(5, initial=1.0, final=0.0, max_decay_steps=10) == pytest.approx(0.5)
+
+
+def test_ratio_scheduler():
+    r = Ratio(ratio=0.5)
+    n0 = r(0)
+    assert n0 == 1  # first call primes
+    total = n0
+    for step in range(16, 129, 16):
+        total += r(step)
+    # ~0.5 gradient steps per policy step
+    assert abs(total - 128 * 0.5) <= 2
+
+    state = r.state_dict()
+    r2 = Ratio(ratio=0.1).load_state_dict(state)
+    assert r2.state_dict() == state
+
+
+def test_ratio_zero():
+    r = Ratio(ratio=0)
+    assert r(100) == 0
