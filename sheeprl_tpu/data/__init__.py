@@ -1,0 +1,17 @@
+from sheeprl_tpu.data.buffers import (
+    EnvIndependentReplayBuffer,
+    EpisodeBuffer,
+    ReplayBuffer,
+    SequentialReplayBuffer,
+    get_array,
+)
+from sheeprl_tpu.data.feed import DevicePrefetcher
+
+__all__ = [
+    "EnvIndependentReplayBuffer",
+    "EpisodeBuffer",
+    "ReplayBuffer",
+    "SequentialReplayBuffer",
+    "get_array",
+    "DevicePrefetcher",
+]

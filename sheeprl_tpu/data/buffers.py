@@ -1,0 +1,788 @@
+"""Replay / rollout buffers: host-side dict-of-numpy, time-major (T, B, *).
+
+TPU-native counterpart of reference sheeprl/data/buffers.py (ReplayBuffer:20,
+SequentialReplayBuffer:363, EnvIndependentReplayBuffer:529, EpisodeBuffer:746,
+get_tensor:1158). Storage and index math mirror the reference exactly —
+wrap-around adds, next-obs validity at the write head, sequence start-index
+windows — because those edge cases are battle-tested. What changes for TPU:
+
+- ``get_array`` converts to ``jax.Array`` (``jax.device_put``) instead of
+  torch tensors, with the int64→int32 / float64→float32 TPU dtype mapping;
+- ``sample_arrays`` returns a pytree ready for ``device_put`` / donation;
+- asynchronous host→HBM streaming lives in sheeprl_tpu/data/feed.py
+  (double-buffered prefetch), not here.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import shutil
+import uuid
+from itertools import compress
+from pathlib import Path
+from typing import Any, Dict, Optional, Sequence, Type, Union
+
+import numpy as np
+
+from sheeprl_tpu.utils.memmap import MemmapArray
+from sheeprl_tpu.utils.utils import NUMPY_TO_JAX_DTYPE
+
+_VALID_MEMMAP_MODES = ("r+", "w+", "c", "copyonwrite", "readwrite", "write")
+
+
+def get_array(
+    array: Union[np.ndarray, MemmapArray],
+    dtype: Any = None,
+    clone: bool = False,
+    device: Any = None,
+):
+    """numpy/Memmap -> jax.Array with the TPU dtype map (ref get_tensor:1158)."""
+    import jax
+    import jax.numpy as jnp
+
+    if isinstance(array, MemmapArray):
+        array = array.array
+    if clone:
+        array = np.array(array)
+    else:
+        array = np.asarray(array)
+    if dtype is None:
+        dtype = NUMPY_TO_JAX_DTYPE.get(array.dtype, None)
+    out = jnp.asarray(array, dtype=dtype)
+    if device is not None:
+        out = jax.device_put(out, device)
+    return out
+
+
+class ReplayBuffer:
+    """Circular dict-of-arrays buffer, shapes (buffer_size, n_envs, *)."""
+
+    batch_axis: int = 1
+
+    def __init__(
+        self,
+        buffer_size: int,
+        n_envs: int = 1,
+        obs_keys: Sequence[str] = ("observations",),
+        memmap: bool = False,
+        memmap_dir: Optional[Union[str, os.PathLike]] = None,
+        memmap_mode: str = "r+",
+        **kwargs: Any,
+    ):
+        if buffer_size <= 0:
+            raise ValueError(f"The buffer size must be greater than zero, got: {buffer_size}")
+        if n_envs <= 0:
+            raise ValueError(f"The number of environments must be greater than zero, got: {n_envs}")
+        self._buffer_size = buffer_size
+        self._n_envs = n_envs
+        self._obs_keys = tuple(obs_keys)
+        self._memmap = memmap
+        self._memmap_dir = memmap_dir
+        self._memmap_mode = memmap_mode
+        self._buf: Dict[str, Union[np.ndarray, MemmapArray]] = {}
+        if self._memmap:
+            if self._memmap_mode not in _VALID_MEMMAP_MODES:
+                raise ValueError(f"Accepted values for memmap_mode are {_VALID_MEMMAP_MODES}")
+            if self._memmap_dir is None:
+                raise ValueError("memmap=True requires 'memmap_dir' to be set")
+            self._memmap_dir = Path(self._memmap_dir)
+            self._memmap_dir.mkdir(parents=True, exist_ok=True)
+        self._pos = 0
+        self._full = False
+        self._rng: np.random.Generator = np.random.default_rng()
+
+    # ------------------------------------------------------------------ #
+    @property
+    def buffer(self) -> Dict[str, np.ndarray]:
+        return self._buf
+
+    @property
+    def buffer_size(self) -> int:
+        return self._buffer_size
+
+    @property
+    def full(self) -> bool:
+        return self._full
+
+    @property
+    def n_envs(self) -> int:
+        return self._n_envs
+
+    @property
+    def empty(self) -> bool:
+        return len(self._buf) == 0
+
+    @property
+    def is_memmap(self) -> bool:
+        return self._memmap
+
+    def __len__(self) -> int:
+        return self._buffer_size
+
+    def seed(self, seed: Optional[int]) -> None:
+        self._rng = np.random.default_rng(seed)
+
+    # ------------------------------------------------------------------ #
+    @staticmethod
+    def _validate(data: Dict[str, np.ndarray]) -> None:
+        if not isinstance(data, dict):
+            raise ValueError(f"'data' must be a dict of numpy arrays, got {type(data)}")
+        shapes = {}
+        for k, v in data.items():
+            if not isinstance(v, np.ndarray):
+                raise ValueError(f"'data[{k}]' must be a numpy array, got {type(v)}")
+            if v.ndim < 2:
+                raise RuntimeError(
+                    f"'data' must have at least 2 dims [sequence_length, n_envs, ...]; '{k}' has shape {v.shape}"
+                )
+            shapes[k] = v.shape[:2]
+        if len(set(shapes.values())) > 1:
+            raise RuntimeError(f"Arrays in 'data' must agree in the first 2 dims, got {shapes}")
+
+    def add(self, data: Union["ReplayBuffer", Dict[str, np.ndarray]], validate_args: bool = False) -> None:
+        """Insert (T, n_envs, *) rows at the write head, wrapping circularly."""
+        if isinstance(data, ReplayBuffer):
+            data = data.buffer
+        if validate_args:
+            self._validate(data)
+        data_len = next(iter(data.values())).shape[0]
+        next_pos = (self._pos + data_len) % self._buffer_size
+        if next_pos <= self._pos or (data_len > self._buffer_size and not self._full):
+            idxes = np.concatenate(
+                [np.arange(self._pos, self._buffer_size), np.arange(0, next_pos)]
+            ).astype(np.intp)
+        else:
+            idxes = np.arange(self._pos, next_pos, dtype=np.intp)
+        if data_len > self._buffer_size:
+            # keep only the most recent buffer_size rows (+ the wrapped tail)
+            data = {k: v[-self._buffer_size - next_pos:] for k, v in data.items()}
+        if self.empty:
+            for k, v in data.items():
+                shape = (self._buffer_size, self._n_envs, *v.shape[2:])
+                if self._memmap:
+                    self._buf[k] = MemmapArray(
+                        filename=Path(self._memmap_dir) / f"{k}.memmap",
+                        dtype=v.dtype,
+                        shape=shape,
+                        mode=self._memmap_mode,
+                    )
+                else:
+                    self._buf[k] = np.empty(shape, dtype=v.dtype)
+        for k, v in data.items():
+            self._buf[k][idxes] = v
+        if self._pos + data_len >= self._buffer_size:
+            self._full = True
+        self._pos = next_pos
+
+    # ------------------------------------------------------------------ #
+    def sample(
+        self,
+        batch_size: int,
+        sample_next_obs: bool = False,
+        clone: bool = False,
+        n_samples: int = 1,
+        **kwargs: Any,
+    ) -> Dict[str, np.ndarray]:
+        """Uniform sample -> dict of (n_samples, batch_size, *).
+
+        When ``sample_next_obs`` the row at the write head is excluded since
+        its next-obs would be stale (see reference sample:223 and the SB3
+        discussion it links).
+        """
+        if batch_size <= 0 or n_samples <= 0:
+            raise ValueError(
+                f"'batch_size' ({batch_size}) and 'n_samples' ({n_samples}) must be both greater than 0"
+            )
+        if not self._full and self._pos == 0:
+            raise ValueError("No sample has been added to the buffer, call 'add' first")
+        if self._full:
+            first_range_end = self._pos - 1 if sample_next_obs else self._pos
+            second_range_end = (
+                self._buffer_size if first_range_end >= 0 else self._buffer_size + first_range_end
+            )
+            valid = np.concatenate(
+                [np.arange(0, first_range_end), np.arange(self._pos, second_range_end)]
+            ).astype(np.intp)
+            batch_idxes = valid[self._rng.integers(0, len(valid), size=(batch_size * n_samples,))]
+        else:
+            max_pos = self._pos - 1 if sample_next_obs else self._pos
+            if max_pos == 0:
+                raise RuntimeError(
+                    "Cannot sample next observations with a single transition in the buffer"
+                )
+            batch_idxes = self._rng.integers(0, max_pos, size=(batch_size * n_samples,), dtype=np.intp)
+        out = self._get_samples(batch_idxes, sample_next_obs=sample_next_obs, clone=clone)
+        return {k: v.reshape(n_samples, batch_size, *v.shape[1:]) for k, v in out.items()}
+
+    def _get_samples(
+        self, batch_idxes: np.ndarray, sample_next_obs: bool = False, clone: bool = False
+    ) -> Dict[str, np.ndarray]:
+        if self.empty:
+            raise RuntimeError("The buffer has not been initialized, add data first")
+        env_idxes = self._rng.integers(0, self._n_envs, size=(len(batch_idxes),), dtype=np.intp)
+        flat = (batch_idxes * self._n_envs + env_idxes).ravel()
+        if sample_next_obs:
+            flat_next = (((batch_idxes + 1) % self._buffer_size) * self._n_envs + env_idxes).ravel()
+        samples: Dict[str, np.ndarray] = {}
+        for k, v in self._buf.items():
+            arr = np.asarray(v)
+            flat_v = arr.reshape(-1, *arr.shape[2:])
+            samples[k] = np.take(flat_v, flat, axis=0)
+            if clone:
+                samples[k] = samples[k].copy()
+            if sample_next_obs and k in self._obs_keys:
+                samples[f"next_{k}"] = np.take(flat_v, flat_next, axis=0)
+                if clone:
+                    samples[f"next_{k}"] = samples[f"next_{k}"].copy()
+        return samples
+
+    def sample_arrays(
+        self,
+        batch_size: int,
+        sample_next_obs: bool = False,
+        clone: bool = False,
+        n_samples: int = 1,
+        dtype: Any = None,
+        device: Any = None,
+        **kwargs: Any,
+    ) -> Dict[str, Any]:
+        """sample() then convert to jax arrays (reference sample_tensors:291)."""
+        samples = self.sample(
+            batch_size=batch_size,
+            sample_next_obs=sample_next_obs,
+            clone=clone,
+            n_samples=n_samples,
+            **kwargs,
+        )
+        return {k: get_array(v, dtype=dtype, device=device) for k, v in samples.items()}
+
+    def to_arrays(self, dtype: Any = None, clone: bool = False, device: Any = None) -> Dict[str, Any]:
+        """Whole-buffer conversion (reference to_tensor:109)."""
+        return {k: get_array(v, dtype=dtype, clone=clone, device=device) for k, v in self._buf.items()}
+
+    # ------------------------------------------------------------------ #
+    def __getitem__(self, key: str) -> Union[np.ndarray, MemmapArray]:
+        if not isinstance(key, str):
+            raise TypeError("'key' must be a string")
+        if self.empty:
+            raise RuntimeError("The buffer has not been initialized, add data first")
+        return self._buf.get(key)
+
+    def __setitem__(self, key: str, value: Union[np.ndarray, MemmapArray]) -> None:
+        if not isinstance(value, (np.ndarray, MemmapArray)):
+            raise ValueError(f"value must be np.ndarray or MemmapArray, got {type(value)}")
+        if self.empty:
+            raise RuntimeError("The buffer has not been initialized, add data first")
+        if tuple(value.shape[:2]) != (self._buffer_size, self._n_envs):
+            raise RuntimeError(
+                f"'value' must have leading dims (buffer_size, n_envs), got {value.shape}"
+            )
+        if self._memmap:
+            filename = (
+                value.filename
+                if isinstance(value, MemmapArray)
+                else Path(self._memmap_dir) / f"{key}.memmap"
+            )
+            self._buf[key] = MemmapArray.from_array(value, filename=filename, mode=self._memmap_mode)
+        else:
+            self._buf[key] = np.copy(value.array if isinstance(value, MemmapArray) else value)
+
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+
+
+class SequentialReplayBuffer(ReplayBuffer):
+    """Samples contiguous sequences (n_samples, seq_len, batch, *), ignoring
+    episode boundaries; wrap-around-safe start windows (ref sample:395-465)."""
+
+    batch_axis: int = 2
+
+    def sample(
+        self,
+        batch_size: int,
+        sample_next_obs: bool = False,
+        clone: bool = False,
+        n_samples: int = 1,
+        sequence_length: int = 1,
+        **kwargs: Any,
+    ) -> Dict[str, np.ndarray]:
+        batch_dim = batch_size * n_samples
+        if batch_size <= 0 or n_samples <= 0:
+            raise ValueError(
+                f"'batch_size' ({batch_size}) and 'n_samples' ({n_samples}) must be both greater than 0"
+            )
+        if not self._full and self._pos == 0:
+            raise ValueError("No sample has been added to the buffer, call 'add' first")
+        if not self._full and self._pos - sequence_length + 1 < 1:
+            raise ValueError(
+                f"Cannot sample a sequence of length {sequence_length}. Data added so far: {self._pos}"
+            )
+        if self._full and sequence_length > self._buffer_size:
+            raise ValueError(
+                f"The sequence length ({sequence_length}) is greater than the buffer size ({self._buffer_size})"
+            )
+
+        if self._full:
+            # valid starts: [0, pos - L] plus [pos, buffer_size) minus wrapped
+            # tail that would cross the write head
+            first_range_end = self._pos - sequence_length + 1
+            second_range_end = (
+                self._buffer_size if first_range_end >= 0 else self._buffer_size + first_range_end
+            )
+            valid = np.concatenate(
+                [np.arange(0, max(first_range_end, 0)), np.arange(self._pos, second_range_end)]
+            ).astype(np.intp)
+            start_idxes = valid[self._rng.integers(0, len(valid), size=(batch_dim,))]
+        else:
+            start_idxes = self._rng.integers(
+                0, self._pos - sequence_length + 1, size=(batch_dim,), dtype=np.intp
+            )
+        chunk = np.arange(sequence_length, dtype=np.intp)[None, :]
+        idxes = (start_idxes[:, None] + chunk) % self._buffer_size
+        return self._get_seq_samples(
+            idxes, batch_size, n_samples, sequence_length, sample_next_obs=sample_next_obs, clone=clone
+        )
+
+    def _get_seq_samples(
+        self,
+        batch_idxes: np.ndarray,
+        batch_size: int,
+        n_samples: int,
+        sequence_length: int,
+        sample_next_obs: bool = False,
+        clone: bool = False,
+    ) -> Dict[str, np.ndarray]:
+        flat_batch_idxes = batch_idxes.ravel()
+        # each sequence stays within one env
+        if self._n_envs == 1:
+            env_idxes = np.zeros(flat_batch_idxes.shape[0], dtype=np.intp)
+        else:
+            env_idxes = self._rng.integers(0, self._n_envs, size=(batch_size * n_samples,), dtype=np.intp)
+            env_idxes = np.repeat(env_idxes, sequence_length)
+        flat = (flat_batch_idxes * self._n_envs + env_idxes).ravel()
+        samples: Dict[str, np.ndarray] = {}
+        for k, v in self._buf.items():
+            arr = np.asarray(v)
+            flat_v = arr.reshape(-1, *arr.shape[2:])
+            taken = np.take(flat_v, flat, axis=0)
+            batched = taken.reshape(n_samples, batch_size, sequence_length, *taken.shape[1:])
+            samples[k] = np.swapaxes(batched, 1, 2)
+            if clone:
+                samples[k] = samples[k].copy()
+            if sample_next_obs:
+                flat_next = (((flat_batch_idxes + 1) % self._buffer_size) * self._n_envs + env_idxes).ravel()
+                taken_n = np.take(flat_v, flat_next, axis=0)
+                batched_n = taken_n.reshape(n_samples, batch_size, sequence_length, *taken_n.shape[1:])
+                samples[f"next_{k}"] = np.swapaxes(batched_n, 1, 2)
+                if clone:
+                    samples[f"next_{k}"] = samples[f"next_{k}"].copy()
+        return samples
+
+
+class EnvIndependentReplayBuffer:
+    """One sub-buffer per environment (ref EnvIndependentReplayBuffer:529):
+    per-env memmap subdirs, routed adds, multinomial sample split."""
+
+    def __init__(
+        self,
+        buffer_size: int,
+        n_envs: int = 1,
+        obs_keys: Sequence[str] = ("observations",),
+        memmap: bool = False,
+        memmap_dir: Optional[Union[str, os.PathLike]] = None,
+        memmap_mode: str = "r+",
+        buffer_cls: Type[ReplayBuffer] = ReplayBuffer,
+        **kwargs: Any,
+    ):
+        if buffer_size <= 0:
+            raise ValueError(f"The buffer size must be greater than zero, got: {buffer_size}")
+        if n_envs <= 0:
+            raise ValueError(f"The number of environments must be greater than zero, got: {n_envs}")
+        if memmap:
+            if memmap_mode not in _VALID_MEMMAP_MODES:
+                raise ValueError(f"Accepted values for memmap_mode are {_VALID_MEMMAP_MODES}")
+            if memmap_dir is None:
+                raise ValueError("memmap=True requires 'memmap_dir' to be set")
+            memmap_dir = Path(memmap_dir)
+        self._buf: Sequence[ReplayBuffer] = [
+            buffer_cls(
+                buffer_size=buffer_size,
+                n_envs=1,
+                obs_keys=obs_keys,
+                memmap=memmap,
+                memmap_dir=memmap_dir / f"env_{i}" if memmap else None,
+                memmap_mode=memmap_mode,
+                **kwargs,
+            )
+            for i in range(n_envs)
+        ]
+        self._buffer_size = buffer_size
+        self._n_envs = n_envs
+        self._rng: np.random.Generator = np.random.default_rng()
+        self._concat_along_axis = buffer_cls.batch_axis
+
+    @property
+    def buffer(self) -> Sequence[ReplayBuffer]:
+        return tuple(self._buf)
+
+    @property
+    def buffer_size(self) -> int:
+        return self._buffer_size
+
+    @property
+    def full(self) -> Sequence[bool]:
+        return tuple(b.full for b in self._buf)
+
+    @property
+    def n_envs(self) -> int:
+        return self._n_envs
+
+    @property
+    def empty(self) -> Sequence[bool]:
+        return tuple(b.empty for b in self._buf)
+
+    @property
+    def is_memmap(self) -> Sequence[bool]:
+        return tuple(b.is_memmap for b in self._buf)
+
+    def __len__(self) -> int:
+        return self._buffer_size
+
+    def seed(self, seed: Optional[int]) -> None:
+        self._rng = np.random.default_rng(seed)
+        for i, b in enumerate(self._buf):
+            b.seed(None if seed is None else seed + i)
+
+    def add(
+        self,
+        data: Union[ReplayBuffer, Dict[str, np.ndarray]],
+        indices: Optional[Sequence[int]] = None,
+        validate_args: bool = False,
+    ) -> None:
+        if isinstance(data, ReplayBuffer):
+            data = data.buffer
+        if indices is None:
+            indices = tuple(range(self._n_envs))
+        elif len(indices) != next(iter(data.values())).shape[1]:
+            raise ValueError(
+                f"The length of 'indices' ({len(indices)}) must equal the envs dim of 'data' "
+                f"({next(iter(data.values())).shape[1]})"
+            )
+        for data_idx, env_idx in enumerate(indices):
+            env_data = {k: v[:, data_idx: data_idx + 1] for k, v in data.items()}
+            self._buf[env_idx].add(env_data, validate_args=validate_args)
+
+    def sample(
+        self,
+        batch_size: int,
+        sample_next_obs: bool = False,
+        clone: bool = False,
+        n_samples: int = 1,
+        **kwargs: Any,
+    ) -> Dict[str, np.ndarray]:
+        if batch_size <= 0 or n_samples <= 0:
+            raise ValueError(
+                f"'batch_size' ({batch_size}) and 'n_samples' ({n_samples}) must be both greater than 0"
+            )
+        bs_per_buf = np.bincount(self._rng.integers(0, self._n_envs, (batch_size,)))
+        per_buf = [
+            b.sample(batch_size=bs, sample_next_obs=sample_next_obs, clone=clone, n_samples=n_samples, **kwargs)
+            for b, bs in zip(self._buf, bs_per_buf)
+            if bs > 0
+        ]
+        samples: Dict[str, np.ndarray] = {}
+        for k in per_buf[0].keys():
+            samples[k] = np.concatenate([s[k] for s in per_buf], axis=self._concat_along_axis)
+        return samples
+
+    def sample_arrays(
+        self,
+        batch_size: int,
+        sample_next_obs: bool = False,
+        clone: bool = False,
+        n_samples: int = 1,
+        dtype: Any = None,
+        device: Any = None,
+        **kwargs: Any,
+    ) -> Dict[str, Any]:
+        samples = self.sample(
+            batch_size=batch_size,
+            sample_next_obs=sample_next_obs,
+            clone=clone,
+            n_samples=n_samples,
+            **kwargs,
+        )
+        return {k: get_array(v, dtype=dtype, device=device) for k, v in samples.items()}
+
+
+class EpisodeBuffer:
+    """Whole-episode store with per-episode (optionally memmapped) dirs,
+    minimum-length validation, oldest-episode eviction and prioritize_ends
+    sampling (ref EpisodeBuffer:746)."""
+
+    batch_axis: int = 2
+
+    def __init__(
+        self,
+        buffer_size: int,
+        minimum_episode_length: int,
+        n_envs: int = 1,
+        obs_keys: Sequence[str] = ("observations",),
+        prioritize_ends: bool = False,
+        memmap: bool = False,
+        memmap_dir: Optional[Union[str, os.PathLike]] = None,
+        memmap_mode: str = "r+",
+    ) -> None:
+        if buffer_size <= 0:
+            raise ValueError(f"The buffer size must be greater than zero, got: {buffer_size}")
+        if minimum_episode_length <= 0:
+            raise ValueError(
+                f"The sequence length must be greater than zero, got: {minimum_episode_length}"
+            )
+        if buffer_size < minimum_episode_length:
+            raise ValueError(
+                f"The sequence length must be lower than the buffer size, got: bs = {buffer_size} "
+                f"and sl = {minimum_episode_length}"
+            )
+        self._n_envs = n_envs
+        self._obs_keys = tuple(obs_keys)
+        self._buffer_size = buffer_size
+        self._minimum_episode_length = minimum_episode_length
+        self._prioritize_ends = prioritize_ends
+        self._open_episodes: list = [[] for _ in range(n_envs)]
+        self._cum_lengths: list = []
+        self._buf: list = []
+        self._rng: np.random.Generator = np.random.default_rng()
+        self._memmap = memmap
+        self._memmap_dir = memmap_dir
+        self._memmap_mode = memmap_mode
+        if self._memmap:
+            if self._memmap_mode not in _VALID_MEMMAP_MODES:
+                raise ValueError(f"Accepted values for memmap_mode are {_VALID_MEMMAP_MODES}")
+            if self._memmap_dir is None:
+                raise ValueError("memmap=True requires 'memmap_dir' to be set")
+            self._memmap_dir = Path(self._memmap_dir)
+            self._memmap_dir.mkdir(parents=True, exist_ok=True)
+
+    # ------------------------------------------------------------------ #
+    @property
+    def prioritize_ends(self) -> bool:
+        return self._prioritize_ends
+
+    @prioritize_ends.setter
+    def prioritize_ends(self, value: bool) -> None:
+        self._prioritize_ends = value
+
+    @property
+    def buffer(self) -> Sequence[Dict[str, np.ndarray]]:
+        return self._buf
+
+    @property
+    def obs_keys(self) -> Sequence[str]:
+        return self._obs_keys
+
+    @property
+    def n_envs(self) -> int:
+        return self._n_envs
+
+    @property
+    def buffer_size(self) -> int:
+        return self._buffer_size
+
+    @property
+    def minimum_episode_length(self) -> int:
+        return self._minimum_episode_length
+
+    @property
+    def is_memmap(self) -> bool:
+        return self._memmap
+
+    @property
+    def full(self) -> bool:
+        return (
+            self._cum_lengths[-1] + self._minimum_episode_length > self._buffer_size
+            if len(self._buf) > 0
+            else False
+        )
+
+    def __len__(self) -> int:
+        return self._cum_lengths[-1] if len(self._buf) > 0 else 0
+
+    def seed(self, seed: Optional[int]) -> None:
+        self._rng = np.random.default_rng(seed)
+
+    # ------------------------------------------------------------------ #
+    def add(
+        self,
+        data: Union[ReplayBuffer, Dict[str, np.ndarray]],
+        env_idxes: Optional[Sequence[int]] = None,
+        validate_args: bool = False,
+    ) -> None:
+        """Split incoming (T, n_envs, *) chunks on done boundaries into
+        per-env open episodes; closed episodes are validated and stored."""
+        if isinstance(data, ReplayBuffer):
+            data = data.buffer
+        if validate_args:
+            ReplayBuffer._validate(data)
+            if "terminated" not in data and "truncated" not in data:
+                raise RuntimeError(
+                    f"The episode must contain the `terminated` and the `truncated` keys, got: {data.keys()}"
+                )
+            if env_idxes is not None and (np.asarray(env_idxes) >= self._n_envs).any():
+                raise ValueError(
+                    f"The env indices must be in [0, {self._n_envs}), given {env_idxes}"
+                )
+        if env_idxes is None:
+            env_idxes = range(self._n_envs)
+        for i, env in enumerate(env_idxes):
+            env_data = {k: v[:, i] for k, v in data.items()}
+            done = np.logical_or(env_data["terminated"], env_data["truncated"])
+            ends = done.nonzero()[0].tolist()
+            if len(ends) == 0:
+                self._open_episodes[env].append(env_data)
+                continue
+            ends.append(len(done))
+            start = 0
+            for ep_end in ends:
+                episode = {k: env_data[k][start: ep_end + 1] for k in env_data}
+                if len(np.logical_or(episode["terminated"], episode["truncated"])) > 0:
+                    self._open_episodes[env].append(episode)
+                start = ep_end + 1
+                open_ep = self._open_episodes[env]
+                if open_ep and bool(
+                    np.logical_or(open_ep[-1]["terminated"][-1], open_ep[-1]["truncated"][-1])
+                ):
+                    self._save_episode(open_ep)
+                    self._open_episodes[env] = []
+
+    def _save_episode(self, episode_chunks: Sequence[Dict[str, np.ndarray]]) -> None:
+        if len(episode_chunks) == 0:
+            raise RuntimeError("Invalid episode: an empty sequence was given")
+        episode = {
+            k: np.concatenate([c[k] for c in episode_chunks], axis=0) for k in episode_chunks[0]
+        }
+        ends = np.logical_or(episode["terminated"], episode["truncated"])
+        ep_len = ends.shape[0]
+        if len(ends.nonzero()[0]) != 1 or not ends[-1]:
+            raise RuntimeError("The episode must contain exactly one done, at its last step")
+        if ep_len < self._minimum_episode_length:
+            raise RuntimeError(
+                f"Episode too short (at least {self._minimum_episode_length} steps), got: {ep_len} steps"
+            )
+        if ep_len > self._buffer_size:
+            raise RuntimeError(f"Episode too long (at most {self._buffer_size} steps), got: {ep_len} steps")
+
+        if self.full or len(self) + ep_len > self._buffer_size:
+            cum = np.array(self._cum_lengths)
+            mask = (len(self) - cum + ep_len) <= self._buffer_size
+            last_to_remove = int(mask.argmax())
+            if self._memmap and self._memmap_dir is not None:
+                for _ in range(last_to_remove + 1):
+                    first = self._buf[0]
+                    dirname = os.path.dirname(str(next(iter(first.values())).filename))
+                    self._buf.pop(0)
+                    try:
+                        shutil.rmtree(dirname)
+                    except Exception as e:
+                        logging.error(e)
+            else:
+                self._buf = self._buf[last_to_remove + 1:]
+            cum = cum[last_to_remove + 1:] - cum[last_to_remove]
+            self._cum_lengths = cum.tolist()
+        self._cum_lengths.append(len(self) + ep_len)
+        if self._memmap:
+            ep_dir = self._memmap_dir / f"episode_{uuid.uuid4()}"
+            ep_dir.mkdir(parents=True, exist_ok=True)
+            stored = {}
+            for k, v in episode.items():
+                stored[k] = MemmapArray(
+                    filename=str(ep_dir / f"{k}.memmap"), dtype=v.dtype, shape=v.shape, mode=self._memmap_mode
+                )
+                stored[k][:] = v
+            episode = stored
+        self._buf.append(episode)
+
+    # ------------------------------------------------------------------ #
+    def sample(
+        self,
+        batch_size: int,
+        sample_next_obs: bool = False,
+        n_samples: int = 1,
+        clone: bool = False,
+        sequence_length: int = 1,
+        **kwargs: Any,
+    ) -> Dict[str, np.ndarray]:
+        """Sample fixed-length windows within episodes ->
+        (n_samples, sequence_length, batch_size, *)."""
+        if batch_size <= 0:
+            raise ValueError(f"Batch size must be greater than 0, got: {batch_size}")
+        if n_samples <= 0:
+            raise ValueError(f"The number of samples must be greater than 0, got: {n_samples}")
+        lengths = np.array(self._cum_lengths) - np.array([0] + self._cum_lengths[:-1])
+        if sample_next_obs:
+            valid_mask = lengths > sequence_length
+        else:
+            valid_mask = lengths >= sequence_length
+        valid_episodes = list(compress(self._buf, valid_mask)) if len(self._buf) else []
+        if len(valid_episodes) == 0:
+            raise RuntimeError(
+                "No valid episodes in the buffer: add at least one episode of length >= "
+                f"{sequence_length}"
+            )
+        chunk = np.arange(sequence_length, dtype=np.intp)[None, :]
+        n_per_ep = np.bincount(self._rng.integers(0, len(valid_episodes), (batch_size * n_samples,)))
+        gathered: Dict[str, list] = {k: [] for k in valid_episodes[0].keys()}
+        if sample_next_obs:
+            gathered.update({f"next_{k}": [] for k in self._obs_keys})
+        for i, n in enumerate(n_per_ep):
+            if n == 0:
+                continue
+            ep = valid_episodes[i]
+            ep_len = np.logical_or(np.asarray(ep["terminated"]), np.asarray(ep["truncated"])).shape[0]
+            if sample_next_obs:
+                ep_len -= 1
+            upper = ep_len - sequence_length + 1
+            if self._prioritize_ends:
+                upper += sequence_length
+            start_idxes = np.minimum(
+                self._rng.integers(0, upper, size=(n,)).reshape(-1, 1),
+                ep_len - sequence_length,
+            ).astype(np.intp)
+            indices = start_idxes + chunk
+            for k in valid_episodes[0].keys():
+                arr = np.asarray(ep[k])
+                gathered[k].append(
+                    np.take(arr, indices.ravel(), axis=0).reshape(n, sequence_length, *arr.shape[1:])
+                )
+                if sample_next_obs and k in self._obs_keys:
+                    gathered[f"next_{k}"].append(arr[indices + 1])
+        samples: Dict[str, np.ndarray] = {}
+        for k, v in gathered.items():
+            if len(v) > 0:
+                samples[k] = np.moveaxis(
+                    np.concatenate(v, axis=0).reshape(n_samples, batch_size, sequence_length, *v[0].shape[2:]),
+                    2,
+                    1,
+                )
+                if clone:
+                    samples[k] = samples[k].copy()
+        return samples
+
+    def sample_arrays(
+        self,
+        batch_size: int,
+        sample_next_obs: bool = False,
+        n_samples: int = 1,
+        clone: bool = False,
+        sequence_length: int = 1,
+        dtype: Any = None,
+        device: Any = None,
+        **kwargs: Any,
+    ) -> Dict[str, Any]:
+        samples = self.sample(batch_size, sample_next_obs, n_samples, clone, sequence_length)
+        return {k: get_array(v, dtype=dtype, device=device) for k, v in samples.items()}

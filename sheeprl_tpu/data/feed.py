@@ -1,0 +1,100 @@
+"""Host→HBM streaming: double-buffered device prefetch.
+
+The reference hides host→device latency in CUDA's async copy semantics;
+on TPU we overlap explicitly: a background thread samples from the (host,
+numpy) replay buffer and ``jax.device_put``s the next batch while the
+current one trains (SURVEY.md §7 "host/device pipeline", BASELINE north
+star "host→HBM streaming with device-side prefetch").
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Dict, Iterator, Optional
+
+import jax
+
+
+class DevicePrefetcher:
+    """Iterator wrapping a batch-producing callable with an N-deep device
+    prefetch queue.
+
+    ``producer()`` must return a pytree of numpy arrays (or None to stop).
+    Batches are ``device_put`` on the worker thread so the accelerator copy
+    overlaps the training step.
+    """
+
+    def __init__(
+        self,
+        producer: Callable[[], Optional[Dict[str, Any]]],
+        sharding: Any = None,
+        depth: int = 2,
+    ):
+        if depth < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        self._producer = producer
+        self._sharding = sharding
+        self._queue: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._error: Optional[BaseException] = None
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+
+    def _worker(self) -> None:
+        try:
+            while not self._stop.is_set():
+                batch = self._producer()
+                if batch is None:
+                    self._queue.put(None)
+                    return
+                if self._sharding is not None:
+                    batch = jax.device_put(batch, self._sharding)
+                else:
+                    batch = jax.device_put(batch)
+                while not self._stop.is_set():
+                    try:
+                        self._queue.put(batch, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+        except BaseException as e:  # surfaced on next __next__
+            self._error = e
+            try:
+                self._queue.put(None, timeout=0.1)
+            except queue.Full:
+                pass
+
+    def __iter__(self) -> Iterator:
+        return self
+
+    def __next__(self):
+        while True:
+            if self._error is not None:
+                err, self._error = self._error, None
+                raise err
+            try:
+                item = self._queue.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            if item is None:
+                if self._error is not None:
+                    err, self._error = self._error, None
+                    raise err
+                raise StopIteration
+            return item
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
+        while not self._queue.empty():
+            try:
+                self._queue.get_nowait()
+            except queue.Empty:
+                break
+
+    def __enter__(self) -> "DevicePrefetcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,185 @@
+"""Memory-mapped numpy array container.
+
+Host-side replay storage backing (reference sheeprl/utils/memmap.py:22-270).
+Semantics preserved:
+- backed by a file (temporary if no filename given);
+- file *ownership*: only the owning instance unlinks a temp file on deletion;
+- ``from_array`` copies a plain ndarray in, or re-attaches (without taking
+  ownership) when given another memmap of the same file;
+- pickling transfers the path but never the ownership, so a deserialized
+  copy (e.g. in an env/actor subprocess) reads the same file without racing
+  the owner's cleanup.
+
+Buffers stay host-side numpy in the TPU build (SURVEY.md §2.9); device
+transfer happens in the feed layer (sheeprl_tpu/data/feed.py).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import warnings
+from pathlib import Path
+from typing import Any, Optional, Tuple, Union
+
+import numpy as np
+
+_VALID_MODES = ("r+", "w+", "c", "copyonwrite", "readwrite", "write")
+
+
+class MemmapArray(np.lib.mixins.NDArrayOperatorsMixin):
+    def __init__(
+        self,
+        shape: Union[int, Tuple[int, ...]],
+        dtype: Any = None,
+        mode: str = "r+",
+        reset: bool = False,
+        filename: Optional[Union[str, os.PathLike]] = None,
+    ):
+        if mode not in _VALID_MODES:
+            raise ValueError(f"mode must be one of {_VALID_MODES}, got '{mode}'")
+        if filename is None:
+            fd, path = tempfile.mkstemp(".memmap")
+            os.close(fd)
+            self._filename = Path(path).resolve()
+            self._is_temp = True
+        else:
+            path = Path(filename).resolve()
+            if path.exists():
+                warnings.warn(
+                    "The specified filename already exists; modifications may be reflected.",
+                    category=UserWarning,
+                )
+            path.parent.mkdir(parents=True, exist_ok=True)
+            path.touch(exist_ok=True)
+            self._filename = path
+            self._is_temp = False
+        self._dtype = np.dtype(dtype) if dtype is not None else np.dtype("float32")
+        self._shape = (shape,) if isinstance(shape, int) else tuple(shape)
+        self._mode = mode
+        self._array: Optional[np.memmap] = np.memmap(
+            filename=self._filename, dtype=self._dtype, shape=self._shape, mode=self._mode
+        )
+        if reset:
+            self._array[:] = 0
+        self._has_ownership = True
+
+    # ------------------------------------------------------------------ #
+    @property
+    def filename(self) -> Path:
+        return self._filename
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def mode(self) -> str:
+        return self._mode
+
+    @property
+    def has_ownership(self) -> bool:
+        return self._has_ownership
+
+    @has_ownership.setter
+    def has_ownership(self, value: bool) -> None:
+        self._has_ownership = bool(value)
+
+    @property
+    def array(self) -> np.memmap:
+        if self._array is None:
+            self._array = np.memmap(
+                filename=self._filename, dtype=self._dtype, shape=self._shape, mode=self._mode
+            )
+        return self._array
+
+    @array.setter
+    def array(self, v: np.ndarray) -> None:
+        if not isinstance(v, (np.memmap, np.ndarray)):
+            raise ValueError(f"expected np.ndarray/np.memmap, got {type(v)}")
+        if isinstance(v, np.memmap) and v.filename is not None:
+            # attach to the other file, dropping ownership of ours
+            self._release()
+            self._filename = Path(v.filename).resolve()
+            self._is_temp = False
+            self._shape = v.shape
+            self._dtype = v.dtype
+            self._has_ownership = False
+            self._array = np.memmap(
+                filename=self._filename, dtype=self._dtype, shape=self._shape, mode=self._mode
+            )
+        else:
+            if self.array.size != v.size:
+                raise ValueError(f"size mismatch: {v.shape} vs {self._shape}")
+            self.array[:] = np.reshape(v, self._shape)
+            self.array.flush()
+
+    @classmethod
+    def from_array(
+        cls,
+        array: Union[np.ndarray, np.memmap, "MemmapArray"],
+        mode: str = "r+",
+        filename: Optional[Union[str, os.PathLike]] = None,
+    ) -> "MemmapArray":
+        filename = Path(filename).resolve() if filename is not None else None
+        out = cls(filename=filename, dtype=array.dtype, shape=array.shape, mode=mode)
+        src = array.array if isinstance(array, MemmapArray) else array
+        if isinstance(src, np.memmap) and src.filename is not None:
+            if filename is not None and filename == Path(src.filename).resolve():
+                out.array = src  # re-attach, no ownership
+            else:
+                out.array[:] = src[:]
+        else:
+            out.array[:] = np.reshape(src, out._shape)
+            out.array.flush()
+        return out
+
+    # ------------------------------------------------------------------ #
+    def _release(self) -> None:
+        if self._array is not None:
+            if self._has_ownership:
+                self._array.flush()
+            self._array = None
+
+    def __del__(self) -> None:
+        try:
+            had_ownership = self._has_ownership
+            self._release()
+            if had_ownership and self._is_temp and os.path.isfile(self._filename):
+                os.unlink(self._filename)
+        except Exception:
+            pass
+
+    def __array__(self, dtype=None) -> np.ndarray:
+        return np.asarray(self.array, dtype=dtype)
+
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state["_array"] = None
+        state["_has_ownership"] = False  # deserialized copies never own the file
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+
+    def __getattr__(self, attr: str) -> Any:
+        # forward ndarray API (sum, mean, ravel, ...) to the backing memmap
+        if attr.startswith("_"):
+            raise AttributeError(attr)
+        return getattr(self.array, attr)
+
+    def __getitem__(self, idx: Any) -> np.ndarray:
+        return self.array[idx]
+
+    def __setitem__(self, idx: Any, value: Any) -> None:
+        self.array[idx] = value
+
+    def __len__(self) -> int:
+        return len(self.array)
+
+    def __repr__(self) -> str:
+        return f"MemmapArray(shape={self._shape}, dtype={self._dtype}, mode={self._mode}, filename={self._filename})"

@@ -1,0 +1,314 @@
+import numpy as np
+import pytest
+
+from sheeprl_tpu.data import (
+    EnvIndependentReplayBuffer,
+    EpisodeBuffer,
+    ReplayBuffer,
+    SequentialReplayBuffer,
+)
+
+
+def _mkdata(t, n_envs, obs_dim=3, start=0):
+    return {
+        "observations": np.arange(start, start + t * n_envs * obs_dim, dtype=np.float32).reshape(t, n_envs, obs_dim),
+        "rewards": np.ones((t, n_envs, 1), dtype=np.float32),
+        "dones": np.zeros((t, n_envs, 1), dtype=np.float32),
+    }
+
+
+class TestReplayBuffer:
+    def test_invalid_sizes(self):
+        with pytest.raises(ValueError):
+            ReplayBuffer(0)
+        with pytest.raises(ValueError):
+            ReplayBuffer(4, 0)
+
+    def test_add_and_len(self):
+        rb = ReplayBuffer(10, 2)
+        rb.add(_mkdata(4, 2))
+        assert not rb.full
+        assert rb["observations"].shape == (10, 2, 3)
+
+    def test_wraparound_add(self):
+        rb = ReplayBuffer(5, 1)
+        rb.add(_mkdata(4, 1))
+        rb.add(_mkdata(3, 1, start=100))
+        assert rb.full
+        assert rb._pos == 2
+        # idxes [4, 0, 1] receive the 3 added rows in order
+        np.testing.assert_array_equal(rb["observations"][4, 0], [100, 101, 102])
+        np.testing.assert_array_equal(rb["observations"][0, 0], [103, 104, 105])
+        np.testing.assert_array_equal(rb["observations"][1, 0], [106, 107, 108])
+
+    def test_oversize_add_keeps_most_recent(self):
+        rb = ReplayBuffer(4, 1)
+        data = _mkdata(10, 1)
+        rb.add(data)
+        assert rb.full
+        flat = rb["observations"][:, 0, 0]
+        # the last buffer_size rows of the incoming data must all be present
+        assert set(data["observations"][-4:, 0, 0]) <= set(flat.tolist())
+
+    def test_sample_shapes(self):
+        rb = ReplayBuffer(10, 2)
+        rb.add(_mkdata(6, 2))
+        s = rb.sample(5, n_samples=3)
+        assert s["observations"].shape == (3, 5, 3)
+
+    def test_sample_before_add_raises(self):
+        rb = ReplayBuffer(10)
+        with pytest.raises(ValueError):
+            rb.sample(1)
+
+    def test_sample_next_obs_excludes_write_head(self):
+        rb = ReplayBuffer(4, 1, obs_keys=("observations",))
+        rb.add(_mkdata(4, 1))  # full, _pos == 0
+        rb.add(_mkdata(1, 1, start=500))  # _pos == 1; index 0 invalid for next
+        s = rb.sample(64, sample_next_obs=True)
+        assert "next_observations" in s
+        # row at _pos-1=0 excluded: next_obs of idx 0 would be the fresh write
+        assert 500.0 not in s["observations"][..., 0]
+
+    def test_sample_next_obs_single_sample_raises(self):
+        rb = ReplayBuffer(4, 1)
+        rb.add(_mkdata(1, 1))
+        with pytest.raises(RuntimeError):
+            rb.sample(1, sample_next_obs=True)
+
+    def test_getitem_setitem(self):
+        rb = ReplayBuffer(4, 2)
+        rb.add(_mkdata(2, 2))
+        new = np.zeros((4, 2, 7), dtype=np.float32)
+        rb["extra"] = new
+        assert rb["extra"].shape == (4, 2, 7)
+        with pytest.raises(RuntimeError):
+            rb["bad"] = np.zeros((3, 2))
+        with pytest.raises(TypeError):
+            rb[0]
+
+    def test_memmap_persistence(self, tmp_path):
+        rb = ReplayBuffer(6, 1, memmap=True, memmap_dir=tmp_path / "rb")
+        rb.add(_mkdata(3, 1))
+        assert (tmp_path / "rb" / "observations.memmap").exists()
+        assert rb.is_memmap
+        s = rb.sample(2)
+        assert s["observations"].shape == (1, 2, 3)
+
+    def test_sample_arrays_jax(self):
+        import jax.numpy as jnp
+
+        rb = ReplayBuffer(8, 1)
+        rb.add(_mkdata(4, 1))
+        s = rb.sample_arrays(3)
+        assert isinstance(s["observations"], jnp.ndarray)
+        assert s["observations"].dtype == jnp.float32
+
+
+class TestSequentialReplayBuffer:
+    def test_sequence_shapes(self):
+        srb = SequentialReplayBuffer(20, 2)
+        srb.add(_mkdata(10, 2))
+        s = srb.sample(4, n_samples=2, sequence_length=5)
+        assert s["observations"].shape == (2, 5, 4, 3)
+
+    def test_sequences_are_contiguous(self):
+        srb = SequentialReplayBuffer(32, 1)
+        data = {"observations": np.arange(16, dtype=np.float32).reshape(16, 1, 1)}
+        srb.add(data)
+        s = srb.sample(8, sequence_length=4)
+        seqs = s["observations"][0, :, :, 0]  # (L, B)
+        diffs = np.diff(seqs, axis=0)
+        np.testing.assert_array_equal(diffs, np.ones_like(diffs))
+
+    def test_sequence_wraparound_validity(self):
+        srb = SequentialReplayBuffer(8, 1)
+        srb.add({"observations": np.arange(8, dtype=np.float32).reshape(8, 1, 1)})
+        srb.add({"observations": (100 + np.arange(3, dtype=np.float32)).reshape(3, 1, 1)})
+        # _pos=3: sequences may wrap the circular boundary but must stay
+        # contiguous in time-of-write and never cross the write head
+        s = srb.sample(64, sequence_length=3)
+        seqs = s["observations"][0, :, :, 0]  # (L, B)
+        chrono = {3.0: 0, 4.0: 1, 5.0: 2, 6.0: 3, 7.0: 4, 100.0: 5, 101.0: 6, 102.0: 7}
+        for b in range(seqs.shape[1]):
+            order = [chrono[v] for v in seqs[:, b]]
+            assert np.all(np.diff(order) == 1), seqs[:, b]
+
+    def test_too_long_sequence_raises(self):
+        srb = SequentialReplayBuffer(8, 1)
+        srb.add(_mkdata(4, 1))
+        with pytest.raises(ValueError):
+            srb.sample(1, sequence_length=6)
+
+
+class TestEnvIndependent:
+    def test_routing_with_indices(self):
+        b = EnvIndependentReplayBuffer(10, n_envs=3, buffer_cls=ReplayBuffer)
+        data = _mkdata(2, 2)
+        b.add(data, indices=[0, 2])
+        assert not b.buffer[0].empty
+        assert b.buffer[1].empty
+        assert not b.buffer[2].empty
+
+    def test_bad_indices_length(self):
+        b = EnvIndependentReplayBuffer(10, n_envs=2)
+        with pytest.raises(ValueError):
+            b.add(_mkdata(2, 2), indices=[0])
+
+    def test_sample_concat(self):
+        b = EnvIndependentReplayBuffer(10, n_envs=2, buffer_cls=SequentialReplayBuffer)
+        b.add(_mkdata(8, 2))
+        s = b.sample(6, sequence_length=3)
+        assert s["observations"].shape == (1, 3, 6, 3)
+
+    def test_memmap_subdirs(self, tmp_path):
+        b = EnvIndependentReplayBuffer(10, n_envs=2, memmap=True, memmap_dir=tmp_path / "ei")
+        b.add(_mkdata(2, 2))
+        assert (tmp_path / "ei" / "env_0" / "observations.memmap").exists()
+        assert (tmp_path / "ei" / "env_1" / "observations.memmap").exists()
+
+
+def _ep_data(t, n_envs, done_at=None):
+    d = {
+        "observations": np.arange(t * n_envs, dtype=np.float32).reshape(t, n_envs, 1),
+        "terminated": np.zeros((t, n_envs, 1), dtype=np.float32),
+        "truncated": np.zeros((t, n_envs, 1), dtype=np.float32),
+    }
+    if done_at is not None:
+        d["terminated"][done_at] = 1.0
+    return d
+
+
+class TestEpisodeBuffer:
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            EpisodeBuffer(0, 1)
+        with pytest.raises(ValueError):
+            EpisodeBuffer(4, 8)
+
+    def test_open_episode_accumulates(self):
+        eb = EpisodeBuffer(100, 2, n_envs=1)
+        eb.add(_ep_data(5, 1))
+        assert len(eb) == 0  # no done yet
+        assert len(eb._open_episodes[0]) == 1
+
+    def test_episode_closed_on_done(self):
+        eb = EpisodeBuffer(100, 2, n_envs=1)
+        eb.add(_ep_data(5, 1, done_at=4))
+        assert len(eb) == 5
+        assert len(eb._open_episodes[0]) == 0
+
+    def test_chunked_episode_concatenated(self):
+        eb = EpisodeBuffer(100, 2, n_envs=1)
+        eb.add(_ep_data(3, 1))
+        eb.add(_ep_data(4, 1, done_at=3))
+        assert len(eb) == 7
+
+    def test_short_episode_rejected(self):
+        eb = EpisodeBuffer(100, 5, n_envs=1)
+        with pytest.raises(RuntimeError):
+            eb.add(_ep_data(2, 1, done_at=1))
+
+    def test_eviction(self):
+        eb = EpisodeBuffer(10, 2, n_envs=1)
+        for _ in range(4):
+            eb.add(_ep_data(4, 1, done_at=3))
+        assert len(eb) <= 10
+        assert len(eb.buffer) == 2
+
+    def test_sample_shapes(self):
+        eb = EpisodeBuffer(100, 2, n_envs=1)
+        eb.add(_ep_data(10, 1, done_at=9))
+        s = eb.sample(4, n_samples=2, sequence_length=3)
+        assert s["observations"].shape == (2, 3, 4, 1)
+
+    def test_sample_windows_within_episode(self):
+        eb = EpisodeBuffer(100, 2, n_envs=1)
+        eb.add(_ep_data(10, 1, done_at=9))
+        s = eb.sample(16, sequence_length=4)
+        seqs = s["observations"][0, :, :, 0]
+        diffs = np.diff(seqs, axis=0)
+        np.testing.assert_array_equal(diffs, np.ones_like(diffs))
+
+    def test_prioritize_ends_reaches_tail(self):
+        eb = EpisodeBuffer(100, 2, n_envs=1, prioritize_ends=True)
+        eb.add(_ep_data(10, 1, done_at=9))
+        eb.seed(3)
+        s = eb.sample(256, sequence_length=4)
+        # with prioritized ends the last window start (6) must appear often
+        starts = s["observations"][0, 0, :, 0]
+        assert (starts == 6).sum() > 256 / 7
+
+    def test_memmap_episode_dirs(self, tmp_path):
+        eb = EpisodeBuffer(100, 2, n_envs=1, memmap=True, memmap_dir=tmp_path / "eb")
+        eb.add(_ep_data(5, 1, done_at=4))
+        dirs = list((tmp_path / "eb").glob("episode_*"))
+        assert len(dirs) == 1
+
+    def test_memmap_eviction_removes_dirs(self, tmp_path):
+        eb = EpisodeBuffer(10, 2, n_envs=1, memmap=True, memmap_dir=tmp_path / "eb2")
+        for _ in range(4):
+            eb.add(_ep_data(4, 1, done_at=3))
+        dirs = list((tmp_path / "eb2").glob("episode_*"))
+        assert len(dirs) == len(eb.buffer) == 2
+
+
+class TestMemmapArray:
+    def test_ownership_and_pickle(self, tmp_path):
+        import pickle
+
+        from sheeprl_tpu.utils.memmap import MemmapArray
+
+        m = MemmapArray(shape=(4, 2), dtype=np.float32, filename=tmp_path / "a.memmap")
+        m[:] = 1.0
+        blob = pickle.dumps(m)
+        m2 = pickle.loads(blob)
+        assert not m2.has_ownership
+        np.testing.assert_array_equal(np.asarray(m2), np.ones((4, 2), dtype=np.float32))
+        m2[0, 0] = 5.0
+        assert m[0, 0] == 5.0
+
+    def test_from_array(self):
+        from sheeprl_tpu.utils.memmap import MemmapArray
+
+        src = np.arange(6, dtype=np.int32).reshape(2, 3)
+        m = MemmapArray.from_array(src)
+        np.testing.assert_array_equal(np.asarray(m), src)
+        assert m.has_ownership
+
+    def test_ndarray_forwarding(self):
+        from sheeprl_tpu.utils.memmap import MemmapArray
+
+        m = MemmapArray.from_array(np.ones((3, 3), dtype=np.float32))
+        assert m.sum() == 9.0
+        assert (m + 1).sum() == 18.0
+
+
+def test_device_prefetcher():
+    from sheeprl_tpu.data import DevicePrefetcher
+
+    n = {"i": 0}
+
+    def producer():
+        if n["i"] >= 5:
+            return None
+        n["i"] += 1
+        return {"x": np.full((2, 2), n["i"], dtype=np.float32)}
+
+    out = []
+    with DevicePrefetcher(producer, depth=2) as pf:
+        for batch in pf:
+            out.append(float(batch["x"][0, 0]))
+    assert out == [1.0, 2.0, 3.0, 4.0, 5.0]
+
+
+def test_device_prefetcher_propagates_errors():
+    from sheeprl_tpu.data import DevicePrefetcher
+
+    def producer():
+        raise RuntimeError("boom")
+
+    pf = DevicePrefetcher(producer)
+    with pytest.raises(RuntimeError, match="boom"):
+        next(pf)
+    pf.close()
