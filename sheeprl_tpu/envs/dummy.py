@@ -1,0 +1,114 @@
+"""Deterministic dummy environments — the fake backend for the test suite
+(reference sheeprl/envs/dummy.py:8 + utils/env.py:234).
+
+Observations count steps; images are NHWC (H, W, C) uint8 — the TPU build's
+canonical image layout."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import gymnasium as gym
+import numpy as np
+
+
+class BaseDummyEnv(gym.Env):
+    metadata = {"render_modes": ["rgb_array"], "render_fps": 30}
+    render_mode = "rgb_array"
+
+    def __init__(
+        self,
+        image_size: Tuple[int, int, int] = (64, 64, 3),
+        n_steps: int = 128,
+        vector_shape: Tuple[int] = (10,),
+        dict_obs_space: bool = True,
+    ):
+        self._dict_obs_space = dict_obs_space
+        if self._dict_obs_space:
+            self.observation_space = gym.spaces.Dict(
+                {
+                    "rgb": gym.spaces.Box(0, 255, shape=image_size, dtype=np.uint8),
+                    "state": gym.spaces.Box(-20, 20, shape=vector_shape, dtype=np.float32),
+                }
+            )
+        else:
+            self.observation_space = gym.spaces.Box(-20, 20, shape=vector_shape, dtype=np.float32)
+        self.reward_range = (-np.inf, np.inf)
+        self._current_step = 0
+        self._n_steps = n_steps
+
+    def get_obs(self):
+        if self._dict_obs_space:
+            return {
+                "rgb": np.full(
+                    self.observation_space["rgb"].shape, self._current_step % 256, dtype=np.uint8
+                ),
+                "state": np.full(self.observation_space["state"].shape, self._current_step, dtype=np.float32),
+            }
+        return np.full(self.observation_space.shape, self._current_step, dtype=np.float32)
+
+    def step(self, action):
+        done = self._current_step == self._n_steps
+        self._current_step += 1
+        return self.get_obs(), 0.0, done, False, {}
+
+    def reset(self, seed=None, options=None):
+        super().reset(seed=seed)
+        self._current_step = 0
+        return self.get_obs(), {}
+
+    def render(self):
+        return np.zeros((64, 64, 3), dtype=np.uint8)
+
+    def close(self):
+        pass
+
+
+class ContinuousDummyEnv(BaseDummyEnv):
+    def __init__(
+        self,
+        image_size: Tuple[int, int, int] = (64, 64, 3),
+        n_steps: int = 128,
+        vector_shape: Tuple[int] = (10,),
+        action_dim: int = 2,
+        dict_obs_space: bool = True,
+    ):
+        self.action_space = gym.spaces.Box(-1.0, 1.0, shape=(action_dim,))
+        super().__init__(image_size, n_steps, vector_shape, dict_obs_space)
+
+
+class DiscreteDummyEnv(BaseDummyEnv):
+    def __init__(
+        self,
+        image_size: Tuple[int, int, int] = (64, 64, 3),
+        n_steps: int = 4,
+        vector_shape: Tuple[int] = (10,),
+        action_dim: int = 2,
+        dict_obs_space: bool = True,
+    ):
+        self.action_space = gym.spaces.Discrete(action_dim)
+        super().__init__(image_size, n_steps, vector_shape, dict_obs_space)
+
+
+class MultiDiscreteDummyEnv(BaseDummyEnv):
+    def __init__(
+        self,
+        image_size: Tuple[int, int, int] = (64, 64, 3),
+        n_steps: int = 128,
+        vector_shape: Tuple[int] = (10,),
+        action_dims: List[int] = [2, 2],
+        dict_obs_space: bool = True,
+    ):
+        self.action_space = gym.spaces.MultiDiscrete(action_dims)
+        super().__init__(image_size, n_steps, vector_shape, dict_obs_space)
+
+
+def make_dummy_env(id: str, **kwargs) -> gym.Env:
+    """Factory resolving a dummy env id (reference utils/env.py:234)."""
+    if "continuous" in id:
+        return ContinuousDummyEnv(**kwargs)
+    if "multidiscrete" in id:
+        return MultiDiscreteDummyEnv(**kwargs)
+    if "discrete" in id:
+        return DiscreteDummyEnv(**kwargs)
+    raise ValueError(f"Unrecognized dummy environment: {id}")

@@ -1,0 +1,322 @@
+"""Generic gymnasium wrappers.
+
+Counterpart of reference sheeprl/envs/wrappers.py (MaskVelocityWrapper:13,
+ActionRepeat:48, RestartOnException:74, FrameStack:126,
+RewardAsObservationWrapper:185, GrayscaleRenderWrapper:244,
+ActionsAsObservationWrapper:258), written against gymnasium>=1.0.
+
+TPU-first difference: FrameStack concatenates frames on the **channel
+(last) axis** of NHWC images — (H, W, C*num_stack) — instead of adding a
+leading stack axis, so stacked frames feed XLA convolutions directly with
+no reshape."""
+
+from __future__ import annotations
+
+import copy
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Sequence, SupportsFloat, Tuple, Union
+
+import gymnasium as gym
+import numpy as np
+
+
+class MaskVelocityWrapper(gym.ObservationWrapper):
+    """Mask velocity terms of classic-control observations to make the MDP
+    partially observable."""
+
+    velocity_indices = {
+        "CartPole-v0": np.array([1, 3]),
+        "CartPole-v1": np.array([1, 3]),
+        "MountainCar-v0": np.array([1]),
+        "MountainCarContinuous-v0": np.array([1]),
+        "Pendulum-v1": np.array([2]),
+        "LunarLander-v2": np.array([2, 3, 5]),
+        "LunarLanderContinuous-v2": np.array([2, 3, 5]),
+    }
+
+    def __init__(self, env: gym.Env):
+        super().__init__(env)
+        assert env.unwrapped.spec is not None
+        env_id: str = env.unwrapped.spec.id
+        self.mask = np.ones_like(env.observation_space.sample())
+        try:
+            self.mask[self.velocity_indices[env_id]] = 0.0
+        except KeyError as e:
+            raise NotImplementedError(f"Velocity masking not implemented for {env_id}") from e
+
+    def observation(self, observation: np.ndarray) -> np.ndarray:
+        return observation * self.mask
+
+
+class ActionRepeat(gym.Wrapper):
+    """Repeat an action `amount` times, accumulating rewards, stopping early
+    on termination."""
+
+    def __init__(self, env: gym.Env, amount: int = 1):
+        super().__init__(env)
+        if amount <= 0:
+            raise ValueError("`amount` should be a positive integer")
+        self._amount = amount
+
+    @property
+    def action_repeat(self) -> int:
+        return self._amount
+
+    def step(self, action):
+        terminated = truncated = False
+        total_reward = 0.0
+        obs, info = None, {}
+        for _ in range(self._amount):
+            obs, reward, terminated, truncated, info = self.env.step(action)
+            total_reward += float(reward)
+            if terminated or truncated:
+                break
+        return obs, total_reward, terminated, truncated, info
+
+
+class RestartOnException(gym.Wrapper):
+    """Fault tolerance: re-instantiate a crashed env, within a sliding-window
+    fail budget; flags the restart via ``info["restart_on_exception"]``.
+
+    Algorithms react by truncating the last stored step and restarting the
+    episode (see reference dreamer_v3.py:595-608)."""
+
+    def __init__(
+        self,
+        env_fn: Callable[..., gym.Env],
+        exceptions: Union[type, Tuple[type, ...]] = (Exception,),
+        window: float = 300,
+        maxfails: int = 2,
+        wait: float = 20,
+    ):
+        if not isinstance(exceptions, (tuple, list)):
+            exceptions = (exceptions,)
+        self._env_fn = env_fn
+        self._exceptions = tuple(exceptions)
+        self._window = window
+        self._maxfails = maxfails
+        self._wait = wait
+        self._last = time.time()
+        self._fails = 0
+        super().__init__(self._env_fn())
+
+    def _register_failure(self, e: BaseException, where: str) -> None:
+        if time.time() > self._last + self._window:
+            self._last = time.time()
+            self._fails = 1
+        else:
+            self._fails += 1
+        if self._fails > self._maxfails:
+            raise RuntimeError(f"The env crashed too many times: {self._fails}") from e
+        gym.logger.warn(f"{where} - Restarting env after crash with {type(e).__name__}: {e}")
+        time.sleep(self._wait)
+
+    def step(self, action) -> Tuple[Any, SupportsFloat, bool, bool, Dict[str, Any]]:
+        try:
+            return self.env.step(action)
+        except self._exceptions as e:
+            self._register_failure(e, "STEP")
+            self.env = self._env_fn()
+            new_obs, info = self.env.reset()
+            info.update({"restart_on_exception": True})
+            return new_obs, 0.0, False, False, info
+
+    def reset(self, *, seed: Optional[int] = None, options: Optional[Dict[str, Any]] = None):
+        try:
+            return self.env.reset(seed=seed, options=options)
+        except self._exceptions as e:
+            self._register_failure(e, "RESET")
+            self.env = self._env_fn()
+            new_obs, info = self.env.reset(seed=seed, options=options)
+            info.update({"restart_on_exception": True})
+            return new_obs, info
+
+
+class FrameStack(gym.Wrapper):
+    """Stack the last ``num_stack`` frames of dict image observations on the
+    channel axis: (H, W, C) -> (H, W, C*num_stack), with optional dilation."""
+
+    def __init__(self, env: gym.Env, num_stack: int, cnn_keys: Sequence[str], dilation: int = 1) -> None:
+        super().__init__(env)
+        if num_stack <= 0:
+            raise ValueError(f"Invalid value for num_stack, expected a value greater than zero, got {num_stack}")
+        if dilation <= 0:
+            raise ValueError(f"Invalid value for dilation, expected a value greater than zero, got {dilation}")
+        if not isinstance(env.observation_space, gym.spaces.Dict):
+            raise RuntimeError(
+                f"Expected an observation space of type gym.spaces.Dict, got: {type(env.observation_space)}"
+            )
+        self._num_stack = num_stack
+        self._dilation = dilation
+        self._cnn_keys = []
+        self.observation_space = copy.deepcopy(self.env.observation_space)
+        for k, v in self.env.observation_space.spaces.items():
+            if cnn_keys and k in cnn_keys and len(v.shape) == 3:
+                self._cnn_keys.append(k)
+                h, w, c = v.shape
+                self.observation_space[k] = gym.spaces.Box(
+                    np.concatenate([v.low] * num_stack, axis=-1),
+                    np.concatenate([v.high] * num_stack, axis=-1),
+                    (h, w, c * num_stack),
+                    v.dtype,
+                )
+        if len(self._cnn_keys) == 0:
+            raise RuntimeError("Specify at least one valid cnn key to be stacked")
+        self._frames = {k: deque(maxlen=num_stack * dilation) for k in self._cnn_keys}
+
+    def _get_obs(self, key: str) -> np.ndarray:
+        subset = list(self._frames[key])[self._dilation - 1 :: self._dilation]
+        assert len(subset) == self._num_stack
+        return np.concatenate(subset, axis=-1)
+
+    def step(self, action):
+        obs, reward, terminated, truncated, infos = self.env.step(action)
+        for k in self._cnn_keys:
+            self._frames[k].append(obs[k])
+            obs[k] = self._get_obs(k)
+        return obs, reward, terminated, truncated, infos
+
+    def reset(self, *, seed=None, options=None, **kwargs):
+        obs, infos = self.env.reset(seed=seed, **kwargs)
+        for k in self._cnn_keys:
+            self._frames[k].clear()
+            for _ in range(self._num_stack * self._dilation):
+                self._frames[k].append(obs[k])
+            obs[k] = self._get_obs(k)
+        return obs, infos
+
+
+class RewardAsObservationWrapper(gym.Wrapper):
+    """Expose the previous reward as a (1,) Box observation under the
+    ``reward`` key (``obs`` key wraps non-dict observations)."""
+
+    def __init__(self, env: gym.Env) -> None:
+        super().__init__(env)
+        reward_range = getattr(self.env, "reward_range", None) or (-np.inf, np.inf)
+        reward_space = gym.spaces.Box(*reward_range, (1,), np.float32)
+        if isinstance(self.env.observation_space, gym.spaces.Dict):
+            self.observation_space = gym.spaces.Dict(
+                {"reward": reward_space, **dict(self.env.observation_space.items())}
+            )
+        else:
+            self.observation_space = gym.spaces.Dict(
+                {"obs": self.env.observation_space, "reward": reward_space}
+            )
+
+    def _convert_obs(self, obs: Any, reward: Union[float, np.ndarray]) -> Dict[str, Any]:
+        reward_obs = np.asarray(reward, dtype=np.float32).reshape(-1)
+        if isinstance(obs, dict):
+            obs["reward"] = reward_obs
+        else:
+            obs = {"obs": obs, "reward": reward_obs}
+        return obs
+
+    def step(self, action):
+        obs, reward, terminated, truncated, infos = self.env.step(action)
+        return self._convert_obs(obs, copy.deepcopy(reward)), reward, terminated, truncated, infos
+
+    def reset(self, *, seed=None, options=None):
+        obs, infos = self.env.reset(seed=seed, options=options)
+        return self._convert_obs(obs, 0), infos
+
+
+class GrayscaleRenderWrapper(gym.Wrapper):
+    """Promote 2D/1-channel render frames to 3-channel for video encoders."""
+
+    def render(self):
+        frame = super().render()
+        if isinstance(frame, np.ndarray):
+            if len(frame.shape) == 2:
+                frame = frame[..., np.newaxis]
+            if len(frame.shape) == 3 and frame.shape[-1] == 1:
+                frame = frame.repeat(3, axis=-1)
+        return frame
+
+
+class ActionsAsObservationWrapper(gym.Wrapper):
+    """Expose the last ``num_stack`` executed actions (one-hot for discrete
+    spaces) as the ``action_stack`` observation, noop-filled on reset."""
+
+    def __init__(self, env: gym.Env, num_stack: int, noop: Union[float, int, List[int]], dilation: int = 1):
+        super().__init__(env)
+        if num_stack < 1:
+            raise ValueError(
+                f"The number of actions to stack must be greater or equal than 1, got: {num_stack}"
+            )
+        if dilation < 1:
+            raise ValueError(f"The actions stack dilation argument must be greater than zero, got: {dilation}")
+        if not isinstance(noop, (int, float, list)):
+            raise ValueError(f"The noop action must be an integer or float or list, got: {noop} ({type(noop)})")
+        self._num_stack = num_stack
+        self._dilation = dilation
+        self._actions = deque(maxlen=num_stack * dilation)
+        self._is_continuous = isinstance(self.env.action_space, gym.spaces.Box)
+        self._is_multidiscrete = isinstance(self.env.action_space, gym.spaces.MultiDiscrete)
+        self.observation_space = copy.deepcopy(self.env.observation_space)
+        if self._is_continuous:
+            if isinstance(noop, list):
+                raise ValueError(f"The noop actions must be a float for continuous action spaces, got: {noop}")
+            self._action_shape = self.env.action_space.shape[0]
+            low = np.resize(self.env.action_space.low, self._action_shape * num_stack)
+            high = np.resize(self.env.action_space.high, self._action_shape * num_stack)
+            self.noop = np.full((self._action_shape,), noop, dtype=np.float32)
+        elif self._is_multidiscrete:
+            if not isinstance(noop, list):
+                raise ValueError(f"The noop actions must be a list for multi-discrete action spaces, got: {noop}")
+            nvec = self.env.action_space.nvec
+            if len(nvec) != len(noop):
+                raise RuntimeError(
+                    "The number of noop actions must equal the number of env actions: "
+                    f"nvec={nvec}, noop={noop}"
+                )
+            low, high = 0, 1
+            self._action_shape = int(sum(nvec))
+            noops = []
+            for idx, n in zip(noop, nvec):
+                oh = np.zeros((n,), dtype=np.float32)
+                oh[idx] = 1.0
+                noops.append(oh)
+            self.noop = np.concatenate(noops, axis=-1)
+        else:
+            if isinstance(noop, (list, float)):
+                raise ValueError(f"The noop actions must be an integer for discrete action spaces, got: {noop}")
+            low, high = 0, 1
+            self._action_shape = int(self.env.action_space.n)
+            self.noop = np.zeros((self._action_shape,), dtype=np.float32)
+            self.noop[noop] = 1.0
+        self.observation_space["action_stack"] = gym.spaces.Box(
+            low=low, high=high, shape=(self._action_shape * num_stack,), dtype=np.float32
+        )
+
+    def _encode(self, action: Any) -> np.ndarray:
+        if self._is_continuous:
+            return np.asarray(action, dtype=np.float32).reshape(-1)
+        if self._is_multidiscrete:
+            parts = []
+            for idx, n in zip(np.asarray(action).reshape(-1), self.env.action_space.nvec):
+                oh = np.zeros((n,), dtype=np.float32)
+                oh[int(idx)] = 1.0
+                parts.append(oh)
+            return np.concatenate(parts, axis=-1)
+        oh = np.zeros((self._action_shape,), dtype=np.float32)
+        oh[int(np.asarray(action).reshape(-1)[0])] = 1.0
+        return oh
+
+    def step(self, action):
+        self._actions.append(self._encode(action))
+        obs, reward, terminated, truncated, info = super().step(action)
+        obs["action_stack"] = self._get_actions_stack()
+        return obs, reward, terminated, truncated, info
+
+    def reset(self, *, seed=None, options=None):
+        obs, info = super().reset(seed=seed, options=options)
+        self._actions.clear()
+        for _ in range(self._num_stack * self._dilation):
+            self._actions.append(self.noop)
+        obs["action_stack"] = self._get_actions_stack()
+        return obs, info
+
+    def _get_actions_stack(self) -> np.ndarray:
+        stack = list(self._actions)[self._dilation - 1 :: self._dilation]
+        return np.concatenate(stack, axis=-1).astype(np.float32)

@@ -1,0 +1,227 @@
+"""Environment factory: thunk builder + wrapper chain + vector envs.
+
+Counterpart of reference sheeprl/utils/env.py:26-232. Pipeline order is
+preserved: instantiate wrapper -> ActionRepeat -> MaskVelocity -> dict-ify
+obs -> resize/grayscale (cv2, host-side CPU) -> FrameStack ->
+ActionsAsObservation -> RewardAsObservation -> seeding -> TimeLimit ->
+RecordEpisodeStatistics -> RecordVideo (rank0/env0 only).
+
+TPU-first differences:
+- images stay **NHWC uint8** end-to-end (no CHW transpose) — XLA's native
+  conv layout; normalization to [0,1]/[-0.5,0.5] happens on-device inside
+  the jitted train step, keeping host->HBM transfers at 1 byte/pixel;
+- vector envs run with gymnasium's SAME_STEP autoreset, which matches the
+  final_obs/final_info semantics the algorithms' truncation bootstrapping
+  relies on.
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+from typing import Any, Callable, Dict, Optional
+
+import gymnasium as gym
+import numpy as np
+
+from sheeprl_tpu.config import instantiate
+from sheeprl_tpu.envs.wrappers import (
+    ActionRepeat,
+    ActionsAsObservationWrapper,
+    FrameStack,
+    GrayscaleRenderWrapper,
+    MaskVelocityWrapper,
+    RewardAsObservationWrapper,
+)
+
+
+def make_env(
+    cfg: Dict[str, Any],
+    seed: int,
+    rank: int,
+    run_name: Optional[str] = None,
+    prefix: str = "",
+    vector_env_idx: int = 0,
+) -> Callable[[], gym.Env]:
+    """Build a thunk that creates a fully-wrapped env with dict observations."""
+
+    def thunk() -> gym.Env:
+        try:
+            env_spec = gym.spec(cfg.env.id).entry_point
+        except Exception:
+            env_spec = ""
+
+        instantiate_kwargs = {}
+        if "seed" in cfg.env.wrapper:
+            instantiate_kwargs["seed"] = seed
+        if "rank" in cfg.env.wrapper:
+            instantiate_kwargs["rank"] = rank + vector_env_idx
+        env = instantiate(dict(cfg.env.wrapper), **instantiate_kwargs)
+
+        if cfg.env.action_repeat > 1 and "atari" not in str(env_spec):
+            env = ActionRepeat(env, cfg.env.action_repeat)
+
+        if cfg.env.get("mask_velocities", False):
+            env = MaskVelocityWrapper(env)
+
+        cnn_keys_enc = cfg.algo.cnn_keys.encoder
+        mlp_keys_enc = cfg.algo.mlp_keys.encoder
+        if not (
+            isinstance(mlp_keys_enc, list)
+            and isinstance(cnn_keys_enc, list)
+            and len(cnn_keys_enc + mlp_keys_enc) > 0
+        ):
+            raise ValueError(
+                "`algo.cnn_keys.encoder` and `algo.mlp_keys.encoder` must be non-empty lists of strings, got: "
+                f"cnn={cnn_keys_enc} mlp={mlp_keys_enc}"
+            )
+
+        # dict-ify observations
+        if isinstance(env.observation_space, gym.spaces.Box) and len(env.observation_space.shape) < 2:
+            # vector-only observation
+            if len(cnn_keys_enc) > 0:
+                if len(cnn_keys_enc) > 1:
+                    warnings.warn(
+                        f"Multiple cnn keys specified, only the first one is kept: {cnn_keys_enc[0]}"
+                    )
+                env = gym.wrappers.AddRenderObservation(
+                    env,
+                    render_only=len(mlp_keys_enc) == 0,
+                    render_key=cnn_keys_enc[0],
+                    obs_key=mlp_keys_enc[0] if mlp_keys_enc else "state",
+                )
+                if len(mlp_keys_enc) == 0:
+                    # render-only returns a bare Box; dict-ify it
+                    cnn_key = cnn_keys_enc[0]
+                    space = gym.spaces.Dict({cnn_key: env.observation_space})
+                    env = gym.wrappers.TransformObservation(env, lambda obs: {cnn_key: obs}, space)
+            else:
+                if len(mlp_keys_enc) > 1:
+                    warnings.warn(
+                        f"Multiple mlp keys specified, only the first one is kept: {mlp_keys_enc[0]}"
+                    )
+                mlp_key = mlp_keys_enc[0]
+                space = gym.spaces.Dict({mlp_key: env.observation_space})
+                env = gym.wrappers.TransformObservation(env, lambda obs: {mlp_key: obs}, space)
+        elif isinstance(env.observation_space, gym.spaces.Box) and 2 <= len(env.observation_space.shape) <= 3:
+            # pixel-only observation
+            if len(cnn_keys_enc) > 1:
+                warnings.warn(
+                    f"Multiple cnn keys specified, only the first one is kept: {cnn_keys_enc[0]}"
+                )
+            elif len(cnn_keys_enc) == 0:
+                raise ValueError(
+                    "You have selected a pixel observation but no cnn key has been specified. "
+                    "Set `algo.cnn_keys.encoder=[your_cnn_key]`"
+                )
+            cnn_key = cnn_keys_enc[0]
+            space = gym.spaces.Dict({cnn_key: env.observation_space})
+            env = gym.wrappers.TransformObservation(env, lambda obs: {cnn_key: obs}, space)
+
+        if (
+            len(
+                set(env.observation_space.keys()).intersection(set(mlp_keys_enc + cnn_keys_enc))
+            )
+            == 0
+        ):
+            raise ValueError(
+                f"The user-specified keys {mlp_keys_enc + cnn_keys_enc} are not a subset of the "
+                f"environment observation keys {list(env.observation_space.keys())}"
+            )
+
+        env_cnn_keys = set(
+            k for k in env.observation_space.spaces.keys() if len(env.observation_space[k].shape) in {2, 3}
+        )
+        cnn_keys = env_cnn_keys.intersection(set(cnn_keys_enc))
+
+        def transform_obs(obs: Dict[str, Any]) -> Dict[str, Any]:
+            import cv2
+
+            for k in cnn_keys:
+                current = obs[k]
+                shape = current.shape
+                is_3d = len(shape) == 3
+                is_grayscale = not is_3d or shape[-1] == 1 or shape[0] == 1
+
+                # normalize odd layouts to HWC
+                if not is_3d:
+                    current = np.expand_dims(current, axis=-1)
+                elif shape[0] in (1, 3) and shape[-1] not in (1, 3):
+                    current = np.transpose(current, (1, 2, 0))  # stray CHW input
+
+                if current.shape[:-1] != (cfg.env.screen_size, cfg.env.screen_size):
+                    current = cv2.resize(
+                        current, (cfg.env.screen_size, cfg.env.screen_size), interpolation=cv2.INTER_AREA
+                    )
+                    if len(current.shape) == 2:
+                        current = current[..., None]
+
+                if cfg.env.grayscale and not is_grayscale:
+                    current = cv2.cvtColor(current, cv2.COLOR_RGB2GRAY)
+
+                if len(current.shape) == 2:
+                    current = np.expand_dims(current, axis=-1)
+                    if not cfg.env.grayscale:
+                        current = np.repeat(current, 3, axis=-1)
+
+                obs[k] = current  # HWC, uint8
+            return obs
+
+        if cnn_keys:
+            new_space = dict(env.observation_space.spaces)
+            for k in cnn_keys:
+                new_space[k] = gym.spaces.Box(
+                    0,
+                    255,
+                    (cfg.env.screen_size, cfg.env.screen_size, 1 if cfg.env.grayscale else 3),
+                    np.uint8,
+                )
+            env = gym.wrappers.TransformObservation(env, transform_obs, gym.spaces.Dict(new_space))
+
+        if cnn_keys and len(cnn_keys) > 0 and cfg.env.frame_stack > 1:
+            if cfg.env.frame_stack_dilation <= 0:
+                raise ValueError(
+                    f"The frame stack dilation argument must be greater than zero, got: {cfg.env.frame_stack_dilation}"
+                )
+            env = FrameStack(env, cfg.env.frame_stack, cnn_keys, cfg.env.frame_stack_dilation)
+
+        if cfg.env.actions_as_observation.num_stack > 0:
+            env = ActionsAsObservationWrapper(env, **cfg.env.actions_as_observation)
+
+        if cfg.env.reward_as_observation:
+            env = RewardAsObservationWrapper(env)
+
+        env.action_space.seed(seed)
+        env.observation_space.seed(seed)
+        if cfg.env.max_episode_steps and cfg.env.max_episode_steps > 0:
+            env = gym.wrappers.TimeLimit(env, max_episode_steps=cfg.env.max_episode_steps)
+        env = gym.wrappers.RecordEpisodeStatistics(env)
+        if cfg.env.capture_video and rank == 0 and vector_env_idx == 0 and run_name is not None:
+            if cfg.env.grayscale:
+                env = GrayscaleRenderWrapper(env)
+            env = gym.wrappers.RecordVideo(
+                env,
+                os.path.join(run_name, prefix + "_videos" if prefix else "videos"),
+                disable_logger=True,
+            )
+        return env
+
+    return thunk
+
+
+def make_vector_env(
+    cfg: Dict[str, Any],
+    seed: int,
+    rank: int,
+    run_name: Optional[str] = None,
+    prefix: str = "",
+) -> gym.vector.VectorEnv:
+    """SAME_STEP-autoreset vector env over ``cfg.env.num_envs`` thunks."""
+    thunks = [
+        make_env(cfg, seed + rank * cfg.env.num_envs + i, rank, run_name, prefix, vector_env_idx=i)
+        for i in range(cfg.env.num_envs)
+    ]
+    mode = gym.vector.AutoresetMode.SAME_STEP
+    if cfg.env.sync_env:
+        return gym.vector.SyncVectorEnv(thunks, autoreset_mode=mode)
+    return gym.vector.AsyncVectorEnv(thunks, context="spawn", autoreset_mode=mode)

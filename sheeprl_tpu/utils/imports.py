@@ -1,0 +1,28 @@
+"""Availability probes for optional environment backends and tooling
+(reference sheeprl/utils/imports.py:17)."""
+
+from __future__ import annotations
+
+import importlib.util
+import platform
+
+
+def _available(module: str) -> bool:
+    try:
+        return importlib.util.find_spec(module) is not None
+    except (ModuleNotFoundError, ValueError):
+        return False
+
+
+_IS_ATARI_AVAILABLE = _available("ale_py")
+_IS_BOX2D_AVAILABLE = _available("Box2D")
+_IS_CRAFTER_AVAILABLE = _available("crafter")
+_IS_DIAMBRA_AVAILABLE = _available("diambra")
+_IS_DIAMBRA_ARENA_AVAILABLE = _available("diambra.arena")
+_IS_DMC_AVAILABLE = _available("dm_control")
+_IS_MINEDOJO_AVAILABLE = _available("minedojo")
+_IS_MINERL_AVAILABLE = _available("minerl")
+_IS_SUPER_MARIO_BROS_AVAILABLE = _available("gym_super_mario_bros")
+_IS_MLFLOW_AVAILABLE = _available("mlflow")
+_IS_TENSORBOARD_AVAILABLE = _available("tensorboard") or _available("tensorboardX")
+_IS_WINDOWS = platform.system() == "Windows"

@@ -1,0 +1,98 @@
+import gymnasium as gym
+import numpy as np
+import pytest
+
+from sheeprl_tpu.config import compose
+from sheeprl_tpu.utils.env import make_env, make_vector_env
+
+
+def _cfg(**overrides):
+    ov = ["exp=ppo", "env=dummy", "env.capture_video=False"] + [f"{k}={v}" for k, v in overrides.items()]
+    return compose(overrides=ov)
+
+
+def test_make_env_vector_obs():
+    cfg = _cfg()
+    env = make_env(cfg, seed=0, rank=0)()
+    obs, _ = env.reset()
+    assert set(obs.keys()) >= {"state"}
+    assert isinstance(env.observation_space, gym.spaces.Dict)
+    env.close()
+
+
+def test_make_env_gym_cartpole_state_key():
+    cfg = compose(overrides=["exp=ppo", "env.capture_video=False"])
+    env = make_env(cfg, seed=0, rank=0)()
+    obs, _ = env.reset()
+    assert "state" in obs
+    assert obs["state"].shape == (4,)
+    env.close()
+
+
+def test_make_env_pixel_obs_nhwc_resize():
+    cfg = _cfg(**{
+        "algo.cnn_keys.encoder": "[rgb]",
+        "algo.mlp_keys.encoder": "[state]",
+        "env.screen_size": 32,
+    })
+    env = make_env(cfg, seed=0, rank=0)()
+    obs, _ = env.reset()
+    assert obs["rgb"].shape == (32, 32, 3)
+    assert obs["rgb"].dtype == np.uint8
+    env.close()
+
+
+def test_make_env_grayscale():
+    cfg = _cfg(**{
+        "algo.cnn_keys.encoder": "[rgb]",
+        "env.grayscale": True,
+        "env.screen_size": 16,
+    })
+    env = make_env(cfg, seed=0, rank=0)()
+    obs, _ = env.reset()
+    assert obs["rgb"].shape == (16, 16, 1)
+    env.close()
+
+
+def test_make_env_frame_stack():
+    cfg = _cfg(**{
+        "algo.cnn_keys.encoder": "[rgb]",
+        "env.frame_stack": 4,
+        "env.screen_size": 16,
+    })
+    env = make_env(cfg, seed=0, rank=0)()
+    obs, _ = env.reset()
+    assert obs["rgb"].shape == (16, 16, 12)
+    env.close()
+
+
+def test_make_env_bad_keys_raise():
+    cfg = _cfg(**{"algo.mlp_keys.encoder": "[nope]"})
+    with pytest.raises(ValueError):
+        make_env(cfg, seed=0, rank=0)()
+
+
+def test_make_vector_env_sync():
+    cfg = _cfg(**{"env.num_envs": 2, "env.sync_env": True})
+    envs = make_vector_env(cfg, seed=0, rank=0)
+    obs, _ = envs.reset()
+    assert obs["state"].shape == (2, 10)
+    actions = envs.action_space.sample()
+    obs, rewards, term, trunc, infos = envs.step(actions)
+    assert rewards.shape == (2,)
+    envs.close()
+
+
+def test_vector_env_same_step_autoreset_final_obs():
+    cfg = _cfg(**{"env.num_envs": 2, "env.sync_env": True})
+    envs = make_vector_env(cfg, seed=0, rank=0)
+    envs.reset()
+    final_seen = False
+    for _ in range(10):
+        obs, rewards, term, trunc, infos = envs.step(envs.action_space.sample())
+        if (term | trunc).any():
+            assert "final_obs" in infos or "final_observation" in infos
+            final_seen = True
+            break
+    assert final_seen
+    envs.close()

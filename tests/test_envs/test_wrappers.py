@@ -1,0 +1,146 @@
+import gymnasium as gym
+import numpy as np
+import pytest
+
+from sheeprl_tpu.envs.dummy import (
+    ContinuousDummyEnv,
+    DiscreteDummyEnv,
+    MultiDiscreteDummyEnv,
+    make_dummy_env,
+)
+from sheeprl_tpu.envs.wrappers import (
+    ActionRepeat,
+    ActionsAsObservationWrapper,
+    FrameStack,
+    RestartOnException,
+    RewardAsObservationWrapper,
+)
+
+
+def test_dummy_envs_step():
+    for env in (ContinuousDummyEnv(), DiscreteDummyEnv(), MultiDiscreteDummyEnv()):
+        obs, _ = env.reset()
+        assert obs["rgb"].shape == (64, 64, 3)  # NHWC
+        assert obs["state"].shape == (10,)
+        obs, rew, term, trunc, info = env.step(env.action_space.sample())
+        assert isinstance(rew, float)
+
+
+def test_make_dummy_env_ids():
+    assert isinstance(make_dummy_env("dummy_continuous"), ContinuousDummyEnv)
+    assert isinstance(make_dummy_env("dummy_multidiscrete"), MultiDiscreteDummyEnv)
+    assert isinstance(make_dummy_env("dummy_discrete"), DiscreteDummyEnv)
+    with pytest.raises(ValueError):
+        make_dummy_env("whatever")
+
+
+def test_action_repeat():
+    env = DiscreteDummyEnv(n_steps=100)
+    wrapped = ActionRepeat(env, 4)
+    wrapped.reset()
+    obs, rew, *_ = wrapped.step(0)
+    assert env._current_step == 4
+
+
+def test_frame_stack_channel_axis():
+    env = DiscreteDummyEnv(n_steps=100)
+    fs = FrameStack(env, num_stack=3, cnn_keys=["rgb"])
+    obs, _ = fs.reset()
+    assert obs["rgb"].shape == (64, 64, 9)  # stacked on channels (NHWC)
+    obs, *_ = fs.step(0)
+    assert obs["rgb"].shape == (64, 64, 9)
+    # newest frame occupies the last channel block
+    assert (obs["rgb"][..., 6:] == 1).all()
+
+
+def test_frame_stack_dilation():
+    env = DiscreteDummyEnv(n_steps=100)
+    fs = FrameStack(env, num_stack=2, cnn_keys=["rgb"], dilation=2)
+    obs, _ = fs.reset()
+    for i in range(1, 5):
+        obs, *_ = fs.step(0)
+    # frames at steps 2 and 4 -> channel blocks [2, 4]
+    assert (obs["rgb"][..., :3] == 2).all()
+    assert (obs["rgb"][..., 3:] == 4).all()
+
+
+def test_frame_stack_requires_dict():
+    with pytest.raises(RuntimeError):
+        FrameStack(gym.make("CartPole-v1"), 2, ["rgb"])
+    with pytest.raises(RuntimeError):
+        FrameStack(DiscreteDummyEnv(), 2, [])
+
+
+def test_reward_as_observation():
+    env = RewardAsObservationWrapper(DiscreteDummyEnv())
+    obs, _ = env.reset()
+    assert "reward" in obs and obs["reward"].shape == (1,)
+    obs, *_ = env.step(0)
+    assert obs["reward"].shape == (1,)
+    assert "reward" in env.observation_space.spaces
+
+
+def test_actions_as_observation_discrete():
+    env = ActionsAsObservationWrapper(DiscreteDummyEnv(), num_stack=3, noop=0)
+    obs, _ = env.reset()
+    assert obs["action_stack"].shape == (6,)  # 3 stacked one-hots of dim 2
+    obs, *_ = env.step(1)
+    np.testing.assert_array_equal(obs["action_stack"][-2:], [0, 1])
+
+
+def test_actions_as_observation_continuous():
+    env = ActionsAsObservationWrapper(ContinuousDummyEnv(action_dim=2), num_stack=2, noop=0.0)
+    obs, _ = env.reset()
+    assert obs["action_stack"].shape == (4,)
+
+
+def test_actions_as_observation_multidiscrete_noop_validation():
+    with pytest.raises(ValueError):
+        ActionsAsObservationWrapper(MultiDiscreteDummyEnv(), num_stack=2, noop=0)
+    env = ActionsAsObservationWrapper(MultiDiscreteDummyEnv(), num_stack=1, noop=[0, 0])
+    obs, _ = env.reset()
+    assert obs["action_stack"].shape == (4,)
+
+
+def test_actions_as_observation_invalid_args():
+    with pytest.raises(ValueError):
+        ActionsAsObservationWrapper(DiscreteDummyEnv(), num_stack=0, noop=0)
+    with pytest.raises(ValueError):
+        ActionsAsObservationWrapper(DiscreteDummyEnv(), num_stack=2, noop=0, dilation=0)
+    with pytest.raises(ValueError):
+        ActionsAsObservationWrapper(DiscreteDummyEnv(), num_stack=2, noop=0.5)
+
+
+class _CrashingEnv(gym.Env):
+    observation_space = gym.spaces.Box(-1, 1, (2,))
+    action_space = gym.spaces.Discrete(2)
+    crashes = 0
+
+    def reset(self, seed=None, options=None):
+        return np.zeros(2, dtype=np.float32), {}
+
+    def step(self, action):
+        type(self).crashes += 1
+        if type(self).crashes <= 1:
+            raise RuntimeError("crash")
+        return np.zeros(2, dtype=np.float32), 0.0, False, False, {}
+
+
+def test_restart_on_exception():
+    _CrashingEnv.crashes = 0
+    env = RestartOnException(lambda: _CrashingEnv(), wait=0.0, maxfails=3)
+    env.reset()
+    obs, rew, term, trunc, info = env.step(0)
+    assert info.get("restart_on_exception") is True
+
+
+def test_restart_on_exception_budget_exhausted():
+    class AlwaysCrash(_CrashingEnv):
+        def step(self, action):
+            raise RuntimeError("crash")
+
+    env = RestartOnException(lambda: AlwaysCrash(), wait=0.0, maxfails=1)
+    env.reset()
+    with pytest.raises(RuntimeError, match="crashed too many"):
+        env.step(0)
+        env.step(0)
