@@ -1,0 +1,3 @@
+from sheeprl_tpu.parallel.mesh import MeshRuntime
+
+__all__ = ["MeshRuntime"]

@@ -1,0 +1,266 @@
+"""MeshRuntime — the TPU-native replacement for Lightning Fabric.
+
+The reference wraps torch.distributed in Fabric (per-process DDP launcher,
+NCCL/Gloo collectives, precision plugins — SURVEY.md §2.7/§5.8). On TPU the
+idiomatic equivalent is single-controller SPMD:
+
+- ``jax.distributed.initialize`` (multi-host) instead of TCPStore rendezvous;
+- a ``jax.sharding.Mesh`` whose axes replace process groups: the ``data``
+  axis is DDP, a ``model`` axis gives fsdp/tensor sharding;
+- gradient all-reduce disappears: batches are sharded over ``data`` and XLA
+  inserts the ``psum`` inside the jitted update (``NamedSharding`` + jit);
+- precision plugins become a dtype policy (params fp32, compute bf16 on the
+  MXU by default).
+
+One MeshRuntime instance plays the roles of reference cli.py's
+``hydra.utils.instantiate(cfg.fabric)`` object and utils/fabric.py:8's
+single-device clone (``runtime.single_device()``).
+"""
+
+from __future__ import annotations
+
+import os
+import random
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+_PRECISIONS = ("32-true", "bf16-mixed", "bf16-true")
+
+
+class MeshRuntime:
+    """Owns device selection, the device mesh, dtype policy and RNG keys."""
+
+    def __init__(
+        self,
+        devices: int = 1,
+        num_nodes: int = 1,
+        strategy: str = "auto",
+        accelerator: str = "auto",
+        precision: str = "32-true",
+        model_parallel_size: int = 1,
+        **kwargs: Any,
+    ):
+        if precision not in _PRECISIONS:
+            raise ValueError(f"precision must be one of {_PRECISIONS}, got '{precision}'")
+        self._requested_devices = devices
+        self._num_nodes = num_nodes
+        self._strategy = strategy
+        self._accelerator = accelerator
+        self._precision = precision
+        self._model_parallel_size = model_parallel_size
+        self._launched = False
+        self._mesh: Optional[Mesh] = None
+        self._key: Optional[jax.Array] = None
+
+    # ------------------------------------------------------------------ #
+    # device / mesh setup
+    # ------------------------------------------------------------------ #
+    def _resolve_backend(self) -> str:
+        if self._accelerator in ("auto", None):
+            return jax.default_backend()
+        if self._accelerator in ("tpu", "cpu", "gpu"):
+            return self._accelerator
+        raise ValueError(f"Unknown accelerator '{self._accelerator}'")
+
+    def launch(self) -> "MeshRuntime":
+        """Initialize (multi-host if configured) runtime and build the mesh.
+
+        Unlike Fabric there is no process spawning: SPMD means one python
+        process per host drives all local devices.
+        """
+        if self._launched:
+            return self
+        if self._num_nodes > 1 and jax.process_count() == 1:
+            # multi-host rendezvous (reads JAX coordinator env vars)
+            jax.distributed.initialize()
+        backend = self._resolve_backend()
+        try:
+            devices = jax.devices(backend)
+        except RuntimeError:
+            devices = jax.devices()
+        n = self._requested_devices
+        if n in (-1, "auto", None):
+            n = len(devices)
+        n = int(n)
+        if n > len(devices):
+            raise RuntimeError(f"Requested {n} devices but only {len(devices)} are available")
+        devices = devices[:n]
+
+        mp = max(1, int(self._model_parallel_size))
+        if self._strategy in ("auto", "dp", "ddp"):
+            mp = 1
+        if n % mp != 0:
+            raise ValueError(f"devices ({n}) must be divisible by model_parallel_size ({mp})")
+        dp = n // mp
+        dev_array = np.asarray(devices).reshape(dp, mp)
+        self._mesh = Mesh(dev_array, axis_names=("data", "model"))
+        self._launched = True
+        return self
+
+    @property
+    def mesh(self) -> Mesh:
+        if not self._launched:
+            self.launch()
+        return self._mesh
+
+    @property
+    def world_size(self) -> int:
+        """Number of data-parallel workers (mesh data-axis size)."""
+        return self.mesh.shape["data"]
+
+    @property
+    def device_count(self) -> int:
+        return len(self.mesh.devices.ravel())
+
+    @property
+    def global_rank(self) -> int:
+        return jax.process_index()
+
+    @property
+    def node_rank(self) -> int:
+        return jax.process_index()
+
+    @property
+    def is_global_zero(self) -> bool:
+        return jax.process_index() == 0
+
+    @property
+    def strategy(self) -> str:
+        return self._strategy
+
+    @property
+    def precision(self) -> str:
+        return self._precision
+
+    @property
+    def device(self):
+        return self.mesh.devices.ravel()[0]
+
+    # ------------------------------------------------------------------ #
+    # dtype policy
+    # ------------------------------------------------------------------ #
+    @property
+    def compute_dtype(self):
+        return jnp.float32 if self._precision == "32-true" else jnp.bfloat16
+
+    @property
+    def param_dtype(self):
+        return jnp.bfloat16 if self._precision == "bf16-true" else jnp.float32
+
+    # ------------------------------------------------------------------ #
+    # RNG
+    # ------------------------------------------------------------------ #
+    def seed_everything(self, seed: int) -> jax.Array:
+        """Seed python/numpy and derive the root PRNG key (replaces Fabric's
+        seed_everything + torch cudnn flags)."""
+        random.seed(seed)
+        np.random.seed(seed)
+        os.environ["PYTHONHASHSEED"] = str(seed)
+        self._key = jax.random.PRNGKey(seed)
+        return self._key
+
+    def next_key(self, num: int = 1):
+        """Split fresh subkeys off the root key (stateful convenience for the
+        host-side loop; jitted code threads keys explicitly)."""
+        if self._key is None:
+            self.seed_everything(0)
+        self._key, *subs = jax.random.split(self._key, num + 1)
+        return subs[0] if num == 1 else subs
+
+    # ------------------------------------------------------------------ #
+    # shardings
+    # ------------------------------------------------------------------ #
+    def sharding(self, *axes: Optional[str]) -> NamedSharding:
+        """NamedSharding with the given axis names over array dims."""
+        return NamedSharding(self.mesh, P(*axes))
+
+    @property
+    def batch_sharding(self) -> NamedSharding:
+        """Shard dim 0 over the data axis (per-device minibatch split)."""
+        return NamedSharding(self.mesh, P("data"))
+
+    @property
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def shard_batch(self, batch: Any, axis: int = 0) -> Any:
+        """device_put a host pytree, splitting ``axis`` over the data axis.
+
+        Every leaf's ``axis`` dim must be divisible by world_size.
+        """
+        spec = tuple([None] * axis + ["data"])
+        sharding = NamedSharding(self.mesh, P(*spec))
+        return jax.device_put(batch, sharding)
+
+    def replicate(self, tree: Any) -> Any:
+        """Replicate params/opt-state across the mesh."""
+        return jax.device_put(tree, self.replicated)
+
+    def setup_step(
+        self,
+        fn: Callable,
+        donate_argnums: Tuple[int, ...] = (),
+        static_argnums: Tuple[int, ...] = (),
+    ) -> Callable:
+        """jit-compile a step function under this mesh.
+
+        With inputs placed via ``shard_batch``/``replicate``, XLA lays out
+        the computation SPMD over the mesh and inserts the cross-device
+        collectives (the DDP grad all-reduce equivalent) automatically.
+        """
+        jitted = jax.jit(fn, donate_argnums=donate_argnums, static_argnums=static_argnums)
+
+        def wrapped(*args, **kw):
+            with jax.set_mesh(self.mesh):
+                return jitted(*args, **kw)
+
+        wrapped._jitted = jitted
+        return wrapped
+
+    # ------------------------------------------------------------------ #
+    # single-device view (players / target critics)
+    # ------------------------------------------------------------------ #
+    def single_device(self) -> "MeshRuntime":
+        """A 1-device runtime on the same backend (reference
+        utils/fabric.py:8-35): used for env-interaction players so inference
+        never pays mesh collectives."""
+        rt = MeshRuntime(
+            devices=1,
+            num_nodes=1,
+            strategy="auto",
+            accelerator=self._accelerator,
+            precision=self._precision,
+        )
+        rt.launch()
+        rt._key = self._key
+        return rt
+
+    # ------------------------------------------------------------------ #
+    # host-side collectives (metrics, small objects)
+    # ------------------------------------------------------------------ #
+    def all_gather_object(self, obj: Any) -> list:
+        """Gather a picklable object from every process (multi-host); on a
+        single process returns [obj]. Replacement for TorchCollective
+        broadcast/gather of config/metric dicts."""
+        if jax.process_count() == 1:
+            return [obj]
+        from jax.experimental import multihost_utils
+
+        return multihost_utils.broadcast_one_to_all(obj) if False else list(
+            multihost_utils.process_allgather(obj)
+        )
+
+    def barrier(self) -> None:
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("sheeprl_tpu_barrier")
+
+    def print(self, *args: Any, **kwargs: Any) -> None:
+        if self.is_global_zero:
+            print(*args, **kwargs)

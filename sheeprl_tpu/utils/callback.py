@@ -1,0 +1,227 @@
+"""Checkpointing (reference sheeprl/utils/callback.py:14-148 + fabric.save).
+
+State pytrees (params, optimizer states, counters, Ratio/Moments state)
+are ``jax.device_get``-ed and serialized with cloudpickle; replay buffers
+are host-side numpy already. Before saving, off-policy buffers are made
+consistent by forcing a truncation at the write head (``_ckpt_rb``) and
+restored right after — exactly the reference semantics (callback.py:92-131).
+
+Multi-host: each process saves only on process 0 (buffers of other hosts
+are NOT gathered in v1 — single-host parity first; the decoupled player
+saves its own buffer like the reference's player path)."""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+
+class CheckpointCallback:
+    """keep-last-N checkpoint writer."""
+
+    def __init__(self, keep_last: Optional[int] = None):
+        self.keep_last = keep_last
+
+    # ------------------------------------------------------------------ #
+    # buffer consistency (reference _ckpt_rb / _experiment_consistent_rb)
+    # ------------------------------------------------------------------ #
+    @staticmethod
+    def _ckpt_rb(rb) -> Union[List[Tuple[Any, np.ndarray]], None]:
+        """Force a truncation at the write head so resumed sampling never
+        crosses an in-flight episode. Returns restore info."""
+        from sheeprl_tpu.data.buffers import (
+            EnvIndependentReplayBuffer,
+            EpisodeBuffer,
+            ReplayBuffer,
+        )
+
+        if isinstance(rb, ReplayBuffer):
+            if rb.empty or "truncated" not in rb.buffer:
+                return None
+            state = np.copy(rb["truncated"][rb._pos - 1])
+            rb["truncated"][rb._pos - 1, :] = True
+            return [(rb, state)]
+        if isinstance(rb, EnvIndependentReplayBuffer):
+            states = []
+            for sub in rb.buffer:
+                st = CheckpointCallback._ckpt_rb(sub)
+                if st:
+                    states.extend(st)
+            return states
+        if isinstance(rb, EpisodeBuffer):
+            # open episodes are dropped from the saved state (reference
+            # behavior: only closed episodes survive a checkpoint)
+            state = rb._open_episodes
+            rb._open_episodes = [[] for _ in range(rb.n_envs)]
+            return [(rb, state)]
+        return None
+
+    @staticmethod
+    def _restore_rb(restore_info) -> None:
+        from sheeprl_tpu.data.buffers import EpisodeBuffer, ReplayBuffer
+
+        if not restore_info:
+            return
+        for rb, state in restore_info:
+            if isinstance(rb, ReplayBuffer):
+                rb["truncated"][rb._pos - 1] = state
+            elif isinstance(rb, EpisodeBuffer):
+                rb._open_episodes = state
+
+    # ------------------------------------------------------------------ #
+    def save(
+        self,
+        runtime,
+        ckpt_path: Union[str, os.PathLike],
+        state: Dict[str, Any],
+    ) -> Optional[str]:
+        """Serialize ``state`` to ``ckpt_path`` on global rank zero."""
+        import cloudpickle
+        import jax
+
+        if not runtime.is_global_zero:
+            return None
+        restore = None
+        rb = state.get("rb")
+        if rb is not None:
+            restore = self._ckpt_rb(rb) if not isinstance(rb, list) else [
+                s for b in rb for s in (self._ckpt_rb(b) or [])
+            ]
+        try:
+            host_state = {}
+            for k, v in state.items():
+                if k == "rb":
+                    host_state[k] = self._materialize_rb(v)
+                else:
+                    host_state[k] = jax.device_get(v)
+            path = Path(ckpt_path)
+            path.parent.mkdir(parents=True, exist_ok=True)
+            tmp = path.with_suffix(path.suffix + ".tmp")
+            with open(tmp, "wb") as f:
+                cloudpickle.dump(host_state, f)
+            os.replace(tmp, path)
+        finally:
+            self._restore_rb(restore)
+        if self.keep_last:
+            self._delete_old_checkpoints(path.parent)
+        return str(path)
+
+    @staticmethod
+    def _materialize_rb(rb):
+        """Deep-copy buffer contents into plain numpy for serialization
+        (memmap-backed arrays are read into RAM)."""
+        from sheeprl_tpu.data.buffers import (
+            EnvIndependentReplayBuffer,
+            EpisodeBuffer,
+            ReplayBuffer,
+        )
+
+        if isinstance(rb, list):
+            return [CheckpointCallback._materialize_rb(b) for b in rb]
+        if isinstance(rb, ReplayBuffer):
+            return {
+                "kind": "replay",
+                "cls": type(rb).__name__,
+                "buffer_size": rb.buffer_size,
+                "n_envs": rb.n_envs,
+                "obs_keys": rb._obs_keys,
+                "pos": rb._pos,
+                "full": rb._full,
+                "data": {k: np.array(v) for k, v in rb.buffer.items()},
+            }
+        if isinstance(rb, EnvIndependentReplayBuffer):
+            return {
+                "kind": "env_independent",
+                "buffer_size": rb.buffer_size,
+                "n_envs": rb.n_envs,
+                "sub": [CheckpointCallback._materialize_rb(b) for b in rb.buffer],
+            }
+        if isinstance(rb, EpisodeBuffer):
+            return {
+                "kind": "episode",
+                "buffer_size": rb.buffer_size,
+                "minimum_episode_length": rb.minimum_episode_length,
+                "n_envs": rb.n_envs,
+                "obs_keys": rb.obs_keys,
+                "prioritize_ends": rb.prioritize_ends,
+                "episodes": [{k: np.array(v) for k, v in ep.items()} for ep in rb.buffer],
+                "cum_lengths": list(rb._cum_lengths),
+            }
+        return rb
+
+    def _delete_old_checkpoints(self, ckpt_folder: Path) -> None:
+        ckpts = sorted(ckpt_folder.glob("ckpt_*.ckpt"), key=os.path.getmtime)
+        if len(ckpts) > self.keep_last:
+            for c in ckpts[: -self.keep_last]:
+                try:
+                    os.unlink(c)
+                except OSError:
+                    pass
+
+
+def load_checkpoint(path: Union[str, os.PathLike]) -> Dict[str, Any]:
+    import cloudpickle
+
+    with open(path, "rb") as f:
+        return cloudpickle.load(f)
+
+
+def restore_buffer(saved, memmap: bool = False, memmap_dir=None):
+    """Rebuild a buffer object from its materialized checkpoint form."""
+    from sheeprl_tpu.data.buffers import (
+        EnvIndependentReplayBuffer,
+        EpisodeBuffer,
+        ReplayBuffer,
+        SequentialReplayBuffer,
+    )
+
+    if isinstance(saved, list):
+        return [restore_buffer(s, memmap, memmap_dir) for s in saved]
+    if not isinstance(saved, dict) or "kind" not in saved:
+        return saved
+    if saved["kind"] == "replay":
+        cls = SequentialReplayBuffer if saved["cls"] == "SequentialReplayBuffer" else ReplayBuffer
+        rb = cls(
+            saved["buffer_size"],
+            saved["n_envs"],
+            obs_keys=saved["obs_keys"],
+            memmap=memmap,
+            memmap_dir=memmap_dir,
+        )
+        if saved["data"]:
+            rb.add({k: v for k, v in saved["data"].items()})
+            rb._pos = saved["pos"]
+            rb._full = saved["full"]
+            for k, v in saved["data"].items():
+                rb.buffer[k][:] = v
+        return rb
+    if saved["kind"] == "env_independent":
+        rb = EnvIndependentReplayBuffer(
+            saved["buffer_size"],
+            saved["n_envs"],
+            memmap=memmap,
+            memmap_dir=memmap_dir,
+            buffer_cls=SequentialReplayBuffer,
+        )
+        rb._buf = [
+            restore_buffer(s, memmap, None if memmap_dir is None else Path(memmap_dir) / f"env_{i}")
+            for i, s in enumerate(saved["sub"])
+        ]
+        return rb
+    if saved["kind"] == "episode":
+        rb = EpisodeBuffer(
+            saved["buffer_size"],
+            saved["minimum_episode_length"],
+            n_envs=saved["n_envs"],
+            obs_keys=saved["obs_keys"],
+            prioritize_ends=saved["prioritize_ends"],
+            memmap=memmap,
+            memmap_dir=memmap_dir,
+        )
+        for ep in saved["episodes"]:
+            rb._save_episode([ep])
+        return rb
+    raise ValueError(f"Unknown buffer kind: {saved.get('kind')}")

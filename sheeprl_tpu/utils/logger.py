@@ -1,0 +1,134 @@
+"""Logger factory: rank-0 TensorBoard writer + versioned log dirs
+(reference sheeprl/utils/logger.py:12-89).
+
+The reference broadcasts the chosen log_dir to all ranks over a
+TorchCollective; under single-controller SPMD each host derives the same
+dir deterministically (version scan happens on process 0 and is shared via
+the multihost broadcast only when running multi-host)."""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+from sheeprl_tpu.config import instantiate
+
+
+class TensorBoardLogger:
+    """Thin SummaryWriter wrapper (tensorboardX) with the reference logger's
+    interface subset: log_metrics, log_hyperparams, log_video."""
+
+    def __init__(self, root_dir: str, name: str, version: Optional[str] = None):
+        self._root_dir = root_dir
+        self._name = name
+        self._version = version
+        self._writer = None
+
+    @property
+    def log_dir(self) -> str:
+        return os.path.join(self._root_dir, self._name, self._version or "")
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    @property
+    def writer(self):
+        if self._writer is None:
+            from tensorboardX import SummaryWriter
+
+            os.makedirs(self.log_dir, exist_ok=True)
+            self._writer = SummaryWriter(self.log_dir)
+        return self._writer
+
+    def log_metrics(self, metrics: Dict[str, float], step: Optional[int] = None) -> None:
+        for k, v in metrics.items():
+            try:
+                self.writer.add_scalar(k, float(v), global_step=step)
+            except (TypeError, ValueError):
+                pass
+
+    def log_hyperparams(self, params: Dict[str, Any]) -> None:
+        try:
+            import yaml
+
+            self.writer.add_text("hparams", "```yaml\n" + yaml.safe_dump(_plain(params)) + "\n```")
+        except Exception:
+            pass
+
+    def log_video(self, tag: str, frames, fps: int = 30, step: Optional[int] = None) -> None:
+        """frames: (T, H, W, C) uint8."""
+        import numpy as np
+
+        arr = np.asarray(frames)
+        if arr.ndim == 4:
+            arr = arr[None].transpose(0, 1, 4, 2, 3)  # (N, T, C, H, W) for tbX
+        try:
+            self.writer.add_video(tag, arr, global_step=step, fps=fps)
+        except Exception:
+            pass
+
+    def finalize(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+
+
+def _plain(v: Any) -> Any:
+    if isinstance(v, dict):
+        return {k: _plain(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_plain(x) for x in v]
+    return v
+
+
+def get_log_dir(runtime, root_dir: str, run_name: str, share: bool = True) -> str:
+    """Create logs/<root_dir>/<run_name>/version_N (auto-increment), shared
+    across processes (reference logger.py:39-89)."""
+    if runtime.is_global_zero:
+        base = os.path.join(root_dir, run_name)
+        os.makedirs(base, exist_ok=True)
+        existing = [
+            int(d.rsplit("_", 1)[1])
+            for d in os.listdir(base)
+            if d.startswith("version_") and d.rsplit("_", 1)[1].isdigit()
+        ]
+        version = max(existing) + 1 if existing else 0
+        log_dir = os.path.join(base, f"version_{version}")
+        os.makedirs(log_dir, exist_ok=True)
+    else:
+        log_dir = None
+    if share:
+        import jax
+
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            import numpy as np
+
+            # share the version number (fixed-size payload) from process 0
+            payload = np.zeros((1,), dtype=np.int64)
+            if runtime.is_global_zero:
+                payload[0] = int(log_dir.rsplit("_", 1)[1])
+            version = int(multihost_utils.broadcast_one_to_all(payload)[0])
+            log_dir = os.path.join(root_dir, run_name, f"version_{version}")
+    return log_dir
+
+
+def get_logger(runtime, cfg: Dict[str, Any]) -> Optional[TensorBoardLogger]:
+    """Instantiate the configured logger on rank 0 only (reference
+    logger.py:12-37)."""
+    if not runtime.is_global_zero or cfg.metric.log_level == 0:
+        return None
+    logger_cfg = dict(cfg.metric.logger)
+    root_dir = logger_cfg.get("root_dir", os.path.join("logs", "runs"))
+    logger_cfg["root_dir"] = root_dir
+    if logger_cfg.get("version") is None:
+        base = os.path.join(root_dir, logger_cfg.get("name", "run"))
+        existing = []
+        if os.path.isdir(base):
+            existing = [
+                int(d.rsplit("_", 1)[1])
+                for d in os.listdir(base)
+                if d.startswith("version_") and d.rsplit("_", 1)[1].isdigit()
+            ]
+        logger_cfg["version"] = f"version_{max(existing) + 1 if existing else 0}"
+    return instantiate(logger_cfg)

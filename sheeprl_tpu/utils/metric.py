@@ -1,0 +1,164 @@
+"""Metric accumulation (host-side, numpy) — torchmetrics-free equivalent of
+reference sheeprl/utils/metric.py (MetricAggregator:17,
+RankIndependentMetricAggregator:146) and the torchmetrics Mean/Sum metrics
+the configs reference.
+
+Under single-controller SPMD every process already computes over global
+(sharded) arrays, so `sync_on_compute` only matters multi-host, where it
+all-gathers the computed scalars via jax multihost utils."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+
+class Metric:
+    """Minimal accumulate/compute/reset metric."""
+
+    def __init__(self, sync_on_compute: bool = False, **kwargs: Any):
+        self.sync_on_compute = sync_on_compute
+        self.reset()
+
+    def update(self, value: Any) -> None:
+        raise NotImplementedError
+
+    def compute(self) -> float:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        raise NotImplementedError
+
+    def _sync(self, value: float, reduce: str) -> float:
+        if not self.sync_on_compute:
+            return value
+        import jax
+
+        if jax.process_count() == 1:
+            return value
+        from jax.experimental import multihost_utils
+
+        vals = np.asarray(multihost_utils.process_allgather(np.asarray(value)))
+        return float(vals.sum() if reduce == "sum" else vals.mean())
+
+
+class MeanMetric(Metric):
+    def update(self, value: Any) -> None:
+        value = np.asarray(value, dtype=np.float64)
+        self._total += float(np.nansum(value))
+        self._count += int(np.isfinite(value).sum()) if value.ndim else 1
+
+    def compute(self) -> float:
+        if self._count == 0:
+            return float("nan")
+        return self._sync(self._total / self._count, "mean")
+
+    def reset(self) -> None:
+        self._total = 0.0
+        self._count = 0
+
+
+class SumMetric(Metric):
+    def update(self, value: Any) -> None:
+        self._total += float(np.asarray(value, dtype=np.float64).sum())
+
+    def compute(self) -> float:
+        return self._sync(self._total, "sum")
+
+    def reset(self) -> None:
+        self._total = 0.0
+
+
+class LastValueMetric(Metric):
+    def update(self, value: Any) -> None:
+        self._value = float(np.asarray(value, dtype=np.float64).reshape(-1)[-1])
+
+    def compute(self) -> float:
+        return self._sync(self._value, "mean")
+
+    def reset(self) -> None:
+        self._value = float("nan")
+
+
+class MetricAggregator:
+    """name -> Metric dict with a global disable flag and NaN dropping on
+    compute (reference metric.py:17-144)."""
+
+    disabled: bool = False
+
+    def __init__(self, metrics: Optional[Dict[str, Metric]] = None, raise_on_missing: bool = False):
+        self.metrics: Dict[str, Metric] = dict(metrics or {})
+        self._raise_on_missing = raise_on_missing
+
+    def add(self, name: str, metric: Metric) -> None:
+        if name in self.metrics:
+            raise ValueError(f"Metric '{name}' already exists")
+        self.metrics[name] = metric
+
+    def update(self, name: str, value: Any) -> None:
+        if self.disabled:
+            return
+        if name not in self.metrics:
+            if self._raise_on_missing:
+                raise KeyError(f"Unknown metric '{name}'")
+            return
+        self.metrics[name].update(value)
+
+    def pop(self, name: str) -> None:
+        if name not in self.metrics and self._raise_on_missing:
+            raise KeyError(f"Unknown metric '{name}'")
+        self.metrics.pop(name, None)
+
+    def reset(self) -> None:
+        if self.disabled:
+            return
+        for m in self.metrics.values():
+            m.reset()
+
+    def compute(self) -> Dict[str, float]:
+        """Compute all metrics, dropping NaNs (unlogged torchmetrics return
+        NaN in the reference too)."""
+        if self.disabled:
+            return {}
+        out = {}
+        for name, metric in self.metrics.items():
+            v = metric.compute()
+            if v == v:  # not NaN
+                out[name] = v
+        return out
+
+    def keys(self):
+        return self.metrics.keys()
+
+    def __contains__(self, name: str) -> bool:
+        return name in self.metrics
+
+
+class RankIndependentMetricAggregator:
+    """Aggregator whose compute() returns per-process values stacked
+    host-side (reference metric.py:146-195); used where per-rank metrics
+    must not be averaged."""
+
+    def __init__(self, metrics: Union[Dict[str, Metric], MetricAggregator]):
+        self._aggregator = metrics if isinstance(metrics, MetricAggregator) else MetricAggregator(metrics)
+        for m in self._aggregator.metrics.values():
+            m.sync_on_compute = False
+
+    def update(self, name: str, value: Any) -> None:
+        self._aggregator.update(name, value)
+
+    def compute(self) -> List[Dict[str, float]]:
+        import jax
+
+        values = self._aggregator.compute()
+        if jax.process_count() == 1:
+            return [values]
+        from jax.experimental import multihost_utils
+
+        keys = sorted(values)
+        stacked = multihost_utils.process_allgather(np.asarray([values[k] for k in keys]))
+        return [dict(zip(keys, row.tolist())) for row in np.asarray(stacked)]
+
+    def reset(self) -> None:
+        self._aggregator.reset()

@@ -1,0 +1,52 @@
+"""Wall-clock timer context/decorator accumulating into metrics
+(reference sheeprl/utils/timer.py:16-83).
+
+Used around env interaction and train steps to derive ``Time/sps_*``
+throughputs. ``timer.disabled`` turns all timing into no-ops. On TPU the
+train step is async-dispatched, so timed regions must end with a
+``block_until_ready`` (the algorithms do this on their final loss) for the
+numbers to mean anything.
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import ContextDecorator
+from typing import Any, Dict, Optional, Type
+
+from sheeprl_tpu.utils.metric import Metric, SumMetric
+
+
+class timer(ContextDecorator):
+    disabled: bool = False
+    timers: Dict[str, Metric] = {}
+
+    def __init__(self, name: str, metric_cls: Type[Metric] = SumMetric, **metric_kwargs: Any):
+        self.name = name
+        if not timer.disabled and name not in timer.timers:
+            timer.timers[name] = metric_cls(**metric_kwargs)
+
+    def __enter__(self) -> "timer":
+        if not timer.disabled:
+            self._start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc: Any) -> bool:
+        if not timer.disabled:
+            timer.timers[self.name].update(time.perf_counter() - self._start)
+        return False
+
+    @classmethod
+    def compute(cls) -> Dict[str, float]:
+        if cls.disabled:
+            return {}
+        out = {}
+        for name, metric in cls.timers.items():
+            v = metric.compute()
+            if v == v:
+                out[name] = v
+        return out
+
+    @classmethod
+    def reset(cls) -> None:
+        cls.timers = {}

@@ -1,0 +1,121 @@
+import numpy as np
+import pytest
+
+from sheeprl_tpu.utils.metric import MeanMetric, MetricAggregator, SumMetric
+from sheeprl_tpu.utils.timer import timer
+
+
+def test_mean_metric():
+    m = MeanMetric()
+    m.update(1.0)
+    m.update([2.0, 3.0])
+    assert m.compute() == pytest.approx(2.0)
+    m.reset()
+    assert np.isnan(m.compute())
+
+
+def test_sum_metric():
+    m = SumMetric()
+    m.update(2.0)
+    m.update(3.0)
+    assert m.compute() == 5.0
+
+
+def test_aggregator_nan_dropping_and_disable():
+    agg = MetricAggregator({"a": MeanMetric(), "b": MeanMetric()})
+    agg.update("a", 1.0)
+    out = agg.compute()
+    assert out == {"a": 1.0}  # 'b' had no updates -> NaN dropped
+    MetricAggregator.disabled = True
+    try:
+        agg.update("a", 100.0)
+        assert agg.compute() == {}
+    finally:
+        MetricAggregator.disabled = False
+
+
+def test_aggregator_missing_key():
+    agg = MetricAggregator({}, raise_on_missing=True)
+    with pytest.raises(KeyError):
+        agg.update("missing", 1)
+    agg2 = MetricAggregator({})
+    agg2.update("missing", 1)  # silently ignored
+
+
+def test_timer_accumulates():
+    timer.reset()
+    with timer("Time/test"):
+        pass
+    with timer("Time/test"):
+        pass
+    out = timer.compute()
+    assert "Time/test" in out and out["Time/test"] >= 0
+    timer.reset()
+    timer.disabled = True
+    try:
+        with timer("Time/x"):
+            pass
+        assert timer.compute() == {}
+    finally:
+        timer.disabled = False
+        timer.reset()
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    import jax.numpy as jnp
+
+    from sheeprl_tpu.data.buffers import ReplayBuffer
+    from sheeprl_tpu.parallel import MeshRuntime
+    from sheeprl_tpu.utils.callback import CheckpointCallback, load_checkpoint, restore_buffer
+
+    rt = MeshRuntime(accelerator="cpu").launch()
+    rb = ReplayBuffer(8, 1)
+    rb.add({
+        "observations": np.ones((3, 1, 2), dtype=np.float32),
+        "truncated": np.zeros((3, 1, 1), dtype=np.float32),
+    })
+    cb = CheckpointCallback(keep_last=2)
+    state = {
+        "params": {"w": jnp.arange(3.0)},
+        "iter_num": 7,
+        "rb": rb,
+    }
+    path = cb.save(rt, tmp_path / "ckpt_7_0.ckpt", state)
+    # buffer mutation restored after save
+    assert rb["truncated"][rb._pos - 1, 0, 0] == 0.0
+
+    loaded = load_checkpoint(path)
+    assert loaded["iter_num"] == 7
+    np.testing.assert_array_equal(loaded["params"]["w"], [0, 1, 2])
+    # saved buffer had the forced truncation
+    assert loaded["rb"]["data"]["truncated"][rb._pos - 1, 0, 0] == 1.0
+
+    rb2 = restore_buffer(loaded["rb"])
+    assert rb2._pos == rb._pos
+    np.testing.assert_array_equal(np.asarray(rb2["observations"]), np.asarray(rb["observations"]))
+
+
+def test_checkpoint_keep_last(tmp_path):
+    import jax.numpy as jnp
+
+    from sheeprl_tpu.parallel import MeshRuntime
+    from sheeprl_tpu.utils.callback import CheckpointCallback
+
+    rt = MeshRuntime(accelerator="cpu").launch()
+    cb = CheckpointCallback(keep_last=2)
+    for i in range(5):
+        cb.save(rt, tmp_path / f"ckpt_{i}_0.ckpt", {"params": {"w": jnp.zeros(1)}, "iter_num": i})
+    remaining = sorted(p.name for p in tmp_path.glob("ckpt_*.ckpt"))
+    assert len(remaining) == 2
+    assert "ckpt_4_0.ckpt" in remaining
+
+
+def test_logger_versioning(tmp_path):
+    from sheeprl_tpu.parallel import MeshRuntime
+    from sheeprl_tpu.utils.logger import get_log_dir
+
+    rt = MeshRuntime(accelerator="cpu").launch()
+    d1 = get_log_dir(rt, str(tmp_path), "run")
+    d2 = get_log_dir(rt, str(tmp_path), "run")
+    assert d1.endswith("version_0")
+    assert d2.endswith("version_1")
