@@ -1,0 +1,4 @@
+from sheeprl_tpu.cli import run
+
+if __name__ == "__main__":
+    run()

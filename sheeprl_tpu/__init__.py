@@ -1,0 +1,16 @@
+"""sheeprl_tpu — TPU-native (jax/XLA/pjit/pallas) distributed deep-RL
+framework with the capabilities of SheepRL.
+
+Importing the package registers every algorithm via decorator side-effect
+(reference sheeprl/__init__.py:18-51)."""
+
+import os
+
+# Quiet TPU init logs in CLI usage
+os.environ.setdefault("TPU_STDERR_LOG_LEVEL", "3")
+
+from sheeprl_tpu.utils.registry import algorithm_registry, evaluation_registry  # noqa: E402
+
+from sheeprl_tpu.algos import ppo  # noqa: E402, F401
+
+__version__ = "0.1.0"

@@ -1,0 +1,1 @@
+from sheeprl_tpu.algos.ppo import evaluate, ppo  # noqa: F401  (registry side-effect)

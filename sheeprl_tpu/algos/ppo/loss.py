@@ -1,0 +1,53 @@
+"""PPO losses in jax (reference sheeprl/algos/ppo/loss.py:1-76)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _reduce(x: jax.Array, reduction: str) -> jax.Array:
+    reduction = reduction.lower()
+    if reduction == "none":
+        return x
+    if reduction == "mean":
+        return x.mean()
+    if reduction == "sum":
+        return x.sum()
+    raise ValueError(f"Unrecognized reduction: {reduction}")
+
+
+def policy_loss(
+    new_logprobs: jax.Array,
+    logprobs: jax.Array,
+    advantages: jax.Array,
+    clip_coef: jax.Array,
+    reduction: str = "mean",
+) -> jax.Array:
+    """Clipped surrogate objective, eq. (7) of the PPO paper."""
+    logratio = new_logprobs - logprobs
+    ratio = jnp.exp(logratio)
+    pg_loss1 = advantages * ratio
+    pg_loss2 = advantages * jnp.clip(ratio, 1.0 - clip_coef, 1.0 + clip_coef)
+    pg_loss = -jnp.minimum(pg_loss1, pg_loss2)
+    return _reduce(pg_loss, reduction)
+
+
+def value_loss(
+    new_values: jax.Array,
+    old_values: jax.Array,
+    returns: jax.Array,
+    clip_coef: jax.Array,
+    clip_vloss: bool,
+    reduction: str = "mean",
+) -> jax.Array:
+    if not clip_vloss:
+        return _reduce((new_values - returns) ** 2, reduction)
+    v_loss_unclipped = (new_values - returns) ** 2
+    v_clipped = old_values + jnp.clip(new_values - old_values, -clip_coef, clip_coef)
+    v_loss_clipped = (v_clipped - returns) ** 2
+    return 0.5 * jnp.maximum(v_loss_unclipped, v_loss_clipped).mean()
+
+
+def entropy_loss(entropy: jax.Array, reduction: str = "mean") -> jax.Array:
+    return _reduce(-entropy, reduction)

@@ -1,0 +1,437 @@
+"""PPO (coupled) — TPU-native main loop.
+
+Counterpart of reference sheeprl/algos/ppo/ppo.py (train:30, main:106).
+TPU-first design decisions (vs the reference's per-minibatch python loop +
+DDP backward):
+
+- the ENTIRE update — next-value bootstrap, GAE, advantage normalization,
+  ``update_epochs`` x minibatches of clipped-surrogate steps — is ONE jitted
+  function (``make_update_fn``) with ``lax.scan`` over epochs and
+  minibatches. One dispatch per iteration; XLA fuses the whole schedule;
+- data parallelism is the mesh ``data`` axis: the rollout batch is sharded
+  over envs, params replicated; XLA inserts the gradient all-reduce that
+  DDP did (SURVEY.md §2.7);
+- ``cfg.env.num_envs`` is per data-parallel worker (reference semantics):
+  the host runs ``num_envs * world_size`` vectorized envs;
+- annealed lr/clip/ent coefficients are traced scalars (no recompiles);
+  lr rides ``optax.inject_hyperparams``;
+- truncation bootstrapping (reference ppo.py:301-321) computes V(final_obs)
+  on a fixed-shape batch (all envs, substituted rows) to avoid recompiles.
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+import warnings
+from typing import Any, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from sheeprl_tpu.algos.ppo.agent import build_agent, evaluate_actions, get_values, PPOPlayer, sample_actions
+from sheeprl_tpu.algos.ppo.loss import entropy_loss, policy_loss, value_loss
+from sheeprl_tpu.algos.ppo.utils import normalize_obs, prepare_obs, test
+from sheeprl_tpu.config import instantiate
+from sheeprl_tpu.config.compose import _locate
+from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.utils.callback import CheckpointCallback, load_checkpoint
+from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
+from sheeprl_tpu.utils.registry import register_algorithm
+from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.utils.utils import gae, normalize_tensor, polynomial_decay, print_config, save_configs
+
+
+def build_ppo_optimizer(optim_cfg: Dict[str, Any], max_grad_norm: float) -> optax.GradientTransformation:
+    """optax optimizer with injectable learning_rate (for annealing inside
+    jit) and optional global-norm clipping."""
+    kwargs = {k: v for k, v in dict(optim_cfg).items() if k != "_target_"}
+    base_fn = _locate(optim_cfg["_target_"])
+    tx = optax.inject_hyperparams(base_fn)(**kwargs)
+    if max_grad_norm and max_grad_norm > 0:
+        tx = optax.chain(optax.clip_by_global_norm(float(max_grad_norm)), tx)
+    return tx
+
+
+def make_update_fn(
+    runtime,
+    module,
+    tx: optax.GradientTransformation,
+    cfg: Dict[str, Any],
+    obs_keys: Sequence[str],
+):
+    """Build the single jitted PPO update (GAE + epochs x minibatches)."""
+    cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
+    update_epochs = int(cfg.algo.update_epochs)
+    mb_size = int(cfg.algo.per_rank_batch_size) * runtime.world_size
+    gamma = float(cfg.algo.gamma)
+    gae_lambda = float(cfg.algo.gae_lambda)
+    vf_coef = float(cfg.algo.vf_coef)
+    clip_vloss = bool(cfg.algo.clip_vloss)
+    reduction = str(cfg.algo.loss_reduction)
+    normalize_adv = bool(cfg.algo.normalize_advantages)
+
+    def update(params, opt_state, data, next_obs, key, clip_coef, ent_coef, lr):
+        # ------------------------------------------------- GAE (on device)
+        norm_next_obs = normalize_obs(
+            {k: next_obs[k].astype(jnp.float32) for k in obs_keys}, cnn_keys, obs_keys
+        )
+        next_values = get_values(module, params, norm_next_obs)
+        returns, advantages = gae(
+            data["rewards"], data["values"], data["dones"], next_values, gamma, gae_lambda
+        )
+        data = {**data, "returns": returns, "advantages": advantages}
+
+        # ------------------------------------------------- flatten (T*B, ...)
+        n_total = data["rewards"].shape[0] * data["rewards"].shape[1]
+        flat = {k: v.reshape(n_total, *v.shape[2:]) for k, v in data.items()}
+        num_minibatches = max(1, -(-n_total // mb_size))
+        n_used = num_minibatches * mb_size
+
+        # inject the (possibly annealed) learning rate
+        opt_state = _set_lr(opt_state, lr)
+
+        def loss_fn(p, mb):
+            obs = {k: mb[k].astype(jnp.float32) for k in obs_keys}
+            obs = normalize_obs(obs, cnn_keys, obs_keys)
+            new_logprobs, entropy, new_values = evaluate_actions(module, p, obs, mb["actions"])
+            adv = mb["advantages"]
+            if normalize_adv:
+                adv = normalize_tensor(adv)
+            pg = policy_loss(new_logprobs, mb["logprobs"], adv, clip_coef, reduction)
+            vl = value_loss(new_values, mb["values"], mb["returns"], clip_coef, clip_vloss, reduction)
+            ent = entropy_loss(entropy, reduction)
+            total = pg + vf_coef * vl + ent_coef * ent
+            return total, jnp.stack([pg, vl, ent])
+
+        grad_fn = jax.grad(loss_fn, has_aux=True)
+
+        def mb_step(carry, mb):
+            params, opt_state = carry
+            grads, losses = grad_fn(params, mb)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return (params, opt_state), losses
+
+        def epoch_step(carry, ekey):
+            params, opt_state = carry
+            perm = jax.random.permutation(ekey, n_total)
+            if n_used > n_total:  # pad by wrapping (fixed shapes for scan)
+                perm = jnp.concatenate([perm, perm[: n_used - n_total]])
+            shuffled = jax.tree_util.tree_map(
+                lambda x: x[perm].reshape(num_minibatches, mb_size, *x.shape[1:]), flat
+            )
+            (params, opt_state), losses = jax.lax.scan(mb_step, (params, opt_state), shuffled)
+            return (params, opt_state), losses.mean(0)
+
+        keys = jax.random.split(key, update_epochs)
+        (params, opt_state), losses = jax.lax.scan(epoch_step, (params, opt_state), keys)
+        mean_losses = losses.mean(0)
+        metrics = {
+            "Loss/policy_loss": mean_losses[0],
+            "Loss/value_loss": mean_losses[1],
+            "Loss/entropy_loss": mean_losses[2],
+        }
+        return params, opt_state, metrics
+
+    return runtime.setup_step(update, donate_argnums=(0, 1))
+
+
+def _set_lr(opt_state, lr):
+    """Override learning_rate inside an InjectHyperparamsState (possibly
+    nested in an optax.chain tuple)."""
+    if hasattr(opt_state, "hyperparams") and "learning_rate" in opt_state.hyperparams:
+        hp = dict(opt_state.hyperparams)
+        hp["learning_rate"] = jnp.asarray(lr, dtype=jnp.asarray(hp["learning_rate"]).dtype)
+        return opt_state._replace(hyperparams=hp)
+    if type(opt_state) is tuple:  # optax.chain state (not a NamedTuple state)
+        return tuple(_set_lr(s, lr) for s in opt_state)
+    return opt_state
+
+
+@register_algorithm()
+def main(runtime, cfg: Dict[str, Any]):
+    if "minedojo" in str(cfg.env.wrapper.get("_target_", "")).lower():
+        raise ValueError(
+            "MineDojo is not currently supported by the PPO agent (no action-mask handling); "
+            "use one of the Dreamer agents."
+        )
+
+    initial_ent_coef = copy.deepcopy(cfg.algo.ent_coef)
+    initial_clip_coef = copy.deepcopy(cfg.algo.clip_coef)
+
+    world_size = runtime.world_size
+    runtime.seed_everything(cfg.seed)
+
+    state = None
+    if cfg.checkpoint.resume_from:
+        state = load_checkpoint(cfg.checkpoint.resume_from)
+
+    logger = get_logger(runtime, cfg)
+    log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name)
+    runtime.print(f"Log dir: {log_dir}")
+    if logger:
+        logger.log_hyperparams(cfg)
+
+    # ------------------------------------------------------------- envs
+    from gymnasium.vector import AsyncVectorEnv, AutoresetMode, SyncVectorEnv
+
+    total_envs = cfg.env.num_envs * world_size
+    thunks = [
+        make_env(
+            cfg,
+            cfg.seed + i,
+            0,
+            log_dir if runtime.is_global_zero else None,
+            "train",
+            vector_env_idx=i,
+        )
+        for i in range(total_envs)
+    ]
+    if cfg.env.sync_env:
+        envs = SyncVectorEnv(thunks, autoreset_mode=AutoresetMode.SAME_STEP)
+    else:
+        envs = AsyncVectorEnv(thunks, context="spawn", autoreset_mode=AutoresetMode.SAME_STEP)
+    observation_space = envs.single_observation_space
+
+    import gymnasium as gym
+
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+    cnn_keys = cfg.algo.cnn_keys.encoder
+    mlp_keys = cfg.algo.mlp_keys.encoder
+    obs_keys = cnn_keys + mlp_keys
+    if obs_keys == []:
+        raise RuntimeError("Specify at least one of `cnn_keys.encoder` or `mlp_keys.encoder`")
+    if cfg.metric.log_level > 0:
+        runtime.print("Encoder CNN keys:", cnn_keys)
+        runtime.print("Encoder MLP keys:", mlp_keys)
+
+    is_continuous = isinstance(envs.single_action_space, gym.spaces.Box)
+    is_multidiscrete = isinstance(envs.single_action_space, gym.spaces.MultiDiscrete)
+    actions_dim = tuple(
+        envs.single_action_space.shape
+        if is_continuous
+        else (envs.single_action_space.nvec.tolist() if is_multidiscrete else [envs.single_action_space.n])
+    )
+    clip_rewards_fn = (lambda r: np.tanh(r)) if cfg.env.clip_rewards else (lambda r: r)
+
+    # ------------------------------------------------------------- agent
+    module, params = build_agent(
+        runtime,
+        actions_dim,
+        is_continuous,
+        cfg,
+        observation_space,
+        state["agent"] if state else None,
+    )
+    params = runtime.replicate(params)
+    tx = build_ppo_optimizer(cfg.algo.optimizer, cfg.algo.max_grad_norm)
+    opt_state = runtime.replicate(tx.init(params)) if state is None else jax.tree_util.tree_map(
+        jnp.asarray, state["optimizer"]
+    )
+
+    def _prep(obs):
+        return prepare_obs(obs, cnn_keys=cnn_keys, num_envs=total_envs)
+
+    player = PPOPlayer(module, params, _prep)
+
+    if runtime.is_global_zero:
+        save_configs(cfg, log_dir)
+
+    aggregator = None
+    if not MetricAggregator.disabled:
+        aggregator: MetricAggregator = instantiate(dict(cfg.metric.aggregator))
+
+    # ------------------------------------------------------------- buffer
+    if cfg.buffer.size < cfg.algo.rollout_steps:
+        raise ValueError(
+            f"The size of the buffer ({cfg.buffer.size}) cannot be lower "
+            f"than the rollout steps ({cfg.algo.rollout_steps})"
+        )
+    rb = ReplayBuffer(
+        cfg.buffer.size,
+        total_envs,
+        memmap=cfg.buffer.memmap,
+        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{runtime.global_rank}"),
+        obs_keys=obs_keys,
+    )
+
+    # ------------------------------------------------------------- counters
+    last_train = 0
+    train_step = 0
+    start_iter = (state["iter_num"] // world_size) + 1 if state else 1
+    policy_step = state["iter_num"] * cfg.env.num_envs * cfg.algo.rollout_steps if state else 0
+    last_log = state["last_log"] if state else 0
+    last_checkpoint = state["last_checkpoint"] if state else 0
+    policy_steps_per_iter = int(cfg.env.num_envs * cfg.algo.rollout_steps * world_size)
+    total_iters = cfg.algo.total_steps // policy_steps_per_iter if not cfg.dry_run else 1
+    if state:
+        cfg.algo.per_rank_batch_size = state["batch_size"] // world_size
+
+    if cfg.metric.log_level > 0 and cfg.metric.log_every % policy_steps_per_iter != 0:
+        warnings.warn(
+            f"metric.log_every ({cfg.metric.log_every}) is not a multiple of "
+            f"policy_steps_per_iter ({policy_steps_per_iter}); metrics log at the next multiple."
+        )
+
+    ckpt_cb = CheckpointCallback(keep_last=cfg.checkpoint.keep_last)
+    update_fn = make_update_fn(runtime, module, tx, cfg, obs_keys)
+
+    lr0 = float(cfg.algo.optimizer.get("learning_rate", cfg.algo.optimizer.get("lr", 1e-3)))
+    current_lr = lr0
+    current_clip = float(cfg.algo.clip_coef)
+    current_ent = float(cfg.algo.ent_coef)
+
+    # ------------------------------------------------------------- run
+    step_data: Dict[str, np.ndarray] = {}
+    next_obs_np = envs.reset(seed=cfg.seed)[0]
+
+    for iter_num in range(start_iter, total_iters + 1):
+        for _ in range(cfg.algo.rollout_steps):
+            policy_step += cfg.env.num_envs * world_size
+
+            with timer("Time/env_interaction_time", SumMetric, sync_on_compute=False):
+                flat_actions, real_actions, logprobs, values = player.get_actions(
+                    next_obs_np, runtime.next_key()
+                )
+                real_actions_np = np.asarray(real_actions)
+                obs, rewards, terminated, truncated, info = envs.step(
+                    real_actions_np.reshape(envs.action_space.shape)
+                )
+                truncated_envs = np.nonzero(truncated)[0]
+                if len(truncated_envs) > 0:
+                    # fixed-shape bootstrap: substitute final obs rows, value
+                    # the full env batch, pick the truncated entries
+                    real_next_obs = {k: np.array(v) for k, v in obs.items()}
+                    for env_idx in truncated_envs:
+                        final = info["final_obs"][env_idx]
+                        for k in obs_keys:
+                            real_next_obs[k][env_idx] = final[k]
+                    vals = np.asarray(player.get_values(real_next_obs))
+                    rewards[truncated_envs] += cfg.algo.gamma * vals[truncated_envs].reshape(
+                        rewards[truncated_envs].shape
+                    )
+                dones = np.logical_or(terminated, truncated).reshape(total_envs, 1).astype(np.uint8)
+                rewards = clip_rewards_fn(rewards).reshape(total_envs, 1).astype(np.float32)
+
+            for k in obs_keys:
+                step_data[k] = next_obs_np[k][np.newaxis]
+            step_data["dones"] = dones[np.newaxis]
+            step_data["values"] = np.asarray(values)[np.newaxis]
+            step_data["actions"] = np.asarray(flat_actions)[np.newaxis]
+            step_data["logprobs"] = np.asarray(logprobs)[np.newaxis]
+            step_data["rewards"] = rewards[np.newaxis]
+            rb.add(step_data, validate_args=cfg.buffer.validate_args)
+
+            next_obs_np = obs
+
+            if cfg.metric.log_level > 0 and "final_info" in info:
+                ep = info["final_info"].get("episode")
+                if ep is not None:
+                    mask = info["final_info"]["_episode"]
+                    for i in np.nonzero(mask)[0]:
+                        ep_rew = float(ep["r"][i])
+                        ep_len = float(ep["l"][i])
+                        if aggregator and "Rewards/rew_avg" in aggregator:
+                            aggregator.update("Rewards/rew_avg", ep_rew)
+                        if aggregator and "Game/ep_len_avg" in aggregator:
+                            aggregator.update("Game/ep_len_avg", ep_len)
+                        runtime.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}")
+
+        # ------------------------------------------------- device update
+        local_data = rb.to_arrays()
+        local_data = {
+            k: v.astype(jnp.float32) if v.dtype not in (jnp.uint8,) else v for k, v in local_data.items()
+        }
+        device_next_obs = {k: jnp.asarray(next_obs_np[k]) for k in obs_keys}
+
+        with timer("Time/train_time", SumMetric, sync_on_compute=cfg.metric.sync_on_compute):
+            params, opt_state, train_metrics = update_fn(
+                params,
+                opt_state,
+                local_data,
+                device_next_obs,
+                runtime.next_key(),
+                jnp.float32(current_clip),
+                jnp.float32(current_ent),
+                jnp.float32(current_lr),
+            )
+            train_metrics = jax.device_get(train_metrics)
+        player.params = params
+        train_step += world_size
+
+        if aggregator and not aggregator.disabled:
+            for k, v in train_metrics.items():
+                aggregator.update(k, v)
+
+        # ------------------------------------------------- logging
+        if cfg.metric.log_level > 0 and logger:
+            logger.log_metrics({"Info/learning_rate": current_lr}, policy_step)
+            logger.log_metrics({"Info/clip_coef": current_clip, "Info/ent_coef": current_ent}, policy_step)
+            if policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters:
+                if aggregator and not aggregator.disabled:
+                    logger.log_metrics(aggregator.compute(), policy_step)
+                    aggregator.reset()
+                if not timer.disabled:
+                    timer_metrics = timer.compute()
+                    if timer_metrics.get("Time/train_time", 0) > 0:
+                        logger.log_metrics(
+                            {"Time/sps_train": (train_step - last_train) / timer_metrics["Time/train_time"]},
+                            policy_step,
+                        )
+                    if timer_metrics.get("Time/env_interaction_time", 0) > 0:
+                        logger.log_metrics(
+                            {
+                                "Time/sps_env_interaction": (
+                                    (policy_step - last_log) / world_size * cfg.env.action_repeat
+                                )
+                                / timer_metrics["Time/env_interaction_time"]
+                            },
+                            policy_step,
+                        )
+                    timer.reset()
+                last_log = policy_step
+                last_train = train_step
+
+        # ------------------------------------------------- annealing
+        if cfg.algo.anneal_lr:
+            current_lr = polynomial_decay(
+                iter_num, initial=lr0, final=0.0, max_decay_steps=total_iters, power=1.0
+            )
+        if cfg.algo.anneal_clip_coef:
+            current_clip = polynomial_decay(
+                iter_num, initial=initial_clip_coef, final=0.0, max_decay_steps=total_iters, power=1.0
+            )
+        if cfg.algo.anneal_ent_coef:
+            current_ent = polynomial_decay(
+                iter_num, initial=initial_ent_coef, final=0.0, max_decay_steps=total_iters, power=1.0
+            )
+
+        # ------------------------------------------------- checkpoint
+        if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
+            iter_num == total_iters and cfg.checkpoint.save_last
+        ):
+            last_checkpoint = policy_step
+            ckpt_state = {
+                "agent": params,
+                "optimizer": opt_state,
+                "iter_num": iter_num * world_size,
+                "batch_size": cfg.algo.per_rank_batch_size * world_size,
+                "last_log": last_log,
+                "last_checkpoint": last_checkpoint,
+            }
+            ckpt_path = os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{runtime.global_rank}.ckpt")
+            ckpt_cb.save(runtime, ckpt_path, ckpt_state)
+
+    envs.close()
+    if runtime.is_global_zero and cfg.algo.run_test:
+        test_rew = test(player, runtime, cfg, log_dir)
+        if logger:
+            logger.log_metrics({"Test/cumulative_reward": test_rew}, policy_step)
+    if logger:
+        logger.finalize()

@@ -1,0 +1,68 @@
+"""PPO helpers (reference sheeprl/algos/ppo/utils.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.utils.env import make_env
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/value_loss",
+    "Loss/policy_loss",
+    "Loss/entropy_loss",
+}
+MODELS_TO_REGISTER = {"agent"}
+
+
+def normalize_obs(obs: Dict[str, Any], cnn_keys: Sequence[str], obs_keys: Sequence[str]) -> Dict[str, Any]:
+    """uint8 image keys -> [-0.5, 0.5] floats; runs on-device inside jit so
+    host->HBM transfers stay at 1 byte/pixel."""
+    return {k: obs[k] / 255.0 - 0.5 if k in cnn_keys else obs[k] for k in obs_keys}
+
+
+def prepare_obs(
+    obs: Dict[str, np.ndarray], *, cnn_keys: Sequence[str] = (), num_envs: int = 1, **kwargs: Any
+) -> Dict[str, jnp.ndarray]:
+    """Host numpy obs dict -> float device arrays (B, ...), normalized."""
+    out = {}
+    for k, v in obs.items():
+        arr = jnp.asarray(v, dtype=jnp.float32)
+        if k in cnn_keys:
+            arr = arr.reshape(num_envs, *arr.shape[-3:])
+        else:
+            arr = arr.reshape(num_envs, -1)
+        out[k] = arr
+    return normalize_obs(out, cnn_keys, list(out.keys()))
+
+
+def test(player, runtime, cfg: Dict[str, Any], log_dir: str) -> float:
+    """Greedy rollout of one episode on rank 0 (reference ppo/utils.py test)."""
+    from sheeprl_tpu.algos.ppo.agent import PPOPlayer
+
+    # rebind obs preparation to a single env (the training player batches
+    # over all vectorized envs)
+    player = PPOPlayer(
+        player.module,
+        player.params,
+        lambda obs: prepare_obs(obs, cnn_keys=cfg.algo.cnn_keys.encoder, num_envs=1),
+    )
+    env = make_env(cfg, cfg.seed, 0, log_dir, "test", vector_env_idx=0)()
+    done = False
+    cumulative_rew = 0.0
+    obs = env.reset(seed=cfg.seed)[0]
+    while not done:
+        _, real_actions, _, _ = player.get_actions(obs, runtime.next_key(), greedy=True)
+        actions = np.asarray(real_actions).reshape(env.action_space.shape)
+        obs, reward, terminated, truncated, _ = env.step(actions)
+        done = bool(terminated or truncated)
+        cumulative_rew += float(reward)
+        if cfg.dry_run:
+            done = True
+    runtime.print("Test - Reward:", cumulative_rew)
+    env.close()
+    return cumulative_rew

@@ -1,0 +1,199 @@
+"""CLI: run / evaluation / registration entrypoints.
+
+Counterpart of reference sheeprl/cli.py (run:358, run_algorithm:60,
+eval_algorithm:202, check_configs:271, resume_from_checkpoint:23,
+evaluation:369, registration:408), driven by the in-house hydra-style
+composer (no hydra dependency). Overrides are passed exactly like the
+reference: ``python sheeprl.py exp=ppo env.num_envs=8 fabric.devices=4``.
+
+There is no ``fabric.launch`` process boundary: under single-controller
+SPMD one process per host drives all local devices through the mesh.
+"""
+
+from __future__ import annotations
+
+import importlib
+import os
+import sys
+from typing import Any, Dict, List, Optional, Sequence
+
+from sheeprl_tpu.config import compose, dotdict
+from sheeprl_tpu.config.compose import deep_merge, yaml_load
+from sheeprl_tpu.utils.registry import algorithm_registry, evaluation_registry, find_algorithm, find_evaluation
+
+
+def resume_from_checkpoint(cfg: dotdict) -> dotdict:
+    """Merge the checkpoint's config with the current one, keeping the new
+    total_steps / learning_starts-style knobs (reference cli.py:23-57)."""
+    import yaml
+
+    ckpt_path = cfg.checkpoint.resume_from
+    ckpt_dir = os.path.dirname(os.path.dirname(ckpt_path))
+    old_cfg_path = os.path.join(ckpt_dir, "config.yaml")
+    if not os.path.exists(old_cfg_path):
+        old_cfg_path = os.path.join(os.path.dirname(ckpt_path), "config.yaml")
+    if not os.path.exists(old_cfg_path):
+        raise RuntimeError(f"Cannot find the config file of the checkpoint: {old_cfg_path}")
+    with open(old_cfg_path) as f:
+        old_cfg = yaml_load(f.read())
+    if old_cfg["env"]["id"] != cfg.env.id:
+        raise RuntimeError(
+            f"This experiment is run with a different environment from the checkpoint: "
+            f"{old_cfg['env']['id']} vs {cfg.env.id}"
+        )
+    if old_cfg["algo"]["name"] != cfg.algo.name:
+        raise RuntimeError(
+            f"This experiment is run with a different algorithm from the checkpoint: "
+            f"{old_cfg['algo']['name']} vs {cfg.algo.name}"
+        )
+    kept = {
+        "total_steps": cfg.algo.total_steps,
+        "resume_from": ckpt_path,
+        "run_name": cfg.run_name,
+        "exp_name": cfg.exp_name,
+        "seed": cfg.seed,
+    }
+    learning_starts = cfg.algo.get("learning_starts")
+    merged = dict(old_cfg)
+    deep_merge(merged, {"checkpoint": {"resume_from": ckpt_path}})
+    merged["algo"]["total_steps"] = kept["total_steps"]
+    if learning_starts is not None:
+        merged["algo"]["learning_starts"] = learning_starts
+    merged["run_name"] = kept["run_name"]
+    merged["exp_name"] = kept["exp_name"]
+    merged["seed"] = kept["seed"]
+    return dotdict(merged)
+
+
+def check_configs(cfg: dotdict) -> None:
+    """Config validation (reference cli.py:271-345): strategy whitelist and
+    per-algo constraints."""
+    strategy = str(cfg.fabric.get("strategy", "auto"))
+    if strategy not in ("auto", "dp", "ddp", "fsdp"):
+        raise ValueError(
+            f"Unknown fabric strategy '{strategy}'. The TPU runtime supports: auto, dp/ddp, fsdp"
+        )
+    decoupled = False
+    try:
+        _, _, decoupled = find_algorithm(cfg.algo.name)
+    except RuntimeError:
+        pass
+    if decoupled and cfg.fabric.get("accelerator") == "cpu" and int(cfg.env.num_envs) < 1:
+        raise ValueError("Decoupled algorithms need at least one environment")
+
+
+def _build_runtime(cfg: dotdict):
+    from sheeprl_tpu.config import instantiate
+
+    fabric_cfg = dict(cfg.fabric)
+    runtime = instantiate(fabric_cfg)
+    runtime.launch()
+    return runtime
+
+
+def run_algorithm(cfg: dotdict) -> None:
+    """Registry lookup + algorithm dispatch (reference cli.py:60-199)."""
+    module, entrypoint, decoupled = find_algorithm(cfg.algo.name)
+    algo_module = importlib.import_module(f"{module}.{cfg.algo.name}")
+    utils_module = importlib.import_module(f"{module}.utils")
+
+    # filter metric aggregator by the algo's known keys (reference cli.py:151-165)
+    keys = getattr(utils_module, "AGGREGATOR_KEYS", set())
+    if "aggregator" in cfg.metric and "metrics" in cfg.metric.aggregator:
+        cfg.metric.aggregator.metrics = dotdict(
+            {k: v for k, v in cfg.metric.aggregator.metrics.items() if k in keys}
+        )
+
+    from sheeprl_tpu.utils.metric import MetricAggregator
+    from sheeprl_tpu.utils.timer import timer
+
+    if cfg.metric.log_level == 0:
+        MetricAggregator.disabled = True
+        timer.disabled = True
+    if cfg.metric.get("disable_timer", False):
+        timer.disabled = True
+
+    runtime = _build_runtime(cfg)
+    entry_fn = getattr(algo_module, entrypoint)
+    entry_fn(runtime, cfg)
+
+
+def run(args: Optional[Sequence[str]] = None) -> None:
+    """Main training app: ``sheeprl exp=... [overrides...]``."""
+    overrides = list(args if args is not None else sys.argv[1:])
+    cfg = compose(config_name="config", overrides=overrides)
+    if cfg.get("num_threads"):
+        os.environ.setdefault("XLA_FLAGS", "")
+    from sheeprl_tpu.utils.utils import print_config
+
+    if cfg.checkpoint.resume_from:
+        cfg = resume_from_checkpoint(cfg)
+    check_configs(cfg)
+    print_config(cfg)
+    run_algorithm(cfg)
+
+
+def eval_algorithm(cfg: dotdict) -> None:
+    """Load checkpoint + dispatch registered evaluation (reference cli.py:202)."""
+    from sheeprl_tpu.utils.callback import load_checkpoint
+
+    state = load_checkpoint(cfg.checkpoint_path)
+    module, entrypoint = find_evaluation(cfg.algo.name)
+    eval_module = importlib.import_module(f"{module}.evaluate")
+    eval_fn = getattr(eval_module, entrypoint)
+    runtime = _build_runtime(cfg)
+    eval_fn(runtime, cfg, state)
+
+
+def evaluation(args: Optional[Sequence[str]] = None) -> None:
+    """Evaluation app: ``sheeprl-eval checkpoint_path=... [overrides...]``.
+
+    Loads the run config saved next to the checkpoint, then overrides
+    env/fabric for single-device evaluation (reference cli.py:369-405).
+    """
+    overrides = list(args if args is not None else sys.argv[1:])
+    kv = dict(o.split("=", 1) for o in overrides if "=" in o)
+    ckpt_path = kv.get("checkpoint_path")
+    if not ckpt_path:
+        raise ValueError("You must specify `checkpoint_path=...`")
+    ckpt_dir = os.path.dirname(os.path.dirname(os.path.abspath(ckpt_path)))
+    cfg_path = os.path.join(ckpt_dir, "config.yaml")
+    if not os.path.exists(cfg_path):
+        raise RuntimeError(f"Cannot find the config file of the checkpoint: {cfg_path}")
+    with open(cfg_path) as f:
+        run_cfg = dotdict(yaml_load(f.read()))
+    capture_video = yaml_load(kv.get("env.capture_video", "True"))
+    seed = int(kv.get("seed", run_cfg.get("seed", 42)))
+    run_cfg["env"]["capture_video"] = bool(capture_video)
+    run_cfg["env"]["num_envs"] = 1
+    run_cfg["fabric"] = dotdict(
+        {
+            "_target_": "sheeprl_tpu.parallel.MeshRuntime",
+            "devices": 1,
+            "num_nodes": 1,
+            "strategy": "auto",
+            "accelerator": kv.get("fabric.accelerator", run_cfg["fabric"].get("accelerator", "auto")),
+            "precision": run_cfg["fabric"].get("precision", "32-true"),
+        }
+    )
+    run_cfg["seed"] = seed
+    run_cfg["checkpoint_path"] = os.path.abspath(ckpt_path)
+    run_cfg["run_name"] = os.path.join(
+        os.path.basename(os.path.dirname(os.path.dirname(ckpt_dir))) if False else str(run_cfg.get("run_name", "run")),
+        "evaluation",
+    )
+    cfg = dotdict(run_cfg)
+    eval_algorithm(cfg)
+
+
+def registration(args: Optional[Sequence[str]] = None) -> None:
+    """Model-manager registration app (reference cli.py:408). Requires the
+    optional mlflow backend."""
+    from sheeprl_tpu.utils.imports import _IS_MLFLOW_AVAILABLE
+
+    if not _IS_MLFLOW_AVAILABLE:
+        raise ModuleNotFoundError(
+            "mlflow is not installed in this environment; the model-manager registration app "
+            "requires it (`pip install mlflow`)"
+        )
+    raise NotImplementedError  # implemented once an mlflow backend is present

@@ -228,12 +228,10 @@ class LayerNormGRUCell(nn.Module):
 
 
 class MultiEncoder(nn.Module):
-    """Concat features of a CNN encoder (over stacked image keys) and an MLP
-    encoder (over stacked vector keys). Reference MultiEncoder:413.
-
-    Sub-encoders are passed as modules; obs is a dict. CNN keys are
-    concatenated on the channel (last) axis, MLP keys on the feature axis.
-    """
+    """Concat features of a CNN encoder and an MLP encoder over a dict obs
+    (reference MultiEncoder:413). Sub-encoders receive the full obs dict and
+    extract/stack their own keys (CNN keys on the channel axis, MLP keys on
+    the feature axis) — same contract as the reference's per-algo encoders."""
 
     cnn_encoder: Optional[nn.Module] = None
     mlp_encoder: Optional[nn.Module] = None
@@ -243,11 +241,9 @@ class MultiEncoder(nn.Module):
     def __call__(self, obs: Dict[str, jax.Array]) -> jax.Array:
         feats = []
         if self.cnn_encoder is not None and len(self.cnn_keys) > 0:
-            imgs = jnp.concatenate([obs[k] for k in self.cnn_keys], axis=-1)
-            feats.append(self.cnn_encoder(imgs))
+            feats.append(self.cnn_encoder(obs))
         if self.mlp_encoder is not None and len(self.mlp_keys) > 0:
-            vecs = jnp.concatenate([obs[k] for k in self.mlp_keys], axis=-1)
-            feats.append(self.mlp_encoder(vecs))
+            feats.append(self.mlp_encoder(obs))
         if not feats:
             raise ValueError("MultiEncoder needs at least one of cnn/mlp encoders")
         return jnp.concatenate(feats, axis=-1) if len(feats) > 1 else feats[0]
@@ -266,14 +262,16 @@ class MultiDecoder(nn.Module):
 
     def __call__(self, latent: jax.Array) -> Dict[str, jax.Array]:
         out: Dict[str, jax.Array] = {}
+        import numpy as np
+
         if self.cnn_decoder is not None and len(self.cnn_keys) > 0:
             rec = self.cnn_decoder(latent)
-            splits = list(jnp.cumsum(jnp.asarray(self.cnn_channels))[:-1])
+            splits = np.cumsum(self.cnn_channels)[:-1].tolist()
             chunks = jnp.split(rec, splits, axis=-1) if splits else [rec]
             out.update(dict(zip(self.cnn_keys, chunks)))
         if self.mlp_decoder is not None and len(self.mlp_keys) > 0:
             rec = self.mlp_decoder(latent)
-            splits = list(jnp.cumsum(jnp.asarray(self.mlp_dims))[:-1])
+            splits = np.cumsum(self.mlp_dims)[:-1].tolist()
             chunks = jnp.split(rec, splits, axis=-1) if splits else [rec]
             out.update(dict(zip(self.mlp_keys, chunks)))
         return out

@@ -8,11 +8,18 @@ real pod.
 
 import os
 
-# must be set before jax is imported anywhere
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# FORCE cpu — the machine env pins JAX_PLATFORMS to the real TPU tunnel,
+# which tests must never touch. The axon sitecustomize imports jax at
+# interpreter start (before this file runs), so the env var alone is too
+# late; jax.config.update works as long as no backend is initialized yet.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

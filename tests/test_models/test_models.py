@@ -89,12 +89,19 @@ def test_layer_norm_gru_cell():
 
 
 def test_multi_encoder_concat():
-    enc = MultiEncoder(
-        cnn_encoder=NatureCNN(features_dim=32),
-        mlp_encoder=MLP(hidden_sizes=(16,)),
-        cnn_keys=("rgb",),
-        mlp_keys=("state",),
-    )
+    import flax.linen as nn
+
+    class _Cnn(nn.Module):
+        @nn.compact
+        def __call__(self, obs):
+            return NatureCNN(features_dim=32)(obs["rgb"])
+
+    class _Mlp(nn.Module):
+        @nn.compact
+        def __call__(self, obs):
+            return MLP(hidden_sizes=(16,))(obs["state"])
+
+    enc = MultiEncoder(cnn_encoder=_Cnn(), mlp_encoder=_Mlp(), cnn_keys=("rgb",), mlp_keys=("state",))
     obs = {"rgb": jnp.ones((2, 64, 64, 3)), "state": jnp.ones((2, 4))}
     params = enc.init(KEY, obs)
     out = enc.apply(params, obs)
