@@ -96,7 +96,7 @@ def test_two_hot_distribution_mean_and_logprob():
     idx = int(jnp.argmin(jnp.abs(bins - jnp.log1p(jnp.array(target_val)))))
     logits = jax.nn.one_hot(idx, 255) * 100.0
     d = TwoHotEncodingDistribution(logits[None], dims=1)
-    assert float(d.mean) == pytest.approx(target_val, rel=0.1)
+    assert float(d.mean[0, 0]) == pytest.approx(target_val, rel=0.1)
     lp = d.log_prob(jnp.array([[target_val]]))
     assert lp.shape == (1,)
 
