@@ -1,0 +1,57 @@
+"""Benchmark harness — prints ONE JSON line for the driver.
+
+Workload: the reference's own PPO benchmark protocol
+(reference benchmarks/benchmark.py + configs/exp/ppo_benchmarks.yaml):
+PPO on CartPole-v1, 1 env, 65536 total steps, linear actor/critic heads,
+logging/checkpoint/test disabled, wall-clock around cli.run().
+
+Baseline: 81.27 s (reference README.md:100-115, SheepRL v0.5.5, 1 device).
+``vs_baseline`` is the speedup factor (baseline_time / our_time, >1 is
+faster than the reference).
+
+Env overrides:
+  BENCH_TOTAL_STEPS  — shrink the workload (wall-clock is extrapolated
+                       linearly to 65536 for the reported value).
+"""
+
+import json
+import os
+import sys
+import time
+
+REFERENCE_PPO_SECONDS = 81.27
+FULL_STEPS = 65536
+
+
+def main() -> None:
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    total_steps = int(os.environ.get("BENCH_TOTAL_STEPS", FULL_STEPS))
+
+    # the axon sitecustomize pins jax to the TPU tunnel; BENCH_PLATFORM=cpu
+    # lets the benchmark run on the host backend for local testing
+    if os.environ.get("BENCH_PLATFORM"):
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+
+    from sheeprl_tpu.cli import run
+
+    args = [
+        "exp=ppo_benchmarks",
+        f"algo.total_steps={total_steps}",
+    ]
+    tic = time.perf_counter()
+    run(args)
+    elapsed = time.perf_counter() - tic
+    scaled = elapsed * (FULL_STEPS / total_steps)
+    result = {
+        "metric": "ppo_cartpole_benchmark_wallclock",
+        "value": round(scaled, 2),
+        "unit": "s",
+        "vs_baseline": round(REFERENCE_PPO_SECONDS / scaled, 3),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()
