@@ -29,10 +29,19 @@ def main() -> None:
 
     # the axon sitecustomize pins jax to the TPU tunnel; BENCH_PLATFORM=cpu
     # lets the benchmark run on the host backend for local testing
-    if os.environ.get("BENCH_PLATFORM"):
-        import jax
+    import jax
 
+    if os.environ.get("BENCH_PLATFORM"):
         jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+    else:
+        # make the host CPU backend available alongside the TPU so the
+        # env-interaction player can run host-side (see MeshRuntime.player_device)
+        try:
+            current = jax.config.jax_platforms or "axon"
+            if "cpu" not in current:
+                jax.config.update("jax_platforms", f"{current},cpu")
+        except Exception:
+            pass
 
     from sheeprl_tpu.cli import run
 

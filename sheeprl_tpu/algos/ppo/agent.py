@@ -199,22 +199,44 @@ def get_values(module: PPOAgentModule, params: Any, obs: Dict[str, jax.Array]) -
 
 class PPOPlayer:
     """Host-side convenience wrapper: jitted greedy/sampling policies bound
-    to a mutable params reference (reference PPOPlayer:242)."""
+    to a mutable params reference (reference PPOPlayer:242).
 
-    def __init__(self, module: PPOAgentModule, params: Any, prepare_obs_fn):
+    ``device`` pins the player to a specific device — on TPU-through-tunnel
+    setups the env hot loop runs the (tiny) policy on the host CPU backend
+    so each env step avoids a device round-trip; params sync once per
+    rollout (the BASELINE north star's "CPU actors feed TPU learners")."""
+
+    def __init__(self, module: PPOAgentModule, params: Any, prepare_obs_fn, device=None):
         self.module = module
-        self.params = params
+        self.device = device
+        self._params = jax.device_put(params, device) if device is not None else params
         self._prepare_obs = prepare_obs_fn
         self._sample = jax.jit(
             lambda p, o, k, greedy: sample_actions(module, p, o, k, greedy), static_argnums=(3,)
         )
         self._values = jax.jit(lambda p, o: get_values(module, p, o))
 
+    @property
+    def params(self) -> Any:
+        return self._params
+
+    @params.setter
+    def params(self, value: Any) -> None:
+        self._params = jax.device_put(value, self.device) if self.device is not None else value
+
+    def _obs(self, obs: Dict[str, Any]) -> Dict[str, jax.Array]:
+        prepared = self._prepare_obs(obs)
+        if self.device is not None:
+            prepared = jax.device_put(prepared, self.device)
+        return prepared
+
     def get_actions(self, obs: Dict[str, Any], key: jax.Array, greedy: bool = False):
-        return self._sample(self.params, self._prepare_obs(obs), key, greedy)
+        if self.device is not None:
+            key = jax.device_put(key, self.device)
+        return self._sample(self._params, self._obs(obs), key, greedy)
 
     def get_values(self, obs: Dict[str, Any]) -> jax.Array:
-        return self._values(self.params, self._prepare_obs(obs))
+        return self._values(self._params, self._obs(obs))
 
 
 def build_agent(

@@ -240,6 +240,18 @@ class MeshRuntime:
         rt._key = self._key
         return rt
 
+    def player_device(self):
+        """Device for env-interaction policies: the host CPU backend when
+        training runs on an accelerator — the env hot loop then avoids a
+        device round-trip per step (tiny policy nets, CPU-actor/TPU-learner
+        split) — else None (same device as training)."""
+        if self.device.platform == "cpu":
+            return None
+        try:
+            return jax.local_devices(backend="cpu")[0]
+        except RuntimeError:
+            return None
+
     # ------------------------------------------------------------------ #
     # host-side collectives (metrics, small objects)
     # ------------------------------------------------------------------ #
