@@ -1,0 +1,1 @@
+from sheeprl_tpu.algos.a2c import a2c, evaluate  # noqa: F401  (registry side-effect)

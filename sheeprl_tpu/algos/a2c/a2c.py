@@ -1,0 +1,302 @@
+"""A2C — TPU-native main loop (reference sheeprl/algos/a2c/a2c.py:26,118).
+
+Same rollout scaffold as PPO; the update differs: a single optimizer step
+per iteration with gradients accumulated over minibatches (the reference's
+``no_backward_sync`` + deferred ``optimizer.step``). In jax that's a
+``lax.scan`` summing grads over minibatch chunks, then one ``tx.update`` —
+the whole thing one jitted function."""
+
+from __future__ import annotations
+
+import os
+import warnings
+from typing import Any, Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from sheeprl_tpu.algos.a2c.loss import policy_loss, value_loss
+from sheeprl_tpu.algos.ppo.agent import build_agent, evaluate_actions, get_values, PPOPlayer
+from sheeprl_tpu.algos.ppo.ppo import _set_lr, build_ppo_optimizer
+from sheeprl_tpu.algos.ppo.utils import normalize_obs, prepare_obs, test
+from sheeprl_tpu.config import instantiate
+from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.utils.callback import CheckpointCallback, load_checkpoint
+from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
+from sheeprl_tpu.utils.registry import register_algorithm
+from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.utils.utils import gae, normalize_tensor, polynomial_decay, save_configs
+
+
+def make_update_fn(runtime, module, tx, cfg: Dict[str, Any], obs_keys: Sequence[str]):
+    mb_size = int(cfg.algo.per_rank_batch_size) * runtime.world_size
+    gamma = float(cfg.algo.gamma)
+    gae_lambda = float(cfg.algo.gae_lambda)
+    vf_coef = float(cfg.algo.vf_coef)
+    reduction = str(cfg.algo.loss_reduction)
+    normalize_adv = bool(cfg.algo.get("normalize_advantages", False))
+    ent_coef = float(cfg.algo.ent_coef)
+
+    def update(params, opt_state, data, next_obs, key, lr):
+        next_values = get_values(
+            module, params, normalize_obs({k: next_obs[k].astype(jnp.float32) for k in obs_keys}, (), obs_keys)
+        )
+        returns, advantages = gae(
+            data["rewards"], data["values"], data["dones"], next_values, gamma, gae_lambda
+        )
+        data = {**data, "returns": returns, "advantages": advantages}
+        n_total = data["rewards"].shape[0] * data["rewards"].shape[1]
+        flat = {k: v.reshape(n_total, *v.shape[2:]) for k, v in data.items()}
+        num_minibatches = max(1, -(-n_total // mb_size))
+        n_used = num_minibatches * mb_size
+
+        opt_state = _set_lr(opt_state, lr)
+
+        def loss_fn(p, mb):
+            obs = normalize_obs({k: mb[k].astype(jnp.float32) for k in obs_keys}, (), obs_keys)
+            logprobs, entropy, new_values = evaluate_actions(module, p, obs, mb["actions"])
+            adv = normalize_tensor(mb["advantages"]) if normalize_adv else mb["advantages"]
+            pg = policy_loss(logprobs, adv, reduction)
+            vl = value_loss(new_values, mb["returns"], reduction)
+            total = pg + vf_coef * vl - ent_coef * entropy.mean()
+            return total, jnp.stack([pg, vl])
+
+        grad_fn = jax.grad(loss_fn, has_aux=True)
+
+        perm = jax.random.permutation(key, n_total)
+        if n_used > n_total:
+            perm = jnp.concatenate([perm, perm[: n_used - n_total]])
+        shuffled = jax.tree_util.tree_map(
+            lambda x: x[perm].reshape(num_minibatches, mb_size, *x.shape[1:]), flat
+        )
+
+        def mb_step(acc, mb):
+            grads, losses = grad_fn(params, mb)
+            acc = jax.tree_util.tree_map(jnp.add, acc, grads)
+            return acc, losses
+
+        zero_grads = jax.tree_util.tree_map(jnp.zeros_like, params)
+        grads, losses = jax.lax.scan(mb_step, zero_grads, shuffled)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        mean_losses = losses.mean(0)
+        return params, opt_state, {
+            "Loss/policy_loss": mean_losses[0],
+            "Loss/value_loss": mean_losses[1],
+        }
+
+    return runtime.setup_step(update, donate_argnums=(0, 1))
+
+
+@register_algorithm()
+def main(runtime, cfg: Dict[str, Any]):
+    if len(cfg.algo.cnn_keys.encoder) > 0:
+        raise ValueError("A2C supports only vector observations (mlp keys)")
+
+    world_size = runtime.world_size
+    runtime.seed_everything(cfg.seed)
+
+    state = load_checkpoint(cfg.checkpoint.resume_from) if cfg.checkpoint.resume_from else None
+
+    logger = get_logger(runtime, cfg)
+    log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name)
+    runtime.print(f"Log dir: {log_dir}")
+    if logger:
+        logger.log_hyperparams(cfg)
+
+    from gymnasium.vector import AsyncVectorEnv, AutoresetMode, SyncVectorEnv
+    import gymnasium as gym
+
+    total_envs = cfg.env.num_envs * world_size
+    thunks = [
+        make_env(cfg, cfg.seed + i, 0, log_dir if runtime.is_global_zero else None, "train", vector_env_idx=i)
+        for i in range(total_envs)
+    ]
+    envs = (
+        SyncVectorEnv(thunks, autoreset_mode=AutoresetMode.SAME_STEP)
+        if cfg.env.sync_env
+        else AsyncVectorEnv(thunks, context="spawn", autoreset_mode=AutoresetMode.SAME_STEP)
+    )
+    observation_space = envs.single_observation_space
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+    obs_keys = list(cfg.algo.mlp_keys.encoder)
+
+    is_continuous = isinstance(envs.single_action_space, gym.spaces.Box)
+    is_multidiscrete = isinstance(envs.single_action_space, gym.spaces.MultiDiscrete)
+    actions_dim = tuple(
+        envs.single_action_space.shape
+        if is_continuous
+        else (envs.single_action_space.nvec.tolist() if is_multidiscrete else [envs.single_action_space.n])
+    )
+
+    module, params = build_agent(
+        runtime, actions_dim, is_continuous, cfg, observation_space, state["agent"] if state else None
+    )
+    params = runtime.replicate(params)
+    tx = build_ppo_optimizer(cfg.algo.optimizer, cfg.algo.max_grad_norm)
+    opt_state = (
+        runtime.replicate(tx.init(params))
+        if state is None
+        else jax.tree_util.tree_map(jnp.asarray, state["optimizer"])
+    )
+    player = PPOPlayer(
+        module,
+        params,
+        lambda obs: prepare_obs(obs, num_envs=total_envs),
+        device=runtime.player_device(),
+    )
+
+    if runtime.is_global_zero:
+        save_configs(cfg, log_dir)
+
+    aggregator = None
+    if not MetricAggregator.disabled:
+        aggregator = instantiate(dict(cfg.metric.aggregator))
+
+    rb = ReplayBuffer(
+        cfg.buffer.size,
+        total_envs,
+        memmap=cfg.buffer.memmap,
+        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{runtime.global_rank}"),
+        obs_keys=obs_keys,
+    )
+
+    last_train = 0
+    train_step = 0
+    start_iter = (state["iter_num"] // world_size) + 1 if state else 1
+    policy_step = state["iter_num"] * cfg.env.num_envs * cfg.algo.rollout_steps if state else 0
+    last_log = state["last_log"] if state else 0
+    last_checkpoint = state["last_checkpoint"] if state else 0
+    policy_steps_per_iter = int(cfg.env.num_envs * cfg.algo.rollout_steps * world_size)
+    total_iters = cfg.algo.total_steps // policy_steps_per_iter if not cfg.dry_run else 1
+    if state:
+        cfg.algo.per_rank_batch_size = state["batch_size"] // world_size
+
+    ckpt_cb = CheckpointCallback(keep_last=cfg.checkpoint.keep_last)
+    update_fn = make_update_fn(runtime, module, tx, cfg, obs_keys)
+    lr0 = float(cfg.algo.optimizer.get("learning_rate", 1e-3))
+    current_lr = lr0
+
+    step_data: Dict[str, np.ndarray] = {}
+    next_obs_np = envs.reset(seed=cfg.seed)[0]
+
+    for iter_num in range(start_iter, total_iters + 1):
+        for _ in range(cfg.algo.rollout_steps):
+            policy_step += cfg.env.num_envs * world_size
+            with timer("Time/env_interaction_time", SumMetric, sync_on_compute=False):
+                flat_actions, real_actions, logprobs, values = player.get_actions(
+                    next_obs_np, runtime.next_key()
+                )
+                obs, rewards, terminated, truncated, info = envs.step(
+                    np.asarray(real_actions).reshape(envs.action_space.shape)
+                )
+                truncated_envs = np.nonzero(truncated)[0]
+                if len(truncated_envs) > 0:
+                    real_next_obs = {k: np.array(v) for k, v in obs.items()}
+                    for env_idx in truncated_envs:
+                        final = info["final_obs"][env_idx]
+                        for k in obs_keys:
+                            real_next_obs[k][env_idx] = final[k]
+                    vals = np.asarray(player.get_values(real_next_obs))
+                    rewards[truncated_envs] += cfg.algo.gamma * vals[truncated_envs].reshape(
+                        rewards[truncated_envs].shape
+                    )
+                dones = np.logical_or(terminated, truncated).reshape(total_envs, 1).astype(np.uint8)
+                rewards = rewards.reshape(total_envs, 1).astype(np.float32)
+
+            for k in obs_keys:
+                step_data[k] = next_obs_np[k][np.newaxis]
+            step_data["dones"] = dones[np.newaxis]
+            step_data["values"] = np.asarray(values)[np.newaxis]
+            step_data["actions"] = np.asarray(flat_actions)[np.newaxis]
+            step_data["logprobs"] = np.asarray(logprobs)[np.newaxis]
+            step_data["rewards"] = rewards[np.newaxis]
+            rb.add(step_data, validate_args=cfg.buffer.validate_args)
+            next_obs_np = obs
+
+            if cfg.metric.log_level > 0 and "final_info" in info:
+                ep = info["final_info"].get("episode")
+                if ep is not None:
+                    for i in np.nonzero(info["final_info"]["_episode"])[0]:
+                        if aggregator and "Rewards/rew_avg" in aggregator:
+                            aggregator.update("Rewards/rew_avg", float(ep["r"][i]))
+                        if aggregator and "Game/ep_len_avg" in aggregator:
+                            aggregator.update("Game/ep_len_avg", float(ep["l"][i]))
+                        runtime.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={float(ep['r'][i])}")
+
+        local_data = rb.to_arrays()
+        local_data = {k: v.astype(jnp.float32) for k, v in local_data.items()}
+        device_next_obs = {k: jnp.asarray(next_obs_np[k]) for k in obs_keys}
+
+        with timer("Time/train_time", SumMetric, sync_on_compute=cfg.metric.sync_on_compute):
+            params, opt_state, train_metrics = update_fn(
+                params, opt_state, local_data, device_next_obs, runtime.next_key(), jnp.float32(current_lr)
+            )
+            train_metrics = jax.device_get(train_metrics)
+        player.params = params
+        train_step += world_size
+
+        if aggregator and not aggregator.disabled:
+            for k, v in train_metrics.items():
+                aggregator.update(k, v)
+
+        if cfg.metric.log_level > 0 and logger:
+            logger.log_metrics({"Info/learning_rate": current_lr}, policy_step)
+            if policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters:
+                if aggregator and not aggregator.disabled:
+                    logger.log_metrics(aggregator.compute(), policy_step)
+                    aggregator.reset()
+                if not timer.disabled:
+                    timer_metrics = timer.compute()
+                    if timer_metrics.get("Time/train_time", 0) > 0:
+                        logger.log_metrics(
+                            {"Time/sps_train": (train_step - last_train) / timer_metrics["Time/train_time"]},
+                            policy_step,
+                        )
+                    if timer_metrics.get("Time/env_interaction_time", 0) > 0:
+                        logger.log_metrics(
+                            {
+                                "Time/sps_env_interaction": (
+                                    (policy_step - last_log) / world_size * cfg.env.action_repeat
+                                )
+                                / timer_metrics["Time/env_interaction_time"]
+                            },
+                            policy_step,
+                        )
+                    timer.reset()
+                last_log = policy_step
+                last_train = train_step
+
+        if cfg.algo.anneal_lr:
+            current_lr = polynomial_decay(iter_num, initial=lr0, final=0.0, max_decay_steps=total_iters, power=1.0)
+
+        if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
+            iter_num == total_iters and cfg.checkpoint.save_last
+        ):
+            last_checkpoint = policy_step
+            ckpt_state = {
+                "agent": params,
+                "optimizer": opt_state,
+                "iter_num": iter_num * world_size,
+                "batch_size": cfg.algo.per_rank_batch_size * world_size,
+                "last_log": last_log,
+                "last_checkpoint": last_checkpoint,
+            }
+            ckpt_cb.save(
+                runtime,
+                os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{runtime.global_rank}.ckpt"),
+                ckpt_state,
+            )
+
+    envs.close()
+    if runtime.is_global_zero and cfg.algo.run_test:
+        test_rew = test(player, runtime, cfg, log_dir)
+        if logger:
+            logger.log_metrics({"Test/cumulative_reward": test_rew}, policy_step)
+    if logger:
+        logger.finalize()

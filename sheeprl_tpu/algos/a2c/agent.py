@@ -1,0 +1,11 @@
+"""A2C reuses the PPO agent (reference sheeprl/algos/a2c/utils.py:10 —
+a2c/agent.py is empty and imports from sheeprl.algos.ppo.agent)."""
+
+from sheeprl_tpu.algos.ppo.agent import (  # noqa: F401
+    PPOAgentModule,
+    PPOPlayer,
+    build_agent,
+    evaluate_actions,
+    get_values,
+    sample_actions,
+)

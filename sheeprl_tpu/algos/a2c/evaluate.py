@@ -1,0 +1,13 @@
+"""A2C evaluation entrypoint (reference sheeprl/algos/a2c/evaluate.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from sheeprl_tpu.algos.ppo.evaluate import evaluate_ppo
+from sheeprl_tpu.utils.registry import register_evaluation
+
+
+@register_evaluation(algorithms="a2c")
+def evaluate_a2c(runtime, cfg: Dict[str, Any], state: Dict[str, Any]):
+    evaluate_ppo(runtime, cfg, state)

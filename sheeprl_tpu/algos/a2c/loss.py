@@ -1,0 +1,16 @@
+"""A2C losses in jax (reference sheeprl/algos/a2c/loss.py:1-40)."""
+
+from __future__ import annotations
+
+import jax
+
+from sheeprl_tpu.algos.ppo.loss import _reduce
+
+
+def policy_loss(logprobs: jax.Array, advantages: jax.Array, reduction: str = "mean") -> jax.Array:
+    """Vanilla policy-gradient objective (no ratio clipping)."""
+    return _reduce(-(logprobs * advantages), reduction)
+
+
+def value_loss(values: jax.Array, returns: jax.Array, reduction: str = "mean") -> jax.Array:
+    return _reduce((values - returns) ** 2, reduction)

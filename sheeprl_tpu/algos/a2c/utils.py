@@ -1,0 +1,6 @@
+"""A2C helpers (reference sheeprl/algos/a2c/utils.py)."""
+
+from sheeprl_tpu.algos.ppo.utils import normalize_obs, prepare_obs, test  # noqa: F401
+
+AGGREGATOR_KEYS = {"Rewards/rew_avg", "Game/ep_len_avg", "Loss/value_loss", "Loss/policy_loss"}
+MODELS_TO_REGISTER = {"agent"}

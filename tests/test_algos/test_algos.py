@@ -108,3 +108,17 @@ def test_ppo_pixel(standard_args, tmp_path):
         f"root_dir={tmp_path}/ppopix",
     ]
     _run(args)
+
+
+def test_a2c(standard_args, devices, tmp_path):
+    args = standard_args + [
+        "exp=a2c",
+        "algo.rollout_steps=8",
+        "algo.per_rank_batch_size=4",
+        "algo.dense_units=8",
+        "algo.mlp_layers=1",
+        "algo.mlp_keys.encoder=[state]",
+        f"fabric.devices={devices}",
+        f"root_dir={tmp_path}/a2c",
+    ]
+    _run(args)
