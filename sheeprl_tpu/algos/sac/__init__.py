@@ -1,0 +1,1 @@
+from sheeprl_tpu.algos.sac import evaluate, sac  # noqa: F401  (registry side-effect)

@@ -1,0 +1,23 @@
+"""SAC losses (arXiv:1812.05905; reference sheeprl/algos/sac/loss.py:1-26)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def policy_loss(alpha: jax.Array, logprobs: jax.Array, qf_values: jax.Array) -> jax.Array:
+    # Eq. 7
+    return ((alpha * logprobs) - qf_values).mean()
+
+
+def critic_loss(qf_values: jax.Array, next_qf_value: jax.Array, num_critics: int) -> jax.Array:
+    # Eq. 5 — sum of per-critic MSEs against the shared target
+    return sum(
+        ((qf_values[..., i : i + 1] - next_qf_value) ** 2).mean() for i in range(num_critics)
+    )
+
+
+def entropy_loss(log_alpha: jax.Array, logprobs: jax.Array, target_entropy: jax.Array) -> jax.Array:
+    # Eq. 17
+    return (-log_alpha * (jax.lax.stop_gradient(logprobs) + target_entropy)).mean()

@@ -1,0 +1,400 @@
+"""SAC (coupled) — TPU-native main loop (reference sheeprl/algos/sac/sac.py
+train:32, main:82).
+
+TPU-first decisions:
+- all G gradient steps of an iteration run as ONE jitted ``lax.scan`` over a
+  (G, B, ...) batch sampled host-side in a single call (the reference also
+  samples once per iteration to cut communications, sac.py:306);
+- critic ensemble is vmapped (see agent.py), EMA targets via
+  ``optax.incremental_update`` gated by ``lax.cond`` on the
+  target_network_frequency schedule;
+- log_alpha's gradient over the data-sharded batch is implicitly
+  all-reduced by XLA (the reference all_reduces it by hand, sac.py:72);
+- the replay ratio scheduler (``Ratio``) stays host-side — the number of
+  gradient steps G is data shape, so distinct G values each compile once.
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from sheeprl_tpu.algos.sac.agent import (
+    SACPlayer,
+    actor_action_and_log_prob,
+    build_agent,
+    critic_ensemble_apply,
+)
+from sheeprl_tpu.algos.sac.loss import critic_loss, entropy_loss, policy_loss
+from sheeprl_tpu.algos.sac.utils import prepare_obs, test
+from sheeprl_tpu.config import instantiate
+from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.utils.callback import CheckpointCallback, load_checkpoint, restore_buffer
+from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
+from sheeprl_tpu.utils.registry import register_algorithm
+from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.utils.utils import Ratio, save_configs
+
+
+def _make_optimizer(optim_cfg: Dict[str, Any]) -> optax.GradientTransformation:
+    from sheeprl_tpu.config.compose import _locate
+
+    kwargs = {k: v for k, v in dict(optim_cfg).items() if k != "_target_"}
+    return _locate(optim_cfg["_target_"])(**kwargs)
+
+
+def make_train_fn(runtime, actor, critic, txs, cfg: Dict[str, Any], target_entropy: float):
+    gamma = float(cfg.algo.gamma)
+    tau = float(cfg.algo.tau)
+    num_critics = int(cfg.algo.critic.n)
+    actor_tx, critic_tx, alpha_tx = txs
+
+    def train(params, opt_states, data, key, do_ema):
+        """params: {actor, critic, target_critic, log_alpha};
+        data: (G, B, ...) pytree; one scan step per gradient step."""
+
+        def one_step(carry, inp):
+            params, opt_states = carry
+            batch, k = inp
+            k1, k2 = jax.random.split(k)
+            alpha = jnp.exp(params["log_alpha"])
+
+            # ---------------- critic update (Eq. 5)
+            next_actions, next_logp = actor_action_and_log_prob(
+                actor, params["actor"], batch["next_observations"], k1
+            )
+            qf_next = critic_ensemble_apply(
+                critic, params["target_critic"], batch["next_observations"], next_actions
+            )
+            min_qf_next = qf_next.min(-1, keepdims=True) - alpha * next_logp
+            next_qf_value = batch["rewards"] + (1 - batch["terminated"]) * gamma * min_qf_next
+            next_qf_value = jax.lax.stop_gradient(next_qf_value)
+
+            def qf_loss_fn(cp):
+                qf_values = critic_ensemble_apply(critic, cp, batch["observations"], batch["actions"])
+                return critic_loss(qf_values, next_qf_value, num_critics)
+
+            qf_loss, qf_grads = jax.value_and_grad(qf_loss_fn)(params["critic"])
+            updates, new_critic_opt = critic_tx.update(qf_grads, opt_states["critic"], params["critic"])
+            new_critic = optax.apply_updates(params["critic"], updates)
+
+            # ---------------- EMA target (reference qfs_target_ema)
+            new_target = jax.lax.cond(
+                do_ema,
+                lambda: optax.incremental_update(new_critic, params["target_critic"], tau),
+                lambda: params["target_critic"],
+            )
+
+            # ---------------- actor update (Eq. 7)
+            def actor_loss_fn(ap):
+                actions, logp = actor_action_and_log_prob(actor, ap, batch["observations"], k2)
+                q = critic_ensemble_apply(critic, new_critic, batch["observations"], actions)
+                return policy_loss(alpha, logp, q.min(-1, keepdims=True)), logp
+
+            (actor_loss, logp), actor_grads = jax.value_and_grad(actor_loss_fn, has_aux=True)(
+                params["actor"]
+            )
+            updates, new_actor_opt = actor_tx.update(actor_grads, opt_states["actor"], params["actor"])
+            new_actor = optax.apply_updates(params["actor"], updates)
+
+            # ---------------- alpha update (Eq. 17); grad is a global-batch
+            # mean -> XLA psums it across the data axis
+            def alpha_loss_fn(la):
+                return entropy_loss(la, logp, target_entropy)
+
+            alpha_loss, alpha_grad = jax.value_and_grad(alpha_loss_fn)(params["log_alpha"])
+            updates, new_alpha_opt = alpha_tx.update(alpha_grad, opt_states["alpha"], params["log_alpha"])
+            new_log_alpha = optax.apply_updates(params["log_alpha"], updates)
+
+            new_params = {
+                "actor": new_actor,
+                "critic": new_critic,
+                "target_critic": new_target,
+                "log_alpha": new_log_alpha,
+            }
+            new_opt_states = {"actor": new_actor_opt, "critic": new_critic_opt, "alpha": new_alpha_opt}
+            return (new_params, new_opt_states), jnp.stack([qf_loss, actor_loss, alpha_loss])
+
+        g = data["rewards"].shape[0]
+        keys = jax.random.split(key, g)
+        (params, opt_states), losses = jax.lax.scan(one_step, (params, opt_states), (data, keys))
+        mean_losses = losses.mean(0)
+        metrics = {
+            "Loss/value_loss": mean_losses[0],
+            "Loss/policy_loss": mean_losses[1],
+            "Loss/alpha_loss": mean_losses[2],
+        }
+        return params, opt_states, metrics
+
+    return runtime.setup_step(train, donate_argnums=(0, 1))
+
+
+@register_algorithm()
+def main(runtime, cfg: Dict[str, Any]):
+    import gymnasium as gym
+    from gymnasium.vector import AsyncVectorEnv, AutoresetMode, SyncVectorEnv
+
+    if "minedojo" in str(cfg.env.wrapper.get("_target_", "")).lower():
+        raise ValueError("MineDojo is not supported by the SAC agent")
+
+    world_size = runtime.world_size
+    runtime.seed_everything(cfg.seed)
+
+    state = load_checkpoint(cfg.checkpoint.resume_from) if cfg.checkpoint.resume_from else None
+
+    if len(cfg.algo.cnn_keys.encoder) > 0:
+        warnings.warn("SAC cannot use image observations, the CNN keys will be ignored")
+        cfg.algo.cnn_keys.encoder = []
+
+    logger = get_logger(runtime, cfg)
+    log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name)
+    runtime.print(f"Log dir: {log_dir}")
+    if logger:
+        logger.log_hyperparams(cfg)
+
+    total_envs = cfg.env.num_envs * world_size
+    thunks = [
+        make_env(cfg, cfg.seed + i, 0, log_dir if runtime.is_global_zero else None, "train", vector_env_idx=i)
+        for i in range(total_envs)
+    ]
+    envs = (
+        SyncVectorEnv(thunks, autoreset_mode=AutoresetMode.SAME_STEP)
+        if cfg.env.sync_env
+        else AsyncVectorEnv(thunks, context="spawn", autoreset_mode=AutoresetMode.SAME_STEP)
+    )
+    action_space = envs.single_action_space
+    observation_space = envs.single_observation_space
+    if not isinstance(action_space, gym.spaces.Box):
+        raise ValueError("Only continuous action space is supported for the SAC agent")
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+    if len(cfg.algo.mlp_keys.encoder) == 0:
+        raise RuntimeError("You should specify at least one MLP key for the encoder: `mlp_keys.encoder=[state]`")
+    for k in cfg.algo.mlp_keys.encoder:
+        if len(observation_space[k].shape) > 1:
+            raise ValueError(
+                f"Only vector observations are supported by SAC; key '{k}' has shape "
+                f"{observation_space[k].shape}"
+            )
+    mlp_keys = list(cfg.algo.mlp_keys.encoder)
+
+    actor, critic, params, target_entropy = build_agent(
+        runtime, cfg, observation_space, action_space, state["agent"] if state else None
+    )
+    params = runtime.replicate(params)
+    actor_tx = _make_optimizer(cfg.algo.actor.optimizer)
+    critic_tx = _make_optimizer(cfg.algo.critic.optimizer)
+    alpha_tx = _make_optimizer(cfg.algo.alpha.optimizer)
+    if state is not None:
+        opt_states = jax.tree_util.tree_map(jnp.asarray, state["opt_states"])
+    else:
+        opt_states = {
+            "actor": actor_tx.init(params["actor"]),
+            "critic": critic_tx.init(params["critic"]),
+            "alpha": alpha_tx.init(params["log_alpha"]),
+        }
+        opt_states = runtime.replicate(opt_states)
+
+    player = SACPlayer(
+        actor,
+        params["actor"],
+        lambda obs: prepare_obs(obs, mlp_keys=mlp_keys, num_envs=total_envs),
+        device=runtime.player_device(),
+    )
+
+    if runtime.is_global_zero:
+        save_configs(cfg, log_dir)
+
+    aggregator = None
+    if not MetricAggregator.disabled:
+        aggregator = instantiate(dict(cfg.metric.aggregator))
+
+    buffer_size = cfg.buffer.size // int(total_envs) if not cfg.dry_run else 1
+    rb = ReplayBuffer(
+        max(buffer_size, 1),
+        total_envs,
+        memmap=cfg.buffer.memmap,
+        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{runtime.global_rank}"),
+        obs_keys=("observations",),
+    )
+    if state and cfg.buffer.checkpoint:
+        rb = restore_buffer(
+            state["rb"],
+            memmap=cfg.buffer.memmap,
+            memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{runtime.global_rank}"),
+        )
+
+    last_train = 0
+    train_step = 0
+    start_iter = (state["iter_num"] // world_size) + 1 if state else 1
+    policy_step = state["iter_num"] * cfg.env.num_envs if state else 0
+    last_log = state["last_log"] if state else 0
+    last_checkpoint = state["last_checkpoint"] if state else 0
+    policy_steps_per_iter = int(total_envs)
+    total_iters = int(cfg.algo.total_steps // policy_steps_per_iter) if not cfg.dry_run else 1
+    learning_starts = cfg.algo.learning_starts // policy_steps_per_iter if not cfg.dry_run else 0
+    prefill_steps = learning_starts - int(learning_starts > 0)
+    if state:
+        cfg.algo.per_rank_batch_size = state["batch_size"] // world_size
+        learning_starts += start_iter
+        prefill_steps += start_iter
+
+    ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
+    if state:
+        ratio.load_state_dict(state["ratio"])
+
+    ckpt_cb = CheckpointCallback(keep_last=cfg.checkpoint.keep_last)
+    train_fn = make_train_fn(
+        runtime, actor, critic, (actor_tx, critic_tx, alpha_tx), cfg, target_entropy
+    )
+    ema_every = cfg.algo.critic.target_network_frequency // policy_steps_per_iter + 1
+
+    step_data: Dict[str, np.ndarray] = {}
+    obs = envs.reset(seed=cfg.seed)[0]
+
+    cumulative_per_rank_gradient_steps = 0
+    for iter_num in range(start_iter, total_iters + 1):
+        policy_step += policy_steps_per_iter
+
+        with timer("Time/env_interaction_time", SumMetric, sync_on_compute=False):
+            if iter_num <= learning_starts:
+                actions = envs.action_space.sample()
+            else:
+                actions = np.asarray(player.get_actions(obs, runtime.next_key()))
+            next_obs, rewards, terminated, truncated, infos = envs.step(
+                actions.reshape(envs.action_space.shape)
+            )
+            rewards = rewards.reshape(total_envs, -1)
+
+        if cfg.metric.log_level > 0 and "final_info" in infos:
+            ep = infos["final_info"].get("episode")
+            if ep is not None:
+                for i in np.nonzero(infos["final_info"]["_episode"])[0]:
+                    if aggregator and not aggregator.disabled:
+                        aggregator.update("Rewards/rew_avg", float(ep["r"][i]))
+                        aggregator.update("Game/ep_len_avg", float(ep["l"][i]))
+                    runtime.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={float(ep['r'][i])}")
+
+        # real next obs (substitute final obs for autoreset rows)
+        real_next_obs = {k: np.array(v) for k, v in next_obs.items()}
+        if "final_obs" in infos:
+            for idx in np.nonzero(infos["_final_obs"])[0]:
+                for k, v in infos["final_obs"][idx].items():
+                    real_next_obs[k][idx] = v
+        flat_next_obs = np.concatenate([real_next_obs[k] for k in mlp_keys], axis=-1).astype(np.float32)
+
+        step_data["terminated"] = terminated.reshape(1, total_envs, -1).astype(np.uint8)
+        step_data["truncated"] = truncated.reshape(1, total_envs, -1).astype(np.uint8)
+        step_data["actions"] = actions.reshape(1, total_envs, -1).astype(np.float32)
+        step_data["observations"] = np.concatenate([obs[k] for k in mlp_keys], axis=-1).astype(np.float32)[
+            np.newaxis
+        ]
+        if not cfg.buffer.sample_next_obs:
+            step_data["next_observations"] = flat_next_obs[np.newaxis]
+        step_data["rewards"] = rewards[np.newaxis].astype(np.float32)
+        rb.add(step_data, validate_args=cfg.buffer.validate_args)
+        obs = next_obs
+
+        if iter_num >= learning_starts:
+            per_rank_gradient_steps = ratio(
+                (policy_step - prefill_steps + policy_steps_per_iter) / world_size
+            )
+            if per_rank_gradient_steps > 0:
+                g = per_rank_gradient_steps
+                batch_total = g * cfg.algo.per_rank_batch_size * world_size
+                sample = rb.sample(
+                    batch_size=batch_total,
+                    sample_next_obs=cfg.buffer.sample_next_obs,
+                )
+                data = {
+                    k: jnp.asarray(v, dtype=jnp.float32).reshape(
+                        g, cfg.algo.per_rank_batch_size * world_size, *v.shape[2:]
+                    )
+                    for k, v in sample.items()
+                }
+                if cfg.buffer.sample_next_obs:
+                    data["next_observations"] = data.pop("next_observations")
+                with timer("Time/train_time", SumMetric, sync_on_compute=cfg.metric.sync_on_compute):
+                    params, opt_states, train_metrics = train_fn(
+                        params,
+                        opt_states,
+                        data,
+                        runtime.next_key(),
+                        jnp.asarray(iter_num % ema_every == 0),
+                    )
+                    train_metrics = jax.device_get(train_metrics)
+                player.params = params["actor"]
+                cumulative_per_rank_gradient_steps += g
+                train_step += world_size
+                if aggregator and not aggregator.disabled:
+                    for k, v in train_metrics.items():
+                        aggregator.update(k, v)
+
+        if cfg.metric.log_level > 0 and (
+            policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters
+        ):
+            if logger:
+                if aggregator and not aggregator.disabled:
+                    logger.log_metrics(aggregator.compute(), policy_step)
+                    aggregator.reset()
+                logger.log_metrics(
+                    {"Params/replay_ratio": cumulative_per_rank_gradient_steps * world_size / policy_step},
+                    policy_step,
+                )
+                if not timer.disabled:
+                    timer_metrics = timer.compute()
+                    if timer_metrics.get("Time/train_time", 0) > 0:
+                        logger.log_metrics(
+                            {"Time/sps_train": (train_step - last_train) / timer_metrics["Time/train_time"]},
+                            policy_step,
+                        )
+                    if timer_metrics.get("Time/env_interaction_time", 0) > 0:
+                        logger.log_metrics(
+                            {
+                                "Time/sps_env_interaction": (
+                                    (policy_step - last_log) / world_size * cfg.env.action_repeat
+                                )
+                                / timer_metrics["Time/env_interaction_time"]
+                            },
+                            policy_step,
+                        )
+                    timer.reset()
+            last_log = policy_step
+            last_train = train_step
+
+        if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
+            iter_num == total_iters and cfg.checkpoint.save_last
+        ):
+            last_checkpoint = policy_step
+            ckpt_state = {
+                "agent": params,
+                "opt_states": opt_states,
+                "ratio": ratio.state_dict(),
+                "iter_num": iter_num * world_size,
+                "batch_size": cfg.algo.per_rank_batch_size * world_size,
+                "last_log": last_log,
+                "last_checkpoint": last_checkpoint,
+            }
+            if cfg.buffer.checkpoint:
+                ckpt_state["rb"] = rb
+            ckpt_cb.save(
+                runtime,
+                os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{runtime.global_rank}.ckpt"),
+                ckpt_state,
+            )
+
+    envs.close()
+    if runtime.is_global_zero and cfg.algo.run_test:
+        test_rew = test(player, runtime, cfg, log_dir)
+        if logger:
+            logger.log_metrics({"Test/cumulative_reward": test_rew}, policy_step)
+    if logger:
+        logger.finalize()

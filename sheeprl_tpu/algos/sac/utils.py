@@ -1,0 +1,51 @@
+"""SAC helpers (reference sheeprl/algos/sac/utils.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.utils.env import make_env
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/value_loss",
+    "Loss/policy_loss",
+    "Loss/alpha_loss",
+}
+MODELS_TO_REGISTER = {"agent"}
+
+
+def prepare_obs(
+    obs: Dict[str, np.ndarray], *, mlp_keys: Sequence[str] = (), num_envs: int = 1, **kwargs: Any
+) -> jnp.ndarray:
+    """Concat the vector obs keys -> (num_envs, obs_dim) float array."""
+    with_batch = {k: np.asarray(obs[k]).reshape(num_envs, -1) for k in mlp_keys}
+    return jnp.asarray(np.concatenate([with_batch[k] for k in mlp_keys], axis=-1), dtype=jnp.float32)
+
+
+def test(player, runtime, cfg: Dict[str, Any], log_dir: str) -> float:
+    from sheeprl_tpu.algos.sac.agent import SACPlayer
+
+    player = SACPlayer(
+        player.actor,
+        player.params,
+        lambda obs: prepare_obs(obs, mlp_keys=cfg.algo.mlp_keys.encoder, num_envs=1),
+    )
+    env = make_env(cfg, cfg.seed, 0, log_dir, "test", vector_env_idx=0)()
+    done = False
+    cumulative_rew = 0.0
+    obs = env.reset(seed=cfg.seed)[0]
+    while not done:
+        actions = np.asarray(player.get_actions(obs, greedy=True))
+        obs, reward, terminated, truncated, _ = env.step(actions.reshape(env.action_space.shape))
+        done = bool(terminated or truncated)
+        cumulative_rew += float(reward)
+        if cfg.dry_run:
+            done = True
+    runtime.print("Test - Reward:", cumulative_rew)
+    env.close()
+    return cumulative_rew

@@ -122,3 +122,38 @@ def test_a2c(standard_args, devices, tmp_path):
         f"root_dir={tmp_path}/a2c",
     ]
     _run(args)
+
+
+def test_sac(standard_args, devices, tmp_path):
+    args = standard_args + [
+        "exp=sac",
+        "env.id=dummy_continuous",
+        "algo.per_rank_batch_size=4",
+        "algo.hidden_size=8",
+        "algo.learning_starts=0",
+        "algo.mlp_keys.encoder=[state]",
+        f"fabric.devices={devices}",
+        f"root_dir={tmp_path}/sac",
+    ]
+    _run(args)
+
+
+def test_sac_sample_next_obs(standard_args, tmp_path):
+    # dry_run shrinks the buffer to one row, which cannot serve next-obs
+    # samples — run a real (tiny) loop instead
+    args = [a for a in standard_args if a != "dry_run=True"] + [
+        "exp=sac",
+        "algo.total_steps=8",
+        "buffer.size=64",
+        "metric.log_every=4",
+        "checkpoint.every=8",
+        "env.id=dummy_continuous",
+        "algo.per_rank_batch_size=4",
+        "algo.hidden_size=8",
+        "algo.learning_starts=4",
+        "algo.mlp_keys.encoder=[state]",
+        "buffer.sample_next_obs=True",
+        "fabric.devices=1",
+        f"root_dir={tmp_path}/sacno",
+    ]
+    _run(args)
