@@ -1,0 +1,1 @@
+from sheeprl_tpu.algos.droq import droq, evaluate  # noqa: F401  (registry side-effect)

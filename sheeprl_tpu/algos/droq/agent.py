@@ -1,0 +1,96 @@
+"""DroQ agent (arXiv:2110.02034) — reference sheeprl/algos/droq/agent.py
+(DROQCritic:20, DROQAgent:63).
+
+Same functional layout as SAC (vmapped critic ensemble, EMA target pytree);
+the critic adds Dropout + LayerNorm, so ensemble application threads a
+dropout rng."""
+
+from __future__ import annotations
+
+from math import prod
+from typing import Any, Dict, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.sac.agent import SACActor
+from sheeprl_tpu.models.models import MLP
+
+
+class DROQCritic(nn.Module):
+    hidden_size: int = 256
+    num_critics: int = 1
+    dropout: float = 0.0
+
+    @nn.compact
+    def __call__(self, obs: jax.Array, action: jax.Array, deterministic: bool = True) -> jax.Array:
+        x = jnp.concatenate([obs, action], -1)
+        return MLP(
+            hidden_sizes=(self.hidden_size, self.hidden_size),
+            output_dim=self.num_critics,
+            activation="relu",
+            layer_norm=True,
+            dropout=self.dropout,
+        )(x, deterministic=deterministic)
+
+
+def droq_ensemble_init(critic: DROQCritic, n: int, key: jax.Array, obs: jax.Array, act: jax.Array):
+    keys = jax.random.split(key, n)
+    return jax.vmap(lambda k: critic.init({"params": k}, obs, act))(keys)
+
+
+def droq_ensemble_apply(
+    critic: DROQCritic,
+    stacked_params: Any,
+    obs: jax.Array,
+    act: jax.Array,
+    dropout_key: Optional[jax.Array] = None,
+) -> jax.Array:
+    """(B, n) q-values; dropout active iff a dropout_key is given."""
+    if dropout_key is None:
+        q = jax.vmap(lambda p: critic.apply(p, obs, act, deterministic=True))(stacked_params)
+    else:
+        n = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+        keys = jax.random.split(dropout_key, n)
+        q = jax.vmap(
+            lambda p, k: critic.apply(p, obs, act, deterministic=False, rngs={"dropout": k})
+        )(stacked_params, keys)
+    return jnp.moveaxis(q.squeeze(-1), 0, -1)
+
+
+def build_agent(
+    runtime,
+    cfg: Dict[str, Any],
+    obs_space,
+    action_space,
+    agent_state: Optional[Dict[str, Any]] = None,
+) -> Tuple[SACActor, DROQCritic, Dict[str, Any], float]:
+    act_dim = int(prod(action_space.shape))
+    obs_dim = int(sum(prod(obs_space[k].shape) for k in cfg.algo.mlp_keys.encoder))
+    actor = SACActor(
+        hidden_size=int(cfg.algo.actor.hidden_size),
+        action_dim=act_dim,
+        action_low=np.asarray(action_space.low),
+        action_high=np.asarray(action_space.high),
+    )
+    critic = DROQCritic(
+        hidden_size=int(cfg.algo.critic.hidden_size),
+        num_critics=1,
+        dropout=float(cfg.algo.critic.dropout),
+    )
+    if agent_state is not None:
+        params = jax.tree_util.tree_map(jnp.asarray, agent_state)
+    else:
+        dummy_obs = jnp.zeros((1, obs_dim), jnp.float32)
+        dummy_act = jnp.zeros((1, act_dim), jnp.float32)
+        params = {
+            "actor": actor.init(runtime.next_key(), dummy_obs),
+            "critic": droq_ensemble_init(
+                critic, int(cfg.algo.critic.n), runtime.next_key(), dummy_obs, dummy_act
+            ),
+        }
+        params["target_critic"] = jax.tree_util.tree_map(jnp.copy, params["critic"])
+        params["log_alpha"] = jnp.log(jnp.asarray([float(cfg.algo.alpha.alpha)], jnp.float32))
+    return actor, critic, params, -float(act_dim)

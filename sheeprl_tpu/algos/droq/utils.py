@@ -1,0 +1,3 @@
+"""DroQ helpers (reference sheeprl/algos/droq/utils.py)."""
+
+from sheeprl_tpu.algos.sac.utils import AGGREGATOR_KEYS, MODELS_TO_REGISTER, prepare_obs, test  # noqa: F401

@@ -157,3 +157,17 @@ def test_sac_sample_next_obs(standard_args, tmp_path):
         f"root_dir={tmp_path}/sacno",
     ]
     _run(args)
+
+
+def test_droq(standard_args, tmp_path):
+    args = standard_args + [
+        "exp=droq",
+        "env.id=dummy_continuous",
+        "algo.per_rank_batch_size=4",
+        "algo.hidden_size=8",
+        "algo.learning_starts=0",
+        "algo.mlp_keys.encoder=[state]",
+        "fabric.devices=1",
+        f"root_dir={tmp_path}/droq",
+    ]
+    _run(args)
