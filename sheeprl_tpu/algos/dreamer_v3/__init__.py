@@ -1,0 +1,1 @@
+from sheeprl_tpu.algos.dreamer_v3 import dreamer_v3, evaluate  # noqa: F401  (registry side-effect)

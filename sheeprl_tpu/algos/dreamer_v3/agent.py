@@ -1,0 +1,787 @@
+"""DreamerV3 agent (flax) — counterpart of reference
+sheeprl/algos/dreamer_v3/agent.py (CNNEncoder:42, MLPEncoder:100,
+CNNDecoder:154, MLPDecoder:229, RecurrentModel:281, RSSM:344,
+DecoupledRSSM:501, PlayerDV3:596, Actor:694, build_agent:935).
+
+Structure: one top-level flax module per optimizer group — the world model
+is a dict of modules {encoder, rssm, observation_model, reward_model,
+continue_model} sharing a single params pytree ``params["world_model"]``;
+actor and critic are separate. The reference's weight-tying between agent
+and player (agent.py:1229-1235) is inherent here: the player applies the
+same params.
+
+Numerical-parity notes (SURVEY.md §7 "hard parts"):
+- unimix 1% on RSSM and actor logits;
+- Hafner initialization (agent.py:1170-1180): trunc-normal fan-avg
+  everywhere, uniform fan-avg on dist heads, zeros on reward/critic heads;
+- learnable initial recurrent state passed through tanh;
+- ``is_first``-gated resets inside the dynamic step;
+- images are NHWC; frame (H, W, C).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.models.models import MLP, LayerNormGRUCell, resolve_activation
+from sheeprl_tpu.utils.distribution import (
+    Independent,
+    Normal,
+    OneHotCategoricalStraightThrough,
+    TanhNormal,
+)
+from sheeprl_tpu.utils.utils import symlog
+
+# Hafner inits (reference dreamer_v3/utils.py:143-187)
+trunc_init = nn.initializers.variance_scaling(1.0, "fan_avg", "truncated_normal")
+
+
+def uniform_out_init(scale: float) -> Callable:
+    if scale == 0.0:
+        return nn.initializers.zeros_init()
+    return nn.initializers.variance_scaling(scale, "fan_avg", "uniform")
+
+
+def _ln_enabled(cfg_node: Any) -> bool:
+    """Map the reference's layer_norm `cls` strings to a bool."""
+    if cfg_node is None:
+        return False
+    cls = str(cfg_node.get("cls", "")) if isinstance(cfg_node, dict) else str(cfg_node)
+    return "identity" not in cls.lower()
+
+
+def _ln_eps(cfg_node: Any) -> float:
+    if isinstance(cfg_node, dict):
+        return float(cfg_node.get("kw", {}).get("eps", 1e-3))
+    return 1e-3
+
+
+class LinearLnAct(nn.Module):
+    """Dense (no bias when followed by LN) -> LayerNorm -> activation —
+    the Dreamer building block."""
+
+    units: int
+    layer_norm: bool = True
+    eps: float = 1e-3
+    act: Any = "silu"
+    kernel_init: Callable = trunc_init
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = nn.Dense(self.units, use_bias=not self.layer_norm, kernel_init=self.kernel_init)(x)
+        if self.layer_norm:
+            x = nn.LayerNorm(epsilon=self.eps)(x)
+        return resolve_activation(self.act)(x)
+
+
+class DreamerMLP(nn.Module):
+    """Stack of LinearLnAct blocks + optional output head with its own init."""
+
+    units: int
+    layers: int
+    output_dim: Optional[int] = None
+    layer_norm: bool = True
+    eps: float = 1e-3
+    act: Any = "silu"
+    out_init: Callable = trunc_init
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        for _ in range(self.layers):
+            x = LinearLnAct(self.units, self.layer_norm, self.eps, self.act)(x)
+        if self.output_dim is not None:
+            x = nn.Dense(self.output_dim, kernel_init=self.out_init)(x)
+        return x
+
+
+class CNNEncoder(nn.Module):
+    """4-ish-stage conv encoder, kernel 4 stride 2, channels [1,2,4,8]*mult,
+    NHWC, LayerNorm over channels + SiLU; flattens to a feature vector."""
+
+    keys: Sequence[str]
+    channels_multiplier: int
+    stages: int = 4
+    layer_norm: bool = True
+    eps: float = 1e-3
+    act: Any = "silu"
+
+    @nn.compact
+    def __call__(self, obs: Dict[str, jax.Array]) -> jax.Array:
+        x = jnp.concatenate([obs[k] for k in self.keys], axis=-1)  # channel concat
+        for i in range(self.stages):
+            x = nn.Conv(
+                (2**i) * self.channels_multiplier,
+                (4, 4),
+                strides=(2, 2),
+                padding=[(1, 1), (1, 1)],
+                use_bias=not self.layer_norm,
+                kernel_init=trunc_init,
+            )(x)
+            if self.layer_norm:
+                x = nn.LayerNorm(epsilon=self.eps)(x)
+            x = resolve_activation(self.act)(x)
+        return x.reshape(*x.shape[:-3], -1)
+
+
+class MLPEncoder(nn.Module):
+    keys: Sequence[str]
+    mlp_layers: int = 4
+    dense_units: int = 512
+    layer_norm: bool = True
+    eps: float = 1e-3
+    act: Any = "silu"
+    symlog_inputs: bool = True
+
+    @nn.compact
+    def __call__(self, obs: Dict[str, jax.Array]) -> jax.Array:
+        x = jnp.concatenate(
+            [symlog(obs[k]) if self.symlog_inputs else obs[k] for k in self.keys], -1
+        )
+        return DreamerMLP(self.dense_units, self.mlp_layers, None, self.layer_norm, self.eps, self.act)(x)
+
+
+class MultiEncoderDV3(nn.Module):
+    cnn_encoder: Optional[nn.Module] = None
+    mlp_encoder: Optional[nn.Module] = None
+
+    def __call__(self, obs: Dict[str, jax.Array]) -> jax.Array:
+        feats = []
+        if self.cnn_encoder is not None:
+            feats.append(self.cnn_encoder(obs))
+        if self.mlp_encoder is not None:
+            feats.append(self.mlp_encoder(obs))
+        return jnp.concatenate(feats, -1) if len(feats) > 1 else feats[0]
+
+
+class CNNDecoder(nn.Module):
+    """Linear projection -> (4, 4, 8*mult) -> transposed convs back to
+    (H, W, sum(channels)); returns a dict split per image key."""
+
+    keys: Sequence[str]
+    output_channels: Sequence[int]
+    channels_multiplier: int
+    cnn_encoder_output_dim: int
+    image_size: Tuple[int, int]
+    stages: int = 4
+    layer_norm: bool = True
+    eps: float = 1e-3
+    act: Any = "silu"
+
+    @nn.compact
+    def __call__(self, latent: jax.Array) -> Dict[str, jax.Array]:
+        lead = latent.shape[:-1]
+        x = nn.Dense(self.cnn_encoder_output_dim, kernel_init=trunc_init)(latent)
+        x = x.reshape(-1, 4, 4, (2 ** (self.stages - 1)) * self.channels_multiplier)
+        for i in range(self.stages - 1):
+            ch = (2 ** (self.stages - i - 2)) * self.channels_multiplier
+            x = nn.ConvTranspose(
+                ch,
+                (4, 4),
+                strides=(2, 2),
+                padding=[(2, 2), (2, 2)],
+                use_bias=not self.layer_norm,
+                kernel_init=trunc_init,
+            )(x)
+            if self.layer_norm:
+                x = nn.LayerNorm(epsilon=self.eps)(x)
+            x = resolve_activation(self.act)(x)
+        x = nn.ConvTranspose(
+            int(sum(self.output_channels)),
+            (4, 4),
+            strides=(2, 2),
+            padding=[(2, 2), (2, 2)],
+            kernel_init=uniform_out_init(1.0),
+        )(x)
+        x = x.reshape(*lead, *x.shape[1:])
+        out: Dict[str, jax.Array] = {}
+        start = 0
+        for k, c in zip(self.keys, self.output_channels):
+            out[k] = x[..., start : start + c]
+            start += c
+        return out
+
+
+class MLPDecoder(nn.Module):
+    keys: Sequence[str]
+    output_dims: Sequence[int]
+    mlp_layers: int = 4
+    dense_units: int = 512
+    layer_norm: bool = True
+    eps: float = 1e-3
+    act: Any = "silu"
+
+    @nn.compact
+    def __call__(self, latent: jax.Array) -> Dict[str, jax.Array]:
+        x = DreamerMLP(self.dense_units, self.mlp_layers, None, self.layer_norm, self.eps, self.act)(latent)
+        return {
+            k: nn.Dense(d, kernel_init=uniform_out_init(1.0))(x)
+            for k, d in zip(self.keys, self.output_dims)
+        }
+
+
+class MultiDecoderDV3(nn.Module):
+    cnn_decoder: Optional[nn.Module] = None
+    mlp_decoder: Optional[nn.Module] = None
+
+    def __call__(self, latent: jax.Array) -> Dict[str, jax.Array]:
+        out: Dict[str, jax.Array] = {}
+        if self.cnn_decoder is not None:
+            out.update(self.cnn_decoder(latent))
+        if self.mlp_decoder is not None:
+            out.update(self.mlp_decoder(latent))
+        return out
+
+
+class RecurrentModel(nn.Module):
+    """MLP projection -> LayerNormGRUCell (reference RecurrentModel:281)."""
+
+    recurrent_state_size: int
+    dense_units: int
+    layer_norm: bool = True
+    eps: float = 1e-3
+
+    @nn.compact
+    def __call__(self, inp: jax.Array, recurrent_state: jax.Array) -> jax.Array:
+        feat = LinearLnAct(self.dense_units, self.layer_norm, self.eps, "silu")(inp)
+        new_h, _ = LayerNormGRUCell(
+            hidden_size=self.recurrent_state_size, use_bias=False, layer_norm=True
+        )(recurrent_state, feat)
+        return new_h
+
+
+def compute_stochastic_state(
+    logits: jax.Array, discrete: int, key: Optional[jax.Array], sample: bool = True
+) -> jax.Array:
+    """(..., stoch*discrete) logits -> (..., stoch, discrete) one-hot ST
+    sample (reference dreamer_v2/utils.py:44)."""
+    logits = logits.reshape(*logits.shape[:-1], -1, discrete)
+    dist = OneHotCategoricalStraightThrough(logits=logits)
+    return dist.rsample(key) if sample else dist.mode
+
+
+class RSSM(nn.Module):
+    """Recurrent State-Space Model with discrete latents (reference RSSM:344).
+
+    ``decoupled`` makes the posterior depend only on the embedded obs
+    (reference DecoupledRSSM:501)."""
+
+    actions_dim: Sequence[int]
+    embedded_obs_dim: int
+    recurrent_state_size: int
+    dense_units: int
+    stochastic_size: int = 32
+    discrete_size: int = 32
+    hidden_size: int = 1024
+    unimix: float = 0.01
+    layer_norm: bool = True
+    eps: float = 1e-3
+    act: Any = "silu"
+    learnable_initial_recurrent_state: bool = True
+    decoupled: bool = False
+
+    def setup(self) -> None:
+        stoch = self.stochastic_size * self.discrete_size
+        self.recurrent_model = RecurrentModel(
+            recurrent_state_size=self.recurrent_state_size,
+            dense_units=self.dense_units,
+            layer_norm=self.layer_norm,
+            eps=self.eps,
+        )
+        self.representation_model = DreamerMLP(
+            self.hidden_size, 1, stoch, self.layer_norm, self.eps, self.act, uniform_out_init(1.0)
+        )
+        self.transition_model = DreamerMLP(
+            self.hidden_size, 1, stoch, self.layer_norm, self.eps, self.act, uniform_out_init(1.0)
+        )
+        if self.learnable_initial_recurrent_state:
+            self.initial_recurrent_state = self.param(
+                "initial_recurrent_state", nn.initializers.zeros, (self.recurrent_state_size,)
+            )
+        else:
+            self.initial_recurrent_state = jnp.zeros((self.recurrent_state_size,))
+
+    def recurrent_step(self, inp: jax.Array, recurrent_state: jax.Array) -> jax.Array:
+        """Expose the recurrent model for the player's stateful step."""
+        return self.recurrent_model(inp, recurrent_state)
+
+    def init_all(self, posterior, recurrent_state, action, embedded_obs, is_first, key):
+        """Initialization path touching every submodule (the decoupled
+        dynamic skips the representation model)."""
+        out = self.dynamic(posterior, recurrent_state, action, embedded_obs, is_first, key)
+        if self.decoupled:
+            self._representation(embedded_obs, key)
+        return out
+
+    def _uniform_mix(self, logits: jax.Array) -> jax.Array:
+        logits = logits.reshape(*logits.shape[:-1], -1, self.discrete_size)
+        if self.unimix > 0.0:
+            probs = jax.nn.softmax(logits, -1)
+            uniform = jnp.ones_like(probs) / self.discrete_size
+            probs = (1 - self.unimix) * probs + self.unimix * uniform
+            logits = jnp.log(probs)
+        return logits.reshape(*logits.shape[:-2], -1)
+
+    def get_initial_states(self, batch_shape: Sequence[int]) -> Tuple[jax.Array, jax.Array]:
+        init_rec = jnp.broadcast_to(
+            jnp.tanh(self.initial_recurrent_state), (*batch_shape, self.recurrent_state_size)
+        )
+        _, initial_posterior = self._transition(init_rec, sample_state=False, key=None)
+        return init_rec, initial_posterior
+
+    def _representation(
+        self, embedded_obs: jax.Array, key: jax.Array, recurrent_state: Optional[jax.Array] = None
+    ) -> Tuple[jax.Array, jax.Array]:
+        if self.decoupled:
+            x = embedded_obs
+        else:
+            x = jnp.concatenate([recurrent_state, embedded_obs], -1)
+        logits = self._uniform_mix(self.representation_model(x))
+        return logits, compute_stochastic_state(logits, self.discrete_size, key)
+
+    def _transition(
+        self, recurrent_out: jax.Array, key: Optional[jax.Array], sample_state: bool = True
+    ) -> Tuple[jax.Array, jax.Array]:
+        logits = self._uniform_mix(self.transition_model(recurrent_out))
+        return logits, compute_stochastic_state(logits, self.discrete_size, key, sample=sample_state)
+
+    def dynamic(
+        self,
+        posterior: jax.Array,
+        recurrent_state: jax.Array,
+        action: jax.Array,
+        embedded_obs: jax.Array,
+        is_first: jax.Array,
+        key: jax.Array,
+    ):
+        """One dynamic-learning step with is_first-gated resets."""
+        k1, k2 = jax.random.split(key)
+        action = (1 - is_first) * action
+        initial_recurrent_state, initial_posterior = self.get_initial_states(recurrent_state.shape[:-1])
+        recurrent_state = (1 - is_first) * recurrent_state + is_first * initial_recurrent_state
+        posterior = posterior.reshape(*posterior.shape[:-2], -1)
+        posterior = (1 - is_first) * posterior + is_first * initial_posterior.reshape(posterior.shape)
+
+        recurrent_state = self.recurrent_model(
+            jnp.concatenate([posterior, action], -1), recurrent_state
+        )
+        prior_logits, prior = self._transition(recurrent_state, k1)
+        if self.decoupled:
+            return recurrent_state, prior, prior_logits
+        posterior_logits, posterior = self._representation(embedded_obs, k2, recurrent_state)
+        return recurrent_state, posterior, prior, posterior_logits, prior_logits
+
+    def imagination(self, prior: jax.Array, recurrent_state: jax.Array, actions: jax.Array, key: jax.Array):
+        recurrent_state = self.recurrent_model(
+            jnp.concatenate([prior, actions], -1), recurrent_state
+        )
+        _, imagined_prior = self._transition(recurrent_state, key)
+        return imagined_prior, recurrent_state
+
+
+class Actor(nn.Module):
+    """DV3 actor: trunk MLP + per-subaction heads with unimix'd ST one-hot
+    dists (discrete) or scaled-Normal (continuous) (reference Actor:694)."""
+
+    actions_dim: Sequence[int]
+    is_continuous: bool
+    distribution: str = "auto"
+    init_std: float = 0.0
+    min_std: float = 0.1
+    max_std: float = 1.0
+    dense_units: int = 1024
+    mlp_layers: int = 5
+    layer_norm: bool = True
+    eps: float = 1e-3
+    act: Any = "silu"
+    unimix: float = 0.01
+    action_clip: float = 1.0
+
+    def _dist_name(self) -> str:
+        d = self.distribution.lower()
+        if d == "auto":
+            return "scaled_normal" if self.is_continuous else "discrete"
+        return d
+
+    def _uniform_mix(self, logits: jax.Array) -> jax.Array:
+        if self.unimix > 0.0:
+            probs = jax.nn.softmax(logits, -1)
+            uniform = jnp.ones_like(probs) / probs.shape[-1]
+            probs = (1 - self.unimix) * probs + self.unimix * uniform
+            logits = jnp.log(probs)
+        return logits
+
+    @nn.compact
+    def __call__(
+        self,
+        state: jax.Array,
+        greedy: bool = False,
+        key: Optional[jax.Array] = None,
+        mask: Optional[Dict[str, jax.Array]] = None,
+    ):
+        x = state
+        for _ in range(self.mlp_layers):
+            x = LinearLnAct(self.dense_units, self.layer_norm, self.eps, self.act)(x)
+        if self.is_continuous:
+            pre = nn.Dense(int(np.sum(self.actions_dim)) * 2, kernel_init=uniform_out_init(1.0))(x)
+            mean, std = jnp.split(pre, 2, -1)
+            name = self._dist_name()
+            if name == "tanh_normal":
+                mean = 5 * jnp.tanh(mean / 5)
+                std = jax.nn.softplus(std + self.init_std) + self.min_std
+                dist = Independent(TanhNormal(mean, std), 0)
+            elif name == "normal":
+                dist = Independent(Normal(mean, std), 1)
+            elif name == "scaled_normal":
+                std = (self.max_std - self.min_std) * jax.nn.sigmoid(std + self.init_std) + self.min_std
+                dist = Independent(Normal(jnp.tanh(mean), std), 1)
+            else:
+                raise ValueError(f"Bad continuous distribution: {name}")
+            if greedy:
+                # reference samples 100 and keeps the argmax-log-prob one;
+                # for these unimodal dists the mean is that argmax
+                actions = dist.mean
+            else:
+                actions = dist.rsample(key)
+            if self.action_clip > 0.0:
+                clip = jnp.full_like(actions, self.action_clip)
+                actions = actions * jax.lax.stop_gradient(
+                    clip / jnp.maximum(clip, jnp.abs(actions))
+                )
+            return (actions,), (dist,)
+        heads = [
+            nn.Dense(d, kernel_init=uniform_out_init(1.0))(x) for d in self.actions_dim
+        ]
+        actions: List[jax.Array] = []
+        dists = []
+        keys = jax.random.split(key, len(heads)) if key is not None else [None] * len(heads)
+        for i, logits in enumerate(heads):
+            logits = self._uniform_mix(logits)
+            if mask is not None and i == 0 and "mask_action_type" in mask:
+                logits = jnp.where(mask["mask_action_type"], logits, -jnp.inf)
+            d = OneHotCategoricalStraightThrough(logits=logits)
+            dists.append(d)
+            actions.append(d.mode if greedy else d.rsample(keys[i]))
+        return tuple(actions), tuple(dists)
+
+
+class WorldModel:
+    """Container of the world-model modules sharing one params tree
+    (reference dreamer_v2/agent.py WorldModel:707)."""
+
+    def __init__(self, encoder, rssm, observation_model, reward_model, continue_model):
+        self.encoder = encoder
+        self.rssm = rssm
+        self.observation_model = observation_model
+        self.reward_model = reward_model
+        self.continue_model = continue_model
+
+
+class PlayerDV3:
+    """Stateful env-interaction wrapper: carries per-env (actions,
+    recurrent_state, stochastic_state), masked-reset on dones
+    (reference PlayerDV3:596). The RSSM step + actor sampling is one jitted
+    function, optionally pinned to the host CPU backend."""
+
+    def __init__(
+        self,
+        world_model: WorldModel,
+        actor: Actor,
+        params: Dict[str, Any],
+        actions_dim: Sequence[int],
+        num_envs: int,
+        stochastic_size: int,
+        recurrent_state_size: int,
+        discrete_size: int = 32,
+        decoupled_rssm: bool = False,
+        actor_type: Optional[str] = None,
+        device=None,
+    ):
+        self.wm = world_model
+        self.actor_module = actor
+        self.actions_dim = tuple(actions_dim)
+        self.num_envs = num_envs
+        self.stochastic_size = stochastic_size
+        self.discrete_size = discrete_size
+        self.recurrent_state_size = recurrent_state_size
+        self.decoupled_rssm = decoupled_rssm
+        self.actor_type = actor_type
+        self.device = device
+        self.params = params  # {"world_model": ..., "actor": ...}
+
+        def _step(params, obs, prev_actions, recurrent_state, stochastic_state, key, greedy):
+            embedded_obs = self.wm.encoder.apply(params["world_model"]["encoder"], obs)
+            recurrent_state = self.wm.rssm.apply(
+                params["world_model"]["rssm"],
+                jnp.concatenate([stochastic_state, prev_actions], -1),
+                recurrent_state,
+                method=RSSM.recurrent_step,
+            )
+            k1, k2 = jax.random.split(key)
+            if self.decoupled_rssm:
+                _, stoch = self.wm.rssm.apply(
+                    params["world_model"]["rssm"], embedded_obs, k1, method=RSSM._representation
+                )
+            else:
+                _, stoch = self.wm.rssm.apply(
+                    params["world_model"]["rssm"],
+                    embedded_obs,
+                    k1,
+                    recurrent_state,
+                    method=RSSM._representation,
+                )
+            stoch_flat = stoch.reshape(*stoch.shape[:-2], self.stochastic_size * self.discrete_size)
+            actions, _ = self.actor_module.apply(
+                params["actor"],
+                jnp.concatenate([stoch_flat, recurrent_state], -1),
+                greedy,
+                k2,
+            )
+            return actions, jnp.concatenate(actions, -1), recurrent_state, stoch_flat
+
+        self._step = jax.jit(_step, static_argnums=(6,))
+        self.init_states()
+
+    @property
+    def params(self):
+        return self._params
+
+    @params.setter
+    def params(self, value):
+        self._params = jax.device_put(value, self.device) if self.device is not None else value
+
+    def init_states(self, reset_envs: Optional[Sequence[int]] = None) -> None:
+        if reset_envs is None or len(reset_envs) == 0:
+            self.actions = jnp.zeros((1, self.num_envs, int(np.sum(self.actions_dim))))
+            rec, stoch = self._initial_states((1, self.num_envs))
+            self.recurrent_state = rec
+            self.stochastic_state = stoch.reshape(1, self.num_envs, -1)
+        else:
+            idx = np.asarray(reset_envs)
+            self.actions = self.actions.at[:, idx].set(0.0)
+            rec, stoch = self._initial_states((1, len(idx)))
+            self.recurrent_state = self.recurrent_state.at[:, idx].set(rec)
+            self.stochastic_state = self.stochastic_state.at[:, idx].set(
+                stoch.reshape(1, len(idx), -1)
+            )
+
+    def _initial_states(self, batch_shape):
+        return self.wm.rssm.apply(
+            self._params["world_model"]["rssm"], batch_shape, method=RSSM.get_initial_states
+        )
+
+    def get_actions(
+        self, obs: Dict[str, jax.Array], key: jax.Array, greedy: bool = False, mask=None
+    ) -> Sequence[jax.Array]:
+        if self.device is not None:
+            obs = jax.device_put(obs, self.device)
+            key = jax.device_put(key, self.device)
+        actions, flat, self.recurrent_state, self.stochastic_state = self._step(
+            self._params, obs, self.actions, self.recurrent_state, self.stochastic_state, key, greedy
+        )
+        self.actions = flat
+        return actions
+
+
+def build_agent(
+    runtime,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+    cfg: Dict[str, Any],
+    obs_space,
+    world_model_state: Optional[Any] = None,
+    actor_state: Optional[Any] = None,
+    critic_state: Optional[Any] = None,
+    target_critic_state: Optional[Any] = None,
+):
+    """-> (world_model(WorldModel), actor(Actor), critic(DreamerMLP), params)
+
+    ``params`` = {"world_model": {...}, "actor": ..., "critic": ...,
+    "target_critic": ...}.
+    """
+    world_model_cfg = cfg.algo.world_model
+    actor_cfg = cfg.algo.actor
+    critic_cfg = cfg.algo.critic
+
+    recurrent_state_size = world_model_cfg.recurrent_model.recurrent_state_size
+    stochastic_size = world_model_cfg.stochastic_size * world_model_cfg.discrete_size
+    latent_state_size = stochastic_size + recurrent_state_size
+
+    cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
+    mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
+    cnn_stages = int(np.log2(cfg.env.screen_size) - np.log2(4))
+
+    cnn_encoder = (
+        CNNEncoder(
+            keys=cnn_keys,
+            channels_multiplier=world_model_cfg.encoder.cnn_channels_multiplier,
+            stages=cnn_stages,
+            layer_norm=_ln_enabled(world_model_cfg.encoder.cnn_layer_norm),
+            eps=_ln_eps(world_model_cfg.encoder.cnn_layer_norm),
+            act="silu",
+        )
+        if len(cnn_keys) > 0
+        else None
+    )
+    mlp_encoder = (
+        MLPEncoder(
+            keys=mlp_keys,
+            mlp_layers=world_model_cfg.encoder.mlp_layers,
+            dense_units=world_model_cfg.encoder.dense_units,
+            layer_norm=_ln_enabled(world_model_cfg.encoder.mlp_layer_norm),
+            eps=_ln_eps(world_model_cfg.encoder.mlp_layer_norm),
+        )
+        if len(mlp_keys) > 0
+        else None
+    )
+    encoder = MultiEncoderDV3(cnn_encoder, mlp_encoder)
+
+    cnn_encoder_output_dim = (
+        (2 ** (cnn_stages - 1)) * world_model_cfg.encoder.cnn_channels_multiplier * 4 * 4
+        if cnn_encoder is not None
+        else 0
+    )
+    mlp_encoder_output_dim = world_model_cfg.encoder.dense_units if mlp_encoder is not None else 0
+    embedded_obs_dim = cnn_encoder_output_dim + mlp_encoder_output_dim
+
+    rssm = RSSM(
+        actions_dim=tuple(actions_dim),
+        embedded_obs_dim=embedded_obs_dim,
+        recurrent_state_size=recurrent_state_size,
+        dense_units=world_model_cfg.recurrent_model.dense_units,
+        stochastic_size=world_model_cfg.stochastic_size,
+        discrete_size=world_model_cfg.discrete_size,
+        hidden_size=world_model_cfg.transition_model.hidden_size,
+        unimix=cfg.algo.unimix,
+        layer_norm=_ln_enabled(world_model_cfg.recurrent_model.layer_norm),
+        eps=_ln_eps(world_model_cfg.recurrent_model.layer_norm),
+        learnable_initial_recurrent_state=world_model_cfg.learnable_initial_recurrent_state,
+        decoupled=bool(world_model_cfg.decoupled_rssm),
+    )
+
+    cnn_decoder = (
+        CNNDecoder(
+            keys=tuple(cfg.algo.cnn_keys.decoder),
+            output_channels=[int(obs_space[k].shape[-1]) for k in cfg.algo.cnn_keys.decoder],
+            channels_multiplier=world_model_cfg.observation_model.cnn_channels_multiplier,
+            cnn_encoder_output_dim=cnn_encoder_output_dim,
+            image_size=tuple(obs_space[cfg.algo.cnn_keys.decoder[0]].shape[:2]),
+            stages=cnn_stages,
+            layer_norm=_ln_enabled(world_model_cfg.observation_model.cnn_layer_norm),
+            eps=_ln_eps(world_model_cfg.observation_model.cnn_layer_norm),
+        )
+        if len(cfg.algo.cnn_keys.decoder) > 0
+        else None
+    )
+    mlp_decoder = (
+        MLPDecoder(
+            keys=tuple(cfg.algo.mlp_keys.decoder),
+            output_dims=[int(obs_space[k].shape[0]) for k in cfg.algo.mlp_keys.decoder],
+            mlp_layers=world_model_cfg.observation_model.mlp_layers,
+            dense_units=world_model_cfg.observation_model.dense_units,
+            layer_norm=_ln_enabled(world_model_cfg.observation_model.mlp_layer_norm),
+            eps=_ln_eps(world_model_cfg.observation_model.mlp_layer_norm),
+        )
+        if len(cfg.algo.mlp_keys.decoder) > 0
+        else None
+    )
+    observation_model = MultiDecoderDV3(cnn_decoder, mlp_decoder)
+
+    reward_model = DreamerMLP(
+        units=world_model_cfg.reward_model.dense_units,
+        layers=world_model_cfg.reward_model.mlp_layers,
+        output_dim=world_model_cfg.reward_model.bins,
+        layer_norm=_ln_enabled(world_model_cfg.reward_model.layer_norm),
+        eps=_ln_eps(world_model_cfg.reward_model.layer_norm),
+        out_init=uniform_out_init(0.0),
+    )
+    continue_model = DreamerMLP(
+        units=world_model_cfg.discount_model.dense_units,
+        layers=world_model_cfg.discount_model.mlp_layers,
+        output_dim=1,
+        layer_norm=_ln_enabled(world_model_cfg.discount_model.layer_norm),
+        eps=_ln_eps(world_model_cfg.discount_model.layer_norm),
+        out_init=uniform_out_init(1.0),
+    )
+    world_model = WorldModel(encoder, rssm, observation_model, reward_model, continue_model)
+
+    actor = Actor(
+        actions_dim=tuple(actions_dim),
+        is_continuous=is_continuous,
+        distribution=cfg.distribution.get("type", "auto"),
+        init_std=actor_cfg.init_std,
+        min_std=actor_cfg.min_std,
+        max_std=actor_cfg.get("max_std", 1.0),
+        dense_units=actor_cfg.dense_units,
+        mlp_layers=actor_cfg.mlp_layers,
+        layer_norm=_ln_enabled(actor_cfg.layer_norm),
+        eps=_ln_eps(actor_cfg.layer_norm),
+        unimix=cfg.algo.unimix,
+        action_clip=actor_cfg.action_clip,
+    )
+    critic = DreamerMLP(
+        units=critic_cfg.dense_units,
+        layers=critic_cfg.mlp_layers,
+        output_dim=critic_cfg.bins,
+        layer_norm=_ln_enabled(critic_cfg.layer_norm),
+        eps=_ln_eps(critic_cfg.layer_norm),
+        out_init=uniform_out_init(0.0),
+    )
+
+    # ------------------------------------------------------------- init
+    B = 1
+    dummy_obs = {}
+    for k in cnn_keys:
+        dummy_obs[k] = jnp.zeros((B, *obs_space[k].shape), jnp.float32)
+    for k in mlp_keys:
+        dummy_obs[k] = jnp.zeros((B, *obs_space[k].shape), jnp.float32)
+    dummy_embed = jnp.zeros((B, embedded_obs_dim), jnp.float32)
+    dummy_latent = jnp.zeros((B, latent_state_size), jnp.float32)
+    k = runtime.next_key
+
+    if world_model_state is not None:
+        wm_params = jax.tree_util.tree_map(jnp.asarray, world_model_state)
+    else:
+        rssm_params = rssm.init(
+            {"params": k()},
+            jnp.zeros((B, world_model_cfg.stochastic_size, world_model_cfg.discrete_size)),
+            jnp.zeros((B, recurrent_state_size)),
+            jnp.zeros((B, int(np.sum(actions_dim)))),
+            dummy_embed,
+            jnp.zeros((B, 1)),
+            k(),
+            method=RSSM.init_all,
+        )
+        wm_params = {
+            "encoder": encoder.init(k(), dummy_obs),
+            "rssm": rssm_params,
+            "observation_model": observation_model.init(k(), dummy_latent),
+            "reward_model": reward_model.init(k(), dummy_latent),
+            "continue_model": continue_model.init(k(), dummy_latent),
+        }
+    actor_params = (
+        jax.tree_util.tree_map(jnp.asarray, actor_state)
+        if actor_state is not None
+        else actor.init({"params": k()}, dummy_latent, False, k())
+    )
+    critic_params = (
+        jax.tree_util.tree_map(jnp.asarray, critic_state)
+        if critic_state is not None
+        else critic.init(k(), dummy_latent)
+    )
+    target_critic_params = (
+        jax.tree_util.tree_map(jnp.asarray, target_critic_state)
+        if target_critic_state is not None
+        else jax.tree_util.tree_map(jnp.copy, critic_params)
+    )
+    params = {
+        "world_model": wm_params,
+        "actor": actor_params,
+        "critic": critic_params,
+        "target_critic": target_critic_params,
+    }
+    return world_model, actor, critic, params

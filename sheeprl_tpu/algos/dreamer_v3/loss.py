@@ -1,0 +1,66 @@
+"""DreamerV3 world-model loss (Eq. 5 of arXiv:2301.04104; reference
+sheeprl/algos/dreamer_v3/loss.py:9-88): observation + reward + continue
+log-likelihoods and the two-sided KL (dynamic 0.5 / representation 0.1)
+with free nats."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from sheeprl_tpu.utils.distribution import (
+    Distribution,
+    Independent,
+    OneHotCategoricalStraightThrough,
+    kl_divergence,
+)
+
+sg = jax.lax.stop_gradient
+
+
+def reconstruction_loss(
+    po: Dict[str, Distribution],
+    observations: Dict[str, jax.Array],
+    pr: Distribution,
+    rewards: jax.Array,
+    priors_logits: jax.Array,
+    posteriors_logits: jax.Array,
+    kl_dynamic: float = 0.5,
+    kl_representation: float = 0.1,
+    kl_free_nats: float = 1.0,
+    kl_regularizer: float = 1.0,
+    pc: Optional[Distribution] = None,
+    continue_targets: Optional[jax.Array] = None,
+    continue_scale_factor: float = 1.0,
+) -> Tuple[jax.Array, ...]:
+    observation_loss = -sum(po[k].log_prob(observations[k]) for k in po.keys())
+    reward_loss = -pr.log_prob(rewards)
+    # KL balancing: dynamic (posterior detached) + representation (prior detached)
+    kl = kl_divergence(
+        Independent(OneHotCategoricalStraightThrough(logits=sg(posteriors_logits)), 1),
+        Independent(OneHotCategoricalStraightThrough(logits=priors_logits), 1),
+    )
+    dyn_loss = kl_dynamic * jnp.maximum(kl, kl_free_nats)
+    repr_loss = kl_representation * jnp.maximum(
+        kl_divergence(
+            Independent(OneHotCategoricalStraightThrough(logits=posteriors_logits), 1),
+            Independent(OneHotCategoricalStraightThrough(logits=sg(priors_logits)), 1),
+        ),
+        kl_free_nats,
+    )
+    kl_loss = dyn_loss + repr_loss
+    if pc is not None and continue_targets is not None:
+        continue_loss = continue_scale_factor * -pc.log_prob(continue_targets)
+    else:
+        continue_loss = jnp.zeros_like(reward_loss)
+    rec_loss = (kl_regularizer * kl_loss + observation_loss + reward_loss + continue_loss).mean()
+    return (
+        rec_loss,
+        kl.mean(),
+        kl_loss.mean(),
+        reward_loss.mean(),
+        observation_loss.mean(),
+        continue_loss.mean(),
+    )

@@ -1,0 +1,101 @@
+"""DreamerV3 helpers (reference sheeprl/algos/dreamer_v3/utils.py):
+Moments:40 (percentile EMA return normalizer), compute_lambda_values:67,
+prepare_obs, test, AGGREGATOR_KEYS."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.utils.utils import lambda_values as compute_lambda_values  # noqa: F401
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/world_model_loss",
+    "Loss/value_loss",
+    "Loss/policy_loss",
+    "Loss/observation_loss",
+    "Loss/reward_loss",
+    "Loss/state_loss",
+    "Loss/continue_loss",
+    "State/kl",
+    "State/post_entropy",
+    "State/prior_entropy",
+    "Grads/world_model",
+    "Grads/actor",
+    "Grads/critic",
+}
+MODELS_TO_REGISTER = {"world_model", "actor", "critic", "target_critic", "moments"}
+
+
+def init_moments() -> Dict[str, jax.Array]:
+    return {"low": jnp.zeros(()), "high": jnp.zeros(())}
+
+
+def update_moments(
+    state: Dict[str, jax.Array],
+    x: jax.Array,
+    decay: float = 0.99,
+    max_: float = 1e8,
+    percentile_low: float = 0.05,
+    percentile_high: float = 0.95,
+) -> Tuple[Dict[str, jax.Array], jax.Array, jax.Array]:
+    """Percentile-EMA return normalization (reference Moments:40-63).
+
+    The reference all_gathers across ranks; under jit over the global
+    (sharded) array the quantile already sees all data — XLA inserts the
+    collective. Returns (new_state, offset, invscale)."""
+    x = jax.lax.stop_gradient(x.astype(jnp.float32))
+    low = jnp.quantile(x, percentile_low)
+    high = jnp.quantile(x, percentile_high)
+    new_low = decay * state["low"] + (1 - decay) * low
+    new_high = decay * state["high"] + (1 - decay) * high
+    invscale = jnp.maximum(1.0 / max_, new_high - new_low)
+    return {"low": new_low, "high": new_high}, new_low, invscale
+
+
+def prepare_obs(
+    obs: Dict[str, np.ndarray], *, cnn_keys: Sequence[str] = (), num_envs: int = 1, **kwargs: Any
+) -> Dict[str, jnp.ndarray]:
+    """(1, num_envs, ...) float obs dict; images NHWC normalized to
+    [-0.5, 0.5]."""
+    out = {}
+    for k, v in obs.items():
+        arr = jnp.asarray(v, dtype=jnp.float32)
+        if k in cnn_keys:
+            arr = arr.reshape(1, num_envs, *arr.shape[-3:]) / 255.0 - 0.5
+        else:
+            arr = arr.reshape(1, num_envs, -1)
+        out[k] = arr
+    return out
+
+
+def test(player, runtime, cfg: Dict[str, Any], log_dir: str, test_name: str = "", greedy: bool = True) -> float:
+    env = make_env(cfg, cfg.seed, 0, log_dir, "test" + (f"_{test_name}" if test_name else ""))()
+    done = False
+    cumulative_rew = 0.0
+    obs = env.reset(seed=cfg.seed)[0]
+    old_num_envs = player.num_envs
+    player.num_envs = 1
+    player.init_states()
+    while not done:
+        prepared = prepare_obs(obs, cnn_keys=cfg.algo.cnn_keys.encoder, num_envs=1)
+        mask = {k: v for k, v in prepared.items() if k.startswith("mask")} or None
+        real_actions = player.get_actions(prepared, runtime.next_key(), greedy, mask)
+        if player.actor_module.is_continuous:
+            acts = np.stack([np.asarray(a) for a in real_actions], -1)
+        else:
+            acts = np.stack([np.asarray(a).argmax(-1) for a in real_actions], -1)
+        obs, reward, terminated, truncated, _ = env.step(acts.reshape(env.action_space.shape))
+        done = bool(terminated or truncated or cfg.dry_run)
+        cumulative_rew += float(reward)
+    runtime.print("Test - Reward:", cumulative_rew)
+    env.close()
+    player.num_envs = old_num_envs
+    player.init_states()
+    return cumulative_rew

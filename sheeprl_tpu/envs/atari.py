@@ -1,0 +1,24 @@
+"""Atari environment factory (gated on ale_py availability).
+
+The reference relies on gymnasium's atari extras
+(configs/env/atari.yaml: gym.make of *NoFrameskip-v4). Frame preprocessing
+(resize/grayscale) happens in make_env's transform chain, so here we only
+need the raw env with rgb rendering."""
+
+from __future__ import annotations
+
+import gymnasium as gym
+
+from sheeprl_tpu.utils.imports import _IS_ATARI_AVAILABLE
+
+
+def make_atari_env(id: str, screen_size: int = 64, **kwargs) -> gym.Env:
+    if not _IS_ATARI_AVAILABLE:
+        raise ModuleNotFoundError(
+            "ale_py is not installed in this environment; Atari environments are unavailable. "
+            "Install gymnasium[atari] to use them."
+        )
+    import ale_py  # noqa: F401
+
+    gym.register_envs(ale_py)
+    return gym.make(id, render_mode="rgb_array")

@@ -171,3 +171,61 @@ def test_droq(standard_args, tmp_path):
         f"root_dir={tmp_path}/droq",
     ]
     _run(args)
+
+
+def _dv3_tiny_args():
+    return [
+        "algo.per_rank_batch_size=2",
+        "algo.per_rank_sequence_length=1",
+        "algo.horizon=3",
+        "algo.learning_starts=0",
+        "algo.dense_units=8",
+        "algo.mlp_layers=1",
+        "algo.world_model.encoder.cnn_channels_multiplier=2",
+        "algo.world_model.recurrent_model.recurrent_state_size=8",
+        "algo.world_model.representation_model.hidden_size=8",
+        "algo.world_model.transition_model.hidden_size=8",
+        "algo.world_model.stochastic_size=4",
+        "algo.world_model.discrete_size=4",
+        "algo.world_model.reward_model.bins=15",
+        "algo.critic.bins=15",
+        "env.screen_size=16",
+    ]
+
+
+def test_dreamer_v3(standard_args, devices, tmp_path):
+    args = standard_args + _dv3_tiny_args() + [
+        "exp=dreamer_v3",
+        "env=dummy",
+        "algo.mlp_keys.encoder=[state]",
+        "algo.cnn_keys.encoder=[rgb]",
+        f"fabric.devices={devices}",
+        f"root_dir={tmp_path}/dv3",
+    ]
+    _run(args)
+
+
+def test_dreamer_v3_continuous(standard_args, tmp_path):
+    args = standard_args + _dv3_tiny_args() + [
+        "exp=dreamer_v3",
+        "env=dummy",
+        "env.id=dummy_continuous",
+        "algo.mlp_keys.encoder=[state]",
+        "algo.cnn_keys.encoder=[]",
+        "fabric.devices=1",
+        f"root_dir={tmp_path}/dv3c",
+    ]
+    _run(args)
+
+
+def test_dreamer_v3_decoupled_rssm(standard_args, tmp_path):
+    args = standard_args + _dv3_tiny_args() + [
+        "exp=dreamer_v3",
+        "env=dummy",
+        "algo.mlp_keys.encoder=[state]",
+        "algo.cnn_keys.encoder=[]",
+        "algo.world_model.decoupled_rssm=True",
+        "fabric.devices=1",
+        f"root_dir={tmp_path}/dv3d",
+    ]
+    _run(args)
