@@ -111,34 +111,66 @@ def make_train_fn(runtime, world_model, actor, critic, txs, cfg, is_continuous, 
             embedded_obs = world_model.encoder.apply(wm_params["encoder"], batch_obs)  # (T, B, E)
             dyn_keys = jax.random.split(k_dyn, T)
 
-            def dyn_step(carry, inp):
-                posterior, recurrent_state = carry
-                action, emb, first, kk = inp
-                out = rssm.apply(
-                    wm_params["rssm"],
-                    posterior,
-                    recurrent_state,
-                    action,
-                    emb,
-                    first,
-                    kk,
-                    method=RSSM.dynamic,
+            if decoupled:
+                # posterior depends only on obs (reference DecoupledRSSM:501;
+                # dreamer_v3.py:117-131): compute all posteriors up front,
+                # roll the recurrent model with the previous-step posterior
+                posteriors_logits, posteriors = rssm.apply(
+                    wm_params["rssm"], embedded_obs, k_dyn, method=RSSM._representation
                 )
-                recurrent_state, posterior, _, posterior_logits, prior_logits = out
-                return (posterior, recurrent_state), (
-                    recurrent_state,
-                    posterior,
-                    posterior_logits,
-                    prior_logits,
+                prev_posteriors = jnp.concatenate(
+                    [jnp.zeros_like(posteriors[:1]), posteriors[:-1]], 0
                 )
 
-            init = (
-                jnp.zeros((B, stochastic_size, discrete_size)),
-                jnp.zeros((B, recurrent_state_size)),
-            )
-            _, (recurrent_states, posteriors, posteriors_logits, priors_logits) = jax.lax.scan(
-                dyn_step, init, (batch_actions, embedded_obs, is_first, dyn_keys)
-            )
+                def dyn_step_dec(recurrent_state, inp):
+                    prev_post, action, first, kk = inp
+                    recurrent_state, _, prior_logits = rssm.apply(
+                        wm_params["rssm"],
+                        prev_post,
+                        recurrent_state,
+                        action,
+                        jnp.zeros(()),  # unused in decoupled mode
+                        first,
+                        kk,
+                        method=RSSM.dynamic,
+                    )
+                    return recurrent_state, (recurrent_state, prior_logits)
+
+                _, (recurrent_states, priors_logits) = jax.lax.scan(
+                    dyn_step_dec,
+                    jnp.zeros((B, recurrent_state_size)),
+                    (prev_posteriors, batch_actions, is_first, dyn_keys),
+                )
+            else:
+
+                def dyn_step(carry, inp):
+                    posterior, recurrent_state = carry
+                    action, emb, first, kk = inp
+                    out = rssm.apply(
+                        wm_params["rssm"],
+                        posterior,
+                        recurrent_state,
+                        action,
+                        emb,
+                        first,
+                        kk,
+                        method=RSSM.dynamic,
+                    )
+                    recurrent_state, posterior, _, posterior_logits, prior_logits = out
+                    return (posterior, recurrent_state), (
+                        recurrent_state,
+                        posterior,
+                        posterior_logits,
+                        prior_logits,
+                    )
+
+                init = (
+                    jnp.zeros((B, stochastic_size, discrete_size)),
+                    jnp.zeros((B, recurrent_state_size)),
+                )
+                _, (recurrent_states, posteriors, posteriors_logits, priors_logits) = jax.lax.scan(
+                    dyn_step, init, (batch_actions, embedded_obs, is_first, dyn_keys)
+                )
             latent_states = jnp.concatenate(
                 [posteriors.reshape(T, B, -1), recurrent_states], -1
             )
