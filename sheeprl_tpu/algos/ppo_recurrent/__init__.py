@@ -1,0 +1,1 @@
+from sheeprl_tpu.algos.ppo_recurrent import evaluate, ppo_recurrent  # noqa: F401  (registry side-effect)

@@ -1,0 +1,49 @@
+"""Recurrent-PPO evaluation entrypoint (reference
+sheeprl/algos/ppo_recurrent/evaluate.py:15)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import gymnasium as gym
+
+from sheeprl_tpu.algos.ppo_recurrent.agent import RecurrentPPOPlayer, build_agent
+from sheeprl_tpu.algos.ppo_recurrent.utils import prepare_obs, test
+from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.registry import register_evaluation
+
+
+@register_evaluation(algorithms="ppo_recurrent")
+def evaluate_ppo_recurrent(runtime, cfg: Dict[str, Any], state: Dict[str, Any]):
+    logger = get_logger(runtime, cfg)
+    log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name)
+    runtime.print(f"Log dir: {log_dir}")
+    runtime.seed_everything(cfg.seed)
+
+    env = make_env(cfg, cfg.seed, 0, log_dir, "test", vector_env_idx=0)()
+    observation_space = env.observation_space
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+    if cfg.algo.cnn_keys.encoder + cfg.algo.mlp_keys.encoder == []:
+        raise RuntimeError("Specify at least one of `cnn_keys.encoder` or `mlp_keys.encoder`")
+
+    is_continuous = isinstance(env.action_space, gym.spaces.Box)
+    is_multidiscrete = isinstance(env.action_space, gym.spaces.MultiDiscrete)
+    actions_dim = tuple(
+        env.action_space.shape
+        if is_continuous
+        else (env.action_space.nvec.tolist() if is_multidiscrete else [env.action_space.n])
+    )
+    env.close()
+    module, params = build_agent(runtime, actions_dim, is_continuous, cfg, observation_space, state["agent"])
+    player = RecurrentPPOPlayer(
+        module,
+        params,
+        lambda obs: prepare_obs(obs, cnn_keys=cfg.algo.cnn_keys.encoder, num_envs=1),
+        num_envs=1,
+    )
+    rew = test(player, runtime, cfg, log_dir)
+    if logger:
+        logger.log_metrics({"Test/cumulative_reward": rew}, 0)
+        logger.finalize()

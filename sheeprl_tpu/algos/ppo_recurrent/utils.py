@@ -1,0 +1,64 @@
+"""Recurrent-PPO helpers (reference sheeprl/algos/ppo_recurrent/utils.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.ppo.utils import normalize_obs
+from sheeprl_tpu.utils.env import make_env
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/value_loss",
+    "Loss/policy_loss",
+    "Loss/entropy_loss",
+}
+MODELS_TO_REGISTER = {"agent"}
+
+
+def prepare_obs(
+    obs: Dict[str, np.ndarray], *, cnn_keys: Sequence[str] = (), num_envs: int = 1, **kwargs: Any
+) -> Dict[str, jnp.ndarray]:
+    """Host numpy obs dict -> float device arrays (T=1, B, ...), normalized."""
+    out = {}
+    for k, v in obs.items():
+        arr = jnp.asarray(v, dtype=jnp.float32)
+        if k in cnn_keys:
+            arr = arr.reshape(1, num_envs, *arr.shape[-3:])
+        else:
+            arr = arr.reshape(1, num_envs, -1)
+        out[k] = arr
+    return normalize_obs(out, cnn_keys, list(out.keys()))
+
+
+def test(player, runtime, cfg: Dict[str, Any], log_dir: str) -> float:
+    """Greedy single-episode rollout on rank 0 with carried recurrent state
+    (reference ppo_recurrent/utils.py test)."""
+    from sheeprl_tpu.algos.ppo_recurrent.agent import RecurrentPPOPlayer
+
+    player = RecurrentPPOPlayer(
+        player.module,
+        player.params,
+        lambda obs: prepare_obs(obs, cnn_keys=cfg.algo.cnn_keys.encoder, num_envs=1),
+        num_envs=1,
+    )
+    env = make_env(cfg, cfg.seed, 0, log_dir, "test", vector_env_idx=0)()
+    done = False
+    cumulative_rew = 0.0
+    obs = env.reset(seed=cfg.seed)[0]
+    player.init_states()
+    while not done:
+        _, real_actions, _, _ = player.get_actions(obs, runtime.next_key(), greedy=True)
+        actions = np.asarray(real_actions).reshape(env.action_space.shape)
+        obs, reward, terminated, truncated, _ = env.step(actions)
+        done = bool(terminated or truncated)
+        cumulative_rew += float(reward)
+        if cfg.dry_run:
+            done = True
+    runtime.print("Test - Reward:", cumulative_rew)
+    env.close()
+    return cumulative_rew

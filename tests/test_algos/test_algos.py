@@ -110,6 +110,43 @@ def test_ppo_pixel(standard_args, tmp_path):
     _run(args)
 
 
+def test_ppo_recurrent(standard_args, devices, tmp_path):
+    args = standard_args + [
+        "exp=ppo_recurrent",
+        "env.num_envs=2",
+        "algo.rollout_steps=8",
+        "algo.per_rank_sequence_length=4",
+        "algo.per_rank_num_batches=2",
+        "algo.update_epochs=2",
+        "algo.dense_units=8",
+        "algo.rnn.lstm.hidden_size=8",
+        "algo.mlp_layers=1",
+        "algo.mlp_keys.encoder=[state]",
+        f"fabric.devices={devices}",
+        f"root_dir={tmp_path}/ppor",
+    ]
+    _run(args)
+
+
+def test_ppo_recurrent_continuous(standard_args, tmp_path):
+    args = standard_args + [
+        "exp=ppo_recurrent",
+        "env.id=dummy_continuous",
+        "env.num_envs=2",
+        "algo.rollout_steps=8",
+        "algo.per_rank_sequence_length=4",
+        "algo.per_rank_num_batches=2",
+        "algo.update_epochs=1",
+        "algo.dense_units=8",
+        "algo.rnn.lstm.hidden_size=8",
+        "algo.mlp_layers=1",
+        "algo.mlp_keys.encoder=[state]",
+        "fabric.devices=1",
+        f"root_dir={tmp_path}/pporc",
+    ]
+    _run(args)
+
+
 def test_a2c(standard_args, devices, tmp_path):
     args = standard_args + [
         "exp=a2c",
