@@ -1,0 +1,1 @@
+from sheeprl_tpu.algos.dreamer_v2 import dreamer_v2, evaluate  # noqa: F401  (registry side-effect)

@@ -1,22 +1,17 @@
-"""DreamerV3 agent (flax) — counterpart of reference
-sheeprl/algos/dreamer_v3/agent.py (CNNEncoder:42, MLPEncoder:100,
-CNNDecoder:154, MLPDecoder:229, RecurrentModel:281, RSSM:344,
-DecoupledRSSM:501, PlayerDV3:596, Actor:694, build_agent:935).
+"""DreamerV2 agent (flax) — counterpart of reference
+sheeprl/algos/dreamer_v2/agent.py (CNNEncoder:31, MLPEncoder:85,
+CNNDecoder:129, MLPDecoder:199, RecurrentModel:246, RSSM:301, Actor:416,
+WorldModel:707, PlayerDV2:735, build_agent:836).
 
-Structure: one top-level flax module per optimizer group — the world model
-is a dict of modules {encoder, rssm, observation_model, reward_model,
-continue_model} sharing a single params pytree ``params["world_model"]``;
-actor and critic are separate. The reference's weight-tying between agent
-and player (agent.py:1229-1235) is inherent here: the player applies the
-same params.
-
-Numerical-parity notes (SURVEY.md §7 "hard parts"):
-- unimix 1% on RSSM and actor logits;
-- Hafner initialization (agent.py:1170-1180): trunc-normal fan-avg
-  everywhere, uniform fan-avg on dist heads, zeros on reward/critic heads;
-- learnable initial recurrent state passed through tanh;
-- ``is_first``-gated resets inside the dynamic step;
-- images are NHWC; frame (H, W, C).
+Differences from the DV3 agent that define the V2 behavior:
+- ELU activations, LayerNorm mostly off (GRU keeps its LN);
+- encoder convs are VALID-padded k=4 s=2 (64 -> 31 -> 14 -> 6 -> 2), the
+  decoder inverts with VALID deconvs of kernels [5, 5, 6, 6] from a 1x1
+  feature map;
+- no unimix on latent/actor logits, no learnable initial recurrent state
+  (zeros resets), no symlog/two-hot heads;
+- continuous actor defaults to a TruncatedNormal on tanh(mean);
+- Xavier-normal init with zero biases (reference dreamer_v2/utils.py:64).
 """
 
 from __future__ import annotations
@@ -28,102 +23,74 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from sheeprl_tpu.models.models import MLP, LayerNormGRUCell, resolve_activation
+from sheeprl_tpu.models.models import LayerNormGRUCell, resolve_activation
 from sheeprl_tpu.utils.distribution import (
     Independent,
     Normal,
+    OneHotCategorical,
     OneHotCategoricalStraightThrough,
     TanhNormal,
+    TruncatedNormal,
 )
-from sheeprl_tpu.utils.utils import symlog
 
-# Hafner inits (reference dreamer_v3/utils.py:143-187)
-trunc_init = nn.initializers.variance_scaling(1.0, "fan_avg", "truncated_normal")
+xavier_init = nn.initializers.xavier_normal()
 
 
-def uniform_out_init(scale: float) -> Callable:
-    if scale == 0.0:
-        return nn.initializers.zeros_init()
-    return nn.initializers.variance_scaling(scale, "fan_avg", "uniform")
-
-
-def _ln_enabled(cfg_node: Any) -> bool:
-    """Map the reference's layer_norm `cls` strings to a bool."""
-    if cfg_node is None:
-        return False
-    cls = str(cfg_node.get("cls", "")) if isinstance(cfg_node, dict) else str(cfg_node)
-    return "identity" not in cls.lower()
-
-
-def _ln_eps(cfg_node: Any) -> float:
-    if isinstance(cfg_node, dict):
-        return float(cfg_node.get("kw", {}).get("eps", 1e-3))
-    return 1e-3
-
-
-class LinearLnAct(nn.Module):
-    """Dense (no bias when followed by LN) -> LayerNorm -> activation —
-    the Dreamer building block."""
+class DenseActLn(nn.Module):
+    """Dense -> (optional LayerNorm) -> activation, Xavier-normal init."""
 
     units: int
-    layer_norm: bool = True
-    eps: float = 1e-3
-    act: Any = "silu"
-    kernel_init: Callable = trunc_init
+    act: Any = "elu"
+    layer_norm: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
-        x = nn.Dense(self.units, use_bias=not self.layer_norm, kernel_init=self.kernel_init)(x)
+        x = nn.Dense(self.units, kernel_init=xavier_init)(x)
         if self.layer_norm:
-            x = nn.LayerNorm(epsilon=self.eps)(x)
+            x = nn.LayerNorm()(x)
         return resolve_activation(self.act)(x)
 
 
-class DreamerMLP(nn.Module):
-    """Stack of LinearLnAct blocks + optional output head with its own init."""
+class V2MLP(nn.Module):
+    """Stack of DenseActLn blocks + optional linear output head."""
 
     units: int
     layers: int
     output_dim: Optional[int] = None
-    layer_norm: bool = True
-    eps: float = 1e-3
-    act: Any = "silu"
-    out_init: Callable = trunc_init
+    act: Any = "elu"
+    layer_norm: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         for _ in range(self.layers):
-            x = LinearLnAct(self.units, self.layer_norm, self.eps, self.act)(x)
+            x = DenseActLn(self.units, self.act, self.layer_norm)(x)
         if self.output_dim is not None:
-            x = nn.Dense(self.output_dim, kernel_init=self.out_init)(x)
+            x = nn.Dense(self.output_dim, kernel_init=xavier_init)(x)
         return x
 
 
 class CNNEncoder(nn.Module):
-    """4-ish-stage conv encoder, kernel 4 stride 2, channels [1,2,4,8]*mult,
-    NHWC, LayerNorm over channels + SiLU; flattens to a feature vector."""
+    """4-stage VALID conv encoder, kernel 4 stride 2, channels
+    [1, 2, 4, 8] * mult, NHWC (reference CNNEncoder:31 assumes 64x64)."""
 
     keys: Sequence[str]
     channels_multiplier: int
-    stages: int = 4
-    layer_norm: bool = True
-    eps: float = 1e-3
-    act: Any = "silu"
+    layer_norm: bool = False
+    act: Any = "elu"
 
     @nn.compact
     def __call__(self, obs: Dict[str, jax.Array]) -> jax.Array:
-        x = jnp.concatenate([obs[k] for k in self.keys], axis=-1)  # channel concat
-        for i in range(self.stages):
+        x = jnp.concatenate([obs[k] for k in self.keys], axis=-1)
+        for i in range(4):
             x = nn.Conv(
                 (2**i) * self.channels_multiplier,
                 (4, 4),
                 strides=(2, 2),
-                padding=[(1, 1), (1, 1)],
-                use_bias=not self.layer_norm,
-                kernel_init=trunc_init,
+                padding="VALID",
+                kernel_init=xavier_init,
             )(x)
             if self.layer_norm:
-                x = nn.LayerNorm(epsilon=self.eps)(x)
+                x = nn.LayerNorm()(x)
             x = resolve_activation(self.act)(x)
         return x.reshape(*x.shape[:-3], -1)
 
@@ -131,21 +98,17 @@ class CNNEncoder(nn.Module):
 class MLPEncoder(nn.Module):
     keys: Sequence[str]
     mlp_layers: int = 4
-    dense_units: int = 512
-    layer_norm: bool = True
-    eps: float = 1e-3
-    act: Any = "silu"
-    symlog_inputs: bool = True
+    dense_units: int = 400
+    layer_norm: bool = False
+    act: Any = "elu"
 
     @nn.compact
     def __call__(self, obs: Dict[str, jax.Array]) -> jax.Array:
-        x = jnp.concatenate(
-            [symlog(obs[k]) if self.symlog_inputs else obs[k] for k in self.keys], -1
-        )
-        return DreamerMLP(self.dense_units, self.mlp_layers, None, self.layer_norm, self.eps, self.act)(x)
+        x = jnp.concatenate([obs[k] for k in self.keys], -1)
+        return V2MLP(self.dense_units, self.mlp_layers, None, self.act, self.layer_norm)(x)
 
 
-class MultiEncoderDV3(nn.Module):
+class MultiEncoderV2(nn.Module):
     cnn_encoder: Optional[nn.Module] = None
     mlp_encoder: Optional[nn.Module] = None
 
@@ -159,43 +122,36 @@ class MultiEncoderDV3(nn.Module):
 
 
 class CNNDecoder(nn.Module):
-    """Linear projection -> (4, 4, 8*mult) -> transposed convs back to
-    (H, W, sum(channels)); returns a dict split per image key."""
+    """Linear latent -> (1, 1, cnn_encoder_output_dim) -> 4 VALID deconvs of
+    kernels [5, 5, 6, 6] stride 2 back to 64x64 (reference CNNDecoder:129)."""
 
     keys: Sequence[str]
     output_channels: Sequence[int]
     channels_multiplier: int
     cnn_encoder_output_dim: int
-    image_size: Tuple[int, int]
-    stages: int = 4
-    layer_norm: bool = True
-    eps: float = 1e-3
-    act: Any = "silu"
+    layer_norm: bool = False
+    act: Any = "elu"
 
     @nn.compact
     def __call__(self, latent: jax.Array) -> Dict[str, jax.Array]:
         lead = latent.shape[:-1]
-        x = nn.Dense(self.cnn_encoder_output_dim, kernel_init=trunc_init)(latent)
-        x = x.reshape(-1, 4, 4, (2 ** (self.stages - 1)) * self.channels_multiplier)
-        for i in range(self.stages - 1):
-            ch = (2 ** (self.stages - i - 2)) * self.channels_multiplier
+        x = nn.Dense(self.cnn_encoder_output_dim, kernel_init=xavier_init)(latent)
+        x = x.reshape(-1, 1, 1, self.cnn_encoder_output_dim)
+        chans = [4 * self.channels_multiplier, 2 * self.channels_multiplier, self.channels_multiplier]
+        kernels = [5, 5, 6, 6]
+        for i, ch in enumerate(chans):
             x = nn.ConvTranspose(
-                ch,
-                (4, 4),
-                strides=(2, 2),
-                padding=[(2, 2), (2, 2)],
-                use_bias=not self.layer_norm,
-                kernel_init=trunc_init,
+                ch, (kernels[i], kernels[i]), strides=(2, 2), padding="VALID", kernel_init=xavier_init
             )(x)
             if self.layer_norm:
-                x = nn.LayerNorm(epsilon=self.eps)(x)
+                x = nn.LayerNorm()(x)
             x = resolve_activation(self.act)(x)
         x = nn.ConvTranspose(
             int(sum(self.output_channels)),
-            (4, 4),
+            (kernels[-1], kernels[-1]),
             strides=(2, 2),
-            padding=[(2, 2), (2, 2)],
-            kernel_init=uniform_out_init(1.0),
+            padding="VALID",
+            kernel_init=xavier_init,
         )(x)
         x = x.reshape(*lead, *x.shape[1:])
         out: Dict[str, jax.Array] = {}
@@ -210,21 +166,19 @@ class MLPDecoder(nn.Module):
     keys: Sequence[str]
     output_dims: Sequence[int]
     mlp_layers: int = 4
-    dense_units: int = 512
-    layer_norm: bool = True
-    eps: float = 1e-3
-    act: Any = "silu"
+    dense_units: int = 400
+    layer_norm: bool = False
+    act: Any = "elu"
 
     @nn.compact
     def __call__(self, latent: jax.Array) -> Dict[str, jax.Array]:
-        x = DreamerMLP(self.dense_units, self.mlp_layers, None, self.layer_norm, self.eps, self.act)(latent)
+        x = V2MLP(self.dense_units, self.mlp_layers, None, self.act, self.layer_norm)(latent)
         return {
-            k: nn.Dense(d, kernel_init=uniform_out_init(1.0))(x)
-            for k, d in zip(self.keys, self.output_dims)
+            k: nn.Dense(d, kernel_init=xavier_init)(x) for k, d in zip(self.keys, self.output_dims)
         }
 
 
-class MultiDecoderDV3(nn.Module):
+class MultiDecoderV2(nn.Module):
     cnn_decoder: Optional[nn.Module] = None
     mlp_decoder: Optional[nn.Module] = None
 
@@ -238,18 +192,19 @@ class MultiDecoderDV3(nn.Module):
 
 
 class RecurrentModel(nn.Module):
-    """MLP projection -> LayerNormGRUCell (reference RecurrentModel:281)."""
+    """Dense+act projection -> LayerNormGRUCell with bias and LN (reference
+    RecurrentModel:246: the GRU always keeps its LayerNorm in V2)."""
 
     recurrent_state_size: int
     dense_units: int
-    layer_norm: bool = True
-    eps: float = 1e-3
+    layer_norm: bool = False  # LN of the pre-GRU MLP only
+    act: Any = "elu"
 
     @nn.compact
     def __call__(self, inp: jax.Array, recurrent_state: jax.Array) -> jax.Array:
-        feat = LinearLnAct(self.dense_units, self.layer_norm, self.eps, "silu")(inp)
+        feat = DenseActLn(self.dense_units, self.act, self.layer_norm)(inp)
         new_h, _ = LayerNormGRUCell(
-            hidden_size=self.recurrent_state_size, use_bias=False, layer_norm=True
+            hidden_size=self.recurrent_state_size, use_bias=True, layer_norm=True
         )(recurrent_state, feat)
         return new_h
 
@@ -258,17 +213,15 @@ def compute_stochastic_state(
     logits: jax.Array, discrete: int, key: Optional[jax.Array], sample: bool = True
 ) -> jax.Array:
     """(..., stoch*discrete) logits -> (..., stoch, discrete) one-hot ST
-    sample (reference dreamer_v2/utils.py:44)."""
+    sample (reference dreamer_v2/utils.py:44); no unimix in V2."""
     logits = logits.reshape(*logits.shape[:-1], -1, discrete)
     dist = OneHotCategoricalStraightThrough(logits=logits)
     return dist.rsample(key) if sample else dist.mode
 
 
 class RSSM(nn.Module):
-    """Recurrent State-Space Model with discrete latents (reference RSSM:344).
-
-    ``decoupled`` makes the posterior depend only on the embedded obs
-    (reference DecoupledRSSM:501)."""
+    """Discrete-latent RSSM with zeros initial state and is_first-gated
+    zero resets (reference RSSM:301)."""
 
     actions_dim: Sequence[int]
     embedded_obs_dim: int
@@ -276,77 +229,40 @@ class RSSM(nn.Module):
     dense_units: int
     stochastic_size: int = 32
     discrete_size: int = 32
-    hidden_size: int = 1024
-    unimix: float = 0.01
-    layer_norm: bool = True
-    eps: float = 1e-3
-    act: Any = "silu"
-    learnable_initial_recurrent_state: bool = True
-    decoupled: bool = False
+    representation_hidden_size: int = 600
+    transition_hidden_size: int = 600
+    layer_norm: bool = False
+    recurrent_layer_norm: bool = False
+    act: Any = "elu"
 
     def setup(self) -> None:
         stoch = self.stochastic_size * self.discrete_size
         self.recurrent_model = RecurrentModel(
             recurrent_state_size=self.recurrent_state_size,
             dense_units=self.dense_units,
-            layer_norm=self.layer_norm,
-            eps=self.eps,
+            layer_norm=self.recurrent_layer_norm,
+            act=self.act,
         )
-        self.representation_model = DreamerMLP(
-            self.hidden_size, 1, stoch, self.layer_norm, self.eps, self.act, uniform_out_init(1.0)
+        self.representation_model = V2MLP(
+            self.representation_hidden_size, 1, stoch, self.act, self.layer_norm
         )
-        self.transition_model = DreamerMLP(
-            self.hidden_size, 1, stoch, self.layer_norm, self.eps, self.act, uniform_out_init(1.0)
+        self.transition_model = V2MLP(
+            self.transition_hidden_size, 1, stoch, self.act, self.layer_norm
         )
-        if self.learnable_initial_recurrent_state:
-            self.initial_recurrent_state = self.param(
-                "initial_recurrent_state", nn.initializers.zeros, (self.recurrent_state_size,)
-            )
-        else:
-            self.initial_recurrent_state = jnp.zeros((self.recurrent_state_size,))
 
     def recurrent_step(self, inp: jax.Array, recurrent_state: jax.Array) -> jax.Array:
-        """Expose the recurrent model for the player's stateful step."""
         return self.recurrent_model(inp, recurrent_state)
 
-    def init_all(self, posterior, recurrent_state, action, embedded_obs, is_first, key):
-        """Initialization path touching every submodule (the decoupled
-        dynamic skips the representation model)."""
-        out = self.dynamic(posterior, recurrent_state, action, embedded_obs, is_first, key)
-        if self.decoupled:
-            self._representation(embedded_obs, key)
-        return out
-
-    def _uniform_mix(self, logits: jax.Array) -> jax.Array:
-        logits = logits.reshape(*logits.shape[:-1], -1, self.discrete_size)
-        if self.unimix > 0.0:
-            probs = jax.nn.softmax(logits, -1)
-            uniform = jnp.ones_like(probs) / self.discrete_size
-            probs = (1 - self.unimix) * probs + self.unimix * uniform
-            logits = jnp.log(probs)
-        return logits.reshape(*logits.shape[:-2], -1)
-
-    def get_initial_states(self, batch_shape: Sequence[int]) -> Tuple[jax.Array, jax.Array]:
-        init_rec = jnp.broadcast_to(
-            jnp.tanh(self.initial_recurrent_state), (*batch_shape, self.recurrent_state_size)
-        )
-        _, initial_posterior = self._transition(init_rec, sample_state=False, key=None)
-        return init_rec, initial_posterior
-
     def _representation(
-        self, embedded_obs: jax.Array, key: jax.Array, recurrent_state: Optional[jax.Array] = None
+        self, recurrent_state: jax.Array, embedded_obs: jax.Array, key: Optional[jax.Array]
     ) -> Tuple[jax.Array, jax.Array]:
-        if self.decoupled:
-            x = embedded_obs
-        else:
-            x = jnp.concatenate([recurrent_state, embedded_obs], -1)
-        logits = self._uniform_mix(self.representation_model(x))
+        logits = self.representation_model(jnp.concatenate([recurrent_state, embedded_obs], -1))
         return logits, compute_stochastic_state(logits, self.discrete_size, key)
 
     def _transition(
         self, recurrent_out: jax.Array, key: Optional[jax.Array], sample_state: bool = True
     ) -> Tuple[jax.Array, jax.Array]:
-        logits = self._uniform_mix(self.transition_model(recurrent_out))
+        logits = self.transition_model(recurrent_out)
         return logits, compute_stochastic_state(logits, self.discrete_size, key, sample=sample_state)
 
     def dynamic(
@@ -358,21 +274,17 @@ class RSSM(nn.Module):
         is_first: jax.Array,
         key: jax.Array,
     ):
-        """One dynamic-learning step with is_first-gated resets."""
+        """One dynamic step; zero resets where is_first (reference
+        dynamic:336-369)."""
         k1, k2 = jax.random.split(key)
         action = (1 - is_first) * action
-        initial_recurrent_state, initial_posterior = self.get_initial_states(recurrent_state.shape[:-1])
-        recurrent_state = (1 - is_first) * recurrent_state + is_first * initial_recurrent_state
-        posterior = posterior.reshape(*posterior.shape[:-2], -1)
-        posterior = (1 - is_first) * posterior + is_first * initial_posterior.reshape(posterior.shape)
-
+        posterior = (1 - is_first) * posterior.reshape(*posterior.shape[:-2], -1)
+        recurrent_state = (1 - is_first) * recurrent_state
         recurrent_state = self.recurrent_model(
             jnp.concatenate([posterior, action], -1), recurrent_state
         )
         prior_logits, prior = self._transition(recurrent_state, k1)
-        if self.decoupled:
-            return recurrent_state, prior, prior_logits
-        posterior_logits, posterior = self._representation(embedded_obs, k2, recurrent_state)
+        posterior_logits, posterior = self._representation(recurrent_state, embedded_obs, k2)
         return recurrent_state, posterior, prior, posterior_logits, prior_logits
 
     def imagination(self, prior: jax.Array, recurrent_state: jax.Array, actions: jax.Array, key: jax.Array):
@@ -384,36 +296,24 @@ class RSSM(nn.Module):
 
 
 class Actor(nn.Module):
-    """DV3 actor: trunk MLP + per-subaction heads with unimix'd ST one-hot
-    dists (discrete) or scaled-Normal (continuous) (reference Actor:694)."""
+    """DV2 actor: ELU trunk + per-subaction one-hot ST heads (discrete) or a
+    TruncatedNormal/TanhNormal/Normal head (continuous) (reference Actor:416)."""
 
     actions_dim: Sequence[int]
     is_continuous: bool
     distribution: str = "auto"
     init_std: float = 0.0
     min_std: float = 0.1
-    max_std: float = 1.0
-    dense_units: int = 1024
-    mlp_layers: int = 5
-    layer_norm: bool = True
-    eps: float = 1e-3
-    act: Any = "silu"
-    unimix: float = 0.01
-    action_clip: float = 1.0
+    dense_units: int = 400
+    mlp_layers: int = 4
+    layer_norm: bool = False
+    act: Any = "elu"
 
     def _dist_name(self) -> str:
         d = self.distribution.lower()
         if d == "auto":
-            return "scaled_normal" if self.is_continuous else "discrete"
+            return "trunc_normal" if self.is_continuous else "discrete"
         return d
-
-    def _uniform_mix(self, logits: jax.Array) -> jax.Array:
-        if self.unimix > 0.0:
-            probs = jax.nn.softmax(logits, -1)
-            uniform = jnp.ones_like(probs) / probs.shape[-1]
-            probs = (1 - self.unimix) * probs + self.unimix * uniform
-            logits = jnp.log(probs)
-        return logits
 
     @nn.compact
     def __call__(
@@ -425,9 +325,9 @@ class Actor(nn.Module):
     ):
         x = state
         for _ in range(self.mlp_layers):
-            x = LinearLnAct(self.dense_units, self.layer_norm, self.eps, self.act)(x)
+            x = DenseActLn(self.dense_units, self.act, self.layer_norm)(x)
         if self.is_continuous:
-            pre = nn.Dense(int(np.sum(self.actions_dim)) * 2, kernel_init=uniform_out_init(1.0))(x)
+            pre = nn.Dense(int(np.sum(self.actions_dim)) * 2, kernel_init=xavier_init)(x)
             mean, std = jnp.split(pre, 2, -1)
             name = self._dist_name()
             if name == "tanh_normal":
@@ -436,31 +336,20 @@ class Actor(nn.Module):
                 dist = Independent(TanhNormal(mean, std), 1)
             elif name == "normal":
                 dist = Independent(Normal(mean, std), 1)
-            elif name == "scaled_normal":
-                std = (self.max_std - self.min_std) * jax.nn.sigmoid(std + self.init_std) + self.min_std
-                dist = Independent(Normal(jnp.tanh(mean), std), 1)
+            elif name == "trunc_normal":
+                std = 2 * jax.nn.sigmoid((std + self.init_std) / 2) + self.min_std
+                dist = Independent(TruncatedNormal(jnp.tanh(mean), std, -1.0, 1.0), 1)
             else:
                 raise ValueError(f"Bad continuous distribution: {name}")
-            if greedy:
-                # reference samples 100 and keeps the argmax-log-prob one;
-                # for these unimodal dists the mean is that argmax
-                actions = dist.mean
-            else:
-                actions = dist.rsample(key)
-            if self.action_clip > 0.0:
-                clip = jnp.full_like(actions, self.action_clip)
-                actions = actions * jax.lax.stop_gradient(
-                    clip / jnp.maximum(clip, jnp.abs(actions))
-                )
+            # reference (greedy) samples 100 and keeps the argmax-log-prob
+            # one; for these unimodal dists the mode is that argmax
+            actions = dist.mode if greedy else dist.rsample(key)
             return (actions,), (dist,)
-        heads = [
-            nn.Dense(d, kernel_init=uniform_out_init(1.0))(x) for d in self.actions_dim
-        ]
+        heads = [nn.Dense(d, kernel_init=xavier_init)(x) for d in self.actions_dim]
         actions: List[jax.Array] = []
         dists = []
         keys = jax.random.split(key, len(heads)) if key is not None else [None] * len(heads)
         for i, logits in enumerate(heads):
-            logits = self._uniform_mix(logits)
             if mask is not None and i == 0 and "mask_action_type" in mask:
                 logits = jnp.where(mask["mask_action_type"], logits, -jnp.inf)
             d = OneHotCategoricalStraightThrough(logits=logits)
@@ -469,11 +358,37 @@ class Actor(nn.Module):
         return tuple(actions), tuple(dists)
 
 
+def add_exploration_noise(
+    actions: Sequence[jax.Array],
+    key: jax.Array,
+    expl_amount: float,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+) -> Sequence[jax.Array]:
+    """Epsilon-style exploration noise (reference Actor.add_exploration_noise:
+    clipped Normal jitter for continuous, uniform one-hot resample with
+    probability ``expl_amount`` for discrete)."""
+    if expl_amount <= 0.0:
+        return tuple(actions)
+    if is_continuous:
+        flat = jnp.concatenate(list(actions), -1)
+        noisy = jnp.clip(flat + expl_amount * jax.random.normal(key, flat.shape), -1.0, 1.0)
+        return (noisy,)
+    out = []
+    keys = jax.random.split(key, 2 * len(actions))
+    for i, act in enumerate(actions):
+        sample = OneHotCategorical(logits=jnp.zeros_like(act)).sample(keys[2 * i])
+        coin = jax.random.uniform(keys[2 * i + 1], act.shape[:-1] + (1,))
+        out.append(jnp.where(coin < expl_amount, sample, act))
+    return tuple(out)
+
+
 class WorldModel:
     """Container of the world-model modules sharing one params tree
-    (reference dreamer_v2/agent.py WorldModel:707)."""
+    (reference WorldModel:707). ``continue_model`` may be None
+    (use_continues=False default in V2)."""
 
-    def __init__(self, encoder, rssm, observation_model, reward_model, continue_model):
+    def __init__(self, encoder, rssm, observation_model, reward_model, continue_model=None):
         self.encoder = encoder
         self.rssm = rssm
         self.observation_model = observation_model
@@ -481,11 +396,9 @@ class WorldModel:
         self.continue_model = continue_model
 
 
-class PlayerDV3:
-    """Stateful env-interaction wrapper: carries per-env (actions,
-    recurrent_state, stochastic_state), masked-reset on dones
-    (reference PlayerDV3:596). The RSSM step + actor sampling is one jitted
-    function, optionally pinned to the host CPU backend."""
+class PlayerDV2:
+    """Stateful env-interaction wrapper with zeros init states
+    (reference PlayerDV2:735)."""
 
     def __init__(
         self,
@@ -497,8 +410,8 @@ class PlayerDV3:
         stochastic_size: int,
         recurrent_state_size: int,
         discrete_size: int = 32,
-        decoupled_rssm: bool = False,
         actor_type: Optional[str] = None,
+        expl_amount: float = 0.0,
         device=None,
     ):
         self.wm = world_model
@@ -508,12 +421,12 @@ class PlayerDV3:
         self.stochastic_size = stochastic_size
         self.discrete_size = discrete_size
         self.recurrent_state_size = recurrent_state_size
-        self.decoupled_rssm = decoupled_rssm
         self.actor_type = actor_type
+        self.expl_amount = expl_amount
         self.device = device
-        self.params = params  # {"world_model": ..., "actor": ...}
+        self.params = params
 
-        def _step(params, obs, prev_actions, recurrent_state, stochastic_state, key, greedy):
+        def _step(params, obs, prev_actions, recurrent_state, stochastic_state, key, mask, greedy, expl_amount):
             embedded_obs = self.wm.encoder.apply(params["world_model"]["encoder"], obs)
             recurrent_state = self.wm.rssm.apply(
                 params["world_model"]["rssm"],
@@ -521,29 +434,26 @@ class PlayerDV3:
                 recurrent_state,
                 method=RSSM.recurrent_step,
             )
-            k1, k2 = jax.random.split(key)
-            if self.decoupled_rssm:
-                _, stoch = self.wm.rssm.apply(
-                    params["world_model"]["rssm"], embedded_obs, k1, method=RSSM._representation
-                )
-            else:
-                _, stoch = self.wm.rssm.apply(
-                    params["world_model"]["rssm"],
-                    embedded_obs,
-                    k1,
-                    recurrent_state,
-                    method=RSSM._representation,
-                )
+            k1, k2, k3 = jax.random.split(key, 3)
+            _, stoch = self.wm.rssm.apply(
+                params["world_model"]["rssm"], recurrent_state, embedded_obs, k1,
+                method=RSSM._representation,
+            )
             stoch_flat = stoch.reshape(*stoch.shape[:-2], self.stochastic_size * self.discrete_size)
             actions, _ = self.actor_module.apply(
                 params["actor"],
                 jnp.concatenate([stoch_flat, recurrent_state], -1),
                 greedy,
                 k2,
+                mask,
             )
+            if expl_amount > 0.0 and not greedy:
+                actions = add_exploration_noise(
+                    actions, k3, expl_amount, self.actions_dim, self.actor_module.is_continuous
+                )
             return actions, jnp.concatenate(actions, -1), recurrent_state, stoch_flat
 
-        self._step = jax.jit(_step, static_argnums=(6,))
+        self._step = jax.jit(_step, static_argnums=(7, 8))
         self.init_states()
 
     @property
@@ -557,22 +467,15 @@ class PlayerDV3:
     def init_states(self, reset_envs: Optional[Sequence[int]] = None) -> None:
         if reset_envs is None or len(reset_envs) == 0:
             self.actions = jnp.zeros((1, self.num_envs, int(np.sum(self.actions_dim))))
-            rec, stoch = self._initial_states((1, self.num_envs))
-            self.recurrent_state = rec
-            self.stochastic_state = stoch.reshape(1, self.num_envs, -1)
+            self.recurrent_state = jnp.zeros((1, self.num_envs, self.recurrent_state_size))
+            self.stochastic_state = jnp.zeros(
+                (1, self.num_envs, self.stochastic_size * self.discrete_size)
+            )
         else:
             idx = np.asarray(reset_envs)
             self.actions = self.actions.at[:, idx].set(0.0)
-            rec, stoch = self._initial_states((1, len(idx)))
-            self.recurrent_state = self.recurrent_state.at[:, idx].set(rec)
-            self.stochastic_state = self.stochastic_state.at[:, idx].set(
-                stoch.reshape(1, len(idx), -1)
-            )
-
-    def _initial_states(self, batch_shape):
-        return self.wm.rssm.apply(
-            self._params["world_model"]["rssm"], batch_shape, method=RSSM.get_initial_states
-        )
+            self.recurrent_state = self.recurrent_state.at[:, idx].set(0.0)
+            self.stochastic_state = self.stochastic_state.at[:, idx].set(0.0)
 
     def get_actions(
         self, obs: Dict[str, jax.Array], key: jax.Array, greedy: bool = False, mask=None
@@ -581,7 +484,15 @@ class PlayerDV3:
             obs = jax.device_put(obs, self.device)
             key = jax.device_put(key, self.device)
         actions, flat, self.recurrent_state, self.stochastic_state = self._step(
-            self._params, obs, self.actions, self.recurrent_state, self.stochastic_state, key, greedy
+            self._params,
+            obs,
+            self.actions,
+            self.recurrent_state,
+            self.stochastic_state,
+            key,
+            mask,
+            greedy,
+            float(self.expl_amount),
         )
         self.actions = flat
         return actions
@@ -598,11 +509,9 @@ def build_agent(
     critic_state: Optional[Any] = None,
     target_critic_state: Optional[Any] = None,
 ):
-    """-> (world_model(WorldModel), actor(Actor), critic(DreamerMLP), params)
-
-    ``params`` = {"world_model": {...}, "actor": ..., "critic": ...,
-    "target_critic": ...}.
-    """
+    """-> (world_model, actor, critic(V2MLP), params) with
+    params = {world_model, actor, critic, target_critic} (reference
+    build_agent:836)."""
     world_model_cfg = cfg.algo.world_model
     actor_cfg = cfg.algo.actor
     critic_cfg = cfg.algo.critic
@@ -613,16 +522,18 @@ def build_agent(
 
     cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
     mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
-    cnn_stages = int(np.log2(cfg.env.screen_size) - np.log2(4))
+    use_continues = bool(world_model_cfg.use_continues)
+
+    cnn_act = world_model_cfg.encoder.get("cnn_act", "elu")
+    dense_act = world_model_cfg.encoder.get("dense_act", "elu")
+    enc_ln = bool(world_model_cfg.encoder.layer_norm)
 
     cnn_encoder = (
         CNNEncoder(
             keys=cnn_keys,
             channels_multiplier=world_model_cfg.encoder.cnn_channels_multiplier,
-            stages=cnn_stages,
-            layer_norm=_ln_enabled(world_model_cfg.encoder.cnn_layer_norm),
-            eps=_ln_eps(world_model_cfg.encoder.cnn_layer_norm),
-            act="silu",
+            layer_norm=enc_ln,
+            act=cnn_act,
         )
         if len(cnn_keys) > 0
         else None
@@ -632,19 +543,27 @@ def build_agent(
             keys=mlp_keys,
             mlp_layers=world_model_cfg.encoder.mlp_layers,
             dense_units=world_model_cfg.encoder.dense_units,
-            layer_norm=_ln_enabled(world_model_cfg.encoder.mlp_layer_norm),
-            eps=_ln_eps(world_model_cfg.encoder.mlp_layer_norm),
+            layer_norm=enc_ln,
+            act=dense_act,
         )
         if len(mlp_keys) > 0
         else None
     )
-    encoder = MultiEncoderDV3(cnn_encoder, mlp_encoder)
+    encoder = MultiEncoderV2(cnn_encoder, mlp_encoder)
 
-    cnn_encoder_output_dim = (
-        (2 ** (cnn_stages - 1)) * world_model_cfg.encoder.cnn_channels_multiplier * 4 * 4
-        if cnn_encoder is not None
-        else 0
-    )
+    if cnn_encoder is not None:
+        size = int(obs_space[cnn_keys[0]].shape[0])
+        if size != 64:
+            # the fixed 4-stage VALID encoder/decoder pair round-trips 64x64
+            # only (reference CNNEncoder:31 'assumes that the image is a 64x64')
+            raise ValueError(
+                f"DreamerV2's conv encoder/decoder require env.screen_size=64, got: {size}"
+            )
+        for _ in range(4):
+            size = (size - 4) // 2 + 1
+        cnn_encoder_output_dim = size * size * 8 * world_model_cfg.encoder.cnn_channels_multiplier
+    else:
+        cnn_encoder_output_dim = 0
     mlp_encoder_output_dim = world_model_cfg.encoder.dense_units if mlp_encoder is not None else 0
     embedded_obs_dim = cnn_encoder_output_dim + mlp_encoder_output_dim
 
@@ -655,12 +574,11 @@ def build_agent(
         dense_units=world_model_cfg.recurrent_model.dense_units,
         stochastic_size=world_model_cfg.stochastic_size,
         discrete_size=world_model_cfg.discrete_size,
-        hidden_size=world_model_cfg.transition_model.hidden_size,
-        unimix=cfg.algo.unimix,
-        layer_norm=_ln_enabled(world_model_cfg.recurrent_model.layer_norm),
-        eps=_ln_eps(world_model_cfg.recurrent_model.layer_norm),
-        learnable_initial_recurrent_state=world_model_cfg.learnable_initial_recurrent_state,
-        decoupled=bool(world_model_cfg.decoupled_rssm),
+        representation_hidden_size=world_model_cfg.representation_model.hidden_size,
+        transition_hidden_size=world_model_cfg.transition_model.hidden_size,
+        layer_norm=bool(world_model_cfg.representation_model.layer_norm),
+        recurrent_layer_norm=bool(world_model_cfg.recurrent_model.layer_norm),
+        act=dense_act,
     )
 
     cnn_decoder = (
@@ -669,10 +587,8 @@ def build_agent(
             output_channels=[int(obs_space[k].shape[-1]) for k in cfg.algo.cnn_keys.decoder],
             channels_multiplier=world_model_cfg.observation_model.cnn_channels_multiplier,
             cnn_encoder_output_dim=cnn_encoder_output_dim,
-            image_size=tuple(obs_space[cfg.algo.cnn_keys.decoder[0]].shape[:2]),
-            stages=cnn_stages,
-            layer_norm=_ln_enabled(world_model_cfg.observation_model.cnn_layer_norm),
-            eps=_ln_eps(world_model_cfg.observation_model.cnn_layer_norm),
+            layer_norm=bool(world_model_cfg.observation_model.layer_norm),
+            act=cnn_act,
         )
         if len(cfg.algo.cnn_keys.decoder) > 0
         else None
@@ -683,29 +599,31 @@ def build_agent(
             output_dims=[int(obs_space[k].shape[0]) for k in cfg.algo.mlp_keys.decoder],
             mlp_layers=world_model_cfg.observation_model.mlp_layers,
             dense_units=world_model_cfg.observation_model.dense_units,
-            layer_norm=_ln_enabled(world_model_cfg.observation_model.mlp_layer_norm),
-            eps=_ln_eps(world_model_cfg.observation_model.mlp_layer_norm),
+            layer_norm=bool(world_model_cfg.observation_model.layer_norm),
+            act=dense_act,
         )
         if len(cfg.algo.mlp_keys.decoder) > 0
         else None
     )
-    observation_model = MultiDecoderDV3(cnn_decoder, mlp_decoder)
+    observation_model = MultiDecoderV2(cnn_decoder, mlp_decoder)
 
-    reward_model = DreamerMLP(
+    reward_model = V2MLP(
         units=world_model_cfg.reward_model.dense_units,
         layers=world_model_cfg.reward_model.mlp_layers,
-        output_dim=world_model_cfg.reward_model.bins,
-        layer_norm=_ln_enabled(world_model_cfg.reward_model.layer_norm),
-        eps=_ln_eps(world_model_cfg.reward_model.layer_norm),
-        out_init=uniform_out_init(0.0),
-    )
-    continue_model = DreamerMLP(
-        units=world_model_cfg.discount_model.dense_units,
-        layers=world_model_cfg.discount_model.mlp_layers,
         output_dim=1,
-        layer_norm=_ln_enabled(world_model_cfg.discount_model.layer_norm),
-        eps=_ln_eps(world_model_cfg.discount_model.layer_norm),
-        out_init=uniform_out_init(1.0),
+        act=dense_act,
+        layer_norm=bool(world_model_cfg.reward_model.layer_norm),
+    )
+    continue_model = (
+        V2MLP(
+            units=world_model_cfg.discount_model.dense_units,
+            layers=world_model_cfg.discount_model.mlp_layers,
+            output_dim=1,
+            act=dense_act,
+            layer_norm=bool(world_model_cfg.discount_model.layer_norm),
+        )
+        if use_continues
+        else None
     )
     world_model = WorldModel(encoder, rssm, observation_model, reward_model, continue_model)
 
@@ -715,21 +633,17 @@ def build_agent(
         distribution=cfg.distribution.get("type", "auto"),
         init_std=actor_cfg.init_std,
         min_std=actor_cfg.min_std,
-        max_std=actor_cfg.get("max_std", 1.0),
         dense_units=actor_cfg.dense_units,
         mlp_layers=actor_cfg.mlp_layers,
-        layer_norm=_ln_enabled(actor_cfg.layer_norm),
-        eps=_ln_eps(actor_cfg.layer_norm),
-        unimix=cfg.algo.unimix,
-        action_clip=actor_cfg.action_clip,
+        layer_norm=bool(actor_cfg.layer_norm),
+        act=actor_cfg.get("dense_act", "elu"),
     )
-    critic = DreamerMLP(
+    critic = V2MLP(
         units=critic_cfg.dense_units,
         layers=critic_cfg.mlp_layers,
-        output_dim=critic_cfg.bins,
-        layer_norm=_ln_enabled(critic_cfg.layer_norm),
-        eps=_ln_eps(critic_cfg.layer_norm),
-        out_init=uniform_out_init(0.0),
+        output_dim=1,
+        act=critic_cfg.get("dense_act", "elu"),
+        layer_norm=bool(critic_cfg.layer_norm),
     )
 
     # ------------------------------------------------------------- init
@@ -754,15 +668,16 @@ def build_agent(
             dummy_embed,
             jnp.zeros((B, 1)),
             k(),
-            method=RSSM.init_all,
+            method=RSSM.dynamic,
         )
         wm_params = {
             "encoder": encoder.init(k(), dummy_obs),
             "rssm": rssm_params,
             "observation_model": observation_model.init(k(), dummy_latent),
             "reward_model": reward_model.init(k(), dummy_latent),
-            "continue_model": continue_model.init(k(), dummy_latent),
         }
+        if continue_model is not None:
+            wm_params["continue_model"] = continue_model.init(k(), dummy_latent)
     actor_params = (
         jax.tree_util.tree_map(jnp.asarray, actor_state)
         if actor_state is not None

@@ -1,0 +1,63 @@
+"""DreamerV2 world-model loss (reference sheeprl/algos/dreamer_v2/loss.py:9):
+ELBO with KL balancing (alpha * KL(sg(post) || prior) +
+(1 - alpha) * KL(post || sg(prior))), free-nats clamping (averaged or
+element-wise per ``kl_free_avg``), Normal(.., 1) obs/reward heads and an
+optional Bernoulli continue head."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from sheeprl_tpu.utils.distribution import (
+    Distribution,
+    Independent,
+    OneHotCategoricalStraightThrough,
+    kl_divergence,
+)
+
+sg = jax.lax.stop_gradient
+
+
+def reconstruction_loss(
+    po: Dict[str, Distribution],
+    observations: Dict[str, jax.Array],
+    pr: Distribution,
+    rewards: jax.Array,
+    priors_logits: jax.Array,
+    posteriors_logits: jax.Array,
+    kl_balancing_alpha: float = 0.8,
+    kl_free_nats: float = 0.0,
+    kl_free_avg: bool = True,
+    kl_regularizer: float = 1.0,
+    pc: Optional[Distribution] = None,
+    continue_targets: Optional[jax.Array] = None,
+    discount_scale_factor: float = 1.0,
+) -> Tuple[jax.Array, ...]:
+    """-> (reconstruction_loss, kl, kl_loss, reward_loss, observation_loss,
+    continue_loss)."""
+    observation_loss = -sum(po[k].log_prob(observations[k]).mean() for k in po.keys())
+    reward_loss = -pr.log_prob(rewards).mean()
+    lhs = kl = kl_divergence(
+        Independent(OneHotCategoricalStraightThrough(logits=sg(posteriors_logits)), 1),
+        Independent(OneHotCategoricalStraightThrough(logits=priors_logits), 1),
+    )
+    rhs = kl_divergence(
+        Independent(OneHotCategoricalStraightThrough(logits=posteriors_logits), 1),
+        Independent(OneHotCategoricalStraightThrough(logits=sg(priors_logits)), 1),
+    )
+    if kl_free_avg:
+        loss_lhs = jnp.maximum(lhs.mean(), kl_free_nats)
+        loss_rhs = jnp.maximum(rhs.mean(), kl_free_nats)
+    else:
+        loss_lhs = jnp.maximum(lhs, kl_free_nats).mean()
+        loss_rhs = jnp.maximum(rhs, kl_free_nats).mean()
+    kl_loss = kl_balancing_alpha * loss_lhs + (1 - kl_balancing_alpha) * loss_rhs
+    if pc is not None and continue_targets is not None:
+        continue_loss = discount_scale_factor * -pc.log_prob(continue_targets).mean()
+    else:
+        continue_loss = jnp.zeros_like(reward_loss)
+    rec_loss = kl_regularizer * kl_loss + observation_loss + reward_loss + continue_loss
+    return rec_loss, kl, kl_loss, reward_loss, observation_loss, continue_loss

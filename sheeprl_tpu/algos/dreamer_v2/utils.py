@@ -1,0 +1,96 @@
+"""DreamerV2 helpers (reference sheeprl/algos/dreamer_v2/utils.py):
+compute_lambda_values:86, prepare_obs:109, test, AGGREGATOR_KEYS:24."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.utils.env import make_env
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/world_model_loss",
+    "Loss/value_loss",
+    "Loss/policy_loss",
+    "Loss/observation_loss",
+    "Loss/reward_loss",
+    "Loss/state_loss",
+    "Loss/continue_loss",
+    "State/post_entropy",
+    "State/prior_entropy",
+    "State/kl",
+    "Grads/world_model",
+    "Grads/actor",
+    "Grads/critic",
+}
+MODELS_TO_REGISTER = {"world_model", "actor", "critic", "target_critic"}
+
+
+def compute_lambda_values(
+    rewards: jax.Array,
+    values: jax.Array,
+    continues: jax.Array,
+    bootstrap: jax.Array,
+    lmbda: float = 0.95,
+) -> jax.Array:
+    """TD(lambda) targets, Hafner-V2 form (reference compute_lambda_values:86):
+    inputs = r + c * V_next * (1 - lambda), backward recursion
+    agg = inputs_t + c_t * lambda * agg. All shapes (H, N, 1); ``bootstrap``
+    is (1, N, 1)."""
+    next_values = jnp.concatenate([values[1:], bootstrap], 0)
+    inputs = rewards + continues * next_values * (1 - lmbda)
+
+    def step(agg, inp):
+        inp_t, cont_t = inp
+        agg = inp_t + cont_t * lmbda * agg
+        return agg, agg
+
+    _, lv = jax.lax.scan(step, bootstrap[0], (inputs, continues), reverse=True)
+    return lv
+
+
+def prepare_obs(
+    obs: Dict[str, np.ndarray], *, cnn_keys: Sequence[str] = (), num_envs: int = 1, **kwargs: Any
+) -> Dict[str, jnp.ndarray]:
+    """(1, num_envs, ...) float obs dict; images NHWC normalized to
+    [-0.5, 0.5]."""
+    out = {}
+    for k, v in obs.items():
+        arr = jnp.asarray(v, dtype=jnp.float32)
+        if k in cnn_keys:
+            arr = arr.reshape(1, num_envs, *arr.shape[-3:]) / 255.0 - 0.5
+        else:
+            arr = arr.reshape(1, num_envs, -1)
+        out[k] = arr
+    return out
+
+
+def test(player, runtime, cfg: Dict[str, Any], log_dir: str, test_name: str = "", greedy: bool = True) -> float:
+    env = make_env(cfg, cfg.seed, 0, log_dir, "test" + (f"_{test_name}" if test_name else ""))()
+    done = False
+    cumulative_rew = 0.0
+    obs = env.reset(seed=cfg.seed)[0]
+    old_num_envs = player.num_envs
+    player.num_envs = 1
+    player.init_states()
+    while not done:
+        prepared = prepare_obs(obs, cnn_keys=cfg.algo.cnn_keys.encoder, num_envs=1)
+        mask = {k: v for k, v in prepared.items() if k.startswith("mask")} or None
+        real_actions = player.get_actions(prepared, runtime.next_key(), greedy, mask)
+        if player.actor_module.is_continuous:
+            acts = np.stack([np.asarray(a) for a in real_actions], -1)
+        else:
+            acts = np.stack([np.asarray(a).argmax(-1) for a in real_actions], -1)
+        obs, reward, terminated, truncated, _ = env.step(acts.reshape(env.action_space.shape))
+        done = bool(terminated or truncated or cfg.dry_run)
+        cumulative_rew += float(reward)
+    runtime.print("Test - Reward:", cumulative_rew)
+    env.close()
+    player.num_envs = old_num_envs
+    player.init_states()
+    return cumulative_rew

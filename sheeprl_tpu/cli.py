@@ -86,6 +86,16 @@ def _build_runtime(cfg: dotdict):
     from sheeprl_tpu.config import instantiate
 
     fabric_cfg = dict(cfg.fabric)
+    if fabric_cfg.get("accelerator") == "cpu":
+        # force the host platform even when the machine env pins
+        # JAX_PLATFORMS to an accelerator (works while no backend is
+        # initialized yet, same trick as tests/conftest.py)
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
     runtime = instantiate(fabric_cfg)
     runtime.launch()
     return runtime

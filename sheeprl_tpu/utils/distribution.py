@@ -184,6 +184,16 @@ class TruncatedNormal(Distribution):
         pdf = lambda t: jnp.exp(-(t**2) / 2) / math.sqrt(2 * math.pi)  # noqa: E731
         return self.loc + self.scale * (pdf(self._a) - pdf(self._b)) / self._z
 
+    def entropy(self) -> jax.Array:
+        # H = log(sqrt(2*pi*e) * scale * Z) + (a*pdf(a) - b*pdf(b)) / (2Z)
+        pdf = lambda t: jnp.exp(-(t**2) / 2) / math.sqrt(2 * math.pi)  # noqa: E731
+        return (
+            0.5 * math.log(2 * math.pi * math.e)
+            + jnp.log(self.scale)
+            + jnp.log(self._z)
+            + (self._a * pdf(self._a) - self._b * pdf(self._b)) / (2 * self._z)
+        )
+
 
 class Categorical(Distribution):
     def __init__(self, logits: Optional[jax.Array] = None, probs: Optional[jax.Array] = None):
