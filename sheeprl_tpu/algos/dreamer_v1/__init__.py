@@ -1,0 +1,1 @@
+from sheeprl_tpu.algos.dreamer_v1 import dreamer_v1, evaluate  # noqa: F401  (registry side-effect)

@@ -1,0 +1,58 @@
+"""DreamerV1 evaluation entrypoint (reference
+sheeprl/algos/dreamer_v1/evaluate.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import gymnasium as gym
+
+from sheeprl_tpu.algos.dreamer_v1.agent import PlayerDV1, build_agent
+from sheeprl_tpu.algos.dreamer_v1.utils import test
+from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.registry import register_evaluation
+
+
+@register_evaluation(algorithms="dreamer_v1")
+def evaluate_dreamer_v1(runtime, cfg: Dict[str, Any], state: Dict[str, Any]):
+    logger = get_logger(runtime, cfg)
+    log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name)
+    runtime.print(f"Log dir: {log_dir}")
+    runtime.seed_everything(cfg.seed)
+
+    env = make_env(cfg, cfg.seed, 0, log_dir, "test", vector_env_idx=0)()
+    observation_space = env.observation_space
+    action_space = env.action_space
+    is_continuous = isinstance(action_space, gym.spaces.Box)
+    is_multidiscrete = isinstance(action_space, gym.spaces.MultiDiscrete)
+    actions_dim = tuple(
+        action_space.shape
+        if is_continuous
+        else (action_space.nvec.tolist() if is_multidiscrete else [action_space.n])
+    )
+    env.close()
+
+    world_model, actor, critic, params = build_agent(
+        runtime,
+        actions_dim,
+        is_continuous,
+        cfg,
+        observation_space,
+        state["world_model"],
+        state["actor"],
+        state["critic"],
+    )
+    player = PlayerDV1(
+        world_model,
+        actor,
+        {"world_model": params["world_model"], "actor": params["actor"]},
+        actions_dim,
+        1,
+        cfg.algo.world_model.stochastic_size,
+        cfg.algo.world_model.recurrent_model.recurrent_state_size,
+    )
+    rew = test(player, runtime, cfg, log_dir)
+    if logger:
+        logger.log_metrics({"Test/cumulative_reward": rew}, 0)
+        logger.finalize()

@@ -1,0 +1,52 @@
+"""DreamerV1 losses (reference sheeprl/algos/dreamer_v1/loss.py):
+actor_loss:27 (-mean lambda), critic_loss:9, reconstruction_loss:41 (ELBO
+with plain Gaussian KL + free nats; no balancing).
+
+Note: the reference's continue term is ``+ log_prob`` (loss.py:95), which
+ascends the continue model's likelihood when minimized; the correct
+``- log_prob`` is used here (use_continues defaults to False so the default
+path is identical)."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from sheeprl_tpu.utils.distribution import Distribution, kl_divergence
+
+
+def actor_loss(discounted_lambda_values: jax.Array) -> jax.Array:
+    return -jnp.mean(discounted_lambda_values)
+
+
+def critic_loss(qv: Distribution, lambda_values: jax.Array, discount: jax.Array) -> jax.Array:
+    return -jnp.mean(discount * qv.log_prob(lambda_values))
+
+
+def reconstruction_loss(
+    qo: Dict[str, Distribution],
+    observations: Dict[str, jax.Array],
+    qr: Distribution,
+    rewards: jax.Array,
+    posteriors_dist: Distribution,
+    priors_dist: Distribution,
+    kl_free_nats: float = 3.0,
+    kl_regularizer: float = 1.0,
+    qc: Optional[Distribution] = None,
+    continue_targets: Optional[jax.Array] = None,
+    continue_scale_factor: float = 10.0,
+) -> Tuple[jax.Array, ...]:
+    """-> (reconstruction_loss, kl, state_loss, reward_loss,
+    observation_loss, continue_loss)."""
+    observation_loss = -sum(qo[k].log_prob(observations[k]).mean() for k in qo.keys())
+    reward_loss = -qr.log_prob(rewards).mean()
+    kl = kl_divergence(posteriors_dist, priors_dist).mean()
+    state_loss = jnp.maximum(kl, kl_free_nats)
+    if qc is not None and continue_targets is not None:
+        continue_loss = continue_scale_factor * -qc.log_prob(continue_targets).mean()
+    else:
+        continue_loss = jnp.zeros_like(reward_loss)
+    rec_loss = kl_regularizer * state_loss + observation_loss + reward_loss + continue_loss
+    return rec_loss, kl, state_loss, reward_loss, observation_loss, continue_loss

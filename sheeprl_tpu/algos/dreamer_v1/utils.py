@@ -1,0 +1,62 @@
+"""DreamerV1 helpers (reference sheeprl/algos/dreamer_v1/utils.py):
+compute_lambda_values:42, compute_stochastic_state:80, AGGREGATOR_KEYS."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.dreamer_v1.agent import compute_stochastic_state  # noqa: F401
+from sheeprl_tpu.algos.dreamer_v2.utils import prepare_obs, test  # noqa: F401  (shared V1/V2 pipeline)
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/world_model_loss",
+    "Loss/value_loss",
+    "Loss/policy_loss",
+    "Loss/observation_loss",
+    "Loss/reward_loss",
+    "Loss/state_loss",
+    "Loss/continue_loss",
+    "State/post_entropy",
+    "State/prior_entropy",
+    "State/kl",
+    "Params/exploration_amount",
+    "Grads/world_model",
+    "Grads/actor",
+    "Grads/critic",
+}
+MODELS_TO_REGISTER = {"world_model", "actor", "critic"}
+
+
+def compute_lambda_values(
+    rewards: jax.Array,
+    values: jax.Array,
+    continues: jax.Array,
+    last_values: jax.Array,
+    horizon: int = 15,
+    lmbda: float = 0.95,
+) -> jax.Array:
+    """V1 lambda-return recursion (reference compute_lambda_values:42):
+    produces ``horizon - 1`` rows; the accumulator starts at ZERO and the
+    last step bootstraps with the (full) last value while earlier steps use
+    ``V_{t+1} * (1 - lambda)``. Inputs are (H, N, 1); ``last_values``
+    (N, 1)."""
+    next_values = jnp.concatenate(
+        [values[1 : horizon - 1] * (1 - lmbda), last_values[None]], 0
+    )  # (H-1, N, 1)
+    deltas = rewards[: horizon - 1] + next_values * continues[: horizon - 1]
+
+    def step(agg, inp):
+        delta_t, cont_t = inp
+        agg = delta_t + lmbda * cont_t * agg
+        return agg, agg
+
+    _, lv = jax.lax.scan(
+        step, jnp.zeros_like(last_values), (deltas, continues[: horizon - 1]), reverse=True
+    )
+    return lv

@@ -367,13 +367,17 @@ def add_exploration_noise(
 ) -> Sequence[jax.Array]:
     """Epsilon-style exploration noise (reference Actor.add_exploration_noise:
     clipped Normal jitter for continuous, uniform one-hot resample with
-    probability ``expl_amount`` for discrete)."""
-    if expl_amount <= 0.0:
+    probability ``expl_amount`` for discrete). ``expl_amount`` may be a
+    traced scalar (decay schedules); amount 0 is then a no-op rather than a
+    short-circuit."""
+    if isinstance(expl_amount, (int, float)) and expl_amount <= 0.0:
         return tuple(actions)
     if is_continuous:
         flat = jnp.concatenate(list(actions), -1)
         noisy = jnp.clip(flat + expl_amount * jax.random.normal(key, flat.shape), -1.0, 1.0)
-        return (noisy,)
+        # the clip belongs to the noise: with amount 0 (traced) return the
+        # raw action so unbounded heads are not silently truncated
+        return (jnp.where(jnp.asarray(expl_amount) > 0, noisy, flat),)
     out = []
     keys = jax.random.split(key, 2 * len(actions))
     for i, act in enumerate(actions):

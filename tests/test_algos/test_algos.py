@@ -336,3 +336,45 @@ def test_dreamer_v2_episode_buffer(standard_args, tmp_path):
         f"root_dir={tmp_path}/dv2e",
     ]
     _run(args)
+
+
+def _dv1_tiny_args():
+    return [
+        "algo.per_rank_batch_size=2",
+        "algo.per_rank_sequence_length=2",
+        "algo.horizon=4",
+        "algo.dense_units=8",
+        "algo.mlp_layers=1",
+        "algo.world_model.encoder.cnn_channels_multiplier=1",
+        "algo.world_model.recurrent_model.recurrent_state_size=8",
+        "algo.world_model.representation_model.hidden_size=8",
+        "algo.world_model.transition_model.hidden_size=8",
+        "algo.world_model.stochastic_size=4",
+    ]
+
+
+def test_dreamer_v1(standard_args, devices, tmp_path):
+    args = standard_args + _dv1_tiny_args() + [
+        "exp=dreamer_v1",
+        "env=dummy",
+        "algo.mlp_keys.encoder=[state]",
+        "algo.cnn_keys.encoder=[rgb]",
+        "env.screen_size=64",
+        f"fabric.devices={devices}",
+        f"root_dir={tmp_path}/dv1",
+    ]
+    _run(args)
+
+
+def test_dreamer_v1_continuous(standard_args, tmp_path):
+    args = standard_args + _dv1_tiny_args() + [
+        "exp=dreamer_v1",
+        "env=dummy",
+        "env.id=dummy_continuous",
+        "algo.mlp_keys.encoder=[state]",
+        "algo.cnn_keys.encoder=[]",
+        "algo.world_model.use_continues=True",
+        "fabric.devices=1",
+        f"root_dir={tmp_path}/dv1c",
+    ]
+    _run(args)
