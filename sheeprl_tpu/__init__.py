@@ -11,6 +11,16 @@ os.environ.setdefault("TPU_STDERR_LOG_LEVEL", "3")
 
 from sheeprl_tpu.utils.registry import algorithm_registry, evaluation_registry  # noqa: E402
 
-from sheeprl_tpu.algos import a2c, dreamer_v1, dreamer_v2, dreamer_v3, droq, ppo, ppo_recurrent, sac  # noqa: E402, F401
+from sheeprl_tpu.algos import (  # noqa: E402, F401
+    a2c,
+    dreamer_v1,
+    dreamer_v2,
+    dreamer_v3,
+    droq,
+    ppo,
+    ppo_recurrent,
+    sac,
+    sac_ae,
+)
 
 __version__ = "0.1.0"

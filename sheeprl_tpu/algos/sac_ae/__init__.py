@@ -1,0 +1,1 @@
+from sheeprl_tpu.algos.sac_ae import evaluate, sac_ae  # noqa: F401  (registry side-effect)

@@ -1,0 +1,40 @@
+"""SAC-AE evaluation entrypoint (reference
+sheeprl/algos/sac_ae/evaluate.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import gymnasium as gym
+
+from sheeprl_tpu.algos.sac_ae.agent import SACAEPlayer, build_agent
+from sheeprl_tpu.algos.sac_ae.utils import prepare_obs, test
+from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.registry import register_evaluation
+
+
+@register_evaluation(algorithms="sac_ae")
+def evaluate_sac_ae(runtime, cfg: Dict[str, Any], state: Dict[str, Any]):
+    logger = get_logger(runtime, cfg)
+    log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name)
+    runtime.print(f"Log dir: {log_dir}")
+    runtime.seed_everything(cfg.seed)
+
+    env = make_env(cfg, cfg.seed, 0, log_dir, "test", vector_env_idx=0)()
+    observation_space = env.observation_space
+    action_space = env.action_space
+    if not isinstance(action_space, gym.spaces.Box):
+        raise ValueError("Only continuous action space is supported for the SAC-AE agent")
+    env.close()
+
+    modules, params, _ = build_agent(runtime, cfg, observation_space, action_space, state["agent"])
+    player = SACAEPlayer(
+        modules,
+        {"encoder": params["critic"]["encoder"], "actor": params["actor"]},
+        lambda obs: prepare_obs(obs, cnn_keys=cfg.algo.cnn_keys.encoder, num_envs=1),
+    )
+    rew = test(player, runtime, cfg, log_dir)
+    if logger:
+        logger.log_metrics({"Test/cumulative_reward": rew}, 0)
+        logger.finalize()

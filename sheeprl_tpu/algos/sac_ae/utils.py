@@ -1,0 +1,72 @@
+"""SAC-AE helpers (reference sheeprl/algos/sac_ae/utils.py):
+preprocess_obs:68, AGGREGATOR_KEYS, prepare_obs, test."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.utils.env import make_env
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/value_loss",
+    "Loss/policy_loss",
+    "Loss/alpha_loss",
+    "Loss/reconstruction_loss",
+}
+MODELS_TO_REGISTER = {"agent", "encoder", "decoder"}
+
+
+def preprocess_obs(obs: jax.Array, key: jax.Array, bits: int = 8) -> jax.Array:
+    """Quantize [0, 255] images to ``bits`` bits with uniform dequantization
+    noise, centered (reference preprocess_obs:68, arXiv:1807.03039)."""
+    bins = 2**bits
+    if bits < 8:
+        obs = jnp.floor(obs / 2 ** (8 - bits))
+    obs = obs / bins
+    obs = obs + jax.random.uniform(key, obs.shape) / bins
+    return obs - 0.5
+
+
+def prepare_obs(
+    obs: Dict[str, np.ndarray], *, cnn_keys: Sequence[str] = (), num_envs: int = 1, **kwargs: Any
+) -> Dict[str, jnp.ndarray]:
+    """(num_envs, ...) float obs dict; images NHWC normalized to [0, 1]."""
+    out = {}
+    for k, v in obs.items():
+        arr = jnp.asarray(v, dtype=jnp.float32)
+        if k in cnn_keys:
+            arr = arr.reshape(num_envs, *arr.shape[-3:]) / 255.0
+        else:
+            arr = arr.reshape(num_envs, -1)
+        out[k] = arr
+    return out
+
+
+def test(player, runtime, cfg: Dict[str, Any], log_dir: str) -> float:
+    from sheeprl_tpu.algos.sac_ae.agent import SACAEPlayer
+
+    player = SACAEPlayer(
+        player.modules,
+        player.params,
+        lambda obs: prepare_obs(obs, cnn_keys=cfg.algo.cnn_keys.encoder, num_envs=1),
+    )
+    env = make_env(cfg, cfg.seed, 0, log_dir, "test")()
+    done = False
+    cumulative_rew = 0.0
+    obs = env.reset(seed=cfg.seed)[0]
+    while not done:
+        actions = player.get_actions(obs, greedy=True)
+        obs, reward, terminated, truncated, _ = env.step(
+            np.asarray(actions).reshape(env.action_space.shape)
+        )
+        done = bool(terminated or truncated or cfg.dry_run)
+        cumulative_rew += float(reward)
+    runtime.print("Test - Reward:", cumulative_rew)
+    env.close()
+    return cumulative_rew

@@ -378,3 +378,41 @@ def test_dreamer_v1_continuous(standard_args, tmp_path):
         f"root_dir={tmp_path}/dv1c",
     ]
     _run(args)
+
+
+def test_sac_ae(standard_args, devices, tmp_path):
+    args = standard_args + [
+        "exp=sac_ae",
+        "env=dummy",
+        "env.id=dummy_continuous",
+        "env.screen_size=64",
+        "algo.mlp_keys.encoder=[state]",
+        "algo.cnn_keys.encoder=[rgb]",
+        "algo.per_rank_batch_size=2",
+        "algo.hidden_size=8",
+        "algo.dense_units=8",
+        "algo.encoder.features_dim=8",
+        "algo.cnn_channels_multiplier=1",
+        "algo.mlp_layers=1",
+        f"fabric.devices={devices}",
+        f"root_dir={tmp_path}/sacae",
+    ]
+    _run(args)
+
+
+def test_sac_ae_mlp_only(standard_args, tmp_path):
+    args = standard_args + [
+        "exp=sac_ae",
+        "env=dummy",
+        "env.id=dummy_continuous",
+        "algo.mlp_keys.encoder=[state]",
+        "algo.cnn_keys.encoder=[]",
+        "algo.per_rank_batch_size=2",
+        "algo.hidden_size=8",
+        "algo.dense_units=8",
+        "algo.cnn_channels_multiplier=1",
+        "algo.mlp_layers=1",
+        "fabric.devices=1",
+        f"root_dir={tmp_path}/sacaem",
+    ]
+    _run(args)
