@@ -1,0 +1,5 @@
+from sheeprl_tpu.algos.p2e_dv3 import (  # noqa: F401  (registry side-effect)
+    evaluate,
+    p2e_dv3_exploration,
+    p2e_dv3_finetuning,
+)

@@ -1,0 +1,49 @@
+"""P2E-DV3 helpers (reference sheeprl/algos/p2e_dv3/utils.py)."""
+
+from __future__ import annotations
+
+from sheeprl_tpu.algos.dreamer_v3.utils import AGGREGATOR_KEYS as AGGREGATOR_KEYS_DV3
+from sheeprl_tpu.algos.dreamer_v3.utils import prepare_obs, test  # noqa: F401
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/world_model_loss",
+    "Loss/policy_loss_task",
+    "Loss/value_loss_task",
+    "Loss/policy_loss_exploration",
+    "Loss/observation_loss",
+    "Loss/reward_loss",
+    "Loss/state_loss",
+    "Loss/continue_loss",
+    "Loss/ensemble_loss",
+    "State/kl",
+    "State/post_entropy",
+    "State/prior_entropy",
+    "Grads/world_model",
+    "Grads/actor_task",
+    "Grads/critic_task",
+    "Grads/actor_exploration",
+    "Grads/ensemble",
+    # generic per-exploration-critic keys, expanded to <key>_<critic_name>
+    "Loss/value_loss_exploration",
+    "Values_exploration/predicted_values",
+    "Values_exploration/lambda_values",
+    "Grads/critic_exploration",
+    "Rewards/intrinsic",
+}.union(AGGREGATOR_KEYS_DV3)
+MODELS_TO_REGISTER = {
+    "world_model",
+    "ensembles",
+    "actor_exploration",
+    "critic_exploration_intrinsic",
+    "target_critic_exploration_intrinsic",
+    "moments_exploration_intrinsic",
+    "critic_exploration_extrinsic",
+    "target_critic_exploration_extrinsic",
+    "moments_exploration_extrinsic",
+    "actor_task",
+    "critic_task",
+    "target_critic_task",
+    "moments_task",
+}

@@ -20,7 +20,9 @@ def _register_algorithm(fn: Callable, decoupled: bool = False) -> Callable:
     entrypoint = fn.__name__
     module = fn.__module__
     root_module = module.rsplit(".", 1)[0]
-    algo_name = module.rsplit(".", 2)[-2] if module.count(".") >= 2 else module
+    # the algo name is the module FILE name (not the package): p2e-style
+    # packages register several algos (p2e_dv3_exploration / _finetuning)
+    algo_name = module.rsplit(".", 1)[-1]
     registered = algorithm_registry.setdefault(root_module, [])
     if any(r["name"] == algo_name for r in registered):
         # a module can expose several entrypoints (e.g. decoupled player/trainer
