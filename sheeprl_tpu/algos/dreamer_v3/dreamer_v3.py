@@ -319,7 +319,9 @@ def make_train_fn(runtime, world_model, actor, critic, txs, cfg, is_continuous, 
             try:
                 entropy = ent_coef * jnp.stack([p.entropy() for p in policies], -1).sum(-1)
             except NotImplementedError:
-                entropy = jnp.zeros_like(objective)
+                # must span the full trajectory (H+1 rows): the loss slices
+                # [:-1], while `objective` is already one row shorter
+                entropy = jnp.zeros(imagined_trajectories.shape[:2])
             policy_loss = -jnp.mean(sg(discount[:-1]) * (objective + entropy[..., None][:-1]))
             aux = {
                 "imagined_trajectories": sg(imagined_trajectories),

@@ -1,0 +1,5 @@
+from sheeprl_tpu.algos.p2e_dv1 import (  # noqa: F401  (registry side-effect)
+    evaluate,
+    p2e_dv1_exploration,
+    p2e_dv1_finetuning,
+)

@@ -138,7 +138,9 @@ def make_train_fn(
         try:
             entropy = ent_coef * jnp.stack([p.entropy() for p in policies], -1).sum(-1)
         except NotImplementedError:
-            entropy = jnp.zeros_like(objective[..., 0])
+            # must span the full trajectory (H+1 rows): the caller slices
+            # [:-1], while `objective` is already one row shorter
+            entropy = jnp.zeros(traj.shape[:2])
         return objective, entropy
 
     def _critic_update(critic_params, target_params, tx, opt_state, traj, lambda_vals, discount):
@@ -285,7 +287,8 @@ def make_train_fn(
                 if ccfg["reward_type"] == "intrinsic":
                     ens_traj_in = jnp.concatenate([sg(traj), sg(imagined_actions)], -1)
                     preds = jax.vmap(lambda p: ensemble.apply(p, ens_traj_in))(new_ens_params)
-                    reward = preds.var(0).mean(-1, keepdims=True) * intrinsic_reward_multiplier
+                    # torch's Tensor.var is unbiased (ddof=1), reference :285
+                    reward = preds.var(0, ddof=1).mean(-1, keepdims=True) * intrinsic_reward_multiplier
                 else:
                     reward = TwoHotEncodingDistribution(
                         world_model.reward_model.apply(new_wm_params["reward_model"], traj), dims=1

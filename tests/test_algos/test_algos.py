@@ -416,3 +416,74 @@ def test_sac_ae_mlp_only(standard_args, tmp_path):
         f"root_dir={tmp_path}/sacaem",
     ]
     _run(args)
+
+
+def test_p2e_dv1(standard_args, tmp_path):
+    """Exploration -> finetuning chain (reference test_algos.py:262-299)."""
+    import glob
+
+    root = f"{tmp_path}/p2edv1"
+    args = standard_args + _dv1_tiny_args() + [
+        "exp=p2e_dv1_exploration",
+        "env=dummy",
+        "algo.mlp_keys.encoder=[state]",
+        "algo.cnn_keys.encoder=[]",
+        "algo.ensembles.n=2",
+        "algo.ensembles.dense_units=8",
+        "algo.ensembles.mlp_layers=1",
+        "fabric.devices=1",
+        f"root_dir={root}",
+        f"metric.logger.root_dir={tmp_path}/logs_p2edv1",
+    ]
+    _run(args)
+    ckpts = sorted(glob.glob(f"{root}/**/ckpt_*.ckpt", recursive=True))
+    assert len(ckpts) > 0
+    ft_args = standard_args + _dv1_tiny_args() + [
+        "exp=p2e_dv1_finetuning",
+        "env=dummy",
+        "algo.mlp_keys.encoder=[state]",
+        "algo.cnn_keys.encoder=[]",
+        "algo.ensembles.n=2",
+        "algo.ensembles.dense_units=8",
+        "algo.ensembles.mlp_layers=1",
+        f"checkpoint.exploration_ckpt_path={ckpts[-1]}",
+        "fabric.devices=1",
+        f"root_dir={root}_ft",
+        f"metric.logger.root_dir={tmp_path}/logs_p2edv1_ft",
+    ]
+    _run(ft_args)
+
+
+def test_p2e_dv3(standard_args, tmp_path):
+    """Exploration -> finetuning chain on the DV3 skeleton."""
+    import glob
+
+    args = standard_args + _dv3_tiny_args() + [
+        "exp=p2e_dv3_exploration",
+        "env=dummy",
+        "algo.mlp_keys.encoder=[state]",
+        "algo.cnn_keys.encoder=[]",
+        "algo.ensembles.n=2",
+        "algo.ensembles.dense_units=8",
+        "algo.ensembles.mlp_layers=1",
+        "fabric.devices=1",
+        f"root_dir={tmp_path}/p2edv3",
+        f"metric.logger.root_dir={tmp_path}/logs_p2edv3",
+    ]
+    _run(args)
+    ckpts = sorted(glob.glob(f"{tmp_path}/p2edv3/**/ckpt_*.ckpt", recursive=True))
+    assert len(ckpts) > 0
+    ft_args = standard_args + _dv3_tiny_args() + [
+        "exp=p2e_dv3_finetuning",
+        "env=dummy",
+        "algo.mlp_keys.encoder=[state]",
+        "algo.cnn_keys.encoder=[]",
+        "algo.ensembles.n=2",
+        "algo.ensembles.dense_units=8",
+        "algo.ensembles.mlp_layers=1",
+        f"checkpoint.exploration_ckpt_path={ckpts[-1]}",
+        "fabric.devices=1",
+        f"root_dir={tmp_path}/p2edv3_ft",
+        f"metric.logger.root_dir={tmp_path}/logs_p2edv3_ft",
+    ]
+    _run(ft_args)
