@@ -18,6 +18,7 @@ from sheeprl_tpu.algos import (  # noqa: E402, F401
     dreamer_v3,
     droq,
     p2e_dv1,
+    p2e_dv2,
     p2e_dv3,
     ppo,
     ppo_recurrent,

@@ -1,0 +1,5 @@
+from sheeprl_tpu.algos.p2e_dv2 import (  # noqa: F401  (registry side-effect)
+    evaluate,
+    p2e_dv2_exploration,
+    p2e_dv2_finetuning,
+)

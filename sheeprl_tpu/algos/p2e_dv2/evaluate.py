@@ -1,0 +1,55 @@
+"""P2E-DV2 evaluation entrypoint (reference
+sheeprl/algos/p2e_dv2/evaluate.py): evaluates the TASK policy."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import gymnasium as gym
+
+from sheeprl_tpu.algos.dreamer_v2.utils import test
+from sheeprl_tpu.algos.p2e_dv2.agent import build_agent, make_player
+from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.registry import register_evaluation
+
+
+@register_evaluation(algorithms=["p2e_dv2_exploration", "p2e_dv2_finetuning"])
+def evaluate_p2e_dv2(runtime, cfg: Dict[str, Any], state: Dict[str, Any]):
+    logger = get_logger(runtime, cfg)
+    log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name)
+    runtime.print(f"Log dir: {log_dir}")
+    runtime.seed_everything(cfg.seed)
+
+    env = make_env(cfg, cfg.seed, 0, log_dir, "test", vector_env_idx=0)()
+    observation_space = env.observation_space
+    action_space = env.action_space
+    is_continuous = isinstance(action_space, gym.spaces.Box)
+    is_multidiscrete = isinstance(action_space, gym.spaces.MultiDiscrete)
+    actions_dim = tuple(
+        action_space.shape
+        if is_continuous
+        else (action_space.nvec.tolist() if is_multidiscrete else [action_space.n])
+    )
+    env.close()
+
+    world_model, actor, critic, ensemble, params = build_agent(
+        runtime,
+        actions_dim,
+        is_continuous,
+        cfg,
+        observation_space,
+        state["world_model"],
+        state.get("ensembles"),
+        state["actor_task"],
+        state["critic_task"],
+        state.get("target_critic_task"),
+        state["actor_exploration"],
+        state.get("critic_exploration"),
+        state.get("target_critic_exploration"),
+    )
+    player = make_player(runtime, world_model, actor, params, actions_dim, 1, cfg, "task")
+    rew = test(player, runtime, cfg, log_dir)
+    if logger:
+        logger.log_metrics({"Test/cumulative_reward": rew}, 0)
+        logger.finalize()

@@ -1,0 +1,753 @@
+"""P2E-DV2 exploration phase (reference
+sheeprl/algos/p2e_dv2/p2e_dv2_exploration.py train:37, main:481).
+
+One jitted gradient step composed of:
+1. world-model update (DV2 KL-balanced loss; reward/continue heads read
+   DETACHED latents — p2e_dv2_exploration.py:155-160);
+2. disagreement-ensemble update: each member regresses the next FLATTENED
+   STOCHASTIC STATE from (z_t, h_t, a_t) under a unit-variance Gaussian
+   likelihood (p2e_dv2_exploration.py:196-220);
+3. exploration behavior: DV2 imagination (start state included, zero
+   action at index 0) with the exploration actor; intrinsic reward =
+   ensemble variance over the predicted stochastic states
+   (p2e_dv2_exploration.py:251-263); lambda-returns off the TARGET critic,
+   dynamics-backprop (continuous) or reinforce (discrete) actor loss and
+   Normal(.,1) critic regression;
+4. zero-shot task behavior: the same imagination driven by the task actor
+   with the reward-model rewards (p2e_dv2_exploration.py:334-430).
+
+Target critics (task + exploration) are hard-refreshed every
+``per_rank_target_network_update_freq`` gradient steps by the host loop
+(reference p2e_dv2_exploration.py:817-837)."""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from sheeprl_tpu.algos.dreamer_v2.agent import RSSM
+from sheeprl_tpu.algos.dreamer_v2.dreamer_v2 import _make_optimizer
+from sheeprl_tpu.algos.dreamer_v2.loss import reconstruction_loss
+from sheeprl_tpu.algos.dreamer_v2.utils import compute_lambda_values, prepare_obs, test
+from sheeprl_tpu.algos.p2e_dv2.agent import build_agent, make_player
+from sheeprl_tpu.config import instantiate
+from sheeprl_tpu.data.buffers import (
+    EnvIndependentReplayBuffer,
+    EpisodeBuffer,
+    SequentialReplayBuffer,
+)
+from sheeprl_tpu.utils.callback import CheckpointCallback, load_checkpoint, restore_buffer
+from sheeprl_tpu.utils.distribution import (
+    Bernoulli,
+    Independent,
+    Normal,
+    OneHotCategorical,
+)
+from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
+from sheeprl_tpu.utils.registry import register_algorithm
+from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.utils.utils import Ratio, save_configs
+
+sg = jax.lax.stop_gradient
+
+
+def make_train_fn(runtime, world_model, actor, critic, ensemble, txs, cfg, is_continuous, actions_dim):
+    """Build the single jitted P2E-DV2 exploration gradient step."""
+    wm_tx, ens_tx, actor_task_tx, critic_task_tx, actor_expl_tx, critic_expl_tx = txs
+    cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
+    mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
+    cnn_keys_dec = tuple(cfg.algo.cnn_keys.decoder)
+    mlp_keys_dec = tuple(cfg.algo.mlp_keys.decoder)
+    stochastic_size = int(cfg.algo.world_model.stochastic_size)
+    discrete_size = int(cfg.algo.world_model.discrete_size)
+    stoch_state_size = stochastic_size * discrete_size
+    recurrent_state_size = int(cfg.algo.world_model.recurrent_model.recurrent_state_size)
+    horizon = int(cfg.algo.horizon)
+    gamma = float(cfg.algo.gamma)
+    lmbda = float(cfg.algo.lmbda)
+    ent_coef = float(cfg.algo.actor.ent_coef)
+    kl_balancing_alpha = float(cfg.algo.world_model.kl_balancing_alpha)
+    kl_free_nats = float(cfg.algo.world_model.kl_free_nats)
+    kl_free_avg = bool(cfg.algo.world_model.kl_free_avg)
+    kl_regularizer = float(cfg.algo.world_model.kl_regularizer)
+    discount_scale_factor = float(cfg.algo.world_model.discount_scale_factor)
+    use_continues = bool(cfg.algo.world_model.use_continues)
+    intrinsic_reward_multiplier = float(cfg.algo.intrinsic_reward_multiplier)
+
+    rssm = world_model.rssm
+
+    def _imagine(actor_params, wm_params, imagined_prior0, recurrent_state0, key):
+        """DV2-style imagination: (H+1, TB, L) trajectory INCLUDING the
+        replayed start state at index 0, with a zero placeholder action at
+        index 0 (reference p2e_dv2_exploration.py:226-248)."""
+        img_keys = jax.random.split(key, horizon)
+        latent0 = jnp.concatenate([imagined_prior0, recurrent_state0], -1)
+
+        def img_step(carry, kk):
+            prior, rec, latent = carry
+            k_act, k_im = jax.random.split(kk)
+            acts, _ = actor.apply(actor_params, sg(latent), False, k_act)
+            action = jnp.concatenate(acts, -1)
+            prior, rec = rssm.apply(
+                wm_params["rssm"], prior, rec, action, k_im, method=RSSM.imagination
+            )
+            prior = prior.reshape(-1, stoch_state_size)
+            latent = jnp.concatenate([prior, rec], -1)
+            return (prior, rec, latent), (latent, action)
+
+        _, (latents, actions_seq) = jax.lax.scan(
+            img_step, (imagined_prior0, recurrent_state0, latent0), img_keys
+        )
+        imagined_trajectories = jnp.concatenate([latent0[None], latents], 0)  # (H+1, TB, L)
+        imagined_actions = jnp.concatenate(
+            [jnp.zeros_like(actions_seq[:1]), actions_seq], 0
+        )
+        return imagined_trajectories, imagined_actions
+
+    def _behavior_update(
+        actor_params, critic_params, target_critic_params, actor_tx_, critic_tx_,
+        actor_opt, critic_opt, wm_params, ens_params, imagined_prior0,
+        recurrent_state0, true_continue, key, reward_source,
+    ):
+        """One DV2 actor+critic update in imagination. ``reward_source`` is
+        'intrinsic' (ensemble variance) or 'task' (reward model)."""
+
+        def actor_loss_fn(ap):
+            k_img, k_pol = jax.random.split(key)
+            traj, imagined_actions = _imagine(
+                ap, wm_params, imagined_prior0, recurrent_state0, k_img
+            )
+            predicted_target_values = critic.apply(target_critic_params, traj)
+            if reward_source == "intrinsic":
+                ens_in = jnp.concatenate([sg(traj), sg(imagined_actions)], -1)
+                preds = jax.vmap(lambda p: ensemble.apply(p, ens_in))(ens_params)
+                # torch's Tensor.var is unbiased (ddof=1), reference :263
+                rewards = preds.var(0, ddof=1).mean(-1, keepdims=True) * intrinsic_reward_multiplier
+            else:
+                rewards = world_model.reward_model.apply(wm_params["reward_model"], traj)
+            if use_continues:
+                continues = jax.nn.sigmoid(
+                    world_model.continue_model.apply(wm_params["continue_model"], traj)
+                )
+                continues = jnp.concatenate([true_continue[None], continues[1:]], 0)
+            else:
+                continues = jnp.ones_like(rewards) * gamma
+
+            lambda_values = compute_lambda_values(
+                rewards[:-1],
+                predicted_target_values[:-1],
+                continues[:-1],
+                bootstrap=predicted_target_values[-1:],
+                lmbda=lmbda,
+            )  # (H, TB, 1)
+            discount = sg(
+                jnp.cumprod(jnp.concatenate([jnp.ones_like(continues[:1]), continues[:-1]], 0), 0)
+            )
+
+            _, policies = actor.apply(ap, sg(traj[:-2]), False, k_pol)
+            if is_continuous:
+                objective = lambda_values[1:]
+            else:
+                # reinforce with the TARGET critic as baseline (reference :288-300)
+                advantage = sg(lambda_values[1:] - predicted_target_values[:-2])
+                splits = np.cumsum(actions_dim)[:-1].tolist()
+                sub_actions = jnp.split(imagined_actions, splits, -1)
+                objective = (
+                    jnp.stack(
+                        [
+                            p.log_prob(sg(a[1:-1]))[..., None]
+                            for p, a in zip(policies, sub_actions)
+                        ],
+                        -1,
+                    ).sum(-1)
+                    * advantage
+                )
+            try:
+                entropy = ent_coef * jnp.stack([p.entropy() for p in policies], -1).sum(-1)
+            except NotImplementedError:
+                entropy = jnp.zeros_like(objective[..., 0])
+            policy_loss = -jnp.mean(sg(discount[:-2]) * (objective + entropy[..., None]))
+            aux = {
+                "traj": sg(traj),
+                "lambda_values": sg(lambda_values),
+                "discount": discount,
+                "rewards": sg(rewards),
+                "predicted_values": sg(predicted_target_values),
+            }
+            return policy_loss, aux
+
+        (policy_loss, aux), actor_grads = jax.value_and_grad(actor_loss_fn, has_aux=True)(actor_params)
+        updates, new_actor_opt = actor_tx_.update(actor_grads, actor_opt, actor_params)
+        new_actor_params = optax.apply_updates(actor_params, updates)
+
+        def critic_loss_fn(cp):
+            qv = Independent(Normal(critic.apply(cp, aux["traj"][:-1]), 1.0), 1)
+            return -jnp.mean(
+                aux["discount"][:-1, ..., 0] * qv.log_prob(aux["lambda_values"])
+            )
+
+        value_loss, critic_grads = jax.value_and_grad(critic_loss_fn)(critic_params)
+        updates, new_critic_opt = critic_tx_.update(critic_grads, critic_opt, critic_params)
+        new_critic_params = optax.apply_updates(critic_params, updates)
+
+        return (
+            new_actor_params, new_critic_params, new_actor_opt, new_critic_opt,
+            policy_loss, value_loss, optax.global_norm(actor_grads), optax.global_norm(critic_grads),
+            aux,
+        )
+
+    def train(params, opt_states, data, key):
+        T, B = data["rewards"].shape[:2]
+        k_dyn, k_img_e, k_img_t = jax.random.split(key, 3)
+
+        batch_obs = {k: data[k] / 255.0 - 0.5 for k in cnn_keys}
+        batch_obs.update({k: data[k] for k in mlp_keys})
+        is_first = data["is_first"].at[0].set(1.0)
+
+        # ---------------------------------------------------- world model
+        def wm_loss_fn(wm_params):
+            embedded_obs = world_model.encoder.apply(wm_params["encoder"], batch_obs)
+            dyn_keys = jax.random.split(k_dyn, T)
+
+            def dyn_step(carry, inp):
+                posterior, recurrent_state = carry
+                action, emb, first, kk = inp
+                out = rssm.apply(
+                    wm_params["rssm"], posterior, recurrent_state, action, emb, first, kk,
+                    method=RSSM.dynamic,
+                )
+                recurrent_state, posterior, _, posterior_logits, prior_logits = out
+                return (posterior, recurrent_state), (
+                    recurrent_state, posterior, posterior_logits, prior_logits,
+                )
+
+            init = (
+                jnp.zeros((B, stochastic_size, discrete_size)),
+                jnp.zeros((B, recurrent_state_size)),
+            )
+            _, (recurrent_states, posteriors, posteriors_logits, priors_logits) = jax.lax.scan(
+                dyn_step, init, (data["actions"], embedded_obs, is_first, dyn_keys)
+            )
+            latent_states = jnp.concatenate(
+                [posteriors.reshape(T, B, -1), recurrent_states], -1
+            )
+            reconstructed_obs = world_model.observation_model.apply(
+                wm_params["observation_model"], latent_states
+            )
+            po = {
+                k: Independent(Normal(v, jnp.ones_like(v)), len(v.shape[2:]))
+                for k, v in reconstructed_obs.items()
+                if k in cnn_keys_dec + mlp_keys_dec
+            }
+            # reward/continue heads read detached latents in the exploration
+            # phase (reference p2e_dv2_exploration.py:155-160)
+            pr = Independent(
+                Normal(world_model.reward_model.apply(wm_params["reward_model"], sg(latent_states)), 1.0), 1
+            )
+            if use_continues:
+                pc = Independent(
+                    Bernoulli(
+                        logits=world_model.continue_model.apply(
+                            wm_params["continue_model"], sg(latent_states)
+                        )
+                    ),
+                    1,
+                )
+                continues_targets = (1 - data["terminated"]) * gamma
+            else:
+                pc = continues_targets = None
+            pl = priors_logits.reshape(T, B, stochastic_size, discrete_size)
+            psl = posteriors_logits.reshape(T, B, stochastic_size, discrete_size)
+            rec_loss, kl, state_loss, reward_loss, observation_loss, continue_loss = reconstruction_loss(
+                po, batch_obs, pr, data["rewards"], pl, psl,
+                kl_balancing_alpha, kl_free_nats, kl_free_avg, kl_regularizer,
+                pc, continues_targets, discount_scale_factor,
+            )
+            aux = {
+                "posteriors": posteriors,
+                "recurrent_states": recurrent_states,
+                "posteriors_logits": psl,
+                "priors_logits": pl,
+                "kl": kl.mean(),
+                "state_loss": state_loss,
+                "reward_loss": reward_loss,
+                "observation_loss": observation_loss,
+                "continue_loss": continue_loss,
+            }
+            return rec_loss, aux
+
+        (rec_loss, wm_aux), wm_grads = jax.value_and_grad(wm_loss_fn, has_aux=True)(
+            params["world_model"]
+        )
+        updates, new_wm_opt = wm_tx.update(wm_grads, opt_states["world_model"], params["world_model"])
+        new_wm_params = optax.apply_updates(params["world_model"], updates)
+
+        posteriors = sg(wm_aux["posteriors"])  # (T, B, S, D)
+        recurrent_states = sg(wm_aux["recurrent_states"])
+        posteriors_flat = posteriors.reshape(T, B, stoch_state_size)
+
+        # ---------------------------------------------------- ensembles
+        # next-stochastic-state regression under Normal(out, 1)
+        # (reference p2e_dv2_exploration.py:196-220)
+        ens_in = jnp.concatenate([posteriors_flat, recurrent_states, data["actions"]], -1)
+
+        def ens_loss_fn(ens_params):
+            out = jax.vmap(lambda p: ensemble.apply(p, ens_in))(ens_params)[:, :-1]
+            target = posteriors_flat[1:]
+            logp = jax.vmap(lambda o: Independent(Normal(o, 1.0), 1).log_prob(target).mean())(out)
+            return -logp.sum()
+
+        ens_loss, ens_grads = jax.value_and_grad(ens_loss_fn)(params["ensembles"])
+        updates, new_ens_opt = ens_tx.update(ens_grads, opt_states["ensembles"], params["ensembles"])
+        new_ens_params = optax.apply_updates(params["ensembles"], updates)
+
+        imagined_prior0 = posteriors_flat.reshape(T * B, stoch_state_size)
+        recurrent_state0 = recurrent_states.reshape(T * B, recurrent_state_size)
+        true_continue = (1 - data["terminated"]).reshape(T * B, 1) * gamma
+
+        # ------------------------------------- exploration behavior
+        (
+            new_actor_expl, new_critic_expl, new_actor_expl_opt, new_critic_expl_opt,
+            policy_loss_expl, value_loss_expl, actor_expl_gnorm, critic_expl_gnorm, expl_aux,
+        ) = _behavior_update(
+            params["actor_exploration"], params["critic_exploration"],
+            params["target_critic_exploration"],
+            actor_expl_tx, critic_expl_tx,
+            opt_states["actor_exploration"], opt_states["critic_exploration"],
+            new_wm_params, new_ens_params, imagined_prior0, recurrent_state0,
+            true_continue, k_img_e, "intrinsic",
+        )
+
+        # ------------------------------------- zero-shot task behavior
+        (
+            new_actor_task, new_critic_task, new_actor_task_opt, new_critic_task_opt,
+            policy_loss_task, value_loss_task, actor_task_gnorm, critic_task_gnorm, _,
+        ) = _behavior_update(
+            params["actor_task"], params["critic_task"],
+            params["target_critic_task"],
+            actor_task_tx, critic_task_tx,
+            opt_states["actor_task"], opt_states["critic_task"],
+            new_wm_params, new_ens_params, imagined_prior0, recurrent_state0,
+            true_continue, k_img_t, "task",
+        )
+
+        new_params = {
+            "world_model": new_wm_params,
+            "actor_task": new_actor_task,
+            "critic_task": new_critic_task,
+            "target_critic_task": params["target_critic_task"],
+            "actor_exploration": new_actor_expl,
+            "critic_exploration": new_critic_expl,
+            "target_critic_exploration": params["target_critic_exploration"],
+            "ensembles": new_ens_params,
+        }
+        new_opt_states = {
+            "world_model": new_wm_opt,
+            "ensembles": new_ens_opt,
+            "actor_task": new_actor_task_opt,
+            "critic_task": new_critic_task_opt,
+            "actor_exploration": new_actor_expl_opt,
+            "critic_exploration": new_critic_expl_opt,
+        }
+        post_ent = Independent(
+            OneHotCategorical(logits=sg(wm_aux["posteriors_logits"])), 1
+        ).entropy().mean()
+        prior_ent = Independent(
+            OneHotCategorical(logits=sg(wm_aux["priors_logits"])), 1
+        ).entropy().mean()
+        metrics = {
+            "Loss/world_model_loss": rec_loss,
+            "Loss/observation_loss": wm_aux["observation_loss"],
+            "Loss/reward_loss": wm_aux["reward_loss"],
+            "Loss/state_loss": wm_aux["state_loss"],
+            "Loss/continue_loss": wm_aux["continue_loss"],
+            "State/kl": wm_aux["kl"],
+            "State/post_entropy": post_ent,
+            "State/prior_entropy": prior_ent,
+            "Loss/ensemble_loss": ens_loss,
+            "Loss/policy_loss_exploration": policy_loss_expl,
+            "Loss/value_loss_exploration": value_loss_expl,
+            "Loss/policy_loss_task": policy_loss_task,
+            "Loss/value_loss_task": value_loss_task,
+            "Values_exploration/predicted_values": expl_aux["predicted_values"].mean(),
+            "Values_exploration/lambda_values": expl_aux["lambda_values"].mean(),
+            "Rewards/intrinsic": expl_aux["rewards"].mean(),
+            "Grads/world_model": optax.global_norm(wm_grads),
+            "Grads/ensemble": optax.global_norm(ens_grads),
+            "Grads/actor_exploration": actor_expl_gnorm,
+            "Grads/critic_exploration": critic_expl_gnorm,
+            "Grads/actor_task": actor_task_gnorm,
+            "Grads/critic_task": critic_task_gnorm,
+        }
+        return new_params, new_opt_states, metrics
+
+    return runtime.setup_step(train, donate_argnums=(0, 1))
+
+
+@register_algorithm()
+def main(runtime, cfg: Dict[str, Any]):
+    import gymnasium as gym
+    from gymnasium.vector import AsyncVectorEnv, AutoresetMode, SyncVectorEnv
+
+    world_size = runtime.world_size
+    runtime.seed_everything(cfg.seed)
+    state = load_checkpoint(cfg.checkpoint.resume_from) if cfg.checkpoint.resume_from else None
+
+    # These arguments cannot be changed (reference p2e_dv2_exploration.py:490-493)
+    cfg.env.frame_stack = 1
+    cfg.algo.player.actor_type = "exploration"
+
+    logger = get_logger(runtime, cfg)
+    log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name)
+    runtime.print(f"Log dir: {log_dir}")
+    if logger:
+        logger.log_hyperparams(cfg)
+
+    total_envs = cfg.env.num_envs * world_size
+    thunks = [
+        make_env(cfg, cfg.seed + i, 0, log_dir if runtime.is_global_zero else None, "train", vector_env_idx=i)
+        for i in range(total_envs)
+    ]
+    envs = (
+        SyncVectorEnv(thunks, autoreset_mode=AutoresetMode.SAME_STEP)
+        if cfg.env.sync_env
+        else AsyncVectorEnv(thunks, context="spawn", autoreset_mode=AutoresetMode.SAME_STEP)
+    )
+    action_space = envs.single_action_space
+    observation_space = envs.single_observation_space
+
+    is_continuous = isinstance(action_space, gym.spaces.Box)
+    is_multidiscrete = isinstance(action_space, gym.spaces.MultiDiscrete)
+    actions_dim = tuple(
+        action_space.shape
+        if is_continuous
+        else (action_space.nvec.tolist() if is_multidiscrete else [action_space.n])
+    )
+    clip_rewards_fn = (lambda r: np.tanh(r)) if cfg.env.clip_rewards else (lambda r: r)
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+    if len(set(cfg.algo.cnn_keys.decoder) - set(cfg.algo.cnn_keys.encoder)) > 0:
+        raise RuntimeError("The CNN keys of the decoder must be contained in the encoder ones")
+    if len(set(cfg.algo.mlp_keys.decoder) - set(cfg.algo.mlp_keys.encoder)) > 0:
+        raise RuntimeError("The MLP keys of the decoder must be contained in the encoder ones")
+    obs_keys = cfg.algo.cnn_keys.encoder + cfg.algo.mlp_keys.encoder
+
+    world_model, actor, critic, ensemble, params = build_agent(
+        runtime,
+        actions_dim,
+        is_continuous,
+        cfg,
+        observation_space,
+        state["world_model"] if state else None,
+        state["ensembles"] if state else None,
+        state["actor_task"] if state else None,
+        state["critic_task"] if state else None,
+        state["target_critic_task"] if state else None,
+        state["actor_exploration"] if state else None,
+        state["critic_exploration"] if state else None,
+        state["target_critic_exploration"] if state else None,
+    )
+    params = runtime.replicate(params)
+
+    wm_tx = _make_optimizer(cfg.algo.world_model.optimizer, cfg.algo.world_model.clip_gradients)
+    ens_tx = _make_optimizer(cfg.algo.ensembles.optimizer, cfg.algo.ensembles.clip_gradients)
+    actor_task_tx = _make_optimizer(cfg.algo.actor.optimizer, cfg.algo.actor.clip_gradients)
+    critic_task_tx = _make_optimizer(cfg.algo.critic.optimizer, cfg.algo.critic.clip_gradients)
+    actor_expl_tx = _make_optimizer(cfg.algo.actor.optimizer, cfg.algo.actor.clip_gradients)
+    critic_expl_tx = _make_optimizer(cfg.algo.critic.optimizer, cfg.algo.critic.clip_gradients)
+    if state is not None:
+        opt_states = jax.tree_util.tree_map(jnp.asarray, state["opt_states"])
+    else:
+        opt_states = runtime.replicate(
+            {
+                "world_model": wm_tx.init(params["world_model"]),
+                "ensembles": ens_tx.init(params["ensembles"]),
+                "actor_task": actor_task_tx.init(params["actor_task"]),
+                "critic_task": critic_task_tx.init(params["critic_task"]),
+                "actor_exploration": actor_expl_tx.init(params["actor_exploration"]),
+                "critic_exploration": critic_expl_tx.init(params["critic_exploration"]),
+            }
+        )
+
+    player = make_player(runtime, world_model, actor, params, actions_dim, total_envs, cfg, "exploration")
+
+    if runtime.is_global_zero:
+        save_configs(cfg, log_dir)
+
+    aggregator = None
+    if not MetricAggregator.disabled:
+        aggregator = instantiate(dict(cfg.metric.aggregator))
+
+    buffer_size = cfg.buffer.size // total_envs if not cfg.dry_run else 2
+    buffer_type = str(cfg.buffer.get("type", "sequential")).lower()
+    if buffer_type == "sequential":
+        rb = EnvIndependentReplayBuffer(
+            max(buffer_size, 2),
+            n_envs=total_envs,
+            memmap=cfg.buffer.memmap,
+            memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{runtime.global_rank}"),
+            buffer_cls=SequentialReplayBuffer,
+        )
+    elif buffer_type == "episode":
+        rb = EpisodeBuffer(
+            max(buffer_size, 4),
+            minimum_episode_length=1 if cfg.dry_run else cfg.algo.per_rank_sequence_length,
+            n_envs=total_envs,
+            obs_keys=obs_keys,
+            prioritize_ends=cfg.buffer.get("prioritize_ends", False),
+            memmap=cfg.buffer.memmap,
+            memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{runtime.global_rank}"),
+        )
+    else:
+        raise ValueError(
+            f"Unrecognized buffer type: must be one of `sequential` or `episode`, received: {buffer_type}"
+        )
+    if state and cfg.buffer.checkpoint:
+        rb = restore_buffer(state["rb"], memmap=cfg.buffer.memmap)
+
+    train_step = 0
+    last_train = 0
+    start_iter = (state["iter_num"] // world_size) + 1 if state else 1
+    policy_step = state["iter_num"] * cfg.env.num_envs if state else 0
+    last_log = state["last_log"] if state else 0
+    last_checkpoint = state["last_checkpoint"] if state else 0
+    policy_steps_per_iter = int(total_envs)
+    total_iters = int(cfg.algo.total_steps // policy_steps_per_iter) if not cfg.dry_run else 1
+    learning_starts = cfg.algo.learning_starts // policy_steps_per_iter if not cfg.dry_run else 0
+    prefill_steps = learning_starts - int(learning_starts > 0)
+    if state:
+        cfg.algo.per_rank_batch_size = state["batch_size"] // world_size
+        learning_starts += start_iter
+        prefill_steps += start_iter
+
+    ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
+    if state:
+        ratio.load_state_dict(state["ratio"])
+
+    ckpt_cb = CheckpointCallback(keep_last=cfg.checkpoint.keep_last)
+    train_fn = make_train_fn(
+        runtime,
+        world_model,
+        actor,
+        critic,
+        ensemble,
+        (wm_tx, ens_tx, actor_task_tx, critic_task_tx, actor_expl_tx, critic_expl_tx),
+        cfg,
+        is_continuous,
+        actions_dim,
+    )
+
+    @jax.jit
+    def _hard_update(critic_params):
+        return jax.tree_util.tree_map(jnp.copy, critic_params)
+
+    # initial zero-action buffer row (reference p2e_dv2_exploration.py:631-645)
+    step_data: Dict[str, np.ndarray] = {}
+    obs = envs.reset(seed=cfg.seed)[0]
+    for k in obs_keys:
+        step_data[k] = obs[k][np.newaxis]
+    step_data["terminated"] = np.zeros((1, total_envs, 1))
+    step_data["truncated"] = np.zeros((1, total_envs, 1))
+    if cfg.dry_run:
+        step_data["truncated"] = step_data["truncated"] + 1
+        step_data["terminated"] = step_data["terminated"] + 1
+    step_data["actions"] = np.zeros((1, total_envs, int(np.sum(actions_dim))))
+    step_data["rewards"] = np.zeros((1, total_envs, 1))
+    step_data["is_first"] = np.ones_like(step_data["terminated"])
+    rb.add(step_data, validate_args=cfg.buffer.validate_args)
+    player.init_states()
+
+    cumulative_per_rank_gradient_steps = 0
+    for iter_num in range(start_iter, total_iters + 1):
+        policy_step += policy_steps_per_iter
+
+        with timer("Time/env_interaction_time", SumMetric, sync_on_compute=False):
+            if iter_num <= learning_starts and cfg.checkpoint.resume_from is None:
+                real_actions = actions = np.array(envs.action_space.sample())
+                if not is_continuous:
+                    actions = np.concatenate(
+                        [
+                            np.eye(act_dim, dtype=np.float32)[act]
+                            for act, act_dim in zip(actions.reshape(len(actions_dim), -1), actions_dim)
+                        ],
+                        axis=-1,
+                    )
+            else:
+                prepared = prepare_obs(obs, cnn_keys=cfg.algo.cnn_keys.encoder, num_envs=total_envs)
+                mask = {k: v for k, v in prepared.items() if k.startswith("mask")} or None
+                action_list = player.get_actions(prepared, runtime.next_key(), mask=mask)
+                actions = np.asarray(jnp.concatenate(action_list, -1)).reshape(1, total_envs, -1)
+                if is_continuous:
+                    real_actions = np.concatenate([np.asarray(a) for a in action_list], -1)
+                else:
+                    real_actions = np.stack([np.asarray(a).argmax(-1) for a in action_list], -1)
+
+            step_data["is_first"] = np.logical_or(
+                step_data["terminated"], step_data["truncated"]
+            ).astype(np.float32)
+            next_obs, rewards, terminated, truncated, infos = envs.step(
+                np.asarray(real_actions).reshape(envs.action_space.shape)
+            )
+            dones = np.logical_or(terminated, truncated).astype(np.uint8)
+            if cfg.dry_run and buffer_type == "episode":
+                dones = np.ones_like(dones)
+                terminated = np.ones_like(terminated)
+
+        if cfg.metric.log_level > 0 and "final_info" in infos:
+            ep = infos["final_info"].get("episode")
+            if ep is not None:
+                for i in np.nonzero(infos["final_info"]["_episode"])[0]:
+                    if aggregator and not aggregator.disabled:
+                        aggregator.update("Rewards/rew_avg", float(ep["r"][i]))
+                        aggregator.update("Game/ep_len_avg", float(ep["l"][i]))
+                    runtime.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={float(ep['r'][i])}")
+
+        real_next_obs = {k: np.array(v) for k, v in next_obs.items()}
+        if "final_obs" in infos:
+            for idx in np.nonzero(infos["_final_obs"])[0]:
+                for k, v in infos["final_obs"][idx].items():
+                    real_next_obs[k][idx] = v
+
+        for k in obs_keys:
+            step_data[k] = real_next_obs[k][np.newaxis]
+        obs = next_obs
+
+        step_data["terminated"] = terminated.reshape((1, total_envs, -1)).astype(np.float32)
+        step_data["truncated"] = truncated.reshape((1, total_envs, -1)).astype(np.float32)
+        step_data["actions"] = np.asarray(actions).reshape(1, total_envs, -1)
+        step_data["rewards"] = clip_rewards_fn(rewards.reshape((1, total_envs, -1)))
+        rb.add(step_data, validate_args=cfg.buffer.validate_args)
+
+        dones_idxes = dones.nonzero()[0].tolist()
+        reset_envs = len(dones_idxes)
+        if reset_envs > 0:
+            reset_data = {}
+            for k in obs_keys:
+                reset_data[k] = (next_obs[k][dones_idxes])[np.newaxis]
+            reset_data["terminated"] = np.zeros((1, reset_envs, 1))
+            reset_data["truncated"] = np.zeros((1, reset_envs, 1))
+            reset_data["actions"] = np.zeros((1, reset_envs, int(np.sum(actions_dim))))
+            reset_data["rewards"] = np.zeros((1, reset_envs, 1))
+            reset_data["is_first"] = np.ones_like(reset_data["terminated"])
+            rb.add(reset_data, dones_idxes, validate_args=cfg.buffer.validate_args)
+            step_data["terminated"][:, dones_idxes] = 0.0
+            step_data["truncated"][:, dones_idxes] = 0.0
+            player.init_states(reset_envs=dones_idxes)
+
+        # ------------------------------------------------------ train
+        if iter_num >= learning_starts:
+            ratio_steps = policy_step - prefill_steps * policy_steps_per_iter
+            per_rank_gradient_steps = ratio(ratio_steps / world_size)
+            if per_rank_gradient_steps > 0:
+                local_data = rb.sample(
+                    cfg.algo.per_rank_batch_size * world_size,
+                    sequence_length=cfg.algo.per_rank_sequence_length,
+                    n_samples=per_rank_gradient_steps,
+                    prioritize_ends=cfg.buffer.get("prioritize_ends", False),
+                )
+                with timer("Time/train_time", SumMetric, sync_on_compute=cfg.metric.sync_on_compute):
+                    for i in range(per_rank_gradient_steps):
+                        if (
+                            cumulative_per_rank_gradient_steps
+                            % cfg.algo.critic.per_rank_target_network_update_freq
+                            == 0
+                        ):
+                            params["target_critic_task"] = _hard_update(params["critic_task"])
+                            params["target_critic_exploration"] = _hard_update(
+                                params["critic_exploration"]
+                            )
+                        batch = {
+                            k: jnp.asarray(v[i], dtype=jnp.float32) for k, v in local_data.items()
+                        }
+                        params, opt_states, train_metrics = train_fn(
+                            params, opt_states, batch, runtime.next_key()
+                        )
+                        cumulative_per_rank_gradient_steps += 1
+                    train_step += world_size
+                player.params = {
+                    "world_model": params["world_model"],
+                    "actor": params["actor_exploration"],
+                }
+                if aggregator and not aggregator.disabled:
+                    for k, v in jax.device_get(train_metrics).items():
+                        aggregator.update(k, v)
+
+        # ------------------------------------------------------ logging
+        if cfg.metric.log_level > 0 and (
+            policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters
+        ):
+            if logger:
+                if aggregator and not aggregator.disabled:
+                    logger.log_metrics(aggregator.compute(), policy_step)
+                    aggregator.reset()
+                logger.log_metrics(
+                    {"Params/replay_ratio": cumulative_per_rank_gradient_steps * world_size / policy_step},
+                    policy_step,
+                )
+                if not timer.disabled:
+                    timer_metrics = timer.compute()
+                    if timer_metrics.get("Time/train_time", 0) > 0:
+                        logger.log_metrics(
+                            {"Time/sps_train": (train_step - last_train) / timer_metrics["Time/train_time"]},
+                            policy_step,
+                        )
+                    if timer_metrics.get("Time/env_interaction_time", 0) > 0:
+                        logger.log_metrics(
+                            {
+                                "Time/sps_env_interaction": (
+                                    (policy_step - last_log) / world_size * cfg.env.action_repeat
+                                )
+                                / timer_metrics["Time/env_interaction_time"]
+                            },
+                            policy_step,
+                        )
+                    timer.reset()
+            last_log = policy_step
+            last_train = train_step
+
+        # ------------------------------------------------------ checkpoint
+        if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
+            iter_num == total_iters and cfg.checkpoint.save_last
+        ):
+            last_checkpoint = policy_step
+            ckpt_state = {
+                "world_model": params["world_model"],
+                "actor_task": params["actor_task"],
+                "critic_task": params["critic_task"],
+                "target_critic_task": params["target_critic_task"],
+                "actor_exploration": params["actor_exploration"],
+                "critic_exploration": params["critic_exploration"],
+                "target_critic_exploration": params["target_critic_exploration"],
+                "ensembles": params["ensembles"],
+                "opt_states": opt_states,
+                "ratio": ratio.state_dict(),
+                "iter_num": iter_num * world_size,
+                "batch_size": cfg.algo.per_rank_batch_size * world_size,
+                "last_log": last_log,
+                "last_checkpoint": last_checkpoint,
+            }
+            if cfg.buffer.checkpoint:
+                ckpt_state["rb"] = rb
+            ckpt_cb.save(
+                runtime,
+                os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{runtime.global_rank}.ckpt"),
+                ckpt_state,
+            )
+
+    envs.close()
+    # task test zero-shot
+    if runtime.is_global_zero and cfg.algo.run_test:
+        player.params = {"world_model": params["world_model"], "actor": params["actor_task"]}
+        player.actor_type = "task"
+        test_rew = test(player, runtime, cfg, log_dir, "zero-shot")
+        if logger:
+            logger.log_metrics({"Test/cumulative_reward": test_rew}, policy_step)
+    if logger:
+        logger.finalize()

@@ -1,0 +1,419 @@
+"""P2E-DV2 finetuning phase (reference
+sheeprl/algos/p2e_dv2/p2e_dv2_finetuning.py main:40).
+
+Consumes the exploration run's checkpoint
+(``checkpoint.exploration_ckpt_path``): restores the world model, both
+actors and the task critic + target critic, pins all the model-shape
+hyperparameters to the exploration config (reference :57-75), optionally
+inherits the exploration replay buffer, then trains the TASK behavior with
+the standard DreamerV2 gradient step. The player collects with the
+exploration actor until learning starts, then switches to the task actor
+(reference :360-365)."""
+
+from __future__ import annotations
+
+import os
+import pathlib
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.dreamer_v2.dreamer_v2 import _make_optimizer, make_train_fn
+from sheeprl_tpu.algos.dreamer_v2.utils import prepare_obs, test
+from sheeprl_tpu.algos.p2e_dv2.agent import build_agent, make_player
+from sheeprl_tpu.config import instantiate
+from sheeprl_tpu.config.compose import yaml_load
+from sheeprl_tpu.data.buffers import (
+    EnvIndependentReplayBuffer,
+    EpisodeBuffer,
+    SequentialReplayBuffer,
+)
+from sheeprl_tpu.utils.callback import CheckpointCallback, load_checkpoint, restore_buffer
+from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
+from sheeprl_tpu.utils.registry import register_algorithm
+from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.utils.utils import Ratio, dotdict, save_configs
+
+
+def _load_exploration_cfg(ckpt_path: str) -> dotdict:
+    """The exploration run's resolved config lives two levels above the
+    checkpoint file (<log_dir>/checkpoint/ckpt_*.ckpt)."""
+    p = pathlib.Path(ckpt_path)
+    cfg_path = p.parent.parent / "config.yaml"
+    if not cfg_path.exists():
+        raise RuntimeError(f"Cannot find the exploration config at: {cfg_path}")
+    with open(cfg_path) as f:
+        return dotdict(yaml_load(f.read()))
+
+
+@register_algorithm()
+def main(runtime, cfg: Dict[str, Any]):
+    import gymnasium as gym
+    from gymnasium.vector import AsyncVectorEnv, AutoresetMode, SyncVectorEnv
+
+    world_size = runtime.world_size
+    runtime.seed_everything(cfg.seed)
+
+    ckpt_path = cfg.checkpoint.exploration_ckpt_path
+    exploration_cfg = _load_exploration_cfg(ckpt_path)
+    resume_from_checkpoint = bool(cfg.checkpoint.resume_from)
+    state = load_checkpoint(cfg.checkpoint.resume_from if resume_from_checkpoint else ckpt_path)
+
+    # the models must match the exploration phase exactly (reference :57-75)
+    for key in (
+        "gamma", "lmbda", "horizon", "layer_norm", "dense_units", "mlp_layers",
+        "dense_act", "cnn_act", "world_model", "actor", "critic", "cnn_keys", "mlp_keys",
+    ):
+        if key in exploration_cfg.algo:
+            cfg.algo[key] = exploration_cfg.algo[key]
+    cfg.env.clip_rewards = exploration_cfg.env.clip_rewards
+    if cfg.buffer.get("load_from_exploration", False) and exploration_cfg.buffer.checkpoint:
+        cfg.env.num_envs = exploration_cfg.env.num_envs
+    cfg.env.frame_stack = 1
+
+    logger = get_logger(runtime, cfg)
+    log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name)
+    runtime.print(f"Log dir: {log_dir}")
+    if logger:
+        logger.log_hyperparams(cfg)
+
+    total_envs = cfg.env.num_envs * world_size
+    thunks = [
+        make_env(cfg, cfg.seed + i, 0, log_dir if runtime.is_global_zero else None, "train", vector_env_idx=i)
+        for i in range(total_envs)
+    ]
+    envs = (
+        SyncVectorEnv(thunks, autoreset_mode=AutoresetMode.SAME_STEP)
+        if cfg.env.sync_env
+        else AsyncVectorEnv(thunks, context="spawn", autoreset_mode=AutoresetMode.SAME_STEP)
+    )
+    action_space = envs.single_action_space
+    observation_space = envs.single_observation_space
+
+    is_continuous = isinstance(action_space, gym.spaces.Box)
+    is_multidiscrete = isinstance(action_space, gym.spaces.MultiDiscrete)
+    actions_dim = tuple(
+        action_space.shape
+        if is_continuous
+        else (action_space.nvec.tolist() if is_multidiscrete else [action_space.n])
+    )
+    clip_rewards_fn = (lambda r: np.tanh(r)) if cfg.env.clip_rewards else (lambda r: r)
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+    obs_keys = cfg.algo.cnn_keys.encoder + cfg.algo.mlp_keys.encoder
+
+    world_model, actor, critic, ensemble, params = build_agent(
+        runtime,
+        actions_dim,
+        is_continuous,
+        cfg,
+        observation_space,
+        state["world_model"],
+        state.get("ensembles"),
+        state["actor_task"],
+        state["critic_task"],
+        state.get("target_critic_task"),
+        state["actor_exploration"],
+        state.get("critic_exploration"),
+        state.get("target_critic_exploration"),
+    )
+    params = runtime.replicate(params)
+
+    wm_tx = _make_optimizer(cfg.algo.world_model.optimizer, cfg.algo.world_model.clip_gradients)
+    actor_tx = _make_optimizer(cfg.algo.actor.optimizer, cfg.algo.actor.clip_gradients)
+    critic_tx = _make_optimizer(cfg.algo.critic.optimizer, cfg.algo.critic.clip_gradients)
+    saved_opt = state.get("opt_states", {})
+    opt_states = {
+        "world_model": (
+            jax.tree_util.tree_map(jnp.asarray, saved_opt["world_model"])
+            if "world_model" in saved_opt
+            else runtime.replicate(wm_tx.init(params["world_model"]))
+        ),
+        "actor": (
+            jax.tree_util.tree_map(jnp.asarray, saved_opt["actor_task"])
+            if "actor_task" in saved_opt
+            else runtime.replicate(actor_tx.init(params["actor_task"]))
+        ),
+        "critic": (
+            jax.tree_util.tree_map(jnp.asarray, saved_opt["critic_task"])
+            if "critic_task" in saved_opt
+            else runtime.replicate(critic_tx.init(params["critic_task"]))
+        ),
+    }
+
+    # DV2-shaped param view for the task training step; the pytrees are
+    # shared, not copied
+    dv2_params = {
+        "world_model": params["world_model"],
+        "actor": params["actor_task"],
+        "critic": params["critic_task"],
+        "target_critic": params["target_critic_task"],
+    }
+
+    actor_type = str(cfg.algo.player.actor_type)
+    player = make_player(runtime, world_model, actor, params, actions_dim, total_envs, cfg, actor_type)
+
+    if runtime.is_global_zero:
+        save_configs(cfg, log_dir)
+
+    aggregator = None
+    if not MetricAggregator.disabled:
+        aggregator = instantiate(dict(cfg.metric.aggregator))
+
+    buffer_size = cfg.buffer.size // total_envs if not cfg.dry_run else 2
+    buffer_type = str(cfg.buffer.get("type", "sequential")).lower()
+    if buffer_type == "sequential":
+        rb = EnvIndependentReplayBuffer(
+            max(buffer_size, 2),
+            n_envs=total_envs,
+            memmap=cfg.buffer.memmap,
+            memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{runtime.global_rank}"),
+            buffer_cls=SequentialReplayBuffer,
+        )
+    elif buffer_type == "episode":
+        rb = EpisodeBuffer(
+            max(buffer_size, 4),
+            minimum_episode_length=1 if cfg.dry_run else cfg.algo.per_rank_sequence_length,
+            n_envs=total_envs,
+            obs_keys=obs_keys,
+            prioritize_ends=cfg.buffer.get("prioritize_ends", False),
+            memmap=cfg.buffer.memmap,
+            memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{runtime.global_rank}"),
+        )
+    else:
+        raise ValueError(
+            f"Unrecognized buffer type: must be one of `sequential` or `episode`, received: {buffer_type}"
+        )
+    if (resume_from_checkpoint or cfg.buffer.get("load_from_exploration", False)) and "rb" in state:
+        rb = restore_buffer(state["rb"], memmap=cfg.buffer.memmap)
+
+    train_step = 0
+    last_train = 0
+    start_iter = (state["iter_num"] // world_size) + 1 if resume_from_checkpoint else 1
+    policy_step = state["iter_num"] * cfg.env.num_envs if resume_from_checkpoint else 0
+    last_log = state["last_log"] if resume_from_checkpoint else 0
+    last_checkpoint = state["last_checkpoint"] if resume_from_checkpoint else 0
+    policy_steps_per_iter = int(total_envs)
+    total_iters = int(cfg.algo.total_steps // policy_steps_per_iter) if not cfg.dry_run else 1
+    learning_starts = cfg.algo.learning_starts // policy_steps_per_iter if not cfg.dry_run else 0
+    prefill_steps = learning_starts - int(learning_starts > 0)
+    if resume_from_checkpoint:
+        cfg.algo.per_rank_batch_size = state["batch_size"] // world_size
+        learning_starts += start_iter
+        prefill_steps += start_iter
+
+    ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
+    if resume_from_checkpoint:
+        ratio.load_state_dict(state["ratio"])
+
+    ckpt_cb = CheckpointCallback(keep_last=cfg.checkpoint.keep_last)
+    train_fn = make_train_fn(
+        runtime, world_model, actor, critic, (wm_tx, actor_tx, critic_tx), cfg, is_continuous, actions_dim
+    )
+
+    @jax.jit
+    def _hard_update(critic_params):
+        return jax.tree_util.tree_map(jnp.copy, critic_params)
+
+    # initial zero-action buffer row
+    step_data: Dict[str, np.ndarray] = {}
+    obs = envs.reset(seed=cfg.seed)[0]
+    for k in obs_keys:
+        step_data[k] = obs[k][np.newaxis]
+    step_data["terminated"] = np.zeros((1, total_envs, 1))
+    step_data["truncated"] = np.zeros((1, total_envs, 1))
+    if cfg.dry_run:
+        step_data["truncated"] = step_data["truncated"] + 1
+        step_data["terminated"] = step_data["terminated"] + 1
+    step_data["actions"] = np.zeros((1, total_envs, int(np.sum(actions_dim))))
+    step_data["rewards"] = np.zeros((1, total_envs, 1))
+    step_data["is_first"] = np.ones_like(step_data["terminated"])
+    rb.add(step_data, validate_args=cfg.buffer.validate_args)
+    player.init_states()
+
+    cumulative_per_rank_gradient_steps = 0
+    for iter_num in range(start_iter, total_iters + 1):
+        policy_step += policy_steps_per_iter
+
+        with timer("Time/env_interaction_time", SumMetric, sync_on_compute=False):
+            prepared = prepare_obs(obs, cnn_keys=cfg.algo.cnn_keys.encoder, num_envs=total_envs)
+            mask = {k: v for k, v in prepared.items() if k.startswith("mask")} or None
+            action_list = player.get_actions(prepared, runtime.next_key(), mask=mask)
+            actions = np.asarray(jnp.concatenate(action_list, -1)).reshape(1, total_envs, -1)
+            if is_continuous:
+                real_actions = np.concatenate([np.asarray(a) for a in action_list], -1)
+            else:
+                real_actions = np.stack([np.asarray(a).argmax(-1) for a in action_list], -1)
+
+            step_data["is_first"] = np.logical_or(
+                step_data["terminated"], step_data["truncated"]
+            ).astype(np.float32)
+            next_obs, rewards, terminated, truncated, infos = envs.step(
+                np.asarray(real_actions).reshape(envs.action_space.shape)
+            )
+            dones = np.logical_or(terminated, truncated).astype(np.uint8)
+            if cfg.dry_run and buffer_type == "episode":
+                dones = np.ones_like(dones)
+                terminated = np.ones_like(terminated)
+
+        if cfg.metric.log_level > 0 and "final_info" in infos:
+            ep = infos["final_info"].get("episode")
+            if ep is not None:
+                for i in np.nonzero(infos["final_info"]["_episode"])[0]:
+                    if aggregator and not aggregator.disabled:
+                        aggregator.update("Rewards/rew_avg", float(ep["r"][i]))
+                        aggregator.update("Game/ep_len_avg", float(ep["l"][i]))
+                    runtime.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={float(ep['r'][i])}")
+
+        real_next_obs = {k: np.array(v) for k, v in next_obs.items()}
+        if "final_obs" in infos:
+            for idx in np.nonzero(infos["_final_obs"])[0]:
+                for k, v in infos["final_obs"][idx].items():
+                    real_next_obs[k][idx] = v
+
+        for k in obs_keys:
+            step_data[k] = real_next_obs[k][np.newaxis]
+        obs = next_obs
+
+        step_data["terminated"] = terminated.reshape((1, total_envs, -1)).astype(np.float32)
+        step_data["truncated"] = truncated.reshape((1, total_envs, -1)).astype(np.float32)
+        step_data["actions"] = np.asarray(actions).reshape(1, total_envs, -1)
+        step_data["rewards"] = clip_rewards_fn(rewards.reshape((1, total_envs, -1)))
+        rb.add(step_data, validate_args=cfg.buffer.validate_args)
+
+        dones_idxes = dones.nonzero()[0].tolist()
+        reset_envs = len(dones_idxes)
+        if reset_envs > 0:
+            reset_data = {}
+            for k in obs_keys:
+                reset_data[k] = (next_obs[k][dones_idxes])[np.newaxis]
+            reset_data["terminated"] = np.zeros((1, reset_envs, 1))
+            reset_data["truncated"] = np.zeros((1, reset_envs, 1))
+            reset_data["actions"] = np.zeros((1, reset_envs, int(np.sum(actions_dim))))
+            reset_data["rewards"] = np.zeros((1, reset_envs, 1))
+            reset_data["is_first"] = np.ones_like(reset_data["terminated"])
+            rb.add(reset_data, dones_idxes, validate_args=cfg.buffer.validate_args)
+            step_data["terminated"][:, dones_idxes] = 0.0
+            step_data["truncated"][:, dones_idxes] = 0.0
+            player.init_states(reset_envs=dones_idxes)
+
+        # ------------------------------------------------------ train
+        if iter_num >= learning_starts:
+            ratio_steps = policy_step - prefill_steps * policy_steps_per_iter
+            per_rank_gradient_steps = ratio(ratio_steps / world_size)
+            if per_rank_gradient_steps > 0:
+                if player.actor_type != "task":
+                    # player switches to the task actor once learning starts
+                    # (reference p2e_dv2_finetuning.py:360-365)
+                    player.actor_type = "task"
+                    player.params = {
+                        "world_model": dv2_params["world_model"],
+                        "actor": dv2_params["actor"],
+                    }
+                local_data = rb.sample(
+                    cfg.algo.per_rank_batch_size * world_size,
+                    sequence_length=cfg.algo.per_rank_sequence_length,
+                    n_samples=per_rank_gradient_steps,
+                    prioritize_ends=cfg.buffer.get("prioritize_ends", False),
+                )
+                with timer("Time/train_time", SumMetric, sync_on_compute=cfg.metric.sync_on_compute):
+                    for i in range(per_rank_gradient_steps):
+                        if (
+                            cumulative_per_rank_gradient_steps
+                            % cfg.algo.critic.per_rank_target_network_update_freq
+                            == 0
+                        ):
+                            dv2_params["target_critic"] = _hard_update(dv2_params["critic"])
+                        batch = {
+                            k: jnp.asarray(v[i], dtype=jnp.float32) for k, v in local_data.items()
+                        }
+                        dv2_params, opt_states, train_metrics = train_fn(
+                            dv2_params, opt_states, batch, runtime.next_key()
+                        )
+                        cumulative_per_rank_gradient_steps += 1
+                    train_step += world_size
+                player.params = {
+                    "world_model": dv2_params["world_model"],
+                    "actor": dv2_params["actor"],
+                }
+                if aggregator and not aggregator.disabled:
+                    for k, v in jax.device_get(train_metrics).items():
+                        aggregator.update(k, v)
+
+        # ------------------------------------------------------ logging
+        if cfg.metric.log_level > 0 and (
+            policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters
+        ):
+            if logger:
+                if aggregator and not aggregator.disabled:
+                    logger.log_metrics(aggregator.compute(), policy_step)
+                    aggregator.reset()
+                logger.log_metrics(
+                    {"Params/replay_ratio": cumulative_per_rank_gradient_steps * world_size / policy_step},
+                    policy_step,
+                )
+                if not timer.disabled:
+                    timer_metrics = timer.compute()
+                    if timer_metrics.get("Time/train_time", 0) > 0:
+                        logger.log_metrics(
+                            {"Time/sps_train": (train_step - last_train) / timer_metrics["Time/train_time"]},
+                            policy_step,
+                        )
+                    if timer_metrics.get("Time/env_interaction_time", 0) > 0:
+                        logger.log_metrics(
+                            {
+                                "Time/sps_env_interaction": (
+                                    (policy_step - last_log) / world_size * cfg.env.action_repeat
+                                )
+                                / timer_metrics["Time/env_interaction_time"]
+                            },
+                            policy_step,
+                        )
+                    timer.reset()
+            last_log = policy_step
+            last_train = train_step
+
+        # ------------------------------------------------------ checkpoint
+        if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
+            iter_num == total_iters and cfg.checkpoint.save_last
+        ):
+            last_checkpoint = policy_step
+            ckpt_state = {
+                "world_model": dv2_params["world_model"],
+                "actor_task": dv2_params["actor"],
+                "critic_task": dv2_params["critic"],
+                "target_critic_task": dv2_params["target_critic"],
+                "actor_exploration": params["actor_exploration"],
+                "opt_states": {
+                    "world_model": opt_states["world_model"],
+                    "actor_task": opt_states["actor"],
+                    "critic_task": opt_states["critic"],
+                },
+                "ratio": ratio.state_dict(),
+                "iter_num": iter_num * world_size,
+                "batch_size": cfg.algo.per_rank_batch_size * world_size,
+                "last_log": last_log,
+                "last_checkpoint": last_checkpoint,
+            }
+            if cfg.buffer.checkpoint:
+                ckpt_state["rb"] = rb
+            ckpt_cb.save(
+                runtime,
+                os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{runtime.global_rank}.ckpt"),
+                ckpt_state,
+            )
+
+    envs.close()
+    # task test few-shot
+    if runtime.is_global_zero and cfg.algo.run_test:
+        player.actor_type = "task"
+        player.params = {"world_model": dv2_params["world_model"], "actor": dv2_params["actor"]}
+        test_rew = test(player, runtime, cfg, log_dir, "few-shot")
+        if logger:
+            logger.log_metrics({"Test/cumulative_reward": test_rew}, policy_step)
+    if logger:
+        logger.finalize()

@@ -454,6 +454,42 @@ def test_p2e_dv1(standard_args, tmp_path):
     _run(ft_args)
 
 
+def test_p2e_dv2(standard_args, tmp_path):
+    """Exploration -> finetuning chain on the DV2 skeleton."""
+    import glob
+
+    root = f"{tmp_path}/p2edv2"
+    args = standard_args + _dv2_tiny_args() + [
+        "exp=p2e_dv2_exploration",
+        "env=dummy",
+        "algo.mlp_keys.encoder=[state]",
+        "algo.cnn_keys.encoder=[]",
+        "algo.ensembles.n=2",
+        "algo.ensembles.dense_units=8",
+        "algo.ensembles.mlp_layers=1",
+        "fabric.devices=1",
+        f"root_dir={root}",
+        f"metric.logger.root_dir={tmp_path}/logs_p2edv2",
+    ]
+    _run(args)
+    ckpts = sorted(glob.glob(f"{root}/**/ckpt_*.ckpt", recursive=True))
+    assert len(ckpts) > 0
+    ft_args = standard_args + _dv2_tiny_args() + [
+        "exp=p2e_dv2_finetuning",
+        "env=dummy",
+        "algo.mlp_keys.encoder=[state]",
+        "algo.cnn_keys.encoder=[]",
+        "algo.ensembles.n=2",
+        "algo.ensembles.dense_units=8",
+        "algo.ensembles.mlp_layers=1",
+        f"checkpoint.exploration_ckpt_path={ckpts[-1]}",
+        "fabric.devices=1",
+        f"root_dir={root}_ft",
+        f"metric.logger.root_dir={tmp_path}/logs_p2edv2_ft",
+    ]
+    _run(ft_args)
+
+
 def test_p2e_dv3(standard_args, tmp_path):
     """Exploration -> finetuning chain on the DV3 skeleton."""
     import glob
