@@ -1,1 +1,1 @@
-from sheeprl_tpu.algos.ppo import evaluate, ppo  # noqa: F401  (registry side-effect)
+from sheeprl_tpu.algos.ppo import evaluate, ppo, ppo_decoupled  # noqa: F401  (registry side-effect)

@@ -1,0 +1,444 @@
+"""PPO decoupled — CPU-player / TPU-learner topology.
+
+Counterpart of reference sheeprl/algos/ppo/ppo_decoupled.py (player:32,
+trainer:368, main:623). The reference implements the split with
+torch.distributed process ranks (rank-0 player + DDP trainer group) and
+explicit TorchCollective object collectives. The idiomatic TPU mapping
+(SURVEY.md §5.8) replaces both:
+
+- the TRAINER is the main process: it owns the accelerator mesh and runs
+  the same single-jit PPO update as the coupled path (GAE + epochs x
+  minibatches); data parallelism is the mesh ``data`` axis, so the
+  reference's "N-1 DDP trainer ranks" collapse into one SPMD program;
+- the PLAYER is a spawned subprocess pinned to the host CPU backend
+  (``JAX_PLATFORMS=cpu``): it owns ALL the envs (reference
+  ppo_decoupled.py:67), the logger and the checkpoint files, exactly like
+  the reference's rank-0;
+- the TorchCollective protocol becomes two multiprocessing queues:
+  ``scatter_object_list`` (data -> trainers, reference :299) is the data
+  queue; the flattened-params ``broadcast`` (trainer-1 -> player, :302) and
+  metrics broadcast (:578) ride the response queue; the trainer-state
+  handoff for ``on_checkpoint_player`` (:337) is a ``need_ckpt_state`` flag
+  answered with optimizer state; the ``-1`` shutdown sentinel (:344) is a
+  ``("stop",)`` message.
+"""
+
+from __future__ import annotations
+
+import copy
+import multiprocessing as mp
+import os
+import warnings
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.ppo.agent import PPOPlayer, build_agent
+from sheeprl_tpu.algos.ppo.ppo import build_ppo_optimizer, make_update_fn
+from sheeprl_tpu.algos.ppo.utils import prepare_obs, test
+from sheeprl_tpu.config import instantiate
+from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.utils.callback import CheckpointCallback, load_checkpoint
+from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
+from sheeprl_tpu.utils.registry import register_algorithm
+from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.utils.utils import polynomial_decay, save_configs
+
+# generous IPC timeout: the first trainer reply waits on a fresh XLA
+# compile of the full update (~20-40s on TPU)
+_QUEUE_TIMEOUT_S = 600.0
+
+
+def _np_tree(tree: Any) -> Any:
+    """Pytree -> host numpy (the queue transport format)."""
+    return jax.tree_util.tree_map(np.asarray, jax.device_get(tree))
+
+
+def _player_loop(cfg, data_q: mp.Queue, resp_q: mp.Queue, state_counters) -> None:
+    """Player process body (reference ppo_decoupled.py:32-365).
+
+    Runs on the host CPU backend (the parent exports JAX_PLATFORMS=cpu
+    around the spawn): owns envs, logger, rollout buffer, checkpoints, and
+    the live policy used for acting; receives refreshed weights from the
+    trainer once per iteration.
+    """
+    import gymnasium as gym
+    from gymnasium.vector import AsyncVectorEnv, AutoresetMode, SyncVectorEnv
+
+    from sheeprl_tpu.parallel.mesh import MeshRuntime
+
+    if cfg.metric.log_level == 0:
+        MetricAggregator.disabled = True
+        timer.disabled = True
+    if cfg.metric.get("disable_timer", False):
+        timer.disabled = True
+
+    runtime = MeshRuntime(devices=1, accelerator="cpu", precision=cfg.fabric.precision)
+    runtime.launch()
+    runtime.seed_everything(cfg.seed)
+
+    logger = get_logger(runtime, cfg)
+    log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name)
+    runtime.print(f"Log dir: {log_dir}")
+    if logger:
+        logger.log_hyperparams(cfg)
+
+    # ALL envs live on the player (reference ppo_decoupled.py:67)
+    total_envs = int(cfg.env.num_envs)
+    thunks = [
+        make_env(cfg, cfg.seed + i, 0, log_dir, "train", vector_env_idx=i)
+        for i in range(total_envs)
+    ]
+    envs = (
+        SyncVectorEnv(thunks, autoreset_mode=AutoresetMode.SAME_STEP)
+        if cfg.env.sync_env
+        else AsyncVectorEnv(thunks, context="spawn", autoreset_mode=AutoresetMode.SAME_STEP)
+    )
+    observation_space = envs.single_observation_space
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+    cnn_keys = cfg.algo.cnn_keys.encoder
+    mlp_keys = cfg.algo.mlp_keys.encoder
+    obs_keys = cnn_keys + mlp_keys
+    if obs_keys == []:
+        raise RuntimeError("Specify at least one of `cnn_keys.encoder` or `mlp_keys.encoder`")
+
+    is_continuous = isinstance(envs.single_action_space, gym.spaces.Box)
+    is_multidiscrete = isinstance(envs.single_action_space, gym.spaces.MultiDiscrete)
+    actions_dim = tuple(
+        envs.single_action_space.shape
+        if is_continuous
+        else (envs.single_action_space.nvec.tolist() if is_multidiscrete else [envs.single_action_space.n])
+    )
+    clip_rewards_fn = (lambda r: np.tanh(r)) if cfg.env.clip_rewards else (lambda r: r)
+
+    # hand the agent blueprint to the trainer (reference broadcasts
+    # agent_args from the player, :117)
+    data_q.put(("init", observation_space, actions_dim, is_continuous))
+
+    # inference-only agent; weights arrive from the trainer (reference :126)
+    module, params = build_agent(runtime, actions_dim, is_continuous, cfg, observation_space)
+    tag, payload = resp_q.get(timeout=_QUEUE_TIMEOUT_S)
+    assert tag == "params", f"expected initial params, got {tag}"
+    player = PPOPlayer(
+        module,
+        jax.tree_util.tree_map(jnp.asarray, payload),
+        lambda o: prepare_obs(o, cnn_keys=cnn_keys, num_envs=total_envs),
+    )
+
+    save_configs(cfg, log_dir)
+
+    aggregator = None
+    if not MetricAggregator.disabled:
+        aggregator = instantiate(dict(cfg.metric.aggregator))
+
+    if cfg.buffer.size < cfg.algo.rollout_steps:
+        raise ValueError(
+            f"The size of the buffer ({cfg.buffer.size}) cannot be lower "
+            f"than the rollout steps ({cfg.algo.rollout_steps})"
+        )
+    rb = ReplayBuffer(
+        cfg.buffer.size,
+        total_envs,
+        memmap=cfg.buffer.memmap,
+        memmap_dir=os.path.join(log_dir, "memmap_buffer", "rank_0"),
+        obs_keys=obs_keys,
+    )
+    ckpt_cb = CheckpointCallback(keep_last=cfg.checkpoint.keep_last)
+
+    start_iter, policy_step, last_log, last_checkpoint = state_counters
+    train_step = 0
+    last_train = 0
+    train_time_window = 0.0  # trainer-side seconds accumulated since last log
+    policy_steps_per_iter = int(cfg.env.num_envs * cfg.algo.rollout_steps)
+    total_iters = cfg.algo.total_steps // policy_steps_per_iter if not cfg.dry_run else 1
+    if cfg.metric.log_level > 0 and cfg.metric.log_every % policy_steps_per_iter != 0:
+        warnings.warn(
+            f"metric.log_every ({cfg.metric.log_every}) is not a multiple of "
+            f"policy_steps_per_iter ({policy_steps_per_iter}); metrics log at the next multiple."
+        )
+
+    step_data: Dict[str, np.ndarray] = {}
+    next_obs_np = envs.reset(seed=cfg.seed)[0]
+
+    for iter_num in range(start_iter, total_iters + 1):
+        for _ in range(cfg.algo.rollout_steps):
+            policy_step += cfg.env.num_envs
+
+            with timer("Time/env_interaction_time", SumMetric, sync_on_compute=False):
+                flat_actions, real_actions, logprobs, values = player.get_actions(
+                    next_obs_np, runtime.next_key()
+                )
+                real_actions_np = np.asarray(real_actions)
+                obs, rewards, terminated, truncated, info = envs.step(
+                    real_actions_np.reshape(envs.action_space.shape)
+                )
+                truncated_envs = np.nonzero(truncated)[0]
+                if len(truncated_envs) > 0:
+                    real_next_obs = {k: np.array(v) for k, v in obs.items()}
+                    for env_idx in truncated_envs:
+                        final = info["final_obs"][env_idx]
+                        for k in obs_keys:
+                            real_next_obs[k][env_idx] = final[k]
+                    vals = np.asarray(player.get_values(real_next_obs))
+                    rewards[truncated_envs] += cfg.algo.gamma * vals[truncated_envs].reshape(
+                        rewards[truncated_envs].shape
+                    )
+                dones = np.logical_or(terminated, truncated).reshape(total_envs, 1).astype(np.uint8)
+                rewards = clip_rewards_fn(rewards).reshape(total_envs, 1).astype(np.float32)
+
+            for k in obs_keys:
+                step_data[k] = next_obs_np[k][np.newaxis]
+            step_data["dones"] = dones[np.newaxis]
+            step_data["values"] = np.asarray(values)[np.newaxis]
+            step_data["actions"] = np.asarray(flat_actions)[np.newaxis]
+            step_data["logprobs"] = np.asarray(logprobs)[np.newaxis]
+            step_data["rewards"] = rewards[np.newaxis]
+            rb.add(step_data, validate_args=cfg.buffer.validate_args)
+
+            next_obs_np = obs
+
+            if cfg.metric.log_level > 0 and "final_info" in info:
+                ep = info["final_info"].get("episode")
+                if ep is not None:
+                    for i in np.nonzero(info["final_info"]["_episode"])[0]:
+                        ep_rew = float(ep["r"][i])
+                        ep_len = float(ep["l"][i])
+                        if aggregator and "Rewards/rew_avg" in aggregator:
+                            aggregator.update("Rewards/rew_avg", ep_rew)
+                        if aggregator and "Game/ep_len_avg" in aggregator:
+                            aggregator.update("Game/ep_len_avg", ep_len)
+                        runtime.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}")
+
+        # --------------------------------------------- ship rollout to trainer
+        need_ckpt = (
+            cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every
+        ) or (iter_num == total_iters and cfg.checkpoint.save_last)
+        local_data = {k: np.asarray(v) for k, v in rb.to_arrays().items()}
+        final_obs = {k: np.asarray(next_obs_np[k]) for k in obs_keys}
+        data_q.put(("data", local_data, final_obs, need_ckpt))
+
+        # --------------------------------------------- refreshed weights back
+        tag, new_params, train_metrics, opt_state_np, info_scalars = resp_q.get(
+            timeout=_QUEUE_TIMEOUT_S
+        )
+        assert tag == "update", f"expected update, got {tag}"
+        player.params = jax.tree_util.tree_map(jnp.asarray, new_params)
+        train_step += 1
+        train_time_window += info_scalars.pop("train_time", 0.0)
+
+        if aggregator and not aggregator.disabled:
+            for k, v in train_metrics.items():
+                aggregator.update(k, v)
+
+        # --------------------------------------------- logging (player-side)
+        if cfg.metric.log_level > 0 and logger:
+            logger.log_metrics(info_scalars, policy_step)
+            if policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters:
+                if aggregator and not aggregator.disabled:
+                    logger.log_metrics(aggregator.compute(), policy_step)
+                    aggregator.reset()
+                if not timer.disabled:
+                    timer_metrics = timer.compute()
+                    if train_time_window > 0:
+                        logger.log_metrics(
+                            {"Time/sps_train": (train_step - last_train) / train_time_window},
+                            policy_step,
+                        )
+                        train_time_window = 0.0
+                    if timer_metrics.get("Time/env_interaction_time", 0) > 0:
+                        logger.log_metrics(
+                            {
+                                "Time/sps_env_interaction": (
+                                    (policy_step - last_log) * cfg.env.action_repeat
+                                )
+                                / timer_metrics["Time/env_interaction_time"]
+                            },
+                            policy_step,
+                        )
+                    timer.reset()
+                last_log = policy_step
+                last_train = train_step
+
+        # --------------------------------------------- checkpoint (player saves,
+        # trainer state received on demand — reference on_checkpoint_player :337)
+        if need_ckpt:
+            last_checkpoint = policy_step
+            ckpt_state = {
+                "agent": new_params,
+                "optimizer": opt_state_np,
+                "iter_num": iter_num,
+                "batch_size": cfg.algo.per_rank_batch_size,
+                "last_log": last_log,
+                "last_checkpoint": last_checkpoint,
+            }
+            ckpt_path = os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_0.ckpt")
+            ckpt_cb.save(runtime, ckpt_path, ckpt_state)
+
+    # shutdown sentinel (reference scatters -1, :344)
+    data_q.put(("stop",))
+    envs.close()
+    if cfg.algo.run_test:
+        test_rew = test(player, runtime, cfg, log_dir)
+        if logger:
+            logger.log_metrics({"Test/cumulative_reward": test_rew}, policy_step)
+    if logger:
+        logger.finalize()
+
+
+@register_algorithm(decoupled=True)
+def main(runtime, cfg: Dict[str, Any]):
+    """Trainer process body + player spawn (reference ppo_decoupled.py:368-621).
+
+    The trainer never touches an env: it answers each rollout message with
+    refreshed weights, running the coupled PPO single-jit update over the
+    mesh (the reference's DDP trainer subgroup)."""
+    if "minedojo" in str(cfg.env.wrapper.get("_target_", "")).lower():
+        raise ValueError(
+            "MineDojo is not currently supported by the PPO agent (no action-mask handling); "
+            "use one of the Dreamer agents."
+        )
+
+    initial_ent_coef = copy.deepcopy(cfg.algo.ent_coef)
+    initial_clip_coef = copy.deepcopy(cfg.algo.clip_coef)
+
+    runtime.seed_everything(cfg.seed)
+
+    state = None
+    if cfg.checkpoint.resume_from:
+        state = load_checkpoint(cfg.checkpoint.resume_from)
+        cfg.algo.per_rank_batch_size = state["batch_size"]
+
+    start_iter = state["iter_num"] + 1 if state else 1
+    policy_step = (
+        state["iter_num"] * cfg.env.num_envs * cfg.algo.rollout_steps if state else 0
+    )
+    counters = (
+        start_iter,
+        policy_step,
+        state["last_log"] if state else 0,
+        state["last_checkpoint"] if state else 0,
+    )
+
+    # spawn the player pinned to the host CPU backend: the env copies the
+    # parent's environ at start, so the override only affects the child
+    ctx = mp.get_context("spawn")
+    data_q: mp.Queue = ctx.Queue()
+    resp_q: mp.Queue = ctx.Queue()
+    saved_platform = os.environ.get("JAX_PLATFORMS")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        player_proc = ctx.Process(
+            target=_player_loop, args=(cfg, data_q, resp_q, counters), daemon=False
+        )
+        player_proc.start()
+    finally:
+        if saved_platform is None:
+            os.environ.pop("JAX_PLATFORMS", None)
+        else:
+            os.environ["JAX_PLATFORMS"] = saved_platform
+
+    try:
+        tag, observation_space, actions_dim, is_continuous = data_q.get(timeout=_QUEUE_TIMEOUT_S)
+        assert tag == "init", f"expected init, got {tag}"
+        obs_keys = cfg.algo.cnn_keys.encoder + cfg.algo.mlp_keys.encoder
+
+        module, params = build_agent(
+            runtime,
+            actions_dim,
+            is_continuous,
+            cfg,
+            observation_space,
+            state["agent"] if state else None,
+        )
+        params = runtime.replicate(params)
+        tx = build_ppo_optimizer(cfg.algo.optimizer, cfg.algo.max_grad_norm)
+        opt_state = (
+            runtime.replicate(tx.init(params))
+            if state is None
+            else jax.tree_util.tree_map(jnp.asarray, state["optimizer"])
+        )
+        update_fn = make_update_fn(runtime, module, tx, cfg, obs_keys)
+
+        # initial weights to the player (reference broadcast, :126)
+        resp_q.put(("params", _np_tree(params)))
+
+        policy_steps_per_iter = int(cfg.env.num_envs * cfg.algo.rollout_steps)
+        total_iters = cfg.algo.total_steps // policy_steps_per_iter if not cfg.dry_run else 1
+
+        lr0 = float(cfg.algo.optimizer.get("learning_rate", cfg.algo.optimizer.get("lr", 1e-3)))
+        current_lr = lr0
+        current_clip = float(cfg.algo.clip_coef)
+        current_ent = float(cfg.algo.ent_coef)
+
+        iter_num = start_iter - 1
+        while True:
+            msg = data_q.get(timeout=_QUEUE_TIMEOUT_S)
+            if msg[0] == "stop":
+                break
+            _, local_data, final_obs, need_ckpt = msg
+            iter_num += 1
+
+            local_data = {
+                k: v.astype(np.float32) if v.dtype not in (np.uint8,) else v
+                for k, v in local_data.items()
+            }
+            device_next_obs = {k: jnp.asarray(v) for k, v in final_obs.items()}
+
+            with timer("Time/train_time", SumMetric, sync_on_compute=cfg.metric.sync_on_compute):
+                params, opt_state, train_metrics = update_fn(
+                    params,
+                    opt_state,
+                    local_data,
+                    device_next_obs,
+                    runtime.next_key(),
+                    jnp.float32(current_clip),
+                    jnp.float32(current_ent),
+                    jnp.float32(current_lr),
+                )
+                train_metrics = {k: float(v) for k, v in jax.device_get(train_metrics).items()}
+
+            info_scalars = {
+                "Info/learning_rate": current_lr,
+                "Info/clip_coef": current_clip,
+                "Info/ent_coef": current_ent,
+            }
+            if not timer.disabled:
+                info_scalars["train_time"] = float(timer.compute().get("Time/train_time", 0.0))
+                timer.reset()
+
+            # annealing lives on the trainer (reference :528-540)
+            if cfg.algo.anneal_lr:
+                current_lr = polynomial_decay(
+                    iter_num, initial=lr0, final=0.0, max_decay_steps=total_iters, power=1.0
+                )
+            if cfg.algo.anneal_clip_coef:
+                current_clip = polynomial_decay(
+                    iter_num, initial=initial_clip_coef, final=0.0,
+                    max_decay_steps=total_iters, power=1.0,
+                )
+            if cfg.algo.anneal_ent_coef:
+                current_ent = polynomial_decay(
+                    iter_num, initial=initial_ent_coef, final=0.0,
+                    max_decay_steps=total_iters, power=1.0,
+                )
+
+            resp_q.put(
+                (
+                    "update",
+                    _np_tree(params),
+                    train_metrics,
+                    _np_tree(opt_state) if need_ckpt else None,
+                    info_scalars,
+                )
+            )
+
+        player_proc.join(timeout=_QUEUE_TIMEOUT_S)
+    finally:
+        if player_proc.is_alive():
+            player_proc.terminate()
+            player_proc.join()

@@ -60,6 +60,28 @@ def test_ppo(standard_args, devices, tmp_path):
     assert len(ckpts) >= 0  # log_dir layout asserted in test_cli
 
 
+def test_ppo_decoupled(standard_args, devices, tmp_path):
+    """CPU-player/TPU-learner decoupled topology (reference
+    test_algos.py test_ppo_decoupled:187): the player subprocess owns the
+    envs + checkpoints, the trainer answers with refreshed weights."""
+    import glob
+
+    args = standard_args + [
+        "exp=ppo_decoupled",
+        "algo.rollout_steps=8",
+        "algo.per_rank_batch_size=4",
+        "algo.update_epochs=2",
+        "algo.dense_units=8",
+        "algo.mlp_layers=1",
+        "algo.mlp_keys.encoder=[state]",
+        f"fabric.devices={devices}",
+        f"root_dir={tmp_path}/ppodec",
+    ]
+    _run(args)
+    ckpts = glob.glob(f"{tmp_path}/ppodec/**/ckpt_*.ckpt", recursive=True)
+    assert len(ckpts) > 0
+
+
 def test_ppo_continuous(standard_args, tmp_path):
     args = standard_args + [
         "exp=ppo",
