@@ -58,7 +58,7 @@ def _np_tree(tree: Any) -> Any:
     return jax.tree_util.tree_map(np.asarray, jax.device_get(tree))
 
 
-def _player_loop(cfg, data_q: mp.Queue, resp_q: mp.Queue, state_counters) -> None:
+def _player_loop(cfg, data_q: mp.Queue, resp_q: mp.Queue, state_counters, world_size: int) -> None:
     """Player process body (reference ppo_decoupled.py:32-365).
 
     Runs on the host CPU backend (the parent exports JAX_PLATFORMS=cpu
@@ -268,11 +268,13 @@ def _player_loop(cfg, data_q: mp.Queue, resp_q: mp.Queue, state_counters) -> Non
         # trainer state received on demand — reference on_checkpoint_player :337)
         if need_ckpt:
             last_checkpoint = policy_step
+            # iter_num/batch_size stored in coupled units (scaled by the
+            # trainer mesh size) so checkpoints swap between variants
             ckpt_state = {
                 "agent": new_params,
                 "optimizer": opt_state_np,
-                "iter_num": iter_num,
-                "batch_size": cfg.algo.per_rank_batch_size,
+                "iter_num": iter_num * world_size,
+                "batch_size": cfg.algo.per_rank_batch_size * world_size,
                 "last_log": last_log,
                 "last_checkpoint": last_checkpoint,
             }
@@ -311,11 +313,13 @@ def main(runtime, cfg: Dict[str, Any]):
     state = None
     if cfg.checkpoint.resume_from:
         state = load_checkpoint(cfg.checkpoint.resume_from)
-        cfg.algo.per_rank_batch_size = state["batch_size"]
+        cfg.algo.per_rank_batch_size = state["batch_size"] // runtime.world_size
 
-    start_iter = state["iter_num"] + 1 if state else 1
+    start_iter = (state["iter_num"] // runtime.world_size) + 1 if state else 1
     policy_step = (
-        state["iter_num"] * cfg.env.num_envs * cfg.algo.rollout_steps if state else 0
+        (state["iter_num"] // runtime.world_size) * cfg.env.num_envs * cfg.algo.rollout_steps
+        if state
+        else 0
     )
     counters = (
         start_iter,
@@ -333,7 +337,7 @@ def main(runtime, cfg: Dict[str, Any]):
     os.environ["JAX_PLATFORMS"] = "cpu"
     try:
         player_proc = ctx.Process(
-            target=_player_loop, args=(cfg, data_q, resp_q, counters), daemon=False
+            target=_player_loop, args=(cfg, data_q, resp_q, counters, runtime.world_size), daemon=False
         )
         player_proc.start()
     finally:

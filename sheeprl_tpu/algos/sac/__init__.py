@@ -1,1 +1,1 @@
-from sheeprl_tpu.algos.sac import evaluate, sac  # noqa: F401  (registry side-effect)
+from sheeprl_tpu.algos.sac import evaluate, sac, sac_decoupled  # noqa: F401  (registry side-effect)

@@ -1,0 +1,395 @@
+"""SAC decoupled — CPU-player / TPU-learner topology.
+
+Counterpart of reference sheeprl/algos/sac/sac_decoupled.py (player:33,
+trainer:356, main:548). Same process split as
+``sheeprl_tpu.algos.ppo.ppo_decoupled`` (which see for the mapping from
+the reference's TorchCollective groups to host IPC queues), with the
+off-policy twists of the reference:
+
+- the PLAYER owns the replay buffer and the ``Ratio`` replay-ratio
+  scheduler: each iteration past ``learning_starts`` it samples
+  ``G x batch_size`` transitions in one call and ships them (reference
+  sample-and-scatter, sac_decoupled.py:243-257);
+- the trainer runs the coupled SAC single-jit ``lax.scan`` over the G
+  gradient steps and answers with refreshed ACTOR weights only (the critics
+  never act; reference broadcasts the actor vector, :261-263), plus the
+  full agent + optimizer state when the player flags a checkpoint
+  (reference on_checkpoint_player, :314).
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import warnings
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.ppo.ppo_decoupled import _QUEUE_TIMEOUT_S, _np_tree
+from sheeprl_tpu.algos.sac.agent import SACPlayer, build_agent
+from sheeprl_tpu.algos.sac.sac import _make_optimizer, make_train_fn
+from sheeprl_tpu.algos.sac.utils import prepare_obs, test
+from sheeprl_tpu.config import instantiate
+from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.utils.callback import CheckpointCallback, load_checkpoint, restore_buffer
+from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
+from sheeprl_tpu.utils.registry import register_algorithm
+from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.utils.utils import Ratio, save_configs
+
+
+def _player_loop(
+    cfg, data_q: mp.Queue, resp_q: mp.Queue, state_counters, ratio_state, rb_state, world_size: int
+) -> None:
+    """Player process body (reference sac_decoupled.py:33-353)."""
+    import gymnasium as gym
+    from gymnasium.vector import AsyncVectorEnv, AutoresetMode, SyncVectorEnv
+
+    from sheeprl_tpu.parallel.mesh import MeshRuntime
+
+    if cfg.metric.log_level == 0:
+        MetricAggregator.disabled = True
+        timer.disabled = True
+    if cfg.metric.get("disable_timer", False):
+        timer.disabled = True
+
+    runtime = MeshRuntime(devices=1, accelerator="cpu", precision=cfg.fabric.precision)
+    runtime.launch()
+    runtime.seed_everything(cfg.seed)
+
+    logger = get_logger(runtime, cfg)
+    log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name)
+    runtime.print(f"Log dir: {log_dir}")
+    if logger:
+        logger.log_hyperparams(cfg)
+
+    total_envs = int(cfg.env.num_envs)
+    thunks = [
+        make_env(cfg, cfg.seed + i, 0, log_dir, "train", vector_env_idx=i)
+        for i in range(total_envs)
+    ]
+    envs = (
+        SyncVectorEnv(thunks, autoreset_mode=AutoresetMode.SAME_STEP)
+        if cfg.env.sync_env
+        else AsyncVectorEnv(thunks, context="spawn", autoreset_mode=AutoresetMode.SAME_STEP)
+    )
+    action_space = envs.single_action_space
+    observation_space = envs.single_observation_space
+    if not isinstance(action_space, gym.spaces.Box):
+        raise ValueError("Only continuous action space is supported for the SAC agent")
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+    if len(cfg.algo.mlp_keys.encoder) == 0:
+        raise RuntimeError("You should specify at least one MLP key for the encoder: `mlp_keys.encoder=[state]`")
+    for k in cfg.algo.mlp_keys.encoder:
+        if len(observation_space[k].shape) > 1:
+            raise ValueError(
+                f"Only vector observations are supported by SAC; key '{k}' has shape "
+                f"{observation_space[k].shape}"
+            )
+    mlp_keys = list(cfg.algo.mlp_keys.encoder)
+
+    data_q.put(("init", observation_space, action_space))
+
+    actor, critic, params, _ = build_agent(runtime, cfg, observation_space, action_space)
+    tag, payload = resp_q.get(timeout=_QUEUE_TIMEOUT_S)
+    assert tag == "params", f"expected initial params, got {tag}"
+    player = SACPlayer(
+        actor,
+        jax.tree_util.tree_map(jnp.asarray, payload),
+        lambda obs: prepare_obs(obs, mlp_keys=mlp_keys, num_envs=total_envs),
+    )
+
+    save_configs(cfg, log_dir)
+
+    aggregator = None
+    if not MetricAggregator.disabled:
+        aggregator = instantiate(dict(cfg.metric.aggregator))
+
+    buffer_size = cfg.buffer.size // int(total_envs) if not cfg.dry_run else 1
+    rb = ReplayBuffer(
+        max(buffer_size, 1),
+        total_envs,
+        memmap=cfg.buffer.memmap,
+        memmap_dir=os.path.join(log_dir, "memmap_buffer", "rank_0"),
+        obs_keys=("observations",),
+    )
+    if rb_state is not None:
+        rb = restore_buffer(
+            rb_state,
+            memmap=cfg.buffer.memmap,
+            memmap_dir=os.path.join(log_dir, "memmap_buffer", "rank_0"),
+        )
+    ckpt_cb = CheckpointCallback(keep_last=cfg.checkpoint.keep_last)
+
+    start_iter, policy_step, last_log, last_checkpoint = state_counters
+    train_step = 0
+    last_train = 0
+    train_time_window = 0.0
+    policy_steps_per_iter = int(total_envs)
+    total_iters = int(cfg.algo.total_steps // policy_steps_per_iter) if not cfg.dry_run else 1
+    learning_starts = cfg.algo.learning_starts // policy_steps_per_iter if not cfg.dry_run else 0
+    prefill_steps = learning_starts - int(learning_starts > 0)
+    if start_iter > 1:
+        learning_starts += start_iter
+        prefill_steps += start_iter
+
+    ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
+    if ratio_state is not None:
+        ratio.load_state_dict(ratio_state)
+
+    step_data: Dict[str, np.ndarray] = {}
+    obs = envs.reset(seed=cfg.seed)[0]
+
+    cumulative_per_rank_gradient_steps = 0
+    for iter_num in range(start_iter, total_iters + 1):
+        policy_step += policy_steps_per_iter
+
+        with timer("Time/env_interaction_time", SumMetric, sync_on_compute=False):
+            if iter_num <= learning_starts:
+                actions = envs.action_space.sample()
+            else:
+                actions = np.asarray(player.get_actions(obs, runtime.next_key()))
+            next_obs, rewards, terminated, truncated, infos = envs.step(
+                actions.reshape(envs.action_space.shape)
+            )
+            rewards = rewards.reshape(total_envs, -1)
+
+        if cfg.metric.log_level > 0 and "final_info" in infos:
+            ep = infos["final_info"].get("episode")
+            if ep is not None:
+                for i in np.nonzero(infos["final_info"]["_episode"])[0]:
+                    if aggregator and not aggregator.disabled:
+                        aggregator.update("Rewards/rew_avg", float(ep["r"][i]))
+                        aggregator.update("Game/ep_len_avg", float(ep["l"][i]))
+                    runtime.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={float(ep['r'][i])}")
+
+        real_next_obs = {k: np.array(v) for k, v in next_obs.items()}
+        if "final_obs" in infos:
+            for idx in np.nonzero(infos["_final_obs"])[0]:
+                for k, v in infos["final_obs"][idx].items():
+                    real_next_obs[k][idx] = v
+        flat_next_obs = np.concatenate([real_next_obs[k] for k in mlp_keys], axis=-1).astype(np.float32)
+
+        step_data["terminated"] = terminated.reshape(1, total_envs, -1).astype(np.uint8)
+        step_data["truncated"] = truncated.reshape(1, total_envs, -1).astype(np.uint8)
+        step_data["actions"] = actions.reshape(1, total_envs, -1).astype(np.float32)
+        step_data["observations"] = np.concatenate([obs[k] for k in mlp_keys], axis=-1).astype(np.float32)[
+            np.newaxis
+        ]
+        if not cfg.buffer.sample_next_obs:
+            step_data["next_observations"] = flat_next_obs[np.newaxis]
+        step_data["rewards"] = rewards[np.newaxis].astype(np.float32)
+        rb.add(step_data, validate_args=cfg.buffer.validate_args)
+        obs = next_obs
+
+        # ------------------------------------------ sample-and-ship to trainer
+        if iter_num >= learning_starts:
+            per_rank_gradient_steps = ratio(
+                (policy_step - prefill_steps + policy_steps_per_iter) / world_size
+            )
+            if per_rank_gradient_steps > 0:
+                g = per_rank_gradient_steps
+                sample = rb.sample(
+                    batch_size=g * cfg.algo.per_rank_batch_size * world_size,
+                    sample_next_obs=cfg.buffer.sample_next_obs,
+                )
+                sample = {k: np.asarray(v) for k, v in sample.items()}
+                data_q.put(("data", sample, g, iter_num))
+
+                tag, actor_params, train_metrics = resp_q.get(timeout=_QUEUE_TIMEOUT_S)
+                assert tag == "update", f"expected update, got {tag}"
+                player.params = jax.tree_util.tree_map(jnp.asarray, actor_params)
+                cumulative_per_rank_gradient_steps += g
+                train_step += world_size
+                train_time_window += train_metrics.pop("train_time", 0.0)
+                if aggregator and not aggregator.disabled:
+                    for k, v in train_metrics.items():
+                        aggregator.update(k, v)
+
+        # ------------------------------------------ checkpoint (player saves,
+        # trainer state requested on demand so zero-gradient-step iterations
+        # and save_last still checkpoint — unlike piggybacking on the data
+        # message)
+        if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
+            iter_num == total_iters and cfg.checkpoint.save_last
+        ):
+            data_q.put(("ckpt_req",))
+            tag, full_state = resp_q.get(timeout=_QUEUE_TIMEOUT_S)
+            assert tag == "ckpt_state", f"expected ckpt_state, got {tag}"
+            last_checkpoint = policy_step
+            ckpt_state = {
+                "agent": full_state["agent"],
+                "opt_states": full_state["opt_states"],
+                "ratio": ratio.state_dict(),
+                "iter_num": iter_num * world_size,
+                "batch_size": cfg.algo.per_rank_batch_size * world_size,
+                "last_log": last_log,
+                "last_checkpoint": last_checkpoint,
+            }
+            if cfg.buffer.checkpoint:
+                ckpt_state["rb"] = rb
+            ckpt_cb.save(
+                runtime,
+                os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_0.ckpt"),
+                ckpt_state,
+            )
+
+        if cfg.metric.log_level > 0 and (
+            policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters
+        ):
+            if logger:
+                if aggregator and not aggregator.disabled:
+                    logger.log_metrics(aggregator.compute(), policy_step)
+                    aggregator.reset()
+                logger.log_metrics(
+                    {"Params/replay_ratio": cumulative_per_rank_gradient_steps * world_size / policy_step},
+                    policy_step,
+                )
+                if not timer.disabled:
+                    timer_metrics = timer.compute()
+                    if train_time_window > 0:
+                        logger.log_metrics(
+                            {"Time/sps_train": (train_step - last_train) / train_time_window},
+                            policy_step,
+                        )
+                        train_time_window = 0.0
+                    if timer_metrics.get("Time/env_interaction_time", 0) > 0:
+                        logger.log_metrics(
+                            {
+                                "Time/sps_env_interaction": (
+                                    (policy_step - last_log) * cfg.env.action_repeat
+                                )
+                                / timer_metrics["Time/env_interaction_time"]
+                            },
+                            policy_step,
+                        )
+                    timer.reset()
+            last_log = policy_step
+            last_train = train_step
+
+    # shutdown sentinel (reference scatters -1, sac_decoupled.py:328)
+    data_q.put(("stop",))
+    envs.close()
+    if cfg.algo.run_test:
+        test_rew = test(player, runtime, cfg, log_dir)
+        if logger:
+            logger.log_metrics({"Test/cumulative_reward": test_rew}, policy_step)
+    if logger:
+        logger.finalize()
+
+
+@register_algorithm(decoupled=True)
+def main(runtime, cfg: Dict[str, Any]):
+    """Trainer process body + player spawn (reference sac_decoupled.py:356-545)."""
+    runtime.seed_everything(cfg.seed)
+
+    if "minedojo" in str(cfg.env.wrapper.get("_target_", "")).lower():
+        raise ValueError("MineDojo is not supported by the SAC agent")
+    if len(cfg.algo.cnn_keys.encoder) > 0:
+        warnings.warn("SAC cannot use image observations, the CNN keys will be ignored")
+        cfg.algo.cnn_keys.encoder = []
+
+    state = None
+    if cfg.checkpoint.resume_from:
+        state = load_checkpoint(cfg.checkpoint.resume_from)
+        cfg.algo.per_rank_batch_size = state["batch_size"] // runtime.world_size
+
+    start_iter = (state["iter_num"] // runtime.world_size) + 1 if state else 1
+    counters = (
+        start_iter,
+        (state["iter_num"] // runtime.world_size) * cfg.env.num_envs if state else 0,
+        state["last_log"] if state else 0,
+        state["last_checkpoint"] if state else 0,
+    )
+    ratio_state = state["ratio"] if state else None
+    rb_state = state["rb"] if state and cfg.buffer.checkpoint and "rb" in state else None
+
+    ctx = mp.get_context("spawn")
+    data_q: mp.Queue = ctx.Queue()
+    resp_q: mp.Queue = ctx.Queue()
+    saved_platform = os.environ.get("JAX_PLATFORMS")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        player_proc = ctx.Process(
+            target=_player_loop,
+            args=(cfg, data_q, resp_q, counters, ratio_state, rb_state, runtime.world_size),
+            daemon=False,
+        )
+        player_proc.start()
+    finally:
+        if saved_platform is None:
+            os.environ.pop("JAX_PLATFORMS", None)
+        else:
+            os.environ["JAX_PLATFORMS"] = saved_platform
+
+    try:
+        tag, observation_space, action_space = data_q.get(timeout=_QUEUE_TIMEOUT_S)
+        assert tag == "init", f"expected init, got {tag}"
+
+        actor, critic, params, target_entropy = build_agent(
+            runtime, cfg, observation_space, action_space, state["agent"] if state else None
+        )
+        params = runtime.replicate(params)
+        actor_tx = _make_optimizer(cfg.algo.actor.optimizer)
+        critic_tx = _make_optimizer(cfg.algo.critic.optimizer)
+        alpha_tx = _make_optimizer(cfg.algo.alpha.optimizer)
+        if state is not None:
+            opt_states = jax.tree_util.tree_map(jnp.asarray, state["opt_states"])
+        else:
+            opt_states = runtime.replicate(
+                {
+                    "actor": actor_tx.init(params["actor"]),
+                    "critic": critic_tx.init(params["critic"]),
+                    "alpha": alpha_tx.init(params["log_alpha"]),
+                }
+            )
+        train_fn = make_train_fn(
+            runtime, actor, critic, (actor_tx, critic_tx, alpha_tx), cfg, target_entropy
+        )
+        ema_every = cfg.algo.critic.target_network_frequency // int(cfg.env.num_envs) + 1
+
+        resp_q.put(("params", _np_tree(params["actor"])))
+
+        while True:
+            msg = data_q.get(timeout=_QUEUE_TIMEOUT_S)
+            if msg[0] == "stop":
+                break
+            if msg[0] == "ckpt_req":
+                resp_q.put(
+                    ("ckpt_state", {"agent": _np_tree(params), "opt_states": _np_tree(opt_states)})
+                )
+                continue
+            _, sample, g, iter_num = msg
+
+            data = {
+                k: jnp.asarray(v, dtype=jnp.float32).reshape(
+                    g, cfg.algo.per_rank_batch_size * runtime.world_size, *v.shape[2:]
+                )
+                for k, v in sample.items()
+            }
+            with timer("Time/train_time", SumMetric, sync_on_compute=cfg.metric.sync_on_compute):
+                params, opt_states, train_metrics = train_fn(
+                    params,
+                    opt_states,
+                    data,
+                    runtime.next_key(),
+                    jnp.asarray(iter_num % ema_every == 0),
+                )
+                train_metrics = {k: float(v) for k, v in jax.device_get(train_metrics).items()}
+            if not timer.disabled:
+                train_metrics["train_time"] = float(timer.compute().get("Time/train_time", 0.0))
+                timer.reset()
+
+            resp_q.put(("update", _np_tree(params["actor"]), train_metrics))
+
+        player_proc.join(timeout=_QUEUE_TIMEOUT_S)
+    finally:
+        if player_proc.is_alive():
+            player_proc.terminate()
+            player_proc.join()

@@ -183,6 +183,27 @@ def test_a2c(standard_args, devices, tmp_path):
     _run(args)
 
 
+def test_sac_decoupled(standard_args, devices, tmp_path):
+    """CPU-player/TPU-learner decoupled SAC (reference
+    test_algos.py test_sac_decoupled:126): the player subprocess owns the
+    envs, the replay buffer and the checkpoints."""
+    import glob
+
+    args = standard_args + [
+        "exp=sac_decoupled",
+        "env.id=dummy_continuous",
+        "algo.per_rank_batch_size=4",
+        "algo.hidden_size=8",
+        "algo.learning_starts=0",
+        "algo.mlp_keys.encoder=[state]",
+        f"fabric.devices={devices}",
+        f"root_dir={tmp_path}/sacdec",
+    ]
+    _run(args)
+    ckpts = glob.glob(f"{tmp_path}/sacdec/**/ckpt_*.ckpt", recursive=True)
+    assert len(ckpts) > 0
+
+
 def test_sac(standard_args, devices, tmp_path):
     args = standard_args + [
         "exp=sac",
