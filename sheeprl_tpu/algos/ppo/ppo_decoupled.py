@@ -275,8 +275,8 @@ def _player_loop(cfg, data_q: mp.Queue, resp_q: mp.Queue, state_counters, world_
                 "optimizer": opt_state_np,
                 "iter_num": iter_num * world_size,
                 "batch_size": cfg.algo.per_rank_batch_size * world_size,
-                "last_log": last_log,
-                "last_checkpoint": last_checkpoint,
+                "last_log": last_log * world_size,
+                "last_checkpoint": last_checkpoint * world_size,
             }
             ckpt_path = os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_0.ckpt")
             ckpt_cb.save(runtime, ckpt_path, ckpt_state)
@@ -324,8 +324,8 @@ def main(runtime, cfg: Dict[str, Any]):
     counters = (
         start_iter,
         policy_step,
-        state["last_log"] if state else 0,
-        state["last_checkpoint"] if state else 0,
+        state["last_log"] // runtime.world_size if state else 0,
+        state["last_checkpoint"] // runtime.world_size if state else 0,
     )
 
     # spawn the player pinned to the host CPU backend: the env copies the
@@ -441,7 +441,9 @@ def main(runtime, cfg: Dict[str, Any]):
                 )
             )
 
-        player_proc.join(timeout=_QUEUE_TIMEOUT_S)
+        # the player still runs its test episode + logger shutdown after the
+        # stop sentinel — give it ample time before the terminate fallback
+        player_proc.join(timeout=3600.0)
     finally:
         if player_proc.is_alive():
             player_proc.terminate()

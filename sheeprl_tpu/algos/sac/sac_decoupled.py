@@ -44,7 +44,7 @@ from sheeprl_tpu.utils.utils import Ratio, save_configs
 
 
 def _player_loop(
-    cfg, data_q: mp.Queue, resp_q: mp.Queue, state_counters, ratio_state, rb_state, world_size: int
+    cfg, data_q: mp.Queue, resp_q: mp.Queue, state_counters, ratio_state, world_size: int
 ) -> None:
     """Player process body (reference sac_decoupled.py:33-353)."""
     import gymnasium as gym
@@ -119,12 +119,24 @@ def _player_loop(
         memmap_dir=os.path.join(log_dir, "memmap_buffer", "rank_0"),
         obs_keys=("observations",),
     )
-    if rb_state is not None:
-        rb = restore_buffer(
-            rb_state,
-            memmap=cfg.buffer.memmap,
-            memmap_dir=os.path.join(log_dir, "memmap_buffer", "rank_0"),
-        )
+    # the buffer is restored here (not shipped through the spawn pipe): a
+    # materialized replay buffer can be GBs
+    if cfg.checkpoint.resume_from and cfg.buffer.checkpoint:
+        rb_state = load_checkpoint(cfg.checkpoint.resume_from).get("rb")
+        if rb_state is not None:
+            restored = restore_buffer(
+                rb_state,
+                memmap=cfg.buffer.memmap,
+                memmap_dir=os.path.join(log_dir, "memmap_buffer", "rank_0"),
+            )
+            del rb_state
+            if restored.n_envs != total_envs:
+                raise RuntimeError(
+                    f"The restored replay buffer tracks {restored.n_envs} envs but this run "
+                    f"steps {total_envs}; buffers only restore across runs with matching env "
+                    "counts (coupled runs step num_envs * world_size envs, decoupled num_envs)."
+                )
+            rb = restored
     ckpt_cb = CheckpointCallback(keep_last=cfg.checkpoint.keep_last)
 
     start_iter, policy_step, last_log, last_checkpoint = state_counters
@@ -190,9 +202,10 @@ def _player_loop(
 
         # ------------------------------------------ sample-and-ship to trainer
         if iter_num >= learning_starts:
-            per_rank_gradient_steps = ratio(
-                (policy_step - prefill_steps + policy_steps_per_iter) / world_size
-            )
+            # decoupled policy_step advances num_envs per iter (no world
+            # factor), so the ratio argument is already in coupled's
+            # per-rank scale — do NOT divide by world_size
+            per_rank_gradient_steps = ratio(policy_step - prefill_steps + policy_steps_per_iter)
             if per_rank_gradient_steps > 0:
                 g = per_rank_gradient_steps
                 sample = rb.sample(
@@ -227,10 +240,12 @@ def _player_loop(
                 "agent": full_state["agent"],
                 "opt_states": full_state["opt_states"],
                 "ratio": ratio.state_dict(),
+                # counters stored in coupled policy-step units (x world_size)
+                # so checkpoints swap between variants
                 "iter_num": iter_num * world_size,
                 "batch_size": cfg.algo.per_rank_batch_size * world_size,
-                "last_log": last_log,
-                "last_checkpoint": last_checkpoint,
+                "last_log": last_log * world_size,
+                "last_checkpoint": last_checkpoint * world_size,
             }
             if cfg.buffer.checkpoint:
                 ckpt_state["rb"] = rb
@@ -304,11 +319,10 @@ def main(runtime, cfg: Dict[str, Any]):
     counters = (
         start_iter,
         (state["iter_num"] // runtime.world_size) * cfg.env.num_envs if state else 0,
-        state["last_log"] if state else 0,
-        state["last_checkpoint"] if state else 0,
+        state["last_log"] // runtime.world_size if state else 0,
+        state["last_checkpoint"] // runtime.world_size if state else 0,
     )
     ratio_state = state["ratio"] if state else None
-    rb_state = state["rb"] if state and cfg.buffer.checkpoint and "rb" in state else None
 
     ctx = mp.get_context("spawn")
     data_q: mp.Queue = ctx.Queue()
@@ -318,7 +332,7 @@ def main(runtime, cfg: Dict[str, Any]):
     try:
         player_proc = ctx.Process(
             target=_player_loop,
-            args=(cfg, data_q, resp_q, counters, ratio_state, rb_state, runtime.world_size),
+            args=(cfg, data_q, resp_q, counters, ratio_state, runtime.world_size),
             daemon=False,
         )
         player_proc.start()
@@ -388,7 +402,9 @@ def main(runtime, cfg: Dict[str, Any]):
 
             resp_q.put(("update", _np_tree(params["actor"]), train_metrics))
 
-        player_proc.join(timeout=_QUEUE_TIMEOUT_S)
+        # the player still runs its test episode + logger shutdown after the
+        # stop sentinel — give it ample time before the terminate fallback
+        player_proc.join(timeout=3600.0)
     finally:
         if player_proc.is_alive():
             player_proc.terminate()

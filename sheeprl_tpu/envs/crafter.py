@@ -1,0 +1,71 @@
+"""Crafter adapter (gated on ``crafter``).
+
+Behavioral counterpart of reference sheeprl/envs/crafter.py
+(CrafterWrapper:17): old-gym crafter.Env becomes a gymnasium env with a
+``{"rgb": ...}`` dict observation; the terminal ``discount`` distinguishes
+termination (discount == 0) from truncation."""
+
+from __future__ import annotations
+
+from sheeprl_tpu.utils.imports import _IS_CRAFTER_AVAILABLE
+
+if not _IS_CRAFTER_AVAILABLE:
+    raise ModuleNotFoundError(
+        "crafter is not installed; Crafter environments are unavailable. "
+        "Install crafter to use them."
+    )
+
+from typing import Any, Dict, Optional, Sequence, Union
+
+import crafter
+import gymnasium as gym
+import numpy as np
+from gymnasium import spaces
+
+
+class CrafterWrapper(gym.Env):
+    def __init__(self, id: str, screen_size: Union[Sequence[int], int], seed: Optional[int] = None):
+        if id not in {"crafter_reward", "crafter_nonreward"}:
+            raise AssertionError(f"Unknown crafter task: {id}")
+        if isinstance(screen_size, int):
+            screen_size = (screen_size,) * 2
+
+        env = crafter.Env(size=tuple(screen_size), seed=seed, reward=(id == "crafter_reward"))
+        self.env = env
+        self.observation_space = spaces.Dict(
+            {
+                "rgb": spaces.Box(
+                    env.observation_space.low,
+                    env.observation_space.high,
+                    env.observation_space.shape,
+                    env.observation_space.dtype,
+                )
+            }
+        )
+        self.action_space = spaces.Discrete(env.action_space.n)
+        self.reward_range = env.reward_range or (-np.inf, np.inf)
+        self.observation_space.seed(seed)
+        self.action_space.seed(seed)
+        self._render_mode = "rgb_array"
+        self._metadata = {"render_fps": 30}
+
+    @property
+    def render_mode(self) -> Optional[str]:
+        return self._render_mode
+
+    def step(self, action: Any):
+        obs, reward, done, info = self.env.step(action)
+        terminated = done and info["discount"] == 0
+        truncated = done and info["discount"] != 0
+        return {"rgb": obs}, reward, terminated, truncated, info
+
+    def reset(self, *, seed: Optional[int] = None, options: Optional[Dict[str, Any]] = None):
+        self.env._seed = seed
+        obs = self.env.reset()
+        return {"rgb": obs}, {}
+
+    def render(self):
+        return self.env.render()
+
+    def close(self) -> None:
+        return

@@ -1,0 +1,142 @@
+"""DIAMBRA Arena adapter (gated on ``diambra`` + ``diambra.arena``).
+
+Behavioral counterpart of reference sheeprl/envs/diambra.py
+(DiambraWrapper:22): arena settings assembly (role/action-space
+validation, sticky-action step_ratio guard, frame-shape placement by
+``increase_performance``), Discrete/MultiDiscrete observation entries
+normalized to int32 Boxes, and ``env_domain`` info tagging."""
+
+from __future__ import annotations
+
+import warnings
+
+from sheeprl_tpu.utils.imports import _IS_DIAMBRA_ARENA_AVAILABLE, _IS_DIAMBRA_AVAILABLE
+
+if not _IS_DIAMBRA_AVAILABLE:
+    raise ModuleNotFoundError(
+        "diambra is not installed; DIAMBRA environments are unavailable. Install diambra to use them."
+    )
+if not _IS_DIAMBRA_ARENA_AVAILABLE:
+    raise ModuleNotFoundError(
+        "diambra.arena is not installed; DIAMBRA environments are unavailable. "
+        "Install diambra-arena to use them."
+    )
+
+from typing import Any, Dict, Optional, Tuple, Union
+
+import diambra
+import diambra.arena
+import gymnasium as gym
+import numpy as np
+from diambra.arena import EnvironmentSettings, WrappersSettings
+
+
+class DiambraWrapper(gym.Wrapper):
+    def __init__(
+        self,
+        id: str,
+        action_space: str = "DISCRETE",
+        screen_size: Union[int, Tuple[int, int]] = 64,
+        grayscale: bool = False,
+        repeat_action: int = 1,
+        rank: int = 0,
+        diambra_settings: Optional[Dict[str, Any]] = None,
+        diambra_wrappers: Optional[Dict[str, Any]] = None,
+        render_mode: str = "rgb_array",
+        log_level: int = 0,
+        increase_performance: bool = True,
+    ) -> None:
+        if isinstance(screen_size, int):
+            screen_size = (screen_size,) * 2
+        diambra_settings = dict(diambra_settings or {})
+        diambra_wrappers = dict(diambra_wrappers or {})
+
+        for disabled in ("frame_shape", "n_players"):
+            if diambra_settings.pop(disabled, None) is not None:
+                warnings.warn(f"The DIAMBRA {disabled} setting is disabled")
+
+        role = diambra_settings.pop("role", None)
+        if action_space not in {"DISCRETE", "MULTI_DISCRETE"}:
+            raise ValueError(
+                "The valid values for the `action_space` attribute are "
+                f"'DISCRETE' or 'MULTI_DISCRETE', got {action_space}"
+            )
+        if role is not None and role not in {"P1", "P2"}:
+            raise ValueError(f"The valid values for the `role` attribute are 'P1' or 'P2' or None, got {role}")
+        self._action_type = action_space.lower()
+        settings = EnvironmentSettings(
+            **{
+                **diambra_settings,
+                "game_id": id,
+                "action_space": getattr(diambra.arena.SpaceTypes, action_space, diambra.arena.SpaceTypes.DISCRETE),
+                "n_players": 1,
+                "role": getattr(diambra.arena.Roles, role, diambra.arena.Roles.P1) if role is not None else None,
+                "render_mode": render_mode,
+            }
+        )
+        if repeat_action > 1:
+            if "step_ratio" not in settings or settings["step_ratio"] > 1:
+                warnings.warn(
+                    f"step_ratio parameter modified to 1 because the sticky action is active ({repeat_action})"
+                )
+            settings["step_ratio"] = 1
+        for disabled in ("frame_shape", "stack_frames", "dilation", "flatten"):
+            if diambra_wrappers.pop(disabled, None) is not None:
+                warnings.warn(f"The DIAMBRA {disabled} wrapper is disabled")
+        wrappers = WrappersSettings(
+            **{
+                **diambra_wrappers,
+                "flatten": True,
+                "repeat_action": repeat_action,
+            }
+        )
+        # resizing in the engine (settings) is faster than in the wrapper
+        if increase_performance:
+            settings.frame_shape = tuple(screen_size) + (int(grayscale),)
+        else:
+            wrappers.frame_shape = tuple(screen_size) + (int(grayscale),)
+        env = diambra.arena.make(id, settings, wrappers, rank=rank, render_mode=render_mode, log_level=log_level)
+        super().__init__(env)
+
+        self.action_space = self.env.action_space
+        obs = {}
+        for k, space in self.env.observation_space.spaces.items():
+            if isinstance(space, gym.spaces.Box):
+                obs[k] = space
+                continue
+            if isinstance(space, gym.spaces.Discrete):
+                low, high, shape = 0, space.n - 1, (1,)
+            elif isinstance(space, gym.spaces.MultiDiscrete):
+                low, high, shape = np.zeros_like(space.nvec), space.nvec - 1, (len(space.nvec),)
+            else:
+                raise RuntimeError(f"Invalid observation space, got: {type(space)}")
+            obs[k] = gym.spaces.Box(low, high, shape, np.int32)
+        self.observation_space = gym.spaces.Dict(obs)
+        self._render_mode = render_mode
+
+    @property
+    def render_mode(self) -> Optional[str]:
+        return self._render_mode
+
+    def __getattr__(self, name):
+        return getattr(self.env, name)
+
+    def _convert_obs(self, obs: Dict[str, Union[int, np.ndarray]]) -> Dict[str, np.ndarray]:
+        return {
+            k: np.asarray(v).reshape(self.observation_space[k].shape) for k, v in obs.items()
+        }
+
+    def step(self, action: Any):
+        if self._action_type == "discrete" and isinstance(action, np.ndarray):
+            action = action.squeeze().item()
+        obs, reward, terminated, truncated, infos = self.env.step(action)
+        infos["env_domain"] = "DIAMBRA"
+        return self._convert_obs(obs), reward, terminated or infos.get("env_done", False), truncated, infos
+
+    def render(self, mode: str = "rgb_array", **kwargs):
+        return self.env.render()
+
+    def reset(self, *, seed: Optional[int] = None, options: Optional[Dict[str, Any]] = None):
+        obs, infos = self.env.reset(seed=seed, options=options)
+        infos["env_domain"] = "DIAMBRA"
+        return self._convert_obs(obs), infos

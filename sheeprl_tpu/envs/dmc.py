@@ -1,0 +1,205 @@
+"""DeepMind Control Suite adapter (gated on ``dm_control``).
+
+Behavioral counterpart of reference sheeprl/envs/dmc.py (DMCWrapper:49),
+itself derived from the public dmc2gym wrapper: dm_env specs become
+gymnasium Boxes, actions are normalized to [-1, 1], and the observation is
+a dict with optional ``rgb`` (rendered pixels) and ``state`` (flattened
+proprioception) keys.
+
+TPU-native divergence: images default to channels-LAST (NHWC) because the
+whole sheeprl_tpu preprocessing/encoder pipeline is NHWC (XLA's preferred
+conv layout), where the reference defaults to channels-first for torch.
+"""
+
+from __future__ import annotations
+
+from sheeprl_tpu.utils.imports import _IS_DMC_AVAILABLE
+
+if not _IS_DMC_AVAILABLE:
+    raise ModuleNotFoundError(
+        "dm_control is not installed; DMC environments are unavailable. "
+        "Install dm_control to use them."
+    )
+
+from typing import Any, Dict, Optional, Tuple
+
+import gymnasium as gym
+import numpy as np
+from dm_control import suite
+from dm_env import specs
+from gymnasium import spaces
+
+
+def _spec_to_box(spec, dtype) -> spaces.Box:
+    """Concatenate a collection of dm_env specs into one flat Box."""
+    mins, maxs = [], []
+    for s in spec:
+        dim = int(np.prod(s.shape))
+        if type(s) is specs.Array:
+            bound = np.inf * np.ones(dim, dtype=np.float32)
+            mins.append(-bound)
+            maxs.append(bound)
+        elif type(s) is specs.BoundedArray:
+            zeros = np.zeros(dim, dtype=np.float32)
+            mins.append(s.minimum + zeros)
+            maxs.append(s.maximum + zeros)
+        else:
+            raise ValueError(f"Unrecognized spec: {type(s)}")
+    low = np.concatenate(mins, axis=0).astype(dtype)
+    high = np.concatenate(maxs, axis=0).astype(dtype)
+    return spaces.Box(low, high, dtype=dtype)
+
+
+def _flatten_obs(obs: Dict[Any, Any]) -> np.ndarray:
+    pieces = [np.array([v]) if np.isscalar(v) else np.asarray(v).ravel() for v in obs.values()]
+    return np.concatenate(pieces, axis=0)
+
+
+class DMCWrapper(gym.Env):
+    """dm_control suite task as a gymnasium env with dict observations.
+
+    A ``gym.Env`` (not ``gym.Wrapper``) because the wrapped object is a
+    dm_env ``Environment``, which newer gymnasium Wrappers reject."""
+
+    def __init__(
+        self,
+        domain_name: str,
+        task_name: str,
+        from_pixels: bool = False,
+        from_vectors: bool = True,
+        height: int = 84,
+        width: int = 84,
+        camera_id: int = 0,
+        task_kwargs: Optional[Dict[Any, Any]] = None,
+        environment_kwargs: Optional[Dict[Any, Any]] = None,
+        channels_first: bool = False,
+        visualize_reward: bool = False,
+        seed: Optional[int] = None,
+    ):
+        if not (from_vectors or from_pixels):
+            raise ValueError(
+                "'from_vectors' and 'from_pixels' must not be both False: "
+                f"got {from_vectors} and {from_pixels} respectively."
+            )
+        self._from_pixels = from_pixels
+        self._from_vectors = from_vectors
+        self._height = height
+        self._width = width
+        self._camera_id = camera_id
+        self._channels_first = channels_first
+
+        # the wrapper owns task seeding through reset()
+        task_kwargs = dict(task_kwargs or {})
+        task_kwargs.pop("random", None)
+        env = suite.load(
+            domain_name=domain_name,
+            task_name=task_name,
+            task_kwargs=task_kwargs,
+            visualize_reward=visualize_reward,
+            environment_kwargs=environment_kwargs,
+        )
+        self.env = env
+
+        self._true_action_space = _spec_to_box([env.action_spec()], np.float32)
+        self._norm_action_space = spaces.Box(
+            low=-1.0, high=1.0, shape=self._true_action_space.shape, dtype=np.float32
+        )
+        reward_space = _spec_to_box([env.reward_spec()], np.float32)
+        self._reward_range = (reward_space.low.item(), reward_space.high.item())
+
+        obs_space = {}
+        if from_pixels:
+            shape = (3, height, width) if channels_first else (height, width, 3)
+            obs_space["rgb"] = spaces.Box(low=0, high=255, shape=shape, dtype=np.uint8)
+        if from_vectors:
+            obs_space["state"] = _spec_to_box(env.observation_spec().values(), np.float64)
+        self._observation_space = spaces.Dict(obs_space)
+        self._state_space = _spec_to_box(env.observation_spec().values(), np.float64)
+        self.current_state = None
+        self._render_mode = "rgb_array"
+        self._metadata = {}
+        self.seed(seed=seed)
+
+    def __getattr__(self, name):
+        if name.startswith("_") or name == "env":
+            raise AttributeError(name)
+        return getattr(self.env, name)
+
+    @property
+    def observation_space(self) -> spaces.Dict:
+        return self._observation_space
+
+    @observation_space.setter
+    def observation_space(self, space) -> None:
+        self._observation_space = space
+
+    @property
+    def state_space(self) -> spaces.Box:
+        return self._state_space
+
+    @property
+    def action_space(self) -> spaces.Box:
+        return self._norm_action_space
+
+    @action_space.setter
+    def action_space(self, space) -> None:
+        self._norm_action_space = space
+
+    @property
+    def reward_range(self) -> Tuple[float, float]:
+        return self._reward_range
+
+    @property
+    def render_mode(self) -> str:
+        return self._render_mode
+
+    def seed(self, seed: Optional[int] = None) -> None:
+        self._true_action_space.seed(seed)
+        self._norm_action_space.seed(seed)
+        self._observation_space.seed(seed)
+
+    def _get_obs(self, time_step) -> Dict[str, np.ndarray]:
+        obs = {}
+        if self._from_pixels:
+            rgb = self.render(camera_id=self._camera_id)
+            if self._channels_first:
+                rgb = rgb.transpose(2, 0, 1).copy()
+            obs["rgb"] = rgb
+        if self._from_vectors:
+            obs["state"] = _flatten_obs(time_step.observation)
+        return obs
+
+    def _convert_action(self, action) -> np.ndarray:
+        """[-1, 1] -> the task's true action bounds."""
+        action = np.asarray(action, dtype=np.float64)
+        true_delta = self._true_action_space.high - self._true_action_space.low
+        norm_delta = self._norm_action_space.high - self._norm_action_space.low
+        action = (action - self._norm_action_space.low) / norm_delta
+        return (action * true_delta + self._true_action_space.low).astype(np.float32)
+
+    def step(self, action):
+        time_step = self.env.step(self._convert_action(action))
+        obs = self._get_obs(time_step)
+        self.current_state = _flatten_obs(time_step.observation)
+        info = {
+            "discount": time_step.discount,
+            "internal_state": self.env.physics.get_state().copy(),
+        }
+        # dm_env signals episode end via discount: 1.0 at the horizon
+        # (time limit), 0.0 on true termination
+        truncated = time_step.last() and time_step.discount == 1
+        terminated = False if time_step.first() else time_step.last() and time_step.discount == 0
+        return obs, time_step.reward or 0.0, terminated, truncated, info
+
+    def reset(self, seed: Optional[int] = None, options: Optional[Dict[str, Any]] = None):
+        if not isinstance(seed, np.random.RandomState):
+            seed = np.random.RandomState(seed)
+        self.env.task._random = seed
+        time_step = self.env.reset()
+        self.current_state = _flatten_obs(time_step.observation)
+        return self._get_obs(time_step), {}
+
+    def render(self, camera_id: Optional[int] = None) -> np.ndarray:
+        return self.env.physics.render(
+            height=self._height, width=self._width, camera_id=camera_id or self._camera_id
+        )

@@ -1,0 +1,293 @@
+"""MineDojo adapter (gated on ``minedojo``).
+
+Behavioral counterpart of reference sheeprl/envs/minedojo.py
+(MineDojoWrapper:56): flattens MineDojo's 8-slot functional action space to
+a 3-head MultiDiscrete (action-type, craft-item, inventory-slot), converts
+the raw observations to fixed-size vectors over the full Minecraft item
+vocabulary, emits per-head ACTION MASKS consumed by the Dreamer Minedojo
+actors, enforces pitch limits, and implements sticky attack/jump.
+"""
+
+from __future__ import annotations
+
+from sheeprl_tpu.utils.imports import _IS_MINEDOJO_AVAILABLE
+
+if not _IS_MINEDOJO_AVAILABLE:
+    raise ModuleNotFoundError(
+        "minedojo is not installed; MineDojo environments are unavailable. "
+        "Install minedojo to use them."
+    )
+
+import copy
+from typing import Any, Dict, Optional, Tuple
+
+import gymnasium as gym
+import minedojo
+import minedojo.tasks
+import numpy as np
+from minedojo.sim import ALL_CRAFT_SMELT_ITEMS, ALL_ITEMS
+
+N_ALL_ITEMS = len(ALL_ITEMS)
+
+# 19 composite agent actions -> MineDojo's 8-slot action vector
+# (slot meanings: move, strafe, jump/sneak/sprint, pitch, yaw, functional,
+# craft-arg, inventory-arg; 12 is the no-op camera bucket)
+ACTION_MAP = {
+    0: np.array([0, 0, 0, 12, 12, 0, 0, 0]),  # no-op
+    1: np.array([1, 0, 0, 12, 12, 0, 0, 0]),  # forward
+    2: np.array([2, 0, 0, 12, 12, 0, 0, 0]),  # back
+    3: np.array([0, 1, 0, 12, 12, 0, 0, 0]),  # left
+    4: np.array([0, 2, 0, 12, 12, 0, 0, 0]),  # right
+    5: np.array([1, 0, 1, 12, 12, 0, 0, 0]),  # jump + forward
+    6: np.array([1, 0, 2, 12, 12, 0, 0, 0]),  # sneak + forward
+    7: np.array([1, 0, 3, 12, 12, 0, 0, 0]),  # sprint + forward
+    8: np.array([0, 0, 0, 11, 12, 0, 0, 0]),  # pitch down (-15)
+    9: np.array([0, 0, 0, 13, 12, 0, 0, 0]),  # pitch up (+15)
+    10: np.array([0, 0, 0, 12, 11, 0, 0, 0]),  # yaw down (-15)
+    11: np.array([0, 0, 0, 12, 13, 0, 0, 0]),  # yaw up (+15)
+    12: np.array([0, 0, 0, 12, 12, 1, 0, 0]),  # use
+    13: np.array([0, 0, 0, 12, 12, 2, 0, 0]),  # drop
+    14: np.array([0, 0, 0, 12, 12, 3, 0, 0]),  # attack
+    15: np.array([0, 0, 0, 12, 12, 4, 0, 0]),  # craft
+    16: np.array([0, 0, 0, 12, 12, 5, 0, 0]),  # equip
+    17: np.array([0, 0, 0, 12, 12, 6, 0, 0]),  # place
+    18: np.array([0, 0, 0, 12, 12, 7, 0, 0]),  # destroy
+}
+ITEM_ID_TO_NAME = dict(enumerate(ALL_ITEMS))
+ITEM_NAME_TO_ID = dict(zip(ALL_ITEMS, range(N_ALL_ITEMS)))
+# minedojo.make mutates the global task-spec table; keep a pristine copy so
+# repeated construction stays deterministic
+ALL_TASKS_SPECS = copy.deepcopy(minedojo.tasks.ALL_TASKS_SPECS)
+
+
+def _norm(name: str) -> str:
+    return "_".join(name.split(" "))
+
+
+class MineDojoWrapper(gym.Env):
+    def __init__(
+        self,
+        id: str,
+        height: int = 64,
+        width: int = 64,
+        pitch_limits: Tuple[int, int] = (-60, 60),
+        seed: Optional[int] = None,
+        sticky_attack: Optional[int] = 30,
+        sticky_jump: Optional[int] = 10,
+        **kwargs: Optional[Dict[Any, Any]],
+    ):
+        self._height = height
+        self._width = width
+        self._pitch_limits = pitch_limits
+        self._pos = kwargs.get("start_position", None)
+        self._break_speed_multiplier = kwargs.pop("break_speed_multiplier", 100)
+        self._start_pos = copy.deepcopy(self._pos)
+        # a high break-speed multiplier replaces the sticky attack
+        self._sticky_attack = 0 if self._break_speed_multiplier > 1 else sticky_attack
+        self._sticky_jump = sticky_jump
+        self._sticky_attack_counter = 0
+        self._sticky_jump_counter = 0
+
+        if self._pos is not None and not (self._pitch_limits[0] <= self._pos["pitch"] <= self._pitch_limits[1]):
+            raise ValueError(
+                f"The initial position must respect the pitch limits {self._pitch_limits}, "
+                f"given {self._pos['pitch']}"
+            )
+
+        env = minedojo.make(
+            task_id=id,
+            image_size=(height, width),
+            world_seed=seed,
+            fast_reset=True,
+            break_speed_multiplier=self._break_speed_multiplier,
+            **kwargs,
+        )
+        self.env = env
+        self._inventory: Dict[str, list] = {}
+        self._inventory_names: Optional[np.ndarray] = None
+        self._inventory_max = np.zeros(N_ALL_ITEMS)
+        self.action_space = gym.spaces.MultiDiscrete(
+            np.array([len(ACTION_MAP), len(ALL_CRAFT_SMELT_ITEMS), N_ALL_ITEMS])
+        )
+        self.observation_space = gym.spaces.Dict(
+            {
+                "rgb": gym.spaces.Box(0, 255, self.env.observation_space["rgb"].shape, np.uint8),
+                "inventory": gym.spaces.Box(0.0, np.inf, (N_ALL_ITEMS,), np.float32),
+                "inventory_max": gym.spaces.Box(0.0, np.inf, (N_ALL_ITEMS,), np.float32),
+                "inventory_delta": gym.spaces.Box(-np.inf, np.inf, (N_ALL_ITEMS,), np.float32),
+                "equipment": gym.spaces.Box(0.0, 1.0, (N_ALL_ITEMS,), np.int32),
+                "life_stats": gym.spaces.Box(0.0, np.array([20.0, 20.0, 300.0]), (3,), np.float32),
+                "mask_action_type": gym.spaces.Box(0, 1, (len(ACTION_MAP),), bool),
+                "mask_equip_place": gym.spaces.Box(0, 1, (N_ALL_ITEMS,), bool),
+                "mask_destroy": gym.spaces.Box(0, 1, (N_ALL_ITEMS,), bool),
+                "mask_craft_smelt": gym.spaces.Box(0, 1, (len(ALL_CRAFT_SMELT_ITEMS),), bool),
+            }
+        )
+        self._render_mode = "rgb_array"
+        self.seed(seed=seed)
+        minedojo.tasks.ALL_TASKS_SPECS = copy.deepcopy(ALL_TASKS_SPECS)
+
+    @property
+    def render_mode(self) -> Optional[str]:
+        return self._render_mode
+
+    def __getattr__(self, name):
+        if name.startswith("_") or name == "env":
+            raise AttributeError(name)
+        return getattr(self.env, name)
+
+    def _convert_inventory(self, inventory: Dict[str, Any]) -> np.ndarray:
+        """Slot list -> per-item count vector; tracks slot positions and the
+        running max count per item."""
+        converted = np.zeros(N_ALL_ITEMS)
+        self._inventory = {}
+        self._inventory_names = np.array([_norm(item) for item in inventory["name"].copy().tolist()])
+        for i, (item, quantity) in enumerate(zip(inventory["name"], inventory["quantity"])):
+            item = _norm(item)
+            self._inventory.setdefault(item, []).append(i)
+            # air stacks are counted as one slot each
+            converted[ITEM_NAME_TO_ID[item]] += 1 if item == "air" else quantity
+        self._inventory_max = np.maximum(converted, self._inventory_max)
+        return converted
+
+    def _convert_inventory_delta(self, inventory_delta: Dict[str, Any]) -> np.ndarray:
+        converted = np.zeros(N_ALL_ITEMS)
+        for sign, names_key, qty_key in (
+            (+1, "inc_name_by_craft", "inc_quantity_by_craft"),
+            (-1, "dec_name_by_craft", "dec_quantity_by_craft"),
+            (+1, "inc_name_by_other", "inc_quantity_by_other"),
+            (-1, "dec_name_by_other", "dec_quantity_by_other"),
+        ):
+            for item, quantity in zip(inventory_delta[names_key], inventory_delta[qty_key]):
+                converted[ITEM_NAME_TO_ID[_norm(item)]] += sign * quantity
+        return converted
+
+    def _convert_equipment(self, equipment: Dict[str, Any]) -> np.ndarray:
+        equip = np.zeros(N_ALL_ITEMS, dtype=np.int32)
+        equip[ITEM_NAME_TO_ID[_norm(equipment["name"][0])]] = 1
+        return equip
+
+    def _convert_masks(self, masks: Dict[str, Any]) -> Dict[str, np.ndarray]:
+        equip_mask = np.zeros(N_ALL_ITEMS, dtype=bool)
+        destroy_mask = np.zeros(N_ALL_ITEMS, dtype=bool)
+        for item, eqp, dst in zip(self._inventory_names, masks["equip"], masks["destroy"]):
+            idx = ITEM_NAME_TO_ID[item]
+            equip_mask[idx] = eqp
+            destroy_mask[idx] = dst
+        # functional actions equip(5)/place(6) need an equippable item,
+        # destroy(7) a destroyable one
+        masks["action_type"][5:7] *= np.any(equip_mask).item()
+        masks["action_type"][7] *= np.any(destroy_mask).item()
+        return {
+            # the 12 movement/camera actions are always valid
+            "mask_action_type": np.concatenate((np.array([True] * 12), masks["action_type"][1:])),
+            "mask_equip_place": equip_mask,
+            "mask_destroy": destroy_mask,
+            "mask_craft_smelt": masks["craft_smelt"],
+        }
+
+    def _convert_action(self, action: np.ndarray) -> np.ndarray:
+        converted = ACTION_MAP[int(action[0])].copy()
+        if self._sticky_attack:
+            if converted[5] == 3:  # attack selected: arm the counter
+                self._sticky_attack_counter = self._sticky_attack - 1
+            if self._sticky_attack_counter > 0 and converted[5] == 0:
+                converted[5] = 3
+                self._sticky_attack_counter -= 1
+            elif converted[5] != 3:
+                self._sticky_attack_counter = 0
+        if self._sticky_jump:
+            if converted[2] == 1:  # jump selected: arm the counter
+                self._sticky_jump_counter = self._sticky_jump - 1
+            if self._sticky_jump_counter > 0 and converted[0] == 0:
+                converted[2] = 1
+                # keep moving forward while the sticky jump plays out unless
+                # another movement action was chosen
+                if converted[0] == converted[1] == 0:
+                    converted[0] = 1
+                self._sticky_jump_counter -= 1
+            elif converted[2] != 1:
+                self._sticky_jump_counter = 0
+        # craft (functional action 4) consumes the craft-item head
+        converted[6] = int(action[1]) if converted[5] == 4 else 0
+        # equip/place/destroy (5/6/7) consume the inventory-slot head
+        if converted[5] in {5, 6, 7}:
+            converted[7] = self._inventory[ITEM_ID_TO_NAME[int(action[2])]][0]
+        else:
+            converted[7] = 0
+        return converted
+
+    def _convert_obs(self, obs: Dict[str, Any]) -> Dict[str, np.ndarray]:
+        return {
+            "rgb": obs["rgb"].copy(),
+            "inventory": self._convert_inventory(obs["inventory"]),
+            "inventory_max": self._inventory_max,
+            "inventory_delta": self._convert_inventory_delta(obs["delta_inv"]),
+            "equipment": self._convert_equipment(obs["equipment"]),
+            "life_stats": np.concatenate(
+                (obs["life_stats"]["life"], obs["life_stats"]["food"], obs["life_stats"]["oxygen"])
+            ),
+            **self._convert_masks(obs["masks"]),
+        }
+
+    def _read_position(self, obs: Dict[str, Any]) -> Dict[str, float]:
+        return {
+            "x": float(obs["location_stats"]["pos"][0]),
+            "y": float(obs["location_stats"]["pos"][1]),
+            "z": float(obs["location_stats"]["pos"][2]),
+            "pitch": float(obs["location_stats"]["pitch"].item()),
+            "yaw": float(obs["location_stats"]["yaw"].item()),
+        }
+
+    def seed(self, seed: Optional[int] = None) -> None:
+        self.observation_space.seed(seed)
+        self.action_space.seed(seed)
+
+    def step(self, action: np.ndarray):
+        raw_action = action
+        action = self._convert_action(action)
+        # clamp the pitch by cancelling camera moves that would exceed it
+        next_pitch = self._pos["pitch"] + (action[3] - 12) * 15
+        if not (self._pitch_limits[0] <= next_pitch <= self._pitch_limits[1]):
+            action[3] = 12
+
+        obs, reward, done, info = self.env.step(action)
+        is_timelimit = info.get("TimeLimit.truncated", False)
+        self._pos = self._read_position(obs)
+        info.update(
+            {
+                "life_stats": {
+                    "life": float(obs["life_stats"]["life"].item()),
+                    "oxygen": float(obs["life_stats"]["oxygen"].item()),
+                    "food": float(obs["life_stats"]["food"].item()),
+                },
+                "location_stats": copy.deepcopy(self._pos),
+                "action": raw_action.tolist(),
+                "biomeid": float(obs["location_stats"]["biome_id"].item()),
+            }
+        )
+        return self._convert_obs(obs), reward, done and not is_timelimit, done and is_timelimit, info
+
+    def reset(self, seed: Optional[int] = None, options: Optional[Dict[str, Any]] = None):
+        obs = self.env.reset()
+        self._pos = self._read_position(obs)
+        self._sticky_jump_counter = 0
+        self._sticky_attack_counter = 0
+        self._inventory_max = np.zeros(N_ALL_ITEMS)
+        return self._convert_obs(obs), {
+            "life_stats": {
+                "life": float(obs["life_stats"]["life"].item()),
+                "oxygen": float(obs["life_stats"]["oxygen"].item()),
+                "food": float(obs["life_stats"]["food"].item()),
+            },
+            "location_stats": copy.deepcopy(self._pos),
+            "biomeid": float(obs["location_stats"]["biome_id"].item()),
+        }
+
+    def render(self):
+        if self.render_mode == "human":
+            return super().render()
+        if self.render_mode == "rgb_array":
+            prev = self.env.unwrapped._prev_obs
+            return None if prev is None else prev["rgb"]
+        return None

@@ -1,0 +1,241 @@
+"""MineRL adapter (gated on ``minerl``).
+
+Behavioral counterpart of reference sheeprl/envs/minerl.py
+(MineRLWrapper:48): builds the custom Navigate/ObtainDiamond/
+ObtainIronPickaxe tasks (sheeprl_tpu.envs.minerl_envs), flattens the
+MineRL dict action space to one Discrete space via an auto-derived
+ACTIONS_MAP (enums expand to one action per value, camera to 4 fixed
+15-degree moves, jump/sneak/sprint imply forward), converts observations
+to fixed-size vectors (optionally multi-hot over the full Minecraft item
+vocabulary), enforces pitch limits, and implements sticky attack/jump.
+
+TPU-native divergence: the ``rgb`` observation stays channels-LAST (HWC)
+to match the NHWC sheeprl_tpu pipeline (the reference transposes to CHW
+for torch)."""
+
+from __future__ import annotations
+
+from sheeprl_tpu.utils.imports import _IS_MINERL_AVAILABLE
+
+if not _IS_MINERL_AVAILABLE:
+    raise ModuleNotFoundError(
+        "minerl is not installed; MineRL environments are unavailable. "
+        "Install minerl==0.4.4 to use them."
+    )
+
+import copy
+from typing import Any, Dict, Optional, Tuple
+
+import gymnasium as gym
+import minerl
+import numpy as np
+from minerl.herobraine.hero import mc
+
+from sheeprl_tpu.envs.minerl_envs.navigate import CustomNavigate
+from sheeprl_tpu.envs.minerl_envs.obtain import CustomObtainDiamond, CustomObtainIronPickaxe
+
+CUSTOM_ENVS = {
+    "custom_navigate": CustomNavigate,
+    "custom_obtain_diamond": CustomObtainDiamond,
+    "custom_obtain_iron_pickaxe": CustomObtainIronPickaxe,
+}
+
+N_ALL_ITEMS = len(mc.ALL_ITEMS)
+NOOP = {
+    "camera": (0, 0),
+    "forward": 0,
+    "back": 0,
+    "left": 0,
+    "right": 0,
+    "attack": 0,
+    "sprint": 0,
+    "jump": 0,
+    "sneak": 0,
+    "craft": "none",
+    "nearbyCraft": "none",
+    "nearbySmelt": "none",
+    "place": "none",
+    "equip": "none",
+}
+ITEM_ID_TO_NAME = dict(enumerate(mc.ALL_ITEMS))
+ITEM_NAME_TO_ID = dict(zip(mc.ALL_ITEMS, range(N_ALL_ITEMS)))
+
+
+class MineRLWrapper(gym.Env):
+    def __init__(
+        self,
+        id: str,
+        height: int = 64,
+        width: int = 64,
+        pitch_limits: Tuple[int, int] = (-60, 60),
+        seed: Optional[int] = None,
+        sticky_attack: Optional[int] = 30,
+        sticky_jump: Optional[int] = 10,
+        break_speed_multiplier: Optional[int] = 100,
+        multihot_inventory: bool = True,
+        **kwargs: Optional[Dict[Any, Any]],
+    ):
+        self._height = height
+        self._width = width
+        self._pitch_limits = pitch_limits
+        # a high break-speed multiplier replaces the sticky attack
+        self._sticky_attack = 0 if break_speed_multiplier > 1 else sticky_attack
+        self._sticky_jump = sticky_jump
+        self._sticky_attack_counter = 0
+        self._sticky_jump_counter = 0
+        self._break_speed_multiplier = break_speed_multiplier
+        self._multihot_inventory = multihot_inventory
+        if "navigate" not in id.lower():
+            kwargs.pop("extreme", None)
+
+        env = CUSTOM_ENVS[id.lower()](break_speed=break_speed_multiplier, **kwargs).make()
+        self.env = env
+
+        # flatten the dict action space to one Discrete space: index 0 is
+        # the no-op; enum actions expand to one index per value, camera to 4
+        # fixed 15-degree moves, binary actions to one index
+        self.ACTIONS_MAP: Dict[int, Dict[str, Any]] = {0: {}}
+        act_idx = 1
+        for act in self.env.action_space:
+            if isinstance(self.env.action_space[act], minerl.herobraine.hero.spaces.Enum):
+                act_val = set(self.env.action_space[act].values.tolist()) - {"none"}
+                act_len = len(act_val)
+            elif act != "camera":
+                act_len = 1
+                act_val = [1]
+            else:
+                act_len = 4
+                act_val = [
+                    np.array([-15, 0]),
+                    np.array([15, 0]),
+                    np.array([0, -15]),
+                    np.array([0, 15]),
+                ]
+            action = dict(zip((np.arange(act_len) + act_idx).tolist(), [{act: v} for v in act_val]))
+            # jumping/sneaking/sprinting in place is useless: pair with forward
+            if act in {"jump", "sneak", "sprint"}:
+                action[act_idx]["forward"] = 1
+            self.ACTIONS_MAP.update(action)
+            act_idx += act_len
+
+        self.action_space = gym.spaces.Discrete(len(self.ACTIONS_MAP))
+
+        if multihot_inventory:
+            self.inventory_size = N_ALL_ITEMS
+            self.inventory_item_to_id = ITEM_NAME_TO_ID
+        else:
+            self.inventory_size = len(self.env.observation_space["inventory"])
+            self.inventory_item_to_id = dict(
+                zip(self.env.observation_space["inventory"], range(self.inventory_size))
+            )
+        obs_space = {
+            "rgb": gym.spaces.Box(0, 255, (height, width, 3), np.uint8),
+            "life_stats": gym.spaces.Box(0.0, np.array([20.0, 20.0, 300.0]), (3,), np.float32),
+            "inventory": gym.spaces.Box(0.0, np.inf, (self.inventory_size,), np.float32),
+            "max_inventory": gym.spaces.Box(0.0, np.inf, (self.inventory_size,), np.float32),
+        }
+        if "compass" in self.env.observation_space.spaces:
+            obs_space["compass"] = gym.spaces.Box(-180, 180, (1,), np.float32)
+        if "equipped_items" in self.env.observation_space.spaces:
+            if multihot_inventory:
+                self.equip_size = N_ALL_ITEMS
+                self.equip_item_to_id = ITEM_NAME_TO_ID
+            else:
+                equip_values = self.env.observation_space["equipped_items"]["mainhand"]["type"].values.tolist()
+                self.equip_size = len(equip_values)
+                self.equip_item_to_id = dict(zip(equip_values, range(self.equip_size)))
+            obs_space["equipment"] = gym.spaces.Box(0.0, 1.0, (self.equip_size,), np.int32)
+        self.observation_space = gym.spaces.Dict(obs_space)
+
+        self._pos = {"pitch": 0.0, "yaw": 0.0}
+        self._max_inventory = np.zeros(self.inventory_size)
+        self._render_mode = "rgb_array"
+        self.seed(seed=seed)
+
+    @property
+    def render_mode(self) -> Optional[str]:
+        return self._render_mode
+
+    def __getattr__(self, name):
+        if name.startswith("_") or name == "env":
+            raise AttributeError(name)
+        return getattr(self.env, name)
+
+    def _convert_actions(self, action: np.ndarray) -> Dict[str, Any]:
+        converted = copy.deepcopy(NOOP)
+        converted.update(self.ACTIONS_MAP[action.item()])
+        if self._sticky_attack:
+            if converted["attack"]:
+                self._sticky_attack_counter = self._sticky_attack
+            if self._sticky_attack_counter > 0:
+                converted["attack"] = 1
+                converted["jump"] = 0
+                self._sticky_attack_counter -= 1
+        if self._sticky_jump:
+            if converted["jump"]:
+                self._sticky_jump_counter = self._sticky_jump
+            if self._sticky_jump_counter > 0:
+                converted["jump"] = 1
+                converted["forward"] = 1
+                self._sticky_jump_counter -= 1
+        return converted
+
+    def _convert_equipment(self, equipment: Dict[str, Any]) -> np.ndarray:
+        equip = np.zeros(self.equip_size, dtype=np.int32)
+        try:
+            equip[self.equip_item_to_id[equipment["mainhand"]["type"]]] = 1
+        except KeyError:
+            equip[self.equip_item_to_id["air"]] = 1
+        return equip
+
+    def _convert_inventory(self, inventory: Dict[str, Any]) -> Dict[str, np.ndarray]:
+        converted = {"inventory": np.zeros(self.inventory_size)}
+        for item, quantity in inventory.items():
+            # air stacks count one per slot
+            converted["inventory"][self.inventory_item_to_id[item]] += 1 if item == "air" else quantity
+        converted["max_inventory"] = np.maximum(converted["inventory"], self._max_inventory)
+        self._max_inventory = converted["max_inventory"].copy()
+        return converted
+
+    def _convert_obs(self, obs: Dict[str, Any]) -> Dict[str, np.ndarray]:
+        converted = {
+            "rgb": obs["pov"].copy(),
+            "life_stats": np.array(
+                [obs["life_stats"]["life"], obs["life_stats"]["food"], obs["life_stats"]["air"]],
+                dtype=np.float32,
+            ),
+            **self._convert_inventory(obs["inventory"]),
+        }
+        if "equipment" in self.observation_space.spaces:
+            converted["equipment"] = self._convert_equipment(obs["equipped_items"])
+        if "compass" in self.observation_space.spaces:
+            converted["compass"] = obs["compass"]["angle"].reshape(-1)
+        return converted
+
+    def seed(self, seed: Optional[int] = None) -> None:
+        self.observation_space.seed(seed)
+        self.action_space.seed(seed)
+
+    def step(self, actions: np.ndarray):
+        converted_actions = self._convert_actions(actions)
+        # clamp pitch by cancelling the vertical camera move
+        next_pitch = self._pos["pitch"] + converted_actions["camera"][0]
+        next_yaw = ((self._pos["yaw"] + converted_actions["camera"][1]) + 180) % 360 - 180
+        if not (self._pitch_limits[0] <= next_pitch <= self._pitch_limits[1]):
+            converted_actions["camera"] = np.array([0, converted_actions["camera"][1]])
+            next_pitch = self._pos["pitch"]
+
+        obs, reward, done, info = self.env.step(converted_actions)
+        self._pos = {"pitch": next_pitch, "yaw": next_yaw}
+        return self._convert_obs(obs), reward, done, False, info
+
+    def reset(self, seed: Optional[int] = None, options: Optional[Dict[str, Any]] = None):
+        obs = self.env.reset()
+        self._max_inventory = np.zeros(self.inventory_size)
+        self._sticky_attack_counter = 0
+        self._sticky_jump_counter = 0
+        self._pos = {"pitch": 0.0, "yaw": 0.0}
+        return self._convert_obs(obs), {}
+
+    def render(self, mode: Optional[str] = "rgb_array"):
+        return self.env.render(self.render_mode)

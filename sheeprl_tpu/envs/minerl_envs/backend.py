@@ -1,0 +1,70 @@
+"""Shared base spec for the custom MineRL tasks (gated on ``minerl``).
+
+Behavioral counterpart of reference sheeprl/envs/minerl_envs/backend.py
+(CustomSimpleEmbodimentEnvSpec:19), itself derived from the public
+minerllabs/minerl simple-embodiment spec plus danijar/diamond_env's
+break-speed handler: POV/location/life-stats observables, the simple
+keyboard + camera actionables, and a configurable block-break speed
+multiplier injected into the mission XML."""
+
+from __future__ import annotations
+
+from sheeprl_tpu.utils.imports import _IS_MINERL_AVAILABLE
+
+if not _IS_MINERL_AVAILABLE:
+    raise ModuleNotFoundError(
+        "minerl is not installed; MineRL environments are unavailable. "
+        "Install minerl==0.4.4 to use them."
+    )
+
+from abc import ABC
+from typing import List
+
+from minerl.herobraine.env_spec import EnvSpec
+from minerl.herobraine.hero import handler, handlers
+from minerl.herobraine.hero.handlers.translation import TranslationHandler
+from minerl.herobraine.hero.mc import INVERSE_KEYMAP
+
+SIMPLE_KEYBOARD_ACTION = ["forward", "back", "left", "right", "jump", "sneak", "sprint", "attack"]
+
+
+class BreakSpeedMultiplier(handler.Handler):
+    """Mission-XML handler scaling block-breaking speed."""
+
+    def __init__(self, multiplier: float = 1.0):
+        self.multiplier = multiplier
+
+    def to_string(self) -> str:
+        return f"break_speed({self.multiplier})"
+
+    def xml_template(self) -> str:
+        return "<BreakSpeedMultiplier>{{multiplier}}</BreakSpeedMultiplier>"
+
+
+class CustomSimpleEmbodimentEnvSpec(EnvSpec, ABC):
+    """Base spec all custom sheeprl_tpu MineRL tasks inherit from."""
+
+    def __init__(self, name, *args, resolution=(64, 64), break_speed: int = 100, **kwargs):
+        self.resolution = resolution
+        self.break_speed = break_speed
+        super().__init__(name, *args, **kwargs)
+
+    def create_agent_start(self) -> List[handler.Handler]:
+        return [BreakSpeedMultiplier(self.break_speed)]
+
+    def create_observables(self) -> List[TranslationHandler]:
+        return [
+            handlers.POVObservation(self.resolution),
+            handlers.ObservationFromCurrentLocation(),
+            handlers.ObservationFromLifeStats(),
+        ]
+
+    def create_actionables(self) -> List[TranslationHandler]:
+        return [
+            handlers.KeybasedCommandAction(k, v)
+            for k, v in INVERSE_KEYMAP.items()
+            if k in SIMPLE_KEYBOARD_ACTION
+        ] + [handlers.CameraAction()]
+
+    def create_monitors(self) -> List[TranslationHandler]:
+        return []

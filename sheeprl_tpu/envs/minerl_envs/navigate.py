@@ -1,0 +1,107 @@
+"""Custom MineRL Navigate task (gated on ``minerl``).
+
+Behavioral counterpart of reference sheeprl/envs/minerl_envs/navigate.py
+(CustomNavigate:18): reach a diamond block ~64m away guided by a compass;
++100 sparse reward on touch (optionally dense distance shaping); the
+in-engine time limit is disabled so the gymnasium TimeLimit wrapper can
+distinguish truncation from termination."""
+
+from __future__ import annotations
+
+from sheeprl_tpu.utils.imports import _IS_MINERL_AVAILABLE
+
+if not _IS_MINERL_AVAILABLE:
+    raise ModuleNotFoundError(
+        "minerl is not installed; MineRL environments are unavailable. "
+        "Install minerl==0.4.4 to use them."
+    )
+
+from typing import List
+
+import minerl.herobraine.hero.handlers as handlers
+from minerl.herobraine.hero.handler import Handler
+
+from sheeprl_tpu.envs.minerl_envs.backend import CustomSimpleEmbodimentEnvSpec
+
+NAVIGATE_STEPS = 6000
+
+
+class CustomNavigate(CustomSimpleEmbodimentEnvSpec):
+    def __init__(self, dense, extreme, *args, **kwargs):
+        suffix = ("Extreme" if extreme else "") + ("Dense" if dense else "")
+        self.dense, self.extreme = dense, extreme
+        # time limit handled by the gymnasium TimeLimit wrapper (MineRL
+        # cannot distinguish terminated from truncated)
+        kwargs.pop("max_episode_steps", None)
+        super().__init__(f"CustomMineRLNavigate{suffix}-v0", *args, max_episode_steps=None, **kwargs)
+
+    def is_from_folder(self, folder: str) -> bool:
+        return folder == ("navigateextreme" if self.extreme else "navigate")
+
+    def create_observables(self) -> List[Handler]:
+        return super().create_observables() + [
+            handlers.CompassObservation(angle=True, distance=False),
+            handlers.FlatInventoryObservation(["dirt"]),
+        ]
+
+    def create_actionables(self) -> List[Handler]:
+        return super().create_actionables() + [
+            handlers.PlaceBlock(["none", "dirt"], _other="none", _default="none")
+        ]
+
+    def create_rewardables(self) -> List[Handler]:
+        return [
+            handlers.RewardForTouchingBlockType(
+                [{"type": "diamond_block", "behaviour": "onceOnly", "reward": 100.0}]
+            )
+        ] + ([handlers.RewardForDistanceTraveledToCompassTarget(reward_per_block=1.0)] if self.dense else [])
+
+    def create_agent_start(self) -> List[Handler]:
+        return super().create_agent_start() + [
+            handlers.SimpleInventoryAgentStart([dict(type="compass", quantity="1")])
+        ]
+
+    def create_agent_handlers(self) -> List[Handler]:
+        return [handlers.AgentQuitFromTouchingBlockType(["diamond_block"])]
+
+    def create_server_world_generators(self) -> List[Handler]:
+        if self.extreme:
+            return [handlers.BiomeGenerator(biome=3, force_reset=True)]
+        return [handlers.DefaultWorldGenerator(force_reset=True)]
+
+    def create_server_quit_producers(self) -> List[Handler]:
+        return [handlers.ServerQuitWhenAnyAgentFinishes()]
+
+    def create_server_decorators(self) -> List[Handler]:
+        return [
+            handlers.NavigationDecorator(
+                max_randomized_radius=64,
+                min_randomized_radius=64,
+                block="diamond_block",
+                placement="surface",
+                max_radius=8,
+                min_radius=0,
+                max_randomized_distance=8,
+                min_randomized_distance=0,
+                randomize_compass_location=True,
+            )
+        ]
+
+    def create_server_initial_conditions(self) -> List[Handler]:
+        return [
+            handlers.TimeInitialCondition(allow_passage_of_time=False, start_time=6000),
+            handlers.WeatherInitialCondition("clear"),
+            handlers.SpawningInitialCondition("false"),
+        ]
+
+    def get_docstring(self) -> str:
+        biome = "an extreme hills biome" if self.extreme else "a random survival map"
+        shaping = "dense distance-based shaping" if self.dense else "a sparse +100 on reaching the goal"
+        return (
+            "Reach a diamond block ~64m from spawn guided by a compass observation; "
+            f"the agent spawns in {biome} and receives {shaping}."
+        )
+
+    def determine_success_from_rewards(self, rewards: list) -> bool:
+        reward_threshold = 100.0 + (60 if self.dense else 0)
+        return sum(rewards) >= reward_threshold

@@ -1,0 +1,189 @@
+"""Custom MineRL Obtain tasks (gated on ``minerl``).
+
+Behavioral counterpart of reference sheeprl/envs/minerl_envs/obtain.py
+(CustomObtain:23, CustomObtainDiamond:172, CustomObtainIronPickaxe:251):
+the classic obtain-item hierarchy with GUI-free craft/smelt/equip/place
+actionables, milestone reward schedules, and agent-quit handlers on the
+target item; in-engine time limits disabled (TimeLimit wrapper instead)."""
+
+from __future__ import annotations
+
+from sheeprl_tpu.utils.imports import _IS_MINERL_AVAILABLE
+
+if not _IS_MINERL_AVAILABLE:
+    raise ModuleNotFoundError(
+        "minerl is not installed; MineRL environments are unavailable. "
+        "Install minerl==0.4.4 to use them."
+    )
+
+from typing import Dict, List, Union
+
+from minerl.herobraine.hero import handlers
+from minerl.herobraine.hero.handler import Handler
+
+from sheeprl_tpu.envs.minerl_envs.backend import CustomSimpleEmbodimentEnvSpec
+
+NONE = "none"
+OTHER = "other"
+
+# milestone schedule shared by the diamond/iron-pickaxe tasks (diamond adds
+# the final 1024 entry)
+_IRON_SCHEDULE = [
+    dict(type="log", amount=1, reward=1),
+    dict(type="planks", amount=1, reward=2),
+    dict(type="stick", amount=1, reward=4),
+    dict(type="crafting_table", amount=1, reward=4),
+    dict(type="wooden_pickaxe", amount=1, reward=8),
+    dict(type="cobblestone", amount=1, reward=16),
+    dict(type="furnace", amount=1, reward=32),
+    dict(type="stone_pickaxe", amount=1, reward=32),
+    dict(type="iron_ore", amount=1, reward=64),
+    dict(type="iron_ingot", amount=1, reward=128),
+    dict(type="iron_pickaxe", amount=1, reward=256),
+]
+
+
+def snake_to_camel(word: str) -> str:
+    return "".join(x.capitalize() or "_" for x in word.split("_"))
+
+
+class CustomObtain(CustomSimpleEmbodimentEnvSpec):
+    def __init__(
+        self,
+        target_item: str,
+        dense: bool,
+        reward_schedule: List[Dict[str, Union[str, int, float]]],
+        *args,
+        max_episode_steps=None,
+        **kwargs,
+    ):
+        self.target_item = target_item
+        self.dense = dense
+        self.reward_schedule = reward_schedule
+        suffix = snake_to_camel(target_item) + ("Dense" if dense else "")
+        self.reward_text = (
+            "every time it obtains an item" if dense else "only once per item the first time it obtains that item"
+        )
+        super().__init__(
+            *args,
+            name=f"CustomMineRLObtain{suffix}-v0",
+            max_episode_steps=max_episode_steps,
+            **kwargs,
+        )
+
+    def create_observables(self) -> List[Handler]:
+        return super().create_observables() + [
+            handlers.FlatInventoryObservation(
+                [
+                    "dirt", "coal", "torch", "log", "planks", "stick", "crafting_table",
+                    "wooden_axe", "wooden_pickaxe", "stone", "cobblestone", "furnace",
+                    "stone_axe", "stone_pickaxe", "iron_ore", "iron_ingot", "iron_axe",
+                    "iron_pickaxe",
+                ]
+            ),
+            handlers.EquippedItemObservation(
+                items=[
+                    "air", "wooden_axe", "wooden_pickaxe", "stone_axe", "stone_pickaxe",
+                    "iron_axe", "iron_pickaxe", OTHER,
+                ],
+                _default="air",
+                _other=OTHER,
+            ),
+        ]
+
+    def create_actionables(self) -> List[Handler]:
+        return super().create_actionables() + [
+            handlers.PlaceBlock(
+                [NONE, "dirt", "stone", "cobblestone", "crafting_table", "furnace", "torch"],
+                _other=NONE,
+                _default=NONE,
+            ),
+            handlers.EquipAction(
+                [NONE, "air", "wooden_axe", "wooden_pickaxe", "stone_axe", "stone_pickaxe",
+                 "iron_axe", "iron_pickaxe"],
+                _other=NONE,
+                _default=NONE,
+            ),
+            handlers.CraftAction(
+                [NONE, "torch", "stick", "planks", "crafting_table"], _other=NONE, _default=NONE
+            ),
+            handlers.CraftNearbyAction(
+                [NONE, "wooden_axe", "wooden_pickaxe", "stone_axe", "stone_pickaxe",
+                 "iron_axe", "iron_pickaxe", "furnace"],
+                _other=NONE,
+                _default=NONE,
+            ),
+            handlers.SmeltItemNearby([NONE, "iron_ingot", "coal"], _other=NONE, _default=NONE),
+        ]
+
+    def create_rewardables(self) -> List[Handler]:
+        reward_handler = handlers.RewardForCollectingItems if self.dense else handlers.RewardForCollectingItemsOnce
+        return [reward_handler(self.reward_schedule if self.reward_schedule else {self.target_item: 1})]
+
+    def create_agent_handlers(self) -> List[Handler]:
+        return [handlers.AgentQuitFromPossessingItem([dict(type="diamond", amount=1)])]
+
+    def create_server_world_generators(self) -> List[Handler]:
+        return [handlers.DefaultWorldGenerator(force_reset=True)]
+
+    def create_server_quit_producers(self) -> List[Handler]:
+        return [handlers.ServerQuitWhenAnyAgentFinishes()]
+
+    def create_server_decorators(self) -> List[Handler]:
+        return []
+
+    def create_server_initial_conditions(self) -> List[Handler]:
+        return [
+            handlers.TimeInitialCondition(start_time=6000, allow_passage_of_time=True),
+            handlers.SpawningInitialCondition(allow_spawning=True),
+        ]
+
+    def is_from_folder(self, folder: str) -> bool:
+        return folder == f"o_{self.target_item}"
+
+    def get_docstring(self) -> str:
+        return (
+            f"Obtain a {self.target_item} starting from nothing on a random survival map; "
+            f"the agent is rewarded {self.reward_text} along the item hierarchy."
+        )
+
+    def determine_success_from_rewards(self, rewards: list) -> bool:
+        rewards = set(rewards)
+        max_missing = round(len(self.reward_schedule) * 0.1)
+        reward_values = [s["reward"] for s in self.reward_schedule]
+        return len(rewards.intersection(reward_values)) >= len(reward_values) - max_missing
+
+
+class CustomObtainDiamond(CustomObtain):
+    def __init__(self, dense, *args, **kwargs):
+        kwargs.pop("max_episode_steps", None)
+        super().__init__(
+            *args,
+            target_item="diamond",
+            dense=dense,
+            reward_schedule=_IRON_SCHEDULE + [dict(type="diamond", amount=1, reward=1024)],
+            max_episode_steps=None,
+            **kwargs,
+        )
+
+    def is_from_folder(self, folder: str) -> bool:
+        return folder == "o_dia"
+
+
+class CustomObtainIronPickaxe(CustomObtain):
+    def __init__(self, dense, *args, **kwargs):
+        kwargs.pop("max_episode_steps", None)
+        super().__init__(
+            *args,
+            target_item="iron_pickaxe",
+            dense=dense,
+            reward_schedule=list(_IRON_SCHEDULE),
+            max_episode_steps=None,
+            **kwargs,
+        )
+
+    def create_agent_handlers(self) -> List[Handler]:
+        return [handlers.AgentQuitFromCraftingItem([dict(type="iron_pickaxe", amount=1)])]
+
+    def is_from_folder(self, folder: str) -> bool:
+        return folder == "o_iron"

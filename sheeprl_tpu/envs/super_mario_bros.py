@@ -1,0 +1,76 @@
+"""Super Mario Bros adapter (gated on ``gym_super_mario_bros``).
+
+Behavioral counterpart of reference sheeprl/envs/super_mario_bros.py
+(SuperMarioBrosWrapper:26): nes-py env behind a JoypadSpace with a
+seedable reset, ``{"rgb": ...}`` dict observation, and time-limit-aware
+terminated/truncated split."""
+
+from __future__ import annotations
+
+from sheeprl_tpu.utils.imports import _IS_SUPER_MARIO_BROS_AVAILABLE
+
+if not _IS_SUPER_MARIO_BROS_AVAILABLE:
+    raise ModuleNotFoundError(
+        "gym_super_mario_bros is not installed; Super Mario Bros environments "
+        "are unavailable. Install gym_super_mario_bros to use them."
+    )
+
+from typing import Any, Dict, Optional, Union
+
+import gym_super_mario_bros as gsmb
+import gymnasium as gym
+import numpy as np
+from gym_super_mario_bros.actions import COMPLEX_MOVEMENT, RIGHT_ONLY, SIMPLE_MOVEMENT
+from nes_py.wrappers import JoypadSpace
+
+ACTIONS_SPACE_MAP = {"simple": SIMPLE_MOVEMENT, "right_only": RIGHT_ONLY, "complex": COMPLEX_MOVEMENT}
+
+
+class JoypadSpaceCustomReset(JoypadSpace):
+    """JoypadSpace whose reset forwards gymnasium's seed/options kwargs."""
+
+    def reset(self, seed: Optional[int] = None, options: Optional[Dict[str, Any]] = None):
+        return self.env.reset(seed=seed, options=options)
+
+
+class SuperMarioBrosWrapper(gym.Env):
+    def __init__(self, id: str, action_space: str = "simple", render_mode: str = "rgb_array"):
+        env = JoypadSpaceCustomReset(gsmb.make(id), ACTIONS_SPACE_MAP[action_space])
+        self.env = env
+        self._render_mode = render_mode
+        self.observation_space = gym.spaces.Dict(
+            {
+                "rgb": gym.spaces.Box(
+                    env.observation_space.low,
+                    env.observation_space.high,
+                    env.observation_space.shape,
+                    env.observation_space.dtype,
+                )
+            }
+        )
+        self.action_space = gym.spaces.Discrete(env.action_space.n)
+
+    @property
+    def render_mode(self) -> str:
+        return self._render_mode
+
+    @render_mode.setter
+    def render_mode(self, render_mode: str) -> None:
+        self._render_mode = render_mode
+
+    def step(self, action: Union[np.ndarray, int]):
+        if isinstance(action, np.ndarray):
+            action = action.squeeze().item()
+        obs, reward, done, info = self.env.step(action)
+        is_timelimit = info.get("time", False)
+        return {"rgb": obs.copy()}, reward, done and not is_timelimit, done and is_timelimit, info
+
+    def reset(self, *, seed: Optional[int] = None, options: Optional[Dict[str, Any]] = None):
+        obs = self.env.reset(seed=seed, options=options)
+        return {"rgb": obs.copy()}, {}
+
+    def render(self):
+        frame = self.env.render(mode=self.render_mode)
+        if self.render_mode == "rgb_array" and frame is not None:
+            return frame.copy()
+        return None
