@@ -1,0 +1,4 @@
+from sheeprl_tpu.cli import evaluation
+
+if __name__ == "__main__":
+    evaluation()

@@ -1,0 +1,4 @@
+from sheeprl_tpu.cli import registration
+
+if __name__ == "__main__":
+    registration()

@@ -1,0 +1,36 @@
+"""``sheeprl_tpu-agents``: table of every registered algorithm
+(reference sheeprl/available_agents.py:7-34)."""
+
+from __future__ import annotations
+
+import sheeprl_tpu  # noqa: F401  (populate registries via import side-effect)
+from sheeprl_tpu.utils.registry import algorithm_registry, evaluation_registry
+
+
+def available_agents() -> None:
+    from rich.console import Console
+    from rich.table import Table
+
+    table = Table(title="SheepRL-TPU Agents")
+    table.add_column("Module")
+    table.add_column("Algorithm")
+    table.add_column("Entrypoint")
+    table.add_column("Decoupled")
+    table.add_column("Evaluated by")
+
+    for module, registrations in algorithm_registry.items():
+        for algo in registrations:
+            evaluated_by = "Undefined"
+            for eval_module, eval_regs in evaluation_registry.items():
+                for ev in eval_regs:
+                    if algo["name"] in ev["name"]:
+                        evaluated_by = f"{eval_module}.{ev['entrypoint']}"
+                        break
+            table.add_row(
+                module, algo["name"], algo["entrypoint"], str(algo["decoupled"]), evaluated_by
+            )
+    Console().print(table)
+
+
+if __name__ == "__main__":
+    available_agents()

@@ -197,8 +197,13 @@ def evaluation(args: Optional[Sequence[str]] = None) -> None:
 
 
 def registration(args: Optional[Sequence[str]] = None) -> None:
-    """Model-manager registration app (reference cli.py:408). Requires the
-    optional mlflow backend."""
+    """Model-manager registration app:
+    ``sheeprl-registration checkpoint_path=... [model_manager overrides...]``
+    (reference cli.py:408-448). Requires the optional mlflow backend.
+
+    Loads the run config saved next to the checkpoint, merges any
+    ``model_manager.*`` overrides, then logs + registers the configured
+    MODELS_TO_REGISTER param trees from the checkpoint state."""
     from sheeprl_tpu.utils.imports import _IS_MLFLOW_AVAILABLE
 
     if not _IS_MLFLOW_AVAILABLE:
@@ -206,4 +211,39 @@ def registration(args: Optional[Sequence[str]] = None) -> None:
             "mlflow is not installed in this environment; the model-manager registration app "
             "requires it (`pip install mlflow`)"
         )
-    raise NotImplementedError  # implemented once an mlflow backend is present
+    overrides = list(args if args is not None else sys.argv[1:])
+    kv = dict(o.split("=", 1) for o in overrides if "=" in o)
+    ckpt_path = kv.pop("checkpoint_path", None)
+    if not ckpt_path:
+        raise ValueError("You must specify `checkpoint_path=...`")
+    ckpt_dir = os.path.dirname(os.path.dirname(os.path.abspath(ckpt_path)))
+    cfg_path = os.path.join(ckpt_dir, "config.yaml")
+    if not os.path.exists(cfg_path):
+        raise RuntimeError(f"Cannot find the config file of the checkpoint: {cfg_path}")
+    with open(cfg_path) as f:
+        run_cfg = dotdict(yaml_load(f.read()))
+    # apply model_manager / tracking overrides on the saved config
+    for key, value in kv.items():
+        node = run_cfg
+        parts = key.split(".")
+        for p in parts[:-1]:
+            node = node.setdefault(p, dotdict({}))
+        node[parts[-1]] = yaml_load(value)
+    run_cfg["fabric"] = dotdict(
+        {
+            "_target_": "sheeprl_tpu.parallel.MeshRuntime",
+            "devices": 1,
+            "num_nodes": 1,
+            "strategy": "auto",
+            "accelerator": "cpu",
+            "precision": run_cfg["fabric"].get("precision", "32-true"),
+        }
+    )
+    cfg = dotdict(run_cfg)
+
+    from sheeprl_tpu.utils.callback import load_checkpoint
+    from sheeprl_tpu.utils.mlflow import register_model_from_checkpoint
+
+    state = load_checkpoint(os.path.abspath(ckpt_path))
+    runtime = _build_runtime(cfg)
+    register_model_from_checkpoint(runtime, cfg, state)

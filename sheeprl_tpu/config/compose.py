@@ -167,7 +167,13 @@ def _resolve_value(expr: str, root: dict, stack: Tuple[str, ...]) -> Any:
         return datetime.datetime.now().strftime(expr[4:])
     if expr.startswith("oc.env:") or expr.startswith("env:"):
         parts = expr.split(":", 2)[1:]
-        return os.environ.get(parts[0], parts[1] if len(parts) > 1 else "")
+        name = parts[0]
+        default: Any = parts[1] if len(parts) > 1 else ""
+        # OmegaConf-compatible comma default: ${oc.env:VAR,fallback}
+        if "," in name and len(parts) == 1:
+            name, _, raw_default = name.partition(",")
+            default = yaml_load(raw_default)
+        return os.environ.get(name, default)
     if expr.startswith("eval:"):
         # restricted arithmetic resolver, used e.g. for derived sizes
         return eval(expr[5:], {"__builtins__": {}}, {})  # noqa: S307

@@ -60,7 +60,9 @@ class CrafterWrapper(gym.Env):
         return {"rgb": obs}, reward, terminated, truncated, info
 
     def reset(self, *, seed: Optional[int] = None, options: Optional[Dict[str, Any]] = None):
-        self.env._seed = seed
+        # seed=None must not clobber the constructor-provided seed
+        if seed is not None:
+            self.env._seed = seed
         obs = self.env.reset()
         return {"rgb": obs}, {}
 

@@ -64,6 +64,13 @@ class DiambraWrapper(gym.Wrapper):
         if role is not None and role not in {"P1", "P2"}:
             raise ValueError(f"The valid values for the `role` attribute are 'P1' or 'P2' or None, got {role}")
         self._action_type = action_space.lower()
+        if repeat_action > 1:
+            # sticky actions need the engine stepping one frame at a time
+            if diambra_settings.get("step_ratio", 6) > 1:
+                warnings.warn(
+                    f"step_ratio parameter modified to 1 because the sticky action is active ({repeat_action})"
+                )
+            diambra_settings["step_ratio"] = 1
         settings = EnvironmentSettings(
             **{
                 **diambra_settings,
@@ -74,12 +81,6 @@ class DiambraWrapper(gym.Wrapper):
                 "render_mode": render_mode,
             }
         )
-        if repeat_action > 1:
-            if "step_ratio" not in settings or settings["step_ratio"] > 1:
-                warnings.warn(
-                    f"step_ratio parameter modified to 1 because the sticky action is active ({repeat_action})"
-                )
-            settings["step_ratio"] = 1
         for disabled in ("frame_shape", "stack_frames", "dilation", "flatten"):
             if diambra_wrappers.pop(disabled, None) is not None:
                 warnings.warn(f"The DIAMBRA {disabled} wrapper is disabled")

@@ -192,9 +192,11 @@ class DMCWrapper(gym.Env):
         return obs, time_step.reward or 0.0, terminated, truncated, info
 
     def reset(self, seed: Optional[int] = None, options: Optional[Dict[str, Any]] = None):
-        if not isinstance(seed, np.random.RandomState):
-            seed = np.random.RandomState(seed)
-        self.env.task._random = seed
+        # gymnasium semantics: seed=None keeps the existing (seeded) stream
+        if isinstance(seed, np.random.RandomState):
+            self.env.task._random = seed
+        elif seed is not None:
+            self.env.task._random = np.random.RandomState(seed)
         time_step = self.env.reset()
         self.current_state = _flatten_obs(time_step.observation)
         return self._get_obs(time_step), {}

@@ -98,7 +98,9 @@ class MineRLWrapper(gym.Env):
         act_idx = 1
         for act in self.env.action_space:
             if isinstance(self.env.action_space[act], minerl.herobraine.hero.spaces.Enum):
-                act_val = set(self.env.action_space[act].values.tolist()) - {"none"}
+                # sorted so action indices are stable across processes
+                # (spawned env workers have different hash seeds)
+                act_val = sorted(set(self.env.action_space[act].values.tolist()) - {"none"})
                 act_len = len(act_val)
             elif act != "camera":
                 act_len = 1

@@ -62,8 +62,12 @@ class SuperMarioBrosWrapper(gym.Env):
         if isinstance(action, np.ndarray):
             action = action.squeeze().item()
         obs, reward, done, info = self.env.step(action)
-        is_timelimit = info.get("time", False)
-        return {"rgb": obs.copy()}, reward, done and not is_timelimit, done and is_timelimit, info
+        # info["time"] is the in-game countdown clock: an episode ending with
+        # time left is a real death (terminated), the clock hitting zero is a
+        # timeout (truncated). The reference inverts this (its `is_timelimit
+        # = info.get("time", False)` is truthy on deaths); fixed here.
+        is_timeout = info.get("time", 1) == 0
+        return {"rgb": obs.copy()}, reward, done and not is_timeout, done and is_timeout, info
 
     def reset(self, *, seed: Optional[int] = None, options: Optional[Dict[str, Any]] = None):
         obs = self.env.reset(seed=seed, options=options)

@@ -73,6 +73,70 @@ class TensorBoardLogger:
             self._writer.close()
 
 
+class MLflowLogger:
+    """MLflow metric logger (reference selects lightning's MLFlowLogger via
+    the ``logger@metric.logger: mlflow`` hydra group); gated on mlflow."""
+
+    def __init__(
+        self,
+        experiment_name: str,
+        tracking_uri: Optional[str] = None,
+        run_name: Optional[str] = None,
+        tags: Optional[Dict[str, Any]] = None,
+        run_id: Optional[str] = None,
+        **_: Any,
+    ):
+        from sheeprl_tpu.utils.imports import _IS_MLFLOW_AVAILABLE
+
+        if not _IS_MLFLOW_AVAILABLE:
+            raise ModuleNotFoundError(
+                "mlflow is not installed; the mlflow logger requires it (`pip install mlflow`)."
+            )
+        import mlflow
+
+        self._mlflow = mlflow
+        self.tracking_uri = tracking_uri or os.getenv("MLFLOW_TRACKING_URI")
+        if self.tracking_uri:
+            mlflow.set_tracking_uri(self.tracking_uri)
+        experiment = mlflow.get_experiment_by_name(experiment_name)
+        experiment_id = (
+            mlflow.create_experiment(experiment_name) if experiment is None else experiment.experiment_id
+        )
+        self._run = mlflow.start_run(
+            run_id=run_id, experiment_id=experiment_id, run_name=run_name, tags=tags
+        )
+
+    @property
+    def run_id(self) -> str:
+        return self._run.info.run_id
+
+    @property
+    def log_dir(self) -> Optional[str]:
+        return None
+
+    def log_metrics(self, metrics: Dict[str, float], step: Optional[int] = None) -> None:
+        clean = {}
+        for k, v in metrics.items():
+            try:
+                clean[k] = float(v)
+            except (TypeError, ValueError):
+                pass
+        if clean:
+            self._mlflow.log_metrics(clean, step=step, run_id=self.run_id)
+
+    def log_hyperparams(self, params: Dict[str, Any]) -> None:
+        try:
+            self._mlflow.log_dict(_plain(dict(params)), "config.json", run_id=self.run_id)
+        except Exception:
+            pass
+
+    def log_video(self, tag: str, frames, fps: int = 30, step: Optional[int] = None) -> None:
+        pass  # videos are not logged to mlflow
+
+    def finalize(self) -> None:
+        self._mlflow.end_run()
+
+
 def _plain(v: Any) -> Any:
     if isinstance(v, dict):
         return {k: _plain(x) for k, x in v.items()}

@@ -1,0 +1,121 @@
+"""MLflow registration helpers (gated on ``mlflow``).
+
+Behavioral counterpart of reference sheeprl/utils/mlflow.py
+(register_model:384, register_model_from_checkpoint:330): called at the end
+of training (or offline through the ``sheeprl_tpu-registration`` app) to
+log the agent's models and register them in the MLflow model registry.
+
+Models here are param pytrees: each is pickled (as a pure-numpy tree) and
+logged as a run artifact, then registered from that artifact URI (see
+sheeprl_tpu/utils/model_manager.py for the rationale)."""
+
+from __future__ import annotations
+
+from sheeprl_tpu.utils.imports import _IS_MLFLOW_AVAILABLE
+
+if not _IS_MLFLOW_AVAILABLE:
+    raise ModuleNotFoundError(
+        "mlflow is not installed; MLflow registration requires it (`pip install mlflow`)."
+    )
+
+import os
+import pickle
+import tempfile
+from datetime import datetime
+from typing import Any, Dict, Optional
+
+import mlflow
+
+from sheeprl_tpu.utils.model_manager import MlflowModelManager
+
+
+def _to_numpy_tree(tree: Any) -> Any:
+    import jax
+    import numpy as np
+
+    return jax.tree_util.tree_map(np.asarray, jax.device_get(tree))
+
+
+def log_models(
+    cfg: Dict[str, Any],
+    models_to_log: Dict[str, Any],
+    run_id: Optional[str] = None,
+    experiment_id: Optional[str] = None,
+    run_name: Optional[str] = None,
+) -> Dict[str, str]:
+    """Log each params pytree as a pickled artifact inside one MLflow run.
+
+    Returns {model_key: artifact model_uri} (the generic equivalent of the
+    reference's per-algo ``log_models``, ppo/utils.py:75)."""
+    model_uris: Dict[str, str] = {}
+    with mlflow.start_run(
+        run_id=run_id, experiment_id=experiment_id, run_name=run_name, nested=True
+    ) as active:
+        with tempfile.TemporaryDirectory() as tmp:
+            for name, params in models_to_log.items():
+                path = os.path.join(tmp, f"{name}.pkl")
+                with open(path, "wb") as f:
+                    pickle.dump(_to_numpy_tree(params), f)
+                mlflow.log_artifact(path, artifact_path=name)
+                model_uris[name] = f"runs:/{active.info.run_id}/{name}"
+        mlflow.log_dict(dict(cfg), "config.json")
+    return model_uris
+
+
+def register_model(runtime, cfg: Dict[str, Any], models_to_log: Dict[str, Any]) -> None:
+    """End-of-training registration (reference mlflow.py:384)."""
+    tracking_uri = os.getenv("MLFLOW_TRACKING_URI", None) or cfg.metric.logger.get(
+        "tracking_uri", None
+    )
+    if not tracking_uri:
+        raise ValueError(
+            "The tracking uri is not defined, use an mlflow logger with a tracking uri or define "
+            "the MLFLOW_TRACKING_URI environment variable."
+        )
+    mlflow.set_tracking_uri(tracking_uri)
+    experiment = mlflow.get_experiment_by_name(cfg.exp_name)
+    experiment_id = (
+        mlflow.create_experiment(cfg.exp_name) if experiment is None else experiment.experiment_id
+    )
+    run_name = f"{cfg.algo.name}_{cfg.env.id}_{datetime.today().strftime('%Y-%m-%d %H:%M:%S')}"
+    model_uris = log_models(cfg, models_to_log, None, experiment_id, run_name)
+
+    cfg_model_manager = cfg.model_manager
+    if len(model_uris) != len(cfg_model_manager.models):
+        raise RuntimeError(
+            f"The number of models of the {cfg.algo.name} agent must be equal to the number "
+            f"of models you want to register. {len(cfg_model_manager.models)} model registration "
+            f"configs are given, but the agent has {len(model_uris)} models."
+        )
+    manager = MlflowModelManager(runtime, tracking_uri)
+    for k, cfg_model in cfg_model_manager.models.items():
+        manager.register_model(
+            model_uris[k], cfg_model["model_name"], cfg_model.get("description"), cfg_model.get("tags")
+        )
+
+
+def register_model_from_checkpoint(runtime, cfg: Dict[str, Any], state: Dict[str, Any]) -> None:
+    """Offline registration from a checkpoint (reference mlflow.py:330):
+    collects the algo's MODELS_TO_REGISTER param trees from the checkpoint
+    state and logs+registers them."""
+    import importlib
+
+    from sheeprl_tpu.utils.registry import find_algorithm
+
+    module, _, _ = find_algorithm(cfg.algo.name)
+    utils_module = importlib.import_module(f"{module}.utils")
+    models_to_register = getattr(utils_module, "MODELS_TO_REGISTER", set())
+    missing = sorted(m for m in cfg.model_manager.models if m not in models_to_register)
+    if missing:
+        raise RuntimeError(
+            f"The models you want to register must be in {sorted(models_to_register)}, got {missing}"
+        )
+    models_to_log = {
+        name: state[name] for name in cfg.model_manager.models if name in state
+    }
+    if not models_to_log:
+        raise RuntimeError(
+            f"None of the configured models {sorted(cfg.model_manager.models)} exist in the "
+            f"checkpoint (available keys: {sorted(state)})"
+        )
+    register_model(runtime, cfg, models_to_log)

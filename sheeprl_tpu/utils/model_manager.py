@@ -1,0 +1,140 @@
+"""Model manager (gated on ``mlflow``).
+
+Behavioral counterpart of reference sheeprl/utils/mlflow.py
+(AbstractModelManager:28, MlflowModelManager:75): register / transition /
+delete / download model versions in an MLflow registry, plus
+``register_best_models`` which scans an experiment's runs for the best
+``Test/cumulative_reward``.
+
+TPU-native divergence: agents are param PYTREES, not torch modules, so a
+"model" is logged as a pickled-pytree artifact (``<name>.pkl`` holding the
+numpy tree) and registered from that artifact URI — the jax equivalent of
+``mlflow.pytorch.log_model``. Loading is ``pickle.load`` + feeding the
+tree to the matching ``build_agent``."""
+
+from __future__ import annotations
+
+from sheeprl_tpu.utils.imports import _IS_MLFLOW_AVAILABLE
+
+if not _IS_MLFLOW_AVAILABLE:
+    raise ModuleNotFoundError(
+        "mlflow is not installed; the model manager requires it (`pip install mlflow`)."
+    )
+
+from abc import ABC, abstractmethod
+from typing import Any, Dict, Optional
+
+import mlflow
+from mlflow.tracking import MlflowClient
+
+
+class AbstractModelManager(ABC):
+    """The model-manager surface every backend must provide."""
+
+    def __init__(self, runtime, tracking_uri: str):
+        self.runtime = runtime
+        self.tracking_uri = tracking_uri
+
+    @abstractmethod
+    def register_model(
+        self, model_uri: str, model_name: str, description: Optional[str] = None, tags: Optional[Dict[str, Any]] = None
+    ) -> Any:
+        """Register a logged model artifact as a new model version."""
+
+    @abstractmethod
+    def transition_model(
+        self, model_name: str, version: int, stage: str, description: Optional[str] = None
+    ) -> Any:
+        """Move a model version to a new stage (staging/production/...)."""
+
+    @abstractmethod
+    def delete_model(self, model_name: str, version: int, description: Optional[str] = None) -> None:
+        """Delete one model version (and the registered model when empty)."""
+
+    @abstractmethod
+    def register_best_models(
+        self, experiment_name: str, models_info: Dict[str, Dict[str, Any]], metric: str = "Test/cumulative_reward"
+    ) -> Any:
+        """Register the models of the best run of an experiment."""
+
+    @abstractmethod
+    def download_model(self, model_name: str, version: int, output_path: str) -> None:
+        """Download a registered model version's artifacts."""
+
+
+class MlflowModelManager(AbstractModelManager):
+    """MLflow-backed implementation (reference MlflowModelManager:75)."""
+
+    def __init__(self, runtime, tracking_uri: str):
+        super().__init__(runtime, tracking_uri)
+        mlflow.set_tracking_uri(tracking_uri)
+        self.client = MlflowClient(tracking_uri)
+
+    def register_model(
+        self, model_uri: str, model_name: str, description: Optional[str] = None, tags: Optional[Dict[str, Any]] = None
+    ):
+        model_info = mlflow.register_model(model_uri=model_uri, name=model_name, tags=tags)
+        if description:
+            self.client.update_model_version(model_name, model_info.version, description)
+        self.runtime.print(
+            f"Registered model {model_name} version {model_info.version} from {model_uri}"
+        )
+        return model_info
+
+    def get_latest_version(self, model_name: str):
+        versions = self.client.search_model_versions(
+            f"name = '{model_name}'", order_by=["version_number DESC"], max_results=1
+        )
+        return versions[0] if versions else None
+
+    def transition_model(
+        self, model_name: str, version: int, stage: str, description: Optional[str] = None
+    ):
+        self.client.transition_model_version_stage(model_name, str(version), stage)
+        if description:
+            self.client.update_model_version(model_name, version, description)
+        self.runtime.print(f"Transitioned model {model_name} version {version} to {stage}")
+        return self.client.get_model_version(model_name, version)
+
+    def delete_model(self, model_name: str, version: int, description: Optional[str] = None) -> None:
+        self.client.delete_model_version(model_name, str(version))
+        self.runtime.print(f"Deleted model {model_name} version {version} ({description or ''})")
+        # drop the registered model entirely once the last version is gone
+        if not self.client.search_model_versions(f"name = '{model_name}'", max_results=1):
+            self.client.delete_registered_model(model_name)
+            self.runtime.print(f"Deleted registered model {model_name}")
+
+    def register_best_models(
+        self,
+        experiment_name: str,
+        models_info: Dict[str, Dict[str, Any]],
+        metric: str = "Test/cumulative_reward",
+    ):
+        """Scan every run of ``experiment_name`` and register, for each model
+        in ``models_info``, the artifact of the run with the best ``metric``
+        (reference mlflow.py:214-279)."""
+        experiment = mlflow.get_experiment_by_name(experiment_name)
+        if experiment is None:
+            raise ValueError(f"Experiment '{experiment_name}' does not exist")
+        runs = self.client.search_runs(
+            [experiment.experiment_id], order_by=[f"metrics.`{metric}` DESC"], max_results=1
+        )
+        if not runs:
+            raise ValueError(f"No runs found for experiment '{experiment_name}'")
+        best_run = runs[0]
+        registered = {}
+        for k, info in models_info.items():
+            model_uri = f"runs:/{best_run.info.run_id}/{info.get('path', k)}"
+            registered[k] = self.register_model(
+                model_uri, info["model_name"], info.get("description"), info.get("tags")
+            )
+        return registered
+
+    def download_model(self, model_name: str, version: int, output_path: str) -> None:
+        import os
+
+        os.makedirs(output_path, exist_ok=True)
+        mlflow.artifacts.download_artifacts(
+            artifact_uri=f"models:/{model_name}/{version}", dst_path=output_path
+        )
+        self.runtime.print(f"Downloaded model {model_name} version {version} to {output_path}")

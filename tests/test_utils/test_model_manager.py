@@ -1,0 +1,39 @@
+"""Model-manager surface: mlflow gating + registration app behavior."""
+
+import importlib
+
+import pytest
+
+from sheeprl_tpu.utils.imports import _IS_MLFLOW_AVAILABLE
+
+
+@pytest.mark.skipif(_IS_MLFLOW_AVAILABLE, reason="mlflow installed")
+@pytest.mark.parametrize("mod", ["sheeprl_tpu.utils.model_manager", "sheeprl_tpu.utils.mlflow"])
+def test_model_manager_import_gating(mod):
+    with pytest.raises(ModuleNotFoundError, match="mlflow"):
+        importlib.import_module(mod)
+
+
+@pytest.mark.skipif(_IS_MLFLOW_AVAILABLE, reason="mlflow installed")
+def test_registration_app_gated():
+    from sheeprl_tpu.cli import registration
+
+    with pytest.raises(ModuleNotFoundError, match="mlflow"):
+        registration(["checkpoint_path=/nonexistent"])
+
+
+@pytest.mark.skipif(_IS_MLFLOW_AVAILABLE, reason="mlflow installed")
+def test_mlflow_logger_gated():
+    from sheeprl_tpu.utils.logger import MLflowLogger
+
+    with pytest.raises(ModuleNotFoundError, match="mlflow"):
+        MLflowLogger(experiment_name="x")
+
+
+def test_available_agents_prints(capsys):
+    from sheeprl_tpu.available_agents import available_agents
+
+    available_agents()
+    out = capsys.readouterr().out
+    for name in ("ppo", "sac_decoupled", "dreamer_v3", "p2e_dv2_exploration"):
+        assert name[:12] in out
