@@ -61,12 +61,12 @@ def update_moments(
 
 def prepare_obs(
     obs: Dict[str, np.ndarray], *, cnn_keys: Sequence[str] = (), num_envs: int = 1, **kwargs: Any
-) -> Dict[str, jnp.ndarray]:
+) -> Dict[str, np.ndarray]:
     """(1, num_envs, ...) float obs dict; images NHWC normalized to
     [-0.5, 0.5]."""
     out = {}
     for k, v in obs.items():
-        arr = jnp.asarray(v, dtype=jnp.float32)
+        arr = np.asarray(v, dtype=np.float32)
         if k in cnn_keys:
             arr = arr.reshape(1, num_envs, *arr.shape[-3:]) / 255.0 - 0.5
         else:

@@ -27,11 +27,12 @@ def normalize_obs(obs: Dict[str, Any], cnn_keys: Sequence[str], obs_keys: Sequen
 
 def prepare_obs(
     obs: Dict[str, np.ndarray], *, cnn_keys: Sequence[str] = (), num_envs: int = 1, **kwargs: Any
-) -> Dict[str, jnp.ndarray]:
-    """Host numpy obs dict -> float device arrays (B, ...), normalized."""
+) -> Dict[str, np.ndarray]:
+    """Host numpy obs dict -> float numpy arrays (B, ...), normalized; the
+    device transfer happens inside the consuming jit/player."""
     out = {}
     for k, v in obs.items():
-        arr = jnp.asarray(v, dtype=jnp.float32)
+        arr = np.asarray(v, dtype=np.float32)
         if k in cnn_keys:
             arr = arr.reshape(num_envs, *arr.shape[-3:])
         else:

@@ -22,11 +22,11 @@ MODELS_TO_REGISTER = {"agent"}
 
 def prepare_obs(
     obs: Dict[str, np.ndarray], *, cnn_keys: Sequence[str] = (), num_envs: int = 1, **kwargs: Any
-) -> Dict[str, jnp.ndarray]:
+) -> Dict[str, np.ndarray]:
     """Host numpy obs dict -> float device arrays (T=1, B, ...), normalized."""
     out = {}
     for k, v in obs.items():
-        arr = jnp.asarray(v, dtype=jnp.float32)
+        arr = np.asarray(v, dtype=np.float32)
         if k in cnn_keys:
             arr = arr.reshape(1, num_envs, *arr.shape[-3:])
         else:

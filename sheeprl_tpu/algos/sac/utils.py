@@ -21,10 +21,10 @@ MODELS_TO_REGISTER = {"agent"}
 
 def prepare_obs(
     obs: Dict[str, np.ndarray], *, mlp_keys: Sequence[str] = (), num_envs: int = 1, **kwargs: Any
-) -> jnp.ndarray:
+) -> np.ndarray:
     """Concat the vector obs keys -> (num_envs, obs_dim) float array."""
     with_batch = {k: np.asarray(obs[k]).reshape(num_envs, -1) for k in mlp_keys}
-    return jnp.asarray(np.concatenate([with_batch[k] for k in mlp_keys], axis=-1), dtype=jnp.float32)
+    return np.concatenate([with_batch[k] for k in mlp_keys], axis=-1).astype(np.float32)
 
 
 def test(player, runtime, cfg: Dict[str, Any], log_dir: str) -> float:

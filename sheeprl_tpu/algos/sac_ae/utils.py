@@ -35,11 +35,11 @@ def preprocess_obs(obs: jax.Array, key: jax.Array, bits: int = 8) -> jax.Array:
 
 def prepare_obs(
     obs: Dict[str, np.ndarray], *, cnn_keys: Sequence[str] = (), num_envs: int = 1, **kwargs: Any
-) -> Dict[str, jnp.ndarray]:
+) -> Dict[str, np.ndarray]:
     """(num_envs, ...) float obs dict; images NHWC normalized to [0, 1]."""
     out = {}
     for k, v in obs.items():
-        arr = jnp.asarray(v, dtype=jnp.float32)
+        arr = np.asarray(v, dtype=np.float32)
         if k in cnn_keys:
             arr = arr.reshape(num_envs, *arr.shape[-3:]) / 255.0
         else:

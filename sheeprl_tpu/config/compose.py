@@ -166,14 +166,14 @@ def _resolve_value(expr: str, root: dict, stack: Tuple[str, ...]) -> Any:
     if expr.startswith("now:"):
         return datetime.datetime.now().strftime(expr[4:])
     if expr.startswith("oc.env:") or expr.startswith("env:"):
-        parts = expr.split(":", 2)[1:]
-        name = parts[0]
-        default: Any = parts[1] if len(parts) > 1 else ""
-        # OmegaConf-compatible comma default: ${oc.env:VAR,fallback}
-        if "," in name and len(parts) == 1:
-            name, _, raw_default = name.partition(",")
-            default = yaml_load(raw_default)
-        return os.environ.get(name, default)
+        body = expr.split(":", 1)[1]
+        # OmegaConf-compatible comma default first — the default itself may
+        # contain colons (URIs): ${oc.env:VAR,http://host:5000}
+        if "," in body.split(":", 1)[0]:
+            name, _, raw_default = body.partition(",")
+            return os.environ.get(name, yaml_load(raw_default))
+        name, sep, default = body.partition(":")
+        return os.environ.get(name, default if sep else "")
     if expr.startswith("eval:"):
         # restricted arithmetic resolver, used e.g. for derived sizes
         return eval(expr[5:], {"__builtins__": {}}, {})  # noqa: S307

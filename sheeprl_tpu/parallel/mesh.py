@@ -75,6 +75,18 @@ class MeshRuntime:
         """
         if self._launched:
             return self
+        # persistent XLA compilation cache: repeat runs skip the multi-second
+        # compile of the jitted train/policy steps
+        try:
+            cache_dir = os.environ.get(
+                "SHEEPRL_COMPILATION_CACHE", os.path.join(os.path.expanduser("~"), ".cache", "sheeprl_tpu_xla")
+            )
+            if cache_dir and cache_dir.lower() != "off":
+                os.makedirs(cache_dir, exist_ok=True)
+                jax.config.update("jax_compilation_cache_dir", cache_dir)
+                jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+        except Exception:
+            pass
         if self._num_nodes > 1 and jax.process_count() == 1:
             # multi-host rendezvous (reads JAX coordinator env vars)
             jax.distributed.initialize()
@@ -157,20 +169,30 @@ class MeshRuntime:
     # ------------------------------------------------------------------ #
     def seed_everything(self, seed: int) -> jax.Array:
         """Seed python/numpy and derive the root PRNG key (replaces Fabric's
-        seed_everything + torch cudnn flags)."""
+        seed_everything + torch cudnn flags).
+
+        ``next_key`` draws raw uint32 key DATA from a seeded host-side
+        numpy stream: generating keys costs microseconds, while any eager
+        jax op in the env hot loop pays a per-dispatch toll (and, on
+        tunneled-TPU setups, a device round trip per step)."""
         random.seed(seed)
         np.random.seed(seed)
         os.environ["PYTHONHASHSEED"] = str(seed)
         self._key = jax.random.PRNGKey(seed)
+        self._np_key_rng = np.random.Generator(np.random.PCG64(seed))
         return self._key
 
     def next_key(self, num: int = 1):
-        """Split fresh subkeys off the root key (stateful convenience for the
-        host-side loop; jitted code threads keys explicitly)."""
+        """Fresh independent PRNG keys for the host-side loop (jitted code
+        threads keys explicitly). Raw uint32[2] key data drawn from a seeded
+        host RNG — no device computation per call."""
         if self._key is None:
             self.seed_everything(0)
-        self._key, *subs = jax.random.split(self._key, num + 1)
-        return subs[0] if num == 1 else subs
+        data = self._np_key_rng.integers(0, 2**32, size=(num, 2), dtype=np.uint32)
+        # returned as UNCOMMITTED numpy key data: jit places it with the
+        # computation (replicated over the mesh for train steps, pinned by
+        # the player's device_put for the env hot loop)
+        return data[0] if num == 1 else [row for row in data]
 
     # ------------------------------------------------------------------ #
     # shardings

@@ -110,12 +110,11 @@ def register_model_from_checkpoint(runtime, cfg: Dict[str, Any], state: Dict[str
         raise RuntimeError(
             f"The models you want to register must be in {sorted(models_to_register)}, got {missing}"
         )
-    models_to_log = {
-        name: state[name] for name in cfg.model_manager.models if name in state
-    }
-    if not models_to_log:
+    absent = sorted(m for m in cfg.model_manager.models if m not in state)
+    if absent:
         raise RuntimeError(
-            f"None of the configured models {sorted(cfg.model_manager.models)} exist in the "
-            f"checkpoint (available keys: {sorted(state)})"
+            f"The configured models {absent} do not exist in the checkpoint "
+            f"(available keys: {sorted(state)})"
         )
+    models_to_log = {name: state[name] for name in cfg.model_manager.models}
     register_model(runtime, cfg, models_to_log)
