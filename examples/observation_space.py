@@ -1,0 +1,31 @@
+"""Print the observation/action space an algorithm will see for a given
+config (counterpart of the reference's examples/observation_space.py).
+
+Usage:
+    python examples/observation_space.py exp=ppo env.id=CartPole-v1
+    python examples/observation_space.py exp=dreamer_v3 env=atari
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+import sys
+
+from sheeprl_tpu.config import compose
+from sheeprl_tpu.utils.env import make_env
+
+if __name__ == "__main__":
+    cfg = compose(overrides=list(sys.argv[1:]) or ["exp=ppo", "env.id=CartPole-v1"])
+    cfg.env.capture_video = False
+    env = make_env(cfg, cfg.seed, 0, None, "example")()
+    print(f"env id:             {cfg.env.id}")
+    print(f"observation space:  {env.observation_space}")
+    print(f"action space:       {env.action_space}")
+    print(f"cnn encoder keys:   {cfg.algo.cnn_keys.encoder}")
+    print(f"mlp encoder keys:   {cfg.algo.mlp_keys.encoder}")
+    obs, _ = env.reset(seed=cfg.seed)
+    print("sample obs shapes: ", {k: v.shape for k, v in obs.items()})
+    env.close()

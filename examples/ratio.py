@@ -1,0 +1,32 @@
+"""Demonstrate the replay-ratio scheduler (counterpart of the reference's
+examples/ratio.py): the ``Ratio`` accumulates gradient-step credit at
+``replay_ratio`` per policy step and pays it out in integer repeats.
+
+Run: python examples/ratio.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+from sheeprl_tpu.utils.utils import Ratio
+
+if __name__ == "__main__":
+    num_envs = 1
+    world_size = 1
+    replay_ratio = 0.0625
+    total_policy_steps = 2**10
+    learning_starts = 128
+
+    r = Ratio(ratio=replay_ratio, pretrain_steps=0)
+    policy_steps_per_iter = num_envs * world_size
+    gradient_steps = 0
+    for i in range(0, total_policy_steps, policy_steps_per_iter):
+        if i >= learning_starts:
+            gradient_steps += r(i / world_size)
+    print(f"replay ratio (cfg):      {replay_ratio}")
+    print(f"gradient steps:          {gradient_steps}")
+    print(f"policy steps:            {total_policy_steps}")
+    print(f"measured ratio:          {gradient_steps / total_policy_steps:.4f}")

@@ -349,13 +349,36 @@ class Actor(nn.Module):
         actions: List[jax.Array] = []
         dists = []
         keys = jax.random.split(key, len(heads)) if key is not None else [None] * len(heads)
+        # MineDojo-style conditional masks (reference MinedojoActor:577),
+        # vectorized: craft head constrained when the functional action is
+        # craft (15), inventory head for equip/place (16/17) / destroy (18)
+        functional_action = None
         for i, logits in enumerate(heads):
-            if mask is not None and i == 0 and "mask_action_type" in mask:
-                logits = jnp.where(mask["mask_action_type"], logits, -jnp.inf)
+            if mask is not None:
+                if i == 0 and "mask_action_type" in mask:
+                    logits = jnp.where(mask["mask_action_type"], logits, -jnp.inf)
+                elif i == 1 and "mask_craft_smelt" in mask:
+                    is_craft = (functional_action == 15)[..., None]
+                    valid = jnp.where(is_craft, mask["mask_craft_smelt"], True)
+                    logits = jnp.where(valid, logits, -jnp.inf)
+                elif i == 2 and "mask_equip_place" in mask and "mask_destroy" in mask:
+                    fa = functional_action[..., None]
+                    valid = jnp.where(
+                        (fa == 16) | (fa == 17),
+                        mask["mask_equip_place"],
+                        jnp.where(fa == 18, mask["mask_destroy"], True),
+                    )
+                    logits = jnp.where(valid, logits, -jnp.inf)
             d = OneHotCategoricalStraightThrough(logits=logits)
             dists.append(d)
             actions.append(d.mode if greedy else d.rsample(keys[i]))
+            if functional_action is None:
+                functional_action = actions[0].argmax(-1)
         return tuple(actions), tuple(dists)
+
+
+# cfg.algo.actor.cls target for MineDojo runs (reference MinedojoActor:577)
+MinedojoActor = Actor
 
 
 def add_exploration_noise(

@@ -459,14 +459,41 @@ class Actor(nn.Module):
         actions: List[jax.Array] = []
         dists = []
         keys = jax.random.split(key, len(heads)) if key is not None else [None] * len(heads)
+        # MineDojo-style conditional masks (reference MinedojoActor:848,
+        # vectorized instead of python loops over the batch): head 0 gets
+        # the action-type mask; head 1 (craft item) is constrained only when
+        # the sampled functional action is craft (15); head 2 (inventory
+        # slot) only for equip/place (16/17) or destroy (18)
+        functional_action = None
         for i, logits in enumerate(heads):
             logits = self._uniform_mix(logits)
-            if mask is not None and i == 0 and "mask_action_type" in mask:
-                logits = jnp.where(mask["mask_action_type"], logits, -jnp.inf)
+            if mask is not None:
+                if i == 0 and "mask_action_type" in mask:
+                    logits = jnp.where(mask["mask_action_type"], logits, -jnp.inf)
+                elif i == 1 and "mask_craft_smelt" in mask:
+                    is_craft = (functional_action == 15)[..., None]
+                    valid = jnp.where(is_craft, mask["mask_craft_smelt"], True)
+                    logits = jnp.where(valid, logits, -jnp.inf)
+                elif i == 2 and "mask_equip_place" in mask and "mask_destroy" in mask:
+                    fa = functional_action[..., None]
+                    valid = jnp.where(
+                        (fa == 16) | (fa == 17),
+                        mask["mask_equip_place"],
+                        jnp.where(fa == 18, mask["mask_destroy"], True),
+                    )
+                    logits = jnp.where(valid, logits, -jnp.inf)
             d = OneHotCategoricalStraightThrough(logits=logits)
             dists.append(d)
             actions.append(d.mode if greedy else d.rsample(keys[i]))
+            if functional_action is None:
+                functional_action = actions[0].argmax(-1)
         return tuple(actions), tuple(dists)
+
+
+# cfg.algo.actor.cls target for MineDojo runs (reference MinedojoActor:848);
+# the conditional-mask logic lives directly in Actor's discrete branch, so
+# the Minedojo variant is the same module
+MinedojoActor = Actor
 
 
 class WorldModel:

@@ -1,0 +1,51 @@
+"""Conditional MineDojo action masks in the Dreamer actors (reference
+MinedojoActor dv3 agent.py:848 / dv2 agent.py:577): head 0 respects the
+action-type mask; head 1 (craft item) is constrained only when the sampled
+functional action is craft (15); head 2 (inventory slot) only for
+equip/place (16/17) or destroy (18)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+
+@pytest.mark.parametrize("module_path", ["dreamer_v2", "dreamer_v3"])
+def test_minedojo_conditional_masks(module_path):
+    agent_mod = __import__(f"sheeprl_tpu.algos.{module_path}.agent", fromlist=["MinedojoActor"])
+    Actor = agent_mod.MinedojoActor
+
+    actions_dim = (19, 5, 7)
+    kwargs = dict(actions_dim=actions_dim, is_continuous=False, dense_units=8, mlp_layers=1)
+    actor = Actor(**kwargs)
+    key = jax.random.PRNGKey(0)
+    state = jnp.zeros((4, 16), jnp.float32)
+    params = actor.init({"params": key}, state, False, key)
+
+    # force the functional action to CRAFT (15) via the action-type mask,
+    # and allow only craft item 2 + inventory slot 3
+    mask = {
+        "mask_action_type": jnp.zeros((4, 19), bool).at[:, 15].set(True),
+        "mask_craft_smelt": jnp.zeros((4, 5), bool).at[:, 2].set(True),
+        "mask_equip_place": jnp.zeros((4, 7), bool).at[:, 3].set(True),
+        "mask_destroy": jnp.zeros((4, 7), bool).at[:, 4].set(True),
+    }
+    actions, _ = actor.apply(params, state, False, jax.random.PRNGKey(1), mask)
+    assert np.all(np.asarray(actions[0]).argmax(-1) == 15)
+    # craft selected -> craft head constrained to the only allowed item
+    assert np.all(np.asarray(actions[1]).argmax(-1) == 2)
+    # craft is not equip/place/destroy -> inventory head unconstrained
+    # (just verify it sampled a valid one-hot)
+    assert np.all(np.asarray(actions[2]).sum(-1) == 1)
+
+    # now force DESTROY (18): inventory head must obey mask_destroy
+    mask["mask_action_type"] = jnp.zeros((4, 19), bool).at[:, 18].set(True)
+    actions, _ = actor.apply(params, state, False, jax.random.PRNGKey(2), mask)
+    assert np.all(np.asarray(actions[0]).argmax(-1) == 18)
+    assert np.all(np.asarray(actions[2]).argmax(-1) == 4)
+
+    # EQUIP (16): inventory head obeys mask_equip_place
+    mask["mask_action_type"] = jnp.zeros((4, 19), bool).at[:, 16].set(True)
+    actions, _ = actor.apply(params, state, False, jax.random.PRNGKey(3), mask)
+    assert np.all(np.asarray(actions[0]).argmax(-1) == 16)
+    assert np.all(np.asarray(actions[2]).argmax(-1) == 3)
