@@ -33,7 +33,6 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 import multiprocessing as mp
-import os
 
 
 def player_loop(player_id: int, cfg: dict, data_q: mp.Queue, resp_q: mp.Queue) -> None:

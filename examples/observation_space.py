@@ -11,9 +11,6 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-
-import sys
-
 from sheeprl_tpu.config import compose
 from sheeprl_tpu.utils.env import make_env
 
