@@ -1,0 +1,29 @@
+"""Wall-clock one of the `exp=*_benchmarks` workloads through the real CLI
+(counterpart of the reference's benchmarks/benchmark.py).
+
+Usage:
+    python benchmarks/benchmark.py                 # PPO (the headline)
+    python benchmarks/benchmark.py a2c_benchmarks
+    python benchmarks/benchmark.py sac_benchmarks
+    python benchmarks/benchmark.py dreamer_v3_benchmarks
+    # multi-device variants, e.g.:
+    python benchmarks/benchmark.py ppo_benchmarks fabric.devices=2 env.num_envs=2
+
+For the driver-facing single-JSON-line benchmark see `bench.py` at the repo
+root.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if __name__ == "__main__":
+    from sheeprl_tpu.cli import run
+
+    exp = sys.argv[1] if len(sys.argv) > 1 else "ppo_benchmarks"
+    overrides = [f"exp={exp}", *sys.argv[2:]]
+    tic = time.perf_counter()
+    run(overrides)
+    print(time.perf_counter() - tic)

@@ -1,0 +1,117 @@
+"""DreamerV3 imagination rollout demo (script counterpart of the
+reference's notebooks/dreamer_v3_imagination.ipynb).
+
+Loads a DreamerV3 checkpoint (or builds a randomly-initialized agent when
+none is given), encodes a real observation, rolls the RSSM forward in
+IMAGINATION for H steps driven by the actor, and decodes the imagined
+latent states back to observations.
+
+Usage:
+    python notebooks/dreamer_v3_imagination.py [checkpoint_path=<ckpt>] [horizon=15]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.config import compose
+from sheeprl_tpu.algos.dreamer_v3.agent import RSSM, build_agent
+from sheeprl_tpu.algos.dreamer_v3.utils import prepare_obs
+from sheeprl_tpu.parallel.mesh import MeshRuntime
+from sheeprl_tpu.utils.callback import load_checkpoint
+from sheeprl_tpu.utils.env import make_env
+
+if __name__ == "__main__":
+    kv = dict(a.split("=", 1) for a in sys.argv[1:] if "=" in a)
+    horizon = int(kv.get("horizon", 15))
+
+    cfg = compose(
+        overrides=[
+            "exp=dreamer_v3",
+            "env=dummy",
+            "env.num_envs=1",
+            "env.capture_video=False",
+            "algo.cnn_keys.encoder=[rgb]",
+            "algo.mlp_keys.encoder=[]",
+            "algo.dense_units=64",
+            "algo.mlp_layers=1",
+            "algo.world_model.recurrent_model.recurrent_state_size=64",
+            "algo.world_model.representation_model.hidden_size=64",
+            "algo.world_model.transition_model.hidden_size=64",
+            "algo.world_model.stochastic_size=8",
+            "algo.world_model.discrete_size=8",
+            "fabric.accelerator=cpu",
+        ]
+    )
+    runtime = MeshRuntime(devices=1, accelerator="cpu").launch()
+    runtime.seed_everything(cfg.seed)
+
+    env = make_env(cfg, cfg.seed, 0, None, "imagination")()
+    action_space = env.action_space
+    is_continuous = isinstance(action_space, gym.spaces.Box)
+    actions_dim = tuple(
+        action_space.shape if is_continuous else [action_space.n]
+    )
+
+    state = None
+    if "checkpoint_path" in kv:
+        state = load_checkpoint(kv["checkpoint_path"])
+    world_model, actor, critic, params = build_agent(
+        runtime,
+        actions_dim,
+        is_continuous,
+        cfg,
+        env.observation_space,
+        state["world_model"] if state else None,
+        state["actor"] if state else None,
+        state["critic"] if state else None,
+        state["target_critic"] if state else None,
+    )
+
+    stochastic_size = int(cfg.algo.world_model.stochastic_size)
+    discrete_size = int(cfg.algo.world_model.discrete_size)
+    recurrent_size = int(cfg.algo.world_model.recurrent_model.recurrent_state_size)
+
+    # ------------------------------------------------- encode a real obs
+    obs, _ = env.reset(seed=cfg.seed)
+    prepared = prepare_obs(obs, cnn_keys=cfg.algo.cnn_keys.encoder, num_envs=1)
+    batch_obs = {k: jnp.asarray(v, jnp.float32) / 255.0 - 0.5 for k, v in prepared.items()}
+    embedded = world_model.encoder.apply(params["world_model"]["encoder"], batch_obs)
+
+    recurrent_state = jnp.zeros((1, recurrent_size))
+    k1, k2 = jax.random.split(jnp.asarray(runtime.next_key()).astype(jnp.uint32))
+    _, stochastic = world_model.rssm.apply(
+        params["world_model"]["rssm"], embedded[0], k1, recurrent_state,
+        method=RSSM._representation,
+    )
+    prior = stochastic.reshape(1, stochastic_size * discrete_size)
+
+    # ------------------------------------------------- imagine forward
+    frames = []
+    for t in range(horizon):
+        latent = jnp.concatenate([prior, recurrent_state], -1)
+        k_act, k_img = jax.random.split(jax.random.PRNGKey(t))
+        acts, _ = actor.apply(params["actor"], latent, False, k_act)
+        action = jnp.concatenate(acts, -1)
+        prior_d, recurrent_state = world_model.rssm.apply(
+            params["world_model"]["rssm"], prior, recurrent_state, action, k_img,
+            method=RSSM.imagination,
+        )
+        prior = prior_d.reshape(1, stochastic_size * discrete_size)
+        latent = jnp.concatenate([prior, recurrent_state], -1)
+        decoded = world_model.observation_model.apply(
+            params["world_model"]["observation_model"], latent[None]
+        )
+        frame = np.asarray((decoded["rgb"][0, 0] + 0.5) * 255.0).clip(0, 255).astype(np.uint8)
+        frames.append(frame)
+    env.close()
+
+    out = kv.get("out", "/tmp/dreamer_v3_imagination.npz")
+    np.savez(out, frames=np.stack(frames))
+    print(f"imagined {len(frames)} frames of shape {frames[0].shape} -> {out}")

@@ -1,0 +1,25 @@
+"""Every experiment config must compose (reference parity: the full exp=
+surface of sheeprl/configs/exp)."""
+
+import os
+
+import pytest
+
+from sheeprl_tpu.config.compose import compose
+
+_EXP_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "sheeprl_tpu", "configs", "exp")
+_EXPS = sorted(
+    f[:-5] for f in os.listdir(_EXP_DIR) if f.endswith(".yaml") and f != "default.yaml"
+)
+
+
+@pytest.mark.parametrize("exp", _EXPS)
+def test_exp_config_composes(exp):
+    cfg = compose(overrides=[f"exp={exp}"])
+    assert cfg.algo.name
+    assert cfg.env.wrapper.get("_target_") or cfg.env.id
+    # every exp selects a registered algorithm
+    import sheeprl_tpu  # noqa: F401
+    from sheeprl_tpu.utils.registry import find_algorithm
+
+    find_algorithm(cfg.algo.name)
