@@ -80,8 +80,9 @@ if __name__ == "__main__":
 
     # ------------------------------------------------- encode a real obs
     obs, _ = env.reset(seed=cfg.seed)
+    # prepare_obs already normalizes CNN keys to [-0.5, 0.5]
     prepared = prepare_obs(obs, cnn_keys=cfg.algo.cnn_keys.encoder, num_envs=1)
-    batch_obs = {k: jnp.asarray(v, jnp.float32) / 255.0 - 0.5 for k, v in prepared.items()}
+    batch_obs = {k: jnp.asarray(v, jnp.float32) for k, v in prepared.items()}
     embedded = world_model.encoder.apply(params["world_model"]["encoder"], batch_obs)
 
     recurrent_state = jnp.zeros((1, recurrent_size))

@@ -1,0 +1,5 @@
+from sheeprl_tpu.ops.ring_attention import (  # noqa: F401
+    blockwise_attention,
+    make_ring_attention,
+    ring_attention,
+)

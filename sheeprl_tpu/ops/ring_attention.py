@@ -1,0 +1,197 @@
+"""Sequence/context parallelism primitives: blockwise + ring attention.
+
+The reference framework has no attention anywhere (SURVEY.md §5.7) — its
+long-sequence handling is truncated BPTT through the RSSM. These ops make
+long-context sequence parallelism a first-class capability of the TPU
+runtime for attention-based models: the sequence axis is sharded over a
+mesh axis, every device computes attention for its query shard, and K/V
+shards rotate around the ring over ICI (`jax.lax.ppermute`) while an
+online-softmax accumulator folds in one block per hop — memory per device
+stays O(seq/n_devices), and the K/V transfer overlaps with the block
+matmuls (Ring Attention, arXiv:2310.01889; blockwise parallel transformers,
+arXiv:2305.19370).
+
+Layouts: `q, k, v` are `(..., S, H, D)` (sequence, heads, head_dim) —
+batch dims lead. All math runs in float32 accumulators regardless of input
+dtype (bf16-safe).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _block_scores(q: jax.Array, k: jax.Array) -> jax.Array:
+    """(…, Sq, H, D) x (…, Sk, H, D) -> (…, H, Sq, Sk) scaled scores."""
+    d = q.shape[-1]
+    return jnp.einsum("...qhd,...khd->...hqk", q, k).astype(jnp.float32) / jnp.sqrt(
+        jnp.float32(d)
+    )
+
+
+def _online_update(carry, scores: jax.Array, v: jax.Array, mask: Optional[jax.Array]):
+    """Fold one KV block into the online-softmax state.
+
+    carry: (acc (…, H, Sq, D), row_sum (…, H, Sq, 1), row_max (…, H, Sq, 1))
+    """
+    acc, row_sum, row_max = carry
+    if mask is not None:
+        scores = jnp.where(mask, scores, -jnp.inf)
+    block_max = scores.max(-1, keepdims=True)
+    new_max = jnp.maximum(row_max, block_max)
+    # -inf rows (fully masked so far) must not produce NaNs
+    safe_new_max = jnp.where(jnp.isneginf(new_max), 0.0, new_max)
+    correction = jnp.exp(row_max - safe_new_max)
+    p = jnp.exp(scores - safe_new_max)
+    if mask is not None:
+        p = jnp.where(mask, p, 0.0)
+    acc = acc * correction + jnp.einsum("...hqk,...khd->...hqd", p, v.astype(jnp.float32))
+    row_sum = row_sum * correction + p.sum(-1, keepdims=True)
+    return acc, row_sum, new_max
+
+
+def _finalize(acc: jax.Array, row_sum: jax.Array, dtype) -> jax.Array:
+    out = acc / jnp.maximum(row_sum, 1e-30)
+    # (…, H, Sq, D) -> (…, Sq, H, D)
+    return jnp.swapaxes(out, -3, -2).astype(dtype)
+
+
+def blockwise_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    block_size: int = 512,
+    causal: bool = False,
+) -> jax.Array:
+    """Single-device flash-style attention: `lax.scan` over KV blocks with
+    an online softmax — O(S * block) memory instead of O(S^2).
+
+    q, k, v: (..., S, H, D). Returns (..., Sq, H, D)."""
+    s_k = k.shape[-3]
+    block_size = min(block_size, s_k)
+    n_blocks = -(-s_k // block_size)
+    pad = n_blocks * block_size - s_k
+    if pad:
+        pad_widths = [(0, 0)] * (k.ndim - 3) + [(0, pad), (0, 0), (0, 0)]
+        k = jnp.pad(k, pad_widths)
+        v = jnp.pad(v, pad_widths)
+
+    s_q = q.shape[-3]
+    h = q.shape[-2]
+    batch_shape = q.shape[:-3]
+    q_pos = jnp.arange(s_q)
+
+    # (n_blocks, …, block, H, D) scan layout
+    def to_blocks(x):
+        x = x.reshape(*batch_shape, n_blocks, block_size, h, x.shape[-1])
+        return jnp.moveaxis(x, len(batch_shape), 0)
+
+    kb, vb = to_blocks(k), to_blocks(v)
+
+    acc = jnp.zeros((*batch_shape, h, s_q, q.shape[-1]), jnp.float32)
+    row_sum = jnp.zeros((*batch_shape, h, s_q, 1), jnp.float32)
+    row_max = jnp.full((*batch_shape, h, s_q, 1), -jnp.inf, jnp.float32)
+
+    def step(carry, inp):
+        i, (k_i, v_i) = inp
+        scores = _block_scores(q, k_i)
+        k_pos = i * block_size + jnp.arange(block_size)
+        mask = k_pos[None, :] < s_k  # padding mask, (1, block)
+        if causal:
+            mask = mask & (k_pos[None, :] <= q_pos[:, None])
+        mask = jnp.broadcast_to(mask, scores.shape[-2:])
+        return _online_update(carry, scores, v_i, mask), None
+
+    (acc, row_sum, _), _ = jax.lax.scan(
+        step, (acc, row_sum, row_max), (jnp.arange(n_blocks), (kb, vb))
+    )
+    return _finalize(acc, row_sum, q.dtype)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    causal: bool = False,
+) -> jax.Array:
+    """Ring attention body — call INSIDE `shard_map` with the sequence axis
+    sharded over `axis_name`.
+
+    Each device holds `(..., S/n, H, D)` shards. K/V rotate around the ring
+    with `ppermute`; after n hops every query shard has attended to the
+    full sequence. For `causal=True` global positions are reconstructed
+    from the device index and the hop count."""
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    s_local = q.shape[-3]
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    q_pos = idx * s_local + jnp.arange(s_local)
+
+    acc = jnp.zeros((*q.shape[:-3], q.shape[-2], s_local, q.shape[-1]), jnp.float32)
+    row_sum = jnp.zeros((*q.shape[:-3], q.shape[-2], s_local, 1), jnp.float32)
+    row_max = jnp.full((*q.shape[:-3], q.shape[-2], s_local, 1), -jnp.inf, jnp.float32)
+
+    # the ring size is static, so the hop loop unrolls at trace time (a
+    # lax.scan carry would fight shard_map's varying-axes typing around
+    # ppermute); XLA still pipelines the permute against the block matmuls
+    acc_state = (acc, row_sum, row_max)
+    k_i, v_i = k, v
+    for i in range(n):
+        scores = _block_scores(q, k_i)
+        if causal:
+            # after i hops this K/V block originated on device (idx - i) % n
+            src = (idx - i) % n
+            k_pos = src * s_local + jnp.arange(s_local)
+            mask = jnp.broadcast_to(k_pos[None, :] <= q_pos[:, None], scores.shape[-2:])
+        else:
+            mask = None
+        acc_state = _online_update(acc_state, scores, v_i, mask)
+        if i + 1 < n:
+            # rotate K/V one step around the ring
+            k_i = jax.lax.ppermute(k_i, axis_name, perm)
+            v_i = jax.lax.ppermute(v_i, axis_name, perm)
+    acc, row_sum, _ = acc_state
+    return _finalize(acc, row_sum, q.dtype)
+
+
+def make_ring_attention(
+    mesh: Mesh,
+    axis_name: str = "data",
+    causal: bool = False,
+):
+    """jitted ring attention over `mesh`: inputs `(..., S, H, D)` with the
+    sequence axis sharded over `axis_name` (S divisible by the axis size).
+
+    This is the public entry: it wraps `ring_attention` in `shard_map` with
+    the sequence-sharded PartitionSpecs and jits the result. The spec is
+    built per input rank so any number of leading batch dims works."""
+    fns = {}
+
+    def _build(ndim: int):
+        # (..., S, H, D): shard the sequence axis, replicate the rest
+        spec = P(*([None] * (ndim - 3)), axis_name, None, None)
+
+        @jax.jit
+        @partial(jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+        def fn(q, k, v):
+            return ring_attention(q, k, v, axis_name=axis_name, causal=causal)
+
+        return fn, NamedSharding(mesh, spec)
+
+    def apply(q, k, v):
+        if q.ndim < 3:
+            raise ValueError(f"ring attention inputs must be (..., S, H, D), got rank {q.ndim}")
+        if q.ndim not in fns:
+            fns[q.ndim] = _build(q.ndim)
+        fn, sharding = fns[q.ndim]
+        q, k, v = (jax.device_put(x, sharding) for x in (q, k, v))
+        return fn(q, k, v)
+
+    return apply
