@@ -33,6 +33,7 @@ from sheeprl_tpu.algos.dreamer_v2.loss import reconstruction_loss
 from sheeprl_tpu.algos.dreamer_v2.utils import compute_lambda_values, prepare_obs, test
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.config.compose import _locate
+from sheeprl_tpu.data.feed import batched_feed
 from sheeprl_tpu.data.buffers import (
     EnvIndependentReplayBuffer,
     EpisodeBuffer,
@@ -584,16 +585,14 @@ def main(runtime, cfg: Dict[str, Any]):
                     prioritize_ends=cfg.buffer.get("prioritize_ends", False),
                 )
                 with timer("Time/train_time", SumMetric, sync_on_compute=cfg.metric.sync_on_compute):
-                    for i in range(per_rank_gradient_steps):
+                    feed = batched_feed(local_data, per_rank_gradient_steps)
+                    for i, batch in zip(range(per_rank_gradient_steps), feed):
                         if (
                             cumulative_per_rank_gradient_steps
                             % cfg.algo.critic.per_rank_target_network_update_freq
                             == 0
                         ):
                             params["target_critic"] = _hard_update(params["critic"])
-                        batch = {
-                            k: jnp.asarray(v[i], dtype=jnp.float32) for k, v in local_data.items()
-                        }
                         params, opt_states, train_metrics = train_fn(
                             params, opt_states, batch, runtime.next_key()
                         )

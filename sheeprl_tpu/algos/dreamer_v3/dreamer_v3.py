@@ -39,6 +39,7 @@ from sheeprl_tpu.algos.dreamer_v3.utils import (
 )
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.config.compose import _locate
+from sheeprl_tpu.data.feed import batched_feed
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
 from sheeprl_tpu.envs.wrappers import RestartOnException
 from sheeprl_tpu.utils.callback import CheckpointCallback, load_checkpoint, restore_buffer
@@ -659,7 +660,8 @@ def main(runtime, cfg: Dict[str, Any]):
                     n_samples=per_rank_gradient_steps,
                 )
                 with timer("Time/train_time", SumMetric, sync_on_compute=cfg.metric.sync_on_compute):
-                    for i in range(per_rank_gradient_steps):
+                    feed = batched_feed(local_data, per_rank_gradient_steps)
+                    for i, batch in zip(range(per_rank_gradient_steps), feed):
                         if (
                             cumulative_per_rank_gradient_steps
                             % cfg.algo.critic.per_rank_target_network_update_freq
@@ -669,9 +671,6 @@ def main(runtime, cfg: Dict[str, Any]):
                             params["target_critic"] = _ema(
                                 params["critic"], params["target_critic"], tau
                             )
-                        batch = {
-                            k: jnp.asarray(v[i], dtype=jnp.float32) for k, v in local_data.items()
-                        }
                         params, opt_states, moments_state, train_metrics = train_fn(
                             params, opt_states, moments_state, batch, runtime.next_key()
                         )

@@ -32,6 +32,7 @@ from sheeprl_tpu.algos.dreamer_v2.dreamer_v2 import _make_optimizer
 from sheeprl_tpu.algos.dreamer_v2.utils import prepare_obs, test
 from sheeprl_tpu.algos.p2e_dv1.agent import build_agent, make_player
 from sheeprl_tpu.config import instantiate
+from sheeprl_tpu.data.feed import batched_feed
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
 from sheeprl_tpu.utils.callback import CheckpointCallback, load_checkpoint, restore_buffer
 from sheeprl_tpu.utils.distribution import Bernoulli, Independent, Normal
@@ -558,10 +559,8 @@ def main(runtime, cfg: Dict[str, Any]):
                     n_samples=per_rank_gradient_steps,
                 )
                 with timer("Time/train_time", SumMetric, sync_on_compute=cfg.metric.sync_on_compute):
-                    for i in range(per_rank_gradient_steps):
-                        batch = {
-                            k: jnp.asarray(v[i], dtype=jnp.float32) for k, v in local_data.items()
-                        }
+                    feed = batched_feed(local_data, per_rank_gradient_steps)
+                    for i, batch in zip(range(per_rank_gradient_steps), feed):
                         params, opt_states, train_metrics = train_fn(
                             params, opt_states, batch, runtime.next_key()
                         )

@@ -25,6 +25,7 @@ from sheeprl_tpu.algos.dreamer_v2.utils import prepare_obs, test
 from sheeprl_tpu.algos.p2e_dv2.agent import build_agent, make_player
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.config.compose import yaml_load
+from sheeprl_tpu.data.feed import batched_feed
 from sheeprl_tpu.data.buffers import (
     EnvIndependentReplayBuffer,
     EpisodeBuffer,
@@ -321,16 +322,14 @@ def main(runtime, cfg: Dict[str, Any]):
                     prioritize_ends=cfg.buffer.get("prioritize_ends", False),
                 )
                 with timer("Time/train_time", SumMetric, sync_on_compute=cfg.metric.sync_on_compute):
-                    for i in range(per_rank_gradient_steps):
+                    feed = batched_feed(local_data, per_rank_gradient_steps)
+                    for i, batch in zip(range(per_rank_gradient_steps), feed):
                         if (
                             cumulative_per_rank_gradient_steps
                             % cfg.algo.critic.per_rank_target_network_update_freq
                             == 0
                         ):
                             dv2_params["target_critic"] = _hard_update(dv2_params["critic"])
-                        batch = {
-                            k: jnp.asarray(v[i], dtype=jnp.float32) for k, v in local_data.items()
-                        }
                         dv2_params, opt_states, train_metrics = train_fn(
                             dv2_params, opt_states, batch, runtime.next_key()
                         )

@@ -37,6 +37,7 @@ from sheeprl_tpu.algos.dreamer_v3.utils import (
 )
 from sheeprl_tpu.algos.p2e_dv3.agent import build_agent, make_player
 from sheeprl_tpu.config import instantiate
+from sheeprl_tpu.data.feed import batched_feed
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
 from sheeprl_tpu.envs.wrappers import RestartOnException
 from sheeprl_tpu.utils.callback import CheckpointCallback, load_checkpoint, restore_buffer
@@ -757,7 +758,8 @@ def main(runtime, cfg: Dict[str, Any]):
                     n_samples=per_rank_gradient_steps,
                 )
                 with timer("Time/train_time", SumMetric, sync_on_compute=cfg.metric.sync_on_compute):
-                    for i in range(per_rank_gradient_steps):
+                    feed = batched_feed(local_data, per_rank_gradient_steps)
+                    for i, batch in zip(range(per_rank_gradient_steps), feed):
                         if (
                             cumulative_per_rank_gradient_steps
                             % cfg.algo.critic.per_rank_target_network_update_freq
@@ -773,9 +775,6 @@ def main(runtime, cfg: Dict[str, Any]):
                                     params["critics_exploration"][name]["target_module"],
                                     tau,
                                 )
-                        batch = {
-                            k: jnp.asarray(v[i], dtype=jnp.float32) for k, v in local_data.items()
-                        }
                         params, opt_states, moments_task, moments_expl, train_metrics = train_fn(
                             params, opt_states, moments_task, moments_expl, batch, runtime.next_key()
                         )
