@@ -660,21 +660,21 @@ def main(runtime, cfg: Dict[str, Any]):
                     n_samples=per_rank_gradient_steps,
                 )
                 with timer("Time/train_time", SumMetric, sync_on_compute=cfg.metric.sync_on_compute):
-                    feed = batched_feed(local_data, per_rank_gradient_steps)
-                    for i, batch in zip(range(per_rank_gradient_steps), feed):
-                        if (
-                            cumulative_per_rank_gradient_steps
-                            % cfg.algo.critic.per_rank_target_network_update_freq
-                            == 0
-                        ):
-                            tau = 1.0 if cumulative_per_rank_gradient_steps == 0 else cfg.algo.critic.tau
-                            params["target_critic"] = _ema(
-                                params["critic"], params["target_critic"], tau
+                    with batched_feed(local_data, per_rank_gradient_steps) as feed:
+                        for batch in feed:
+                            if (
+                                cumulative_per_rank_gradient_steps
+                                % cfg.algo.critic.per_rank_target_network_update_freq
+                                == 0
+                            ):
+                                tau = 1.0 if cumulative_per_rank_gradient_steps == 0 else cfg.algo.critic.tau
+                                params["target_critic"] = _ema(
+                                    params["critic"], params["target_critic"], tau
+                                )
+                            params, opt_states, moments_state, train_metrics = train_fn(
+                                params, opt_states, moments_state, batch, runtime.next_key()
                             )
-                        params, opt_states, moments_state, train_metrics = train_fn(
-                            params, opt_states, moments_state, batch, runtime.next_key()
-                        )
-                        cumulative_per_rank_gradient_steps += 1
+                            cumulative_per_rank_gradient_steps += 1
                     train_step += world_size
                 player.params = {"world_model": params["world_model"], "actor": params["actor"]}
                 if aggregator and not aggregator.disabled:

@@ -286,12 +286,12 @@ def main(runtime, cfg: Dict[str, Any]):
                     n_samples=per_rank_gradient_steps,
                 )
                 with timer("Time/train_time", SumMetric, sync_on_compute=cfg.metric.sync_on_compute):
-                    feed = batched_feed(local_data, per_rank_gradient_steps)
-                    for i, batch in zip(range(per_rank_gradient_steps), feed):
-                        dv1_params, opt_states, train_metrics = train_fn(
-                            dv1_params, opt_states, batch, runtime.next_key()
-                        )
-                        cumulative_per_rank_gradient_steps += 1
+                    with batched_feed(local_data, per_rank_gradient_steps) as feed:
+                        for batch in feed:
+                            dv1_params, opt_states, train_metrics = train_fn(
+                                dv1_params, opt_states, batch, runtime.next_key()
+                            )
+                            cumulative_per_rank_gradient_steps += 1
                     train_step += world_size
                 player.params = {
                     "world_model": dv1_params["world_model"],

@@ -654,21 +654,21 @@ def main(runtime, cfg: Dict[str, Any]):
                     prioritize_ends=cfg.buffer.get("prioritize_ends", False),
                 )
                 with timer("Time/train_time", SumMetric, sync_on_compute=cfg.metric.sync_on_compute):
-                    feed = batched_feed(local_data, per_rank_gradient_steps)
-                    for i, batch in zip(range(per_rank_gradient_steps), feed):
-                        if (
-                            cumulative_per_rank_gradient_steps
-                            % cfg.algo.critic.per_rank_target_network_update_freq
-                            == 0
-                        ):
-                            params["target_critic_task"] = _hard_update(params["critic_task"])
-                            params["target_critic_exploration"] = _hard_update(
-                                params["critic_exploration"]
+                    with batched_feed(local_data, per_rank_gradient_steps) as feed:
+                        for batch in feed:
+                            if (
+                                cumulative_per_rank_gradient_steps
+                                % cfg.algo.critic.per_rank_target_network_update_freq
+                                == 0
+                            ):
+                                params["target_critic_task"] = _hard_update(params["critic_task"])
+                                params["target_critic_exploration"] = _hard_update(
+                                    params["critic_exploration"]
+                                )
+                            params, opt_states, train_metrics = train_fn(
+                                params, opt_states, batch, runtime.next_key()
                             )
-                        params, opt_states, train_metrics = train_fn(
-                            params, opt_states, batch, runtime.next_key()
-                        )
-                        cumulative_per_rank_gradient_steps += 1
+                            cumulative_per_rank_gradient_steps += 1
                     train_step += world_size
                 player.params = {
                     "world_model": params["world_model"],

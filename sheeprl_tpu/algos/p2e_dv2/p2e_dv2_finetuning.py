@@ -322,18 +322,18 @@ def main(runtime, cfg: Dict[str, Any]):
                     prioritize_ends=cfg.buffer.get("prioritize_ends", False),
                 )
                 with timer("Time/train_time", SumMetric, sync_on_compute=cfg.metric.sync_on_compute):
-                    feed = batched_feed(local_data, per_rank_gradient_steps)
-                    for i, batch in zip(range(per_rank_gradient_steps), feed):
-                        if (
-                            cumulative_per_rank_gradient_steps
-                            % cfg.algo.critic.per_rank_target_network_update_freq
-                            == 0
-                        ):
-                            dv2_params["target_critic"] = _hard_update(dv2_params["critic"])
-                        dv2_params, opt_states, train_metrics = train_fn(
-                            dv2_params, opt_states, batch, runtime.next_key()
-                        )
-                        cumulative_per_rank_gradient_steps += 1
+                    with batched_feed(local_data, per_rank_gradient_steps) as feed:
+                        for batch in feed:
+                            if (
+                                cumulative_per_rank_gradient_steps
+                                % cfg.algo.critic.per_rank_target_network_update_freq
+                                == 0
+                            ):
+                                dv2_params["target_critic"] = _hard_update(dv2_params["critic"])
+                            dv2_params, opt_states, train_metrics = train_fn(
+                                dv2_params, opt_states, batch, runtime.next_key()
+                            )
+                            cumulative_per_rank_gradient_steps += 1
                     train_step += world_size
                 player.params = {
                     "world_model": dv2_params["world_model"],

@@ -758,27 +758,27 @@ def main(runtime, cfg: Dict[str, Any]):
                     n_samples=per_rank_gradient_steps,
                 )
                 with timer("Time/train_time", SumMetric, sync_on_compute=cfg.metric.sync_on_compute):
-                    feed = batched_feed(local_data, per_rank_gradient_steps)
-                    for i, batch in zip(range(per_rank_gradient_steps), feed):
-                        if (
-                            cumulative_per_rank_gradient_steps
-                            % cfg.algo.critic.per_rank_target_network_update_freq
-                            == 0
-                        ):
-                            tau = 1.0 if cumulative_per_rank_gradient_steps == 0 else cfg.algo.critic.tau
-                            params["target_critic_task"] = _ema(
-                                params["critic_task"], params["target_critic_task"], tau
-                            )
-                            for name in critics_cfg:
-                                params["critics_exploration"][name]["target_module"] = _ema(
-                                    params["critics_exploration"][name]["module"],
-                                    params["critics_exploration"][name]["target_module"],
-                                    tau,
+                    with batched_feed(local_data, per_rank_gradient_steps) as feed:
+                        for batch in feed:
+                            if (
+                                cumulative_per_rank_gradient_steps
+                                % cfg.algo.critic.per_rank_target_network_update_freq
+                                == 0
+                            ):
+                                tau = 1.0 if cumulative_per_rank_gradient_steps == 0 else cfg.algo.critic.tau
+                                params["target_critic_task"] = _ema(
+                                    params["critic_task"], params["target_critic_task"], tau
                                 )
-                        params, opt_states, moments_task, moments_expl, train_metrics = train_fn(
-                            params, opt_states, moments_task, moments_expl, batch, runtime.next_key()
-                        )
-                        cumulative_per_rank_gradient_steps += 1
+                                for name in critics_cfg:
+                                    params["critics_exploration"][name]["target_module"] = _ema(
+                                        params["critics_exploration"][name]["module"],
+                                        params["critics_exploration"][name]["target_module"],
+                                        tau,
+                                    )
+                            params, opt_states, moments_task, moments_expl, train_metrics = train_fn(
+                                params, opt_states, moments_task, moments_expl, batch, runtime.next_key()
+                            )
+                            cumulative_per_rank_gradient_steps += 1
                     train_step += world_size
                 player.params = {
                     "world_model": params["world_model"],
