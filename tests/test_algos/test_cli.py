@@ -1,0 +1,65 @@
+"""CLI behavior tests: resume-from-checkpoint happy path, env/algo mismatch
+errors, evaluation round-trip (reference tests/test_algos/test_cli.py)."""
+
+import glob
+
+import pytest
+
+from sheeprl_tpu.cli import evaluation, run
+
+
+def _ppo_args(tmp_path, root="cli_ppo"):
+    return [
+        "exp=ppo",
+        "dry_run=True",
+        "env=dummy",
+        "env.num_envs=2",
+        "env.sync_env=True",
+        "env.capture_video=False",
+        "fabric.accelerator=cpu",
+        "fabric.devices=1",
+        "metric.log_level=1",
+        f"metric.logger.root_dir={tmp_path}/logs",
+        "checkpoint.save_last=True",
+        "buffer.memmap=False",
+        "seed=0",
+        "algo.rollout_steps=8",
+        "algo.per_rank_batch_size=4",
+        "algo.update_epochs=1",
+        "algo.dense_units=8",
+        "algo.mlp_layers=1",
+        "algo.mlp_keys.encoder=[state]",
+        f"root_dir={tmp_path}/{root}",
+    ]
+
+
+def _train_and_get_ckpt(tmp_path, root="cli_ppo"):
+    run(_ppo_args(tmp_path, root))
+    ckpts = sorted(glob.glob(f"{tmp_path}/{root}/**/ckpt_*.ckpt", recursive=True))
+    assert len(ckpts) > 0
+    return ckpts[-1]
+
+
+def test_resume_from_checkpoint(tmp_path):
+    ckpt = _train_and_get_ckpt(tmp_path)
+    run(_ppo_args(tmp_path) + [f"checkpoint.resume_from={ckpt}"])
+
+
+def test_resume_from_checkpoint_env_error(tmp_path):
+    ckpt = _train_and_get_ckpt(tmp_path)
+    with pytest.raises(RuntimeError, match="different environment"):
+        run(_ppo_args(tmp_path) + [f"checkpoint.resume_from={ckpt}", "env.id=dummy_continuous"])
+
+
+def test_resume_from_checkpoint_algo_error(tmp_path):
+    ckpt = _train_and_get_ckpt(tmp_path)
+    with pytest.raises(RuntimeError, match="different algorithm"):
+        run(
+            _ppo_args(tmp_path)
+            + [f"checkpoint.resume_from={ckpt}", "exp=a2c", "~algo.update_epochs", "~algo.clip_coef"]
+        )
+
+
+def test_evaluate(tmp_path):
+    ckpt = _train_and_get_ckpt(tmp_path, root="cli_ppo_eval")
+    evaluation([f"checkpoint_path={ckpt}", "env.capture_video=False", "fabric.accelerator=cpu"])
