@@ -237,12 +237,11 @@ def main(runtime, cfg: Dict[str, Any]):
             params, opt_state, train_metrics = update_fn(
                 params, opt_state, local_data, device_next_obs, runtime.next_key(), jnp.float32(current_lr)
             )
-            train_metrics = jax.device_get(train_metrics)
         player.params = params
         train_step += world_size
 
         if aggregator and not aggregator.disabled:
-            for k, v in train_metrics.items():
+            for k, v in jax.device_get(train_metrics).items():
                 aggregator.update(k, v)
 
         if cfg.metric.log_level > 0 and logger:

@@ -261,24 +261,23 @@ def main(runtime, cfg: Dict[str, Any]):
                 bs = cfg.algo.per_rank_batch_size * world_size
                 critic_sample = rb.sample(batch_size=g * bs, sample_next_obs=cfg.buffer.sample_next_obs)
                 critic_data = {
-                    k: jnp.asarray(v, jnp.float32).reshape(g, bs, *v.shape[2:])
+                    k: np.asarray(v, np.float32).reshape(g, bs, *v.shape[2:])
                     for k, v in critic_sample.items()
                 }
                 actor_sample = rb.sample(batch_size=bs, sample_next_obs=cfg.buffer.sample_next_obs)
                 actor_data = {
-                    k: jnp.asarray(v, jnp.float32).reshape(bs, *v.shape[2:])
+                    k: np.asarray(v, np.float32).reshape(bs, *v.shape[2:])
                     for k, v in actor_sample.items()
                 }
                 with timer("Time/train_time", SumMetric, sync_on_compute=cfg.metric.sync_on_compute):
                     params, opt_states, train_metrics = train_fn(
                         params, opt_states, critic_data, actor_data, runtime.next_key()
                     )
-                    train_metrics = jax.device_get(train_metrics)
                 player.params = params["actor"]
                 cumulative_per_rank_gradient_steps += g
                 train_step += world_size
                 if aggregator and not aggregator.disabled:
-                    for k, v in train_metrics.items():
+                    for k, v in jax.device_get(train_metrics).items():
                         aggregator.update(k, v)
 
         if cfg.metric.log_level > 0 and (

@@ -361,12 +361,13 @@ def main(runtime, cfg: Dict[str, Any]):
                 jnp.float32(current_ent),
                 jnp.float32(current_lr),
             )
-            train_metrics = jax.device_get(train_metrics)
         player.params = params
         train_step += world_size
 
         if aggregator and not aggregator.disabled:
-            for k, v in train_metrics.items():
+            # materializing metrics blocks on the update; only pay that
+            # sync when metrics are on
+            for k, v in jax.device_get(train_metrics).items():
                 aggregator.update(k, v)
 
         # ------------------------------------------------- logging

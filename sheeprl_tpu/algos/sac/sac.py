@@ -314,14 +314,14 @@ def main(runtime, cfg: Dict[str, Any]):
                     batch_size=batch_total,
                     sample_next_obs=cfg.buffer.sample_next_obs,
                 )
+                # reshape host-side: eager jnp ops in the hot loop pay a
+                # dispatch each; jit transfers the numpy batch in one copy
                 data = {
-                    k: jnp.asarray(v, dtype=jnp.float32).reshape(
+                    k: np.asarray(v, dtype=np.float32).reshape(
                         g, cfg.algo.per_rank_batch_size * world_size, *v.shape[2:]
                     )
                     for k, v in sample.items()
                 }
-                if cfg.buffer.sample_next_obs:
-                    data["next_observations"] = data.pop("next_observations")
                 with timer("Time/train_time", SumMetric, sync_on_compute=cfg.metric.sync_on_compute):
                     params, opt_states, train_metrics = train_fn(
                         params,
@@ -330,12 +330,13 @@ def main(runtime, cfg: Dict[str, Any]):
                         runtime.next_key(),
                         jnp.asarray(iter_num % ema_every == 0),
                     )
-                    train_metrics = jax.device_get(train_metrics)
                 player.params = params["actor"]
                 cumulative_per_rank_gradient_steps += g
                 train_step += world_size
                 if aggregator and not aggregator.disabled:
-                    for k, v in train_metrics.items():
+                    # materializing metrics blocks on the train step; only
+                    # pay that sync when metrics are on
+                    for k, v in jax.device_get(train_metrics).items():
                         aggregator.update(k, v)
 
         if cfg.metric.log_level > 0 and (

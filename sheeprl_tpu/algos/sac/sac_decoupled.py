@@ -382,7 +382,7 @@ def main(runtime, cfg: Dict[str, Any]):
             _, sample, g, iter_num = msg
 
             data = {
-                k: jnp.asarray(v, dtype=jnp.float32).reshape(
+                k: np.asarray(v, dtype=np.float32).reshape(
                     g, cfg.algo.per_rank_batch_size * runtime.world_size, *v.shape[2:]
                 )
                 for k, v in sample.items()

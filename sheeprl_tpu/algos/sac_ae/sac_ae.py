@@ -397,7 +397,7 @@ def main(runtime, cfg: Dict[str, Any]):
                     sample_next_obs=cfg.buffer.sample_next_obs,
                 )
                 data = {
-                    k: jnp.asarray(v, dtype=jnp.float32).reshape(
+                    k: np.asarray(v, dtype=np.float32).reshape(
                         g, cfg.algo.per_rank_batch_size * world_size, *v.shape[2:]
                     )
                     for k, v in sample.items()
@@ -410,12 +410,11 @@ def main(runtime, cfg: Dict[str, Any]):
                         runtime.next_key(),
                         jnp.asarray(cumulative_per_rank_gradient_steps),
                     )
-                    train_metrics = jax.device_get(train_metrics)
                 player.params = {"encoder": params["critic"]["encoder"], "actor": params["actor"]}
                 cumulative_per_rank_gradient_steps += g
                 train_step += world_size
                 if aggregator and not aggregator.disabled:
-                    for k, v in train_metrics.items():
+                    for k, v in jax.device_get(train_metrics).items():
                         aggregator.update(k, v)
 
         if cfg.metric.log_level > 0 and (
