@@ -50,7 +50,9 @@ def two_hot_encoder(x: jax.Array, support_range: int = 300, num_buckets: Optiona
     """
     if num_buckets is None:
         num_buckets = support_range * 2 + 1
-    x = jnp.clip(symlog(x), -support_range, support_range)
+    # plain two-hot, no symlog: like the reference util, the symlog
+    # compression is the caller's (TwoHotEncodingDistribution's) job
+    x = jnp.clip(x, -support_range, support_range)
     support = jnp.linspace(-support_range, support_range, num_buckets)
     below = (support <= x).astype(jnp.int32).sum(-1, keepdims=True) - 1
     below = jnp.clip(below, 0, num_buckets - 1)
@@ -69,10 +71,11 @@ def two_hot_encoder(x: jax.Array, support_range: int = 300, num_buckets: Optiona
 
 
 def two_hot_decoder(probs: jax.Array, support_range: int) -> jax.Array:
-    """Decode a two-hot distribution back to a scalar (..., 1)."""
+    """Decode a two-hot distribution back to a scalar (..., 1); plain
+    expectation over the support (no symexp — the caller's job)."""
     num_buckets = probs.shape[-1]
     support = jnp.linspace(-support_range, support_range, num_buckets)
-    return symexp((probs * support).sum(-1, keepdims=True))
+    return (probs * support).sum(-1, keepdims=True)
 
 
 def gae(
@@ -121,8 +124,10 @@ def lambda_values(
     Inputs (T, B, 1) where ``continues`` already includes gamma.
     Reference: sheeprl/algos/dreamer_v3/utils.py:67-79.
     """
-    vals = jnp.concatenate([values[1:], values[-1:]], axis=0)
-    interm = rewards + continues * vals * (1 - lmbda)
+    # reference recursion: R[t] = r[t] + c[t]*((1-lambda)*v[t] + lambda*R[t+1])
+    # seeded with R[T] = v[T-1] (UNshifted v[t] in the interm term — the
+    # callers pass already-offset reward/value slices)
+    interm = rewards + continues * values * (1 - lmbda)
 
     def step(carry, inp):
         it, cont = inp
