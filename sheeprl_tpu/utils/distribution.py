@@ -371,23 +371,12 @@ class TwoHotEncodingDistribution(Distribution):
 
     def log_prob(self, x: jax.Array) -> jax.Array:
         """x: (..., 1) raw-space scalars; returns (...,) summed over event dims."""
-        x = symlog(x)
-        nbins = self.bins.shape[0]
-        below = (self.bins <= x).astype(jnp.int32).sum(-1, keepdims=True) - 1
-        below = jnp.clip(below, 0, nbins - 1)
-        above = jnp.clip(below + 1, 0, nbins - 1)
-        equal = below == above
-        dist_below = jnp.where(equal, 1.0, jnp.abs(jnp.take(self.bins, below.squeeze(-1))[..., None] - x))
-        dist_above = jnp.where(equal, 1.0, jnp.abs(jnp.take(self.bins, above.squeeze(-1))[..., None] - x))
-        total = dist_below + dist_above
-        w_below = dist_above / total
-        w_above = dist_below / total
-        target = (
-            jax.nn.one_hot(below.squeeze(-1), nbins) * w_below
-            + jax.nn.one_hot(above.squeeze(-1), nbins) * w_above
+        from sheeprl_tpu.utils.utils import two_hot_encoder
+
+        target = two_hot_encoder(
+            symlog(x), support_range=int(self.high), num_buckets=self.bins.shape[0]
         )
-        log_pred = self.logits
-        return (target * log_pred).sum(-1, keepdims=True).sum(self._dims)
+        return (target * self.logits).sum(-1, keepdims=True).sum(self._dims)
 
 
 def kl_divergence(p: Distribution, q: Distribution) -> jax.Array:

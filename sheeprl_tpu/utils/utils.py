@@ -42,7 +42,7 @@ def symexp(x: jax.Array) -> jax.Array:
 
 
 def two_hot_encoder(x: jax.Array, support_range: int = 300, num_buckets: Optional[int] = None) -> jax.Array:
-    """Two-hot encode ``x`` (symlog-compressed) over a symexp-spaced support.
+    """Two-hot encode ``x`` over a uniform support (plain — the caller symlogs).
 
     Equivalent of reference utils/utils.py:158-180: support has
     ``num_buckets`` bins spanning ``[-support_range, support_range]``.

@@ -8,7 +8,8 @@ evaluated on identical random inputs as the jax implementations."""
 
 import numpy as np
 import pytest
-import torch
+
+torch = pytest.importorskip("torch")
 
 jnp = pytest.importorskip("jax.numpy")
 
