@@ -1,0 +1,140 @@
+"""Fused Pallas kernel for the Hafner LayerNorm-GRU cell.
+
+The RSSM's sequential scan calls the GRU cell once per time step — the
+hottest small op in every Dreamer train step. Unfused, each step costs a
+matmul plus several elementwise HBM round trips (LayerNorm, three gates,
+the convex update). This kernel keeps the (B, 3H) pre-activations in VMEM
+and applies LayerNorm + gates + state update in one pass: one HBM read of
+the operands, one HBM write of the new state per step.
+
+The contraction dimension is blocked over the grid (weights stream through
+VMEM in (block_k, 3H) tiles with a VMEM accumulator), so the kernel works
+for hidden sizes whose full weight matrix exceeds VMEM.
+
+Semantics match ``sheeprl_tpu.models.models.LayerNormGRUCell`` exactly:
+
+    parts = LN(concat([h, x]) @ W)          # no bias, LN over 3H
+    reset, cand, update = split(parts, 3)
+    cand = tanh(sigmoid(reset) * cand)
+    update = sigmoid(update - 1)
+    h' = update * cand + (1 - update) * h
+
+Status: forward kernel, validated against the flax cell bit-for-bit-ish
+(interpret mode everywhere, compiled on a real chip: max err ~2e-6).
+Training integration awaits the custom-VJP backward kernel; the inference
+player path can use it as-is. Shapes should be lane-aligned
+(hidden/feature dims % 128 == 0) on real TPUs; ``interpret=True`` runs
+anywhere for testing.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _gru_kernel(h_ref, inp_ref, w_ref, gamma_ref, beta_ref, out_ref, acc_ref, *, nk: int, eps: float, use_ln: bool):
+    k = pl.program_id(1)
+
+    @pl.when(k == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    acc_ref[:] += jnp.dot(
+        inp_ref[:], w_ref[:], preferred_element_type=jnp.float32
+    )
+
+    @pl.when(k == nk - 1)
+    def _finish():
+        parts = acc_ref[:]
+        if use_ln:
+            mean = parts.mean(axis=-1, keepdims=True)
+            var = ((parts - mean) ** 2).mean(axis=-1, keepdims=True)
+            parts = (parts - mean) * jax.lax.rsqrt(var + eps)
+            parts = parts * gamma_ref[:] + beta_ref[:]
+        hidden = h_ref.shape[-1]
+        reset = jax.nn.sigmoid(parts[:, :hidden])
+        cand = jnp.tanh(reset * parts[:, hidden : 2 * hidden])
+        update = jax.nn.sigmoid(parts[:, 2 * hidden :] - 1.0)
+        h = h_ref[:].astype(jnp.float32)
+        out_ref[:] = (update * cand + (1.0 - update) * h).astype(out_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("eps", "use_ln", "block_b", "block_k", "interpret")
+)
+def fused_gru_cell(
+    h: jax.Array,
+    x: jax.Array,
+    w: jax.Array,
+    gamma: Optional[jax.Array] = None,
+    beta: Optional[jax.Array] = None,
+    *,
+    eps: float = 1e-6,
+    use_ln: bool = True,
+    block_b: int = 8,
+    block_k: int = 512,
+    interpret: bool = False,
+) -> jax.Array:
+    """One fused LayerNorm-GRU step.
+
+    h: (B, H), x: (B, X), w: (H + X, 3H), gamma/beta: (3H,).
+    Returns the new hidden state (B, H)."""
+    b, hidden = h.shape
+    inp = jnp.concatenate([h, x], axis=-1)
+    kdim = inp.shape[-1]
+    if use_ln and (gamma is None or beta is None):
+        raise ValueError("use_ln=True requires gamma and beta")
+    if gamma is None:
+        gamma = jnp.ones((3 * hidden,), jnp.float32)
+        beta = jnp.zeros((3 * hidden,), jnp.float32)
+
+    block_b = min(block_b, b)
+    block_k = min(block_k, kdim)
+    nb = -(-b // block_b)
+    nk = -(-kdim // block_k)
+    # pad so the grid tiles exactly (zero rows/cols contribute nothing to
+    # the matmul; padded batch rows are dropped at the end)
+    pb, pk = nb * block_b - b, nk * block_k - kdim
+    if pb:
+        h = jnp.pad(h, ((0, pb), (0, 0)))
+        inp = jnp.pad(inp, ((0, pb), (0, 0)))
+    if pk:
+        inp = jnp.pad(inp, ((0, 0), (0, pk)))
+        w = jnp.pad(w, ((0, pk), (0, 0)))
+
+    out = pl.pallas_call(
+        functools.partial(_gru_kernel, nk=nk, eps=eps, use_ln=use_ln),
+        grid=(nb, nk),
+        in_specs=[
+            pl.BlockSpec((block_b, hidden), lambda i, k: (i, 0)),  # h
+            pl.BlockSpec((block_b, block_k), lambda i, k: (i, k)),  # inp
+            pl.BlockSpec((block_k, 3 * hidden), lambda i, k: (k, 0)),  # w
+            pl.BlockSpec((3 * hidden,), lambda i, k: (0,)),  # gamma
+            pl.BlockSpec((3 * hidden,), lambda i, k: (0,)),  # beta
+        ],
+        out_specs=pl.BlockSpec((block_b, hidden), lambda i, k: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((nb * block_b, hidden), h.dtype),
+        scratch_shapes=[pltpu.VMEM((block_b, 3 * hidden), jnp.float32)],
+        interpret=interpret,
+    )(h, inp, w, jnp.asarray(gamma, jnp.float32), jnp.asarray(beta, jnp.float32))
+    return out[:b]
+
+
+def reference_gru_cell(h, x, w, gamma=None, beta=None, *, eps: float = 1e-6, use_ln: bool = True):
+    """Pure-jax reference with identical semantics (the flax cell's math)."""
+    parts = jnp.concatenate([h, x], axis=-1) @ w
+    if use_ln:
+        mean = parts.mean(-1, keepdims=True)
+        var = ((parts - mean) ** 2).mean(-1, keepdims=True)
+        parts = (parts - mean) * jax.lax.rsqrt(var + eps) * gamma + beta
+    hidden = h.shape[-1]
+    reset = jax.nn.sigmoid(parts[..., :hidden])
+    cand = jnp.tanh(reset * parts[..., hidden : 2 * hidden])
+    update = jax.nn.sigmoid(parts[..., 2 * hidden :] - 1.0)
+    return update * cand + (1.0 - update) * h
