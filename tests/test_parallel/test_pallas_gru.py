@@ -27,6 +27,28 @@ def test_fused_gru_matches_reference(b, hidden, xdim, use_ln):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
 
 
+def test_gru_cell_custom_vjp_gradients(monkeypatch):
+    """gru_cell (pallas forward + analytic backward) must produce the same
+    gradients as differentiating the reference formulas directly."""
+    import sheeprl_tpu.ops.pallas_gru as pg
+
+    rng = np.random.default_rng(4)
+    b, hidden, xdim = 4, 128, 128
+    h = jnp.asarray(rng.normal(size=(b, hidden)), jnp.float32)
+    x = jnp.asarray(rng.normal(size=(b, xdim)), jnp.float32)
+    w = jnp.asarray(rng.normal(scale=0.1, size=(hidden + xdim, 3 * hidden)), jnp.float32)
+    gamma = jnp.ones((3 * hidden,))
+    beta = jnp.zeros((3 * hidden,))
+
+    orig = pg.fused_gru_cell
+    monkeypatch.setattr(
+        pg, "fused_gru_cell", lambda *a, **k: orig(*a, **{**k, "interpret": True})
+    )
+    g_fused = jax.grad(lambda w_: pg.gru_cell(h, x, w_, gamma, beta).sum())(w)
+    g_ref = jax.grad(lambda w_: pg.reference_gru_cell(h, x, w_, gamma, beta).sum())(w)
+    np.testing.assert_allclose(np.asarray(g_fused), np.asarray(g_ref), rtol=1e-5, atol=1e-6)
+
+
 def test_fused_gru_matches_flax_cell():
     """The kernel reproduces LayerNormGRUCell bit-for-bit-ish using the
     cell's own parameters."""
