@@ -1,17 +1,24 @@
-"""Benchmark harness — prints ONE JSON line for the driver.
+"""Benchmark harness — prints one JSON line per metric for the driver.
 
-Workload: the reference's own PPO benchmark protocol
+Line 1 — PPO wall-clock, the reference's own benchmark protocol
 (reference benchmarks/benchmark.py + configs/exp/ppo_benchmarks.yaml):
 PPO on CartPole-v1, 1 env, 65536 total steps, linear actor/critic heads,
 logging/checkpoint/test disabled, wall-clock around cli.run().
-
 Baseline: 81.27 s (reference README.md:100-115, SheepRL v0.5.5, 1 device).
-``vs_baseline`` is the speedup factor (baseline_time / our_time, >1 is
-faster than the reference).
+
+Line 2 — the north star (BASELINE.md): DreamerV3-S replayed-frames/s of
+the full jitted train step on Atari-shaped pixels (B=16, T=64, 64x64x3).
+Baseline: the reference's Atari-100K MsPacman run (README.md:44-51) —
+100K policy steps x replay_ratio 1 = 100K gradient steps x 1024 frames
+in 14 h on an RTX 3080 ~= 2032 replayed frames/s.
+
+``vs_baseline`` is the speedup factor (>1 is faster than the reference).
 
 Env overrides:
-  BENCH_TOTAL_STEPS  — shrink the workload (wall-clock is extrapolated
+  BENCH_TOTAL_STEPS  — shrink the PPO workload (wall-clock is extrapolated
                        linearly to 65536 for the reported value).
+  BENCH_DV3_STEPS    — timed DV3 train steps (default 20).
+  BENCH_SKIP_DV3 / BENCH_SKIP_PPO — skip a section.
 """
 
 import json
@@ -20,6 +27,7 @@ import sys
 import time
 
 REFERENCE_PPO_SECONDS = 81.27
+REFERENCE_DV3_FRAMES_PER_S = 2032.0
 FULL_STEPS = 65536
 
 
@@ -43,23 +51,41 @@ def main() -> None:
         except Exception:
             pass
 
-    from sheeprl_tpu.cli import run
+    if not os.environ.get("BENCH_SKIP_PPO"):
+        from sheeprl_tpu.cli import run
 
-    args = [
-        "exp=ppo_benchmarks",
-        f"algo.total_steps={total_steps}",
-    ]
-    tic = time.perf_counter()
-    run(args)
-    elapsed = time.perf_counter() - tic
-    scaled = elapsed * (FULL_STEPS / total_steps)
-    result = {
-        "metric": "ppo_cartpole_benchmark_wallclock",
-        "value": round(scaled, 2),
-        "unit": "s",
-        "vs_baseline": round(REFERENCE_PPO_SECONDS / scaled, 3),
-    }
-    print(json.dumps(result))
+        args = [
+            "exp=ppo_benchmarks",
+            f"algo.total_steps={total_steps}",
+        ]
+        tic = time.perf_counter()
+        run(args)
+        elapsed = time.perf_counter() - tic
+        scaled = elapsed * (FULL_STEPS / total_steps)
+        result = {
+            "metric": "ppo_cartpole_benchmark_wallclock",
+            "value": round(scaled, 2),
+            "unit": "s",
+            "vs_baseline": round(REFERENCE_PPO_SECONDS / scaled, 3),
+        }
+        print(json.dumps(result))
+
+    if not os.environ.get("BENCH_SKIP_DV3"):
+        from benchmarks.bench_dv3_step import time_variant
+
+        dv3_steps = int(os.environ.get("BENCH_DV3_STEPS", 20))
+        dt, t_len, b_size = time_variant(fused=False, precision="bf16-mixed", steps=dv3_steps)
+        frames_per_s = t_len * b_size / dt
+        print(
+            json.dumps(
+                {
+                    "metric": "dreamer_v3_S_train_replayed_frames_per_s",
+                    "value": round(frames_per_s, 1),
+                    "unit": "frames/s",
+                    "vs_baseline": round(frames_per_s / REFERENCE_DV3_FRAMES_PER_S, 3),
+                }
+            )
+        )
 
 
 if __name__ == "__main__":

@@ -1,0 +1,120 @@
+"""DV3-S train-step micro-benchmark on the current default jax platform.
+
+Builds the full single-jit DreamerV3 train step (world model + imagination +
+actor + critic + Moments) at S size on Atari-shaped pixels (64x64x3,
+batch 16 x seq 64 — the reference's per_rank settings,
+reference configs/algo/dreamer_v3.yaml + exp/dreamer_v3_100k_ms_pacman.yaml)
+and times it with the fused Pallas GRU off and on.
+
+Usage: python benchmarks/bench_dv3_step.py [--precision bf16-mixed] [--steps 20]
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build(fused: bool, precision: str):
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sheeprl_tpu.algos.dreamer_v3.agent import build_agent
+    from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import _make_optimizer, make_train_fn
+    from sheeprl_tpu.algos.dreamer_v3.utils import init_moments
+    from sheeprl_tpu.config import compose
+    from sheeprl_tpu.parallel.mesh import MeshRuntime
+
+    import gymnasium as gym
+
+    cfg = compose(
+        overrides=[
+            "exp=dreamer_v3",
+            "env=dummy",
+            "algo=dreamer_v3_S",
+            "env.num_envs=1",
+            "algo.cnn_keys.encoder=[rgb]",
+            "algo.mlp_keys.encoder=[]",
+            f"algo.world_model.recurrent_model.fused={fused}",
+        ]
+    )
+    runtime = MeshRuntime(devices=1, accelerator="auto", precision=precision).launch()
+    runtime.seed_everything(0)
+    obs_space = gym.spaces.Dict({"rgb": gym.spaces.Box(0, 255, (64, 64, 3), np.uint8)})
+    actions_dim = (6,)
+    world_model, actor, critic, params = build_agent(runtime, actions_dim, True, cfg, obs_space)
+    wm_tx = _make_optimizer(cfg.algo.world_model.optimizer, cfg.algo.world_model.clip_gradients)
+    actor_tx = _make_optimizer(cfg.algo.actor.optimizer, cfg.algo.actor.clip_gradients)
+    critic_tx = _make_optimizer(cfg.algo.critic.optimizer, cfg.algo.critic.clip_gradients)
+    opt_states = {
+        "world_model": wm_tx.init(params["world_model"]),
+        "actor": actor_tx.init(params["actor"]),
+        "critic": critic_tx.init(params["critic"]),
+    }
+    moments = init_moments()
+    train_fn = make_train_fn(
+        runtime, world_model, actor, critic, (wm_tx, actor_tx, critic_tx), cfg, True, actions_dim
+    )
+
+    T, B = int(cfg.algo.per_rank_sequence_length), int(cfg.algo.per_rank_batch_size)
+    rng = np.random.default_rng(0)
+    data = {
+        "rgb": jnp.asarray(rng.integers(0, 255, (T, B, 64, 64, 3)).astype(np.float32)),
+        "actions": jnp.asarray(np.eye(6, dtype=np.float32)[rng.integers(0, 6, (T, B))]),
+        "rewards": jnp.asarray(rng.normal(size=(T, B, 1)).astype(np.float32)),
+        "terminated": jnp.zeros((T, B, 1), jnp.float32),
+        "is_first": jnp.zeros((T, B, 1), jnp.float32),
+    }
+    return runtime, train_fn, params, opt_states, moments, data, (T, B)
+
+
+def time_variant(fused: bool, precision: str, steps: int):
+    """Returns (seconds_per_step, T, B) for the timed configuration."""
+    import jax
+
+    runtime, train_fn, params, opt_states, moments, data, (T, B) = build(fused, precision)
+    # Place ALL carried state on the mesh up front: feeding unsharded arrays
+    # into the first call and mesh-sharded outputs into the next changes the
+    # input avals and forces a full Python retrace per call — which once
+    # masqueraded as a "4.9s f32 train step" (real steady state: ~0.12s).
+    params = runtime.replicate(params)
+    opt_states = runtime.replicate(opt_states)
+    moments = runtime.replicate(moments)
+    # compile + warmup (2 calls: the second proves the cache is stable)
+    for _ in range(2):
+        params, opt_states, moments, metrics = train_fn(
+            params, opt_states, moments, data, runtime.next_key()
+        )
+        float(jax.tree_util.tree_leaves(metrics)[0])
+    tic = time.perf_counter()
+    for _ in range(steps):
+        params, opt_states, moments, metrics = train_fn(
+            params, opt_states, moments, data, runtime.next_key()
+        )
+        # host-fetch a scalar: block_until_ready alone under-syncs on some
+        # remote-device platforms
+        float(jax.tree_util.tree_leaves(metrics)[0])
+    dt = (time.perf_counter() - tic) / steps
+    frames = T * B / dt
+    print(
+        f"fused={fused} precision={precision}: {dt * 1e3:.1f} ms/step, "
+        f"{frames:,.0f} replayed frames/s (T={T}, B={B})",
+        file=sys.stderr,
+    )
+    return dt, T, B
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--precision", default="bf16-mixed")
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--fused", default="both", choices=["both", "true", "false"])
+    args = ap.parse_args()
+    if args.fused in ("false", "both"):
+        base, _, _ = time_variant(False, args.precision, args.steps)
+    if args.fused in ("true", "both"):
+        fused, _, _ = time_variant(True, args.precision, args.steps)
+    if args.fused == "both":
+        print(f"speedup fused/unfused: {base / fused:.3f}x")

@@ -68,8 +68,8 @@ def make_update_fn(runtime, module, tx, cfg: Dict[str, Any], obs_keys: Sequence[
         grad_fn = jax.grad(loss_fn, has_aux=True)
 
         perm = jax.random.permutation(key, n_total)
-        if n_used > n_total:
-            perm = jnp.concatenate([perm, perm[: n_used - n_total]])
+        if n_used > n_total:  # pad by wrapping as many times as needed
+            perm = jnp.tile(perm, -(-n_used // n_total))[:n_used]
         shuffled = jax.tree_util.tree_map(
             lambda x: x[perm].reshape(num_minibatches, mb_size, *x.shape[1:]), flat
         )

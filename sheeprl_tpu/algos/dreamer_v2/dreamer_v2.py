@@ -57,11 +57,9 @@ sg = jax.lax.stop_gradient
 
 
 def _make_optimizer(optim_cfg, clip_gradients):
-    kwargs = {k: v for k, v in dict(optim_cfg).items() if k != "_target_"}
-    tx = _locate(optim_cfg["_target_"])(**kwargs)
-    if clip_gradients is not None and clip_gradients > 0:
-        tx = optax.chain(optax.clip_by_global_norm(float(clip_gradients)), tx)
-    return tx
+    from sheeprl_tpu.optim import build_optimizer
+
+    return build_optimizer(optim_cfg, clip_gradients)
 
 
 def make_train_fn(runtime, world_model, actor, critic, txs, cfg, is_continuous, actions_dim):

@@ -244,12 +244,16 @@ class RecurrentModel(nn.Module):
     dense_units: int
     layer_norm: bool = True
     eps: float = 1e-3
+    fused: bool = False
 
     @nn.compact
     def __call__(self, inp: jax.Array, recurrent_state: jax.Array) -> jax.Array:
         feat = LinearLnAct(self.dense_units, self.layer_norm, self.eps, "silu")(inp)
         new_h, _ = LayerNormGRUCell(
-            hidden_size=self.recurrent_state_size, use_bias=False, layer_norm=True
+            hidden_size=self.recurrent_state_size,
+            use_bias=False,
+            layer_norm=True,
+            fused=self.fused,
         )(recurrent_state, feat)
         return new_h
 
@@ -283,6 +287,7 @@ class RSSM(nn.Module):
     act: Any = "silu"
     learnable_initial_recurrent_state: bool = True
     decoupled: bool = False
+    fused_gru: bool = False
 
     def setup(self) -> None:
         stoch = self.stochastic_size * self.discrete_size
@@ -291,6 +296,7 @@ class RSSM(nn.Module):
             dense_units=self.dense_units,
             layer_norm=self.layer_norm,
             eps=self.eps,
+            fused=self.fused_gru,
         )
         self.representation_model = DreamerMLP(
             self.hidden_size, 1, stoch, self.layer_norm, self.eps, self.act, uniform_out_init(1.0)
@@ -688,6 +694,7 @@ def build_agent(
         eps=_ln_eps(world_model_cfg.recurrent_model.layer_norm),
         learnable_initial_recurrent_state=world_model_cfg.learnable_initial_recurrent_state,
         decoupled=bool(world_model_cfg.decoupled_rssm),
+        fused_gru=bool(world_model_cfg.recurrent_model.get("fused", False)),
     )
 
     cnn_decoder = (

@@ -49,12 +49,40 @@ from sheeprl_tpu.utils.utils import gae, normalize_tensor, polynomial_decay, pri
 def build_ppo_optimizer(optim_cfg: Dict[str, Any], max_grad_norm: float) -> optax.GradientTransformation:
     """optax optimizer with injectable learning_rate (for annealing inside
     jit) and optional global-norm clipping."""
-    kwargs = {k: v for k, v in dict(optim_cfg).items() if k != "_target_"}
-    base_fn = _locate(optim_cfg["_target_"])
+    from sheeprl_tpu.optim import normalize_optim_kwargs, resolve_weight_decay
+
+    cfg = dict(optim_cfg)
+    base_fn = _locate(cfg.pop("_target_"))
+    kwargs = normalize_optim_kwargs(cfg)
+    wd = resolve_weight_decay(kwargs, base_fn)
     tx = optax.inject_hyperparams(base_fn)(**kwargs)
+    if wd:
+        tx = optax.chain(optax.add_decayed_weights(wd), tx)
     if max_grad_norm and max_grad_norm > 0:
         tx = optax.chain(optax.clip_by_global_norm(float(max_grad_norm)), tx)
     return tx
+
+
+def rank_local_perm(key, n_total, n_envs, world_size, mb_size, num_minibatches):
+    """Epoch permutation for ``buffer.share_data=False``: rank w owns envs
+    [w*B_local, (w+1)*B_local) of the (T, B) rollout; each rank's (t, b)
+    cells are permuted among themselves and the ranks striped across every
+    minibatch, so a minibatch row never leaves its rank — the SPMD
+    equivalent of DDP's per-rank DataLoader (reference ppo.py:383-390 with
+    share_data left False)."""
+    b_local = n_envs // world_size
+    n_local = n_total // world_size  # = T * b_local per rank
+    pr = mb_size // world_size
+    local = jax.vmap(lambda k: jax.random.permutation(k, n_local))(
+        jax.random.split(key, world_size)
+    )  # (W, n_local) of rank-linear indices l = t*b_local + b
+    n_used_local = num_minibatches * pr
+    if n_used_local > n_local:  # pad by wrapping as many times as needed
+        local = jnp.tile(local, (1, -(-n_used_local // n_local)))[:, :n_used_local]
+    t, b = local // b_local, local % b_local
+    flat_idx = t * n_envs + jnp.arange(world_size)[:, None] * b_local + b
+    striped = flat_idx.reshape(world_size, num_minibatches, pr)
+    return striped.transpose(1, 0, 2).reshape(-1)
 
 
 def make_update_fn(
@@ -64,9 +92,20 @@ def make_update_fn(
     cfg: Dict[str, Any],
     obs_keys: Sequence[str],
 ):
-    """Build the single jitted PPO update (GAE + epochs x minibatches)."""
+    """Build the single jitted PPO update (GAE + epochs x minibatches).
+
+    ``buffer.share_data`` (reference ppo.py:40-50, 383-390) controls the
+    epoch shuffle: True gathers the whole rollout and permutes GLOBALLY —
+    under SPMD that is simply a global permutation of the flattened batch,
+    XLA inserting the cross-device all-to-all the reference got from
+    fabric.all_gather + DistributedSampler. False (the reference default)
+    keeps minibatches rank-local: each device shard is permuted within
+    itself and minibatches are rank-striped, so no rollout data ever
+    crosses devices — exactly DDP semantics."""
     cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
     update_epochs = int(cfg.algo.update_epochs)
+    share_data = bool(cfg.buffer.get("share_data", False))
+    world_size = int(runtime.world_size)
     mb_size = int(cfg.algo.per_rank_batch_size) * runtime.world_size
     gamma = float(cfg.algo.gamma)
     gae_lambda = float(cfg.algo.gae_lambda)
@@ -117,11 +156,19 @@ def make_update_fn(
             params = optax.apply_updates(params, updates)
             return (params, opt_state), losses
 
+        n_envs = data["rewards"].shape[1]
+
+        def _epoch_perm(ekey):
+            if share_data or world_size == 1 or n_envs % world_size != 0:
+                perm = jax.random.permutation(ekey, n_total)
+                if n_used > n_total:  # pad by wrapping (fixed shapes for scan)
+                    perm = jnp.tile(perm, -(-n_used // n_total))[:n_used]
+                return perm
+            return rank_local_perm(ekey, n_total, n_envs, world_size, mb_size, num_minibatches)
+
         def epoch_step(carry, ekey):
             params, opt_state = carry
-            perm = jax.random.permutation(ekey, n_total)
-            if n_used > n_total:  # pad by wrapping (fixed shapes for scan)
-                perm = jnp.concatenate([perm, perm[: n_used - n_total]])
+            perm = _epoch_perm(ekey)
             shuffled = jax.tree_util.tree_map(
                 lambda x: x[perm].reshape(num_minibatches, mb_size, *x.shape[1:]), flat
             )

@@ -45,10 +45,9 @@ from sheeprl_tpu.utils.utils import Ratio, save_configs
 
 
 def _make_optimizer(optim_cfg: Dict[str, Any]) -> optax.GradientTransformation:
-    from sheeprl_tpu.config.compose import _locate
+    from sheeprl_tpu.optim import build_optimizer
 
-    kwargs = {k: v for k, v in dict(optim_cfg).items() if k != "_target_"}
-    return _locate(optim_cfg["_target_"])(**kwargs)
+    return build_optimizer(optim_cfg)
 
 
 def make_train_fn(runtime, actor, critic, txs, cfg: Dict[str, Any], target_entropy: float):
@@ -304,8 +303,11 @@ def main(runtime, cfg: Dict[str, Any]):
         obs = next_obs
 
         if iter_num >= learning_starts:
-            per_rank_gradient_steps = ratio(
-                (policy_step - prefill_steps + policy_steps_per_iter) / world_size
+            # benchmark protocol pins 1 gradient step/iter (reference sac.py:299-304)
+            per_rank_gradient_steps = (
+                ratio((policy_step - prefill_steps + policy_steps_per_iter) / world_size)
+                if not cfg.get("run_benchmarks", False)
+                else 1
             )
             if per_rank_gradient_steps > 0:
                 g = per_rank_gradient_steps

@@ -68,18 +68,30 @@ def resume_from_checkpoint(cfg: dotdict) -> dotdict:
 def check_configs(cfg: dotdict) -> None:
     """Config validation (reference cli.py:271-345): strategy whitelist and
     per-algo constraints."""
+    from sheeprl_tpu.parallel.mesh import _STRATEGIES
+
     strategy = str(cfg.fabric.get("strategy", "auto"))
-    if strategy not in ("auto", "dp", "ddp", "fsdp"):
+    if strategy not in _STRATEGIES:
         raise ValueError(
-            f"Unknown fabric strategy '{strategy}'. The TPU runtime supports: auto, dp/ddp, fsdp"
+            f"Unknown fabric strategy '{strategy}'. The TPU runtime supports: "
+            + ", ".join(_STRATEGIES)
         )
     decoupled = False
     try:
         _, _, decoupled = find_algorithm(cfg.algo.name)
     except RuntimeError:
         pass
-    if decoupled and cfg.fabric.get("accelerator") == "cpu" and int(cfg.env.num_envs) < 1:
-        raise ValueError("Decoupled algorithms need at least one environment")
+    if decoupled:
+        # reference cli.py:289-332: decoupled algos only run under DDP; here
+        # the learner runs on the mesh, so only dp-style layouts qualify
+        if strategy == "fsdp":
+            raise ValueError(
+                f"The '{strategy}' strategy is currently not supported for decoupled "
+                "algorithms. Please launch the script with a data-parallel strategy "
+                "('python sheeprl.py fabric.strategy=ddp')"
+            )
+        if cfg.fabric.get("accelerator") == "cpu" and int(cfg.env.num_envs) < 1:
+            raise ValueError("Decoupled algorithms need at least one environment")
 
 
 def _build_runtime(cfg: dotdict):
@@ -125,7 +137,22 @@ def run_algorithm(cfg: dotdict) -> None:
 
     runtime = _build_runtime(cfg)
     entry_fn = getattr(algo_module, entrypoint)
-    entry_fn(runtime, cfg)
+
+    if cfg.metric.get("profile", False) and runtime.is_global_zero:
+        # jax.profiler trace of the whole run (rank 0): the TPU analogue of
+        # the reference's missing torch-profiler hook (SURVEY §5.1). Meant
+        # for short profiling runs — traces grow with wall-clock. View with
+        # tensorboard --logdir <root_dir>/profile.
+        import jax
+
+        trace_dir = os.path.join(
+            str(cfg.get("root_dir", ".")), str(cfg.get("run_name", "run")), "profile"
+        )
+        os.makedirs(trace_dir, exist_ok=True)
+        with jax.profiler.trace(trace_dir):
+            entry_fn(runtime, cfg)
+    else:
+        entry_fn(runtime, cfg)
 
 
 def run(args: Optional[Sequence[str]] = None) -> None:
@@ -188,10 +215,7 @@ def evaluation(args: Optional[Sequence[str]] = None) -> None:
     )
     run_cfg["seed"] = seed
     run_cfg["checkpoint_path"] = os.path.abspath(ckpt_path)
-    run_cfg["run_name"] = os.path.join(
-        os.path.basename(os.path.dirname(os.path.dirname(ckpt_dir))) if False else str(run_cfg.get("run_name", "run")),
-        "evaluation",
-    )
+    run_cfg["run_name"] = os.path.join(str(run_cfg.get("run_name", "run")), "evaluation")
     cfg = dotdict(run_cfg)
     eval_algorithm(cfg)
 

@@ -200,25 +200,69 @@ class NatureCNN(nn.Module):
 class LayerNormGRUCell(nn.Module):
     """Hafner-style GRU cell: one dense over [x, h] -> LayerNorm -> split into
     reset/candidate/update, with the update-gate ``-1`` bias trick
-    (reference LayerNormGRUCell:331, from danijar/dreamerv2)."""
+    (reference LayerNormGRUCell:331, from danijar/dreamerv2).
+
+    ``fused=True`` routes the step through the Pallas fused kernel
+    (``sheeprl_tpu.ops.pallas_gru.gru_cell``: one HBM round trip per step,
+    custom-VJP backward) whenever it is eligible (LayerNorm on, no dense
+    bias). The parameter tree is identical either way, so checkpoints are
+    interchangeable between fused on/off. Off-TPU backends run the kernel
+    in interpreter mode, keeping tests and CPU dry runs working."""
 
     hidden_size: int
     use_bias: bool = False
     layer_norm: bool = True
+    fused: bool = False
     dtype: Dtype = jnp.float32
     param_dtype: Dtype = jnp.float32
 
     @nn.compact
     def __call__(self, h: jax.Array, x: jax.Array) -> Tuple[jax.Array, jax.Array]:
-        inp = jnp.concatenate([h, x], axis=-1)
-        parts = nn.Dense(
+        dense = nn.Dense(
             3 * self.hidden_size,
             use_bias=self.use_bias,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
-        )(inp)
-        if self.layer_norm:
-            parts = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype)(parts)
+        )
+        ln = (
+            nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype)
+            if self.layer_norm
+            else None
+        )
+        if (
+            self.fused
+            and self.layer_norm
+            and not self.use_bias
+            and not self.is_initializing()
+        ):
+            from sheeprl_tpu.ops.pallas_gru import gru_cell
+
+            p = self.variables["params"]
+            lead = h.shape[:-1]  # kernel wants (B, H); callers pass e.g. (1, B, H)
+
+            def _step(interpret: bool):
+                def f(h2, x2, w, scale, bias):
+                    return gru_cell(h2, x2, w, scale, bias, 1e-6, True, 8, 512, interpret)
+
+                return f
+
+            # interpret-mode choice must be per lowering platform, not
+            # process-global: with a TPU default backend the env-interaction
+            # player still runs this cell on the host CPU backend
+            new_h = jax.lax.platform_dependent(
+                h.reshape(-1, h.shape[-1]),
+                x.reshape(-1, x.shape[-1]),
+                p["Dense_0"]["kernel"],
+                p["LayerNorm_0"]["scale"],
+                p["LayerNorm_0"]["bias"],
+                tpu=_step(False),
+                default=_step(True),
+            ).reshape(*lead, -1)
+            return new_h, new_h
+        inp = jnp.concatenate([h, x], axis=-1)
+        parts = dense(inp)
+        if ln is not None:
+            parts = ln(parts)
         reset, cand, update = jnp.split(parts, 3, axis=-1)
         reset = jax.nn.sigmoid(reset)
         cand = jnp.tanh(reset * cand)

@@ -19,12 +19,13 @@ Semantics match ``sheeprl_tpu.models.models.LayerNormGRUCell`` exactly:
     update = sigmoid(update - 1)
     h' = update * cand + (1 - update) * h
 
-Status: forward kernel, validated against the flax cell bit-for-bit-ish
-(interpret mode everywhere, compiled on a real chip: max err ~2e-6).
-Training integration awaits the custom-VJP backward kernel; the inference
-player path can use it as-is. Shapes should be lane-aligned
-(hidden/feature dims % 128 == 0) on real TPUs; ``interpret=True`` runs
-anywhere for testing.
+Status: integrated. ``models.LayerNormGRUCell(fused=True)`` routes through
+``gru_cell`` (Pallas forward + analytic custom-VJP backward), enabled from
+configs via ``algo.world_model.recurrent_model.fused``. Validated against
+the flax cell bit-for-bit-ish (interpret mode everywhere, compiled on a
+real chip: max err ~2e-6). Shapes should be lane-aligned (hidden/feature
+dims % 128 == 0) on real TPUs; ``interpret=True`` runs anywhere for
+testing.
 """
 
 from __future__ import annotations
@@ -140,27 +141,34 @@ def reference_gru_cell(h, x, w, gamma=None, beta=None, *, eps: float = 1e-6, use
     return update * cand + (1.0 - update) * h
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
-def gru_cell(h, x, w, gamma, beta, eps: float = 1e-6, use_ln: bool = True, block_b: int = 8, block_k: int = 512):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def gru_cell(
+    h, x, w, gamma, beta,
+    eps: float = 1e-6, use_ln: bool = True, block_b: int = 8, block_k: int = 512,
+    interpret: bool = False,
+):
     """Training-safe fused GRU step: Pallas forward, analytic XLA backward.
 
     The backward recomputes the (cheap) gate activations from the saved
     residuals and differentiates the reference formulas — the memory win of
     the fused forward is kept, and the op is usable inside the RSSM train
-    scan."""
+    scan. ``interpret=True`` runs the kernel in interpreter mode so the op
+    works on non-TPU backends (tests, CPU dry runs)."""
     return fused_gru_cell(
-        h, x, w, gamma, beta, eps=eps, use_ln=use_ln, block_b=block_b, block_k=block_k
+        h, x, w, gamma, beta,
+        eps=eps, use_ln=use_ln, block_b=block_b, block_k=block_k, interpret=interpret,
     )
 
 
-def _gru_fwd(h, x, w, gamma, beta, eps, use_ln, block_b, block_k):
+def _gru_fwd(h, x, w, gamma, beta, eps, use_ln, block_b, block_k, interpret):
     out = fused_gru_cell(
-        h, x, w, gamma, beta, eps=eps, use_ln=use_ln, block_b=block_b, block_k=block_k
+        h, x, w, gamma, beta,
+        eps=eps, use_ln=use_ln, block_b=block_b, block_k=block_k, interpret=interpret,
     )
     return out, (h, x, w, gamma, beta)
 
 
-def _gru_bwd(eps, use_ln, block_b, block_k, res, g):
+def _gru_bwd(eps, use_ln, block_b, block_k, interpret, res, g):
     h, x, w, gamma, beta = res
     # rematerialize through the reference formulas and use XLA's VJP; the
     # activations are tiny next to the weight gradient matmuls

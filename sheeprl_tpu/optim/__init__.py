@@ -55,13 +55,61 @@ def rmsprop_tf(
     return optax.GradientTransformation(init_fn, update_fn)
 
 
-def build_optimizer(optim_cfg: dict, max_grad_norm: Optional[float] = None) -> optax.GradientTransformation:
-    """Instantiate an optax optimizer from a `_target_` config node, with
-    optional global-norm clipping chained in front (fabric.clip_gradients
-    equivalent)."""
-    from sheeprl_tpu.config import instantiate
+# the reference's torch optimizer argument names, mapped to optax's
+# (reference configs/optim/*.yaml: lr / betas / alpha / weight_decay)
+_TORCH_KEY_RENAMES = {"lr": "learning_rate", "alpha": "decay"}
 
-    tx = instantiate(dict(optim_cfg))
+
+def normalize_optim_kwargs(kwargs: dict) -> dict:
+    """Accept torch-style optimizer kwargs alongside optax-native ones so
+    reference command lines (``algo.optimizer.lr=3e-4``) run unmodified.
+    Also coerces yaml-1.1 scientific-notation strings ("3e-4") to floats."""
+    out = {}
+    betas = kwargs.pop("betas", None)
+    if betas is not None:
+        out["b1"], out["b2"] = betas
+    for k, v in kwargs.items():
+        if isinstance(v, str):
+            try:
+                v = float(v)
+            except ValueError:
+                pass
+        out[_TORCH_KEY_RENAMES.get(k, k)] = v
+    return out
+
+
+def resolve_weight_decay(kwargs: dict, fn) -> float:
+    """torch-L2 weight-decay resolution shared by every optimizer factory:
+    when ``fn`` does not take ``weight_decay`` natively (optax.adam/sgd/
+    rmsprop), pop it from ``kwargs`` and return the rate to chain as
+    ``optax.add_decayed_weights`` BEFORE the transform — wd·param then
+    enters the gradient moments exactly as torch.optim.Adam(weight_decay=)
+    does. Targets with native handling (optax.adamw, rmsprop_tf) keep the
+    kwarg and 0.0 is returned."""
+    import inspect
+
+    wd = float(kwargs.get("weight_decay", 0.0) or 0.0)
+    if "weight_decay" in kwargs and "weight_decay" not in inspect.signature(fn).parameters:
+        kwargs.pop("weight_decay")
+        return wd
+    return 0.0
+
+
+def build_optimizer(optim_cfg: dict, max_grad_norm: Optional[float] = None) -> optax.GradientTransformation:
+    """Instantiate an optax optimizer from a ``_target_`` config node, with
+    optional global-norm clipping chained in front (fabric.clip_gradients
+    equivalent) and torch-style kwargs accepted (see
+    ``normalize_optim_kwargs`` / ``resolve_weight_decay``)."""
+    from sheeprl_tpu.config.compose import _locate
+
+    cfg = dict(optim_cfg)
+    target = cfg.pop("_target_")
+    kwargs = normalize_optim_kwargs(cfg)
+    fn = _locate(target)
+    wd = resolve_weight_decay(kwargs, fn)
+    tx = fn(**kwargs)
+    if wd:
+        tx = optax.chain(optax.add_decayed_weights(wd), tx)
     if max_grad_norm is not None and max_grad_norm > 0:
         tx = optax.chain(optax.clip_by_global_norm(float(max_grad_norm)), tx)
     return tx

@@ -30,6 +30,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
 _PRECISIONS = ("32-true", "bf16-mixed", "bf16-true")
+_STRATEGIES = ("auto", "dp", "ddp", "fsdp")
 
 
 class MeshRuntime:
@@ -42,17 +43,17 @@ class MeshRuntime:
         strategy: str = "auto",
         accelerator: str = "auto",
         precision: str = "32-true",
-        model_parallel_size: int = 1,
         **kwargs: Any,
     ):
         if precision not in _PRECISIONS:
             raise ValueError(f"precision must be one of {_PRECISIONS}, got '{precision}'")
+        if strategy not in _STRATEGIES:
+            raise ValueError(f"strategy must be one of {_STRATEGIES}, got '{strategy}'")
         self._requested_devices = devices
         self._num_nodes = num_nodes
         self._strategy = strategy
         self._accelerator = accelerator
         self._precision = precision
-        self._model_parallel_size = model_parallel_size
         self._launched = False
         self._mesh: Optional[Mesh] = None
         self._key: Optional[jax.Array] = None
@@ -103,14 +104,12 @@ class MeshRuntime:
             raise RuntimeError(f"Requested {n} devices but only {len(devices)} are available")
         devices = devices[:n]
 
-        mp = max(1, int(self._model_parallel_size))
-        if self._strategy in ("auto", "dp", "ddp"):
-            mp = 1
-        if n % mp != 0:
-            raise ValueError(f"devices ({n}) must be divisible by model_parallel_size ({mp})")
-        dp = n // mp
-        dev_array = np.asarray(devices).reshape(dp, mp)
-        self._mesh = Mesh(dev_array, axis_names=("data", "model"))
+        # One mesh axis: DP and FSDP both lay the batch over "data"; FSDP
+        # additionally shards the parameters over the same axis (ZeRO-style)
+        # in ``replicate`` — XLA's sharding propagation turns that into
+        # all-gather-on-use + reduce-scatter-of-grads without any change to
+        # the jitted train steps.
+        self._mesh = Mesh(np.asarray(devices), axis_names=("data",))
         self._launched = True
         return self
 
@@ -220,8 +219,27 @@ class MeshRuntime:
         return jax.device_put(batch, sharding)
 
     def replicate(self, tree: Any) -> Any:
-        """Replicate params/opt-state across the mesh."""
-        return jax.device_put(tree, self.replicated)
+        """Place params/opt-state on the mesh.
+
+        Default strategies replicate every leaf. Under ``strategy="fsdp"``
+        each leaf is sharded over the data axis on its first dimension
+        divisible by the mesh size (scalars and indivisible leaves stay
+        replicated): the ZeRO-3 layout, with XLA inserting the weight
+        all-gathers and gradient reduce-scatters during jit."""
+        if self._strategy != "fsdp" or self.world_size == 1:
+            return jax.device_put(tree, self.replicated)
+        ws = self.world_size
+
+        def place(leaf: Any) -> Any:
+            shape = getattr(leaf, "shape", ())
+            for d, s in enumerate(shape):
+                if s >= ws and s % ws == 0:
+                    spec = [None] * len(shape)
+                    spec[d] = "data"
+                    return jax.device_put(leaf, NamedSharding(self.mesh, P(*spec)))
+            return jax.device_put(leaf, self.replicated)
+
+        return jax.tree_util.tree_map(place, tree)
 
     def setup_step(
         self,
@@ -285,9 +303,7 @@ class MeshRuntime:
             return [obj]
         from jax.experimental import multihost_utils
 
-        return multihost_utils.broadcast_one_to_all(obj) if False else list(
-            multihost_utils.process_allgather(obj)
-        )
+        return list(multihost_utils.process_allgather(obj))
 
     def barrier(self) -> None:
         if jax.process_count() > 1:

@@ -51,13 +51,11 @@ def test_ppo(standard_args, devices, tmp_path):
         f"root_dir={tmp_path}/ppo",
     ]
     _run(args)
-    # a checkpoint must exist
+    # checkpoint.save_last=True must have produced a checkpoint under root_dir
     import glob
 
-    ckpts = glob.glob(f"{tmp_path}/logs/**/ckpt_*.ckpt", recursive=True) + glob.glob(
-        "logs/**/ckpt_*.ckpt", recursive=True
-    )
-    assert len(ckpts) >= 0  # log_dir layout asserted in test_cli
+    ckpts = glob.glob(f"{tmp_path}/ppo/**/ckpt_*.ckpt", recursive=True)
+    assert len(ckpts) > 0
 
 
 def test_ppo_decoupled(standard_args, devices, tmp_path):
@@ -285,6 +283,20 @@ def test_dreamer_v3(standard_args, devices, tmp_path):
     _run(args)
 
 
+def test_dreamer_v3_fused_gru(standard_args, tmp_path):
+    """End-to-end with the Pallas fused GRU routed in (interpret mode on CPU)."""
+    args = standard_args + _dv3_tiny_args() + [
+        "exp=dreamer_v3",
+        "env=dummy",
+        "algo.mlp_keys.encoder=[state]",
+        "algo.cnn_keys.encoder=[rgb]",
+        "algo.world_model.recurrent_model.fused=True",
+        "fabric.devices=1",
+        f"root_dir={tmp_path}/dv3f",
+    ]
+    _run(args)
+
+
 def test_dreamer_v3_continuous(standard_args, tmp_path):
     args = standard_args + _dv3_tiny_args() + [
         "exp=dreamer_v3",
@@ -292,6 +304,7 @@ def test_dreamer_v3_continuous(standard_args, tmp_path):
         "env.id=dummy_continuous",
         "algo.mlp_keys.encoder=[state]",
         "algo.cnn_keys.encoder=[]",
+        "algo.cnn_keys.decoder=[]",
         "fabric.devices=1",
         f"root_dir={tmp_path}/dv3c",
     ]
@@ -304,6 +317,7 @@ def test_dreamer_v3_decoupled_rssm(standard_args, tmp_path):
         "env=dummy",
         "algo.mlp_keys.encoder=[state]",
         "algo.cnn_keys.encoder=[]",
+        "algo.cnn_keys.decoder=[]",
         "algo.world_model.decoupled_rssm=True",
         "fabric.devices=1",
         f"root_dir={tmp_path}/dv3d",
@@ -348,6 +362,7 @@ def test_dreamer_v2_continuous(standard_args, tmp_path):
         "env.id=dummy_continuous",
         "algo.mlp_keys.encoder=[state]",
         "algo.cnn_keys.encoder=[]",
+        "algo.cnn_keys.decoder=[]",
         "fabric.devices=1",
         f"root_dir={tmp_path}/dv2c",
     ]
@@ -360,6 +375,7 @@ def test_dreamer_v2_use_continues(standard_args, tmp_path):
         "env=dummy",
         "algo.mlp_keys.encoder=[state]",
         "algo.cnn_keys.encoder=[]",
+        "algo.cnn_keys.decoder=[]",
         "algo.world_model.use_continues=True",
         "fabric.devices=1",
         f"root_dir={tmp_path}/dv2u",
@@ -373,6 +389,7 @@ def test_dreamer_v2_episode_buffer(standard_args, tmp_path):
         "env=dummy",
         "algo.mlp_keys.encoder=[state]",
         "algo.cnn_keys.encoder=[]",
+        "algo.cnn_keys.decoder=[]",
         "buffer.type=episode",
         "buffer.prioritize_ends=True",
         "fabric.devices=1",
@@ -416,6 +433,7 @@ def test_dreamer_v1_continuous(standard_args, tmp_path):
         "env.id=dummy_continuous",
         "algo.mlp_keys.encoder=[state]",
         "algo.cnn_keys.encoder=[]",
+        "algo.cnn_keys.decoder=[]",
         "algo.world_model.use_continues=True",
         "fabric.devices=1",
         f"root_dir={tmp_path}/dv1c",
@@ -450,6 +468,7 @@ def test_sac_ae_mlp_only(standard_args, tmp_path):
         "env.id=dummy_continuous",
         "algo.mlp_keys.encoder=[state]",
         "algo.cnn_keys.encoder=[]",
+        "algo.cnn_keys.decoder=[]",
         "algo.per_rank_batch_size=2",
         "algo.hidden_size=8",
         "algo.dense_units=8",
@@ -471,6 +490,7 @@ def test_p2e_dv1(standard_args, tmp_path):
         "env=dummy",
         "algo.mlp_keys.encoder=[state]",
         "algo.cnn_keys.encoder=[]",
+        "algo.cnn_keys.decoder=[]",
         "algo.ensembles.n=2",
         "algo.ensembles.dense_units=8",
         "algo.ensembles.mlp_layers=1",
@@ -486,6 +506,7 @@ def test_p2e_dv1(standard_args, tmp_path):
         "env=dummy",
         "algo.mlp_keys.encoder=[state]",
         "algo.cnn_keys.encoder=[]",
+        "algo.cnn_keys.decoder=[]",
         "algo.ensembles.n=2",
         "algo.ensembles.dense_units=8",
         "algo.ensembles.mlp_layers=1",
@@ -507,6 +528,7 @@ def test_p2e_dv2(standard_args, tmp_path):
         "env=dummy",
         "algo.mlp_keys.encoder=[state]",
         "algo.cnn_keys.encoder=[]",
+        "algo.cnn_keys.decoder=[]",
         "algo.ensembles.n=2",
         "algo.ensembles.dense_units=8",
         "algo.ensembles.mlp_layers=1",
@@ -522,6 +544,7 @@ def test_p2e_dv2(standard_args, tmp_path):
         "env=dummy",
         "algo.mlp_keys.encoder=[state]",
         "algo.cnn_keys.encoder=[]",
+        "algo.cnn_keys.decoder=[]",
         "algo.ensembles.n=2",
         "algo.ensembles.dense_units=8",
         "algo.ensembles.mlp_layers=1",
@@ -542,6 +565,7 @@ def test_p2e_dv3(standard_args, tmp_path):
         "env=dummy",
         "algo.mlp_keys.encoder=[state]",
         "algo.cnn_keys.encoder=[]",
+        "algo.cnn_keys.decoder=[]",
         "algo.ensembles.n=2",
         "algo.ensembles.dense_units=8",
         "algo.ensembles.mlp_layers=1",
@@ -557,6 +581,7 @@ def test_p2e_dv3(standard_args, tmp_path):
         "env=dummy",
         "algo.mlp_keys.encoder=[state]",
         "algo.cnn_keys.encoder=[]",
+        "algo.cnn_keys.decoder=[]",
         "algo.ensembles.n=2",
         "algo.ensembles.dense_units=8",
         "algo.ensembles.mlp_layers=1",

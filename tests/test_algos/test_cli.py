@@ -1,11 +1,98 @@
-"""CLI behavior tests: resume-from-checkpoint happy path, env/algo mismatch
+"""CLI behavior tests: strategy validation, module lookup, real-CLI
+subprocess smoke, resume-from-checkpoint happy path, env/algo mismatch
 errors, evaluation round-trip (reference tests/test_algos/test_cli.py)."""
 
 import glob
+import os
+import subprocess
+import sys
 
 import pytest
 
 from sheeprl_tpu.cli import evaluation, run
+
+_REPO_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def test_unknown_strategy_fail(tmp_path):
+    """reference test_cli.py strategy whitelist: unknown strategies abort."""
+    with pytest.raises(ValueError, match="Unknown fabric strategy 'pipeline'"):
+        run(_ppo_args(tmp_path) + ["fabric.strategy=pipeline"])
+
+
+def test_module_not_found(tmp_path):
+    """reference test_cli.py:36: unknown algo names give an actionable error."""
+    with pytest.raises(RuntimeError, match="not_found"):
+        run(_ppo_args(tmp_path) + ["algo.name=not_found"])
+
+
+def test_decoupled_strategy_fail(tmp_path):
+    """reference test_cli.py:66: decoupled algos reject non-data-parallel
+    strategies."""
+    with pytest.raises(ValueError, match="not supported for decoupled"):
+        run(_ppo_args(tmp_path) + ["exp=ppo_decoupled", "fabric.strategy=fsdp"])
+
+
+def _subprocess_env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def test_run_algo_subprocess(tmp_path):
+    """reference test_cli.py:110 — drive the real CLI end-to-end."""
+    subprocess.run(
+        [
+            sys.executable,
+            "sheeprl.py",
+            "exp=ppo",
+            "env=dummy",
+            "dry_run=True",
+            "algo.rollout_steps=2",
+            "algo.per_rank_batch_size=2",
+            "algo.dense_units=8",
+            "algo.mlp_layers=1",
+            "algo.mlp_keys.encoder=[state]",
+            "env.capture_video=False",
+            "checkpoint.save_last=False",
+            "metric.log_level=0",
+            "metric.disable_timer=True",
+            "buffer.memmap=False",
+            f"root_dir={tmp_path}/sub",
+        ],
+        check=True,
+        cwd=_REPO_ROOT,
+        env=_subprocess_env(),
+        timeout=300,
+    )
+
+
+def test_run_decoupled_algo_subprocess(tmp_path):
+    """reference test_cli.py:99 — decoupled PPO through the real CLI."""
+    subprocess.run(
+        [
+            sys.executable,
+            "sheeprl.py",
+            "exp=ppo_decoupled",
+            "env=dummy",
+            "dry_run=True",
+            "algo.rollout_steps=2",
+            "algo.per_rank_batch_size=2",
+            "algo.dense_units=8",
+            "algo.mlp_layers=1",
+            "algo.mlp_keys.encoder=[state]",
+            "env.capture_video=False",
+            "checkpoint.save_last=False",
+            "metric.log_level=0",
+            "metric.disable_timer=True",
+            "buffer.memmap=False",
+            f"root_dir={tmp_path}/subdec",
+        ],
+        check=True,
+        cwd=_REPO_ROOT,
+        env=_subprocess_env(),
+        timeout=300,
+    )
 
 
 def _ppo_args(tmp_path, root="cli_ppo"):

@@ -1,0 +1,79 @@
+"""buffer.share_data semantics (reference ppo.py:40-50, 383-390).
+
+share_data=True -> global shuffle across ranks (the SPMD jit's plain
+permutation). share_data=False -> minibatches stay rank-local; the
+rank_local_perm index math must keep every minibatch row on its own rank's
+env columns while still covering the whole rollout each epoch.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from sheeprl_tpu.algos.ppo.ppo import rank_local_perm
+from sheeprl_tpu.cli import run
+
+
+@pytest.mark.parametrize("T,n_envs,world,pr", [(8, 4, 2, 4), (4, 8, 4, 2), (6, 4, 2, 3)])
+def test_rank_local_perm_properties(T, n_envs, world, pr):
+    n_total = T * n_envs
+    mb_size = pr * world
+    num_mb = n_total // mb_size
+    perm = np.asarray(
+        rank_local_perm(jax.random.PRNGKey(0), n_total, n_envs, world, mb_size, num_mb)
+    )
+    # full coverage, no duplicates (divisible case)
+    assert sorted(perm.tolist()) == list(range(n_total))
+    # every minibatch row block [w] indexes only rank w's env columns
+    b_local = n_envs // world
+    mbs = perm.reshape(num_mb, world, pr)
+    for w in range(world):
+        envs = mbs[:, w, :] % n_envs
+        assert ((envs >= w * b_local) & (envs < (w + 1) * b_local)).all()
+
+
+def test_rank_local_perm_wraps_indivisible():
+    # num_minibatches * pr > n_local: wrap within the rank, never across
+    T, n_envs, world, pr = 5, 4, 2, 4
+    n_total = T * n_envs
+    mb_size = pr * world
+    num_mb = -(-n_total // mb_size)
+    perm = np.asarray(
+        rank_local_perm(jax.random.PRNGKey(1), n_total, n_envs, world, mb_size, num_mb)
+    )
+    assert perm.size == num_mb * mb_size
+    b_local = n_envs // world
+    mbs = perm.reshape(num_mb, world, pr)
+    for w in range(world):
+        envs = mbs[:, w, :] % n_envs
+        assert ((envs >= w * b_local) & (envs < (w + 1) * b_local)).all()
+    # the whole rollout is still covered
+    assert set(perm.tolist()) == set(range(n_total))
+
+
+@pytest.mark.parametrize("share", ["True", "False"])
+def test_ppo_share_data_two_devices(tmp_path, share):
+    run(
+        [
+            "exp=ppo",
+            "dry_run=True",
+            "env=dummy",
+            "env.num_envs=4",
+            "env.sync_env=True",
+            "env.capture_video=False",
+            "fabric.accelerator=cpu",
+            "fabric.devices=2",
+            "metric.log_level=0",
+            "buffer.memmap=False",
+            f"buffer.share_data={share}",
+            "seed=0",
+            "algo.rollout_steps=8",
+            "algo.per_rank_batch_size=4",
+            "algo.update_epochs=2",
+            "algo.dense_units=8",
+            "algo.mlp_layers=1",
+            "algo.mlp_keys.encoder=[state]",
+            "checkpoint.save_last=False",
+            f"root_dir={tmp_path}/sd{share}",
+        ]
+    )

@@ -1,0 +1,121 @@
+"""Mechanical exp-config parity against the reference yaml tree.
+
+For every experiment file that exists in both config trees, the values the
+reference sets in its exp yaml must be reproduced by OUR composed config at
+the same dotted path (reference sheeprl/configs/exp/*). Deliberate
+divergences are whitelisted explicitly below; everything else failing here
+is config drift (VERDICT r1 item 5).
+
+The reference tree is only read when present (CI machines without
+/root/reference skip the test).
+"""
+
+import os
+
+import pytest
+import yaml
+
+from sheeprl_tpu.config.compose import compose
+
+_REF_EXP_DIR = "/root/reference/sheeprl/configs/exp"
+_OUR_EXP_DIR = os.path.join(
+    os.path.dirname(__file__), "..", "..", "sheeprl_tpu", "configs", "exp"
+)
+
+# leaf-key renames (ours on the right): optax naming for torch's
+_KEY_RENAMES = {"lr": "learning_rate", "alpha": "decay"}
+
+# (path, reference value, our value) triples that deliberately diverge
+_VALUE_WHITELIST = {
+    # gymnasium in this environment ships LunarLander v3 only
+    ("env.id", "LunarLanderContinuous-v2", "LunarLanderContinuous-v3"),
+    # reference bug: its exp sets id=reward but its own CrafterWrapper
+    # asserts id in {crafter_reward, crafter_nonreward} (envs/crafter.py:19)
+    ("env.id", "reward", "crafter_reward"),
+}
+
+# dotted-path prefixes that deliberately diverge from the reference:
+#   *._target_          — ours point at sheeprl_tpu classes / string activations
+#   fabric.*            — MeshRuntime surface (no Lightning strategy/plugin args)
+#   env.wrapper.*       — adapter classes differ by construction
+#   metric.aggregator.* — torchmetrics targets replaced by jax-native metrics
+_SKIP_PREFIXES = (
+    "fabric",
+    "env.wrapper",
+    "metric.aggregator",
+    "algo.actor.moments.percentile",  # struct identical, nested target renames
+    "algo.optimier",  # reference typo in sac_benchmarks.yaml — dead key there
+)
+_SKIP_LEAVES = ("_target_", "cls")
+
+
+def _both() -> list:
+    if not os.path.isdir(_REF_EXP_DIR):
+        return []
+    ours = {f for f in os.listdir(_OUR_EXP_DIR) if f.endswith(".yaml")}
+    refs = {f for f in os.listdir(_REF_EXP_DIR) if f.endswith(".yaml")}
+    return sorted(f[:-5] for f in ours & refs if f != "default.yaml")
+
+
+def _leaves(node, prefix=""):
+    if isinstance(node, dict):
+        for k, v in node.items():
+            if k == "defaults":
+                continue
+            yield from _leaves(v, f"{prefix}.{k}" if prefix else str(k))
+    else:
+        yield prefix, node
+
+
+def _norm(value):
+    """Normalize representation differences: activation class paths, and
+    yaml-1.1 scientific notation without a dot ("3e-4") loading as str."""
+    if isinstance(value, str):
+        try:
+            value = float(value)
+        except ValueError:
+            return value.rsplit(".", 1)[-1].lower()
+    if isinstance(value, float) and value == int(value):
+        return int(value)
+    return value
+
+
+def _lookup(cfg, path):
+    node = cfg
+    for part in path.split("."):
+        part = _KEY_RENAMES.get(part, part)
+        if not isinstance(node, dict) or part not in node:
+            return None, False
+        node = node[part]
+    return node, True
+
+
+@pytest.mark.parametrize("exp", _both())
+def test_exp_matches_reference(exp):
+    with open(os.path.join(_REF_EXP_DIR, exp + ".yaml")) as f:
+        ref = yaml.safe_load(f) or {}
+    cfg = compose(overrides=[f"exp={exp}"])
+    mismatches = []
+    for path, ref_value in _leaves(ref):
+        if any(path == p or path.startswith(p + ".") for p in _SKIP_PREFIXES):
+            continue
+        if path.rsplit(".", 1)[-1] in _SKIP_LEAVES:
+            continue
+        if isinstance(ref_value, str) and "${" in ref_value:
+            continue  # interpolation: resolved values compared via other leaves
+        ours, found = _lookup(cfg, path)
+        if (
+            found
+            and isinstance(ref_value, (str, int, float, bool, type(None)))
+            and not isinstance(ours, (list, dict))
+            and (path, ref_value, ours) in _VALUE_WHITELIST
+        ):
+            continue
+        if not found:
+            mismatches.append(f"{path}: missing (reference={ref_value!r})")
+        elif isinstance(ref_value, list):
+            if [_norm(v) for v in ref_value] != [_norm(v) for v in ours]:
+                mismatches.append(f"{path}: ours={ours!r} reference={ref_value!r}")
+        elif _norm(ref_value) != _norm(ours):
+            mismatches.append(f"{path}: ours={ours!r} reference={ref_value!r}")
+    assert not mismatches, "config drift vs reference:\n  " + "\n  ".join(mismatches)

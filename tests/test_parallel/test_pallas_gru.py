@@ -66,3 +66,44 @@ def test_fused_gru_matches_flax_cell():
         h, x, w, ln["scale"], ln["bias"], eps=1e-6, block_b=4, block_k=128, interpret=True
     )
     np.testing.assert_allclose(np.asarray(out), np.asarray(new_h), rtol=2e-5, atol=2e-5)
+
+
+def test_flax_cell_fused_flag():
+    """LayerNormGRUCell(fused=True) shares the unfused param tree and
+    reproduces outputs AND parameter gradients (off-TPU it runs the kernel
+    in interpreter mode)."""
+    b, hidden, xdim = 4, 128, 128
+    rng = np.random.default_rng(2)
+    h = jnp.asarray(rng.normal(size=(b, hidden)), jnp.float32)
+    x = jnp.asarray(rng.normal(size=(b, xdim)), jnp.float32)
+
+    plain = LayerNormGRUCell(hidden_size=hidden)
+    fused = LayerNormGRUCell(hidden_size=hidden, fused=True)
+    params = plain.init(jax.random.PRNGKey(0), h, x)
+    assert jax.tree_util.tree_structure(params) == jax.tree_util.tree_structure(
+        fused.init(jax.random.PRNGKey(0), h, x)
+    )
+
+    out_plain, _ = plain.apply(params, h, x)
+    out_fused, _ = fused.apply(params, h, x)
+    np.testing.assert_allclose(np.asarray(out_fused), np.asarray(out_plain), rtol=2e-5, atol=2e-5)
+
+    g_plain = jax.grad(lambda p: plain.apply(p, h, x)[0].sum())(params)
+    g_fused = jax.grad(lambda p: fused.apply(p, h, x)[0].sum())(params)
+    for a, b_ in zip(jax.tree_util.tree_leaves(g_plain), jax.tree_util.tree_leaves(g_fused)):
+        np.testing.assert_allclose(np.asarray(b_), np.asarray(a), rtol=1e-4, atol=1e-5)
+
+
+def test_flax_cell_fused_ineligible_falls_back():
+    """use_bias=True (DreamerV2's cell) is ineligible for the kernel; the
+    fused flag must silently use the plain path with identical results."""
+    b, hidden, xdim = 3, 64, 96
+    rng = np.random.default_rng(3)
+    h = jnp.asarray(rng.normal(size=(b, hidden)), jnp.float32)
+    x = jnp.asarray(rng.normal(size=(b, xdim)), jnp.float32)
+    plain = LayerNormGRUCell(hidden_size=hidden, use_bias=True)
+    fused = LayerNormGRUCell(hidden_size=hidden, use_bias=True, fused=True)
+    params = plain.init(jax.random.PRNGKey(0), h, x)
+    np.testing.assert_array_equal(
+        np.asarray(fused.apply(params, h, x)[0]), np.asarray(plain.apply(params, h, x)[0])
+    )

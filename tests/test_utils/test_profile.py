@@ -1,0 +1,33 @@
+"""metric.profile=True dumps a jax.profiler trace (SURVEY §5.1)."""
+
+import glob
+
+
+def test_profile_flag_produces_trace(tmp_path):
+    from sheeprl_tpu.cli import run
+
+    run(
+        [
+            "exp=ppo",
+            "dry_run=True",
+            "env=dummy",
+            "env.num_envs=1",
+            "env.sync_env=True",
+            "env.capture_video=False",
+            "fabric.accelerator=cpu",
+            "fabric.devices=1",
+            "metric.log_level=0",
+            "metric.profile=True",
+            "buffer.memmap=False",
+            "algo.rollout_steps=2",
+            "algo.per_rank_batch_size=2",
+            "algo.dense_units=8",
+            "algo.mlp_layers=1",
+            "algo.mlp_keys.encoder=[state]",
+            "checkpoint.save_last=False",
+            f"root_dir={tmp_path}/prof",
+            "run_name=r0",
+        ]
+    )
+    traces = glob.glob(f"{tmp_path}/prof/r0/profile/**/*.xplane.pb", recursive=True)
+    assert traces, "no profiler trace produced"
