@@ -14,11 +14,18 @@ in 14 h on an RTX 3080 ~= 2032 replayed frames/s.
 
 ``vs_baseline`` is the speedup factor (>1 is faster than the reference).
 
+Line 3 — SAC wall-clock, the reference's benchmark protocol
+(configs/exp/sac_benchmarks.yaml: LunarLanderContinuous, 65536 steps,
+1 gradient step per env step). ``algo.dispatch_batch=64`` batches 64
+gradient steps into one jitted dispatch — same total work, amortized
+device-dispatch latency. Baseline: 320.21 s (reference README.md:133-149).
+
 Env overrides:
   BENCH_TOTAL_STEPS  — shrink the PPO workload (wall-clock is extrapolated
                        linearly to 65536 for the reported value).
   BENCH_DV3_STEPS    — timed DV3 train steps (default 20).
-  BENCH_SKIP_DV3 / BENCH_SKIP_PPO — skip a section.
+  BENCH_SAC_STEPS    — shrink the SAC workload (linear extrapolation).
+  BENCH_SKIP_DV3 / BENCH_SKIP_PPO / BENCH_SKIP_SAC — skip a section.
 """
 
 import json
@@ -27,6 +34,7 @@ import sys
 import time
 
 REFERENCE_PPO_SECONDS = 81.27
+REFERENCE_SAC_SECONDS = 320.21
 REFERENCE_DV3_FRAMES_PER_S = 2032.0
 FULL_STEPS = 65536
 
@@ -69,6 +77,31 @@ def main() -> None:
             "vs_baseline": round(REFERENCE_PPO_SECONDS / scaled, 3),
         }
         print(json.dumps(result))
+
+    if not os.environ.get("BENCH_SKIP_SAC"):
+        from sheeprl_tpu.cli import run
+
+        sac_steps = int(os.environ.get("BENCH_SAC_STEPS", FULL_STEPS))
+        tic = time.perf_counter()
+        run(
+            [
+                "exp=sac_benchmarks",
+                f"algo.total_steps={sac_steps}",
+                "algo.dispatch_batch=64",
+                "root_dir=/tmp/sheeprl_tpu_bench_sac",
+            ]
+        )
+        sac_scaled = (time.perf_counter() - tic) * (FULL_STEPS / sac_steps)
+        print(
+            json.dumps(
+                {
+                    "metric": "sac_lunarlander_benchmark_wallclock",
+                    "value": round(sac_scaled, 2),
+                    "unit": "s",
+                    "vs_baseline": round(REFERENCE_SAC_SECONDS / sac_scaled, 3),
+                }
+            )
+        )
 
     if not os.environ.get("BENCH_SKIP_DV3"):
         from benchmarks.bench_dv3_step import time_variant

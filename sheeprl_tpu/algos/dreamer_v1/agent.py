@@ -42,6 +42,7 @@ from sheeprl_tpu.algos.dreamer_v2.agent import (
 )
 from sheeprl_tpu.models.models import resolve_activation
 from sheeprl_tpu.utils.distribution import Normal
+from sheeprl_tpu.utils.utils import transfer_tree
 
 
 def compute_stochastic_state(
@@ -204,7 +205,7 @@ class PlayerDV1:
 
     @params.setter
     def params(self, value):
-        self._params = jax.device_put(value, self.device) if self.device is not None else value
+        self._params = transfer_tree(value, self.device)
 
     def get_expl_amount(self, step: int) -> float:
         amount = self.expl_amount

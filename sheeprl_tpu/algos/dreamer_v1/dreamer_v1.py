@@ -37,7 +37,7 @@ from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import Ratio, save_configs
+from sheeprl_tpu.utils.utils import device_get_metrics, Ratio, save_configs
 
 sg = jax.lax.stop_gradient
 
@@ -491,7 +491,7 @@ def main(runtime, cfg: Dict[str, Any]):
                     train_step += world_size
                 player.params = {"world_model": params["world_model"], "actor": params["actor"]}
                 if aggregator and not aggregator.disabled:
-                    for k, v in jax.device_get(train_metrics).items():
+                    for k, v in device_get_metrics(train_metrics).items():
                         aggregator.update(k, v)
                     aggregator.update(
                         "Params/exploration_amount", player.get_expl_amount(policy_step)

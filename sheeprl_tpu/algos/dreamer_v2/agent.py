@@ -32,6 +32,7 @@ from sheeprl_tpu.utils.distribution import (
     TanhNormal,
     TruncatedNormal,
 )
+from sheeprl_tpu.utils.utils import transfer_tree
 
 xavier_init = nn.initializers.xavier_normal()
 
@@ -489,7 +490,7 @@ class PlayerDV2:
 
     @params.setter
     def params(self, value):
-        self._params = jax.device_put(value, self.device) if self.device is not None else value
+        self._params = transfer_tree(value, self.device)
 
     def init_states(self, reset_envs: Optional[Sequence[int]] = None) -> None:
         if reset_envs is None or len(reset_envs) == 0:

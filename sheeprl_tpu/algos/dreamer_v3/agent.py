@@ -35,7 +35,7 @@ from sheeprl_tpu.utils.distribution import (
     OneHotCategoricalStraightThrough,
     TanhNormal,
 )
-from sheeprl_tpu.utils.utils import symlog
+from sheeprl_tpu.utils.utils import symlog, transfer_tree
 
 # Hafner inits (reference dreamer_v3/utils.py:143-187)
 trunc_init = nn.initializers.variance_scaling(1.0, "fan_avg", "truncated_normal")
@@ -585,7 +585,7 @@ class PlayerDV3:
 
     @params.setter
     def params(self, value):
-        self._params = jax.device_put(value, self.device) if self.device is not None else value
+        self._params = transfer_tree(value, self.device)
 
     def init_states(self, reset_envs: Optional[Sequence[int]] = None) -> None:
         if reset_envs is None or len(reset_envs) == 0:

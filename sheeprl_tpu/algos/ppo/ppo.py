@@ -43,7 +43,7 @@ from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import gae, normalize_tensor, polynomial_decay, print_config, save_configs
+from sheeprl_tpu.utils.utils import device_get_metrics, gae, normalize_tensor, polynomial_decay, print_config, save_configs
 
 
 def build_ppo_optimizer(optim_cfg: Dict[str, Any], max_grad_norm: float) -> optax.GradientTransformation:
@@ -414,7 +414,7 @@ def main(runtime, cfg: Dict[str, Any]):
         if aggregator and not aggregator.disabled:
             # materializing metrics blocks on the update; only pay that
             # sync when metrics are on
-            for k, v in jax.device_get(train_metrics).items():
+            for k, v in device_get_metrics(train_metrics).items():
                 aggregator.update(k, v)
 
         # ------------------------------------------------- logging

@@ -46,7 +46,7 @@ from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import polynomial_decay, save_configs
+from sheeprl_tpu.utils.utils import device_get_metrics, polynomial_decay, save_configs
 
 # generous IPC timeout: the first trainer reply waits on a fresh XLA
 # compile of the full update (~20-40s on TPU)
@@ -404,7 +404,7 @@ def main(runtime, cfg: Dict[str, Any]):
                     jnp.float32(current_ent),
                     jnp.float32(current_lr),
                 )
-                train_metrics = {k: float(v) for k, v in jax.device_get(train_metrics).items()}
+                train_metrics = device_get_metrics(train_metrics)
 
             info_scalars = {
                 "Info/learning_rate": current_lr,

@@ -24,6 +24,7 @@ import numpy as np
 from sheeprl_tpu.algos.ppo.agent import CNNEncoder, MLPEncoder
 from sheeprl_tpu.models.models import MLP, MultiEncoder
 from sheeprl_tpu.utils.distribution import Independent, Normal, OneHotCategorical
+from sheeprl_tpu.utils.utils import transfer_tree
 
 Dtype = Any
 
@@ -283,7 +284,7 @@ class RecurrentPPOPlayer:
 
     @params.setter
     def params(self, value: Any) -> None:
-        self._params = jax.device_put(value, self.device) if self.device is not None else value
+        self._params = transfer_tree(value, self.device)
 
     def init_states(self) -> None:
         h = self.module.rnn_hidden_size

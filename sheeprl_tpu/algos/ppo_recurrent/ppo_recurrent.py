@@ -43,7 +43,7 @@ from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import gae, normalize_tensor, polynomial_decay, print_config, save_configs
+from sheeprl_tpu.utils.utils import device_get_metrics, gae, normalize_tensor, polynomial_decay, print_config, save_configs
 
 
 def make_update_fn(runtime, module, tx, cfg: Dict[str, Any], obs_keys: Sequence[str]):
@@ -369,7 +369,7 @@ def main(runtime, cfg: Dict[str, Any]):
         train_step += world_size
 
         if aggregator and not aggregator.disabled:
-            for k, v in jax.device_get(train_metrics).items():
+            for k, v in device_get_metrics(train_metrics).items():
                 aggregator.update(k, v)
 
         # ------------------------------------------------- logging

@@ -22,6 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from sheeprl_tpu.models.models import MLP
+from sheeprl_tpu.utils.utils import transfer_tree
 
 LOG_STD_MIN = -5.0
 LOG_STD_MAX = 2.0
@@ -129,7 +130,7 @@ class SACPlayer:
 
     @params.setter
     def params(self, value: Any) -> None:
-        self._params = jax.device_put(value, self.device) if self.device is not None else value
+        self._params = transfer_tree(value, self.device)
 
     def get_actions(self, obs: Dict[str, Any], key: Optional[jax.Array] = None, greedy: bool = False):
         prepared = self._prepare_obs(obs)

@@ -41,7 +41,7 @@ from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import Ratio, save_configs
+from sheeprl_tpu.utils.utils import device_get_metrics, Ratio, save_configs
 
 
 def _make_optimizer(optim_cfg: Dict[str, Any]) -> optax.GradientTransformation:
@@ -58,11 +58,14 @@ def make_train_fn(runtime, actor, critic, txs, cfg: Dict[str, Any], target_entro
 
     def train(params, opt_states, data, key, do_ema):
         """params: {actor, critic, target_critic, log_alpha};
-        data: (G, B, ...) pytree; one scan step per gradient step."""
+        data: (G, B, ...) pytree; one scan step per gradient step;
+        do_ema: (G,) bool — per-step target soft-update flags (the reference
+        EMAs once per env iteration, so the flags carry each gradient
+        step's originating-iteration schedule through the scan)."""
 
         def one_step(carry, inp):
             params, opt_states = carry
-            batch, k = inp
+            batch, k, do_ema_step = inp
             k1, k2 = jax.random.split(k)
             alpha = jnp.exp(params["log_alpha"])
 
@@ -87,7 +90,7 @@ def make_train_fn(runtime, actor, critic, txs, cfg: Dict[str, Any], target_entro
 
             # ---------------- EMA target (reference qfs_target_ema)
             new_target = jax.lax.cond(
-                do_ema,
+                do_ema_step,
                 lambda: optax.incremental_update(new_critic, params["target_critic"], tau),
                 lambda: params["target_critic"],
             )
@@ -124,7 +127,9 @@ def make_train_fn(runtime, actor, critic, txs, cfg: Dict[str, Any], target_entro
 
         g = data["rewards"].shape[0]
         keys = jax.random.split(key, g)
-        (params, opt_states), losses = jax.lax.scan(one_step, (params, opt_states), (data, keys))
+        (params, opt_states), losses = jax.lax.scan(
+            one_step, (params, opt_states), (data, keys, do_ema)
+        )
         mean_losses = losses.mean(0)
         metrics = {
             "Loss/value_loss": mean_losses[0],
@@ -259,6 +264,15 @@ def main(runtime, cfg: Dict[str, Any]):
     step_data: Dict[str, np.ndarray] = {}
     obs = envs.reset(seed=cfg.seed)[0]
 
+    # dispatch batching: accumulate the ratio-granted gradient steps of
+    # several env iterations into ONE jitted scan dispatch. Default 1 keeps
+    # the reference's per-step cadence; >1 amortizes per-dispatch latency
+    # (the same trade the reference's decoupled SAC makes by training on a
+    # stale player) — essential when the accelerator sits behind a
+    # high-latency link.
+    dispatch_batch = max(1, int(cfg.algo.get("dispatch_batch", 1)))
+    pending_iters = list(state.get("pending_iters", [])) if state else []
+
     cumulative_per_rank_gradient_steps = 0
     for iter_num in range(start_iter, total_iters + 1):
         policy_step += policy_steps_per_iter
@@ -310,7 +324,19 @@ def main(runtime, cfg: Dict[str, Any]):
                 else 1
             )
             if per_rank_gradient_steps > 0:
-                g = per_rank_gradient_steps
+                # remember which iteration granted each pending step so the
+                # dispatch reproduces the reference's per-iteration EMA
+                # cadence and step accounting exactly
+                pending_iters.extend([iter_num] * per_rank_gradient_steps)
+            if pending_iters and (
+                len(pending_iters) >= dispatch_batch or iter_num == total_iters
+            ):
+                g = len(pending_iters)
+                ema_flags = np.asarray(
+                    [it % ema_every == 0 for it in pending_iters], dtype=bool
+                )
+                iters_in_window = len(set(pending_iters))
+                pending_iters = []
                 batch_total = g * cfg.algo.per_rank_batch_size * world_size
                 sample = rb.sample(
                     batch_size=batch_total,
@@ -330,15 +356,15 @@ def main(runtime, cfg: Dict[str, Any]):
                         opt_states,
                         data,
                         runtime.next_key(),
-                        jnp.asarray(iter_num % ema_every == 0),
+                        jnp.asarray(ema_flags),
                     )
                 player.params = params["actor"]
                 cumulative_per_rank_gradient_steps += g
-                train_step += world_size
+                train_step += world_size * iters_in_window
                 if aggregator and not aggregator.disabled:
                     # materializing metrics blocks on the train step; only
                     # pay that sync when metrics are on
-                    for k, v in jax.device_get(train_metrics).items():
+                    for k, v in device_get_metrics(train_metrics).items():
                         aggregator.update(k, v)
 
         if cfg.metric.log_level > 0 and (
@@ -381,6 +407,8 @@ def main(runtime, cfg: Dict[str, Any]):
                 "agent": params,
                 "opt_states": opt_states,
                 "ratio": ratio.state_dict(),
+                # undispatched ratio-granted gradient steps (dispatch_batch>1)
+                "pending_iters": list(pending_iters),
                 "iter_num": iter_num * world_size,
                 "batch_size": cfg.algo.per_rank_batch_size * world_size,
                 "last_log": last_log,

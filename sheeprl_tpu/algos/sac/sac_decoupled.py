@@ -40,7 +40,7 @@ from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import Ratio, save_configs
+from sheeprl_tpu.utils.utils import device_get_metrics, Ratio, save_configs
 
 
 def _player_loop(
@@ -393,9 +393,11 @@ def main(runtime, cfg: Dict[str, Any]):
                     opt_states,
                     data,
                     runtime.next_key(),
-                    jnp.asarray(iter_num % ema_every == 0),
+                    # per-step EMA flags: all steps of this dispatch come
+                    # from this iteration (see sac.make_train_fn)
+                    jnp.full((data["rewards"].shape[0],), iter_num % ema_every == 0),
                 )
-                train_metrics = {k: float(v) for k, v in jax.device_get(train_metrics).items()}
+                train_metrics = device_get_metrics(train_metrics)
             if not timer.disabled:
                 train_metrics["train_time"] = float(timer.compute().get("Time/train_time", 0.0))
                 timer.reset()

@@ -29,6 +29,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from sheeprl_tpu.utils.utils import transfer_tree
+
 LOG_STD_MIN = -10.0
 LOG_STD_MAX = 2.0
 
@@ -310,7 +312,7 @@ class SACAEPlayer:
 
     @params.setter
     def params(self, value):
-        self._params = jax.device_put(value, self.device) if self.device is not None else value
+        self._params = transfer_tree(value, self.device)
 
     def get_actions(self, obs, key=None, greedy: bool = False):
         prepared = self.prepare_obs_fn(obs)

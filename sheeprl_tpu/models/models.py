@@ -319,3 +319,121 @@ class MultiDecoder(nn.Module):
             chunks = jnp.split(rec, splits, axis=-1) if splits else [rec]
             out.update(dict(zip(self.mlp_keys, chunks)))
         return out
+
+
+class MultiHeadSelfAttention(nn.Module):
+    """Multi-head self-attention whose kernel is the framework's
+    long-context op suite (``sheeprl_tpu.ops``): ``parallelism="blockwise"``
+    runs the single-device flash-style kernel (O(S·block) memory);
+    ``parallelism="ring"`` expects to execute INSIDE ``jax.shard_map`` with
+    the sequence axis sharded over ``axis_name`` — K/V shards rotate over
+    ICI so memory per device stays O(S/n) (Ring Attention; SURVEY §5.7
+    marks the reference as having no long-context support at all, this is
+    a TPU-first extension)."""
+
+    num_heads: int
+    head_dim: int
+    causal: bool = True
+    parallelism: str = "blockwise"  # blockwise | ring
+    axis_name: str = "seq"
+    block_size: int = 512
+    dtype: Dtype = jnp.float32
+    param_dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        from sheeprl_tpu.ops.ring_attention import blockwise_attention, ring_attention
+
+        features = self.num_heads * self.head_dim
+        kw = dict(dtype=self.dtype, param_dtype=self.param_dtype, use_bias=False)
+        qkv = nn.Dense(3 * features, **kw)(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape = (*x.shape[:-1], self.num_heads, self.head_dim)
+        q, k, v = q.reshape(shape), k.reshape(shape), v.reshape(shape)
+        if self.parallelism == "ring":
+            out = ring_attention(q, k, v, axis_name=self.axis_name, causal=self.causal)
+        else:
+            out = blockwise_attention(q, k, v, block_size=self.block_size, causal=self.causal)
+        out = out.reshape(*x.shape[:-1], features)
+        return nn.Dense(x.shape[-1], **kw)(out)
+
+
+class TransformerBlock(nn.Module):
+    """Pre-LN attention + MLP residual block over (..., S, E) sequences."""
+
+    num_heads: int
+    head_dim: int
+    mlp_ratio: int = 4
+    causal: bool = True
+    parallelism: str = "blockwise"
+    axis_name: str = "seq"
+    dtype: Dtype = jnp.float32
+    param_dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        attn = MultiHeadSelfAttention(
+            self.num_heads,
+            self.head_dim,
+            self.causal,
+            self.parallelism,
+            self.axis_name,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+        )
+        x = x + attn(nn.LayerNorm(dtype=self.dtype)(x))
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        h = nn.Dense(self.mlp_ratio * x.shape[-1], dtype=self.dtype, param_dtype=self.param_dtype)(h)
+        h = jax.nn.gelu(h)
+        h = nn.Dense(x.shape[-1], dtype=self.dtype, param_dtype=self.param_dtype)(h)
+        return x + h
+
+
+class SequenceTransformer(nn.Module):
+    """Causal transformer over token/feature sequences with selectable
+    sequence parallelism — the long-context model family of the framework.
+
+    With ``parallelism="ring"`` wrap the apply in ``jax.shard_map`` (or use
+    ``sheeprl_tpu.parallel.sequence_parallel_step``) so each mesh device
+    holds S/n of the sequence; learned positional embeddings are indexed
+    per shard via the device's axis position."""
+
+    vocab_size: int
+    embed_dim: int = 256
+    depth: int = 2
+    num_heads: int = 4
+    max_len: int = 2048
+    parallelism: str = "blockwise"
+    axis_name: str = "seq"
+    dtype: Dtype = jnp.float32
+    param_dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, tokens: jax.Array) -> jax.Array:
+        emb = nn.Embed(self.vocab_size, self.embed_dim, param_dtype=self.param_dtype)(tokens)
+        pos_table = self.param(
+            "pos_embed",
+            nn.initializers.normal(0.02),
+            (self.max_len, self.embed_dim),
+            self.param_dtype,
+        )
+        s_local = tokens.shape[-1]
+        start = 0
+        if self.parallelism == "ring":
+            # global position of this device's shard inside shard_map
+            start = jax.lax.axis_index(self.axis_name) * s_local
+        pos = jax.lax.dynamic_slice_in_dim(pos_table, start, s_local, axis=0)
+        x = emb + pos
+        head_dim = self.embed_dim // self.num_heads
+        for _ in range(self.depth):
+            x = TransformerBlock(
+                self.num_heads,
+                head_dim,
+                causal=True,
+                parallelism=self.parallelism,
+                axis_name=self.axis_name,
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+            )(x)
+        x = nn.LayerNorm(dtype=self.dtype)(x)
+        return nn.Dense(self.vocab_size, dtype=self.dtype, param_dtype=self.param_dtype)(x)

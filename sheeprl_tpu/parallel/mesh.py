@@ -281,10 +281,17 @@ class MeshRuntime:
         return rt
 
     def player_device(self):
-        """Device for env-interaction policies: the host CPU backend when
-        training runs on an accelerator — the env hot loop then avoids a
-        device round-trip per step (tiny policy nets, CPU-actor/TPU-learner
-        split) — else None (same device as training)."""
+        """Device for env-interaction policies.
+
+        Default ("cpu"): the host CPU backend when training runs on an
+        accelerator — the env hot loop then avoids a device round-trip per
+        step (tiny policy nets, CPU-actor/TPU-learner split). Override with
+        SHEEPRL_PLAYER_DEVICE=accelerator to keep the player on the training
+        device: the right call when the accelerator sits behind a
+        high-latency link, where re-downloading the params tree to the host
+        after every train dispatch costs seconds per leaf."""
+        if os.environ.get("SHEEPRL_PLAYER_DEVICE", "cpu") == "accelerator":
+            return None
         if self.device.platform == "cpu":
             return None
         try:

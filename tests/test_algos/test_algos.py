@@ -237,6 +237,28 @@ def test_sac_sample_next_obs(standard_args, tmp_path):
     _run(args)
 
 
+def test_sac_dispatch_batch(standard_args, tmp_path):
+    """Gradient-step dispatch batching (algo.dispatch_batch>1) accumulates
+    several iterations into one jitted scan call without changing the total
+    number of gradient steps."""
+    args = [a for a in standard_args if a != "dry_run=True"] + [
+        "exp=sac",
+        "algo.total_steps=16",
+        "buffer.size=64",
+        "metric.log_every=8",
+        "checkpoint.every=16",
+        "env.id=dummy_continuous",
+        "algo.per_rank_batch_size=4",
+        "algo.hidden_size=8",
+        "algo.learning_starts=4",
+        "algo.dispatch_batch=4",
+        "algo.mlp_keys.encoder=[state]",
+        "fabric.devices=1",
+        f"root_dir={tmp_path}/sacdb",
+    ]
+    _run(args)
+
+
 def test_droq(standard_args, tmp_path):
     args = standard_args + [
         "exp=droq",

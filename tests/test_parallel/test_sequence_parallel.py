@@ -1,0 +1,88 @@
+"""Sequence-parallel transformer training on the 8-device CPU mesh:
+ring attention inside shard_map, grads pmean'd over the ring
+(sheeprl_tpu/parallel/sequence.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from sheeprl_tpu.models.models import SequenceTransformer
+from sheeprl_tpu.parallel import MeshRuntime
+from sheeprl_tpu.parallel.sequence import make_sequence_parallel_train_step
+
+
+def _data(rng, batch, seq, vocab):
+    # copy task: second half repeats the first half
+    half = seq // 2
+    first = rng.integers(1, vocab, (batch, half))
+    tokens = np.concatenate([first, first], axis=1).astype(np.int32)
+    return tokens[:, :-1], tokens[:, 1:]
+
+
+def test_sequence_parallel_step_runs_and_learns():
+    rt = MeshRuntime(devices=8, strategy="dp", accelerator="cpu").launch()
+    vocab, batch, seq = 16, 4, 64  # 63 usable -> pad to 64 boundary with seq=65
+    model = SequenceTransformer(
+        vocab_size=vocab, embed_dim=32, depth=1, num_heads=2, max_len=seq,
+        parallelism="ring", axis_name="data",
+    )
+    # same param tree, usable outside shard_map for initialization
+    init_model = SequenceTransformer(
+        vocab_size=vocab, embed_dim=32, depth=1, num_heads=2, max_len=seq,
+        parallelism="blockwise",
+    )
+    rng = np.random.default_rng(0)
+    tokens = np.concatenate(
+        [rng.integers(1, vocab, (batch, seq // 2))] * 2 + [np.zeros((batch, 1), np.int64)],
+        axis=1,
+    ).astype(np.int32)  # (B, 65): 64 inputs, 64 targets
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+
+    params = init_model.init(jax.random.PRNGKey(0), jnp.asarray(inputs[:, : seq // 8]))
+    tx = optax.adam(1e-2)
+    opt_state = tx.init(params)
+    step, token_sharding = make_sequence_parallel_train_step(rt.mesh, model, tx, "data")
+
+    inputs = jax.device_put(jnp.asarray(inputs), token_sharding)
+    targets = jax.device_put(jnp.asarray(targets), token_sharding)
+    params = rt.replicate(params)
+    opt_state = rt.replicate(opt_state)
+
+    losses = []
+    for _ in range(30):
+        params, opt_state, loss = step(params, opt_state, inputs, targets)
+        losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.8, f"no learning: {losses[0]} -> {losses[-1]}"
+
+
+def test_sequence_parallel_matches_single_device():
+    """The ring-sharded forward equals the blockwise single-device forward."""
+    vocab, batch, seq = 12, 2, 32
+    rng = np.random.default_rng(1)
+    tokens = jnp.asarray(rng.integers(0, vocab, (batch, seq)), jnp.int32)
+
+    ring_model = SequenceTransformer(
+        vocab_size=vocab, embed_dim=16, depth=1, num_heads=2, max_len=seq,
+        parallelism="ring", axis_name="data",
+    )
+    local_model = SequenceTransformer(
+        vocab_size=vocab, embed_dim=16, depth=1, num_heads=2, max_len=seq,
+        parallelism="blockwise",
+    )
+    rt = MeshRuntime(devices=8, strategy="dp", accelerator="cpu").launch()
+    params = local_model.init(jax.random.PRNGKey(0), tokens)
+    ref = local_model.apply(params, tokens)
+
+    from functools import partial
+
+    spec = jax.sharding.PartitionSpec(None, "data")
+
+    @partial(jax.shard_map, mesh=rt.mesh, in_specs=(jax.sharding.PartitionSpec(), spec), out_specs=spec)
+    def fwd(p, t):
+        return ring_model.apply(p, t)
+
+    got = fwd(params, tokens)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-4, atol=2e-4)

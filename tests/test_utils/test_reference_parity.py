@@ -110,3 +110,34 @@ def test_lambda_values_parity():
         torch.from_numpy(rewards), torch.from_numpy(values), torch.from_numpy(continues), 0.95
     ).numpy()
     np.testing.assert_allclose(ours, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_transfer_tree_and_batched_metrics():
+    """transfer_tree round-trips a mixed pytree onto a device with one
+    cross-backend copy; device_get_metrics fetches dict scalars in one
+    transfer."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sheeprl_tpu.utils.utils import device_get_metrics, transfer_tree
+
+    cpu = jax.devices("cpu")[0]
+    tree = {
+        "w": jnp.arange(12, dtype=jnp.float32).reshape(3, 4),
+        "nested": {"b": jnp.ones((5,), jnp.float32) * 2},
+        # exact int transfer (values beyond f32's 2^24 integer range)
+        "count": jnp.asarray([16_777_217, 3], jnp.int32),
+    }
+    out = transfer_tree(tree, cpu)
+    np.testing.assert_array_equal(np.asarray(out["w"]), np.asarray(tree["w"]))
+    np.testing.assert_array_equal(np.asarray(out["nested"]["b"]), np.asarray(tree["nested"]["b"]))
+    assert next(iter(out["w"].devices())) == cpu
+    np.testing.assert_array_equal(np.asarray(out["count"]), np.asarray([16_777_217, 3]))
+    assert out["count"].dtype == jnp.int32
+    assert transfer_tree(tree, None) is tree
+
+    metrics = {"a": jnp.float32(1.5), "b": jnp.asarray([2.5])}
+    got = device_get_metrics(metrics)
+    assert got == {"a": 1.5, "b": 2.5}
+    assert device_get_metrics({}) == {}
