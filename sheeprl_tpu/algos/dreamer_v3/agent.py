@@ -70,13 +70,19 @@ class LinearLnAct(nn.Module):
     eps: float = 1e-3
     act: Any = "silu"
     kernel_init: Callable = trunc_init
+    dtype: Any = jnp.float32  # compute dtype; params stay f32, LN reduces f32
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
-        x = nn.Dense(self.units, use_bias=not self.layer_norm, kernel_init=self.kernel_init)(x)
+        x = nn.Dense(
+            self.units,
+            use_bias=not self.layer_norm,
+            kernel_init=self.kernel_init,
+            dtype=self.dtype,
+        )(x)
         if self.layer_norm:
-            x = nn.LayerNorm(epsilon=self.eps)(x)
-        return resolve_activation(self.act)(x)
+            x = nn.LayerNorm(epsilon=self.eps)(x)  # f32 statistics
+        return resolve_activation(self.act)(x.astype(self.dtype))
 
 
 class DreamerMLP(nn.Module):
@@ -89,13 +95,15 @@ class DreamerMLP(nn.Module):
     eps: float = 1e-3
     act: Any = "silu"
     out_init: Callable = trunc_init
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         for _ in range(self.layers):
-            x = LinearLnAct(self.units, self.layer_norm, self.eps, self.act)(x)
+            x = LinearLnAct(self.units, self.layer_norm, self.eps, self.act, dtype=self.dtype)(x)
         if self.output_dim is not None:
-            x = nn.Dense(self.output_dim, kernel_init=self.out_init)(x)
+            # heads emit f32: downstream distributions/losses stay exact
+            x = nn.Dense(self.output_dim, kernel_init=self.out_init)(x.astype(jnp.float32))
         return x
 
 
@@ -109,6 +117,7 @@ class CNNEncoder(nn.Module):
     layer_norm: bool = True
     eps: float = 1e-3
     act: Any = "silu"
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, obs: Dict[str, jax.Array]) -> jax.Array:
@@ -121,10 +130,11 @@ class CNNEncoder(nn.Module):
                 padding=[(1, 1), (1, 1)],
                 use_bias=not self.layer_norm,
                 kernel_init=trunc_init,
+                dtype=self.dtype,
             )(x)
             if self.layer_norm:
-                x = nn.LayerNorm(epsilon=self.eps)(x)
-            x = resolve_activation(self.act)(x)
+                x = nn.LayerNorm(epsilon=self.eps)(x)  # f32 statistics
+            x = resolve_activation(self.act)(x.astype(self.dtype))
         return x.reshape(*x.shape[:-3], -1)
 
 
@@ -136,13 +146,17 @@ class MLPEncoder(nn.Module):
     eps: float = 1e-3
     act: Any = "silu"
     symlog_inputs: bool = True
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, obs: Dict[str, jax.Array]) -> jax.Array:
         x = jnp.concatenate(
             [symlog(obs[k]) if self.symlog_inputs else obs[k] for k in self.keys], -1
         )
-        return DreamerMLP(self.dense_units, self.mlp_layers, None, self.layer_norm, self.eps, self.act)(x)
+        return DreamerMLP(
+            self.dense_units, self.mlp_layers, None, self.layer_norm, self.eps, self.act,
+            dtype=self.dtype,
+        )(x)
 
 
 class MultiEncoderDV3(nn.Module):
@@ -171,11 +185,12 @@ class CNNDecoder(nn.Module):
     layer_norm: bool = True
     eps: float = 1e-3
     act: Any = "silu"
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, latent: jax.Array) -> Dict[str, jax.Array]:
         lead = latent.shape[:-1]
-        x = nn.Dense(self.cnn_encoder_output_dim, kernel_init=trunc_init)(latent)
+        x = nn.Dense(self.cnn_encoder_output_dim, kernel_init=trunc_init, dtype=self.dtype)(latent)
         x = x.reshape(-1, 4, 4, (2 ** (self.stages - 1)) * self.channels_multiplier)
         for i in range(self.stages - 1):
             ch = (2 ** (self.stages - i - 2)) * self.channels_multiplier
@@ -186,17 +201,19 @@ class CNNDecoder(nn.Module):
                 padding=[(2, 2), (2, 2)],
                 use_bias=not self.layer_norm,
                 kernel_init=trunc_init,
+                dtype=self.dtype,
             )(x)
             if self.layer_norm:
-                x = nn.LayerNorm(epsilon=self.eps)(x)
-            x = resolve_activation(self.act)(x)
+                x = nn.LayerNorm(epsilon=self.eps)(x)  # f32 statistics
+            x = resolve_activation(self.act)(x.astype(self.dtype))
+        # final deconv emits f32 for the reconstruction distributions
         x = nn.ConvTranspose(
             int(sum(self.output_channels)),
             (4, 4),
             strides=(2, 2),
             padding=[(2, 2), (2, 2)],
             kernel_init=uniform_out_init(1.0),
-        )(x)
+        )(x.astype(jnp.float32))
         x = x.reshape(*lead, *x.shape[1:])
         out: Dict[str, jax.Array] = {}
         start = 0
@@ -214,10 +231,15 @@ class MLPDecoder(nn.Module):
     layer_norm: bool = True
     eps: float = 1e-3
     act: Any = "silu"
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, latent: jax.Array) -> Dict[str, jax.Array]:
-        x = DreamerMLP(self.dense_units, self.mlp_layers, None, self.layer_norm, self.eps, self.act)(latent)
+        x = DreamerMLP(
+            self.dense_units, self.mlp_layers, None, self.layer_norm, self.eps, self.act,
+            dtype=self.dtype,
+        )(latent)
+        x = x.astype(jnp.float32)  # heads emit f32 for the dists
         return {
             k: nn.Dense(d, kernel_init=uniform_out_init(1.0))(x)
             for k, d in zip(self.keys, self.output_dims)
@@ -245,17 +267,20 @@ class RecurrentModel(nn.Module):
     layer_norm: bool = True
     eps: float = 1e-3
     fused: bool = False
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, inp: jax.Array, recurrent_state: jax.Array) -> jax.Array:
-        feat = LinearLnAct(self.dense_units, self.layer_norm, self.eps, "silu")(inp)
+        feat = LinearLnAct(self.dense_units, self.layer_norm, self.eps, "silu", dtype=self.dtype)(inp)
         new_h, _ = LayerNormGRUCell(
             hidden_size=self.recurrent_state_size,
             use_bias=False,
             layer_norm=True,
             fused=self.fused,
+            dtype=self.dtype,
         )(recurrent_state, feat)
-        return new_h
+        # the carried recurrent state stays f32 across scan steps
+        return new_h.astype(jnp.float32)
 
 
 def compute_stochastic_state(
@@ -288,6 +313,7 @@ class RSSM(nn.Module):
     learnable_initial_recurrent_state: bool = True
     decoupled: bool = False
     fused_gru: bool = False
+    dtype: Any = jnp.float32
 
     def setup(self) -> None:
         stoch = self.stochastic_size * self.discrete_size
@@ -297,12 +323,15 @@ class RSSM(nn.Module):
             layer_norm=self.layer_norm,
             eps=self.eps,
             fused=self.fused_gru,
+            dtype=self.dtype,
         )
         self.representation_model = DreamerMLP(
-            self.hidden_size, 1, stoch, self.layer_norm, self.eps, self.act, uniform_out_init(1.0)
+            self.hidden_size, 1, stoch, self.layer_norm, self.eps, self.act, uniform_out_init(1.0),
+            dtype=self.dtype,
         )
         self.transition_model = DreamerMLP(
-            self.hidden_size, 1, stoch, self.layer_norm, self.eps, self.act, uniform_out_init(1.0)
+            self.hidden_size, 1, stoch, self.layer_norm, self.eps, self.act, uniform_out_init(1.0),
+            dtype=self.dtype,
         )
         if self.learnable_initial_recurrent_state:
             self.initial_recurrent_state = self.param(
@@ -406,6 +435,7 @@ class Actor(nn.Module):
     act: Any = "silu"
     unimix: float = 0.01
     action_clip: float = 1.0
+    dtype: Any = jnp.float32
 
     def _dist_name(self) -> str:
         d = self.distribution.lower()
@@ -431,7 +461,8 @@ class Actor(nn.Module):
     ):
         x = state
         for _ in range(self.mlp_layers):
-            x = LinearLnAct(self.dense_units, self.layer_norm, self.eps, self.act)(x)
+            x = LinearLnAct(self.dense_units, self.layer_norm, self.eps, self.act, dtype=self.dtype)(x)
+        x = x.astype(jnp.float32)  # dist heads in f32
         if self.is_continuous:
             pre = nn.Dense(int(np.sum(self.actions_dim)) * 2, kernel_init=uniform_out_init(1.0))(x)
             mean, std = jnp.split(pre, 2, -1)
@@ -647,6 +678,10 @@ def build_agent(
     cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
     mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
     cnn_stages = int(np.log2(cfg.env.screen_size) - np.log2(4))
+    # fabric.precision policy: trunks compute in bf16 under *-mixed/true
+    # (dist heads, LayerNorm statistics and the scan-carried states stay
+    # f32 — see the per-module dtype notes)
+    compute_dtype = runtime.compute_dtype
 
     cnn_encoder = (
         CNNEncoder(
@@ -656,6 +691,7 @@ def build_agent(
             layer_norm=_ln_enabled(world_model_cfg.encoder.cnn_layer_norm),
             eps=_ln_eps(world_model_cfg.encoder.cnn_layer_norm),
             act="silu",
+            dtype=compute_dtype,
         )
         if len(cnn_keys) > 0
         else None
@@ -667,6 +703,7 @@ def build_agent(
             dense_units=world_model_cfg.encoder.dense_units,
             layer_norm=_ln_enabled(world_model_cfg.encoder.mlp_layer_norm),
             eps=_ln_eps(world_model_cfg.encoder.mlp_layer_norm),
+            dtype=compute_dtype,
         )
         if len(mlp_keys) > 0
         else None
@@ -695,6 +732,7 @@ def build_agent(
         learnable_initial_recurrent_state=world_model_cfg.learnable_initial_recurrent_state,
         decoupled=bool(world_model_cfg.decoupled_rssm),
         fused_gru=bool(world_model_cfg.recurrent_model.get("fused", False)),
+        dtype=compute_dtype,
     )
 
     cnn_decoder = (
@@ -707,6 +745,7 @@ def build_agent(
             stages=cnn_stages,
             layer_norm=_ln_enabled(world_model_cfg.observation_model.cnn_layer_norm),
             eps=_ln_eps(world_model_cfg.observation_model.cnn_layer_norm),
+            dtype=compute_dtype,
         )
         if len(cfg.algo.cnn_keys.decoder) > 0
         else None
@@ -719,6 +758,7 @@ def build_agent(
             dense_units=world_model_cfg.observation_model.dense_units,
             layer_norm=_ln_enabled(world_model_cfg.observation_model.mlp_layer_norm),
             eps=_ln_eps(world_model_cfg.observation_model.mlp_layer_norm),
+            dtype=compute_dtype,
         )
         if len(cfg.algo.mlp_keys.decoder) > 0
         else None
@@ -732,6 +772,7 @@ def build_agent(
         layer_norm=_ln_enabled(world_model_cfg.reward_model.layer_norm),
         eps=_ln_eps(world_model_cfg.reward_model.layer_norm),
         out_init=uniform_out_init(0.0),
+        dtype=compute_dtype,
     )
     continue_model = DreamerMLP(
         units=world_model_cfg.discount_model.dense_units,
@@ -740,6 +781,7 @@ def build_agent(
         layer_norm=_ln_enabled(world_model_cfg.discount_model.layer_norm),
         eps=_ln_eps(world_model_cfg.discount_model.layer_norm),
         out_init=uniform_out_init(1.0),
+        dtype=compute_dtype,
     )
     world_model = WorldModel(encoder, rssm, observation_model, reward_model, continue_model)
 
@@ -756,6 +798,7 @@ def build_agent(
         eps=_ln_eps(actor_cfg.layer_norm),
         unimix=cfg.algo.unimix,
         action_clip=actor_cfg.action_clip,
+        dtype=compute_dtype,
     )
     critic = DreamerMLP(
         units=critic_cfg.dense_units,
@@ -764,6 +807,7 @@ def build_agent(
         layer_norm=_ln_enabled(critic_cfg.layer_norm),
         eps=_ln_eps(critic_cfg.layer_norm),
         out_init=uniform_out_init(0.0),
+        dtype=compute_dtype,
     )
 
     # ------------------------------------------------------------- init

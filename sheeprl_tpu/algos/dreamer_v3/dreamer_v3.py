@@ -675,7 +675,12 @@ def main(runtime, cfg: Dict[str, Any]):
                             cumulative_per_rank_gradient_steps += 1
                     train_step += world_size
                 player.params = {"world_model": params["world_model"], "actor": params["actor"]}
-                if aggregator and not aggregator.disabled:
+                # metric.fetch_every amortizes the per-iteration device
+                # sync of the losses dict on high-latency links (1 =
+                # reference cadence; the aggregator still averages over the
+                # log window)
+                fetch_every = max(1, int(cfg.metric.get("fetch_every", 1)))
+                if aggregator and not aggregator.disabled and iter_num % fetch_every == 0:
                     for k, v in device_get_metrics(train_metrics).items():
                         aggregator.update(k, v)
 

@@ -239,6 +239,11 @@ class LayerNormGRUCell(nn.Module):
 
             p = self.variables["params"]
             lead = h.shape[:-1]  # kernel wants (B, H); callers pass e.g. (1, B, H)
+            # honor the compute dtype exactly like the unfused path (the
+            # kernel accumulates in f32 either way), so fused/unfused stay
+            # interchangeable per precision
+            h = h.astype(self.dtype)
+            x = x.astype(self.dtype)
 
             def _step(interpret: bool):
                 def f(h2, x2, w, scale, bias):
@@ -252,7 +257,7 @@ class LayerNormGRUCell(nn.Module):
             new_h = jax.lax.platform_dependent(
                 h.reshape(-1, h.shape[-1]),
                 x.reshape(-1, x.shape[-1]),
-                p["Dense_0"]["kernel"],
+                p["Dense_0"]["kernel"].astype(self.dtype),
                 p["LayerNorm_0"]["scale"],
                 p["LayerNorm_0"]["bias"],
                 tpu=_step(False),

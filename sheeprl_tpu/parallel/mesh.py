@@ -31,6 +31,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 _PRECISIONS = ("32-true", "bf16-mixed", "bf16-true")
 _STRATEGIES = ("auto", "dp", "ddp", "fsdp")
+_PLAYER_DEVICES = ("auto", "cpu", "accelerator")
 
 
 class MeshRuntime:
@@ -43,17 +44,23 @@ class MeshRuntime:
         strategy: str = "auto",
         accelerator: str = "auto",
         precision: str = "32-true",
+        player_device: str = "auto",
         **kwargs: Any,
     ):
         if precision not in _PRECISIONS:
             raise ValueError(f"precision must be one of {_PRECISIONS}, got '{precision}'")
         if strategy not in _STRATEGIES:
             raise ValueError(f"strategy must be one of {_STRATEGIES}, got '{strategy}'")
+        if player_device not in _PLAYER_DEVICES:
+            raise ValueError(
+                f"player_device must be one of {_PLAYER_DEVICES}, got '{player_device}'"
+            )
         self._requested_devices = devices
         self._num_nodes = num_nodes
         self._strategy = strategy
         self._accelerator = accelerator
         self._precision = precision
+        self._player_device = player_device
         self._launched = False
         self._mesh: Optional[Mesh] = None
         self._key: Optional[jax.Array] = None
@@ -88,6 +95,14 @@ class MeshRuntime:
                 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
         except Exception:
             pass
+        if self._precision == "bf16-true":
+            import warnings
+
+            warnings.warn(
+                "bf16-true parameter storage is not implemented yet: parameters "
+                "stay float32 and the run behaves like bf16-mixed (compute in "
+                "bf16, f32 params/optimizer state)."
+            )
         if self._num_nodes > 1 and jax.process_count() == 1:
             # multi-host rendezvous (reads JAX coordinator env vars)
             jax.distributed.initialize()
@@ -283,14 +298,21 @@ class MeshRuntime:
     def player_device(self):
         """Device for env-interaction policies.
 
-        Default ("cpu"): the host CPU backend when training runs on an
-        accelerator — the env hot loop then avoids a device round-trip per
-        step (tiny policy nets, CPU-actor/TPU-learner split). Override with
-        SHEEPRL_PLAYER_DEVICE=accelerator to keep the player on the training
-        device: the right call when the accelerator sits behind a
-        high-latency link, where re-downloading the params tree to the host
-        after every train dispatch costs seconds per leaf."""
-        if os.environ.get("SHEEPRL_PLAYER_DEVICE", "cpu") == "accelerator":
+        "auto"/"cpu" (default): the host CPU backend when training runs on
+        an accelerator — the env hot loop then avoids a device round trip
+        per step (tiny policy nets, CPU-actor/TPU-learner split).
+        "accelerator": keep the player on the training device — the right
+        call when the accelerator sits behind a high-latency link, where
+        re-downloading the params tree to the host after every train
+        dispatch costs seconds per leaf. Configured via
+        ``fabric.player_device``; the SHEEPRL_PLAYER_DEVICE env var
+        overrides the config."""
+        choice = os.environ.get("SHEEPRL_PLAYER_DEVICE", self._player_device)
+        if choice not in _PLAYER_DEVICES:
+            raise ValueError(
+                f"player_device must be one of {_PLAYER_DEVICES}, got '{choice}'"
+            )
+        if choice == "accelerator":
             return None
         if self.device.platform == "cpu":
             return None
