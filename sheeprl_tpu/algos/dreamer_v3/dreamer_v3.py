@@ -56,7 +56,7 @@ from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import device_get_metrics, Ratio, save_configs
+from sheeprl_tpu.utils.utils import MetricFetchGate, device_get_metrics, Ratio, save_configs
 
 sg = jax.lax.stop_gradient
 
@@ -554,6 +554,7 @@ def main(runtime, cfg: Dict[str, Any]):
     player.init_states()
 
     cumulative_per_rank_gradient_steps = 0
+    metric_fetch_gate = MetricFetchGate(cfg.metric.get("fetch_every", 1))
     for iter_num in range(start_iter, total_iters + 1):
         policy_step += policy_steps_per_iter
 
@@ -679,8 +680,7 @@ def main(runtime, cfg: Dict[str, Any]):
                 # sync of the losses dict on high-latency links (1 =
                 # reference cadence; the aggregator still averages over the
                 # log window)
-                fetch_every = max(1, int(cfg.metric.get("fetch_every", 1)))
-                if aggregator and not aggregator.disabled and iter_num % fetch_every == 0:
+                if aggregator and not aggregator.disabled and metric_fetch_gate():
                     for k, v in device_get_metrics(train_metrics).items():
                         aggregator.update(k, v)
 

@@ -54,7 +54,7 @@ from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import device_get_metrics, Ratio, save_configs
+from sheeprl_tpu.utils.utils import MetricFetchGate, device_get_metrics, Ratio, save_configs
 
 sg = jax.lax.stop_gradient
 
@@ -656,6 +656,7 @@ def main(runtime, cfg: Dict[str, Any]):
     player.init_states()
 
     cumulative_per_rank_gradient_steps = 0
+    metric_fetch_gate = MetricFetchGate(cfg.metric.get("fetch_every", 1))
     for iter_num in range(start_iter, total_iters + 1):
         policy_step += policy_steps_per_iter
 
@@ -784,7 +785,7 @@ def main(runtime, cfg: Dict[str, Any]):
                     "world_model": params["world_model"],
                     "actor": params["actor_exploration"],
                 }
-                if aggregator and not aggregator.disabled:
+                if aggregator and not aggregator.disabled and metric_fetch_gate():
                     for k, v in device_get_metrics(train_metrics).items():
                         aggregator.update(k, v)
 

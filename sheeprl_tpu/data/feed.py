@@ -18,8 +18,10 @@ import jax
 
 def batched_feed(local_data: Dict[str, Any], n_batches: int, depth: int = 2) -> "DevicePrefetcher":
     """Prefetcher over the leading (n_samples) axis of a sampled buffer dict:
-    yields ``n_batches`` float32 batches, each ``device_put`` on the worker
-    thread so the host->HBM copy of batch i+1 overlaps gradient step i.
+    yields ``n_batches`` batches, each ``device_put`` on the worker thread
+    so the host->HBM copy of batch i+1 overlaps gradient step i. uint8
+    image data stays uint8 (4x less host memory traffic and upload; the
+    jitted train steps normalize on device); everything else is float32.
 
     Drop-in for the Dreamer-family gradient-step loops' per-step
     ``jnp.asarray(v[i])`` conversion."""
@@ -31,7 +33,10 @@ def batched_feed(local_data: Dict[str, Any], n_batches: int, depth: int = 2) -> 
         i = next(counter, None)
         if i is None:
             return None
-        return {k: np.asarray(v[i], dtype=np.float32) for k, v in local_data.items()}
+        return {
+            k: np.asarray(v[i]) if getattr(v, "dtype", None) == np.uint8 else np.asarray(v[i], dtype=np.float32)
+            for k, v in local_data.items()
+        }
 
     return DevicePrefetcher(producer, depth=depth)
 

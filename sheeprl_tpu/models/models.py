@@ -239,15 +239,16 @@ class LayerNormGRUCell(nn.Module):
 
             p = self.variables["params"]
             lead = h.shape[:-1]  # kernel wants (B, H); callers pass e.g. (1, B, H)
-            # honor the compute dtype exactly like the unfused path (the
-            # kernel accumulates in f32 either way), so fused/unfused stay
-            # interchangeable per precision
-            h = h.astype(self.dtype)
-            x = x.astype(self.dtype)
+            # mixed-precision semantics match the unfused path exactly: the
+            # contraction runs in the compute dtype inside the kernel while
+            # the carried state, gates and LayerNorm stay f32
+            mm_dtype = self.dtype
 
             def _step(interpret: bool):
                 def f(h2, x2, w, scale, bias):
-                    return gru_cell(h2, x2, w, scale, bias, 1e-6, True, 8, 512, interpret)
+                    return gru_cell(
+                        h2, x2, w, scale, bias, 1e-6, True, 8, 512, interpret, mm_dtype
+                    )
 
                 return f
 
@@ -257,7 +258,7 @@ class LayerNormGRUCell(nn.Module):
             new_h = jax.lax.platform_dependent(
                 h.reshape(-1, h.shape[-1]),
                 x.reshape(-1, x.shape[-1]),
-                p["Dense_0"]["kernel"].astype(self.dtype),
+                p["Dense_0"]["kernel"],
                 p["LayerNorm_0"]["scale"],
                 p["LayerNorm_0"]["bias"],
                 tpu=_step(False),

@@ -39,15 +39,19 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
-def _gru_kernel(h_ref, inp_ref, w_ref, gamma_ref, beta_ref, out_ref, acc_ref, *, nk: int, eps: float, use_ln: bool):
+def _gru_kernel(h_ref, inp_ref, w_ref, gamma_ref, beta_ref, out_ref, acc_ref, *, nk: int, eps: float, use_ln: bool, matmul_dtype):
     k = pl.program_id(1)
 
     @pl.when(k == 0)
     def _init():
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
+    # the contraction runs in ``matmul_dtype`` (bf16 under mixed precision,
+    # MXU fast path) with an f32 accumulator; gates/LN/state update stay f32
     acc_ref[:] += jnp.dot(
-        inp_ref[:], w_ref[:], preferred_element_type=jnp.float32
+        inp_ref[:].astype(matmul_dtype),
+        w_ref[:].astype(matmul_dtype),
+        preferred_element_type=jnp.float32,
     )
 
     @pl.when(k == nk - 1)
@@ -67,7 +71,8 @@ def _gru_kernel(h_ref, inp_ref, w_ref, gamma_ref, beta_ref, out_ref, acc_ref, *,
 
 
 @functools.partial(
-    jax.jit, static_argnames=("eps", "use_ln", "block_b", "block_k", "interpret")
+    jax.jit,
+    static_argnames=("eps", "use_ln", "block_b", "block_k", "interpret", "matmul_dtype"),
 )
 def fused_gru_cell(
     h: jax.Array,
@@ -81,6 +86,7 @@ def fused_gru_cell(
     block_b: int = 8,
     block_k: int = 512,
     interpret: bool = False,
+    matmul_dtype=jnp.float32,
 ) -> jax.Array:
     """One fused LayerNorm-GRU step.
 
@@ -110,7 +116,7 @@ def fused_gru_cell(
         w = jnp.pad(w, ((0, pk), (0, 0)))
 
     out = pl.pallas_call(
-        functools.partial(_gru_kernel, nk=nk, eps=eps, use_ln=use_ln),
+        functools.partial(_gru_kernel, nk=nk, eps=eps, use_ln=use_ln, matmul_dtype=matmul_dtype),
         grid=(nb, nk),
         in_specs=[
             pl.BlockSpec((block_b, hidden), lambda i, k: (i, 0)),  # h
@@ -141,11 +147,11 @@ def reference_gru_cell(h, x, w, gamma=None, beta=None, *, eps: float = 1e-6, use
     return update * cand + (1.0 - update) * h
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
 def gru_cell(
     h, x, w, gamma, beta,
     eps: float = 1e-6, use_ln: bool = True, block_b: int = 8, block_k: int = 512,
-    interpret: bool = False,
+    interpret: bool = False, matmul_dtype=jnp.float32,
 ):
     """Training-safe fused GRU step: Pallas forward, analytic XLA backward.
 
@@ -157,18 +163,20 @@ def gru_cell(
     return fused_gru_cell(
         h, x, w, gamma, beta,
         eps=eps, use_ln=use_ln, block_b=block_b, block_k=block_k, interpret=interpret,
+        matmul_dtype=matmul_dtype,
     )
 
 
-def _gru_fwd(h, x, w, gamma, beta, eps, use_ln, block_b, block_k, interpret):
+def _gru_fwd(h, x, w, gamma, beta, eps, use_ln, block_b, block_k, interpret, matmul_dtype):
     out = fused_gru_cell(
         h, x, w, gamma, beta,
         eps=eps, use_ln=use_ln, block_b=block_b, block_k=block_k, interpret=interpret,
+        matmul_dtype=matmul_dtype,
     )
     return out, (h, x, w, gamma, beta)
 
 
-def _gru_bwd(eps, use_ln, block_b, block_k, interpret, res, g):
+def _gru_bwd(eps, use_ln, block_b, block_k, interpret, matmul_dtype, res, g):
     h, x, w, gamma, beta = res
     # rematerialize through the reference formulas and use XLA's VJP; the
     # activations are tiny next to the weight gradient matmuls

@@ -216,6 +216,22 @@ class Ratio:
         return self
 
 
+class MetricFetchGate:
+    """Counts train dispatches and fires every ``metric.fetch_every``-th one
+    (amortizes the device sync of the losses dict on high-latency links;
+    1 = reference cadence). Counting dispatches rather than iterations keeps
+    the gate aligned with whatever schedule the replay ratio produces."""
+
+    def __init__(self, every: Any):
+        self.every = max(1, int(every or 1))
+        self._n = 0
+
+    def __call__(self) -> bool:
+        hit = self._n % self.every == 0
+        self._n += 1
+        return hit
+
+
 def device_get_metrics(metrics: Dict[str, Any]) -> Dict[str, float]:
     """Fetch a dict of device scalars with ONE device-to-host transfer.
 
