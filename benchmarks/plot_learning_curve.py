@@ -1,0 +1,51 @@
+"""Extract a learning curve from a training run's stdout log.
+
+The training loops print ``Rank-0: policy_step=N, reward_env_i=R`` on every
+episode end; this tool bins those into a curve and writes a compact JSON
+artifact (plus an ASCII sparkline for quick reading).
+
+Usage: python benchmarks/plot_learning_curve.py <log> [out.json] [bin=4000]
+"""
+
+import json
+import re
+import sys
+from collections import defaultdict
+
+_LINE = re.compile(r"policy_step=(\d+), reward_env_\d+=([-+\d.eE]+)")
+
+
+def extract(log_path: str, bin_size: int = 4000):
+    bins = defaultdict(list)
+    for line in open(log_path, errors="ignore"):
+        m = _LINE.search(line)
+        if m:
+            step, rew = int(m.group(1)), float(m.group(2))
+            bins[(step // bin_size) * bin_size].append(rew)
+    return [
+        {"policy_step": k, "reward_mean": sum(v) / len(v), "reward_max": max(v), "episodes": len(v)}
+        for k, v in sorted(bins.items())
+    ]
+
+
+def sparkline(curve, width: int = 60) -> str:
+    if not curve:
+        return "(empty)"
+    blocks = "▁▂▃▄▅▆▇█"
+    vals = [c["reward_mean"] for c in curve]
+    lo, hi = min(vals), max(vals)
+    rng = (hi - lo) or 1.0
+    return "".join(blocks[int((v - lo) / rng * (len(blocks) - 1))] for v in vals[:width])
+
+
+if __name__ == "__main__":
+    log = sys.argv[1]
+    out = sys.argv[2] if len(sys.argv) > 2 else None
+    bin_size = int(sys.argv[3]) if len(sys.argv) > 3 else 4000
+    curve = extract(log, bin_size)
+    for c in curve:
+        print(f"step {c['policy_step']:>8,}  mean {c['reward_mean']:7.1f}  max {c['reward_max']:7.1f}  ({c['episodes']} eps)")
+    print(sparkline(curve))
+    if out:
+        json.dump(curve, open(out, "w"), indent=1)
+        print(f"-> {out}")

@@ -266,14 +266,17 @@ class LayerNormGRUCell(nn.Module):
             ).reshape(*lead, -1)
             return new_h, new_h
         inp = jnp.concatenate([h, x], axis=-1)
-        parts = dense(inp)
+        # only the contraction runs in the compute dtype; LayerNorm, gates
+        # and the convex state update stay f32 (same split as the fused
+        # kernel, which keeps its accumulator/gates in f32)
+        parts = dense(inp).astype(jnp.float32)
         if ln is not None:
             parts = ln(parts)
         reset, cand, update = jnp.split(parts, 3, axis=-1)
         reset = jax.nn.sigmoid(reset)
         cand = jnp.tanh(reset * cand)
         update = jax.nn.sigmoid(update - 1.0)
-        new_h = update * cand + (1.0 - update) * h
+        new_h = update * cand + (1.0 - update) * h.astype(jnp.float32)
         return new_h, new_h
 
 
