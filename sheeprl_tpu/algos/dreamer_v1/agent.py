@@ -63,12 +63,18 @@ class RecurrentModel(nn.Module):
 
     recurrent_state_size: int
     act: Any = "elu"
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, inp: jax.Array, recurrent_state: jax.Array) -> jax.Array:
-        feat = nn.Dense(self.recurrent_state_size, kernel_init=xavier_init)(inp)
+        feat = nn.Dense(self.recurrent_state_size, kernel_init=xavier_init, dtype=self.dtype)(inp)
         feat = resolve_activation(self.act)(feat)
-        new_h, _ = nn.GRUCell(features=self.recurrent_state_size)(recurrent_state, feat)
+        # the GRU cell itself stays f32: flax's GRUCell computes the whole
+        # convex update in its dtype, and a bf16 carry loses state updates
+        # below 2^-8 every sequential step
+        new_h, _ = nn.GRUCell(features=self.recurrent_state_size)(
+            recurrent_state, feat.astype(jnp.float32)
+        )
         return new_h
 
 
@@ -83,16 +89,19 @@ class RSSM(nn.Module):
     transition_hidden_size: int = 200
     min_std: float = 0.1
     act: Any = "elu"
+    dtype: Any = jnp.float32
 
     def setup(self) -> None:
         self.recurrent_model = RecurrentModel(
-            recurrent_state_size=self.recurrent_state_size, act=self.act
+            recurrent_state_size=self.recurrent_state_size, act=self.act, dtype=self.dtype
         )
         self.representation_model = V2MLP(
-            self.representation_hidden_size, 1, 2 * self.stochastic_size, self.act, False
+            self.representation_hidden_size, 1, 2 * self.stochastic_size, self.act, False,
+            dtype=self.dtype,
         )
         self.transition_model = V2MLP(
-            self.transition_hidden_size, 1, 2 * self.stochastic_size, self.act, False
+            self.transition_hidden_size, 1, 2 * self.stochastic_size, self.act, False,
+            dtype=self.dtype,
         )
 
     def recurrent_step(self, inp: jax.Array, recurrent_state: jax.Array) -> jax.Array:
@@ -276,6 +285,7 @@ def build_agent(
     use_continues = bool(world_model_cfg.use_continues)
     cnn_act = world_model_cfg.encoder.get("cnn_act", "relu")
     dense_act = world_model_cfg.encoder.get("dense_act", "elu")
+    compute_dtype = runtime.compute_dtype  # precision policy (same split as DV3)
 
     cnn_encoder = (
         CNNEncoder(
@@ -283,6 +293,7 @@ def build_agent(
             channels_multiplier=world_model_cfg.encoder.cnn_channels_multiplier,
             layer_norm=False,
             act=cnn_act,
+            dtype=compute_dtype,
         )
         if len(cnn_keys) > 0
         else None
@@ -294,6 +305,7 @@ def build_agent(
             dense_units=world_model_cfg.encoder.dense_units,
             layer_norm=False,
             act=dense_act,
+            dtype=compute_dtype,
         )
         if len(mlp_keys) > 0
         else None
@@ -323,6 +335,7 @@ def build_agent(
         transition_hidden_size=world_model_cfg.transition_model.hidden_size,
         min_std=float(world_model_cfg.min_std),
         act=dense_act,
+        dtype=compute_dtype,
     )
 
     cnn_decoder = (
@@ -333,6 +346,7 @@ def build_agent(
             cnn_encoder_output_dim=cnn_encoder_output_dim,
             layer_norm=False,
             act=cnn_act,
+            dtype=compute_dtype,
         )
         if len(cfg.algo.cnn_keys.decoder) > 0
         else None
@@ -345,6 +359,7 @@ def build_agent(
             dense_units=world_model_cfg.observation_model.dense_units,
             layer_norm=False,
             act=dense_act,
+            dtype=compute_dtype,
         )
         if len(cfg.algo.mlp_keys.decoder) > 0
         else None
@@ -356,6 +371,7 @@ def build_agent(
         layers=world_model_cfg.reward_model.mlp_layers,
         output_dim=1,
         act=dense_act,
+        dtype=compute_dtype,
     )
     continue_model = (
         V2MLP(
@@ -363,6 +379,7 @@ def build_agent(
             layers=world_model_cfg.discount_model.mlp_layers,
             output_dim=1,
             act=dense_act,
+            dtype=compute_dtype,
         )
         if use_continues
         else None
@@ -379,12 +396,14 @@ def build_agent(
         mlp_layers=actor_cfg.mlp_layers,
         layer_norm=False,
         act=actor_cfg.get("dense_act", "elu"),
+        dtype=compute_dtype,
     )
     critic = V2MLP(
         units=critic_cfg.dense_units,
         layers=critic_cfg.mlp_layers,
         output_dim=1,
         act=critic_cfg.get("dense_act", "elu"),
+        dtype=compute_dtype,
     )
 
     B = 1

@@ -43,13 +43,14 @@ class DenseActLn(nn.Module):
     units: int
     act: Any = "elu"
     layer_norm: bool = False
+    dtype: Any = jnp.float32  # compute dtype; params f32, LN statistics f32
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
-        x = nn.Dense(self.units, kernel_init=xavier_init)(x)
+        x = nn.Dense(self.units, kernel_init=xavier_init, dtype=self.dtype)(x)
         if self.layer_norm:
             x = nn.LayerNorm()(x)
-        return resolve_activation(self.act)(x)
+        return resolve_activation(self.act)(x.astype(self.dtype))
 
 
 class V2MLP(nn.Module):
@@ -60,13 +61,15 @@ class V2MLP(nn.Module):
     output_dim: Optional[int] = None
     act: Any = "elu"
     layer_norm: bool = False
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         for _ in range(self.layers):
-            x = DenseActLn(self.units, self.act, self.layer_norm)(x)
+            x = DenseActLn(self.units, self.act, self.layer_norm, dtype=self.dtype)(x)
         if self.output_dim is not None:
-            x = nn.Dense(self.output_dim, kernel_init=xavier_init)(x)
+            # heads emit f32 for the downstream distributions
+            x = nn.Dense(self.output_dim, kernel_init=xavier_init)(x.astype(jnp.float32))
         return x
 
 
@@ -78,6 +81,7 @@ class CNNEncoder(nn.Module):
     channels_multiplier: int
     layer_norm: bool = False
     act: Any = "elu"
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, obs: Dict[str, jax.Array]) -> jax.Array:
@@ -89,10 +93,11 @@ class CNNEncoder(nn.Module):
                 strides=(2, 2),
                 padding="VALID",
                 kernel_init=xavier_init,
+                dtype=self.dtype,
             )(x)
             if self.layer_norm:
                 x = nn.LayerNorm()(x)
-            x = resolve_activation(self.act)(x)
+            x = resolve_activation(self.act)(x.astype(self.dtype))
         return x.reshape(*x.shape[:-3], -1)
 
 
@@ -102,11 +107,12 @@ class MLPEncoder(nn.Module):
     dense_units: int = 400
     layer_norm: bool = False
     act: Any = "elu"
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, obs: Dict[str, jax.Array]) -> jax.Array:
         x = jnp.concatenate([obs[k] for k in self.keys], -1)
-        return V2MLP(self.dense_units, self.mlp_layers, None, self.act, self.layer_norm)(x)
+        return V2MLP(self.dense_units, self.mlp_layers, None, self.act, self.layer_norm, dtype=self.dtype)(x)
 
 
 class MultiEncoderV2(nn.Module):
@@ -132,21 +138,24 @@ class CNNDecoder(nn.Module):
     cnn_encoder_output_dim: int
     layer_norm: bool = False
     act: Any = "elu"
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, latent: jax.Array) -> Dict[str, jax.Array]:
         lead = latent.shape[:-1]
-        x = nn.Dense(self.cnn_encoder_output_dim, kernel_init=xavier_init)(latent)
+        x = nn.Dense(self.cnn_encoder_output_dim, kernel_init=xavier_init, dtype=self.dtype)(latent)
         x = x.reshape(-1, 1, 1, self.cnn_encoder_output_dim)
         chans = [4 * self.channels_multiplier, 2 * self.channels_multiplier, self.channels_multiplier]
         kernels = [5, 5, 6, 6]
         for i, ch in enumerate(chans):
             x = nn.ConvTranspose(
-                ch, (kernels[i], kernels[i]), strides=(2, 2), padding="VALID", kernel_init=xavier_init
+                ch, (kernels[i], kernels[i]), strides=(2, 2), padding="VALID", kernel_init=xavier_init,
+                dtype=self.dtype,
             )(x)
             if self.layer_norm:
                 x = nn.LayerNorm()(x)
-            x = resolve_activation(self.act)(x)
+            x = resolve_activation(self.act)(x.astype(self.dtype))
+        x = x.astype(jnp.float32)  # final deconv emits f32 for the dists
         x = nn.ConvTranspose(
             int(sum(self.output_channels)),
             (kernels[-1], kernels[-1]),
@@ -170,10 +179,12 @@ class MLPDecoder(nn.Module):
     dense_units: int = 400
     layer_norm: bool = False
     act: Any = "elu"
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, latent: jax.Array) -> Dict[str, jax.Array]:
-        x = V2MLP(self.dense_units, self.mlp_layers, None, self.act, self.layer_norm)(latent)
+        x = V2MLP(self.dense_units, self.mlp_layers, None, self.act, self.layer_norm, dtype=self.dtype)(latent)
+        x = x.astype(jnp.float32)
         return {
             k: nn.Dense(d, kernel_init=xavier_init)(x) for k, d in zip(self.keys, self.output_dims)
         }
@@ -200,14 +211,16 @@ class RecurrentModel(nn.Module):
     dense_units: int
     layer_norm: bool = False  # LN of the pre-GRU MLP only
     act: Any = "elu"
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, inp: jax.Array, recurrent_state: jax.Array) -> jax.Array:
-        feat = DenseActLn(self.dense_units, self.act, self.layer_norm)(inp)
+        feat = DenseActLn(self.dense_units, self.act, self.layer_norm, dtype=self.dtype)(inp)
         new_h, _ = LayerNormGRUCell(
-            hidden_size=self.recurrent_state_size, use_bias=True, layer_norm=True
+            hidden_size=self.recurrent_state_size, use_bias=True, layer_norm=True,
+            dtype=self.dtype,
         )(recurrent_state, feat)
-        return new_h
+        return new_h.astype(jnp.float32)
 
 
 def compute_stochastic_state(
@@ -235,6 +248,7 @@ class RSSM(nn.Module):
     layer_norm: bool = False
     recurrent_layer_norm: bool = False
     act: Any = "elu"
+    dtype: Any = jnp.float32
 
     def setup(self) -> None:
         stoch = self.stochastic_size * self.discrete_size
@@ -243,12 +257,13 @@ class RSSM(nn.Module):
             dense_units=self.dense_units,
             layer_norm=self.recurrent_layer_norm,
             act=self.act,
+            dtype=self.dtype,
         )
         self.representation_model = V2MLP(
-            self.representation_hidden_size, 1, stoch, self.act, self.layer_norm
+            self.representation_hidden_size, 1, stoch, self.act, self.layer_norm, dtype=self.dtype
         )
         self.transition_model = V2MLP(
-            self.transition_hidden_size, 1, stoch, self.act, self.layer_norm
+            self.transition_hidden_size, 1, stoch, self.act, self.layer_norm, dtype=self.dtype
         )
 
     def recurrent_step(self, inp: jax.Array, recurrent_state: jax.Array) -> jax.Array:
@@ -309,6 +324,7 @@ class Actor(nn.Module):
     mlp_layers: int = 4
     layer_norm: bool = False
     act: Any = "elu"
+    dtype: Any = jnp.float32
 
     def _dist_name(self) -> str:
         d = self.distribution.lower()
@@ -326,7 +342,8 @@ class Actor(nn.Module):
     ):
         x = state
         for _ in range(self.mlp_layers):
-            x = DenseActLn(self.dense_units, self.act, self.layer_norm)(x)
+            x = DenseActLn(self.dense_units, self.act, self.layer_norm, dtype=self.dtype)(x)
+        x = x.astype(jnp.float32)  # dist heads in f32
         if self.is_continuous:
             pre = nn.Dense(int(np.sum(self.actions_dim)) * 2, kernel_init=xavier_init)(x)
             mean, std = jnp.split(pre, 2, -1)
@@ -555,6 +572,9 @@ def build_agent(
     cnn_act = world_model_cfg.encoder.get("cnn_act", "elu")
     dense_act = world_model_cfg.encoder.get("dense_act", "elu")
     enc_ln = bool(world_model_cfg.encoder.layer_norm)
+    # fabric.precision policy: trunks compute in bf16 under *-mixed/true,
+    # heads/LN statistics/scan carries stay f32 (same split as DV3)
+    compute_dtype = runtime.compute_dtype
 
     cnn_encoder = (
         CNNEncoder(
@@ -562,6 +582,7 @@ def build_agent(
             channels_multiplier=world_model_cfg.encoder.cnn_channels_multiplier,
             layer_norm=enc_ln,
             act=cnn_act,
+            dtype=compute_dtype,
         )
         if len(cnn_keys) > 0
         else None
@@ -573,6 +594,7 @@ def build_agent(
             dense_units=world_model_cfg.encoder.dense_units,
             layer_norm=enc_ln,
             act=dense_act,
+            dtype=compute_dtype,
         )
         if len(mlp_keys) > 0
         else None
@@ -607,6 +629,7 @@ def build_agent(
         layer_norm=bool(world_model_cfg.representation_model.layer_norm),
         recurrent_layer_norm=bool(world_model_cfg.recurrent_model.layer_norm),
         act=dense_act,
+        dtype=compute_dtype,
     )
 
     cnn_decoder = (
@@ -617,6 +640,7 @@ def build_agent(
             cnn_encoder_output_dim=cnn_encoder_output_dim,
             layer_norm=bool(world_model_cfg.observation_model.layer_norm),
             act=cnn_act,
+            dtype=compute_dtype,
         )
         if len(cfg.algo.cnn_keys.decoder) > 0
         else None
@@ -629,6 +653,7 @@ def build_agent(
             dense_units=world_model_cfg.observation_model.dense_units,
             layer_norm=bool(world_model_cfg.observation_model.layer_norm),
             act=dense_act,
+            dtype=compute_dtype,
         )
         if len(cfg.algo.mlp_keys.decoder) > 0
         else None
@@ -641,6 +666,7 @@ def build_agent(
         output_dim=1,
         act=dense_act,
         layer_norm=bool(world_model_cfg.reward_model.layer_norm),
+        dtype=compute_dtype,
     )
     continue_model = (
         V2MLP(
@@ -649,6 +675,7 @@ def build_agent(
             output_dim=1,
             act=dense_act,
             layer_norm=bool(world_model_cfg.discount_model.layer_norm),
+            dtype=compute_dtype,
         )
         if use_continues
         else None
@@ -665,6 +692,7 @@ def build_agent(
         mlp_layers=actor_cfg.mlp_layers,
         layer_norm=bool(actor_cfg.layer_norm),
         act=actor_cfg.get("dense_act", "elu"),
+        dtype=compute_dtype,
     )
     critic = V2MLP(
         units=critic_cfg.dense_units,
@@ -672,6 +700,7 @@ def build_agent(
         output_dim=1,
         act=critic_cfg.get("dense_act", "elu"),
         layer_norm=bool(critic_cfg.layer_norm),
+        dtype=compute_dtype,
     )
 
     # ------------------------------------------------------------- init

@@ -225,7 +225,9 @@ class LayerNormGRUCell(nn.Module):
             param_dtype=self.param_dtype,
         )
         ln = (
-            nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype)
+            # f32 output on purpose: the gates and convex state update
+            # downstream must stay f32 (same split as the fused kernel)
+            nn.LayerNorm(param_dtype=self.param_dtype)
             if self.layer_norm
             else None
         )

@@ -1,0 +1,40 @@
+"""Mixed-precision policy of the recurrent cells: bf16 contractions, f32
+LayerNorm/gates/carry — bf16 outputs must track f32 closely."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.models.models import LayerNormGRUCell
+
+
+def test_layernorm_gru_bf16_tracks_f32():
+    b, hidden, xdim = 4, 128, 128
+    rng = np.random.default_rng(0)
+    h = jnp.asarray(rng.normal(size=(b, hidden)), jnp.float32)
+    x = jnp.asarray(rng.normal(size=(b, xdim)), jnp.float32)
+
+    f32_cell = LayerNormGRUCell(hidden_size=hidden)
+    bf16_cell = LayerNormGRUCell(hidden_size=hidden, dtype=jnp.bfloat16)
+    params = f32_cell.init(jax.random.PRNGKey(0), h, x)
+
+    out32, _ = f32_cell.apply(params, h, x)
+    out16, _ = bf16_cell.apply(params, h, x)
+    # carry stays f32 under the mixed policy
+    assert out16.dtype == jnp.float32
+    # only the contraction ran in bf16 -> small relative error
+    np.testing.assert_allclose(np.asarray(out16), np.asarray(out32), rtol=0.05, atol=0.02)
+
+
+def test_layernorm_gru_bf16_fused_matches_unfused():
+    b, hidden, xdim = 4, 128, 128
+    rng = np.random.default_rng(1)
+    h = jnp.asarray(rng.normal(size=(b, hidden)), jnp.float32)
+    x = jnp.asarray(rng.normal(size=(b, xdim)), jnp.float32)
+    unfused = LayerNormGRUCell(hidden_size=hidden, dtype=jnp.bfloat16)
+    fused = LayerNormGRUCell(hidden_size=hidden, dtype=jnp.bfloat16, fused=True)
+    params = unfused.init(jax.random.PRNGKey(0), h, x)
+    a, _ = unfused.apply(params, h, x)
+    b_, _ = fused.apply(params, h, x)
+    # both paths: bf16 contraction, f32 LN/gates/update
+    np.testing.assert_allclose(np.asarray(b_), np.asarray(a), rtol=0.02, atol=0.01)
