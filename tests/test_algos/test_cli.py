@@ -150,3 +150,46 @@ def test_resume_from_checkpoint_algo_error(tmp_path):
 def test_evaluate(tmp_path):
     ckpt = _train_and_get_ckpt(tmp_path, root="cli_ppo_eval")
     evaluation([f"checkpoint_path={ckpt}", "env.capture_video=False", "fabric.accelerator=cpu"])
+
+
+def _sac_args(tmp_path, root="cli_sac"):
+    return [
+        "exp=sac",
+        "env=dummy",
+        "env.id=dummy_continuous",
+        "env.num_envs=2",
+        "env.sync_env=True",
+        "env.capture_video=False",
+        "fabric.accelerator=cpu",
+        "fabric.devices=1",
+        "metric.log_level=0",
+        "checkpoint.save_last=True",
+        "buffer.memmap=False",
+        "buffer.size=64",
+        "seed=0",
+        "algo.total_steps=16",
+        "algo.learning_starts=4",
+        "algo.per_rank_batch_size=4",
+        "algo.hidden_size=8",
+        "algo.dispatch_batch=4",
+        "algo.mlp_keys.encoder=[state]",
+        f"root_dir={tmp_path}/{root}",
+    ]
+
+
+def test_sac_resume_with_dispatch_batch(tmp_path):
+    """Resume restores the undispatched gradient-step window
+    (pending_iters) saved by algo.dispatch_batch>1: a mid-run checkpoint
+    (checkpoint.every=4 < dispatch_batch window) lands while pending
+    steps are accumulated, and the numerically-latest checkpoint resumes."""
+    import re
+
+    run(_sac_args(tmp_path) + ["checkpoint.every=4"])
+    ckpts = glob.glob(f"{tmp_path}/cli_sac/**/ckpt_*.ckpt", recursive=True)
+    assert ckpts
+    by_step = sorted(ckpts, key=lambda p: int(re.search(r"ckpt_(\d+)_", p).group(1)))
+    # a mid-run checkpoint must carry a NON-empty pending window
+    from sheeprl_tpu.utils.callback import load_checkpoint
+
+    assert any(load_checkpoint(c).get("pending_iters") for c in by_step[:-1])
+    run(_sac_args(tmp_path) + [f"checkpoint.resume_from={by_step[-1]}", "algo.total_steps=24"])
