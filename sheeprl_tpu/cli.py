@@ -129,11 +129,10 @@ def run_algorithm(cfg: dotdict) -> None:
     from sheeprl_tpu.utils.metric import MetricAggregator
     from sheeprl_tpu.utils.timer import timer
 
-    if cfg.metric.log_level == 0:
-        MetricAggregator.disabled = True
-        timer.disabled = True
-    if cfg.metric.get("disable_timer", False):
-        timer.disabled = True
+    # set both ways: these are class-level flags, and a previous in-process
+    # run (tests, notebooks) may have disabled them
+    MetricAggregator.disabled = cfg.metric.log_level == 0
+    timer.disabled = cfg.metric.log_level == 0 or bool(cfg.metric.get("disable_timer", False))
 
     runtime = _build_runtime(cfg)
     entry_fn = getattr(algo_module, entrypoint)

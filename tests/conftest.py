@@ -34,3 +34,16 @@ def _no_env_leaks():
         if k not in before:
             del os.environ[k]
     os.environ.update(before)
+
+
+@pytest.fixture(autouse=True)
+def _reset_metric_globals():
+    """timer/MetricAggregator disabled are CLASS-level flags the CLI sets
+    per run; reset them so one test's metric.log_level=0 cannot leak into
+    another's assertions."""
+    from sheeprl_tpu.utils.metric import MetricAggregator
+    from sheeprl_tpu.utils.timer import timer
+
+    before = (timer.disabled, MetricAggregator.disabled)
+    yield
+    timer.disabled, MetricAggregator.disabled = before
