@@ -70,11 +70,27 @@ def build(fused: bool, precision: str):
     return runtime, train_fn, params, opt_states, moments, data, (T, B)
 
 
-def time_variant(fused: bool, precision: str, steps: int):
-    """Returns (seconds_per_step, T, B) for the timed configuration."""
+def time_variant(
+    fused: bool,
+    precision: str,
+    steps: int,
+    cost_analysis: bool = False,
+    sync_every_step: bool = True,
+):
+    """Returns (seconds_per_step, T, B, extras) for the timed configuration.
+
+    ``sync_every_step=False`` times the loop the way the training CLI runs
+    it — chained async dispatches with a single trailing host sync — which
+    amortizes the per-call round-trip of remote-device links (the axon
+    tunnel's ~0.1 s RTT otherwise dominates a ~25 ms on-device step).
+    ``extras["flops_per_step"]`` (XLA cost analysis of the compiled step,
+    for MFU computation) is populated when ``cost_analysis=True`` and the
+    backend supports it.
+    """
     import jax
 
     runtime, train_fn, params, opt_states, moments, data, (T, B) = build(fused, precision)
+    extras = {}
     # Place ALL carried state on the mesh up front: feeding unsharded arrays
     # into the first call and mesh-sharded outputs into the next changes the
     # input avals and forces a full Python retrace per call — which once
@@ -93,17 +109,34 @@ def time_variant(fused: bool, precision: str, steps: int):
         params, opt_states, moments, metrics = train_fn(
             params, opt_states, moments, data, runtime.next_key()
         )
-        # host-fetch a scalar: block_until_ready alone under-syncs on some
-        # remote-device platforms
+        if sync_every_step:
+            # host-fetch a scalar: block_until_ready alone under-syncs on
+            # some remote-device platforms
+            float(jax.tree_util.tree_leaves(metrics)[0])
+    if not sync_every_step:
         float(jax.tree_util.tree_leaves(metrics)[0])
     dt = (time.perf_counter() - tic) / steps
     frames = T * B / dt
+    if cost_analysis:
+        try:
+            jitted = getattr(train_fn, "_jitted", None)
+            if jitted is not None:
+                with jax.set_mesh(runtime.mesh):
+                    compiled = jitted.lower(
+                        params, opt_states, moments, data, runtime.next_key()
+                    ).compile()
+                ca = compiled.cost_analysis()
+                if isinstance(ca, (list, tuple)):
+                    ca = ca[0]
+                extras["flops_per_step"] = float(ca.get("flops", 0.0)) or None
+        except Exception as e:  # cost analysis is best-effort on tunnel backends
+            print(f"cost_analysis unavailable: {e}", file=sys.stderr)
     print(
         f"fused={fused} precision={precision}: {dt * 1e3:.1f} ms/step, "
         f"{frames:,.0f} replayed frames/s (T={T}, B={B})",
         file=sys.stderr,
     )
-    return dt, T, B
+    return dt, T, B, extras
 
 
 if __name__ == "__main__":
@@ -113,8 +146,8 @@ if __name__ == "__main__":
     ap.add_argument("--fused", default="both", choices=["both", "true", "false"])
     args = ap.parse_args()
     if args.fused in ("false", "both"):
-        base, _, _ = time_variant(False, args.precision, args.steps)
+        base, _, _, _ = time_variant(False, args.precision, args.steps)
     if args.fused in ("true", "both"):
-        fused, _, _ = time_variant(True, args.precision, args.steps)
+        fused, _, _, _ = time_variant(True, args.precision, args.steps)
     if args.fused == "both":
         print(f"speedup fused/unfused: {base / fused:.3f}x")
