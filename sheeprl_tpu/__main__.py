@@ -1,0 +1,6 @@
+"""``python -m sheeprl_tpu`` entry point (reference sheeprl/__main__.py:1-4)."""
+
+from sheeprl_tpu.cli import run
+
+if __name__ == "__main__":
+    run()

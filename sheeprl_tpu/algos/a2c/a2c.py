@@ -30,6 +30,7 @@ from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import device_get_metrics, gae, normalize_tensor, polynomial_decay, save_configs
+from sheeprl_tpu.optim import restore_opt_states
 
 
 def make_update_fn(runtime, module, tx, cfg: Dict[str, Any], obs_keys: Sequence[str]):
@@ -137,12 +138,12 @@ def main(runtime, cfg: Dict[str, Any]):
     module, params = build_agent(
         runtime, actions_dim, is_continuous, cfg, observation_space, state["agent"] if state else None
     )
-    params = runtime.replicate(params)
-    tx = build_ppo_optimizer(cfg.algo.optimizer, cfg.algo.max_grad_norm)
+    params = runtime.replicate(runtime.to_param_dtype(params))
+    tx = build_ppo_optimizer(cfg.algo.optimizer, cfg.algo.max_grad_norm, runtime.precision)
     opt_state = (
         runtime.replicate(tx.init(params))
         if state is None
-        else jax.tree_util.tree_map(jnp.asarray, state["optimizer"])
+        else restore_opt_states(state["optimizer"], params, runtime.precision)
     )
     player = PPOPlayer(
         module,

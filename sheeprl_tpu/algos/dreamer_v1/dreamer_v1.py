@@ -38,6 +38,7 @@ from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import MetricFetchGate, device_get_metrics, Ratio, save_configs
+from sheeprl_tpu.optim import restore_opt_states
 
 sg = jax.lax.stop_gradient
 
@@ -315,13 +316,14 @@ def main(runtime, cfg: Dict[str, Any]):
         state["actor"] if state else None,
         state["critic"] if state else None,
     )
-    params = runtime.replicate(params)
+    params = runtime.replicate(runtime.to_param_dtype(params))
 
-    wm_tx = _make_optimizer(cfg.algo.world_model.optimizer, cfg.algo.world_model.clip_gradients)
-    actor_tx = _make_optimizer(cfg.algo.actor.optimizer, cfg.algo.actor.clip_gradients)
-    critic_tx = _make_optimizer(cfg.algo.critic.optimizer, cfg.algo.critic.clip_gradients)
+    precision = runtime.precision
+    wm_tx = _make_optimizer(cfg.algo.world_model.optimizer, cfg.algo.world_model.clip_gradients, precision)
+    actor_tx = _make_optimizer(cfg.algo.actor.optimizer, cfg.algo.actor.clip_gradients, precision)
+    critic_tx = _make_optimizer(cfg.algo.critic.optimizer, cfg.algo.critic.clip_gradients, precision)
     if state is not None:
-        opt_states = jax.tree_util.tree_map(jnp.asarray, state["opt_states"])
+        opt_states = restore_opt_states(state["opt_states"], params, runtime.precision)
     else:
         opt_states = runtime.replicate(
             {

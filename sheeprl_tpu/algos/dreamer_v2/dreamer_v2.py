@@ -52,14 +52,15 @@ from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import MetricFetchGate, device_get_metrics, Ratio, save_configs
+from sheeprl_tpu.optim import restore_opt_states
 
 sg = jax.lax.stop_gradient
 
 
-def _make_optimizer(optim_cfg, clip_gradients):
+def _make_optimizer(optim_cfg, clip_gradients, precision="32-true"):
     from sheeprl_tpu.optim import build_optimizer
 
-    return build_optimizer(optim_cfg, clip_gradients)
+    return build_optimizer(optim_cfg, clip_gradients, precision)
 
 
 def make_train_fn(runtime, world_model, actor, critic, txs, cfg, is_continuous, actions_dim):
@@ -385,13 +386,18 @@ def main(runtime, cfg: Dict[str, Any]):
         state["critic"] if state else None,
         state["target_critic"] if state else None,
     )
-    params = runtime.replicate(params)
+    # no f32 carve-out for the target critic: DV2 HARD-updates it (a
+    # wholesale copy of the bf16 critic every ``hard_update_freq`` steps,
+    # including step 0), so bf16 storage loses nothing — unlike the
+    # EMA targets in DV3/SAC
+    params = runtime.replicate(runtime.to_param_dtype(params))
 
-    wm_tx = _make_optimizer(cfg.algo.world_model.optimizer, cfg.algo.world_model.clip_gradients)
-    actor_tx = _make_optimizer(cfg.algo.actor.optimizer, cfg.algo.actor.clip_gradients)
-    critic_tx = _make_optimizer(cfg.algo.critic.optimizer, cfg.algo.critic.clip_gradients)
+    precision = runtime.precision
+    wm_tx = _make_optimizer(cfg.algo.world_model.optimizer, cfg.algo.world_model.clip_gradients, precision)
+    actor_tx = _make_optimizer(cfg.algo.actor.optimizer, cfg.algo.actor.clip_gradients, precision)
+    critic_tx = _make_optimizer(cfg.algo.critic.optimizer, cfg.algo.critic.clip_gradients, precision)
     if state is not None:
-        opt_states = jax.tree_util.tree_map(jnp.asarray, state["opt_states"])
+        opt_states = restore_opt_states(state["opt_states"], params, runtime.precision)
     else:
         opt_states = runtime.replicate(
             {

@@ -57,14 +57,15 @@ from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import MetricFetchGate, device_get_metrics, Ratio, save_configs
+from sheeprl_tpu.optim import restore_opt_states
 
 sg = jax.lax.stop_gradient
 
 
-def _make_optimizer(optim_cfg, clip_gradients):
+def _make_optimizer(optim_cfg, clip_gradients, precision="32-true"):
     from sheeprl_tpu.optim import build_optimizer
 
-    return build_optimizer(optim_cfg, clip_gradients)
+    return build_optimizer(optim_cfg, clip_gradients, precision)
 
 
 def make_train_fn(runtime, world_model, actor, critic, txs, cfg, is_continuous, actions_dim):
@@ -466,13 +467,17 @@ def main(runtime, cfg: Dict[str, Any]):
         state["critic"] if state else None,
         state["target_critic"] if state else None,
     )
-    params = runtime.replicate(params)
+    # bf16-true: bf16 parameter storage (the EMA target keeps f32 — its
+    # small per-step updates would drown in bf16 rounding); the optimizers
+    # below hold the f32 master copy (optim.master_weights)
+    params = runtime.replicate(runtime.to_param_dtype(params, exclude=("target_critic",)))
 
-    wm_tx = _make_optimizer(cfg.algo.world_model.optimizer, cfg.algo.world_model.clip_gradients)
-    actor_tx = _make_optimizer(cfg.algo.actor.optimizer, cfg.algo.actor.clip_gradients)
-    critic_tx = _make_optimizer(cfg.algo.critic.optimizer, cfg.algo.critic.clip_gradients)
+    precision = runtime.precision
+    wm_tx = _make_optimizer(cfg.algo.world_model.optimizer, cfg.algo.world_model.clip_gradients, precision)
+    actor_tx = _make_optimizer(cfg.algo.actor.optimizer, cfg.algo.actor.clip_gradients, precision)
+    critic_tx = _make_optimizer(cfg.algo.critic.optimizer, cfg.algo.critic.clip_gradients, precision)
     if state is not None:
-        opt_states = jax.tree_util.tree_map(jnp.asarray, state["opt_states"])
+        opt_states = restore_opt_states(state["opt_states"], params, runtime.precision)
         moments_state = jax.tree_util.tree_map(jnp.asarray, state["moments"])
     else:
         opt_states = runtime.replicate(

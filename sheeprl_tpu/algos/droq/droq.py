@@ -31,6 +31,7 @@ from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import MetricFetchGate, device_get_metrics, Ratio, save_configs
+from sheeprl_tpu.optim import restore_opt_states
 
 
 def make_train_fn(runtime, actor, critic, txs, cfg: Dict[str, Any], target_entropy: float):
@@ -145,12 +146,16 @@ def main(runtime, cfg: Dict[str, Any]):
     actor, critic, params, target_entropy = build_agent(
         runtime, cfg, observation_space, action_space, state["agent"] if state else None
     )
-    params = runtime.replicate(params)
-    actor_tx = _make_optimizer(cfg.algo.actor.optimizer)
-    critic_tx = _make_optimizer(cfg.algo.critic.optimizer)
-    alpha_tx = _make_optimizer(cfg.algo.alpha.optimizer)
+    params = runtime.replicate(
+        runtime.to_param_dtype(params, exclude=("target_critic", "log_alpha"))
+    )
+    actor_tx = _make_optimizer(cfg.algo.actor.optimizer, runtime.precision)
+    critic_tx = _make_optimizer(cfg.algo.critic.optimizer, runtime.precision)
+    alpha_tx = _make_optimizer(cfg.algo.alpha.optimizer, runtime.precision)
     if state is not None:
-        opt_states = jax.tree_util.tree_map(jnp.asarray, state["opt_states"])
+        opt_states = restore_opt_states(
+            state["opt_states"], params, runtime.precision, key_map={"alpha": "log_alpha"}
+        )
     else:
         opt_states = runtime.replicate(
             {

@@ -38,6 +38,7 @@ from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import MetricFetchGate, device_get_metrics, Ratio, dotdict, save_configs
+from sheeprl_tpu.optim import restore_opt_states
 
 
 def _load_exploration_cfg(ckpt_path: str) -> dotdict:
@@ -122,25 +123,29 @@ def main(runtime, cfg: Dict[str, Any]):
         state.get("critic_exploration"),
         state.get("target_critic_exploration"),
     )
-    params = runtime.replicate(params)
+    # no f32 carve-out for the target critics: DV2-style HARD updates
+    # (wholesale copies of the bf16 critics, including step 0) make bf16
+    # target storage lossless
+    params = runtime.replicate(runtime.to_param_dtype(params))
+    precision = runtime.precision
 
-    wm_tx = _make_optimizer(cfg.algo.world_model.optimizer, cfg.algo.world_model.clip_gradients)
-    actor_tx = _make_optimizer(cfg.algo.actor.optimizer, cfg.algo.actor.clip_gradients)
-    critic_tx = _make_optimizer(cfg.algo.critic.optimizer, cfg.algo.critic.clip_gradients)
+    wm_tx = _make_optimizer(cfg.algo.world_model.optimizer, cfg.algo.world_model.clip_gradients, precision)
+    actor_tx = _make_optimizer(cfg.algo.actor.optimizer, cfg.algo.actor.clip_gradients, precision)
+    critic_tx = _make_optimizer(cfg.algo.critic.optimizer, cfg.algo.critic.clip_gradients, precision)
     saved_opt = state.get("opt_states", {})
     opt_states = {
         "world_model": (
-            jax.tree_util.tree_map(jnp.asarray, saved_opt["world_model"])
+            restore_opt_states(saved_opt["world_model"], params["world_model"], runtime.precision)
             if "world_model" in saved_opt
             else runtime.replicate(wm_tx.init(params["world_model"]))
         ),
         "actor": (
-            jax.tree_util.tree_map(jnp.asarray, saved_opt["actor_task"])
+            restore_opt_states(saved_opt["actor_task"], params["actor_task"], runtime.precision)
             if "actor_task" in saved_opt
             else runtime.replicate(actor_tx.init(params["actor_task"]))
         ),
         "critic": (
-            jax.tree_util.tree_map(jnp.asarray, saved_opt["critic_task"])
+            restore_opt_states(saved_opt["critic_task"], params["critic_task"], runtime.precision)
             if "critic_task" in saved_opt
             else runtime.replicate(critic_tx.init(params["critic_task"]))
         ),

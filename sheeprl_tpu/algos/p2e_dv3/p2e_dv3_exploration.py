@@ -55,6 +55,7 @@ from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import MetricFetchGate, device_get_metrics, Ratio, save_configs
+from sheeprl_tpu.optim import restore_opt_states
 
 sg = jax.lax.stop_gradient
 
@@ -553,19 +554,30 @@ def main(runtime, cfg: Dict[str, Any]):
         state["actor_exploration"] if state else None,
         state["critics_exploration"] if state else None,
     )
-    params = runtime.replicate(params)
+    # the trainable exploration critics get bf16 storage like everything
+    # else; only their nested EMA target_module subtrees stay f32
+    params = runtime.replicate(
+        runtime.to_param_dtype(params, exclude=("target_critic_task", "target_module"))
+    )
+    precision = runtime.precision
 
-    wm_tx = _make_optimizer(cfg.algo.world_model.optimizer, cfg.algo.world_model.clip_gradients)
-    ens_tx = _make_optimizer(cfg.algo.ensembles.optimizer, cfg.algo.ensembles.clip_gradients)
-    actor_task_tx = _make_optimizer(cfg.algo.actor.optimizer, cfg.algo.actor.clip_gradients)
-    critic_task_tx = _make_optimizer(cfg.algo.critic.optimizer, cfg.algo.critic.clip_gradients)
-    actor_expl_tx = _make_optimizer(cfg.algo.actor.optimizer, cfg.algo.actor.clip_gradients)
+    wm_tx = _make_optimizer(cfg.algo.world_model.optimizer, cfg.algo.world_model.clip_gradients, precision)
+    ens_tx = _make_optimizer(cfg.algo.ensembles.optimizer, cfg.algo.ensembles.clip_gradients, precision)
+    actor_task_tx = _make_optimizer(cfg.algo.actor.optimizer, cfg.algo.actor.clip_gradients, precision)
+    critic_task_tx = _make_optimizer(cfg.algo.critic.optimizer, cfg.algo.critic.clip_gradients, precision)
+    actor_expl_tx = _make_optimizer(cfg.algo.actor.optimizer, cfg.algo.actor.clip_gradients, precision)
     critics_expl_txs = {
-        name: _make_optimizer(cfg.algo.critic.optimizer, cfg.algo.critic.clip_gradients)
+        name: _make_optimizer(cfg.algo.critic.optimizer, cfg.algo.critic.clip_gradients, precision)
         for name in critics_cfg
     }
     if state is not None:
-        opt_states = jax.tree_util.tree_map(jnp.asarray, state["opt_states"])
+        params_for_opt = {
+            **params,
+            "critics_exploration": {
+                n: p["module"] for n, p in params["critics_exploration"].items()
+            },
+        }
+        opt_states = restore_opt_states(state["opt_states"], params_for_opt, runtime.precision)
         moments_task = jax.tree_util.tree_map(jnp.asarray, state["moments_task"])
         moments_expl = jax.tree_util.tree_map(jnp.asarray, state["moments_exploration"])
     else:

@@ -44,23 +44,22 @@ from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import device_get_metrics, gae, normalize_tensor, polynomial_decay, print_config, save_configs
+from sheeprl_tpu.optim import restore_opt_states
 
 
-def build_ppo_optimizer(optim_cfg: Dict[str, Any], max_grad_norm: float) -> optax.GradientTransformation:
+def build_ppo_optimizer(
+    optim_cfg: Dict[str, Any], max_grad_norm: float, precision: str = "32-true"
+) -> optax.GradientTransformation:
     """optax optimizer with injectable learning_rate (for annealing inside
     jit) and optional global-norm clipping."""
-    from sheeprl_tpu.optim import normalize_optim_kwargs, resolve_weight_decay
+    from sheeprl_tpu.optim import finalize_optimizer, normalize_optim_kwargs, resolve_weight_decay
 
     cfg = dict(optim_cfg)
     base_fn = _locate(cfg.pop("_target_"))
     kwargs = normalize_optim_kwargs(cfg)
     wd = resolve_weight_decay(kwargs, base_fn)
     tx = optax.inject_hyperparams(base_fn)(**kwargs)
-    if wd:
-        tx = optax.chain(optax.add_decayed_weights(wd), tx)
-    if max_grad_norm and max_grad_norm > 0:
-        tx = optax.chain(optax.clip_by_global_norm(float(max_grad_norm)), tx)
-    return tx
+    return finalize_optimizer(tx, wd, max_grad_norm, precision)
 
 
 def rank_local_perm(key, n_total, n_envs, world_size, mb_size, num_minibatches):
@@ -105,6 +104,20 @@ def make_update_fn(
     cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
     update_epochs = int(cfg.algo.update_epochs)
     share_data = bool(cfg.buffer.get("share_data", False))
+    if (
+        not share_data
+        and runtime.world_size > 1
+        and int(cfg.env.num_envs) % runtime.world_size != 0
+    ):
+        import warnings
+
+        warnings.warn(
+            f"buffer.share_data=False requests rank-local (DDP-style) minibatches, but "
+            f"env.num_envs={cfg.env.num_envs} is not divisible by world_size="
+            f"{runtime.world_size}: falling back to a GLOBAL epoch shuffle "
+            f"(equivalent to share_data=True). Make num_envs divisible to keep "
+            f"rank-local semantics."
+        )
     world_size = int(runtime.world_size)
     mb_size = int(cfg.algo.per_rank_batch_size) * runtime.world_size
     gamma = float(cfg.algo.gamma)
@@ -190,7 +203,11 @@ def make_update_fn(
 
 def _set_lr(opt_state, lr):
     """Override learning_rate inside an InjectHyperparamsState (possibly
-    nested in an optax.chain tuple)."""
+    nested in an optax.chain tuple or a bf16-true MasterWeightsState)."""
+    from sheeprl_tpu.optim import MasterWeightsState
+
+    if isinstance(opt_state, MasterWeightsState):
+        return opt_state._replace(inner=_set_lr(opt_state.inner, lr))
     if hasattr(opt_state, "hyperparams") and "learning_rate" in opt_state.hyperparams:
         hp = dict(opt_state.hyperparams)
         hp["learning_rate"] = jnp.asarray(lr, dtype=jnp.asarray(hp["learning_rate"]).dtype)
@@ -276,10 +293,12 @@ def main(runtime, cfg: Dict[str, Any]):
         observation_space,
         state["agent"] if state else None,
     )
-    params = runtime.replicate(params)
-    tx = build_ppo_optimizer(cfg.algo.optimizer, cfg.algo.max_grad_norm)
-    opt_state = runtime.replicate(tx.init(params)) if state is None else jax.tree_util.tree_map(
-        jnp.asarray, state["optimizer"]
+    params = runtime.replicate(runtime.to_param_dtype(params))
+    tx = build_ppo_optimizer(cfg.algo.optimizer, cfg.algo.max_grad_norm, runtime.precision)
+    opt_state = (
+        runtime.replicate(tx.init(params))
+        if state is None
+        else restore_opt_states(state["optimizer"], params, runtime.precision)
     )
 
     def _prep(obs):

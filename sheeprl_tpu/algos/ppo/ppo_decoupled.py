@@ -46,6 +46,7 @@ from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.optim import restore_opt_states
 from sheeprl_tpu.utils.utils import device_get_metrics, polynomial_decay, save_configs
 
 # generous IPC timeout: the first trainer reply waits on a fresh XLA
@@ -359,12 +360,12 @@ def main(runtime, cfg: Dict[str, Any]):
             observation_space,
             state["agent"] if state else None,
         )
-        params = runtime.replicate(params)
-        tx = build_ppo_optimizer(cfg.algo.optimizer, cfg.algo.max_grad_norm)
+        params = runtime.replicate(runtime.to_param_dtype(params))
+        tx = build_ppo_optimizer(cfg.algo.optimizer, cfg.algo.max_grad_norm, runtime.precision)
         opt_state = (
             runtime.replicate(tx.init(params))
             if state is None
-            else jax.tree_util.tree_map(jnp.asarray, state["optimizer"])
+            else restore_opt_states(state["optimizer"], params, runtime.precision)
         )
         update_fn = make_update_fn(runtime, module, tx, cfg, obs_keys)
 

@@ -42,12 +42,13 @@ from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import MetricFetchGate, device_get_metrics, Ratio, save_configs
+from sheeprl_tpu.optim import restore_opt_states
 
 
-def _make_optimizer(optim_cfg: Dict[str, Any]) -> optax.GradientTransformation:
+def _make_optimizer(optim_cfg: Dict[str, Any], precision: str = "32-true") -> optax.GradientTransformation:
     from sheeprl_tpu.optim import build_optimizer
 
-    return build_optimizer(optim_cfg)
+    return build_optimizer(optim_cfg, precision=precision)
 
 
 def make_train_fn(runtime, actor, critic, txs, cfg: Dict[str, Any], target_entropy: float):
@@ -193,12 +194,18 @@ def main(runtime, cfg: Dict[str, Any]):
     actor, critic, params, target_entropy = build_agent(
         runtime, cfg, observation_space, action_space, state["agent"] if state else None
     )
-    params = runtime.replicate(params)
-    actor_tx = _make_optimizer(cfg.algo.actor.optimizer)
-    critic_tx = _make_optimizer(cfg.algo.critic.optimizer)
-    alpha_tx = _make_optimizer(cfg.algo.alpha.optimizer)
+    # bf16-true: bf16 param storage; EMA target + log_alpha keep f32 (small
+    # per-step updates drown in bf16 rounding); optimizers hold f32 masters
+    params = runtime.replicate(
+        runtime.to_param_dtype(params, exclude=("target_critic", "log_alpha"))
+    )
+    actor_tx = _make_optimizer(cfg.algo.actor.optimizer, runtime.precision)
+    critic_tx = _make_optimizer(cfg.algo.critic.optimizer, runtime.precision)
+    alpha_tx = _make_optimizer(cfg.algo.alpha.optimizer, runtime.precision)
     if state is not None:
-        opt_states = jax.tree_util.tree_map(jnp.asarray, state["opt_states"])
+        opt_states = restore_opt_states(
+            state["opt_states"], params, runtime.precision, key_map={"alpha": "log_alpha"}
+        )
     else:
         opt_states = {
             "actor": actor_tx.init(params["actor"]),

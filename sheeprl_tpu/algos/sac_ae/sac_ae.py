@@ -32,6 +32,7 @@ from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import MetricFetchGate, device_get_metrics, Ratio, save_configs
+from sheeprl_tpu.optim import restore_opt_states
 
 sg = jax.lax.stop_gradient
 
@@ -269,15 +270,22 @@ def main(runtime, cfg: Dict[str, Any]):
     modules, params, target_entropy = build_agent(
         runtime, cfg, observation_space, action_space, state["agent"] if state else None
     )
-    params = runtime.replicate(params)
+    params = runtime.replicate(
+        runtime.to_param_dtype(params, exclude=("target", "log_alpha"))
+    )
 
-    critic_tx = _make_optimizer(cfg.algo.critic.optimizer)
-    actor_tx = _make_optimizer(cfg.algo.actor.optimizer)
-    alpha_tx = _make_optimizer(cfg.algo.alpha.optimizer)
-    encoder_tx = _make_optimizer(cfg.algo.encoder.optimizer)
-    decoder_tx = _make_optimizer(cfg.algo.decoder.optimizer)
+    critic_tx = _make_optimizer(cfg.algo.critic.optimizer, runtime.precision)
+    actor_tx = _make_optimizer(cfg.algo.actor.optimizer, runtime.precision)
+    alpha_tx = _make_optimizer(cfg.algo.alpha.optimizer, runtime.precision)
+    encoder_tx = _make_optimizer(cfg.algo.encoder.optimizer, runtime.precision)
+    decoder_tx = _make_optimizer(cfg.algo.decoder.optimizer, runtime.precision)
     if state is not None:
-        opt_states = jax.tree_util.tree_map(jnp.asarray, state["opt_states"])
+        # the encoder opt state pairs with the encoder SUBTREE nested under
+        # the critic params (shared critic/encoder tree, see init below)
+        params_for_opt = {**params, "encoder": params["critic"]["encoder"]}
+        opt_states = restore_opt_states(
+            state["opt_states"], params_for_opt, runtime.precision, key_map={"alpha": "log_alpha"}
+        )
     else:
         opt_states = runtime.replicate(
             {

@@ -22,6 +22,53 @@ from sheeprl_tpu.config.compose import deep_merge, yaml_load
 from sheeprl_tpu.utils.registry import algorithm_registry, evaluation_registry, find_algorithm, find_evaluation
 
 
+def _app_config(name: str) -> dict:
+    """Defaults of an app-level entry config (eval_config.yaml /
+    model_manager_config.yaml, reference sheeprl/configs/*.yaml) — the
+    reference mounts these via @hydra.main; here they are plain yaml files
+    in the package config dir."""
+    path = os.path.join(os.path.dirname(__file__), "configs", f"{name}.yaml")
+    try:
+        with open(path) as f:
+            return yaml_load(f.read()) or {}
+    except OSError:
+        return {}
+
+
+def _resolve_interp(value, ctx: dict):
+    """Resolve the tiny interpolation set the app-level entry configs use
+    (``${now:FMT}``, ``${oc.env:VAR}``, ``${key}`` from ``ctx``) — the
+    stand-in for the omegaconf resolvers the reference's @hydra.main
+    mounting provides.  Unresolvable values (missing env var / ``???``)
+    become None so callers fall back to their defaults."""
+    if not isinstance(value, str) or value == "???":
+        return None if value == "???" else value
+
+    import re
+    from datetime import datetime
+
+    unresolved = False
+
+    def sub(m) -> str:
+        nonlocal unresolved
+        expr = m.group(1)
+        if expr.startswith("now:"):
+            return datetime.now().strftime(expr[4:])
+        if expr.startswith("oc.env:"):
+            env = os.getenv(expr[7:])
+            if env is None:
+                unresolved = True
+                return ""
+            return env
+        if expr in ctx and ctx[expr] is not None:
+            return str(ctx[expr])
+        unresolved = True
+        return ""
+
+    out = re.sub(r"\$\{([^}]+)\}", sub, value)
+    return None if unresolved else out
+
+
 def resume_from_checkpoint(cfg: dotdict) -> dotdict:
     """Merge the checkpoint's config with the current one, keeping the new
     total_steps / learning_starts-style knobs (reference cli.py:23-57)."""
@@ -198,8 +245,12 @@ def evaluation(args: Optional[Sequence[str]] = None) -> None:
         raise RuntimeError(f"Cannot find the config file of the checkpoint: {cfg_path}")
     with open(cfg_path) as f:
         run_cfg = dotdict(yaml_load(f.read()))
-    capture_video = yaml_load(kv.get("env.capture_video", "True"))
-    seed = int(kv.get("seed", run_cfg.get("seed", 42)))
+    app_defaults = _app_config("eval_config")
+    capture_video = yaml_load(
+        kv.get("env.capture_video", str(app_defaults.get("env", {}).get("capture_video", True)))
+    )
+    default_seed = app_defaults.get("seed")
+    seed = int(kv.get("seed", run_cfg.get("seed", 42 if default_seed is None else default_seed)))
     run_cfg["env"]["capture_video"] = bool(capture_video)
     run_cfg["env"]["num_envs"] = 1
     run_cfg["fabric"] = dotdict(
@@ -208,7 +259,11 @@ def evaluation(args: Optional[Sequence[str]] = None) -> None:
             "devices": 1,
             "num_nodes": 1,
             "strategy": "auto",
-            "accelerator": kv.get("fabric.accelerator", run_cfg["fabric"].get("accelerator", "auto")),
+            "accelerator": kv.get(
+                "fabric.accelerator",
+                app_defaults.get("fabric", {}).get("accelerator")
+                or run_cfg["fabric"].get("accelerator", "auto"),
+            ),
             "precision": run_cfg["fabric"].get("precision", "32-true"),
         }
     )
@@ -267,6 +322,28 @@ def registration(args: Optional[Sequence[str]] = None) -> None:
     from sheeprl_tpu.utils.callback import load_checkpoint
     from sheeprl_tpu.utils.mlflow import register_model_from_checkpoint
 
+    # run/experiment naming + tracking uri defaults from the registration
+    # app's entry config (reference sheeprl/configs/model_manager_config.yaml);
+    # explicit run.name= / experiment.name= / tracking_uri= overrides win
+    app_defaults = _app_config("model_manager_config")
+    ctx = {"exp_name": run_cfg.get("exp_name")}
+    run_name = run_cfg.get("run", {}).get("name") or _resolve_interp(
+        (app_defaults.get("run") or {}).get("name"), ctx
+    )
+    experiment_name = run_cfg.get("experiment", {}).get("name") or _resolve_interp(
+        (app_defaults.get("experiment") or {}).get("name"), ctx
+    )
+    tracking_uri = run_cfg.get("tracking_uri") or _resolve_interp(
+        app_defaults.get("tracking_uri"), ctx
+    )
+
     state = load_checkpoint(os.path.abspath(ckpt_path))
     runtime = _build_runtime(cfg)
-    register_model_from_checkpoint(runtime, cfg, state)
+    register_model_from_checkpoint(
+        runtime,
+        cfg,
+        state,
+        run_name=run_name,
+        experiment_name=experiment_name,
+        tracking_uri=tracking_uri,
+    )

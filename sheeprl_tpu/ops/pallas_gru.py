@@ -39,7 +39,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
-def _gru_kernel(h_ref, inp_ref, w_ref, gamma_ref, beta_ref, out_ref, acc_ref, *, nk: int, eps: float, use_ln: bool, matmul_dtype):
+def _gru_kernel(h_ref, inp_ref, w_ref, gamma_ref, beta_ref, out_ref, acc_ref, *, nk: int, eps: float, use_ln: bool):
     k = pl.program_id(1)
 
     @pl.when(k == 0)
@@ -47,10 +47,12 @@ def _gru_kernel(h_ref, inp_ref, w_ref, gamma_ref, beta_ref, out_ref, acc_ref, *,
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
     # the contraction runs in ``matmul_dtype`` (bf16 under mixed precision,
-    # MXU fast path) with an f32 accumulator; gates/LN/state update stay f32
+    # MXU fast path) with an f32 accumulator; gates/LN/state update stay f32.
+    # inp/w are pre-cast by the caller so their tiles stream through VMEM at
+    # the matmul dtype's width (half the HBM traffic under bf16).
     acc_ref[:] += jnp.dot(
-        inp_ref[:].astype(matmul_dtype),
-        w_ref[:].astype(matmul_dtype),
+        inp_ref[:],
+        w_ref[:],
         preferred_element_type=jnp.float32,
     )
 
@@ -101,8 +103,21 @@ def fused_gru_cell(
         gamma = jnp.ones((3 * hidden,), jnp.float32)
         beta = jnp.zeros((3 * hidden,), jnp.float32)
 
+    # stream inp/w at the matmul dtype's width (MXU-native bf16 under mixed
+    # precision: half the HBM traffic and half the VMEM per tile)
+    inp = inp.astype(matmul_dtype)
+    w = w.astype(matmul_dtype)
     block_b = min(block_b, b)
     block_k = min(block_k, kdim)
+    # VMEM budget: the (block_k, 3H) weight tile is double-buffered by the
+    # pipeline, and the f32 accumulator + h/inp/out blocks live alongside it.
+    # Shrink block_k until 2 weight tiles + accumulator fit in ~10 MB (of the
+    # 16 MB scoped VMEM), otherwise L/XL hidden sizes (3H >= 9216) OOM at
+    # compile time ("ran out of memory in memory space vmem").
+    itemsize = jnp.dtype(matmul_dtype).itemsize
+    vmem_budget = 10 * 2**20 - 4 * block_b * 3 * hidden  # minus f32 accumulator
+    while block_k > 128 and 2 * block_k * 3 * hidden * itemsize > vmem_budget:
+        block_k //= 2
     nb = -(-b // block_b)
     nk = -(-kdim // block_k)
     # pad so the grid tiles exactly (zero rows/cols contribute nothing to
@@ -116,7 +131,7 @@ def fused_gru_cell(
         w = jnp.pad(w, ((0, pk), (0, 0)))
 
     out = pl.pallas_call(
-        functools.partial(_gru_kernel, nk=nk, eps=eps, use_ln=use_ln, matmul_dtype=matmul_dtype),
+        functools.partial(_gru_kernel, nk=nk, eps=eps, use_ln=use_ln),
         grid=(nb, nk),
         in_specs=[
             pl.BlockSpec((block_b, hidden), lambda i, k: (i, 0)),  # h

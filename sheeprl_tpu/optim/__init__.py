@@ -8,7 +8,7 @@ V1/V2 configs expect.
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -60,10 +60,26 @@ def rmsprop_tf(
 _TORCH_KEY_RENAMES = {"lr": "learning_rate", "alpha": "decay"}
 
 
+# torch optimizer kwargs with NO optax counterpart: harmless at their torch
+# defaults (dropped silently), an explicit error otherwise — better than the
+# TypeError the optax factory would raise
+_TORCH_NOOP_DEFAULTS = {
+    "dampening": 0,
+    "foreach": None,
+    "fused": None,
+    "maximize": False,
+    "capturable": False,
+    "differentiable": False,
+    "amsgrad": False,
+}
+
+
 def normalize_optim_kwargs(kwargs: dict) -> dict:
     """Accept torch-style optimizer kwargs alongside optax-native ones so
     reference command lines (``algo.optimizer.lr=3e-4``) run unmodified.
-    Also coerces yaml-1.1 scientific-notation strings ("3e-4") to floats."""
+    Also coerces yaml-1.1 scientific-notation strings ("3e-4") to floats,
+    and drops torch-only kwargs left at their torch defaults (raising an
+    actionable error when they are not)."""
     out = {}
     betas = kwargs.pop("betas", None)
     if betas is not None:
@@ -74,6 +90,14 @@ def normalize_optim_kwargs(kwargs: dict) -> dict:
                 v = float(v)
             except ValueError:
                 pass
+        if k in _TORCH_NOOP_DEFAULTS:
+            if v in (_TORCH_NOOP_DEFAULTS[k], None):
+                continue
+            raise ValueError(
+                f"torch optimizer kwarg '{k}={v}' has no optax equivalent; remove it "
+                f"from the optimizer config (only its torch default "
+                f"{_TORCH_NOOP_DEFAULTS[k]!r} is accepted and ignored)."
+            )
         out[_TORCH_KEY_RENAMES.get(k, k)] = v
     return out
 
@@ -95,11 +119,156 @@ def resolve_weight_decay(kwargs: dict, fn) -> float:
     return 0.0
 
 
-def build_optimizer(optim_cfg: dict, max_grad_norm: Optional[float] = None) -> optax.GradientTransformation:
+class MasterWeightsState(NamedTuple):
+    """State of :func:`master_weights`: inner optimizer state (moments etc.
+    built on the f32 master copy) plus the f32 master parameters."""
+
+    inner: optax.OptState
+    master: optax.Params
+
+
+def _f32_copy(tree):
+    """f32 COPY of every float leaf: the master-weight synthesis rule shared
+    by master_weights.init and restore_opt_states.  Always a copy — for
+    leaves already f32 (e.g. excluded from the bf16 storage cast) astype
+    would alias the parameter buffer, and the jitted train steps donate
+    both params and opt state; aliased buffers trip "attempt to donate the
+    same buffer twice"."""
+    return jax.tree_util.tree_map(
+        lambda p: jnp.array(p, dtype=jnp.float32, copy=True)
+        if jnp.issubdtype(p.dtype, jnp.floating)
+        else p,
+        tree,
+    )
+
+
+def master_weights(tx: optax.GradientTransformation) -> optax.GradientTransformation:
+    """bf16-true training: keep a float32 master copy of the parameters in
+    the optimizer state and run the whole update in f32.
+
+    The model stores (and streams from HBM) bfloat16 parameters — half the
+    weight traffic of f32 on the bandwidth-bound paths — while the update
+    math keeps full precision: incoming (possibly bf16) gradients are
+    upcast, the inner transform's moments live in f32, and the emitted
+    update is ``new_master - f32(params)`` so that
+    ``optax.apply_updates(params, updates)`` (which computes in the
+    promoted dtype before casting back) lands on EXACTLY
+    ``bf16(new_master)`` — no drift between master and stored params.
+
+    The torch analogue is Lightning's bf16-true + master-weight optimizers;
+    here it is a plain optax transformation, so every algo picks it up
+    through ``build_optimizer(..., precision="bf16-true")``.
+    """
+
+    def init_fn(params):
+        master = _f32_copy(params)
+        return MasterWeightsState(inner=tx.init(master), master=master)
+
+    def update_fn(updates, state, params=None):
+        if not isinstance(state, MasterWeightsState):
+            # a structure change here would break the scan-carried updates
+            # (PPO minibatch scans, SAC G-step scans need a structure-stable
+            # carry), so migration must happen at restore time instead
+            raise TypeError(
+                "master_weights.update received a plain opt state (e.g. restored from "
+                "a checkpoint saved at a different precision); migrate it on the host "
+                "with sheeprl_tpu.optim.restore_opt_states(...) before training."
+            )
+        grads32 = jax.tree_util.tree_map(
+            lambda g: g.astype(jnp.float32) if jnp.issubdtype(g.dtype, jnp.floating) else g,
+            updates,
+        )
+        inner_updates, new_inner = tx.update(grads32, state.inner, state.master)
+        new_master = optax.apply_updates(state.master, inner_updates)
+        if params is None:
+            emitted = jax.tree_util.tree_map(lambda m, o: m - o, new_master, state.master)
+        else:
+            emitted = jax.tree_util.tree_map(
+                lambda m, p: m - p.astype(jnp.float32), new_master, params
+            )
+        return emitted, MasterWeightsState(inner=new_inner, master=new_master)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def restore_opt_states(saved, params, precision: str, key_map: Optional[dict] = None):
+    """Materialize a checkpointed opt state at restore time and migrate
+    its STRUCTURE across precision changes — on the host, outside jit,
+    because the scan-based train steps (PPO minibatch scans, SAC G-step
+    scans) need a structure-stable opt-state carry:
+
+    - ``precision="bf16-true"`` but ``saved`` has no master weights (a
+      checkpoint from an older bf16-true run where params stayed f32, or
+      a 32-true exploration run finetuned at bf16-true): wrap it in
+      :class:`MasterWeightsState`, synthesizing the f32 master from the
+      paired ``params``.
+    - any other precision but ``saved`` IS a :class:`MasterWeightsState`
+      (bf16-true checkpoint resumed at 32-true / bf16-mixed): unwrap to
+      the inner state, whose f32 moments are exactly what the plain
+      transform expects.
+
+    ``saved`` is either one opt state or a (possibly nested) dict of
+    per-component states; ``params`` pairs with it key-by-key, with
+    ``key_map`` renaming saved keys to params keys (e.g. SAC's
+    ``{"alpha": "log_alpha"}``).  Every path also runs the leaves through
+    ``jnp.asarray`` (the plain-restore behavior this replaces)."""
+    key_map = key_map or {}
+    if isinstance(saved, dict):
+        return {
+            k: restore_opt_states(
+                v,
+                None if params is None else params.get(key_map.get(k, k)),
+                precision,
+                key_map=key_map,
+            )
+            for k, v in saved.items()
+        }
+    saved = jax.tree_util.tree_map(jnp.asarray, saved)
+    wrapped = isinstance(saved, MasterWeightsState)
+    if precision == "bf16-true" and not wrapped:
+        if params is None:
+            raise ValueError(
+                "restore_opt_states needs the matching params to synthesize the f32 "
+                "master weights when migrating a checkpoint to bf16-true."
+            )
+        return MasterWeightsState(inner=saved, master=_f32_copy(params))
+    if precision != "bf16-true" and wrapped:
+        return saved.inner
+    return saved
+
+
+def finalize_optimizer(
+    tx: optax.GradientTransformation,
+    weight_decay: float,
+    max_grad_norm: Optional[float],
+    precision: str,
+) -> optax.GradientTransformation:
+    """Shared tail of every optimizer build (plain and ppo-family):
+    decoupled weight decay -> global-norm clip -> precision wrapper.
+    Keeping it in one place means a precision or ordering tweak cannot
+    silently diverge between ``build_optimizer`` and
+    ``build_ppo_optimizer``."""
+    if weight_decay:
+        tx = optax.chain(optax.add_decayed_weights(weight_decay), tx)
+    if max_grad_norm is not None and max_grad_norm > 0:
+        tx = optax.chain(optax.clip_by_global_norm(float(max_grad_norm)), tx)
+    if precision == "bf16-true":
+        tx = master_weights(tx)
+    return tx
+
+
+def build_optimizer(
+    optim_cfg: dict,
+    max_grad_norm: Optional[float] = None,
+    precision: str = "32-true",
+) -> optax.GradientTransformation:
     """Instantiate an optax optimizer from a ``_target_`` config node, with
     optional global-norm clipping chained in front (fabric.clip_gradients
     equivalent) and torch-style kwargs accepted (see
-    ``normalize_optim_kwargs`` / ``resolve_weight_decay``)."""
+    ``normalize_optim_kwargs`` / ``resolve_weight_decay``).
+
+    ``precision="bf16-true"`` wraps the transform in :func:`master_weights`
+    (f32 master copy + f32 moments over bf16 stored params)."""
     from sheeprl_tpu.config.compose import _locate
 
     cfg = dict(optim_cfg)
@@ -108,8 +277,4 @@ def build_optimizer(optim_cfg: dict, max_grad_norm: Optional[float] = None) -> o
     fn = _locate(target)
     wd = resolve_weight_decay(kwargs, fn)
     tx = fn(**kwargs)
-    if wd:
-        tx = optax.chain(optax.add_decayed_weights(wd), tx)
-    if max_grad_norm is not None and max_grad_norm > 0:
-        tx = optax.chain(optax.clip_by_global_norm(float(max_grad_norm)), tx)
-    return tx
+    return finalize_optimizer(tx, wd, max_grad_norm, precision)

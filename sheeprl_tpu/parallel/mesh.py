@@ -95,14 +95,6 @@ class MeshRuntime:
                 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
         except Exception:
             pass
-        if self._precision == "bf16-true":
-            import warnings
-
-            warnings.warn(
-                "bf16-true parameter storage is not implemented yet: parameters "
-                "stay float32 and the run behaves like bf16-mixed (compute in "
-                "bf16, f32 params/optimizer state)."
-            )
         if self._num_nodes > 1 and jax.process_count() == 1:
             # multi-host rendezvous (reads JAX coordinator env vars)
             jax.distributed.initialize()
@@ -178,6 +170,40 @@ class MeshRuntime:
     def param_dtype(self):
         return jnp.bfloat16 if self._precision == "bf16-true" else jnp.float32
 
+    def to_param_dtype(self, tree: Any, exclude: Tuple[str, ...] = ()) -> Any:
+        """Cast float32 leaves to the parameter STORAGE dtype.
+
+        Under ``bf16-true`` parameters live in bfloat16 — half the HBM
+        footprint and half the weight traffic on bandwidth-bound paths
+        (e.g. the RSSM scan's per-step matmuls) — while flax modules
+        promote them to each module's compute dtype on use, and the
+        optimizer keeps an f32 master copy
+        (``sheeprl_tpu.optim.master_weights``).  Dict keys in ``exclude``
+        match at ANY nesting depth (e.g. an EMA ``target_critic`` at the
+        top level, or each ensemble member's ``target_module`` inside
+        p2e's ``critics_exploration``): the whole subtree under a matched
+        key keeps f32 storage — EMA targets' small per-step updates would
+        drown in bf16 rounding.  No-op for other precisions, so call
+        sites are unconditional."""
+        if self.param_dtype == jnp.float32:
+            return tree
+        cast = lambda t: jax.tree_util.tree_map(
+            lambda x: x.astype(jnp.bfloat16)
+            if getattr(x, "dtype", None) == jnp.float32
+            else x,
+            t,
+        )
+        if not exclude:
+            return cast(tree)
+        ex = frozenset(exclude)
+
+        def rec(node):
+            if isinstance(node, dict):
+                return {k: (v if k in ex else rec(v)) for k, v in node.items()}
+            return cast(node)
+
+        return rec(tree)
+
     # ------------------------------------------------------------------ #
     # RNG
     # ------------------------------------------------------------------ #
@@ -237,7 +263,7 @@ class MeshRuntime:
         """Place params/opt-state on the mesh.
 
         Default strategies replicate every leaf. Under ``strategy="fsdp"``
-        each leaf is sharded over the data axis on its first dimension
+        each leaf is sharded over the data axis on its LARGEST dimension
         divisible by the mesh size (scalars and indivisible leaves stay
         replicated): the ZeRO-3 layout, with XLA inserting the weight
         all-gathers and gradient reduce-scatters during jit."""
@@ -246,12 +272,19 @@ class MeshRuntime:
         ws = self.world_size
 
         def place(leaf: Any) -> Any:
+            # shard the LARGEST divisible dim: picking the first one can hit
+            # a small leading axis (e.g. a conv kernel's spatial dim),
+            # producing tiny shards and halo all-gathers
             shape = getattr(leaf, "shape", ())
-            for d, s in enumerate(shape):
-                if s >= ws and s % ws == 0:
-                    spec = [None] * len(shape)
-                    spec[d] = "data"
-                    return jax.device_put(leaf, NamedSharding(self.mesh, P(*spec)))
+            best = max(
+                (d for d, s in enumerate(shape) if s >= ws and s % ws == 0),
+                key=lambda d: shape[d],
+                default=None,
+            )
+            if best is not None:
+                spec = [None] * len(shape)
+                spec[best] = "data"
+                return jax.device_put(leaf, NamedSharding(self.mesh, P(*spec)))
             return jax.device_put(leaf, self.replicated)
 
         return jax.tree_util.tree_map(place, tree)

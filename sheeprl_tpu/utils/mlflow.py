@@ -5,9 +5,11 @@ Behavioral counterpart of reference sheeprl/utils/mlflow.py
 of training (or offline through the ``sheeprl_tpu-registration`` app) to
 log the agent's models and register them in the MLflow model registry.
 
-Models here are param pytrees: each is pickled (as a pure-numpy tree) and
-logged as a run artifact, then registered from that artifact URI (see
-sheeprl_tpu/utils/model_manager.py for the rationale)."""
+Models here are param pytrees: each is logged as an mlflow pyfunc MODEL
+(:class:`JaxParamsModel` wrapping the pure-numpy tree, optionally with a
+signature and a reconstructable module spec) and registered from that
+model URI — the jax-native analogue of the reference's
+``mlflow.pytorch.log_model`` flavor."""
 
 from __future__ import annotations
 
@@ -36,17 +38,63 @@ def _to_numpy_tree(tree: Any) -> Any:
     return jax.tree_util.tree_map(np.asarray, jax.device_get(tree))
 
 
+class JaxParamsModel(mlflow.pyfunc.PythonModel):
+    """pyfunc flavor for a jax/flax param pytree — the TPU counterpart of
+    the reference's ``mlflow.pytorch.log_model`` modules (reference
+    sheeprl/utils/mlflow.py:330-427): the registered model is a LOADABLE
+    mlflow Model (``mlflow.pyfunc.load_model``), not a bare pickle.
+
+    When a ``module_spec`` — ``{"target": "pkg.mod.Class", "kwargs": {...},
+    "method": "apply"}`` — is logged alongside, ``predict`` reconstructs
+    the flax module and applies it to the input batch; otherwise the
+    loaded model still exposes the numpy param tree via ``.params``.
+    """
+
+    def load_context(self, context):
+        with open(context.artifacts["params"], "rb") as f:
+            self.params = pickle.load(f)
+        spec_path = context.artifacts.get("module_spec")
+        self.module_spec = None
+        if spec_path and os.path.exists(spec_path):
+            with open(spec_path, "rb") as f:
+                self.module_spec = pickle.load(f)
+
+    def predict(self, context, model_input, params=None):
+        if self.module_spec is None:
+            raise NotImplementedError(
+                "This model was logged without a module_spec; use the loaded "
+                "pyfunc's .params pytree with the matching sheeprl_tpu module."
+            )
+        import importlib
+
+        target = self.module_spec["target"]
+        mod_path, cls_name = target.rsplit(".", 1)
+        module = getattr(importlib.import_module(mod_path), cls_name)(
+            **self.module_spec.get("kwargs", {})
+        )
+        method = self.module_spec.get("method", "apply")
+        return getattr(module, method)(self.params, model_input)
+
+
 def log_models(
     cfg: Dict[str, Any],
     models_to_log: Dict[str, Any],
     run_id: Optional[str] = None,
     experiment_id: Optional[str] = None,
     run_name: Optional[str] = None,
+    signatures: Optional[Dict[str, Any]] = None,
+    module_specs: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, str]:
-    """Log each params pytree as a pickled artifact inside one MLflow run.
+    """Log each params pytree as an mlflow pyfunc MODEL inside one run.
 
-    Returns {model_key: artifact model_uri} (the generic equivalent of the
-    reference's per-algo ``log_models``, ppo/utils.py:75)."""
+    Returns {model_key: model_uri} (the generic equivalent of the
+    reference's per-algo ``log_models``, ppo/utils.py:75, which logs
+    ``mlflow.pytorch`` flavors).  ``signatures[name]`` may carry an
+    ``mlflow.models.ModelSignature`` or an ``(input_example,
+    output_example)`` tuple to infer one; ``module_specs[name]`` makes the
+    logged model's ``predict`` functional (see :class:`JaxParamsModel`)."""
+    from mlflow.models import infer_signature
+
     model_uris: Dict[str, str] = {}
     with mlflow.start_run(
         run_id=run_id, experiment_id=experiment_id, run_name=run_name, nested=True
@@ -56,16 +104,43 @@ def log_models(
                 path = os.path.join(tmp, f"{name}.pkl")
                 with open(path, "wb") as f:
                     pickle.dump(_to_numpy_tree(params), f)
-                mlflow.log_artifact(path, artifact_path=name)
-                model_uris[name] = f"runs:/{active.info.run_id}/{name}"
+                artifacts = {"params": path}
+                spec = (module_specs or {}).get(name)
+                if spec is not None:
+                    spec_path = os.path.join(tmp, f"{name}_module_spec.pkl")
+                    with open(spec_path, "wb") as f:
+                        pickle.dump(spec, f)
+                    artifacts["module_spec"] = spec_path
+                signature = (signatures or {}).get(name)
+                if isinstance(signature, tuple):
+                    signature = infer_signature(*signature)
+                info = mlflow.pyfunc.log_model(
+                    artifact_path=name,
+                    python_model=JaxParamsModel(),
+                    artifacts=artifacts,
+                    signature=signature,
+                )
+                model_uris[name] = info.model_uri
         mlflow.log_dict(dict(cfg), "config.json")
     return model_uris
 
 
-def register_model(runtime, cfg: Dict[str, Any], models_to_log: Dict[str, Any]) -> None:
-    """End-of-training registration (reference mlflow.py:384)."""
-    tracking_uri = os.getenv("MLFLOW_TRACKING_URI", None) or cfg.metric.logger.get(
-        "tracking_uri", None
+def register_model(
+    runtime,
+    cfg: Dict[str, Any],
+    models_to_log: Dict[str, Any],
+    run_name: Optional[str] = None,
+    experiment_name: Optional[str] = None,
+    tracking_uri: Optional[str] = None,
+) -> None:
+    """End-of-training registration (reference mlflow.py:384).  The offline
+    registration app passes ``run_name`` / ``experiment_name`` /
+    ``tracking_uri`` resolved from ``configs/model_manager_config.yaml``;
+    in-training callers use the defaults below."""
+    tracking_uri = (
+        tracking_uri
+        or os.getenv("MLFLOW_TRACKING_URI", None)
+        or cfg.metric.logger.get("tracking_uri", None)
     )
     if not tracking_uri:
         raise ValueError(
@@ -73,11 +148,13 @@ def register_model(runtime, cfg: Dict[str, Any], models_to_log: Dict[str, Any]) 
             "the MLFLOW_TRACKING_URI environment variable."
         )
     mlflow.set_tracking_uri(tracking_uri)
-    experiment = mlflow.get_experiment_by_name(cfg.exp_name)
+    experiment_name = experiment_name or cfg.exp_name
+    experiment = mlflow.get_experiment_by_name(experiment_name)
     experiment_id = (
-        mlflow.create_experiment(cfg.exp_name) if experiment is None else experiment.experiment_id
+        mlflow.create_experiment(experiment_name) if experiment is None else experiment.experiment_id
     )
-    run_name = f"{cfg.algo.name}_{cfg.env.id}_{datetime.today().strftime('%Y-%m-%d %H:%M:%S')}"
+    if not run_name:
+        run_name = f"{cfg.algo.name}_{cfg.env.id}_{datetime.today().strftime('%Y-%m-%d %H:%M:%S')}"
     model_uris = log_models(cfg, models_to_log, None, experiment_id, run_name)
 
     cfg_model_manager = cfg.model_manager
@@ -94,7 +171,14 @@ def register_model(runtime, cfg: Dict[str, Any], models_to_log: Dict[str, Any]) 
         )
 
 
-def register_model_from_checkpoint(runtime, cfg: Dict[str, Any], state: Dict[str, Any]) -> None:
+def register_model_from_checkpoint(
+    runtime,
+    cfg: Dict[str, Any],
+    state: Dict[str, Any],
+    run_name: Optional[str] = None,
+    experiment_name: Optional[str] = None,
+    tracking_uri: Optional[str] = None,
+) -> None:
     """Offline registration from a checkpoint (reference mlflow.py:330):
     collects the algo's MODELS_TO_REGISTER param trees from the checkpoint
     state and logs+registers them."""
@@ -117,4 +201,11 @@ def register_model_from_checkpoint(runtime, cfg: Dict[str, Any], state: Dict[str
             f"(available keys: {sorted(state)})"
         )
     models_to_log = {name: state[name] for name in cfg.model_manager.models}
-    register_model(runtime, cfg, models_to_log)
+    register_model(
+        runtime,
+        cfg,
+        models_to_log,
+        run_name=run_name,
+        experiment_name=experiment_name,
+        tracking_uri=tracking_uri,
+    )

@@ -94,7 +94,14 @@ def gae(
     Reference: sheeprl/utils/utils.py:64-102 (python loop over T);
     here a reverse ``lax.scan`` so the whole thing is one XLA op.
     """
-    not_done = 1.0 - dones.astype(values.dtype)
+    # advantage accumulation always runs in f32: under bf16 compute
+    # policies the critic emits bf16 values, and a bf16 scan carry both
+    # loses precision and trips the carry-dtype check (the f32 rewards
+    # promote the carry output to f32)
+    values = values.astype(jnp.float32)
+    next_value = next_value.astype(jnp.float32)
+    rewards = rewards.astype(jnp.float32)
+    not_done = 1.0 - dones.astype(jnp.float32)
     next_values = jnp.concatenate([values[1:], next_value[None]], axis=0)
 
     def step(lastgaelam, inp):
@@ -105,7 +112,7 @@ def gae(
 
     _, advantages = jax.lax.scan(
         step,
-        jnp.zeros_like(next_value),
+        jnp.zeros_like(next_value, dtype=jnp.float32),
         (rewards, not_done, values, next_values),
         reverse=True,
     )
@@ -220,7 +227,11 @@ class MetricFetchGate:
     """Counts train dispatches and fires every ``metric.fetch_every``-th one
     (amortizes the device sync of the losses dict on high-latency links;
     1 = reference cadence). Counting dispatches rather than iterations keeps
-    the gate aligned with whatever schedule the replay ratio produces."""
+    the gate aligned with whatever schedule the replay ratio produces.
+
+    ``every > 1`` SUBSAMPLES: skipped dispatches' losses are dropped, not
+    deferred, so logged averages cover every N-th dispatch (see
+    configs/metric/default.yaml)."""
 
     def __init__(self, every: Any):
         self.every = max(1, int(every or 1))
@@ -265,15 +276,27 @@ def transfer_tree(tree: Any, device) -> Any:
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     if not leaves or device is None:
         return tree if device is None else jax.device_put(tree, device)
-    src = next(iter(leaves[0].devices())) if hasattr(leaves[0], "devices") else None
-    if src is None or src.platform == getattr(device, "platform", None):
-        return jax.device_put(tree, device)
+
+    # Partition by ACTUAL leaf location: only leaves living on a remote
+    # accelerator need the concat-and-single-fetch path.  Host (numpy) and
+    # same-platform leaves go straight through device_put — routing them
+    # through jnp.concatenate would first PUSH them to the remote source
+    # device and fetch them back, extra round trips on exactly the
+    # high-latency links this function optimizes.
+    target_platform = getattr(device, "platform", None)
+    out = [None] * len(leaves)
+    remote = []
+    for i, leaf in enumerate(leaves):
+        src = next(iter(leaf.devices())) if hasattr(leaf, "devices") else None
+        if src is None or src.platform == target_platform:
+            out[i] = jax.device_put(leaf, device)
+        else:
+            remote.append(i)
     # one transfer per dtype group — NO casting, so integer/f64 leaves stay
     # exact and bf16 leaves don't double their payload
     groups: Dict[Any, list] = {}
-    for i, leaf in enumerate(leaves):
-        groups.setdefault(jnp.asarray(leaf).dtype, []).append(i)
-    out = [None] * len(leaves)
+    for i in remote:
+        groups.setdefault(jnp.asarray(leaves[i]).dtype, []).append(i)
     for dt, idxs in groups.items():
         flat = jnp.concatenate([jnp.ravel(leaves[i]) for i in idxs])
         host = np.asarray(flat)  # the single cross-backend copy per dtype

@@ -1,0 +1,22 @@
+"""Run the test suite against a live local MLflow tracking server
+(reference tests/run_tests_mlflow.py): spins up ``mlflow ui`` on :5000,
+points MLFLOW_TRACKING_URI at it, runs pytest, and tears the server down.
+
+The mlflow-dependent tests (model manager, registration app) skip
+themselves when mlflow is not importable, so this runner is the way to
+exercise them for real."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+if __name__ == "__main__":
+    os.environ["MLFLOW_TRACKING_URI"] = "http://localhost:5000"
+    p = subprocess.Popen(["mlflow", "ui", "--port", "5000"])
+    try:
+        exit_code = pytest.main(["-s", "-vv"])
+    finally:
+        p.terminate()
+    sys.exit(exit_code)

@@ -1,0 +1,232 @@
+"""bf16-true precision policy: bfloat16 parameter STORAGE with f32 master
+weights in the optimizer (``sheeprl_tpu.optim.master_weights``) and f32
+compute where the mixed policy demands it (LN/gates/carries).
+
+The reference counterpart is Lightning Fabric's ``precision=bf16-true``
+plugin (reference sheeprl/utils/utils.py dtype handling); here the policy
+is a pytree cast (``MeshRuntime.to_param_dtype``) plus an optax
+transformation, so every algorithm shares one implementation.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from sheeprl_tpu.models.models import MLP, LayerNormGRUCell
+from sheeprl_tpu.optim import MasterWeightsState, build_optimizer, master_weights
+from sheeprl_tpu.parallel.mesh import MeshRuntime
+
+
+def _tree_bytes(tree):
+    return sum(x.size * x.dtype.itemsize for x in jax.tree_util.tree_leaves(tree))
+
+
+def test_to_param_dtype_casts_and_excludes():
+    runtime = MeshRuntime(devices=1, accelerator="cpu", precision="bf16-true").launch()
+    tree = {
+        "actor": {"w": jnp.ones((4, 4), jnp.float32), "step": jnp.zeros((), jnp.int32)},
+        "target_critic": {"w": jnp.ones((4, 4), jnp.float32)},
+    }
+    cast = runtime.to_param_dtype(tree, exclude=("target_critic",))
+    assert cast["actor"]["w"].dtype == jnp.bfloat16
+    assert cast["actor"]["step"].dtype == jnp.int32  # non-float leaves untouched
+    assert cast["target_critic"]["w"].dtype == jnp.float32  # EMA target stays f32
+    # storage halves for the cast branch
+    assert _tree_bytes(cast["actor"]) < _tree_bytes(tree["actor"])
+
+
+def test_to_param_dtype_noop_for_f32_precisions():
+    for precision in ("32-true", "bf16-mixed"):
+        runtime = MeshRuntime(devices=1, accelerator="cpu", precision=precision).launch()
+        tree = {"w": jnp.ones((2, 2), jnp.float32)}
+        assert runtime.to_param_dtype(tree)["w"].dtype == jnp.float32
+
+
+def test_master_weights_exact_bf16_of_master():
+    """After every update, stored params are EXACTLY bf16(master)."""
+    tx = master_weights(optax.adam(1e-2))
+    params = {"w": jnp.asarray(np.random.default_rng(0).normal(size=(8, 8)), jnp.bfloat16)}
+    state = tx.init(params)
+    assert isinstance(state, MasterWeightsState)
+    assert state.master["w"].dtype == jnp.float32
+    # adam moments are built on the f32 master, not the bf16 params
+    assert all(
+        leaf.dtype in (jnp.float32, jnp.int32)
+        for leaf in jax.tree_util.tree_leaves(state.inner)
+    )
+    for i in range(5):
+        grads = {"w": jnp.full((8, 8), 0.1 + 0.01 * i, jnp.bfloat16)}
+        updates, state = tx.update(grads, state, params)
+        params = optax.apply_updates(params, updates)
+        assert params["w"].dtype == jnp.bfloat16
+        np.testing.assert_array_equal(
+            np.asarray(params["w"]),
+            np.asarray(state.master["w"].astype(jnp.bfloat16)),
+        )
+
+
+def test_master_weights_tracks_f32_training():
+    """bf16-true training follows an all-f32 run: the master accumulates
+    sub-bf16 updates that pure-bf16 storage would round away."""
+    lr = 1e-3
+    tx16 = master_weights(optax.sgd(lr))
+    tx32 = optax.sgd(lr)
+    w0 = np.random.default_rng(1).normal(size=(16,)).astype(np.float32)
+    p16 = {"w": jnp.asarray(w0, jnp.bfloat16)}
+    p32 = {"w": jnp.asarray(w0)}
+    s16, s32 = tx16.init(p16), tx32.init(p32)
+    g = jnp.full((16,), 1e-3, jnp.float32)  # tiny: lr*g ~ 1e-6 << bf16 ulp of w
+    for _ in range(100):
+        u16, s16 = tx16.update({"w": g.astype(jnp.bfloat16)}, s16, p16)
+        p16 = optax.apply_updates(p16, u16)
+        u32, s32 = tx32.update({"w": g}, s32, p32)
+        p32 = optax.apply_updates(p32, u32)
+    # master matches the f32 run to f32 accuracy (same arithmetic, the
+    # initial bf16 cast of w0 aside)...
+    np.testing.assert_allclose(
+        np.asarray(s16.master["w"]),
+        np.asarray(jnp.asarray(w0, jnp.bfloat16).astype(jnp.float32) + 100 * -lr * g),
+        rtol=1e-5,
+    )
+    # ...whereas pure-bf16 accumulation rounds each 1e-6 update to a no-op
+    # for any weight of magnitude ~1 (bf16 ulp ~ 8e-3): the naive bf16 run
+    # would not have moved at all, the master moved by 100 steps
+    naive = jnp.asarray(w0, jnp.bfloat16) + jnp.asarray(-lr * 1e-3, jnp.bfloat16)
+    big = np.abs(w0) > 0.5
+    assert big.any()
+    np.testing.assert_array_equal(
+        np.asarray(naive)[big], np.asarray(jnp.asarray(w0, jnp.bfloat16))[big]
+    )
+    moved = np.abs(np.asarray(s16.master["w"]) - np.asarray(jnp.asarray(w0, jnp.bfloat16), np.float32))
+    assert (moved[big] > 5e-5).all()
+
+
+def test_build_optimizer_precision_wiring():
+    cfg = {"_target_": "optax.adam", "lr": 1e-3}
+    tx = build_optimizer(dict(cfg), None, precision="bf16-true")
+    state = tx.init({"w": jnp.ones((2,), jnp.bfloat16)})
+    assert isinstance(state, MasterWeightsState)
+    tx32 = build_optimizer(dict(cfg), None, precision="32-true")
+    state32 = tx32.init({"w": jnp.ones((2,), jnp.float32)})
+    assert not isinstance(state32, MasterWeightsState)  # f32 state shape unchanged
+
+
+def test_set_lr_reaches_through_master_weights():
+    from sheeprl_tpu.algos.ppo.ppo import _set_lr, build_ppo_optimizer
+
+    tx = build_ppo_optimizer({"_target_": "optax.adam", "lr": 1e-3}, 0.5, "bf16-true")
+    state = tx.init({"w": jnp.ones((2,), jnp.bfloat16)})
+    state = _set_lr(state, 1e-5)
+
+    def find_lr(s):
+        if hasattr(s, "hyperparams") and "learning_rate" in s.hyperparams:
+            return float(s.hyperparams["learning_rate"])
+        if isinstance(s, MasterWeightsState):
+            return find_lr(s.inner)
+        if isinstance(s, tuple) and type(s) is tuple:
+            for sub in s:
+                got = find_lr(sub)
+                if got is not None:
+                    return got
+        return None
+
+    assert find_lr(state) == pytest.approx(1e-5)
+
+
+def test_modules_promote_bf16_params_to_f32_compute():
+    """flax modules with f32 compute dtype upcast bf16 stored params: the
+    LN/carry pins of the mixed policy hold under bf16-true storage."""
+    b, hidden = 4, 128
+    rng = np.random.default_rng(2)
+    h = jnp.asarray(rng.normal(size=(b, hidden)), jnp.float32)
+    x = jnp.asarray(rng.normal(size=(b, hidden)), jnp.float32)
+    cell = LayerNormGRUCell(hidden_size=hidden, dtype=jnp.bfloat16)
+    params32 = cell.init(jax.random.PRNGKey(0), h, x)
+    params16 = jax.tree_util.tree_map(lambda p: p.astype(jnp.bfloat16), params32)
+    out16, _ = cell.apply(params16, h, x)
+    out32, _ = cell.apply(params32, h, x)
+    assert out16.dtype == jnp.float32  # carry stays f32
+    np.testing.assert_allclose(np.asarray(out16), np.asarray(out32), rtol=0.05, atol=0.02)
+
+    mlp = MLP(hidden_sizes=(32,), output_dim=8, dtype=jnp.float32)
+    mp32 = mlp.init(jax.random.PRNGKey(1), x)
+    mp16 = jax.tree_util.tree_map(lambda p: p.astype(jnp.bfloat16), mp32)
+    y16 = mlp.apply(mp16, x)
+    assert y16.dtype == jnp.float32  # f32 head compute from bf16 storage
+
+
+def test_to_param_dtype_nested_exclude():
+    """exclude matches dict keys at any depth: p2e's ensemble critics keep
+    their nested EMA ``target_module`` subtrees in f32 while the trainable
+    ``module`` subtrees get bf16 storage."""
+    runtime = MeshRuntime(devices=1, accelerator="cpu", precision="bf16-true").launch()
+    tree = {
+        "critics_exploration": {
+            "intrinsic": {
+                "module": {"w": jnp.ones((4, 4), jnp.float32)},
+                "target_module": {"w": jnp.ones((4, 4), jnp.float32)},
+            }
+        }
+    }
+    cast = runtime.to_param_dtype(tree, exclude=("target_module",))
+    sub = cast["critics_exploration"]["intrinsic"]
+    assert sub["module"]["w"].dtype == jnp.bfloat16
+    assert sub["target_module"]["w"].dtype == jnp.float32
+
+
+def test_restore_opt_states_migrates_to_bf16_true():
+    """Checkpoint migration happens at RESTORE time (host-side — the
+    scan-based train steps need a structure-stable opt-state carry): an opt
+    state saved WITHOUT master weights (older bf16-true run, or a 32-true
+    checkpoint resumed at bf16-true) gets wrapped with an f32 master
+    synthesized from the paired params."""
+    from sheeprl_tpu.optim import restore_opt_states
+
+    params32 = {"w": jnp.full((4,), 0.5, jnp.float32)}
+    inner = optax.sgd(0.1)
+    plain_state = inner.init(params32)  # what an old checkpoint stored
+
+    params16 = jax.tree_util.tree_map(lambda p: p.astype(jnp.bfloat16), params32)
+    migrated = restore_opt_states(plain_state, params16, "bf16-true")
+    assert isinstance(migrated, MasterWeightsState)
+    assert migrated.master["w"].dtype == jnp.float32
+
+    wrapped = master_weights(inner)
+    grads = {"w": jnp.ones((4,), jnp.bfloat16)}
+    updates, new_state = wrapped.update(grads, migrated, params16)
+    assert isinstance(new_state, MasterWeightsState)
+    new_params = optax.apply_updates(params16, updates)
+    np.testing.assert_allclose(
+        np.asarray(new_params["w"], np.float32),
+        np.asarray((0.5 - 0.1 * 1.0) * np.ones(4), np.float32).astype(jnp.bfloat16),
+    )
+    # an unmigrated plain state is an actionable error, not a scan crash
+    with pytest.raises(TypeError, match="restore_opt_states"):
+        wrapped.update(grads, plain_state, params16)
+
+
+def test_restore_opt_states_migrates_from_bf16_true():
+    """Reverse migration: a MasterWeightsState checkpoint resumed at
+    32-true unwraps to the inner state (f32 moments as-is); per-component
+    dicts recurse with key_map renames (SAC's alpha -> log_alpha)."""
+    from sheeprl_tpu.optim import restore_opt_states
+
+    params = {"w": jnp.full((4,), 0.5, jnp.float32)}
+    tx = build_optimizer({"_target_": "optax.sgd", "lr": 0.1}, precision="32-true")
+    wrapped = master_weights(optax.sgd(0.1))
+    saved = wrapped.init(params)  # what a bf16-true checkpoint stored
+    restored = restore_opt_states(saved, params, "32-true")
+    assert not isinstance(restored, MasterWeightsState)
+    grads = {"w": jnp.ones((4,), jnp.float32)}
+    updates, new_state = tx.update(grads, restored, params)
+    assert not isinstance(new_state, MasterWeightsState)
+    new_params = optax.apply_updates(params, updates)
+    np.testing.assert_allclose(np.asarray(new_params["w"]), 0.4 * np.ones(4), rtol=1e-6)
+
+    # dict-of-components with key_map: "alpha" pairs with params["log_alpha"]
+    comp_params = {"log_alpha": jnp.zeros((), jnp.float32)}
+    comp_saved = {"alpha": optax.sgd(0.1).init(comp_params["log_alpha"])}
+    out = restore_opt_states(comp_saved, comp_params, "bf16-true", key_map={"alpha": "log_alpha"})
+    assert isinstance(out["alpha"], MasterWeightsState)
