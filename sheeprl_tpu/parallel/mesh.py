@@ -95,9 +95,37 @@ class MeshRuntime:
                 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
         except Exception:
             pass
-        if self._num_nodes > 1 and jax.process_count() == 1:
-            # multi-host rendezvous (reads JAX coordinator env vars)
-            jax.distributed.initialize()
+        # NOTE: the guard must not call jax.process_count() — that would
+        # initialize the XLA backend, after which distributed.initialize()
+        # refuses to run
+        if self._num_nodes > 1 and not jax.distributed.is_initialized():
+            # multi-host rendezvous. Cloud TPU / SLURM / MPI environments
+            # auto-detect coordinator settings; plain CPU/GPU clusters (and
+            # the 2-process test in tests/test_parallel) pass them
+            # explicitly via SHEEPRL_COORDINATOR_ADDRESS / _NUM_PROCESSES /
+            # _PROCESS_ID.  Counterpart of the reference's
+            # TorchCollective.setup + env:// init (SURVEY.md §5.8).
+            init_kwargs = {}
+            addr = os.environ.get("SHEEPRL_COORDINATOR_ADDRESS")
+            if addr:
+                missing = [
+                    k
+                    for k in ("SHEEPRL_NUM_PROCESSES", "SHEEPRL_PROCESS_ID")
+                    if k not in os.environ
+                ]
+                if missing:
+                    raise RuntimeError(
+                        "SHEEPRL_COORDINATOR_ADDRESS is set but "
+                        + " and ".join(missing)
+                        + " is not; the three variables must be set together "
+                        "for an explicit multi-host rendezvous."
+                    )
+                init_kwargs = dict(
+                    coordinator_address=addr,
+                    num_processes=int(os.environ["SHEEPRL_NUM_PROCESSES"]),
+                    process_id=int(os.environ["SHEEPRL_PROCESS_ID"]),
+                )
+            jax.distributed.initialize(**init_kwargs)
         backend = self._resolve_backend()
         try:
             devices = jax.devices(backend)
@@ -363,9 +391,19 @@ class MeshRuntime:
         broadcast/gather of config/metric dicts."""
         if jax.process_count() == 1:
             return [obj]
+        import pickle
+
         from jax.experimental import multihost_utils
 
-        return list(multihost_utils.process_allgather(obj))
+        # process_allgather only moves numeric arrays, so arbitrary objects
+        # ride as pickled uint8 payloads padded to the global max length
+        # (same trick as torch.distributed.all_gather_object)
+        payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+        sizes = np.asarray(multihost_utils.process_allgather(np.asarray([payload.size]))).reshape(-1)
+        padded = np.zeros((int(sizes.max()),), np.uint8)
+        padded[: payload.size] = payload
+        gathered = np.asarray(multihost_utils.process_allgather(padded))
+        return [pickle.loads(gathered[i, : int(sizes[i])].tobytes()) for i in range(len(sizes))]
 
     def barrier(self) -> None:
         if jax.process_count() > 1:
