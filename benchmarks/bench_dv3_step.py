@@ -45,9 +45,13 @@ def build(fused: bool, precision: str):
     obs_space = gym.spaces.Dict({"rgb": gym.spaces.Box(0, 255, (64, 64, 3), np.uint8)})
     actions_dim = (6,)
     world_model, actor, critic, params = build_agent(runtime, actions_dim, True, cfg, obs_space)
-    wm_tx = _make_optimizer(cfg.algo.world_model.optimizer, cfg.algo.world_model.clip_gradients)
-    actor_tx = _make_optimizer(cfg.algo.actor.optimizer, cfg.algo.actor.clip_gradients)
-    critic_tx = _make_optimizer(cfg.algo.critic.optimizer, cfg.algo.critic.clip_gradients)
+    # same storage/optimizer policy as the training CLI (dreamer_v3.py main):
+    # bf16-true stores params in bfloat16 with f32 master weights in the
+    # optimizer and keeps the EMA target critic f32
+    params = runtime.to_param_dtype(params, exclude=("target_critic",))
+    wm_tx = _make_optimizer(cfg.algo.world_model.optimizer, cfg.algo.world_model.clip_gradients, precision)
+    actor_tx = _make_optimizer(cfg.algo.actor.optimizer, cfg.algo.actor.clip_gradients, precision)
+    critic_tx = _make_optimizer(cfg.algo.critic.optimizer, cfg.algo.critic.clip_gradients, precision)
     opt_states = {
         "world_model": wm_tx.init(params["world_model"]),
         "actor": actor_tx.init(params["actor"]),

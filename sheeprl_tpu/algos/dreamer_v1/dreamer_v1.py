@@ -152,8 +152,13 @@ def make_train_fn(runtime, world_model, actor, critic, txs, cfg, is_continuous, 
         new_wm_params = optax.apply_updates(params["world_model"], updates)
 
         # ---------------------------------------------------- imagination
-        imagined_prior0 = sg(wm_aux["posteriors"]).reshape(T * B, stochastic_size)
-        recurrent_state0 = sg(wm_aux["recurrent_states"]).reshape(T * B, recurrent_state_size)
+        # B-MAJOR flatten (T,B,..)->(B,T,..)->(B*T,..): keeps the mesh's
+        # batch sharding through the merge (a T-major flatten interleaves
+        # the shards and GSPMD replicates the imagination phase on every
+        # device); downstream ops reduce over the merged axis, so the
+        # order change is semantics-free
+        imagined_prior0 = sg(wm_aux["posteriors"]).swapaxes(0, 1).reshape(T * B, stochastic_size)
+        recurrent_state0 = sg(wm_aux["recurrent_states"]).swapaxes(0, 1).reshape(T * B, recurrent_state_size)
 
         def actor_loss_fn(actor_params):
             img_keys = jax.random.split(k_img, horizon)

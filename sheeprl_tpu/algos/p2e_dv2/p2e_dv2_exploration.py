@@ -310,9 +310,14 @@ def make_train_fn(runtime, world_model, actor, critic, ensemble, txs, cfg, is_co
         updates, new_ens_opt = ens_tx.update(ens_grads, opt_states["ensembles"], params["ensembles"])
         new_ens_params = optax.apply_updates(params["ensembles"], updates)
 
-        imagined_prior0 = posteriors_flat.reshape(T * B, stoch_state_size)
-        recurrent_state0 = recurrent_states.reshape(T * B, recurrent_state_size)
-        true_continue = (1 - data["terminated"]).reshape(T * B, 1) * gamma
+        # B-MAJOR flatten (T,B,..)->(B,T,..)->(B*T,..): keeps the mesh's
+        # batch sharding through the merge (a T-major flatten interleaves
+        # the shards and GSPMD replicates the imagination phase on every
+        # device); downstream ops reduce over the merged axis, so the
+        # order change is semantics-free
+        imagined_prior0 = posteriors_flat.swapaxes(0, 1).reshape(T * B, stoch_state_size)
+        recurrent_state0 = recurrent_states.swapaxes(0, 1).reshape(T * B, recurrent_state_size)
+        true_continue = (1 - data["terminated"]).swapaxes(0, 1).reshape(T * B, 1) * gamma
 
         # ------------------------------------- exploration behavior
         (

@@ -261,9 +261,14 @@ def make_train_fn(
         updates, new_ens_opt = ens_tx.update(ens_grads, opt_states["ensembles"], params["ensembles"])
         new_ens_params = optax.apply_updates(params["ensembles"], updates)
 
-        imagined_prior0 = posts_flat.reshape(T * B, stoch_state_size)
-        recurrent_state0 = rec_states.reshape(T * B, recurrent_state_size)
-        true_continue = (1 - data["terminated"]).reshape(T * B, 1)
+        # B-MAJOR flatten (T,B,..)->(B,T,..)->(B*T,..): keeps the mesh's
+        # batch sharding through the merge (a T-major flatten interleaves
+        # the shards and GSPMD replicates the imagination phase on every
+        # device); downstream ops reduce over the merged axis, so the
+        # order change is semantics-free
+        imagined_prior0 = posts_flat.swapaxes(0, 1).reshape(T * B, stoch_state_size)
+        recurrent_state0 = rec_states.swapaxes(0, 1).reshape(T * B, recurrent_state_size)
+        true_continue = (1 - data["terminated"]).swapaxes(0, 1).reshape(T * B, 1)
 
         # ------------------------------------- exploration behavior
         def actor_expl_loss_fn(actor_params):

@@ -392,7 +392,15 @@ def main(runtime, cfg: Dict[str, Any]):
                 k: v.astype(np.float32) if v.dtype not in (np.uint8,) else v
                 for k, v in local_data.items()
             }
-            device_next_obs = {k: jnp.asarray(v) for k, v in final_obs.items()}
+            # env-axis sharding feeds each mesh device only its columns
+            # (the shard_map update path consumes this layout); the
+            # decoupled rollout's env axis is num_envs itself, so an
+            # indivisible count stays unsharded (replicated fallback)
+            if next(iter(local_data.values())).shape[1] % runtime.world_size == 0:
+                local_data = runtime.shard_batch(local_data, axis=1)
+                device_next_obs = runtime.shard_batch(dict(final_obs), axis=0)
+            else:
+                device_next_obs = {k: jnp.asarray(v) for k, v in final_obs.items()}
 
             with timer("Time/train_time", SumMetric, sync_on_compute=cfg.metric.sync_on_compute):
                 params, opt_state, train_metrics = update_fn(
