@@ -275,6 +275,10 @@ def main(runtime, cfg: Dict[str, Any]):
                     k: np.asarray(v, np.float32).reshape(bs, *v.shape[2:])
                     for k, v in actor_sample.items()
                 }
+                # shard the batch axes over the mesh so each device trains
+                # on its own rows (GSPMD inserts the grad psums)
+                critic_data = runtime.shard_batch(critic_data, axis=1)
+                actor_data = runtime.shard_batch(actor_data, axis=0)
                 with timer("Time/train_time", SumMetric, sync_on_compute=cfg.metric.sync_on_compute):
                     params, opt_states, train_metrics = train_fn(
                         params, opt_states, critic_data, actor_data, runtime.next_key()

@@ -411,6 +411,9 @@ def main(runtime, cfg: Dict[str, Any]):
                     )
                     for k, v in sample.items()
                 }
+                # shard the batch axis over the mesh so each device
+                # trains on its own rows (GSPMD inserts the grad psums)
+                data = runtime.shard_batch(data, axis=1)
                 with timer("Time/train_time", SumMetric, sync_on_compute=cfg.metric.sync_on_compute):
                     params, opt_states, train_metrics = train_fn(
                         params,
