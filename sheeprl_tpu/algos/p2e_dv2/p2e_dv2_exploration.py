@@ -665,7 +665,11 @@ def main(runtime, cfg: Dict[str, Any]):
                     prioritize_ends=cfg.buffer.get("prioritize_ends", False),
                 )
                 with timer("Time/train_time", SumMetric, sync_on_compute=cfg.metric.sync_on_compute):
-                    with batched_feed(local_data, per_rank_gradient_steps) as feed:
+                    with batched_feed(
+                        local_data,
+                        per_rank_gradient_steps,
+                        sharding=runtime.batch_sharding(axis=1),
+                    ) as feed:
                         for batch in feed:
                             if (
                                 cumulative_per_rank_gradient_steps

@@ -16,7 +16,9 @@ from typing import Any, Callable, Dict, Iterator, Optional
 import jax
 
 
-def batched_feed(local_data: Dict[str, Any], n_batches: int, depth: int = 2) -> "DevicePrefetcher":
+def batched_feed(
+    local_data: Dict[str, Any], n_batches: int, depth: int = 2, sharding: Any = None
+) -> "DevicePrefetcher":
     """Prefetcher over the leading (n_samples) axis of a sampled buffer dict:
     yields ``n_batches`` batches, each ``device_put`` on the worker thread
     so the host->HBM copy of batch i+1 overlaps gradient step i. uint8
@@ -24,7 +26,10 @@ def batched_feed(local_data: Dict[str, Any], n_batches: int, depth: int = 2) -> 
     jitted train steps normalize on device); everything else is float32.
 
     Drop-in for the Dreamer-family gradient-step loops' per-step
-    ``jnp.asarray(v[i])`` conversion."""
+    ``jnp.asarray(v[i])`` conversion.  Pass ``sharding`` (e.g.
+    ``runtime.batch_sharding(axis=1)``) so multi-device runs place each
+    device's batch columns directly — an unsharded device_put lands
+    replicated and the train step computes redundantly on every device."""
     import numpy as np
 
     counter = iter(range(n_batches))
@@ -38,7 +43,7 @@ def batched_feed(local_data: Dict[str, Any], n_batches: int, depth: int = 2) -> 
             for k, v in local_data.items()
         }
 
-    return DevicePrefetcher(producer, depth=depth)
+    return DevicePrefetcher(producer, sharding=sharding, depth=depth)
 
 
 class DevicePrefetcher:

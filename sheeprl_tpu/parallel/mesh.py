@@ -269,10 +269,12 @@ class MeshRuntime:
         """NamedSharding with the given axis names over array dims."""
         return NamedSharding(self.mesh, P(*axes))
 
-    @property
-    def batch_sharding(self) -> NamedSharding:
-        """Shard dim 0 over the data axis (per-device minibatch split)."""
-        return NamedSharding(self.mesh, P("data"))
+    def batch_sharding(self, axis: int = 0) -> NamedSharding:
+        """Sharding that splits ``axis`` over the data axis (per-device
+        minibatch split; pass to device_put / DevicePrefetcher so batches
+        land already distributed)."""
+        spec = tuple([None] * axis + ["data"])
+        return NamedSharding(self.mesh, P(*spec))
 
     @property
     def replicated(self) -> NamedSharding:
@@ -283,9 +285,7 @@ class MeshRuntime:
 
         Every leaf's ``axis`` dim must be divisible by world_size.
         """
-        spec = tuple([None] * axis + ["data"])
-        sharding = NamedSharding(self.mesh, P(*spec))
-        return jax.device_put(batch, sharding)
+        return jax.device_put(batch, self.batch_sharding(axis))
 
     def replicate(self, tree: Any) -> Any:
         """Place params/opt-state on the mesh.
