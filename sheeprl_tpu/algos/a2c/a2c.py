@@ -42,7 +42,17 @@ def make_update_fn(runtime, module, tx, cfg: Dict[str, Any], obs_keys: Sequence[
     normalize_adv = bool(cfg.algo.get("normalize_advantages", False))
     ent_coef = float(cfg.algo.ent_coef)
 
-    def update(params, opt_state, data, next_obs, key, lr):
+    world_size = int(runtime.world_size)
+
+    def _core(params, opt_state, data, next_obs, key, local_mb, pmean_axis):
+        """GAE + shuffled minibatch gradient ACCUMULATION + one update.
+
+        Runs either on the whole rollout (single device) or, under
+        shard_map, on a rank's env columns with ``local_mb`` rows per
+        minibatch and a ``pmean`` over ``pmean_axis`` before the single
+        optimizer step — the accumulate-then-step structure means the
+        rank-local decomposition is EXACTLY the global computation
+        (sum over minibatches of per-minibatch means)."""
         next_values = get_values(
             module, params, normalize_obs({k: next_obs[k].astype(jnp.float32) for k in obs_keys}, (), obs_keys)
         )
@@ -52,10 +62,8 @@ def make_update_fn(runtime, module, tx, cfg: Dict[str, Any], obs_keys: Sequence[
         data = {**data, "returns": returns, "advantages": advantages}
         n_total = data["rewards"].shape[0] * data["rewards"].shape[1]
         flat = {k: v.reshape(n_total, *v.shape[2:]) for k, v in data.items()}
-        num_minibatches = max(1, -(-n_total // mb_size))
-        n_used = num_minibatches * mb_size
-
-        opt_state = _set_lr(opt_state, lr)
+        num_minibatches = max(1, -(-n_total // local_mb))
+        n_used = num_minibatches * local_mb
 
         def loss_fn(p, mb):
             obs = normalize_obs({k: mb[k].astype(jnp.float32) for k in obs_keys}, (), obs_keys)
@@ -72,7 +80,7 @@ def make_update_fn(runtime, module, tx, cfg: Dict[str, Any], obs_keys: Sequence[
         if n_used > n_total:  # pad by wrapping as many times as needed
             perm = jnp.tile(perm, -(-n_used // n_total))[:n_used]
         shuffled = jax.tree_util.tree_map(
-            lambda x: x[perm].reshape(num_minibatches, mb_size, *x.shape[1:]), flat
+            lambda x: x[perm].reshape(num_minibatches, local_mb, *x.shape[1:]), flat
         )
 
         def mb_step(acc, mb):
@@ -82,6 +90,9 @@ def make_update_fn(runtime, module, tx, cfg: Dict[str, Any], obs_keys: Sequence[
 
         zero_grads = jax.tree_util.tree_map(jnp.zeros_like, params)
         grads, losses = jax.lax.scan(mb_step, zero_grads, shuffled)
+        if pmean_axis is not None:
+            grads = jax.lax.pmean(grads, pmean_axis)
+            losses = jax.lax.pmean(losses, pmean_axis)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         mean_losses = losses.mean(0)
@@ -89,6 +100,33 @@ def make_update_fn(runtime, module, tx, cfg: Dict[str, Any], obs_keys: Sequence[
             "Loss/policy_loss": mean_losses[0],
             "Loss/value_loss": mean_losses[1],
         }
+
+    def update(params, opt_state, data, next_obs, key, lr):
+        opt_state = _set_lr(opt_state, lr)
+        if runtime.ddp_gate(data["rewards"].shape[1], "A2C"):
+            # rank-local DDP core: the epoch-shuffle gather cannot stay
+            # sharded under GSPMD (it would replicate the whole update on
+            # every device — see ppo.py's _update_shard_map)
+            from jax.sharding import PartitionSpec as SMP
+
+            data_specs = jax.tree_util.tree_map(lambda _: SMP(None, "data"), data)
+            obs_specs = jax.tree_util.tree_map(lambda _: SMP("data"), next_obs)
+
+            def body(params, opt_state, data, next_obs, key):
+                rank_key = jax.random.fold_in(key, jax.lax.axis_index("data"))
+                return _core(
+                    params, opt_state, data, next_obs, rank_key,
+                    mb_size // world_size, "data",
+                )
+
+            return jax.shard_map(
+                body,
+                mesh=runtime.mesh,
+                in_specs=(SMP(), SMP(), data_specs, obs_specs, SMP()),
+                out_specs=(SMP(), SMP(), SMP()),
+                check_vma=False,
+            )(params, opt_state, data, next_obs, key)
+        return _core(params, opt_state, data, next_obs, key, mb_size, None)
 
     return runtime.setup_step(update, donate_argnums=(0, 1))
 
@@ -232,7 +270,11 @@ def main(runtime, cfg: Dict[str, Any]):
 
         local_data = rb.to_arrays()
         local_data = {k: v.astype(jnp.float32) for k, v in local_data.items()}
-        device_next_obs = {k: jnp.asarray(next_obs_np[k]) for k in obs_keys}
+        # env-axis sharding: each mesh device receives only its columns
+        local_data = runtime.shard_batch(local_data, axis=1)
+        device_next_obs = runtime.shard_batch(
+            {k: np.asarray(next_obs_np[k]) for k in obs_keys}, axis=0
+        )
 
         with timer("Time/train_time", SumMetric, sync_on_compute=cfg.metric.sync_on_compute):
             params, opt_state, train_metrics = update_fn(

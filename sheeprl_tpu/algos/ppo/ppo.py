@@ -108,11 +108,6 @@ def make_update_fn(
     update_epochs = int(cfg.algo.update_epochs)
     share_data = bool(cfg.buffer.get("share_data", False))
     world_size = int(runtime.world_size)
-    # the sharded (shard_map) multi-device path needs an evenly divisible
-    # env axis and replicated params (strategy != fsdp); otherwise the
-    # update falls back to the replicated GSPMD program — correct but with
-    # NO data-parallel speedup (every device computes the full update)
-    use_shard_map = world_size > 1 and runtime.strategy != "fsdp"
     mb_size = int(cfg.algo.per_rank_batch_size) * runtime.world_size
     gamma = float(cfg.algo.gamma)
     gae_lambda = float(cfg.algo.gae_lambda)
@@ -239,7 +234,7 @@ def make_update_fn(
     def update(params, opt_state, data, next_obs, key, clip_coef, ent_coef, lr):
         # inject the (possibly annealed) learning rate
         opt_state = _set_lr(opt_state, lr)
-        if use_shard_map and data["rewards"].shape[1] % world_size == 0:
+        if runtime.ddp_gate(data["rewards"].shape[1], "PPO"):
             # explicit DDP mapping: GSPMD cannot keep the epoch-shuffle
             # gather sharded (a data-dependent x[perm] over the flattened
             # rollout replicates the WHOLE update on every device), so the
@@ -247,18 +242,6 @@ def make_update_fn(
             # shard_map with rank-local permutations and an explicit
             # pmean of the gradients
             return _update_shard_map(params, opt_state, data, next_obs, key, clip_coef, ent_coef)
-        if world_size > 1:
-            import warnings
-
-            reason = (
-                "strategy=fsdp keeps params sharded, which the DDP shard_map core does not support"
-                if runtime.strategy == "fsdp"
-                else f"env axis {data['rewards'].shape[1]} is not divisible by world_size={world_size}"
-            )
-            warnings.warn(
-                f"multi-device PPO update falling back to the replicated GSPMD path "
-                f"(correct, but every device computes the FULL update — no DP speedup): {reason}."
-            )
         flat, n_total = _gae_and_flatten(params, data, next_obs)
         num_minibatches = max(1, -(-n_total // mb_size))
         n_used = num_minibatches * mb_size

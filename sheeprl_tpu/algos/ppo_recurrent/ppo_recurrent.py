@@ -62,7 +62,9 @@ def make_update_fn(runtime, module, tx, cfg: Dict[str, Any], obs_keys: Sequence[
     normalize_adv = bool(cfg.algo.normalize_advantages)
     reset_on_done = bool(cfg.algo.reset_recurrent_state_on_done)
 
-    def update(params, opt_state, data, next_values, key, clip_coef, ent_coef, lr):
+    world_size = int(runtime.world_size)
+
+    def _core(params, opt_state, data, next_values, key, clip_coef, ent_coef, pmean_axis):
         # ------------------------------------------------- GAE on (T, B)
         returns, advantages = gae(
             data["rewards"], data["values"], data["dones"], next_values, gamma, gae_lambda
@@ -97,8 +99,6 @@ def make_update_fn(runtime, module, tx, cfg: Dict[str, Any], obs_keys: Sequence[
         num_minibatches = max(1, -(-n_seqs // mb_size))
         n_used = num_minibatches * mb_size
 
-        opt_state = _set_lr(opt_state, lr)
-
         def loss_fn(p, mb, mb_hx, mb_cx):
             obs = {k: mb[k].astype(jnp.float32) for k in obs_keys}
             obs = normalize_obs(obs, cnn_keys, obs_keys)
@@ -121,6 +121,10 @@ def make_update_fn(runtime, module, tx, cfg: Dict[str, Any], obs_keys: Sequence[
             params, opt_state = carry
             mb, mb_hx, mb_cx = inp
             grads, losses = grad_fn(params, mb, mb_hx, mb_cx)
+            if pmean_axis is not None:
+                # DDP gradient all-reduce across the rank-local sequences
+                grads = jax.lax.pmean(grads, pmean_axis)
+                losses = jax.lax.pmean(losses, pmean_axis)
             updates, opt_state = tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             return (params, opt_state), losses
@@ -152,6 +156,34 @@ def make_update_fn(runtime, module, tx, cfg: Dict[str, Any], obs_keys: Sequence[
             "Loss/entropy_loss": mean_losses[2],
         }
         return params, opt_state, metrics
+
+    def update(params, opt_state, data, next_values, key, clip_coef, ent_coef, lr):
+        opt_state = _set_lr(opt_state, lr)
+        if runtime.ddp_gate(data["rewards"].shape[1], "recurrent-PPO"):
+            # rank-local DDP core under shard_map: the sequence-shuffle
+            # gather cannot stay sharded under GSPMD (it would replicate
+            # the whole BPTT update on every device — see ppo.py's
+            # _update_shard_map); each rank chunks and shuffles its own
+            # env columns' sequences (per_rank_num_batches is per-rank by
+            # definition) with a pmean per minibatch step
+            from jax.sharding import PartitionSpec as SMP
+
+            data_specs = jax.tree_util.tree_map(lambda _: SMP(None, "data"), data)
+
+            def body(params, opt_state, data, next_values, key, clip_coef, ent_coef):
+                rank_key = jax.random.fold_in(key, jax.lax.axis_index("data"))
+                return _core(
+                    params, opt_state, data, next_values, rank_key, clip_coef, ent_coef, "data"
+                )
+
+            return jax.shard_map(
+                body,
+                mesh=runtime.mesh,
+                in_specs=(SMP(), SMP(), data_specs, SMP("data"), SMP(), SMP(), SMP()),
+                out_specs=(SMP(), SMP(), SMP()),
+                check_vma=False,
+            )(params, opt_state, data, next_values, key, clip_coef, ent_coef)
+        return _core(params, opt_state, data, next_values, key, clip_coef, ent_coef, None)
 
     return runtime.setup_step(update, donate_argnums=(0, 1))
 
@@ -353,9 +385,13 @@ def main(runtime, cfg: Dict[str, Any]):
         local_data = {
             k: v.astype(jnp.float32) if v.dtype not in (jnp.uint8,) else v for k, v in local_data.items()
         }
+        # env-axis sharding: each mesh device receives only its columns
+        local_data = runtime.shard_batch(local_data, axis=1)
         # host round-trip: the player may live on the CPU backend while the
         # update runs under the accelerator mesh
-        next_values = jnp.asarray(np.asarray(player.get_values(next_obs_np)).reshape(total_envs, -1))
+        next_values = runtime.shard_batch(
+            np.asarray(player.get_values(next_obs_np)).reshape(total_envs, -1), axis=0
+        )
 
         with timer("Time/train_time", SumMetric, sync_on_compute=cfg.metric.sync_on_compute):
             params, opt_state, train_metrics = update_fn(

@@ -269,6 +269,33 @@ class MeshRuntime:
         """NamedSharding with the given axis names over array dims."""
         return NamedSharding(self.mesh, P(*axes))
 
+    def ddp_gate(self, batch_axis_size: int, algo: str = "") -> bool:
+        """Whether a rank-local DDP ``shard_map`` core applies: multi-device,
+        evenly divisible batch axis, and replicated (non-fsdp) params — the
+        shard_map cores declare params/opt-state replicated, which would
+        all-gather and destroy a ZeRO (fsdp) layout.  When it returns False
+        on a multi-device mesh, warns that the update runs on the
+        replicated GSPMD fallback (correct, but every device computes the
+        FULL update).  One gate shared by ppo/a2c/ppo_recurrent so the
+        fsdp guard and the warning cannot drift per algo."""
+        if self.world_size == 1:
+            return False
+        if self._strategy != "fsdp" and batch_axis_size % self.world_size == 0:
+            return True
+        import warnings
+
+        reason = (
+            "strategy=fsdp keeps params sharded, which the DDP shard_map core does not support"
+            if self._strategy == "fsdp"
+            else f"batch axis {batch_axis_size} is not divisible by world_size={self.world_size}"
+        )
+        warnings.warn(
+            f"multi-device {algo or 'train'} update falling back to the replicated GSPMD "
+            f"path (correct, but every device computes the FULL update — no DP speedup): "
+            f"{reason}."
+        )
+        return False
+
     def batch_sharding(self, axis: int = 0) -> NamedSharding:
         """Sharding that splits ``axis`` over the data axis (per-device
         minibatch split; pass to device_put / DevicePrefetcher so batches
