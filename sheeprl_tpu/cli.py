@@ -102,7 +102,21 @@ def resume_from_checkpoint(cfg: dotdict) -> dotdict:
     }
     learning_starts = cfg.algo.get("learning_starts")
     merged = dict(old_cfg)
-    deep_merge(merged, {"checkpoint": {"resume_from": ckpt_path}})
+    # checkpoint cadence knobs are OPERATIONAL, not training semantics:
+    # they follow the resuming invocation, so a resume chain can e.g.
+    # checkpoint more often than the original run did (deviation from the
+    # reference, whose resume pins the old cadence — cli.py:49-57)
+    deep_merge(
+        merged,
+        {
+            "checkpoint": {
+                "resume_from": ckpt_path,
+                "every": cfg.checkpoint.every,
+                "keep_last": cfg.checkpoint.keep_last,
+                "save_last": cfg.checkpoint.save_last,
+            }
+        },
+    )
     merged["algo"]["total_steps"] = kept["total_steps"]
     if learning_starts is not None:
         merged["algo"]["learning_starts"] = learning_starts

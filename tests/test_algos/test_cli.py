@@ -193,3 +193,25 @@ def test_sac_resume_with_dispatch_batch(tmp_path):
 
     assert any(load_checkpoint(c).get("pending_iters") for c in by_step[:-1])
     run(_sac_args(tmp_path) + [f"checkpoint.resume_from={by_step[-1]}", "algo.total_steps=24"])
+
+
+def test_resume_honors_new_checkpoint_cadence(tmp_path):
+    """checkpoint.every/keep_last are OPERATIONAL knobs: a resuming
+    invocation's values win over the checkpoint's saved config (deviation
+    from the reference, which pins the old cadence — needed so resume
+    chains can checkpoint more often than the original run)."""
+    from sheeprl_tpu.cli import resume_from_checkpoint
+    from sheeprl_tpu.config.compose import compose
+    from sheeprl_tpu.utils.utils import dotdict
+
+    ckpt = _train_and_get_ckpt(tmp_path, root="cli_cadence")
+    cfg = dotdict(
+        compose(
+            overrides=_ppo_args(tmp_path, root="cli_cadence")
+            + [f"checkpoint.resume_from={ckpt}", "checkpoint.every=123", "checkpoint.keep_last=7"]
+        )
+    )
+    merged = resume_from_checkpoint(cfg)
+    assert merged.checkpoint.every == 123
+    assert merged.checkpoint.keep_last == 7
+    assert merged.checkpoint.resume_from == ckpt
