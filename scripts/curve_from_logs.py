@@ -1,0 +1,95 @@
+"""Stitch a learning curve out of a train_chain.py run's leg logs.
+
+Each leg log contains ``Rank-0: policy_step=N, reward_env_i=R`` lines;
+legs overlap (a rotation replays the steps since the last checkpoint), so
+later legs OVERRIDE earlier ones on overlapping step ranges.  Emits one
+JSON artifact with the per-step mean/min/max across envs and a smoothed
+mean, ready for benchmarks/results/.
+
+Usage:
+    python scripts/curve_from_logs.py --chain-dir runs/dv3_walker/chain_r3 \
+        [--extra-log <earlier run log>] --out benchmarks/results/dv3_walker_curve_r3.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+
+LINE = re.compile(r"policy_step=(\d+), reward_env_(\d+)=([-+\d.eE]+)")
+
+
+def parse_log(path):
+    """-> {policy_step: {env_idx: reward}} for one leg log."""
+    out = {}
+    with open(path, errors="replace") as f:
+        for line in f:
+            m = LINE.search(line)
+            if m:
+                step, env, rew = int(m.group(1)), int(m.group(2)), float(m.group(3))
+                out.setdefault(step, {})[env] = rew
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--chain-dir", required=True)
+    ap.add_argument(
+        "--extra-log",
+        action="append",
+        default=[],
+        help="logs from BEFORE the chain (e.g. the original run), applied first",
+    )
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--smooth", type=int, default=5, help="moving-average window (points)")
+    args = ap.parse_args()
+
+    merged = {}
+    logs = list(args.extra_log) + sorted(glob.glob(os.path.join(args.chain_dir, "leg_*.log")))
+    for path in logs:
+        for step, envs in parse_log(path).items():
+            # later legs override replayed ranges
+            merged.setdefault(step, {}).update(envs)
+
+    points = []
+    for step in sorted(merged):
+        rews = list(merged[step].values())
+        points.append(
+            {
+                "policy_step": step,
+                "reward_mean": round(sum(rews) / len(rews), 2),
+                "reward_min": round(min(rews), 2),
+                "reward_max": round(max(rews), 2),
+                "n_envs": len(rews),
+            }
+        )
+    means = [p["reward_mean"] for p in points]
+    w = max(1, args.smooth)
+    for i, p in enumerate(points):
+        lo = max(0, i - w + 1)
+        p["reward_mean_smoothed"] = round(sum(means[lo : i + 1]) / (i + 1 - lo), 2)
+
+    artifact = {
+        "source_logs": logs,
+        "n_points": len(points),
+        "final_step": points[-1]["policy_step"] if points else 0,
+        "final_reward_mean": points[-1]["reward_mean"] if points else None,
+        "best_reward_mean": max(means) if means else None,
+        "curve": points,
+    }
+    if os.path.dirname(args.out):
+        os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(artifact, f, indent=2)
+    print(
+        json.dumps(
+            {k: artifact[k] for k in ("n_points", "final_step", "final_reward_mean", "best_reward_mean")}
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
