@@ -18,12 +18,14 @@ Driver contract (hardened after round 2's rc=124 timeout):
   re-emitted in canonical order (ppo, sac, dv3) so the flagship DV3 line
   is the last line of stdout.
 - Fixed costs (tunnel backend init, tracing, XLA compiles) are separated
-  from steady state: PPO and SAC run their CLI protocol TWICE — a short
-  run that pays the one-time costs, and a longer run whose EXTRA steps
-  are pure steady state — and the reported wall-clock is
-  ``steady_rate x 65536``.  This is conservative: the protocol's cheaper
-  warmup steps are billed at the full steady-state rate.  (Round 2's
-  naive ``elapsed x 65536/n`` rescaling inflated fixed costs instead.)
+  from steady state: PPO and SAC run their CLI protocol THREE times — a
+  short run that pays the one-time costs (cold compile or cache load), the
+  same short run again fully cached, and a longer cached run whose EXTRA
+  steps over the cached short run are pure steady state — and the reported
+  wall-clock is ``steady_rate x 65536``.  This is conservative: the
+  protocol's cheaper warmup steps are billed at the full steady-state
+  rate.  (Round 2's naive ``elapsed x 65536/n`` rescaling inflated fixed
+  costs; differencing long-vs-COLD went negative on a fresh machine.)
 - XLA executables hit the persistent compilation cache
   (``~/.cache/sheeprl_tpu_xla``, configured by MeshRuntime), so repeat
   runs pay trace+load (~10 s for DV3-S) rather than full compiles.
@@ -73,8 +75,9 @@ REFERENCE_DV3_FRAMES_PER_S = 2032.0
 FULL_STEPS = 65536
 TPU_V5E_BF16_PEAK_FLOPS = 197e12
 
-# (section, conservative wall-clock estimate used for skip decisions)
-SECTIONS = [("dv3", 60), ("ppo", 35), ("sac", 45)]
+# (section, conservative wall-clock estimate used for skip decisions);
+# ppo/sac cover three CLI runs each (cold + cached-warm + long)
+SECTIONS = [("dv3", 60), ("ppo", 40), ("sac", 50)]
 
 
 def _note(**kw):
@@ -96,29 +99,38 @@ def _note(**kw):
 def _cli_steady_rate(overrides, n_warm, n_long):
     """Seconds per policy step in steady state for a CLI protocol.
 
-    Runs the protocol at ``n_warm`` steps (pays backend init, tracing,
-    XLA compile, env creation) and again at ``n_long`` steps; the extra
-    ``n_long - n_warm`` steps of the second run are pure steady state.
-    The second run re-traces but hits the in-process and persistent XLA
-    caches; any residual fixed cost it pays only makes the estimate more
-    conservative.
+    Runs the protocol at ``n_warm`` steps TWICE — the first pays every
+    one-time cost (backend init, tracing, XLA compile or persistent-cache
+    load, env creation), the second hits all caches — and once at
+    ``n_long`` steps.  The extra ``n_long - n_warm`` steps of the long
+    run over the *cached* warm run are pure steady state.  Differencing
+    against the cold first run instead would go NEGATIVE on a fresh
+    machine (cold compiles dwarf the extra steps — observed round 3:
+    rate clamped to ~0 and the vs_baseline division blew up), so the
+    cold run is used for nothing but warming.  Any residual fixed cost
+    the long run pays only makes the estimate more conservative.
     """
     from sheeprl_tpu.cli import run
 
+    tic = time.perf_counter()
+    run(overrides + [f"algo.total_steps={n_warm}"])
+    t_cold = time.perf_counter() - tic
     tic = time.perf_counter()
     run(overrides + [f"algo.total_steps={n_warm}"])
     t_warm = time.perf_counter() - tic
     tic = time.perf_counter()
     run(overrides + [f"algo.total_steps={n_long}"])
     t_long = time.perf_counter() - tic
-    rate = max(t_long - t_warm, 1e-9) / (n_long - n_warm)
-    return rate, t_warm, t_long
+    # fallback (never negative): bill the whole cached long run instead
+    steady = t_long - t_warm if t_long > t_warm else t_long
+    rate = max(steady, 1e-3) / (n_long - n_warm)
+    return rate, t_cold, t_warm, t_long
 
 
 def bench_ppo():
     n_long = max(int(os.environ.get("BENCH_PPO_STEPS", 17408)), 256)
     n_warm = max(min(1024, n_long // 2), 128)
-    rate, t_warm, t_long = _cli_steady_rate(
+    rate, t_cold, t_warm, t_long = _cli_steady_rate(
         ["exp=ppo_benchmarks", "root_dir=/tmp/sheeprl_tpu_bench/ppo"], n_warm, n_long
     )
     value = round(rate * FULL_STEPS, 2)
@@ -128,14 +140,14 @@ def bench_ppo():
         "unit": "s",
         "vs_baseline": round(REFERENCE_PPO_SECONDS / value, 3),
         "method": f"steady-state {n_long - n_warm} steps x {rate * 1e3:.3f} ms/step -> 65536",
-        "measured_s": [round(t_warm, 2), round(t_long, 2)],
+        "measured_s": [round(t_cold, 2), round(t_warm, 2), round(t_long, 2)],
     }
 
 
 def bench_sac():
     n_long = max(int(os.environ.get("BENCH_SAC_STEPS", 5120)), 256)
     n_warm = max(min(1024, n_long // 2), 128)
-    rate, t_warm, t_long = _cli_steady_rate(
+    rate, t_cold, t_warm, t_long = _cli_steady_rate(
         [
             "exp=sac_benchmarks",
             "algo.dispatch_batch=64",
@@ -151,7 +163,7 @@ def bench_sac():
         "unit": "s",
         "vs_baseline": round(REFERENCE_SAC_SECONDS / value, 3),
         "method": f"steady-state {n_long - n_warm} steps x {rate * 1e3:.3f} ms/step -> 65536",
-        "measured_s": [round(t_warm, 2), round(t_long, 2)],
+        "measured_s": [round(t_cold, 2), round(t_warm, 2), round(t_long, 2)],
     }
 
 

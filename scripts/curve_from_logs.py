@@ -29,8 +29,11 @@ def parse_log(path):
         for line in f:
             m = LINE.search(line)
             if m:
-                step, env, rew = int(m.group(1)), int(m.group(2)), float(m.group(3))
-                out.setdefault(step, {})[env] = rew
+                try:
+                    rew = float(m.group(3))
+                except ValueError:  # torn tail line from a SIGKILL'd leg
+                    continue
+                out.setdefault(int(m.group(1)), {})[int(m.group(2))] = rew
     return out
 
 
@@ -50,8 +53,18 @@ def main():
     merged = {}
     logs = list(args.extra_log) + sorted(glob.glob(os.path.join(args.chain_dir, "leg_*.log")))
     for path in logs:
-        for step, envs in parse_log(path).items():
-            # later legs override replayed ranges
+        parsed = parse_log(path)
+        if not parsed:
+            continue
+        # A later leg resumes from a checkpoint BEFORE the previous leg's
+        # kill point and replays that range along a fresh trajectory, so it
+        # overrides everything from its first logged step on — episode ends
+        # land on different (step, env) pairs, so a keywise update would
+        # blend the abandoned trajectory's points into the replayed window.
+        first = min(parsed)
+        for step in [s for s in merged if s >= first]:
+            del merged[step]
+        for step, envs in parsed.items():
             merged.setdefault(step, {}).update(envs)
 
     points = []
