@@ -121,9 +121,11 @@ def _cli_steady_rate(overrides, n_warm, n_long):
     tic = time.perf_counter()
     run(overrides + [f"algo.total_steps={n_long}"])
     t_long = time.perf_counter() - tic
-    # fallback (never negative): bill the whole cached long run instead
+    # fallback (never negative): bill the whole cached long run instead;
+    # the floor is on the RATE so the extrapolated value can never round
+    # to 0.0 and blow up the vs_baseline division (10 us/step floor)
     steady = t_long - t_warm if t_long > t_warm else t_long
-    rate = max(steady, 1e-3) / (n_long - n_warm)
+    rate = max(steady / (n_long - n_warm), 1e-5)
     return rate, t_cold, t_warm, t_long
 
 

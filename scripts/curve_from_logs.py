@@ -50,6 +50,22 @@ def main():
     ap.add_argument("--smooth", type=int, default=5, help="moving-average window (points)")
     args = ap.parse_args()
 
+    # resume step per leg from the chain's status.jsonl: rewards are only
+    # logged at episode ends, so a leg's first LOGGED step can be hundreds
+    # of steps past its resume checkpoint — the override boundary must be
+    # the checkpoint step or stale points blend into that window
+    resume_step = {}
+    status_path = os.path.join(args.chain_dir, "status.jsonl")
+    if os.path.exists(status_path):
+        with open(status_path, errors="replace") as f:
+            for line in f:
+                try:
+                    ev = json.loads(line)
+                except ValueError:
+                    continue
+                if ev.get("event") == "leg_start":
+                    resume_step[int(ev["leg"])] = int(ev.get("from_step") or 0)
+
     merged = {}
     logs = list(args.extra_log) + sorted(glob.glob(os.path.join(args.chain_dir, "leg_*.log")))
     for path in logs:
@@ -58,10 +74,11 @@ def main():
             continue
         # A later leg resumes from a checkpoint BEFORE the previous leg's
         # kill point and replays that range along a fresh trajectory, so it
-        # overrides everything from its first logged step on — episode ends
-        # land on different (step, env) pairs, so a keywise update would
-        # blend the abandoned trajectory's points into the replayed window.
-        first = min(parsed)
+        # overrides everything from its resume step on — episode ends land
+        # on different (step, env) pairs, so a keywise update would blend
+        # the abandoned trajectory's points into the replayed window.
+        m = re.search(r"leg_(\d+)\.log$", os.path.basename(path))
+        first = resume_step.get(int(m.group(1)), min(parsed)) if m else min(parsed)
         for step in [s for s in merged if s >= first]:
             del merged[step]
         for step, envs in parsed.items():

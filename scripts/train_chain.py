@@ -129,8 +129,11 @@ def main() -> int:
             cmd.append(f"checkpoint.resume_from={ckpt}")
         note(event="leg_start", leg=leg, from_step=step, ckpt=ckpt)
         t_leg = time.time()
+        # unbuffered: reward lines must reach the log file as they happen,
+        # or a SIGKILL'd leg loses the buffered tail the curve stitcher needs
+        leg_env = {**os.environ, "PYTHONUNBUFFERED": "1"}
         with open(leg_log, "a") as lf:
-            proc = subprocess.Popen(cmd, stdout=lf, stderr=lf, cwd=repo)
+            proc = subprocess.Popen(cmd, stdout=lf, stderr=lf, cwd=repo, env=leg_env)
             reason = "exit"
             while proc.poll() is None:
                 time.sleep(args.poll_seconds)

@@ -25,6 +25,7 @@ import gymnasium as gym
 import numpy as np
 
 from sheeprl_tpu.config import instantiate
+from sheeprl_tpu.utils.imports import _IS_MOVIEPY_AVAILABLE
 from sheeprl_tpu.envs.wrappers import (
     ActionRepeat,
     ActionsAsObservationWrapper,
@@ -197,13 +198,20 @@ def make_env(
             env = gym.wrappers.TimeLimit(env, max_episode_steps=cfg.env.max_episode_steps)
         env = gym.wrappers.RecordEpisodeStatistics(env)
         if cfg.env.capture_video and rank == 0 and vector_env_idx == 0 and run_name is not None:
-            if cfg.env.grayscale:
-                env = GrayscaleRenderWrapper(env)
-            env = gym.wrappers.RecordVideo(
-                env,
-                os.path.join(run_name, prefix + "_videos" if prefix else "videos"),
-                disable_logger=True,
-            )
+            if not _IS_MOVIEPY_AVAILABLE:
+                # gymnasium's RecordVideo hard-requires moviepy at encode
+                # time; degrade to a no-video run instead of crashing
+                warnings.warn(
+                    "env.capture_video=True but moviepy is not installed: video capture disabled."
+                )
+            else:
+                if cfg.env.grayscale:
+                    env = GrayscaleRenderWrapper(env)
+                env = gym.wrappers.RecordVideo(
+                    env,
+                    os.path.join(run_name, prefix + "_videos" if prefix else "videos"),
+                    disable_logger=True,
+                )
         return env
 
     return thunk

@@ -24,5 +24,6 @@ _IS_MINEDOJO_AVAILABLE = _available("minedojo")
 _IS_MINERL_AVAILABLE = _available("minerl")
 _IS_SUPER_MARIO_BROS_AVAILABLE = _available("gym_super_mario_bros")
 _IS_MLFLOW_AVAILABLE = _available("mlflow")
+_IS_MOVIEPY_AVAILABLE = _available("moviepy")
 _IS_TENSORBOARD_AVAILABLE = _available("tensorboard") or _available("tensorboardX")
 _IS_WINDOWS = platform.system() == "Windows"
