@@ -67,7 +67,8 @@ def main():
                     resume_step[int(ev["leg"])] = int(ev.get("from_step") or 0)
 
     merged = {}
-    logs = list(args.extra_log) + sorted(glob.glob(os.path.join(args.chain_dir, "leg_*.log")))
+    chain_logs = sorted(glob.glob(os.path.join(args.chain_dir, "leg_*.log")))
+    logs = list(args.extra_log) + chain_logs
     for path in logs:
         parsed = parse_log(path)
         if not parsed:
@@ -77,7 +78,9 @@ def main():
         # overrides everything from its resume step on — episode ends land
         # on different (step, env) pairs, so a keywise update would blend
         # the abandoned trajectory's points into the replayed window.
-        m = re.search(r"leg_(\d+)\.log$", os.path.basename(path))
+        # status.jsonl resume steps apply only to THIS chain's own legs;
+        # --extra-log files (earlier runs) fall back to their first point.
+        m = re.search(r"leg_(\d+)\.log$", os.path.basename(path)) if path in chain_logs else None
         first = resume_step.get(int(m.group(1)), min(parsed)) if m else min(parsed)
         for step in [s for s in merged if s >= first]:
             del merged[step]

@@ -19,6 +19,7 @@ TPU-first design:
 from __future__ import annotations
 
 import os
+import time
 import warnings
 from functools import partial
 from typing import Any, Dict, Sequence
@@ -56,7 +57,13 @@ from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import MetricFetchGate, device_get_metrics, Ratio, save_configs
+from sheeprl_tpu.utils.utils import (
+    MetricFetchGate,
+    Ratio,
+    device_get_metrics,
+    fetch_actions,
+    save_configs,
+)
 from sheeprl_tpu.optim import restore_opt_states
 
 sg = jax.lax.stop_gradient
@@ -569,6 +576,7 @@ def main(runtime, cfg: Dict[str, Any]):
 
     cumulative_per_rank_gradient_steps = 0
     metric_fetch_gate = MetricFetchGate(cfg.metric.get("fetch_every", 1))
+    heartbeat_t = time.perf_counter()
     for iter_num in range(start_iter, total_iters + 1):
         policy_step += policy_steps_per_iter
 
@@ -587,13 +595,9 @@ def main(runtime, cfg: Dict[str, Any]):
                 prepared = prepare_obs(obs, cnn_keys=cfg.algo.cnn_keys.encoder, num_envs=total_envs)
                 mask = {k: v for k, v in prepared.items() if k.startswith("mask")} or None
                 action_list = player.get_actions(prepared, runtime.next_key(), mask=mask)
-                actions = np.asarray(jnp.concatenate(action_list, -1)).reshape(1, total_envs, -1)
-                if is_continuous:
-                    real_actions = np.concatenate([np.asarray(a) for a in action_list], -1)
-                else:
-                    real_actions = np.stack(
-                        [np.asarray(a).argmax(-1) for a in action_list], -1
-                    )
+                actions, real_actions = fetch_actions(
+                    action_list, actions_dim, is_continuous, total_envs
+                )
 
             step_data["actions"] = np.asarray(actions).reshape(1, total_envs, -1)
             rb.add(step_data, validate_args=cfg.buffer.validate_args)
@@ -732,6 +736,15 @@ def main(runtime, cfg: Dict[str, Any]):
                             policy_step,
                         )
                     timer.reset()
+            # throughput heartbeat on stdout: long tunnel-bound runs are
+            # otherwise dark between episode-end reward lines
+            heartbeat_now = time.perf_counter()
+            runtime.print(
+                f"Rank-0: heartbeat policy_step={policy_step}, "
+                f"sps={(policy_step - last_log) / max(heartbeat_now - heartbeat_t, 1e-9):.2f}, "
+                f"gradient_steps={cumulative_per_rank_gradient_steps}"
+            )
+            heartbeat_t = heartbeat_now
             last_log = policy_step
             last_train = train_step
 

@@ -41,7 +41,7 @@ from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import MetricFetchGate, device_get_metrics, Ratio, save_configs
+from sheeprl_tpu.utils.utils import fetch_actions, MetricFetchGate, device_get_metrics, Ratio, save_configs
 from sheeprl_tpu.optim import restore_opt_states
 
 sg = jax.lax.stop_gradient
@@ -505,11 +505,9 @@ def main(runtime, cfg: Dict[str, Any]):
                 action_list = player.get_actions(
                     prepared, runtime.next_key(), mask=mask, step=policy_step
                 )
-                actions = np.asarray(jnp.concatenate(action_list, -1)).reshape(1, total_envs, -1)
-                if is_continuous:
-                    real_actions = np.concatenate([np.asarray(a) for a in action_list], -1)
-                else:
-                    real_actions = np.stack([np.asarray(a).argmax(-1) for a in action_list], -1)
+                actions, real_actions = fetch_actions(
+                    action_list, actions_dim, is_continuous, total_envs
+                )
 
             next_obs, rewards, terminated, truncated, infos = envs.step(
                 np.asarray(real_actions).reshape(envs.action_space.shape)

@@ -102,10 +102,12 @@ def resume_from_checkpoint(cfg: dotdict) -> dotdict:
     }
     learning_starts = cfg.algo.get("learning_starts")
     merged = dict(old_cfg)
-    # checkpoint cadence knobs are OPERATIONAL, not training semantics:
-    # they follow the resuming invocation, so a resume chain can e.g.
-    # checkpoint more often than the original run did (deviation from the
-    # reference, whose resume pins the old cadence — cli.py:49-57)
+    # checkpoint cadence and metric knobs are OPERATIONAL, not training
+    # semantics: they follow the resuming invocation, so a resume chain can
+    # e.g. checkpoint more often or fetch metrics less often (amortizing
+    # the per-dispatch device sync on high-latency links) than the original
+    # run did (deviation from the reference, whose resume pins the old
+    # cadence — cli.py:49-57)
     deep_merge(
         merged,
         {
@@ -114,7 +116,13 @@ def resume_from_checkpoint(cfg: dotdict) -> dotdict:
                 "every": cfg.checkpoint.every,
                 "keep_last": cfg.checkpoint.keep_last,
                 "save_last": cfg.checkpoint.save_last,
-            }
+            },
+            "metric": {
+                "log_every": cfg.metric.log_every,
+                "log_level": cfg.metric.log_level,
+                "fetch_every": cfg.metric.get("fetch_every", 1),
+                "disable_timer": cfg.metric.get("disable_timer", False),
+            },
         },
     )
     merged["algo"]["total_steps"] = kept["total_steps"]

@@ -243,6 +243,32 @@ class MetricFetchGate:
         return hit
 
 
+def fetch_actions(
+    action_list: Sequence[jax.Array],
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+    num_envs: int,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Single device-to-host fetch of the player's per-head actions.
+
+    Returns ``(actions, real_actions)``: the flat ``(1, num_envs,
+    sum(actions_dim))`` buffer layout, and the env-facing form
+    (concatenated floats for continuous spaces, per-head argmax indices
+    for discrete/multi-discrete). On a remote accelerator every
+    ``np.asarray`` of a device array is a full link round trip, so the
+    heads are concatenated on-device and fetched ONCE; everything else is
+    derived host-side (the per-head fetches used to dominate the env hot
+    loop on the tunnel backend)."""
+    flat = np.asarray(jnp.concatenate(action_list, -1))
+    actions = flat.reshape(1, num_envs, -1)
+    if is_continuous:
+        real_actions = flat
+    else:
+        segments = np.split(flat, np.cumsum(np.asarray(actions_dim))[:-1], axis=-1)
+        real_actions = np.stack([seg.argmax(-1) for seg in segments], -1)
+    return actions, real_actions
+
+
 def device_get_metrics(metrics: Dict[str, Any]) -> Dict[str, float]:
     """Fetch a dict of device scalars with ONE device-to-host transfer.
 
