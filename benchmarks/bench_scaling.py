@@ -1,5 +1,6 @@
-"""Virtual-mesh scaling table: PPO and DreamerV3 jitted-step wall-clock at
-1/2/4/8 mesh devices (BASELINE.md's "PPO FPS 1->16 chips" stand-in).
+"""Virtual-mesh scaling table: PPO, SAC, and DreamerV3 jitted-step
+wall-clock at 1/2/4/8 mesh devices (BASELINE.md's "PPO FPS 1->16 chips"
+stand-in).
 
 All "devices" here are XLA host-platform devices sharing ONE physical
 core, so wall-clock cannot improve with mesh size; what the table
@@ -183,6 +184,65 @@ def bench_dv3(devices: int, steps: int):
     return dt, T * B
 
 
+def bench_sac(devices: int, steps: int):
+    """SAC scan dispatch (G=8 gradient steps per call, twin critics, alpha
+    autotune) on a `devices`-wide mesh; global batch fixed at 8 x 512
+    vector rows (the GSPMD path: batch-axis sharding, psum'd grads)."""
+    import gymnasium as gym
+
+    from sheeprl_tpu.algos.sac.agent import build_agent
+    from sheeprl_tpu.algos.sac.sac import _make_optimizer, make_train_fn
+    from sheeprl_tpu.config import compose
+    from sheeprl_tpu.parallel.mesh import MeshRuntime
+
+    cfg = compose(
+        overrides=[
+            "exp=sac",
+            "env=dummy",
+            "env.id=dummy_continuous",
+            "algo.mlp_keys.encoder=[state]",
+        ]
+    )
+    runtime = MeshRuntime(devices=devices, accelerator="cpu").launch()
+    runtime.seed_everything(0)
+    obs_space = gym.spaces.Dict({"state": gym.spaces.Box(-1, 1, (16,), np.float32)})
+    act_space = gym.spaces.Box(-1, 1, (4,), np.float32)
+    actor, critic, params, target_entropy = build_agent(runtime, cfg, obs_space, act_space)
+    params = runtime.replicate(params)
+    actor_tx = _make_optimizer(cfg.algo.actor.optimizer)
+    critic_tx = _make_optimizer(cfg.algo.critic.optimizer)
+    alpha_tx = _make_optimizer(cfg.algo.alpha.optimizer)
+    opt_states = runtime.replicate(
+        {
+            "actor": actor_tx.init(params["actor"]),
+            "critic": critic_tx.init(params["critic"]),
+            "alpha": alpha_tx.init(params["log_alpha"]),
+        }
+    )
+    train_fn = make_train_fn(
+        runtime, actor, critic, (actor_tx, critic_tx, alpha_tx), cfg, target_entropy
+    )
+    G, B = 8, 512
+    rng = np.random.default_rng(0)
+    data = {
+        "observations": jnp.asarray(rng.normal(size=(G, B, 16)).astype(np.float32)),
+        "next_observations": jnp.asarray(rng.normal(size=(G, B, 16)).astype(np.float32)),
+        "actions": jnp.asarray(rng.normal(size=(G, B, 4)).astype(np.float32)),
+        "rewards": jnp.asarray(rng.normal(size=(G, B, 1)).astype(np.float32)),
+        "terminated": jnp.zeros((G, B, 1), jnp.float32),
+    }
+    data = runtime.shard_batch(data, axis=1)
+    ema_flags = jnp.asarray(np.array([True] + [False] * (G - 1)))
+
+    def step(carry):
+        params, opt_states = carry
+        params, opt_states, _ = train_fn(params, opt_states, data, runtime.next_key(), ema_flags)
+        return params, opt_states
+
+    dt = _time_step(step, (params, opt_states), n_steps=steps)
+    return dt, G * B
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=6)
@@ -203,7 +263,7 @@ def main():
         "global batch fixed, normalized step time ~1.0 at every mesh size = "
         "zero-overhead sharding; >1.0 = partition/collective overhead"
     ), "algos": {}}
-    for name, fn in (("ppo", bench_ppo), ("dreamer_v3", bench_dv3)):
+    for name, fn in (("ppo", bench_ppo), ("sac", bench_sac), ("dreamer_v3", bench_dv3)):
         base = None
         rows = []
         for n in MESH_SIZES:

@@ -739,10 +739,16 @@ def main(runtime, cfg: Dict[str, Any]):
             # throughput heartbeat on stdout: long tunnel-bound runs are
             # otherwise dark between episode-end reward lines
             heartbeat_now = time.perf_counter()
+            split = ""
+            if logger and not timer.disabled:  # timer_metrics exists iff both hold
+                split = (
+                    f", env_s={timer_metrics.get('Time/env_interaction_time', 0):.1f}"
+                    f", train_s={timer_metrics.get('Time/train_time', 0):.1f}"
+                )
             runtime.print(
                 f"Rank-0: heartbeat policy_step={policy_step}, "
                 f"sps={(policy_step - last_log) / max(heartbeat_now - heartbeat_t, 1e-9):.2f}, "
-                f"gradient_steps={cumulative_per_rank_gradient_steps}"
+                f"gradient_steps={cumulative_per_rank_gradient_steps}" + split
             )
             heartbeat_t = heartbeat_now
             last_log = policy_step

@@ -225,6 +225,20 @@ def run_algorithm(cfg: dotdict) -> None:
 
 def run(args: Optional[Sequence[str]] = None) -> None:
     """Main training app: ``sheeprl exp=... [overrides...]``."""
+    try:
+        stack_dump_s = float(os.environ.get("SHEEPRL_STACK_DUMP_S", 0))
+    except ValueError:
+        stack_dump_s = 0.0
+    if stack_dump_s > 0:
+        # observability for long headless runs: dump every thread's stack
+        # to the given file on a fixed cadence, so a slow/stuck training
+        # loop shows WHERE it sits without gdb/py-spy on the host
+        import faulthandler
+
+        path = os.environ.get("SHEEPRL_STACK_DUMP_FILE", "/tmp/sheeprl_stacks.log")
+        faulthandler.dump_traceback_later(
+            stack_dump_s, repeat=True, file=open(path, "w", buffering=1), exit=False
+        )
     overrides = list(args if args is not None else sys.argv[1:])
     cfg = compose(config_name="config", overrides=overrides)
     if cfg.get("num_threads"):

@@ -383,6 +383,14 @@ class MeshRuntime:
         rt._key = self._key
         return rt
 
+    def _device_is_remote(self) -> bool:
+        """True when the training device sits behind a network tunnel
+        (remote PJRT plugins like axon report a plain accelerator
+        ``platform`` but stamp the plugin into ``platform_version``)."""
+        version = str(getattr(self.device.client, "platform_version", "")).lower()
+        platforms = str(getattr(jax.config, "jax_platforms", "") or "").lower()
+        return any(marker in version or marker in platforms for marker in ("axon", "proxy"))
+
     def player_device(self):
         """Device for env-interaction policies.
 
@@ -403,6 +411,15 @@ class MeshRuntime:
         if choice == "accelerator":
             return None
         if self.device.platform == "cpu":
+            return None
+        if choice == "auto" and self._device_is_remote():
+            # Tunneled/proxied accelerators invert the CPU-player split's
+            # economics: refreshing the player's params tree costs a full
+            # device->host download of the world model per training
+            # iteration (measured ~3-4 s/iter for DreamerV3-S at ~33 MB/s
+            # link bandwidth, 5x the whole rest of the loop), while an
+            # on-accelerator player pays one action-fetch RTT per env
+            # step. Keep the player on the training device.
             return None
         try:
             return jax.local_devices(backend="cpu")[0]
