@@ -15,6 +15,7 @@ from __future__ import annotations
 import importlib
 import os
 import sys
+import warnings
 from typing import Any, Dict, List, Optional, Sequence
 
 from sheeprl_tpu.config import compose, dotdict
@@ -236,9 +237,14 @@ def run(args: Optional[Sequence[str]] = None) -> None:
         import faulthandler
 
         path = os.environ.get("SHEEPRL_STACK_DUMP_FILE", "/tmp/sheeprl_stacks.log")
-        faulthandler.dump_traceback_later(
-            stack_dump_s, repeat=True, file=open(path, "w", buffering=1), exit=False
-        )
+        try:
+            dump_file = open(path, "w", buffering=1)
+        except OSError as e:  # diagnostics must never kill the run
+            warnings.warn(f"stack dump disabled, cannot open {path}: {e}")
+        else:
+            faulthandler.dump_traceback_later(
+                stack_dump_s, repeat=True, file=dump_file, exit=False
+            )
     overrides = list(args if args is not None else sys.argv[1:])
     cfg = compose(config_name="config", overrides=overrides)
     if cfg.get("num_threads"):

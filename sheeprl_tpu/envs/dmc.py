@@ -75,7 +75,7 @@ class DMCWrapper(gym.Env):
         channels_first: bool = False,
         visualize_reward: bool = False,
         seed: Optional[int] = None,
-        fast_render: bool = True,
+        fast_render: bool = False,
     ):
         if not (from_vectors or from_pixels):
             raise ValueError(
@@ -104,8 +104,10 @@ class DMCWrapper(gym.Env):
             # Headless hosts render through software GL, where the shadow /
             # reflection / MSAA passes dominate (measured 52 -> 26 ms per
             # 64x64 frame on one CPU core). Scene content is unchanged —
-            # only lighting decoration — so policies keep learning; set
-            # fast_render=False for pixel-exact parity with default MuJoCo.
+            # only lighting decoration — so policies keep learning.
+            # Default False (pixel-exact MuJoCo defaults): checkpoints
+            # whose saved config predates this knob must keep their frame
+            # distribution on resume; configs/env/dmc.yaml opts new runs in.
             m = env.physics.model
             m.vis.quality.shadowsize = 0
             m.vis.quality.offsamples = 0

@@ -215,3 +215,31 @@ def test_resume_honors_new_checkpoint_cadence(tmp_path):
     assert merged.checkpoint.every == 123
     assert merged.checkpoint.keep_last == 7
     assert merged.checkpoint.resume_from == ckpt
+
+
+def test_resume_honors_new_metric_knobs(tmp_path):
+    """metric.{log_every,log_level,fetch_every,disable_timer} are
+    OPERATIONAL knobs like the checkpoint cadence: the resuming
+    invocation's values win over the checkpoint's saved config (so a
+    resume chain can amortize the per-dispatch device sync with
+    fetch_every>1 on a high-latency link)."""
+    from sheeprl_tpu.cli import resume_from_checkpoint
+    from sheeprl_tpu.config.compose import compose
+    from sheeprl_tpu.utils.utils import dotdict
+
+    ckpt = _train_and_get_ckpt(tmp_path, root="cli_metric_knobs")
+    cfg = dotdict(
+        compose(
+            overrides=_ppo_args(tmp_path, root="cli_metric_knobs")
+            + [
+                f"checkpoint.resume_from={ckpt}",
+                "metric.log_every=777",
+                "metric.fetch_every=16",
+                "metric.disable_timer=True",
+            ]
+        )
+    )
+    merged = resume_from_checkpoint(cfg)
+    assert merged.metric.log_every == 777
+    assert merged.metric.fetch_every == 16
+    assert merged.metric.disable_timer is True

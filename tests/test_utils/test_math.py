@@ -115,3 +115,29 @@ def test_ratio_scheduler():
 def test_ratio_zero():
     r = Ratio(ratio=0)
     assert r(100) == 0
+
+
+def test_fetch_actions_continuous_and_discrete():
+    """fetch_actions derives the buffer layout and the env-facing actions
+    from ONE concatenated fetch (the per-head np.asarray round trips used
+    to dominate the env hot loop on remote-device links)."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from sheeprl_tpu.utils.utils import fetch_actions
+
+    # continuous: two heads (3 + 2 dims), 4 envs
+    heads = [jnp.arange(12.0).reshape(1, 4, 3), jnp.arange(8.0).reshape(1, 4, 2) + 100]
+    actions, real = fetch_actions(heads, (3, 2), True, 4)
+    np.testing.assert_allclose(
+        actions, np.concatenate([np.asarray(h) for h in heads], -1).reshape(1, 4, 5)
+    )
+    np.testing.assert_allclose(real, actions)
+
+    # multi-discrete: two one-hot heads (3-way and 2-way), argmax per head
+    h1 = jnp.asarray(np.eye(3, dtype=np.float32)[[0, 2, 1, 0]]).reshape(1, 4, 3)
+    h2 = jnp.asarray(np.eye(2, dtype=np.float32)[[1, 0, 1, 1]]).reshape(1, 4, 2)
+    actions, real = fetch_actions([h1, h2], (3, 2), False, 4)
+    assert actions.shape == (1, 4, 5)
+    np.testing.assert_array_equal(real[..., 0], [[0, 2, 1, 0]])
+    np.testing.assert_array_equal(real[..., 1], [[1, 0, 1, 1]])
