@@ -234,6 +234,7 @@ def test_resume_honors_new_metric_knobs(tmp_path):
             + [
                 f"checkpoint.resume_from={ckpt}",
                 "metric.log_every=777",
+                "metric.log_level=0",
                 "metric.fetch_every=16",
                 "metric.disable_timer=True",
             ]
@@ -241,5 +242,6 @@ def test_resume_honors_new_metric_knobs(tmp_path):
     )
     merged = resume_from_checkpoint(cfg)
     assert merged.metric.log_every == 777
+    assert merged.metric.log_level == 0
     assert merged.metric.fetch_every == 16
     assert merged.metric.disable_timer is True
