@@ -1,0 +1,115 @@
+"""Profile the jitted DreamerV3-S train step and report the top ops.
+
+VERDICT r2 item 3 ("attack the top hotspot") needs a real breakdown of
+where the ~30 ms step goes before any kernel work is justified. This
+captures a ``jax.profiler`` trace of a few steady-state steps, then
+parses the trace-event JSON for the busiest XLA ops on the device.
+
+Run on an IDLE chip (timing noise with a concurrent training run is
++-15%):
+
+    python benchmarks/profile_dv3_step.py [--steps 5] [--out PATH]
+
+Writes benchmarks/results/dv3_profile_r3.json with
+{op, total_ms, count, pct_of_top} rows and prints the table.
+"""
+
+import argparse
+import glob
+import gzip
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def capture(steps: int, trace_dir: str):
+    import jax
+
+    from benchmarks.bench_dv3_step import build
+
+    runtime, train_fn, params, opt_states, moments, data, (T, B) = build(False, "bf16-mixed")
+    params = runtime.replicate(params)
+    opt_states = runtime.replicate(opt_states)
+    moments = runtime.replicate(moments)
+    for _ in range(2):  # compile + warm
+        params, opt_states, moments, m = train_fn(params, opt_states, moments, data, runtime.next_key())
+    float(jax.tree_util.tree_leaves(m)[0])
+
+    with jax.profiler.trace(trace_dir):
+        tic = time.perf_counter()
+        for _ in range(steps):
+            params, opt_states, moments, m = train_fn(
+                params, opt_states, moments, data, runtime.next_key()
+            )
+        float(jax.tree_util.tree_leaves(m)[0])
+        dt = (time.perf_counter() - tic) / steps
+    return dt, T * B, (T, B)
+
+
+def parse_trace(trace_dir: str, top: int = 25):
+    """Aggregate device-lane op durations from the trace-event JSON."""
+    paths = glob.glob(os.path.join(trace_dir, "**", "*.trace.json.gz"), recursive=True)
+    if not paths:
+        raise FileNotFoundError(f"no trace.json.gz under {trace_dir}")
+    with gzip.open(max(paths, key=os.path.getmtime), "rt") as f:
+        events = json.load(f).get("traceEvents", [])
+    # device lanes are process/thread names containing TPU/device markers
+    device_pids = set()
+    names = {}
+    for ev in events:
+        if ev.get("ph") == "M" and ev.get("name") == "process_name":
+            pname = ev.get("args", {}).get("name", "")
+            names[ev.get("pid")] = pname
+            if any(k in pname.lower() for k in ("tpu", "device", "xla")):
+                device_pids.add(ev.get("pid"))
+    agg = {}
+    for ev in events:
+        if ev.get("ph") == "X" and ev.get("pid") in device_pids:
+            name = ev.get("name", "?")
+            entry = agg.setdefault(name, [0.0, 0])
+            entry[0] += float(ev.get("dur", 0.0)) / 1e3  # us -> ms
+            entry[1] += 1
+    rows = sorted(
+        ({"op": k, "total_ms": round(v[0], 2), "count": v[1]} for k, v in agg.items()),
+        key=lambda r: -r["total_ms"],
+    )
+    return rows[:top], {pid: names.get(pid, "") for pid in device_pids}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument("--trace-dir", default="/tmp/sheeprl_dv3_trace")
+    ap.add_argument(
+        "--out",
+        default=os.path.join(os.path.dirname(__file__), "results", "dv3_profile_r3.json"),
+    )
+    ap.add_argument("--top", type=int, default=25)
+    args = ap.parse_args()
+
+    dt, frames, shape = capture(args.steps, args.trace_dir)
+    rows, lanes = parse_trace(args.trace_dir, args.top)
+    total = sum(r["total_ms"] for r in rows) or 1.0
+    for r in rows:
+        r["pct_of_top"] = round(100.0 * r["total_ms"] / total, 1)
+    artifact = {
+        "protocol": f"jax.profiler trace of {args.steps} steady-state DV3-S train steps "
+        f"(T={shape[0]}, B={shape[1]}, bf16-mixed), device-lane op totals",
+        "measured_step_ms": round(dt * 1e3, 1),
+        "replayed_frames_per_s": round(frames / dt, 1),
+        "device_lanes": lanes,
+        "top_ops": rows,
+    }
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(artifact, f, indent=2)
+    for r in rows[:15]:
+        print(json.dumps(r))
+    print(f"wrote {args.out} (step {artifact['measured_step_ms']} ms)")
+
+
+if __name__ == "__main__":
+    main()
