@@ -1,0 +1,30 @@
+#!/bin/bash
+# Round-3 session transition: stop the walker chain, stitch its curve,
+# launch the cartpole-swingup (dense) chain. Run from the repo root.
+set -x
+cd /root/repo
+
+# 1. stop walker chain + leg
+ps -eo pid,args | grep -E "train_chain|run_name=chain_leg" | grep -v grep | awk '{print $1}' | while read p; do kill "$p" 2>/dev/null; done
+sleep 10
+ps -eo pid,args | grep -E "train_chain|run_name=chain_leg" | grep -v grep | awk '{print $1}' | while read p; do kill -9 "$p" 2>/dev/null; done
+
+# 2. stitch the walker curve artifact
+python scripts/curve_from_logs.py --chain-dir runs/dv3_walker/chain_r3b \
+  --out benchmarks/results/dv3_walker_walk_curve_r3.json
+
+# 3. launch the cartpole dense chain (deadline ~19:50 UTC = 1785527400)
+mkdir -p runs/dv3_cartpole/chain_r3
+MUJOCO_GL=egl SHEEPRL_STACK_DUMP_S=60 SHEEPRL_STACK_DUMP_FILE=/tmp/cartpole_stacks.log \
+nohup python scripts/train_chain.py \
+  --run-dir runs/dv3_cartpole --chain-dir runs/dv3_cartpole/chain_r3 \
+  --target-step 200000 --deadline-ts 1785527400 \
+  --leg-seconds 7200 --max-rss-gb 38 --max-failures 4 \
+  -- exp=dreamer_v3_dmc_cartpole_swingup env.num_envs=8 env.capture_video=False \
+     algo.replay_ratio=0.3 buffer.size=100000 buffer.memmap=False \
+     checkpoint.every=4000 checkpoint.keep_last=3 metric.log_every=2000 \
+     metric.fetch_every=8 \
+     root_dir=/root/repo/runs/dv3_cartpole \
+  > runs/dv3_cartpole/chain_r3/chain.out 2>&1 &
+disown
+echo "cartpole chain launched"
