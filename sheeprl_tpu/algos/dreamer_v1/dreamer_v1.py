@@ -338,10 +338,11 @@ def main(runtime, cfg: Dict[str, Any]):
             }
         )
 
+    player_params = {"world_model": params["world_model"], "actor": params["actor"]}
     player = PlayerDV1(
         world_model,
         actor,
-        {"world_model": params["world_model"], "actor": params["actor"]},
+        player_params,
         actions_dim,
         total_envs,
         cfg.algo.world_model.stochastic_size,
@@ -349,7 +350,7 @@ def main(runtime, cfg: Dict[str, Any]):
         expl_amount=float(cfg.algo.actor.get("expl_amount", 0.0)),
         expl_decay=float(cfg.algo.actor.get("expl_decay", 0.0)),
         expl_min=float(cfg.algo.actor.get("expl_min", 0.0)),
-        device=runtime.player_device(),
+        device=runtime.player_device(player_params),
     )
 
     if runtime.is_global_zero:

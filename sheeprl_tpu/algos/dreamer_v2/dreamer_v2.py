@@ -412,17 +412,18 @@ def main(runtime, cfg: Dict[str, Any]):
             }
         )
 
+    player_params = {"world_model": params["world_model"], "actor": params["actor"]}
     player = PlayerDV2(
         world_model,
         actor,
-        {"world_model": params["world_model"], "actor": params["actor"]},
+        player_params,
         actions_dim,
         total_envs,
         cfg.algo.world_model.stochastic_size,
         cfg.algo.world_model.recurrent_model.recurrent_state_size,
         discrete_size=cfg.algo.world_model.discrete_size,
         expl_amount=float(cfg.algo.actor.get("expl_amount", 0.0)),
-        device=runtime.player_device(),
+        device=runtime.player_device(player_params),
     )
 
     if runtime.is_global_zero:

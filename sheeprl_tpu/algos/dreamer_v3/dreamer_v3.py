@@ -505,17 +505,18 @@ def main(runtime, cfg: Dict[str, Any]):
         )
         moments_state = runtime.replicate(init_moments())
 
+    player_params = {"world_model": params["world_model"], "actor": params["actor"]}
     player = PlayerDV3(
         world_model,
         actor,
-        {"world_model": params["world_model"], "actor": params["actor"]},
+        player_params,
         actions_dim,
         total_envs,
         cfg.algo.world_model.stochastic_size,
         cfg.algo.world_model.recurrent_model.recurrent_state_size,
         discrete_size=cfg.algo.world_model.discrete_size,
         decoupled_rssm=bool(cfg.algo.world_model.decoupled_rssm),
-        device=runtime.player_device(),
+        device=runtime.player_device(player_params),
     )
 
     if runtime.is_global_zero:

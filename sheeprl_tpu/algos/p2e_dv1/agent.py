@@ -144,10 +144,11 @@ def make_player(
     """PlayerDV1 over the selected policy ('exploration' or 'task'); switch
     policies by re-assigning ``player.params`` + ``player.actor_type``."""
     actor_params = params["actor_exploration"] if actor_type == "exploration" else params["actor_task"]
+    player_params = {"world_model": params["world_model"], "actor": actor_params}
     return PlayerDV1(
         world_model,
         actor,
-        {"world_model": params["world_model"], "actor": actor_params},
+        player_params,
         actions_dim,
         num_envs,
         cfg.algo.world_model.stochastic_size,
@@ -156,5 +157,5 @@ def make_player(
         expl_decay=float(cfg.algo.actor.get("expl_decay", 0.0)),
         expl_min=float(cfg.algo.actor.get("expl_min", 0.0)),
         actor_type=actor_type,
-        device=runtime.player_device(),
+        device=runtime.player_device(player_params),
     )

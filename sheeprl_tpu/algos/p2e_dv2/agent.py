@@ -143,10 +143,11 @@ def make_player(
     """PlayerDV2 over the selected policy ('exploration' or 'task'); switch
     policies by re-assigning ``player.params`` + ``player.actor_type``."""
     actor_params = params["actor_exploration"] if actor_type == "exploration" else params["actor_task"]
+    player_params = {"world_model": params["world_model"], "actor": actor_params}
     return PlayerDV2(
         world_model,
         actor,
-        {"world_model": params["world_model"], "actor": actor_params},
+        player_params,
         actions_dim,
         num_envs,
         cfg.algo.world_model.stochastic_size,
@@ -154,5 +155,5 @@ def make_player(
         discrete_size=cfg.algo.world_model.discrete_size,
         actor_type=actor_type,
         expl_amount=float(cfg.algo.actor.get("expl_amount", 0.0)),
-        device=runtime.player_device(),
+        device=runtime.player_device(player_params),
     )

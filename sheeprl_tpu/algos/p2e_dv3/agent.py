@@ -160,16 +160,17 @@ def make_player(
     policies by re-assigning ``player.params`` (reference swaps the actor
     module and re-ties weights, p2e_dv3_finetuning.py:350-353)."""
     actor_params = params["actor_exploration"] if actor_type == "exploration" else params["actor_task"]
+    player_params = {"world_model": params["world_model"], "actor": actor_params}
     player = PlayerDV3(
         world_model,
         actor,
-        {"world_model": params["world_model"], "actor": actor_params},
+        player_params,
         actions_dim,
         num_envs,
         cfg.algo.world_model.stochastic_size,
         cfg.algo.world_model.recurrent_model.recurrent_state_size,
         discrete_size=cfg.algo.world_model.discrete_size,
         actor_type=actor_type,
-        device=runtime.player_device(),
+        device=runtime.player_device(player_params),
     )
     return player

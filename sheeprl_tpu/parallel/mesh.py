@@ -391,7 +391,7 @@ class MeshRuntime:
         platforms = str(getattr(jax.config, "jax_platforms", "") or "").lower()
         return any(marker in version or marker in platforms for marker in ("axon", "proxy"))
 
-    def player_device(self):
+    def player_device(self, params: Any = None):
         """Device for env-interaction policies.
 
         "auto"/"cpu" (default): the host CPU backend when training runs on
@@ -402,7 +402,17 @@ class MeshRuntime:
         re-downloading the params tree to the host after every train
         dispatch costs seconds per leaf. Configured via
         ``fabric.player_device``; the SHEEPRL_PLAYER_DEVICE env var
-        overrides the config."""
+        overrides the config.
+
+        ``params`` (the player's weight pytree, when the caller has it)
+        lets "auto" weigh the two costs on tunneled accelerators: a
+        CPU player re-downloads those weights after every training
+        iteration (measured ~3-4 s/iter for DreamerV3-S's ~40 MB at
+        ~33 MB/s link bandwidth — 5x the rest of the loop), while an
+        on-accelerator player pays one action-fetch RTT (~0.1 s) per env
+        step. Big trees (world models) therefore stay on the training
+        device; small ones (PPO/SAC MLPs, whose refresh is a few hundred
+        KB per rollout) stay on the CPU where actions are free."""
         choice = os.environ.get("SHEEPRL_PLAYER_DEVICE", self._player_device)
         if choice not in _PLAYER_DEVICES:
             raise ValueError(
@@ -413,14 +423,14 @@ class MeshRuntime:
         if self.device.platform == "cpu":
             return None
         if choice == "auto" and self._device_is_remote():
-            # Tunneled/proxied accelerators invert the CPU-player split's
-            # economics: refreshing the player's params tree costs a full
-            # device->host download of the world model per training
-            # iteration (measured ~3-4 s/iter for DreamerV3-S at ~33 MB/s
-            # link bandwidth, 5x the whole rest of the loop), while an
-            # on-accelerator player pays one action-fetch RTT per env
-            # step. Keep the player on the training device.
-            return None
+            if params is None:
+                return None  # no size info: assume refresh-heavy
+            nbytes = sum(
+                int(np.prod(np.shape(leaf))) * np.dtype(getattr(leaf, "dtype", np.float32)).itemsize
+                for leaf in jax.tree_util.tree_leaves(params)
+            )
+            if nbytes >= 4 * 1024 * 1024:
+                return None
         try:
             return jax.local_devices(backend="cpu")[0]
         except RuntimeError:
