@@ -189,6 +189,15 @@ def bench_dv3():
         "vs_baseline": round(frames_per_s / REFERENCE_DV3_FRAMES_PER_S, 3),
         "step_ms": round(dt * 1e3, 1),
         "mfu_pct": round(100.0 * flops / dt / TPU_V5E_BF16_PEAK_FLOPS, 2) if flops else None,
+        # r4: the benched config now matches the BASELINE.md anchor
+        # (dreamer_v3_100k_ms_pacman): DISCRETE actions.  r1-r3 benched a
+        # continuous-action variant of the same S size (heavier: dynamics
+        # backprop through imagination); r4 numbers for that variant are in
+        # benchmarks/results/dv3_profile_r4.json for apples-to-apples.
+        "config": f"T={t_len},B={b_size},"
+        + ("continuous(6)" if os.environ.get("SHEEPRL_BENCH_CONTINUOUS", "0") == "1" else "discrete(6)")
+        + ",bf16-mixed",
+        "flops_per_step": flops,
     }
 
 

@@ -10,6 +10,11 @@ Usage: python benchmarks/bench_dv3_step.py [--precision bf16-mixed] [--steps 20]
 """
 
 import argparse
+import os as _os
+
+# the reference anchor config (dreamer_v3_100k_ms_pacman) is DISCRETE —
+# REINFORCE actor loss, no dynamics backprop through imagination
+IS_CONTINUOUS = _os.environ.get("SHEEPRL_BENCH_CONTINUOUS", "0") == "1"
 import os
 import sys
 import time
@@ -44,7 +49,7 @@ def build(fused: bool, precision: str):
     runtime.seed_everything(0)
     obs_space = gym.spaces.Dict({"rgb": gym.spaces.Box(0, 255, (64, 64, 3), np.uint8)})
     actions_dim = (6,)
-    world_model, actor, critic, params = build_agent(runtime, actions_dim, True, cfg, obs_space)
+    world_model, actor, critic, params = build_agent(runtime, actions_dim, IS_CONTINUOUS, cfg, obs_space)
     # same storage/optimizer policy as the training CLI (dreamer_v3.py main):
     # bf16-true stores params in bfloat16 with f32 master weights in the
     # optimizer and keeps the EMA target critic f32
@@ -59,7 +64,7 @@ def build(fused: bool, precision: str):
     }
     moments = init_moments()
     train_fn = make_train_fn(
-        runtime, world_model, actor, critic, (wm_tx, actor_tx, critic_tx), cfg, True, actions_dim
+        runtime, world_model, actor, critic, (wm_tx, actor_tx, critic_tx), cfg, IS_CONTINUOUS, actions_dim
     )
 
     T, B = int(cfg.algo.per_rank_sequence_length), int(cfg.algo.per_rank_batch_size)

@@ -284,11 +284,28 @@ class RecurrentModel(nn.Module):
 
 
 def compute_stochastic_state(
-    logits: jax.Array, discrete: int, key: Optional[jax.Array], sample: bool = True
+    logits: jax.Array,
+    discrete: int,
+    key: Optional[jax.Array],
+    sample: bool = True,
+    noise: Optional[jax.Array] = None,
 ) -> jax.Array:
     """(..., stoch*discrete) logits -> (..., stoch, discrete) one-hot ST
-    sample (reference dreamer_v2/utils.py:44)."""
+    sample (reference dreamer_v2/utils.py:44).
+
+    ``noise`` is pre-drawn Gumbel noise of the reshaped logits' shape: the
+    categorical sample is then ``argmax(logits + noise)`` with the same
+    straight-through estimator, and no RNG runs at the call site.  Used by
+    the train scans, whose bodies are latency-bound — hoisting the threefry
+    chains out of the ``lax.scan`` body batches all of a rollout's RNG into
+    one fused op outside the sequential loop."""
     logits = logits.reshape(*logits.shape[:-1], -1, discrete)
+    if noise is not None and sample:
+        hard = jax.nn.one_hot(
+            jnp.argmax(logits + noise, -1), discrete, dtype=logits.dtype
+        )
+        p = jax.nn.softmax(logits, -1)
+        return jax.lax.stop_gradient(hard) + p - jax.lax.stop_gradient(p)
     dist = OneHotCategoricalStraightThrough(logits=logits)
     return dist.rsample(key) if sample else dist.mode
 
@@ -369,20 +386,30 @@ class RSSM(nn.Module):
         return init_rec, initial_posterior
 
     def _representation(
-        self, embedded_obs: jax.Array, key: jax.Array, recurrent_state: Optional[jax.Array] = None
+        self,
+        embedded_obs: jax.Array,
+        key: Optional[jax.Array],
+        recurrent_state: Optional[jax.Array] = None,
+        noise: Optional[jax.Array] = None,
     ) -> Tuple[jax.Array, jax.Array]:
         if self.decoupled:
             x = embedded_obs
         else:
             x = jnp.concatenate([recurrent_state, embedded_obs], -1)
         logits = self._uniform_mix(self.representation_model(x))
-        return logits, compute_stochastic_state(logits, self.discrete_size, key)
+        return logits, compute_stochastic_state(logits, self.discrete_size, key, noise=noise)
 
     def _transition(
-        self, recurrent_out: jax.Array, key: Optional[jax.Array], sample_state: bool = True
+        self,
+        recurrent_out: jax.Array,
+        key: Optional[jax.Array],
+        sample_state: bool = True,
+        noise: Optional[jax.Array] = None,
     ) -> Tuple[jax.Array, jax.Array]:
         logits = self._uniform_mix(self.transition_model(recurrent_out))
-        return logits, compute_stochastic_state(logits, self.discrete_size, key, sample=sample_state)
+        return logits, compute_stochastic_state(
+            logits, self.discrete_size, key, sample=sample_state, noise=noise
+        )
 
     def dynamic(
         self,
@@ -391,10 +418,19 @@ class RSSM(nn.Module):
         action: jax.Array,
         embedded_obs: jax.Array,
         is_first: jax.Array,
-        key: jax.Array,
+        key: Optional[jax.Array],
+        noise: Optional[Tuple[jax.Array, jax.Array]] = None,
     ):
-        """One dynamic-learning step with is_first-gated resets."""
-        k1, k2 = jax.random.split(key)
+        """One dynamic-learning step with is_first-gated resets.
+
+        ``noise`` — optional pre-drawn (prior_gumbel, posterior_gumbel) pair,
+        see :func:`compute_stochastic_state`."""
+        if noise is not None:
+            k1 = k2 = None
+            n1, n2 = noise
+        else:
+            k1, k2 = jax.random.split(key)
+            n1 = n2 = None
         action = (1 - is_first) * action
         initial_recurrent_state, initial_posterior = self.get_initial_states(recurrent_state.shape[:-1])
         recurrent_state = (1 - is_first) * recurrent_state + is_first * initial_recurrent_state
@@ -404,17 +440,127 @@ class RSSM(nn.Module):
         recurrent_state = self.recurrent_model(
             jnp.concatenate([posterior, action], -1), recurrent_state
         )
-        prior_logits, prior = self._transition(recurrent_state, k1)
+        prior_logits, prior = self._transition(recurrent_state, k1, noise=n1)
         if self.decoupled:
             return recurrent_state, prior, prior_logits
-        posterior_logits, posterior = self._representation(embedded_obs, k2, recurrent_state)
+        posterior_logits, posterior = self._representation(embedded_obs, k2, recurrent_state, noise=n2)
         return recurrent_state, posterior, prior, posterior_logits, prior_logits
 
-    def imagination(self, prior: jax.Array, recurrent_state: jax.Array, actions: jax.Array, key: jax.Array):
+    def representation_embed_proj(self, embedded_obs: jax.Array) -> jax.Array:
+        """Embed-side half of the representation model's first matmul.
+
+        The first Dense of the representation model sees ``[h_t, embed_t]``;
+        splitting its kernel lets the (big) embed half run as ONE batched
+        matmul over the whole sequence outside the train scan, while only
+        the small h-side product stays on the sequential critical path.
+        Crucially this also moves the (embed_dim, units) kernel-gradient
+        accumulation out of the backward while-loop's carry."""
+        p = self.representation_model.variables["params"]["LinearLnAct_0"]["Dense_0"]
+        k_e = p["kernel"][self.recurrent_state_size:].astype(self.dtype)
+        out = embedded_obs.astype(self.dtype) @ k_e
+        if not self.layer_norm:
+            out = out + p["bias"].astype(self.dtype)
+        return out
+
+    def _representation_from_proj(
+        self,
+        emb_proj: jax.Array,
+        recurrent_state: jax.Array,
+        noise: Optional[jax.Array] = None,
+        key: Optional[jax.Array] = None,
+    ) -> Tuple[jax.Array, jax.Array]:
+        """Posterior from a precomputed embed projection (scan-body path of
+        :meth:`_representation`; non-decoupled only).  Manually unrolls the
+        DreamerMLP(layers=1) block so the h-side product can be added to
+        ``emb_proj`` before the LayerNorm."""
+        params = self.representation_model.variables["params"]
+        p = params["LinearLnAct_0"]["Dense_0"]
+        k_h = p["kernel"][: self.recurrent_state_size].astype(self.dtype)
+        x = recurrent_state.astype(self.dtype) @ k_h + emb_proj
+        if self.layer_norm:
+            ln = params["LinearLnAct_0"]["LayerNorm_0"]
+            xf = x.astype(jnp.float32)
+            mu = xf.mean(-1, keepdims=True)
+            var = ((xf - mu) ** 2).mean(-1, keepdims=True)
+            x = (xf - mu) * jax.lax.rsqrt(var + self.eps) * ln["scale"] + ln["bias"]
+        x = resolve_activation(self.act)(x.astype(self.dtype))
+        head = params["Dense_0"]
+        logits = x.astype(jnp.float32) @ head["kernel"] + head["bias"]
+        logits = self._uniform_mix(logits)
+        return logits, compute_stochastic_state(
+            logits, self.discrete_size, key, noise=noise
+        )
+
+    def dynamic_posterior(
+        self,
+        posterior: jax.Array,
+        recurrent_state: jax.Array,
+        action: jax.Array,
+        emb_proj: jax.Array,
+        is_first: jax.Array,
+        init_states: Tuple[jax.Array, jax.Array],
+        key: Optional[jax.Array] = None,
+        noise: Optional[jax.Array] = None,
+    ):
+        """The sequential-only slice of :meth:`dynamic` for the train scan.
+
+        Two things are deliberately NOT here, because they are
+        t-independent given ``h_t`` and batch over the whole sequence
+        outside the ``lax.scan`` (the scan body is latency-bound, so every
+        op removed from it is ~T ops removed from the critical path):
+
+        - the transition model / prior — its logits are a pure function of
+          the stacked recurrent states (and the prior SAMPLE is unused by
+          the world-model loss);
+        - the initial-state computation — ``get_initial_states`` runs the
+          transition MLP on a constant, so it is evaluated once and passed
+          in as ``init_states``.
+        """
+        init_rec, init_post = init_states
+        action = (1 - is_first) * action
+        recurrent_state = (1 - is_first) * recurrent_state + is_first * init_rec
+        posterior = posterior.reshape(*posterior.shape[:-2], -1)
+        posterior = (1 - is_first) * posterior + is_first * init_post.reshape(posterior.shape)
+        recurrent_state = self.recurrent_model(
+            jnp.concatenate([posterior, action], -1), recurrent_state
+        )
+        posterior_logits, posterior = self._representation_from_proj(
+            emb_proj, recurrent_state, noise=noise, key=key
+        )
+        return recurrent_state, posterior, posterior_logits
+
+    def recurrent_step_gated(
+        self,
+        prev_posterior: jax.Array,
+        recurrent_state: jax.Array,
+        action: jax.Array,
+        is_first: jax.Array,
+        init_states: Tuple[jax.Array, jax.Array],
+    ) -> jax.Array:
+        """Decoupled-RSSM scan body: is_first-gated reset + recurrent model
+        only (posteriors are precomputed in batch, priors are batched over
+        the stacked recurrent states outside the scan)."""
+        init_rec, init_post = init_states
+        action = (1 - is_first) * action
+        recurrent_state = (1 - is_first) * recurrent_state + is_first * init_rec
+        prev = prev_posterior.reshape(*prev_posterior.shape[:-2], -1)
+        prev = (1 - is_first) * prev + is_first * init_post.reshape(prev.shape)
+        return self.recurrent_model(
+            jnp.concatenate([prev, action], -1), recurrent_state
+        )
+
+    def imagination(
+        self,
+        prior: jax.Array,
+        recurrent_state: jax.Array,
+        actions: jax.Array,
+        key: Optional[jax.Array],
+        noise: Optional[jax.Array] = None,
+    ):
         recurrent_state = self.recurrent_model(
             jnp.concatenate([prior, actions], -1), recurrent_state
         )
-        _, imagined_prior = self._transition(recurrent_state, key)
+        _, imagined_prior = self._transition(recurrent_state, key, noise=noise)
         return imagined_prior, recurrent_state
 
 

@@ -30,6 +30,7 @@ import numpy as np
 import optax
 
 from sheeprl_tpu.algos.dreamer_v3.agent import RSSM, PlayerDV3, build_agent
+from sheeprl_tpu.models.models import resolve_activation
 from sheeprl_tpu.algos.dreamer_v3.loss import reconstruction_loss
 from sheeprl_tpu.algos.dreamer_v3.utils import (
     compute_lambda_values,
@@ -69,6 +70,87 @@ from sheeprl_tpu.optim import restore_opt_states
 sg = jax.lax.stop_gradient
 
 
+def _mlp_geometry(tree):
+    """(n_hidden_layers, units, has_layer_norm) of a DreamerMLP param tree,
+    or None if the tree isn't shaped like one."""
+    p = tree.get("params", tree)
+    layers = sorted(k for k in p if k.startswith("LinearLnAct_"))
+    if not layers or "Dense_0" not in p:
+        return None
+    first = p[layers[0]]
+    if "Dense_0" not in first:
+        return None
+    units = first["Dense_0"]["kernel"].shape[-1]
+    has_ln = "LayerNorm_0" in first
+    for name in layers:
+        blk = p[name]
+        if blk["Dense_0"]["kernel"].shape[-1] != units or ("LayerNorm_0" in blk) != has_ln:
+            return None
+    return len(layers), units, has_ln
+
+
+def fused_mlp_heads(trees, x, eps, act_fn, dtype):
+    """Run several same-geometry DreamerMLP heads over one shared input as
+    batched matmuls.
+
+    The DV3 trajectory heads (critic / reward / continue, and the two
+    critics of the value loss) each run a small (D, U) MLP over the same
+    (H+1, T*B, D) imagined-trajectory tensor; issued separately they are
+    latency-bound dispatches.  Concatenating the first-layer kernels and
+    batching the deeper layers as ``einsum('...hu,huv->...hv')`` turns 3N
+    small ops into N wide MXU ops.  Returns the per-head f32 logits list.
+    Gradients flow exactly as in the unfused form (concat/slice are linear).
+    """
+    n = len(trees)
+    ps = [t.get("params", t) for t in trees]
+    geom = _mlp_geometry(trees[0])
+    layers, units, has_ln = geom
+    k1 = jnp.concatenate(
+        [p["LinearLnAct_0"]["Dense_0"]["kernel"].astype(dtype) for p in ps], -1
+    )
+    h = (x.astype(dtype) @ k1).reshape(*x.shape[:-1], n, units)
+    for li in range(layers):
+        if li > 0:
+            wl = jnp.stack(
+                [p[f"LinearLnAct_{li}"]["Dense_0"]["kernel"].astype(dtype) for p in ps]
+            )
+            h = jnp.einsum("...hu,huv->...hv", h, wl)
+        if has_ln:
+            scale = jnp.stack([p[f"LinearLnAct_{li}"]["LayerNorm_0"]["scale"] for p in ps])
+            bias = jnp.stack([p[f"LinearLnAct_{li}"]["LayerNorm_0"]["bias"] for p in ps])
+            hf = h.astype(jnp.float32)
+            mu = hf.mean(-1, keepdims=True)
+            var = ((hf - mu) ** 2).mean(-1, keepdims=True)
+            h = (hf - mu) * jax.lax.rsqrt(var + eps) * scale + bias
+        else:
+            h = h + jnp.stack(
+                [p[f"LinearLnAct_{li}"]["Dense_0"]["bias"] for p in ps]
+            ).astype(h.dtype)
+        h = act_fn(h.astype(dtype))
+    hf = h.astype(jnp.float32)
+    return [
+        hf[..., i, :] @ p["Dense_0"]["kernel"] + p["Dense_0"]["bias"]
+        for i, p in enumerate(ps)
+    ]
+
+
+def _heads_fusible(trees, modules):
+    # measured OFF by default: on a single v5e the fused path compiled to
+    # MORE flops (the separate per-head evals let XLA CSE the online-critic
+    # forward between the actor and critic losses) and a slower step
+    # (17.1 ms vs 15.9 ms at DV3-S); kept behind a flag for multi-chip
+    # studies where dispatch latency dominates
+    if os.environ.get("SHEEPRL_FUSE_HEADS", "0") != "1":
+        return False
+    # the fused path evaluates every head with ONE activation/eps — require
+    # the modules to actually agree, not just their kernel geometry
+    m0 = modules[0]
+    if not all(m.act == m0.act and m.eps == m0.eps and m.layer_norm == m0.layer_norm for m in modules):
+        return False
+    geoms = [_mlp_geometry(t) for t in trees]
+    return all(g is not None and g == geoms[0] for g in geoms)
+
+
 def _make_optimizer(optim_cfg, clip_gradients, precision="32-true"):
     from sheeprl_tpu.optim import build_optimizer
 
@@ -97,6 +179,30 @@ def make_train_fn(runtime, world_model, actor, critic, txs, cfg, is_continuous, 
     continue_scale_factor = float(cfg.algo.world_model.continue_scale_factor)
     moments_cfg = cfg.algo.actor.moments
     decoupled = bool(cfg.algo.world_model.decoupled_rssm)
+    # scan bodies at Dreamer sizes are launch/latency-bound (B=16 rows keep
+    # every matmul far below an MXU tile): unrolling lets XLA fuse across
+    # iterations and cuts while-loop trip counts, which round-3 profiling
+    # showed to be 56% of device step time (dv3_profile_r3.json)
+    scan_unroll = int(os.environ.get("SHEEPRL_SCAN_UNROLL", getattr(cfg.algo, "scan_unroll", 8) or 8))
+    img_unroll = int(os.environ.get("SHEEPRL_IMG_UNROLL", getattr(cfg.algo, "imagination_unroll", 3) or 3))
+    remat_policy = os.environ.get("SHEEPRL_REMAT_POLICY", "dots")
+    dyn_remat_policy = os.environ.get("SHEEPRL_DYN_REMAT", remat_policy)
+
+    def _remat(f, policy_name=None):
+        # full remat keeps only the scan carry+outputs; "dots" additionally
+        # saves matmul results so the backward pass re-runs only the cheap
+        # elementwise chains, not the MXU work.  "dots" measured best for
+        # BOTH scans on a v5e (imagination: kills the ~40 stacked
+        # (H, T*B, 512) residual buffers; dynamic: 16.15 ms vs 16.78 ms
+        # without remat even at B=16 rows)
+        p = remat_policy if policy_name is None else policy_name
+        if p == "none":
+            return f
+        if p == "dots":
+            return jax.checkpoint(
+                f, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+            )
+        return jax.checkpoint(f)
 
     rssm = world_model.rssm
 
@@ -114,70 +220,106 @@ def make_train_fn(runtime, world_model, actor, critic, txs, cfg, is_continuous, 
         )
 
         # ---------------------------------------------------- world model
+        # all the rollout's categorical-sampling randomness is drawn HERE, in
+        # two batched gumbel ops, instead of 3 threefry chains per scan
+        # iteration — the scan bodies are latency-bound, so op count inside
+        # the sequential loop is what sets the step time
+        noise_shape = (T, B, stochastic_size, discrete_size)
+        dyn_noise_q = jax.random.gumbel(k_dyn, noise_shape, jnp.float32)
+
+        # the CNN encoder converts to the compute dtype at its first conv
+        # anyway; handing it a bf16 copy halves the biggest single input read
+        # (the (T, B, 64, 64, C) pixel stack).  MLP observations stay f32:
+        # their encoder applies symlog BEFORE the first Dense, so pre-rounding
+        # them would change the compression.  Loss targets keep f32 originals.
+        enc_obs = {k: batch_obs[k].astype(runtime.compute_dtype) for k in cnn_keys}
+        enc_obs.update({k: batch_obs[k] for k in mlp_keys})
+
         def wm_loss_fn(wm_params):
-            embedded_obs = world_model.encoder.apply(wm_params["encoder"], batch_obs)  # (T, B, E)
-            dyn_keys = jax.random.split(k_dyn, T)
+            embedded_obs = world_model.encoder.apply(wm_params["encoder"], enc_obs)  # (T, B, E)
+            # constant wrt t: evaluate the learned initial state (which runs
+            # the transition MLP) ONCE instead of in every scan iteration
+            init_states = rssm.apply(
+                wm_params["rssm"], (B,), method=RSSM.get_initial_states
+            )
+            init_states = (init_states[0], init_states[1].reshape(B, -1))
 
             if decoupled:
                 # posterior depends only on obs (reference DecoupledRSSM:501;
                 # dreamer_v3.py:117-131): compute all posteriors up front,
                 # roll the recurrent model with the previous-step posterior
                 posteriors_logits, posteriors = rssm.apply(
-                    wm_params["rssm"], embedded_obs, k_dyn, method=RSSM._representation
+                    wm_params["rssm"], embedded_obs, None, noise=dyn_noise_q,
+                    method=RSSM._representation,
                 )
                 prev_posteriors = jnp.concatenate(
                     [jnp.zeros_like(posteriors[:1]), posteriors[:-1]], 0
                 )
 
                 def dyn_step_dec(recurrent_state, inp):
-                    prev_post, action, first, kk = inp
-                    recurrent_state, _, prior_logits = rssm.apply(
+                    prev_post, action, first = inp
+                    recurrent_state = rssm.apply(
                         wm_params["rssm"],
                         prev_post,
                         recurrent_state,
                         action,
-                        jnp.zeros(()),  # unused in decoupled mode
                         first,
-                        kk,
-                        method=RSSM.dynamic,
+                        init_states,
+                        method=RSSM.recurrent_step_gated,
                     )
-                    return recurrent_state, (recurrent_state, prior_logits)
+                    return recurrent_state, recurrent_state
 
-                _, (recurrent_states, priors_logits) = jax.lax.scan(
+                _, recurrent_states = jax.lax.scan(
                     dyn_step_dec,
                     jnp.zeros((B, recurrent_state_size)),
-                    (prev_posteriors, batch_actions, is_first, dyn_keys),
+                    (prev_posteriors, batch_actions, is_first),
+                    unroll=scan_unroll,
                 )
             else:
 
+                # embed half of the representation model's first matmul,
+                # batched over the whole sequence (see representation_embed_proj)
+                emb_proj = rssm.apply(
+                    wm_params["rssm"], embedded_obs, method=RSSM.representation_embed_proj
+                )
+
                 def dyn_step(carry, inp):
                     posterior, recurrent_state = carry
-                    action, emb, first, kk = inp
-                    out = rssm.apply(
+                    action, emb, first, nq_t = inp
+                    recurrent_state, posterior, posterior_logits = rssm.apply(
                         wm_params["rssm"],
                         posterior,
                         recurrent_state,
                         action,
                         emb,
                         first,
-                        kk,
-                        method=RSSM.dynamic,
+                        init_states,
+                        noise=nq_t,
+                        method=RSSM.dynamic_posterior,
                     )
-                    recurrent_state, posterior, _, posterior_logits, prior_logits = out
                     return (posterior, recurrent_state), (
                         recurrent_state,
                         posterior,
                         posterior_logits,
-                        prior_logits,
                     )
 
                 init = (
                     jnp.zeros((B, stochastic_size, discrete_size)),
                     jnp.zeros((B, recurrent_state_size)),
                 )
-                _, (recurrent_states, posteriors, posteriors_logits, priors_logits) = jax.lax.scan(
-                    dyn_step, init, (batch_actions, embedded_obs, is_first, dyn_keys)
+                _, (recurrent_states, posteriors, posteriors_logits) = jax.lax.scan(
+                    _remat(dyn_step, dyn_remat_policy), init,
+                    (batch_actions, emb_proj, is_first, dyn_noise_q),
+                    unroll=scan_unroll,
                 )
+            # prior logits for the KL, batched over the stacked recurrent
+            # states of the whole sequence (the prior SAMPLE is unused by
+            # the world-model loss, so nothing prior-related needs to live
+            # inside the sequential scan)
+            priors_logits, _ = rssm.apply(
+                wm_params["rssm"], recurrent_states, None, sample_state=False,
+                method=RSSM._transition,
+            )
             latent_states = jnp.concatenate(
                 [posteriors.reshape(T, B, -1), recurrent_states], -1
             )
@@ -259,46 +401,74 @@ def make_train_fn(runtime, world_model, actor, critic, txs, cfg, is_continuous, 
         )
         true_continue = (1 - data["terminated"]).swapaxes(0, 1).reshape(1, T * B, 1)
 
-        def actor_loss_fn(actor_params):
-            img_keys = jax.random.split(k_img, horizon + 1)
+        # imagination RNG, hoisted out of the scan body like the dynamic
+        # scan's: one batched gumbel draw for every step's prior sample,
+        # pre-split keys for the actor heads
+        k_img_n, k_img_a = jax.random.split(k_img)
+        img_noise = jax.random.gumbel(
+            k_img_n, (horizon, T * B, stochastic_size, discrete_size), jnp.float32
+        )
+        act_keys = jax.random.split(k_img_a, horizon + 1)
 
-            latent0 = jnp.concatenate([imagined_prior0, recurrent_state0], -1)
-            acts0, _ = actor.apply(actor_params, sg(latent0), False, img_keys[0])
+        traj_dtype = runtime.compute_dtype
+
+        def actor_loss_fn(actor_params):
+            latent0 = jnp.concatenate([imagined_prior0, recurrent_state0], -1).astype(traj_dtype)
+            acts0, _ = actor.apply(actor_params, sg(latent0), False, act_keys[0])
             action0 = jnp.concatenate(acts0, -1)
 
-            def img_step(carry, kk):
+            def img_step(carry, inp):
                 prior, rec, action = carry
-                k_im, k_act = jax.random.split(kk)
+                n_t, k_act = inp
                 imagined_prior, rec = rssm.apply(
-                    new_wm_params["rssm"], prior, rec, action, k_im, method=RSSM.imagination
+                    new_wm_params["rssm"], prior, rec, action, None, noise=n_t,
+                    method=RSSM.imagination,
                 )
                 imagined_prior = imagined_prior.reshape(-1, stoch_state_size)
                 latent = jnp.concatenate([imagined_prior, rec], -1)
                 acts, _ = actor.apply(actor_params, sg(latent), False, k_act)
                 action = jnp.concatenate(acts, -1)
-                return (imagined_prior, rec, action), (latent, action)
+                # stack the trajectory in the compute dtype: every consumer
+                # (critic/reward/continue/actor heads) immediately converts
+                # to bf16 anyway, and the (H, T*B, L) stacks are the step's
+                # biggest activation traffic (reference trains these heads
+                # under torch.autocast bf16, so precision semantics match)
+                return (imagined_prior, rec, action), (latent.astype(traj_dtype), action)
 
+            # remat: the imagination while-loop is HBM-bound on the ~40
+            # stacked (H, T*B, 512) residual buffers autodiff saves for the
+            # backward pass — recomputing the body instead keeps only the
+            # carry + outputs and cuts the loop's memory traffic several-fold
             (_, _, _), (latents, actions_seq) = jax.lax.scan(
-                img_step, (imagined_prior0, recurrent_state0, action0), img_keys[1:]
+                _remat(img_step), (imagined_prior0, recurrent_state0, action0),
+                (img_noise, act_keys[1:]),
+                unroll=img_unroll,
             )
             imagined_trajectories = jnp.concatenate([latent0[None], latents], 0)  # (H+1, TB, L)
             imagined_actions = jnp.concatenate([action0[None], actions_seq], 0)
 
-            predicted_values = TwoHotEncodingDistribution(
-                critic.apply(params["critic"], imagined_trajectories), dims=1
-            ).mean
-            predicted_rewards = TwoHotEncodingDistribution(
-                world_model.reward_model.apply(new_wm_params["reward_model"], imagined_trajectories),
-                dims=1,
-            ).mean
-            continues = Independent(
-                BernoulliSafeMode(
-                    logits=world_model.continue_model.apply(
-                        new_wm_params["continue_model"], imagined_trajectories
-                    )
-                ),
-                1,
-            ).mode
+            traj_head_trees = [
+                params["critic"],
+                new_wm_params["reward_model"],
+                new_wm_params["continue_model"],
+            ]
+            traj_head_modules = (critic, world_model.reward_model, world_model.continue_model)
+            if _heads_fusible(traj_head_trees, traj_head_modules):
+                v_logits, r_logits, c_logits = fused_mlp_heads(
+                    traj_head_trees, imagined_trajectories,
+                    float(critic.eps), resolve_activation(critic.act), traj_dtype,
+                )
+            else:
+                v_logits = critic.apply(params["critic"], imagined_trajectories)
+                r_logits = world_model.reward_model.apply(
+                    new_wm_params["reward_model"], imagined_trajectories
+                )
+                c_logits = world_model.continue_model.apply(
+                    new_wm_params["continue_model"], imagined_trajectories
+                )
+            predicted_values = TwoHotEncodingDistribution(v_logits, dims=1).mean
+            predicted_rewards = TwoHotEncodingDistribution(r_logits, dims=1).mean
+            continues = Independent(BernoulliSafeMode(logits=c_logits), 1).mode
             continues = jnp.concatenate([true_continue.squeeze(0)[None], continues[1:]], 0)
 
             lambda_vals = compute_lambda_values(
@@ -359,10 +529,16 @@ def make_train_fn(runtime, world_model, actor, critic, txs, cfg, is_continuous, 
         lambda_vals = actor_aux["lambda_values"]
 
         def critic_loss_fn(critic_params):
-            qv = TwoHotEncodingDistribution(critic.apply(critic_params, traj), dims=1)
-            predicted_target_values = TwoHotEncodingDistribution(
-                critic.apply(params["target_critic"], traj), dims=1
-            ).mean
+            if _heads_fusible([critic_params, params["target_critic"]], (critic, critic)):
+                q_logits, tgt_logits = fused_mlp_heads(
+                    [critic_params, params["target_critic"]], traj,
+                    float(critic.eps), resolve_activation(critic.act), traj_dtype,
+                )
+            else:
+                q_logits = critic.apply(critic_params, traj)
+                tgt_logits = critic.apply(params["target_critic"], traj)
+            qv = TwoHotEncodingDistribution(q_logits, dims=1)
+            predicted_target_values = TwoHotEncodingDistribution(tgt_logits, dims=1).mean
             value_loss = -qv.log_prob(lambda_vals)
             value_loss = value_loss - qv.log_prob(sg(predicted_target_values))
             return jnp.mean(value_loss * discount[:-1].squeeze(-1))

@@ -355,11 +355,24 @@ class TwoHotEncodingDistribution(Distribution):
     255 bins over [-20, 20])."""
 
     def __init__(self, logits: jax.Array, dims: int = 1, low: float = -20.0, high: float = 20.0):
-        self.logits = logits - jax.scipy.special.logsumexp(logits, -1, keepdims=True)
-        self.probs = jax.nn.softmax(logits, -1)
+        self._raw_logits = logits
         self._dims = tuple(range(-dims, 0))
         self.bins = jnp.linspace(low, high, logits.shape[-1])
         self.low, self.high = low, high
+
+    # normalized logits / probs are LAZY: most call sites use only one of
+    # .mean (probs) or .log_prob (logits), and each materializes a full
+    # (..., num_buckets) pass — computing both eagerly doubled the head
+    # read traffic of every train step
+    @property
+    def logits(self) -> jax.Array:
+        return self._raw_logits - jax.scipy.special.logsumexp(
+            self._raw_logits, -1, keepdims=True
+        )
+
+    @property
+    def probs(self) -> jax.Array:
+        return jax.nn.softmax(self._raw_logits, -1)
 
     @property
     def mean(self) -> jax.Array:

@@ -51,14 +51,18 @@ def two_hot_encoder(x: jax.Array, support_range: int = 300, num_buckets: Optiona
     if num_buckets is None:
         num_buckets = support_range * 2 + 1
     # plain two-hot, no symlog: like the reference util, the symlog
-    # compression is the caller's (TwoHotEncodingDistribution's) job
+    # compression is the caller's (TwoHotEncodingDistribution's) job.
+    # the support is a uniform linspace, so the bracketing bin and its value
+    # are closed-form — no (..., num_buckets) comparison broadcast and no
+    # gathers (TPU gathers are slow; this op runs on every reward/value
+    # target of every train step)
     x = jnp.clip(x, -support_range, support_range)
-    support = jnp.linspace(-support_range, support_range, num_buckets)
-    below = (support <= x).astype(jnp.int32).sum(-1, keepdims=True) - 1
+    step = (2.0 * support_range) / (num_buckets - 1)
+    below = jnp.floor((x + support_range) / step).astype(jnp.int32)
     below = jnp.clip(below, 0, num_buckets - 1)
     above = jnp.clip(below + 1, 0, num_buckets - 1)
-    sup_below = jnp.take(support, below.squeeze(-1))[..., None]
-    sup_above = jnp.take(support, above.squeeze(-1))[..., None]
+    sup_below = -support_range + below.astype(x.dtype) * step
+    sup_above = -support_range + above.astype(x.dtype) * step
     equal = below == above
     dist_below = jnp.where(equal, 1.0, jnp.abs(sup_below - x))
     dist_above = jnp.where(equal, 1.0, jnp.abs(sup_above - x))
@@ -141,7 +145,10 @@ def lambda_values(
         carry = it + cont * lmbda * carry
         return carry, carry
 
-    _, ret = jax.lax.scan(step, values[-1], (interm, continues), reverse=True)
+    # the recursion is a handful of elementwise ops over (B, 1) rows — full
+    # unroll turns the whole return computation (fwd AND transpose/bwd) into
+    # one fusion instead of a 15-trip while loop
+    _, ret = jax.lax.scan(step, values[-1], (interm, continues), reverse=True, unroll=16)
     return ret
 
 
