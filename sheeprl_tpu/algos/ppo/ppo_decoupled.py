@@ -70,7 +70,10 @@ def _player_loop(cfg, data_q: mp.Queue, resp_q: mp.Queue, state_counters, world_
     import gymnasium as gym
     from gymnasium.vector import AsyncVectorEnv, AutoresetMode, SyncVectorEnv
 
+    from sheeprl_tpu.cli import install_stack_dumper
     from sheeprl_tpu.parallel.mesh import MeshRuntime
+
+    install_stack_dumper(suffix=".player")
 
     if cfg.metric.log_level == 0:
         MetricAggregator.disabled = True
@@ -125,10 +128,18 @@ def _player_loop(cfg, data_q: mp.Queue, resp_q: mp.Queue, state_counters, world_
     module, params = build_agent(runtime, actions_dim, is_continuous, cfg, observation_space)
     tag, payload = resp_q.get(timeout=_QUEUE_TIMEOUT_S)
     assert tag == "params", f"expected initial params, got {tag}"
+    # pin the acting policy to the HOST CPU device explicitly: the
+    # JAX_PLATFORMS=cpu env the parent exports around the spawn does NOT
+    # stop a PJRT plugin (axon tunnel) from registering itself as the
+    # default backend in this child — an unpinned jit then runs every env
+    # step's action over the remote link (~0.1 s RTT each, observed before
+    # this pin: a CartPole rollout of 128 steps took minutes)
+    host_cpu = jax.local_devices(backend="cpu")[0]
     player = PPOPlayer(
         module,
-        jax.tree_util.tree_map(jnp.asarray, payload),
+        payload,
         lambda o: prepare_obs(o, cnn_keys=cnn_keys, num_envs=total_envs),
+        device=host_cpu,
     )
 
     save_configs(cfg, log_dir)
@@ -228,7 +239,12 @@ def _player_loop(cfg, data_q: mp.Queue, resp_q: mp.Queue, state_counters, world_
             timeout=_QUEUE_TIMEOUT_S
         )
         assert tag == "update", f"expected update, got {tag}"
-        player.params = jax.tree_util.tree_map(jnp.asarray, new_params)
+        # hand the numpy tree straight to the setter: jnp.asarray here would
+        # place the fresh params on the DEFAULT backend (the tunnel-attached
+        # chip) and the setter's transfer to the host-CPU player would then
+        # round-trip every leaf over the link — ~1 s/iteration, observed as
+        # decoupled running 5x slower than coupled before this change
+        player.params = new_params
         train_step += 1
         train_time_window += info_scalars.pop("train_time", 0.0)
 

@@ -51,7 +51,10 @@ def _player_loop(
     import gymnasium as gym
     from gymnasium.vector import AsyncVectorEnv, AutoresetMode, SyncVectorEnv
 
+    from sheeprl_tpu.cli import install_stack_dumper
     from sheeprl_tpu.parallel.mesh import MeshRuntime
+
+    install_stack_dumper(suffix=".player")
 
     if cfg.metric.log_level == 0:
         MetricAggregator.disabled = True
@@ -100,10 +103,15 @@ def _player_loop(
     actor, critic, params, _ = build_agent(runtime, cfg, observation_space, action_space)
     tag, payload = resp_q.get(timeout=_QUEUE_TIMEOUT_S)
     assert tag == "params", f"expected initial params, got {tag}"
+    # explicit host-CPU pin — see ppo_decoupled._player_loop: the axon PJRT
+    # plugin ignores the JAX_PLATFORMS=cpu export and would otherwise run
+    # every env step's action over the tunnel
+    host_cpu = jax.local_devices(backend="cpu")[0]
     player = SACPlayer(
         actor,
-        jax.tree_util.tree_map(jnp.asarray, payload),
+        payload,
         lambda obs: prepare_obs(obs, mlp_keys=mlp_keys, num_envs=total_envs),
+        device=host_cpu,
     )
 
     save_configs(cfg, log_dir)
@@ -218,7 +226,9 @@ def _player_loop(
 
                 tag, actor_params, train_metrics = resp_q.get(timeout=_QUEUE_TIMEOUT_S)
                 assert tag == "update", f"expected update, got {tag}"
-                player.params = jax.tree_util.tree_map(jnp.asarray, actor_params)
+                # numpy straight to the setter — see ppo_decoupled: jnp.asarray
+                # would stage the params on the tunnel backend first
+                player.params = actor_params
                 cumulative_per_rank_gradient_steps += g
                 train_step += world_size
                 train_time_window += train_metrics.pop("train_time", 0.0)

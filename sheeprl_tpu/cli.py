@@ -224,27 +224,40 @@ def run_algorithm(cfg: dotdict) -> None:
         entry_fn(runtime, cfg)
 
 
-def run(args: Optional[Sequence[str]] = None) -> None:
-    """Main training app: ``sheeprl exp=... [overrides...]``."""
+def install_stack_dumper(suffix: str = "") -> None:
+    """Observability for long headless runs: dump every thread's stack to
+    ``SHEEPRL_STACK_DUMP_FILE``(+suffix) every ``SHEEPRL_STACK_DUMP_S``
+    seconds, so a slow/stuck loop shows WHERE it sits without gdb/py-spy.
+    Decoupled player subprocesses call this too (with a suffix), since the
+    parent's dumper cannot see their threads."""
     try:
         stack_dump_s = float(os.environ.get("SHEEPRL_STACK_DUMP_S", 0))
     except ValueError:
         stack_dump_s = 0.0
-    if stack_dump_s > 0:
-        # observability for long headless runs: dump every thread's stack
-        # to the given file on a fixed cadence, so a slow/stuck training
-        # loop shows WHERE it sits without gdb/py-spy on the host
-        import faulthandler
+    if stack_dump_s <= 0:
+        return
+    # idempotent per-process: repeated run() calls in one interpreter (the
+    # bench harness) must neither truncate earlier legs' stack history nor
+    # leak the previously registered dump file
+    if getattr(install_stack_dumper, "_installed", None) == suffix:
+        return
+    import faulthandler
 
-        path = os.environ.get("SHEEPRL_STACK_DUMP_FILE", "/tmp/sheeprl_stacks.log")
-        try:
-            dump_file = open(path, "w", buffering=1)
-        except OSError as e:  # diagnostics must never kill the run
-            warnings.warn(f"stack dump disabled, cannot open {path}: {e}")
-        else:
-            faulthandler.dump_traceback_later(
-                stack_dump_s, repeat=True, file=dump_file, exit=False
-            )
+    path = os.environ.get("SHEEPRL_STACK_DUMP_FILE", "/tmp/sheeprl_stacks.log") + suffix
+    try:
+        dump_file = open(path, "a", buffering=1)
+    except OSError as e:  # diagnostics must never kill the run
+        warnings.warn(f"stack dump disabled, cannot open {path}: {e}")
+    else:
+        install_stack_dumper._installed = suffix
+        faulthandler.dump_traceback_later(
+            stack_dump_s, repeat=True, file=dump_file, exit=False
+        )
+
+
+def run(args: Optional[Sequence[str]] = None) -> None:
+    """Main training app: ``sheeprl exp=... [overrides...]``."""
+    install_stack_dumper()
     overrides = list(args if args is not None else sys.argv[1:])
     cfg = compose(config_name="config", overrides=overrides)
     if cfg.get("num_threads"):
