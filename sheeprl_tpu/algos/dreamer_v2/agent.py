@@ -23,7 +23,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from sheeprl_tpu.models.models import LayerNormGRUCell, resolve_activation
+from sheeprl_tpu.models.models import (
+    LayerNormGRUCell,
+    batch_major_flatten,
+    batch_major_unflatten,
+    resolve_activation,
+)
 from sheeprl_tpu.utils.distribution import (
     Independent,
     Normal,
@@ -86,6 +91,8 @@ class CNNEncoder(nn.Module):
     @nn.compact
     def __call__(self, obs: Dict[str, jax.Array]) -> jax.Array:
         x = jnp.concatenate([obs[k] for k in self.keys], axis=-1)
+        # sharding-critical: see batch_major_flatten
+        x, lead = batch_major_flatten(x, 3)
         for i in range(4):
             x = nn.Conv(
                 (2**i) * self.channels_multiplier,
@@ -98,7 +105,7 @@ class CNNEncoder(nn.Module):
             if self.layer_norm:
                 x = nn.LayerNorm()(x)
             x = resolve_activation(self.act)(x.astype(self.dtype))
-        return x.reshape(*x.shape[:-3], -1)
+        return batch_major_unflatten(x.reshape(x.shape[0], -1), lead)
 
 
 class MLPEncoder(nn.Module):
@@ -142,8 +149,9 @@ class CNNDecoder(nn.Module):
 
     @nn.compact
     def __call__(self, latent: jax.Array) -> Dict[str, jax.Array]:
-        lead = latent.shape[:-1]
         x = nn.Dense(self.cnn_encoder_output_dim, kernel_init=xavier_init, dtype=self.dtype)(latent)
+        # sharding-critical: see batch_major_flatten
+        x, lead = batch_major_flatten(x, 1)
         x = x.reshape(-1, 1, 1, self.cnn_encoder_output_dim)
         chans = [4 * self.channels_multiplier, 2 * self.channels_multiplier, self.channels_multiplier]
         kernels = [5, 5, 6, 6]
@@ -163,7 +171,7 @@ class CNNDecoder(nn.Module):
             padding="VALID",
             kernel_init=xavier_init,
         )(x)
-        x = x.reshape(*lead, *x.shape[1:])
+        x = batch_major_unflatten(x, lead)
         out: Dict[str, jax.Array] = {}
         start = 0
         for k, c in zip(self.keys, self.output_channels):

@@ -28,7 +28,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from sheeprl_tpu.models.models import MLP, LayerNormGRUCell, resolve_activation
+from sheeprl_tpu.models.models import (
+    MLP,
+    LayerNormGRUCell,
+    batch_major_flatten,
+    batch_major_unflatten,
+    resolve_activation,
+)
 from sheeprl_tpu.utils.distribution import (
     Independent,
     Normal,
@@ -122,6 +128,8 @@ class CNNEncoder(nn.Module):
     @nn.compact
     def __call__(self, obs: Dict[str, jax.Array]) -> jax.Array:
         x = jnp.concatenate([obs[k] for k in self.keys], axis=-1)  # channel concat
+        # sharding-critical: see batch_major_flatten
+        x, lead = batch_major_flatten(x, 3)
         for i in range(self.stages):
             x = nn.Conv(
                 (2**i) * self.channels_multiplier,
@@ -135,7 +143,7 @@ class CNNEncoder(nn.Module):
             if self.layer_norm:
                 x = nn.LayerNorm(epsilon=self.eps)(x)  # f32 statistics
             x = resolve_activation(self.act)(x.astype(self.dtype))
-        return x.reshape(*x.shape[:-3], -1)
+        return batch_major_unflatten(x.reshape(x.shape[0], -1), lead)
 
 
 class MLPEncoder(nn.Module):
@@ -189,8 +197,9 @@ class CNNDecoder(nn.Module):
 
     @nn.compact
     def __call__(self, latent: jax.Array) -> Dict[str, jax.Array]:
-        lead = latent.shape[:-1]
         x = nn.Dense(self.cnn_encoder_output_dim, kernel_init=trunc_init, dtype=self.dtype)(latent)
+        # sharding-critical: see batch_major_flatten
+        x, lead = batch_major_flatten(x, 1)
         x = x.reshape(-1, 4, 4, (2 ** (self.stages - 1)) * self.channels_multiplier)
         for i in range(self.stages - 1):
             ch = (2 ** (self.stages - i - 2)) * self.channels_multiplier
@@ -214,7 +223,7 @@ class CNNDecoder(nn.Module):
             padding=[(2, 2), (2, 2)],
             kernel_init=uniform_out_init(1.0),
         )(x.astype(jnp.float32))
-        x = x.reshape(*lead, *x.shape[1:])
+        x = batch_major_unflatten(x, lead)
         out: Dict[str, jax.Array] = {}
         start = 0
         for k, c in zip(self.keys, self.output_channels):

@@ -13,6 +13,14 @@ conv layout), where the reference defaults to channels-first for torch.
 
 from __future__ import annotations
 
+import os
+
+# MuJoCo's GL backend must be chosen before dm_control loads its rendering
+# stack.  Unset, it tries GLFW, which aborts (SIGABRT) on headless hosts
+# with no display; EGL drives a GPU-less software context fine.  Only a
+# default — export MUJOCO_GL to override.
+os.environ.setdefault("MUJOCO_GL", "egl")
+
 from sheeprl_tpu.utils.imports import _IS_DMC_AVAILABLE
 
 if not _IS_DMC_AVAILABLE:

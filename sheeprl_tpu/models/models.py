@@ -61,6 +61,34 @@ def resolve_activation(act: Union[str, Callable, None]) -> Callable:
     return _ACTIVATIONS[key]
 
 
+def batch_major_flatten(x: jax.Array, event_ndims: int) -> Tuple[jax.Array, Tuple[int, ...]]:
+    """Flatten the leading dims of ``x`` (all but the last ``event_ndims``)
+    BATCH-major: ``(T, B, *event) -> (B*T, *event)``.
+
+    Sharding-critical: flax's Conv/ConvTranspose flatten leading dims
+    time-major, which interleaves a mesh-sharded axis-1 batch, so GSPMD
+    all-gathers and every device runs the conv stack on the FULL global
+    batch (caught by benchmarks/flops_probe.py).  Returns the flattened
+    array and the original leading shape for :func:`batch_major_unflatten`.
+    Inputs with a single leading dim pass through untouched.
+    """
+    lead = x.shape[:-event_ndims]
+    if len(lead) == 2:
+        x = x.swapaxes(0, 1).reshape(-1, *x.shape[-event_ndims:])
+    elif len(lead) != 1:
+        x = x.reshape(-1, *x.shape[-event_ndims:])
+    return x, lead
+
+
+def batch_major_unflatten(x: jax.Array, lead: Tuple[int, ...]) -> jax.Array:
+    """Inverse of :func:`batch_major_flatten` over the new event shape."""
+    if len(lead) == 2:
+        return x.reshape(lead[1], lead[0], *x.shape[1:]).swapaxes(0, 1)
+    if len(lead) == 1:
+        return x
+    return x.reshape(*lead, *x.shape[1:])
+
+
 def _per_layer(spec: Any, n: int) -> list:
     """Broadcast a scalar spec to n layers (reference utils/model.py create_layers)."""
     if isinstance(spec, (list, tuple)):
