@@ -1,8 +1,11 @@
 """Checkpointing (reference sheeprl/utils/callback.py:14-148 + fabric.save).
 
 State pytrees (params, optimizer states, counters, Ratio/Moments state)
-are ``jax.device_get``-ed and serialized with cloudpickle; replay buffers
-are host-side numpy already. Before saving, off-policy buffers are made
+are ``jax.device_get``-ed and written in the versioned leaf-manifest
+format (``utils/ckpt_format.py``: JSON structure + plain .npy leaves in
+one zip — stable across refactors, partially readable); cloudpickle is
+kept as a READ fallback for pre-v1 checkpoints. Replay buffers are
+host-side numpy already. Before saving, off-policy buffers are made
 consistent by forcing a truncation at the write head (``_ckpt_rb``) and
 restored right after — exactly the reference semantics (callback.py:92-131).
 
@@ -79,8 +82,9 @@ class CheckpointCallback:
         state: Dict[str, Any],
     ) -> Optional[str]:
         """Serialize ``state`` to ``ckpt_path`` on global rank zero."""
-        import cloudpickle
         import jax
+
+        from sheeprl_tpu.utils.ckpt_format import save_state
 
         if not runtime.is_global_zero:
             return None
@@ -98,11 +102,7 @@ class CheckpointCallback:
                 else:
                     host_state[k] = jax.device_get(v)
             path = Path(ckpt_path)
-            path.parent.mkdir(parents=True, exist_ok=True)
-            tmp = path.with_suffix(path.suffix + ".tmp")
-            with open(tmp, "wb") as f:
-                cloudpickle.dump(host_state, f)
-            os.replace(tmp, path)
+            save_state(path, host_state)
         finally:
             self._restore_rb(restore)
         if self.keep_last:
@@ -162,11 +162,26 @@ class CheckpointCallback:
                     pass
 
 
-def load_checkpoint(path: Union[str, os.PathLike]) -> Dict[str, Any]:
+def load_checkpoint(
+    path: Union[str, os.PathLike], select: Optional[Sequence[str]] = None
+) -> Dict[str, Any]:
+    """Load a checkpoint: the versioned leaf-manifest format, with a
+    cloudpickle fallback for pre-v1 checkpoints (migration = resume once;
+    the next save writes v1).  ``select`` limits a v1 load to the given
+    top-level keys without reading the other leaves off disk."""
+    from sheeprl_tpu.utils.ckpt_format import is_v1, load_state
+
+    if is_v1(path):
+        return load_state(path, select=select)
     import cloudpickle
 
     with open(path, "rb") as f:
-        return cloudpickle.load(f)
+        state = cloudpickle.load(f)
+    if select is not None:
+        # the pickle blob can't be partially read, but the returned shape
+        # must match the v1 path
+        state = {k: v for k, v in state.items() if k in set(select)}
+    return state
 
 
 def restore_buffer(saved, memmap: bool = False, memmap_dir=None):

@@ -1,0 +1,96 @@
+"""Versioned leaf-manifest checkpoint format (utils/ckpt_format.py)."""
+
+import collections
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.utils.ckpt_format import FORMAT_VERSION, is_v1, load_state, save_state
+from sheeprl_tpu.utils.callback import load_checkpoint
+
+
+def _state():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    params = {"w": jnp.full((4, 4), 1.5, jnp.bfloat16), "b": jnp.zeros(3)}
+    opt = optax.adam(1e-3).init(jax.tree_util.tree_map(lambda x: x.astype(jnp.float32), params))
+    return jax.device_get(
+        {
+            "agent": params,
+            "opt": opt,
+            "iter_num": 7,
+            "ratio": {"calls": 3.5},
+            "none": None,
+            "episodes": [{"obs": np.arange(6, dtype=np.uint8).reshape(2, 3)}],
+            "run_name": "dv3",
+        }
+    )
+
+
+def test_round_trip(tmp_path):
+    p = tmp_path / "ckpt_1_0.ckpt"
+    save_state(p, _state())
+    assert is_v1(p)
+    back = load_state(p)
+    assert back["iter_num"] == 7 and back["run_name"] == "dv3" and back["none"] is None
+    assert back["agent"]["w"].dtype.name == "bfloat16"
+    assert np.array_equal(
+        back["agent"]["w"].view(np.uint16), np.asarray(_state()["agent"]["w"]).view(np.uint16)
+    )
+    assert np.array_equal(back["episodes"][0]["obs"], _state()["episodes"][0]["obs"])
+    # optax namedtuple structure survives (restore_opt_states tree-maps it)
+    assert type(back["opt"][0]).__name__ == "ScaleByAdamState"
+    assert back["opt"][0]._fields == _state()["opt"][0]._fields
+
+
+def test_partial_read(tmp_path):
+    p = tmp_path / "c.ckpt"
+    save_state(p, _state())
+    sel = load_state(p, select=("iter_num", "ratio"))
+    assert set(sel) == {"iter_num", "ratio"} and sel["ratio"]["calls"] == 3.5
+
+
+def test_load_checkpoint_pickle_fallback(tmp_path):
+    import cloudpickle
+
+    p = tmp_path / "old.ckpt"
+    with open(p, "wb") as f:
+        cloudpickle.dump({"iter_num": 3, "x": np.ones(2)}, f)
+    assert not is_v1(p)
+    back = load_checkpoint(p)
+    assert back["iter_num"] == 3 and np.array_equal(back["x"], np.ones(2))
+
+
+def test_load_checkpoint_reads_v1(tmp_path):
+    p = tmp_path / "new.ckpt"
+    save_state(p, _state())
+    assert load_checkpoint(p)["iter_num"] == 7
+
+
+def test_missing_namedtuple_class_degrades_gracefully(tmp_path):
+    Gone = collections.namedtuple("GoneState", ["count", "mu"])
+    p = tmp_path / "g.ckpt"
+    save_state(p, {"opt": Gone(np.int32(2), np.zeros(3))})
+    back = load_state(p)  # class path "tests...:GoneState" won't import
+    assert back["opt"]._fields == ("count", "mu")
+    assert int(back["opt"].count) == 2
+
+
+def test_unpicklable_objects_rejected(tmp_path):
+    class Custom:
+        pass
+
+    with pytest.raises(TypeError):
+        save_state(tmp_path / "bad.ckpt", {"x": Custom()})
+
+
+def test_version_stamp(tmp_path):
+    import json
+
+    p = tmp_path / "v.ckpt"
+    save_state(p, {"a": 1})
+    with np.load(p) as npz:
+        doc = json.loads(bytes(npz["manifest"]))
+    assert doc["version"] == FORMAT_VERSION
