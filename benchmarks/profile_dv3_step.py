@@ -56,15 +56,25 @@ def parse_trace(trace_dir: str, top: int = 25):
         raise FileNotFoundError(f"no trace.json.gz under {trace_dir}")
     with gzip.open(max(paths, key=os.path.getmtime), "rt") as f:
         events = json.load(f).get("traceEvents", [])
-    # device lanes are process/thread names containing TPU/device markers
+    # device lanes: require an ACCELERATOR marker and exclude host lanes —
+    # "/device:CPU:0" and host-side XLA threads would otherwise pollute the
+    # "device op" totals that the kernel-work decisions are based on
     device_pids = set()
     names = {}
     for ev in events:
         if ev.get("ph") == "M" and ev.get("name") == "process_name":
             pname = ev.get("args", {}).get("name", "")
             names[ev.get("pid")] = pname
-            if any(k in pname.lower() for k in ("tpu", "device", "xla")):
+            low = pname.lower()
+            is_accel = any(k in low for k in ("tpu", "gpu", "accelerator"))
+            is_host = ":cpu" in low or "host" in low or "python" in low
+            if is_accel and not is_host:
                 device_pids.add(ev.get("pid"))
+    if not device_pids:
+        raise RuntimeError(
+            f"no accelerator lanes in trace (process names: {sorted(set(names.values()))[:10]}) — "
+            "refusing to aggregate host lanes as device time"
+        )
     agg = {}
     for ev in events:
         if ev.get("ph") == "X" and ev.get("pid") in device_pids:

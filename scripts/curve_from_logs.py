@@ -18,6 +18,7 @@ import glob
 import json
 import os
 import re
+import sys
 
 LINE = re.compile(r"policy_step=(\d+), reward_env_(\d+)=([-+\d.eE]+)")
 
@@ -68,9 +69,14 @@ def main():
 
     merged = {}
     chain_logs = sorted(glob.glob(os.path.join(args.chain_dir, "leg_*.log")))
-    logs = list(args.extra_log) + chain_logs
+    # --extra-log boundaries are each file's own first step, so files passed
+    # out of chronological order would silently delete later data; sort them
+    # by first parsed step before merging
+    cache = {p: parse_log(p) for p in args.extra_log}
+    extra = sorted(args.extra_log, key=lambda p: min(cache[p] or {0: 0}))
+    logs = extra + chain_logs
     for path in logs:
-        parsed = parse_log(path)
+        parsed = cache.get(path) or parse_log(path)
         if not parsed:
             continue
         # A later leg resumes from a checkpoint BEFORE the previous leg's
@@ -82,7 +88,16 @@ def main():
         # --extra-log files (earlier runs) fall back to their first point.
         m = re.search(r"leg_(\d+)\.log$", os.path.basename(path)) if path in chain_logs else None
         first = resume_step.get(int(m.group(1)), min(parsed)) if m else min(parsed)
-        for step in [s for s in merged if s >= first]:
+        dropped = [s for s in merged if s >= first]
+        uncovered = [s for s in dropped if s > max(parsed)]
+        if uncovered:
+            print(
+                f"WARNING: {os.path.basename(path)} (boundary {first}) deletes "
+                f"{len(uncovered)} merged points beyond its own last step "
+                f"{max(parsed)} (e.g. {uncovered[:3]}) — check log ordering",
+                file=sys.stderr,
+            )
+        for step in dropped:
             del merged[step]
         for step, envs in parsed.items():
             merged.setdefault(step, {}).update(envs)
@@ -105,8 +120,25 @@ def main():
         lo = max(0, i - w + 1)
         p["reward_mean_smoothed"] = round(sum(means[lo : i + 1]) / (i + 1 - lo), 2)
 
+    # disclose rendering settings that confound comparisons against the
+    # reference's learning curves (ADVICE r3: dmc fast_render changes pixel
+    # observations); read from any saved run config next to the chain dir
+    render_cfg = None
+    run_root = os.path.dirname(os.path.abspath(args.chain_dir.rstrip("/")))
+    candidates = glob.glob(os.path.join(run_root, "chain_leg*", "**", "config.yaml"), recursive=True)
+    # newest leg config = the one that actually produced the tail of the curve
+    for cfg_path in sorted(candidates, key=os.path.getmtime, reverse=True)[:1]:
+        try:
+            with open(cfg_path) as f:
+                for line in f:
+                    if "fast_render" in line:
+                        render_cfg = line.strip()
+                        break
+        except OSError:
+            pass
     artifact = {
         "source_logs": logs,
+        "render_settings": render_cfg,
         "n_points": len(points),
         "final_step": points[-1]["policy_step"] if points else 0,
         "final_reward_mean": points[-1]["reward_mean"] if points else None,

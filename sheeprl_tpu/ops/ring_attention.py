@@ -107,8 +107,13 @@ def blockwise_attention(
         mask = jnp.broadcast_to(mask, scores.shape[-2:])
         return _online_update(carry, scores, v_i, mask), None
 
+    # remat the block fold: autodiff would otherwise SAVE every block's
+    # (H, Sq, block) scores/probabilities for the backward pass, making the
+    # "O(S * block)" claim quietly O(S^2) once gradients flow (caught by
+    # benchmarks/bench_ring_attention.py's compiled-memory sweep).
+    # Recomputing scores in the backward pass is the flash-attention trade.
     (acc, row_sum, _), _ = jax.lax.scan(
-        step, (acc, row_sum, row_max), (jnp.arange(n_blocks), (kb, vb))
+        jax.checkpoint(step), (acc, row_sum, row_max), (jnp.arange(n_blocks), (kb, vb))
     )
     return _finalize(acc, row_sum, q.dtype)
 
@@ -138,25 +143,73 @@ def ring_attention(
     row_sum = jnp.zeros((*q.shape[:-3], q.shape[-2], s_local, 1), jnp.float32)
     row_max = jnp.full((*q.shape[:-3], q.shape[-2], s_local, 1), -jnp.inf, jnp.float32)
 
-    # the ring size is static, so the hop loop unrolls at trace time (a
-    # lax.scan carry would fight shard_map's varying-axes typing around
-    # ppermute); XLA still pipelines the permute against the block matmuls
-    acc_state = (acc, row_sum, row_max)
-    k_i, v_i = k, v
-    for i in range(n):
-        scores = _block_scores(q, k_i)
-        if causal:
-            # after i hops this K/V block originated on device (idx - i) % n
-            src = (idx - i) % n
-            k_pos = src * s_local + jnp.arange(s_local)
-            mask = jnp.broadcast_to(k_pos[None, :] <= q_pos[:, None], scores.shape[-2:])
-        else:
-            mask = None
-        acc_state = _online_update(acc_state, scores, v_i, mask)
-        if i + 1 < n:
-            # rotate K/V one step around the ring
-            k_i = jax.lax.ppermute(k_i, axis_name, perm)
-            v_i = jax.lax.ppermute(v_i, axis_name, perm)
+    # hop loop as lax.scan: an unrolled python loop left EVERY hop's
+    # (H, S/n, S/n) score/probability buffers simultaneously live (XLA's
+    # buffer assignment would not reuse them across the unrolled hops), so
+    # both forward and backward peaked at O(S^2/n) per device — exactly the
+    # blowup ring attention exists to avoid.  With a scan only one hop's
+    # buffers exist at a time, and the rematted body keeps autodiff from
+    # saving per-hop scores (the flash-attention trade: recompute in bwd).
+    # Measured by benchmarks/bench_ring_attention.py's compiled-memory sweep.
+    # inner blocking: even one hop's FULL (S/n, S/n) score block is the
+    # dominant working set at long context; folding the hop's K/V shard in
+    # (S/n, block) chunks keeps per-device temp memory ~linear in S/n
+    h = q.shape[-2]
+    batch_shape = q.shape[:-3]
+    block = min(512, s_local)
+    n_inner = -(-s_local // block)
+    pad = n_inner * block - s_local
+
+    def hop(carry, i):
+        acc_state, k_i, v_i = carry
+        src = (idx - i) % n  # K/V origin device after i hops
+
+        kp, vp = k_i, v_i
+        if pad:
+            widths = [(0, 0)] * (k_i.ndim - 3) + [(0, pad), (0, 0), (0, 0)]
+            kp, vp = jnp.pad(kp, widths), jnp.pad(vp, widths)
+
+        def to_blocks(x):
+            x = x.reshape(*batch_shape, n_inner, block, h, x.shape[-1])
+            return jnp.moveaxis(x, len(batch_shape), 0)
+
+        def inner(carry2, inp):
+            j, (k_j, v_j) = inp
+            scores = _block_scores(q, k_j)
+            k_pos = src * s_local + j * block + jnp.arange(block)
+            mask = k_pos[None, :] < (src * s_local + s_local)  # pad mask
+            if causal:
+                mask = mask & (k_pos[None, :] <= q_pos[:, None])
+            mask = jnp.broadcast_to(mask, scores.shape[-2:])
+            return _online_update(carry2, scores, v_j, mask), None
+
+        acc_state, _ = jax.lax.scan(
+            jax.checkpoint(inner), acc_state, (jnp.arange(n_inner), (to_blocks(kp), to_blocks(vp)))
+        )
+        # rotate K/V one step around the ring (the final rotation returns
+        # them to their origin device — semantics-free)
+        k_i = jax.lax.ppermute(k_i, axis_name, perm)
+        v_i = jax.lax.ppermute(v_i, axis_name, perm)
+        return (acc_state, k_i, v_i), None
+
+    # the zeros-initialized accumulators are device-INvariant to shard_map's
+    # varying-axes typing while the body's outputs (mixed with sharded q/k/v)
+    # are device-varying — mark the carry varying up front so the scan types
+    # close (this is what forced the old unrolled-python hop loop)
+    if hasattr(jax.lax, "pcast"):
+        acc, row_sum, row_max = jax.lax.pcast(
+            (acc, row_sum, row_max), axis_name, to="varying"
+        )
+    else:  # older jax
+        acc, row_sum, row_max = jax.lax.pvary((acc, row_sum, row_max), axis_name)
+    init = ((acc, row_sum, row_max), k, v)
+    # no outer remat: the inner fold already remats the score blocks.
+    # NOTE on gradients: the outer scan saves each hop's carried K/V shard
+    # as a residual, so backward holds n x (S/n) = O(S) of K/V per device
+    # (a few hundred MB at 64K tokens) on top of the O(S/n * block)
+    # activations; eliminating it needs a custom VJP that re-materializes
+    # K/V by continuing the ring rotation in reverse — future work.
+    (acc_state, _, _), _ = jax.lax.scan(hop, init, jnp.arange(n))
     acc, row_sum, _ = acc_state
     return _finalize(acc, row_sum, q.dtype)
 

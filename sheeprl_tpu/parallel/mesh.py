@@ -45,6 +45,7 @@ class MeshRuntime:
         accelerator: str = "auto",
         precision: str = "32-true",
         player_device: str = "auto",
+        player_params_cutoff_mb: float = 4.0,
         **kwargs: Any,
     ):
         if precision not in _PRECISIONS:
@@ -61,6 +62,8 @@ class MeshRuntime:
         self._accelerator = accelerator
         self._precision = precision
         self._player_device = player_device
+        self._player_cutoff_mb = float(player_params_cutoff_mb)
+        self._player_choice_logged = False
         self._launched = False
         self._mesh: Optional[Mesh] = None
         self._key: Optional[jax.Array] = None
@@ -418,23 +421,47 @@ class MeshRuntime:
             raise ValueError(
                 f"player_device must be one of {_PLAYER_DEVICES}, got '{choice}'"
             )
+        device, why = self._player_device_decision(choice, params)
+        if not self._player_choice_logged:
+            # the heuristic is load-bearing (a wrong pick costs ~5x loop
+            # throughput on tunneled links) — make the decision visible once
+            self._player_choice_logged = True
+            self.print(f"Player device: {device if device is not None else 'training device'} ({why})")
+        return device
+
+    def _player_params_nbytes(self, params: Any) -> int:
+        return sum(
+            int(np.prod(np.shape(leaf))) * np.dtype(getattr(leaf, "dtype", np.float32)).itemsize
+            for leaf in jax.tree_util.tree_leaves(params)
+        )
+
+    def _player_device_decision(self, choice: str, params: Any):
+        """(device-or-None, reason) per the decision table; None = stay on
+        the training device.  Pure given (choice, backend platform,
+        remoteness, params size) — pinned by tests/test_parallel/test_mesh.py."""
+        cutoff_mb = float(os.environ.get("SHEEPRL_PLAYER_CUTOFF_MB", self._player_cutoff_mb))
         if choice == "accelerator":
-            return None
+            return None, "player_device=accelerator"
         if self.device.platform == "cpu":
-            return None
+            return None, "training backend is already the host CPU"
         if choice == "auto" and self._device_is_remote():
             if params is None:
-                return None  # no size info: assume refresh-heavy
-            nbytes = sum(
-                int(np.prod(np.shape(leaf))) * np.dtype(getattr(leaf, "dtype", np.float32)).itemsize
-                for leaf in jax.tree_util.tree_leaves(params)
-            )
-            if nbytes >= 4 * 1024 * 1024:
-                return None
+                return None, "remote link + unknown params size: assume refresh-heavy"
+            nbytes = self._player_params_nbytes(params)
+            if nbytes >= cutoff_mb * 1024 * 1024:
+                return None, (
+                    f"remote link + params {nbytes / 1e6:.1f} MB >= cutoff {cutoff_mb} MB: "
+                    "per-iteration weight refresh would dominate"
+                )
+            why = f"remote link + params {nbytes / 1e6:.1f} MB < cutoff {cutoff_mb} MB"
+        elif choice == "cpu":
+            why = "player_device=cpu (explicit; size gate bypassed)"
+        else:
+            why = "local accelerator: host CPU actions are free"
         try:
-            return jax.local_devices(backend="cpu")[0]
+            return jax.local_devices(backend="cpu")[0], why
         except RuntimeError:
-            return None
+            return None, "no host CPU backend available"
 
     # ------------------------------------------------------------------ #
     # host-side collectives (metrics, small objects)
