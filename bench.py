@@ -243,8 +243,8 @@ def bench_dec():
         }
 
     for algo, exp, n_warm, n_long in (
-        ("ppo", "ppo_benchmarks", 512, 4096),
-        ("sac", "sac_benchmarks", 256, 1536),
+        ("ppo", "ppo_benchmarks", 512, 3072),
+        ("sac", "sac_benchmarks", 256, 1024),
     ):
         base = [
             f"exp={exp}",
