@@ -46,12 +46,22 @@ from sheeprl_tpu.utils.utils import transfer_tree
 
 
 def compute_stochastic_state(
-    state_information: jax.Array, key: Optional[jax.Array], min_std: float = 0.1, sample: bool = True
+    state_information: jax.Array,
+    key: Optional[jax.Array],
+    min_std: float = 0.1,
+    sample: bool = True,
+    noise: Optional[jax.Array] = None,
 ) -> Tuple[Tuple[jax.Array, jax.Array], jax.Array]:
     """(..., 2*stoch) -> ((mean, std), sampled state) (reference
-    dreamer_v1/utils.py:80)."""
+    dreamer_v1/utils.py:80).
+
+    ``noise`` is pre-drawn standard-normal noise of the mean's shape —
+    the reparameterized sample becomes ``mean + std * noise``, letting
+    the train scans hoist RNG out of their latency-bound bodies."""
     mean, std = jnp.split(state_information, 2, -1)
     std = jax.nn.softplus(std) + min_std
+    if noise is not None and sample:
+        return (mean, std), mean + std * noise
     dist = Normal(mean, std)
     state = dist.rsample(key) if sample else mean
     return (mean, std), state
@@ -107,16 +117,17 @@ class RSSM(nn.Module):
     def recurrent_step(self, inp: jax.Array, recurrent_state: jax.Array) -> jax.Array:
         return self.recurrent_model(inp, recurrent_state)
 
-    def _representation(self, recurrent_state: jax.Array, embedded_obs: jax.Array, key):
+    def _representation(self, recurrent_state: jax.Array, embedded_obs: jax.Array, key, noise=None):
         return compute_stochastic_state(
             self.representation_model(jnp.concatenate([recurrent_state, embedded_obs], -1)),
             key,
             self.min_std,
+            noise=noise,
         )
 
-    def _transition(self, recurrent_out: jax.Array, key, sample_state: bool = True):
+    def _transition(self, recurrent_out: jax.Array, key, sample_state: bool = True, noise=None):
         return compute_stochastic_state(
-            self.transition_model(recurrent_out), key, self.min_std, sample=sample_state
+            self.transition_model(recurrent_out), key, self.min_std, sample=sample_state, noise=noise
         )
 
     def dynamic(
@@ -137,11 +148,32 @@ class RSSM(nn.Module):
         posterior_mean_std, posterior = self._representation(recurrent_state, embedded_obs, k2)
         return recurrent_state, posterior, prior, posterior_mean_std, prior_mean_std
 
-    def imagination(self, stochastic_state: jax.Array, recurrent_state: jax.Array, actions: jax.Array, key):
+    def dynamic_posterior(
+        self,
+        posterior: jax.Array,
+        recurrent_state: jax.Array,
+        action: jax.Array,
+        embedded_obs: jax.Array,
+        key=None,
+        noise=None,
+    ):
+        """Sequential-only slice of :meth:`dynamic` for the train scan —
+        the transition model (prior) is a pure function of ``h_t`` and
+        batches over the stacked recurrent states outside the scan; its
+        mean/std for the KL are recomputed there (see dreamer_v3.agent)."""
+        recurrent_state = self.recurrent_model(
+            jnp.concatenate([posterior, action], -1), recurrent_state
+        )
+        posterior_mean_std, posterior = self._representation(
+            recurrent_state, embedded_obs, key, noise=noise
+        )
+        return recurrent_state, posterior, posterior_mean_std
+
+    def imagination(self, stochastic_state: jax.Array, recurrent_state: jax.Array, actions: jax.Array, key, noise=None):
         recurrent_state = self.recurrent_model(
             jnp.concatenate([stochastic_state, actions], -1), recurrent_state
         )
-        _, imagined_prior = self._transition(recurrent_state, key)
+        _, imagined_prior = self._transition(recurrent_state, key, noise=noise)
         return imagined_prior, recurrent_state
 
 

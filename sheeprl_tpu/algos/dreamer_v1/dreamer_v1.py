@@ -37,7 +37,7 @@ from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import fetch_actions, MetricFetchGate, device_get_metrics, Ratio, save_configs
+from sheeprl_tpu.utils.utils import fetch_actions, MetricFetchGate, device_get_metrics, Ratio, save_configs, scan_remat, scan_unroll_setting
 from sheeprl_tpu.optim import restore_opt_states
 
 sg = jax.lax.stop_gradient
@@ -60,6 +60,12 @@ def make_train_fn(runtime, world_model, actor, critic, txs, cfg, is_continuous, 
     continue_scale_factor = float(cfg.algo.world_model.continue_scale_factor)
     use_continues = bool(cfg.algo.world_model.use_continues)
 
+    # scan tuning inherited from the measured DV3 work (same structure,
+    # same latency-bound bodies — see dreamer_v3.make_train_fn)
+    scan_unroll = scan_unroll_setting(cfg, "dyn")
+    img_unroll = scan_unroll_setting(cfg, "img")
+    _remat = scan_remat
+
     rssm = world_model.rssm
 
     def train(params, opt_states, data, key):
@@ -68,30 +74,38 @@ def make_train_fn(runtime, world_model, actor, critic, txs, cfg, is_continuous, 
 
         batch_obs = {k: data[k] / 255.0 - 0.5 for k in cnn_keys}
         batch_obs.update({k: data[k] for k in mlp_keys})
+        # the rollout's reparameterization noise, hoisted out of the scan
+        # body into one batched draw (the scan bodies are latency-bound)
+        dyn_noise = jax.random.normal(k_dyn, (T, B, stochastic_size), jnp.float32)
 
         # ---------------------------------------------------- world model
         def wm_loss_fn(wm_params):
             embedded_obs = world_model.encoder.apply(wm_params["encoder"], batch_obs)
-            dyn_keys = jax.random.split(k_dyn, T)
 
             def dyn_step(carry, inp):
                 posterior, recurrent_state = carry
-                action, emb, kk = inp
-                out = rssm.apply(
-                    wm_params["rssm"], posterior, recurrent_state, action, emb, kk,
-                    method=RSSM.dynamic,
+                action, emb, n_t = inp
+                recurrent_state, posterior, post_ms = rssm.apply(
+                    wm_params["rssm"], posterior, recurrent_state, action, emb,
+                    None, noise=n_t, method=RSSM.dynamic_posterior,
                 )
-                recurrent_state, posterior, _, post_ms, prior_ms = out
                 return (posterior, recurrent_state), (
-                    recurrent_state, posterior, post_ms[0], post_ms[1], prior_ms[0], prior_ms[1],
+                    recurrent_state, posterior, post_ms[0], post_ms[1],
                 )
 
             init = (
                 jnp.zeros((B, stochastic_size)),
                 jnp.zeros((B, recurrent_state_size)),
             )
-            _, (recurrent_states, posteriors, post_means, post_stds, prior_means, prior_stds) = (
-                jax.lax.scan(dyn_step, init, (data["actions"], embedded_obs, dyn_keys))
+            _, (recurrent_states, posteriors, post_means, post_stds) = jax.lax.scan(
+                _remat(dyn_step), init, (data["actions"], embedded_obs, dyn_noise),
+                unroll=scan_unroll,
+            )
+            # prior mean/std for the KL, batched over the stacked recurrent
+            # states (the prior SAMPLE is unused by the world-model loss)
+            (prior_means, prior_stds), _ = rssm.apply(
+                wm_params["rssm"], recurrent_states, None, sample_state=False,
+                method=RSSM._transition,
             )
             latent_states = jnp.concatenate([posteriors, recurrent_states], -1)
             reconstructed_obs = world_model.observation_model.apply(
@@ -160,23 +174,30 @@ def make_train_fn(runtime, world_model, actor, critic, txs, cfg, is_continuous, 
         imagined_prior0 = sg(wm_aux["posteriors"]).swapaxes(0, 1).reshape(T * B, stochastic_size)
         recurrent_state0 = sg(wm_aux["recurrent_states"]).swapaxes(0, 1).reshape(T * B, recurrent_state_size)
 
-        def actor_loss_fn(actor_params):
-            img_keys = jax.random.split(k_img, horizon)
+        # imagination RNG hoisted out of the scan body (see the dynamic scan)
+        k_img_n, k_img_a = jax.random.split(k_img)
+        img_noise = jax.random.normal(k_img_n, (horizon, T * B, stochastic_size), jnp.float32)
+        act_keys = jax.random.split(k_img_a, horizon)
 
-            def img_step(carry, kk):
+        def actor_loss_fn(actor_params):
+            def img_step(carry, inp):
                 prior, rec = carry
-                k_act, k_im = jax.random.split(kk)
+                k_act, n_t = inp
                 latent = jnp.concatenate([prior, rec], -1)
                 acts, _ = actor.apply(actor_params, sg(latent), False, k_act)
                 action = jnp.concatenate(acts, -1)
                 prior, rec = rssm.apply(
-                    new_wm_params["rssm"], prior, rec, action, k_im, method=RSSM.imagination
+                    new_wm_params["rssm"], prior, rec, action, None, noise=n_t,
+                    method=RSSM.imagination,
                 )
                 new_latent = jnp.concatenate([prior, rec], -1)
                 return (prior, rec), new_latent
 
+            # remat: see dreamer_v3 (backward residual blowup otherwise)
             _, imagined_trajectories = jax.lax.scan(
-                img_step, (imagined_prior0, recurrent_state0), img_keys
+                _remat(img_step), (imagined_prior0, recurrent_state0),
+                (act_keys, img_noise),
+                unroll=img_unroll,
             )  # (H, TB, L) — imagined states only
 
             predicted_values = critic.apply(params["critic"], imagined_trajectories)

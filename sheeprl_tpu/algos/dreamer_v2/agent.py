@@ -232,11 +232,26 @@ class RecurrentModel(nn.Module):
 
 
 def compute_stochastic_state(
-    logits: jax.Array, discrete: int, key: Optional[jax.Array], sample: bool = True
+    logits: jax.Array,
+    discrete: int,
+    key: Optional[jax.Array],
+    sample: bool = True,
+    noise: Optional[jax.Array] = None,
 ) -> jax.Array:
     """(..., stoch*discrete) logits -> (..., stoch, discrete) one-hot ST
-    sample (reference dreamer_v2/utils.py:44); no unimix in V2."""
+    sample (reference dreamer_v2/utils.py:44); no unimix in V2.
+
+    ``noise`` is pre-drawn Gumbel noise of the reshaped logits' shape —
+    the categorical sample becomes ``argmax(logits + noise)`` with the
+    same straight-through estimator, letting train scans hoist all RNG
+    out of their latency-bound bodies (see dreamer_v3.agent)."""
     logits = logits.reshape(*logits.shape[:-1], -1, discrete)
+    if noise is not None and sample:
+        hard = jax.nn.one_hot(
+            jnp.argmax(logits + noise, -1), discrete, dtype=logits.dtype
+        )
+        p = jax.nn.softmax(logits, -1)
+        return jax.lax.stop_gradient(hard) + p - jax.lax.stop_gradient(p)
     dist = OneHotCategoricalStraightThrough(logits=logits)
     return dist.rsample(key) if sample else dist.mode
 
@@ -278,16 +293,26 @@ class RSSM(nn.Module):
         return self.recurrent_model(inp, recurrent_state)
 
     def _representation(
-        self, recurrent_state: jax.Array, embedded_obs: jax.Array, key: Optional[jax.Array]
+        self,
+        recurrent_state: jax.Array,
+        embedded_obs: jax.Array,
+        key: Optional[jax.Array],
+        noise: Optional[jax.Array] = None,
     ) -> Tuple[jax.Array, jax.Array]:
         logits = self.representation_model(jnp.concatenate([recurrent_state, embedded_obs], -1))
-        return logits, compute_stochastic_state(logits, self.discrete_size, key)
+        return logits, compute_stochastic_state(logits, self.discrete_size, key, noise=noise)
 
     def _transition(
-        self, recurrent_out: jax.Array, key: Optional[jax.Array], sample_state: bool = True
+        self,
+        recurrent_out: jax.Array,
+        key: Optional[jax.Array],
+        sample_state: bool = True,
+        noise: Optional[jax.Array] = None,
     ) -> Tuple[jax.Array, jax.Array]:
         logits = self.transition_model(recurrent_out)
-        return logits, compute_stochastic_state(logits, self.discrete_size, key, sample=sample_state)
+        return logits, compute_stochastic_state(
+            logits, self.discrete_size, key, sample=sample_state, noise=noise
+        )
 
     def dynamic(
         self,
@@ -311,11 +336,43 @@ class RSSM(nn.Module):
         posterior_logits, posterior = self._representation(recurrent_state, embedded_obs, k2)
         return recurrent_state, posterior, prior, posterior_logits, prior_logits
 
-    def imagination(self, prior: jax.Array, recurrent_state: jax.Array, actions: jax.Array, key: jax.Array):
+    def dynamic_posterior(
+        self,
+        posterior: jax.Array,
+        recurrent_state: jax.Array,
+        action: jax.Array,
+        embedded_obs: jax.Array,
+        is_first: jax.Array,
+        key: Optional[jax.Array] = None,
+        noise: Optional[jax.Array] = None,
+    ):
+        """Sequential-only slice of :meth:`dynamic` for the train scan: the
+        transition model (prior) is a pure function of ``h_t``, its SAMPLE
+        is unused by the world-model loss, and it batches over the stacked
+        recurrent states outside the scan (see dreamer_v3.agent)."""
+        action = (1 - is_first) * action
+        posterior = (1 - is_first) * posterior.reshape(*posterior.shape[:-2], -1)
+        recurrent_state = (1 - is_first) * recurrent_state
+        recurrent_state = self.recurrent_model(
+            jnp.concatenate([posterior, action], -1), recurrent_state
+        )
+        posterior_logits, posterior = self._representation(
+            recurrent_state, embedded_obs, key, noise=noise
+        )
+        return recurrent_state, posterior, posterior_logits
+
+    def imagination(
+        self,
+        prior: jax.Array,
+        recurrent_state: jax.Array,
+        actions: jax.Array,
+        key: Optional[jax.Array],
+        noise: Optional[jax.Array] = None,
+    ):
         recurrent_state = self.recurrent_model(
             jnp.concatenate([prior, actions], -1), recurrent_state
         )
-        _, imagined_prior = self._transition(recurrent_state, key)
+        _, imagined_prior = self._transition(recurrent_state, key, noise=noise)
         return imagined_prior, recurrent_state
 
 

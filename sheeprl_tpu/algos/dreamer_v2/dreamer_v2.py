@@ -51,7 +51,7 @@ from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import fetch_actions, MetricFetchGate, device_get_metrics, Ratio, save_configs
+from sheeprl_tpu.utils.utils import fetch_actions, MetricFetchGate, device_get_metrics, Ratio, save_configs, scan_remat, scan_unroll_setting
 from sheeprl_tpu.optim import restore_opt_states
 
 sg = jax.lax.stop_gradient
@@ -85,6 +85,11 @@ def make_train_fn(runtime, world_model, actor, critic, txs, cfg, is_continuous, 
     kl_regularizer = float(cfg.algo.world_model.kl_regularizer)
     discount_scale_factor = float(cfg.algo.world_model.discount_scale_factor)
     use_continues = bool(cfg.algo.world_model.use_continues)
+    # scan tuning inherited from the measured DV3 work (same structure,
+    # same latency-bound bodies — see dreamer_v3.make_train_fn)
+    scan_unroll = scan_unroll_setting(cfg, "dyn")
+    img_unroll = scan_unroll_setting(cfg, "img")
+    _remat = scan_remat
 
     rssm = world_model.rssm
 
@@ -97,30 +102,41 @@ def make_train_fn(runtime, world_model, actor, critic, txs, cfg, is_continuous, 
         # the first element of a sampled sequence is treated as an episode
         # start (reference dreamer_v2.py:128)
         is_first = data["is_first"].at[0].set(1.0)
+        # the rollout's sampling RNG, hoisted out of the scan body into one
+        # batched gumbel draw (the scan bodies are latency-bound)
+        dyn_noise_q = jax.random.gumbel(
+            k_dyn, (T, B, stochastic_size, discrete_size), jnp.float32
+        )
 
         # ---------------------------------------------------- world model
         def wm_loss_fn(wm_params):
             embedded_obs = world_model.encoder.apply(wm_params["encoder"], batch_obs)
-            dyn_keys = jax.random.split(k_dyn, T)
 
             def dyn_step(carry, inp):
                 posterior, recurrent_state = carry
-                action, emb, first, kk = inp
-                out = rssm.apply(
-                    wm_params["rssm"], posterior, recurrent_state, action, emb, first, kk,
-                    method=RSSM.dynamic,
+                action, emb, first, nq_t = inp
+                recurrent_state, posterior, posterior_logits = rssm.apply(
+                    wm_params["rssm"], posterior, recurrent_state, action, emb, first,
+                    None, noise=nq_t, method=RSSM.dynamic_posterior,
                 )
-                recurrent_state, posterior, _, posterior_logits, prior_logits = out
                 return (posterior, recurrent_state), (
-                    recurrent_state, posterior, posterior_logits, prior_logits,
+                    recurrent_state, posterior, posterior_logits,
                 )
 
             init = (
                 jnp.zeros((B, stochastic_size, discrete_size)),
                 jnp.zeros((B, recurrent_state_size)),
             )
-            _, (recurrent_states, posteriors, posteriors_logits, priors_logits) = jax.lax.scan(
-                dyn_step, init, (data["actions"], embedded_obs, is_first, dyn_keys)
+            _, (recurrent_states, posteriors, posteriors_logits) = jax.lax.scan(
+                _remat(dyn_step), init,
+                (data["actions"], embedded_obs, is_first, dyn_noise_q),
+                unroll=scan_unroll,
+            )
+            # prior logits for the KL, batched over the stacked recurrent
+            # states (the prior SAMPLE is unused by the world-model loss)
+            priors_logits, _ = rssm.apply(
+                wm_params["rssm"], recurrent_states, None, sample_state=False,
+                method=RSSM._transition,
             )
             latent_states = jnp.concatenate(
                 [posteriors.reshape(T, B, -1), recurrent_states], -1
@@ -194,24 +210,36 @@ def make_train_fn(runtime, world_model, actor, critic, txs, cfg, is_continuous, 
         recurrent_state0 = sg(wm_aux["recurrent_states"]).swapaxes(0, 1).reshape(T * B, recurrent_state_size)
         true_continue = (1 - data["terminated"]).swapaxes(0, 1).reshape(T * B, 1) * gamma
 
+        # imagination RNG hoisted out of the scan body (see the dynamic
+        # scan); actor keys pre-split outside
+        k_img_n, k_img_a = jax.random.split(k_img)
+        img_noise = jax.random.gumbel(
+            k_img_n, (horizon, T * B, stochastic_size, discrete_size), jnp.float32
+        )
+        act_keys = jax.random.split(k_img_a, horizon + 1)
+
         def actor_loss_fn(actor_params):
-            img_keys = jax.random.split(k_img, horizon + 1)
             latent0 = jnp.concatenate([imagined_prior0, recurrent_state0], -1)
 
-            def img_step(carry, kk):
+            def img_step(carry, inp):
                 prior, rec, latent = carry
-                k_act, k_im = jax.random.split(kk)
+                k_act, n_t = inp
                 acts, _ = actor.apply(actor_params, sg(latent), False, k_act)
                 action = jnp.concatenate(acts, -1)
                 prior, rec = rssm.apply(
-                    new_wm_params["rssm"], prior, rec, action, k_im, method=RSSM.imagination
+                    new_wm_params["rssm"], prior, rec, action, None, noise=n_t,
+                    method=RSSM.imagination,
                 )
                 prior = prior.reshape(-1, stoch_state_size)
                 latent = jnp.concatenate([prior, rec], -1)
                 return (prior, rec, latent), (latent, action)
 
+            # remat: without it the while loop carries every step's
+            # residuals for the backward pass (see dreamer_v3)
             _, (latents, actions_seq) = jax.lax.scan(
-                img_step, (imagined_prior0, recurrent_state0, latent0), img_keys[:horizon]
+                _remat(img_step), (imagined_prior0, recurrent_state0, latent0),
+                (act_keys[:horizon], img_noise),
+                unroll=img_unroll,
             )
             # traj[0] is the replayed posterior state; actions[0] is a
             # placeholder zero action (reference dreamer_v2.py:237-247)
@@ -245,7 +273,7 @@ def make_train_fn(runtime, world_model, actor, critic, txs, cfg, is_continuous, 
                 jnp.cumprod(jnp.concatenate([jnp.ones_like(continues[:1]), continues[:-1]], 0), 0)
             )
 
-            _, policies = actor.apply(actor_params, sg(imagined_trajectories[:-2]), False, img_keys[-1])
+            _, policies = actor.apply(actor_params, sg(imagined_trajectories[:-2]), False, act_keys[-1])
 
             # dynamics backprop through the imagined rollout
             dynamics = lambda_values[1:]

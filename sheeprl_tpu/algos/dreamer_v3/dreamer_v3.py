@@ -64,6 +64,8 @@ from sheeprl_tpu.utils.utils import (
     device_get_metrics,
     fetch_actions,
     save_configs,
+    scan_remat,
+    scan_unroll_setting,
 )
 from sheeprl_tpu.optim import restore_opt_states
 
@@ -183,26 +185,14 @@ def make_train_fn(runtime, world_model, actor, critic, txs, cfg, is_continuous, 
     # every matmul far below an MXU tile): unrolling lets XLA fuse across
     # iterations and cuts while-loop trip counts, which round-3 profiling
     # showed to be 56% of device step time (dv3_profile_r3.json)
-    scan_unroll = int(os.environ.get("SHEEPRL_SCAN_UNROLL", getattr(cfg.algo, "scan_unroll", 8) or 8))
-    img_unroll = int(os.environ.get("SHEEPRL_IMG_UNROLL", getattr(cfg.algo, "imagination_unroll", 3) or 3))
-    remat_policy = os.environ.get("SHEEPRL_REMAT_POLICY", "dots")
-    dyn_remat_policy = os.environ.get("SHEEPRL_DYN_REMAT", remat_policy)
-
-    def _remat(f, policy_name=None):
-        # full remat keeps only the scan carry+outputs; "dots" additionally
-        # saves matmul results so the backward pass re-runs only the cheap
-        # elementwise chains, not the MXU work.  "dots" measured best for
-        # BOTH scans on a v5e (imagination: kills the ~40 stacked
-        # (H, T*B, 512) residual buffers; dynamic: 16.15 ms vs 16.78 ms
-        # without remat even at B=16 rows)
-        p = remat_policy if policy_name is None else policy_name
-        if p == "none":
-            return f
-        if p == "dots":
-            return jax.checkpoint(
-                f, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
-            )
-        return jax.checkpoint(f)
+    # shared knobs (utils.scan_remat / scan_unroll_setting): "dots" remat
+    # measured best for BOTH scans on a v5e (imagination: kills the ~40
+    # stacked (H, T*B, 512) residual buffers; dynamic: 16.15 ms vs
+    # 16.78 ms without remat even at B=16 rows)
+    scan_unroll = scan_unroll_setting(cfg, "dyn")
+    img_unroll = scan_unroll_setting(cfg, "img")
+    dyn_remat_policy = os.environ.get("SHEEPRL_DYN_REMAT")
+    _remat = scan_remat
 
     rssm = world_model.rssm
 

@@ -54,7 +54,7 @@ from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import fetch_actions, MetricFetchGate, device_get_metrics, Ratio, save_configs
+from sheeprl_tpu.utils.utils import fetch_actions, MetricFetchGate, device_get_metrics, Ratio, save_configs, scan_remat, scan_unroll_setting
 from sheeprl_tpu.optim import restore_opt_states
 
 sg = jax.lax.stop_gradient
@@ -212,30 +212,40 @@ def make_train_fn(runtime, world_model, actor, critic, ensemble, txs, cfg, is_co
         batch_obs = {k: data[k] / 255.0 - 0.5 for k in cnn_keys}
         batch_obs.update({k: data[k] for k in mlp_keys})
         is_first = data["is_first"].at[0].set(1.0)
+        # sampling RNG hoisted out of the scan body (see dreamer_v3)
+        dyn_noise_q = jax.random.gumbel(
+            k_dyn, (T, B, stochastic_size, discrete_size), jnp.float32
+        )
 
         # ---------------------------------------------------- world model
         def wm_loss_fn(wm_params):
             embedded_obs = world_model.encoder.apply(wm_params["encoder"], batch_obs)
-            dyn_keys = jax.random.split(k_dyn, T)
 
             def dyn_step(carry, inp):
                 posterior, recurrent_state = carry
-                action, emb, first, kk = inp
-                out = rssm.apply(
-                    wm_params["rssm"], posterior, recurrent_state, action, emb, first, kk,
-                    method=RSSM.dynamic,
+                action, emb, first, nq_t = inp
+                recurrent_state, posterior, posterior_logits = rssm.apply(
+                    wm_params["rssm"], posterior, recurrent_state, action, emb, first,
+                    None, noise=nq_t, method=RSSM.dynamic_posterior,
                 )
-                recurrent_state, posterior, _, posterior_logits, prior_logits = out
                 return (posterior, recurrent_state), (
-                    recurrent_state, posterior, posterior_logits, prior_logits,
+                    recurrent_state, posterior, posterior_logits,
                 )
 
             init = (
                 jnp.zeros((B, stochastic_size, discrete_size)),
                 jnp.zeros((B, recurrent_state_size)),
             )
-            _, (recurrent_states, posteriors, posteriors_logits, priors_logits) = jax.lax.scan(
-                dyn_step, init, (data["actions"], embedded_obs, is_first, dyn_keys)
+            _, (recurrent_states, posteriors, posteriors_logits) = jax.lax.scan(
+                scan_remat(dyn_step),
+                init, (data["actions"], embedded_obs, is_first, dyn_noise_q),
+                unroll=scan_unroll_setting(cfg, "dyn"),
+            )
+            # prior logits for the KL, batched outside the scan (the prior
+            # SAMPLE is unused by the world-model loss)
+            priors_logits, _ = rssm.apply(
+                wm_params["rssm"], recurrent_states, None, sample_state=False,
+                method=RSSM._transition,
             )
             latent_states = jnp.concatenate(
                 [posteriors.reshape(T, B, -1), recurrent_states], -1

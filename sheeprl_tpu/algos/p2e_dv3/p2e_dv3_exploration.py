@@ -54,7 +54,7 @@ from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import fetch_actions, MetricFetchGate, device_get_metrics, Ratio, save_configs
+from sheeprl_tpu.utils.utils import fetch_actions, MetricFetchGate, device_get_metrics, Ratio, save_configs, scan_remat, scan_unroll_setting
 from sheeprl_tpu.optim import restore_opt_states
 
 sg = jax.lax.stop_gradient
@@ -82,6 +82,7 @@ def make_train_fn(
     kl_free_nats = float(cfg.algo.world_model.kl_free_nats)
     kl_regularizer = float(cfg.algo.world_model.kl_regularizer)
     continue_scale_factor = float(cfg.algo.world_model.continue_scale_factor)
+    decoupled = bool(cfg.algo.world_model.decoupled_rssm)
     moments_cfg = cfg.algo.actor.moments
     intrinsic_reward_multiplier = float(cfg.algo.intrinsic_reward_multiplier)
     critic_names = tuple(critics_cfg.keys())
@@ -168,30 +169,74 @@ def make_train_fn(
         batch_actions = jnp.concatenate(
             [jnp.zeros_like(data["actions"][:1]), data["actions"][:-1]], axis=0
         )
+        # sampling RNG hoisted out of the scan body into one batched gumbel
+        # draw (the scan bodies are latency-bound; see dreamer_v3)
+        dyn_noise_q = jax.random.gumbel(
+            k_dyn, (T, B, stochastic_size, discrete_size), jnp.float32
+        )
 
         # ---------------------------------------------------- world model
         def wm_loss_fn(wm_params):
             embedded_obs = world_model.encoder.apply(wm_params["encoder"], batch_obs)
-            dyn_keys = jax.random.split(k_dyn, T)
+            init_states = rssm.apply(wm_params["rssm"], (B,), method=RSSM.get_initial_states)
+            init_states = (init_states[0], init_states[1].reshape(B, -1))
 
-            def dyn_step(carry, inp):
-                posterior, recurrent_state = carry
-                action, emb, first, kk = inp
-                out = rssm.apply(
-                    wm_params["rssm"], posterior, recurrent_state, action, emb, first, kk,
-                    method=RSSM.dynamic,
+            if decoupled:
+                # DecoupledRSSM: the posterior depends only on obs, so it
+                # batches over the whole sequence and the scan body is just
+                # the gated recurrent step (see dreamer_v3.py's branch)
+                posteriors_logits, posteriors = rssm.apply(
+                    wm_params["rssm"], embedded_obs, None, noise=dyn_noise_q,
+                    method=RSSM._representation,
                 )
-                recurrent_state, posterior, _, posterior_logits, prior_logits = out
-                return (posterior, recurrent_state), (
-                    recurrent_state, posterior, posterior_logits, prior_logits,
+                prev_posteriors = jnp.concatenate(
+                    [jnp.zeros_like(posteriors[:1]), posteriors[:-1]], 0
                 )
 
-            init = (
-                jnp.zeros((B, stochastic_size, discrete_size)),
-                jnp.zeros((B, recurrent_state_size)),
-            )
-            _, (recurrent_states, posteriors, posteriors_logits, priors_logits) = jax.lax.scan(
-                dyn_step, init, (batch_actions, embedded_obs, is_first, dyn_keys)
+                def dyn_step_dec(recurrent_state, inp):
+                    prev_post, action, first = inp
+                    recurrent_state = rssm.apply(
+                        wm_params["rssm"], prev_post, recurrent_state, action, first,
+                        init_states, method=RSSM.recurrent_step_gated,
+                    )
+                    return recurrent_state, recurrent_state
+
+                _, recurrent_states = jax.lax.scan(
+                    scan_remat(dyn_step_dec),
+                    jnp.zeros((B, recurrent_state_size)),
+                    (prev_posteriors, batch_actions, is_first),
+                    unroll=scan_unroll_setting(cfg, "dyn"),
+                )
+            else:
+                emb_proj = rssm.apply(
+                    wm_params["rssm"], embedded_obs, method=RSSM.representation_embed_proj
+                )
+
+                def dyn_step(carry, inp):
+                    posterior, recurrent_state = carry
+                    action, emb, first, nq_t = inp
+                    recurrent_state, posterior, posterior_logits = rssm.apply(
+                        wm_params["rssm"], posterior, recurrent_state, action, emb, first,
+                        init_states, noise=nq_t, method=RSSM.dynamic_posterior,
+                    )
+                    return (posterior, recurrent_state), (
+                        recurrent_state, posterior, posterior_logits,
+                    )
+
+                init = (
+                    jnp.zeros((B, stochastic_size, discrete_size)),
+                    jnp.zeros((B, recurrent_state_size)),
+                )
+                _, (recurrent_states, posteriors, posteriors_logits) = jax.lax.scan(
+                    scan_remat(dyn_step),
+                    init, (batch_actions, emb_proj, is_first, dyn_noise_q),
+                    unroll=scan_unroll_setting(cfg, "dyn"),
+                )
+            # prior logits for the KL, batched over the stacked recurrent
+            # states (the prior SAMPLE is unused by the world-model loss)
+            priors_logits, _ = rssm.apply(
+                wm_params["rssm"], recurrent_states, None, sample_state=False,
+                method=RSSM._transition,
             )
             latent_states = jnp.concatenate([posteriors.reshape(T, B, -1), recurrent_states], -1)
             reconstructed_obs = world_model.observation_model.apply(
