@@ -347,6 +347,26 @@ def test_dreamer_v3_decoupled_rssm(standard_args, tmp_path):
     _run(args)
 
 
+def test_p2e_dv3_decoupled_rssm(standard_args, tmp_path):
+    """Exploration phase with the DecoupledRSSM variant (the batched
+    posterior + gated-recurrent-only scan branch)."""
+    args = standard_args + _dv3_tiny_args() + [
+        "exp=p2e_dv3_exploration",
+        "env=dummy",
+        "algo.mlp_keys.encoder=[state]",
+        "algo.cnn_keys.encoder=[]",
+        "algo.cnn_keys.decoder=[]",
+        "algo.world_model.decoupled_rssm=True",
+        "algo.ensembles.n=2",
+        "algo.ensembles.dense_units=8",
+        "algo.ensembles.mlp_layers=1",
+        "fabric.devices=1",
+        f"root_dir={tmp_path}/p2edv3dec",
+        f"metric.logger.root_dir={tmp_path}/logs_p2edv3dec",
+    ]
+    _run(args)
+
+
 def _dv2_tiny_args():
     return [
         "algo.per_rank_batch_size=2",
