@@ -1,0 +1,43 @@
+#!/bin/bash
+# End-of-chain pipeline for the round-4 cartpole-swingup run: stitch the
+# reward curve across legs, greedy-eval the newest checkpoint, and fold
+# the eval into the curve artifact. Run AFTER the chain has stopped.
+set -e
+cd /root/repo
+OUT=benchmarks/results/dv3_cartpole_swingup_curve_r4.json
+
+# the round-3 chain's leg logs survived on this machine: stitch the FULL
+# 0 -> N curve (r3 legs as extra logs, r4 legs override from their resume
+# steps)
+EXTRA=$(ls runs/dv3_cartpole/chain_r3/leg_*.log 2>/dev/null | sed 's/^/--extra-log /' | tr '\n' ' ')
+python scripts/curve_from_logs.py \
+  --chain-dir runs/dv3_cartpole/chain_r4 \
+  $EXTRA \
+  --out "$OUT"
+
+CKPT=$(python - <<'EOF'
+from scripts.train_chain import latest_ckpt
+step, ckpt = latest_ckpt("runs/dv3_cartpole")
+print(ckpt)
+EOF
+)
+echo "evaluating $CKPT"
+MUJOCO_GL=egl timeout 1200 python sheeprl_eval.py "checkpoint_path=$CKPT" \
+  env.capture_video=False 2>&1 | tee /tmp/cartpole_eval_r4.log | tail -3
+
+python - "$OUT" <<'EOF'
+import json, re, sys
+out = sys.argv[1]
+d = json.load(open(out))
+txt = open("/tmp/cartpole_eval_r4.log").read()
+m = re.findall(r"Test - Reward: ([-\d.]+)", txt)
+d["greedy_eval_reward_at_final_ckpt"] = float(m[-1]) if m else None
+d["experiment"] = ("dreamer_v3_dmc_cartpole_swingup (dense; DV3-S, pixels 64x64, 8 envs, "
+                   "replay_ratio 0.3, action_repeat 2, EGL rendering)")
+d["hardware"] = "1x TPU v5e (tunneled axon backend) + 1-core CPU host"
+d["protocol"] = ("round-4 chain resumed from round 3's 40K-step checkpoint (reward ~253); "
+                 "scripts/train_chain.py checkpoint-resume legs; VERDICT r3 item 6 "
+                 "(target: greedy eval >= 600)")
+json.dump(d, open(out, "w"), indent=2)
+print(json.dumps({k: d[k] for k in ("final_step", "final_reward_mean", "best_reward_mean", "greedy_eval_reward_at_final_ckpt")}))
+EOF
