@@ -2,7 +2,7 @@
 # End-of-chain pipeline for the round-4 cartpole-swingup run: stitch the
 # reward curve across legs, greedy-eval the newest checkpoint, and fold
 # the eval into the curve artifact. Run AFTER the chain has stopped.
-set -e
+set -e -o pipefail
 cd /root/repo
 OUT=benchmarks/results/dv3_cartpole_swingup_curve_r4.json
 
@@ -21,6 +21,10 @@ step, ckpt = latest_ckpt("runs/dv3_cartpole")
 print(ckpt)
 EOF
 )
+if [ -z "$CKPT" ] || [ "$CKPT" = "None" ]; then
+  echo "ERROR: no checkpoint found under runs/dv3_cartpole" >&2
+  exit 1
+fi
 echo "evaluating $CKPT"
 MUJOCO_GL=egl timeout 1200 python sheeprl_eval.py "checkpoint_path=$CKPT" \
   env.capture_video=False 2>&1 | tee /tmp/cartpole_eval_r4.log | tail -3

@@ -18,7 +18,7 @@ dtype (bf16-safe).
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import Optional
 
 import jax
@@ -61,6 +61,39 @@ def _finalize(acc: jax.Array, row_sum: jax.Array, dtype) -> jax.Array:
     return jnp.swapaxes(out, -3, -2).astype(dtype)
 
 
+def _mark_varying(tree, axis_name: str):
+    """Zeros-initialized accumulators are device-INvariant to shard_map's
+    varying-axes typing while the scan body's outputs (mixed with sharded
+    inputs) are device-varying — mark the carry varying up front so the
+    scan types close."""
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(tree, axis_name, to="varying")
+    return jax.lax.pvary(tree, axis_name)  # older jax
+
+
+def _hop_block_mask(src, j, block: int, s_local: int, q_pos, scores_shape, causal: bool):
+    """Padding + causal mask for inner block `j` of the K/V shard that
+    originated on device `src` — SHARED by the forward fold and the custom
+    backward so the recomputed softmax weights can never desynchronize
+    from the forward's."""
+    k_pos = src * s_local + j * block + jnp.arange(block)
+    mask = k_pos[None, :] < (src * s_local + s_local)  # pad mask
+    if causal:
+        mask = mask & (k_pos[None, :] <= q_pos[:, None])
+    return jnp.broadcast_to(mask, scores_shape)
+
+
+def _pad_blocks(x, batch_shape, n_inner: int, block: int, pad: int):
+    """(…, S/n, H, D) -> (n_inner, …, block, H, D) scan layout, padding the
+    sequence axis up to a block multiple. Shared by fwd + bwd hops."""
+    if pad:
+        widths = [(0, 0)] * (x.ndim - 3) + [(0, pad), (0, 0), (0, 0)]
+        x = jnp.pad(x, widths)
+    h = x.shape[-2]
+    x = x.reshape(*batch_shape, n_inner, block, h, x.shape[-1])
+    return jnp.moveaxis(x, len(batch_shape), 0)
+
+
 def blockwise_attention(
     q: jax.Array,
     k: jax.Array,
@@ -76,22 +109,17 @@ def blockwise_attention(
     block_size = min(block_size, s_k)
     n_blocks = -(-s_k // block_size)
     pad = n_blocks * block_size - s_k
-    if pad:
-        pad_widths = [(0, 0)] * (k.ndim - 3) + [(0, pad), (0, 0), (0, 0)]
-        k = jnp.pad(k, pad_widths)
-        v = jnp.pad(v, pad_widths)
 
     s_q = q.shape[-3]
     h = q.shape[-2]
     batch_shape = q.shape[:-3]
     q_pos = jnp.arange(s_q)
 
-    # (n_blocks, …, block, H, D) scan layout
-    def to_blocks(x):
-        x = x.reshape(*batch_shape, n_blocks, block_size, h, x.shape[-1])
-        return jnp.moveaxis(x, len(batch_shape), 0)
-
-    kb, vb = to_blocks(k), to_blocks(v)
+    # single-device case == one ring hop with src=0 and the whole sequence
+    # as the "local shard": reuse the shared blocking + mask helpers so the
+    # logic cannot drift from the ring path
+    kb = _pad_blocks(k, batch_shape, n_blocks, block_size, pad)
+    vb = _pad_blocks(v, batch_shape, n_blocks, block_size, pad)
 
     acc = jnp.zeros((*batch_shape, h, s_q, q.shape[-1]), jnp.float32)
     row_sum = jnp.zeros((*batch_shape, h, s_q, 1), jnp.float32)
@@ -100,11 +128,7 @@ def blockwise_attention(
     def step(carry, inp):
         i, (k_i, v_i) = inp
         scores = _block_scores(q, k_i)
-        k_pos = i * block_size + jnp.arange(block_size)
-        mask = k_pos[None, :] < s_k  # padding mask, (1, block)
-        if causal:
-            mask = mask & (k_pos[None, :] <= q_pos[:, None])
-        mask = jnp.broadcast_to(mask, scores.shape[-2:])
+        mask = _hop_block_mask(0, i, block_size, s_k, q_pos, scores.shape[-2:], causal)
         return _online_update(carry, scores, v_i, mask), None
 
     # remat the block fold: autodiff would otherwise SAVE every block's
@@ -118,20 +142,16 @@ def blockwise_attention(
     return _finalize(acc, row_sum, q.dtype)
 
 
-def ring_attention(
+def _ring_forward(
     q: jax.Array,
     k: jax.Array,
     v: jax.Array,
     axis_name: str,
     causal: bool = False,
-) -> jax.Array:
-    """Ring attention body — call INSIDE `shard_map` with the sequence axis
-    sharded over `axis_name`.
-
-    Each device holds `(..., S/n, H, D)` shards. K/V rotate around the ring
-    with `ppermute`; after n hops every query shard has attended to the
-    full sequence. For `causal=True` global positions are reconstructed
-    from the device index and the hop count."""
+):
+    """Forward ring pass; returns `(out, lse)` where `lse` is the
+    per-query log-sum-exp `(…, H, Sq, 1)` the custom backward needs to
+    re-normalize recomputed score blocks."""
     n = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     s_local = q.shape[-3]
@@ -154,7 +174,6 @@ def ring_attention(
     # inner blocking: even one hop's FULL (S/n, S/n) score block is the
     # dominant working set at long context; folding the hop's K/V shard in
     # (S/n, block) chunks keeps per-device temp memory ~linear in S/n
-    h = q.shape[-2]
     batch_shape = q.shape[:-3]
     block = min(512, s_local)
     n_inner = -(-s_local // block)
@@ -164,27 +183,22 @@ def ring_attention(
         acc_state, k_i, v_i = carry
         src = (idx - i) % n  # K/V origin device after i hops
 
-        kp, vp = k_i, v_i
-        if pad:
-            widths = [(0, 0)] * (k_i.ndim - 3) + [(0, pad), (0, 0), (0, 0)]
-            kp, vp = jnp.pad(kp, widths), jnp.pad(vp, widths)
-
-        def to_blocks(x):
-            x = x.reshape(*batch_shape, n_inner, block, h, x.shape[-1])
-            return jnp.moveaxis(x, len(batch_shape), 0)
-
         def inner(carry2, inp):
             j, (k_j, v_j) = inp
             scores = _block_scores(q, k_j)
-            k_pos = src * s_local + j * block + jnp.arange(block)
-            mask = k_pos[None, :] < (src * s_local + s_local)  # pad mask
-            if causal:
-                mask = mask & (k_pos[None, :] <= q_pos[:, None])
-            mask = jnp.broadcast_to(mask, scores.shape[-2:])
+            mask = _hop_block_mask(src, j, block, s_local, q_pos, scores.shape[-2:], causal)
             return _online_update(carry2, scores, v_j, mask), None
 
         acc_state, _ = jax.lax.scan(
-            jax.checkpoint(inner), acc_state, (jnp.arange(n_inner), (to_blocks(kp), to_blocks(vp)))
+            jax.checkpoint(inner),
+            acc_state,
+            (
+                jnp.arange(n_inner),
+                (
+                    _pad_blocks(k_i, batch_shape, n_inner, block, pad),
+                    _pad_blocks(v_i, batch_shape, n_inner, block, pad),
+                ),
+            ),
         )
         # rotate K/V one step around the ring (the final rotation returns
         # them to their origin device — semantics-free)
@@ -192,26 +206,149 @@ def ring_attention(
         v_i = jax.lax.ppermute(v_i, axis_name, perm)
         return (acc_state, k_i, v_i), None
 
-    # the zeros-initialized accumulators are device-INvariant to shard_map's
-    # varying-axes typing while the body's outputs (mixed with sharded q/k/v)
-    # are device-varying — mark the carry varying up front so the scan types
-    # close (this is what forced the old unrolled-python hop loop)
-    if hasattr(jax.lax, "pcast"):
-        acc, row_sum, row_max = jax.lax.pcast(
-            (acc, row_sum, row_max), axis_name, to="varying"
-        )
-    else:  # older jax
-        acc, row_sum, row_max = jax.lax.pvary((acc, row_sum, row_max), axis_name)
+    acc, row_sum, row_max = _mark_varying((acc, row_sum, row_max), axis_name)
     init = ((acc, row_sum, row_max), k, v)
-    # no outer remat: the inner fold already remats the score blocks.
-    # NOTE on gradients: the outer scan saves each hop's carried K/V shard
-    # as a residual, so backward holds n x (S/n) = O(S) of K/V per device
-    # (a few hundred MB at 64K tokens) on top of the O(S/n * block)
-    # activations; eliminating it needs a custom VJP that re-materializes
-    # K/V by continuing the ring rotation in reverse — future work.
+    # no outer remat: the inner fold already remats the score blocks, and
+    # under the custom VJP below autodiff never traces this scan at all.
     (acc_state, _, _), _ = jax.lax.scan(hop, init, jnp.arange(n))
-    acc, row_sum, _ = acc_state
-    return _finalize(acc, row_sum, q.dtype)
+    acc, row_sum, row_max = acc_state
+    lse = jnp.where(
+        row_sum > 0.0,
+        jnp.where(jnp.isneginf(row_max), 0.0, row_max) + jnp.log(jnp.maximum(row_sum, 1e-30)),
+        -jnp.inf,
+    )
+    return _finalize(acc, row_sum, q.dtype), lse
+
+
+def _ring_backward(q, k, v, out, lse, g, axis_name: str, causal: bool):
+    """Flash-style backward for the ring: rotate K/V (and their gradient
+    accumulators) around the ring AGAIN, recomputing each hop's score
+    blocks from the saved `lse` instead of storing them — so residuals are
+    just the local q/k/v/out/lse shards, O(S/n) per device, not the
+    O(S) per-device K/V carry chain a plain `lax.scan` VJP would save.
+
+    Standard flash-attention gradients per block (scores already scaled):
+      W  = exp(scores - lse)            (softmax weights, recomputed)
+      dV = Wᵀ · dO
+      dP = dO · Vᵀ
+      dS = W ⊙ (dP - Δ) / sqrt(D),  Δ = rowsum(dO ⊙ O)
+      dQ += dS · K,   dK += dSᵀ · Q
+    Each device keeps its query-shard quantities (q, dO, Δ, lse, dQ)
+    resident; (K, V, dK, dV) travel together — after n hops dK/dV have
+    accumulated every device's contribution and are home again."""
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    s_local = q.shape[-3]
+    h = q.shape[-2]
+    d = q.shape[-1]
+    batch_shape = q.shape[:-3]
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    q_pos = idx * s_local + jnp.arange(s_local)
+    scale = 1.0 / jnp.sqrt(jnp.float32(d))
+
+    # head-major f32 copies of the query-resident tensors
+    qt = jnp.swapaxes(q, -3, -2).astype(jnp.float32)  # (…, H, Sq, D)
+    gt = jnp.swapaxes(g, -3, -2).astype(jnp.float32)
+    ot = jnp.swapaxes(out, -3, -2).astype(jnp.float32)
+    delta = (gt * ot).sum(-1, keepdims=True)  # (…, H, Sq, 1)
+    dead = jnp.isneginf(lse)  # fully-masked query rows contribute nothing
+    safe_lse = jnp.where(dead, 0.0, lse)
+
+    block = min(512, s_local)
+    n_inner = -(-s_local // block)
+    pad = n_inner * block - s_local
+
+    def from_blocks(x):
+        x = jnp.moveaxis(x, 0, len(batch_shape))
+        x = x.reshape(*batch_shape, n_inner * block, h, x.shape[-1])
+        return x[..., :s_local, :, :]
+
+    def hop(carry, i):
+        dq, k_i, v_i, dk_i, dv_i = carry
+        src = (idx - i) % n  # K/V origin device after i hops (as in fwd)
+
+        def inner(dq2, inp):
+            j, (k_j, v_j) = inp
+            scores = _block_scores(q, k_j)  # (…, H, Sq, block) f32
+            mask = _hop_block_mask(src, j, block, s_local, q_pos, scores.shape[-2:], causal)
+            w = jnp.where(mask & ~dead, jnp.exp(scores - safe_lse), 0.0)
+            kt_j = jnp.swapaxes(k_j, -3, -2).astype(jnp.float32)  # (…, H, block, D)
+            vt_j = jnp.swapaxes(v_j, -3, -2).astype(jnp.float32)
+            dp = jnp.einsum("...hqd,...hkd->...hqk", gt, vt_j)
+            ds = w * (dp - delta) * scale
+            dq_c = jnp.einsum("...hqk,...hkd->...hqd", ds, kt_j)
+            dk_j = jnp.einsum("...hqk,...hqd->...khd", ds, qt)
+            dv_j = jnp.einsum("...hqk,...hqd->...khd", w, gt)
+            return dq2 + dq_c, (dk_j, dv_j)
+
+        dq, (dk_blocks, dv_blocks) = jax.lax.scan(
+            inner,
+            dq,
+            (
+                jnp.arange(n_inner),
+                (
+                    _pad_blocks(k_i, batch_shape, n_inner, block, pad),
+                    _pad_blocks(v_i, batch_shape, n_inner, block, pad),
+                ),
+            ),
+        )
+        dk_i = dk_i + from_blocks(dk_blocks)
+        dv_i = dv_i + from_blocks(dv_blocks)
+        # rotate the shard AND its gradient accumulator together; after n
+        # hops both are back on the shard's origin device
+        k_i, v_i, dk_i, dv_i = (
+            jax.lax.ppermute(x, axis_name, perm) for x in (k_i, v_i, dk_i, dv_i)
+        )
+        return (dq, k_i, v_i, dk_i, dv_i), None
+
+    dq = jnp.zeros(qt.shape, jnp.float32)
+    dk = jnp.zeros((*batch_shape, s_local, h, d), jnp.float32)
+    dv = jnp.zeros(dk.shape, jnp.float32)
+    dq, dk, dv = _mark_varying((dq, dk, dv), axis_name)
+    (dq, _, _, dk, dv), _ = jax.lax.scan(hop, (dq, k, v, dk, dv), jnp.arange(n))
+    dq = jnp.swapaxes(dq, -3, -2)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@lru_cache(maxsize=None)
+def _ring_attention_vjp(axis_name: str, causal: bool):
+    @jax.custom_vjp
+    def attn(q, k, v):
+        return _ring_forward(q, k, v, axis_name, causal)[0]
+
+    def fwd(q, k, v):
+        out, lse = _ring_forward(q, k, v, axis_name, causal)
+        return out, (q, k, v, out, lse)
+
+    def bwd(res, g):
+        return _ring_backward(*res, g, axis_name, causal)
+
+    attn.defvjp(fwd, bwd)
+    return attn
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    causal: bool = False,
+) -> jax.Array:
+    """Ring attention body — call INSIDE `shard_map` with the sequence axis
+    sharded over `axis_name`.
+
+    Each device holds `(..., S/n, H, D)` shards. K/V rotate around the ring
+    with `ppermute`; after n hops every query shard has attended to the
+    full sequence. For `causal=True` global positions are reconstructed
+    from the device index and the hop count.
+
+    Differentiation goes through a custom VJP (`_ring_backward`) that
+    re-rotates K/V around the ring instead of saving the forward scan's
+    per-hop K/V carries — per-device memory stays O(S/n) under gradients
+    (measured by benchmarks/bench_ring_attention.py). Trade-off of
+    `jax.custom_vjp`: only reverse-mode differentiation is supported —
+    `jax.jvp` / `jax.jacfwd` / `jax.linearize` through this op raise."""
+    return _ring_attention_vjp(axis_name, bool(causal))(q, k, v)
 
 
 def make_ring_attention(

@@ -8,11 +8,11 @@ the TPU-first extension that makes long context first-class:
 - each device holds a contiguous ``S/n`` shard of every sequence;
 - attention runs as a ring: K/V shards rotate over ICI with
   ``jax.lax.ppermute`` while an online softmax folds one block per hop
-  (``sheeprl_tpu.ops.ring_attention``) — per-device ACTIVATION memory
-  stays O(S/n * block); under gradients the hop scan additionally holds
-  O(S) of K/V residuals per device (see ring_attention.py; measured
-  3.1 GB/device for a full 64K-token train step vs 413 GB materialized
-  attention, benchmarks/results/ring_attention_r4.json);
+  (``sheeprl_tpu.ops.ring_attention``) — per-device memory stays
+  O(S/n * block) even under gradients: a custom VJP re-rotates K/V
+  around the ring in the backward pass instead of saving the forward
+  scan's per-hop K/V carries (numbers in
+  benchmarks/results/ring_attention_r4.json);
 - gradients are ``pmean``-reduced across the ring, so the step is a drop-in
   SPMD train step: params replicated in, params replicated out.
 
