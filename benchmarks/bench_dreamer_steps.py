@@ -1,0 +1,154 @@
+"""DV1/DV2 train-step micro-benchmark on the current default jax platform.
+
+Round-4 context: the DV3 scan-path optimizations (RNG hoisted out of scan
+bodies, prior/transition model evaluated outside the dynamic scan, remat
+on scan bodies, closed-form two_hot) were propagated to DreamerV1/V2 and
+the P2E family — this script produces the wall-clock evidence at each
+algo's own benchmark-protocol shape (DV1: B=50 x T=50 continuous, its DMC
+home config, reference configs/exp/dreamer_v1.yaml; DV2: B=16 x T=50
+discrete, its Atari home config, reference configs/exp/dreamer_v2.yaml),
+with the same async-dispatch timing the training CLI uses.
+
+Usage: python benchmarks/bench_dreamer_steps.py [--algo dv1 dv2]
+           [--steps 16] [--precision bf16-mixed]
+           [--out benchmarks/results/dreamer_steps_r4.json]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build(version: int, precision: str):
+    import gymnasium as gym
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sheeprl_tpu.config import compose
+    from sheeprl_tpu.parallel.mesh import MeshRuntime
+
+    agent_mod = __import__(f"sheeprl_tpu.algos.dreamer_v{version}.agent", fromlist=["x"])
+    mod = __import__(
+        f"sheeprl_tpu.algos.dreamer_v{version}.dreamer_v{version}", fromlist=["x"]
+    )
+
+    # each algo's home-domain benchmark shape
+    is_continuous = version == 1
+    cfg = compose(
+        overrides=[
+            f"exp=dreamer_v{version}",
+            "env=dummy",
+            "env.num_envs=1",
+            "algo.cnn_keys.encoder=[rgb]",
+            "algo.mlp_keys.encoder=[]",
+        ]
+    )
+    runtime = MeshRuntime(devices=1, accelerator="auto", precision=precision).launch()
+    runtime.seed_everything(0)
+    obs_space = gym.spaces.Dict({"rgb": gym.spaces.Box(0, 255, (64, 64, 3), np.uint8)})
+    actions_dim = (6,)
+    world_model, actor, critic, params = agent_mod.build_agent(
+        runtime, actions_dim, is_continuous, cfg, obs_space
+    )
+    params = runtime.to_param_dtype(params)
+    mk = mod._make_optimizer
+    txs = tuple(
+        mk(getattr(cfg.algo, k).optimizer, getattr(cfg.algo, k).clip_gradients, precision)
+        for k in ("world_model", "actor", "critic")
+    )
+    opt_states = {
+        k: tx.init(params[k]) for k, tx in zip(("world_model", "actor", "critic"), txs)
+    }
+    train_fn = mod.make_train_fn(
+        runtime, world_model, actor, critic, txs, cfg, is_continuous, actions_dim
+    )
+
+    T = int(cfg.algo.per_rank_sequence_length)
+    B = int(cfg.algo.per_rank_batch_size)
+    rng = np.random.default_rng(0)
+    if is_continuous:
+        actions = rng.normal(size=(T, B, 6)).astype(np.float32)
+    else:
+        actions = np.eye(6, dtype=np.float32)[rng.integers(0, 6, (T, B))]
+    data = {
+        "rgb": jnp.asarray(rng.integers(0, 255, (T, B, 64, 64, 3)).astype(np.float32)),
+        "actions": jnp.asarray(actions),
+        "rewards": jnp.asarray(rng.normal(size=(T, B, 1)).astype(np.float32)),
+        "terminated": jnp.zeros((T, B, 1), jnp.float32),
+        "truncated": jnp.zeros((T, B, 1), jnp.float32),
+    }
+    if version >= 2:
+        data["is_first"] = jnp.zeros((T, B, 1), jnp.float32)
+    return runtime, train_fn, params, opt_states, data, (T, B)
+
+
+def time_algo(version: int, precision: str, steps: int):
+    """Returns (seconds_per_step, T, B): async dispatch chain with one
+    trailing host sync — the way the training CLI runs the step (see
+    bench_dv3_step.time_variant for why per-step syncs mis-measure
+    remote-device links)."""
+    import jax
+
+    runtime, train_fn, params, opt_states, data, (T, B) = build(version, precision)
+    params = runtime.replicate(params)
+    opt_states = runtime.replicate(opt_states)
+    for _ in range(2):  # compile + cache-stability warmup
+        params, opt_states, metrics = train_fn(params, opt_states, data, runtime.next_key())
+        float(jax.tree_util.tree_leaves(metrics)[0])
+    tic = time.perf_counter()
+    for _ in range(steps):
+        params, opt_states, metrics = train_fn(params, opt_states, data, runtime.next_key())
+    float(jax.tree_util.tree_leaves(metrics)[0])
+    dt = (time.perf_counter() - tic) / steps
+    print(
+        f"dv{version}: {dt * 1e3:.1f} ms/step, {T * B / dt:,.0f} replayed frames/s "
+        f"(T={T}, B={B}, {precision})",
+        file=sys.stderr,
+    )
+    return dt, T, B
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--algo", nargs="+", default=["dv1", "dv2"], choices=["dv1", "dv2"])
+    ap.add_argument("--steps", type=int, default=16)
+    ap.add_argument("--precision", default="bf16-mixed")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    rows = {}
+    for name in args.algo:
+        version = int(name[-1])
+        dt, T, B = time_algo(version, args.precision, args.steps)
+        rows[name] = {
+            "step_ms": round(dt * 1e3, 2),
+            "replayed_frames_per_s": round(T * B / dt, 1),
+            "T": T,
+            "B": B,
+        }
+        print(json.dumps({name: rows[name]}))
+    if args.out:
+        import jax
+
+        out = {
+            "protocol": (
+                f"{args.steps} steady-state async-dispatched train steps, one trailing "
+                f"sync, {args.precision}; DV1 at its DMC home shape (B=50 T=50, "
+                "continuous), DV2 at its Atari home shape (B=16 T=50, discrete)"
+            ),
+            "platform": jax.devices()[0].platform,
+            "device": str(jax.devices()[0]),
+            "rows": rows,
+        }
+        os.makedirs(os.path.dirname(args.out), exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=1)
+        print(f"wrote {args.out}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,5 @@
+"""Importing this package registers the algorithm + its evaluation
+(registration is an import side-effect, exactly like the built-ins in
+``sheeprl_tpu/algos/__init__.py``)."""
+
+from my_algos.vpg import evaluate, vpg  # noqa: F401

@@ -1,0 +1,37 @@
+"""Evaluation entrypoint: ``python sheeprl_eval.py checkpoint_path=...``
+resolves ``cfg.algo.name`` through the registry and imports
+``<root_module>.evaluate`` — for an external package that is this file."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import gymnasium as gym
+
+from my_algos.vpg.agent import build_agent, VPGPlayer
+from my_algos.vpg.utils import test
+from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.registry import register_evaluation
+
+
+@register_evaluation(algorithms="vpg")
+def evaluate_vpg(runtime, cfg: Dict[str, Any], state: Dict[str, Any]):
+    logger = get_logger(runtime, cfg)
+    log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name)
+    runtime.print(f"Log dir: {log_dir}")
+    runtime.seed_everything(cfg.seed)
+
+    env = make_env(cfg, cfg.seed, 0, log_dir, "test", vector_env_idx=0)()
+    if not isinstance(env.action_space, gym.spaces.Discrete):
+        raise RuntimeError("vpg evaluates single Discrete action spaces only")
+    actions_dim = (int(env.action_space.n),)
+    obs_space = env.observation_space
+    env.close()
+
+    module, params = build_agent(runtime, actions_dim, False, cfg, obs_space, state["agent"])
+    player = VPGPlayer(module, params, list(cfg.algo.mlp_keys.encoder), num_envs=1)
+    rew = test(player, runtime, cfg, log_dir)
+    if logger:
+        logger.log_metrics({"Test/cumulative_reward": rew}, 0)
+        logger.finalize()

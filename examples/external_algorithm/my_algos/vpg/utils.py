@@ -1,0 +1,38 @@
+"""Metric whitelist + model-manager keys + greedy test rollout for vpg."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from my_algos.vpg.agent import VPGPlayer
+from sheeprl_tpu.utils.env import make_env
+
+# metrics the aggregator is allowed to track (see howto/logs_and_checkpoints.md)
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/policy_loss",
+    "Loss/value_loss",
+}
+# checkpoint keys the model manager can publish (see howto/model_manager.md)
+MODELS_TO_REGISTER = {"agent"}
+
+
+def test(player: VPGPlayer, runtime, cfg: Dict[str, Any], log_dir: str) -> float:
+    """Greedy rollout of one episode on rank 0."""
+    single = VPGPlayer(player.module, player.params, player.mlp_keys, num_envs=1)
+    env = make_env(cfg, cfg.seed, 0, log_dir, "test", vector_env_idx=0)()
+    obs = env.reset(seed=cfg.seed)[0]
+    done, cumulative_rew = False, 0.0
+    while not done:
+        actions, _, _ = single.get_actions(obs, runtime.next_key(), greedy=True)
+        obs, reward, terminated, truncated, _ = env.step(int(np.asarray(actions)[0]))
+        done = bool(terminated or truncated)
+        cumulative_rew += float(reward)
+        if cfg.dry_run:
+            done = True
+    runtime.print("Test - Reward:", cumulative_rew)
+    env.close()
+    return cumulative_rew
