@@ -231,6 +231,13 @@ class Composer:
     def __init__(self, config_dirs: Sequence[Path]):
         self.config_dirs = [Path(d) for d in config_dirs]
         self._cli_keys: set = set()
+        # hydra's package-qualified CLI selections, e.g.
+        # ``logger@metric.logger=mlflow`` / ``optim@algo.actor.optimizer=sgd``:
+        # {(group, absolute_package): option}. Matched entries are tracked so
+        # a typo'd package errors instead of silently doing nothing.
+        self._pkg_selections: Dict[Tuple[str, str], str] = {}
+        self._pkg_matched: set = set()
+        self._load_cache: Dict[str, Tuple[dict, str]] = {}
 
     # -- file loading ------------------------------------------------------ #
     def _find(self, rel: str) -> Optional[Path]:
@@ -242,23 +249,35 @@ class Composer:
         return None
 
     def _load(self, rel: str) -> Tuple[dict, str]:
-        """Return (raw-yaml-dict, package-directive)."""
-        p = self._find(rel)
-        if p is None:
-            raise ConfigError(
-                f"Config file '{rel}' not found in {[str(d) for d in self.config_dirs]}"
-            )
-        text = p.read_text()
-        pkg = "_group_"
-        for line in text.splitlines()[:5]:
-            m = re.match(r"#\s*@package\s+(\S+)", line.strip())
-            if m:
-                pkg = m.group(1)
-                break
-        data = yaml_load(text) or {}
-        if not isinstance(data, dict):
-            raise ConfigError(f"Config file '{rel}' must contain a mapping")
-        return data, pkg
+        """Return (raw-yaml-dict, package-directive). Parses each file once
+        per composer (the mount prediction and the two composition passes
+        re-read files); callers get a fresh deep copy since composition
+        mutates the dict (defaults pop, merges)."""
+        if rel not in self._load_cache:
+            p = self._find(rel)
+            if p is None:
+                raise ConfigError(
+                    f"Config file '{rel}' not found in {[str(d) for d in self.config_dirs]}"
+                )
+            text = p.read_text()
+            pkg = "_group_"
+            for line in text.splitlines()[:5]:
+                m = re.match(r"#\s*@package\s+(\S+)", line.strip())
+                if m:
+                    pkg = m.group(1)
+                    break
+            data = yaml_load(text) or {}
+            if not isinstance(data, dict):
+                raise ConfigError(f"Config file '{rel}' must contain a mapping")
+            self._load_cache[rel] = (data, pkg)
+        data, pkg = self._load_cache[rel]
+        return copy.deepcopy(data), pkg
+
+    def _peek_pkg(self, rel: str) -> str:
+        """The file's @package header only — no dict copy."""
+        if rel not in self._load_cache:
+            self._load(rel)
+        return self._load_cache[rel][1]
 
     # -- defaults handling ------------------------------------------------- #
     @staticmethod
@@ -281,8 +300,13 @@ class Composer:
         rel: str,
         group_prefix: str,
         selections: Dict[str, str],
+        mount_prefix: str = "",
     ) -> Tuple[dict, str]:
-        """Compose one file with its own defaults list. Returns (tree, pkg)."""
+        """Compose one file with its own defaults list. Returns (tree, pkg).
+
+        ``mount_prefix`` is the absolute package path this file's tree lands
+        at ("" for the root / ``_global_`` files) — package-qualified CLI
+        selections are matched against it."""
         data, pkg = self._load(rel)
         defaults = data.pop("defaults", None)
         own = data  # content of the file itself (post-defaults-pop)
@@ -302,7 +326,7 @@ class Composer:
                 # bare string entry: include a sibling file of the same group
                 # (e.g. `- default` inside algo/ppo.yaml -> algo/default.yaml)
                 inc = f"{group_prefix}/{group_expr}" if group_prefix else group_expr
-                sub_tree, _ = self._compose_file(inc, group_prefix, selections)
+                sub_tree, _ = self._compose_file(inc, group_prefix, selections, mount_prefix)
                 deep_merge(result, sub_tree)
                 continue
             if is_override:
@@ -328,6 +352,16 @@ class Composer:
             chosen = selections.get(group_key, option)
             if chosen in (None, ""):
                 chosen = option
+            # package-qualified CLI selection (``group@abs.package=option``)
+            # beats everything: it names one specific mount of the group, so
+            # e.g. ``optim@algo.actor.optimizer=sgd`` swaps the actor's
+            # optimizer without touching the world model's or the critic's
+            local_pkg = package if package is not None else group_key.replace("/", ".")
+            abs_pkg = f"{mount_prefix}.{local_pkg}" if mount_prefix else local_pkg
+            pkg_sel = self._pkg_selections.get((group_key, abs_pkg))
+            if pkg_sel is not None:
+                chosen = pkg_sel
+                self._pkg_matched.add((group_key, abs_pkg))
             if chosen == MISSING or chosen is None:
                 if group_key in selections and selections[group_key] not in (None, "", MISSING):
                     chosen = selections[group_key]
@@ -339,14 +373,19 @@ class Composer:
             if chosen.endswith((".yaml", ".yml")):
                 chosen = chosen.rsplit(".", 1)[0]
             sub_rel = f"{group_path}/{chosen}"
-            sub_tree, sub_pkg = self._compose_file(sub_rel, group_path, selections)
-            # where to mount
+            # predict the mount before recursing so the subtree knows its own
+            # absolute package (only the sub-file's @package header is needed)
             if package is not None:
                 mount = None if package in ("_global_",) else package
-            elif sub_pkg == "_global_":
+            elif self._peek_pkg(sub_rel) == "_global_":
                 mount = None
             else:
                 mount = group_key.replace("/", ".")
+            child_prefix = (
+                mount_prefix if mount is None
+                else (f"{mount_prefix}.{mount}" if mount_prefix else mount)
+            )
+            sub_tree, _ = self._compose_file(sub_rel, group_path, selections, child_prefix)
             if mount is None:
                 deep_merge(result, sub_tree)
             else:
@@ -415,6 +454,18 @@ def compose(
         key, raw = ov.split("=", 1)
         add = key.startswith("+")
         key = key.lstrip("+")
+        # package-qualified group selection (hydra syntax), e.g.
+        # ``logger@metric.logger=mlflow``: <group>@<absolute.package>=<option>
+        if "@" in key and not add:
+            grp, package = key.split("@", 1)
+            if "." not in grp and any((d / grp).is_dir() for d in composer.config_dirs):
+                if composer._find(f"{grp}/{raw}") is None:
+                    raise ConfigError(
+                        f"Override '{ov}': group '{grp}' has no option '{raw}' "
+                        f"(no {grp}/{raw}.yaml on the search path)"
+                    )
+                composer._pkg_selections[(grp, package)] = raw
+                continue
         # group selection iff a matching option file exists
         if "." not in key and composer._find(f"{key}/{raw}") is not None:
             selections[key] = raw
@@ -426,7 +477,18 @@ def compose(
     # the final selection map. CLI selections always win.
     composer._cli_keys = set(selections)
     composer._compose_file(config_name, "", selections)
+    # pass 1 may match package selections against mounts that only exist
+    # under pre-override selections — only pass 2 (the final tree) counts
+    composer._pkg_matched.clear()
     tree, _ = composer._compose_file(config_name, "", selections)
+    unmatched = set(composer._pkg_selections) - composer._pkg_matched
+    if unmatched:
+        grp, package = sorted(unmatched)[0]
+        raise ConfigError(
+            f"Override '{grp}@{package}={composer._pkg_selections[(grp, package)]}' "
+            f"matched no defaults entry: no '{grp}' group is mounted at package "
+            f"'{package}' in the composed tree"
+        )
     for key, val in sets + adds:
         _set_path(tree, key, val)
     for key in dels:

@@ -79,3 +79,36 @@ def test_search_path_env(tmp_path, monkeypatch):
     cfg = compose(overrides=["exp=custom"])
     assert cfg.algo.total_steps == 17
     assert cfg.env.id == "dummy_discrete"
+
+
+def test_package_qualified_selection_logger():
+    """Hydra syntax ``group@abs.package=option`` (the form the reference's
+    docs teach for logger swapping) selects the option at that mount."""
+    cfg = compose(overrides=["exp=ppo", "logger@metric.logger=mlflow"])
+    assert "MLflowLogger" in cfg.metric.logger._target_
+    # the bare-group spelling keeps working
+    cfg = compose(overrides=["exp=ppo", "logger=mlflow"])
+    assert "MLflowLogger" in cfg.metric.logger._target_
+
+
+def test_package_qualified_selection_targets_one_mount():
+    """With several mounts of the same group (dreamer's three optimizers),
+    the package picks exactly one."""
+    cfg = compose(overrides=["exp=dreamer_v3", "optim@algo.actor.optimizer=sgd"])
+    assert "sgd" in cfg.algo.actor.optimizer._target_
+    assert "adam" in cfg.algo.world_model.optimizer._target_
+    assert "adam" in cfg.algo.critic.optimizer._target_
+
+
+def test_package_qualified_selection_typo_errors():
+    """A package that matches no defaults entry must error, not silently
+    no-op (the pre-fix behavior wrote a junk 'logger@metric' leaf)."""
+    with pytest.raises(ConfigError, match="matched no defaults entry"):
+        compose(overrides=["exp=ppo", "logger@metric.typo=mlflow"])
+
+
+def test_package_qualified_selection_bad_option_errors():
+    """A typo'd OPTION (not just package) must error too — the pre-fix
+    fallthrough wrote a junk 'logger@metric' leaf silently."""
+    with pytest.raises(ConfigError, match="no option 'mlfow'"):
+        compose(overrides=["exp=ppo", "logger@metric.logger=mlfow"])
