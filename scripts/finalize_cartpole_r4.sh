@@ -23,6 +23,16 @@ if [ -z "$CKPT" ] || [ "$CKPT" = "None" ]; then
   echo "ERROR: no checkpoint found under runs/dv3_cartpole" >&2
   exit 1
 fi
+# the run-dir is shared across chains: make sure the newest checkpoint
+# actually belongs to the r4 curve being finalized (within one
+# checkpoint/log cadence of the stitched final step)
+CKPT_STEP=$(basename "$CKPT" | sed -E 's/ckpt_([0-9]+)_.*/\1/')
+FINAL_STEP=$(python -c "import json,sys; print(json.load(open('$OUT'))['final_step'])")
+DELTA=$((CKPT_STEP - FINAL_STEP)); DELTA=${DELTA#-}
+if [ "$DELTA" -gt 8000 ]; then
+  echo "ERROR: newest ckpt step $CKPT_STEP is $DELTA steps from the curve's final step $FINAL_STEP — wrong chain's checkpoint?" >&2
+  exit 1
+fi
 echo "evaluating $CKPT"
 MUJOCO_GL=egl timeout 1200 python sheeprl_eval.py "checkpoint_path=$CKPT" \
   env.capture_video=False 2>&1 | tee /tmp/cartpole_eval_r4.log | tail -3
