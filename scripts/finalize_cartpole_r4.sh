@@ -6,8 +6,8 @@ set -e -o pipefail
 cd /root/repo
 OUT=benchmarks/results/dv3_cartpole_swingup_curve_r4.json
 
-# session-2 machine: the chain trained FROM SCRATCH in chain_r4 (no r3
-# legs exist here, and stitching another run's logs would corrupt the
+# the chain trained FROM SCRATCH in chain_r4 (no r3 legs exist on this
+# machine, and stitching another run's logs would corrupt the
 # from-scratch curve this artifact claims to be)
 python scripts/curve_from_logs.py \
   --chain-dir runs/dv3_cartpole/chain_r4 \
@@ -47,12 +47,11 @@ d["greedy_eval_reward_at_final_ckpt"] = float(m[-1]) if m else None
 d["experiment"] = ("dreamer_v3_dmc_cartpole_swingup (dense; DV3-S, pixels 64x64, 8 envs, "
                    "replay_ratio 0.3, action_repeat 2, EGL rendering)")
 d["hardware"] = "1x TPU v5e (tunneled axon backend) + 1-core CPU host"
-d["protocol"] = ("round-4 chain trained FROM SCRATCH on the session-2 machine (the r3 "
-                 "checkpoint did not survive the mid-round machine swap); "
-                 "scripts/train_chain.py checkpoint-resume legs, async vector envs from "
-                 "leg 5; VERDICT r3 item 6 (target: greedy eval >= 600). For reference, "
-                 "r3's separate run reached train mean 253 at 40K; this run passed that "
-                 "before 36K")
+d["protocol"] = ("round-4 chain trained FROM SCRATCH on the session-3 machine (neither the "
+                 "r3 checkpoint nor the earlier r4 chains survived the mid-round machine "
+                 "swaps); scripts/train_chain.py checkpoint-resume legs, async vector envs "
+                 "from leg 0; VERDICT r3 item 6 (target: greedy eval >= 600). For "
+                 "reference, r3's separate run reached train mean 253 at 40K")
 json.dump(d, open(out, "w"), indent=2)
 print(json.dumps({k: d[k] for k in ("final_step", "final_reward_mean", "best_reward_mean", "greedy_eval_reward_at_final_ckpt")}))
 EOF
