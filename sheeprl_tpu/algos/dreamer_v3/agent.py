@@ -33,6 +33,9 @@ from sheeprl_tpu.models.models import (
     LayerNormGRUCell,
     batch_major_flatten,
     batch_major_unflatten,
+    gru_cell_apply,
+    linear_ln_act_apply,
+    ln_act_apply,
     resolve_activation,
 )
 from sheeprl_tpu.utils.distribution import (
@@ -487,12 +490,12 @@ class RSSM(nn.Module):
         k_h = p["kernel"][: self.recurrent_state_size].astype(self.dtype)
         x = recurrent_state.astype(self.dtype) @ k_h + emb_proj
         if self.layer_norm:
-            ln = params["LinearLnAct_0"]["LayerNorm_0"]
-            xf = x.astype(jnp.float32)
-            mu = xf.mean(-1, keepdims=True)
-            var = ((xf - mu) ** 2).mean(-1, keepdims=True)
-            x = (xf - mu) * jax.lax.rsqrt(var + self.eps) * ln["scale"] + ln["bias"]
-        x = resolve_activation(self.act)(x.astype(self.dtype))
+            x = ln_act_apply(
+                params["LinearLnAct_0"]["LayerNorm_0"], x,
+                eps=self.eps, act=self.act, dtype=self.dtype,
+            )
+        else:
+            x = resolve_activation(self.act)(x.astype(self.dtype))
         head = params["Dense_0"]
         logits = x.astype(jnp.float32) @ head["kernel"] + head["bias"]
         logits = self._uniform_mix(logits)
@@ -548,7 +551,12 @@ class RSSM(nn.Module):
     ) -> jax.Array:
         """Decoupled-RSSM scan body: is_first-gated reset + recurrent model
         only (posteriors are precomputed in batch, priors are batched over
-        the stacked recurrent states outside the scan)."""
+        the stacked recurrent states outside the scan).
+
+        Kept as the reference semantics for
+        :meth:`recurrent_features_seq` + :meth:`gru_step_gated`, which split
+        the same computation so the input projection leaves the scan; the
+        identity is pinned by ``tests/test_models/test_models.py``."""
         init_rec, init_post = init_states
         action = (1 - is_first) * action
         recurrent_state = (1 - is_first) * recurrent_state + is_first * init_rec
@@ -557,6 +565,54 @@ class RSSM(nn.Module):
         return self.recurrent_model(
             jnp.concatenate([prev, action], -1), recurrent_state
         )
+
+    def recurrent_features_seq(
+        self,
+        prev_posteriors: jax.Array,
+        actions: jax.Array,
+        is_first: jax.Array,
+        init_post: jax.Array,
+    ) -> jax.Array:
+        """is_first-gated inputs + the recurrent model's input projection,
+        batched over the whole (T, B) sequence.
+
+        The projection sees only ``[z_{t-1}, a_t]`` — never ``h`` — so when
+        every posterior is known up front (DecoupledRSSM: the posterior
+        depends only on the embedded obs, reference DecoupledRSSM:501) the
+        whole Dense+LN+SiLU block runs as ONE matmul over T*B rows instead
+        of T sequential (B, .) matmuls inside the scan, and its
+        kernel-gradient accumulation leaves the backward while-loop's carry
+        (same argument as :meth:`representation_embed_proj`)."""
+        prev = prev_posteriors.reshape(*prev_posteriors.shape[:-2], -1)
+        # init_post: (B, stoch, discrete) or (B, stoch*discrete) -> (B, N),
+        # broadcasting against prev's (T, B, N)
+        prev = (1 - is_first) * prev + is_first * init_post.reshape(init_post.shape[0], -1)
+        actions = (1 - is_first) * actions
+        inp = jnp.concatenate([prev, actions], -1)
+        return linear_ln_act_apply(
+            self.recurrent_model.variables["params"]["LinearLnAct_0"],
+            inp,
+            layer_norm=self.layer_norm,
+            eps=self.eps,
+            act="silu",  # RecurrentModel hard-codes silu for its projection
+            dtype=self.dtype,
+        )
+
+    def gru_step_gated(
+        self,
+        feat: jax.Array,
+        recurrent_state: jax.Array,
+        is_first: jax.Array,
+        init_rec: jax.Array,
+    ) -> jax.Array:
+        """The sequential residue of :meth:`recurrent_step_gated` once
+        :meth:`recurrent_features_seq` has batched the input projection:
+        is_first-gated state reset + one GRU cell step."""
+        recurrent_state = (1 - is_first) * recurrent_state + is_first * init_rec
+        p = self.recurrent_model.variables["params"]["LayerNormGRUCell_0"]
+        return gru_cell_apply(
+            p, recurrent_state, feat, fused=self.fused_gru, dtype=self.dtype
+        ).astype(jnp.float32)
 
     def imagination(
         self,

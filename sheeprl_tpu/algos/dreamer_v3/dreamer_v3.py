@@ -246,23 +246,32 @@ def make_train_fn(runtime, world_model, actor, critic, txs, cfg, is_continuous, 
                     [jnp.zeros_like(posteriors[:1]), posteriors[:-1]], 0
                 )
 
+                # the recurrent model's input projection sees only
+                # [z_{t-1}, a_t] — all known up front here — so it batches
+                # over the whole sequence and the scan body shrinks to the
+                # is_first-gated GRU cell (RSSM.recurrent_features_seq)
+                feats = rssm.apply(
+                    wm_params["rssm"], prev_posteriors, batch_actions,
+                    is_first, init_states[1],
+                    method=RSSM.recurrent_features_seq,
+                )
+
                 def dyn_step_dec(recurrent_state, inp):
-                    prev_post, action, first = inp
+                    feat, first = inp
                     recurrent_state = rssm.apply(
                         wm_params["rssm"],
-                        prev_post,
+                        feat,
                         recurrent_state,
-                        action,
                         first,
-                        init_states,
-                        method=RSSM.recurrent_step_gated,
+                        init_states[0],
+                        method=RSSM.gru_step_gated,
                     )
                     return recurrent_state, recurrent_state
 
                 _, recurrent_states = jax.lax.scan(
                     dyn_step_dec,
                     jnp.zeros((B, recurrent_state_size)),
-                    (prev_posteriors, batch_actions, is_first),
+                    (feats, is_first),
                     unroll=scan_unroll,
                 )
             else:

@@ -193,18 +193,26 @@ def make_train_fn(
                     [jnp.zeros_like(posteriors[:1]), posteriors[:-1]], 0
                 )
 
+                # input projection batched over the sequence; only the gated
+                # GRU cell stays sequential (RSSM.recurrent_features_seq)
+                feats = rssm.apply(
+                    wm_params["rssm"], prev_posteriors, batch_actions,
+                    is_first, init_states[1],
+                    method=RSSM.recurrent_features_seq,
+                )
+
                 def dyn_step_dec(recurrent_state, inp):
-                    prev_post, action, first = inp
+                    feat, first = inp
                     recurrent_state = rssm.apply(
-                        wm_params["rssm"], prev_post, recurrent_state, action, first,
-                        init_states, method=RSSM.recurrent_step_gated,
+                        wm_params["rssm"], feat, recurrent_state, first,
+                        init_states[0], method=RSSM.gru_step_gated,
                     )
                     return recurrent_state, recurrent_state
 
                 _, recurrent_states = jax.lax.scan(
                     scan_remat(dyn_step_dec),
                     jnp.zeros((B, recurrent_state_size)),
-                    (prev_posteriors, batch_actions, is_first),
+                    (feats, is_first),
                     unroll=scan_unroll_setting(cfg, "dyn"),
                 )
             else:

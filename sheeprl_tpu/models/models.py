@@ -225,6 +225,98 @@ class NatureCNN(nn.Module):
         return x
 
 
+def ln_act_apply(ln_params, x: jax.Array, *, eps: float, act: Any, dtype: Dtype) -> jax.Array:
+    """LayerNorm (flax fast-variance formula, f32 statistics) + activation
+    from a raw ``{"scale", "bias"}`` param dict — the post-matmul half of
+    :class:`LinearLnAct` for callers that hand-roll the matmul (split or
+    hoisted kernels)."""
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(-1, keepdims=True)
+    var = jnp.maximum((xf * xf).mean(-1, keepdims=True) - mu * mu, 0.0)
+    xf = (xf - mu) * jax.lax.rsqrt(var + eps) * ln_params["scale"] + ln_params["bias"]
+    return resolve_activation(act)(xf.astype(dtype))
+
+
+def linear_ln_act_apply(
+    params,
+    x: jax.Array,
+    *,
+    layer_norm: bool = True,
+    eps: float = 1e-3,
+    act: Any = "silu",
+    dtype: Dtype = jnp.float32,
+) -> jax.Array:
+    """Apply a :class:`LinearLnAct` block straight from its param subtree
+    (``{"Dense_0": ..., "LayerNorm_0": ...}``), matching the module's
+    numerics (Dense in the compute dtype, LN in f32 with flax's
+    E[x^2]-E[x]^2 variance). For callers that have hoisted the block out
+    of a ``lax.scan`` — the Dense/LN/act math lives HERE, not in per-site
+    copies."""
+    x = x.astype(dtype) @ params["Dense_0"]["kernel"].astype(dtype)
+    if "bias" in params["Dense_0"]:
+        x = x + params["Dense_0"]["bias"].astype(dtype)
+    if layer_norm:
+        return ln_act_apply(params["LayerNorm_0"], x, eps=eps, act=act, dtype=dtype)
+    return resolve_activation(act)(x.astype(dtype))
+
+
+def gru_cell_apply(
+    params,
+    h: jax.Array,
+    x: jax.Array,
+    *,
+    fused: bool = False,
+    dtype: Dtype = jnp.float32,
+    use_bias: bool = False,
+    layer_norm: bool = True,
+) -> jax.Array:
+    """Apply a :class:`LayerNormGRUCell` straight from its param subtree.
+
+    ``params`` is the cell's own scope (``{"Dense_0": ..., "LayerNorm_0":
+    ...}``). Lets callers that have hoisted the surrounding computation out
+    of a ``lax.scan`` (e.g. ``RSSM.gru_step_gated``) run just the cell on
+    the sequential critical path without flax module ceremony, with the
+    same numerics as the module's ``__call__`` — including the Pallas
+    fused-kernel routing when ``fused=True``."""
+    if fused and layer_norm and not use_bias:
+        from sheeprl_tpu.ops.pallas_gru import gru_cell
+
+        lead = h.shape[:-1]
+
+        def _step(interpret: bool):
+            def f(h2, x2, w, scale, bias):
+                return gru_cell(h2, x2, w, scale, bias, 1e-6, True, 8, 512, interpret, dtype)
+
+            return f
+
+        return jax.lax.platform_dependent(
+            h.reshape(-1, h.shape[-1]),
+            x.reshape(-1, x.shape[-1]),
+            params["Dense_0"]["kernel"],
+            params["LayerNorm_0"]["scale"],
+            params["LayerNorm_0"]["bias"],
+            tpu=_step(False),
+            default=_step(True),
+        ).reshape(*lead, -1)
+
+    inp = jnp.concatenate([h, x], axis=-1)
+    parts = inp.astype(dtype) @ params["Dense_0"]["kernel"].astype(dtype)
+    if use_bias:
+        parts = parts + params["Dense_0"]["bias"].astype(dtype)
+    parts = parts.astype(jnp.float32)
+    if layer_norm:
+        ln = params["LayerNorm_0"]
+        # flax fast-variance formula (E[x^2] - E[x]^2), epsilon default 1e-6
+        mu = parts.mean(-1, keepdims=True)
+        var = jnp.maximum((parts * parts).mean(-1, keepdims=True) - mu * mu, 0.0)
+        parts = (parts - mu) * jax.lax.rsqrt(var + 1e-6) * ln["scale"] + ln["bias"]
+    reset, cand, update = jnp.split(parts, 3, axis=-1)
+    reset = jax.nn.sigmoid(reset)
+    cand = jnp.tanh(reset * cand)
+    update = jax.nn.sigmoid(update - 1.0)
+    return update * cand + (1.0 - update) * h.astype(jnp.float32)
+
+
 class LayerNormGRUCell(nn.Module):
     """Hafner-style GRU cell: one dense over [x, h] -> LayerNorm -> split into
     reset/candidate/update, with the update-gate ``-1`` bias trick
@@ -265,35 +357,15 @@ class LayerNormGRUCell(nn.Module):
             and not self.use_bias
             and not self.is_initializing()
         ):
-            from sheeprl_tpu.ops.pallas_gru import gru_cell
-
-            p = self.variables["params"]
-            lead = h.shape[:-1]  # kernel wants (B, H); callers pass e.g. (1, B, H)
             # mixed-precision semantics match the unfused path exactly: the
             # contraction runs in the compute dtype inside the kernel while
-            # the carried state, gates and LayerNorm stay f32
-            mm_dtype = self.dtype
-
-            def _step(interpret: bool):
-                def f(h2, x2, w, scale, bias):
-                    return gru_cell(
-                        h2, x2, w, scale, bias, 1e-6, True, 8, 512, interpret, mm_dtype
-                    )
-
-                return f
-
-            # interpret-mode choice must be per lowering platform, not
+            # the carried state, gates and LayerNorm stay f32.  The
+            # interpret-mode choice inside is per lowering platform, not
             # process-global: with a TPU default backend the env-interaction
-            # player still runs this cell on the host CPU backend
-            new_h = jax.lax.platform_dependent(
-                h.reshape(-1, h.shape[-1]),
-                x.reshape(-1, x.shape[-1]),
-                p["Dense_0"]["kernel"],
-                p["LayerNorm_0"]["scale"],
-                p["LayerNorm_0"]["bias"],
-                tpu=_step(False),
-                default=_step(True),
-            ).reshape(*lead, -1)
+            # player still runs this cell on the host CPU backend.
+            new_h = gru_cell_apply(
+                self.variables["params"], h, x, fused=True, dtype=self.dtype
+            )
             return new_h, new_h
         inp = jnp.concatenate([h, x], axis=-1)
         # only the contraction runs in the compute dtype; LayerNorm, gates

@@ -131,3 +131,68 @@ def test_rmsprop_tf_step():
     updates, state = tx.update(grads, state, params)
     params = optax.apply_updates(params, updates)
     assert np.all(np.asarray(params["w"]) < 1.0)
+
+
+def test_gru_cell_apply_matches_module():
+    from sheeprl_tpu.models.models import gru_cell_apply
+
+    cell = LayerNormGRUCell(hidden_size=16)
+    h = jax.random.normal(KEY, (5, 16))
+    x = jax.random.normal(jax.random.PRNGKey(1), (5, 8))
+    params = cell.init(KEY, h, x)
+    module_out, _ = cell.apply(params, h, x)
+    fn_out = gru_cell_apply(params["params"], h, x)
+    np.testing.assert_allclose(np.asarray(module_out), np.asarray(fn_out), rtol=1e-6, atol=1e-6)
+
+
+def test_decoupled_scan_input_projection_hoist_identity():
+    """recurrent_features_seq + gru_step_gated == the recurrent_step_gated
+    scan (the decoupled dynamic path's hoisted form must be a pure
+    re-bracketing, not a semantic change)."""
+    from sheeprl_tpu.algos.dreamer_v3.agent import RSSM
+
+    T, B, R, A, E = 6, 5, 8, 3, 16
+    rssm = RSSM(
+        actions_dim=(A,),
+        embedded_obs_dim=E,
+        recurrent_state_size=R,
+        dense_units=12,
+        stochastic_size=4,
+        discrete_size=4,
+        hidden_size=12,
+        decoupled=True,
+    )
+    k = jax.random.PRNGKey(7)
+    ks = jax.random.split(k, 6)
+    post = jax.random.normal(ks[0], (B, 4, 4))
+    h0 = jnp.zeros((B, R))
+    act0 = jnp.zeros((B, A))
+    emb = jax.random.normal(ks[1], (B, E))
+    first0 = jnp.ones((B, 1))
+    params = rssm.init(ks[2], post, h0, act0, emb, first0, ks[3], method=RSSM.init_all)
+
+    prev_posts = jax.random.normal(ks[4], (T, B, 4, 4))
+    actions = jax.random.normal(ks[5], (T, B, A))
+    is_first = jnp.zeros((T, B, 1)).at[0].set(1.0).at[3, 2].set(1.0)
+    init_states = rssm.apply(params, (B,), method=RSSM.get_initial_states)
+    init_states = (init_states[0], init_states[1].reshape(B, -1))
+
+    def old_step(h, inp):
+        pp, a, f = inp
+        h = rssm.apply(params, pp, h, a, f, init_states, method=RSSM.recurrent_step_gated)
+        return h, h
+
+    _, hs_old = jax.lax.scan(old_step, jnp.zeros((B, R)), (prev_posts, actions, is_first))
+
+    feats = rssm.apply(
+        params, prev_posts, actions, is_first, init_states[1],
+        method=RSSM.recurrent_features_seq,
+    )
+
+    def new_step(h, inp):
+        feat, f = inp
+        h = rssm.apply(params, feat, h, f, init_states[0], method=RSSM.gru_step_gated)
+        return h, h
+
+    _, hs_new = jax.lax.scan(new_step, jnp.zeros((B, R)), (feats, is_first))
+    np.testing.assert_allclose(np.asarray(hs_old), np.asarray(hs_new), rtol=2e-5, atol=2e-6)
