@@ -1,0 +1,94 @@
+"""Wall-clock the one-kernel sequence GRU against the per-step scan paths.
+
+Three variants of the decoupled-RSSM dynamic recurrence at DV3-S shapes
+(T=64, B=16, H=512, X=512 — the benched anchor config), on the current
+default jax platform:
+
+* ``scan``      — lax.scan over the unfused cell (gru_step_gated path)
+* ``scan_fused``— lax.scan over the per-step Pallas cell (fused=True)
+* ``seq``       — ops/seq_gru.gru_sequence (ONE kernel, weights resident)
+
+Times forward-only and forward+backward (grad wrt weights), since the train
+path runs under jax.grad. Writes benchmarks/results/seq_gru_<platform>.json.
+
+Usage: python benchmarks/bench_seq_gru.py [--T 64] [--B 16] [--H 512] [--steps 30]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--T", type=int, default=64)
+    ap.add_argument("--B", type=int, default=16)
+    ap.add_argument("--H", type=int, default=512)
+    ap.add_argument("--X", type=int, default=512)
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sheeprl_tpu.ops.pallas_gru import gru_cell
+    from sheeprl_tpu.ops.seq_gru import gru_sequence, gru_sequence_reference
+
+    platform = jax.default_backend()
+    interpret = platform != "tpu"
+    T, B, H, X = args.T, args.B, args.H, args.X
+    rng = np.random.default_rng(0)
+    h0 = jnp.zeros((B, H))
+    xs = jnp.asarray(rng.normal(size=(T, B, X)), jnp.float32)
+    w = jnp.asarray(rng.normal(scale=0.05, size=(H + X, 3 * H)), jnp.float32)
+    gamma = jnp.ones((3 * H,))
+    beta = jnp.zeros((3 * H,))
+    is_first = jnp.zeros((T, B, 1)).at[0].set(1.0)
+    init_rec = jnp.asarray(rng.normal(size=(B, H)), jnp.float32)
+
+    def scan_plain(w_, xs_):
+        return gru_sequence_reference(h0, xs_, w_, gamma, beta, is_first, init_rec)
+
+    def scan_fused(w_, xs_):
+        def step(h, inp):
+            x, f = inp
+            hg = (1.0 - f) * h + f * init_rec
+            h_new = gru_cell(hg, x, w_, gamma, beta, 1e-6, True, 8, 512, interpret)
+            return h_new, h_new
+
+        _, hs = jax.lax.scan(step, h0, (xs_, is_first))
+        return hs
+
+    def seq(w_, xs_):
+        return gru_sequence(h0, xs_, w_, gamma, beta, is_first, init_rec, 1e-6, interpret)
+
+    results = {"platform": platform, "T": T, "B": B, "H": H, "X": X, "steps": args.steps}
+    for name, fn in (("scan", scan_plain), ("scan_fused", scan_fused), ("seq", seq)):
+        fwd = jax.jit(lambda w_, xs_, fn=fn: fn(w_, xs_).sum())
+        grad = jax.jit(jax.grad(lambda w_, xs_, fn=fn: fn(w_, xs_).sum()))
+        for tag, f in (("fwd", fwd), ("grad", grad)):
+            out = f(w, xs)
+            jax.block_until_ready(out)
+            tic = time.perf_counter()
+            for _ in range(args.steps):
+                out = f(w, xs)
+            jax.block_until_ready(out)
+            ms = (time.perf_counter() - tic) / args.steps * 1e3
+            results[f"{name}_{tag}_ms"] = round(ms, 3)
+            print(f"{name:10s} {tag}: {ms:8.3f} ms", file=sys.stderr)
+
+    out_path = args.out or f"benchmarks/results/seq_gru_{platform}.json"
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    with open(out_path, "w") as f:
+        json.dump(results, f, indent=2)
+    print(json.dumps(results))
+
+
+if __name__ == "__main__":
+    main()

@@ -342,6 +342,7 @@ class RSSM(nn.Module):
     learnable_initial_recurrent_state: bool = True
     decoupled: bool = False
     fused_gru: bool = False
+    fused_seq: bool = False
     dtype: Any = jnp.float32
 
     def setup(self) -> None:
@@ -613,6 +614,55 @@ class RSSM(nn.Module):
         return gru_cell_apply(
             p, recurrent_state, feat, fused=self.fused_gru, dtype=self.dtype
         ).astype(jnp.float32)
+
+    def seq_scan_eligible(self, feat_dim: int) -> bool:
+        """Is the one-kernel sequence GRU usable for this model size?"""
+        from sheeprl_tpu.ops.seq_gru import fits_vmem
+
+        # no layer_norm condition: the GRU cell's LN is unconditional in
+        # RecurrentModel (self.layer_norm only governs the MLP blocks)
+        return (
+            self.fused_seq
+            and self.recurrent_state_size % 128 == 0
+            and feat_dim % 128 == 0
+            and fits_vmem(self.recurrent_state_size, feat_dim, self.dtype)
+        )
+
+    def gru_sequence_gated(
+        self,
+        feats: jax.Array,
+        is_first: jax.Array,
+        init_rec: jax.Array,
+    ) -> jax.Array:
+        """The whole decoupled dynamic recurrence in ONE Pallas kernel: T
+        is_first-gated GRU steps with the weight matrix VMEM-resident
+        (ops/seq_gru.py). Semantically identical to scanning
+        :meth:`gru_step_gated` over ``feats`` from a zero carry."""
+        from sheeprl_tpu.ops.seq_gru import gru_sequence
+
+        p = self.recurrent_model.variables["params"]["LayerNormGRUCell_0"]
+        h0 = jnp.zeros((feats.shape[1], self.recurrent_state_size))
+        dt = self.dtype
+
+        def _run(interpret: bool):
+            def f(h0_, xs, w, g, b, fi, ir):
+                return gru_sequence(h0_, xs, w, g, b, fi, ir, 1e-6, interpret, dt)
+
+            return f
+
+        # interpret mode per lowering platform (tests/CPU players), same
+        # pattern as gru_cell_apply
+        return jax.lax.platform_dependent(
+            h0,
+            feats,
+            p["Dense_0"]["kernel"],
+            p["LayerNorm_0"]["scale"],
+            p["LayerNorm_0"]["bias"],
+            is_first.astype(jnp.float32),
+            init_rec,
+            tpu=_run(False),
+            default=_run(True),
+        )
 
     def imagination(
         self,
@@ -943,6 +993,7 @@ def build_agent(
         learnable_initial_recurrent_state=world_model_cfg.learnable_initial_recurrent_state,
         decoupled=bool(world_model_cfg.decoupled_rssm),
         fused_gru=bool(world_model_cfg.recurrent_model.get("fused", False)),
+        fused_seq=bool(world_model_cfg.recurrent_model.get("fused_seq", False)),
         dtype=compute_dtype,
     )
 

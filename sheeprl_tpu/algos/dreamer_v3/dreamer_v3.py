@@ -256,24 +256,32 @@ def make_train_fn(runtime, world_model, actor, critic, txs, cfg, is_continuous, 
                     method=RSSM.recurrent_features_seq,
                 )
 
-                def dyn_step_dec(recurrent_state, inp):
-                    feat, first = inp
-                    recurrent_state = rssm.apply(
-                        wm_params["rssm"],
-                        feat,
-                        recurrent_state,
-                        first,
-                        init_states[0],
-                        method=RSSM.gru_step_gated,
+                if rssm.seq_scan_eligible(int(feats.shape[-1])):
+                    # the whole recurrence in ONE Pallas kernel (weights
+                    # VMEM-resident across time, efficient-BPTT custom VJP)
+                    recurrent_states = rssm.apply(
+                        wm_params["rssm"], feats, is_first, init_states[0],
+                        method=RSSM.gru_sequence_gated,
                     )
-                    return recurrent_state, recurrent_state
+                else:
+                    def dyn_step_dec(recurrent_state, inp):
+                        feat, first = inp
+                        recurrent_state = rssm.apply(
+                            wm_params["rssm"],
+                            feat,
+                            recurrent_state,
+                            first,
+                            init_states[0],
+                            method=RSSM.gru_step_gated,
+                        )
+                        return recurrent_state, recurrent_state
 
-                _, recurrent_states = jax.lax.scan(
-                    dyn_step_dec,
-                    jnp.zeros((B, recurrent_state_size)),
-                    (feats, is_first),
-                    unroll=scan_unroll,
-                )
+                    _, recurrent_states = jax.lax.scan(
+                        dyn_step_dec,
+                        jnp.zeros((B, recurrent_state_size)),
+                        (feats, is_first),
+                        unroll=scan_unroll,
+                    )
             else:
 
                 # embed half of the representation model's first matmul,
