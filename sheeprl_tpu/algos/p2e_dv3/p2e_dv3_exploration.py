@@ -201,20 +201,27 @@ def make_train_fn(
                     method=RSSM.recurrent_features_seq,
                 )
 
-                def dyn_step_dec(recurrent_state, inp):
-                    feat, first = inp
-                    recurrent_state = rssm.apply(
-                        wm_params["rssm"], feat, recurrent_state, first,
-                        init_states[0], method=RSSM.gru_step_gated,
+                if rssm.seq_scan_eligible(int(feats.shape[-1])):
+                    # whole recurrence in ONE Pallas kernel (see dreamer_v3)
+                    recurrent_states = rssm.apply(
+                        wm_params["rssm"], feats, is_first, init_states[0],
+                        method=RSSM.gru_sequence_gated,
                     )
-                    return recurrent_state, recurrent_state
+                else:
+                    def dyn_step_dec(recurrent_state, inp):
+                        feat, first = inp
+                        recurrent_state = rssm.apply(
+                            wm_params["rssm"], feat, recurrent_state, first,
+                            init_states[0], method=RSSM.gru_step_gated,
+                        )
+                        return recurrent_state, recurrent_state
 
-                _, recurrent_states = jax.lax.scan(
-                    scan_remat(dyn_step_dec),
-                    jnp.zeros((B, recurrent_state_size)),
-                    (feats, is_first),
-                    unroll=scan_unroll_setting(cfg, "dyn"),
-                )
+                    _, recurrent_states = jax.lax.scan(
+                        scan_remat(dyn_step_dec),
+                        jnp.zeros((B, recurrent_state_size)),
+                        (feats, is_first),
+                        unroll=scan_unroll_setting(cfg, "dyn"),
+                    )
             else:
                 emb_proj = rssm.apply(
                     wm_params["rssm"], embedded_obs, method=RSSM.representation_embed_proj
