@@ -1,0 +1,165 @@
+"""Probe: which in-kernel group-softmax/argmax designs can Mosaic lower?
+
+The fused sequence-RSSM kernel must sample a (B, 32 groups, 32 classes)
+one-hot categorical INSIDE the sequential kernel (unimix softmax per group,
+argmax of mixed logits + gumbel, one-hot), while the matmul chain wants the
+flat (B, 1024) layout.  Two candidate designs:
+
+* ``reshape``  — (B, 1024) -> (B, 32, 32) in-kernel reshape + softmax/argmax
+  over the trailing 32.  REJECTED by Mosaic on v5e ("infer-vector-layout:
+  unsupported shape cast", probed 2026-08-01); kept here as a canary for
+  future toolchains.
+* ``segmax``   — reshape-free: per-group max via a 5-round segmented tree of
+  lane rolls (``pltpu.roll``), group-start extraction + broadcast-back via
+  two 0/1 selection matmuls (exact in f32), group sums likewise, and the
+  one-hot as an equality mask normalized by the (tie-count) group sum.
+  softmax(log p_mix) == p_mix, so the straight-through probabilities come
+  for free.
+
+Runs both in a minimal pallas_call on the current default platform and
+diffs against the pure-jax computation. Usage:
+    python benchmarks/probe_mosaic_groupops.py [--cpu] [--variant segmax|reshape]
+"""
+
+import json
+import sys
+import functools
+
+import jax
+
+if "--cpu" in sys.argv:
+    # the axon sitecustomize imports jax before env vars can take effect;
+    # jax.config works as long as no backend is initialized yet (conftest.py)
+    jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+GROUPS = 32
+CLASSES = 32
+N = GROUPS * CLASSES
+
+
+def _kernel_reshape(logits_ref, noise_ref, out_ref, probs_ref, *, unimix: float):
+    l3 = logits_ref[:].reshape(logits_ref.shape[0], GROUPS, CLASSES)
+    p = jax.nn.softmax(l3, -1)
+    p = (1.0 - unimix) * p + unimix / CLASSES
+    mixed = jnp.log(p)
+    n3 = noise_ref[:].reshape(noise_ref.shape[0], GROUPS, CLASSES)
+    idx = jnp.argmax(mixed + n3, -1)
+    hard = (idx[..., None] == jax.lax.broadcasted_iota(jnp.int32, (1, 1, CLASSES), 2)).astype(
+        jnp.float32
+    )
+    out_ref[:] = hard.reshape(out_ref.shape)
+    probs_ref[:] = jax.nn.softmax(mixed, -1).reshape(probs_ref.shape)
+
+
+def _segmax(x):
+    """Position i -> max over lanes [i, i+CLASSES-1] (window never crosses a
+    group boundary AT group-start positions, which are the only ones read)."""
+    n = x.shape[1]
+    s = 1
+    while s < CLASSES:
+        # roll left by s (shift must be non-negative: left-by-s == right-by-(n-s))
+        x = jnp.maximum(x, pltpu.roll(x, shift=n - s, axis=1))
+        s *= 2
+    return x
+
+
+def _kernel_segmax(logits_ref, noise_ref, sel_ref, bcast_ref, out_ref, probs_ref, *, unimix: float):
+    l = logits_ref[:]  # (B, N) f32
+    sel = sel_ref[:]  # (N, GROUPS) 0/1: picks group-start lanes
+    bcast = bcast_ref[:]  # (GROUPS, N) 0/1: broadcasts per-group scalars back
+    gm = jnp.dot(_segmax(l), sel, preferred_element_type=jnp.float32)  # (B, GROUPS)
+    gm = jnp.dot(gm, bcast, preferred_element_type=jnp.float32)  # (B, N), exact copies
+    e = jnp.exp(l - gm)
+    # group sums: e @ (ones per group) == e @ (bcast.T as 0/1 membership)
+    gs = jnp.dot(e, bcast.T, preferred_element_type=jnp.float32)  # (B, GROUPS)
+    gs = jnp.dot(gs, bcast, preferred_element_type=jnp.float32)  # (B, N)
+    p = (1.0 - unimix) * (e / gs) + unimix / CLASSES
+    mixed = jnp.log(p)
+    m2 = mixed + noise_ref[:]
+    gm2 = jnp.dot(_segmax(m2), sel, preferred_element_type=jnp.float32)
+    gm2 = jnp.dot(gm2, bcast, preferred_element_type=jnp.float32)
+    mask = (m2 == gm2).astype(jnp.float32)
+    ties = jnp.dot(mask, bcast.T, preferred_element_type=jnp.float32)
+    ties = jnp.dot(ties, bcast, preferred_element_type=jnp.float32)
+    out_ref[:] = mask / ties
+    # softmax(log p_mix) == p_mix (p_mix sums to 1 per group)
+    probs_ref[:] = p
+
+
+def selection_matrices():
+    sel = np.zeros((N, GROUPS), np.float32)
+    for g in range(GROUPS):
+        sel[g * CLASSES, g] = 1.0
+    bcast = np.zeros((GROUPS, N), np.float32)
+    for g in range(GROUPS):
+        bcast[g, g * CLASSES : (g + 1) * CLASSES] = 1.0
+    return jnp.asarray(sel), jnp.asarray(bcast)
+
+
+def main():
+    B = 16
+    variant = "segmax"
+    for i, a in enumerate(sys.argv):
+        if a == "--variant":
+            variant = sys.argv[i + 1]
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.normal(scale=2.0, size=(B, N)), jnp.float32)
+    noise = jnp.asarray(rng.gumbel(size=(B, N)), jnp.float32)
+    interpret = jax.default_backend() != "tpu"
+
+    out_shape = (
+        jax.ShapeDtypeStruct((B, N), jnp.float32),
+        jax.ShapeDtypeStruct((B, N), jnp.float32),
+    )
+    if variant == "reshape":
+        fn = pl.pallas_call(
+            functools.partial(_kernel_reshape, unimix=0.01), out_shape=out_shape, interpret=interpret
+        )
+        args = (logits, noise)
+    else:
+        sel, bcast = selection_matrices()
+        fn = pl.pallas_call(
+            functools.partial(_kernel_segmax, unimix=0.01), out_shape=out_shape, interpret=interpret
+        )
+        args = (logits, noise, sel, bcast)
+
+    try:
+        hard, probs = jax.jit(fn)(*args)
+        hard.block_until_ready()
+    except Exception as e:  # noqa: BLE001 - report any lowering failure
+        with open("/tmp/probe_mosaic_full_error.log", "w") as f:
+            f.write(str(e))
+        print(json.dumps({"ok": False, "variant": variant, "backend": jax.default_backend(), "error": str(e)[:500]}))
+        sys.exit(1)
+
+    # pure-jax reference
+    l3 = logits.reshape(B, GROUPS, CLASSES)
+    p = jax.nn.softmax(l3, -1)
+    p = 0.99 * p + 0.01 / CLASSES
+    mixed = jnp.log(p)
+    ref_hard = jax.nn.one_hot(jnp.argmax(mixed + noise.reshape(B, GROUPS, CLASSES), -1), CLASSES)
+    ref_probs = jax.nn.softmax(mixed, -1)
+    out = {
+        "ok": bool(
+            jnp.allclose(hard.reshape(B, GROUPS, CLASSES), ref_hard, atol=1e-6)
+            and jnp.allclose(probs.reshape(B, GROUPS, CLASSES), ref_probs, atol=1e-5)
+        ),
+        "variant": variant,
+        "backend": jax.default_backend(),
+        "interpret": interpret,
+        "max_prob_err": float(jnp.abs(probs.reshape(B, GROUPS, CLASSES) - ref_probs).max()),
+        "hard_mismatch_rows": int(
+            (jnp.abs(hard.reshape(B, GROUPS, CLASSES) - ref_hard) > 1e-6).any(-1).any(-1).sum()
+        ),
+    }
+    print(json.dumps(out))
+    sys.exit(0 if out["ok"] else 1)
+
+
+if __name__ == "__main__":
+    main()
