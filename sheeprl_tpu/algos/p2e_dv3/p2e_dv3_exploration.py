@@ -40,6 +40,11 @@ from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.feed import batched_feed
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
 from sheeprl_tpu.envs.wrappers import RestartOnException
+from sheeprl_tpu.ops.dyn_bptt import (
+    dyn_rssm_sequence,
+    extract_dyn_params,
+    rssm_dyn_bptt_eligible,
+)
 from sheeprl_tpu.utils.callback import CheckpointCallback, load_checkpoint, restore_buffer
 from sheeprl_tpu.utils.distribution import (
     BernoulliSafeMode,
@@ -89,6 +94,11 @@ def make_train_fn(
     weights_sum = sum(c["weight"] for c in critics_cfg.values())
 
     rssm = world_model.rssm
+    # efficient-BPTT dynamic scan (see dreamer_v3.py / ops/dyn_bptt.py)
+    dyn_bptt = bool(cfg.algo.world_model.get("dyn_bptt", False))
+    if os.environ.get("SHEEPRL_DYN_BPTT") is not None:
+        dyn_bptt = os.environ["SHEEPRL_DYN_BPTT"].lower() not in ("0", "false")
+    dyn_bptt = dyn_bptt and rssm_dyn_bptt_eligible(rssm)
 
     def _update_moments(state, x):
         return update_moments(
@@ -227,26 +237,46 @@ def make_train_fn(
                     wm_params["rssm"], embedded_obs, method=RSSM.representation_embed_proj
                 )
 
-                def dyn_step(carry, inp):
-                    posterior, recurrent_state = carry
-                    action, emb, first, nq_t = inp
-                    recurrent_state, posterior, posterior_logits = rssm.apply(
-                        wm_params["rssm"], posterior, recurrent_state, action, emb, first,
-                        init_states, noise=nq_t, method=RSSM.dynamic_posterior,
+                if dyn_bptt:
+                    recurrent_states, zst_, posteriors_logits = dyn_rssm_sequence(
+                        jnp.zeros((B, stochastic_size * discrete_size)),
+                        jnp.zeros((B, recurrent_state_size)),
+                        batch_actions,
+                        emb_proj,
+                        is_first,
+                        dyn_noise_q,
+                        init_states[0],
+                        init_states[1],
+                        extract_dyn_params(wm_params["rssm"], recurrent_state_size),
+                        eps_proj=rssm.eps,
+                        eps_rep=rssm.eps,
+                        unimix=rssm.unimix,
+                        discrete=discrete_size,
+                        matmul_dtype=rssm.dtype,
+                        unroll=scan_unroll_setting(cfg, "dyn"),
                     )
-                    return (posterior, recurrent_state), (
-                        recurrent_state, posterior, posterior_logits,
-                    )
+                    posteriors = zst_.reshape(T, B, stochastic_size, discrete_size)
+                else:
+                    def dyn_step(carry, inp):
+                        posterior, recurrent_state = carry
+                        action, emb, first, nq_t = inp
+                        recurrent_state, posterior, posterior_logits = rssm.apply(
+                            wm_params["rssm"], posterior, recurrent_state, action, emb, first,
+                            init_states, noise=nq_t, method=RSSM.dynamic_posterior,
+                        )
+                        return (posterior, recurrent_state), (
+                            recurrent_state, posterior, posterior_logits,
+                        )
 
-                init = (
-                    jnp.zeros((B, stochastic_size, discrete_size)),
-                    jnp.zeros((B, recurrent_state_size)),
-                )
-                _, (recurrent_states, posteriors, posteriors_logits) = jax.lax.scan(
-                    scan_remat(dyn_step),
-                    init, (batch_actions, emb_proj, is_first, dyn_noise_q),
-                    unroll=scan_unroll_setting(cfg, "dyn"),
-                )
+                    init = (
+                        jnp.zeros((B, stochastic_size, discrete_size)),
+                        jnp.zeros((B, recurrent_state_size)),
+                    )
+                    _, (recurrent_states, posteriors, posteriors_logits) = jax.lax.scan(
+                        scan_remat(dyn_step),
+                        init, (batch_actions, emb_proj, is_first, dyn_noise_q),
+                        unroll=scan_unroll_setting(cfg, "dyn"),
+                    )
             # prior logits for the KL, batched over the stacked recurrent
             # states (the prior SAMPLE is unused by the world-model loss)
             priors_logits, _ = rssm.apply(

@@ -1,0 +1,460 @@
+"""Efficient-BPTT custom VJP for the non-decoupled DV3 dynamic scan.
+
+The default DV3 world-model recurrence (this repo's
+``RSSM.dynamic_posterior``; reference sheeprl dreamer_v3.py:113-146 +
+RSSM.dynamic agent.py:396) interleaves posterior sampling with the GRU:
+
+    feat   = silu(LN_p([z_{t-1}, a_t] @ Wp))          # input projection
+    h_t    = LayerNormGRU(h_{t-1}, feat)              # Hafner GRU
+    logits = head(silu(LN_r(h_t @ k_h + emb_proj_t))) # representation model
+    z_t    = ST-sample(unimix(logits) + gumbel)       # posterior
+
+Autodiff-through-``lax.scan`` puts FOUR weight-gradient accumulators
+(Wp, Wg, k_h, head — ~4.5 MB f32 at DV3-S) into the backward while-loop's
+carry: every reverse iteration reads and writes them all (~9 MB of HBM
+round-trip per step, ~0.6 ms of the 15.9 ms DV3-S train step) on top of
+the serial matmuls.  A Pallas whole-sequence forward kernel does NOT help
+here — measured on the v5e, one-kernel grid=(T,) recurrences are
+launch-overhead-bound and lose to XLA's while loop
+(benchmarks/results/seq_gru_tpu_r4.json: 4.10 ms vs 3.85 ms fwd at
+T=64/B=16/H=512) — but the backward is fixable in pure JAX:
+
+* the forward stays an XLA ``lax.scan`` (already latency-optimal), saving
+  only the carried states (hs, zs) — no per-step residual stacking;
+* the backward recomputes every activation, LayerNorm statistic and gate
+  from the saved states in batched (T*B) matmuls, then runs a reverse
+  ``lax.scan`` whose carry is ONLY (dh, dz): four small matmuls per step
+  (head/rep/GRU/projection transposes) and elementwise chain rules;
+* every weight gradient is a single batched contraction over stacked
+  reverse-scan outputs, OUTSIDE the sequential loop.
+
+Same structure as ``ops/seq_gru.py``'s VJP (the decoupled case), extended
+with the straight-through/unimix sampling chain: the internal carry
+gradient d(z_t) from step t+1's projection flows through softmax(mixed_t)
+(the ST estimator), the unimix log-mix, and the representation head into
+h_t — exactly what autodiff-through-scan computes.
+
+Numerics: matmuls run in the caller's compute dtype with f32 LayerNorms,
+mirroring ``linear_ln_act_apply``/``gru_cell_apply``; all backward
+cotangent arithmetic is f32 (autodiff would carry bf16 cotangents through
+bf16 segments — the f32 choice is strictly more precise; grads match
+autodiff exactly in f32 and to bf16 tolerance under bf16-mixed, pinned by
+``tests/test_parallel/test_dyn_bptt.py``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "DynParams",
+    "dyn_rssm_sequence",
+    "extract_dyn_params",
+    "rssm_dyn_bptt_eligible",
+]
+
+
+class DynParams(NamedTuple):
+    """Raw weight leaves of the fused dynamic step (flax param layout).
+
+    w_proj (S+A, P)    recurrent model input projection (Dense, no bias)
+    lnp_*  (P,)        its LayerNorm (eps = RSSM.eps)
+    w_gru  (H+P, 3H)   LayerNormGRUCell dense (no bias)
+    lng_*  (3H,)       its LayerNorm (eps 1e-6)
+    k_h    (H, R)      representation trunk, h-side rows of the first Dense
+    lnr_*  (R,)        representation trunk LayerNorm (eps = RSSM.eps)
+    head_k (R, S) / head_b (S,)   logits head (f32 matmul)
+    """
+
+    w_proj: jax.Array
+    lnp_scale: jax.Array
+    lnp_bias: jax.Array
+    w_gru: jax.Array
+    lng_scale: jax.Array
+    lng_bias: jax.Array
+    k_h: jax.Array
+    lnr_scale: jax.Array
+    lnr_bias: jax.Array
+    head_k: jax.Array
+    head_b: jax.Array
+
+
+def _ln_fwd(x32, scale, bias, eps):
+    """flax fast-variance LayerNorm in f32; returns (out, xhat, inv)."""
+    mu = x32.mean(-1, keepdims=True)
+    var = jnp.maximum((x32 * x32).mean(-1, keepdims=True) - mu * mu, 0.0)
+    inv = jax.lax.rsqrt(var + eps)
+    xhat = (x32 - mu) * inv
+    return xhat * scale + bias, xhat, inv
+
+
+def _ln_bwd(dy, scale, xhat, inv):
+    """Cotangent of the LN input given d(out); scale/bias grads batch outside."""
+    dxhat = dy * scale
+    return inv * (
+        dxhat
+        - dxhat.mean(-1, keepdims=True)
+        - xhat * (dxhat * xhat).mean(-1, keepdims=True)
+    )
+
+
+def _silu_grad(v):
+    s = jax.nn.sigmoid(v)
+    return s * (1.0 + v * (1.0 - s))
+
+
+def _group_softmax(x, groups, classes):
+    return jax.nn.softmax(x.reshape(*x.shape[:-1], groups, classes), -1)
+
+
+@functools.lru_cache(maxsize=8)
+def _get_op(eps_p: float, eps_r: float, unimix: float, discrete: int, dt_name: str, unroll: int):
+    dt = jnp.dtype(dt_name)
+    f32 = jnp.float32
+
+    def _step_fwd(params: DynParams, init_rec, init_post, carry, inp):
+        """One dynamic step, numerics-identical to RSSM.dynamic_posterior."""
+        z, h = carry
+        a, emb, f, n = inp
+        keep = 1.0 - f
+        a_eff = keep * a
+        hg = keep * h + f * init_rec
+        zg = keep * z + f * init_post
+
+        fpre = jnp.concatenate([zg, a_eff], -1).astype(dt) @ params.w_proj.astype(dt)
+        lnp, _, _ = _ln_fwd(fpre.astype(f32), params.lnp_scale, params.lnp_bias, eps_p)
+        feat = jax.nn.silu(lnp.astype(dt))
+
+        gpre = jnp.concatenate([hg.astype(dt), feat], -1) @ params.w_gru.astype(dt)
+        parts, _, _ = _ln_fwd(gpre.astype(f32), params.lng_scale, params.lng_bias, 1e-6)
+        hidden = h.shape[-1]
+        reset = jax.nn.sigmoid(parts[..., :hidden])
+        cand = jnp.tanh(reset * parts[..., hidden : 2 * hidden])
+        update = jax.nn.sigmoid(parts[..., 2 * hidden :] - 1.0)
+        h_new = update * cand + (1.0 - update) * hg
+
+        xpre = h_new.astype(dt) @ params.k_h.astype(dt) + emb
+        lnr, _, _ = _ln_fwd(xpre.astype(f32), params.lnr_scale, params.lnr_bias, eps_r)
+        x = jax.nn.silu(lnr.astype(dt))
+        logits = x.astype(f32) @ params.head_k + params.head_b
+
+        groups = logits.shape[-1] // discrete
+        pr = _group_softmax(logits, groups, discrete)
+        pm = (1.0 - unimix) * pr + unimix / discrete
+        mixed = jnp.log(pm)
+        hard = jax.nn.one_hot(
+            jnp.argmax(mixed + n.reshape(mixed.shape), -1), discrete, dtype=f32
+        )
+        z_new = hard.reshape(z.shape)
+        return (z_new, h_new), (h_new, z_new, mixed.reshape(z.shape))
+
+    def _fwd_scan(z0, h0, actions, emb_proj, is_first, noise, init_rec, init_post, params):
+        step = functools.partial(_step_fwd, params, init_rec, init_post)
+        _, (hs, zs, mixed) = jax.lax.scan(
+            step, (z0, h0), (actions, emb_proj, is_first, noise), unroll=unroll
+        )
+        return hs, zs, mixed
+
+    @jax.custom_vjp
+    def op(z0, h0, actions, emb_proj, is_first, noise, init_rec, init_post, params):
+        return _fwd_scan(z0, h0, actions, emb_proj, is_first, noise, init_rec, init_post, params)
+
+    def op_fwd(z0, h0, actions, emb_proj, is_first, noise, init_rec, init_post, params):
+        hs, zs, mixed = _fwd_scan(
+            z0, h0, actions, emb_proj, is_first, noise, init_rec, init_post, params
+        )
+        return (hs, zs, mixed), (
+            z0,
+            h0,
+            actions,
+            emb_proj,
+            is_first,
+            noise,
+            init_rec,
+            init_post,
+            params,
+            hs,
+            zs,
+        )
+
+    def op_bwd(res, cots):
+        z0, h0, actions, emb_proj, is_first, noise, init_rec, init_post, params, hs, zs = res
+        d_hs, d_zs, d_mixed = cots
+        T, b = hs.shape[:2]
+        hidden = h0.shape[-1]
+        stoch = z0.shape[-1]
+        groups = stoch // discrete
+
+        # ---- batched recompute of every step's activations from the saved
+        # states (one (T*B) matmul per layer, nothing sequential)
+        f = is_first.astype(f32)
+        keep = 1.0 - f
+        z_prev = jnp.concatenate([z0[None], zs[:-1]], 0)
+        h_prev = jnp.concatenate([h0[None], hs[:-1]], 0)
+        a_eff = keep * actions
+        hg = keep * h_prev + f * init_rec
+        zg = keep * z_prev + f * init_post
+
+        inp_p32 = jnp.concatenate([zg, a_eff], -1)
+        fpre = (inp_p32.astype(dt) @ params.w_proj.astype(dt)).astype(f32)
+        lnp, xhat_p, inv_p = _ln_fwd(fpre, params.lnp_scale, params.lnp_bias, eps_p)
+        lnp_dt = lnp.astype(dt)
+        feat = jax.nn.silu(lnp_dt)
+
+        g_in32 = jnp.concatenate([hg, feat.astype(f32)], -1)
+        gpre = (g_in32.astype(dt) @ params.w_gru.astype(dt)).astype(f32)
+        parts, xhat_g, inv_g = _ln_fwd(gpre, params.lng_scale, params.lng_bias, 1e-6)
+        reset = jax.nn.sigmoid(parts[..., :hidden])
+        p2 = parts[..., hidden : 2 * hidden]
+        cand = jnp.tanh(reset * p2)
+        update = jax.nn.sigmoid(parts[..., 2 * hidden :] - 1.0)
+
+        xpre = (hs.astype(dt) @ params.k_h.astype(dt) + emb_proj).astype(f32)
+        lnr, xhat_r, inv_r = _ln_fwd(xpre, params.lnr_scale, params.lnr_bias, eps_r)
+        lnr_dt = lnr.astype(dt)
+        x32 = jax.nn.silu(lnr_dt).astype(f32)
+        logits = x32 @ params.head_k + params.head_b
+        pr = _group_softmax(logits, groups, discrete)
+        pm = (1.0 - unimix) * pr + unimix / discrete
+        mixed = jnp.log(pm)
+        p_st = jax.nn.softmax(mixed, -1)  # softmax(log pm): fp-faithful to fwd
+
+        w_gru_h = params.w_gru[:hidden].astype(f32)
+        w_gru_x = params.w_gru[hidden:].astype(f32)
+        w_proj_z = params.w_proj[:stoch].astype(f32)
+        k_h32 = params.k_h.astype(f32)
+        head_k32 = params.head_k.astype(f32)
+
+        def back_step(carry, inp_t):
+            dh_c, dz_c = carry
+            (
+                d_hs_t,
+                d_zs_t,
+                d_mixed_t,
+                f_t,
+                p_st_t,
+                pm_t,
+                pr_t,
+                x32_t,
+                lnr_dt_t,
+                xhat_r_t,
+                inv_r_t,
+                hg_t,
+                cand_t,
+                update_t,
+                reset_t,
+                p2_t,
+                xhat_g_t,
+                inv_g_t,
+                lnp_dt_t,
+                xhat_p_t,
+                inv_p_t,
+            ) = inp_t
+            keep_t = 1.0 - f_t
+
+            # straight-through + unimix backward into the logits
+            dz3 = (d_zs_t + dz_c).reshape(-1, groups, discrete)
+            dmx = p_st_t * (dz3 - (dz3 * p_st_t).sum(-1, keepdims=True))
+            dmx = dmx + d_mixed_t.reshape(dmx.shape)
+            dpm = dmx / pm_t
+            dpr = (1.0 - unimix) * dpm
+            dlogits = (pr_t * (dpr - (dpr * pr_t).sum(-1, keepdims=True))).reshape(
+                -1, groups * discrete
+            )
+
+            # representation head + trunk backward
+            dx32 = dlogits @ head_k32.T
+            dlnr = dx32 * _silu_grad(lnr_dt_t.astype(f32))
+            dxpre = _ln_bwd(dlnr, params.lnr_scale, xhat_r_t, inv_r_t)
+            dh_rep = dxpre @ k_h32.T
+
+            # GRU backward (gated carry hg)
+            dh_tot = d_hs_t + dh_c + dh_rep
+            du = (cand_t - hg_t) * dh_tot
+            dcand = update_t * dh_tot
+            dhg = (1.0 - update_t) * dh_tot
+            dp3 = du * update_t * (1.0 - update_t)
+            dtanh = dcand * (1.0 - cand_t * cand_t)
+            dp2 = dtanh * reset_t
+            dreset = dtanh * p2_t
+            dp1 = dreset * reset_t * (1.0 - reset_t)
+            dparts = jnp.concatenate([dp1, dp2, dp3], -1)
+            dgpre = _ln_bwd(dparts, params.lng_scale, xhat_g_t, inv_g_t)
+            dhg = dhg + dgpre @ w_gru_h.T
+            dfeat = dgpre @ w_gru_x.T
+
+            # input projection backward
+            dlnp = dfeat * _silu_grad(lnp_dt_t.astype(f32))
+            dfpre = _ln_bwd(dlnp, params.lnp_scale, xhat_p_t, inv_p_t)
+            dzg = dfpre @ w_proj_z.T
+
+            dh_prev = keep_t * dhg
+            dz_prev = keep_t * dzg
+            return (dh_prev, dz_prev), (dlogits, dxpre, dparts, dgpre, dfpre, dhg, dzg, dh_tot)
+
+        seq = (
+            d_hs.astype(f32),
+            d_zs.astype(f32).reshape(T, b, stoch),
+            d_mixed.astype(f32),
+            f,
+            p_st,
+            pm,
+            pr,
+            x32,
+            lnr_dt,
+            xhat_r,
+            inv_r,
+            hg,
+            cand,
+            update,
+            reset,
+            p2,
+            xhat_g,
+            inv_g,
+            lnp_dt,
+            xhat_p,
+            inv_p,
+        )
+        (dh0, dz0), (dlogits, dxpre, dparts, dgpre, dfpre, dhgs, dzgs, dh_tots) = jax.lax.scan(
+            back_step,
+            (jnp.zeros_like(h0, f32), jnp.zeros_like(z0, f32)),
+            seq,
+            reverse=True,
+            unroll=unroll,
+        )
+
+        # ---- weight gradients: one batched contraction each
+        n_r = params.k_h.shape[-1]
+        x32f = x32.reshape(T * b, n_r)
+        dlogf = dlogits.reshape(T * b, stoch)
+        dxpref = dxpre.reshape(T * b, n_r)
+        # LN scale/bias grads need the pre-LN-input cotangents dlnr/dlnp
+        dlnr_full = (dlogits @ head_k32.T) * _silu_grad(lnr_dt.astype(f32))
+        dlnp_full = (dgpre @ w_gru_x.T) * _silu_grad(lnp_dt.astype(f32))
+
+        grads = DynParams(
+            w_proj=(inp_p32.reshape(T * b, -1).T @ dfpre.reshape(T * b, -1)).astype(
+                params.w_proj.dtype
+            ),
+            lnp_scale=(dlnp_full * xhat_p).sum((0, 1)),
+            lnp_bias=dlnp_full.sum((0, 1)),
+            w_gru=(g_in32.reshape(T * b, -1).T @ dgpre.reshape(T * b, -1)).astype(
+                params.w_gru.dtype
+            ),
+            lng_scale=(dparts * xhat_g).sum((0, 1)),
+            lng_bias=dparts.sum((0, 1)),
+            k_h=(hs.reshape(T * b, hidden).T @ dxpref).astype(params.k_h.dtype),
+            lnr_scale=(dlnr_full * xhat_r).sum((0, 1)),
+            lnr_bias=dlnr_full.sum((0, 1)),
+            head_k=(x32f.T @ dlogf).astype(params.head_k.dtype),
+            head_b=dlogf.sum(0).astype(params.head_b.dtype),
+        )
+        d_actions = (keep * (dfpre @ params.w_proj[stoch:].astype(f32).T)).astype(actions.dtype)
+        d_emb = dxpre.astype(emb_proj.dtype)
+        d_init_rec = (f * dhgs).sum(0).astype(init_rec.dtype)
+        d_init_post = (f * dzgs).sum(0).astype(init_post.dtype)
+        return (
+            dz0.astype(z0.dtype),
+            dh0.astype(h0.dtype),
+            d_actions,
+            d_emb,
+            jnp.zeros_like(is_first),
+            jnp.zeros_like(noise),
+            d_init_rec,
+            d_init_post,
+            grads,
+        )
+
+    op.defvjp(op_fwd, op_bwd)
+    return op
+
+
+def rssm_dyn_bptt_eligible(rssm) -> bool:
+    """Does this RSSM's configuration match the op's closed-form backward?
+
+    Requires the non-decoupled posterior, LayerNorm blocks (no Dense
+    biases), silu activations (the backward hard-codes silu'), unimix > 0,
+    and the plain (non-Pallas) GRU cell so the fwd numerics are the
+    reference scan's."""
+    return (
+        not rssm.decoupled
+        and rssm.layer_norm
+        and rssm.unimix > 0.0
+        and rssm.act == "silu"
+        and not rssm.fused_gru
+    )
+
+
+def extract_dyn_params(rssm_variables, hidden: int) -> DynParams:
+    """Pull the op's raw weight leaves out of a bound RSSM param tree
+    (``wm_params["rssm"]``). Plain dict indexing/slicing, so autodiff
+    routes the op's weight cotangents back into the original tree
+    (including the h-side rows of the representation model's first Dense —
+    the embed-side rows get their gradient through the
+    ``representation_embed_proj`` path)."""
+    p = rssm_variables["params"]
+    lin = p["recurrent_model"]["LinearLnAct_0"]
+    gru = p["recurrent_model"]["LayerNormGRUCell_0"]
+    rep_lin = p["representation_model"]["LinearLnAct_0"]
+    head = p["representation_model"]["Dense_0"]
+    return DynParams(
+        w_proj=lin["Dense_0"]["kernel"],
+        lnp_scale=lin["LayerNorm_0"]["scale"],
+        lnp_bias=lin["LayerNorm_0"]["bias"],
+        w_gru=gru["Dense_0"]["kernel"],
+        lng_scale=gru["LayerNorm_0"]["scale"],
+        lng_bias=gru["LayerNorm_0"]["bias"],
+        k_h=rep_lin["Dense_0"]["kernel"][:hidden],
+        lnr_scale=rep_lin["LayerNorm_0"]["scale"],
+        lnr_bias=rep_lin["LayerNorm_0"]["bias"],
+        head_k=head["kernel"],
+        head_b=head["bias"],
+    )
+
+
+def dyn_rssm_sequence(
+    z0,
+    h0,
+    actions,
+    emb_proj,
+    is_first,
+    noise,
+    init_rec,
+    init_post,
+    params: DynParams,
+    *,
+    eps_proj: float = 1e-3,
+    eps_rep: float = 1e-3,
+    unimix: float = 0.01,
+    discrete: int = 32,
+    matmul_dtype=jnp.float32,
+    unroll: int = 1,
+):
+    """Run the full T-step dynamic recurrence with the efficient-BPTT VJP.
+
+    z0 (B, S) f32 flat posterior; h0 (B, H); actions (T, B, A) f32
+    (UNgated — the is_first gating happens inside); emb_proj (T, B, R) in
+    the compute dtype (embed-side projection incl. any bias,
+    ``RSSM.representation_embed_proj``); is_first (T, B, 1); noise
+    (T, B, groups, discrete) pre-drawn gumbel; init_rec (B, H) /
+    init_post (B, S) from ``RSSM.get_initial_states``.
+
+    Returns (hs (T,B,H) f32, z_st (T,B,S) f32, mixed_logits (T,B,S) f32);
+    ``z_st``'s forward value is the hard one-hot sample and its gradient is
+    the straight-through estimator, exactly like scanning
+    ``RSSM.dynamic_posterior``.
+    """
+    op = _get_op(
+        float(eps_proj),
+        float(eps_rep),
+        float(unimix),
+        int(discrete),
+        jnp.dtype(matmul_dtype).name,
+        int(unroll),
+    )
+    noise = noise.reshape(*noise.shape[:2], -1)
+    return op(z0, h0, actions, emb_proj, is_first, noise, init_rec, init_post, params)

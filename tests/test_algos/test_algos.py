@@ -319,6 +319,20 @@ def test_dreamer_v3_fused_gru(standard_args, tmp_path):
     _run(args)
 
 
+def test_dreamer_v3_dyn_bptt(standard_args, devices, tmp_path):
+    """End-to-end with the efficient-BPTT dynamic scan (ops/dyn_bptt.py)."""
+    args = standard_args + _dv3_tiny_args() + [
+        "exp=dreamer_v3",
+        "env=dummy",
+        "algo.mlp_keys.encoder=[state]",
+        "algo.cnn_keys.encoder=[rgb]",
+        "algo.world_model.dyn_bptt=True",
+        f"fabric.devices={devices}",
+        f"root_dir={tmp_path}/dv3b",
+    ]
+    _run(args)
+
+
 def test_dreamer_v3_continuous(standard_args, tmp_path):
     args = standard_args + _dv3_tiny_args() + [
         "exp=dreamer_v3",

@@ -1,0 +1,230 @@
+"""Efficient-BPTT dynamic scan (ops/dyn_bptt.py) vs the production
+``RSSM.dynamic_posterior`` lax.scan: forward outputs and full-pipeline
+gradients (params incl. init states + embedded obs) must match."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.algos.dreamer_v3.agent import RSSM
+from sheeprl_tpu.ops.dyn_bptt import DynParams, dyn_rssm_sequence
+
+T, B = 7, 3
+H, P, R, E, A = 32, 16, 24, 20, 5
+STOCH, DISC = 4, 8
+S = STOCH * DISC
+EPS = 1e-3
+UNIMIX = 0.01
+
+
+def _rssm(dtype):
+    return RSSM(
+        actions_dim=(A,),
+        embedded_obs_dim=E,
+        recurrent_state_size=H,
+        dense_units=P,
+        stochastic_size=STOCH,
+        discrete_size=DISC,
+        hidden_size=R,
+        unimix=UNIMIX,
+        layer_norm=True,
+        eps=EPS,
+        act="silu",
+        decoupled=False,
+        dtype=dtype,
+    )
+
+
+def _data(seed=0):
+    rng = np.random.default_rng(seed)
+    actions = jnp.asarray(rng.normal(size=(T, B, A)), jnp.float32)
+    embedded = jnp.asarray(rng.normal(size=(T, B, E)), jnp.float32)
+    is_first = jnp.asarray(rng.integers(0, 2, size=(T, B, 1)), jnp.float32)
+    is_first = is_first.at[0].set(1.0)
+    noise = jnp.asarray(rng.gumbel(size=(T, B, STOCH, DISC)), jnp.float32)
+    return actions, embedded, is_first, noise
+
+
+def _init_params(rssm):
+    k = jax.random.PRNGKey(0)
+    return rssm.init(
+        k,
+        jnp.zeros((B, STOCH, DISC)),
+        jnp.zeros((B, H)),
+        jnp.zeros((B, A)),
+        jnp.zeros((B, E)),
+        jnp.zeros((B, 1)),
+        jax.random.PRNGKey(1),
+        method=RSSM.init_all,
+    )
+
+
+def _pipeline_ref(rssm, params, actions, embedded, is_first, noise, unroll=1):
+    """Mirror of the dreamer_v3.py non-decoupled wm scan."""
+    init_states = rssm.apply(params, (B,), method=RSSM.get_initial_states)
+    init_states = (init_states[0], init_states[1].reshape(B, -1))
+    emb_proj = rssm.apply(params, embedded, method=RSSM.representation_embed_proj)
+
+    def dyn_step(carry, inp):
+        posterior, recurrent_state = carry
+        action, emb, first, nq_t = inp
+        recurrent_state, posterior, posterior_logits = rssm.apply(
+            params,
+            posterior,
+            recurrent_state,
+            action,
+            emb,
+            first,
+            init_states,
+            noise=nq_t,
+            method=RSSM.dynamic_posterior,
+        )
+        return (posterior, recurrent_state), (recurrent_state, posterior, posterior_logits)
+
+    init = (jnp.zeros((B, STOCH, DISC)), jnp.zeros((B, H)))
+    _, (hs, posts, logits) = jax.lax.scan(
+        dyn_step, init, (actions, emb_proj, is_first, noise), unroll=unroll
+    )
+    return hs, posts.reshape(T, B, S), logits
+
+
+def _pipeline_bptt(rssm, params, actions, embedded, is_first, noise, dtype, unroll=1):
+    init_states = rssm.apply(params, (B,), method=RSSM.get_initial_states)
+    emb_proj = rssm.apply(params, embedded, method=RSSM.representation_embed_proj)
+    p = params["params"]
+    lin = p["recurrent_model"]["LinearLnAct_0"]
+    gru = p["recurrent_model"]["LayerNormGRUCell_0"]
+    rep_lin = p["representation_model"]["LinearLnAct_0"]
+    head = p["representation_model"]["Dense_0"]
+    dyn_params = DynParams(
+        w_proj=lin["Dense_0"]["kernel"],
+        lnp_scale=lin["LayerNorm_0"]["scale"],
+        lnp_bias=lin["LayerNorm_0"]["bias"],
+        w_gru=gru["Dense_0"]["kernel"],
+        lng_scale=gru["LayerNorm_0"]["scale"],
+        lng_bias=gru["LayerNorm_0"]["bias"],
+        k_h=rep_lin["Dense_0"]["kernel"][:H],
+        lnr_scale=rep_lin["LayerNorm_0"]["scale"],
+        lnr_bias=rep_lin["LayerNorm_0"]["bias"],
+        head_k=head["kernel"],
+        head_b=head["bias"],
+    )
+    hs, z_st, logits = dyn_rssm_sequence(
+        jnp.zeros((B, S)),
+        jnp.zeros((B, H)),
+        actions,
+        emb_proj,
+        is_first,
+        noise,
+        init_states[0],
+        init_states[1].reshape(B, -1),
+        dyn_params,
+        eps_proj=EPS,
+        eps_rep=EPS,
+        unimix=UNIMIX,
+        discrete=DISC,
+        matmul_dtype=dtype,
+        unroll=unroll,
+    )
+    return hs, z_st, logits
+
+
+def _loss(outs, ws):
+    hs, z, logits = outs
+    return (hs * ws[0]).sum() + (z.reshape(T, B, S) * ws[1]).sum() + (logits * ws[2]).sum()
+
+
+@pytest.mark.parametrize("unroll", [1, 2])
+def test_forward_matches_scan(unroll):
+    rssm = _rssm(jnp.float32)
+    params = _init_params(rssm)
+    actions, embedded, is_first, noise = _data()
+    ref = _pipeline_ref(rssm, params, actions, embedded, is_first, noise, unroll=1)
+    got = _pipeline_bptt(rssm, params, actions, embedded, is_first, noise, jnp.float32, unroll)
+    np.testing.assert_allclose(got[0], ref[0], atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(got[1], ref[1], atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(got[2], ref[2], atol=1e-5, rtol=1e-5)
+    # hard samples are one-hot and identical
+    assert np.allclose(np.asarray(got[1]).sum(-1), STOCH)
+
+
+def test_grads_match_scan_f32():
+    rssm = _rssm(jnp.float32)
+    params = _init_params(rssm)
+    actions, embedded, is_first, noise = _data(1)
+    rng = np.random.default_rng(7)
+    ws = [
+        jnp.asarray(rng.normal(size=(T, B, H)), jnp.float32),
+        jnp.asarray(rng.normal(size=(T, B, S)), jnp.float32),
+        jnp.asarray(rng.normal(size=(T, B, S)), jnp.float32),
+    ]
+
+    def f_ref(params, embedded, actions):
+        return _loss(_pipeline_ref(rssm, params, actions, embedded, is_first, noise), ws)
+
+    def f_bptt(params, embedded, actions):
+        return _loss(
+            _pipeline_bptt(rssm, params, actions, embedded, is_first, noise, jnp.float32), ws
+        )
+
+    v_ref, g_ref = jax.value_and_grad(f_ref, argnums=(0, 1, 2))(params, embedded, actions)
+    v_got, g_got = jax.value_and_grad(f_bptt, argnums=(0, 1, 2))(params, embedded, actions)
+    np.testing.assert_allclose(v_got, v_ref, rtol=1e-5)
+
+    flat_ref, _ = jax.tree_util.tree_flatten_with_path(g_ref)
+    flat_got, _ = jax.tree_util.tree_flatten_with_path(g_got)
+    assert len(flat_ref) == len(flat_got)
+    for (path_r, leaf_r), (path_g, leaf_g) in zip(flat_ref, flat_got):
+        assert path_r == path_g
+        path_s = jax.tree_util.keystr(path_r)
+        if "transition_model" in path_s:
+            # the op never touches the prior/transition model
+            continue
+        scale = max(1e-6, float(np.abs(leaf_r).max()))
+        np.testing.assert_allclose(
+            np.asarray(leaf_g, np.float64) / scale,
+            np.asarray(leaf_r, np.float64) / scale,
+            atol=5e-5,
+            err_msg=path_s,
+        )
+
+
+def test_grads_close_bf16():
+    """Under bf16-mixed the op's f32 cotangents may differ from autodiff's
+    bf16 ones by bf16 rounding — require agreement to bf16 tolerance."""
+    rssm = _rssm(jnp.bfloat16)
+    params = _init_params(rssm)
+    actions, embedded, is_first, noise = _data(2)
+    rng = np.random.default_rng(8)
+    ws = [
+        jnp.asarray(rng.normal(size=(T, B, H)), jnp.float32),
+        jnp.asarray(rng.normal(size=(T, B, S)), jnp.float32),
+        jnp.asarray(rng.normal(size=(T, B, S)), jnp.float32),
+    ]
+
+    def f_ref(params):
+        return _loss(_pipeline_ref(rssm, params, actions, embedded, is_first, noise), ws)
+
+    def f_bptt(params):
+        return _loss(
+            _pipeline_bptt(rssm, params, actions, embedded, is_first, noise, jnp.bfloat16), ws
+        )
+
+    v_ref = f_ref(params)
+    v_got = f_bptt(params)
+    np.testing.assert_allclose(float(v_got), float(v_ref), rtol=2e-2)
+    g_ref = jax.grad(f_ref)(params)
+    g_got = jax.grad(f_bptt)(params)
+    for (path, leaf_r), (_, leaf_g) in zip(
+        jax.tree_util.tree_flatten_with_path(g_ref)[0],
+        jax.tree_util.tree_flatten_with_path(g_got)[0],
+    ):
+        path_s = jax.tree_util.keystr(path)
+        if "transition_model" in path_s:
+            continue
+        scale = max(1e-4, float(np.abs(np.asarray(leaf_r, np.float32)).max()))
+        err = np.abs(
+            np.asarray(leaf_g, np.float32) - np.asarray(leaf_r, np.float32)
+        ).max() / scale
+        assert err < 6e-2, f"{path_s}: rel err {err}"
