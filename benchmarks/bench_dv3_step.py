@@ -153,10 +153,18 @@ if __name__ == "__main__":
     ap.add_argument("--precision", default="bf16-mixed")
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--fused", default="both", choices=["both", "true", "false"])
+    ap.add_argument(
+        "--async-chain",
+        action="store_true",
+        help="time chained async dispatches with one trailing sync (the way "
+        "the training CLI runs; hides the remote-link RTT that otherwise "
+        "dominates per-step sync timing on tunneled devices)",
+    )
     args = ap.parse_args()
+    sync = not args.async_chain
     if args.fused in ("false", "both"):
-        base, _, _, _ = time_variant(False, args.precision, args.steps)
+        base, _, _, _ = time_variant(False, args.precision, args.steps, sync_every_step=sync)
     if args.fused in ("true", "both"):
-        fused, _, _, _ = time_variant(True, args.precision, args.steps)
+        fused, _, _, _ = time_variant(True, args.precision, args.steps, sync_every_step=sync)
     if args.fused == "both":
         print(f"speedup fused/unfused: {base / fused:.3f}x")
