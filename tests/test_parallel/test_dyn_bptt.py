@@ -135,6 +135,35 @@ def _loss(outs, ws):
     return (hs * ws[0]).sum() + (z.reshape(T, B, S) * ws[1]).sum() + (logits * ws[2]).sum()
 
 
+def test_default_dv3_config_is_eligible():
+    """The shipped exp=dreamer_v3 defaults must actually route through the
+    op (silu + LayerNorm + unimix 0.01 + plain GRU + coupled RSSM); a
+    config/eligibility drift would silently fall back to the slow scan."""
+    from sheeprl_tpu.config import compose
+    from sheeprl_tpu.ops.dyn_bptt import rssm_dyn_bptt_eligible
+
+    from sheeprl_tpu.algos.dreamer_v3.agent import _ln_enabled
+
+    cfg = compose(overrides=["exp=dreamer_v3", "env=dummy"])
+    assert bool(cfg.algo.world_model.dyn_bptt) is True
+    wm = cfg.algo.world_model
+    # field sources mirror build_agent's RSSM construction (agent.py)
+    rssm = RSSM(
+        actions_dim=(4,),
+        embedded_obs_dim=16,
+        recurrent_state_size=int(wm.recurrent_model.recurrent_state_size),
+        dense_units=int(wm.recurrent_model.dense_units),
+        stochastic_size=int(wm.stochastic_size),
+        discrete_size=int(wm.discrete_size),
+        hidden_size=int(wm.transition_model.hidden_size),
+        unimix=float(cfg.algo.unimix),
+        layer_norm=_ln_enabled(wm.recurrent_model.layer_norm),
+        decoupled=bool(wm.decoupled_rssm),
+        fused_gru=bool(wm.recurrent_model.get("fused", False)),
+    )
+    assert rssm_dyn_bptt_eligible(rssm)
+
+
 @pytest.mark.parametrize("unroll", [1, 2])
 def test_forward_matches_scan(unroll):
     rssm = _rssm(jnp.float32)
