@@ -130,6 +130,46 @@ class RSSM(nn.Module):
             self.transition_model(recurrent_out), key, self.min_std, sample=sample_state, noise=noise
         )
 
+    def representation_embed_proj(self, embedded_obs: jax.Array) -> jax.Array:
+        """Embed-side half (plus bias) of the representation model's first
+        Dense, batched over the whole sequence outside the train scan —
+        keeps the (embed_dim, units) kernel-grad accumulator out of the
+        backward while-loop (same hoist as dreamer_v3/dreamer_v2)."""
+        p = self.representation_model.variables["params"]["DenseActLn_0"]["Dense_0"]
+        k_e = p["kernel"][self.recurrent_state_size:].astype(self.dtype)
+        return embedded_obs.astype(self.dtype) @ k_e + p["bias"].astype(self.dtype)
+
+    def _representation_from_proj(self, emb_proj: jax.Array, recurrent_state: jax.Array, key, noise=None):
+        from sheeprl_tpu.models.models import resolve_activation
+
+        params = self.representation_model.variables["params"]
+        p = params["DenseActLn_0"]["Dense_0"]
+        k_h = p["kernel"][: self.recurrent_state_size].astype(self.dtype)
+        x = recurrent_state.astype(self.dtype) @ k_h + emb_proj
+        x = resolve_activation(self.act)(x.astype(self.dtype))  # V1: no LN
+        head = params["Dense_0"]
+        mean_std = x.astype(jnp.float32) @ head["kernel"] + head["bias"]
+        return compute_stochastic_state(mean_std, key, self.min_std, noise=noise)
+
+    def dynamic_posterior_from_proj(
+        self,
+        posterior: jax.Array,
+        recurrent_state: jax.Array,
+        action: jax.Array,
+        emb_proj: jax.Array,
+        key=None,
+        noise=None,
+    ):
+        """:meth:`dynamic_posterior` with the embed-side product
+        precomputed (see :meth:`representation_embed_proj`)."""
+        recurrent_state = self.recurrent_model(
+            jnp.concatenate([posterior, action], -1), recurrent_state
+        )
+        posterior_mean_std, posterior = self._representation_from_proj(
+            emb_proj, recurrent_state, key, noise=noise
+        )
+        return recurrent_state, posterior, posterior_mean_std
+
     def dynamic(
         self,
         posterior: jax.Array,

@@ -81,13 +81,19 @@ def make_train_fn(runtime, world_model, actor, critic, txs, cfg, is_continuous, 
         # ---------------------------------------------------- world model
         def wm_loss_fn(wm_params):
             embedded_obs = world_model.encoder.apply(wm_params["encoder"], batch_obs)
+            # embed-side product batched over the sequence (see
+            # RSSM.representation_embed_proj) — keeps the (embed_dim, units)
+            # kernel-grad accumulator out of the backward while-loop
+            emb_proj = rssm.apply(
+                wm_params["rssm"], embedded_obs, method=RSSM.representation_embed_proj
+            )
 
             def dyn_step(carry, inp):
                 posterior, recurrent_state = carry
                 action, emb, n_t = inp
                 recurrent_state, posterior, post_ms = rssm.apply(
                     wm_params["rssm"], posterior, recurrent_state, action, emb,
-                    None, noise=n_t, method=RSSM.dynamic_posterior,
+                    None, noise=n_t, method=RSSM.dynamic_posterior_from_proj,
                 )
                 return (posterior, recurrent_state), (
                     recurrent_state, posterior, post_ms[0], post_ms[1],
@@ -98,7 +104,7 @@ def make_train_fn(runtime, world_model, actor, critic, txs, cfg, is_continuous, 
                 jnp.zeros((B, recurrent_state_size)),
             )
             _, (recurrent_states, posteriors, post_means, post_stds) = jax.lax.scan(
-                _remat(dyn_step), init, (data["actions"], embedded_obs, dyn_noise),
+                _remat(dyn_step), init, (data["actions"], emb_proj, dyn_noise),
                 unroll=scan_unroll,
             )
             # prior mean/std for the KL, batched over the stacked recurrent

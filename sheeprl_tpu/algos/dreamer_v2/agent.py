@@ -314,6 +314,74 @@ class RSSM(nn.Module):
             logits, self.discrete_size, key, sample=sample_state, noise=noise
         )
 
+    def representation_embed_proj(self, embedded_obs: jax.Array) -> jax.Array:
+        """Embed-side half of the representation model's first Dense.
+
+        The first DenseActLn of the representation model sees
+        ``[h_t, embed_t]``; splitting its kernel lets the (big) embed-side
+        product — plus the Dense bias — run as ONE batched matmul over the
+        whole sequence outside the train scan, and moves its
+        (embed_dim, units) kernel-gradient accumulation out of the
+        backward while-loop's carry (same argument as the DV3 hoist,
+        dreamer_v3.agent.RSSM.representation_embed_proj)."""
+        p = self.representation_model.variables["params"]["DenseActLn_0"]["Dense_0"]
+        k_e = p["kernel"][self.recurrent_state_size:].astype(self.dtype)
+        return embedded_obs.astype(self.dtype) @ k_e + p["bias"].astype(self.dtype)
+
+    def _representation_from_proj(
+        self,
+        emb_proj: jax.Array,
+        recurrent_state: jax.Array,
+        key: Optional[jax.Array] = None,
+        noise: Optional[jax.Array] = None,
+    ) -> Tuple[jax.Array, jax.Array]:
+        """Posterior from a precomputed embed projection: the scan-body
+        slice of :meth:`_representation` (manually unrolled V2MLP(layers=1)
+        so the h-side product adds onto ``emb_proj``)."""
+        from sheeprl_tpu.models.models import ln_act_apply, resolve_activation
+
+        params = self.representation_model.variables["params"]
+        p = params["DenseActLn_0"]["Dense_0"]
+        k_h = p["kernel"][: self.recurrent_state_size].astype(self.dtype)
+        x = recurrent_state.astype(self.dtype) @ k_h + emb_proj
+        if self.layer_norm:
+            # DenseActLn uses flax LayerNorm defaults (eps 1e-6, f32 stats)
+            x = ln_act_apply(
+                params["DenseActLn_0"]["LayerNorm_0"], x,
+                eps=1e-6, act=self.act, dtype=self.dtype,
+            )
+        else:
+            x = resolve_activation(self.act)(x.astype(self.dtype))
+        head = params["Dense_0"]
+        logits = x.astype(jnp.float32) @ head["kernel"] + head["bias"]
+        return logits, compute_stochastic_state(
+            logits, self.discrete_size, key, noise=noise
+        )
+
+    def dynamic_posterior_from_proj(
+        self,
+        posterior: jax.Array,
+        recurrent_state: jax.Array,
+        action: jax.Array,
+        emb_proj: jax.Array,
+        is_first: jax.Array,
+        key: Optional[jax.Array] = None,
+        noise: Optional[jax.Array] = None,
+    ):
+        """:meth:`dynamic_posterior` with the representation model's
+        embed-side product precomputed (see
+        :meth:`representation_embed_proj`)."""
+        action = (1 - is_first) * action
+        posterior = (1 - is_first) * posterior.reshape(*posterior.shape[:-2], -1)
+        recurrent_state = (1 - is_first) * recurrent_state
+        recurrent_state = self.recurrent_model(
+            jnp.concatenate([posterior, action], -1), recurrent_state
+        )
+        posterior_logits, posterior = self._representation_from_proj(
+            emb_proj, recurrent_state, key, noise=noise
+        )
+        return recurrent_state, posterior, posterior_logits
+
     def dynamic(
         self,
         posterior: jax.Array,

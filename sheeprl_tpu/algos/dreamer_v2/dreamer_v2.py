@@ -111,13 +111,20 @@ def make_train_fn(runtime, world_model, actor, critic, txs, cfg, is_continuous, 
         # ---------------------------------------------------- world model
         def wm_loss_fn(wm_params):
             embedded_obs = world_model.encoder.apply(wm_params["encoder"], batch_obs)
+            # embed half of the representation model's first matmul, batched
+            # over the whole sequence (see RSSM.representation_embed_proj) —
+            # keeps the (embed_dim, units) kernel-grad accumulator out of
+            # the backward while-loop
+            emb_proj = rssm.apply(
+                wm_params["rssm"], embedded_obs, method=RSSM.representation_embed_proj
+            )
 
             def dyn_step(carry, inp):
                 posterior, recurrent_state = carry
                 action, emb, first, nq_t = inp
                 recurrent_state, posterior, posterior_logits = rssm.apply(
                     wm_params["rssm"], posterior, recurrent_state, action, emb, first,
-                    None, noise=nq_t, method=RSSM.dynamic_posterior,
+                    None, noise=nq_t, method=RSSM.dynamic_posterior_from_proj,
                 )
                 return (posterior, recurrent_state), (
                     recurrent_state, posterior, posterior_logits,
@@ -129,7 +136,7 @@ def make_train_fn(runtime, world_model, actor, critic, txs, cfg, is_continuous, 
             )
             _, (recurrent_states, posteriors, posteriors_logits) = jax.lax.scan(
                 _remat(dyn_step), init,
-                (data["actions"], embedded_obs, is_first, dyn_noise_q),
+                (data["actions"], emb_proj, is_first, dyn_noise_q),
                 unroll=scan_unroll,
             )
             # prior logits for the KL, batched over the stacked recurrent

@@ -170,13 +170,17 @@ def make_train_fn(runtime, world_model, actor, critic, ensemble, txs, cfg, is_co
         # ---------------------------------------------------- world model
         def wm_loss_fn(wm_params):
             embedded_obs = world_model.encoder.apply(wm_params["encoder"], batch_obs)
+            # embed-side product batched over the sequence (see dreamer_v1)
+            emb_proj = rssm.apply(
+                wm_params["rssm"], embedded_obs, method=RSSM.representation_embed_proj
+            )
 
             def dyn_step(carry, inp):
                 posterior, recurrent_state = carry
                 action, emb, n_t = inp
                 recurrent_state, posterior, post_ms = rssm.apply(
                     wm_params["rssm"], posterior, recurrent_state, action, emb,
-                    None, noise=n_t, method=RSSM.dynamic_posterior,
+                    None, noise=n_t, method=RSSM.dynamic_posterior_from_proj,
                 )
                 return (posterior, recurrent_state), (
                     recurrent_state, posterior, post_ms[0], post_ms[1],
@@ -188,7 +192,7 @@ def make_train_fn(runtime, world_model, actor, critic, ensemble, txs, cfg, is_co
             )
             _, (recurrent_states, posteriors, post_means, post_stds) = jax.lax.scan(
                 scan_remat(dyn_step),
-                init, (data["actions"], embedded_obs, dyn_noise),
+                init, (data["actions"], emb_proj, dyn_noise),
                 unroll=scan_unroll_setting(cfg, "dyn"),
             )
             # prior mean/std for the KL, batched outside the scan (the prior

@@ -220,13 +220,17 @@ def make_train_fn(runtime, world_model, actor, critic, ensemble, txs, cfg, is_co
         # ---------------------------------------------------- world model
         def wm_loss_fn(wm_params):
             embedded_obs = world_model.encoder.apply(wm_params["encoder"], batch_obs)
+            # embed-side product batched over the sequence (see dreamer_v2)
+            emb_proj = rssm.apply(
+                wm_params["rssm"], embedded_obs, method=RSSM.representation_embed_proj
+            )
 
             def dyn_step(carry, inp):
                 posterior, recurrent_state = carry
                 action, emb, first, nq_t = inp
                 recurrent_state, posterior, posterior_logits = rssm.apply(
                     wm_params["rssm"], posterior, recurrent_state, action, emb, first,
-                    None, noise=nq_t, method=RSSM.dynamic_posterior,
+                    None, noise=nq_t, method=RSSM.dynamic_posterior_from_proj,
                 )
                 return (posterior, recurrent_state), (
                     recurrent_state, posterior, posterior_logits,
@@ -238,7 +242,7 @@ def make_train_fn(runtime, world_model, actor, critic, ensemble, txs, cfg, is_co
             )
             _, (recurrent_states, posteriors, posteriors_logits) = jax.lax.scan(
                 scan_remat(dyn_step),
-                init, (data["actions"], embedded_obs, is_first, dyn_noise_q),
+                init, (data["actions"], emb_proj, is_first, dyn_noise_q),
                 unroll=scan_unroll_setting(cfg, "dyn"),
             )
             # prior logits for the KL, batched outside the scan (the prior

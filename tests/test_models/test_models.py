@@ -196,3 +196,82 @@ def test_decoupled_scan_input_projection_hoist_identity():
 
     _, hs_new = jax.lax.scan(new_step, jnp.zeros((B, R)), (feats, is_first))
     np.testing.assert_allclose(np.asarray(hs_old), np.asarray(hs_new), rtol=2e-5, atol=2e-6)
+
+
+def test_dv2_embed_proj_hoist_identity():
+    """DV2: dynamic_posterior_from_proj(representation_embed_proj(emb)) ==
+    dynamic_posterior(emb) — the embed-side hoist is a re-bracketing of the
+    representation model's first Dense, not a semantic change."""
+    from sheeprl_tpu.algos.dreamer_v2.agent import RSSM as RSSMv2
+
+    T, B, R, A, E, S, D = 5, 4, 8, 3, 16, 4, 4
+    for layer_norm in (False, True):
+        rssm = RSSMv2(
+            actions_dim=(A,),
+            embedded_obs_dim=E,
+            recurrent_state_size=R,
+            dense_units=12,
+            stochastic_size=S,
+            discrete_size=D,
+            representation_hidden_size=12,
+            transition_hidden_size=12,
+            layer_norm=layer_norm,
+        )
+        k = jax.random.PRNGKey(11)
+        ks = jax.random.split(k, 6)
+        post0 = jnp.zeros((B, S, D))
+        h0 = jnp.zeros((B, R))
+        params = rssm.init(
+            ks[0], post0, h0, jnp.zeros((B, A)), jnp.zeros((B, E)), jnp.zeros((B, 1)), ks[1],
+            method=RSSMv2.dynamic,
+        )
+        post = jax.nn.one_hot(jax.random.randint(ks[2], (B, S), 0, D), D)
+        h = jax.random.normal(ks[3], (B, R))
+        action = jax.random.normal(ks[4], (B, A))
+        emb = jax.random.normal(ks[5], (B, E))
+        first = jnp.zeros((B, 1)).at[1].set(1.0)
+        noise = jax.random.gumbel(jax.random.PRNGKey(12), (B, S, D))
+
+        old = rssm.apply(params, post, h, action, emb, first, None, noise=noise,
+                         method=RSSMv2.dynamic_posterior)
+        emb_proj = rssm.apply(params, emb, method=RSSMv2.representation_embed_proj)
+        new = rssm.apply(params, post, h, action, emb_proj, first, None, noise=noise,
+                         method=RSSMv2.dynamic_posterior_from_proj)
+        for o, n in zip(old, new):
+            np.testing.assert_allclose(np.asarray(o), np.asarray(n), rtol=2e-5, atol=2e-6)
+
+
+def test_dv1_embed_proj_hoist_identity():
+    """DV1: same re-bracketing identity for the continuous-latent RSSM."""
+    from sheeprl_tpu.algos.dreamer_v1.agent import RSSM as RSSMv1
+
+    B, R, A, E, S = 4, 8, 3, 16, 6
+    rssm = RSSMv1(
+        actions_dim=(A,),
+        embedded_obs_dim=E,
+        recurrent_state_size=R,
+        stochastic_size=S,
+        representation_hidden_size=12,
+        transition_hidden_size=12,
+    )
+    k = jax.random.PRNGKey(21)
+    ks = jax.random.split(k, 6)
+    params = rssm.init(
+        ks[0], jnp.zeros((B, S)), jnp.zeros((B, R)), jnp.zeros((B, A)),
+        jnp.zeros((B, E)), ks[1], method=RSSMv1.dynamic,
+    )
+    post = jax.random.normal(ks[2], (B, S))
+    h = jax.random.normal(ks[3], (B, R))
+    action = jax.random.normal(ks[4], (B, A))
+    emb = jax.random.normal(ks[5], (B, E))
+    noise = jax.random.normal(jax.random.PRNGKey(22), (B, S))
+
+    old = rssm.apply(params, post, h, action, emb, None, noise=noise,
+                     method=RSSMv1.dynamic_posterior)
+    emb_proj = rssm.apply(params, emb, method=RSSMv1.representation_embed_proj)
+    new = rssm.apply(params, post, h, action, emb_proj, None, noise=noise,
+                     method=RSSMv1.dynamic_posterior_from_proj)
+    flat_old = jax.tree_util.tree_leaves(old)
+    flat_new = jax.tree_util.tree_leaves(new)
+    for o, n in zip(flat_old, flat_new):
+        np.testing.assert_allclose(np.asarray(o), np.asarray(n), rtol=2e-5, atol=2e-6)
