@@ -29,6 +29,7 @@ import numpy as np
 import optax
 
 from sheeprl_tpu.algos.dreamer_v2.agent import RSSM, PlayerDV2, build_agent
+from sheeprl_tpu.ops.dyn_bptt import dyn_rssm_sequence, extract_dyn_params_v2
 from sheeprl_tpu.algos.dreamer_v2.loss import reconstruction_loss
 from sheeprl_tpu.algos.dreamer_v2.utils import compute_lambda_values, prepare_obs, test
 from sheeprl_tpu.config import instantiate
@@ -92,6 +93,12 @@ def make_train_fn(runtime, world_model, actor, critic, txs, cfg, is_continuous, 
     _remat = scan_remat
 
     rssm = world_model.rssm
+    # efficient-BPTT dynamic scan (see dreamer_v3 / ops/dyn_bptt.py); the
+    # DV2 variant: elu, Dense biases, optional LNs, no unimix, zero resets
+    dyn_bptt = bool(cfg.algo.world_model.get("dyn_bptt", False))
+    if os.environ.get("SHEEPRL_DYN_BPTT") is not None:
+        dyn_bptt = os.environ["SHEEPRL_DYN_BPTT"].lower() not in ("0", "false")
+    dyn_bptt = dyn_bptt and rssm.act in ("silu", "elu")
 
     def train(params, opt_states, data, key):
         T, B = data["rewards"].shape[:2]
@@ -119,26 +126,49 @@ def make_train_fn(runtime, world_model, actor, critic, txs, cfg, is_continuous, 
                 wm_params["rssm"], embedded_obs, method=RSSM.representation_embed_proj
             )
 
-            def dyn_step(carry, inp):
-                posterior, recurrent_state = carry
-                action, emb, first, nq_t = inp
-                recurrent_state, posterior, posterior_logits = rssm.apply(
-                    wm_params["rssm"], posterior, recurrent_state, action, emb, first,
-                    None, noise=nq_t, method=RSSM.dynamic_posterior_from_proj,
+            if dyn_bptt:
+                recurrent_states, zst_, posteriors_logits = dyn_rssm_sequence(
+                    jnp.zeros((B, stochastic_size * discrete_size)),
+                    jnp.zeros((B, recurrent_state_size)),
+                    data["actions"],
+                    emb_proj,
+                    is_first,
+                    dyn_noise_q,
+                    jnp.zeros((B, recurrent_state_size)),  # V2: zero resets
+                    jnp.zeros((B, stochastic_size * discrete_size)),
+                    extract_dyn_params_v2(wm_params["rssm"], recurrent_state_size),
+                    eps_proj=1e-6,  # DenseActLn uses flax LayerNorm defaults
+                    eps_rep=1e-6,
+                    unimix=0.0,
+                    discrete=discrete_size,
+                    matmul_dtype=rssm.dtype,
+                    unroll=scan_unroll,
+                    act=rssm.act,
+                    proj_ln=rssm.recurrent_layer_norm,
+                    rep_ln=rssm.layer_norm,
                 )
-                return (posterior, recurrent_state), (
-                    recurrent_state, posterior, posterior_logits,
-                )
+                posteriors = zst_.reshape(T, B, stochastic_size, discrete_size)
+            else:
+                def dyn_step(carry, inp):
+                    posterior, recurrent_state = carry
+                    action, emb, first, nq_t = inp
+                    recurrent_state, posterior, posterior_logits = rssm.apply(
+                        wm_params["rssm"], posterior, recurrent_state, action, emb, first,
+                        None, noise=nq_t, method=RSSM.dynamic_posterior_from_proj,
+                    )
+                    return (posterior, recurrent_state), (
+                        recurrent_state, posterior, posterior_logits,
+                    )
 
-            init = (
-                jnp.zeros((B, stochastic_size, discrete_size)),
-                jnp.zeros((B, recurrent_state_size)),
-            )
-            _, (recurrent_states, posteriors, posteriors_logits) = jax.lax.scan(
-                _remat(dyn_step), init,
-                (data["actions"], emb_proj, is_first, dyn_noise_q),
-                unroll=scan_unroll,
-            )
+                init = (
+                    jnp.zeros((B, stochastic_size, discrete_size)),
+                    jnp.zeros((B, recurrent_state_size)),
+                )
+                _, (recurrent_states, posteriors, posteriors_logits) = jax.lax.scan(
+                    _remat(dyn_step), init,
+                    (data["actions"], emb_proj, is_first, dyn_noise_q),
+                    unroll=scan_unroll,
+                )
             # prior logits for the KL, batched over the stacked recurrent
             # states (the prior SAMPLE is unused by the world-model loss)
             priors_logits, _ = rssm.apply(

@@ -1,23 +1,23 @@
-"""Efficient-BPTT custom VJP for the non-decoupled DV3 dynamic scan.
+"""Efficient-BPTT custom VJP for the Dreamer dynamic scans (DV3 + DV2).
 
-The default DV3 world-model recurrence (this repo's
+The discrete-latent dynamic recurrence (this repo's
 ``RSSM.dynamic_posterior``; reference sheeprl dreamer_v3.py:113-146 +
-RSSM.dynamic agent.py:396) interleaves posterior sampling with the GRU:
+RSSM.dynamic agent.py:396, dreamer_v2 agent.py RSSM.dynamic:336) interleaves
+posterior sampling with the GRU:
 
-    feat   = silu(LN_p([z_{t-1}, a_t] @ Wp))          # input projection
-    h_t    = LayerNormGRU(h_{t-1}, feat)              # Hafner GRU
-    logits = head(silu(LN_r(h_t @ k_h + emb_proj_t))) # representation model
-    z_t    = ST-sample(unimix(logits) + gumbel)       # posterior
+    feat   = act(LN_p?([z_{t-1}, a_t] @ Wp + bp))     # input projection
+    h_t    = LayerNormGRU(h_{t-1}, feat)              # Hafner GRU (+bias in V2)
+    logits = head(act(LN_r?(h_t @ k_h + emb_proj_t))) # representation model
+    z_t    = ST-sample(unimix?(logits) + gumbel)      # posterior
 
-Autodiff-through-``lax.scan`` puts FOUR weight-gradient accumulators
+Autodiff-through-``lax.scan`` puts every weight-gradient accumulator
 (Wp, Wg, k_h, head — ~4.5 MB f32 at DV3-S) into the backward while-loop's
 carry: every reverse iteration reads and writes them all (~9 MB of HBM
-round-trip per step, ~0.6 ms of the 15.9 ms DV3-S train step) on top of
-the serial matmuls.  A Pallas whole-sequence forward kernel does NOT help
-here — measured on the v5e, one-kernel grid=(T,) recurrences are
-launch-overhead-bound and lose to XLA's while loop
-(benchmarks/results/seq_gru_tpu_r4.json: 4.10 ms vs 3.85 ms fwd at
-T=64/B=16/H=512) — but the backward is fixable in pure JAX:
+round-trip per step) on top of the serial matmuls.  A Pallas
+whole-sequence forward kernel does NOT help here — measured on the v5e,
+one-kernel grid=(T,) recurrences are launch-overhead-bound and lose to
+XLA's while loop (benchmarks/results/seq_gru_tpu_r4.json: 4.10 ms vs
+3.85 ms fwd at T=64/B=16/H=512) — but the backward is fixable in pure JAX:
 
 * the forward stays an XLA ``lax.scan`` (already latency-optimal), saving
   only the carried states (hs, zs) — no per-step residual stacking;
@@ -28,18 +28,23 @@ T=64/B=16/H=512) — but the backward is fixable in pure JAX:
 * every weight gradient is a single batched contraction over stacked
   reverse-scan outputs, OUTSIDE the sequential loop.
 
-Same structure as ``ops/seq_gru.py``'s VJP (the decoupled case), extended
-with the straight-through/unimix sampling chain: the internal carry
-gradient d(z_t) from step t+1's projection flows through softmax(mixed_t)
-(the ST estimator), the unimix log-mix, and the representation head into
-h_t — exactly what autodiff-through-scan computes.
+Chip A/B at DV3-S: 16.2-16.3 → 15.7 ms per train step.
+
+Generality knobs (static): activation (``silu`` for V3 / ``elu`` for V2),
+optional LayerNorms on the projection and representation trunks (with
+their epsilons: V3 configures 1e-3, V2 uses flax's 1e-6 default), Dense
+biases on the projection and GRU (always-present zero arrays when the
+module variant has none — the adds are free next to the matmuls), and
+``unimix`` (V3's 1% log-mix; 0 means the logits pass through raw, V2).
+The is_first reset state is an input pair (init_rec/init_post): V3 passes
+its learned initial state, V2 passes zeros.
 
 Numerics: matmuls run in the caller's compute dtype with f32 LayerNorms,
-mirroring ``linear_ln_act_apply``/``gru_cell_apply``; all backward
-cotangent arithmetic is f32 (autodiff would carry bf16 cotangents through
-bf16 segments — the f32 choice is strictly more precise; grads match
-autodiff exactly in f32 and to bf16 tolerance under bf16-mixed, pinned by
-``tests/test_parallel/test_dyn_bptt.py``).
+mirroring ``linear_ln_act_apply``/``gru_cell_apply``/``DenseActLn``; all
+backward cotangent arithmetic is f32 (autodiff would carry bf16
+cotangents through bf16 segments — the f32 choice is strictly more
+precise; grads match autodiff exactly in f32 and to bf16 tolerance under
+bf16-mixed, pinned by ``tests/test_parallel/test_dyn_bptt.py``).
 """
 
 from __future__ import annotations
@@ -54,6 +59,7 @@ __all__ = [
     "DynParams",
     "dyn_rssm_sequence",
     "extract_dyn_params",
+    "extract_dyn_params_v2",
     "rssm_dyn_bptt_eligible",
 ]
 
@@ -61,19 +67,26 @@ __all__ = [
 class DynParams(NamedTuple):
     """Raw weight leaves of the fused dynamic step (flax param layout).
 
-    w_proj (S+A, P)    recurrent model input projection (Dense, no bias)
-    lnp_*  (P,)        its LayerNorm (eps = RSSM.eps)
-    w_gru  (H+P, 3H)   LayerNormGRUCell dense (no bias)
-    lng_*  (3H,)       its LayerNorm (eps 1e-6)
+    w_proj (S+A, P) / b_proj (P,)   recurrent model input projection
+    lnp_*  (P,)        its LayerNorm (when proj_ln)
+    w_gru  (H+P, 3H) / b_gru (3H,)  LayerNormGRUCell dense
+    lng_*  (3H,)       its LayerNorm (eps 1e-6, always on)
     k_h    (H, R)      representation trunk, h-side rows of the first Dense
-    lnr_*  (R,)        representation trunk LayerNorm (eps = RSSM.eps)
-    head_k (R, S) / head_b (S,)   logits head (f32 matmul)
+                       (the embed-side rows and the Dense bias live in the
+                       precomputed ``emb_proj``)
+    lnr_*  (R,)        representation trunk LayerNorm (when rep_ln)
+    head_k (R, S) / head_b (S,)     logits head (f32 matmul)
+
+    Bias/LN arrays are always present; pass zeros/ones when the module
+    variant has none (their gradients are then simply discarded).
     """
 
     w_proj: jax.Array
+    b_proj: jax.Array
     lnp_scale: jax.Array
     lnp_bias: jax.Array
     w_gru: jax.Array
+    b_gru: jax.Array
     lng_scale: jax.Array
     lng_bias: jax.Array
     k_h: jax.Array
@@ -102,22 +115,46 @@ def _ln_bwd(dy, scale, xhat, inv):
     )
 
 
-def _silu_grad(v):
-    s = jax.nn.sigmoid(v)
-    return s * (1.0 + v * (1.0 - s))
+def _act_fwd(v, act: str):
+    if act == "silu":
+        return jax.nn.silu(v)
+    if act == "elu":
+        return jax.nn.elu(v)
+    raise ValueError(f"unsupported activation for dyn_bptt: {act}")
+
+
+def _act_grad(v, act: str):
+    """d act(v) / dv evaluated at the saved pre-activation value."""
+    if act == "silu":
+        s = jax.nn.sigmoid(v)
+        return s * (1.0 + v * (1.0 - s))
+    if act == "elu":
+        return jnp.where(v > 0, 1.0, jnp.exp(jnp.minimum(v, 0.0)))
+    raise ValueError(f"unsupported activation for dyn_bptt: {act}")
 
 
 def _group_softmax(x, groups, classes):
     return jax.nn.softmax(x.reshape(*x.shape[:-1], groups, classes), -1)
 
 
-@functools.lru_cache(maxsize=8)
-def _get_op(eps_p: float, eps_r: float, unimix: float, discrete: int, dt_name: str, unroll: int):
+@functools.lru_cache(maxsize=16)
+def _get_op(
+    eps_p: float,
+    eps_r: float,
+    unimix: float,
+    discrete: int,
+    dt_name: str,
+    unroll: int,
+    act: str,
+    proj_ln: bool,
+    rep_ln: bool,
+):
     dt = jnp.dtype(dt_name)
     f32 = jnp.float32
 
     def _step_fwd(params: DynParams, init_rec, init_post, carry, inp):
-        """One dynamic step, numerics-identical to RSSM.dynamic_posterior."""
+        """One dynamic step, numerics-identical to RSSM.dynamic_posterior
+        (V3) / RSSM.dynamic_posterior_from_proj (V2)."""
         z, h = carry
         a, emb, f, n = inp
         keep = 1.0 - f
@@ -125,11 +162,20 @@ def _get_op(eps_p: float, eps_r: float, unimix: float, discrete: int, dt_name: s
         hg = keep * h + f * init_rec
         zg = keep * z + f * init_post
 
-        fpre = jnp.concatenate([zg, a_eff], -1).astype(dt) @ params.w_proj.astype(dt)
-        lnp, _, _ = _ln_fwd(fpre.astype(f32), params.lnp_scale, params.lnp_bias, eps_p)
-        feat = jax.nn.silu(lnp.astype(dt))
+        fpre = (
+            jnp.concatenate([zg, a_eff], -1).astype(dt) @ params.w_proj.astype(dt)
+            + params.b_proj.astype(dt)
+        )
+        if proj_ln:
+            lnp, _, _ = _ln_fwd(fpre.astype(f32), params.lnp_scale, params.lnp_bias, eps_p)
+            feat = _act_fwd(lnp.astype(dt), act)
+        else:
+            feat = _act_fwd(fpre, act)
 
-        gpre = jnp.concatenate([hg.astype(dt), feat], -1) @ params.w_gru.astype(dt)
+        gpre = (
+            jnp.concatenate([hg.astype(dt), feat], -1) @ params.w_gru.astype(dt)
+            + params.b_gru.astype(dt)
+        )
         parts, _, _ = _ln_fwd(gpre.astype(f32), params.lng_scale, params.lng_bias, 1e-6)
         hidden = h.shape[-1]
         reset = jax.nn.sigmoid(parts[..., :hidden])
@@ -138,14 +184,20 @@ def _get_op(eps_p: float, eps_r: float, unimix: float, discrete: int, dt_name: s
         h_new = update * cand + (1.0 - update) * hg
 
         xpre = h_new.astype(dt) @ params.k_h.astype(dt) + emb
-        lnr, _, _ = _ln_fwd(xpre.astype(f32), params.lnr_scale, params.lnr_bias, eps_r)
-        x = jax.nn.silu(lnr.astype(dt))
+        if rep_ln:
+            lnr, _, _ = _ln_fwd(xpre.astype(f32), params.lnr_scale, params.lnr_bias, eps_r)
+            x = _act_fwd(lnr.astype(dt), act)
+        else:
+            x = _act_fwd(xpre, act)
         logits = x.astype(f32) @ params.head_k + params.head_b
 
         groups = logits.shape[-1] // discrete
-        pr = _group_softmax(logits, groups, discrete)
-        pm = (1.0 - unimix) * pr + unimix / discrete
-        mixed = jnp.log(pm)
+        if unimix > 0.0:
+            pr = _group_softmax(logits, groups, discrete)
+            pm = (1.0 - unimix) * pr + unimix / discrete
+            mixed = jnp.log(pm)
+        else:
+            mixed = logits.reshape(*logits.shape[:-1], groups, discrete)
         hard = jax.nn.one_hot(
             jnp.argmax(mixed + n.reshape(mixed.shape), -1), discrete, dtype=f32
         )
@@ -200,28 +252,46 @@ def _get_op(eps_p: float, eps_r: float, unimix: float, discrete: int, dt_name: s
         zg = keep * z_prev + f * init_post
 
         inp_p32 = jnp.concatenate([zg, a_eff], -1)
-        fpre = (inp_p32.astype(dt) @ params.w_proj.astype(dt)).astype(f32)
-        lnp, xhat_p, inv_p = _ln_fwd(fpre, params.lnp_scale, params.lnp_bias, eps_p)
-        lnp_dt = lnp.astype(dt)
-        feat = jax.nn.silu(lnp_dt)
+        fpre_dt = (
+            inp_p32.astype(dt) @ params.w_proj.astype(dt) + params.b_proj.astype(dt)
+        )
+        fpre = fpre_dt.astype(f32)
+        if proj_ln:
+            lnp, xhat_p, inv_p = _ln_fwd(fpre, params.lnp_scale, params.lnp_bias, eps_p)
+            actin_p = lnp.astype(dt)  # activation input (saved pre-act value)
+        else:
+            xhat_p = inv_p = jnp.zeros_like(fpre[..., :1])
+            actin_p = fpre_dt
+        feat = _act_fwd(actin_p, act)
 
         g_in32 = jnp.concatenate([hg, feat.astype(f32)], -1)
-        gpre = (g_in32.astype(dt) @ params.w_gru.astype(dt)).astype(f32)
+        gpre = (
+            g_in32.astype(dt) @ params.w_gru.astype(dt) + params.b_gru.astype(dt)
+        ).astype(f32)
         parts, xhat_g, inv_g = _ln_fwd(gpre, params.lng_scale, params.lng_bias, 1e-6)
         reset = jax.nn.sigmoid(parts[..., :hidden])
         p2 = parts[..., hidden : 2 * hidden]
         cand = jnp.tanh(reset * p2)
         update = jax.nn.sigmoid(parts[..., 2 * hidden :] - 1.0)
 
-        xpre = (hs.astype(dt) @ params.k_h.astype(dt) + emb_proj).astype(f32)
-        lnr, xhat_r, inv_r = _ln_fwd(xpre, params.lnr_scale, params.lnr_bias, eps_r)
-        lnr_dt = lnr.astype(dt)
-        x32 = jax.nn.silu(lnr_dt).astype(f32)
+        xpre_dt = hs.astype(dt) @ params.k_h.astype(dt) + emb_proj
+        xpre = xpre_dt.astype(f32)
+        if rep_ln:
+            lnr, xhat_r, inv_r = _ln_fwd(xpre, params.lnr_scale, params.lnr_bias, eps_r)
+            actin_r = lnr.astype(dt)
+        else:
+            xhat_r = inv_r = jnp.zeros_like(xpre[..., :1])
+            actin_r = xpre_dt
+        x32 = _act_fwd(actin_r, act).astype(f32)
         logits = x32 @ params.head_k + params.head_b
-        pr = _group_softmax(logits, groups, discrete)
-        pm = (1.0 - unimix) * pr + unimix / discrete
-        mixed = jnp.log(pm)
-        p_st = jax.nn.softmax(mixed, -1)  # softmax(log pm): fp-faithful to fwd
+        l3 = logits.reshape(T, b, groups, discrete)
+        if unimix > 0.0:
+            pr = jax.nn.softmax(l3, -1)
+            pm = (1.0 - unimix) * pr + unimix / discrete
+            p_st = jax.nn.softmax(jnp.log(pm), -1)  # fp-faithful to the fwd
+        else:
+            pr = pm = jnp.zeros_like(l3[..., :1])  # unused
+            p_st = jax.nn.softmax(l3, -1)
 
         w_gru_h = params.w_gru[:hidden].astype(f32)
         w_gru_x = params.w_gru[hidden:].astype(f32)
@@ -239,8 +309,7 @@ def _get_op(eps_p: float, eps_r: float, unimix: float, discrete: int, dt_name: s
                 p_st_t,
                 pm_t,
                 pr_t,
-                x32_t,
-                lnr_dt_t,
+                actin_r_t,
                 xhat_r_t,
                 inv_r_t,
                 hg_t,
@@ -250,26 +319,32 @@ def _get_op(eps_p: float, eps_r: float, unimix: float, discrete: int, dt_name: s
                 p2_t,
                 xhat_g_t,
                 inv_g_t,
-                lnp_dt_t,
+                actin_p_t,
                 xhat_p_t,
                 inv_p_t,
             ) = inp_t
             keep_t = 1.0 - f_t
 
-            # straight-through + unimix backward into the logits
+            # straight-through (+ unimix) backward into the logits
             dz3 = (d_zs_t + dz_c).reshape(-1, groups, discrete)
             dmx = p_st_t * (dz3 - (dz3 * p_st_t).sum(-1, keepdims=True))
             dmx = dmx + d_mixed_t.reshape(dmx.shape)
-            dpm = dmx / pm_t
-            dpr = (1.0 - unimix) * dpm
-            dlogits = (pr_t * (dpr - (dpr * pr_t).sum(-1, keepdims=True))).reshape(
-                -1, groups * discrete
-            )
+            if unimix > 0.0:
+                dpm = dmx / pm_t
+                dpr = (1.0 - unimix) * dpm
+                dlogits = (pr_t * (dpr - (dpr * pr_t).sum(-1, keepdims=True))).reshape(
+                    -1, groups * discrete
+                )
+            else:
+                dlogits = dmx.reshape(-1, groups * discrete)
 
             # representation head + trunk backward
             dx32 = dlogits @ head_k32.T
-            dlnr = dx32 * _silu_grad(lnr_dt_t.astype(f32))
-            dxpre = _ln_bwd(dlnr, params.lnr_scale, xhat_r_t, inv_r_t)
+            dl = dx32 * _act_grad(actin_r_t.astype(f32), act)
+            if rep_ln:
+                dxpre = _ln_bwd(dl, params.lnr_scale, xhat_r_t, inv_r_t)
+            else:
+                dxpre = dl
             dh_rep = dxpre @ k_h32.T
 
             # GRU backward (gated carry hg)
@@ -288,13 +363,16 @@ def _get_op(eps_p: float, eps_r: float, unimix: float, discrete: int, dt_name: s
             dfeat = dgpre @ w_gru_x.T
 
             # input projection backward
-            dlnp = dfeat * _silu_grad(lnp_dt_t.astype(f32))
-            dfpre = _ln_bwd(dlnp, params.lnp_scale, xhat_p_t, inv_p_t)
+            dl_p = dfeat * _act_grad(actin_p_t.astype(f32), act)
+            if proj_ln:
+                dfpre = _ln_bwd(dl_p, params.lnp_scale, xhat_p_t, inv_p_t)
+            else:
+                dfpre = dl_p
             dzg = dfpre @ w_proj_z.T
 
             dh_prev = keep_t * dhg
             dz_prev = keep_t * dzg
-            return (dh_prev, dz_prev), (dlogits, dxpre, dparts, dgpre, dfpre, dhg, dzg, dh_tot)
+            return (dh_prev, dz_prev), (dlogits, dxpre, dparts, dgpre, dfpre, dhg, dzg)
 
         seq = (
             d_hs.astype(f32),
@@ -304,8 +382,7 @@ def _get_op(eps_p: float, eps_r: float, unimix: float, discrete: int, dt_name: s
             p_st,
             pm,
             pr,
-            x32,
-            lnr_dt,
+            actin_r,
             xhat_r,
             inv_r,
             hg,
@@ -315,11 +392,11 @@ def _get_op(eps_p: float, eps_r: float, unimix: float, discrete: int, dt_name: s
             p2,
             xhat_g,
             inv_g,
-            lnp_dt,
+            actin_p,
             xhat_p,
             inv_p,
         )
-        (dh0, dz0), (dlogits, dxpre, dparts, dgpre, dfpre, dhgs, dzgs, dh_tots) = jax.lax.scan(
+        (dh0, dz0), (dlogits, dxpre, dparts, dgpre, dfpre, dhgs, dzgs) = jax.lax.scan(
             back_step,
             (jnp.zeros_like(h0, f32), jnp.zeros_like(z0, f32)),
             seq,
@@ -333,23 +410,25 @@ def _get_op(eps_p: float, eps_r: float, unimix: float, discrete: int, dt_name: s
         dlogf = dlogits.reshape(T * b, stoch)
         dxpref = dxpre.reshape(T * b, n_r)
         # LN scale/bias grads need the pre-LN-input cotangents dlnr/dlnp
-        dlnr_full = (dlogits @ head_k32.T) * _silu_grad(lnr_dt.astype(f32))
-        dlnp_full = (dgpre @ w_gru_x.T) * _silu_grad(lnp_dt.astype(f32))
+        dlnr_full = (dlogits @ head_k32.T) * _act_grad(actin_r.astype(f32), act)
+        dlnp_full = (dgpre @ w_gru_x.T) * _act_grad(actin_p.astype(f32), act)
 
         grads = DynParams(
             w_proj=(inp_p32.reshape(T * b, -1).T @ dfpre.reshape(T * b, -1)).astype(
                 params.w_proj.dtype
             ),
-            lnp_scale=(dlnp_full * xhat_p).sum((0, 1)),
-            lnp_bias=dlnp_full.sum((0, 1)),
+            b_proj=dfpre.sum((0, 1)).astype(params.b_proj.dtype),
+            lnp_scale=(dlnp_full * xhat_p).sum((0, 1)) if proj_ln else jnp.zeros_like(params.lnp_scale),
+            lnp_bias=dlnp_full.sum((0, 1)) if proj_ln else jnp.zeros_like(params.lnp_bias),
             w_gru=(g_in32.reshape(T * b, -1).T @ dgpre.reshape(T * b, -1)).astype(
                 params.w_gru.dtype
             ),
+            b_gru=dgpre.sum((0, 1)).astype(params.b_gru.dtype),
             lng_scale=(dparts * xhat_g).sum((0, 1)),
             lng_bias=dparts.sum((0, 1)),
             k_h=(hs.reshape(T * b, hidden).T @ dxpref).astype(params.k_h.dtype),
-            lnr_scale=(dlnr_full * xhat_r).sum((0, 1)),
-            lnr_bias=dlnr_full.sum((0, 1)),
+            lnr_scale=(dlnr_full * xhat_r).sum((0, 1)) if rep_ln else jnp.zeros_like(params.lnr_scale),
+            lnr_bias=dlnr_full.sum((0, 1)) if rep_ln else jnp.zeros_like(params.lnr_bias),
             head_k=(x32f.T @ dlogf).astype(params.head_k.dtype),
             head_b=dlogf.sum(0).astype(params.head_b.dtype),
         )
@@ -374,23 +453,21 @@ def _get_op(eps_p: float, eps_r: float, unimix: float, discrete: int, dt_name: s
 
 
 def rssm_dyn_bptt_eligible(rssm) -> bool:
-    """Does this RSSM's configuration match the op's closed-form backward?
-
-    Requires the non-decoupled posterior, LayerNorm blocks (no Dense
-    biases), silu activations (the backward hard-codes silu'), unimix > 0,
-    and the plain (non-Pallas) GRU cell so the fwd numerics are the
-    reference scan's."""
+    """Does this DV3 RSSM's configuration match the op's closed-form
+    backward?  Requires the non-decoupled posterior, LayerNorm blocks,
+    a supported activation, unimix > 0, and the plain (non-Pallas) GRU
+    cell so the fwd numerics are the reference scan's."""
     return (
         not rssm.decoupled
         and rssm.layer_norm
         and rssm.unimix > 0.0
-        and rssm.act == "silu"
+        and rssm.act in ("silu", "elu")
         and not rssm.fused_gru
     )
 
 
 def extract_dyn_params(rssm_variables, hidden: int) -> DynParams:
-    """Pull the op's raw weight leaves out of a bound RSSM param tree
+    """Pull the op's raw weight leaves out of a bound DV3 RSSM param tree
     (``wm_params["rssm"]``). Plain dict indexing/slicing, so autodiff
     routes the op's weight cotangents back into the original tree
     (including the h-side rows of the representation model's first Dense —
@@ -401,16 +478,59 @@ def extract_dyn_params(rssm_variables, hidden: int) -> DynParams:
     gru = p["recurrent_model"]["LayerNormGRUCell_0"]
     rep_lin = p["representation_model"]["LinearLnAct_0"]
     head = p["representation_model"]["Dense_0"]
+    w_proj = lin["Dense_0"]["kernel"]
+    w_gru = gru["Dense_0"]["kernel"]
     return DynParams(
-        w_proj=lin["Dense_0"]["kernel"],
+        w_proj=w_proj,
+        b_proj=jnp.zeros((w_proj.shape[-1],), w_proj.dtype),
         lnp_scale=lin["LayerNorm_0"]["scale"],
         lnp_bias=lin["LayerNorm_0"]["bias"],
-        w_gru=gru["Dense_0"]["kernel"],
+        w_gru=w_gru,
+        b_gru=jnp.zeros((w_gru.shape[-1],), w_gru.dtype),
         lng_scale=gru["LayerNorm_0"]["scale"],
         lng_bias=gru["LayerNorm_0"]["bias"],
         k_h=rep_lin["Dense_0"]["kernel"][:hidden],
         lnr_scale=rep_lin["LayerNorm_0"]["scale"],
         lnr_bias=rep_lin["LayerNorm_0"]["bias"],
+        head_k=head["kernel"],
+        head_b=head["bias"],
+    )
+
+
+def extract_dyn_params_v2(rssm_variables, hidden: int) -> DynParams:
+    """Same extraction for the DV2 RSSM (DenseActLn blocks: Dense WITH
+    bias; GRU with bias; rep-trunk LayerNorm optional — absent leaves are
+    filled with identity LN params, gated off by the ``rep_ln``/
+    ``proj_ln`` statics)."""
+    p = rssm_variables["params"]
+    lin = p["recurrent_model"]["DenseActLn_0"]
+    gru = p["recurrent_model"]["LayerNormGRUCell_0"]
+    rep_lin = p["representation_model"]["DenseActLn_0"]
+    head = p["representation_model"]["Dense_0"]
+    w_proj = lin["Dense_0"]["kernel"]
+    w_gru = gru["Dense_0"]["kernel"]
+    proj_units = w_proj.shape[-1]
+    rep_units = rep_lin["Dense_0"]["kernel"].shape[-1]
+
+    def _ln_or_identity(block, n):
+        if "LayerNorm_0" in block:
+            return block["LayerNorm_0"]["scale"], block["LayerNorm_0"]["bias"]
+        return jnp.ones((n,), w_proj.dtype), jnp.zeros((n,), w_proj.dtype)
+
+    lnp_scale, lnp_bias = _ln_or_identity(lin, proj_units)
+    lnr_scale, lnr_bias = _ln_or_identity(rep_lin, rep_units)
+    return DynParams(
+        w_proj=w_proj,
+        b_proj=lin["Dense_0"]["bias"],
+        lnp_scale=lnp_scale,
+        lnp_bias=lnp_bias,
+        w_gru=w_gru,
+        b_gru=gru["Dense_0"]["bias"],
+        lng_scale=gru["LayerNorm_0"]["scale"],
+        lng_bias=gru["LayerNorm_0"]["bias"],
+        k_h=rep_lin["Dense_0"]["kernel"][:hidden],
+        lnr_scale=lnr_scale,
+        lnr_bias=lnr_bias,
         head_k=head["kernel"],
         head_b=head["bias"],
     )
@@ -433,20 +553,25 @@ def dyn_rssm_sequence(
     discrete: int = 32,
     matmul_dtype=jnp.float32,
     unroll: int = 1,
+    act: str = "silu",
+    proj_ln: bool = True,
+    rep_ln: bool = True,
 ):
     """Run the full T-step dynamic recurrence with the efficient-BPTT VJP.
 
     z0 (B, S) f32 flat posterior; h0 (B, H); actions (T, B, A) f32
     (UNgated — the is_first gating happens inside); emb_proj (T, B, R) in
-    the compute dtype (embed-side projection incl. any bias,
+    the compute dtype (embed-side projection incl. any Dense bias,
     ``RSSM.representation_embed_proj``); is_first (T, B, 1); noise
     (T, B, groups, discrete) pre-drawn gumbel; init_rec (B, H) /
-    init_post (B, S) from ``RSSM.get_initial_states``.
+    init_post (B, S) reset states (DV3: the learned initial state; DV2:
+    zeros).
 
-    Returns (hs (T,B,H) f32, z_st (T,B,S) f32, mixed_logits (T,B,S) f32);
-    ``z_st``'s forward value is the hard one-hot sample and its gradient is
-    the straight-through estimator, exactly like scanning
-    ``RSSM.dynamic_posterior``.
+    Returns (hs (T,B,H) f32, z_st (T,B,S) f32, logits (T,B,S) f32 — the
+    unimix-mixed logits for V3, the raw logits for V2); ``z_st``'s forward
+    value is the hard one-hot sample and its gradient is the
+    straight-through estimator, exactly like scanning the corresponding
+    ``dynamic_posterior`` method.
     """
     op = _get_op(
         float(eps_proj),
@@ -455,6 +580,9 @@ def dyn_rssm_sequence(
         int(discrete),
         jnp.dtype(matmul_dtype).name,
         int(unroll),
+        str(act),
+        bool(proj_ln),
+        bool(rep_ln),
     )
     noise = noise.reshape(*noise.shape[:2], -1)
     return op(z0, h0, actions, emb_proj, is_first, noise, init_rec, init_post, params)
