@@ -31,6 +31,7 @@ import numpy as np
 import optax
 
 from sheeprl_tpu.algos.dreamer_v2.agent import RSSM
+from sheeprl_tpu.ops.dyn_bptt import dyn_rssm_sequence, extract_dyn_params_v2
 from sheeprl_tpu.algos.dreamer_v2.dreamer_v2 import _make_optimizer
 from sheeprl_tpu.algos.dreamer_v2.loss import reconstruction_loss
 from sheeprl_tpu.algos.dreamer_v2.utils import compute_lambda_values, prepare_obs, test
@@ -84,6 +85,11 @@ def make_train_fn(runtime, world_model, actor, critic, ensemble, txs, cfg, is_co
     intrinsic_reward_multiplier = float(cfg.algo.intrinsic_reward_multiplier)
 
     rssm = world_model.rssm
+    # efficient-BPTT dynamic scan (see dreamer_v2 / ops/dyn_bptt.py)
+    dyn_bptt = bool(cfg.algo.world_model.get("dyn_bptt", False))
+    if os.environ.get("SHEEPRL_DYN_BPTT") is not None:
+        dyn_bptt = os.environ["SHEEPRL_DYN_BPTT"].lower() not in ("0", "false")
+    dyn_bptt = dyn_bptt and rssm.act in ("silu", "elu")
 
     def _imagine(actor_params, wm_params, imagined_prior0, recurrent_state0, key):
         """DV2-style imagination: (H+1, TB, L) trajectory INCLUDING the
@@ -225,26 +231,49 @@ def make_train_fn(runtime, world_model, actor, critic, ensemble, txs, cfg, is_co
                 wm_params["rssm"], embedded_obs, method=RSSM.representation_embed_proj
             )
 
-            def dyn_step(carry, inp):
-                posterior, recurrent_state = carry
-                action, emb, first, nq_t = inp
-                recurrent_state, posterior, posterior_logits = rssm.apply(
-                    wm_params["rssm"], posterior, recurrent_state, action, emb, first,
-                    None, noise=nq_t, method=RSSM.dynamic_posterior_from_proj,
+            if dyn_bptt:
+                recurrent_states, zst_, posteriors_logits = dyn_rssm_sequence(
+                    jnp.zeros((B, stochastic_size * discrete_size)),
+                    jnp.zeros((B, recurrent_state_size)),
+                    data["actions"],
+                    emb_proj,
+                    is_first,
+                    dyn_noise_q,
+                    jnp.zeros((B, recurrent_state_size)),  # V2: zero resets
+                    jnp.zeros((B, stochastic_size * discrete_size)),
+                    extract_dyn_params_v2(wm_params["rssm"], recurrent_state_size),
+                    eps_proj=1e-6,
+                    eps_rep=1e-6,
+                    unimix=0.0,
+                    discrete=discrete_size,
+                    matmul_dtype=rssm.dtype,
+                    unroll=scan_unroll_setting(cfg, "dyn"),
+                    act=rssm.act,
+                    proj_ln=rssm.recurrent_layer_norm,
+                    rep_ln=rssm.layer_norm,
                 )
-                return (posterior, recurrent_state), (
-                    recurrent_state, posterior, posterior_logits,
-                )
+                posteriors = zst_.reshape(T, B, stochastic_size, discrete_size)
+            else:
+                def dyn_step(carry, inp):
+                    posterior, recurrent_state = carry
+                    action, emb, first, nq_t = inp
+                    recurrent_state, posterior, posterior_logits = rssm.apply(
+                        wm_params["rssm"], posterior, recurrent_state, action, emb, first,
+                        None, noise=nq_t, method=RSSM.dynamic_posterior_from_proj,
+                    )
+                    return (posterior, recurrent_state), (
+                        recurrent_state, posterior, posterior_logits,
+                    )
 
-            init = (
-                jnp.zeros((B, stochastic_size, discrete_size)),
-                jnp.zeros((B, recurrent_state_size)),
-            )
-            _, (recurrent_states, posteriors, posteriors_logits) = jax.lax.scan(
-                scan_remat(dyn_step),
-                init, (data["actions"], emb_proj, is_first, dyn_noise_q),
-                unroll=scan_unroll_setting(cfg, "dyn"),
-            )
+                init = (
+                    jnp.zeros((B, stochastic_size, discrete_size)),
+                    jnp.zeros((B, recurrent_state_size)),
+                )
+                _, (recurrent_states, posteriors, posteriors_logits) = jax.lax.scan(
+                    scan_remat(dyn_step),
+                    init, (data["actions"], emb_proj, is_first, dyn_noise_q),
+                    unroll=scan_unroll_setting(cfg, "dyn"),
+                )
             # prior logits for the KL, batched outside the scan (the prior
             # SAMPLE is unused by the world-model loss)
             priors_logits, _ = rssm.apply(
