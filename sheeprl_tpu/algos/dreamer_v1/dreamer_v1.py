@@ -29,6 +29,7 @@ from sheeprl_tpu.algos.dreamer_v1.utils import compute_lambda_values, prepare_ob
 from sheeprl_tpu.algos.dreamer_v2.dreamer_v2 import _make_optimizer
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.feed import batched_feed
+from sheeprl_tpu.ops.dyn_bptt import dyn_bptt_setting, dyn_rssm_sequence_v1, extract_dyn_params_v1
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
 from sheeprl_tpu.utils.callback import CheckpointCallback, load_checkpoint, restore_buffer
 from sheeprl_tpu.utils.distribution import Bernoulli, Independent, Normal
@@ -67,6 +68,9 @@ def make_train_fn(runtime, world_model, actor, critic, txs, cfg, is_continuous, 
     _remat = scan_remat
 
     rssm = world_model.rssm
+    # efficient-BPTT dynamic scan (ops/dyn_bptt.py, V1 variant: Gaussian
+    # reparameterized latents, plain flax GRUCell, no LNs, no is_first)
+    dyn_bptt = dyn_bptt_setting(cfg) and rssm.act in ("silu", "elu")
 
     def train(params, opt_states, data, key):
         T, B = data["rewards"].shape[:2]
@@ -88,25 +92,39 @@ def make_train_fn(runtime, world_model, actor, critic, txs, cfg, is_continuous, 
                 wm_params["rssm"], embedded_obs, method=RSSM.representation_embed_proj
             )
 
-            def dyn_step(carry, inp):
-                posterior, recurrent_state = carry
-                action, emb, n_t = inp
-                recurrent_state, posterior, post_ms = rssm.apply(
-                    wm_params["rssm"], posterior, recurrent_state, action, emb,
-                    None, noise=n_t, method=RSSM.dynamic_posterior_from_proj,
+            if dyn_bptt:
+                recurrent_states, posteriors, post_means, post_stds = dyn_rssm_sequence_v1(
+                    jnp.zeros((B, stochastic_size)),
+                    jnp.zeros((B, recurrent_state_size)),
+                    data["actions"],
+                    emb_proj,
+                    dyn_noise,
+                    extract_dyn_params_v1(wm_params["rssm"], recurrent_state_size),
+                    min_std=rssm.min_std,
+                    matmul_dtype=rssm.dtype,
+                    unroll=scan_unroll,
+                    act=rssm.act,
                 )
-                return (posterior, recurrent_state), (
-                    recurrent_state, posterior, post_ms[0], post_ms[1],
-                )
+            else:
+                def dyn_step(carry, inp):
+                    posterior, recurrent_state = carry
+                    action, emb, n_t = inp
+                    recurrent_state, posterior, post_ms = rssm.apply(
+                        wm_params["rssm"], posterior, recurrent_state, action, emb,
+                        None, noise=n_t, method=RSSM.dynamic_posterior_from_proj,
+                    )
+                    return (posterior, recurrent_state), (
+                        recurrent_state, posterior, post_ms[0], post_ms[1],
+                    )
 
-            init = (
-                jnp.zeros((B, stochastic_size)),
-                jnp.zeros((B, recurrent_state_size)),
-            )
-            _, (recurrent_states, posteriors, post_means, post_stds) = jax.lax.scan(
-                _remat(dyn_step), init, (data["actions"], emb_proj, dyn_noise),
-                unroll=scan_unroll,
-            )
+                init = (
+                    jnp.zeros((B, stochastic_size)),
+                    jnp.zeros((B, recurrent_state_size)),
+                )
+                _, (recurrent_states, posteriors, post_means, post_stds) = jax.lax.scan(
+                    _remat(dyn_step), init, (data["actions"], emb_proj, dyn_noise),
+                    unroll=scan_unroll,
+                )
             # prior mean/std for the KL, batched over the stacked recurrent
             # states (the prior SAMPLE is unused by the world-model loss)
             (prior_means, prior_stds), _ = rssm.apply(

@@ -29,7 +29,7 @@ import numpy as np
 import optax
 
 from sheeprl_tpu.algos.dreamer_v2.agent import RSSM, PlayerDV2, build_agent
-from sheeprl_tpu.ops.dyn_bptt import dyn_rssm_sequence, extract_dyn_params_v2
+from sheeprl_tpu.ops.dyn_bptt import dyn_bptt_setting, dyn_rssm_sequence, extract_dyn_params_v2
 from sheeprl_tpu.algos.dreamer_v2.loss import reconstruction_loss
 from sheeprl_tpu.algos.dreamer_v2.utils import compute_lambda_values, prepare_obs, test
 from sheeprl_tpu.config import instantiate
@@ -95,10 +95,7 @@ def make_train_fn(runtime, world_model, actor, critic, txs, cfg, is_continuous, 
     rssm = world_model.rssm
     # efficient-BPTT dynamic scan (see dreamer_v3 / ops/dyn_bptt.py); the
     # DV2 variant: elu, Dense biases, optional LNs, no unimix, zero resets
-    dyn_bptt = bool(cfg.algo.world_model.get("dyn_bptt", False))
-    if os.environ.get("SHEEPRL_DYN_BPTT") is not None:
-        dyn_bptt = os.environ["SHEEPRL_DYN_BPTT"].lower() not in ("0", "false")
-    dyn_bptt = dyn_bptt and rssm.act in ("silu", "elu")
+    dyn_bptt = dyn_bptt_setting(cfg) and rssm.act in ("silu", "elu")
 
     def train(params, opt_states, data, key):
         T, B = data["rewards"].shape[:2]

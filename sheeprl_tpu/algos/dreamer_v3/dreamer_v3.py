@@ -45,6 +45,7 @@ from sheeprl_tpu.data.feed import batched_feed
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
 from sheeprl_tpu.envs.wrappers import RestartOnException
 from sheeprl_tpu.ops.dyn_bptt import (
+    dyn_bptt_setting,
     dyn_rssm_sequence,
     extract_dyn_params,
     rssm_dyn_bptt_eligible,
@@ -203,10 +204,7 @@ def make_train_fn(runtime, world_model, actor, critic, txs, cfg, is_continuous, 
     # efficient-BPTT dynamic scan (ops/dyn_bptt.py): same fwd lax.scan, but a
     # custom VJP whose reverse loop carries only (dh, dz) — the four weight
     # accumulators leave the backward while-loop's carry
-    dyn_bptt = bool(cfg.algo.world_model.get("dyn_bptt", False))
-    if os.environ.get("SHEEPRL_DYN_BPTT") is not None:
-        dyn_bptt = os.environ["SHEEPRL_DYN_BPTT"].lower() not in ("0", "false")
-    dyn_bptt = dyn_bptt and rssm_dyn_bptt_eligible(rssm)
+    dyn_bptt = dyn_bptt_setting(cfg) and rssm_dyn_bptt_eligible(rssm)
 
     def train(params, opt_states, moments_state, data, key):
         T, B = data["rewards"].shape[:2]

@@ -31,7 +31,7 @@ import numpy as np
 import optax
 
 from sheeprl_tpu.algos.dreamer_v2.agent import RSSM
-from sheeprl_tpu.ops.dyn_bptt import dyn_rssm_sequence, extract_dyn_params_v2
+from sheeprl_tpu.ops.dyn_bptt import dyn_bptt_setting, dyn_rssm_sequence, extract_dyn_params_v2
 from sheeprl_tpu.algos.dreamer_v2.dreamer_v2 import _make_optimizer
 from sheeprl_tpu.algos.dreamer_v2.loss import reconstruction_loss
 from sheeprl_tpu.algos.dreamer_v2.utils import compute_lambda_values, prepare_obs, test
@@ -86,10 +86,7 @@ def make_train_fn(runtime, world_model, actor, critic, ensemble, txs, cfg, is_co
 
     rssm = world_model.rssm
     # efficient-BPTT dynamic scan (see dreamer_v2 / ops/dyn_bptt.py)
-    dyn_bptt = bool(cfg.algo.world_model.get("dyn_bptt", False))
-    if os.environ.get("SHEEPRL_DYN_BPTT") is not None:
-        dyn_bptt = os.environ["SHEEPRL_DYN_BPTT"].lower() not in ("0", "false")
-    dyn_bptt = dyn_bptt and rssm.act in ("silu", "elu")
+    dyn_bptt = dyn_bptt_setting(cfg) and rssm.act in ("silu", "elu")
 
     def _imagine(actor_params, wm_params, imagined_prior0, recurrent_state0, key):
         """DV2-style imagination: (H+1, TB, L) trajectory INCLUDING the

@@ -41,6 +41,7 @@ from sheeprl_tpu.data.feed import batched_feed
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
 from sheeprl_tpu.envs.wrappers import RestartOnException
 from sheeprl_tpu.ops.dyn_bptt import (
+    dyn_bptt_setting,
     dyn_rssm_sequence,
     extract_dyn_params,
     rssm_dyn_bptt_eligible,
@@ -95,10 +96,7 @@ def make_train_fn(
 
     rssm = world_model.rssm
     # efficient-BPTT dynamic scan (see dreamer_v3.py / ops/dyn_bptt.py)
-    dyn_bptt = bool(cfg.algo.world_model.get("dyn_bptt", False))
-    if os.environ.get("SHEEPRL_DYN_BPTT") is not None:
-        dyn_bptt = os.environ["SHEEPRL_DYN_BPTT"].lower() not in ("0", "false")
-    dyn_bptt = dyn_bptt and rssm_dyn_bptt_eligible(rssm)
+    dyn_bptt = dyn_bptt_setting(cfg) and rssm_dyn_bptt_eligible(rssm)
 
     def _update_moments(state, x):
         return update_moments(

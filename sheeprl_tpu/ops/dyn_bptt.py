@@ -50,6 +50,7 @@ bf16-mixed, pinned by ``tests/test_parallel/test_dyn_bptt.py``).
 from __future__ import annotations
 
 import functools
+import os
 from typing import NamedTuple
 
 import jax
@@ -57,11 +58,26 @@ import jax.numpy as jnp
 
 __all__ = [
     "DynParams",
+    "V1DynParams",
+    "dyn_bptt_setting",
     "dyn_rssm_sequence",
+    "dyn_rssm_sequence_v1",
     "extract_dyn_params",
+    "extract_dyn_params_v1",
     "extract_dyn_params_v2",
     "rssm_dyn_bptt_eligible",
 ]
+
+
+def dyn_bptt_setting(cfg) -> bool:
+    """The ``algo.world_model.dyn_bptt`` config knob with its
+    ``SHEEPRL_DYN_BPTT`` env override (shared by every Dreamer-family
+    train fn; callers AND their own structural eligibility check, e.g.
+    :func:`rssm_dyn_bptt_eligible` or a supported-activation test)."""
+    enabled = bool(cfg.algo.world_model.get("dyn_bptt", False))
+    if os.environ.get("SHEEPRL_DYN_BPTT") is not None:
+        enabled = os.environ["SHEEPRL_DYN_BPTT"].lower() not in ("0", "false")
+    return enabled
 
 
 class DynParams(NamedTuple):
@@ -450,6 +466,291 @@ def _get_op(
 
     op.defvjp(op_fwd, op_bwd)
     return op
+
+
+class V1DynParams(NamedTuple):
+    """Raw weight leaves of the DV1 (Gaussian-latent) dynamic step.
+
+    w_proj (S+A, P) / b_proj (P,)  recurrent model input projection
+                                   (``RecurrentModel.Dense_0`` — bias present)
+    w_i    (P, 3H) / b_i (3H,)     flax GRUCell input kernels [ir|iz|in]
+    w_h    (H, 3H) / b_hn (H,)     flax GRUCell hidden kernels [hr|hz|hn]
+                                   (only ``hn`` has a bias)
+    k_h    (H, R)                  representation trunk, h-side rows of the
+                                   first Dense (embed-side rows + bias live
+                                   in the precomputed ``emb_proj``)
+    head_k (R, 2S) / head_b (2S,)  (mean, std) head (f32 matmul)
+    """
+
+    w_proj: jax.Array
+    b_proj: jax.Array
+    w_i: jax.Array
+    b_i: jax.Array
+    w_h: jax.Array
+    b_hn: jax.Array
+    k_h: jax.Array
+    head_k: jax.Array
+    head_b: jax.Array
+
+
+@functools.lru_cache(maxsize=16)
+def _get_op_v1(min_std: float, dt_name: str, unroll: int, act: str):
+    """Efficient-BPTT op for the DV1 continuous-latent dynamic recurrence.
+
+    The DV1 chain (``dreamer_v1.agent.RSSM.dynamic_posterior_from_proj``;
+    reference sheeprl dreamer_v1/agent.py RSSM.dynamic:97 +
+    dreamer_v1/utils.py:80) is simpler than V3's: reparameterized Gaussian
+    sampling instead of straight-through/unimix, a plain flax GRUCell
+    instead of the Hafner LayerNorm GRU, no LayerNorms anywhere, and no
+    is_first resets.  The efficient-BPTT design is identical: forward is
+    the plain XLA ``lax.scan`` saving only (hs, zs); backward recomputes
+    all activations in batched (T*B) matmuls and runs a reverse scan whose
+    carry is only (dh, dz), with every weight gradient one batched
+    contraction outside the loop.
+    """
+    dt = jnp.dtype(dt_name)
+    f32 = jnp.float32
+
+    def _gru_fwd(params: V1DynParams, h, feat32):
+        """flax nn.GRUCell numerics: r/z gates, reset applied to the
+        hidden-side candidate product, new_h = (1-z)*n + z*h."""
+        hidden = h.shape[-1]
+        gi = feat32 @ params.w_i.astype(f32) + params.b_i.astype(f32)
+        gh = h @ params.w_h.astype(f32)
+        r = jax.nn.sigmoid(gi[..., :hidden] + gh[..., :hidden])
+        u = jax.nn.sigmoid(gi[..., hidden : 2 * hidden] + gh[..., hidden : 2 * hidden])
+        ghn = gh[..., 2 * hidden :] + params.b_hn.astype(f32)
+        n = jnp.tanh(gi[..., 2 * hidden :] + r * ghn)
+        return (1.0 - u) * n + u * h, (r, u, n, ghn)
+
+    def _step_fwd(params: V1DynParams, carry, inp):
+        z, h = carry
+        a, emb, n_t = inp
+        fpre = (
+            jnp.concatenate([z, a], -1).astype(dt) @ params.w_proj.astype(dt)
+            + params.b_proj.astype(dt)
+        )
+        feat32 = _act_fwd(fpre, act).astype(f32)
+        h_new, _ = _gru_fwd(params, h, feat32)
+        xpre = h_new.astype(dt) @ params.k_h.astype(dt) + emb
+        x = _act_fwd(xpre, act)
+        ms = x.astype(f32) @ params.head_k + params.head_b
+        mean, stdraw = jnp.split(ms, 2, -1)
+        std = jax.nn.softplus(stdraw) + min_std
+        z_new = mean + std * n_t
+        return (z_new, h_new), (h_new, z_new, mean, std)
+
+    def _fwd_scan(z0, h0, actions, emb_proj, noise, params):
+        step = functools.partial(_step_fwd, params)
+        _, (hs, zs, means, stds) = jax.lax.scan(
+            step, (z0, h0), (actions, emb_proj, noise), unroll=unroll
+        )
+        return hs, zs, means, stds
+
+    @jax.custom_vjp
+    def op(z0, h0, actions, emb_proj, noise, params):
+        return _fwd_scan(z0, h0, actions, emb_proj, noise, params)
+
+    def op_fwd(z0, h0, actions, emb_proj, noise, params):
+        hs, zs, means, stds = _fwd_scan(z0, h0, actions, emb_proj, noise, params)
+        return (hs, zs, means, stds), (z0, h0, actions, emb_proj, noise, params, hs, zs)
+
+    def op_bwd(res, cots):
+        z0, h0, actions, emb_proj, noise, params, hs, zs = res
+        d_hs, d_zs, d_means, d_stds = cots
+        T, b = hs.shape[:2]
+        hidden = h0.shape[-1]
+        stoch = z0.shape[-1]
+
+        # ---- batched recompute of every step's activations from the saved
+        # states (one (T*B) matmul per layer, nothing sequential)
+        z_prev = jnp.concatenate([z0[None], zs[:-1]], 0)
+        h_prev = jnp.concatenate([h0[None], hs[:-1]], 0)
+        inp_p32 = jnp.concatenate([z_prev, actions.astype(f32)], -1)
+        fpre_dt = (
+            inp_p32.astype(dt) @ params.w_proj.astype(dt) + params.b_proj.astype(dt)
+        )
+        feat32 = _act_fwd(fpre_dt, act).astype(f32)
+        gi = feat32 @ params.w_i.astype(f32) + params.b_i.astype(f32)
+        gh = h_prev @ params.w_h.astype(f32)
+        r = jax.nn.sigmoid(gi[..., :hidden] + gh[..., :hidden])
+        u = jax.nn.sigmoid(gi[..., hidden : 2 * hidden] + gh[..., hidden : 2 * hidden])
+        ghn = gh[..., 2 * hidden :] + params.b_hn.astype(f32)
+        n_cand = jnp.tanh(gi[..., 2 * hidden :] + r * ghn)
+        xpre_dt = hs.astype(dt) @ params.k_h.astype(dt) + emb_proj
+        x32 = _act_fwd(xpre_dt, act).astype(f32)
+        ms = x32 @ params.head_k + params.head_b
+        stdraw = ms[..., stoch:]
+        sig_std = jax.nn.sigmoid(stdraw)  # d softplus
+
+        w_i32 = params.w_i.astype(f32)
+        w_h32 = params.w_h.astype(f32)
+        w_proj_z32 = params.w_proj[:stoch].astype(f32)
+        k_h32 = params.k_h.astype(f32)
+        head_k32 = params.head_k.astype(f32)
+
+        def back_step(carry, inp_t):
+            dh_c, dz_c = carry
+            (
+                d_hs_t,
+                d_zs_t,
+                d_mean_t,
+                d_std_t,
+                noise_t,
+                sig_t,
+                actin_r_t,
+                h_prev_t,
+                r_t,
+                u_t,
+                n_t,
+                ghn_t,
+                actin_p_t,
+            ) = inp_t
+
+            # reparameterized-sample backward into the (mean, std) head
+            dz_tot = d_zs_t + dz_c
+            dmean = dz_tot + d_mean_t
+            dstd = dz_tot * noise_t + d_std_t
+            dms = jnp.concatenate([dmean, dstd * sig_t], -1)
+
+            # representation trunk backward
+            dx32 = dms @ head_k32.T
+            dxpre = dx32 * _act_grad(actin_r_t.astype(f32), act)
+            dh_rep = dxpre @ k_h32.T
+
+            # flax-GRUCell backward
+            dh_tot = d_hs_t + dh_c + dh_rep
+            du = (h_prev_t - n_t) * dh_tot
+            dn = (1.0 - u_t) * dh_tot
+            dh_direct = u_t * dh_tot
+            dtanh = dn * (1.0 - n_t * n_t)
+            dr = dtanh * ghn_t
+            dghn = dtanh * r_t
+            du_pre = du * u_t * (1.0 - u_t)
+            dr_pre = dr * r_t * (1.0 - r_t)
+            dgi = jnp.concatenate([dr_pre, du_pre, dtanh], -1)
+            dgh = jnp.concatenate([dr_pre, du_pre, dghn], -1)
+            dh_prev = dh_direct + dgh @ w_h32.T
+            dfeat = dgi @ w_i32.T
+
+            # input projection backward
+            dfpre = dfeat * _act_grad(actin_p_t.astype(f32), act)
+            dz_prev = dfpre @ w_proj_z32.T
+            return (dh_prev, dz_prev), (dms, dxpre, dgi, dgh, dfpre)
+
+        seq = (
+            d_hs.astype(f32),
+            d_zs.astype(f32),
+            d_means.astype(f32),
+            d_stds.astype(f32),
+            noise,
+            sig_std,
+            xpre_dt,
+            h_prev,
+            r,
+            u,
+            n_cand,
+            ghn,
+            fpre_dt,
+        )
+        (dh0, dz0), (dms_s, dxpre_s, dgi_s, dgh_s, dfpre_s) = jax.lax.scan(
+            back_step,
+            (jnp.zeros_like(h0, f32), jnp.zeros_like(z0, f32)),
+            seq,
+            reverse=True,
+            unroll=unroll,
+        )
+
+        # ---- weight gradients: one batched contraction each
+        tb = T * b
+        grads = V1DynParams(
+            w_proj=(inp_p32.reshape(tb, -1).T @ dfpre_s.reshape(tb, -1)).astype(
+                params.w_proj.dtype
+            ),
+            b_proj=dfpre_s.sum((0, 1)).astype(params.b_proj.dtype),
+            w_i=(feat32.reshape(tb, -1).T @ dgi_s.reshape(tb, -1)).astype(params.w_i.dtype),
+            b_i=dgi_s.sum((0, 1)).astype(params.b_i.dtype),
+            w_h=(h_prev.reshape(tb, -1).T @ dgh_s.reshape(tb, -1)).astype(params.w_h.dtype),
+            b_hn=dgh_s[..., 2 * hidden :].sum((0, 1)).astype(params.b_hn.dtype),
+            k_h=(hs.reshape(tb, hidden).T @ dxpre_s.reshape(tb, -1)).astype(
+                params.k_h.dtype
+            ),
+            head_k=(x32.reshape(tb, -1).T @ dms_s.reshape(tb, -1)).astype(
+                params.head_k.dtype
+            ),
+            head_b=dms_s.sum((0, 1)).astype(params.head_b.dtype),
+        )
+        d_actions = (dfpre_s @ params.w_proj[stoch:].astype(f32).T).astype(actions.dtype)
+        d_emb = dxpre_s.astype(emb_proj.dtype)
+        return (
+            dz0.astype(z0.dtype),
+            dh0.astype(h0.dtype),
+            d_actions,
+            d_emb,
+            jnp.zeros_like(noise),
+            grads,
+        )
+
+    op.defvjp(op_fwd, op_bwd)
+    return op
+
+
+def extract_dyn_params_v1(rssm_variables, hidden: int) -> V1DynParams:
+    """Pull the DV1 op's raw weight leaves out of a bound DV1 RSSM param
+    tree (``wm_params["rssm"]``).  Plain dict indexing/slicing so autodiff
+    routes the op's weight cotangents back into the original tree; the
+    embed-side rows of the representation Dense get their gradient through
+    the ``representation_embed_proj`` path."""
+    p = rssm_variables["params"]
+    lin = p["recurrent_model"]["Dense_0"]
+    gru = p["recurrent_model"]["GRUCell_0"]
+    rep_lin = p["representation_model"]["DenseActLn_0"]["Dense_0"]
+    head = p["representation_model"]["Dense_0"]
+    return V1DynParams(
+        w_proj=lin["kernel"],
+        b_proj=lin["bias"],
+        w_i=jnp.concatenate(
+            [gru["ir"]["kernel"], gru["iz"]["kernel"], gru["in"]["kernel"]], -1
+        ),
+        b_i=jnp.concatenate([gru["ir"]["bias"], gru["iz"]["bias"], gru["in"]["bias"]], -1),
+        w_h=jnp.concatenate(
+            [gru["hr"]["kernel"], gru["hz"]["kernel"], gru["hn"]["kernel"]], -1
+        ),
+        b_hn=gru["hn"]["bias"],
+        k_h=rep_lin["kernel"][:hidden],
+        head_k=head["kernel"],
+        head_b=head["bias"],
+    )
+
+
+def dyn_rssm_sequence_v1(
+    z0,
+    h0,
+    actions,
+    emb_proj,
+    noise,
+    params: V1DynParams,
+    *,
+    min_std: float = 0.1,
+    matmul_dtype=jnp.float32,
+    unroll: int = 1,
+    act: str = "elu",
+):
+    """Run the DV1 T-step dynamic recurrence with the efficient-BPTT VJP.
+
+    z0 (B, S) f32 Gaussian posterior sample; h0 (B, H); actions (T, B, A);
+    emb_proj (T, B, R) in the compute dtype (embed-side projection incl.
+    the Dense bias, ``RSSM.representation_embed_proj``); noise (T, B, S)
+    pre-drawn standard normal.  No is_first gating — DV1 sequences cross
+    episode boundaries (reference dreamer_v1/agent.py dynamic:97).
+
+    Returns (hs (T,B,H) f32, zs (T,B,S) f32, means (T,B,S) f32,
+    stds (T,B,S) f32); ``zs`` is the reparameterized sample
+    ``mean + std * noise`` so gradients flow through both moments,
+    exactly like scanning ``dynamic_posterior_from_proj``.
+    """
+    op = _get_op_v1(float(min_std), jnp.dtype(matmul_dtype).name, int(unroll), str(act))
+    return op(z0, h0, actions, emb_proj, noise, params)
 
 
 def rssm_dyn_bptt_eligible(rssm) -> bool:
