@@ -43,6 +43,7 @@ from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.config.compose import _locate
 from sheeprl_tpu.data.feed import batched_feed
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
+from sheeprl_tpu.data.device_buffer import DeviceReplayCache
 from sheeprl_tpu.envs.wrappers import RestartOnException
 from sheeprl_tpu.ops.dyn_bptt import (
     dyn_bptt_setting,
@@ -752,7 +753,18 @@ def main(runtime, cfg: Dict[str, Any]):
     if state and cfg.buffer.checkpoint:
         rb = restore_buffer(state["rb"], memmap=cfg.buffer.memmap)
 
+    # HBM-resident replay window + on-device sampling (data/device_buffer.py):
+    # on remote-link single-chip setups the host feed re-uploads ~12.6 MB per
+    # gradient step at ~10-14 MB/s — the cache cuts that to one on-device
+    # gather, leaving only new frames (n_envs x ~12 KB/step) on the link
+    device_cache = DeviceReplayCache.maybe_create(
+        cfg, runtime, capacity=max(buffer_size, 2), n_envs=total_envs
+    )
+    if device_cache is not None and state and cfg.buffer.checkpoint:
+        device_cache.load_from(rb)
+
     train_step = 0
+    train_metrics = None
     last_train = 0
     start_iter = (state["iter_num"] // world_size) + 1 if state else 1
     policy_step = state["iter_num"] * cfg.env.num_envs if state else 0
@@ -817,6 +829,8 @@ def main(runtime, cfg: Dict[str, Any]):
 
             step_data["actions"] = np.asarray(actions).reshape(1, total_envs, -1)
             rb.add(step_data, validate_args=cfg.buffer.validate_args)
+            if device_cache is not None:
+                device_cache.add(step_data)
 
             next_obs, rewards, terminated, truncated, infos = envs.step(
                 np.asarray(real_actions).reshape(envs.action_space.shape)
@@ -875,6 +889,8 @@ def main(runtime, cfg: Dict[str, Any]):
             reset_data["rewards"] = step_data["rewards"][:, dones_idxes]
             reset_data["is_first"] = np.zeros_like(reset_data["terminated"])
             rb.add(reset_data, dones_idxes, validate_args=cfg.buffer.validate_args)
+            if device_cache is not None:
+                device_cache.add(reset_data, dones_idxes)
 
             step_data["rewards"][:, dones_idxes] = np.zeros_like(reset_data["rewards"])
             step_data["terminated"][:, dones_idxes] = np.zeros_like(step_data["terminated"][:, dones_idxes])
@@ -887,31 +903,51 @@ def main(runtime, cfg: Dict[str, Any]):
             ratio_steps = policy_step - prefill_steps * policy_steps_per_iter
             per_rank_gradient_steps = ratio(ratio_steps / world_size)
             if per_rank_gradient_steps > 0:
-                local_data = rb.sample(
-                    cfg.algo.per_rank_batch_size * world_size,
-                    sequence_length=cfg.algo.per_rank_sequence_length,
-                    n_samples=per_rank_gradient_steps,
+                def _grad_step(batch):
+                    nonlocal params, opt_states, moments_state, train_metrics
+                    nonlocal cumulative_per_rank_gradient_steps
+                    if (
+                        cumulative_per_rank_gradient_steps
+                        % cfg.algo.critic.per_rank_target_network_update_freq
+                        == 0
+                    ):
+                        tau = 1.0 if cumulative_per_rank_gradient_steps == 0 else cfg.algo.critic.tau
+                        params["target_critic"] = _ema(
+                            params["critic"], params["target_critic"], tau
+                        )
+                    params, opt_states, moments_state, train_metrics = train_fn(
+                        params, opt_states, moments_state, batch, runtime.next_key()
+                    )
+                    cumulative_per_rank_gradient_steps += 1
+
+                use_device_cache = device_cache is not None and device_cache.can_sample(
+                    cfg.algo.per_rank_sequence_length
                 )
+                if not use_device_cache:
+                    local_data = rb.sample(
+                        cfg.algo.per_rank_batch_size * world_size,
+                        sequence_length=cfg.algo.per_rank_sequence_length,
+                        n_samples=per_rank_gradient_steps,
+                    )
                 with timer("Time/train_time", SumMetric, sync_on_compute=cfg.metric.sync_on_compute):
-                    with batched_feed(
-                        local_data,
-                        per_rank_gradient_steps,
-                        sharding=runtime.batch_sharding(axis=1),
-                    ) as feed:
-                        for batch in feed:
-                            if (
-                                cumulative_per_rank_gradient_steps
-                                % cfg.algo.critic.per_rank_target_network_update_freq
-                                == 0
-                            ):
-                                tau = 1.0 if cumulative_per_rank_gradient_steps == 0 else cfg.algo.critic.tau
-                                params["target_critic"] = _ema(
-                                    params["critic"], params["target_critic"], tau
-                                )
-                            params, opt_states, moments_state, train_metrics = train_fn(
-                                params, opt_states, moments_state, batch, runtime.next_key()
-                            )
-                            cumulative_per_rank_gradient_steps += 1
+                    if use_device_cache:
+                        # on-device gather feeds the jitted step directly —
+                        # no host batch assembly, nothing on the link
+                        for batch in device_cache.sample(
+                            per_rank_gradient_steps,
+                            cfg.algo.per_rank_batch_size * world_size,
+                            cfg.algo.per_rank_sequence_length,
+                            runtime.next_key(),
+                        ):
+                            _grad_step(batch)
+                    else:
+                        with batched_feed(
+                            local_data,
+                            per_rank_gradient_steps,
+                            sharding=runtime.batch_sharding(axis=1),
+                        ) as feed:
+                            for batch in feed:
+                                _grad_step(batch)
                     train_step += world_size
                 player.params = {"world_model": params["world_model"], "actor": params["actor"]}
                 # metric.fetch_every amortizes the per-iteration device

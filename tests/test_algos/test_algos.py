@@ -305,6 +305,27 @@ def test_dreamer_v3(standard_args, devices, tmp_path):
     _run(args)
 
 
+def test_dreamer_v3_device_cache(standard_args, tmp_path):
+    """End-to-end with the HBM-resident replay cache sampling on device
+    (buffer.device_cache=True forces it on the CPU test platform), incl.
+    checkpoint-resume re-filling the cache from the restored host buffer."""
+    args = standard_args + _dv3_tiny_args() + [
+        "exp=dreamer_v3",
+        "env=dummy",
+        "algo.mlp_keys.encoder=[state]",
+        "algo.cnn_keys.encoder=[rgb]",
+        "buffer.device_cache=True",
+        "fabric.devices=1",
+        f"root_dir={tmp_path}/dv3cache",
+    ]
+    _run(args)
+    import glob
+
+    ckpts = sorted(glob.glob(f"{tmp_path}/dv3cache/**/ckpt_*.ckpt", recursive=True))
+    assert ckpts
+    _run(args + [f"checkpoint.resume_from={ckpts[-1]}"])
+
+
 def test_dreamer_v3_fused_gru(standard_args, tmp_path):
     """End-to-end with the Pallas fused GRU routed in (interpret mode on CPU)."""
     args = standard_args + _dv3_tiny_args() + [
