@@ -41,9 +41,8 @@ from sheeprl_tpu.algos.dreamer_v3.utils import (
 )
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.config.compose import _locate
-from sheeprl_tpu.data.feed import batched_feed
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
-from sheeprl_tpu.data.device_buffer import DeviceReplayCache
+from sheeprl_tpu.data.device_buffer import maybe_create_for, sequence_batches
 from sheeprl_tpu.envs.wrappers import RestartOnException
 from sheeprl_tpu.ops.dyn_bptt import (
     dyn_bptt_setting,
@@ -757,11 +756,9 @@ def main(runtime, cfg: Dict[str, Any]):
     # on remote-link single-chip setups the host feed re-uploads ~12.6 MB per
     # gradient step at ~10-14 MB/s — the cache cuts that to one on-device
     # gather, leaving only new frames (n_envs x ~12 KB/step) on the link
-    device_cache = DeviceReplayCache.maybe_create(
-        cfg, runtime, capacity=max(buffer_size, 2), n_envs=total_envs
+    device_cache = maybe_create_for(
+        cfg, runtime, rb, state if state and cfg.buffer.checkpoint else None
     )
-    if device_cache is not None and state and cfg.buffer.checkpoint:
-        device_cache.load_from(rb)
 
     train_step = 0
     train_metrics = None
@@ -920,34 +917,14 @@ def main(runtime, cfg: Dict[str, Any]):
                     )
                     cumulative_per_rank_gradient_steps += 1
 
-                use_device_cache = device_cache is not None and device_cache.can_sample(
-                    cfg.algo.per_rank_sequence_length
-                )
-                if not use_device_cache:
-                    local_data = rb.sample(
-                        cfg.algo.per_rank_batch_size * world_size,
-                        sequence_length=cfg.algo.per_rank_sequence_length,
-                        n_samples=per_rank_gradient_steps,
-                    )
-                with timer("Time/train_time", SumMetric, sync_on_compute=cfg.metric.sync_on_compute):
-                    if use_device_cache:
-                        # on-device gather feeds the jitted step directly —
-                        # no host batch assembly, nothing on the link
-                        for batch in device_cache.sample(
-                            per_rank_gradient_steps,
-                            cfg.algo.per_rank_batch_size * world_size,
-                            cfg.algo.per_rank_sequence_length,
-                            runtime.next_key(),
-                        ):
+                with sequence_batches(
+                    rb, device_cache, runtime, per_rank_gradient_steps,
+                    cfg.algo.per_rank_batch_size * world_size,
+                    cfg.algo.per_rank_sequence_length, runtime.next_key(),
+                ) as feed:
+                    with timer("Time/train_time", SumMetric, sync_on_compute=cfg.metric.sync_on_compute):
+                        for batch in feed:
                             _grad_step(batch)
-                    else:
-                        with batched_feed(
-                            local_data,
-                            per_rank_gradient_steps,
-                            sharding=runtime.batch_sharding(axis=1),
-                        ) as feed:
-                            for batch in feed:
-                                _grad_step(batch)
                     train_step += world_size
                 player.params = {"world_model": params["world_model"], "actor": params["actor"]}
                 # metric.fetch_every amortizes the per-iteration device

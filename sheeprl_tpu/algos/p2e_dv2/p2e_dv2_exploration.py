@@ -37,7 +37,7 @@ from sheeprl_tpu.algos.dreamer_v2.loss import reconstruction_loss
 from sheeprl_tpu.algos.dreamer_v2.utils import compute_lambda_values, prepare_obs, test
 from sheeprl_tpu.algos.p2e_dv2.agent import build_agent, make_player
 from sheeprl_tpu.config import instantiate
-from sheeprl_tpu.data.feed import batched_feed
+from sheeprl_tpu.data.device_buffer import maybe_create_for, sequence_batches
 from sheeprl_tpu.data.buffers import (
     EnvIndependentReplayBuffer,
     EpisodeBuffer,
@@ -562,6 +562,8 @@ def main(runtime, cfg: Dict[str, Any]):
         )
     if state and cfg.buffer.checkpoint:
         rb = restore_buffer(state["rb"], memmap=cfg.buffer.memmap)
+    # HBM-resident replay window + on-device sampling (data/device_buffer.py)
+    device_cache = maybe_create_for(cfg, runtime, rb, state)
 
     train_step = 0
     last_train = 0
@@ -613,6 +615,8 @@ def main(runtime, cfg: Dict[str, Any]):
     step_data["rewards"] = np.zeros((1, total_envs, 1))
     step_data["is_first"] = np.ones_like(step_data["terminated"])
     rb.add(step_data, validate_args=cfg.buffer.validate_args)
+    if device_cache is not None:
+        device_cache.add(step_data)
     player.init_states()
 
     cumulative_per_rank_gradient_steps = 0
@@ -674,6 +678,8 @@ def main(runtime, cfg: Dict[str, Any]):
         step_data["actions"] = np.asarray(actions).reshape(1, total_envs, -1)
         step_data["rewards"] = clip_rewards_fn(rewards.reshape((1, total_envs, -1)))
         rb.add(step_data, validate_args=cfg.buffer.validate_args)
+        if device_cache is not None:
+            device_cache.add(step_data)
 
         dones_idxes = dones.nonzero()[0].tolist()
         reset_envs = len(dones_idxes)
@@ -687,6 +693,8 @@ def main(runtime, cfg: Dict[str, Any]):
             reset_data["rewards"] = np.zeros((1, reset_envs, 1))
             reset_data["is_first"] = np.ones_like(reset_data["terminated"])
             rb.add(reset_data, dones_idxes, validate_args=cfg.buffer.validate_args)
+            if device_cache is not None:
+                device_cache.add(reset_data, dones_idxes)
             step_data["terminated"][:, dones_idxes] = 0.0
             step_data["truncated"][:, dones_idxes] = 0.0
             player.init_states(reset_envs=dones_idxes)
@@ -696,18 +704,13 @@ def main(runtime, cfg: Dict[str, Any]):
             ratio_steps = policy_step - prefill_steps * policy_steps_per_iter
             per_rank_gradient_steps = ratio(ratio_steps / world_size)
             if per_rank_gradient_steps > 0:
-                local_data = rb.sample(
+                with sequence_batches(
+                    rb, device_cache, runtime, per_rank_gradient_steps,
                     cfg.algo.per_rank_batch_size * world_size,
-                    sequence_length=cfg.algo.per_rank_sequence_length,
-                    n_samples=per_rank_gradient_steps,
+                    cfg.algo.per_rank_sequence_length, runtime.next_key(),
                     prioritize_ends=cfg.buffer.get("prioritize_ends", False),
-                )
-                with timer("Time/train_time", SumMetric, sync_on_compute=cfg.metric.sync_on_compute):
-                    with batched_feed(
-                        local_data,
-                        per_rank_gradient_steps,
-                        sharding=runtime.batch_sharding(axis=1),
-                    ) as feed:
+                ) as feed:
+                    with timer("Time/train_time", SumMetric, sync_on_compute=cfg.metric.sync_on_compute):
                         for batch in feed:
                             if (
                                 cumulative_per_rank_gradient_steps

@@ -25,7 +25,7 @@ from sheeprl_tpu.algos.dreamer_v2.utils import prepare_obs, test
 from sheeprl_tpu.algos.p2e_dv2.agent import build_agent, make_player
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.config.compose import yaml_load
-from sheeprl_tpu.data.feed import batched_feed
+from sheeprl_tpu.data.device_buffer import maybe_create_for, sequence_batches
 from sheeprl_tpu.data.buffers import (
     EnvIndependentReplayBuffer,
     EpisodeBuffer,
@@ -194,9 +194,15 @@ def main(runtime, cfg: Dict[str, Any]):
         raise ValueError(
             f"Unrecognized buffer type: must be one of `sequential` or `episode`, received: {buffer_type}"
         )
+    restored_rb = False
     if (resume_from_checkpoint or cfg.buffer.get("load_from_exploration", False)) and "rb" in state:
         rb = restore_buffer(state["rb"], memmap=cfg.buffer.memmap)
+        restored_rb = True
 
+    # HBM-resident replay window + on-device sampling (data/device_buffer.py)
+    device_cache = maybe_create_for(
+        cfg, runtime, rb, state if restored_rb else None
+    )
     train_step = 0
     last_train = 0
     start_iter = (state["iter_num"] // world_size) + 1 if resume_from_checkpoint else 1
@@ -239,6 +245,8 @@ def main(runtime, cfg: Dict[str, Any]):
     step_data["rewards"] = np.zeros((1, total_envs, 1))
     step_data["is_first"] = np.ones_like(step_data["terminated"])
     rb.add(step_data, validate_args=cfg.buffer.validate_args)
+    if device_cache is not None:
+        device_cache.add(step_data)
     player.init_states()
 
     cumulative_per_rank_gradient_steps = 0
@@ -289,6 +297,8 @@ def main(runtime, cfg: Dict[str, Any]):
         step_data["actions"] = np.asarray(actions).reshape(1, total_envs, -1)
         step_data["rewards"] = clip_rewards_fn(rewards.reshape((1, total_envs, -1)))
         rb.add(step_data, validate_args=cfg.buffer.validate_args)
+        if device_cache is not None:
+            device_cache.add(step_data)
 
         dones_idxes = dones.nonzero()[0].tolist()
         reset_envs = len(dones_idxes)
@@ -302,6 +312,8 @@ def main(runtime, cfg: Dict[str, Any]):
             reset_data["rewards"] = np.zeros((1, reset_envs, 1))
             reset_data["is_first"] = np.ones_like(reset_data["terminated"])
             rb.add(reset_data, dones_idxes, validate_args=cfg.buffer.validate_args)
+            if device_cache is not None:
+                device_cache.add(reset_data, dones_idxes)
             step_data["terminated"][:, dones_idxes] = 0.0
             step_data["truncated"][:, dones_idxes] = 0.0
             player.init_states(reset_envs=dones_idxes)
@@ -319,18 +331,13 @@ def main(runtime, cfg: Dict[str, Any]):
                         "world_model": dv2_params["world_model"],
                         "actor": dv2_params["actor"],
                     }
-                local_data = rb.sample(
+                with sequence_batches(
+                    rb, device_cache, runtime, per_rank_gradient_steps,
                     cfg.algo.per_rank_batch_size * world_size,
-                    sequence_length=cfg.algo.per_rank_sequence_length,
-                    n_samples=per_rank_gradient_steps,
+                    cfg.algo.per_rank_sequence_length, runtime.next_key(),
                     prioritize_ends=cfg.buffer.get("prioritize_ends", False),
-                )
-                with timer("Time/train_time", SumMetric, sync_on_compute=cfg.metric.sync_on_compute):
-                    with batched_feed(
-                        local_data,
-                        per_rank_gradient_steps,
-                        sharding=runtime.batch_sharding(axis=1),
-                    ) as feed:
+                ) as feed:
+                    with timer("Time/train_time", SumMetric, sync_on_compute=cfg.metric.sync_on_compute):
                         for batch in feed:
                             if (
                                 cumulative_per_rank_gradient_steps

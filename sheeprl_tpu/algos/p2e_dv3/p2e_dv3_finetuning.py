@@ -25,7 +25,7 @@ from sheeprl_tpu.algos.dreamer_v3.utils import init_moments, prepare_obs, test
 from sheeprl_tpu.algos.p2e_dv3.agent import build_agent, make_player
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.config.compose import yaml_load
-from sheeprl_tpu.data.feed import batched_feed
+from sheeprl_tpu.data.device_buffer import maybe_create_for, sequence_batches
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
 from sheeprl_tpu.utils.callback import CheckpointCallback, load_checkpoint, restore_buffer
 from sheeprl_tpu.utils.env import make_env
@@ -177,9 +177,15 @@ def main(runtime, cfg: Dict[str, Any]):
         memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{runtime.global_rank}"),
         buffer_cls=SequentialReplayBuffer,
     )
+    restored_rb = False
     if (resume_from_checkpoint or cfg.buffer.get("load_from_exploration", False)) and "rb" in state:
         rb = restore_buffer(state["rb"], memmap=cfg.buffer.memmap)
+        restored_rb = True
 
+    # HBM-resident replay window + on-device sampling (data/device_buffer.py)
+    device_cache = maybe_create_for(
+        cfg, runtime, rb, state if restored_rb else None
+    )
     train_step = 0
     last_train = 0
     start_iter = (state["iter_num"] // world_size) + 1 if resume_from_checkpoint else 1
@@ -233,6 +239,8 @@ def main(runtime, cfg: Dict[str, Any]):
 
             step_data["actions"] = np.asarray(actions).reshape(1, total_envs, -1)
             rb.add(step_data, validate_args=cfg.buffer.validate_args)
+            if device_cache is not None:
+                device_cache.add(step_data)
 
             next_obs, rewards, terminated, truncated, infos = envs.step(
                 np.asarray(real_actions).reshape(envs.action_space.shape)
@@ -277,6 +285,8 @@ def main(runtime, cfg: Dict[str, Any]):
             reset_data["rewards"] = step_data["rewards"][:, dones_idxes]
             reset_data["is_first"] = np.zeros_like(reset_data["terminated"])
             rb.add(reset_data, dones_idxes, validate_args=cfg.buffer.validate_args)
+            if device_cache is not None:
+                device_cache.add(reset_data, dones_idxes)
             step_data["rewards"][:, dones_idxes] = np.zeros_like(reset_data["rewards"])
             step_data["terminated"][:, dones_idxes] = np.zeros_like(step_data["terminated"][:, dones_idxes])
             step_data["truncated"][:, dones_idxes] = np.zeros_like(step_data["truncated"][:, dones_idxes])
@@ -294,17 +304,12 @@ def main(runtime, cfg: Dict[str, Any]):
                         "world_model": dv3_params["world_model"],
                         "actor": dv3_params["actor"],
                     }
-                local_data = rb.sample(
+                with sequence_batches(
+                    rb, device_cache, runtime, per_rank_gradient_steps,
                     cfg.algo.per_rank_batch_size * world_size,
-                    sequence_length=cfg.algo.per_rank_sequence_length,
-                    n_samples=per_rank_gradient_steps,
-                )
-                with timer("Time/train_time", SumMetric, sync_on_compute=cfg.metric.sync_on_compute):
-                    with batched_feed(
-                        local_data,
-                        per_rank_gradient_steps,
-                        sharding=runtime.batch_sharding(axis=1),
-                    ) as feed:
+                    cfg.algo.per_rank_sequence_length, runtime.next_key(),
+                ) as feed:
+                    with timer("Time/train_time", SumMetric, sync_on_compute=cfg.metric.sync_on_compute):
                         for batch in feed:
                             if (
                                 cumulative_per_rank_gradient_steps

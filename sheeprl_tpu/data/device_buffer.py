@@ -110,6 +110,48 @@ def _sample(bufs, key, pos, filled, *, n_samples, batch_size, seq_len, cap, n_en
     return out
 
 
+@contextlib.contextmanager
+def sequence_batches(rb, device_cache, runtime, n_samples, batch_size, seq_len, key, **sample_kwargs):
+    """Uniform train-loop feed: yields an iterable of per-gradient-step
+    batch dicts — an on-device gather when the cache is usable, else the
+    host ``rb.sample`` + ``batched_feed`` prefetch path.  Call OUTSIDE the
+    train timer so host sampling keeps its historical accounting.
+    ``sample_kwargs`` (e.g. DV2's prioritize_ends) go to the host sampler;
+    the cache path only exists for plain sequential buffers, where they
+    are no-ops."""
+    if device_cache is not None and device_cache.can_sample(seq_len):
+        yield device_cache.sample(n_samples, batch_size, seq_len, key)
+        return
+    from sheeprl_tpu.data.feed import batched_feed
+
+    local_data = rb.sample(
+        batch_size, sequence_length=seq_len, n_samples=n_samples, **sample_kwargs
+    )
+    with batched_feed(
+        local_data, n_samples, sharding=runtime.batch_sharding(axis=1)
+    ) as feed:
+        yield feed
+
+
+def maybe_create_for(cfg, runtime, rb, state=None):
+    """One-line factory for the training loops: a cache mirroring ``rb``
+    when it is an EnvIndependentReplayBuffer and gating allows (EpisodeBuffer
+    replay — DV2's prioritize_ends mode — keeps the host path).  Pass
+    ``state`` iff ``rb`` was restored from a checkpoint — the cache then
+    refills from it (a non-restored rb is empty, so the refill is a no-op
+    either way; the flag just documents intent at the call sites)."""
+    from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer
+
+    if not isinstance(rb, EnvIndependentReplayBuffer):
+        return None
+    cache = DeviceReplayCache.maybe_create(
+        cfg, runtime, capacity=rb.buffer_size, n_envs=rb.n_envs
+    )
+    if cache is not None and state is not None:
+        cache.load_from(rb)
+    return cache
+
+
 class DeviceReplayCache:
     """Device mirror of a sequential replay buffer (see module docstring).
 
