@@ -24,6 +24,7 @@ from sheeprl_tpu.algos.sac.sac import _make_optimizer
 from sheeprl_tpu.algos.sac.utils import prepare_obs, test
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.data.device_buffer import maybe_create_for_transitions
 from sheeprl_tpu.utils.callback import CheckpointCallback, load_checkpoint, restore_buffer
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -189,6 +190,10 @@ def main(runtime, cfg: Dict[str, Any]):
     )
     if state and cfg.buffer.checkpoint:
         rb = restore_buffer(state["rb"], memmap=cfg.buffer.memmap)
+    # HBM-resident replay window + on-device sampling (data/device_buffer.py)
+    device_cache = maybe_create_for_transitions(
+        cfg, runtime, rb, state if state and cfg.buffer.checkpoint else None
+    )
 
     last_train = 0
     train_step = 0
@@ -256,6 +261,8 @@ def main(runtime, cfg: Dict[str, Any]):
             step_data["next_observations"] = flat_next_obs[np.newaxis]
         step_data["rewards"] = rewards[np.newaxis].astype(np.float32)
         rb.add(step_data, validate_args=cfg.buffer.validate_args)
+        if device_cache is not None:
+            device_cache.add(step_data)
         obs = next_obs
 
         if iter_num >= learning_starts:
@@ -265,20 +272,41 @@ def main(runtime, cfg: Dict[str, Any]):
             if per_rank_gradient_steps > 0:
                 g = per_rank_gradient_steps
                 bs = cfg.algo.per_rank_batch_size * world_size
-                critic_sample = rb.sample(batch_size=g * bs, sample_next_obs=cfg.buffer.sample_next_obs)
-                critic_data = {
-                    k: np.asarray(v, np.float32).reshape(g, bs, *v.shape[2:])
-                    for k, v in critic_sample.items()
-                }
-                actor_sample = rb.sample(batch_size=bs, sample_next_obs=cfg.buffer.sample_next_obs)
-                actor_data = {
-                    k: np.asarray(v, np.float32).reshape(bs, *v.shape[2:])
-                    for k, v in actor_sample.items()
-                }
-                # shard the batch axes over the mesh so each device trains
-                # on its own rows (GSPMD inserts the grad psums)
-                critic_data = runtime.shard_batch(critic_data, axis=1)
-                actor_data = runtime.shard_batch(actor_data, axis=0)
+                if device_cache is not None and device_cache.can_sample_transitions(
+                    cfg.buffer.sample_next_obs
+                ):
+                    # on-device gathers + casts; nothing crosses the link
+                    critic_data = {
+                        k: v.astype(jnp.float32)
+                        for k, v in device_cache.sample_transitions(
+                            g, bs, runtime.next_key(),
+                            sample_next_obs=cfg.buffer.sample_next_obs,
+                            obs_keys=("observations",),
+                        ).items()
+                    }
+                    actor_data = {
+                        k: v[0].astype(jnp.float32)
+                        for k, v in device_cache.sample_transitions(
+                            1, bs, runtime.next_key(),
+                            sample_next_obs=cfg.buffer.sample_next_obs,
+                            obs_keys=("observations",),
+                        ).items()
+                    }
+                else:
+                    critic_sample = rb.sample(batch_size=g * bs, sample_next_obs=cfg.buffer.sample_next_obs)
+                    critic_data = {
+                        k: np.asarray(v, np.float32).reshape(g, bs, *v.shape[2:])
+                        for k, v in critic_sample.items()
+                    }
+                    actor_sample = rb.sample(batch_size=bs, sample_next_obs=cfg.buffer.sample_next_obs)
+                    actor_data = {
+                        k: np.asarray(v, np.float32).reshape(bs, *v.shape[2:])
+                        for k, v in actor_sample.items()
+                    }
+                    # shard the batch axes over the mesh so each device trains
+                    # on its own rows (GSPMD inserts the grad psums)
+                    critic_data = runtime.shard_batch(critic_data, axis=1)
+                    actor_data = runtime.shard_batch(actor_data, axis=0)
                 with timer("Time/train_time", SumMetric, sync_on_compute=cfg.metric.sync_on_compute):
                     params, opt_states, train_metrics = train_fn(
                         params, opt_states, critic_data, actor_data, runtime.next_key()

@@ -35,6 +35,7 @@ from sheeprl_tpu.algos.sac.loss import critic_loss, entropy_loss, policy_loss
 from sheeprl_tpu.algos.sac.utils import prepare_obs, test
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.data.device_buffer import maybe_create_for_transitions
 from sheeprl_tpu.utils.callback import CheckpointCallback, load_checkpoint, restore_buffer
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -242,6 +243,10 @@ def main(runtime, cfg: Dict[str, Any]):
             memmap=cfg.buffer.memmap,
             memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{runtime.global_rank}"),
         )
+    # HBM-resident replay window + on-device sampling (data/device_buffer.py)
+    device_cache = maybe_create_for_transitions(
+        cfg, runtime, rb, state if state and cfg.buffer.checkpoint else None
+    )
 
     last_train = 0
     train_step = 0
@@ -322,6 +327,8 @@ def main(runtime, cfg: Dict[str, Any]):
             step_data["next_observations"] = flat_next_obs[np.newaxis]
         step_data["rewards"] = rewards[np.newaxis].astype(np.float32)
         rb.add(step_data, validate_args=cfg.buffer.validate_args)
+        if device_cache is not None:
+            device_cache.add(step_data)
         obs = next_obs
 
         if iter_num >= learning_starts:
@@ -346,21 +353,36 @@ def main(runtime, cfg: Dict[str, Any]):
                 iters_in_window = len(set(pending_iters))
                 pending_iters = []
                 batch_total = g * cfg.algo.per_rank_batch_size * world_size
-                sample = rb.sample(
-                    batch_size=batch_total,
-                    sample_next_obs=cfg.buffer.sample_next_obs,
-                )
-                # reshape host-side: eager jnp ops in the hot loop pay a
-                # dispatch each; jit transfers the numpy batch in one copy
-                data = {
-                    k: np.asarray(v, dtype=np.float32).reshape(
-                        g, cfg.algo.per_rank_batch_size * world_size, *v.shape[2:]
+                if device_cache is not None and device_cache.can_sample_transitions(
+                    cfg.buffer.sample_next_obs
+                ):
+                    # on-device gather + cast; nothing crosses the link
+                    data = {
+                        k: v.astype(jnp.float32)
+                        for k, v in device_cache.sample_transitions(
+                            g,
+                            cfg.algo.per_rank_batch_size * world_size,
+                            runtime.next_key(),
+                            sample_next_obs=cfg.buffer.sample_next_obs,
+                            obs_keys=("observations",),
+                        ).items()
+                    }
+                else:
+                    sample = rb.sample(
+                        batch_size=batch_total,
+                        sample_next_obs=cfg.buffer.sample_next_obs,
                     )
-                    for k, v in sample.items()
-                }
-                # shard the batch axis over the mesh so each device
-                # trains on its own rows (GSPMD inserts the grad psums)
-                data = runtime.shard_batch(data, axis=1)
+                    # reshape host-side: eager jnp ops in the hot loop pay a
+                    # dispatch each; jit transfers the numpy batch in one copy
+                    data = {
+                        k: np.asarray(v, dtype=np.float32).reshape(
+                            g, cfg.algo.per_rank_batch_size * world_size, *v.shape[2:]
+                        )
+                        for k, v in sample.items()
+                    }
+                    # shard the batch axis over the mesh so each device
+                    # trains on its own rows (GSPMD inserts the grad psums)
+                    data = runtime.shard_batch(data, axis=1)
                 with timer("Time/train_time", SumMetric, sync_on_compute=cfg.metric.sync_on_compute):
                     params, opt_states, train_metrics = train_fn(
                         params,

@@ -25,6 +25,7 @@ from sheeprl_tpu.algos.sac_ae.agent import SACAEPlayer, build_agent
 from sheeprl_tpu.algos.sac_ae.utils import prepare_obs, preprocess_obs, test
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.data.device_buffer import maybe_create_for_transitions
 from sheeprl_tpu.utils.callback import CheckpointCallback, load_checkpoint, restore_buffer
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -326,6 +327,10 @@ def main(runtime, cfg: Dict[str, Any]):
             memmap=cfg.buffer.memmap,
             memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{runtime.global_rank}"),
         )
+    # HBM-resident replay window + on-device sampling (data/device_buffer.py)
+    device_cache = maybe_create_for_transitions(
+        cfg, runtime, rb, state if state and cfg.buffer.checkpoint else None
+    )
 
     last_train = 0
     train_step = 0
@@ -393,6 +398,8 @@ def main(runtime, cfg: Dict[str, Any]):
         step_data["actions"] = actions.reshape(1, total_envs, -1).astype(np.float32)
         step_data["rewards"] = rewards[np.newaxis].astype(np.float32)
         rb.add(step_data, validate_args=cfg.buffer.validate_args)
+        if device_cache is not None:
+            device_cache.add(step_data)
         obs = next_obs
 
         if iter_num >= learning_starts:
@@ -402,19 +409,35 @@ def main(runtime, cfg: Dict[str, Any]):
             if per_rank_gradient_steps > 0:
                 g = per_rank_gradient_steps
                 batch_total = g * cfg.algo.per_rank_batch_size * world_size
-                sample = rb.sample(
-                    batch_size=batch_total,
-                    sample_next_obs=cfg.buffer.sample_next_obs,
-                )
-                data = {
-                    k: np.asarray(v, dtype=np.float32).reshape(
-                        g, cfg.algo.per_rank_batch_size * world_size, *v.shape[2:]
+                if device_cache is not None and device_cache.can_sample_transitions(
+                    cfg.buffer.sample_next_obs
+                ):
+                    # on-device gather + cast (pixels stay uint8 in HBM and
+                    # widen to f32 on device); nothing crosses the link
+                    data = {
+                        k: v.astype(jnp.float32)
+                        for k, v in device_cache.sample_transitions(
+                            g,
+                            cfg.algo.per_rank_batch_size * world_size,
+                            runtime.next_key(),
+                            sample_next_obs=cfg.buffer.sample_next_obs,
+                            obs_keys=tuple(obs_keys),
+                        ).items()
+                    }
+                else:
+                    sample = rb.sample(
+                        batch_size=batch_total,
+                        sample_next_obs=cfg.buffer.sample_next_obs,
                     )
-                    for k, v in sample.items()
-                }
-                # shard the batch axis over the mesh so each device
-                # trains on its own rows (GSPMD inserts the grad psums)
-                data = runtime.shard_batch(data, axis=1)
+                    data = {
+                        k: np.asarray(v, dtype=np.float32).reshape(
+                            g, cfg.algo.per_rank_batch_size * world_size, *v.shape[2:]
+                        )
+                        for k, v in sample.items()
+                    }
+                    # shard the batch axis over the mesh so each device
+                    # trains on its own rows (GSPMD inserts the grad psums)
+                    data = runtime.shard_batch(data, axis=1)
                 with timer("Time/train_time", SumMetric, sync_on_compute=cfg.metric.sync_on_compute):
                     params, opt_states, train_metrics = train_fn(
                         params,

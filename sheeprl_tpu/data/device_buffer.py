@@ -81,6 +81,39 @@ def _append(bufs, row, pos, mask, *, n_envs):
 
 
 @functools.partial(
+    jax.jit,
+    static_argnames=("n_samples", "batch_size", "cap", "n_envs", "next_keys"),
+)
+def _sample_transitions(bufs, key, pos, filled, *, n_samples, batch_size, cap, n_envs, next_keys):
+    """Gather (n_samples, batch, *feat) flat transitions, mirroring
+    ``ReplayBuffer.sample``: rows uniform over stored history (the row at
+    the write head excluded when next-obs are gathered — its successor is
+    stale), env uniform per element, next row = (row + 1) % cap.  SAC-family
+    buffers add all envs in lockstep, so pos/filled are shared scalars here
+    (the caller passes per-env vectors; element 0 is used)."""
+    flat = n_samples * batch_size
+    k_env, k_row = jax.random.split(key)
+    envs = jax.random.randint(k_env, (flat,), 0, n_envs)
+    p0 = pos[0]
+    f0 = filled[0]
+    count = f0 - (1 if next_keys else 0)
+    base = jnp.where(f0 >= cap, p0, 0)
+    u = jax.random.uniform(k_row, (flat,))
+    offs = jnp.minimum((u * count).astype(jnp.int32), count - 1)
+    rows = (base + offs) % cap
+    out = {}
+    for k, buf in bufs.items():
+        g = buf[rows, envs]  # (flat, *feat)
+        out[k] = g.reshape(n_samples, batch_size, *buf.shape[2:])
+    if next_keys:
+        nrows = (rows + 1) % cap
+        for k in next_keys:
+            g = bufs[k][nrows, envs]
+            out[f"next_{k}"] = g.reshape(n_samples, batch_size, *bufs[k].shape[2:])
+    return out
+
+
+@functools.partial(
     jax.jit, static_argnames=("n_samples", "batch_size", "seq_len", "cap", "n_envs")
 )
 def _sample(bufs, key, pos, filled, *, n_samples, batch_size, seq_len, cap, n_envs):
@@ -131,6 +164,22 @@ def sequence_batches(rb, device_cache, runtime, n_samples, batch_size, seq_len, 
         local_data, n_samples, sharding=runtime.batch_sharding(axis=1)
     ) as feed:
         yield feed
+
+
+def maybe_create_for_transitions(cfg, runtime, rb, state=None):
+    """SAC-family factory: a cache mirroring a plain flat-transition
+    ``ReplayBuffer`` (uniform rows, optional next-obs).  Pass ``state`` iff
+    ``rb`` was restored — the cache refills from it."""
+    from sheeprl_tpu.data.buffers import ReplayBuffer
+
+    if type(rb) is not ReplayBuffer:
+        return None
+    cache = DeviceReplayCache.maybe_create(
+        cfg, runtime, capacity=rb.buffer_size, n_envs=rb.n_envs
+    )
+    if cache is not None and state is not None:
+        cache.load_from_replay(rb)
+    return cache
 
 
 def maybe_create_for(cfg, runtime, rb, state=None):
@@ -227,6 +276,17 @@ class DeviceReplayCache:
             raise ValueError(f"indices ({len(idx)}) must match data env columns ({n_in})")
         if not self._ensure({k: v[:, :1] for k, v in data.items()}):
             return
+        if set(data.keys()) != set(self._bufs.keys()):
+            # e.g. a resume that flipped buffer.sample_next_obs changes the
+            # stored key set; the host path tolerates it, so fall back
+            print(
+                "DeviceReplayCache: step keys "
+                f"{sorted(data.keys())} != cached keys {sorted(self._bufs.keys())} "
+                "— cache disabled, training continues on the host feed path"
+            )
+            self.active = False
+            self._bufs = None
+            return
         mask_np = np.zeros(self.n_envs, dtype=bool)
         mask_np[idx] = True
         for t in range(t_len):
@@ -251,15 +311,13 @@ class DeviceReplayCache:
             return
         subs = rb.buffer
         if len(subs) != self.n_envs or any(b.buffer_size != self.capacity for b in subs):
-            print(
-                "DeviceReplayCache: restored host buffer shape "
-                f"({len(subs)} envs x {subs[0].buffer_size if subs else 0}) does not match "
-                f"the cache ({self.n_envs} x {self.capacity}) — cache disabled, "
-                "training continues on the host feed path"
+            # unreachable from maybe_create_for (which sizes the cache from
+            # this rb); direct callers get a hard error
+            raise ValueError(
+                f"host buffer ({len(subs)} envs x "
+                f"{subs[0].buffer_size if subs else 0}) does not match the "
+                f"cache ({self.n_envs} x {self.capacity})"
             )
-            self.active = False
-            self._bufs = None
-            return
         example = None
         for b in subs:
             if b.buffer:
@@ -319,6 +377,73 @@ class DeviceReplayCache:
             n_envs=self.n_envs,
         )
         return [{k: v[i] for k, v in out.items()} for i in range(n_samples)]
+
+    def sample_transitions(
+        self,
+        n_samples: int,
+        batch_size: int,
+        key,
+        sample_next_obs: bool = False,
+        obs_keys: Sequence[str] = (),
+    ) -> Dict[str, jax.Array]:
+        """Flat-transition draw mirroring ``ReplayBuffer.sample`` — returns
+        one device dict shaped (n_samples, batch, *feat) (+ ``next_<k>``
+        for ``obs_keys`` when ``sample_next_obs``)."""
+        if batch_size <= 0 or n_samples <= 0:
+            raise ValueError(
+                f"'batch_size' ({batch_size}) and 'n_samples' ({n_samples}) must be both greater than 0"
+            )
+        need = 2 if sample_next_obs else 1
+        if not (self.active and self._bufs is not None and int(self._filled.min()) >= need):
+            raise ValueError("Not enough data in the device cache, add first")
+        return _sample_transitions(
+            self._bufs,
+            jnp.asarray(key),
+            jnp.asarray(self._pos),
+            jnp.asarray(self._filled),
+            n_samples=int(n_samples),
+            batch_size=int(batch_size),
+            cap=self.capacity,
+            n_envs=self.n_envs,
+            next_keys=tuple(obs_keys) if sample_next_obs else (),
+        )
+
+    def can_sample_transitions(self, sample_next_obs: bool = False) -> bool:
+        need = 2 if sample_next_obs else 1
+        return self.active and self._bufs is not None and bool(np.all(self._filled >= need))
+
+    def load_from_replay(self, rb) -> None:
+        """Refill from a plain (flat-transition) ``ReplayBuffer``."""
+        if not self.active:
+            return
+        if rb.buffer_size != self.capacity or rb.n_envs != self.n_envs:
+            # unreachable from maybe_create_for_transitions (which sizes the
+            # cache from this rb); direct callers get a hard error
+            raise ValueError(
+                f"host buffer ({rb.n_envs} envs x {rb.buffer_size}) does not "
+                f"match the cache ({self.n_envs} x {self.capacity})"
+            )
+        if not rb.buffer:
+            return  # nothing stored yet
+        example = {k: np.asarray(v[:1]) for k, v in rb.buffer.items()}
+        if self._budget is not None and self.estimate_bytes(example) > self._budget:
+            self.active = False
+            return
+        self._bufs = {
+            k: (
+                jax.device_put(
+                    np.ascontiguousarray(np.asarray(v), dtype=_store_dtype(v.dtype)),
+                    self._device,
+                )
+                if self._device is not None
+                else jnp.asarray(np.ascontiguousarray(np.asarray(v), dtype=_store_dtype(v.dtype)))
+            )
+            for k, v in rb.buffer.items()
+        }
+        pos = int(rb._pos)
+        filled = self.capacity if rb.full else pos
+        self._pos = np.full(self.n_envs, pos, dtype=np.int32)
+        self._filled = np.full(self.n_envs, filled, dtype=np.int32)
 
     # ------------------------------------------------------------ factory
     @classmethod

@@ -216,6 +216,27 @@ def test_sac(standard_args, devices, tmp_path):
     _run(args)
 
 
+def test_sac_device_cache(standard_args, tmp_path):
+    """End-to-end SAC with the flat-transition device cache forced on,
+    both with stored next-obs and derived next-obs sampling."""
+    for variant, nxt in (("a", "False"), ("b", "True")):
+        args = standard_args + [
+            "exp=sac",
+            "env.id=dummy_continuous",
+            "algo.per_rank_batch_size=4",
+            "algo.hidden_size=8",
+            "algo.learning_starts=8",
+            "algo.mlp_keys.encoder=[state]",
+            "buffer.device_cache=True",
+            f"buffer.sample_next_obs={nxt}",
+            "fabric.devices=1",
+            "dry_run=False",
+            "algo.total_steps=64",
+            f"root_dir={tmp_path}/saccache{variant}",
+        ]
+        _run(args)
+
+
 def test_sac_sample_next_obs(standard_args, tmp_path):
     # dry_run shrinks the buffer to one row, which cannot serve next-obs
     # samples — run a real (tiny) loop instead

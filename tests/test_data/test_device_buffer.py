@@ -103,6 +103,56 @@ def test_load_from_host_buffer_matches_content():
             )
 
 
+def test_transitions_next_obs_pairs_and_head_exclusion():
+    """Flat-transition draws (SAC family): next_<k> must be the row's
+    successor, and with next-obs the row at the write head is excluded
+    (its successor is stale)."""
+    from sheeprl_tpu.data.buffers import ReplayBuffer
+
+    cache = DeviceReplayCache(CAP, N_ENVS)
+    total = CAP + 9  # wrapped: stale row = oldest stored successor crossing
+    for t in range(total):
+        cache.add(_row(t))
+    out = cache.sample_transitions(
+        4, 16, jax.random.PRNGKey(5), sample_next_obs=True, obs_keys=("clock",)
+    )
+    clock = np.asarray(out["clock"]).reshape(-1)
+    nxt = np.asarray(out["next_clock"]).reshape(-1)
+    np.testing.assert_array_equal(nxt, clock + 1.0)
+    lo, hi = total - CAP, total - 1
+    assert clock.min() >= lo
+    # write-head exclusion: the newest row (hi) can never be drawn as the
+    # base of a next-obs pair — its successor would be the oldest row
+    assert clock.max() <= hi - 1
+
+    # parity with the host buffer's own semantics
+    rb = ReplayBuffer(CAP, N_ENVS, obs_keys=("clock",))
+    for t in range(total):
+        rb.add(_row(t))
+    host = rb.sample(64, sample_next_obs=True)
+    h_clock = host["clock"].reshape(-1)
+    h_nxt = host["next_clock"].reshape(-1)
+    np.testing.assert_array_equal(h_nxt, h_clock + 1.0)
+    assert h_clock.min() >= lo and h_clock.max() <= hi - 1
+
+
+def test_load_from_replay_matches_content():
+    from sheeprl_tpu.data.buffers import ReplayBuffer
+
+    rb = ReplayBuffer(CAP, N_ENVS, obs_keys=("clock",))
+    for t in range(CAP + 3):
+        rb.add(_row(t))
+    cache = DeviceReplayCache(CAP, N_ENVS)
+    cache.load_from_replay(rb)
+    assert list(cache._pos) == [rb._pos] * N_ENVS
+    out = cache.sample_transitions(2, 32, jax.random.PRNGKey(6))
+    clock = np.asarray(out["clock"]).reshape(-1)
+    rgb = np.asarray(out["rgb"]).reshape(-1, 4)[:, 0]
+    assert clock.min() >= 3 and clock.max() <= CAP + 2
+    np.testing.assert_array_equal(rgb, (clock.astype(np.int64) % 251).astype(np.uint8))
+    assert out["rgb"].dtype == np.uint8
+
+
 def test_sample_before_enough_data_raises():
     cache = DeviceReplayCache(CAP, N_ENVS)
     cache.add(_row(0))
