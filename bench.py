@@ -15,8 +15,8 @@ Driver contract (hardened after round 2's rc=124 timeout):
 - Each metric is emitted the moment its section finishes AND appended to
   ``benchmarks/results/bench_last.jsonl`` — a driver timeout can lose the
   tail sections but never completed ones.  At the end all metrics are
-  re-emitted in canonical order (ppo, sac, dec, dv3) so the flagship DV3 line
-  is the last line of stdout.
+  re-emitted in canonical order (loop, ppo, sac, dec, dv3) so the flagship
+  DV3 line is the last line of stdout.
 - Fixed costs (tunnel backend init, tracing, XLA compiles) are separated
   from steady state: PPO and SAC run their CLI protocol FOUR times — a
   short run that pays the one-time costs (cold compile or cache load), the
@@ -52,9 +52,15 @@ Benchmarks (baselines from BASELINE.md / the reference README):
    line also carries ``step_ms`` and ``mfu_pct`` (achieved FLOP/s from
    XLA cost analysis vs the 197 TFLOP/s bf16 peak of one TPU v5e chip).
 
+5. Replay-feed cost per gradient step at DV3-S shapes (the ``loop``
+   section): host buffer sample + upload vs the HBM-resident cache's
+   on-device gather (``data/device_buffer.py``).  Its ``vs_baseline`` is
+   the host-over-device feed ratio on THIS machine's link (the reference
+   pays ~0 feed cost over local PCIe).
+
 ``vs_baseline`` is the speedup factor (>1 is faster than the reference).
 
-Env overrides: BENCH_BUDGET_S, BENCH_SKIP_PPO/SAC/DV3/DEC, BENCH_PPO_STEPS,
+Env overrides: BENCH_BUDGET_S, BENCH_SKIP_PPO/SAC/DV3/DEC/LOOP, BENCH_PPO_STEPS,
 BENCH_SAC_STEPS, BENCH_DV3_STEPS, BENCH_PLATFORM (cpu for local tests).
 """
 
@@ -81,7 +87,7 @@ TPU_V5E_BF16_PEAK_FLOPS = 197e12
 # (section, conservative wall-clock estimate used for skip decisions);
 # ppo/sac cover four CLI runs each (cold + 2 cached-warm + long); dec runs
 # four protocols (coupled/decoupled x ppo/sac) on the TPU-backed learner
-SECTIONS = [("dv3", 60), ("ppo", 50), ("sac", 60), ("dec", 170)]
+SECTIONS = [("dv3", 60), ("loop", 60), ("ppo", 50), ("sac", 60), ("dec", 170)]
 
 
 def _note(**kw):
@@ -185,7 +191,7 @@ def bench_sac():
 def bench_dv3():
     from benchmarks.bench_dv3_step import time_variant
 
-    steps = int(os.environ.get("BENCH_DV3_STEPS", 16))
+    steps = int(os.environ.get("BENCH_DV3_STEPS", 48))
     dt, t_len, b_size, extras = time_variant(
         fused=False,
         precision="bf16-mixed",
@@ -271,6 +277,96 @@ def bench_dec():
     return _metric()
 
 
+def bench_loop():
+    """Replay-feed cost per gradient step at DV3-S shapes: host buffer
+    sample + upload (what every gradient step paid before round 4's
+    session 5) vs the HBM-resident cache's on-device gather
+    (``data/device_buffer.py``).  This is the real-training-loop
+    bottleneck on remote-link chips — the dv3 section's frames/s times a
+    device-resident batch and cannot see it.  ``vs_baseline`` here is the
+    host-feed-over-device-feed ratio on THIS machine's link (the
+    reference pays ~0 feed cost over local PCIe, so a reference-relative
+    number would be meaningless)."""
+    import numpy as np
+    import jax
+
+    from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
+    from sheeprl_tpu.data.device_buffer import DeviceReplayCache
+    from sheeprl_tpu.data.feed import batched_feed
+    from sheeprl_tpu.parallel.mesh import MeshRuntime
+
+    platform = os.environ.get("BENCH_PLATFORM", "auto")
+    runtime = MeshRuntime(accelerator=platform)
+    runtime.launch()
+    runtime.seed_everything(7)
+    T, B, N_ENVS, CAP = 64, 16, 8, 2048
+    rng = np.random.default_rng(0)
+    rb = EnvIndependentReplayBuffer(CAP, n_envs=N_ENVS, buffer_cls=SequentialReplayBuffer)
+    cache = DeviceReplayCache(CAP, N_ENVS, device=runtime.device)
+    for t in range(CAP):
+        row = {
+            "rgb": rng.integers(0, 255, (1, N_ENVS, 64, 64, 3), dtype=np.uint8),
+            "actions": rng.normal(size=(1, N_ENVS, 6)).astype(np.float32),
+            "rewards": np.zeros((1, N_ENVS, 1), np.float32),
+            "is_first": np.zeros((1, N_ENVS, 1), np.float32),
+            "terminated": np.zeros((1, N_ENVS, 1), np.float32),
+            "truncated": np.zeros((1, N_ENVS, 1), np.float32),
+        }
+        rb.add(row)
+    cache.load_from(rb)  # one staged device_put per key — not 2048 appends
+
+    def consume(batch):
+        # force materialization on device (a gradient step would); returns
+        # the on-device scalar so callers can chain without a host sync
+        return jax.tree_util.tree_leaves(batch)[0].sum()
+
+    def consume_sync(batch):
+        # block on EVERY leaf: leaves[0] is the small 'actions' array, and
+        # the 12.6MB rgb upload must finish inside the host-path timer
+        jax.block_until_ready(batch)
+        return float(jax.tree_util.tree_leaves(batch)[0].sum())
+
+    def time_host(n):
+        # the host path is inherently synchronous per draw (the upload is
+        # the cost being measured), so per-iteration blocking is faithful
+        tic = time.perf_counter()
+        for _ in range(n):
+            local = rb.sample(B, sequence_length=T, n_samples=1)
+            with batched_feed(local, 1, sharding=runtime.batch_sharding(axis=1)) as feed:
+                for b in feed:
+                    consume_sync(b)
+        return (time.perf_counter() - tic) / n
+
+    def time_device(n):
+        # chained async draws + ONE trailing sync — the way the training
+        # loop consumes them; a per-draw host fetch would measure the
+        # link RTT (~0.1 s here), not the gather
+        tic = time.perf_counter()
+        acc = None
+        for _ in range(n):
+            acc = consume(cache.sample(1, B, T, runtime.next_key())[0])
+        float(acc)
+        return (time.perf_counter() - tic) / n
+
+    float(consume(cache.sample(1, B, T, runtime.next_key())[0]))  # compile
+    time_host(1)
+    host_s = time_host(4)
+    dev_s = time_device(32)
+    return {
+        "metric": "dv3S_replay_feed_per_gradient_step_ms",
+        "value": round(dev_s * 1e3, 2),
+        "unit": "ms",
+        "vs_baseline": round(host_s / dev_s, 1),
+        "host_feed_ms": round(host_s * 1e3, 1),
+        "method": (
+            "host: EnvIndependent/Sequential sample + prefetch device_put of the "
+            "12.6MB T=64,B=16 uint8 pixel batch; device: DeviceReplayCache on-HBM "
+            "gather; vs_baseline = host/device ratio on this machine's link"
+        ),
+        "platform": runtime.device.platform,
+    }
+
+
 def child_main(section, out_path):
     """Run one section with all output redirected to the log file."""
     global _CHILD_OUT_PATH
@@ -298,7 +394,7 @@ def child_main(section, out_path):
         except Exception:
             pass
 
-    metric = {"dv3": bench_dv3, "ppo": bench_ppo, "sac": bench_sac, "dec": bench_dec}[section]()
+    metric = {"dv3": bench_dv3, "loop": bench_loop, "ppo": bench_ppo, "sac": bench_sac, "dec": bench_dec}[section]()
     with open(out_path, "w") as f:
         json.dump(metric, f)
 

@@ -160,6 +160,19 @@ def test_sample_before_enough_data_raises():
         cache.sample(1, 2, seq_len=4, key=jax.random.PRNGKey(0))
 
 
+def test_changed_key_set_disables_cache():
+    """A resume that changes the stored key set (e.g. flipping
+    buffer.sample_next_obs) must fall back to the host path, not crash."""
+    cache = DeviceReplayCache(CAP, N_ENVS)
+    cache.add(_row(0))
+    row2 = _row(1)
+    row2["extra"] = np.zeros((1, N_ENVS, 1), np.float32)
+    cache.add(row2)  # superset of cached keys
+    assert not cache.active and cache._bufs is None
+    cache.add(_row(2))  # further adds no-op
+    assert not cache.can_sample(1)
+
+
 def test_budget_gate_disables_without_error():
     cache = DeviceReplayCache(CAP, N_ENVS, budget_bytes=8)  # absurdly small
     cache.add(_row(0))
