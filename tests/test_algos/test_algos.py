@@ -616,6 +616,40 @@ def test_p2e_dv1(standard_args, tmp_path):
     _run(ft_args)
 
 
+def test_p2e_dv1_device_cache_chain(standard_args, tmp_path):
+    """Exploration -> finetuning with the device cache forced on: the
+    finetuning run restores the exploration replay buffer and must refill
+    the cache from it (load_from via maybe_create_for)."""
+    import glob
+
+    root = f"{tmp_path}/p2edv1dc"
+    common = standard_args + _dv1_tiny_args() + [
+        "env=dummy",
+        "algo.mlp_keys.encoder=[state]",
+        "algo.cnn_keys.encoder=[]",
+        "algo.cnn_keys.decoder=[]",
+        "algo.ensembles.n=2",
+        "algo.ensembles.dense_units=8",
+        "algo.ensembles.mlp_layers=1",
+        "buffer.device_cache=True",
+        "fabric.devices=1",
+    ]
+    _run(common + [
+        "exp=p2e_dv1_exploration",
+        f"root_dir={root}",
+        f"metric.logger.root_dir={tmp_path}/logs_p2edv1dc",
+    ])
+    ckpts = sorted(glob.glob(f"{root}/**/ckpt_*.ckpt", recursive=True))
+    assert len(ckpts) > 0
+    _run(common + [
+        "exp=p2e_dv1_finetuning",
+        "buffer.load_from_exploration=True",
+        f"checkpoint.exploration_ckpt_path={ckpts[-1]}",
+        f"root_dir={root}_ft",
+        f"metric.logger.root_dir={tmp_path}/logs_p2edv1dc_ft",
+    ])
+
+
 def test_p2e_dv2(standard_args, tmp_path):
     """Exploration -> finetuning chain on the DV2 skeleton."""
     import glob
