@@ -205,3 +205,11 @@ def test_maybe_create_gating(monkeypatch):
     FakeRuntime.device_count = 1
     monkeypatch.setenv("SHEEPRL_DEVICE_CACHE", "0")
     assert DeviceReplayCache.maybe_create(FakeCfg(), FakeRuntime(), 8, 2) is None
+    monkeypatch.delenv("SHEEPRL_DEVICE_CACHE")
+
+    # EpisodeBuffer replay (DV2 prioritize_ends mode) keeps the host path
+    # even with device_cache=True — only the uniform samplers are mirrored
+    from sheeprl_tpu.data.buffers import EpisodeBuffer
+    from sheeprl_tpu.data.device_buffer import maybe_create_for
+
+    assert maybe_create_for(FakeCfg(), FakeRuntime(), EpisodeBuffer(32, 4)) is None
