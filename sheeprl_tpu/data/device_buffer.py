@@ -25,8 +25,10 @@ Gating: ``buffer.device_cache`` (True / False / "auto"; env override
 ``SHEEPRL_DEVICE_CACHE``).  "auto" enables on single-device accelerator
 meshes when the estimated footprint fits ``buffer.device_cache_budget_gb``
 (default 6.0) — exactly the remote-link regime where it pays.  Multi-host
-/ multi-device data parallelism keeps the host path (each process feeds
-its own shard; a replicated cache would multiply HBM cost).
+data parallelism keeps the host path (each process feeds its own shard).
+Single-process multi-device meshes can opt in (``device_cache=True``) to
+:class:`ShardedDeviceReplayCache` — env-sharded rings with per-device
+sampling inside a ``shard_map`` — for sequence replay.
 """
 
 from __future__ import annotations
@@ -40,7 +42,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["DeviceReplayCache", "device_cache_setting"]
+__all__ = [
+    "DeviceReplayCache",
+    "ShardedDeviceReplayCache",
+    "device_cache_setting",
+    "maybe_create_for",
+    "maybe_create_for_transitions",
+    "sequence_batches",
+]
 
 
 def _store_dtype(dt) -> np.dtype:
@@ -113,17 +122,10 @@ def _sample_transitions(bufs, key, pos, filled, *, n_samples, batch_size, cap, n
     return out
 
 
-@functools.partial(
-    jax.jit, static_argnames=("n_samples", "batch_size", "seq_len", "cap", "n_envs")
-)
-def _sample(bufs, key, pos, filled, *, n_samples, batch_size, seq_len, cap, n_envs):
-    """Gather (n_samples, seq_len, batch, *feat) sequence windows.
-
-    Valid starts per env mirror SequentialReplayBuffer.sample: the stored
-    rows span logical times [pos - filled, pos); any L-window inside that
-    span is valid, i.e. ``filled - L + 1`` starts beginning at the oldest
-    row (ring index ``pos`` when full, 0 otherwise).
-    """
+def _gather_windows(bufs, key, pos, filled, *, n_samples, batch_size, seq_len, cap, n_envs):
+    """Core window gather shared by the single-device jit and the
+    per-device body of the sharded sampler (shapes are whatever the
+    caller's shard holds)."""
     flat = n_samples * batch_size
     k_env, k_start = jax.random.split(key)
     envs = jax.random.randint(k_env, (flat,), 0, n_envs)
@@ -141,6 +143,24 @@ def _sample(bufs, key, pos, filled, *, n_samples, batch_size, seq_len, cap, n_en
         g = g.reshape(n_samples, batch_size, seq_len, *buf.shape[2:])
         out[k] = jnp.swapaxes(g, 1, 2)  # (n_samples, L, B, *feat)
     return out
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n_samples", "batch_size", "seq_len", "cap", "n_envs")
+)
+def _sample(bufs, key, pos, filled, *, n_samples, batch_size, seq_len, cap, n_envs):
+    """Gather (n_samples, seq_len, batch, *feat) sequence windows.
+
+    Valid starts per env mirror SequentialReplayBuffer.sample: the stored
+    rows span logical times [pos - filled, pos); any L-window inside that
+    span is valid, i.e. ``filled - L + 1`` starts beginning at the oldest
+    row (ring index ``pos`` when full, 0 otherwise).
+    """
+    return _gather_windows(
+        bufs, key, pos, filled,
+        n_samples=n_samples, batch_size=batch_size, seq_len=seq_len,
+        cap=cap, n_envs=n_envs,
+    )
 
 
 @contextlib.contextmanager
@@ -196,6 +216,31 @@ def maybe_create_for(cfg, runtime, rb, state=None):
     cache = DeviceReplayCache.maybe_create(
         cfg, runtime, capacity=rb.buffer_size, n_envs=rb.n_envs
     )
+    if cache is None and device_cache_setting(cfg) == "on" and runtime.device_count > 1:
+        # opt-in env-sharded variant for single-process data-parallel meshes;
+        # explicit opt-in gets NO budget gate (matching maybe_create's mode=="on")
+        blockers = []
+        if jax.process_count() != 1:
+            blockers.append("multi-process run")
+        if runtime.mesh.shape.get("data") != runtime.device_count:
+            blockers.append("mesh devices not all on the 'data' axis")
+        if rb.n_envs % runtime.device_count:
+            blockers.append(
+                f"n_envs ({rb.n_envs}) not divisible by {runtime.device_count} devices"
+            )
+        if blockers:
+            print(
+                "DeviceReplayCache: buffer.device_cache=True ignored — "
+                + "; ".join(blockers)
+                + "; keeping the host feed path"
+            )
+        else:
+            cache = ShardedDeviceReplayCache(rb.buffer_size, rb.n_envs, runtime)
+            print(
+                f"DeviceReplayCache: env-sharded replay window enabled "
+                f"(capacity {rb.buffer_size} x {rb.n_envs} envs over "
+                f"{runtime.device_count} devices)"
+            )
     if cache is not None and state is not None:
         cache.load_from(rb)
     return cache
@@ -248,14 +293,24 @@ class DeviceReplayCache:
                     f"{self._budget / 1e9:.2f} GB budget — staying on the host path"
                 )
                 return False
-        with jax.default_device(self._device) if self._device is not None else contextlib.nullcontext():
-            self._bufs = {
-                # f64 host rows (numpy default zeros) store as f32 — the
-                # train steps consume f32 anyway (mirrors batched_feed)
-                k: jnp.zeros((self.capacity, self.n_envs, *v.shape[2:]), dtype=_store_dtype(v.dtype))
-                for k, v in row.items()
-            }
+        self._bufs = {
+            # f64 host rows (numpy default zeros) store as f32 — the
+            # train steps consume f32 anyway (mirrors batched_feed)
+            k: self._zeros((self.capacity, self.n_envs, *v.shape[2:]), _store_dtype(v.dtype))
+            for k, v in row.items()
+        }
         return True
+
+    # ---- array-placement hooks (the sharded subclass overrides ONLY these)
+    def _zeros(self, shape, dtype):
+        with jax.default_device(self._device) if self._device is not None else contextlib.nullcontext():
+            return jnp.zeros(shape, dtype=dtype)
+
+    def _put_host(self, host: np.ndarray) -> jax.Array:
+        return jax.device_put(host, self._device) if self._device is not None else jnp.asarray(host)
+
+    def _place_row(self, row: Dict[str, np.ndarray]):
+        return row  # uncommitted host arrays; the _append jit places them
 
     # ------------------------------------------------------------- write
     def add(self, data: Dict[str, np.ndarray], indices: Optional[Sequence[int]] = None) -> None:
@@ -295,6 +350,7 @@ class DeviceReplayCache:
                 full_row = np.zeros((self.n_envs, *v.shape[2:]), dtype=v.dtype)
                 full_row[idx] = v[t]
                 row[k] = full_row
+            row = self._place_row(row)
             self._bufs = _append(
                 self._bufs, row, jnp.asarray(self._pos), jnp.asarray(mask_np), n_envs=self.n_envs
             )
@@ -339,9 +395,7 @@ class DeviceReplayCache:
             host = np.ascontiguousarray(
                 np.concatenate(parts, axis=1), dtype=_store_dtype(v0.dtype)
             )  # (cap, n_envs, *feat)
-            bufs[k] = (
-                jax.device_put(host, self._device) if self._device is not None else jnp.asarray(host)
-            )
+            bufs[k] = self._put_host(host)
         self._bufs = bufs
         self._pos = np.asarray([b._pos for b in subs], dtype=np.int32)
         self._filled = np.asarray(
@@ -453,12 +507,8 @@ class DeviceReplayCache:
         if mode == "off":
             return None
         if runtime.device_count != 1 or jax.process_count() != 1:
-            if mode == "on":
-                print(
-                    "DeviceReplayCache: buffer.device_cache=True ignored — the cache "
-                    "is single-device only (a replicated cache multiplies HBM cost); "
-                    "multi-device runs keep the host feed path"
-                )
+            # multi-device: sequence replay may still get the env-sharded
+            # variant — maybe_create_for handles (and reports) that case
             return None
         if mode == "auto" and runtime.device.platform == "cpu":
             return None  # host-platform run: device_put is free, no win
@@ -475,3 +525,91 @@ class DeviceReplayCache:
         )
         return cache
 
+
+class ShardedDeviceReplayCache(DeviceReplayCache):
+    """Env-sharded cache for single-process data-parallel meshes.
+
+    Each device holds the rings of ``n_envs / n_devices`` environments
+    (buffers sharded ``P(None, "data")`` over the env axis) and samples
+    its ``batch / n_devices`` rows from its OWN envs inside a
+    ``shard_map`` — appends and gathers stay device-local, and the
+    sampled batch comes out already sharded on the batch axis exactly as
+    ``runtime.batch_sharding(axis=1)`` lays it out for the train step.
+
+    Sampling semantics vs the host path: env choice becomes STRATIFIED
+    (exactly batch/n_devices rows from each device's env subset) instead
+    of globally uniform — identical marginals, slightly lower variance.
+    Start-window validity per env is unchanged.  Opt-in only
+    (``buffer.device_cache=True`` on a multi-device mesh); "auto" stays
+    single-device, where the remote-link win actually lives.  Storage
+    and ring/append/refill logic are inherited — this class overrides
+    only the array-placement hooks and the sampler.
+    """
+
+    def __init__(self, capacity: int, n_envs: int, runtime, budget_bytes: Optional[int] = None):
+        n_dev = runtime.device_count
+        if runtime.mesh.shape.get("data") != n_dev:
+            raise ValueError("sharded cache needs every mesh device on the 'data' axis")
+        if n_envs % n_dev:
+            raise ValueError(f"n_envs ({n_envs}) must divide over {n_dev} devices")
+        super().__init__(capacity, n_envs, device=None, budget_bytes=budget_bytes)
+        self._runtime = runtime
+        self._n_dev = n_dev
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        self._env_sharding = NamedSharding(runtime.mesh, P(None, "data"))
+        self._row_sharding = NamedSharding(runtime.mesh, P("data"))
+        self._sharded_sample_fns = {}
+
+    # ---- placement hooks: same logic as the base, sharded arrays
+    def _zeros(self, shape, dtype):
+        return jax.device_put(np.zeros(shape, dtype), self._env_sharding)
+
+    def _put_host(self, host: np.ndarray) -> jax.Array:
+        return jax.device_put(host, self._env_sharding)
+
+    def _place_row(self, row):
+        return {k: jax.device_put(v, self._row_sharding) for k, v in row.items()}
+
+    # ---- per-device stratified sampler
+    def sample(self, n_samples: int, batch_size: int, seq_len: int, key) -> List[Dict[str, jax.Array]]:
+        if batch_size % self._n_dev:
+            raise ValueError(
+                f"batch_size ({batch_size}) must divide over {self._n_dev} devices"
+            )
+        if not self.can_sample(seq_len):
+            raise ValueError(
+                f"Cannot sample a sequence of length {seq_len}. "
+                f"Data added so far: {int(self._filled.min())}"
+            )
+        geom = (int(n_samples), int(batch_size), int(seq_len), tuple(sorted(self._bufs)))
+        fn = self._sharded_sample_fns.get(geom)
+        if fn is None:
+            fn = self._build_sharded_sample(*geom[:3])
+            self._sharded_sample_fns[geom] = fn
+        out = fn(self._bufs, jnp.asarray(key), jnp.asarray(self._pos), jnp.asarray(self._filled))
+        return [{k: v[i] for k, v in out.items()} for i in range(n_samples)]
+
+    def _build_sharded_sample(self, n_samples, batch_size, seq_len):
+        from jax.sharding import PartitionSpec as P
+
+        mesh = self._runtime.mesh
+        cap, n_envs, n_dev = self.capacity, self.n_envs, self._n_dev
+
+        def body(bufs_l, key, pos_l, filled_l):
+            # per-device independent stream; each device samples its own envs
+            k = jax.random.fold_in(key, jax.lax.axis_index("data"))
+            return _gather_windows(
+                bufs_l, k, pos_l, filled_l,
+                n_samples=n_samples, batch_size=batch_size // n_dev,
+                seq_len=seq_len, cap=cap, n_envs=n_envs // n_dev,
+            )
+
+        buf_specs = {k: P(None, "data") for k in self._bufs}
+        out_specs = {k: P(None, None, "data") for k in self._bufs}
+        sharded = jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(buf_specs, P(), P("data"), P("data")),
+            out_specs=out_specs,
+        )
+        return jax.jit(sharded)

@@ -181,6 +181,78 @@ def test_budget_gate_disables_without_error():
     assert not cache.can_sample(1)
 
 
+def test_sharded_cache_multi_device():
+    """Env-sharded variant on the 8-virtual-device CPU mesh: windows must
+    be contiguous/valid per env, the batch axis must come out sharded on
+    'data' (matching runtime.batch_sharding(axis=1)), and env choice is
+    stratified — each device contributes batch/n rows from its own envs."""
+    from jax.sharding import PartitionSpec as P
+    from sheeprl_tpu.data.device_buffer import ShardedDeviceReplayCache
+    from sheeprl_tpu.parallel.mesh import MeshRuntime
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-virtual-device mesh")
+    rt = MeshRuntime(devices=8, strategy="dp", accelerator="cpu").launch()
+    cache = ShardedDeviceReplayCache(CAP, 8, rt)
+    total = 2 * CAP + 3
+    rng = np.random.default_rng(0)
+    for t in range(total):
+        cache.add(
+            {
+                "clock": np.full((1, 8, 1), float(t), np.float32),
+                "env_id": np.arange(8, dtype=np.float32).reshape(1, 8, 1),
+            }
+        )
+    batches = cache.sample(n_samples=2, batch_size=16, seq_len=5, key=jax.random.PRNGKey(0))
+    lo, hi = total - CAP, total - 1
+    for b in batches:
+        assert b["clock"].sharding.spec == P(None, "data")
+        clock = np.asarray(b["clock"])  # (L, B, 1)
+        env_id = np.asarray(b["env_id"])
+        assert clock.shape == (5, 16, 1)
+        for col in range(16):
+            w = clock[:, col, 0]
+            assert np.all(np.diff(w) == 1.0), w
+            assert lo <= w[0] and w[-1] <= hi
+            # stratification: batch column c belongs to device c//2's env
+            # (env axis sharded over 8 devices, 1 env each here)
+            assert np.all(env_id[:, col, 0] == env_id[0, col, 0])
+        # each device's 2 columns only reference its own env
+        owner = env_id[0, :, 0].reshape(8, 2)
+        np.testing.assert_array_equal(owner[:, 0], np.arange(8, dtype=np.float32))
+        np.testing.assert_array_equal(owner[:, 1], np.arange(8, dtype=np.float32))
+
+
+def test_sharded_cache_load_from_and_factory():
+    """maybe_create_for returns the sharded variant on an opt-in
+    multi-device mesh and refills it from the restored host buffer."""
+    from sheeprl_tpu.data.device_buffer import ShardedDeviceReplayCache, maybe_create_for
+    from sheeprl_tpu.parallel.mesh import MeshRuntime
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-virtual-device mesh")
+    rt = MeshRuntime(devices=8, strategy="dp", accelerator="cpu").launch()
+
+    class FakeCfgBuf(dict):
+        def get(self, k, d=None):
+            return dict.get(self, k, d)
+
+    class FakeCfg:
+        buffer = FakeCfgBuf(device_cache=True, checkpoint=True)
+
+    rb = EnvIndependentReplayBuffer(CAP, n_envs=8, buffer_cls=SequentialReplayBuffer)
+    for t in range(CAP + 4):
+        rb.add({"clock": np.full((1, 8, 1), float(t), np.float32)})
+    cache = maybe_create_for(FakeCfg(), rt, rb, state={"rb": object()})
+    assert isinstance(cache, ShardedDeviceReplayCache)
+    batches = cache.sample(1, 8, 4, jax.random.PRNGKey(1))
+    clock = np.asarray(batches[0]["clock"])
+    for col in range(8):
+        w = clock[:, col, 0]
+        assert np.all(np.diff(w) == 1.0)
+        assert 4 <= w[0] and w[-1] <= CAP + 3
+
+
 def test_maybe_create_gating(monkeypatch):
     class FakeCfgBuf(dict):
         def get(self, k, d=None):
