@@ -52,6 +52,11 @@ __all__ = [
 ]
 
 
+# one ring array must stay gather-addressable with int32 linear offsets on
+# TPU (2^31, with a 1 MiB margin); see DeviceReplayCache._ensure
+_INT32_SAFE_BOUND = 2**31 - 2**20
+
+
 def _store_dtype(dt) -> np.dtype:
     dt = np.dtype(dt)
     return np.dtype(np.float32) if dt == np.float64 else dt
@@ -254,13 +259,21 @@ class DeviceReplayCache:
     training device); appends donate the buffers so updates are in-place.
     """
 
-    def __init__(self, capacity: int, n_envs: int, device=None, budget_bytes: Optional[int] = None):
+    def __init__(
+        self,
+        capacity: int,
+        n_envs: int,
+        device=None,
+        budget_bytes: Optional[int] = None,
+        conservative: bool = False,
+    ):
         if capacity <= 0 or n_envs <= 0:
             raise ValueError(f"capacity ({capacity}) and n_envs ({n_envs}) must be positive")
         self.capacity = int(capacity)
         self.n_envs = int(n_envs)
         self._device = device
         self._budget = budget_bytes
+        self._conservative = conservative
         self._bufs: Optional[Dict[str, jax.Array]] = None
         self._pos = np.zeros(n_envs, dtype=np.int32)
         self._filled = np.zeros(n_envs, dtype=np.int32)
@@ -279,11 +292,15 @@ class DeviceReplayCache:
             )
         return total
 
-    def _ensure(self, row: Dict[str, np.ndarray]) -> bool:
-        if self._bufs is not None:
-            return True
-        if not self.active:
-            return False
+    def _per_device_envs(self) -> int:
+        """Env count addressed by one device's gather (the sharded subclass
+        holds 1/n_dev of the env axis per device)."""
+        return self.n_envs
+
+    def _admit(self, row: Dict[str, np.ndarray]) -> bool:
+        """Size gates shared by the fresh-run (`_ensure`) and resume
+        (`load_from*`) allocation paths.  Flips ``active`` off (host feed
+        path) instead of erroring."""
         if self._budget is not None:
             est = self.estimate_bytes(row)
             if est > self._budget:
@@ -293,6 +310,63 @@ class DeviceReplayCache:
                     f"{self._budget / 1e9:.2f} GB budget — staying on the host path"
                 )
                 return False
+        if self._conservative:
+            try:
+                ring_cap_gb = float(os.environ.get("SHEEPRL_DEVICE_CACHE_MAX_RING_GB", "1.5"))
+            except ValueError:
+                print(
+                    "DeviceReplayCache: could not parse SHEEPRL_DEVICE_CACHE_MAX_RING_GB "
+                    "— using the 1.5 GB default"
+                )
+                ring_cap_gb = 1.5
+        for k, v in row.items():
+            feat_elems = int(np.prod(v.shape[2:], dtype=np.int64) or 1)
+            nbytes = (
+                self.capacity
+                * self._per_device_envs()
+                * feat_elems
+                * _store_dtype(v.dtype).itemsize
+            )
+            # int32-addressability gate: the window/transition gathers index
+            # one (capacity, n_envs, *feat) array and XLA's TPU gather
+            # lowering linearizes offsets in int32 — past 2^31 the address
+            # math overflows and CRASHES the TPU worker.  Bytes always
+            # dominate elements (itemsize >= 1), so bytes are the check.
+            if nbytes > _INT32_SAFE_BOUND:
+                self.active = False
+                print(
+                    f"DeviceReplayCache: array '{k}' ring would be {nbytes / 1e9:.2f} GB "
+                    f"— beyond int32-safe gather addressing (2^31 bytes); staying on "
+                    f"the host path (shrink buffer.size to enable)"
+                )
+                return False
+            # auto mode additionally stays inside the empirically proven
+            # envelope: on the tunneled v5e, single ring arrays >= ~1.8 GB
+            # crash the TPU worker within minutes of interleaved
+            # append/sample/train dispatch (DV2 walker, 18750 and 25000
+            # frames/env), while <= ~1.23 GB rings have run clean for many
+            # chain-hours (DV3/SAC).  Mechanism unconfirmed (no server-side
+            # logs through the tunnel) — so "auto" refuses the unproven
+            # region and explicit buffer.device_cache=True trusts the user
+            # (override: SHEEPRL_DEVICE_CACHE_MAX_RING_GB).
+            if self._conservative and nbytes > ring_cap_gb * 1e9:
+                self.active = False
+                print(
+                    f"DeviceReplayCache: array '{k}' ring would be "
+                    f"{nbytes / 1e9:.2f} GB > {ring_cap_gb:.2f} GB auto-mode cap "
+                    f"(proven-stable envelope on tunneled TPU; see "
+                    f"SHEEPRL_DEVICE_CACHE_MAX_RING_GB) — staying on the host path"
+                )
+                return False
+        return True
+
+    def _ensure(self, row: Dict[str, np.ndarray]) -> bool:
+        if self._bufs is not None:
+            return True
+        if not self.active:
+            return False
+        if not self._admit(row):
+            return False
         self._bufs = {
             # f64 host rows (numpy default zeros) store as f32 — the
             # train steps consume f32 anyway (mirrors batched_feed)
@@ -381,8 +455,7 @@ class DeviceReplayCache:
                 break
         if example is None:
             return  # nothing stored yet
-        if self._budget is not None and self.estimate_bytes(example) > self._budget:
-            self.active = False
+        if not self._admit(example):
             return
         bufs = {}
         for k, v0 in example.items():
@@ -480,8 +553,7 @@ class DeviceReplayCache:
         if not rb.buffer:
             return  # nothing stored yet
         example = {k: np.asarray(v[:1]) for k, v in rb.buffer.items()}
-        if self._budget is not None and self.estimate_bytes(example) > self._budget:
-            self.active = False
+        if not self._admit(example):
             return
         self._bufs = {
             k: (
@@ -518,6 +590,7 @@ class DeviceReplayCache:
             n_envs,
             device=runtime.device,
             budget_bytes=int(budget_gb * 1e9) if mode == "auto" else None,
+            conservative=mode == "auto",
         )
         print(
             f"DeviceReplayCache: HBM-resident replay window enabled "
@@ -562,6 +635,10 @@ class ShardedDeviceReplayCache(DeviceReplayCache):
         self._sharded_sample_fns = {}
 
     # ---- placement hooks: same logic as the base, sharded arrays
+    def _per_device_envs(self) -> int:
+        # each device's shard_map gather addresses only its env slice
+        return self.n_envs // self._n_dev
+
     def _zeros(self, shape, dtype):
         return jax.device_put(np.zeros(shape, dtype), self._env_sharding)
 

@@ -285,3 +285,72 @@ def test_maybe_create_gating(monkeypatch):
     from sheeprl_tpu.data.device_buffer import maybe_create_for
 
     assert maybe_create_for(FakeCfg(), FakeRuntime(), EpisodeBuffer(32, 4)) is None
+
+
+def test_int32_addressability_gate(capsys):
+    """One ring array past 2^31 elements/bytes must refuse to allocate
+    (XLA's TPU gather lowering linearizes offsets in int32; overflow
+    crashes the TPU worker — observed with a 25000 x 8 x 64x64x3 ring).
+    The gate flips the cache to the host path instead."""
+    # 25000 * 8 * 64*64*3 = 2.46e9 B > 2^31: exactly the crash shape
+    cache = DeviceReplayCache(25_000, 8)
+    row = {"rgb": np.zeros((1, 8, 64, 64, 3), np.uint8)}
+    cache.add(row)
+    assert not cache.active and cache._bufs is None
+    assert "int32-safe" in capsys.readouterr().out
+    # same row shape with a modest capacity (well under the bound):
+    # allocates fine — the gate must not false-positive
+    ok = DeviceReplayCache(1_250, 8)
+    assert ok._ensure(row) and ok.active
+    # dtype width counts: f32 crosses 2^31 BYTES at 1/4 the element count
+    f32 = DeviceReplayCache(25_000 // 4 + 64, 8)
+    assert not f32._ensure({"x": np.zeros((1, 8, 64, 64, 3), np.float32)})
+    assert not f32.active
+
+
+def test_auto_mode_ring_size_envelope(capsys, monkeypatch):
+    """conservative (auto) caches refuse single ring arrays beyond the
+    proven-stable byte envelope (~1.5 GB default; tunneled-TPU workers
+    crash with bigger rings under train dispatch); explicit opt-in
+    (conservative=False) is gated only by int32 addressability.  The cap
+    is exercised at a megabyte scale through the env override so the test
+    never materializes gigabyte arrays."""
+    row = {"rgb": np.zeros((1, 8, 64, 64, 3), np.uint8)}
+    monkeypatch.setenv("SHEEPRL_DEVICE_CACHE_MAX_RING_GB", "0.01")  # 10 MB cap
+    # 128/env x 8 x 12288 B = 12.6 MB > 10 MB cap: auto refuses, no alloc
+    auto = DeviceReplayCache(128, 8, conservative=True)
+    assert not auto._ensure(row) and not auto.active
+    assert "auto-mode cap" in capsys.readouterr().out
+    # explicit mode ignores the envelope (int32 gate only)
+    explicit = DeviceReplayCache(128, 8, conservative=False)
+    assert explicit._ensure(row) is True
+    # widening the cap admits the same ring in auto mode
+    monkeypatch.setenv("SHEEPRL_DEVICE_CACHE_MAX_RING_GB", "0.02")
+    widened = DeviceReplayCache(128, 8, conservative=True)
+    assert widened._ensure(row) is True
+    # malformed override: warn + fall back to the 1.5 GB default (admits)
+    monkeypatch.setenv("SHEEPRL_DEVICE_CACHE_MAX_RING_GB", "1.5GB")
+    fallback = DeviceReplayCache(128, 8, conservative=True)
+    assert fallback._ensure(row) is True
+    assert "could not parse" in capsys.readouterr().out
+
+
+def test_resume_load_paths_apply_size_gates(capsys, monkeypatch):
+    """load_from / load_from_replay (checkpoint resume) must apply the same
+    gates as the fresh-run path — a resumed oversized ring would recreate
+    the exact TPU-worker crash the gates exist for."""
+    from sheeprl_tpu.data.buffers import ReplayBuffer
+
+    monkeypatch.setenv("SHEEPRL_DEVICE_CACHE_MAX_RING_GB", "0.0001")  # 100 KB
+    rb = ReplayBuffer(64, 4, obs_keys=("rgb",))
+    for t in range(8):
+        rb.add({"rgb": np.full((1, 4, 16, 16, 3), t, np.uint8)})
+    # 64 x 4 x 768 B = 196 KB > 100 KB cap: conservative refill refuses
+    cache = DeviceReplayCache(64, 4, conservative=True)
+    cache.load_from_replay(rb)
+    assert not cache.active and cache._bufs is None
+    assert "auto-mode cap" in capsys.readouterr().out
+    # explicit mode refills fine
+    ok = DeviceReplayCache(64, 4, conservative=False)
+    ok.load_from_replay(rb)
+    assert ok.active and ok._bufs is not None
