@@ -37,7 +37,11 @@ out, ckpt_step = sys.argv[1], int(sys.argv[2])
 d = json.load(open(out))
 txt = open("/tmp/dv2_walker_eval_r4.log").read()
 m = re.findall(r"Test - Reward: ([-\d.]+)", txt)
-d["greedy_eval_reward_at_final_ckpt"] = float(m[-1]) if m else None
+if not m:
+    sys.exit("ERROR: no 'Test - Reward:' line in the eval log — eval failed or "
+             "its output format drifted; refusing to publish the artifact "
+             "without the greedy-eval number")
+d["greedy_eval_reward_at_final_ckpt"] = float(m[-1])
 d["eval_ckpt_step"] = ckpt_step
 d["experiment"] = ("dreamer_v2_dmc_walker_walk (DreamerV2, dm_control walker-walk "
                    "from 64x64 pixels, paper dmc_vision recipe: deter/hidden 200, "
