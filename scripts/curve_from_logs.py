@@ -9,6 +9,10 @@ mean, ready for benchmarks/results/.
 Usage:
     python scripts/curve_from_logs.py --chain-dir runs/dv3_walker/chain_r3 \
         [--extra-log <earlier run log>] --out benchmarks/results/dv3_walker_curve_r3.json
+
+Importable: ``stitch(chain_dir, extra_logs=(), smooth=5)`` returns the
+artifact dict (used by scripts/finalize_curve.py so every end-of-chain
+pipeline shares the resume-aware merge instead of re-parsing logs).
 """
 
 from __future__ import annotations
@@ -38,25 +42,18 @@ def parse_log(path):
     return out
 
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--chain-dir", required=True)
-    ap.add_argument(
-        "--extra-log",
-        action="append",
-        default=[],
-        help="logs from BEFORE the chain (e.g. the original run), applied first",
-    )
-    ap.add_argument("--out", required=True)
-    ap.add_argument("--smooth", type=int, default=5, help="moving-average window (points)")
-    args = ap.parse_args()
+def stitch(chain_dir, extra_logs=(), smooth=5):
+    """Merge a chain's leg logs (+ optional earlier-run logs) into one curve.
 
+    Returns the artifact dict (source_logs/render_settings/n_points/
+    final_step/final_reward_mean/best_reward_mean/curve).
+    """
     # resume step per leg from the chain's status.jsonl: rewards are only
     # logged at episode ends, so a leg's first LOGGED step can be hundreds
     # of steps past its resume checkpoint — the override boundary must be
     # the checkpoint step or stale points blend into that window
     resume_step = {}
-    status_path = os.path.join(args.chain_dir, "status.jsonl")
+    status_path = os.path.join(chain_dir, "status.jsonl")
     if os.path.exists(status_path):
         with open(status_path, errors="replace") as f:
             for line in f:
@@ -68,13 +65,13 @@ def main():
                     resume_step[int(ev["leg"])] = int(ev.get("from_step") or 0)
 
     merged = {}
-    chain_logs = sorted(glob.glob(os.path.join(args.chain_dir, "leg_*.log")))
+    chain_logs = sorted(glob.glob(os.path.join(chain_dir, "leg_*.log")))
     # --extra-log boundaries are each file's own first step, so files passed
     # out of chronological order would silently delete later data; sort them
     # by first parsed step before merging
-    cache = {p: parse_log(p) for p in args.extra_log}
-    extra = sorted(args.extra_log, key=lambda p: min(cache[p] or {0: 0}))
-    logs = extra + chain_logs
+    cache = {p: parse_log(p) for p in extra_logs}
+    extra = sorted(extra_logs, key=lambda p: min(cache[p] or {0: 0}))
+    logs = list(extra) + chain_logs
     for path in logs:
         parsed = cache.get(path) or parse_log(path)
         if not parsed:
@@ -115,7 +112,7 @@ def main():
             }
         )
     means = [p["reward_mean"] for p in points]
-    w = max(1, args.smooth)
+    w = max(1, smooth)
     for i, p in enumerate(points):
         lo = max(0, i - w + 1)
         p["reward_mean_smoothed"] = round(sum(means[lo : i + 1]) / (i + 1 - lo), 2)
@@ -124,7 +121,7 @@ def main():
     # reference's learning curves (ADVICE r3: dmc fast_render changes pixel
     # observations); read from any saved run config next to the chain dir
     render_cfg = None
-    run_root = os.path.dirname(os.path.abspath(args.chain_dir.rstrip("/")))
+    run_root = os.path.dirname(os.path.abspath(chain_dir.rstrip("/")))
     candidates = glob.glob(os.path.join(run_root, "chain_leg*", "**", "config.yaml"), recursive=True)
     # newest leg config = the one that actually produced the tail of the curve
     for cfg_path in sorted(candidates, key=os.path.getmtime, reverse=True)[:1]:
@@ -136,7 +133,7 @@ def main():
                         break
         except OSError:
             pass
-    artifact = {
+    return {
         "source_logs": logs,
         "render_settings": render_cfg,
         "n_points": len(points),
@@ -145,6 +142,22 @@ def main():
         "best_reward_mean": max(means) if means else None,
         "curve": points,
     }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--chain-dir", required=True)
+    ap.add_argument(
+        "--extra-log",
+        action="append",
+        default=[],
+        help="logs from BEFORE the chain (e.g. the original run), applied first",
+    )
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--smooth", type=int, default=5, help="moving-average window (points)")
+    args = ap.parse_args()
+
+    artifact = stitch(args.chain_dir, args.extra_log, args.smooth)
     if os.path.dirname(args.out):
         os.makedirs(os.path.dirname(args.out), exist_ok=True)
     with open(args.out, "w") as f:

@@ -2,6 +2,10 @@
 # End-of-chain pipeline for the round-4 ball_in_cup-catch run: stitch the
 # reward curve across legs, greedy-eval the newest checkpoint, and fold
 # the eval into the curve artifact. Run AFTER the chain has stopped.
+# FROZEN RECORD: this script already produced its committed artifact and
+# is kept as the exact pipeline that made it. New runs should use the
+# shared scripts/finalize_curve.py instead (see finalize_dv2_walker_r4.sh
+# for the wrapper pattern).
 set -e -o pipefail
 cd /root/repo
 OUT=benchmarks/results/dv3_ball_in_cup_catch_curve_r4.json

@@ -1,0 +1,12 @@
+#!/bin/bash
+# End-of-chain pipeline for the round-4 DreamerV1 cartpole-balance run.
+# Run AFTER the chain has stopped. Thin wrapper over finalize_curve.py
+# (the shared stitch + sanity-check + greedy-eval pipeline).
+set -e -o pipefail
+cd /root/repo
+exec python scripts/finalize_curve.py \
+  --chain-dir runs/dv1_cartpole/chain_r4 \
+  --run-dir runs/dv1_cartpole \
+  --out benchmarks/results/dv1_cartpole_balance_curve_r4.json \
+  --experiment "dreamer_v1_dmc_cartpole_balance (DreamerV1, dm_control cartpole-balance from 64x64 pixels, paper DMC recipe: deter 200 / stoch 30 / dense 400 / ELU, action_repeat 2, replay_ratio 0.2, 8 async envs, HBM replay cache)" \
+  --protocol "trained FROM SCRATCH this round via scripts/train_chain.py checkpoint-resume legs; curve = episode-end rewards binned from stdout; first learning-evidence artifact for the DreamerV1 family (DV2: walker-walk r4; DV3: walker 742.8@100K r3, cartpole-swingup 865.5@204K r4, ball_in_cup 916@100K r4)"

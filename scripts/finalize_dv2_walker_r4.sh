@@ -1,57 +1,12 @@
 #!/bin/bash
-# End-of-chain pipeline for the round-4 DreamerV2 walker-walk run: stitch
-# the reward curve across chain legs, greedy-eval the newest checkpoint,
-# fold the eval into the curve artifact. Run AFTER the chain has stopped.
+# End-of-chain pipeline for the round-4 DreamerV2 walker-walk run.
+# Run AFTER the chain has stopped. Thin wrapper over finalize_curve.py
+# (the shared stitch + sanity-check + greedy-eval pipeline).
 set -e -o pipefail
 cd /root/repo
-OUT=benchmarks/results/dv2_walker_walk_curve_r4.json
-
-python scripts/curve_from_logs.py \
+exec python scripts/finalize_curve.py \
   --chain-dir runs/dv2_walker/chain_r4 \
-  --out "$OUT"
-
-CKPT=$(python - <<'EOF'
-from scripts.train_chain import latest_ckpt
-step, ckpt = latest_ckpt("runs/dv2_walker")
-print(ckpt)
-EOF
-)
-if [ -z "$CKPT" ] || [ "$CKPT" = "None" ]; then
-  echo "ERROR: no checkpoint found under runs/dv2_walker" >&2
-  exit 1
-fi
-CKPT_STEP=$(basename "$CKPT" | sed -E 's/ckpt_([0-9]+)_.*/\1/')
-FINAL_STEP=$(python -c "import json,sys; print(json.load(open('$OUT'))['final_step'])")
-DELTA=$((CKPT_STEP - FINAL_STEP)); DELTA=${DELTA#-}
-if [ "$DELTA" -gt 26000 ]; then
-  echo "ERROR: newest ckpt step $CKPT_STEP is $DELTA steps from the curve's final step $FINAL_STEP — wrong chain's checkpoint?" >&2
-  exit 1
-fi
-echo "evaluating $CKPT"
-MUJOCO_GL=egl timeout 1200 python sheeprl_eval.py "checkpoint_path=$CKPT" \
-  env.capture_video=False 2>&1 | tee /tmp/dv2_walker_eval_r4.log | tail -3
-
-python - "$OUT" "$CKPT_STEP" <<'EOF'
-import json, re, sys
-out, ckpt_step = sys.argv[1], int(sys.argv[2])
-d = json.load(open(out))
-txt = open("/tmp/dv2_walker_eval_r4.log").read()
-m = re.findall(r"Test - Reward: ([-\d.]+)", txt)
-if not m:
-    sys.exit("ERROR: no 'Test - Reward:' line in the eval log — eval failed or "
-             "its output format drifted; refusing to publish the artifact "
-             "without the greedy-eval number")
-d["greedy_eval_reward_at_final_ckpt"] = float(m[-1])
-d["eval_ckpt_step"] = ckpt_step
-d["experiment"] = ("dreamer_v2_dmc_walker_walk (DreamerV2, dm_control walker-walk "
-                   "from 64x64 pixels, paper dmc_vision recipe: deter/hidden 200, "
-                   "dynamics-backprop actor, action_repeat 2, replay_ratio 0.2, "
-                   "8 async envs, HBM replay cache at 12500 frames/env)")
-d["hardware"] = "1x TPU v5e (tunneled axon backend) + 1-core CPU host"
-d["protocol"] = ("trained FROM SCRATCH this round via scripts/train_chain.py "
-                 "checkpoint-resume legs; curve = episode-end rewards binned from "
-                 "stdout; first learning-evidence artifact for the DreamerV2 family "
-                 "(DV3 curves: walker 742.8@100K r3, cartpole 865.5@204K r4)")
-json.dump(d, open(out, "w"), indent=2)
-print(json.dumps({k: d.get(k) for k in ("final_step", "final_reward_mean", "best_reward_mean", "greedy_eval_reward_at_final_ckpt")}))
-EOF
+  --run-dir runs/dv2_walker \
+  --out benchmarks/results/dv2_walker_walk_curve_r4.json \
+  --experiment "dreamer_v2_dmc_walker_walk (DreamerV2, dm_control walker-walk from 64x64 pixels, paper dmc_vision recipe: deter/hidden 200, dynamics-backprop actor, action_repeat 2, replay_ratio 0.2, 8 async envs, HBM replay cache at 12500 frames/env)" \
+  --protocol "trained FROM SCRATCH this round via scripts/train_chain.py checkpoint-resume legs; curve = episode-end rewards binned from stdout; first learning-evidence artifact for the DreamerV2 family (DV3 curves: walker 742.8@100K r3, cartpole 865.5@204K r4)"

@@ -1,74 +1,16 @@
 #!/bin/bash
 # End-of-run pipeline for the round-4 Plan2Explore (DV3) two-phase run on
 # dm_control cartpole swingup_sparse. Stitches BOTH phases into one
-# artifact: the exploration phase's task-reward trace (expected ~0 on a
-# sparse task — the policy optimizes ensemble disagreement, not reward)
-# and the finetuning phase's reward curve + greedy eval (the claim under
-# test: the disagreement-driven buffer + world model make the sparse task
-# solvable where random exploration rarely even sees reward).
+# artifact via finalize_curve.py: the finetuning phase's reward curve +
+# greedy eval, plus the exploration phase's task-reward trace (expected
+# ~0 on a sparse task — the policy optimizes ensemble disagreement, not
+# reward). Run AFTER the finetune chain has stopped.
 set -e -o pipefail
 cd /root/repo
-OUT=benchmarks/results/p2e_dv3_cartpole_sparse_r4.json
-
-python scripts/curve_from_logs.py \
+exec python scripts/finalize_curve.py \
   --chain-dir runs/p2e_sparse/chain_fntn \
-  --out "$OUT"
-
-CKPT=$(python - <<'EOF'
-from scripts.train_chain import latest_ckpt
-step, ckpt = latest_ckpt("runs/p2e_sparse/fntn")
-print(ckpt)
-EOF
-)
-if [ -z "$CKPT" ] || [ "$CKPT" = "None" ]; then
-  echo "ERROR: no finetune checkpoint under runs/p2e_sparse/fntn" >&2
-  exit 1
-fi
-CKPT_STEP=$(basename "$CKPT" | sed -E 's/ckpt_([0-9]+)_.*/\1/')
-FINAL_STEP=$(python -c "import json,sys; print(json.load(open('$OUT'))['final_step'])")
-DELTA=$((CKPT_STEP - FINAL_STEP)); DELTA=${DELTA#-}
-if [ "$DELTA" -gt 26000 ]; then
-  echo "ERROR: newest ckpt step $CKPT_STEP is $DELTA steps from the curve's final step $FINAL_STEP" >&2
-  exit 1
-fi
-echo "evaluating $CKPT"
-MUJOCO_GL=egl timeout 1200 python sheeprl_eval.py "checkpoint_path=$CKPT" \
-  env.capture_video=False 2>&1 | tee /tmp/p2e_eval_r4.log | tail -3
-
-python - "$OUT" "$CKPT_STEP" <<'EOF'
-import glob, json, re, sys
-out, ckpt_step = sys.argv[1], int(sys.argv[2])
-d = json.load(open(out))
-txt = open("/tmp/p2e_eval_r4.log").read()
-m = re.findall(r"Test - Reward: ([-\d.]+)", txt)
-if not m:
-    sys.exit("ERROR: no 'Test - Reward:' line in the eval log — refusing to "
-             "publish the artifact without the greedy-eval number")
-d["greedy_eval_reward_at_final_ckpt"] = float(m[-1])
-d["eval_ckpt_step"] = ckpt_step
-
-# exploration-phase task-reward trace (from the exploration run's log):
-# near-zero rewards here are the POINT — they show the sparse task gives
-# random/intrinsic behavior almost no signal
-expl_rewards = []
-for p in sorted(glob.glob("runs/p2e_sparse/expl_leg*.log")):
-    for step, rew in re.findall(r"policy_step=(\d+), reward_env_\d+=([-\d.e]+)", open(p, errors="ignore").read()):
-        expl_rewards.append({"step": int(step), "reward": float(rew)})
-d["exploration_phase_task_rewards"] = expl_rewards
-if expl_rewards:
-    vals = [r["reward"] for r in expl_rewards]
-    d["exploration_phase_summary"] = {
-        "episodes": len(vals),
-        "reward_mean": sum(vals) / len(vals),
-        "reward_max": max(vals),
-    }
-
-d["experiment"] = ("p2e_dv3 two-phase on dm_control cartpole swingup_sparse "
-                   "(DV3-S, pixels, 8 envs): exploration phase trains world model + "
-                   "ensemble on intrinsic disagreement reward only, finetuning "
-                   "resumes from the exploration checkpoint with the inherited "
-                   "buffer and trains the task actor/critic on extrinsic reward")
-d["hardware"] = "1x TPU v5e (tunneled axon backend) + 1-core CPU host"
-json.dump(d, open(out, "w"), indent=2)
-print(json.dumps({k: d.get(k) for k in ("final_step", "final_reward_mean", "best_reward_mean", "greedy_eval_reward_at_final_ckpt")}))
-EOF
+  --run-dir runs/p2e_sparse/fntn \
+  --expl-chain-dir runs/p2e_sparse/chain_expl \
+  --out benchmarks/results/p2e_dv3_cartpole_sparse_r4.json \
+  --experiment "p2e_dv3 two-phase on dm_control cartpole swingup_sparse (DV3-S, pixels, 8 envs): exploration phase trains world model + ensemble on intrinsic disagreement reward only, finetuning resumes from the exploration checkpoint with the inherited buffer and trains the task actor/critic on extrinsic reward" \
+  --protocol "both phases trained FROM SCRATCH this round via scripts/train_chain.py; finetune curve = episode-end rewards binned from stdout; first learning-evidence artifact for the Plan2Explore family"

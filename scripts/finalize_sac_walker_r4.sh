@@ -4,6 +4,10 @@
 # Stitches the reward curve across chain legs, greedy-evals the newest
 # checkpoint, and folds the eval into the curve artifact. Run AFTER the
 # chain has stopped.
+# FROZEN RECORD: this script already produced its committed artifact and
+# is kept as the exact pipeline that made it. New runs should use the
+# shared scripts/finalize_curve.py instead (see finalize_dv2_walker_r4.sh
+# for the wrapper pattern).
 set -e -o pipefail
 cd /root/repo
 OUT=benchmarks/results/sac_walker_walk_curve_r4.json

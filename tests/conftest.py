@@ -7,6 +7,14 @@ real pod.
 """
 
 import os
+import sys
+
+# repo root on sys.path regardless of entry point: the installed `pytest`
+# console script and tests/run_tests.py don't add the cwd, which breaks
+# `from scripts...` imports (scripts/ is not an installed package)
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
 
 # FORCE cpu — the machine env pins JAX_PLATFORMS to the real TPU tunnel,
 # which tests must never touch. The axon sitecustomize imports jax at

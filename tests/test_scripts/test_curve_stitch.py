@@ -1,0 +1,64 @@
+"""Pin the curve stitcher's resume-aware merge (scripts/curve_from_logs.py).
+
+A chain leg resumes from a checkpoint BEFORE the previous leg's kill
+point and replays that range along a fresh trajectory; the stitcher must
+drop the abandoned trajectory's points from the leg's resume step on —
+not from the leg's first LOGGED step (episode ends lag the checkpoint).
+"""
+
+import json
+import os
+
+from scripts.curve_from_logs import stitch
+
+
+def _leg(chain_dir, idx, rows):
+    with open(os.path.join(chain_dir, f"leg_{idx:03d}.log"), "w") as f:
+        for step, env, rew in rows:
+            f.write(f"Rank-0: policy_step={step}, reward_env_{env}={rew}\n")
+            f.write("unrelated log noise\n")
+
+
+def _status(chain_dir, starts):
+    with open(os.path.join(chain_dir, "status.jsonl"), "w") as f:
+        for leg, from_step in starts:
+            f.write(json.dumps({"event": "leg_start", "leg": leg, "from_step": from_step}) + "\n")
+
+
+def test_resume_overrides_abandoned_trajectory(tmp_path):
+    chain = str(tmp_path)
+    # leg 0 logs through step 1000, then is killed; leg 1 resumes from the
+    # ckpt at 800 and replays 900+ along a fresh trajectory
+    _leg(chain, 0, [(100, 0, 10.0), (500, 0, 20.0), (900, 0, 30.0), (1000, 0, 35.0)])
+    _leg(chain, 1, [(950, 0, 31.0), (1100, 0, 40.0)])
+    _status(chain, [(0, 0), (1, 800)])
+
+    art = stitch(chain)
+    steps = [p["policy_step"] for p in art["curve"]]
+    # abandoned points at 900/1000 (>= leg 1's resume step 800) are gone,
+    # even though leg 1's first LOGGED step is 950
+    assert steps == [100, 500, 950, 1100]
+    assert art["final_step"] == 1100
+    assert art["final_reward_mean"] == 40.0
+    assert art["best_reward_mean"] == 40.0
+
+
+def test_multi_env_points_average(tmp_path):
+    chain = str(tmp_path)
+    _leg(chain, 0, [(100, 0, 10.0), (100, 1, 30.0)])
+    _status(chain, [(0, 0)])
+    art = stitch(chain)
+    (p,) = art["curve"]
+    assert p["n_envs"] == 2
+    assert p["reward_mean"] == 20.0
+    assert p["reward_min"] == 10.0
+    assert p["reward_max"] == 30.0
+
+
+def test_torn_tail_line_skipped(tmp_path):
+    chain = str(tmp_path)
+    with open(os.path.join(chain, "leg_000.log"), "w") as f:
+        f.write("Rank-0: policy_step=100, reward_env_0=10.0\n")
+        f.write("Rank-0: policy_step=200, reward_env_0=2.5e\n")  # SIGKILL tear
+    art = stitch(chain)
+    assert [p["policy_step"] for p in art["curve"]] == [100]

@@ -1,0 +1,159 @@
+"""Unit + loop tests for the checkpoint-resume chain runner.
+
+``scripts/train_chain.py`` is the harness behind every long learning run
+(walker/cartpole/ball-in-cup/sac curves), so its ckpt discovery, leg
+rotation, resume propagation, and failure cap get pinned here. The
+trainer subprocess is stubbed: tests monkeypatch ``subprocess.Popen`` in
+the module to run a tiny inline script instead of ``sheeprl.py``.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from scripts.train_chain import latest_ckpt, main, rss_gb
+
+
+def _write_ckpt(run_dir, step, mtime=None):
+    d = os.path.join(run_dir, f"run_{step}", "checkpoint")
+    os.makedirs(d, exist_ok=True)
+    p = os.path.join(d, f"ckpt_{step}_0.ckpt")
+    with open(p, "w") as f:
+        f.write("x")
+    if mtime is not None:
+        os.utime(p, (mtime, mtime))
+    return p
+
+
+class TestLatestCkpt:
+    def test_empty(self, tmp_path):
+        assert latest_ckpt(str(tmp_path)) == (0, None)
+
+    def test_orders_by_step(self, tmp_path):
+        _write_ckpt(str(tmp_path), 100)
+        p200 = _write_ckpt(str(tmp_path), 200)
+        step, path = latest_ckpt(str(tmp_path))
+        assert (step, path) == (200, p200)
+
+    def test_ties_broken_by_mtime(self, tmp_path):
+        now = time.time()
+        _write_ckpt(str(tmp_path / "a"), 300, mtime=now - 100)
+        newer = _write_ckpt(str(tmp_path / "b"), 300, mtime=now)
+        assert latest_ckpt(str(tmp_path)) == (300, newer)
+
+    def test_ignores_malformed_names(self, tmp_path):
+        d = tmp_path / "run" / "checkpoint"
+        d.mkdir(parents=True)
+        (d / "ckpt_notastep.ckpt").write_text("x")
+        assert latest_ckpt(str(tmp_path)) == (0, None)
+
+
+def test_rss_gb():
+    assert rss_gb(os.getpid()) > 0.001
+    assert rss_gb(2**30) == 0.0
+
+
+# stub trainer: appends its argv to calls.jsonl, then (unless told to
+# fail) writes a checkpoint STEP_INCREMENT past the newest existing one
+_STUB = r"""
+import glob, json, os, re, sys
+run_dir, calls_path, should_fail = sys.argv[1], sys.argv[2], sys.argv[3] == "1"
+with open(calls_path, "a") as f:
+    f.write(json.dumps(sys.argv[4:]) + "\n")
+if should_fail:
+    sys.exit(3)
+steps = [int(m.group(1)) for p in glob.glob(os.path.join(run_dir, "**", "ckpt_*_0.ckpt"), recursive=True)
+         for m in [re.search(r"ckpt_(\d+)_0\.ckpt$", p)] if m]
+step = (max(steps) if steps else 0) + 1000
+d = os.path.join(run_dir, "run", "checkpoint")
+os.makedirs(d, exist_ok=True)
+open(os.path.join(d, f"ckpt_{step}_0.ckpt"), "w").write("x")
+"""
+
+
+def _run_chain(tmp_path, monkeypatch, *, target, fail=False, max_failures=3,
+               pre_existing_leg=None):
+    run_dir = str(tmp_path / "run")
+    chain_dir = str(tmp_path / "chain")
+    calls_path = str(tmp_path / "calls.jsonl")
+    os.makedirs(run_dir, exist_ok=True)
+    os.makedirs(chain_dir, exist_ok=True)
+    if pre_existing_leg is not None:
+        open(os.path.join(chain_dir, f"leg_{pre_existing_leg:03d}.log"), "w").close()
+
+    real_popen = subprocess.Popen
+
+    def fake_popen(cmd, **kw):
+        # cmd = [python, .../sheeprl.py, *overrides, run_name=..., (resume)]
+        return real_popen(
+            [sys.executable, "-c", _STUB, run_dir, calls_path,
+             "1" if fail else "0", *cmd[2:]],
+            **kw,
+        )
+
+    import scripts.train_chain as tc
+
+    monkeypatch.setattr(tc.subprocess, "Popen", fake_popen)
+    monkeypatch.setattr(sys, "argv", [
+        "train_chain.py", "--run-dir", run_dir, "--chain-dir", chain_dir,
+        "--target-step", str(target), "--deadline-ts", str(time.time() + 60),
+        "--leg-seconds", "30", "--max-rss-gb", "64", "--poll-seconds", "0.05",
+        "--max-failures", str(max_failures), "--", "exp=dummy", "seed=1",
+    ])
+    rc = main()
+    status = [json.loads(l) for l in open(os.path.join(chain_dir, "status.jsonl"))]
+    calls = [json.loads(l) for l in open(calls_path)] if os.path.exists(calls_path) else []
+    return rc, status, calls, chain_dir
+
+
+def test_chain_runs_legs_to_target(tmp_path, monkeypatch):
+    rc, status, calls, chain_dir = _run_chain(tmp_path, monkeypatch, target=2500)
+    assert rc == 0
+    assert status[-1]["event"] == "target_reached"
+    assert status[-1]["step"] >= 2500
+    # 3 legs of +1000 each; first leg fresh, later legs resume from newest ckpt
+    assert len(calls) == 3
+    assert not any(a.startswith("checkpoint.resume_from=") for a in calls[0])
+    assert any(a.startswith("checkpoint.resume_from=") and "ckpt_1000_0" in a for a in calls[1])
+    assert any(a.startswith("checkpoint.resume_from=") and "ckpt_2000_0" in a for a in calls[2])
+    # every leg got the chain's overrides and a distinct run_name
+    assert all("exp=dummy" in c for c in calls)
+    assert [a for c in calls for a in c if a.startswith("run_name=")] == [
+        "run_name=chain_leg000", "run_name=chain_leg001", "run_name=chain_leg002"]
+    ends = [s for s in status if s["event"] == "leg_end"]
+    assert all(e["made_progress"] for e in ends)
+
+
+def test_chain_failure_cap(tmp_path, monkeypatch):
+    rc, status, calls, _ = _run_chain(tmp_path, monkeypatch, target=5000,
+                                      fail=True, max_failures=2)
+    assert rc == 1
+    assert status[-1]["event"] == "too_many_failures"
+    assert len(calls) == 2  # stopped at the cap, not the target
+    ends = [s for s in status if s["event"] == "leg_end"]
+    assert all(not e["made_progress"] and e["rc"] == 3 for e in ends)
+
+
+def test_chain_restart_continues_leg_numbering(tmp_path, monkeypatch):
+    rc, status, calls, chain_dir = _run_chain(tmp_path, monkeypatch, target=1000,
+                                              pre_existing_leg=4)
+    assert rc == 0
+    # a restarted chain must not clobber an old leg log (the curve
+    # stitcher reads all of them)
+    assert sorted(f for f in os.listdir(chain_dir) if f.endswith(".log")) == [
+        "leg_004.log", "leg_005.log"]
+    assert [a for c in calls for a in c if a.startswith("run_name=")] == [
+        "run_name=chain_leg005"]
+
+
+def test_chain_target_already_reached(tmp_path, monkeypatch):
+    run_dir = str(tmp_path / "run")
+    _write_ckpt(run_dir, 9000)
+    rc, status, calls, _ = _run_chain(tmp_path, monkeypatch, target=5000)
+    assert rc == 0
+    assert status[-1]["event"] == "target_reached"
+    assert calls == []  # no leg launched
