@@ -37,6 +37,19 @@ from scripts.train_chain import latest_ckpt  # noqa: E402
 HARDWARE = "1x TPU v5e (tunneled axon backend) + 1-core CPU host"
 
 
+def parse_eval_output(eval_txt: str):
+    """(last Test-Reward float | None, eval-protocol dict | None).
+
+    The protocol line is emitted by sheeprl_tpu/utils/eval_protocol.py;
+    older checkpoints' evals only have the per-episode Test-Reward lines."""
+    rewards = re.findall(r"Test - Reward: ([-\d.]+)", eval_txt)
+    protocols = re.findall(r"Eval protocol: (\{.*\})", eval_txt)
+    return (
+        float(rewards[-1]) if rewards else None,
+        json.loads(protocols[-1]) if protocols else None,
+    )
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--chain-dir", required=True)
@@ -48,17 +61,21 @@ def main() -> int:
     ap.add_argument("--extra-log", action="append", default=[])
     ap.add_argument("--delta-cap", type=int, default=26000,
                     help="max |ckpt step - curve final step| before refusing")
-    ap.add_argument("--eval-timeout", type=int, default=1200)
+    ap.add_argument("--eval-timeout", type=int, default=4800,
+                    help="seconds; the default covers the 10-episode protocol "
+                         "(5 greedy + 5 sampled) at ~8 min/episode")
     ap.add_argument("--eval-log", default=None,
                     help="persist the eval's full output here "
                          "(default: /tmp/<artifact-stem>_eval.log)")
     ap.add_argument("--expl-chain-dir", default=None,
                     help="optional exploration-phase chain (P2E): its stitched "
                          "task-reward trace is embedded as exploration_phase")
+    ap.add_argument("--smooth", type=int, default=5,
+                    help="reward-binning window passed to stitch()")
     args = ap.parse_args()
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    artifact = stitch(args.chain_dir, args.extra_log)
+    artifact = stitch(args.chain_dir, args.extra_log, smooth=args.smooth)
     if not artifact["curve"]:
         print(f"ERROR: no reward points stitched from {args.chain_dir}", file=sys.stderr)
         return 1
@@ -93,7 +110,8 @@ def main() -> int:
             rc = proc.returncode
         except subprocess.TimeoutExpired:
             rc = "timeout"
-    eval_txt = open(eval_log, errors="replace").read()
+    with open(eval_log, errors="replace") as f:
+        eval_txt = f.read()
     tail = "\n".join(eval_txt.strip().splitlines()[-15:])
     if rc != 0:
         print(
@@ -102,8 +120,8 @@ def main() -> int:
             file=sys.stderr,
         )
         return 1
-    rewards = re.findall(r"Test - Reward: ([-\d.]+)", eval_txt)
-    if not rewards:
+    headline, protocol = parse_eval_output(eval_txt)
+    if headline is None:
         print(
             "ERROR: no 'Test - Reward:' line in the eval output — eval failed "
             "or its output format drifted; refusing to publish the artifact "
@@ -111,9 +129,21 @@ def main() -> int:
             file=sys.stderr,
         )
         return 1
-    print(f"Test - Reward: {rewards[-1]}")
+    print(f"Test - Reward: {headline}")
 
-    artifact["greedy_eval_reward_at_final_ckpt"] = float(rewards[-1])
+    # multi-episode protocol summary (greedy + sampled per-episode lists);
+    # the final 'Test - Reward:' line is the protocol's greedy median, so
+    # the legacy field below stays a robust statistic either way
+    if protocol is not None:
+        artifact["eval_protocol"] = protocol
+    else:
+        print(
+            "WARNING: no 'Eval protocol:' line — single-episode eval output "
+            "(pre-protocol checkpoint format?); publishing the last "
+            "'Test - Reward:' as the only eval number.",
+            file=sys.stderr,
+        )
+    artifact["greedy_eval_reward_at_final_ckpt"] = headline
     artifact["eval_ckpt_step"] = ckpt_step
     artifact["experiment"] = args.experiment
     artifact["hardware"] = args.hardware
@@ -121,7 +151,15 @@ def main() -> int:
         artifact["protocol"] = args.protocol
 
     if args.expl_chain_dir:
-        expl = stitch(args.expl_chain_dir)
+        expl = stitch(args.expl_chain_dir, smooth=args.smooth)
+        if not expl["curve"]:
+            print(
+                f"ERROR: --expl-chain-dir {args.expl_chain_dir} stitched to an "
+                "empty curve — wrong chain dir layout? (expects leg_*.log + "
+                "status.jsonl, as written by scripts/train_chain.py)",
+                file=sys.stderr,
+            )
+            return 1
         vals = [p["reward_mean"] for p in expl["curve"]]
         artifact["exploration_phase"] = {
             "note": (

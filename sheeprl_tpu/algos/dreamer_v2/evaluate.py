@@ -3,6 +3,8 @@ sheeprl/algos/dreamer_v2/evaluate.py)."""
 
 from __future__ import annotations
 
+from functools import partial
+
 from typing import Any, Dict
 
 import gymnasium as gym
@@ -11,6 +13,7 @@ from sheeprl_tpu.algos.dreamer_v2.agent import PlayerDV2, build_agent
 from sheeprl_tpu.algos.dreamer_v2.utils import test
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.eval_protocol import run_eval_protocol
 from sheeprl_tpu.utils.registry import register_evaluation
 
 
@@ -54,7 +57,7 @@ def evaluate_dreamer_v2(runtime, cfg: Dict[str, Any], state: Dict[str, Any]):
         cfg.algo.world_model.recurrent_model.recurrent_state_size,
         discrete_size=cfg.algo.world_model.discrete_size,
     )
-    rew = test(player, runtime, cfg, log_dir)
+    protocol = run_eval_protocol(partial(test, player, runtime, cfg, log_dir), runtime, cfg)
     if logger:
-        logger.log_metrics({"Test/cumulative_reward": rew}, 0)
+        logger.log_metrics({"Test/cumulative_reward": protocol["greedy"]["median"]}, 0)
         logger.finalize()

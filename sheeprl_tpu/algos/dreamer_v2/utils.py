@@ -3,7 +3,7 @@ compute_lambda_values:86, prepare_obs:109, test, AGGREGATOR_KEYS:24."""
 
 from __future__ import annotations
 
-from typing import Any, Dict, Sequence
+from typing import Any, Dict, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -70,11 +70,20 @@ def prepare_obs(
     return out
 
 
-def test(player, runtime, cfg: Dict[str, Any], log_dir: str, test_name: str = "", greedy: bool = True) -> float:
-    env = make_env(cfg, cfg.seed, 0, log_dir, "test" + (f"_{test_name}" if test_name else ""))()
+def test(
+    player,
+    runtime,
+    cfg: Dict[str, Any],
+    log_dir: str,
+    test_name: str = "",
+    greedy: bool = True,
+    seed: Optional[int] = None,
+) -> float:
+    seed = cfg.seed if seed is None else seed
+    env = make_env(cfg, seed, 0, log_dir, "test" + (f"_{test_name}" if test_name else ""))()
     done = False
     cumulative_rew = 0.0
-    obs = env.reset(seed=cfg.seed)[0]
+    obs = env.reset(seed=seed)[0]
     old_num_envs = player.num_envs
     player.num_envs = 1
     player.init_states()

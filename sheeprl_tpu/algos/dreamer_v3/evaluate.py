@@ -3,6 +3,8 @@ sheeprl/algos/dreamer_v3/evaluate.py)."""
 
 from __future__ import annotations
 
+from functools import partial
+
 from typing import Any, Dict
 
 import gymnasium as gym
@@ -11,6 +13,7 @@ from sheeprl_tpu.algos.dreamer_v3.agent import PlayerDV3, build_agent
 from sheeprl_tpu.algos.dreamer_v3.utils import test
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.eval_protocol import run_eval_protocol
 from sheeprl_tpu.utils.registry import register_evaluation
 
 
@@ -55,7 +58,7 @@ def evaluate_dreamer_v3(runtime, cfg: Dict[str, Any], state: Dict[str, Any]):
         discrete_size=cfg.algo.world_model.discrete_size,
         decoupled_rssm=bool(cfg.algo.world_model.decoupled_rssm),
     )
-    rew = test(player, runtime, cfg, log_dir)
+    protocol = run_eval_protocol(partial(test, player, runtime, cfg, log_dir), runtime, cfg)
     if logger:
-        logger.log_metrics({"Test/cumulative_reward": rew}, 0)
+        logger.log_metrics({"Test/cumulative_reward": protocol["greedy"]["median"]}, 0)
         logger.finalize()

@@ -4,7 +4,7 @@ prepare_obs, test, AGGREGATOR_KEYS."""
 
 from __future__ import annotations
 
-from typing import Any, Dict, Sequence, Tuple
+from typing import Any, Dict, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -75,11 +75,20 @@ def prepare_obs(
     return out
 
 
-def test(player, runtime, cfg: Dict[str, Any], log_dir: str, test_name: str = "", greedy: bool = True) -> float:
-    env = make_env(cfg, cfg.seed, 0, log_dir, "test" + (f"_{test_name}" if test_name else ""))()
+def test(
+    player,
+    runtime,
+    cfg: Dict[str, Any],
+    log_dir: str,
+    test_name: str = "",
+    greedy: bool = True,
+    seed: Optional[int] = None,
+) -> float:
+    seed = cfg.seed if seed is None else seed
+    env = make_env(cfg, seed, 0, log_dir, "test" + (f"_{test_name}" if test_name else ""))()
     done = False
     cumulative_rew = 0.0
-    obs = env.reset(seed=cfg.seed)[0]
+    obs = env.reset(seed=seed)[0]
     old_num_envs = player.num_envs
     player.num_envs = 1
     player.init_states()

@@ -2,6 +2,8 @@
 
 from __future__ import annotations
 
+from functools import partial
+
 from typing import Any, Dict
 
 import gymnasium as gym
@@ -11,6 +13,7 @@ from sheeprl_tpu.algos.sac.agent import SACPlayer
 from sheeprl_tpu.algos.sac.utils import prepare_obs, test
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.eval_protocol import run_eval_protocol
 from sheeprl_tpu.utils.registry import register_evaluation
 
 
@@ -31,7 +34,7 @@ def evaluate_droq(runtime, cfg: Dict[str, Any], state: Dict[str, Any]):
         params["actor"],
         lambda obs: prepare_obs(obs, mlp_keys=cfg.algo.mlp_keys.encoder, num_envs=1),
     )
-    rew = test(player, runtime, cfg, log_dir)
+    protocol = run_eval_protocol(partial(test, player, runtime, cfg, log_dir), runtime, cfg)
     if logger:
-        logger.log_metrics({"Test/cumulative_reward": rew}, 0)
+        logger.log_metrics({"Test/cumulative_reward": protocol["greedy"]["median"]}, 0)
         logger.finalize()

@@ -3,6 +3,8 @@ sheeprl/algos/p2e_dv3/evaluate.py): evaluates the TASK policy."""
 
 from __future__ import annotations
 
+from functools import partial
+
 from typing import Any, Dict
 
 import gymnasium as gym
@@ -11,6 +13,7 @@ from sheeprl_tpu.algos.dreamer_v3.utils import test
 from sheeprl_tpu.algos.p2e_dv3.agent import build_agent, make_player
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.eval_protocol import run_eval_protocol
 from sheeprl_tpu.utils.registry import register_evaluation
 
 
@@ -48,7 +51,7 @@ def evaluate_p2e_dv3(runtime, cfg: Dict[str, Any], state: Dict[str, Any]):
         state.get("critics_exploration"),
     )
     player = make_player(runtime, world_model, actor, params, actions_dim, 1, cfg, "task")
-    rew = test(player, runtime, cfg, log_dir)
+    protocol = run_eval_protocol(partial(test, player, runtime, cfg, log_dir), runtime, cfg)
     if logger:
-        logger.log_metrics({"Test/cumulative_reward": rew}, 0)
+        logger.log_metrics({"Test/cumulative_reward": protocol["greedy"]["median"]}, 0)
         logger.finalize()

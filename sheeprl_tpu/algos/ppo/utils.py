@@ -2,7 +2,7 @@
 
 from __future__ import annotations
 
-from typing import Any, Dict, Sequence
+from typing import Any, Dict, Optional, Sequence
 
 import jax.numpy as jnp
 import numpy as np
@@ -41,8 +41,16 @@ def prepare_obs(
     return normalize_obs(out, cnn_keys, list(out.keys()))
 
 
-def test(player, runtime, cfg: Dict[str, Any], log_dir: str) -> float:
-    """Greedy rollout of one episode on rank 0 (reference ppo/utils.py test)."""
+def test(
+    player,
+    runtime,
+    cfg: Dict[str, Any],
+    log_dir: str,
+    test_name: str = "",
+    greedy: bool = True,
+    seed: Optional[int] = None,
+) -> float:
+    """Rollout of one episode on rank 0 (reference ppo/utils.py test)."""
     from sheeprl_tpu.algos.ppo.agent import PPOPlayer
 
     # rebind obs preparation to a single env (the training player batches
@@ -52,12 +60,13 @@ def test(player, runtime, cfg: Dict[str, Any], log_dir: str) -> float:
         player.params,
         lambda obs: prepare_obs(obs, cnn_keys=cfg.algo.cnn_keys.encoder, num_envs=1),
     )
-    env = make_env(cfg, cfg.seed, 0, log_dir, "test", vector_env_idx=0)()
+    seed = cfg.seed if seed is None else seed
+    env = make_env(cfg, seed, 0, log_dir, "test" + (f"_{test_name}" if test_name else ""), vector_env_idx=0)()
     done = False
     cumulative_rew = 0.0
-    obs = env.reset(seed=cfg.seed)[0]
+    obs = env.reset(seed=seed)[0]
     while not done:
-        _, real_actions, _, _ = player.get_actions(obs, runtime.next_key(), greedy=True)
+        _, real_actions, _, _ = player.get_actions(obs, runtime.next_key(), greedy=greedy)
         actions = np.asarray(real_actions).reshape(env.action_space.shape)
         obs, reward, terminated, truncated, _ = env.step(actions)
         done = bool(terminated or truncated)

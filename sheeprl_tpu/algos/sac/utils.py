@@ -2,7 +2,7 @@
 
 from __future__ import annotations
 
-from typing import Any, Dict, Sequence
+from typing import Any, Dict, Optional, Sequence
 
 import jax.numpy as jnp
 import numpy as np
@@ -27,7 +27,15 @@ def prepare_obs(
     return np.concatenate([with_batch[k] for k in mlp_keys], axis=-1).astype(np.float32)
 
 
-def test(player, runtime, cfg: Dict[str, Any], log_dir: str) -> float:
+def test(
+    player,
+    runtime,
+    cfg: Dict[str, Any],
+    log_dir: str,
+    test_name: str = "",
+    greedy: bool = True,
+    seed: Optional[int] = None,
+) -> float:
     from sheeprl_tpu.algos.sac.agent import SACPlayer
 
     player = SACPlayer(
@@ -35,12 +43,13 @@ def test(player, runtime, cfg: Dict[str, Any], log_dir: str) -> float:
         player.params,
         lambda obs: prepare_obs(obs, mlp_keys=cfg.algo.mlp_keys.encoder, num_envs=1),
     )
-    env = make_env(cfg, cfg.seed, 0, log_dir, "test", vector_env_idx=0)()
+    seed = cfg.seed if seed is None else seed
+    env = make_env(cfg, seed, 0, log_dir, "test" + (f"_{test_name}" if test_name else ""), vector_env_idx=0)()
     done = False
     cumulative_rew = 0.0
-    obs = env.reset(seed=cfg.seed)[0]
+    obs = env.reset(seed=seed)[0]
     while not done:
-        actions = np.asarray(player.get_actions(obs, greedy=True))
+        actions = np.asarray(player.get_actions(obs, runtime.next_key(), greedy=greedy))
         obs, reward, terminated, truncated, _ = env.step(actions.reshape(env.action_space.shape))
         done = bool(terminated or truncated)
         cumulative_rew += float(reward)

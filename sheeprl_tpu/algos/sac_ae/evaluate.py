@@ -3,6 +3,8 @@ sheeprl/algos/sac_ae/evaluate.py)."""
 
 from __future__ import annotations
 
+from functools import partial
+
 from typing import Any, Dict
 
 import gymnasium as gym
@@ -11,6 +13,7 @@ from sheeprl_tpu.algos.sac_ae.agent import SACAEPlayer, build_agent
 from sheeprl_tpu.algos.sac_ae.utils import prepare_obs, test
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.eval_protocol import run_eval_protocol
 from sheeprl_tpu.utils.registry import register_evaluation
 
 
@@ -34,7 +37,7 @@ def evaluate_sac_ae(runtime, cfg: Dict[str, Any], state: Dict[str, Any]):
         {"encoder": params["critic"]["encoder"], "actor": params["actor"]},
         lambda obs: prepare_obs(obs, cnn_keys=cfg.algo.cnn_keys.encoder, num_envs=1),
     )
-    rew = test(player, runtime, cfg, log_dir)
+    protocol = run_eval_protocol(partial(test, player, runtime, cfg, log_dir), runtime, cfg)
     if logger:
-        logger.log_metrics({"Test/cumulative_reward": rew}, 0)
+        logger.log_metrics({"Test/cumulative_reward": protocol["greedy"]["median"]}, 0)
         logger.finalize()

@@ -3,7 +3,7 @@ preprocess_obs:68, AGGREGATOR_KEYS, prepare_obs, test."""
 
 from __future__ import annotations
 
-from typing import Any, Dict, Sequence
+from typing import Any, Dict, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -48,7 +48,15 @@ def prepare_obs(
     return out
 
 
-def test(player, runtime, cfg: Dict[str, Any], log_dir: str) -> float:
+def test(
+    player,
+    runtime,
+    cfg: Dict[str, Any],
+    log_dir: str,
+    test_name: str = "",
+    greedy: bool = True,
+    seed: Optional[int] = None,
+) -> float:
     from sheeprl_tpu.algos.sac_ae.agent import SACAEPlayer
 
     player = SACAEPlayer(
@@ -56,12 +64,13 @@ def test(player, runtime, cfg: Dict[str, Any], log_dir: str) -> float:
         player.params,
         lambda obs: prepare_obs(obs, cnn_keys=cfg.algo.cnn_keys.encoder, num_envs=1),
     )
-    env = make_env(cfg, cfg.seed, 0, log_dir, "test")()
+    seed = cfg.seed if seed is None else seed
+    env = make_env(cfg, seed, 0, log_dir, "test" + (f"_{test_name}" if test_name else ""))()
     done = False
     cumulative_rew = 0.0
-    obs = env.reset(seed=cfg.seed)[0]
+    obs = env.reset(seed=seed)[0]
     while not done:
-        actions = player.get_actions(obs, greedy=True)
+        actions = player.get_actions(obs, runtime.next_key(), greedy=greedy)
         obs, reward, terminated, truncated, _ = env.step(
             np.asarray(actions).reshape(env.action_space.shape)
         )

@@ -1,0 +1,79 @@
+"""Multi-episode evaluation protocol: N greedy + N sampled episodes.
+
+The reference's evaluation entrypoints (reference
+sheeprl/algos/*/evaluate.py via sheeprl/algos/*/utils.py ``test``) roll a
+single greedy episode and publish that one number.  Round 4 showed why
+that is fragile: a solved ball_in_cup-catch run (sampled train mean 916)
+greedy-evaluated to 0.0 on its single rollout and that zero headlined the
+artifact.  Here every evaluation rolls ``episodes`` rollouts per mode
+(greedy and sampled) with distinct per-episode seeds and reports the
+per-episode lists plus summary stats, so no single rollout can headline.
+
+The summary is printed as one machine-readable ``Eval protocol: {...}``
+JSON line (parsed by ``scripts/finalize_curve.py``), followed by a final
+``Test - Reward: <greedy median>`` line so older log parsers that take
+the last ``Test - Reward:`` still see a robust statistic.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+from typing import Any, Callable, Dict, Sequence
+
+__all__ = ["run_eval_protocol"]
+
+
+def _summary(vals: Sequence[float]) -> Dict[str, Any]:
+    return {
+        "mean": round(statistics.fmean(vals), 3),
+        "median": round(statistics.median(vals), 3),
+        "min": round(min(vals), 3),
+        "max": round(max(vals), 3),
+        "per_episode": [round(v, 3) for v in vals],
+    }
+
+
+def run_eval_protocol(
+    test_fn: Callable[..., float],
+    runtime,
+    cfg,
+    *,
+    episodes: int | None = None,
+    modes: Sequence[str] = ("greedy", "sampled"),
+) -> Dict[str, Any]:
+    """Roll ``episodes`` rollouts per mode and return the summary dict.
+
+    ``test_fn(greedy=..., seed=..., test_name=...) -> float`` is one
+    episode's return (each algo's ``test`` partial-applied over its
+    player/cfg).  Episode i of every mode uses seed ``cfg.seed + i`` —
+    distinct seeds are what make repeated greedy rollouts informative
+    (same seed + deterministic policy = the same episode N times).
+
+    ``episodes`` defaults to ``$SHEEPRL_EVAL_EPISODES``, else 1 under
+    ``cfg.dry_run`` (CI), else 5.
+    """
+    if episodes is None:
+        episodes = int(os.environ.get("SHEEPRL_EVAL_EPISODES", "0")) or (
+            1 if cfg.dry_run else 5
+        )
+    base_seed = int(cfg.seed or 0)
+    out: Dict[str, Any] = {"episodes_per_mode": episodes, "seed_base": base_seed}
+    for mode in modes:
+        greedy = mode == "greedy"
+        vals = [
+            float(
+                test_fn(
+                    greedy=greedy,
+                    seed=base_seed + i,
+                    test_name=f"{mode}_ep{i}",
+                )
+            )
+            for i in range(episodes)
+        ]
+        out[mode] = _summary(vals)
+    headline = out["greedy" if "greedy" in modes else modes[0]]["median"]
+    runtime.print("Eval protocol:", json.dumps(out, sort_keys=True))
+    runtime.print("Test - Reward:", headline)
+    return out

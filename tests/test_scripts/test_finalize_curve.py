@@ -1,0 +1,36 @@
+"""Pin finalize_curve.py's eval-output parsing (scripts/finalize_curve.py).
+
+The pipeline publishes the eval-protocol summary (greedy + sampled
+per-episode lists) when present and falls back to the legacy single
+'Test - Reward:' line for pre-protocol eval logs.
+"""
+
+from scripts.finalize_curve import parse_eval_output
+
+PROTOCOL_LOG = """\
+Log dir: /tmp/x
+Test - Reward: 900.0
+Test - Reward: 910.0
+Test - Reward: 870.0
+Eval protocol: {"episodes_per_mode": 3, "seed_base": 5, "greedy": {"mean": 893.3, "median": 900.0, "min": 870.0, "max": 910.0, "per_episode": [900.0, 910.0, 870.0]}, "sampled": {"mean": 880.0, "median": 880.0, "min": 860.0, "max": 900.0, "per_episode": [860.0, 880.0, 900.0]}}
+Test - Reward: 900.0
+"""
+
+
+def test_protocol_log_parses():
+    headline, protocol = parse_eval_output(PROTOCOL_LOG)
+    # headline = the trailing greedy-median line, not any single episode
+    assert headline == 900.0
+    assert protocol["episodes_per_mode"] == 3
+    assert protocol["greedy"]["per_episode"] == [900.0, 910.0, 870.0]
+    assert protocol["sampled"]["median"] == 880.0
+
+
+def test_legacy_single_episode_log():
+    headline, protocol = parse_eval_output("noise\nTest - Reward: 123.5\n")
+    assert headline == 123.5
+    assert protocol is None
+
+
+def test_empty_log():
+    assert parse_eval_output("no eval lines here") == (None, None)
