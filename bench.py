@@ -15,8 +15,8 @@ Driver contract (hardened after round 2's rc=124 timeout):
 - Each metric is emitted the moment its section finishes AND appended to
   ``benchmarks/results/bench_last.jsonl`` — a driver timeout can lose the
   tail sections but never completed ones.  At the end all metrics are
-  re-emitted in canonical order (loop, ppo, sac, dec, dv3) so the flagship
-  DV3 line is the last line of stdout.
+  re-emitted in canonical order (loop, ppo, sac, a2c, dec, dv3) so the
+  flagship DV3 line is the last line of stdout.
 - Fixed costs (tunnel backend init, tracing, XLA compiles) are separated
   from steady state: PPO and SAC run their CLI protocol FOUR times — a
   short run that pays the one-time costs (cold compile or cache load), the
@@ -60,8 +60,9 @@ Benchmarks (baselines from BASELINE.md / the reference README):
 
 ``vs_baseline`` is the speedup factor (>1 is faster than the reference).
 
-Env overrides: BENCH_BUDGET_S, BENCH_SKIP_PPO/SAC/DV3/DEC/LOOP, BENCH_PPO_STEPS,
-BENCH_SAC_STEPS, BENCH_DV3_STEPS, BENCH_PLATFORM (cpu for local tests).
+Env overrides: BENCH_BUDGET_S, BENCH_SKIP_PPO/SAC/A2C/DV3/DEC/LOOP,
+BENCH_PPO_STEPS, BENCH_SAC_STEPS, BENCH_A2C_STEPS, BENCH_DV3_STEPS,
+BENCH_PLATFORM (cpu for local tests).
 """
 
 import json
@@ -80,6 +81,7 @@ _CHILD_OUT_PATH = None  # set by child_main so long sections can persist partial
 
 REFERENCE_PPO_SECONDS = 81.27
 REFERENCE_SAC_SECONDS = 320.21
+REFERENCE_A2C_SECONDS = 84.76
 REFERENCE_DV3_FRAMES_PER_S = 2032.0
 FULL_STEPS = 65536
 TPU_V5E_BF16_PEAK_FLOPS = 197e12
@@ -87,7 +89,7 @@ TPU_V5E_BF16_PEAK_FLOPS = 197e12
 # (section, conservative wall-clock estimate used for skip decisions);
 # ppo/sac cover four CLI runs each (cold + 2 cached-warm + long); dec runs
 # four protocols (coupled/decoupled x ppo/sac) on the TPU-backed learner
-SECTIONS = [("dv3", 60), ("loop", 60), ("ppo", 50), ("sac", 60), ("dec", 170)]
+SECTIONS = [("dv3", 60), ("loop", 60), ("ppo", 50), ("sac", 60), ("a2c", 50), ("dec", 170)]
 
 
 def _note(**kw):
@@ -160,6 +162,26 @@ def bench_ppo():
         "value": value,
         "unit": "s",
         "vs_baseline": round(REFERENCE_PPO_SECONDS / value, 3),
+        "method": f"steady-state {n_long - n_warm} steps x {rate * 1e3:.3f} ms/step -> 65536",
+        "measured_s": [round(t_cold, 2), round(t_warm, 2), round(t_long, 2)],
+    }
+
+
+def bench_a2c():
+    """A2C wall-clock — reference configs/exp/a2c_benchmarks.yaml
+    (reference README.md:116-132): CartPole-v1, 1 env, 65536 steps.
+    Baseline: 84.76 s (BASELINE.md)."""
+    n_long = max(int(os.environ.get("BENCH_A2C_STEPS", 33280)), 256)
+    n_warm = max(min(1024, n_long // 2), 128)
+    rate, t_cold, t_warm, t_long = _cli_steady_rate(
+        ["exp=a2c_benchmarks", "root_dir=/tmp/sheeprl_tpu_bench/a2c"], n_warm, n_long
+    )
+    value = round(rate * FULL_STEPS, 2)
+    return {
+        "metric": "a2c_cartpole_benchmark_wallclock",
+        "value": value,
+        "unit": "s",
+        "vs_baseline": round(REFERENCE_A2C_SECONDS / value, 3),
         "method": f"steady-state {n_long - n_warm} steps x {rate * 1e3:.3f} ms/step -> 65536",
         "measured_s": [round(t_cold, 2), round(t_warm, 2), round(t_long, 2)],
     }
@@ -394,7 +416,14 @@ def child_main(section, out_path):
         except Exception:
             pass
 
-    metric = {"dv3": bench_dv3, "loop": bench_loop, "ppo": bench_ppo, "sac": bench_sac, "dec": bench_dec}[section]()
+    metric = {
+        "dv3": bench_dv3,
+        "loop": bench_loop,
+        "ppo": bench_ppo,
+        "sac": bench_sac,
+        "a2c": bench_a2c,
+        "dec": bench_dec,
+    }[section]()
     with open(out_path, "w") as f:
         json.dump(metric, f)
 

@@ -284,6 +284,21 @@ def main(runtime, cfg: Dict[str, Any]):
     # high-latency link.
     dispatch_batch = max(1, int(cfg.algo.get("dispatch_batch", 1)))
     pending_iters = list(state.get("pending_iters", [])) if state else []
+    # cache appends batch on the same cadence as the gradient dispatches:
+    # rows accumulate host-side and land as ONE windowed append right
+    # before the cache is sampled (per-step appends cost a jit dispatch +
+    # H2D each, which re-introduces the per-step link latency that
+    # dispatch_batch exists to amortize)
+    pending_cache_rows = []
+
+    def flush_cache_rows():
+        if pending_cache_rows:
+            window = {
+                k: np.concatenate([r[k] for r in pending_cache_rows], axis=0)
+                for k in pending_cache_rows[0]
+            }
+            device_cache.add(window)
+            pending_cache_rows.clear()
 
     cumulative_per_rank_gradient_steps = 0
     metric_fetch_gate = MetricFetchGate(cfg.metric.get("fetch_every", 1))
@@ -328,7 +343,12 @@ def main(runtime, cfg: Dict[str, Any]):
         step_data["rewards"] = rewards[np.newaxis].astype(np.float32)
         rb.add(step_data, validate_args=cfg.buffer.validate_args)
         if device_cache is not None:
-            device_cache.add(step_data)
+            if dispatch_batch > 1:
+                pending_cache_rows.append(dict(step_data))
+                if len(pending_cache_rows) >= dispatch_batch:
+                    flush_cache_rows()
+            else:
+                device_cache.add(step_data)
         obs = next_obs
 
         if iter_num >= learning_starts:
@@ -353,6 +373,8 @@ def main(runtime, cfg: Dict[str, Any]):
                 iters_in_window = len(set(pending_iters))
                 pending_iters = []
                 batch_total = g * cfg.algo.per_rank_batch_size * world_size
+                if device_cache is not None:
+                    flush_cache_rows()  # sampled content must match the host rb
                 if device_cache is not None and device_cache.can_sample_transitions(
                     cfg.buffer.sample_next_obs
                 ):

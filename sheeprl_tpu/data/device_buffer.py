@@ -94,6 +94,33 @@ def _append(bufs, row, pos, mask, *, n_envs):
     return out
 
 
+@functools.partial(jax.jit, donate_argnums=(0,), static_argnames=("n_envs",))
+def _append_window(bufs, block, pos, mask, *, n_envs):
+    """Write T consecutive rows per env starting at its ring position.
+
+    bufs: {k: (cap, n_envs, *feat)}; block: {k: (T, n_envs, *feat)};
+    pos (n_envs,) i32 write heads; mask (n_envs,) bool.  One dispatch for
+    the whole window: the per-row path costs one jit dispatch + H2D per
+    env step, which on a remote link dominates an off-policy algo's
+    steady state once training itself is dispatch-batched.
+    """
+    t_len = next(iter(block.values())).shape[0]
+    cap = next(iter(bufs.values())).shape[0]
+    envs = jnp.arange(n_envs)
+
+    def body(t, bufs):
+        p = (pos + t) % cap
+        out = {}
+        for k, buf in bufs.items():
+            cur = buf[p, envs]
+            m = mask.reshape((n_envs,) + (1,) * (cur.ndim - 1))
+            row = jax.lax.dynamic_index_in_dim(block[k], t, 0, keepdims=False)
+            out[k] = buf.at[p, envs].set(jnp.where(m, row.astype(buf.dtype), cur))
+        return out
+
+    return jax.lax.fori_loop(0, t_len, body, bufs)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("n_samples", "batch_size", "cap", "n_envs", "next_keys"),
@@ -386,12 +413,17 @@ class DeviceReplayCache:
     def _place_row(self, row: Dict[str, np.ndarray]):
         return row  # uncommitted host arrays; the _append jit places them
 
+    def _place_block(self, block: Dict[str, np.ndarray]):
+        return block  # uncommitted host arrays; the _append_window jit places them
+
     # ------------------------------------------------------------- write
     def add(self, data: Dict[str, np.ndarray], indices: Optional[Sequence[int]] = None) -> None:
         """Mirror of ``EnvIndependentReplayBuffer.add``: ``data`` is
         (T, n_envs_in, *feat); ``indices`` routes columns to env rings
-        (default: all envs in order).  T > 1 loops host-side (the training
-        loops append single rows)."""
+        (default: all envs in order).  T > 1 goes through the windowed
+        append — one jit dispatch for the whole block (training loops that
+        dispatch-batch their gradient steps batch their appends the same
+        way; see sac.py)."""
         if not self.active:
             return
         first = next(iter(data.values()))
@@ -418,18 +450,35 @@ class DeviceReplayCache:
             return
         mask_np = np.zeros(self.n_envs, dtype=bool)
         mask_np[idx] = True
-        for t in range(t_len):
+        advance = t_len  # write heads move by the FULL window, even when
+        if t_len > self.capacity:  # only the last `capacity` rows survive
+            data = {k: v[-self.capacity:] for k, v in data.items()}
+            t_len = self.capacity
+        if t_len == 1:
             row = {}
             for k, v in data.items():
                 full_row = np.zeros((self.n_envs, *v.shape[2:]), dtype=v.dtype)
-                full_row[idx] = v[t]
+                full_row[idx] = v[0]
                 row[k] = full_row
             row = self._place_row(row)
             self._bufs = _append(
                 self._bufs, row, jnp.asarray(self._pos), jnp.asarray(mask_np), n_envs=self.n_envs
             )
-            self._pos[idx] = (self._pos[idx] + 1) % self.capacity
-            self._filled[idx] = np.minimum(self._filled[idx] + 1, self.capacity)
+        else:
+            block = {}
+            for k, v in data.items():
+                full = np.zeros((t_len, self.n_envs, *v.shape[2:]), dtype=v.dtype)
+                full[:, idx] = v
+                block[k] = full
+            block = self._place_block(block)
+            # truncated windows start where sequential adds would have put
+            # the first SURVIVING row: pos advanced by the dropped prefix
+            start = (self._pos + (advance - t_len)) % self.capacity
+            self._bufs = _append_window(
+                self._bufs, block, jnp.asarray(start), jnp.asarray(mask_np), n_envs=self.n_envs
+            )
+        self._pos[idx] = (self._pos[idx] + advance) % self.capacity
+        self._filled[idx] = np.minimum(self._filled[idx] + advance, self.capacity)
 
     def load_from(self, rb) -> None:
         """Bulk re-fill from an ``EnvIndependentReplayBuffer`` (resume path):
@@ -647,6 +696,10 @@ class ShardedDeviceReplayCache(DeviceReplayCache):
 
     def _place_row(self, row):
         return {k: jax.device_put(v, self._row_sharding) for k, v in row.items()}
+
+    def _place_block(self, block):
+        # (T, n_envs, *feat): env axis is dim 1, same layout as the rings
+        return {k: jax.device_put(v, self._env_sharding) for k, v in block.items()}
 
     # ---- per-device stratified sampler
     def sample(self, n_samples: int, batch_size: int, seq_len: int, key) -> List[Dict[str, jax.Array]]:

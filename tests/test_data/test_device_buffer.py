@@ -354,3 +354,48 @@ def test_resume_load_paths_apply_size_gates(capsys, monkeypatch):
     ok = DeviceReplayCache(64, 4, conservative=False)
     ok.load_from_replay(rb)
     assert ok.active and ok._bufs is not None
+
+
+def test_windowed_add_matches_per_row_adds():
+    """T>1 add (one _append_window dispatch) must leave the rings, write
+    heads, and fill counts identical to T sequential per-row adds —
+    including across a ring wrap and past capacity overflow."""
+    a = DeviceReplayCache(CAP, N_ENVS)
+    b = DeviceReplayCache(CAP, N_ENVS)
+    total = CAP + 7  # wraps the ring
+    rows = [_row(t) for t in range(total)]
+    for r in rows:
+        a.add(r)
+    b.add({k: np.concatenate([r[k] for r in rows], axis=0) for k in rows[0]})
+    assert np.array_equal(np.asarray(a._pos), np.asarray(b._pos))
+    assert np.array_equal(np.asarray(a._filled), np.asarray(b._filled))
+    for k in a._bufs:
+        assert np.array_equal(np.asarray(a._bufs[k]), np.asarray(b._bufs[k])), k
+    # a window longer than the ring keeps only the last CAP rows, at the
+    # SAME ring positions sequential adds would have left them
+    c = DeviceReplayCache(CAP, N_ENVS)
+    d = DeviceReplayCache(CAP, N_ENVS)
+    long_rows = [_row(t) for t in range(2 * CAP + 3)]
+    c.add({k: np.concatenate([r[k] for r in long_rows], axis=0) for k in long_rows[0]})
+    for r in long_rows:
+        d.add(r)
+    assert np.array_equal(np.asarray(c._pos), np.asarray(d._pos))
+    assert np.array_equal(np.asarray(c._filled), np.asarray(d._filled))
+    for k in c._bufs:
+        assert np.array_equal(np.asarray(c._bufs[k]), np.asarray(d._bufs[k])), k
+
+
+def test_windowed_add_partial_env_indices():
+    """Windowed adds route columns through `indices` exactly like the
+    per-row path (EnvIndependent semantics: per-env write heads move
+    independently)."""
+    a = DeviceReplayCache(CAP, N_ENVS)
+    b = DeviceReplayCache(CAP, N_ENVS)
+    rows = [_row(t, envs=[0, 2]) for t in range(5)]
+    for r in rows:
+        a.add(r, indices=[0, 2])
+    b.add({k: np.concatenate([r[k] for r in rows], axis=0) for k in rows[0]}, indices=[0, 2])
+    assert np.array_equal(np.asarray(a._pos), np.asarray(b._pos))
+    assert np.array_equal(np.asarray(a._filled), np.asarray(b._filled))
+    for k in a._bufs:
+        assert np.array_equal(np.asarray(a._bufs[k]), np.asarray(b._bufs[k])), k
