@@ -12,11 +12,14 @@ Driver contract (hardened after round 2's rc=124 timeout):
   interpreter per section sidesteps an axon footgun where pre-initialized
   backends make later CLI runs recompile XLA:CPU executables on the
   single host core (~10x slowdown, observed round 3).
-- Each metric is emitted the moment its section finishes AND appended to
-  ``benchmarks/results/bench_last.jsonl`` — a driver timeout can lose the
-  tail sections but never completed ones.  At the end all metrics are
-  re-emitted in canonical order (loop, ppo, sac, a2c, dec, dv3) so the
-  flagship DV3 line is the last line of stdout.
+- Each metric is emitted exactly ONCE on stdout: non-dv3 sections the
+  moment they finish, the flagship DV3 line deferred to the end so it
+  closes the stream (the driver's tail parser reads the last lines).
+  Every metric is also appended to ``benchmarks/results/bench_last.jsonl``
+  the moment its section completes — a driver timeout can lose the tail
+  sections but never completed ones — followed by one per-section
+  telemetry summary record (XLA compile counts/time, compile-cache
+  traffic, HBM usage, host RSS) from the obs layer.
 - Fixed costs (tunnel backend init, tracing, XLA compiles) are separated
   from steady state: PPO and SAC run their CLI protocol FOUR times — a
   short run that pays the one-time costs (cold compile or cache load), the
@@ -214,6 +217,8 @@ def bench_dv3():
     from benchmarks.bench_dv3_step import time_variant
 
     steps = int(os.environ.get("BENCH_DV3_STEPS", 48))
+    from sheeprl_tpu.obs import mfu_percent, peak_flops
+
     dt, t_len, b_size, extras = time_variant(
         fused=False,
         precision="bf16-mixed",
@@ -223,13 +228,16 @@ def bench_dv3():
     )
     frames_per_s = t_len * b_size / dt
     flops = extras.get("flops_per_step")
+    # generic MFU from the obs layer: detected device peak when known,
+    # else the TPU v5e anchor every earlier round reported against
+    mfu = mfu_percent(flops, dt, peak=peak_flops() or TPU_V5E_BF16_PEAK_FLOPS)
     return {
         "metric": "dreamer_v3_S_train_replayed_frames_per_s",
         "value": round(frames_per_s, 1),
         "unit": "frames/s",
         "vs_baseline": round(frames_per_s / REFERENCE_DV3_FRAMES_PER_S, 3),
         "step_ms": round(dt * 1e3, 1),
-        "mfu_pct": round(100.0 * flops / dt / TPU_V5E_BF16_PEAK_FLOPS, 2) if flops else None,
+        "mfu_pct": round(mfu, 2) if mfu else None,
         # r4: the benched config now matches the BASELINE.md anchor
         # (dreamer_v3_100k_ms_pacman): DISCRETE actions.  r1-r3 benched a
         # continuous-action variant of the same S size (heavier: dynamics
@@ -416,6 +424,13 @@ def child_main(section, out_path):
         except Exception:
             pass
 
+    # per-section telemetry summary (obs layer): compile counts/time,
+    # compile-cache traffic, HBM + host RSS — appended to bench_last.jsonl
+    # so a slow section can be attributed to compiles vs steady-state work
+    from sheeprl_tpu.obs import RecompileMonitor
+    from sheeprl_tpu.obs.telemetry import device_memory_stats, host_rss_mb
+
+    monitor = RecompileMonitor(name=f"bench:{section}", warn=False).install()
     metric = {
         "dv3": bench_dv3,
         "loop": bench_loop,
@@ -426,12 +441,27 @@ def child_main(section, out_path):
     }[section]()
     with open(out_path, "w") as f:
         json.dump(metric, f)
+    _note(
+        event="telemetry",
+        section=section,
+        compiles=monitor.snapshot(),
+        hbm=device_memory_stats(),
+        host_rss_mb=host_rss_mb(),
+    )
 
 
 def main():
-    # Parent: never imports jax.  Emits ONLY metric JSON lines on stdout.
+    # Parent: never imports jax.  Emits ONLY metric JSON lines on stdout,
+    # each exactly once (dv3 deferred so it closes the stream).
     metrics = {}
+    emitted = set()
     child = {"proc": None, "section": None}
+
+    def _emit(section):
+        if section in metrics and section not in emitted:
+            sys.stdout.write(json.dumps(metrics[section]) + "\n")
+            sys.stdout.flush()
+            emitted.add(section)
     # fresh event log per run (it is machine-local and git-ignored)
     try:
         os.makedirs(os.path.dirname(RESULTS_PATH), exist_ok=True)
@@ -450,16 +480,14 @@ def main():
             return False
 
     def _on_term(signum, frame):
-        # driver timeout: kill the running section, flush what we have
+        # driver timeout: kill the running section, flush anything not yet
+        # on stdout (the deferred dv3 line + a harvested partial section)
         if child["proc"] is not None and child["proc"].poll() is None:
             child["proc"].kill()
         if child["section"] is not None and child["section"] not in metrics:
             _harvest(child["section"])
-        order = [s for s, _ in SECTIONS if s != "dv3"] + ["dv3"]
-        for key in order:
-            if key in metrics:
-                sys.stdout.write(json.dumps(metrics[key]) + "\n")
-        sys.stdout.flush()
+        for key in [s for s, _ in SECTIONS if s != "dv3"] + ["dv3"]:
+            _emit(key)
         _note(event="sigterm", emitted=list(metrics))
         os._exit(1)
 
@@ -500,27 +528,24 @@ def main():
             with open(out_path) as f:
                 metric = json.load(f)
             metrics[section] = metric
-            sys.stdout.write(json.dumps(metric) + "\n")
-            sys.stdout.flush()
+            if section != "dv3":  # dv3 is deferred to close the stream
+                _emit(section)
             _note(event="done", section=section, section_s=round(time.perf_counter() - t0, 1), **metric)
         except subprocess.TimeoutExpired:
             # the measurement may have completed during interpreter teardown
             if _harvest(section):
-                sys.stdout.write(json.dumps(metrics[section]) + "\n")
-                sys.stdout.flush()
+                if section != "dv3":
+                    _emit(section)
                 _note(event="timeout_harvested", section=section, **metrics[section])
             else:
                 _note(event="timeout", section=section, section_s=round(time.perf_counter() - t0, 1))
         except (OSError, ValueError) as e:
             _note(event="error", section=section, error=f"{type(e).__name__}: {e}")
 
-    # Canonical re-emit — the driver's tail parser reads the LAST lines, so
-    # the flagship DV3 line must close the stream.
-    order = [s for s, _ in SECTIONS if s != "dv3"] + ["dv3"]
-    for key in order:
-        if key in metrics:
-            sys.stdout.write(json.dumps(metrics[key]) + "\n")
-    sys.stdout.flush()
+    # Flush the deferred flagship line LAST — the driver's tail parser
+    # reads the last lines, and every section appears exactly once.
+    for key in [s for s, _ in SECTIONS if s != "dv3"] + ["dv3"]:
+        _emit(key)
     _note(event="end", total_s=round(time.perf_counter() - T_START, 1), emitted=list(metrics))
 
 

@@ -128,16 +128,16 @@ def time_variant(
     frames = T * B / dt
     if cost_analysis:
         try:
+            from sheeprl_tpu.obs import compiled_flops
+            from sheeprl_tpu.utils.jax_compat import set_mesh
+
             jitted = getattr(train_fn, "_jitted", None)
             if jitted is not None:
-                with jax.set_mesh(runtime.mesh):
+                with set_mesh(runtime.mesh):
                     compiled = jitted.lower(
                         params, opt_states, moments, data, runtime.next_key()
                     ).compile()
-                ca = compiled.cost_analysis()
-                if isinstance(ca, (list, tuple)):
-                    ca = ca[0]
-                extras["flops_per_step"] = float(ca.get("flops", 0.0)) or None
+                extras["flops_per_step"] = compiled_flops(compiled)
         except Exception as e:  # cost analysis is best-effort on tunnel backends
             print(f"cost_analysis unavailable: {e}", file=sys.stderr)
     print(

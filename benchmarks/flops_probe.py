@@ -86,12 +86,12 @@ def _obs_space():
 
 
 def _compiled_flops(runtime, train_fn, args):
-    with jax.set_mesh(runtime.mesh):
+    from sheeprl_tpu.obs import compiled_flops
+    from sheeprl_tpu.utils.jax_compat import set_mesh
+
+    with set_mesh(runtime.mesh):
         compiled = train_fn._jitted.lower(*args).compile()
-    ca = compiled.cost_analysis()
-    if isinstance(ca, (list, tuple)):
-        ca = ca[0]
-    return float(ca.get("flops", 0.0))
+    return compiled_flops(compiled) or 0.0
 
 
 def probe_dv(version: int, devices: int) -> float:

@@ -23,6 +23,7 @@ from sheeprl_tpu.algos.ppo.ppo import _set_lr, build_ppo_optimizer
 from sheeprl_tpu.algos.ppo.utils import normalize_obs, prepare_obs, test
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.obs import setup_observability, trace_scope
 from sheeprl_tpu.utils.callback import CheckpointCallback, load_checkpoint
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -31,6 +32,7 @@ from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import device_get_metrics, gae, normalize_tensor, polynomial_decay, save_configs
 from sheeprl_tpu.optim import restore_opt_states
+from sheeprl_tpu.utils.jax_compat import shard_map
 
 
 def make_update_fn(runtime, module, tx, cfg: Dict[str, Any], obs_keys: Sequence[str]):
@@ -119,7 +121,7 @@ def make_update_fn(runtime, module, tx, cfg: Dict[str, Any], obs_keys: Sequence[
                     mb_size // world_size, "data",
                 )
 
-            return jax.shard_map(
+            return shard_map(
                 body,
                 mesh=runtime.mesh,
                 in_specs=(SMP(), SMP(), data_specs, obs_specs, SMP()),
@@ -196,6 +198,7 @@ def main(runtime, cfg: Dict[str, Any]):
     aggregator = None
     if not MetricAggregator.disabled:
         aggregator = instantiate(dict(cfg.metric.aggregator))
+    observability = setup_observability(runtime, cfg, log_dir, logger=logger)
 
     rb = ReplayBuffer(
         cfg.buffer.size,
@@ -225,6 +228,7 @@ def main(runtime, cfg: Dict[str, Any]):
     next_obs_np = envs.reset(seed=cfg.seed)[0]
 
     for iter_num in range(start_iter, total_iters + 1):
+        observability.on_iteration(policy_step)
         for _ in range(cfg.algo.rollout_steps):
             policy_step += cfg.env.num_envs * world_size
             with timer("Time/env_interaction_time", SumMetric, sync_on_compute=False):
@@ -271,10 +275,11 @@ def main(runtime, cfg: Dict[str, Any]):
         local_data = rb.to_arrays()
         local_data = {k: v.astype(jnp.float32) for k, v in local_data.items()}
         # env-axis sharding: each mesh device receives only its columns
-        local_data = runtime.shard_batch(local_data, axis=1)
-        device_next_obs = runtime.shard_batch(
-            {k: np.asarray(next_obs_np[k]) for k in obs_keys}, axis=0
-        )
+        with trace_scope("host_to_device"):
+            local_data = runtime.shard_batch(local_data, axis=1)
+            device_next_obs = runtime.shard_batch(
+                {k: np.asarray(next_obs_np[k]) for k in obs_keys}, axis=0
+            )
 
         with timer("Time/train_time", SumMetric, sync_on_compute=cfg.metric.sync_on_compute):
             params, opt_state, train_metrics = update_fn(
@@ -284,12 +289,15 @@ def main(runtime, cfg: Dict[str, Any]):
         train_step += world_size
 
         if aggregator and not aggregator.disabled:
-            for k, v in device_get_metrics(train_metrics).items():
+            with trace_scope("block_until_ready"):
+                fetched_metrics = device_get_metrics(train_metrics)
+            for k, v in fetched_metrics.items():
                 aggregator.update(k, v)
 
         if cfg.metric.log_level > 0 and logger:
             logger.log_metrics({"Info/learning_rate": current_lr}, policy_step)
             if policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters:
+                observability.on_log(policy_step, train_step)
                 if aggregator and not aggregator.disabled:
                     logger.log_metrics(aggregator.compute(), policy_step)
                     aggregator.reset()
@@ -336,6 +344,7 @@ def main(runtime, cfg: Dict[str, Any]):
             )
 
     envs.close()
+    observability.close()
     if runtime.is_global_zero and cfg.algo.run_test:
         test_rew = test(player, runtime, cfg, log_dir)
         if logger:

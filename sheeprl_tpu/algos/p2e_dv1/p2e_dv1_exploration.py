@@ -35,6 +35,7 @@ from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.device_buffer import maybe_create_for, sequence_batches
 from sheeprl_tpu.ops.dyn_bptt import dyn_bptt_setting, dyn_rssm_sequence_v1, extract_dyn_params_v1
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
+from sheeprl_tpu.obs import setup_observability, trace_scope
 from sheeprl_tpu.utils.callback import CheckpointCallback, load_checkpoint, restore_buffer
 from sheeprl_tpu.utils.distribution import Bernoulli, Independent, Normal
 from sheeprl_tpu.utils.env import make_env
@@ -381,6 +382,7 @@ def main(runtime, cfg: Dict[str, Any]):
     logger = get_logger(runtime, cfg)
     log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name)
     runtime.print(f"Log dir: {log_dir}")
+    observability = setup_observability(runtime, cfg, log_dir, logger=logger)
     if logger:
         logger.log_hyperparams(cfg)
 
@@ -520,6 +522,7 @@ def main(runtime, cfg: Dict[str, Any]):
     cumulative_per_rank_gradient_steps = 0
     metric_fetch_gate = MetricFetchGate(cfg.metric.get("fetch_every", 1))
     for iter_num in range(start_iter, total_iters + 1):
+        observability.on_iteration(policy_step)
         policy_step += policy_steps_per_iter
 
         with timer("Time/env_interaction_time", SumMetric, sync_on_compute=False):
@@ -614,7 +617,9 @@ def main(runtime, cfg: Dict[str, Any]):
                     "actor": params["actor_exploration"],
                 }
                 if aggregator and not aggregator.disabled and metric_fetch_gate():
-                    for k, v in device_get_metrics(train_metrics).items():
+                    with trace_scope("block_until_ready"):
+                        fetched_metrics = device_get_metrics(train_metrics)
+                    for k, v in fetched_metrics.items():
                         aggregator.update(k, v)
                     aggregator.update(
                         "Params/exploration_amount", player.get_expl_amount(policy_step)
@@ -624,6 +629,7 @@ def main(runtime, cfg: Dict[str, Any]):
         if cfg.metric.log_level > 0 and (
             policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters
         ):
+            observability.on_log(policy_step, train_step)
             if logger:
                 if aggregator and not aggregator.disabled:
                     logger.log_metrics(aggregator.compute(), policy_step)
@@ -681,6 +687,7 @@ def main(runtime, cfg: Dict[str, Any]):
             )
 
     envs.close()
+    observability.close()
     # task test zero-shot
     if runtime.is_global_zero and cfg.algo.run_test:
         player.params = {"world_model": params["world_model"], "actor": params["actor_task"]}

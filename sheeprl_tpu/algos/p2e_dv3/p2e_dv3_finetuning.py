@@ -27,6 +27,7 @@ from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.config.compose import yaml_load
 from sheeprl_tpu.data.device_buffer import maybe_create_for, sequence_batches
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
+from sheeprl_tpu.obs import setup_observability, trace_scope
 from sheeprl_tpu.utils.callback import CheckpointCallback, load_checkpoint, restore_buffer
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -78,6 +79,7 @@ def main(runtime, cfg: Dict[str, Any]):
     logger = get_logger(runtime, cfg)
     log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name)
     runtime.print(f"Log dir: {log_dir}")
+    observability = setup_observability(runtime, cfg, log_dir, logger=logger)
     if logger:
         logger.log_hyperparams(cfg)
 
@@ -227,6 +229,7 @@ def main(runtime, cfg: Dict[str, Any]):
     cumulative_per_rank_gradient_steps = 0
     metric_fetch_gate = MetricFetchGate(cfg.metric.get("fetch_every", 1))
     for iter_num in range(start_iter, total_iters + 1):
+        observability.on_iteration(policy_step)
         policy_step += policy_steps_per_iter
 
         with timer("Time/env_interaction_time", SumMetric, sync_on_compute=False):
@@ -330,13 +333,16 @@ def main(runtime, cfg: Dict[str, Any]):
                     "actor": dv3_params["actor"],
                 }
                 if aggregator and not aggregator.disabled and metric_fetch_gate():
-                    for k, v in device_get_metrics(train_metrics).items():
+                    with trace_scope("block_until_ready"):
+                        fetched_metrics = device_get_metrics(train_metrics)
+                    for k, v in fetched_metrics.items():
                         aggregator.update(k, v)
 
         # ------------------------------------------------------ logging
         if cfg.metric.log_level > 0 and (
             policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters
         ):
+            observability.on_log(policy_step, train_step)
             if logger:
                 if aggregator and not aggregator.disabled:
                     logger.log_metrics(aggregator.compute(), policy_step)
@@ -398,6 +404,7 @@ def main(runtime, cfg: Dict[str, Any]):
             )
 
     envs.close()
+    observability.close()
     # task test few-shot
     if runtime.is_global_zero and cfg.algo.run_test:
         player.actor_type = "task"

@@ -37,6 +37,7 @@ from sheeprl_tpu.algos.ppo.utils import normalize_obs, prepare_obs, test
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.config.compose import _locate
 from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.obs import setup_observability, trace_scope
 from sheeprl_tpu.utils.callback import CheckpointCallback, load_checkpoint
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -45,6 +46,7 @@ from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import device_get_metrics, gae, normalize_tensor, polynomial_decay, print_config, save_configs
 from sheeprl_tpu.optim import restore_opt_states
+from sheeprl_tpu.utils.jax_compat import shard_map
 
 
 def build_ppo_optimizer(
@@ -223,7 +225,7 @@ def make_update_fn(
             }
             return params, opt_state, metrics
 
-        return jax.shard_map(
+        return shard_map(
             body,
             mesh=runtime.mesh,
             in_specs=(SMP(), SMP(), data_specs, obs_specs, SMP(), SMP(), SMP()),
@@ -337,6 +339,7 @@ def main(runtime, cfg: Dict[str, Any]):
     logger = get_logger(runtime, cfg)
     log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name)
     runtime.print(f"Log dir: {log_dir}")
+    observability = setup_observability(runtime, cfg, log_dir, logger=logger)
     if logger:
         logger.log_hyperparams(cfg)
 
@@ -457,6 +460,7 @@ def main(runtime, cfg: Dict[str, Any]):
     next_obs_np = envs.reset(seed=cfg.seed)[0]
 
     for iter_num in range(start_iter, total_iters + 1):
+        observability.on_iteration(policy_step)
         for _ in range(cfg.algo.rollout_steps):
             policy_step += cfg.env.num_envs * world_size
 
@@ -516,10 +520,11 @@ def main(runtime, cfg: Dict[str, Any]):
         # shard the rollout over the mesh's env axis so each device
         # receives only its own columns (the shard_map update consumes
         # exactly this layout; 1-device meshes place trivially)
-        local_data = runtime.shard_batch(local_data, axis=1)
-        device_next_obs = runtime.shard_batch(
-            {k: np.asarray(next_obs_np[k]) for k in obs_keys}, axis=0
-        )
+        with trace_scope("host_to_device"):
+            local_data = runtime.shard_batch(local_data, axis=1)
+            device_next_obs = runtime.shard_batch(
+                {k: np.asarray(next_obs_np[k]) for k in obs_keys}, axis=0
+            )
 
         with timer("Time/train_time", SumMetric, sync_on_compute=cfg.metric.sync_on_compute):
             params, opt_state, train_metrics = update_fn(
@@ -538,7 +543,9 @@ def main(runtime, cfg: Dict[str, Any]):
         if aggregator and not aggregator.disabled:
             # materializing metrics blocks on the update; only pay that
             # sync when metrics are on
-            for k, v in device_get_metrics(train_metrics).items():
+            with trace_scope("block_until_ready"):
+                fetched_metrics = device_get_metrics(train_metrics)
+            for k, v in fetched_metrics.items():
                 aggregator.update(k, v)
 
         # ------------------------------------------------- logging
@@ -546,6 +553,7 @@ def main(runtime, cfg: Dict[str, Any]):
             logger.log_metrics({"Info/learning_rate": current_lr}, policy_step)
             logger.log_metrics({"Info/clip_coef": current_clip, "Info/ent_coef": current_ent}, policy_step)
             if policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters:
+                observability.on_log(policy_step, train_step)
                 if aggregator and not aggregator.disabled:
                     logger.log_metrics(aggregator.compute(), policy_step)
                     aggregator.reset()
@@ -601,6 +609,7 @@ def main(runtime, cfg: Dict[str, Any]):
             ckpt_cb.save(runtime, ckpt_path, ckpt_state)
 
     envs.close()
+    observability.close()
     if runtime.is_global_zero and cfg.algo.run_test:
         test_rew = test(player, runtime, cfg, log_dir)
         if logger:

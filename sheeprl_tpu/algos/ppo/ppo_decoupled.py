@@ -40,6 +40,7 @@ from sheeprl_tpu.algos.ppo.ppo import build_ppo_optimizer, make_update_fn
 from sheeprl_tpu.algos.ppo.utils import prepare_obs, test
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.obs import setup_observability, trace_scope
 from sheeprl_tpu.utils.callback import CheckpointCallback, load_checkpoint
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -88,6 +89,7 @@ def _player_loop(cfg, data_q: mp.Queue, resp_q: mp.Queue, state_counters, world_
     logger = get_logger(runtime, cfg)
     log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name)
     runtime.print(f"Log dir: {log_dir}")
+    observability = setup_observability(runtime, cfg, log_dir, logger=logger)
     if logger:
         logger.log_hyperparams(cfg)
 
@@ -166,6 +168,7 @@ def _player_loop(cfg, data_q: mp.Queue, resp_q: mp.Queue, state_counters, world_
     train_step = 0
     last_train = 0
     train_time_window = 0.0  # trainer-side seconds accumulated since last log
+    trainer_compiles = None  # trainer-side XLA compile count (rides info_scalars)
     policy_steps_per_iter = int(cfg.env.num_envs * cfg.algo.rollout_steps)
     total_iters = cfg.algo.total_steps // policy_steps_per_iter if not cfg.dry_run else 1
     if cfg.metric.log_level > 0 and cfg.metric.log_every % policy_steps_per_iter != 0:
@@ -178,6 +181,7 @@ def _player_loop(cfg, data_q: mp.Queue, resp_q: mp.Queue, state_counters, world_
     next_obs_np = envs.reset(seed=cfg.seed)[0]
 
     for iter_num in range(start_iter, total_iters + 1):
+        observability.on_iteration(policy_step)
         for _ in range(cfg.algo.rollout_steps):
             policy_step += cfg.env.num_envs
 
@@ -235,9 +239,12 @@ def _player_loop(cfg, data_q: mp.Queue, resp_q: mp.Queue, state_counters, world_
         data_q.put(("data", local_data, final_obs, need_ckpt))
 
         # --------------------------------------------- refreshed weights back
-        tag, new_params, train_metrics, opt_state_np, info_scalars = resp_q.get(
-            timeout=_QUEUE_TIMEOUT_S
-        )
+        # named span: in a profiler trace this wait IS the decoupled
+        # topology's comms/train stall as seen from the player
+        with trace_scope("ipc_wait_update"):
+            tag, new_params, train_metrics, opt_state_np, info_scalars = resp_q.get(
+                timeout=_QUEUE_TIMEOUT_S
+            )
         assert tag == "update", f"expected update, got {tag}"
         # hand the numpy tree straight to the setter: jnp.asarray here would
         # place the fresh params on the DEFAULT backend (the tunnel-attached
@@ -247,6 +254,7 @@ def _player_loop(cfg, data_q: mp.Queue, resp_q: mp.Queue, state_counters, world_
         player.params = new_params
         train_step += 1
         train_time_window += info_scalars.pop("train_time", 0.0)
+        trainer_compiles = info_scalars.pop("trainer_compiles", trainer_compiles)
 
         if aggregator and not aggregator.disabled:
             for k, v in train_metrics.items():
@@ -256,6 +264,12 @@ def _player_loop(cfg, data_q: mp.Queue, resp_q: mp.Queue, state_counters, world_
         if cfg.metric.log_level > 0 and logger:
             logger.log_metrics(info_scalars, policy_step)
             if policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters:
+                observability.on_log(
+                    policy_step,
+                    train_step,
+                    train_time_s=train_time_window,
+                    extra={"trainer_compiles": trainer_compiles},
+                )
                 if aggregator and not aggregator.disabled:
                     logger.log_metrics(aggregator.compute(), policy_step)
                     aggregator.reset()
@@ -301,6 +315,7 @@ def _player_loop(cfg, data_q: mp.Queue, resp_q: mp.Queue, state_counters, world_
     # shutdown sentinel (reference scatters -1, :344)
     data_q.put(("stop",))
     envs.close()
+    observability.close()
     if cfg.algo.run_test:
         test_rew = test(player, runtime, cfg, log_dir)
         if logger:
@@ -385,6 +400,13 @@ def main(runtime, cfg: Dict[str, Any]):
         )
         update_fn = make_update_fn(runtime, module, tx, cfg, obs_keys)
 
+        # trainer-side recompile watch: the jitted update lives in THIS
+        # process, so its retraces are invisible to the player's telemetry
+        # unless the count rides the update messages (info_scalars)
+        from sheeprl_tpu.obs import RecompileMonitor
+
+        trainer_mon = RecompileMonitor(name="ppo_decoupled_trainer").install()
+
         # initial weights to the player (reference broadcast, :126)
         resp_q.put(("params", _np_tree(params)))
 
@@ -398,7 +420,10 @@ def main(runtime, cfg: Dict[str, Any]):
 
         iter_num = start_iter - 1
         while True:
-            msg = data_q.get(timeout=_QUEUE_TIMEOUT_S)
+            # named span: the trainer idling for the next rollout (the
+            # inverse of the player's ipc_wait_update stall)
+            with trace_scope("ipc_wait_rollout"):
+                msg = data_q.get(timeout=_QUEUE_TIMEOUT_S)
             if msg[0] == "stop":
                 break
             _, local_data, final_obs, need_ckpt = msg
@@ -436,6 +461,8 @@ def main(runtime, cfg: Dict[str, Any]):
                 "Info/clip_coef": current_clip,
                 "Info/ent_coef": current_ent,
             }
+            info_scalars["trainer_compiles"] = trainer_mon.compiles
+            trainer_mon.mark_warmup_complete()  # first update done: further compiles are retraces
             if not timer.disabled:
                 info_scalars["train_time"] = float(timer.compute().get("Time/train_time", 0.0))
                 timer.reset()
@@ -466,6 +493,7 @@ def main(runtime, cfg: Dict[str, Any]):
                 )
             )
 
+        trainer_mon.uninstall()
         # the player still runs its test episode + logger shutdown after the
         # stop sentinel — give it ample time before the terminate fallback
         player_proc.join(timeout=3600.0)

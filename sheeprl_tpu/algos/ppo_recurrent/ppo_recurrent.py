@@ -37,6 +37,7 @@ from sheeprl_tpu.algos.ppo_recurrent.agent import RecurrentPPOPlayer, build_agen
 from sheeprl_tpu.algos.ppo_recurrent.utils import prepare_obs, test
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.obs import setup_observability, trace_scope
 from sheeprl_tpu.utils.callback import CheckpointCallback, load_checkpoint
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -45,6 +46,7 @@ from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import device_get_metrics, gae, normalize_tensor, polynomial_decay, print_config, save_configs
 from sheeprl_tpu.optim import restore_opt_states
+from sheeprl_tpu.utils.jax_compat import shard_map
 
 
 def make_update_fn(runtime, module, tx, cfg: Dict[str, Any], obs_keys: Sequence[str]):
@@ -176,7 +178,7 @@ def make_update_fn(runtime, module, tx, cfg: Dict[str, Any], obs_keys: Sequence[
                     params, opt_state, data, next_values, rank_key, clip_coef, ent_coef, "data"
                 )
 
-            return jax.shard_map(
+            return shard_map(
                 body,
                 mesh=runtime.mesh,
                 in_specs=(SMP(), SMP(), data_specs, SMP("data"), SMP(), SMP(), SMP()),
@@ -214,6 +216,7 @@ def main(runtime, cfg: Dict[str, Any]):
     logger = get_logger(runtime, cfg)
     log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name)
     runtime.print(f"Log dir: {log_dir}")
+    observability = setup_observability(runtime, cfg, log_dir, logger=logger)
     if logger:
         logger.log_hyperparams(cfg)
 
@@ -321,6 +324,7 @@ def main(runtime, cfg: Dict[str, Any]):
     player.init_states()
 
     for iter_num in range(start_iter, total_iters + 1):
+        observability.on_iteration(policy_step)
         for _ in range(cfg.algo.rollout_steps):
             policy_step += cfg.env.num_envs * world_size
 
@@ -408,7 +412,9 @@ def main(runtime, cfg: Dict[str, Any]):
         train_step += world_size
 
         if aggregator and not aggregator.disabled:
-            for k, v in device_get_metrics(train_metrics).items():
+            with trace_scope("block_until_ready"):
+                fetched_metrics = device_get_metrics(train_metrics)
+            for k, v in fetched_metrics.items():
                 aggregator.update(k, v)
 
         # ------------------------------------------------- logging
@@ -416,6 +422,7 @@ def main(runtime, cfg: Dict[str, Any]):
             logger.log_metrics({"Info/learning_rate": current_lr}, policy_step)
             logger.log_metrics({"Info/clip_coef": current_clip, "Info/ent_coef": current_ent}, policy_step)
             if policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters:
+                observability.on_log(policy_step, train_step)
                 if aggregator and not aggregator.disabled:
                     logger.log_metrics(aggregator.compute(), policy_step)
                     aggregator.reset()
@@ -469,6 +476,7 @@ def main(runtime, cfg: Dict[str, Any]):
             ckpt_cb.save(runtime, ckpt_path, ckpt_state)
 
     envs.close()
+    observability.close()
     if runtime.is_global_zero and cfg.algo.run_test:
         test_rew = test(player, runtime, cfg, log_dir)
         if logger:

@@ -34,6 +34,7 @@ from sheeprl_tpu.algos.sac.sac import _make_optimizer, make_train_fn
 from sheeprl_tpu.algos.sac.utils import prepare_obs, test
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.obs import setup_observability, trace_scope
 from sheeprl_tpu.utils.callback import CheckpointCallback, load_checkpoint, restore_buffer
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -69,6 +70,7 @@ def _player_loop(
     logger = get_logger(runtime, cfg)
     log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name)
     runtime.print(f"Log dir: {log_dir}")
+    observability = setup_observability(runtime, cfg, log_dir, logger=logger)
     if logger:
         logger.log_hyperparams(cfg)
 
@@ -152,6 +154,7 @@ def _player_loop(
     train_step = 0
     last_train = 0
     train_time_window = 0.0
+    trainer_compiles = None  # trainer-side XLA compile count (rides train_metrics)
     policy_steps_per_iter = int(total_envs)
     total_iters = int(cfg.algo.total_steps // policy_steps_per_iter) if not cfg.dry_run else 1
     learning_starts = cfg.algo.learning_starts // policy_steps_per_iter if not cfg.dry_run else 0
@@ -169,6 +172,7 @@ def _player_loop(
 
     cumulative_per_rank_gradient_steps = 0
     for iter_num in range(start_iter, total_iters + 1):
+        observability.on_iteration(policy_step)
         policy_step += policy_steps_per_iter
 
         with timer("Time/env_interaction_time", SumMetric, sync_on_compute=False):
@@ -224,7 +228,10 @@ def _player_loop(
                 sample = {k: np.asarray(v) for k, v in sample.items()}
                 data_q.put(("data", sample, g, iter_num))
 
-                tag, actor_params, train_metrics = resp_q.get(timeout=_QUEUE_TIMEOUT_S)
+                # named span: the player stalling on the trainer (IPC +
+                # train dispatch) — the decoupled topology's comms cost
+                with trace_scope("ipc_wait_update"):
+                    tag, actor_params, train_metrics = resp_q.get(timeout=_QUEUE_TIMEOUT_S)
                 assert tag == "update", f"expected update, got {tag}"
                 # numpy straight to the setter — see ppo_decoupled: jnp.asarray
                 # would stage the params on the tunnel backend first
@@ -232,6 +239,7 @@ def _player_loop(
                 cumulative_per_rank_gradient_steps += g
                 train_step += world_size
                 train_time_window += train_metrics.pop("train_time", 0.0)
+                trainer_compiles = train_metrics.pop("trainer_compiles", trainer_compiles)
                 if aggregator and not aggregator.disabled:
                     for k, v in train_metrics.items():
                         aggregator.update(k, v)
@@ -269,6 +277,12 @@ def _player_loop(
         if cfg.metric.log_level > 0 and (
             policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters
         ):
+            observability.on_log(
+                policy_step,
+                train_step,
+                train_time_s=train_time_window,
+                extra={"trainer_compiles": trainer_compiles},
+            )
             if logger:
                 if aggregator and not aggregator.disabled:
                     logger.log_metrics(aggregator.compute(), policy_step)
@@ -302,6 +316,7 @@ def _player_loop(
     # shutdown sentinel (reference scatters -1, sac_decoupled.py:328)
     data_q.put(("stop",))
     envs.close()
+    observability.close()
     if cfg.algo.run_test:
         test_rew = test(player, runtime, cfg, log_dir)
         if logger:
@@ -383,10 +398,18 @@ def main(runtime, cfg: Dict[str, Any]):
         )
         ema_every = cfg.algo.critic.target_network_frequency // int(cfg.env.num_envs) + 1
 
+        # trainer-side recompile watch — see ppo_decoupled: the jitted
+        # train_fn retraces in THIS process, so the count must ride the
+        # update messages to reach the player's telemetry
+        from sheeprl_tpu.obs import RecompileMonitor
+
+        trainer_mon = RecompileMonitor(name="sac_decoupled_trainer").install()
+
         resp_q.put(("params", _np_tree(params["actor"])))
 
         while True:
-            msg = data_q.get(timeout=_QUEUE_TIMEOUT_S)
+            with trace_scope("ipc_wait_rollout"):
+                msg = data_q.get(timeout=_QUEUE_TIMEOUT_S)
             if msg[0] == "stop":
                 break
             if msg[0] == "ckpt_req":
@@ -419,9 +442,12 @@ def main(runtime, cfg: Dict[str, Any]):
             if not timer.disabled:
                 train_metrics["train_time"] = float(timer.compute().get("Time/train_time", 0.0))
                 timer.reset()
+            train_metrics["trainer_compiles"] = trainer_mon.compiles
+            trainer_mon.mark_warmup_complete()  # first update done: further compiles are retraces
 
             resp_q.put(("update", _np_tree(params["actor"]), train_metrics))
 
+        trainer_mon.uninstall()
         # the player still runs its test episode + logger shutdown after the
         # stop sentinel — give it ample time before the terminate fallback
         player_proc.join(timeout=3600.0)

@@ -26,6 +26,7 @@ from sheeprl_tpu.algos.sac_ae.utils import prepare_obs, preprocess_obs, test
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.data.device_buffer import maybe_create_for_transitions
+from sheeprl_tpu.obs import setup_observability, trace_scope
 from sheeprl_tpu.utils.callback import CheckpointCallback, load_checkpoint, restore_buffer
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -241,6 +242,7 @@ def main(runtime, cfg: Dict[str, Any]):
     logger = get_logger(runtime, cfg)
     log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name)
     runtime.print(f"Log dir: {log_dir}")
+    observability = setup_observability(runtime, cfg, log_dir, logger=logger)
     if logger:
         logger.log_hyperparams(cfg)
 
@@ -362,6 +364,7 @@ def main(runtime, cfg: Dict[str, Any]):
     cumulative_per_rank_gradient_steps = 0
     metric_fetch_gate = MetricFetchGate(cfg.metric.get("fetch_every", 1))
     for iter_num in range(start_iter, total_iters + 1):
+        observability.on_iteration(policy_step)
         policy_step += policy_steps_per_iter
 
         with timer("Time/env_interaction_time", SumMetric, sync_on_compute=False):
@@ -450,12 +453,15 @@ def main(runtime, cfg: Dict[str, Any]):
                 cumulative_per_rank_gradient_steps += g
                 train_step += world_size
                 if aggregator and not aggregator.disabled and metric_fetch_gate():
-                    for k, v in device_get_metrics(train_metrics).items():
+                    with trace_scope("block_until_ready"):
+                        fetched_metrics = device_get_metrics(train_metrics)
+                    for k, v in fetched_metrics.items():
                         aggregator.update(k, v)
 
         if cfg.metric.log_level > 0 and (
             policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters
         ):
+            observability.on_log(policy_step, train_step)
             if logger:
                 if aggregator and not aggregator.disabled:
                     logger.log_metrics(aggregator.compute(), policy_step)
@@ -507,6 +513,7 @@ def main(runtime, cfg: Dict[str, Any]):
             )
 
     envs.close()
+    observability.close()
     if runtime.is_global_zero and cfg.algo.run_test:
         test_rew = test(player, runtime, cfg, log_dir)
         if logger:

@@ -256,9 +256,16 @@ def install_stack_dumper(suffix: str = "") -> None:
 
 
 def run(args: Optional[Sequence[str]] = None) -> None:
-    """Main training app: ``sheeprl exp=... [overrides...]``."""
+    """Main training app: ``sheeprl exp=... [overrides...]``.
+
+    ``--profile`` is a convenience flag equivalent to ``metric.profile=True``
+    (whole-run jax.profiler trace on rank 0); windowed capture on long runs
+    goes through ``metric.profile_every_n`` instead (howto/observability.md).
+    """
     install_stack_dumper()
     overrides = list(args if args is not None else sys.argv[1:])
+    if "--profile" in overrides:
+        overrides = [o for o in overrides if o != "--profile"] + ["metric.profile=True"]
     cfg = compose(config_name="config", overrides=overrides)
     if cfg.get("num_threads"):
         os.environ.setdefault("XLA_FLAGS", "")

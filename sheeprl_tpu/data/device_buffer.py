@@ -41,6 +41,7 @@ from typing import Any, Dict, List, Optional, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
+from sheeprl_tpu.utils.jax_compat import shard_map
 
 __all__ = [
     "DeviceReplayCache",
@@ -737,7 +738,7 @@ class ShardedDeviceReplayCache(DeviceReplayCache):
 
         buf_specs = {k: P(None, "data") for k in self._bufs}
         out_specs = {k: P(None, None, "data") for k in self._bufs}
-        sharded = jax.shard_map(
+        sharded = shard_map(
             body, mesh=mesh,
             in_specs=(buf_specs, P(), P("data"), P("data")),
             out_specs=out_specs,

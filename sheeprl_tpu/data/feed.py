@@ -15,6 +15,8 @@ from typing import Any, Callable, Dict, Iterator, Optional
 
 import jax
 
+from sheeprl_tpu.obs.trace import trace_scope
+
 
 def batched_feed(
     local_data: Dict[str, Any], n_batches: int, depth: int = 2, sharding: Any = None
@@ -78,10 +80,13 @@ class DevicePrefetcher:
                 if batch is None:
                     self._queue.put(None)
                     return
-                if self._sharding is not None:
-                    batch = jax.device_put(batch, self._sharding)
-                else:
-                    batch = jax.device_put(batch)
+                # named span in any active profiler trace: upload stalls of
+                # the replay feed show on the worker thread's timeline
+                with trace_scope("host_to_device"):
+                    if self._sharding is not None:
+                        batch = jax.device_put(batch, self._sharding)
+                    else:
+                        batch = jax.device_put(batch)
                 while not self._stop.is_set():
                     try:
                         self._queue.put(batch, timeout=0.1)

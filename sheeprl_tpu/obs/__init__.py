@@ -1,0 +1,218 @@
+"""sheeprl_tpu.obs — the framework-wide TPU-native observability layer.
+
+Four parts (ISSUE 1):
+
+- :mod:`sheeprl_tpu.obs.trace` — jax.profiler phase annotations + windowed
+  on-demand trace capture (``metric.profile_every_n``);
+- :mod:`sheeprl_tpu.obs.xla_stats` — recompile detection, compile-cache
+  counters, generic MFU/FLOPs reporting;
+- :mod:`sheeprl_tpu.obs.telemetry` — the append-only JSONL run-telemetry
+  sink every algo feeds per log interval;
+- :class:`Observability` (here) — the per-run orchestrator the algo loops
+  wire in with three calls: ``on_iteration`` (profiler scheduling, cheap
+  integer work), ``on_log`` (assemble + append one telemetry record), and
+  ``close``.
+
+``setup_observability`` returns a disabled no-op instance on non-zero
+ranks / ``metric.log_level=0`` / ``metric.telemetry=False``, so call
+sites stay unconditional.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, Optional
+
+from sheeprl_tpu.obs.telemetry import (
+    TelemetrySink,
+    device_memory_stats,
+    host_rss_mb,
+    make_record,
+    read_records,
+    validate_record,
+)
+from sheeprl_tpu.obs.trace import ProfileScheduler, start_trace, stop_trace, trace_scope
+from sheeprl_tpu.obs.xla_stats import RecompileMonitor, compiled_flops, mfu_percent, peak_flops
+
+__all__ = [
+    "Observability",
+    "setup_observability",
+    "trace_scope",
+    "start_trace",
+    "stop_trace",
+    "ProfileScheduler",
+    "RecompileMonitor",
+    "TelemetrySink",
+    "compiled_flops",
+    "mfu_percent",
+    "peak_flops",
+    "device_memory_stats",
+    "host_rss_mb",
+    "make_record",
+    "read_records",
+    "validate_record",
+]
+
+
+class Observability:
+    """Per-run observability: owns the telemetry sink, the recompile
+    monitor and the profile scheduler. All methods are no-ops when
+    ``enabled`` is False, so algo loops call them unconditionally."""
+
+    def __init__(
+        self,
+        enabled: bool = False,
+        telemetry_path: Optional[str] = None,
+        telemetry_max_bytes: int = 32 * 1024 * 1024,
+        profile_dir: Optional[str] = None,
+        profile_every_n: int = 0,
+        profile_num_iters: int = 2,
+        world_size: int = 1,
+        action_repeat: int = 1,
+        device: Any = None,
+        logger: Any = None,
+        name: str = "run",
+    ):
+        self.enabled = bool(enabled)
+        self.recompile: Optional[RecompileMonitor] = None
+        self.scheduler: Optional[ProfileScheduler] = None
+        self.sink: Optional[TelemetrySink] = None
+        if not self.enabled:
+            return
+        self._world_size = max(1, int(world_size))
+        self._action_repeat = max(1, int(action_repeat))
+        self._device = device
+        self._logger = logger
+        self._last_step = 0
+        self._last_train = 0
+        self._last_ts = time.perf_counter()
+        self.recompile = RecompileMonitor(name=name).install()
+        if telemetry_path:
+            self.sink = TelemetrySink(telemetry_path, max_bytes=telemetry_max_bytes)
+        if profile_dir and profile_every_n > 0:
+            self.scheduler = ProfileScheduler(profile_dir, profile_every_n, profile_num_iters)
+
+    # ------------------------------------------------------------- hooks
+    def on_iteration(self, policy_step: int = 0) -> None:
+        """Once per training iteration: drives windowed trace capture."""
+        if self.enabled and self.scheduler is not None:
+            self.scheduler.on_iteration()
+
+    def on_log(
+        self,
+        policy_step: int,
+        train_step: int = 0,
+        train_time_s: Optional[float] = None,
+        extra: Optional[Dict[str, Any]] = None,
+    ) -> Optional[Dict[str, Any]]:
+        """Once per log interval, BEFORE ``timer.reset()``: assembles and
+        appends one telemetry record. Returns the record (for tests)."""
+        if not self.enabled:
+            return None
+        from sheeprl_tpu.utils.timer import timer
+
+        timers = {} if timer.disabled else timer.compute()
+        percentiles = {} if timer.disabled else timer.percentiles()
+        now = time.perf_counter()
+        wall = now - self._last_ts
+        d_step = policy_step - self._last_step
+        d_train = train_step - self._last_train
+        train_time = (
+            train_time_s if train_time_s is not None else timers.get("Time/train_time", 0.0)
+        )
+        env_time = timers.get("Time/env_interaction_time", 0.0)
+        record = make_record(
+            step=policy_step,
+            train_step=train_step,
+            sps=(d_step / wall) if wall > 0 and d_step > 0 else None,
+            sps_env=(
+                (d_step / self._world_size * self._action_repeat) / env_time
+                if env_time > 0 and d_step > 0
+                else None
+            ),
+            sps_train=(d_train / train_time) if train_time > 0 and d_train > 0 else None,
+            timers_s=timers,
+            timer_percentiles_s=percentiles,
+            hbm=device_memory_stats(self._device),
+            host_rss=host_rss_mb(),
+            compiles=self.recompile.snapshot() if self.recompile else {},
+            extra=extra,
+        )
+        if self.sink is not None:
+            self.sink.write(record)
+        if self._logger is not None:
+            self._mirror_to_logger(record, policy_step)
+        # retraces of the jitted steps are only suspicious once training has
+        # actually dispatched (SAC-style learning_starts delays the first
+        # train compile well past the first log boundary)
+        if self.recompile and not self.recompile.warmed_up and train_step > 0:
+            self.recompile.mark_warmup_complete()
+        self._last_step = policy_step
+        self._last_train = train_step
+        self._last_ts = now
+        return record
+
+    def _mirror_to_logger(self, record: Dict[str, Any], step: int) -> None:
+        """Mirror the load-bearing scalars to the metrics logger so TPU
+        health is visible in TensorBoard next to the losses."""
+        scalars: Dict[str, float] = {}
+        compiles = record.get("compiles") or {}
+        if "total" in compiles:
+            scalars["Obs/compiles_total"] = compiles["total"]
+            scalars["Obs/compiles_post_warmup"] = compiles.get("post_warmup", 0)
+        hbm = record.get("hbm") or {}
+        if "bytes_in_use" in hbm:
+            scalars["Obs/hbm_gb_in_use"] = hbm["bytes_in_use"] / 1e9
+        if record.get("host_rss_mb") is not None:
+            scalars["Obs/host_rss_mb"] = record["host_rss_mb"]
+        for name, pct in (record.get("timer_percentiles_s") or {}).items():
+            for q in ("p50", "p95"):
+                if q in pct:
+                    scalars[f"{name}_{q}"] = pct[q]
+        if scalars:
+            self._logger.log_metrics(scalars, step)
+
+    def close(self) -> None:
+        if not self.enabled:
+            return
+        if self.scheduler is not None:
+            self.scheduler.close()
+        if self.sink is not None:
+            self.sink.close()
+        if self.recompile is not None:
+            self.recompile.uninstall()
+
+
+def setup_observability(runtime, cfg, log_dir: Optional[str], logger: Any = None) -> Observability:
+    """Build the run's Observability from ``cfg.metric``. Rank-0 only (each
+    process observes itself; the decoupled player wires its own)."""
+    metric_cfg = cfg.get("metric", {}) if hasattr(cfg, "get") else {}
+    enabled = (
+        runtime.is_global_zero
+        and log_dir is not None
+        and int(metric_cfg.get("log_level", 1)) > 0
+        and bool(metric_cfg.get("telemetry", True))
+    )
+    if not enabled:
+        return Observability(enabled=False)
+    profile_dir = metric_cfg.get("profile_dir") or os.path.join(log_dir, "profile")
+    # the whole-run metric.profile trace (cli.py) and the windowed scheduler
+    # cannot nest — the flag wins
+    every_n = 0 if metric_cfg.get("profile", False) else int(metric_cfg.get("profile_every_n", 0) or 0)
+    return Observability(
+        enabled=True,
+        telemetry_path=os.path.join(log_dir, "telemetry.jsonl"),
+        telemetry_max_bytes=int(metric_cfg.get("telemetry_max_bytes", 32 * 1024 * 1024)),
+        profile_dir=profile_dir,
+        profile_every_n=every_n,
+        profile_num_iters=int(metric_cfg.get("profile_num_iters", 2)),
+        world_size=runtime.world_size,
+        action_repeat=int(cfg.env.get("action_repeat", 1)) if "env" in cfg else 1,
+        device=runtime.device,
+        # TB mirroring of the telemetry scalars is opt-in: every extra
+        # add_scalar series costs event-file traffic per log interval, and
+        # the JSONL is the canonical consumer
+        logger=logger if metric_cfg.get("telemetry_tb_mirror", False) else None,
+        name=str(cfg.get("algo", {}).get("name", "run")),
+    )

@@ -24,6 +24,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from sheeprl_tpu.utils.jax_compat import shard_map
 
 
 def _block_scores(q: jax.Array, k: jax.Array) -> jax.Array:
@@ -369,7 +370,7 @@ def make_ring_attention(
         spec = P(*([None] * (ndim - 3)), axis_name, None, None)
 
         @jax.jit
-        @partial(jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+        @partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
         def fn(q, k, v):
             return ring_attention(q, k, v, axis_name=axis_name, causal=causal)
 

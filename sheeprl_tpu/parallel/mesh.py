@@ -359,10 +359,12 @@ class MeshRuntime:
         the computation SPMD over the mesh and inserts the cross-device
         collectives (the DDP grad all-reduce equivalent) automatically.
         """
+        from sheeprl_tpu.utils.jax_compat import set_mesh
+
         jitted = jax.jit(fn, donate_argnums=donate_argnums, static_argnums=static_argnums)
 
         def wrapped(*args, **kw):
-            with jax.set_mesh(self.mesh):
+            with set_mesh(self.mesh):
                 return jitted(*args, **kw)
 
         wrapped._jitted = jitted

@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from sheeprl_tpu.utils.jax_compat import shard_map
 
 
 def make_sequence_parallel_train_step(
@@ -51,7 +52,7 @@ def make_sequence_parallel_train_step(
 
     @jax.jit
     @partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(P(), P(), token_spec, token_spec),
         out_specs=(P(), P(), P()),

@@ -1,0 +1,48 @@
+"""Version compatibility for the handful of jax APIs that moved between
+the 0.4.x series and current jax.
+
+The codebase is written against current jax (``jax.set_mesh`` /
+``jax.shard_map`` with ``check_vma``); container images pinning jax 0.4.x
+only ship the older spellings (``Mesh`` as a context manager /
+``jax.experimental.shard_map.shard_map`` with ``check_rep``). These
+wrappers pick whichever exists so every train loop — and therefore the
+observability layer watching it — runs on both.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+
+
+def set_mesh(mesh) -> Any:
+    """Context manager making ``mesh`` the ambient mesh for jitted calls:
+    ``jax.set_mesh`` on current jax, the ``Mesh`` context manager itself on
+    jax <= 0.5."""
+    if hasattr(jax, "set_mesh"):
+        return jax.set_mesh(mesh)
+    return mesh
+
+
+def shard_map(
+    f: Callable,
+    *,
+    mesh,
+    in_specs,
+    out_specs,
+    check_vma: bool = True,
+    **kwargs: Any,
+) -> Callable:
+    """``jax.shard_map`` when available; otherwise the
+    ``jax.experimental.shard_map`` original, with ``check_vma`` mapped to
+    its old name ``check_rep``."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=check_vma, **kwargs
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=check_vma, **kwargs
+    )

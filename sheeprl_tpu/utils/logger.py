@@ -48,6 +48,11 @@ class TensorBoardLogger:
             except (TypeError, ValueError):
                 pass
 
+    def log_nested_metrics(self, metrics: Dict[str, Any], step: Optional[int] = None) -> None:
+        """Log a possibly-nested dict (e.g. timer percentiles, telemetry
+        records) as flattened ``a/b/c`` scalars, skipping non-numerics."""
+        self.log_metrics(flatten_metrics(metrics), step)
+
     def log_hyperparams(self, params: Dict[str, Any]) -> None:
         try:
             import yaml
@@ -135,6 +140,19 @@ class MLflowLogger:
 
     def finalize(self) -> None:
         self._mlflow.end_run()
+
+
+def flatten_metrics(metrics: Dict[str, Any], prefix: str = "") -> Dict[str, float]:
+    """Flatten nested metric dicts to ``a/b/c -> float``, dropping leaves
+    that are not numeric (telemetry records carry strings/None too)."""
+    out: Dict[str, float] = {}
+    for k, v in metrics.items():
+        key = f"{prefix}/{k}" if prefix else str(k)
+        if isinstance(v, dict):
+            out.update(flatten_metrics(v, key))
+        elif isinstance(v, (int, float)) and not isinstance(v, bool):
+            out[key] = float(v)
+    return out
 
 
 def _plain(v: Any) -> Any:

@@ -47,7 +47,9 @@ class MeanMetric(Metric):
     def update(self, value: Any) -> None:
         value = np.asarray(value, dtype=np.float64)
         self._total += float(np.nansum(value))
-        self._count += int(np.isfinite(value).sum()) if value.ndim else 1
+        # count only finite entries, for scalars too: a 0-d NaN must not
+        # increment the count while a 1-d NaN array leaves it untouched
+        self._count += int(np.isfinite(value).sum())
 
     def compute(self) -> float:
         if self._count == 0:

@@ -1,0 +1,90 @@
+"""JSONL telemetry sink: schema, rotation, probes (ISSUE 1 tentpole)."""
+
+import json
+import os
+
+from sheeprl_tpu.obs.telemetry import (
+    TELEMETRY_REQUIRED_FIELDS,
+    TelemetrySink,
+    host_rss_mb,
+    make_record,
+    read_records,
+    validate_record,
+)
+
+
+def _record(step=1, **kw):
+    return make_record(
+        step=step,
+        train_step=step,
+        sps=100.0,
+        timers_s={"Time/train_time": 0.5},
+        timer_percentiles_s={"Time/train_time": {"p50": 0.01, "p95": 0.02, "n": 8}},
+        compiles={"total": 3, "post_warmup": 0},
+        **kw,
+    )
+
+
+def test_make_record_is_schema_valid():
+    rec = _record()
+    assert validate_record(rec) == []
+    # json round trip preserves validity (what readers actually see)
+    assert validate_record(json.loads(json.dumps(rec))) == []
+
+
+def test_validate_record_catches_problems():
+    assert validate_record("not a dict")
+    rec = _record()
+    del rec["sps"]
+    assert any("sps" in e for e in validate_record(rec))
+    rec = _record()
+    rec["step"] = "nope"
+    assert any("step" in e for e in validate_record(rec))
+
+
+def test_schema_covers_issue_fields():
+    """The acceptance criteria name step/sps/HBM/compile-count records."""
+    for field in ("step", "sps", "hbm", "compiles", "timer_percentiles_s", "host_rss_mb"):
+        assert field in TELEMETRY_REQUIRED_FIELDS
+
+
+def test_sink_append_and_read(tmp_path):
+    path = str(tmp_path / "telemetry.jsonl")
+    sink = TelemetrySink(path)
+    for i in range(5):
+        sink.write(_record(step=i))
+    sink.close()
+    recs = read_records(path)
+    assert [r["step"] for r in recs] == list(range(5))
+    assert all(validate_record(r) == [] for r in recs)
+
+
+def test_sink_reopens_appending(tmp_path):
+    path = str(tmp_path / "telemetry.jsonl")
+    s1 = TelemetrySink(path)
+    s1.write(_record(step=0))
+    s1.close()
+    s2 = TelemetrySink(path)
+    s2.write(_record(step=1))
+    s2.close()
+    assert [r["step"] for r in read_records(path)] == [0, 1]
+
+
+def test_sink_rotation(tmp_path):
+    path = str(tmp_path / "telemetry.jsonl")
+    one_line = len(json.dumps(_record(), separators=(",", ":"))) + 1
+    sink = TelemetrySink(path, max_bytes=int(one_line * 2.5))  # rotate after 2 records
+    for i in range(6):
+        sink.write(_record(step=i))
+    sink.close()
+    assert os.path.exists(path + ".1"), "rotation must keep one backup generation"
+    tail = read_records(path)
+    backup = read_records(path + ".1")
+    # no record lost across the most recent rotation boundary
+    assert [r["step"] for r in backup + tail] == list(range(6))[-len(backup) - len(tail):]
+    assert os.path.getsize(path) <= one_line * 3
+
+
+def test_host_rss_probe():
+    rss = host_rss_mb()
+    assert rss is None or rss > 0
