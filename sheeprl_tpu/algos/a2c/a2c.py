@@ -24,7 +24,8 @@ from sheeprl_tpu.algos.ppo.utils import normalize_obs, prepare_obs, test
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.obs import setup_observability, trace_scope
-from sheeprl_tpu.utils.callback import CheckpointCallback, load_checkpoint
+from sheeprl_tpu.resilience import CheckpointManager
+from sheeprl_tpu.utils.callback import load_checkpoint
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
@@ -219,7 +220,9 @@ def main(runtime, cfg: Dict[str, Any]):
     if state:
         cfg.algo.per_rank_batch_size = state["batch_size"] // world_size
 
-    ckpt_cb = CheckpointCallback(keep_last=cfg.checkpoint.keep_last)
+    ckpt_mgr = CheckpointManager(
+        runtime, cfg, log_dir, observability=observability, last_checkpoint=last_checkpoint
+    )
     update_fn = make_update_fn(runtime, module, tx, cfg, obs_keys)
     lr0 = float(cfg.algo.optimizer.get("learning_rate", 1e-3))
     current_lr = lr0
@@ -325,24 +328,30 @@ def main(runtime, cfg: Dict[str, Any]):
         if cfg.algo.anneal_lr:
             current_lr = polynomial_decay(iter_num, initial=lr0, final=0.0, max_decay_steps=total_iters, power=1.0)
 
-        if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
-            iter_num == total_iters and cfg.checkpoint.save_last
-        ):
-            last_checkpoint = policy_step
-            ckpt_state = {
+        def _ckpt_state():
+            state = {
                 "agent": params,
                 "optimizer": opt_state,
                 "iter_num": iter_num * world_size,
                 "batch_size": cfg.algo.per_rank_batch_size * world_size,
                 "last_log": last_log,
-                "last_checkpoint": last_checkpoint,
+                "last_checkpoint": ckpt_mgr.last_checkpoint,
             }
-            ckpt_cb.save(
-                runtime,
-                os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{runtime.global_rank}.ckpt"),
-                ckpt_state,
-            )
+            # opt-in on-policy buffer persistence (buffer.checkpoint_on_policy):
+            # the rollout is cheap to regenerate, but the resilience benchmark
+            # needs a replay-buffer-bearing state on this loop
+            if cfg.buffer.get("checkpoint_on_policy", False):
+                state["rb"] = rb
+            return state
 
+        ckpt_mgr.maybe_checkpoint(
+            policy_step=policy_step, is_last=iter_num == total_iters, state_fn=_ckpt_state
+        )
+        if ckpt_mgr.preempted:
+            runtime.print(f"Preemption signal: emergency checkpoint written, stopping at iter {iter_num}")
+            break
+
+    ckpt_mgr.close()
     envs.close()
     observability.close()
     if runtime.is_global_zero and cfg.algo.run_test:

@@ -36,7 +36,8 @@ from sheeprl_tpu.data.device_buffer import maybe_create_for, sequence_batches
 from sheeprl_tpu.ops.dyn_bptt import dyn_bptt_setting, dyn_rssm_sequence_v1, extract_dyn_params_v1
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
 from sheeprl_tpu.obs import setup_observability, trace_scope
-from sheeprl_tpu.utils.callback import CheckpointCallback, load_checkpoint, restore_buffer
+from sheeprl_tpu.resilience import CheckpointManager
+from sheeprl_tpu.utils.callback import load_checkpoint, restore_buffer
 from sheeprl_tpu.utils.distribution import Bernoulli, Independent, Normal
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -492,7 +493,9 @@ def main(runtime, cfg: Dict[str, Any]):
     if state:
         ratio.load_state_dict(state["ratio"])
 
-    ckpt_cb = CheckpointCallback(keep_last=cfg.checkpoint.keep_last)
+    ckpt_mgr = CheckpointManager(
+        runtime, cfg, log_dir, observability=observability, last_checkpoint=last_checkpoint
+    )
     train_fn = make_train_fn(
         runtime,
         world_model,
@@ -660,10 +663,7 @@ def main(runtime, cfg: Dict[str, Any]):
             last_train = train_step
 
         # ------------------------------------------------------ checkpoint
-        if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
-            iter_num == total_iters and cfg.checkpoint.save_last
-        ):
-            last_checkpoint = policy_step
+        def _ckpt_state():
             ckpt_state = {
                 "world_model": params["world_model"],
                 "actor_task": params["actor_task"],
@@ -676,16 +676,22 @@ def main(runtime, cfg: Dict[str, Any]):
                 "iter_num": iter_num * world_size,
                 "batch_size": cfg.algo.per_rank_batch_size * world_size,
                 "last_log": last_log,
-                "last_checkpoint": last_checkpoint,
+                "last_checkpoint": ckpt_mgr.last_checkpoint,
             }
             if cfg.buffer.checkpoint:
                 ckpt_state["rb"] = rb
-            ckpt_cb.save(
-                runtime,
-                os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{runtime.global_rank}.ckpt"),
-                ckpt_state,
-            )
+            return ckpt_state
 
+        ckpt_mgr.maybe_checkpoint(
+            policy_step=policy_step, is_last=iter_num == total_iters, state_fn=_ckpt_state
+        )
+        if ckpt_mgr.preempted:
+            runtime.print(
+                f"Preemption signal: emergency checkpoint written, stopping at iter {iter_num}"
+            )
+            break
+
+    ckpt_mgr.close()
     envs.close()
     observability.close()
     # task test zero-shot

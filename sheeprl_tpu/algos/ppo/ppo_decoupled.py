@@ -41,7 +41,17 @@ from sheeprl_tpu.algos.ppo.utils import prepare_obs, test
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.obs import setup_observability, trace_scope
-from sheeprl_tpu.utils.callback import CheckpointCallback, load_checkpoint
+from sheeprl_tpu.resilience import (
+    CheckpointManager,
+    PeerDiedError,
+    PreemptionHandler,
+    child_alive,
+    hard_exit_point,
+    maybe_drop_or_delay_send,
+    parent_alive,
+    queue_get_from_peer,
+)
+from sheeprl_tpu.utils.callback import load_checkpoint
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
@@ -128,7 +138,9 @@ def _player_loop(cfg, data_q: mp.Queue, resp_q: mp.Queue, state_counters, world_
 
     # inference-only agent; weights arrive from the trainer (reference :126)
     module, params = build_agent(runtime, actions_dim, is_continuous, cfg, observation_space)
-    tag, payload = resp_q.get(timeout=_QUEUE_TIMEOUT_S)
+    tag, payload = queue_get_from_peer(
+        resp_q, timeout=_QUEUE_TIMEOUT_S, peer_alive=parent_alive, who="trainer"
+    )
     assert tag == "params", f"expected initial params, got {tag}"
     # pin the acting policy to the HOST CPU device explicitly: the
     # JAX_PLATFORMS=cpu env the parent exports around the spawn does NOT
@@ -162,9 +174,13 @@ def _player_loop(cfg, data_q: mp.Queue, resp_q: mp.Queue, state_counters, world_
         memmap_dir=os.path.join(log_dir, "memmap_buffer", "rank_0"),
         obs_keys=obs_keys,
     )
-    ckpt_cb = CheckpointCallback(keep_last=cfg.checkpoint.keep_last)
 
     start_iter, policy_step, last_log, last_checkpoint = state_counters
+    # the player owns the checkpoint files AND its own preemption handler
+    # (the trainer forwards SIGTERM here; see main below)
+    ckpt_mgr = CheckpointManager(
+        runtime, cfg, log_dir, observability=observability, last_checkpoint=last_checkpoint
+    )
     train_step = 0
     last_train = 0
     train_time_window = 0.0  # trainer-side seconds accumulated since last log
@@ -180,8 +196,32 @@ def _player_loop(cfg, data_q: mp.Queue, resp_q: mp.Queue, state_counters, world_
     step_data: Dict[str, np.ndarray] = {}
     next_obs_np = envs.reset(seed=cfg.seed)[0]
 
+    def _trainer_reply(policy_step_now: int, iter_now: int):
+        """One protocol reply from the trainer. A dead trainer surfaces in
+        ~a second as a final emergency checkpoint + a clear error instead
+        of the full ``_QUEUE_TIMEOUT_S`` hang."""
+        try:
+            return queue_get_from_peer(
+                resp_q, timeout=_QUEUE_TIMEOUT_S, peer_alive=parent_alive, who="trainer"
+            )
+        except PeerDiedError as e:
+            path = ckpt_mgr.emergency_dump(
+                policy_step_now,
+                {
+                    "agent": player.params,
+                    "iter_num": iter_now * world_size,
+                    "policy_step": policy_step_now,
+                },
+            )
+            raise RuntimeError(
+                f"decoupled trainer process died at policy_step={policy_step_now}; "
+                f"the player's last-known weights were dumped to {path} "
+                "(partial state: resume from the last regular ckpt_*.ckpt instead)"
+            ) from e
+
     for iter_num in range(start_iter, total_iters + 1):
         observability.on_iteration(policy_step)
+        hard_exit_point("player_exit")  # fault site: models a player crash
         for _ in range(cfg.algo.rollout_steps):
             policy_step += cfg.env.num_envs
 
@@ -231,19 +271,20 @@ def _player_loop(cfg, data_q: mp.Queue, resp_q: mp.Queue, state_counters, world_
                         runtime.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}")
 
         # --------------------------------------------- ship rollout to trainer
-        need_ckpt = (
-            cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every
-        ) or (iter_num == total_iters and cfg.checkpoint.save_last)
+        # preemption rides the cadence: a pending SIGTERM makes
+        # should_checkpoint True, so this message also requests the trainer
+        # state needed for a full (resumable) emergency checkpoint
+        need_ckpt = ckpt_mgr.should_checkpoint(policy_step, is_last=iter_num == total_iters)
         local_data = {k: np.asarray(v) for k, v in rb.to_arrays().items()}
         final_obs = {k: np.asarray(next_obs_np[k]) for k in obs_keys}
-        data_q.put(("data", local_data, final_obs, need_ckpt))
+        maybe_drop_or_delay_send(data_q.put, ("data", local_data, final_obs, need_ckpt))
 
         # --------------------------------------------- refreshed weights back
         # named span: in a profiler trace this wait IS the decoupled
         # topology's comms/train stall as seen from the player
         with trace_scope("ipc_wait_update"):
-            tag, new_params, train_metrics, opt_state_np, info_scalars = resp_q.get(
-                timeout=_QUEUE_TIMEOUT_S
+            tag, new_params, train_metrics, opt_state_np, info_scalars = _trainer_reply(
+                policy_step, iter_num
             )
         assert tag == "update", f"expected update, got {tag}"
         # hand the numpy tree straight to the setter: jnp.asarray here would
@@ -298,22 +339,33 @@ def _player_loop(cfg, data_q: mp.Queue, resp_q: mp.Queue, state_counters, world_
         # --------------------------------------------- checkpoint (player saves,
         # trainer state received on demand — reference on_checkpoint_player :337)
         if need_ckpt:
-            last_checkpoint = policy_step
             # iter_num/batch_size stored in coupled units (scaled by the
             # trainer mesh size) so checkpoints swap between variants
-            ckpt_state = {
-                "agent": new_params,
-                "optimizer": opt_state_np,
-                "iter_num": iter_num * world_size,
-                "batch_size": cfg.algo.per_rank_batch_size * world_size,
-                "last_log": last_log * world_size,
-                "last_checkpoint": last_checkpoint * world_size,
-            }
-            ckpt_path = os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_0.ckpt")
-            ckpt_cb.save(runtime, ckpt_path, ckpt_state)
+            ckpt_mgr.checkpoint_now(
+                policy_step=policy_step,
+                state_fn=lambda: {
+                    "agent": new_params,
+                    "optimizer": opt_state_np,
+                    "iter_num": iter_num * world_size,
+                    "batch_size": cfg.algo.per_rank_batch_size * world_size,
+                    "last_log": last_log * world_size,
+                    "last_checkpoint": ckpt_mgr.last_checkpoint * world_size,
+                },
+            )
+            if ckpt_mgr.preempted:
+                # the full emergency checkpoint is on disk (need_ckpt was
+                # forced by the pending signal) — stop cleanly
+                runtime.print(
+                    f"Preemption signal: emergency checkpoint written, stopping at iter {iter_num}"
+                )
+                break
+        # a signal that landed AFTER the data message was shipped finds
+        # need_ckpt False; run ONE more iteration — its need_ckpt is then
+        # forced True and fetches the trainer state the full save needs
 
     # shutdown sentinel (reference scatters -1, :344)
     data_q.put(("stop",))
+    ckpt_mgr.close()
     envs.close()
     observability.close()
     if cfg.algo.run_test:
@@ -378,8 +430,50 @@ def main(runtime, cfg: Dict[str, Any]):
         else:
             os.environ["JAX_PLATFORMS"] = saved_platform
 
+    # a SIGTERM delivered to the trainer only (per-process preemption) is
+    # forwarded to the player, which owns the checkpoint files and runs the
+    # emergency-save path; the trainer just keeps answering until "stop"
+    preemption = PreemptionHandler(forward_to=[player_proc]).install()
+
+    def _player_msg(what: str):
+        """Queue get that notices a dead player within ~a second. The
+        trainer owns no run dir, so its final dump lands next to the run
+        root with a distinctive name (partial state: params + optimizer)."""
+        try:
+            return queue_get_from_peer(
+                data_q,
+                timeout=_QUEUE_TIMEOUT_S,
+                peer_alive=child_alive(player_proc),
+                who="player",
+                detail_fn=lambda: f"exitcode={player_proc.exitcode}",
+            )
+        except PeerDiedError as e:
+            path = None
+            try:
+                from sheeprl_tpu.utils.ckpt_format import save_state
+
+                dump_dir = os.path.join(str(cfg.root_dir), str(cfg.run_name))
+                os.makedirs(dump_dir, exist_ok=True)
+                path = save_state(
+                    os.path.join(dump_dir, "emergency_trainer_0.ckpt"),
+                    _np_tree({"agent": params, "optimizer": opt_state}),
+                )
+            except Exception:
+                pass
+            raise RuntimeError(
+                f"decoupled player process died (exitcode={player_proc.exitcode}) while the "
+                f"trainer waited for a {what} message; trainer params/optimizer dumped to {path} "
+                "(partial state: resume from the last regular ckpt_*.ckpt instead)"
+            ) from e
+
     try:
-        tag, observation_space, actions_dim, is_continuous = data_q.get(timeout=_QUEUE_TIMEOUT_S)
+        tag, observation_space, actions_dim, is_continuous = queue_get_from_peer(
+            data_q,
+            timeout=_QUEUE_TIMEOUT_S,
+            peer_alive=child_alive(player_proc),
+            who="player",
+            detail_fn=lambda: f"exitcode={player_proc.exitcode}",
+        )
         assert tag == "init", f"expected init, got {tag}"
         obs_keys = cfg.algo.cnn_keys.encoder + cfg.algo.mlp_keys.encoder
 
@@ -423,7 +517,7 @@ def main(runtime, cfg: Dict[str, Any]):
             # named span: the trainer idling for the next rollout (the
             # inverse of the player's ipc_wait_update stall)
             with trace_scope("ipc_wait_rollout"):
-                msg = data_q.get(timeout=_QUEUE_TIMEOUT_S)
+                msg = _player_msg("rollout")
             if msg[0] == "stop":
                 break
             _, local_data, final_obs, need_ckpt = msg
@@ -483,21 +577,24 @@ def main(runtime, cfg: Dict[str, Any]):
                     max_decay_steps=total_iters, power=1.0,
                 )
 
-            resp_q.put(
+            maybe_drop_or_delay_send(
+                resp_q.put,
                 (
                     "update",
                     _np_tree(params),
                     train_metrics,
                     _np_tree(opt_state) if need_ckpt else None,
                     info_scalars,
-                )
+                ),
             )
+            hard_exit_point("trainer_exit")  # fault site: trainer crash after replying
 
         trainer_mon.uninstall()
         # the player still runs its test episode + logger shutdown after the
         # stop sentinel — give it ample time before the terminate fallback
         player_proc.join(timeout=3600.0)
     finally:
+        preemption.uninstall()
         if player_proc.is_alive():
             player_proc.terminate()
             player_proc.join()

@@ -35,7 +35,17 @@ from sheeprl_tpu.algos.sac.utils import prepare_obs, test
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.obs import setup_observability, trace_scope
-from sheeprl_tpu.utils.callback import CheckpointCallback, load_checkpoint, restore_buffer
+from sheeprl_tpu.resilience import (
+    CheckpointManager,
+    PeerDiedError,
+    PreemptionHandler,
+    child_alive,
+    hard_exit_point,
+    maybe_drop_or_delay_send,
+    parent_alive,
+    queue_get_from_peer,
+)
+from sheeprl_tpu.utils.callback import load_checkpoint, restore_buffer
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
@@ -103,7 +113,9 @@ def _player_loop(
     data_q.put(("init", observation_space, action_space))
 
     actor, critic, params, _ = build_agent(runtime, cfg, observation_space, action_space)
-    tag, payload = resp_q.get(timeout=_QUEUE_TIMEOUT_S)
+    tag, payload = queue_get_from_peer(
+        resp_q, timeout=_QUEUE_TIMEOUT_S, peer_alive=parent_alive, who="trainer"
+    )
     assert tag == "params", f"expected initial params, got {tag}"
     # explicit host-CPU pin — see ppo_decoupled._player_loop: the axon PJRT
     # plugin ignores the JAX_PLATFORMS=cpu export and would otherwise run
@@ -148,9 +160,12 @@ def _player_loop(
                     "counts (coupled runs step num_envs * world_size envs, decoupled num_envs)."
                 )
             rb = restored
-    ckpt_cb = CheckpointCallback(keep_last=cfg.checkpoint.keep_last)
-
     start_iter, policy_step, last_log, last_checkpoint = state_counters
+    # the player owns the checkpoint files AND its own preemption handler
+    # (the trainer forwards SIGTERM here; see main below)
+    ckpt_mgr = CheckpointManager(
+        runtime, cfg, log_dir, observability=observability, last_checkpoint=last_checkpoint
+    )
     train_step = 0
     last_train = 0
     train_time_window = 0.0
@@ -170,9 +185,34 @@ def _player_loop(
     step_data: Dict[str, np.ndarray] = {}
     obs = envs.reset(seed=cfg.seed)[0]
 
+    def _trainer_reply(policy_step_now: int, iter_now: int):
+        """One protocol reply from the trainer. A dead trainer surfaces in
+        ~a second as a final emergency checkpoint + a clear error instead
+        of the full ``_QUEUE_TIMEOUT_S`` hang."""
+        try:
+            return queue_get_from_peer(
+                resp_q, timeout=_QUEUE_TIMEOUT_S, peer_alive=parent_alive, who="trainer"
+            )
+        except PeerDiedError as e:
+            path = ckpt_mgr.emergency_dump(
+                policy_step_now,
+                {
+                    "actor": player.params,
+                    "ratio": ratio.state_dict(),
+                    "iter_num": iter_now * world_size,
+                    "policy_step": policy_step_now,
+                },
+            )
+            raise RuntimeError(
+                f"decoupled trainer process died at policy_step={policy_step_now}; "
+                f"the player's last-known actor weights were dumped to {path} "
+                "(partial state: resume from the last regular ckpt_*.ckpt instead)"
+            ) from e
+
     cumulative_per_rank_gradient_steps = 0
     for iter_num in range(start_iter, total_iters + 1):
         observability.on_iteration(policy_step)
+        hard_exit_point("player_exit")  # fault site: models a player crash
         policy_step += policy_steps_per_iter
 
         with timer("Time/env_interaction_time", SumMetric, sync_on_compute=False):
@@ -226,12 +266,12 @@ def _player_loop(
                     sample_next_obs=cfg.buffer.sample_next_obs,
                 )
                 sample = {k: np.asarray(v) for k, v in sample.items()}
-                data_q.put(("data", sample, g, iter_num))
+                maybe_drop_or_delay_send(data_q.put, ("data", sample, g, iter_num))
 
                 # named span: the player stalling on the trainer (IPC +
                 # train dispatch) — the decoupled topology's comms cost
                 with trace_scope("ipc_wait_update"):
-                    tag, actor_params, train_metrics = resp_q.get(timeout=_QUEUE_TIMEOUT_S)
+                    tag, actor_params, train_metrics = _trainer_reply(policy_step, iter_num)
                 assert tag == "update", f"expected update, got {tag}"
                 # numpy straight to the setter — see ppo_decoupled: jnp.asarray
                 # would stage the params on the tunnel backend first
@@ -248,31 +288,36 @@ def _player_loop(
         # trainer state requested on demand so zero-gradient-step iterations
         # and save_last still checkpoint — unlike piggybacking on the data
         # message)
-        if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
-            iter_num == total_iters and cfg.checkpoint.save_last
-        ):
+        # preemption rides the cadence: a pending SIGTERM makes
+        # should_checkpoint True, so the player requests the trainer state
+        # needed for a full (resumable) emergency checkpoint
+        if ckpt_mgr.should_checkpoint(policy_step, is_last=iter_num == total_iters):
             data_q.put(("ckpt_req",))
-            tag, full_state = resp_q.get(timeout=_QUEUE_TIMEOUT_S)
+            tag, full_state = _trainer_reply(policy_step, iter_num)
             assert tag == "ckpt_state", f"expected ckpt_state, got {tag}"
-            last_checkpoint = policy_step
-            ckpt_state = {
-                "agent": full_state["agent"],
-                "opt_states": full_state["opt_states"],
-                "ratio": ratio.state_dict(),
-                # counters stored in coupled policy-step units (x world_size)
-                # so checkpoints swap between variants
-                "iter_num": iter_num * world_size,
-                "batch_size": cfg.algo.per_rank_batch_size * world_size,
-                "last_log": last_log * world_size,
-                "last_checkpoint": last_checkpoint * world_size,
-            }
-            if cfg.buffer.checkpoint:
-                ckpt_state["rb"] = rb
-            ckpt_cb.save(
-                runtime,
-                os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_0.ckpt"),
-                ckpt_state,
-            )
+
+            def _ckpt_state():
+                state = {
+                    "agent": full_state["agent"],
+                    "opt_states": full_state["opt_states"],
+                    "ratio": ratio.state_dict(),
+                    # counters stored in coupled policy-step units (x world_size)
+                    # so checkpoints swap between variants
+                    "iter_num": iter_num * world_size,
+                    "batch_size": cfg.algo.per_rank_batch_size * world_size,
+                    "last_log": last_log * world_size,
+                    "last_checkpoint": ckpt_mgr.last_checkpoint * world_size,
+                }
+                if cfg.buffer.checkpoint:
+                    state["rb"] = rb
+                return state
+
+            ckpt_mgr.checkpoint_now(policy_step=policy_step, state_fn=_ckpt_state)
+            if ckpt_mgr.preempted:
+                runtime.print(
+                    f"Preemption signal: emergency checkpoint written, stopping at iter {iter_num}"
+                )
+                break
 
         if cfg.metric.log_level > 0 and (
             policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters
@@ -315,6 +360,7 @@ def _player_loop(
 
     # shutdown sentinel (reference scatters -1, sac_decoupled.py:328)
     data_q.put(("stop",))
+    ckpt_mgr.close()
     envs.close()
     observability.close()
     if cfg.algo.run_test:
@@ -368,8 +414,44 @@ def main(runtime, cfg: Dict[str, Any]):
         else:
             os.environ["JAX_PLATFORMS"] = saved_platform
 
+    # a SIGTERM delivered to the trainer only (per-process preemption) is
+    # forwarded to the player, which owns the checkpoint files and runs the
+    # emergency-save path; the trainer just keeps answering until "stop"
+    preemption = PreemptionHandler(forward_to=[player_proc]).install()
+
+    def _player_msg(what: str):
+        """Queue get that notices a dead player within ~a second. The
+        trainer owns no run dir, so its final dump lands next to the run
+        root with a distinctive name (partial state: params + optimizer)."""
+        try:
+            return queue_get_from_peer(
+                data_q,
+                timeout=_QUEUE_TIMEOUT_S,
+                peer_alive=child_alive(player_proc),
+                who="player",
+                detail_fn=lambda: f"exitcode={player_proc.exitcode}",
+            )
+        except PeerDiedError as e:
+            path = None
+            try:
+                from sheeprl_tpu.utils.ckpt_format import save_state
+
+                dump_dir = os.path.join(str(cfg.root_dir), str(cfg.run_name))
+                os.makedirs(dump_dir, exist_ok=True)
+                path = save_state(
+                    os.path.join(dump_dir, "emergency_trainer_0.ckpt"),
+                    _np_tree({"agent": params, "opt_states": opt_states}),
+                )
+            except Exception:
+                pass
+            raise RuntimeError(
+                f"decoupled player process died (exitcode={player_proc.exitcode}) while the "
+                f"trainer waited for a {what} message; trainer params/optimizer dumped to {path} "
+                "(partial state: resume from the last regular ckpt_*.ckpt instead)"
+            ) from e
+
     try:
-        tag, observation_space, action_space = data_q.get(timeout=_QUEUE_TIMEOUT_S)
+        tag, observation_space, action_space = _player_msg("init")
         assert tag == "init", f"expected init, got {tag}"
 
         actor, critic, params, target_entropy = build_agent(
@@ -409,12 +491,13 @@ def main(runtime, cfg: Dict[str, Any]):
 
         while True:
             with trace_scope("ipc_wait_rollout"):
-                msg = data_q.get(timeout=_QUEUE_TIMEOUT_S)
+                msg = _player_msg("rollout")
             if msg[0] == "stop":
                 break
             if msg[0] == "ckpt_req":
-                resp_q.put(
-                    ("ckpt_state", {"agent": _np_tree(params), "opt_states": _np_tree(opt_states)})
+                maybe_drop_or_delay_send(
+                    resp_q.put,
+                    ("ckpt_state", {"agent": _np_tree(params), "opt_states": _np_tree(opt_states)}),
                 )
                 continue
             _, sample, g, iter_num = msg
@@ -445,13 +528,17 @@ def main(runtime, cfg: Dict[str, Any]):
             train_metrics["trainer_compiles"] = trainer_mon.compiles
             trainer_mon.mark_warmup_complete()  # first update done: further compiles are retraces
 
-            resp_q.put(("update", _np_tree(params["actor"]), train_metrics))
+            maybe_drop_or_delay_send(
+                resp_q.put, ("update", _np_tree(params["actor"]), train_metrics)
+            )
+            hard_exit_point("trainer_exit")  # fault site: trainer crash after replying
 
         trainer_mon.uninstall()
         # the player still runs its test episode + logger shutdown after the
         # stop sentinel — give it ample time before the terminate fallback
         player_proc.join(timeout=3600.0)
     finally:
+        preemption.uninstall()
         if player_proc.is_alive():
             player_proc.terminate()
             player_proc.join()

@@ -117,6 +117,7 @@ def resume_from_checkpoint(cfg: dotdict) -> dotdict:
                 "every": cfg.checkpoint.every,
                 "keep_last": cfg.checkpoint.keep_last,
                 "save_last": cfg.checkpoint.save_last,
+                "async_save": cfg.checkpoint.get("async_save", True),
             },
             "metric": {
                 "log_every": cfg.metric.log_every,
@@ -271,6 +272,14 @@ def run(args: Optional[Sequence[str]] = None) -> None:
         os.environ.setdefault("XLA_FLAGS", "")
     from sheeprl_tpu.utils.utils import print_config
 
+    # fault-injection harness (howto/resilience.md): cfg.faults rides the
+    # env var so spawned decoupled children inherit the armed sites
+    if cfg.get("faults"):
+        os.environ["SHEEPRL_FAULTS"] = str(cfg.faults)
+    if cfg.checkpoint.resume_from:
+        from sheeprl_tpu.resilience import resolve_auto_resume
+
+        resolve_auto_resume(cfg)
     if cfg.checkpoint.resume_from:
         cfg = resume_from_checkpoint(cfg)
     check_configs(cfg)

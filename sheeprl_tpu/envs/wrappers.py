@@ -133,6 +133,85 @@ class RestartOnException(gym.Wrapper):
             return new_obs, info
 
 
+class EnvStepGuard(gym.Wrapper):
+    """Robust ``step``: a crashed/raising env is rebuilt ONCE with backoff
+    and the interrupted episode is marked **truncated**; a second fault
+    before the restarted env completes a step re-raises with the env index
+    and the last action in the message.
+
+    Differences from :class:`RestartOnException` (kept for reference
+    parity on the Dreamer-V3/minerl paths): the interrupted episode ends as
+    a normal truncation — the vector env's SAME_STEP autoreset then resets
+    the rebuilt env and the algorithms' truncation bootstrapping handles
+    the value target, so no algorithm-side special-casing is needed — and
+    an unrecoverable env surfaces a diagnosable error instead of a fail
+    counter. Applied per-env inside the thunk (``make_env``) so it guards
+    Sync and Async vector envs alike. The ``env_step_raise`` fault site
+    (resilience/faults.py) raises from inside the guard, making the
+    recovery path testable without a crashy env."""
+
+    def __init__(
+        self,
+        env: gym.Env,
+        env_fn: Callable[[], gym.Env],
+        env_idx: int = 0,
+        backoff_s: float = 1.0,
+    ):
+        super().__init__(env)
+        self._env_fn = env_fn
+        self._env_idx = env_idx
+        self._backoff_s = backoff_s
+        self._last_obs: Any = None
+        self._last_action: Any = None
+        # True from a restart until the rebuilt env survives one step: a
+        # fault in that window is a double fault (the rebuild didn't help)
+        self._just_restarted = False
+
+    def reset(self, *, seed: Optional[int] = None, options: Optional[Dict[str, Any]] = None):
+        obs, info = self.env.reset(seed=seed, options=options)
+        self._last_obs = obs
+        return obs, info
+
+    def step(self, action) -> Tuple[Any, SupportsFloat, bool, bool, Dict[str, Any]]:
+        self._last_action = action
+        try:
+            from sheeprl_tpu.resilience.faults import fault_point
+
+            if fault_point("env_step_raise"):
+                raise RuntimeError("injected fault: env_step_raise")
+            obs, reward, terminated, truncated, info = self.env.step(action)
+        except Exception as e:
+            if self._just_restarted:
+                raise RuntimeError(
+                    f"env {self._env_idx} crashed again right after a restart "
+                    f"(double fault, giving up); last action: {self._last_action!r}"
+                ) from e
+            gym.logger.warn(
+                f"env {self._env_idx} crashed in step ({type(e).__name__}: {e}); "
+                f"restarting once after {self._backoff_s}s and truncating the episode"
+            )
+            try:
+                self.env.close()
+            except Exception:
+                pass
+            time.sleep(self._backoff_s)
+            self.env = self._env_fn()
+            self.env.reset()
+            self._just_restarted = True
+            # end the interrupted episode as a truncation at the last good
+            # observation; SAME_STEP autoreset resets the fresh env next
+            return (
+                self._last_obs,
+                0.0,
+                False,
+                True,
+                {"env_restarted": True, "env_restart_error": f"{type(e).__name__}: {e}"},
+            )
+        self._just_restarted = False
+        self._last_obs = obs
+        return obs, reward, terminated, truncated, info
+
+
 class FrameStack(gym.Wrapper):
     """Stack the last ``num_stack`` frames of dict image observations on the
     channel axis: (H, W, C) -> (H, W, C*num_stack), with optional dilation."""

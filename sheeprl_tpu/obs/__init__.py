@@ -78,6 +78,10 @@ class Observability:
         self.recompile: Optional[RecompileMonitor] = None
         self.scheduler: Optional[ProfileScheduler] = None
         self.sink: Optional[TelemetrySink] = None
+        # zero-arg provider of checkpoint write/stall stats; the
+        # CheckpointManager (resilience/manager.py) attaches itself here so
+        # every telemetry record carries a "ckpt" section
+        self.ckpt_stats: Optional[Any] = None
         if not self.enabled:
             return
         self._world_size = max(1, int(world_size))
@@ -122,6 +126,11 @@ class Observability:
             train_time_s if train_time_s is not None else timers.get("Time/train_time", 0.0)
         )
         env_time = timers.get("Time/env_interaction_time", 0.0)
+        if self.ckpt_stats is not None:
+            try:
+                extra = {**(extra or {}), "ckpt": self.ckpt_stats()}
+            except Exception:
+                pass
         record = make_record(
             step=policy_step,
             train_step=train_step,

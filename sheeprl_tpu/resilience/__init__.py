@@ -1,0 +1,62 @@
+"""sheeprl_tpu.resilience — preemption-tolerant training (ISSUE 2).
+
+Five parts:
+
+- :mod:`~sheeprl_tpu.resilience.manager` — :class:`CheckpointManager`, the
+  shared ``maybe_checkpoint()`` every algo loop calls (cadence + async
+  writing + preemption-forced saves + telemetry);
+- :mod:`~sheeprl_tpu.resilience.async_writer` — background checkpoint
+  serialization with at-most-one-in-flight double buffering;
+- :mod:`~sheeprl_tpu.resilience.preemption` — SIGTERM/SIGINT → clean
+  emergency checkpoint + shutdown, forwarded into decoupled children;
+- :mod:`~sheeprl_tpu.resilience.autoresume` —
+  ``checkpoint.resume_from=auto``: newest *valid* checkpoint wins,
+  corruption falls back to the previous one;
+- :mod:`~sheeprl_tpu.resilience.faults` + :mod:`~sheeprl_tpu.resilience.peer`
+  — the fault-injection harness (``SHEEPRL_FAULTS``) and peer-death
+  detection for the decoupled topologies.
+
+See ``howto/resilience.md`` for the operational model.
+"""
+
+from sheeprl_tpu.resilience.async_writer import AsyncCheckpointWriter
+from sheeprl_tpu.resilience.autoresume import (
+    find_latest_resumable,
+    list_checkpoints,
+    resolve_auto_resume,
+)
+from sheeprl_tpu.resilience.faults import (
+    FaultInjector,
+    fault_arg,
+    fault_point,
+    get_injector,
+    hard_exit_point,
+    maybe_drop_or_delay_send,
+)
+from sheeprl_tpu.resilience.manager import CheckpointManager
+from sheeprl_tpu.resilience.peer import (
+    PeerDiedError,
+    child_alive,
+    parent_alive,
+    queue_get_from_peer,
+)
+from sheeprl_tpu.resilience.preemption import PreemptionHandler
+
+__all__ = [
+    "AsyncCheckpointWriter",
+    "CheckpointManager",
+    "FaultInjector",
+    "PeerDiedError",
+    "PreemptionHandler",
+    "child_alive",
+    "fault_arg",
+    "fault_point",
+    "find_latest_resumable",
+    "get_injector",
+    "hard_exit_point",
+    "list_checkpoints",
+    "maybe_drop_or_delay_send",
+    "parent_alive",
+    "queue_get_from_peer",
+    "resolve_auto_resume",
+]

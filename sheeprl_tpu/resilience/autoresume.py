@@ -1,0 +1,67 @@
+"""Auto-resume: find the newest VALID checkpoint under a run root.
+
+``checkpoint.resume_from=auto`` makes a restarted job (the normal
+aftermath of a preemption) continue from wherever it died without an
+operator pasting checkpoint paths: the CLI scans the experiment's run root
+(``cfg.root_dir`` — every run of the experiment versions its dirs under
+it), validates candidates newest-first with
+:func:`~sheeprl_tpu.utils.ckpt_format.validate_checkpoint`, and resumes
+from the first that passes. A checkpoint torn by the crash (kill -9 mid
+``os.replace`` window, torn device write) is skipped with a warning and
+the previous one is used — the atomic tmp+rename write plus keep-last
+retention guarantees at least one older valid file exists whenever any
+checkpoint was ever completed.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import warnings
+from typing import List, Optional
+
+from sheeprl_tpu.utils.ckpt_format import CheckpointCorruptError, validate_checkpoint
+
+
+def list_checkpoints(scan_root: str) -> List[str]:
+    """All ``ckpt_*.ckpt`` files under ``scan_root`` (recursive), newest
+    mtime first. Emergency peer-death dumps (``emergency_*.ckpt``) are
+    intentionally excluded — they carry partial state."""
+    pattern = os.path.join(glob.escape(scan_root), "**", "ckpt_*.ckpt")
+    ckpts = glob.glob(pattern, recursive=True)
+
+    def _mtime(p: str) -> float:
+        try:
+            return os.path.getmtime(p)
+        except OSError:
+            return 0.0
+
+    return sorted(ckpts, key=_mtime, reverse=True)
+
+
+def find_latest_resumable(scan_root: str) -> Optional[str]:
+    """Newest checkpoint under ``scan_root`` that validates; corrupt ones
+    are skipped with a warning. None when nothing usable exists."""
+    for ckpt in list_checkpoints(scan_root):
+        try:
+            validate_checkpoint(ckpt)
+            return ckpt
+        except CheckpointCorruptError as e:
+            warnings.warn(f"auto-resume: skipping corrupt checkpoint ({e})")
+    return None
+
+
+def resolve_auto_resume(cfg) -> None:
+    """Resolve ``checkpoint.resume_from=auto`` in place. Finding nothing is
+    NOT an error: the first launch of a job and its post-preemption
+    restarts can share one command line."""
+    if str(cfg.checkpoint.resume_from or "").lower() != "auto":
+        return
+    scan_root = str(cfg.get("root_dir", "."))
+    found = find_latest_resumable(scan_root)
+    if found is None:
+        print(f"auto-resume: no valid checkpoint under {scan_root!r}; starting fresh")
+        cfg.checkpoint.resume_from = None
+    else:
+        print(f"auto-resume: resuming from {found}")
+        cfg.checkpoint.resume_from = found

@@ -1,0 +1,149 @@
+"""Fault-injection harness for crash-consistency testing.
+
+Production RL runs die in ways unit tests never exercise: the checkpoint
+writer SIGKILLed halfway through a zip, a torn write surviving the atomic
+rename, a decoupled peer process exiting mid-protocol, an env segfaulting
+under an action. This module turns each of those into a *reproducible*
+event: instrumented sites in the framework call :func:`fault_point` with a
+well-known name, and the harness arms specific sites via the
+``SHEEPRL_FAULTS`` environment variable (or ``cfg.faults``, which the CLI
+exports into the env var so spawned decoupled children inherit it).
+
+Spec grammar (comma-separated)::
+
+    SHEEPRL_FAULTS="site[:after[:arg]][,site2[:after2[:arg2]]...]"
+
+- ``site`` — one of the instrumented names below;
+- ``after`` — fire on the N-th hit of the site (default 1 = first hit);
+- ``arg`` — site-specific payload (e.g. delay seconds), default 0.
+
+Instrumented sites:
+
+==========================  ====================================================
+``ckpt_kill_mid_write``     ``save_state`` truncates the half-written ``.tmp``
+                            and SIGKILLs the process (writer killed mid-write)
+``ckpt_truncate``           ``save_state`` truncates the FINAL ``.ckpt`` after
+                            the atomic rename (torn block-device write)
+``queue_drop``              a decoupled IPC send is silently dropped
+``queue_delay``             a decoupled IPC send sleeps ``arg`` seconds first
+``env_step_raise``          the env-step guard's inner ``env.step`` raises
+``player_exit``             the decoupled player hard-exits (``os._exit(13)``)
+                            at its iteration boundary
+``trainer_exit``            the decoupled trainer hard-exits (``os._exit(13)``)
+                            after answering an update
+==========================  ====================================================
+
+``fault_point(name)`` returns True exactly when the armed site fires (a
+one-shot: each spec entry fires once); sites implement the failure
+behavior themselves so the injected fault is indistinguishable from the
+real one. With no spec armed the per-call cost is one dict lookup on an
+empty dict — safe to leave in hot-ish paths.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, Optional
+
+ENV_VAR = "SHEEPRL_FAULTS"
+
+KNOWN_SITES = (
+    "ckpt_kill_mid_write",
+    "ckpt_truncate",
+    "queue_drop",
+    "queue_delay",
+    "env_step_raise",
+    "player_exit",
+    "trainer_exit",
+)
+
+
+class FaultInjector:
+    """Parsed ``SHEEPRL_FAULTS`` spec + per-site hit counters."""
+
+    def __init__(self, spec: str = ""):
+        self._lock = threading.Lock()
+        self._sites: Dict[str, Dict[str, float]] = {}
+        for entry in (spec or "").split(","):
+            entry = entry.strip()
+            if not entry:
+                continue
+            parts = entry.split(":")
+            name = parts[0]
+            if name not in KNOWN_SITES:
+                raise ValueError(
+                    f"unknown fault site {name!r}; known: {', '.join(KNOWN_SITES)}"
+                )
+            after = int(parts[1]) if len(parts) > 1 and parts[1] else 1
+            arg = float(parts[2]) if len(parts) > 2 and parts[2] else 0.0
+            self._sites[name] = {"after": max(1, after), "hits": 0, "arg": arg, "fired": 0}
+
+    def fire(self, name: str) -> bool:
+        """Count a hit of ``name``; True exactly when its threshold is
+        reached (one-shot)."""
+        if not self._sites:
+            return False
+        with self._lock:
+            site = self._sites.get(name)
+            if site is None or site["fired"]:
+                return False
+            site["hits"] += 1
+            if site["hits"] >= site["after"]:
+                site["fired"] = 1
+                return True
+            return False
+
+    def arg(self, name: str) -> float:
+        site = self._sites.get(name)
+        return float(site["arg"]) if site else 0.0
+
+    @property
+    def armed(self) -> bool:
+        return bool(self._sites)
+
+
+_injector: Optional[FaultInjector] = None
+_injector_spec: Optional[str] = None
+
+
+def get_injector() -> FaultInjector:
+    """Process-wide injector, (re)built whenever ``SHEEPRL_FAULTS``
+    changes — tests flip the env var between in-process runs."""
+    global _injector, _injector_spec
+    spec = os.environ.get(ENV_VAR, "")
+    if _injector is None or spec != _injector_spec:
+        _injector = FaultInjector(spec)
+        _injector_spec = spec
+    return _injector
+
+
+def fault_point(name: str) -> bool:
+    """True when the armed fault ``name`` fires at this call site."""
+    return get_injector().fire(name)
+
+
+def fault_arg(name: str) -> float:
+    return get_injector().arg(name)
+
+
+def maybe_drop_or_delay_send(put_fn, payload) -> None:
+    """IPC send wrapper for the decoupled queues: honors ``queue_drop``
+    (message silently discarded) and ``queue_delay`` (sleep before the
+    put). The default path is a plain ``put_fn(payload)``."""
+    inj = get_injector()
+    if inj.armed:
+        if inj.fire("queue_drop"):
+            return
+        if inj.fire("queue_delay"):
+            time.sleep(inj.arg("queue_delay"))
+    put_fn(payload)
+
+
+def hard_exit_point(name: str) -> None:
+    """Process-death site (``player_exit`` / ``trainer_exit``): exits with
+    a distinctive code, bypassing atexit/finally — the point is to model a
+    crash, not a shutdown."""
+    if fault_point(name):
+        os._exit(13)

@@ -1,0 +1,178 @@
+"""CheckpointManager — the one checkpoint path every algo loop shares.
+
+Before this module, every algorithm carried its own copy of the cadence
+check + state-dict assembly + ``CheckpointCallback.save`` call (13 nearly
+identical blocks). The manager centralizes:
+
+- **cadence**: ``checkpoint.every`` policy-step intervals, ``save_last``
+  on the final iteration, and a forced save when a preemption signal is
+  pending — one ``maybe_checkpoint`` call per iteration;
+- **async writing** (``checkpoint.async_save``): the in-loop cost drops to
+  the fast snapshot (device→host + buffer materialization); manifest
+  encoding and the zip write move to the
+  :class:`~sheeprl_tpu.resilience.async_writer.AsyncCheckpointWriter`
+  background thread, with an end-of-run :meth:`close` barrier;
+- **preemption**: owns the process's
+  :class:`~sheeprl_tpu.resilience.preemption.PreemptionHandler`; loops
+  check :attr:`preempted` after ``maybe_checkpoint`` and break — the
+  forced save has already produced a fully resumable checkpoint;
+- **telemetry**: in-loop stall seconds vs total write seconds are exposed
+  through :meth:`stats` and ride the run's ``telemetry.jsonl`` (PR-1
+  observability sink), so resilience overhead is measurable, not folklore.
+
+``state_fn`` is a zero-arg callable building the state dict — evaluated
+only when a save actually happens, on rank zero, after
+``last_checkpoint`` has been advanced (so the dict can embed
+``mgr.last_checkpoint``).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Callable, Dict, Optional
+
+from sheeprl_tpu.resilience.async_writer import AsyncCheckpointWriter
+from sheeprl_tpu.resilience.preemption import PreemptionHandler
+from sheeprl_tpu.utils.callback import CheckpointCallback
+
+
+class CheckpointManager:
+    def __init__(
+        self,
+        runtime,
+        cfg,
+        log_dir: Optional[str],
+        observability: Any = None,
+        last_checkpoint: int = 0,
+        forward_preemption_to: Optional[list] = None,
+    ):
+        ckpt_cfg = cfg.checkpoint
+        self._runtime = runtime
+        self.every = int(ckpt_cfg.every)
+        self.save_last = bool(ckpt_cfg.save_last)
+        self.async_save = bool(ckpt_cfg.get("async_save", True))
+        self.log_dir = log_dir
+        self.last_checkpoint = int(last_checkpoint)
+        self.cb = CheckpointCallback(keep_last=ckpt_cfg.keep_last)
+        self.writer = (
+            AsyncCheckpointWriter(self.cb.write)
+            if self.async_save and runtime.is_global_zero
+            else None
+        )
+        self.preemption = PreemptionHandler(forward_to=forward_preemption_to).install()
+        # --- stats (telemetry)
+        self.saves = 0
+        self.last_stall_s = 0.0
+        self.total_stall_s = 0.0
+        self._sync_write_s = 0.0
+        if observability is not None:
+            observability.ckpt_stats = self.stats
+
+    # --------------------------------------------------------------- flags
+    @property
+    def preempted(self) -> bool:
+        return self.preemption.preempted
+
+    def should_checkpoint(self, policy_step: int, is_last: bool = False) -> bool:
+        """Cadence check, preemption included. Pure — does not advance
+        ``last_checkpoint`` (that happens in :meth:`checkpoint_now`)."""
+        return (
+            (self.every > 0 and policy_step - self.last_checkpoint >= self.every)
+            or (is_last and self.save_last)
+            or self.preempted
+        )
+
+    # --------------------------------------------------------------- saves
+    def ckpt_path(self, policy_step: int) -> str:
+        return os.path.join(
+            self.log_dir or ".",
+            "checkpoint",
+            f"ckpt_{policy_step}_{self._runtime.global_rank}.ckpt",
+        )
+
+    def maybe_checkpoint(
+        self,
+        *,
+        policy_step: int,
+        is_last: bool,
+        state_fn: Callable[[], Dict[str, Any]],
+    ) -> Optional[str]:
+        """The per-iteration call every algo loop makes. Returns the
+        checkpoint path when a save was (or started being) written."""
+        if not self.should_checkpoint(policy_step, is_last):
+            return None
+        return self.checkpoint_now(policy_step=policy_step, state_fn=state_fn)
+
+    def checkpoint_now(
+        self, *, policy_step: int, state_fn: Callable[[], Dict[str, Any]]
+    ) -> Optional[str]:
+        """Unconditional save at ``policy_step`` (cadence state advances on
+        every rank so multi-process cadences stay in lockstep; only global
+        rank zero touches disk)."""
+        self.last_checkpoint = policy_step
+        if not self._runtime.is_global_zero:
+            return None
+        path = self.ckpt_path(policy_step)
+        t0 = time.perf_counter()
+        if self.writer is not None:
+            host_state = self.cb.snapshot(state_fn())
+            self.writer.submit(path, host_state)
+        else:
+            self.cb.write(path, self.cb.snapshot(state_fn()))
+            self._sync_write_s += time.perf_counter() - t0
+        self.last_stall_s = time.perf_counter() - t0
+        self.total_stall_s += self.last_stall_s
+        self.saves += 1
+        return path
+
+    def emergency_dump(self, policy_step: int, state: Dict[str, Any]) -> Optional[str]:
+        """Best-effort synchronous dump of whatever state the caller still
+        owns (peer death: the full resumable state may be unreachable).
+        Named ``emergency_*.ckpt`` so auto-resume and keep-last retention
+        never treat a partial state as a resume point."""
+        if not self._runtime.is_global_zero:
+            return None
+        from sheeprl_tpu.utils.ckpt_format import save_state
+
+        path = os.path.join(
+            self.log_dir or ".",
+            "checkpoint",
+            f"emergency_{policy_step}_{self._runtime.global_rank}.ckpt",
+        )
+        try:
+            if self.writer is not None:
+                self.writer.wait()
+            save_state(path, self.cb.snapshot(state))
+            return path
+        except Exception as e:  # the original error must stay the headline
+            import warnings
+
+            warnings.warn(f"emergency checkpoint failed: {type(e).__name__}: {e}")
+            return None
+
+    # --------------------------------------------------------------- stats
+    def stats(self) -> Dict[str, Any]:
+        """Telemetry payload: loop stall vs background write seconds."""
+        out: Dict[str, Any] = {
+            "async": self.async_save,
+            "saves": self.saves,
+            "last_stall_s": round(self.last_stall_s, 6),
+            "total_stall_s": round(self.total_stall_s, 6),
+        }
+        if self.writer is not None:
+            w = self.writer.stats()
+            out["last_write_s"] = w["last_write_s"]
+            out["total_write_s"] = w["total_write_s"]
+        else:
+            out["last_write_s"] = round(self.last_stall_s, 6)
+            out["total_write_s"] = round(self._sync_write_s, 6)
+        return out
+
+    # --------------------------------------------------------------- close
+    def close(self) -> None:
+        """End-of-run barrier: the last async write must be fully on disk
+        before the run reports success; signal handlers are restored."""
+        if self.writer is not None:
+            self.writer.wait()
+        self.preemption.uninstall()

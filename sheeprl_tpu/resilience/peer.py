@@ -1,0 +1,78 @@
+"""Peer-death detection for the decoupled player/trainer topologies.
+
+The decoupled algorithms block on ``mp.Queue.get(timeout=600)`` at every
+protocol step. If the peer process dies (OOM kill, segfault, preemption of
+one container), the survivor used to sit the full ``_QUEUE_TIMEOUT_S`` and
+then crash with a bare ``queue.Empty`` — no checkpoint, no indication of
+*why*. :func:`queue_get_from_peer` polls the queue on a short interval and
+checks the peer's liveness between polls, so a dead peer surfaces within
+~a second as :class:`PeerDiedError`; callers react by writing a final
+checkpoint and raising a clear, actionable error.
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import queue as queue_mod
+import time
+from typing import Any, Callable, Optional
+
+# liveness poll cadence while waiting on the peer; short enough that a
+# dead peer is noticed promptly, long enough to stay off the profile
+_PEER_POLL_S = 0.5
+
+
+class PeerDiedError(RuntimeError):
+    """The decoupled peer process died while we were waiting on it."""
+
+    def __init__(self, who: str, detail: str = ""):
+        self.who = who
+        super().__init__(
+            f"decoupled {who} process died while a message was pending"
+            + (f" ({detail})" if detail else "")
+        )
+
+
+def parent_alive() -> bool:
+    """Liveness of the spawning (trainer) process, from inside a child."""
+    parent = mp.parent_process()
+    return parent is None or parent.is_alive()
+
+
+def child_alive(proc) -> Callable[[], bool]:
+    """Liveness predicate for a spawned child handle (exitcode detail is
+    read at raise time by the caller)."""
+    return proc.is_alive
+
+
+def queue_get_from_peer(
+    q,
+    *,
+    timeout: float,
+    peer_alive: Callable[[], bool],
+    who: str,
+    detail_fn: Optional[Callable[[], str]] = None,
+    poll_s: float = _PEER_POLL_S,
+) -> Any:
+    """``q.get`` with peer-liveness polling.
+
+    Raises :class:`PeerDiedError` as soon as the peer is observed dead
+    (after one final drain attempt — the peer may have sent its last
+    message before dying), and ``queue.Empty`` on a genuine timeout with a
+    live peer (protocol stall, not a death).
+    """
+    deadline = time.monotonic() + timeout
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise queue_mod.Empty
+        try:
+            return q.get(timeout=min(poll_s, remaining))
+        except queue_mod.Empty:
+            if not peer_alive():
+                # final drain: a message enqueued just before death is valid
+                try:
+                    return q.get_nowait()
+                except queue_mod.Empty:
+                    detail = detail_fn() if detail_fn else ""
+                    raise PeerDiedError(who, detail) from None

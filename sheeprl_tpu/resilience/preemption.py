@@ -1,0 +1,94 @@
+"""Preemption handling: turn SIGTERM/SIGINT into a clean checkpointed stop.
+
+TPU pods are preemptible: the scheduler sends SIGTERM and gives the job a
+short grace window. Without a handler the default disposition kills the
+process wherever it happens to be — up to ``checkpoint.every`` policy
+steps of work gone, and possibly a half-written checkpoint. The handler
+here converts the first signal into a *flag* the training loop checks once
+per iteration; the loop then forces an emergency checkpoint (full,
+resumable state at an iteration boundary) and exits cleanly.
+
+Decoupled topologies: the trainer (main process) installs the handler with
+``forward_to`` pointing at the spawned player, so a SIGTERM delivered only
+to the parent still reaches the process that owns the checkpoint files.
+The player installs its own handler inside ``_player_loop``.
+
+A second SIGINT restores the default disposition and re-raises
+``KeyboardInterrupt`` — a stuck emergency save must not make ctrl-C
+unusable.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+from typing import Any, List, Optional
+
+
+class PreemptionHandler:
+    """Signal → per-iteration flag, with child-process forwarding."""
+
+    SIGNALS = (signal.SIGTERM, signal.SIGINT)
+
+    def __init__(self, forward_to: Optional[List[Any]] = None):
+        self._flag = threading.Event()
+        self._prev: dict = {}
+        self._installed = False
+        self._sigint_count = 0
+        # multiprocessing.Process handles (or anything with .pid/.is_alive)
+        self._forward_to: List[Any] = list(forward_to or [])
+
+    # ----------------------------------------------------------- install
+    def install(self) -> "PreemptionHandler":
+        """Idempotent; no-op off the main thread (signal.signal would
+        raise) and when already installed."""
+        if self._installed or threading.current_thread() is not threading.main_thread():
+            return self
+        for sig in self.SIGNALS:
+            try:
+                self._prev[sig] = signal.signal(sig, self._on_signal)
+            except (ValueError, OSError):  # non-main interpreter contexts
+                pass
+        self._installed = bool(self._prev)
+        return self
+
+    def uninstall(self) -> None:
+        if not self._installed:
+            return
+        for sig, prev in self._prev.items():
+            try:
+                signal.signal(sig, prev)
+            except (ValueError, OSError):
+                pass
+        self._prev.clear()
+        self._installed = False
+
+    def add_child(self, proc: Any) -> None:
+        """Register a spawned child to forward the preemption signal to."""
+        self._forward_to.append(proc)
+
+    # ----------------------------------------------------------- signal
+    def _on_signal(self, signum, frame) -> None:
+        if signum == signal.SIGINT:
+            self._sigint_count += 1
+            if self._sigint_count > 1:
+                # user really means it: restore default and raise
+                signal.signal(signal.SIGINT, self._prev.get(signal.SIGINT, signal.SIG_DFL))
+                raise KeyboardInterrupt
+        self._flag.set()
+        for proc in self._forward_to:
+            try:
+                if proc.is_alive():
+                    os.kill(proc.pid, signal.SIGTERM)
+            except (OSError, AttributeError):
+                pass
+
+    # ----------------------------------------------------------- queries
+    @property
+    def preempted(self) -> bool:
+        return self._flag.is_set()
+
+    def set(self) -> None:
+        """Programmatic preemption (tests; cooperative shutdown)."""
+        self._flag.set()

@@ -75,19 +75,14 @@ class CheckpointCallback:
                 rb._open_episodes = state
 
     # ------------------------------------------------------------------ #
-    def save(
-        self,
-        runtime,
-        ckpt_path: Union[str, os.PathLike],
-        state: Dict[str, Any],
-    ) -> Optional[str]:
-        """Serialize ``state`` to ``ckpt_path`` on global rank zero."""
+    def snapshot(self, state: Dict[str, Any]) -> Dict[str, Any]:
+        """Fast in-loop snapshot: force buffer consistency, deep-copy the
+        replay buffers into plain numpy, ``jax.device_get`` the device
+        pytrees, then restore the live buffers. The returned host-side
+        pytree is fully decoupled from training state, so it can be
+        serialized on a background thread while the loop keeps stepping."""
         import jax
 
-        from sheeprl_tpu.utils.ckpt_format import save_state
-
-        if not runtime.is_global_zero:
-            return None
         restore = None
         rb = state.get("rb")
         if rb is not None:
@@ -101,13 +96,33 @@ class CheckpointCallback:
                     host_state[k] = self._materialize_rb(v)
                 else:
                     host_state[k] = jax.device_get(v)
-            path = Path(ckpt_path)
-            save_state(path, host_state)
         finally:
             self._restore_rb(restore)
+        return host_state
+
+    def write(self, ckpt_path: Union[str, os.PathLike], host_state: Dict[str, Any]) -> str:
+        """Serialize an already-snapshotted host state to disk (manifest
+        encoding + zip write — the slow half; safe off-thread) and apply the
+        keep-last retention policy."""
+        from sheeprl_tpu.utils.ckpt_format import save_state
+
+        path = Path(ckpt_path)
+        save_state(path, host_state)
         if self.keep_last:
             self._delete_old_checkpoints(path.parent)
         return str(path)
+
+    def save(
+        self,
+        runtime,
+        ckpt_path: Union[str, os.PathLike],
+        state: Dict[str, Any],
+    ) -> Optional[str]:
+        """Serialize ``state`` to ``ckpt_path`` on global rank zero
+        (synchronous snapshot + write)."""
+        if not runtime.is_global_zero:
+            return None
+        return self.write(ckpt_path, self.snapshot(state))
 
     @staticmethod
     def _materialize_rb(rb):
@@ -153,13 +168,41 @@ class CheckpointCallback:
         return rb
 
     def _delete_old_checkpoints(self, ckpt_folder: Path) -> None:
-        ckpts = sorted(ckpt_folder.glob("ckpt_*.ckpt"), key=os.path.getmtime)
-        if len(ckpts) > self.keep_last:
-            for c in ckpts[: -self.keep_last]:
-                try:
-                    os.unlink(c)
-                except OSError:
-                    pass
+        """Keep-last-N retention that can never delete the newest VALID
+        checkpoint: if every file in the kept window is corrupt (e.g. the
+        latest write raced a crash), the newest candidate that still
+        validates is spared even if it falls outside the window — a resume
+        must always have something to land on."""
+        try:
+            ckpts = sorted(ckpt_folder.glob("ckpt_*.ckpt"), key=os.path.getmtime)
+        except OSError:
+            return
+        if len(ckpts) <= self.keep_last:
+            return
+        kept, candidates = ckpts[-self.keep_last :], ckpts[: -self.keep_last]
+        spare = None
+        if not any(self._is_valid(c) for c in kept):
+            for c in reversed(candidates):
+                if self._is_valid(c):
+                    spare = c
+                    break
+        for c in candidates:
+            if c == spare:
+                continue
+            try:
+                os.unlink(c)
+            except OSError:
+                pass
+
+    @staticmethod
+    def _is_valid(path: Path) -> bool:
+        from sheeprl_tpu.utils.ckpt_format import CheckpointCorruptError, validate_checkpoint
+
+        try:
+            validate_checkpoint(path)
+            return True
+        except CheckpointCorruptError:
+            return False
 
 
 def load_checkpoint(
@@ -168,15 +211,27 @@ def load_checkpoint(
     """Load a checkpoint: the versioned leaf-manifest format, with a
     cloudpickle fallback for pre-v1 checkpoints (migration = resume once;
     the next save writes v1).  ``select`` limits a v1 load to the given
-    top-level keys without reading the other leaves off disk."""
-    from sheeprl_tpu.utils.ckpt_format import is_v1, load_state
+    top-level keys without reading the other leaves off disk.  A file that
+    is neither a readable v1 zip nor a loadable pickle raises
+    :class:`~sheeprl_tpu.utils.ckpt_format.CheckpointCorruptError`."""
+    from sheeprl_tpu.utils.ckpt_format import CheckpointCorruptError, is_v1, load_state
 
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"checkpoint not found: {path}")
     if is_v1(path):
         return load_state(path, select=select)
-    import cloudpickle
+    # is_v1 is False for BOTH pickles and truncated v1 zips: a file that
+    # still has the zip magic but a broken central directory must surface
+    # as corruption, not as a cryptic pickle error
+    try:
+        import cloudpickle
 
-    with open(path, "rb") as f:
-        state = cloudpickle.load(f)
+        with open(path, "rb") as f:
+            state = cloudpickle.load(f)
+    except Exception as e:
+        raise CheckpointCorruptError(
+            path, f"not a v1 checkpoint and pickle fallback failed ({type(e).__name__}: {e})"
+        ) from e
     if select is not None:
         # the pickle blob can't be partially read, but the returned shape
         # must match the v1 path

@@ -48,6 +48,18 @@ FORMAT_VERSION = "sheeprl_tpu_ckpt_v1"
 _PRIMITIVES = (bool, int, float, str)
 
 
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint file exists but cannot be read back: truncated zip,
+    unparseable manifest, missing leaves, or a pre-v1 pickle that fails to
+    deserialize. One exception type so callers (auto-resume, load paths)
+    can catch corruption without enumerating zipfile/json/pickle errors."""
+
+    def __init__(self, path: Union[str, os.PathLike], reason: str):
+        self.path = str(path)
+        self.reason = reason
+        super().__init__(f"corrupt checkpoint {self.path}: {reason}")
+
+
 def _encode(node: Any, leaves: list) -> Any:
     """Structure spec for ``node``; array leaves appended to ``leaves``."""
     if node is None:
@@ -131,19 +143,53 @@ def _decode(spec: Any, get_leaf) -> Any:
     raise ValueError(f"unknown node type {t!r} in checkpoint manifest")
 
 
+def _sweep_orphan_tmps(folder: Path, keep: Path) -> None:
+    """Remove ``*.ckpt.tmp`` leftovers from writers that died mid-write.
+    Only one writer ever targets a run's checkpoint dir (rank 0 / the
+    decoupled player), so any tmp that is not the one being written right
+    now is an orphan from a killed process — never a concurrent save."""
+    try:
+        for tmp in folder.glob("*.ckpt.tmp"):
+            if tmp != keep:
+                try:
+                    tmp.unlink()
+                except OSError:
+                    pass
+    except OSError:
+        pass
+
+
 def save_state(path: Union[str, os.PathLike], state: Any) -> str:
-    """Write ``state`` (host-side pytree) to ``path`` atomically."""
+    """Write ``state`` (host-side pytree) to ``path`` atomically (tmp file +
+    rename); orphaned tmps from previously killed writers are swept first."""
+    from sheeprl_tpu.resilience.faults import fault_point
+
     leaves: list = []
     tree = _encode(state, leaves)
     manifest = json.dumps({"version": FORMAT_VERSION, "tree": tree}).encode()
     path = Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
     tmp = path.with_suffix(path.suffix + ".tmp")
+    _sweep_orphan_tmps(path.parent, keep=tmp)
     arrays = {f"leaf_{i}": arr for i, arr in enumerate(leaves)}
     arrays["manifest"] = np.frombuffer(manifest, dtype=np.uint8)
     with open(tmp, "wb") as f:
         np.savez(f, **arrays)
+        # crash-consistency harness: simulate a writer killed mid-write
+        # (tmp half-written, never renamed) — SIGKILLs this process
+        if fault_point("ckpt_kill_mid_write"):
+            f.flush()
+            f.truncate(max(1, os.fstat(f.fileno()).st_size // 2))
+            import signal
+
+            os.kill(os.getpid(), signal.SIGKILL)
     os.replace(tmp, path)
+    # corruption harness: truncate the FINAL file after the atomic rename
+    # (models a torn block-device write surviving the rename)
+    if fault_point("ckpt_truncate"):
+        size = os.path.getsize(path)
+        with open(path, "r+b") as f:
+            f.truncate(max(1, size // 2))
     return str(path)
 
 
@@ -163,17 +209,75 @@ def load_state(
     path: Union[str, os.PathLike], select: Optional[Sequence[str]] = None
 ) -> Any:
     """Load a v1 checkpoint; ``select`` restricts to top-level dict keys
-    (unreferenced leaves are never read from disk)."""
-    with np.load(path, allow_pickle=False) as npz:
-        doc = json.loads(bytes(npz["manifest"]))
-        if doc.get("version") != FORMAT_VERSION:
-            raise ValueError(f"unknown checkpoint version {doc.get('version')!r}")
-        tree = doc["tree"]
-        if select is not None:
-            if tree["__t__"] != "dict":
-                raise ValueError("select= needs a dict-rooted checkpoint")
-            tree = {
-                "__t__": "dict",
-                "items": {k: v for k, v in tree["items"].items() if k in set(select)},
-            }
-        return _decode(tree, lambda i: npz[f"leaf_{i}"])
+    (unreferenced leaves are never read from disk). Truncated/corrupt files
+    raise :class:`CheckpointCorruptError` (not raw zipfile/json errors)."""
+    try:
+        with np.load(path, allow_pickle=False) as npz:
+            doc = json.loads(bytes(npz["manifest"]))
+            if doc.get("version") != FORMAT_VERSION:
+                raise ValueError(f"unknown checkpoint version {doc.get('version')!r}")
+            tree = doc["tree"]
+            if select is not None:
+                if tree["__t__"] != "dict":
+                    raise ValueError("select= needs a dict-rooted checkpoint")
+                tree = {
+                    "__t__": "dict",
+                    "items": {k: v for k, v in tree["items"].items() if k in set(select)},
+                }
+            return _decode(tree, lambda i: npz[f"leaf_{i}"])
+    except (zipfile.BadZipFile, EOFError, KeyError, json.JSONDecodeError) as e:
+        raise CheckpointCorruptError(path, f"{type(e).__name__}: {e}") from e
+
+
+def _count_leaves(spec: Any) -> int:
+    """Number of array-leaf references in a manifest tree spec."""
+    t = spec["__t__"]
+    if t == "leaf":
+        return 1
+    if t in ("namedtuple", "tuple", "list"):
+        return sum(_count_leaves(s) for s in spec["items"])
+    if t == "dict":
+        return sum(_count_leaves(s) for s in spec["items"].values())
+    return 0
+
+
+def validate_checkpoint(path: Union[str, os.PathLike]) -> Dict[str, Any]:
+    """Validate a v1 checkpoint WITHOUT materializing it: zip central
+    directory + per-member CRCs, manifest parses, and every leaf the
+    manifest references exists as a zip member. Raises
+    :class:`CheckpointCorruptError` on any failure; returns a small summary
+    dict on success. This is the gate auto-resume runs before trusting a
+    checkpoint found on disk."""
+    path = Path(path)
+    try:
+        if path.stat().st_size == 0:
+            raise CheckpointCorruptError(path, "empty file")
+    except OSError as e:
+        raise CheckpointCorruptError(path, f"unreadable: {e}") from e
+    try:
+        with zipfile.ZipFile(path) as z:
+            bad = z.testzip()  # CRC-checks every member — catches truncation
+            if bad is not None:
+                raise CheckpointCorruptError(path, f"CRC mismatch in member {bad!r}")
+            names = set(z.namelist())
+            if "manifest.npy" not in names:
+                raise CheckpointCorruptError(path, "no manifest (not a v1 checkpoint)")
+            with z.open("manifest.npy") as f:
+                manifest_arr = np.lib.format.read_array(f, allow_pickle=False)
+            doc = json.loads(bytes(manifest_arr))
+    except CheckpointCorruptError:
+        raise
+    except (zipfile.BadZipFile, EOFError, OSError, ValueError, json.JSONDecodeError) as e:
+        raise CheckpointCorruptError(path, f"{type(e).__name__}: {e}") from e
+    if doc.get("version") != FORMAT_VERSION:
+        raise CheckpointCorruptError(path, f"unknown version {doc.get('version')!r}")
+    n_leaves = _count_leaves(doc["tree"])
+    missing = [i for i in range(n_leaves) if f"leaf_{i}.npy" not in names]
+    if missing:
+        raise CheckpointCorruptError(
+            path, f"manifest references {n_leaves} leaves but members {missing[:5]} are absent"
+        )
+    top_keys = (
+        sorted(doc["tree"]["items"].keys()) if doc["tree"].get("__t__") == "dict" else []
+    )
+    return {"version": doc["version"], "n_leaves": n_leaves, "keys": top_keys}

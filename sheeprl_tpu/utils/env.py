@@ -29,6 +29,7 @@ from sheeprl_tpu.utils.imports import _IS_MOVIEPY_AVAILABLE
 from sheeprl_tpu.envs.wrappers import (
     ActionRepeat,
     ActionsAsObservationWrapper,
+    EnvStepGuard,
     FrameStack,
     GrayscaleRenderWrapper,
     MaskVelocityWrapper,
@@ -46,7 +47,7 @@ def make_env(
 ) -> Callable[[], gym.Env]:
     """Build a thunk that creates a fully-wrapped env with dict observations."""
 
-    def thunk() -> gym.Env:
+    def _build() -> gym.Env:
         try:
             env_spec = gym.spec(cfg.env.id).entry_point
         except Exception:
@@ -212,6 +213,20 @@ def make_env(
                     os.path.join(run_name, prefix + "_videos" if prefix else "videos"),
                     disable_logger=True,
                 )
+        return env
+
+    def thunk() -> gym.Env:
+        env = _build()
+        # env-step robustness (howto/resilience.md): one restart with
+        # backoff on a crashed step, episode marked truncated; runs
+        # per-env so Async vector workers guard themselves
+        if cfg.env.get("restart_on_crash", True):
+            env = EnvStepGuard(
+                env,
+                _build,
+                env_idx=vector_env_idx,
+                backoff_s=float(cfg.env.get("restart_backoff_s", 1.0)),
+            )
         return env
 
     return thunk

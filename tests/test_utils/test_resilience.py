@@ -1,0 +1,351 @@
+"""Unit tests for the resilience subsystem (ISSUE 2).
+
+Covers the pieces in isolation: async writer double buffering + error
+surfacing, checkpoint validation/corruption hardening, orphan-tmp sweep,
+keep-last retention safety, fault-injector spec parsing, auto-resume
+fallback, peer-death detection, preemption flag handling, and the
+env-step guard. Crash-consistency *end-to-end* (SIGKILL mid-write,
+SIGTERM emergency save) lives in ``test_resilience_e2e.py``.
+"""
+
+import os
+import queue as queue_mod
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.resilience import (
+    AsyncCheckpointWriter,
+    FaultInjector,
+    PeerDiedError,
+    PreemptionHandler,
+    find_latest_resumable,
+    queue_get_from_peer,
+)
+from sheeprl_tpu.resilience.faults import get_injector
+from sheeprl_tpu.utils.callback import CheckpointCallback, load_checkpoint
+from sheeprl_tpu.utils.ckpt_format import (
+    CheckpointCorruptError,
+    save_state,
+    validate_checkpoint,
+)
+
+STATE = {"agent": {"w": np.arange(12.0).reshape(3, 4)}, "iter_num": 7}
+
+
+# --------------------------------------------------------------------------- #
+# async writer
+# --------------------------------------------------------------------------- #
+def test_async_writer_overlap(tmp_path):
+    """A second submit while the first write is in flight blocks (at most
+    one in flight) and both checkpoints land, in submit order."""
+    order = []
+    gate = threading.Event()
+
+    def slow_write(path, state):
+        if not order:  # first write parks until the second submit is issued
+            gate.wait(timeout=10)
+        save_state(path, state)
+        order.append(os.path.basename(path))
+
+    w = AsyncCheckpointWriter(slow_write)
+    w.submit(str(tmp_path / "ckpt_1_0.ckpt"), STATE)
+    assert w.in_flight
+    t = threading.Thread(target=gate.set)
+    t.start()  # releases the first write only once submit#2 is blocking
+    w.submit(str(tmp_path / "ckpt_2_0.ckpt"), STATE)  # waits for #1
+    w.wait()
+    t.join()
+    assert order == ["ckpt_1_0.ckpt", "ckpt_2_0.ckpt"]
+    for p in order:
+        validate_checkpoint(tmp_path / p)
+    assert w.writes == 2
+    # the second submit had to absorb the first write's remaining time
+    assert w.total_wait_s > 0
+
+
+def test_async_writer_error_surfaces_on_next_call(tmp_path):
+    def broken_write(path, state):
+        raise OSError("disk full")
+
+    w = AsyncCheckpointWriter(broken_write)
+    w.submit(str(tmp_path / "ckpt_1_0.ckpt"), STATE)  # fails in background
+    with pytest.raises(RuntimeError, match="async checkpoint write failed"):
+        w.wait()
+    # the error is consumed: the writer stays usable afterwards
+    w.wait()
+
+
+# --------------------------------------------------------------------------- #
+# validation + corruption hardening
+# --------------------------------------------------------------------------- #
+def test_validate_checkpoint_ok(tmp_path):
+    p = tmp_path / "ckpt_10_0.ckpt"
+    save_state(p, STATE)
+    info = validate_checkpoint(p)
+    assert info["n_leaves"] == 1 and "agent" in info["keys"]
+
+
+@pytest.mark.parametrize("corruption", ["truncate", "garbage", "empty"])
+def test_corrupt_checkpoints_raise_one_error_type(tmp_path, corruption):
+    p = tmp_path / "ckpt_10_0.ckpt"
+    save_state(p, STATE)
+    if corruption == "truncate":
+        with open(p, "r+b") as f:
+            f.truncate(os.path.getsize(p) // 2)
+    elif corruption == "garbage":
+        p.write_bytes(b"PK\x03\x04 not actually a zip")
+    else:
+        p.write_bytes(b"")
+    with pytest.raises(CheckpointCorruptError):
+        validate_checkpoint(p)
+    with pytest.raises(CheckpointCorruptError):
+        load_checkpoint(p)
+
+
+def test_load_checkpoint_non_zip_raises_corrupt_error(tmp_path):
+    p = tmp_path / "ckpt_10_0.ckpt"
+    p.write_bytes(b"this is neither a zip nor a pickle")
+    with pytest.raises(CheckpointCorruptError):
+        load_checkpoint(p)
+
+
+# --------------------------------------------------------------------------- #
+# orphan tmp sweep + retention safety
+# --------------------------------------------------------------------------- #
+def test_save_state_sweeps_orphan_tmps(tmp_path):
+    orphan = tmp_path / "ckpt_5_0.ckpt.tmp"
+    orphan.write_bytes(b"half-written leftovers of a killed writer")
+    save_state(tmp_path / "ckpt_10_0.ckpt", STATE)
+    assert not orphan.exists()
+    assert not list(tmp_path.glob("*.tmp"))
+
+
+def test_retention_never_deletes_newest_valid(tmp_path):
+    """keep_last=1 with the kept (newest) file corrupt: the newest VALID
+    checkpoint outside the window must be spared."""
+    cb = CheckpointCallback(keep_last=1)
+    good = tmp_path / "ckpt_10_0.ckpt"
+    save_state(good, STATE)
+    time.sleep(0.01)
+    bad = tmp_path / "ckpt_20_0.ckpt"
+    save_state(bad, STATE)
+    with open(bad, "r+b") as f:  # the newest write raced a crash
+        f.truncate(10)
+    cb._delete_old_checkpoints(tmp_path)
+    assert good.exists(), "retention deleted the only valid checkpoint"
+    found = find_latest_resumable(str(tmp_path))
+    assert found == str(good)
+
+
+# --------------------------------------------------------------------------- #
+# fault injector
+# --------------------------------------------------------------------------- #
+def test_fault_injector_spec_parsing():
+    inj = FaultInjector("ckpt_truncate:3,queue_delay:1:2.5")
+    assert not inj.fire("ckpt_truncate")
+    assert not inj.fire("ckpt_truncate")
+    assert inj.fire("ckpt_truncate")  # 3rd hit
+    assert not inj.fire("ckpt_truncate")  # one-shot
+    assert inj.fire("queue_delay")
+    assert inj.arg("queue_delay") == 2.5
+    assert not inj.fire("env_step_raise")  # unarmed site never fires
+
+
+def test_fault_injector_unknown_site():
+    with pytest.raises(ValueError, match="unknown fault site"):
+        FaultInjector("rm_rf_slash")
+
+
+def test_injector_rebuilds_on_env_change(monkeypatch):
+    monkeypatch.setenv("SHEEPRL_FAULTS", "ckpt_truncate")
+    assert get_injector().armed
+    monkeypatch.setenv("SHEEPRL_FAULTS", "")
+    assert not get_injector().armed
+
+
+def test_ckpt_truncate_fault_produces_detectable_corruption(tmp_path, monkeypatch):
+    """The torn-write fault site yields exactly what auto-resume must
+    survive: a renamed-but-corrupt newest checkpoint."""
+    first = tmp_path / "ckpt_10_0.ckpt"
+    save_state(first, STATE)
+    time.sleep(0.01)
+    monkeypatch.setenv("SHEEPRL_FAULTS", "ckpt_truncate")
+    torn = tmp_path / "ckpt_20_0.ckpt"
+    save_state(torn, STATE)
+    assert torn.exists()
+    with pytest.raises(CheckpointCorruptError):
+        validate_checkpoint(torn)
+    # newest is torn -> auto-resume falls back to the previous one
+    with pytest.warns(UserWarning, match="skipping corrupt checkpoint"):
+        found = find_latest_resumable(str(tmp_path))
+    assert found == str(first)
+    # ...and the fallback restores the saved state bit-exact
+    restored = load_checkpoint(found)
+    np.testing.assert_array_equal(restored["agent"]["w"], STATE["agent"]["w"])
+    assert restored["iter_num"] == STATE["iter_num"]
+
+
+# --------------------------------------------------------------------------- #
+# peer-death detection
+# --------------------------------------------------------------------------- #
+def test_queue_get_peer_death_is_fast():
+    q = queue_mod.Queue()
+    t0 = time.monotonic()
+    with pytest.raises(PeerDiedError, match="player process died"):
+        queue_get_from_peer(
+            q, timeout=600.0, peer_alive=lambda: False, who="player", poll_s=0.05
+        )
+    assert time.monotonic() - t0 < 5.0, "dead peer took ~_QUEUE_TIMEOUT_S to surface"
+
+
+def test_queue_get_final_drain_after_death():
+    """A message enqueued just before the peer died must still be
+    delivered, not masked by PeerDiedError."""
+    q = queue_mod.Queue()
+    alive = {"v": True}
+
+    def flaky_alive():
+        # peer observed dead on the first liveness check, but its last
+        # message is already in the queue by then
+        if alive["v"]:
+            alive["v"] = False
+            q.put(("data", 123))
+        return False
+
+    assert queue_get_from_peer(
+        q, timeout=600.0, peer_alive=flaky_alive, who="trainer", poll_s=0.01
+    ) == ("data", 123)
+
+
+def test_queue_get_live_peer_times_out():
+    q = queue_mod.Queue()
+    with pytest.raises(queue_mod.Empty):
+        queue_get_from_peer(q, timeout=0.2, peer_alive=lambda: True, who="player", poll_s=0.05)
+
+
+# --------------------------------------------------------------------------- #
+# preemption handler
+# --------------------------------------------------------------------------- #
+def test_preemption_handler_sigterm_sets_flag():
+    h = PreemptionHandler().install()
+    try:
+        assert not h.preempted
+        os.kill(os.getpid(), signal.SIGTERM)
+        # signal delivery is synchronous for a same-process kill on the
+        # main thread, but poll briefly to be safe
+        for _ in range(100):
+            if h.preempted:
+                break
+            time.sleep(0.01)
+        assert h.preempted
+    finally:
+        h.uninstall()
+    # the previous disposition is restored
+    assert signal.getsignal(signal.SIGTERM) != h._on_signal
+
+
+def test_preemption_forces_checkpoint(tmp_path):
+    """A pending preemption flag forces should_checkpoint regardless of
+    cadence, and the forced save is a normal, resumable checkpoint."""
+    from sheeprl_tpu.resilience import CheckpointManager
+
+    class _Runtime:
+        is_global_zero = True
+        global_rank = 0
+
+    class _Cfg:
+        class checkpoint:
+            every = 10_000
+            save_last = False
+            keep_last = None
+
+            @staticmethod
+            def get(key, default=None):
+                return {"async_save": False}.get(key, default)
+
+    mgr = CheckpointManager(_Runtime(), _Cfg(), str(tmp_path))
+    try:
+        assert not mgr.should_checkpoint(policy_step=5, is_last=False)
+        mgr.preemption.set()
+        assert mgr.should_checkpoint(policy_step=5, is_last=False)
+        path = mgr.maybe_checkpoint(policy_step=5, is_last=False, state_fn=lambda: dict(STATE))
+        assert path is not None
+        validate_checkpoint(path)
+        restored = load_checkpoint(path)
+        assert restored["iter_num"] == STATE["iter_num"]
+    finally:
+        mgr.close()
+
+
+# --------------------------------------------------------------------------- #
+# env-step guard
+# --------------------------------------------------------------------------- #
+import gymnasium as gym
+
+
+class _CrashyEnv(gym.Env):
+    observation_space = gym.spaces.Box(-1, 1, (2,), dtype=np.float32)
+    action_space = gym.spaces.Discrete(2)
+    crash_at = None  # class-level: survives the guard's rebuild
+
+    def __init__(self):
+        self.t = 0
+
+    def reset(self, *, seed=None, options=None):
+        self.t = 0
+        return np.zeros(2, dtype=np.float32), {}
+
+    def step(self, action):
+        self.t += 1
+        if _CrashyEnv.crash_at is not None and self.t >= _CrashyEnv.crash_at:
+            raise ValueError("simulated env crash")
+        return np.full(2, self.t, dtype=np.float32), 1.0, False, False, {}
+
+    def close(self):
+        pass
+
+
+@pytest.fixture()
+def crashy_guard():
+    from sheeprl_tpu.envs.wrappers import EnvStepGuard
+
+    _CrashyEnv.crash_at = None
+    yield EnvStepGuard(_CrashyEnv(), _CrashyEnv, env_idx=3, backoff_s=0.01)
+    _CrashyEnv.crash_at = None
+
+
+def test_env_guard_restart_truncates(crashy_guard):
+    env = crashy_guard
+    env.reset()
+    last_obs = env.step(0)[0]
+    _CrashyEnv.crash_at = 2
+    obs, reward, terminated, truncated, info = env.step(1)
+    assert truncated and not terminated
+    assert info["env_restarted"] and "ValueError" in info["env_restart_error"]
+    np.testing.assert_array_equal(obs, last_obs)  # episode ends at last good obs
+    # recovered env steps normally and clears the double-fault window
+    _CrashyEnv.crash_at = None
+    env.reset()
+    assert not env.step(0)[3]
+
+
+def test_env_guard_double_fault_raises_with_context(crashy_guard):
+    env = crashy_guard
+    env.reset()
+    _CrashyEnv.crash_at = 1  # every step of the rebuilt env crashes too
+    env.step(0)  # first fault -> restart
+    env.reset()
+    with pytest.raises(RuntimeError, match=r"env 3 .*double fault.*last action: 1"):
+        env.step(1)
+
+
+def test_env_guard_fault_injection_site(crashy_guard, monkeypatch):
+    monkeypatch.setenv("SHEEPRL_FAULTS", "env_step_raise")
+    env = crashy_guard
+    env.reset()
+    obs, reward, terminated, truncated, info = env.step(0)
+    assert truncated and info["env_restarted"]
