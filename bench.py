@@ -92,7 +92,7 @@ TPU_V5E_BF16_PEAK_FLOPS = 197e12
 # (section, conservative wall-clock estimate used for skip decisions);
 # ppo/sac cover four CLI runs each (cold + 2 cached-warm + long); dec runs
 # four protocols (coupled/decoupled x ppo/sac) on the TPU-backed learner
-SECTIONS = [("dv3", 60), ("loop", 60), ("ppo", 50), ("sac", 60), ("a2c", 50), ("dec", 170)]
+SECTIONS = [("dv3", 60), ("loop", 60), ("ppo", 100), ("sac", 60), ("a2c", 100), ("dec", 260)]
 
 
 def _note(**kw):
@@ -159,6 +159,17 @@ def bench_ppo():
     rate, t_cold, t_warm, t_long = _cli_steady_rate(
         ["exp=ppo_benchmarks", "root_dir=/tmp/sheeprl_tpu_bench/ppo"], n_warm, n_long
     )
+    # paired A/B: same protocol with the collect/train overlap pipeline on
+    # (ISSUE 3) — the ratio is the overlap's steady-state win on this host
+    rate_ov, *_ = _cli_steady_rate(
+        [
+            "exp=ppo_benchmarks",
+            "algo.overlap_collect=True",
+            "root_dir=/tmp/sheeprl_tpu_bench/ppo_ov",
+        ],
+        n_warm,
+        n_long,
+    )
     value = round(rate * FULL_STEPS, 2)
     return {
         "metric": "ppo_cartpole_benchmark_wallclock",
@@ -167,6 +178,13 @@ def bench_ppo():
         "vs_baseline": round(REFERENCE_PPO_SECONDS / value, 3),
         "method": f"steady-state {n_long - n_warm} steps x {rate * 1e3:.3f} ms/step -> 65536",
         "measured_s": [round(t_cold, 2), round(t_warm, 2), round(t_long, 2)],
+        "overlap_ms_per_step": round(rate_ov * 1e3, 3),
+        "serial_ms_per_step": round(rate * 1e3, 3),
+        "overlap_speedup": round(rate / rate_ov, 3),
+        # the overlap needs host cores for the collector thread to run ON
+        # — on a 1-core host it degenerates to time-slicing + handoff
+        # overhead and CANNOT beat serial (same caveat as bench_dec)
+        "host_cpu_count": os.cpu_count(),
     }
 
 
@@ -179,6 +197,16 @@ def bench_a2c():
     rate, t_cold, t_warm, t_long = _cli_steady_rate(
         ["exp=a2c_benchmarks", "root_dir=/tmp/sheeprl_tpu_bench/a2c"], n_warm, n_long
     )
+    # paired A/B: overlap pipeline on (ISSUE 3)
+    rate_ov, *_ = _cli_steady_rate(
+        [
+            "exp=a2c_benchmarks",
+            "algo.overlap_collect=True",
+            "root_dir=/tmp/sheeprl_tpu_bench/a2c_ov",
+        ],
+        n_warm,
+        n_long,
+    )
     value = round(rate * FULL_STEPS, 2)
     return {
         "metric": "a2c_cartpole_benchmark_wallclock",
@@ -187,6 +215,10 @@ def bench_a2c():
         "vs_baseline": round(REFERENCE_A2C_SECONDS / value, 3),
         "method": f"steady-state {n_long - n_warm} steps x {rate * 1e3:.3f} ms/step -> 65536",
         "measured_s": [round(t_cold, 2), round(t_warm, 2), round(t_long, 2)],
+        "overlap_ms_per_step": round(rate_ov * 1e3, 3),
+        "serial_ms_per_step": round(rate * 1e3, 3),
+        "overlap_speedup": round(rate / rate_ov, 3),
+        "host_cpu_count": os.cpu_count(),
     }
 
 
@@ -295,7 +327,22 @@ def bench_dec():
             "coupled_ms_per_step": round(r_c * 1e3, 3),
             "decoupled_ms_per_step": round(r_d * 1e3, 3),
             "decoupled_speedup": round(r_c / r_d, 3),
+            "transport": os.environ.get("SHEEPRL_DECOUPLED_TRANSPORT", "shm"),
         }
+        if algo == "ppo":
+            # transport A/B (ISSUE 3): the same decoupled pair over the
+            # legacy pickled-queue path quantifies the shm ring's win
+            os.environ["SHEEPRL_DECOUPLED_TRANSPORT"] = "queue"
+            try:
+                r_q, *_ = _cli_steady_rate(
+                    base + [f"algo.name={algo}_decoupled", "run_name=decoupled_q"],
+                    n_warm,
+                    n_long,
+                )
+            finally:
+                os.environ.pop("SHEEPRL_DECOUPLED_TRANSPORT", None)
+            results[algo]["queue_ms_per_step"] = round(r_q * 1e3, 3)
+            results[algo]["shm_over_queue_speedup"] = round(r_q / r_d, 3)
         # durability: the dec section is the longest — persist after each
         # completed protocol pair so a timeout can't lose finished work
         if _CHILD_OUT_PATH:

@@ -1,0 +1,125 @@
+"""Rollout-transport A/B: pickled mp.Queue vs the SharedMemory ring.
+
+Round-trips a synthetic rollout payload parent->child->ack across a
+spawned process at several payload sizes and reports µs/message for both
+transports plus the shm speedup.  This isolates exactly what
+``algo.decoupled_transport`` changes — the per-iteration shipping cost —
+from everything else the decoupled topology does (env stepping, train
+dispatch, scheduling), so the numbers hold on any host, including 1-core
+containers where end-to-end decoupled-vs-coupled is core-bound.
+
+    python benchmarks/bench_shm_transport.py [--out results/shm_transport.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import multiprocessing as mp
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from sheeprl_tpu.parallel.shm_ring import ShmReceiver, ShmSender  # noqa: E402
+
+
+def _payload(nbytes: int):
+    """Rollout-shaped payload: one big obs block + small per-step arrays."""
+    rows = max(nbytes // (4 * 68), 1)
+    rng = np.random.default_rng(0)
+    return [
+        ("obs", rng.normal(size=(rows, 64)).astype(np.float32)),
+        ("actions", rng.integers(0, 3, size=(rows, 2)).astype(np.float32)),
+        ("rewards", rng.normal(size=(rows, 1)).astype(np.float32)),
+        ("dones", rng.integers(0, 2, size=(rows, 1)).astype(np.uint8)),
+    ]
+
+
+def _consumer(mode, data_q, ack_q, free_q, n_msgs):
+    rx = ShmReceiver(free_q)
+    try:
+        for _ in range(n_msgs):
+            msg = data_q.get()
+            if msg[0] == "shm":
+                _, info, slot, leaves = msg
+                views = rx.unpack(info, slot, leaves, copy=False)
+                s = float(views["rewards"][0, 0])  # touch the data
+                del views
+                rx.release(slot)
+            else:
+                _, payload = msg
+                s = float(payload["rewards"][0, 0])
+            ack_q.put(s)
+    finally:
+        rx.close()
+
+
+def _run_mode(mode: str, payload, n_msgs: int) -> float:
+    """Seconds per message for one transport mode."""
+    ctx = mp.get_context("spawn")
+    data_q, ack_q, free_q = ctx.Queue(), ctx.Queue(), ctx.Queue()
+    proc = ctx.Process(target=_consumer, args=(mode, data_q, ack_q, free_q, n_msgs))
+    proc.start()
+    tx = ShmSender(free_q, min_bytes=0) if mode == "shm" else None
+    try:
+        # warm both directions (spawn + first-attach costs stay out of the rate)
+        t0 = None
+        for i in range(n_msgs):
+            if i == n_msgs // 10 + 1:
+                t0 = time.perf_counter()
+                sent_at = i
+            if mode == "shm":
+                sent = tx.send(
+                    data_q.put, "shm", payload, (), acquire_slot=lambda: free_q.get(timeout=30)
+                )
+                assert sent
+            else:
+                data_q.put(("pickle", {k: v for k, v in payload}))
+            ack_q.get(timeout=30)
+        elapsed = time.perf_counter() - t0
+        return elapsed / (n_msgs - sent_at)
+    finally:
+        if tx is not None:
+            tx.close()
+        proc.join(timeout=30)
+        if proc.is_alive():
+            proc.terminate()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--msgs", type=int, default=200)
+    args = ap.parse_args()
+
+    results = {"host_cpu_count": os.cpu_count(), "sizes": []}
+    for size_mb in (0.015, 0.25, 1, 4, 16):
+        nbytes = int(size_mb * (1 << 20))
+        payload = _payload(nbytes)
+        actual = sum(int(a.nbytes) for _, a in payload)
+        n = max(min(args.msgs, int(64e6 / max(actual, 1))), 20)
+        t_q = _run_mode("queue", payload, n)
+        t_s = _run_mode("shm", payload, n)
+        row = {
+            "payload_mb": round(actual / (1 << 20), 3),
+            "queue_us_per_msg": round(t_q * 1e6, 1),
+            "shm_us_per_msg": round(t_s * 1e6, 1),
+            "shm_speedup": round(t_q / t_s, 3),
+            "msgs": n,
+        }
+        results["sizes"].append(row)
+        print(json.dumps(row), flush=True)
+
+    if args.out:
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(results, f, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -44,10 +44,21 @@ def parse_eval_output(eval_txt: str):
     older checkpoints' evals only have the per-episode Test-Reward lines."""
     rewards = re.findall(r"Test - Reward: ([-\d.]+)", eval_txt)
     protocols = re.findall(r"Eval protocol: (\{.*\})", eval_txt)
-    return (
-        float(rewards[-1]) if rewards else None,
-        json.loads(protocols[-1]) if protocols else None,
-    )
+    protocol = None
+    if protocols:
+        try:
+            protocol = json.loads(protocols[-1])
+        except (json.JSONDecodeError, ValueError):
+            # a truncated/garbled protocol line (killed eval, interleaved
+            # writes) must not crash the whole finalize — fall back to the
+            # legacy Test-Reward path with a visible warning
+            print(
+                "WARNING: 'Eval protocol:' line is not valid JSON (truncated "
+                "eval output?); falling back to the legacy 'Test - Reward:' "
+                "number only.",
+                file=sys.stderr,
+            )
+    return (float(rewards[-1]) if rewards else None, protocol)
 
 
 def main() -> int:

@@ -24,6 +24,7 @@ from sheeprl_tpu.algos.ppo.utils import normalize_obs, prepare_obs, test
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.obs import setup_observability, trace_scope
+from sheeprl_tpu.parallel.pipeline import OnPolicyCollector, PipelinedCollector, detach_copy
 from sheeprl_tpu.resilience import CheckpointManager
 from sheeprl_tpu.utils.callback import load_checkpoint
 from sheeprl_tpu.utils.env import make_env
@@ -31,7 +32,14 @@ from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import device_get_metrics, gae, normalize_tensor, polynomial_decay, save_configs
+from sheeprl_tpu.utils.utils import (
+    MetricFetchGate,
+    device_get_metrics,
+    gae,
+    normalize_tensor,
+    polynomial_decay,
+    save_configs,
+)
 from sheeprl_tpu.optim import restore_opt_states
 from sheeprl_tpu.utils.jax_compat import shard_map
 
@@ -227,71 +235,68 @@ def main(runtime, cfg: Dict[str, Any]):
     lr0 = float(cfg.algo.optimizer.get("learning_rate", 1e-3))
     current_lr = lr0
 
-    step_data: Dict[str, np.ndarray] = {}
-    next_obs_np = envs.reset(seed=cfg.seed)[0]
+    # collect/train pipeline: overlap_collect=True steps iteration t+1's
+    # envs on a background thread while iteration t trains (params
+    # staleness <= 1); False keeps the serial pre-pipeline order bit-exact
+    overlap = bool(cfg.algo.get("overlap_collect", False))
+    if overlap:
+        # the player's device_put is a no-op on a same-device tree, so its
+        # initial weights alias the buffers update 1 donates — detach them
+        # before the collector thread starts acting on them
+        player.params = detach_copy(params)
+    collector = OnPolicyCollector(
+        envs=envs,
+        player=player,
+        rb=rb,
+        cfg=cfg,
+        runtime=runtime,
+        obs_keys=obs_keys,
+        total_envs=total_envs,
+        world_size=world_size,
+        aggregator=aggregator,
+        policy_step=policy_step,
+    )
 
-    for iter_num in range(start_iter, total_iters + 1):
-        observability.on_iteration(policy_step)
-        for _ in range(cfg.algo.rollout_steps):
-            policy_step += cfg.env.num_envs * world_size
-            with timer("Time/env_interaction_time", SumMetric, sync_on_compute=False):
-                flat_actions, real_actions, logprobs, values = player.get_actions(
-                    next_obs_np, runtime.next_key()
-                )
-                obs, rewards, terminated, truncated, info = envs.step(
-                    np.asarray(real_actions).reshape(envs.action_space.shape)
-                )
-                truncated_envs = np.nonzero(truncated)[0]
-                if len(truncated_envs) > 0:
-                    real_next_obs = {k: np.array(v) for k, v in obs.items()}
-                    for env_idx in truncated_envs:
-                        final = info["final_obs"][env_idx]
-                        for k in obs_keys:
-                            real_next_obs[k][env_idx] = final[k]
-                    vals = np.asarray(player.get_values(real_next_obs))
-                    rewards[truncated_envs] += cfg.algo.gamma * vals[truncated_envs].reshape(
-                        rewards[truncated_envs].shape
-                    )
-                dones = np.logical_or(terminated, truncated).reshape(total_envs, 1).astype(np.uint8)
-                rewards = rewards.reshape(total_envs, 1).astype(np.float32)
-
-            for k in obs_keys:
-                step_data[k] = next_obs_np[k][np.newaxis]
-            step_data["dones"] = dones[np.newaxis]
-            step_data["values"] = np.asarray(values)[np.newaxis]
-            step_data["actions"] = np.asarray(flat_actions)[np.newaxis]
-            step_data["logprobs"] = np.asarray(logprobs)[np.newaxis]
-            step_data["rewards"] = rewards[np.newaxis]
-            rb.add(step_data, validate_args=cfg.buffer.validate_args)
-            next_obs_np = obs
-
-            if cfg.metric.log_level > 0 and "final_info" in info:
-                ep = info["final_info"].get("episode")
-                if ep is not None:
-                    for i in np.nonzero(info["final_info"]["_episode"])[0]:
-                        if aggregator and "Rewards/rew_avg" in aggregator:
-                            aggregator.update("Rewards/rew_avg", float(ep["r"][i]))
-                        if aggregator and "Game/ep_len_avg" in aggregator:
-                            aggregator.update("Game/ep_len_avg", float(ep["l"][i]))
-                        runtime.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={float(ep['r'][i])}")
-
-        local_data = rb.to_arrays()
-        local_data = {k: v.astype(jnp.float32) for k, v in local_data.items()}
-        # env-axis sharding: each mesh device receives only its columns
+    def _pack(payload):
+        # env-axis sharding: each mesh device receives only its columns; on
+        # the overlapped path this runs on the collector thread, so the
+        # host->device upload of rollout t+1 overlaps train step t
+        local_data = {k: v.astype(jnp.float32) for k, v in payload.data.items()}
+        # np.array (copy), not asarray: SyncVectorEnv mutates its obs
+        # buffer in place and CPU device_put zero-copy aliases host memory
+        host_next_obs = {k: np.array(payload.next_obs[k]) for k in obs_keys}
+        # the upload sources must outlive the update that reads them —
+        # device_put's zero-copy alias does not keep them alive itself
+        payload.host_refs.append((local_data, host_next_obs))
         with trace_scope("host_to_device"):
-            local_data = runtime.shard_batch(local_data, axis=1)
-            device_next_obs = runtime.shard_batch(
-                {k: np.asarray(next_obs_np[k]) for k in obs_keys}, axis=0
-            )
+            payload.data = runtime.shard_batch(local_data, axis=1)
+            payload.next_obs = runtime.shard_batch(host_next_obs, axis=0)
+
+    pipeline = PipelinedCollector(
+        runtime,
+        collector.collect,
+        _pack,
+        start_iter=start_iter,
+        total_iters=total_iters,
+        overlap=overlap,
+        seed=cfg.seed,
+        adopt_params_fn=lambda p: setattr(player, "params", p),
+    )
+    metric_fetch_gate = MetricFetchGate(cfg.metric.get("fetch_every", 1))
+
+    for iter_num, payload in pipeline:
+        observability.on_iteration(policy_step)
+        payload.apply_events(aggregator, runtime, cfg.metric.log_level)
+        policy_step = payload.policy_step_end
 
         with timer("Time/train_time", SumMetric, sync_on_compute=cfg.metric.sync_on_compute):
             params, opt_state, train_metrics = update_fn(
-                params, opt_state, local_data, device_next_obs, runtime.next_key(), jnp.float32(current_lr)
+                params, opt_state, payload.data, payload.next_obs, runtime.next_key(), jnp.float32(current_lr)
             )
-        player.params = params
+        pipeline.publish(iter_num, params)
         train_step += world_size
 
-        if aggregator and not aggregator.disabled:
+        if aggregator and not aggregator.disabled and metric_fetch_gate():
             with trace_scope("block_until_ready"):
                 fetched_metrics = device_get_metrics(train_metrics)
             for k, v in fetched_metrics.items():
@@ -351,6 +356,8 @@ def main(runtime, cfg: Dict[str, Any]):
             runtime.print(f"Preemption signal: emergency checkpoint written, stopping at iter {iter_num}")
             break
 
+    pipeline.close()  # before envs.close(): the collector may be mid-step
+    player.params = params  # the test episode runs on the final weights
     ckpt_mgr.close()
     envs.close()
     observability.close()

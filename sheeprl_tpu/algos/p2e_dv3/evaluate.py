@@ -51,7 +51,11 @@ def evaluate_p2e_dv3(runtime, cfg: Dict[str, Any], state: Dict[str, Any]):
         state.get("critics_exploration"),
     )
     player = make_player(runtime, world_model, actor, params, actions_dim, 1, cfg, "task")
-    protocol = run_eval_protocol(partial(test, player, runtime, cfg, log_dir), runtime, cfg)
+    # DV3-family: headline the sampled-action median (see
+    # dreamer_v3/evaluate.py — greedy can score ~0 on sparse tasks)
+    protocol = run_eval_protocol(
+        partial(test, player, runtime, cfg, log_dir), runtime, cfg, headline_mode="sampled"
+    )
     if logger:
-        logger.log_metrics({"Test/cumulative_reward": protocol["greedy"]["median"]}, 0)
+        logger.log_metrics({"Test/cumulative_reward": protocol["sampled"]["median"]}, 0)
         logger.finalize()

@@ -41,6 +41,7 @@ from sheeprl_tpu.algos.ppo.utils import prepare_obs, test
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.obs import setup_observability, trace_scope
+from sheeprl_tpu.parallel.shm_ring import ShmReceiver, ShmSender, decoupled_transport_setting
 from sheeprl_tpu.resilience import (
     CheckpointManager,
     PeerDiedError,
@@ -58,7 +59,12 @@ from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.optim import restore_opt_states
-from sheeprl_tpu.utils.utils import device_get_metrics, polynomial_decay, save_configs
+from sheeprl_tpu.utils.utils import (
+    device_get_metrics,
+    polynomial_decay,
+    save_configs,
+    start_async_host_copy,
+)
 
 # generous IPC timeout: the first trainer reply waits on a fresh XLA
 # compile of the full update (~20-40s on TPU)
@@ -70,7 +76,22 @@ def _np_tree(tree: Any) -> Any:
     return jax.tree_util.tree_map(np.asarray, jax.device_get(tree))
 
 
-def _player_loop(cfg, data_q: mp.Queue, resp_q: mp.Queue, state_counters, world_size: int) -> None:
+def _flat_leaves(tree: Any):
+    """Ordered ``(name, ndarray)`` pairs for shm shipping; the receiver
+    rebuilds with its OWN treedef (both processes build the same agent)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    return [(str(i), np.asarray(leaf)) for i, leaf in enumerate(leaves)]
+
+
+def _unflat_leaves(treedef, payload: Dict[str, np.ndarray]) -> Any:
+    """Inverse of :func:`_flat_leaves` (payload preserves pack order)."""
+    return jax.tree_util.tree_unflatten(treedef, list(payload.values()))
+
+
+def _player_loop(
+    cfg, data_q: mp.Queue, resp_q: mp.Queue, data_free_q: mp.Queue, resp_free_q: mp.Queue,
+    state_counters, world_size: int,
+) -> None:
     """Player process body (reference ppo_decoupled.py:32-365).
 
     Runs on the host CPU backend (the parent exports JAX_PLATFORMS=cpu
@@ -156,6 +177,14 @@ def _player_loop(cfg, data_q: mp.Queue, resp_q: mp.Queue, state_counters, world_
         device=host_cpu,
     )
 
+    # zero-copy transport: rollouts go out through a SharedMemory ring
+    # (control queue carries metadata only) and params refreshes come back
+    # through the trainer's ring; "queue" keeps the legacy pickled path
+    use_shm = decoupled_transport_setting(cfg) == "shm"
+    rollout_tx = ShmSender(data_free_q) if use_shm else None
+    params_rx = ShmReceiver(resp_free_q) if use_shm else None
+    params_treedef = jax.tree_util.tree_structure(params)
+
     save_configs(cfg, log_dir)
 
     aggregator = None
@@ -229,6 +258,9 @@ def _player_loop(cfg, data_q: mp.Queue, resp_q: mp.Queue, state_counters, world_
                 flat_actions, real_actions, logprobs, values = player.get_actions(
                     next_obs_np, runtime.next_key()
                 )
+                # only the action array is awaited before the env step; the
+                # other fetches ride under the env's wall-clock
+                start_async_host_copy(flat_actions, logprobs, values)
                 real_actions_np = np.asarray(real_actions)
                 obs, rewards, terminated, truncated, info = envs.step(
                     real_actions_np.reshape(envs.action_space.shape)
@@ -277,16 +309,38 @@ def _player_loop(cfg, data_q: mp.Queue, resp_q: mp.Queue, state_counters, world_
         need_ckpt = ckpt_mgr.should_checkpoint(policy_step, is_last=iter_num == total_iters)
         local_data = {k: np.asarray(v) for k, v in rb.to_arrays().items()}
         final_obs = {k: np.asarray(next_obs_np[k]) for k in obs_keys}
-        maybe_drop_or_delay_send(data_q.put, ("data", local_data, final_obs, need_ckpt))
+        sent = False
+        if rollout_tx is not None:
+            arrays = [(f"d/{k}", v) for k, v in local_data.items()] + [
+                (f"o/{k}", v) for k, v in final_obs.items()
+            ]
+            sent = rollout_tx.send(
+                lambda m: maybe_drop_or_delay_send(data_q.put, m),
+                "data_shm",
+                arrays,
+                (need_ckpt,),
+                acquire_slot=lambda: queue_get_from_peer(
+                    data_free_q, timeout=_QUEUE_TIMEOUT_S, peer_alive=parent_alive, who="trainer"
+                ),
+            )
+        if not sent:
+            maybe_drop_or_delay_send(data_q.put, ("data", local_data, final_obs, need_ckpt))
 
         # --------------------------------------------- refreshed weights back
         # named span: in a profiler trace this wait IS the decoupled
         # topology's comms/train stall as seen from the player
         with trace_scope("ipc_wait_update"):
-            tag, new_params, train_metrics, opt_state_np, info_scalars = _trainer_reply(
-                policy_step, iter_num
+            reply = _trainer_reply(policy_step, iter_num)
+        if reply[0] == "update_shm":
+            _, arena_info, slot, leaves_meta, train_metrics, opt_state_np, info_scalars = reply
+            # copy=True: the player keeps these weights past the slot release
+            new_params = _unflat_leaves(
+                params_treedef, params_rx.unpack(arena_info, slot, leaves_meta, copy=True)
             )
-        assert tag == "update", f"expected update, got {tag}"
+            params_rx.release(slot)
+        else:
+            tag, new_params, train_metrics, opt_state_np, info_scalars = reply
+            assert tag == "update", f"expected update, got {tag}"
         # hand the numpy tree straight to the setter: jnp.asarray here would
         # place the fresh params on the DEFAULT backend (the tunnel-attached
         # chip) and the setter's transfer to the host-CPU player would then
@@ -365,6 +419,10 @@ def _player_loop(cfg, data_q: mp.Queue, resp_q: mp.Queue, state_counters, world_
 
     # shutdown sentinel (reference scatters -1, :344)
     data_q.put(("stop",))
+    if rollout_tx is not None:
+        rollout_tx.close()
+    if params_rx is not None:
+        params_rx.close()
     ckpt_mgr.close()
     envs.close()
     observability.close()
@@ -417,11 +475,17 @@ def main(runtime, cfg: Dict[str, Any]):
     ctx = mp.get_context("spawn")
     data_q: mp.Queue = ctx.Queue()
     resp_q: mp.Queue = ctx.Queue()
+    # free-slot queues for the shm rings (queues must be created before the
+    # spawn — they cannot ride another queue); unused on transport=queue
+    data_free_q: mp.Queue = ctx.Queue()
+    resp_free_q: mp.Queue = ctx.Queue()
     saved_platform = os.environ.get("JAX_PLATFORMS")
     os.environ["JAX_PLATFORMS"] = "cpu"
     try:
         player_proc = ctx.Process(
-            target=_player_loop, args=(cfg, data_q, resp_q, counters, runtime.world_size), daemon=False
+            target=_player_loop,
+            args=(cfg, data_q, resp_q, data_free_q, resp_free_q, counters, runtime.world_size),
+            daemon=False,
         )
         player_proc.start()
     finally:
@@ -501,7 +565,12 @@ def main(runtime, cfg: Dict[str, Any]):
 
         trainer_mon = RecompileMonitor(name="ppo_decoupled_trainer").install()
 
-        # initial weights to the player (reference broadcast, :126)
+        use_shm = decoupled_transport_setting(cfg) == "shm"
+        rollout_rx = ShmReceiver(data_free_q) if use_shm else None
+        params_tx = ShmSender(resp_free_q) if use_shm else None
+
+        # initial weights to the player (reference broadcast, :126; one-off
+        # message — the pickled path is fine before the ring exists)
         resp_q.put(("params", _np_tree(params)))
 
         policy_steps_per_iter = int(cfg.env.num_envs * cfg.algo.rollout_steps)
@@ -520,13 +589,25 @@ def main(runtime, cfg: Dict[str, Any]):
                 msg = _player_msg("rollout")
             if msg[0] == "stop":
                 break
-            _, local_data, final_obs, need_ckpt = msg
+            if msg[0] == "data_shm":
+                _, arena_info, slot, leaves_meta, need_ckpt = msg
+                views = rollout_rx.unpack(arena_info, slot, leaves_meta, copy=False)
+                local_data = {k[2:]: v for k, v in views.items() if k.startswith("d/")}
+                final_obs = {k[2:]: np.array(v) for k, v in views.items() if k.startswith("o/")}
+                del views  # the conversion below replaces the slot views
+            else:
+                _, local_data, final_obs, need_ckpt = msg
+                slot = None
             iter_num += 1
 
+            # the astype/copy below materializes private arrays, so a shm
+            # slot can be handed back right after (views die with it)
             local_data = {
-                k: v.astype(np.float32) if v.dtype not in (np.uint8,) else v
+                k: v.astype(np.float32) if v.dtype not in (np.uint8,) else np.array(v)
                 for k, v in local_data.items()
             }
+            if msg[0] == "data_shm":
+                rollout_rx.release(slot)
             # env-axis sharding feeds each mesh device only its columns
             # (the shard_map update path consumes this layout); the
             # decoupled rollout's env axis is num_envs itself, so an
@@ -577,16 +658,26 @@ def main(runtime, cfg: Dict[str, Any]):
                     max_decay_steps=total_iters, power=1.0,
                 )
 
-            maybe_drop_or_delay_send(
-                resp_q.put,
-                (
-                    "update",
-                    _np_tree(params),
-                    train_metrics,
-                    _np_tree(opt_state) if need_ckpt else None,
-                    info_scalars,
-                ),
-            )
+            opt_np = _np_tree(opt_state) if need_ckpt else None
+            sent = False
+            if params_tx is not None:
+                sent = params_tx.send(
+                    lambda m: maybe_drop_or_delay_send(resp_q.put, m),
+                    "update_shm",
+                    _flat_leaves(_np_tree(params)),
+                    (train_metrics, opt_np, info_scalars),
+                    acquire_slot=lambda: queue_get_from_peer(
+                        resp_free_q,
+                        timeout=_QUEUE_TIMEOUT_S,
+                        peer_alive=child_alive(player_proc),
+                        who="player",
+                    ),
+                )
+            if not sent:
+                maybe_drop_or_delay_send(
+                    resp_q.put,
+                    ("update", _np_tree(params), train_metrics, opt_np, info_scalars),
+                )
             hard_exit_point("trainer_exit")  # fault site: trainer crash after replying
 
         trainer_mon.uninstall()
@@ -595,6 +686,12 @@ def main(runtime, cfg: Dict[str, Any]):
         player_proc.join(timeout=3600.0)
     finally:
         preemption.uninstall()
+        try:
+            if use_shm:
+                rollout_rx.close()
+                params_tx.close()
+        except NameError:  # death before the endpoints were created
+            pass
         if player_proc.is_alive():
             player_proc.terminate()
             player_proc.join()

@@ -38,6 +38,7 @@ from sheeprl_tpu.algos.ppo_recurrent.utils import prepare_obs, test
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.obs import setup_observability, trace_scope
+from sheeprl_tpu.parallel.pipeline import OnPolicyCollector, PipelinedCollector, RolloutPayload, detach_copy
 from sheeprl_tpu.resilience import CheckpointManager
 from sheeprl_tpu.utils.callback import load_checkpoint
 from sheeprl_tpu.utils.env import make_env
@@ -45,7 +46,16 @@ from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import device_get_metrics, gae, normalize_tensor, polynomial_decay, print_config, save_configs
+from sheeprl_tpu.utils.utils import (
+    MetricFetchGate,
+    device_get_metrics,
+    gae,
+    normalize_tensor,
+    polynomial_decay,
+    print_config,
+    save_configs,
+    start_async_host_copy,
+)
 from sheeprl_tpu.optim import restore_opt_states
 from sheeprl_tpu.utils.jax_compat import shard_map
 
@@ -191,6 +201,116 @@ def make_update_fn(runtime, module, tx, cfg: Dict[str, Any], obs_keys: Sequence[
     return runtime.setup_step(update, donate_argnums=(0, 1))
 
 
+class RecurrentCollector(OnPolicyCollector):
+    """Rollout stepper for the recurrent player: captures the pre-action
+    LSTM state + previous actions per step, resets recurrent state on
+    done, and values the final observation for the GAE bootstrap."""
+
+    def collect(self, iter_num: int, inline: bool, key_fn) -> RolloutPayload:
+        import time as _time
+
+        cfg = self.cfg
+        payload = RolloutPayload(iter_num)
+        step_data = self._step_data
+        next_obs_np = self.next_obs
+        for _ in range(cfg.algo.rollout_steps):
+            self.policy_step += cfg.env.num_envs * self.world_size
+
+            # state BEFORE acting — what the policy is conditioned on
+            prev_hx = np.asarray(self.player.hx)
+            prev_cx = np.asarray(self.player.cx)
+            prev_actions_np = np.asarray(self.player.prev_actions).reshape(self.total_envs, -1)
+
+            cm = (
+                timer("Time/env_interaction_time", SumMetric, sync_on_compute=False)
+                if inline
+                else None
+            )
+            t0 = None
+            if cm is not None:
+                cm.__enter__()
+            else:
+                t0 = _time.perf_counter()
+            try:
+                flat_actions, real_actions, logprobs, values = self.player.get_actions(
+                    next_obs_np, key_fn()
+                )
+                start_async_host_copy(flat_actions, logprobs, values)
+                real_actions_np = np.asarray(real_actions)
+                obs, rewards, terminated, truncated, info = self.envs.step(
+                    real_actions_np.reshape(self.envs.action_space.shape)
+                )
+                truncated_envs = np.nonzero(truncated)[0]
+                if len(truncated_envs) > 0:
+                    real_next_obs = {k: np.array(v) for k, v in obs.items()}
+                    for env_idx in truncated_envs:
+                        final = info["final_obs"][env_idx]
+                        for k in self.obs_keys:
+                            real_next_obs[k][env_idx] = final[k]
+                    vals = np.asarray(self.player.get_values(real_next_obs)).reshape(
+                        self.total_envs, -1
+                    )
+                    rewards[truncated_envs] += cfg.algo.gamma * vals[truncated_envs].reshape(
+                        rewards[truncated_envs].shape
+                    )
+                dones = (
+                    np.logical_or(terminated, truncated)
+                    .reshape(self.total_envs, 1)
+                    .astype(np.uint8)
+                )
+                rewards = self.clip_rewards_fn(rewards).reshape(self.total_envs, 1).astype(np.float32)
+            finally:
+                if cm is not None:
+                    cm.__exit__(None, None, None)
+                else:
+                    payload.env_seconds += _time.perf_counter() - t0
+
+            for k in self.obs_keys:
+                step_data[k] = next_obs_np[k][np.newaxis]
+            step_data["dones"] = dones[np.newaxis]
+            step_data["values"] = np.asarray(values).reshape(1, self.total_envs, -1)
+            step_data["actions"] = np.asarray(flat_actions).reshape(1, self.total_envs, -1)
+            step_data["logprobs"] = np.asarray(logprobs).reshape(1, self.total_envs, -1)
+            step_data["rewards"] = rewards[np.newaxis]
+            step_data["prev_hx"] = prev_hx[np.newaxis]
+            step_data["prev_cx"] = prev_cx[np.newaxis]
+            step_data["prev_actions"] = prev_actions_np[np.newaxis]
+            self.rb.add(step_data, validate_args=cfg.buffer.validate_args)
+
+            next_obs_np = obs
+            if cfg.algo.reset_recurrent_state_on_done and dones.any():
+                self.player.reset_states(dones)
+
+            if cfg.metric.log_level > 0 and "final_info" in info:
+                ep = info["final_info"].get("episode")
+                if ep is not None:
+                    mask = info["final_info"]["_episode"]
+                    for i in np.nonzero(mask)[0]:
+                        ep_rew = float(ep["r"][i])
+                        ep_len = float(ep["l"][i])
+                        if inline:
+                            if self.aggregator and "Rewards/rew_avg" in self.aggregator:
+                                self.aggregator.update("Rewards/rew_avg", ep_rew)
+                            if self.aggregator and "Game/ep_len_avg" in self.aggregator:
+                                self.aggregator.update("Game/ep_len_avg", ep_len)
+                            self.runtime.print(
+                                f"Rank-0: policy_step={self.policy_step}, reward_env_{i}={ep_rew}"
+                            )
+                        else:
+                            payload.events.append((self.policy_step, int(i), ep_rew, ep_len))
+
+        self.next_obs = next_obs_np
+        payload.data = self.rb.to_arrays()
+        payload.next_obs = next_obs_np
+        # host round-trip: the player may live on the CPU backend while the
+        # update runs under the accelerator mesh
+        payload.extras["next_values"] = np.asarray(self.player.get_values(next_obs_np)).reshape(
+            self.total_envs, -1
+        )
+        payload.policy_step_end = self.policy_step
+        return payload
+
+
 @register_algorithm()
 def main(runtime, cfg: Dict[str, Any]):
     if "minedojo" in str(cfg.env.wrapper.get("_target_", "")).lower():
@@ -322,99 +442,79 @@ def main(runtime, cfg: Dict[str, Any]):
     current_ent = float(cfg.algo.ent_coef)
 
     # ------------------------------------------------------------- run
-    step_data: Dict[str, np.ndarray] = {}
-    next_obs_np = envs.reset(seed=cfg.seed)[0]
+    # collect/train pipeline: overlap_collect=True steps iteration t+1's
+    # envs on a background thread while iteration t trains (params
+    # staleness <= 1); False keeps the serial pre-pipeline order bit-exact
+    overlap = bool(cfg.algo.get("overlap_collect", False))
+    if overlap:
+        # the player's device_put is a no-op on a same-device tree, so its
+        # initial weights alias the buffers update 1 donates — detach them
+        # before the collector thread starts acting on them
+        player.params = detach_copy(params)
+    collector = RecurrentCollector(
+        envs=envs,
+        player=player,
+        rb=rb,
+        cfg=cfg,
+        runtime=runtime,
+        obs_keys=obs_keys,
+        total_envs=total_envs,
+        world_size=world_size,
+        aggregator=aggregator,
+        clip_rewards_fn=clip_rewards_fn,
+        policy_step=policy_step,
+    )
     player.init_states()
 
-    for iter_num in range(start_iter, total_iters + 1):
-        observability.on_iteration(policy_step)
-        for _ in range(cfg.algo.rollout_steps):
-            policy_step += cfg.env.num_envs * world_size
-
-            # state BEFORE acting — what the policy is conditioned on
-            prev_hx = np.asarray(player.hx)
-            prev_cx = np.asarray(player.cx)
-            prev_actions_np = np.asarray(player.prev_actions).reshape(total_envs, -1)
-
-            with timer("Time/env_interaction_time", SumMetric, sync_on_compute=False):
-                flat_actions, real_actions, logprobs, values = player.get_actions(
-                    next_obs_np, runtime.next_key()
-                )
-                real_actions_np = np.asarray(real_actions)
-                obs, rewards, terminated, truncated, info = envs.step(
-                    real_actions_np.reshape(envs.action_space.shape)
-                )
-                truncated_envs = np.nonzero(truncated)[0]
-                if len(truncated_envs) > 0:
-                    real_next_obs = {k: np.array(v) for k, v in obs.items()}
-                    for env_idx in truncated_envs:
-                        final = info["final_obs"][env_idx]
-                        for k in obs_keys:
-                            real_next_obs[k][env_idx] = final[k]
-                    vals = np.asarray(player.get_values(real_next_obs)).reshape(total_envs, -1)
-                    rewards[truncated_envs] += cfg.algo.gamma * vals[truncated_envs].reshape(
-                        rewards[truncated_envs].shape
-                    )
-                dones = np.logical_or(terminated, truncated).reshape(total_envs, 1).astype(np.uint8)
-                rewards = clip_rewards_fn(rewards).reshape(total_envs, 1).astype(np.float32)
-
-            for k in obs_keys:
-                step_data[k] = next_obs_np[k][np.newaxis]
-            step_data["dones"] = dones[np.newaxis]
-            step_data["values"] = np.asarray(values).reshape(1, total_envs, -1)
-            step_data["actions"] = np.asarray(flat_actions).reshape(1, total_envs, -1)
-            step_data["logprobs"] = np.asarray(logprobs).reshape(1, total_envs, -1)
-            step_data["rewards"] = rewards[np.newaxis]
-            step_data["prev_hx"] = prev_hx[np.newaxis]
-            step_data["prev_cx"] = prev_cx[np.newaxis]
-            step_data["prev_actions"] = prev_actions_np[np.newaxis]
-            rb.add(step_data, validate_args=cfg.buffer.validate_args)
-
-            next_obs_np = obs
-            if cfg.algo.reset_recurrent_state_on_done and dones.any():
-                player.reset_states(dones)
-
-            if cfg.metric.log_level > 0 and "final_info" in info:
-                ep = info["final_info"].get("episode")
-                if ep is not None:
-                    mask = info["final_info"]["_episode"]
-                    for i in np.nonzero(mask)[0]:
-                        ep_rew = float(ep["r"][i])
-                        ep_len = float(ep["l"][i])
-                        if aggregator and "Rewards/rew_avg" in aggregator:
-                            aggregator.update("Rewards/rew_avg", ep_rew)
-                        if aggregator and "Game/ep_len_avg" in aggregator:
-                            aggregator.update("Game/ep_len_avg", ep_len)
-                        runtime.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}")
-
-        # ------------------------------------------------- device update
-        local_data = rb.to_arrays()
+    def _pack(payload):
+        # env-axis sharding: each mesh device receives only its columns; on
+        # the overlapped path this runs on the collector thread, so the
+        # host->device upload of rollout t+1 overlaps train step t
         local_data = {
-            k: v.astype(jnp.float32) if v.dtype not in (jnp.uint8,) else v for k, v in local_data.items()
+            k: v.astype(jnp.float32) if v.dtype not in (jnp.uint8,) else np.array(v)
+            for k, v in payload.data.items()
         }
-        # env-axis sharding: each mesh device receives only its columns
-        local_data = runtime.shard_batch(local_data, axis=1)
-        # host round-trip: the player may live on the CPU backend while the
-        # update runs under the accelerator mesh
-        next_values = runtime.shard_batch(
-            np.asarray(player.get_values(next_obs_np)).reshape(total_envs, -1), axis=0
-        )
+        host_next_values = payload.extras["next_values"]
+        # the upload sources must outlive the update that reads them —
+        # CPU device_put zero-copy aliases aligned host buffers without
+        # keeping them alive
+        payload.host_refs.append((local_data, host_next_values))
+        with trace_scope("host_to_device"):
+            payload.data = runtime.shard_batch(local_data, axis=1)
+            payload.extras["next_values"] = runtime.shard_batch(host_next_values, axis=0)
+
+    pipeline = PipelinedCollector(
+        runtime,
+        collector.collect,
+        _pack,
+        start_iter=start_iter,
+        total_iters=total_iters,
+        overlap=overlap,
+        seed=cfg.seed,
+        adopt_params_fn=lambda p: setattr(player, "params", p),
+    )
+    metric_fetch_gate = MetricFetchGate(cfg.metric.get("fetch_every", 1))
+
+    for iter_num, payload in pipeline:
+        observability.on_iteration(policy_step)
+        payload.apply_events(aggregator, runtime, cfg.metric.log_level)
+        policy_step = payload.policy_step_end
 
         with timer("Time/train_time", SumMetric, sync_on_compute=cfg.metric.sync_on_compute):
             params, opt_state, train_metrics = update_fn(
                 params,
                 opt_state,
-                local_data,
-                next_values,
+                payload.data,
+                payload.extras["next_values"],
                 runtime.next_key(),
                 jnp.float32(current_clip),
                 jnp.float32(current_ent),
                 jnp.float32(current_lr),
             )
-        player.params = params
+        pipeline.publish(iter_num, params)
         train_step += world_size
 
-        if aggregator and not aggregator.disabled:
+        if aggregator and not aggregator.disabled and metric_fetch_gate():
             with trace_scope("block_until_ready"):
                 fetched_metrics = device_get_metrics(train_metrics)
             for k, v in fetched_metrics.items():
@@ -479,6 +579,8 @@ def main(runtime, cfg: Dict[str, Any]):
             runtime.print(f"Preemption signal: emergency checkpoint written, stopping at iter {iter_num}")
             break
 
+    pipeline.close()  # before envs.close(): the collector may be mid-step
+    player.params = params  # the test episode runs on the final weights
     ckpt_mgr.close()
     envs.close()
     observability.close()

@@ -28,13 +28,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from sheeprl_tpu.algos.ppo.ppo_decoupled import _QUEUE_TIMEOUT_S, _np_tree
+from sheeprl_tpu.algos.ppo.ppo_decoupled import _QUEUE_TIMEOUT_S, _flat_leaves, _np_tree, _unflat_leaves
 from sheeprl_tpu.algos.sac.agent import SACPlayer, build_agent
 from sheeprl_tpu.algos.sac.sac import _make_optimizer, make_train_fn
 from sheeprl_tpu.algos.sac.utils import prepare_obs, test
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.obs import setup_observability, trace_scope
+from sheeprl_tpu.parallel.shm_ring import ShmReceiver, ShmSender, decoupled_transport_setting
 from sheeprl_tpu.resilience import (
     CheckpointManager,
     PeerDiedError,
@@ -56,7 +57,8 @@ from sheeprl_tpu.optim import restore_opt_states
 
 
 def _player_loop(
-    cfg, data_q: mp.Queue, resp_q: mp.Queue, state_counters, ratio_state, world_size: int
+    cfg, data_q: mp.Queue, resp_q: mp.Queue, data_free_q: mp.Queue, resp_free_q: mp.Queue,
+    state_counters, ratio_state, world_size: int,
 ) -> None:
     """Player process body (reference sac_decoupled.py:33-353)."""
     import gymnasium as gym
@@ -127,6 +129,14 @@ def _player_loop(
         lambda obs: prepare_obs(obs, mlp_keys=mlp_keys, num_envs=total_envs),
         device=host_cpu,
     )
+
+    # zero-copy transport: sampled batches go out through a SharedMemory
+    # ring (control queue carries metadata only) and actor refreshes come
+    # back through the trainer's ring; "queue" keeps the legacy pickled path
+    use_shm = decoupled_transport_setting(cfg) == "shm"
+    sample_tx = ShmSender(data_free_q) if use_shm else None
+    params_rx = ShmReceiver(resp_free_q) if use_shm else None
+    actor_treedef = jax.tree_util.tree_structure(params["actor"])
 
     save_configs(cfg, log_dir)
 
@@ -266,13 +276,37 @@ def _player_loop(
                     sample_next_obs=cfg.buffer.sample_next_obs,
                 )
                 sample = {k: np.asarray(v) for k, v in sample.items()}
-                maybe_drop_or_delay_send(data_q.put, ("data", sample, g, iter_num))
+                sent = False
+                if sample_tx is not None:
+                    sent = sample_tx.send(
+                        lambda m: maybe_drop_or_delay_send(data_q.put, m),
+                        "data_shm",
+                        list(sample.items()),
+                        (g, iter_num),
+                        acquire_slot=lambda: queue_get_from_peer(
+                            data_free_q,
+                            timeout=_QUEUE_TIMEOUT_S,
+                            peer_alive=parent_alive,
+                            who="trainer",
+                        ),
+                    )
+                if not sent:
+                    maybe_drop_or_delay_send(data_q.put, ("data", sample, g, iter_num))
 
                 # named span: the player stalling on the trainer (IPC +
                 # train dispatch) — the decoupled topology's comms cost
                 with trace_scope("ipc_wait_update"):
-                    tag, actor_params, train_metrics = _trainer_reply(policy_step, iter_num)
-                assert tag == "update", f"expected update, got {tag}"
+                    reply = _trainer_reply(policy_step, iter_num)
+                if reply[0] == "update_shm":
+                    _, arena_info, slot, leaves_meta, train_metrics = reply
+                    # copy=True: the player keeps the weights past the release
+                    actor_params = _unflat_leaves(
+                        actor_treedef, params_rx.unpack(arena_info, slot, leaves_meta, copy=True)
+                    )
+                    params_rx.release(slot)
+                else:
+                    tag, actor_params, train_metrics = reply
+                    assert tag == "update", f"expected update, got {tag}"
                 # numpy straight to the setter — see ppo_decoupled: jnp.asarray
                 # would stage the params on the tunnel backend first
                 player.params = actor_params
@@ -360,6 +394,10 @@ def _player_loop(
 
     # shutdown sentinel (reference scatters -1, sac_decoupled.py:328)
     data_q.put(("stop",))
+    if sample_tx is not None:
+        sample_tx.close()
+    if params_rx is not None:
+        params_rx.close()
     ckpt_mgr.close()
     envs.close()
     observability.close()
@@ -399,12 +437,16 @@ def main(runtime, cfg: Dict[str, Any]):
     ctx = mp.get_context("spawn")
     data_q: mp.Queue = ctx.Queue()
     resp_q: mp.Queue = ctx.Queue()
+    # free-slot queues for the shm rings (queues must be created before the
+    # spawn — they cannot ride another queue); unused on transport=queue
+    data_free_q: mp.Queue = ctx.Queue()
+    resp_free_q: mp.Queue = ctx.Queue()
     saved_platform = os.environ.get("JAX_PLATFORMS")
     os.environ["JAX_PLATFORMS"] = "cpu"
     try:
         player_proc = ctx.Process(
             target=_player_loop,
-            args=(cfg, data_q, resp_q, counters, ratio_state, runtime.world_size),
+            args=(cfg, data_q, resp_q, data_free_q, resp_free_q, counters, ratio_state, runtime.world_size),
             daemon=False,
         )
         player_proc.start()
@@ -487,6 +529,10 @@ def main(runtime, cfg: Dict[str, Any]):
 
         trainer_mon = RecompileMonitor(name="sac_decoupled_trainer").install()
 
+        use_shm = decoupled_transport_setting(cfg) == "shm"
+        sample_rx = ShmReceiver(data_free_q) if use_shm else None
+        params_tx = ShmSender(resp_free_q) if use_shm else None
+
         resp_q.put(("params", _np_tree(params["actor"])))
 
         while True:
@@ -500,14 +546,24 @@ def main(runtime, cfg: Dict[str, Any]):
                     ("ckpt_state", {"agent": _np_tree(params), "opt_states": _np_tree(opt_states)}),
                 )
                 continue
-            _, sample, g, iter_num = msg
+            if msg[0] == "data_shm":
+                _, arena_info, slot, leaves_meta, g, iter_num = msg
+                sample = sample_rx.unpack(arena_info, slot, leaves_meta, copy=False)
+            else:
+                _, sample, g, iter_num = msg
+                slot = None
 
+            # np.array (not asarray): materialize private rows so a shm slot
+            # can be handed back right after (views die with the copy)
             data = {
-                k: np.asarray(v, dtype=np.float32).reshape(
+                k: np.array(v, dtype=np.float32).reshape(
                     g, cfg.algo.per_rank_batch_size * runtime.world_size, *v.shape[2:]
                 )
                 for k, v in sample.items()
             }
+            if msg[0] == "data_shm":
+                del sample
+                sample_rx.release(slot)
             # shard the batch axis over the mesh so each device trains on
             # its own rows (GSPMD inserts the grad psums)
             data = runtime.shard_batch(data, axis=1)
@@ -528,9 +584,24 @@ def main(runtime, cfg: Dict[str, Any]):
             train_metrics["trainer_compiles"] = trainer_mon.compiles
             trainer_mon.mark_warmup_complete()  # first update done: further compiles are retraces
 
-            maybe_drop_or_delay_send(
-                resp_q.put, ("update", _np_tree(params["actor"]), train_metrics)
-            )
+            sent = False
+            if params_tx is not None:
+                sent = params_tx.send(
+                    lambda m: maybe_drop_or_delay_send(resp_q.put, m),
+                    "update_shm",
+                    _flat_leaves(_np_tree(params["actor"])),
+                    (train_metrics,),
+                    acquire_slot=lambda: queue_get_from_peer(
+                        resp_free_q,
+                        timeout=_QUEUE_TIMEOUT_S,
+                        peer_alive=child_alive(player_proc),
+                        who="player",
+                    ),
+                )
+            if not sent:
+                maybe_drop_or_delay_send(
+                    resp_q.put, ("update", _np_tree(params["actor"]), train_metrics)
+                )
             hard_exit_point("trainer_exit")  # fault site: trainer crash after replying
 
         trainer_mon.uninstall()
@@ -539,6 +610,12 @@ def main(runtime, cfg: Dict[str, Any]):
         player_proc.join(timeout=3600.0)
     finally:
         preemption.uninstall()
+        try:
+            if use_shm:
+                sample_rx.close()
+                params_tx.close()
+        except NameError:  # death before the endpoints were created
+            pass
         if player_proc.is_alive():
             player_proc.terminate()
             player_proc.join()

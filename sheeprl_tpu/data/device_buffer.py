@@ -96,14 +96,19 @@ def _append(bufs, row, pos, mask, *, n_envs):
 
 
 @functools.partial(jax.jit, donate_argnums=(0,), static_argnames=("n_envs",))
-def _append_window(bufs, block, pos, mask, *, n_envs):
+def _append_window(bufs, block, pos, mask, valid, *, n_envs):
     """Write T consecutive rows per env starting at its ring position.
 
     bufs: {k: (cap, n_envs, *feat)}; block: {k: (T, n_envs, *feat)};
-    pos (n_envs,) i32 write heads; mask (n_envs,) bool.  One dispatch for
-    the whole window: the per-row path costs one jit dispatch + H2D per
-    env step, which on a remote link dominates an off-policy algo's
-    steady state once training itself is dispatch-batched.
+    pos (n_envs,) i32 write heads; mask (n_envs,) bool; valid (T,) bool —
+    rows with ``valid[t]`` False are padding and leave the ring untouched.
+    One dispatch for the whole window: the per-row path costs one jit
+    dispatch + H2D per env step, which on a remote link dominates an
+    off-policy algo's steady state once training itself is
+    dispatch-batched.  Callers pad every window to a FIXED length with the
+    tail masked off (see :meth:`DeviceReplayCache.add`), so only one or
+    two window shapes ever trace — per-length retraces used to recompile
+    this kernel for every distinct flush length (ADVICE r5).
     """
     t_len = next(iter(block.values())).shape[0]
     cap = next(iter(bufs.values())).shape[0]
@@ -111,10 +116,11 @@ def _append_window(bufs, block, pos, mask, *, n_envs):
 
     def body(t, bufs):
         p = (pos + t) % cap
+        row_mask = jnp.logical_and(mask, valid[t])
         out = {}
         for k, buf in bufs.items():
             cur = buf[p, envs]
-            m = mask.reshape((n_envs,) + (1,) * (cur.ndim - 1))
+            m = row_mask.reshape((n_envs,) + (1,) * (cur.ndim - 1))
             row = jax.lax.dynamic_index_in_dim(block[k], t, 0, keepdims=False)
             out[k] = buf.at[p, envs].set(jnp.where(m, row.astype(buf.dtype), cur))
         return out
@@ -305,6 +311,11 @@ class DeviceReplayCache:
         self._bufs: Optional[Dict[str, jax.Array]] = None
         self._pos = np.zeros(n_envs, dtype=np.int32)
         self._filled = np.zeros(n_envs, dtype=np.int32)
+        # fixed dispatch length for windowed appends: the first windowed
+        # add sets it and every later window is padded (masked tail) or
+        # grows it, so _append_window traces at most one or two shapes
+        # instead of one per distinct flush length
+        self._window_pad: Optional[int] = None
         self.active = True  # flips False if the first row busts the budget
 
     # ------------------------------------------------------------- admin
@@ -466,17 +477,29 @@ class DeviceReplayCache:
                 self._bufs, row, jnp.asarray(self._pos), jnp.asarray(mask_np), n_envs=self.n_envs
             )
         else:
+            # pad to the fixed dispatch length (masked tail) so a short
+            # final flush reuses the steady-state trace instead of
+            # recompiling _append_window for its one-off length
+            if self._window_pad is None or t_len > self._window_pad:
+                self._window_pad = t_len
+            pad = self._window_pad
             block = {}
             for k, v in data.items():
-                full = np.zeros((t_len, self.n_envs, *v.shape[2:]), dtype=v.dtype)
-                full[:, idx] = v
+                full = np.zeros((pad, self.n_envs, *v.shape[2:]), dtype=v.dtype)
+                full[:t_len, idx] = v
                 block[k] = full
             block = self._place_block(block)
+            valid = np.arange(pad) < t_len
             # truncated windows start where sequential adds would have put
             # the first SURVIVING row: pos advanced by the dropped prefix
             start = (self._pos + (advance - t_len)) % self.capacity
             self._bufs = _append_window(
-                self._bufs, block, jnp.asarray(start), jnp.asarray(mask_np), n_envs=self.n_envs
+                self._bufs,
+                block,
+                jnp.asarray(start),
+                jnp.asarray(mask_np),
+                jnp.asarray(valid),
+                n_envs=self.n_envs,
             )
         self._pos[idx] = (self._pos[idx] + advance) % self.capacity
         self._filled[idx] = np.minimum(self._filled[idx] + advance, self.capacity)

@@ -1,3 +1,29 @@
 from sheeprl_tpu.parallel.mesh import MeshRuntime
+from sheeprl_tpu.parallel.pipeline import (
+    KeyStream,
+    OnPolicyCollector,
+    PipelinedCollector,
+    RolloutPayload,
+    credit_timer,
+    detach_copy,
+)
+from sheeprl_tpu.parallel.shm_ring import (
+    ShmArena,
+    ShmReceiver,
+    ShmSender,
+    decoupled_transport_setting,
+)
 
-__all__ = ["MeshRuntime"]
+__all__ = [
+    "MeshRuntime",
+    "KeyStream",
+    "OnPolicyCollector",
+    "PipelinedCollector",
+    "RolloutPayload",
+    "credit_timer",
+    "detach_copy",
+    "ShmArena",
+    "ShmReceiver",
+    "ShmSender",
+    "decoupled_transport_setting",
+]

@@ -260,6 +260,11 @@ class MeshRuntime:
         if self._key is None:
             self.seed_everything(0)
         data = self._np_key_rng.integers(0, 2**32, size=(num, 2), dtype=np.uint32)
+        # retain the buffer until the NEXT draw: keys are usually passed as
+        # call-expression temporaries, and CPU device_put may zero-copy
+        # alias the numpy memory — freeing it before the async consumer
+        # executes lets the allocator recycle it mid-computation
+        self._live_key = data
         # returned as UNCOMMITTED numpy key data: jit places it with the
         # computation (replicated over the mesh for train steps, pinned by
         # the player's device_put for the env hot loop)

@@ -1,0 +1,260 @@
+"""Zero-copy rollout transport for the decoupled topologies.
+
+The decoupled PPO/SAC pairs originally shipped every rollout (and every
+params refresh) as a pickled ``multiprocessing.Queue`` payload: pickle
+serializes each array into the pipe, the OS copies the bytes through a
+socketpair, and the receiver deserializes into fresh allocations — three
+copies plus a feeder-thread hop per direction per iteration.  BENCH_r05
+measured decoupled PPO at 0.319x coupled on this host, the opposite of
+the topology's purpose.
+
+This module replaces the payload path with a POSIX shared-memory ring:
+
+- a :class:`ShmArena` is one ``multiprocessing.shared_memory`` segment
+  divided into ``n_slots`` fixed-size slots (sized once from the first
+  payload's byte count plus headroom — the rollout spec is fixed for
+  on-policy loops and bounded for SAC's ratio-granted batches);
+- the WRITER packs a payload's arrays back-to-back into a free slot (one
+  memcpy) and sends only **metadata** over the existing control queue:
+  slot index + per-array ``(key, shape, dtype, offset)`` — the queue
+  pickle stays O(100) bytes regardless of rollout size (the pickle-5
+  out-of-band idea: buffers ride the segment, the pickled message is
+  pure metadata);
+- the READER maps the segment once and reconstructs zero-copy numpy
+  views; it returns the slot via a pre-seeded free-slot queue after the
+  payload has been consumed (flow control = ring occupancy);
+- payloads that do not fit a slot fall back to the plain pickled-queue
+  path transparently (``ShmSender.send`` returns False), so a burst
+  (e.g. SAC's first ratio grant after ``learning_starts``) degrades
+  gracefully instead of failing;
+- cleanup is two-sided: both endpoints ``close()`` their mapping and
+  attempt ``unlink`` (idempotent) in their teardown paths, so a reader
+  OR writer death leaves no orphaned ``/dev/shm`` segment behind — the
+  surviving side unlinks on its own exit.
+
+Config: ``algo.decoupled_transport`` (``shm`` default / ``queue``), env
+override ``SHEEPRL_DECOUPLED_TRANSPORT``.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import secrets
+from multiprocessing import shared_memory
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["ShmArena", "ShmSender", "ShmReceiver", "decoupled_transport_setting"]
+
+
+def decoupled_transport_setting(cfg) -> str:
+    """Resolve ``algo.decoupled_transport`` with its env override to
+    "shm" or "queue"."""
+    val = cfg.algo.get("decoupled_transport", "shm")
+    env = os.environ.get("SHEEPRL_DECOUPLED_TRANSPORT")
+    if env is not None:
+        val = env
+    s = str(val).lower()
+    if s in ("queue", "pickle", "off", "0", "false", "no"):
+        return "queue"
+    return "shm"
+
+
+def _payload_nbytes(arrays: Sequence[Tuple[str, np.ndarray]]) -> int:
+    return sum(int(a.nbytes) for _, a in arrays)
+
+
+class ShmArena:
+    """One shared-memory segment of ``n_slots`` fixed-size slots.
+
+    Create on the writer side with :meth:`create`; attach on the reader
+    side with :meth:`attach` using the writer's :attr:`info` (a tiny
+    picklable dict that rides the control queue).
+    """
+
+    def __init__(self, shm: shared_memory.SharedMemory, n_slots: int, slot_bytes: int, owner: bool):
+        self._shm = shm
+        self.n_slots = int(n_slots)
+        self.slot_bytes = int(slot_bytes)
+        self._owner = owner
+        self._closed = False
+        # belt-and-braces: a process killed by an unhandled exception still
+        # unlinks (SIGKILL can't run this — the surviving peer's close does)
+        atexit.register(self.close)
+
+    # ------------------------------------------------------------ lifecycle
+    @classmethod
+    def create(cls, n_slots: int, slot_bytes: int) -> "ShmArena":
+        if n_slots < 1 or slot_bytes < 1:
+            raise ValueError(f"need n_slots>=1 and slot_bytes>=1, got {n_slots}x{slot_bytes}")
+        name = f"sheeprl_ring_{os.getpid():x}_{secrets.token_hex(4)}"
+        shm = shared_memory.SharedMemory(create=True, size=n_slots * slot_bytes, name=name)
+        return cls(shm, n_slots, slot_bytes, owner=True)
+
+    @classmethod
+    def attach(cls, info: Dict[str, Any]) -> "ShmArena":
+        shm = shared_memory.SharedMemory(name=info["name"])
+        return cls(shm, info["n_slots"], info["slot_bytes"], owner=False)
+
+    @property
+    def info(self) -> Dict[str, Any]:
+        return {"name": self._shm.name, "n_slots": self.n_slots, "slot_bytes": self.slot_bytes}
+
+    def close(self) -> None:
+        """Close the local mapping and try to unlink the segment.
+
+        Unlink is attempted from BOTH endpoints (first wins, the second
+        sees FileNotFoundError): on Linux the segment stays usable for
+        already-attached processes until the last close, and this way a
+        single surviving endpoint is enough to avoid an orphan.
+        """
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._shm.close()
+        except BufferError:
+            # zero-copy views of a slot are still alive somewhere; the
+            # mapping stays until they die (SharedMemory.__del__ retries),
+            # but the NAME can and must still be unlinked below
+            pass
+        except (OSError, ValueError):
+            pass
+        try:
+            self._shm.unlink()
+        except (FileNotFoundError, OSError, ValueError):
+            pass
+        try:
+            atexit.unregister(self.close)
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------- pack/read
+    def pack(self, slot: int, arrays: Sequence[Tuple[str, np.ndarray]]) -> Optional[List[Tuple]]:
+        """Copy ``arrays`` back-to-back into ``slot``; returns the leaves
+        metadata ``[(key, shape, dtype_str, offset), ...]`` or None when
+        the payload does not fit (caller falls back to the queue path)."""
+        if not 0 <= slot < self.n_slots:
+            raise ValueError(f"slot {slot} out of range 0..{self.n_slots - 1}")
+        base = slot * self.slot_bytes
+        off = 0
+        leaves: List[Tuple] = []
+        buf = self._shm.buf
+        for key, arr in arrays:
+            arr = np.ascontiguousarray(arr)
+            if arr.dtype == object:
+                return None
+            nbytes = int(arr.nbytes)
+            if off + nbytes > self.slot_bytes:
+                return None
+            if nbytes:
+                dst = np.frombuffer(buf, dtype=np.uint8, count=nbytes, offset=base + off)
+                dst[:] = arr.view(np.uint8).reshape(-1)
+            leaves.append((key, tuple(arr.shape), str(arr.dtype), off))
+            off += nbytes
+        return leaves
+
+    def unpack(self, slot: int, leaves: Sequence[Tuple], copy: bool = False) -> Dict[str, np.ndarray]:
+        """Rebuild the payload from ``slot``.  ``copy=False`` returns
+        zero-copy views INTO the slot — valid only until the slot is
+        released; ``copy=True`` materializes private arrays."""
+        base = slot * self.slot_bytes
+        out: Dict[str, np.ndarray] = {}
+        for key, shape, dtype, off in leaves:
+            dt = np.dtype(dtype)
+            count = int(np.prod(shape, dtype=np.int64)) if shape else 1
+            view = np.frombuffer(self._shm.buf, dtype=dt, count=count, offset=base + off).reshape(shape)
+            out[key] = np.array(view) if copy else view
+        return out
+
+
+class ShmSender:
+    """Writer endpoint: lazily sizes the arena from the first payload and
+    ships subsequent payloads as metadata-only queue messages.
+
+    ``free_q`` must be an ``mp.Queue`` created by the process that spawned
+    both endpoints (queues cannot ride other queues); the sender seeds it
+    with the slot indices when the arena is created.
+    """
+
+    def __init__(self, free_q, n_slots: int = 2, headroom: float = 1.5, min_bytes: int = 65536):
+        self._free_q = free_q
+        self._n_slots = int(n_slots)
+        self._headroom = float(headroom)
+        self._min_bytes = int(min_bytes)
+        self._arena: Optional[ShmArena] = None
+        self._disabled = False
+        self.fallbacks = 0  # payloads that did not fit and went over the queue
+
+    def _ensure_arena(self, arrays: Sequence[Tuple[str, np.ndarray]]) -> None:
+        if self._arena is not None or self._disabled:
+            return
+        nbytes = _payload_nbytes(arrays)
+        if nbytes < self._min_bytes:
+            # adaptive gate, decided once on the first (spec-sized) payload:
+            # below ~64 KB the ring's extra free-slot queue round trip per
+            # send costs more than pickling the payload outright (measured
+            # 0.85x on KB-scale CartPole rollouts), so small-payload pairs
+            # keep the legacy path and the ring engages only where
+            # zero-copy pays — pixel rollouts, big batches, params trees
+            self._disabled = True
+            return
+        slot_bytes = max(int(nbytes * self._headroom), 4096)
+        self._arena = ShmArena.create(self._n_slots, slot_bytes)
+        for i in range(self._n_slots):
+            self._free_q.put(i)
+
+    def send(self, put_fn, tag: str, arrays: Sequence[Tuple[str, np.ndarray]], extra: Tuple, acquire_slot) -> bool:
+        """Pack ``arrays`` into a free slot and ``put_fn`` the metadata
+        message ``(tag, arena_info, slot, leaves, *extra)``.
+
+        ``acquire_slot()`` blocks for a free slot (callers wrap the free
+        queue with their peer-liveness helper).  Returns False when the
+        payload does not fit the slot OR the sender decided the payload
+        class is too small for the ring to pay (``min_bytes``) — the
+        caller sends its legacy pickled message instead (nothing was
+        consumed: any briefly-held slot is returned).
+        """
+        self._ensure_arena(arrays)
+        if self._arena is None:  # small-payload pair: ring disabled
+            self.fallbacks += 1
+            return False
+        slot = acquire_slot()
+        leaves = self._arena.pack(slot, arrays)
+        if leaves is None:
+            self._free_q.put(slot)  # slot unused; hand it back
+            self.fallbacks += 1
+            return False
+        put_fn((tag, self._arena.info, slot, leaves) + tuple(extra))
+        return True
+
+    def close(self) -> None:
+        if self._arena is not None:
+            self._arena.close()
+            self._arena = None
+
+
+class ShmReceiver:
+    """Reader endpoint: attaches from the first message's arena info and
+    reconstructs payload views; ``release`` returns the slot."""
+
+    def __init__(self, free_q):
+        self._free_q = free_q
+        self._arena: Optional[ShmArena] = None
+
+    def unpack(self, info: Dict[str, Any], slot: int, leaves: Sequence[Tuple], copy: bool = False):
+        if self._arena is None or self._arena.info["name"] != info["name"]:
+            if self._arena is not None:
+                self._arena.close()
+            self._arena = ShmArena.attach(info)
+        return self._arena.unpack(slot, leaves, copy=copy)
+
+    def release(self, slot: int) -> None:
+        self._free_q.put(slot)
+
+    def close(self) -> None:
+        if self._arena is not None:
+            self._arena.close()
+            self._arena = None

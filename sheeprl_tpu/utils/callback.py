@@ -95,7 +95,15 @@ class CheckpointCallback:
                 if k == "rb":
                     host_state[k] = self._materialize_rb(v)
                 else:
-                    host_state[k] = jax.device_get(v)
+                    # device_get on the CPU backend returns ZERO-COPY views
+                    # of the live device buffers; np.array detaches them so
+                    # the async writer can serialize while donated buffers
+                    # get recycled by later train steps (without the copy a
+                    # mid-run checkpoint's content races the update chain)
+                    host_state[k] = jax.tree_util.tree_map(
+                        lambda x: np.array(x) if isinstance(x, np.ndarray) else x,
+                        jax.device_get(v),
+                    )
         finally:
             self._restore_rb(restore)
         return host_state

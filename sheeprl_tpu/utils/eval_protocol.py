@@ -42,6 +42,7 @@ def run_eval_protocol(
     *,
     episodes: int | None = None,
     modes: Sequence[str] = ("greedy", "sampled"),
+    headline_mode: str | None = None,
 ) -> Dict[str, Any]:
     """Roll ``episodes`` rollouts per mode and return the summary dict.
 
@@ -53,13 +54,28 @@ def run_eval_protocol(
 
     ``episodes`` defaults to ``$SHEEPRL_EVAL_EPISODES``, else 1 under
     ``cfg.dry_run`` (CI), else 5.
+
+    ``headline_mode`` picks which mode's median becomes the final
+    ``Test - Reward:`` line (and the ``headline`` summary key).  Default:
+    greedy when present.  DV3-family evaluates headline "sampled" — the
+    reference's ``greedy=False`` mode — because a greedy DV3 rollout can
+    misleadingly score ~0 on sparse tasks the sampled policy solves
+    (observed round 4: a solved ball_in_cup run greedy-evaluated to 0.0).
     """
     if episodes is None:
         episodes = int(os.environ.get("SHEEPRL_EVAL_EPISODES", "0")) or (
             1 if cfg.dry_run else 5
         )
+    if headline_mode is None:
+        headline_mode = "greedy" if "greedy" in modes else modes[0]
+    if headline_mode not in modes:
+        raise ValueError(f"headline_mode '{headline_mode}' not in modes {tuple(modes)}")
     base_seed = int(cfg.seed or 0)
-    out: Dict[str, Any] = {"episodes_per_mode": episodes, "seed_base": base_seed}
+    out: Dict[str, Any] = {
+        "episodes_per_mode": episodes,
+        "seed_base": base_seed,
+        "headline_mode": headline_mode,
+    }
     for mode in modes:
         greedy = mode == "greedy"
         vals = [
@@ -73,7 +89,8 @@ def run_eval_protocol(
             for i in range(episodes)
         ]
         out[mode] = _summary(vals)
-    headline = out["greedy" if "greedy" in modes else modes[0]]["median"]
+    headline = out[headline_mode]["median"]
+    out["headline"] = headline
     runtime.print("Eval protocol:", json.dumps(out, sort_keys=True))
     runtime.print("Test - Reward:", headline)
     return out

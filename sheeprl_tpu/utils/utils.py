@@ -250,6 +250,24 @@ class MetricFetchGate:
         return hit
 
 
+def start_async_host_copy(*arrays: Any) -> None:
+    """Kick off device-to-host copies without waiting for them.
+
+    The env hot loop needs the (tiny) action array NOW but the logprob /
+    value / flat-action arrays only after ``envs.step`` returns; starting
+    their copies before the env step lets the transfers ride under the
+    env's wall-clock instead of serializing ``np.asarray`` round trips
+    afterwards.  No-op for leaves that are not device arrays (numpy
+    inputs, already-fetched results)."""
+    for a in arrays:
+        fn = getattr(a, "copy_to_host_async", None)
+        if fn is not None:
+            try:
+                fn()
+            except RuntimeError:
+                pass  # deleted/donated buffer: the later np.asarray will raise
+
+
 def fetch_actions(
     action_list: Sequence[jax.Array],
     actions_dim: Sequence[int],

@@ -1,0 +1,251 @@
+"""SharedMemory ring transport tests: roundtrip, wraparound, full/empty,
+oversize fallback, endpoint-death cleanup (ISSUE 3 tentpole)."""
+
+import multiprocessing as mp
+import os
+import queue as queue_mod
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.parallel.shm_ring import ShmArena, ShmReceiver, ShmSender
+
+
+def _segment_exists(name: str) -> bool:
+    return os.path.exists(f"/dev/shm/{name}")
+
+
+def _payload(seed=0, rows=16):
+    rng = np.random.default_rng(seed)
+    return [
+        ("obs", rng.normal(size=(rows, 2, 4)).astype(np.float32)),
+        ("actions", rng.integers(0, 3, size=(rows, 2, 1)).astype(np.int32)),
+        ("dones", rng.integers(0, 2, size=(rows, 2, 1)).astype(np.uint8)),
+        ("scalar", np.float32(3.5).reshape(())),
+    ]
+
+
+class TestArena:
+    def test_roundtrip_views_and_copies(self):
+        arena = ShmArena.create(2, 1 << 16)
+        try:
+            payload = _payload()
+            leaves = arena.pack(0, payload)
+            assert leaves is not None
+            for copy in (False, True):
+                out = arena.unpack(0, leaves, copy=copy)
+                for k, v in payload:
+                    np.testing.assert_array_equal(out[k], v)
+                    assert out[k].dtype == v.dtype
+                del out
+        finally:
+            arena.close()
+
+    def test_slots_are_independent(self):
+        arena = ShmArena.create(3, 1 << 16)
+        try:
+            metas = [arena.pack(i, _payload(seed=i)) for i in range(3)]
+            for i, meta in enumerate(metas):
+                out = arena.unpack(i, meta)
+                ref = dict(_payload(seed=i))
+                np.testing.assert_array_equal(out["obs"], ref["obs"])
+                del out
+        finally:
+            arena.close()
+
+    def test_oversize_payload_rejected(self):
+        arena = ShmArena.create(1, 128)
+        try:
+            assert arena.pack(0, [("big", np.zeros(1024, np.float32))]) is None
+        finally:
+            arena.close()
+
+    def test_close_unlinks_segment_from_either_endpoint(self):
+        arena = ShmArena.create(1, 4096)
+        name = arena.info["name"]
+        reader = ShmArena.attach(arena.info)
+        assert _segment_exists(name)
+        # reader dies first: its close already unlinks the NAME; the
+        # writer's close is then a no-op — no orphan either way
+        reader.close()
+        arena.close()
+        assert not _segment_exists(name)
+
+    def test_writer_death_leaves_no_orphan(self):
+        """A reader surviving a (simulated) writer death unlinks on close."""
+        arena = ShmArena.create(1, 4096)
+        name = arena.info["name"]
+        reader = ShmArena.attach(arena.info)
+        del arena  # writer vanished without calling close()... almost:
+        # __del__/atexit normally runs close; the guarantee under test is
+        # that the READER's close alone also removes the name
+        reader.close()
+        assert not _segment_exists(name)
+
+
+class TestSenderReceiver:
+    def _pipe(self, n_slots=2):
+        free_q = mp.get_context("spawn").Queue()
+        ctrl: "queue_mod.Queue" = queue_mod.Queue()
+        # min_bytes=0: these tests exercise the ring itself on small
+        # payloads; the adaptive size gate has its own test below
+        tx = ShmSender(free_q, n_slots=n_slots, min_bytes=0)
+        rx = ShmReceiver(free_q)
+        return free_q, ctrl, tx, rx
+
+    def test_small_payload_pair_skips_ring(self):
+        """Payloads under min_bytes never engage the ring: send returns
+        False (legacy pickled path) and no segment is ever created."""
+        free_q = mp.get_context("spawn").Queue()
+        ctrl: "queue_mod.Queue" = queue_mod.Queue()
+        tx = ShmSender(free_q, min_bytes=65536)
+        try:
+            assert not tx.send(
+                ctrl.put, "d", _payload(rows=4), (), acquire_slot=lambda: free_q.get(timeout=1)
+            )
+            assert tx.fallbacks == 1
+            assert tx._arena is None
+        finally:
+            tx.close()
+
+    def test_wraparound_many_messages_two_slots(self):
+        free_q, ctrl, tx, rx = self._pipe(n_slots=2)
+        try:
+            for i in range(10):
+                sent = tx.send(
+                    ctrl.put,
+                    "data_shm",
+                    _payload(seed=i),
+                    (i,),
+                    acquire_slot=lambda: free_q.get(timeout=5),
+                )
+                assert sent
+                tag, info, slot, leaves, idx = ctrl.get(timeout=5)
+                assert tag == "data_shm" and idx == i
+                out = rx.unpack(info, slot, leaves, copy=True)
+                ref = dict(_payload(seed=i))
+                for k in ref:
+                    np.testing.assert_array_equal(out[k], ref[k])
+                rx.release(slot)
+            assert tx.fallbacks == 0
+        finally:
+            rx.close()
+            tx.close()
+
+    def test_ring_full_blocks_until_release(self):
+        free_q, ctrl, tx, rx = self._pipe(n_slots=1)
+        try:
+            assert tx.send(
+                ctrl.put, "d", _payload(), (), acquire_slot=lambda: free_q.get(timeout=5)
+            )
+            # slot not released: the next acquire must time out (ring full)
+            with pytest.raises(queue_mod.Empty):
+                tx.send(
+                    ctrl.put, "d", _payload(), (), acquire_slot=lambda: free_q.get(timeout=0.2)
+                )
+            _, info, slot, leaves = ctrl.get(timeout=5)
+            rx.unpack(info, slot, leaves, copy=True)
+            rx.release(slot)
+            assert tx.send(
+                ctrl.put, "d", _payload(), (), acquire_slot=lambda: free_q.get(timeout=5)
+            )
+        finally:
+            rx.close()
+            tx.close()
+
+    def test_oversize_falls_back_and_returns_slot(self):
+        free_q, ctrl, tx, rx = self._pipe(n_slots=1)
+        try:
+            assert tx.send(
+                ctrl.put, "d", _payload(rows=4), (), acquire_slot=lambda: free_q.get(timeout=5)
+            )
+            _, info, slot, leaves = ctrl.get(timeout=5)
+            rx.release(slot)
+            # 100x the sizing payload cannot fit the slot -> False, and the
+            # slot it briefly held is back on the free queue
+            big = [("x", np.zeros((4 * 100, 2, 4), np.float32))]
+            assert not tx.send(
+                ctrl.put, "d", big, (), acquire_slot=lambda: free_q.get(timeout=5)
+            )
+            assert tx.fallbacks == 1
+            assert free_q.get(timeout=5) is not None  # slot was handed back
+        finally:
+            rx.close()
+            tx.close()
+
+
+def _reader_proc(info, slot, leaves, result_q):
+    arena = ShmArena.attach(info)
+    try:
+        out = arena.unpack(slot, leaves, copy=True)
+        result_q.put(float(out["obs"].sum()))
+    finally:
+        arena.close()
+
+
+def test_cross_process_roundtrip_and_cleanup():
+    ctx = mp.get_context("spawn")
+    arena = ShmArena.create(1, 1 << 16)
+    name = arena.info["name"]
+    try:
+        payload = _payload(seed=42)
+        leaves = arena.pack(0, payload)
+        result_q = ctx.Queue()
+        proc = ctx.Process(target=_reader_proc, args=(arena.info, 0, leaves, result_q))
+        proc.start()
+        got = result_q.get(timeout=30)
+        proc.join(timeout=30)
+        assert proc.exitcode == 0
+        assert got == pytest.approx(float(dict(payload)["obs"].sum()))
+    finally:
+        arena.close()
+    assert not _segment_exists(name)
+
+
+def _dying_reader(info, ready_q):
+    ShmArena.attach(info)
+    ready_q.put("attached")
+    ready_q.close()
+    ready_q.join_thread()  # flush the feeder thread: _exit would strand the put
+    os._exit(13)  # simulated crash: no close/atexit runs in the reader
+
+
+def test_reader_death_no_orphan_segment():
+    """A reader that dies hard must not leave the segment behind — the
+    writer's close is sufficient cleanup."""
+    ctx = mp.get_context("spawn")
+    arena = ShmArena.create(1, 4096)
+    name = arena.info["name"]
+    ready_q = ctx.Queue()
+    proc = ctx.Process(target=_dying_reader, args=(arena.info, ready_q))
+    proc.start()
+    assert ready_q.get(timeout=30) == "attached"
+    proc.join(timeout=30)
+    assert proc.exitcode == 13
+    arena.close()
+    assert not _segment_exists(name)
+
+
+@pytest.mark.slow
+def test_shm_ring_soak():
+    """Thousands of packed messages over a 2-slot ring: contents stay
+    correct, nothing leaks (registered under the slow marker)."""
+    free_q = mp.get_context("spawn").Queue()
+    ctrl: "queue_mod.Queue" = queue_mod.Queue()
+    tx, rx = ShmSender(free_q, n_slots=2, min_bytes=0), ShmReceiver(free_q)
+    rng = np.random.default_rng(0)
+    try:
+        for i in range(2000):
+            arr = rng.normal(size=(32, 4)).astype(np.float32)
+            assert tx.send(
+                ctrl.put, "d", [("a", arr)], (i,), acquire_slot=lambda: free_q.get(timeout=10)
+            )
+            _, info, slot, leaves, idx = ctrl.get(timeout=10)
+            out = rx.unpack(info, slot, leaves, copy=False)
+            assert idx == i
+            np.testing.assert_array_equal(out["a"], arr)
+            del out
+            rx.release(slot)
+    finally:
+        rx.close()
+        tx.close()

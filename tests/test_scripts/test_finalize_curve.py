@@ -34,3 +34,14 @@ def test_legacy_single_episode_log():
 
 def test_empty_log():
     assert parse_eval_output("no eval lines here") == (None, None)
+
+
+def test_truncated_protocol_line_falls_back(capsys):
+    """A garbled/truncated 'Eval protocol:' JSON (killed eval, interleaved
+    writes) must not crash finalize — legacy Test-Reward path + warning
+    (ISSUE 3 satellite)."""
+    log = 'Test - Reward: 42.0\nEval protocol: {"episodes_per_mode": 3, "greedy": {"med}\n'
+    headline, protocol = parse_eval_output(log)
+    assert headline == 42.0
+    assert protocol is None
+    assert "not valid JSON" in capsys.readouterr().err
