@@ -63,9 +63,9 @@ Benchmarks (baselines from BASELINE.md / the reference README):
 
 ``vs_baseline`` is the speedup factor (>1 is faster than the reference).
 
-Env overrides: BENCH_BUDGET_S, BENCH_SKIP_PPO/SAC/A2C/DV3/DEC/LOOP,
+Env overrides: BENCH_BUDGET_S, BENCH_SKIP_PPO/SAC/A2C/DV3/DEC/LOOP/FANIN,
 BENCH_PPO_STEPS, BENCH_SAC_STEPS, BENCH_A2C_STEPS, BENCH_DV3_STEPS,
-BENCH_PLATFORM (cpu for local tests).
+BENCH_FANIN_STEPS, BENCH_PLATFORM (cpu for local tests).
 """
 
 import json
@@ -91,8 +91,17 @@ TPU_V5E_BF16_PEAK_FLOPS = 197e12
 
 # (section, conservative wall-clock estimate used for skip decisions);
 # ppo/sac cover four CLI runs each (cold + 2 cached-warm + long); dec runs
-# four protocols (coupled/decoupled x ppo/sac) on the TPU-backed learner
-SECTIONS = [("dv3", 60), ("loop", 60), ("ppo", 100), ("sac", 60), ("a2c", 100), ("dec", 260)]
+# five protocol ladders (coupled/decoupled x ppo/sac + queue/tcp transport
+# A/Bs) on the TPU-backed learner; fanin scales the decoupled player count
+SECTIONS = [
+    ("dv3", 60),
+    ("loop", 60),
+    ("ppo", 100),
+    ("sac", 60),
+    ("a2c", 100),
+    ("dec", 300),
+    ("fanin", 140),
+]
 
 
 def _note(**kw):
@@ -282,6 +291,34 @@ def bench_dv3():
     }
 
 
+def _last_transport_telemetry(root_dir):
+    """Newest decoupled run's last telemetry ``transport`` record under
+    ``root_dir`` (payload accounting for the dec/fanin metric lines)."""
+    import glob
+
+    paths = sorted(
+        glob.glob(os.path.join(root_dir, "**", "telemetry.jsonl"), recursive=True),
+        key=os.path.getmtime,
+    )
+    last = None
+    for line in open(paths[-1]) if paths else ():
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if "transport" in rec:
+            last = rec["transport"]
+    return last
+
+
+def _payload_bytes_per_iter(transport_rec):
+    if not transport_rec:
+        return None
+    frames = max(sum(p.get("frames", 0) for p in transport_rec["players"].values()), 1)
+    rollout_bytes = sum(p.get("bytes_in", 0) for p in transport_rec["players"].values())
+    return int(rollout_bytes * len(transport_rec["players"]) / frames)
+
+
 def bench_dec():
     """Coupled vs decoupled (CPU-player / TPU-learner) on the same chip.
 
@@ -323,26 +360,49 @@ def bench_dec():
         r_d, *_ = _cli_steady_rate(
             base + [f"algo.name={algo}_decoupled", "run_name=decoupled"], n_warm, n_long
         )
+        # payload accounting (ISSUE 4) from a short UNTIMED run with
+        # telemetry on (the timed legs keep the benchmark's log_level=0):
+        # keeps BENCH_r*.json trajectories comparable across transports
+        from sheeprl_tpu.cli import run as _cli_run
+
+        _cli_run(
+            base
+            + [
+                f"algo.name={algo}_decoupled",
+                "run_name=decoupled_acct",
+                "metric.log_level=1",
+                f"algo.total_steps={n_warm}",
+            ]
+        )
+        tr = _last_transport_telemetry(f"/tmp/sheeprl_tpu_bench/dec_{algo}")
         results[algo] = {
             "coupled_ms_per_step": round(r_c * 1e3, 3),
             "decoupled_ms_per_step": round(r_d * 1e3, 3),
             "decoupled_speedup": round(r_c / r_d, 3),
             "transport": os.environ.get("SHEEPRL_DECOUPLED_TRANSPORT", "shm"),
+            "num_players": int(tr["num_players"]) if tr else 1,
+            "payload_bytes_per_iter": _payload_bytes_per_iter(tr),
         }
         if algo == "ppo":
-            # transport A/B (ISSUE 3): the same decoupled pair over the
-            # legacy pickled-queue path quantifies the shm ring's win
-            os.environ["SHEEPRL_DECOUPLED_TRANSPORT"] = "queue"
-            try:
-                r_q, *_ = _cli_steady_rate(
-                    base + [f"algo.name={algo}_decoupled", "run_name=decoupled_q"],
-                    n_warm,
-                    n_long,
-                )
-            finally:
-                os.environ.pop("SHEEPRL_DECOUPLED_TRANSPORT", None)
-            results[algo]["queue_ms_per_step"] = round(r_q * 1e3, 3)
-            results[algo]["shm_over_queue_speedup"] = round(r_q / r_d, 3)
+            # transport A/B ladder (ISSUE 3 + 4): the same decoupled pair
+            # over the legacy pickled queue and the new socket stream
+            for leg, env_val in (("queue", "queue"), ("tcp", "tcp")):
+                os.environ["SHEEPRL_DECOUPLED_TRANSPORT"] = env_val
+                try:
+                    r_leg, *_ = _cli_steady_rate(
+                        base + [f"algo.name={algo}_decoupled", f"run_name=decoupled_{leg}"],
+                        n_warm,
+                        n_long,
+                    )
+                finally:
+                    os.environ.pop("SHEEPRL_DECOUPLED_TRANSPORT", None)
+                results[algo][f"{leg}_ms_per_step"] = round(r_leg * 1e3, 3)
+            results[algo]["shm_over_queue_speedup"] = round(
+                results[algo]["queue_ms_per_step"] / (r_d * 1e3), 3
+            )
+            results[algo]["tcp_over_queue_speedup"] = round(
+                results[algo]["queue_ms_per_step"] / results[algo]["tcp_ms_per_step"], 3
+            )
         # durability: the dec section is the longest — persist after each
         # completed protocol pair so a timeout can't lose finished work
         if _CHILD_OUT_PATH:
@@ -352,6 +412,47 @@ def bench_dec():
             except OSError:
                 pass
     return _metric()
+
+
+def bench_fanin():
+    """N-player rollout fan-in scaling (ISSUE 4): decoupled PPO at
+    N=1/2/4 players over the socket transport.  On a 1-core container
+    every player time-slices the same core, so the scaling ratio is a
+    LOWER BOUND that mainly proves the fan-in works end to end — same
+    caveat as the overlap/dec sections (host_cpu_count is recorded)."""
+    from benchmarks.bench_fanin_scaling import _run_once
+
+    steps = int(os.environ.get("BENCH_FANIN_STEPS", 1536))
+    warm = max(steps // 3, 256)
+    root = "/tmp/sheeprl_tpu_bench/fanin"
+    rows = []
+    for n in (1, 2, 4):
+        _run_once("tcp", n, warm, root)  # compile/spawn warmup
+        t_warm = _run_once("tcp", n, warm, root)
+        t_long = _run_once("tcp", n, steps, root)
+        steady = max(t_long - t_warm, 1e-6)
+        sps = (steps - warm) / steady
+        rows.append({"num_players": n, "steady_sps": round(sps, 1)})
+        if n == 4:  # one untimed accounting run with telemetry on
+            _run_once("tcp", n, warm, root, log_level=1)
+        if _CHILD_OUT_PATH:
+            try:
+                with open(_CHILD_OUT_PATH, "w") as f:
+                    json.dump({"metric": "fanin_scaling_partial", "players": rows}, f)
+            except OSError:
+                pass
+    tr = _last_transport_telemetry(root)
+    return {
+        "metric": "decoupled_fanin_scaling_4p_over_1p",
+        "value": round(rows[-1]["steady_sps"] / max(rows[0]["steady_sps"], 1e-6), 3),
+        "unit": "x",
+        # self-relative scaling ratio, not a reference comparison
+        "vs_baseline": None,
+        "transport": "tcp",
+        "players": rows,
+        "payload_bytes_per_iter": _payload_bytes_per_iter(tr),
+        "host_cpu_count": os.cpu_count(),
+    }
 
 
 def bench_loop():
@@ -485,6 +586,7 @@ def child_main(section, out_path):
         "sac": bench_sac,
         "a2c": bench_a2c,
         "dec": bench_dec,
+        "fanin": bench_fanin,
     }[section]()
     with open(out_path, "w") as f:
         json.dump(metric, f)

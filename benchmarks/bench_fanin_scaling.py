@@ -1,0 +1,104 @@
+"""N-player fan-in scaling: aggregate rollout throughput vs num_players.
+
+Runs the decoupled PPO protocol end-to-end at N = 1 / 2 / 4 players over
+the chosen transport and reports steady-state policy-steps/s.  On a
+multi-core host the aggregate env throughput should scale with N until
+the trainer saturates (the SEED-RL shape); on a 1-core container every
+player time-slices the same core, so the numbers here are a LOWER BOUND
+that mainly proves the fan-in works — same caveat as the PR 3 overlap
+bench (``host_cpu_count`` is recorded for exactly that reason).
+
+    python benchmarks/bench_fanin_scaling.py [--out results/fanin_scaling.json]
+        [--transport tcp] [--steps 2048] [--players 1 2 4]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _run_once(transport: str, players: int, steps: int, root: str, log_level: int = 0) -> float:
+    """Wall-clock seconds for one CLI run (fresh process-level state
+    rides on the spawned players; the trainer reuses this interpreter)."""
+    from sheeprl_tpu.cli import run
+
+    tic = time.perf_counter()
+    run(
+        [
+            "exp=ppo_benchmarks",
+            "env.num_envs=4",
+            "env.sync_env=True",
+            "env.capture_video=False",
+            "fabric.accelerator=cpu",
+            "fabric.devices=1",
+            f"metric.log_level={log_level}",
+            "buffer.memmap=False",
+            "checkpoint.every=1000000",
+            "checkpoint.save_last=False",
+            "algo.name=ppo_decoupled",
+            f"algo.total_steps={steps}",
+            "algo.rollout_steps=32",
+            "algo.run_test=False",
+            f"algo.num_players={players}",
+            f"algo.decoupled_transport={transport}",
+            f"root_dir={root}",
+            f"run_name=fanin_{transport}_{players}",
+            "seed=0",
+        ]
+    )
+    return time.perf_counter() - tic
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--transport", default="tcp")
+    ap.add_argument("--steps", type=int, default=2048)
+    ap.add_argument("--players", type=int, nargs="+", default=[1, 2, 4])
+    args = ap.parse_args()
+
+    root = "/tmp/sheeprl_tpu_bench/fanin"
+    results = {
+        "host_cpu_count": os.cpu_count(),
+        "transport": args.transport,
+        "steps": args.steps,
+        "note": (
+            "steady sps per player count; on a 1-core host all players "
+            "time-slice one core, so scaling here is a lower bound"
+        ),
+        "players": [],
+    }
+    warm = max(args.steps // 4, 256)
+    for n in args.players:
+        _run_once(args.transport, n, warm, root)  # compile + spawn warmup
+        t_warm = _run_once(args.transport, n, warm, root)
+        t_long = _run_once(args.transport, n, args.steps, root)
+        # differencing strips the per-run fixed costs (spawn, cache load)
+        steady = max(t_long - t_warm, 1e-6)
+        sps = (args.steps - warm) / steady
+        row = {
+            "num_players": n,
+            "steady_sps": round(sps, 1),
+            "warm_s": round(t_warm, 2),
+            "long_s": round(t_long, 2),
+        }
+        if results["players"]:
+            row["scaling_vs_1p"] = round(sps / results["players"][0]["steady_sps"], 3)
+        results["players"].append(row)
+        print(json.dumps(row), flush=True)
+
+    if args.out:
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(results, f, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

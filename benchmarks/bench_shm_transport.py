@@ -1,14 +1,17 @@
-"""Rollout-transport A/B: pickled mp.Queue vs the SharedMemory ring.
+"""Rollout-transport ladder: pickled mp.Queue vs SharedMemory ring vs tcp.
 
 Round-trips a synthetic rollout payload parent->child->ack across a
-spawned process at several payload sizes and reports µs/message for both
-transports plus the shm speedup.  This isolates exactly what
-``algo.decoupled_transport`` changes — the per-iteration shipping cost —
-from everything else the decoupled topology does (env stepping, train
-dispatch, scheduling), so the numbers hold on any host, including 1-core
-containers where end-to-end decoupled-vs-coupled is core-bound.
+spawned process at several payload sizes and reports µs/message for all
+three ``algo.decoupled_transport`` backends plus their speedups over the
+pickled queue.  This isolates exactly what the transport setting changes
+— the per-iteration shipping cost — from everything else the decoupled
+topology does (env stepping, train dispatch, scheduling), so the numbers
+hold on any host, including 1-core containers where end-to-end
+decoupled-vs-coupled is core-bound.  The tcp leg runs over localhost
+loopback; across real hosts it pays the NIC instead, which is the point
+of having it on the ladder.
 
-    python benchmarks/bench_shm_transport.py [--out results/shm_transport.json]
+    python benchmarks/bench_shm_transport.py [--out results/transport_ladder.json]
 """
 
 from __future__ import annotations
@@ -25,6 +28,9 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from sheeprl_tpu.parallel.shm_ring import ShmReceiver, ShmSender  # noqa: E402
+from sheeprl_tpu.parallel.transport import TcpChannel, TcpListener  # noqa: E402
+
+MODES = ("queue", "shm", "tcp")
 
 
 def _payload(nbytes: int):
@@ -39,7 +45,18 @@ def _payload(nbytes: int):
     ]
 
 
-def _consumer(mode, data_q, ack_q, free_q, n_msgs):
+def _consumer(mode, data_q, ack_q, free_q, address, n_msgs):
+    if mode == "tcp":
+        chan = TcpChannel(address=tuple(address), player_id=0, window=2)
+        try:
+            for _ in range(n_msgs):
+                frame = chan.recv(timeout=60)
+                s = float(frame.arrays["rewards"][0, 0])  # touch the data
+                frame.release()
+                ack_q.put(s)
+        finally:
+            chan.close()
+        return
     rx = ShmReceiver(free_q)
     try:
         for _ in range(n_msgs):
@@ -62,9 +79,12 @@ def _run_mode(mode: str, payload, n_msgs: int) -> float:
     """Seconds per message for one transport mode."""
     ctx = mp.get_context("spawn")
     data_q, ack_q, free_q = ctx.Queue(), ctx.Queue(), ctx.Queue()
-    proc = ctx.Process(target=_consumer, args=(mode, data_q, ack_q, free_q, n_msgs))
+    listener = TcpListener("127.0.0.1", 0, window=2) if mode == "tcp" else None
+    address = list(listener.address) if listener else None
+    proc = ctx.Process(target=_consumer, args=(mode, data_q, ack_q, free_q, address, n_msgs))
     proc.start()
     tx = ShmSender(free_q, min_bytes=0) if mode == "shm" else None
+    chan = listener.channel(0, timeout=60, peer_alive=proc.is_alive) if listener else None
     try:
         # warm both directions (spawn + first-attach costs stay out of the rate)
         t0 = None
@@ -77,6 +97,8 @@ def _run_mode(mode: str, payload, n_msgs: int) -> float:
                     data_q.put, "shm", payload, (), acquire_slot=lambda: free_q.get(timeout=30)
                 )
                 assert sent
+            elif mode == "tcp":
+                chan.send("shm", arrays=payload, seq=i, timeout=60)
             else:
                 data_q.put(("pickle", {k: v for k, v in payload}))
             ack_q.get(timeout=30)
@@ -85,6 +107,10 @@ def _run_mode(mode: str, payload, n_msgs: int) -> float:
     finally:
         if tx is not None:
             tx.close()
+        if chan is not None:
+            chan.close()
+        if listener is not None:
+            listener.close()
         proc.join(timeout=30)
         if proc.is_alive():
             proc.terminate()
@@ -104,11 +130,14 @@ def main() -> int:
         n = max(min(args.msgs, int(64e6 / max(actual, 1))), 20)
         t_q = _run_mode("queue", payload, n)
         t_s = _run_mode("shm", payload, n)
+        t_t = _run_mode("tcp", payload, n)
         row = {
             "payload_mb": round(actual / (1 << 20), 3),
             "queue_us_per_msg": round(t_q * 1e6, 1),
             "shm_us_per_msg": round(t_s * 1e6, 1),
+            "tcp_us_per_msg": round(t_t * 1e6, 1),
             "shm_speedup": round(t_q / t_s, 3),
+            "tcp_over_queue": round(t_q / t_t, 3),
             "msgs": n,
         }
         results["sizes"].append(row)

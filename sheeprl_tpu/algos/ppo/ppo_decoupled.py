@@ -1,26 +1,34 @@
-"""PPO decoupled — CPU-player / TPU-learner topology.
+"""PPO decoupled — N CPU players fanning rollouts into one TPU learner.
 
 Counterpart of reference sheeprl/algos/ppo/ppo_decoupled.py (player:32,
-trainer:368, main:623). The reference implements the split with
-torch.distributed process ranks (rank-0 player + DDP trainer group) and
-explicit TorchCollective object collectives. The idiomatic TPU mapping
-(SURVEY.md §5.8) replaces both:
+trainer:368, main:623), generalized from the reference's 1 player x N DDP
+trainers into the IMPALA/SEED-RL shape a TPU pod wants (Espeholt et al.,
+2018; 2020): ``algo.num_players`` actor processes stream rollout shards
+into ONE centralized learner over a pluggable transport
+(``algo.decoupled_transport = queue | shm | tcp``, see
+``sheeprl_tpu/parallel/transport.py``).
+
+Topology:
 
 - the TRAINER is the main process: it owns the accelerator mesh and runs
-  the same single-jit PPO update as the coupled path (GAE + epochs x
-  minibatches); data parallelism is the mesh ``data`` axis, so the
-  reference's "N-1 DDP trainer ranks" collapse into one SPMD program;
-- the PLAYER is a spawned subprocess pinned to the host CPU backend
-  (``JAX_PLATFORMS=cpu``): it owns ALL the envs (reference
-  ppo_decoupled.py:67), the logger and the checkpoint files, exactly like
-  the reference's rank-0;
-- the TorchCollective protocol becomes two multiprocessing queues:
-  ``scatter_object_list`` (data -> trainers, reference :299) is the data
-  queue; the flattened-params ``broadcast`` (trainer-1 -> player, :302) and
-  metrics broadcast (:578) ride the response queue; the trainer-state
-  handoff for ``on_checkpoint_player`` (:337) is a ``need_ckpt_state`` flag
-  answered with optimizer state; the ``-1`` shutdown sentinel (:344) is a
-  ``("stop",)`` message.
+  the same single-jit PPO update as the coupled path; each round it
+  assembles the global batch from per-player env shards in PLAYER-ID
+  order (deterministic, arrival-order independent) and broadcasts the
+  refreshed weights on a seq-numbered params channel;
+- each PLAYER is a spawned subprocess pinned to the host CPU backend
+  owning ``num_envs / num_players`` of the vectorized envs.  Player 0 is
+  the LEAD: it owns the logger, the telemetry sink and the checkpoint
+  files (the others are pure env-stepping workers);
+- params staleness is a FIXED LAG (``algo.decoupled_params_lag``,
+  PR 3's schedule across processes): rollout k acts on exactly the
+  weights of update ``k - 1 - lag``, so players overlap their env
+  stepping with the trainer's update without ever racing on "newest
+  params win";
+- resilience: a crashed player SHRINKS the fan-in — the trainer logs the
+  shrink (it also rides telemetry under ``transport``), reassembles from
+  the survivors (one XLA recompile for the smaller batch) and keeps
+  training; only losing the LAST player aborts the run with the
+  emergency dump the 1x1 topology always had.
 """
 
 from __future__ import annotations
@@ -41,16 +49,21 @@ from sheeprl_tpu.algos.ppo.utils import prepare_obs, test
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.obs import setup_observability, trace_scope
-from sheeprl_tpu.parallel.shm_ring import ShmReceiver, ShmSender, decoupled_transport_setting
+from sheeprl_tpu.parallel.transport import (
+    FanIn,
+    ParamsFollower,
+    assemble_shards,
+    make_transport,
+    split_envs,
+    transport_setting,
+)
 from sheeprl_tpu.resilience import (
     CheckpointManager,
     PeerDiedError,
     PreemptionHandler,
     child_alive,
     hard_exit_point,
-    maybe_drop_or_delay_send,
     parent_alive,
-    queue_get_from_peer,
 )
 from sheeprl_tpu.utils.callback import load_checkpoint
 from sheeprl_tpu.utils.env import make_env
@@ -72,13 +85,14 @@ _QUEUE_TIMEOUT_S = 600.0
 
 
 def _np_tree(tree: Any) -> Any:
-    """Pytree -> host numpy (the queue transport format)."""
+    """Pytree -> host numpy (the transport format)."""
     return jax.tree_util.tree_map(np.asarray, jax.device_get(tree))
 
 
 def _flat_leaves(tree: Any):
-    """Ordered ``(name, ndarray)`` pairs for shm shipping; the receiver
-    rebuilds with its OWN treedef (both processes build the same agent)."""
+    """Ordered ``(name, ndarray)`` pairs for transport shipping; the
+    receiver rebuilds with its OWN treedef (both processes build the same
+    agent)."""
     leaves = jax.tree_util.tree_leaves(tree)
     return [(str(i), np.asarray(leaf)) for i, leaf in enumerate(leaves)]
 
@@ -88,16 +102,28 @@ def _unflat_leaves(treedef, payload: Dict[str, np.ndarray]) -> Any:
     return jax.tree_util.tree_unflatten(treedef, list(payload.values()))
 
 
-def _player_loop(
-    cfg, data_q: mp.Queue, resp_q: mp.Queue, data_free_q: mp.Queue, resp_free_q: mp.Queue,
-    state_counters, world_size: int,
-) -> None:
+def decoupled_knobs(cfg) -> Dict[str, Any]:
+    """The fan-in configuration surface, resolved with defaults (shared
+    with sac_decoupled)."""
+    lag = int(cfg.algo.get("decoupled_params_lag", 1))
+    return {
+        "backend": transport_setting(cfg),
+        "num_players": int(cfg.algo.get("num_players", 1)),
+        "lag": lag,
+        # a player may have up to lag+1 unacked shards in flight
+        "window": max(2, int(cfg.algo.get("transport_window", 0)) or lag + 1),
+        "host": str(cfg.algo.get("tcp_host", "127.0.0.1")),
+        "port": int(cfg.algo.get("tcp_port", 0)),
+        "compress_min": 65536 if bool(cfg.algo.get("tcp_compress", False)) else 0,
+    }
+
+
+def _player_loop(cfg, spec, state_counters, world_size: int, env_offset: int, n_local_envs: int) -> None:
     """Player process body (reference ppo_decoupled.py:32-365).
 
     Runs on the host CPU backend (the parent exports JAX_PLATFORMS=cpu
-    around the spawn): owns envs, logger, rollout buffer, checkpoints, and
-    the live policy used for acting; receives refreshed weights from the
-    trainer once per iteration.
+    around the spawn): owns its SHARD of the envs; player 0 (the lead)
+    additionally owns the logger, telemetry and checkpoint files.
     """
     import gymnasium as gym
     from gymnasium.vector import AsyncVectorEnv, AutoresetMode, SyncVectorEnv
@@ -105,9 +131,12 @@ def _player_loop(
     from sheeprl_tpu.cli import install_stack_dumper
     from sheeprl_tpu.parallel.mesh import MeshRuntime
 
-    install_stack_dumper(suffix=".player")
+    player_id = spec.player_id
+    lead = player_id == 0
+    knobs = decoupled_knobs(cfg)
+    install_stack_dumper(suffix=f".player{player_id}")
 
-    if cfg.metric.log_level == 0:
+    if cfg.metric.log_level == 0 or not lead:
         MetricAggregator.disabled = True
         timer.disabled = True
     if cfg.metric.get("disable_timer", False):
@@ -115,20 +144,25 @@ def _player_loop(
 
     runtime = MeshRuntime(devices=1, accelerator="cpu", precision=cfg.fabric.precision)
     runtime.launch()
-    runtime.seed_everything(cfg.seed)
+    # player 0 keeps the exact 1x1 stream; siblings fork theirs by id
+    runtime.seed_everything(cfg.seed + player_id)
 
-    logger = get_logger(runtime, cfg)
-    log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name)
-    runtime.print(f"Log dir: {log_dir}")
-    observability = setup_observability(runtime, cfg, log_dir, logger=logger)
+    logger = get_logger(runtime, cfg) if lead else None
+    if lead:
+        log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name)
+        runtime.print(f"Log dir: {log_dir}")
+    else:
+        # non-lead players own no run dir; memmap buffers (if any) land in
+        # a per-player scratch dir next to the run root
+        log_dir = os.path.join(str(cfg.root_dir), str(cfg.run_name), f"player_{player_id}")
+    observability = setup_observability(runtime, cfg, log_dir if lead else None, logger=logger)
     if logger:
         logger.log_hyperparams(cfg)
 
-    # ALL envs live on the player (reference ppo_decoupled.py:67)
     total_envs = int(cfg.env.num_envs)
     thunks = [
-        make_env(cfg, cfg.seed + i, 0, log_dir, "train", vector_env_idx=i)
-        for i in range(total_envs)
+        make_env(cfg, cfg.seed + env_offset + i, 0, log_dir, "train", vector_env_idx=env_offset + i)
+        for i in range(n_local_envs)
     ]
     envs = (
         SyncVectorEnv(thunks, autoreset_mode=AutoresetMode.SAME_STEP)
@@ -153,87 +187,80 @@ def _player_loop(
     )
     clip_rewards_fn = (lambda r: np.tanh(r)) if cfg.env.clip_rewards else (lambda r: r)
 
+    # one duplex channel to the trainer over the configured backend
+    channel = spec.player_channel(peer_alive=parent_alive, who="trainer")
+
     # hand the agent blueprint to the trainer (reference broadcasts
-    # agent_args from the player, :117)
-    data_q.put(("init", observation_space, actions_dim, is_continuous))
+    # agent_args from the player, :117); every player sends one so the
+    # trainer can proceed from whichever subset survives startup
+    channel.send("init", extra=(observation_space, actions_dim, is_continuous))
 
-    # inference-only agent; weights arrive from the trainer (reference :126)
+    # inference-only agent; weights arrive on the params broadcast
     module, params = build_agent(runtime, actions_dim, is_continuous, cfg, observation_space)
-    tag, payload = queue_get_from_peer(
-        resp_q, timeout=_QUEUE_TIMEOUT_S, peer_alive=parent_alive, who="trainer"
-    )
-    assert tag == "params", f"expected initial params, got {tag}"
-    # pin the acting policy to the HOST CPU device explicitly: the
-    # JAX_PLATFORMS=cpu env the parent exports around the spawn does NOT
-    # stop a PJRT plugin (axon tunnel) from registering itself as the
-    # default backend in this child — an unpinned jit then runs every env
-    # step's action over the remote link (~0.1 s RTT each, observed before
-    # this pin: a CartPole rollout of 128 steps took minutes)
-    host_cpu = jax.local_devices(backend="cpu")[0]
-    player = PPOPlayer(
-        module,
-        payload,
-        lambda o: prepare_obs(o, cnn_keys=cnn_keys, num_envs=total_envs),
-        device=host_cpu,
-    )
-
-    # zero-copy transport: rollouts go out through a SharedMemory ring
-    # (control queue carries metadata only) and params refreshes come back
-    # through the trainer's ring; "queue" keeps the legacy pickled path
-    use_shm = decoupled_transport_setting(cfg) == "shm"
-    rollout_tx = ShmSender(data_free_q) if use_shm else None
-    params_rx = ShmReceiver(resp_free_q) if use_shm else None
     params_treedef = jax.tree_util.tree_structure(params)
 
-    save_configs(cfg, log_dir)
+    start_iter, policy_step, last_log, last_checkpoint = state_counters
 
+    train_step = 0
+    last_train = 0
+    train_time_window = 0.0  # trainer-side seconds accumulated since last log
+    trainer_compiles = None  # trainer-side XLA compile count (rides the params frames)
+    latest_info_scalars: Dict[str, Any] = {}
+    latest_transport_stats = None
+    latest_train_metrics: Dict[str, Any] = {}
+    latest_opt_np = None
     aggregator = None
     if not MetricAggregator.disabled:
         aggregator = instantiate(dict(cfg.metric.aggregator))
 
-    if cfg.buffer.size < cfg.algo.rollout_steps:
-        raise ValueError(
-            f"The size of the buffer ({cfg.buffer.size}) cannot be lower "
-            f"than the rollout steps ({cfg.algo.rollout_steps})"
-        )
-    rb = ReplayBuffer(
-        cfg.buffer.size,
-        total_envs,
-        memmap=cfg.buffer.memmap,
-        memmap_dir=os.path.join(log_dir, "memmap_buffer", "rank_0"),
-        obs_keys=obs_keys,
+    def _apply_params_extra(frame) -> None:
+        """Account a params frame's piggybacked trainer state (lead only:
+        metrics, opt-state for checkpoints, info scalars, transport
+        stats).  Safe pre-release — values are scalars/small trees."""
+        nonlocal train_step, train_time_window, trainer_compiles
+        nonlocal latest_info_scalars, latest_transport_stats, latest_train_metrics, latest_opt_np
+        train_step += 1
+        if not lead or not frame.extra:
+            return
+        train_metrics, opt_np, info_scalars, transport_stats = frame.extra
+        latest_train_metrics = train_metrics or {}
+        if opt_np is not None:
+            latest_opt_np = opt_np
+        latest_info_scalars = dict(info_scalars or {})
+        if transport_stats is not None:
+            latest_transport_stats = transport_stats
+        train_time_window += latest_info_scalars.pop("train_time", 0.0)
+        trainer_compiles = latest_info_scalars.pop("trainer_compiles", trainer_compiles)
+        if aggregator and not aggregator.disabled:
+            for k, v in latest_train_metrics.items():
+                aggregator.update(k, v)
+
+    follower = ParamsFollower(
+        channel,
+        lag=knobs["lag"],
+        initial_seq=start_iter - 2,
+        timeout=_QUEUE_TIMEOUT_S,
+        on_stale=_apply_params_extra,
     )
 
-    start_iter, policy_step, last_log, last_checkpoint = state_counters
-    # the player owns the checkpoint files AND its own preemption handler
-    # (the trainer forwards SIGTERM here; see main below)
-    ckpt_mgr = CheckpointManager(
-        runtime, cfg, log_dir, observability=observability, last_checkpoint=last_checkpoint
-    )
-    train_step = 0
-    last_train = 0
-    train_time_window = 0.0  # trainer-side seconds accumulated since last log
-    trainer_compiles = None  # trainer-side XLA compile count (rides info_scalars)
-    policy_steps_per_iter = int(cfg.env.num_envs * cfg.algo.rollout_steps)
-    total_iters = cfg.algo.total_steps // policy_steps_per_iter if not cfg.dry_run else 1
-    if cfg.metric.log_level > 0 and cfg.metric.log_every % policy_steps_per_iter != 0:
-        warnings.warn(
-            f"metric.log_every ({cfg.metric.log_every}) is not a multiple of "
-            f"policy_steps_per_iter ({policy_steps_per_iter}); metrics log at the next multiple."
-        )
+    def _adopt(frame) -> Any:
+        """Copy a params frame out of the transport buffers and hand the
+        numpy tree straight to the setter: jnp.asarray here would place
+        the fresh params on the DEFAULT backend (the tunnel-attached
+        chip) and the setter's transfer to the host-CPU player would then
+        round-trip every leaf over the link — ~1 s/iteration, observed as
+        decoupled running 5x slower than coupled before this change."""
+        new_params = _unflat_leaves(params_treedef, frame.arrays_copy())
+        _apply_params_extra(frame)
+        frame.release()
+        player.params = new_params
+        return new_params
 
-    step_data: Dict[str, np.ndarray] = {}
-    next_obs_np = envs.reset(seed=cfg.seed)[0]
-
-    def _trainer_reply(policy_step_now: int, iter_now: int):
-        """One protocol reply from the trainer. A dead trainer surfaces in
-        ~a second as a final emergency checkpoint + a clear error instead
-        of the full ``_QUEUE_TIMEOUT_S`` hang."""
-        try:
-            return queue_get_from_peer(
-                resp_q, timeout=_QUEUE_TIMEOUT_S, peer_alive=parent_alive, who="trainer"
-            )
-        except PeerDiedError as e:
+    def _die_with_dump(e: PeerDiedError, policy_step_now: int, iter_now: int):
+        """A dead trainer surfaces in ~a second as a final emergency
+        checkpoint + a clear error instead of the full timeout hang."""
+        path = None
+        if lead and ckpt_mgr is not None:
             path = ckpt_mgr.emergency_dump(
                 policy_step_now,
                 {
@@ -242,16 +269,86 @@ def _player_loop(
                     "policy_step": policy_step_now,
                 },
             )
-            raise RuntimeError(
-                f"decoupled trainer process died at policy_step={policy_step_now}; "
-                f"the player's last-known weights were dumped to {path} "
-                "(partial state: resume from the last regular ckpt_*.ckpt instead)"
-            ) from e
+        raise RuntimeError(
+            f"decoupled trainer process died at policy_step={policy_step_now}; "
+            f"the player's last-known weights were dumped to {path} "
+            "(partial state: resume from the last regular ckpt_*.ckpt instead)"
+        ) from e
+
+    # initial weights (the trainer broadcasts seq = start_iter - 1);
+    # nothing to dump yet if the trainer dies here
+    try:
+        init_frame = follower.advance_to(start_iter - 1)
+    except PeerDiedError as e:
+        raise RuntimeError(
+            f"decoupled trainer process died before the initial params broadcast "
+            f"reached player {player_id}"
+        ) from e
+    assert init_frame is not None
+    train_step = 0  # the initial broadcast is not an update
+    # pin the acting policy to the HOST CPU device explicitly: the
+    # JAX_PLATFORMS=cpu env the parent exports around the spawn does NOT
+    # stop a PJRT plugin (axon tunnel) from registering itself as the
+    # default backend in this child — an unpinned jit then runs every env
+    # step's action over the remote link (~0.1 s RTT each)
+    host_cpu = jax.local_devices(backend="cpu")[0]
+    player = PPOPlayer(
+        module,
+        _unflat_leaves(params_treedef, init_frame.arrays_copy()),
+        lambda o: prepare_obs(o, cnn_keys=cnn_keys, num_envs=n_local_envs),
+        device=host_cpu,
+    )
+    init_frame.release()
+
+    if lead:
+        save_configs(cfg, log_dir)
+
+    if cfg.buffer.size < cfg.algo.rollout_steps:
+        raise ValueError(
+            f"The size of the buffer ({cfg.buffer.size}) cannot be lower "
+            f"than the rollout steps ({cfg.algo.rollout_steps})"
+        )
+    rb = ReplayBuffer(
+        cfg.buffer.size,
+        n_local_envs,
+        memmap=cfg.buffer.memmap,
+        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{player_id}"),
+        obs_keys=obs_keys,
+    )
+
+    # the lead owns the checkpoint files AND its own preemption handler
+    # (the trainer forwards SIGTERM to every player; non-leads just stop)
+    ckpt_mgr = (
+        CheckpointManager(runtime, cfg, log_dir, observability=observability, last_checkpoint=last_checkpoint)
+        if lead
+        else None
+    )
+    preemption = None if lead else PreemptionHandler().install()
+    policy_steps_per_iter = int(cfg.env.num_envs * cfg.algo.rollout_steps)
+    total_iters = cfg.algo.total_steps // policy_steps_per_iter if not cfg.dry_run else 1
+    if lead and cfg.metric.log_level > 0 and cfg.metric.log_every % policy_steps_per_iter != 0:
+        warnings.warn(
+            f"metric.log_every ({cfg.metric.log_every}) is not a multiple of "
+            f"policy_steps_per_iter ({policy_steps_per_iter}); metrics log at the next multiple."
+        )
+
+    step_data: Dict[str, np.ndarray] = {}
+    next_obs_np = envs.reset(seed=cfg.seed + env_offset)[0]
 
     for iter_num in range(start_iter, total_iters + 1):
         observability.on_iteration(policy_step)
-        hard_exit_point("player_exit")  # fault site: models a player crash
+        hard_exit_point("player_exit", index=player_id)  # fault site: a player crash
+        # fixed-lag params adoption: rollout k acts on EXACTLY the weights
+        # of update k - 1 - lag (warmup: the initial broadcast)
+        try:
+            frame = follower.params_for_round(iter_num)
+        except PeerDiedError as e:
+            _die_with_dump(e, policy_step, iter_num)
+        new_params = _adopt(frame) if frame is not None else player.params
+
         for _ in range(cfg.algo.rollout_steps):
+            # policy steps are GLOBAL (all players advance in lockstep
+            # modulo the lag), so counters keep the 1x1 meaning
             policy_step += cfg.env.num_envs
 
             with timer("Time/env_interaction_time", SumMetric, sync_on_compute=False):
@@ -276,8 +373,8 @@ def _player_loop(
                     rewards[truncated_envs] += cfg.algo.gamma * vals[truncated_envs].reshape(
                         rewards[truncated_envs].shape
                     )
-                dones = np.logical_or(terminated, truncated).reshape(total_envs, 1).astype(np.uint8)
-                rewards = clip_rewards_fn(rewards).reshape(total_envs, 1).astype(np.float32)
+                dones = np.logical_or(terminated, truncated).reshape(n_local_envs, 1).astype(np.uint8)
+                rewards = clip_rewards_fn(rewards).reshape(n_local_envs, 1).astype(np.float32)
 
             for k in obs_keys:
                 step_data[k] = next_obs_np[k][np.newaxis]
@@ -290,7 +387,7 @@ def _player_loop(
 
             next_obs_np = obs
 
-            if cfg.metric.log_level > 0 and "final_info" in info:
+            if lead and cfg.metric.log_level > 0 and "final_info" in info:
                 ep = info["final_info"].get("episode")
                 if ep is not None:
                     for i in np.nonzero(info["final_info"]["_episode"])[0]:
@@ -302,68 +399,75 @@ def _player_loop(
                             aggregator.update("Game/ep_len_avg", ep_len)
                         runtime.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}")
 
-        # --------------------------------------------- ship rollout to trainer
+        # --------------------------------------------- ship the shard
         # preemption rides the cadence: a pending SIGTERM makes
-        # should_checkpoint True, so this message also requests the trainer
+        # should_checkpoint True, so this shard also requests the trainer
         # state needed for a full (resumable) emergency checkpoint
-        need_ckpt = ckpt_mgr.should_checkpoint(policy_step, is_last=iter_num == total_iters)
+        need_ckpt = (
+            ckpt_mgr.should_checkpoint(policy_step, is_last=iter_num == total_iters) if lead else False
+        )
         local_data = {k: np.asarray(v) for k, v in rb.to_arrays().items()}
-        final_obs = {k: np.asarray(next_obs_np[k]) for k in obs_keys}
-        sent = False
-        if rollout_tx is not None:
-            arrays = [(f"d/{k}", v) for k, v in local_data.items()] + [
-                (f"o/{k}", v) for k, v in final_obs.items()
-            ]
-            sent = rollout_tx.send(
-                lambda m: maybe_drop_or_delay_send(data_q.put, m),
-                "data_shm",
-                arrays,
-                (need_ckpt,),
-                acquire_slot=lambda: queue_get_from_peer(
-                    data_free_q, timeout=_QUEUE_TIMEOUT_S, peer_alive=parent_alive, who="trainer"
-                ),
+        arrays = [(f"d/{k}", v) for k, v in local_data.items()] + [
+            (f"o/{k}", np.asarray(next_obs_np[k])) for k in obs_keys
+        ]
+        try:
+            with trace_scope("ipc_send_shard"):
+                channel.send("data", arrays=arrays, extra=(need_ckpt,), seq=iter_num, timeout=_QUEUE_TIMEOUT_S)
+        except PeerDiedError as e:
+            _die_with_dump(e, policy_step, iter_num)
+
+        # --------------------------------------------- checkpoint barrier
+        # (lead only): the save needs the params + opt-state OF THIS ROUND,
+        # so the fixed lag collapses for one round — named span: in a
+        # profiler trace this wait IS the decoupled topology's comms/train
+        # stall as seen from the player
+        if need_ckpt:
+            try:
+                with trace_scope("ipc_wait_update"):
+                    frame = follower.advance_to(iter_num)
+            except PeerDiedError as e:
+                _die_with_dump(e, policy_step, iter_num)
+            if frame is not None:
+                new_params = _adopt(frame)
+            # iter_num/batch_size stored in coupled units (scaled by the
+            # trainer mesh size) so checkpoints swap between variants
+            ckpt_mgr.checkpoint_now(
+                policy_step=policy_step,
+                state_fn=lambda: {
+                    "agent": new_params,
+                    "optimizer": latest_opt_np,
+                    "iter_num": iter_num * world_size,
+                    "batch_size": cfg.algo.per_rank_batch_size * world_size,
+                    "last_log": last_log * world_size,
+                    "last_checkpoint": ckpt_mgr.last_checkpoint * world_size,
+                },
             )
-        if not sent:
-            maybe_drop_or_delay_send(data_q.put, ("data", local_data, final_obs, need_ckpt))
+            if ckpt_mgr.preempted:
+                # the full emergency checkpoint is on disk (need_ckpt was
+                # forced by the pending signal) — stop cleanly
+                runtime.print(
+                    f"Preemption signal: emergency checkpoint written, stopping at iter {iter_num}"
+                )
+                break
+        if preemption is not None and preemption.preempted:
+            # non-lead worker: nothing to save — drain out so the fan-in
+            # shrinks cleanly instead of the trainer timing out on us
+            break
 
-        # --------------------------------------------- refreshed weights back
-        # named span: in a profiler trace this wait IS the decoupled
-        # topology's comms/train stall as seen from the player
-        with trace_scope("ipc_wait_update"):
-            reply = _trainer_reply(policy_step, iter_num)
-        if reply[0] == "update_shm":
-            _, arena_info, slot, leaves_meta, train_metrics, opt_state_np, info_scalars = reply
-            # copy=True: the player keeps these weights past the slot release
-            new_params = _unflat_leaves(
-                params_treedef, params_rx.unpack(arena_info, slot, leaves_meta, copy=True)
-            )
-            params_rx.release(slot)
-        else:
-            tag, new_params, train_metrics, opt_state_np, info_scalars = reply
-            assert tag == "update", f"expected update, got {tag}"
-        # hand the numpy tree straight to the setter: jnp.asarray here would
-        # place the fresh params on the DEFAULT backend (the tunnel-attached
-        # chip) and the setter's transfer to the host-CPU player would then
-        # round-trip every leaf over the link — ~1 s/iteration, observed as
-        # decoupled running 5x slower than coupled before this change
-        player.params = new_params
-        train_step += 1
-        train_time_window += info_scalars.pop("train_time", 0.0)
-        trainer_compiles = info_scalars.pop("trainer_compiles", trainer_compiles)
-
-        if aggregator and not aggregator.disabled:
-            for k, v in train_metrics.items():
-                aggregator.update(k, v)
-
-        # --------------------------------------------- logging (player-side)
-        if cfg.metric.log_level > 0 and logger:
-            logger.log_metrics(info_scalars, policy_step)
+        # --------------------------------------------- logging (lead-side)
+        if lead and cfg.metric.log_level > 0 and logger:
+            if latest_info_scalars:
+                logger.log_metrics(latest_info_scalars, policy_step)
+                latest_info_scalars = {}
             if policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters:
+                extra = {"trainer_compiles": trainer_compiles}
+                if latest_transport_stats is not None:
+                    extra["transport"] = latest_transport_stats
                 observability.on_log(
                     policy_step,
                     train_step,
                     train_time_s=train_time_window,
-                    extra={"trainer_compiles": trainer_compiles},
+                    extra=extra,
                 )
                 if aggregator and not aggregator.disabled:
                     logger.log_metrics(aggregator.compute(), policy_step)
@@ -390,57 +494,94 @@ def _player_loop(
                 last_log = policy_step
                 last_train = train_step
 
-        # --------------------------------------------- checkpoint (player saves,
-        # trainer state received on demand — reference on_checkpoint_player :337)
-        if need_ckpt:
-            # iter_num/batch_size stored in coupled units (scaled by the
-            # trainer mesh size) so checkpoints swap between variants
-            ckpt_mgr.checkpoint_now(
-                policy_step=policy_step,
-                state_fn=lambda: {
-                    "agent": new_params,
-                    "optimizer": opt_state_np,
-                    "iter_num": iter_num * world_size,
-                    "batch_size": cfg.algo.per_rank_batch_size * world_size,
-                    "last_log": last_log * world_size,
-                    "last_checkpoint": ckpt_mgr.last_checkpoint * world_size,
-                },
-            )
-            if ckpt_mgr.preempted:
-                # the full emergency checkpoint is on disk (need_ckpt was
-                # forced by the pending signal) — stop cleanly
-                runtime.print(
-                    f"Preemption signal: emergency checkpoint written, stopping at iter {iter_num}"
-                )
-                break
-        # a signal that landed AFTER the data message was shipped finds
-        # need_ckpt False; run ONE more iteration — its need_ckpt is then
-        # forced True and fetches the trainer state the full save needs
-
+    # drain the in-flight params broadcast before closing: the trainer
+    # answers the final shard too, and a socket closed with UNREAD data
+    # resets the connection — destroying the broadcast mid-send on the
+    # trainer and the stop sentinel below with it
+    try:
+        frame = follower.advance_to(iter_num, timeout=60.0)
+        if frame is not None:
+            _adopt(frame)
+    except Exception:
+        pass  # a dead/strangled trainer: nothing left to drain
     # shutdown sentinel (reference scatters -1, :344)
-    data_q.put(("stop",))
-    if rollout_tx is not None:
-        rollout_tx.close()
-    if params_rx is not None:
-        params_rx.close()
-    ckpt_mgr.close()
+    try:
+        channel.send("stop")
+    except Exception:
+        pass  # a dead trainer cannot receive it; exit anyway
+    if ckpt_mgr is not None:
+        ckpt_mgr.close()
+    if preemption is not None:
+        preemption.uninstall()
     envs.close()
     observability.close()
-    if cfg.algo.run_test:
+    if lead and cfg.algo.run_test:
         test_rew = test(player, runtime, cfg, log_dir)
         if logger:
             logger.log_metrics({"Test/cumulative_reward": test_rew}, policy_step)
     if logger:
         logger.finalize()
+    channel.close()
+
+
+def spawn_players(cfg, runtime, ctx, target, extra_args=(), knobs=None):
+    """Create the transport + spawn ``num_players`` player processes
+    pinned to the host CPU backend (shared with sac_decoupled).
+
+    Returns ``(hub, fanin_channels, procs, env_shards)``.
+    """
+    knobs = knobs or decoupled_knobs(cfg)
+    num_players = knobs["num_players"]
+    total_envs = int(cfg.env.num_envs)
+    env_shards = split_envs(total_envs, num_players)
+    hub, specs = make_transport(
+        ctx,
+        knobs["backend"],
+        num_players,
+        window=knobs["window"],
+        compress_min=knobs["compress_min"],
+        host=knobs["host"],
+        port=knobs["port"],
+    )
+    procs = []
+    # the env copies the parent's environ at start, so the override only
+    # affects the children
+    saved_platform = os.environ.get("JAX_PLATFORMS")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        for pid, (offset, count) in enumerate(env_shards):
+            proc = ctx.Process(
+                target=target,
+                args=(cfg, specs[pid]) + tuple(extra_args) + (offset, count),
+                daemon=False,
+            )
+            proc.start()
+            procs.append(proc)
+    finally:
+        if saved_platform is None:
+            os.environ.pop("JAX_PLATFORMS", None)
+        else:
+            os.environ["JAX_PLATFORMS"] = saved_platform
+
+    channels = {}
+    for pid, proc in enumerate(procs):
+        ch = hub.channel(pid, timeout=_QUEUE_TIMEOUT_S, peer_alive=proc.is_alive)
+        ch.set_peer(
+            child_alive(proc),
+            f"player[{pid}]",
+            detail_fn=lambda proc=proc: f"exitcode={proc.exitcode}",
+        )
+        channels[pid] = ch
+    return hub, channels, procs, env_shards
 
 
 @register_algorithm(decoupled=True)
 def main(runtime, cfg: Dict[str, Any]):
     """Trainer process body + player spawn (reference ppo_decoupled.py:368-621).
 
-    The trainer never touches an env: it answers each rollout message with
-    refreshed weights, running the coupled PPO single-jit update over the
-    mesh (the reference's DDP trainer subgroup)."""
+    The trainer never touches an env: it assembles each round's global
+    batch from the per-player shards, runs the coupled PPO single-jit
+    update over the mesh, and broadcasts the refreshed weights."""
     if "minedojo" in str(cfg.env.wrapper.get("_target_", "")).lower():
         raise ValueError(
             "MineDojo is not currently supported by the PPO agent (no action-mask handling); "
@@ -451,6 +592,7 @@ def main(runtime, cfg: Dict[str, Any]):
     initial_clip_coef = copy.deepcopy(cfg.algo.clip_coef)
 
     runtime.seed_everything(cfg.seed)
+    knobs = decoupled_knobs(cfg)
 
     state = None
     if cfg.checkpoint.resume_from:
@@ -470,75 +612,53 @@ def main(runtime, cfg: Dict[str, Any]):
         state["last_checkpoint"] // runtime.world_size if state else 0,
     )
 
-    # spawn the player pinned to the host CPU backend: the env copies the
-    # parent's environ at start, so the override only affects the child
     ctx = mp.get_context("spawn")
-    data_q: mp.Queue = ctx.Queue()
-    resp_q: mp.Queue = ctx.Queue()
-    # free-slot queues for the shm rings (queues must be created before the
-    # spawn — they cannot ride another queue); unused on transport=queue
-    data_free_q: mp.Queue = ctx.Queue()
-    resp_free_q: mp.Queue = ctx.Queue()
-    saved_platform = os.environ.get("JAX_PLATFORMS")
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    try:
-        player_proc = ctx.Process(
-            target=_player_loop,
-            args=(cfg, data_q, resp_q, data_free_q, resp_free_q, counters, runtime.world_size),
-            daemon=False,
-        )
-        player_proc.start()
-    finally:
-        if saved_platform is None:
-            os.environ.pop("JAX_PLATFORMS", None)
-        else:
-            os.environ["JAX_PLATFORMS"] = saved_platform
+    hub, channels, procs, env_shards = spawn_players(
+        cfg, runtime, ctx, _player_loop, extra_args=(counters, runtime.world_size), knobs=knobs
+    )
+    rollout_steps = int(cfg.algo.rollout_steps)
+    fanin = FanIn(
+        channels,
+        env_steps_per_frame={pid: count * rollout_steps for pid, (_, count) in enumerate(env_shards)},
+    )
 
     # a SIGTERM delivered to the trainer only (per-process preemption) is
-    # forwarded to the player, which owns the checkpoint files and runs the
-    # emergency-save path; the trainer just keeps answering until "stop"
-    preemption = PreemptionHandler(forward_to=[player_proc]).install()
+    # forwarded to every player; the lead owns the checkpoint files and
+    # runs the emergency-save path, the others drain out cleanly
+    preemption = PreemptionHandler(forward_to=list(procs)).install()
 
-    def _player_msg(what: str):
-        """Queue get that notices a dead player within ~a second. The
-        trainer owns no run dir, so its final dump lands next to the run
-        root with a distinctive name (partial state: params + optimizer)."""
+    def _dump_and_raise(e: PeerDiedError, what: str):
+        """Every player died: final trainer dump + a clear error (the
+        trainer owns no run dir, so the dump lands next to the run root)."""
+        path = None
         try:
-            return queue_get_from_peer(
-                data_q,
-                timeout=_QUEUE_TIMEOUT_S,
-                peer_alive=child_alive(player_proc),
-                who="player",
-                detail_fn=lambda: f"exitcode={player_proc.exitcode}",
-            )
-        except PeerDiedError as e:
-            path = None
-            try:
-                from sheeprl_tpu.utils.ckpt_format import save_state
+            from sheeprl_tpu.utils.ckpt_format import save_state
 
-                dump_dir = os.path.join(str(cfg.root_dir), str(cfg.run_name))
-                os.makedirs(dump_dir, exist_ok=True)
-                path = save_state(
-                    os.path.join(dump_dir, "emergency_trainer_0.ckpt"),
-                    _np_tree({"agent": params, "optimizer": opt_state}),
-                )
-            except Exception:
-                pass
-            raise RuntimeError(
-                f"decoupled player process died (exitcode={player_proc.exitcode}) while the "
-                f"trainer waited for a {what} message; trainer params/optimizer dumped to {path} "
-                "(partial state: resume from the last regular ckpt_*.ckpt instead)"
-            ) from e
+            dump_dir = os.path.join(str(cfg.root_dir), str(cfg.run_name))
+            os.makedirs(dump_dir, exist_ok=True)
+            path = save_state(
+                os.path.join(dump_dir, "emergency_trainer_0.ckpt"),
+                _np_tree({"agent": params, "optimizer": opt_state}),
+            )
+        except Exception:
+            pass
+        raise RuntimeError(
+            f"decoupled player process died (all {knobs['num_players']} players gone: {e}) while "
+            f"the trainer waited for a {what} message; trainer params/optimizer dumped to {path} "
+            "(partial state: resume from the last regular ckpt_*.ckpt instead)"
+        ) from e
 
     try:
-        tag, observation_space, actions_dim, is_continuous = queue_get_from_peer(
-            data_q,
-            timeout=_QUEUE_TIMEOUT_S,
-            peer_alive=child_alive(player_proc),
-            who="player",
-            detail_fn=lambda: f"exitcode={player_proc.exitcode}",
-        )
-        assert tag == "init", f"expected init, got {tag}"
+        # agent blueprint: every live player greets; any one of them works
+        try:
+            _, init_frames = fanin.gather(timeout=_QUEUE_TIMEOUT_S, data_tag="init")
+        except PeerDiedError as e:
+            params = opt_state = None
+            _dump_and_raise(e, "init")
+        first = next(iter(init_frames.values()))
+        observation_space, actions_dim, is_continuous = first.extra
+        for f in init_frames.values():
+            f.release()
         obs_keys = cfg.algo.cnn_keys.encoder + cfg.algo.mlp_keys.encoder
 
         module, params = build_agent(
@@ -559,19 +679,14 @@ def main(runtime, cfg: Dict[str, Any]):
         update_fn = make_update_fn(runtime, module, tx, cfg, obs_keys)
 
         # trainer-side recompile watch: the jitted update lives in THIS
-        # process, so its retraces are invisible to the player's telemetry
-        # unless the count rides the update messages (info_scalars)
+        # process, so its retraces are invisible to the lead's telemetry
+        # unless the count rides the params frames
         from sheeprl_tpu.obs import RecompileMonitor
 
         trainer_mon = RecompileMonitor(name="ppo_decoupled_trainer").install()
 
-        use_shm = decoupled_transport_setting(cfg) == "shm"
-        rollout_rx = ShmReceiver(data_free_q) if use_shm else None
-        params_tx = ShmSender(resp_free_q) if use_shm else None
-
-        # initial weights to the player (reference broadcast, :126; one-off
-        # message — the pickled path is fine before the ring exists)
-        resp_q.put(("params", _np_tree(params)))
+        # initial weights to every player (reference broadcast, :126)
+        fanin.broadcast("params", arrays=_flat_leaves(_np_tree(params)), seq=start_iter - 1)
 
         policy_steps_per_iter = int(cfg.env.num_envs * cfg.algo.rollout_steps)
         total_iters = cfg.algo.total_steps // policy_steps_per_iter if not cfg.dry_run else 1
@@ -581,36 +696,47 @@ def main(runtime, cfg: Dict[str, Any]):
         current_clip = float(cfg.algo.clip_coef)
         current_ent = float(cfg.algo.ent_coef)
 
-        iter_num = start_iter - 1
+        known_live = len(fanin.live)
         while True:
-            # named span: the trainer idling for the next rollout (the
-            # inverse of the player's ipc_wait_update stall)
-            with trace_scope("ipc_wait_rollout"):
-                msg = _player_msg("rollout")
-            if msg[0] == "stop":
-                break
-            if msg[0] == "data_shm":
-                _, arena_info, slot, leaves_meta, need_ckpt = msg
-                views = rollout_rx.unpack(arena_info, slot, leaves_meta, copy=False)
-                local_data = {k[2:]: v for k, v in views.items() if k.startswith("d/")}
-                final_obs = {k[2:]: np.array(v) for k, v in views.items() if k.startswith("o/")}
-                del views  # the conversion below replaces the slot views
-            else:
-                _, local_data, final_obs, need_ckpt = msg
-                slot = None
-            iter_num += 1
+            # named span: the trainer idling for the next fan-in round (the
+            # inverse of the players' ipc_wait_update stall)
+            try:
+                with trace_scope("ipc_wait_rollout"):
+                    seq, frames = fanin.gather(timeout=_QUEUE_TIMEOUT_S)
+            except PeerDiedError as e:
+                _dump_and_raise(e, "rollout")
+            if not frames:
+                break  # every player stopped
+            if len(fanin.live) != known_live:
+                known_live = len(fanin.live)
+                runtime.print(
+                    f"fan-in shrank to {known_live} player(s) "
+                    f"(dead: {sorted(fanin.dead)}): batch reshapes, one XLA recompile"
+                )
+            iter_num = seq
+            need_ckpt = bool(frames[0].extra[0]) if 0 in frames else False
 
-            # the astype/copy below materializes private arrays, so a shm
-            # slot can be handed back right after (views die with it)
-            local_data = {
-                k: v.astype(np.float32) if v.dtype not in (np.uint8,) else np.array(v)
-                for k, v in local_data.items()
-            }
-            if msg[0] == "data_shm":
-                rollout_rx.release(slot)
+            # per-player shard -> materialized arrays (the astype/copy
+            # below frees the transport buffers right after)
+            data_shards: Dict[int, Dict[str, np.ndarray]] = {}
+            obs_shards: Dict[int, Dict[str, np.ndarray]] = {}
+            for pid, frame in frames.items():
+                data_shards[pid] = {
+                    k[2:]: (v.astype(np.float32) if v.dtype not in (np.uint8,) else np.array(v))
+                    for k, v in frame.arrays.items()
+                    if k.startswith("d/")
+                }
+                obs_shards[pid] = {
+                    k[2:]: np.array(v) for k, v in frame.arrays.items() if k.startswith("o/")
+                }
+                frame.release()
+            # deterministic global layout: env axis concatenated in
+            # player-id order regardless of shard arrival order
+            local_data = assemble_shards(data_shards, axis=1)
+            final_obs = assemble_shards(obs_shards, axis=0)
+
             # env-axis sharding feeds each mesh device only its columns
-            # (the shard_map update path consumes this layout); the
-            # decoupled rollout's env axis is num_envs itself, so an
+            # (the shard_map update path consumes this layout); an
             # indivisible count stays unsharded (replicated fallback)
             if next(iter(local_data.values())).shape[1] % runtime.world_size == 0:
                 local_data = runtime.shard_batch(local_data, axis=1)
@@ -659,39 +785,31 @@ def main(runtime, cfg: Dict[str, Any]):
                 )
 
             opt_np = _np_tree(opt_state) if need_ckpt else None
-            sent = False
-            if params_tx is not None:
-                sent = params_tx.send(
-                    lambda m: maybe_drop_or_delay_send(resp_q.put, m),
-                    "update_shm",
-                    _flat_leaves(_np_tree(params)),
-                    (train_metrics, opt_np, info_scalars),
-                    acquire_slot=lambda: queue_get_from_peer(
-                        resp_free_q,
-                        timeout=_QUEUE_TIMEOUT_S,
-                        peer_alive=child_alive(player_proc),
-                        who="player",
-                    ),
-                )
-            if not sent:
-                maybe_drop_or_delay_send(
-                    resp_q.put,
-                    ("update", _np_tree(params), train_metrics, opt_np, info_scalars),
-                )
+            stats = fanin.stats(knobs["backend"])
+            stats["events"] = fanin.events[-8:]
+            fanin.broadcast(
+                "params",
+                arrays=_flat_leaves(_np_tree(params)),
+                seq=iter_num,
+                extra_fn=lambda pid: (
+                    train_metrics,
+                    opt_np if pid == 0 else None,
+                    info_scalars,
+                    stats if pid == 0 else None,
+                ),
+            )
             hard_exit_point("trainer_exit")  # fault site: trainer crash after replying
 
         trainer_mon.uninstall()
-        # the player still runs its test episode + logger shutdown after the
+        # the lead still runs its test episode + logger shutdown after the
         # stop sentinel — give it ample time before the terminate fallback
-        player_proc.join(timeout=3600.0)
+        for proc in procs:
+            proc.join(timeout=3600.0)
     finally:
         preemption.uninstall()
-        try:
-            if use_shm:
-                rollout_rx.close()
-                params_tx.close()
-        except NameError:  # death before the endpoints were created
-            pass
-        if player_proc.is_alive():
-            player_proc.terminate()
-            player_proc.join()
+        fanin.close()
+        hub.close()
+        for proc in procs:
+            if proc.is_alive():
+                proc.terminate()
+                proc.join()

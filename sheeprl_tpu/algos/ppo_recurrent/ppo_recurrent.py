@@ -38,7 +38,7 @@ from sheeprl_tpu.algos.ppo_recurrent.utils import prepare_obs, test
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.obs import setup_observability, trace_scope
-from sheeprl_tpu.parallel.pipeline import OnPolicyCollector, PipelinedCollector, RolloutPayload, detach_copy
+from sheeprl_tpu.parallel.pipeline import OnPolicyCollector, PipelinedCollector, RolloutPayload, detach_copy, resolve_overlap_setting
 from sheeprl_tpu.resilience import CheckpointManager
 from sheeprl_tpu.utils.callback import load_checkpoint
 from sheeprl_tpu.utils.env import make_env
@@ -444,8 +444,10 @@ def main(runtime, cfg: Dict[str, Any]):
     # ------------------------------------------------------------- run
     # collect/train pipeline: overlap_collect=True steps iteration t+1's
     # envs on a background thread while iteration t trains (params
-    # staleness <= 1); False keeps the serial pre-pipeline order bit-exact
-    overlap = bool(cfg.algo.get("overlap_collect", False))
+    # staleness <= 1); False keeps the serial pre-pipeline order bit-exact;
+    # "auto" turns it on only where a spare host core exists for the
+    # collector thread (single-core hosts stay serial)
+    overlap = resolve_overlap_setting(cfg)
     if overlap:
         # the player's device_put is a no-op on a same-device tree, so its
         # initial weights alias the buffers update 1 donates — detach them

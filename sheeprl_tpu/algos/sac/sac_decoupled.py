@@ -1,50 +1,59 @@
-"""SAC decoupled — CPU-player / TPU-learner topology.
+"""SAC decoupled — N CPU players fanning sampled batches into one TPU learner.
 
 Counterpart of reference sheeprl/algos/sac/sac_decoupled.py (player:33,
-trainer:356, main:548). Same process split as
-``sheeprl_tpu.algos.ppo.ppo_decoupled`` (which see for the mapping from
-the reference's TorchCollective groups to host IPC queues), with the
-off-policy twists of the reference:
+trainer:356, main:548).  Same N-player fan-in as
+``sheeprl_tpu.algos.ppo.ppo_decoupled`` (which see for the transport and
+staleness machinery), with the off-policy twists of the reference:
 
-- the PLAYER owns the replay buffer and the ``Ratio`` replay-ratio
-  scheduler: each iteration past ``learning_starts`` it samples
-  ``G x batch_size`` transitions in one call and ships them (reference
-  sample-and-scatter, sac_decoupled.py:243-257);
-- the trainer runs the coupled SAC single-jit ``lax.scan`` over the G
-  gradient steps and answers with refreshed ACTOR weights only (the critics
-  never act; reference broadcasts the actor vector, :261-263), plus the
-  full agent + optimizer state when the player flags a checkpoint
-  (reference on_checkpoint_player, :314).
+- each PLAYER owns a shard of the envs AND of the replay buffer; every
+  iteration past ``learning_starts`` the shared ``Ratio`` schedule (all
+  players compute it on the same GLOBAL policy-step clock, so the
+  per-round gradient-step count ``g`` agrees by construction) makes it
+  sample ``g x batch_size/num_players`` transitions and ship them as
+  update round ``u``'s shard;
+- the trainer concatenates the per-player shards in player-id order into
+  the ``(g, batch)`` layout, runs the coupled SAC ``lax.scan`` over the G
+  gradient steps, and broadcasts refreshed ACTOR weights (seq = u) — the
+  critics never act;
+- the LEAD player (id 0) owns logger/telemetry/checkpoints; its
+  ``ckpt_req`` control frame fetches the full agent + optimizer state on
+  demand (reference on_checkpoint_player, :314);
+- a crashed player shrinks the fan-in (smaller effective batch, one XLA
+  recompile) instead of killing the run.
 """
 
 from __future__ import annotations
 
 import multiprocessing as mp
-import os
 import warnings
+import os
 from typing import Any, Dict
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from sheeprl_tpu.algos.ppo.ppo_decoupled import _QUEUE_TIMEOUT_S, _flat_leaves, _np_tree, _unflat_leaves
+from sheeprl_tpu.algos.ppo.ppo_decoupled import (
+    _QUEUE_TIMEOUT_S,
+    _flat_leaves,
+    _np_tree,
+    _unflat_leaves,
+    decoupled_knobs,
+    spawn_players,
+)
 from sheeprl_tpu.algos.sac.agent import SACPlayer, build_agent
 from sheeprl_tpu.algos.sac.sac import _make_optimizer, make_train_fn
 from sheeprl_tpu.algos.sac.utils import prepare_obs, test
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.obs import setup_observability, trace_scope
-from sheeprl_tpu.parallel.shm_ring import ShmReceiver, ShmSender, decoupled_transport_setting
+from sheeprl_tpu.parallel.transport import FanIn, ParamsFollower, assemble_shards, split_envs
 from sheeprl_tpu.resilience import (
     CheckpointManager,
     PeerDiedError,
     PreemptionHandler,
-    child_alive,
     hard_exit_point,
-    maybe_drop_or_delay_send,
     parent_alive,
-    queue_get_from_peer,
 )
 from sheeprl_tpu.utils.callback import load_checkpoint, restore_buffer
 from sheeprl_tpu.utils.env import make_env
@@ -57,8 +66,7 @@ from sheeprl_tpu.optim import restore_opt_states
 
 
 def _player_loop(
-    cfg, data_q: mp.Queue, resp_q: mp.Queue, data_free_q: mp.Queue, resp_free_q: mp.Queue,
-    state_counters, ratio_state, world_size: int,
+    cfg, spec, state_counters, ratio_state, world_size: int, env_offset: int, n_local_envs: int
 ) -> None:
     """Player process body (reference sac_decoupled.py:33-353)."""
     import gymnasium as gym
@@ -67,9 +75,12 @@ def _player_loop(
     from sheeprl_tpu.cli import install_stack_dumper
     from sheeprl_tpu.parallel.mesh import MeshRuntime
 
-    install_stack_dumper(suffix=".player")
+    player_id = spec.player_id
+    lead = player_id == 0
+    knobs = decoupled_knobs(cfg)
+    install_stack_dumper(suffix=f".player{player_id}")
 
-    if cfg.metric.log_level == 0:
+    if cfg.metric.log_level == 0 or not lead:
         MetricAggregator.disabled = True
         timer.disabled = True
     if cfg.metric.get("disable_timer", False):
@@ -77,19 +88,22 @@ def _player_loop(
 
     runtime = MeshRuntime(devices=1, accelerator="cpu", precision=cfg.fabric.precision)
     runtime.launch()
-    runtime.seed_everything(cfg.seed)
+    runtime.seed_everything(cfg.seed + player_id)
 
-    logger = get_logger(runtime, cfg)
-    log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name)
-    runtime.print(f"Log dir: {log_dir}")
-    observability = setup_observability(runtime, cfg, log_dir, logger=logger)
+    logger = get_logger(runtime, cfg) if lead else None
+    if lead:
+        log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name)
+        runtime.print(f"Log dir: {log_dir}")
+    else:
+        log_dir = os.path.join(str(cfg.root_dir), str(cfg.run_name), f"player_{player_id}")
+    observability = setup_observability(runtime, cfg, log_dir if lead else None, logger=logger)
     if logger:
         logger.log_hyperparams(cfg)
 
     total_envs = int(cfg.env.num_envs)
     thunks = [
-        make_env(cfg, cfg.seed + i, 0, log_dir, "train", vector_env_idx=i)
-        for i in range(total_envs)
+        make_env(cfg, cfg.seed + env_offset + i, 0, log_dir, "train", vector_env_idx=env_offset + i)
+        for i in range(n_local_envs)
     ]
     envs = (
         SyncVectorEnv(thunks, autoreset_mode=AutoresetMode.SAME_STEP)
@@ -112,44 +126,106 @@ def _player_loop(
             )
     mlp_keys = list(cfg.algo.mlp_keys.encoder)
 
-    data_q.put(("init", observation_space, action_space))
+    channel = spec.player_channel(peer_alive=parent_alive, who="trainer")
+    channel.send("init", extra=(observation_space, action_space))
 
     actor, critic, params, _ = build_agent(runtime, cfg, observation_space, action_space)
-    tag, payload = queue_get_from_peer(
-        resp_q, timeout=_QUEUE_TIMEOUT_S, peer_alive=parent_alive, who="trainer"
+    actor_treedef = jax.tree_util.tree_structure(params["actor"])
+
+    start_iter, policy_step, last_log, last_checkpoint = state_counters
+
+    train_step = 0
+    last_train = 0
+    train_time_window = 0.0
+    trainer_compiles = None  # trainer-side XLA compile count (rides the params frames)
+    latest_transport_stats = None
+    aggregator = None
+    if not MetricAggregator.disabled:
+        aggregator = instantiate(dict(cfg.metric.aggregator))
+
+    def _apply_params_extra(frame) -> None:
+        """Account a params frame's piggybacked trainer state (lead only)."""
+        nonlocal train_step, train_time_window, trainer_compiles, latest_transport_stats
+        train_step += world_size
+        if not lead or not frame.extra:
+            return
+        train_metrics, transport_stats = frame.extra
+        metrics = dict(train_metrics or {})
+        if transport_stats is not None:
+            latest_transport_stats = transport_stats
+        train_time_window += metrics.pop("train_time", 0.0)
+        trainer_compiles = metrics.pop("trainer_compiles", trainer_compiles)
+        if aggregator and not aggregator.disabled:
+            for k, v in metrics.items():
+                aggregator.update(k, v)
+
+    follower = ParamsFollower(
+        channel,
+        lag=knobs["lag"],
+        initial_seq=-1,
+        timeout=_QUEUE_TIMEOUT_S,
+        on_stale=_apply_params_extra,
     )
-    assert tag == "params", f"expected initial params, got {tag}"
+
+    def _adopt(frame) -> None:
+        """Copy actor weights out of the transport buffers; numpy straight
+        to the setter — see ppo_decoupled: jnp.asarray would stage the
+        params on the tunnel backend first."""
+        new_params = _unflat_leaves(actor_treedef, frame.arrays_copy())
+        _apply_params_extra(frame)
+        frame.release()
+        player.params = new_params
+
+    def _die_with_dump(e: PeerDiedError, policy_step_now: int, iter_now: int):
+        path = None
+        if lead and ckpt_mgr is not None:
+            path = ckpt_mgr.emergency_dump(
+                policy_step_now,
+                {
+                    "actor": player.params,
+                    "ratio": ratio.state_dict(),
+                    "iter_num": iter_now * world_size,
+                    "policy_step": policy_step_now,
+                },
+            )
+        raise RuntimeError(
+            f"decoupled trainer process died at policy_step={policy_step_now}; "
+            f"the player's last-known actor weights were dumped to {path} "
+            "(partial state: resume from the last regular ckpt_*.ckpt instead)"
+        ) from e
+
+    # initial actor weights (trainer broadcasts seq = 0 before round 1)
+    try:
+        init_frame = follower.advance_to(0)
+    except PeerDiedError as e:
+        raise RuntimeError(
+            f"decoupled trainer process died before the initial params broadcast "
+            f"reached player {player_id}"
+        ) from e
+    assert init_frame is not None
+    train_step = 0  # the initial broadcast is not an update
     # explicit host-CPU pin — see ppo_decoupled._player_loop: the axon PJRT
     # plugin ignores the JAX_PLATFORMS=cpu export and would otherwise run
     # every env step's action over the tunnel
     host_cpu = jax.local_devices(backend="cpu")[0]
     player = SACPlayer(
         actor,
-        payload,
-        lambda obs: prepare_obs(obs, mlp_keys=mlp_keys, num_envs=total_envs),
+        _unflat_leaves(actor_treedef, init_frame.arrays_copy()),
+        lambda obs: prepare_obs(obs, mlp_keys=mlp_keys, num_envs=n_local_envs),
         device=host_cpu,
     )
+    init_frame.release()
 
-    # zero-copy transport: sampled batches go out through a SharedMemory
-    # ring (control queue carries metadata only) and actor refreshes come
-    # back through the trainer's ring; "queue" keeps the legacy pickled path
-    use_shm = decoupled_transport_setting(cfg) == "shm"
-    sample_tx = ShmSender(data_free_q) if use_shm else None
-    params_rx = ShmReceiver(resp_free_q) if use_shm else None
-    actor_treedef = jax.tree_util.tree_structure(params["actor"])
+    if lead:
+        save_configs(cfg, log_dir)
 
-    save_configs(cfg, log_dir)
-
-    aggregator = None
-    if not MetricAggregator.disabled:
-        aggregator = instantiate(dict(cfg.metric.aggregator))
-
+    # per-player buffer shard: each player keeps ITS envs' transitions
     buffer_size = cfg.buffer.size // int(total_envs) if not cfg.dry_run else 1
     rb = ReplayBuffer(
         max(buffer_size, 1),
-        total_envs,
+        n_local_envs,
         memmap=cfg.buffer.memmap,
-        memmap_dir=os.path.join(log_dir, "memmap_buffer", "rank_0"),
+        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{player_id}"),
         obs_keys=("observations",),
     )
     # the buffer is restored here (not shipped through the spawn pipe): a
@@ -160,26 +236,23 @@ def _player_loop(
             restored = restore_buffer(
                 rb_state,
                 memmap=cfg.buffer.memmap,
-                memmap_dir=os.path.join(log_dir, "memmap_buffer", "rank_0"),
+                memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{player_id}"),
             )
             del rb_state
-            if restored.n_envs != total_envs:
+            if restored.n_envs != n_local_envs:
                 raise RuntimeError(
-                    f"The restored replay buffer tracks {restored.n_envs} envs but this run "
-                    f"steps {total_envs}; buffers only restore across runs with matching env "
-                    "counts (coupled runs step num_envs * world_size envs, decoupled num_envs)."
+                    f"The restored replay buffer tracks {restored.n_envs} envs but this player "
+                    f"steps {n_local_envs}; buffers only restore across runs with matching env "
+                    "counts per player (num_envs / num_players)."
                 )
             rb = restored
-    start_iter, policy_step, last_log, last_checkpoint = state_counters
-    # the player owns the checkpoint files AND its own preemption handler
-    # (the trainer forwards SIGTERM here; see main below)
-    ckpt_mgr = CheckpointManager(
-        runtime, cfg, log_dir, observability=observability, last_checkpoint=last_checkpoint
+
+    ckpt_mgr = (
+        CheckpointManager(runtime, cfg, log_dir, observability=observability, last_checkpoint=last_checkpoint)
+        if lead
+        else None
     )
-    train_step = 0
-    last_train = 0
-    train_time_window = 0.0
-    trainer_compiles = None  # trainer-side XLA compile count (rides train_metrics)
+    preemption = None if lead else PreemptionHandler().install()
     policy_steps_per_iter = int(total_envs)
     total_iters = int(cfg.algo.total_steps // policy_steps_per_iter) if not cfg.dry_run else 1
     learning_starts = cfg.algo.learning_starts // policy_steps_per_iter if not cfg.dry_run else 0
@@ -188,41 +261,27 @@ def _player_loop(
         learning_starts += start_iter
         prefill_steps += start_iter
 
+    # the Ratio runs on the GLOBAL policy-step clock (total_envs per
+    # iteration), so every player derives the SAME per-round gradient-step
+    # count g — the trainer asserts shard agreement on it
     ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
     if ratio_state is not None:
         ratio.load_state_dict(ratio_state)
 
-    step_data: Dict[str, np.ndarray] = {}
-    obs = envs.reset(seed=cfg.seed)[0]
+    # this player's share of the global batch (remainder to the first
+    # players, same deterministic split as the envs)
+    total_batch = int(cfg.algo.per_rank_batch_size) * world_size
+    batch_shards = split_envs(total_batch, knobs["num_players"])
+    local_batch = batch_shards[player_id][1]
 
-    def _trainer_reply(policy_step_now: int, iter_now: int):
-        """One protocol reply from the trainer. A dead trainer surfaces in
-        ~a second as a final emergency checkpoint + a clear error instead
-        of the full ``_QUEUE_TIMEOUT_S`` hang."""
-        try:
-            return queue_get_from_peer(
-                resp_q, timeout=_QUEUE_TIMEOUT_S, peer_alive=parent_alive, who="trainer"
-            )
-        except PeerDiedError as e:
-            path = ckpt_mgr.emergency_dump(
-                policy_step_now,
-                {
-                    "actor": player.params,
-                    "ratio": ratio.state_dict(),
-                    "iter_num": iter_now * world_size,
-                    "policy_step": policy_step_now,
-                },
-            )
-            raise RuntimeError(
-                f"decoupled trainer process died at policy_step={policy_step_now}; "
-                f"the player's last-known actor weights were dumped to {path} "
-                "(partial state: resume from the last regular ckpt_*.ckpt instead)"
-            ) from e
+    step_data: Dict[str, np.ndarray] = {}
+    obs = envs.reset(seed=cfg.seed + env_offset)[0]
 
     cumulative_per_rank_gradient_steps = 0
+    update_round = 0
     for iter_num in range(start_iter, total_iters + 1):
         observability.on_iteration(policy_step)
-        hard_exit_point("player_exit")  # fault site: models a player crash
+        hard_exit_point("player_exit", index=player_id)  # fault site: a player crash
         policy_step += policy_steps_per_iter
 
         with timer("Time/env_interaction_time", SumMetric, sync_on_compute=False):
@@ -233,9 +292,9 @@ def _player_loop(
             next_obs, rewards, terminated, truncated, infos = envs.step(
                 actions.reshape(envs.action_space.shape)
             )
-            rewards = rewards.reshape(total_envs, -1)
+            rewards = rewards.reshape(n_local_envs, -1)
 
-        if cfg.metric.log_level > 0 and "final_info" in infos:
+        if lead and cfg.metric.log_level > 0 and "final_info" in infos:
             ep = infos["final_info"].get("episode")
             if ep is not None:
                 for i in np.nonzero(infos["final_info"]["_episode"])[0]:
@@ -251,9 +310,9 @@ def _player_loop(
                     real_next_obs[k][idx] = v
         flat_next_obs = np.concatenate([real_next_obs[k] for k in mlp_keys], axis=-1).astype(np.float32)
 
-        step_data["terminated"] = terminated.reshape(1, total_envs, -1).astype(np.uint8)
-        step_data["truncated"] = truncated.reshape(1, total_envs, -1).astype(np.uint8)
-        step_data["actions"] = actions.reshape(1, total_envs, -1).astype(np.float32)
+        step_data["terminated"] = terminated.reshape(1, n_local_envs, -1).astype(np.uint8)
+        step_data["truncated"] = truncated.reshape(1, n_local_envs, -1).astype(np.uint8)
+        step_data["actions"] = actions.reshape(1, n_local_envs, -1).astype(np.float32)
         step_data["observations"] = np.concatenate([obs[k] for k in mlp_keys], axis=-1).astype(np.float32)[
             np.newaxis
         ]
@@ -263,72 +322,48 @@ def _player_loop(
         rb.add(step_data, validate_args=cfg.buffer.validate_args)
         obs = next_obs
 
-        # ------------------------------------------ sample-and-ship to trainer
+        # ------------------------------------------ sample-and-ship shard
         if iter_num >= learning_starts:
-            # decoupled policy_step advances num_envs per iter (no world
-            # factor), so the ratio argument is already in coupled's
-            # per-rank scale — do NOT divide by world_size
+            # global-clock ratio: policy_step already advances total_envs
+            # per iter, which is coupled's per-rank scale
             per_rank_gradient_steps = ratio(policy_step - prefill_steps + policy_steps_per_iter)
             if per_rank_gradient_steps > 0:
                 g = per_rank_gradient_steps
+                update_round += 1
                 sample = rb.sample(
-                    batch_size=g * cfg.algo.per_rank_batch_size * world_size,
+                    batch_size=g * local_batch,
                     sample_next_obs=cfg.buffer.sample_next_obs,
                 )
-                sample = {k: np.asarray(v) for k, v in sample.items()}
-                sent = False
-                if sample_tx is not None:
-                    sent = sample_tx.send(
-                        lambda m: maybe_drop_or_delay_send(data_q.put, m),
-                        "data_shm",
-                        list(sample.items()),
-                        (g, iter_num),
-                        acquire_slot=lambda: queue_get_from_peer(
-                            data_free_q,
+                sample = [(k, np.asarray(v)) for k, v in sample.items()]
+                try:
+                    with trace_scope("ipc_send_shard"):
+                        channel.send(
+                            "data", arrays=sample, extra=(g, iter_num), seq=update_round,
                             timeout=_QUEUE_TIMEOUT_S,
-                            peer_alive=parent_alive,
-                            who="trainer",
-                        ),
-                    )
-                if not sent:
-                    maybe_drop_or_delay_send(data_q.put, ("data", sample, g, iter_num))
-
-                # named span: the player stalling on the trainer (IPC +
-                # train dispatch) — the decoupled topology's comms cost
-                with trace_scope("ipc_wait_update"):
-                    reply = _trainer_reply(policy_step, iter_num)
-                if reply[0] == "update_shm":
-                    _, arena_info, slot, leaves_meta, train_metrics = reply
-                    # copy=True: the player keeps the weights past the release
-                    actor_params = _unflat_leaves(
-                        actor_treedef, params_rx.unpack(arena_info, slot, leaves_meta, copy=True)
-                    )
-                    params_rx.release(slot)
-                else:
-                    tag, actor_params, train_metrics = reply
-                    assert tag == "update", f"expected update, got {tag}"
-                # numpy straight to the setter — see ppo_decoupled: jnp.asarray
-                # would stage the params on the tunnel backend first
-                player.params = actor_params
+                        )
+                    # fixed-lag adoption: after shipping round u, act on the
+                    # actor of update u - lag (lag 0 = the lock-step protocol)
+                    with trace_scope("ipc_wait_update"):
+                        frame = follower.params_for_round(update_round + 1)
+                except PeerDiedError as e:
+                    _die_with_dump(e, policy_step, iter_num)
+                if frame is not None:
+                    _adopt(frame)
                 cumulative_per_rank_gradient_steps += g
-                train_step += world_size
-                train_time_window += train_metrics.pop("train_time", 0.0)
-                trainer_compiles = train_metrics.pop("trainer_compiles", trainer_compiles)
-                if aggregator and not aggregator.disabled:
-                    for k, v in train_metrics.items():
-                        aggregator.update(k, v)
 
-        # ------------------------------------------ checkpoint (player saves,
+        # ------------------------------------------ checkpoint (lead saves,
         # trainer state requested on demand so zero-gradient-step iterations
-        # and save_last still checkpoint — unlike piggybacking on the data
-        # message)
-        # preemption rides the cadence: a pending SIGTERM makes
-        # should_checkpoint True, so the player requests the trainer state
-        # needed for a full (resumable) emergency checkpoint
-        if ckpt_mgr.should_checkpoint(policy_step, is_last=iter_num == total_iters):
-            data_q.put(("ckpt_req",))
-            tag, full_state = _trainer_reply(policy_step, iter_num)
-            assert tag == "ckpt_state", f"expected ckpt_state, got {tag}"
+        # and save_last still checkpoint)
+        if lead and ckpt_mgr.should_checkpoint(policy_step, is_last=iter_num == total_iters):
+            try:
+                channel.send("ckpt_req", timeout=_QUEUE_TIMEOUT_S)
+                frame = follower.wait_tag("ckpt_state")
+            except PeerDiedError as e:
+                _die_with_dump(e, policy_step, iter_num)
+            # the full nested trees ride pickled (checkpoint cadence only:
+            # the resume path needs the real pytree structure back)
+            full_state = frame.extra[0]
+            frame.release()
 
             def _ckpt_state():
                 state = {
@@ -352,15 +387,20 @@ def _player_loop(
                     f"Preemption signal: emergency checkpoint written, stopping at iter {iter_num}"
                 )
                 break
+        if preemption is not None and preemption.preempted:
+            break  # non-lead worker: drain out so the fan-in shrinks cleanly
 
-        if cfg.metric.log_level > 0 and (
+        if lead and cfg.metric.log_level > 0 and (
             policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters
         ):
+            extra = {"trainer_compiles": trainer_compiles}
+            if latest_transport_stats is not None:
+                extra["transport"] = latest_transport_stats
             observability.on_log(
                 policy_step,
                 train_step,
                 train_time_s=train_time_window,
-                extra={"trainer_compiles": trainer_compiles},
+                extra=extra,
             )
             if logger:
                 if aggregator and not aggregator.disabled:
@@ -392,27 +432,39 @@ def _player_loop(
             last_log = policy_step
             last_train = train_step
 
+    # drain the in-flight params broadcast before closing — see
+    # ppo_decoupled: an unread broadcast at close resets the connection
+    try:
+        frame = follower.advance_to(update_round, timeout=60.0)
+        if frame is not None:
+            _adopt(frame)
+    except Exception:
+        pass  # a dead/strangled trainer: nothing left to drain
     # shutdown sentinel (reference scatters -1, sac_decoupled.py:328)
-    data_q.put(("stop",))
-    if sample_tx is not None:
-        sample_tx.close()
-    if params_rx is not None:
-        params_rx.close()
-    ckpt_mgr.close()
+    try:
+        channel.send("stop")
+    except Exception:
+        pass  # a dead trainer cannot receive it; exit anyway
+    if ckpt_mgr is not None:
+        ckpt_mgr.close()
+    if preemption is not None:
+        preemption.uninstall()
     envs.close()
     observability.close()
-    if cfg.algo.run_test:
+    if lead and cfg.algo.run_test:
         test_rew = test(player, runtime, cfg, log_dir)
         if logger:
             logger.log_metrics({"Test/cumulative_reward": test_rew}, policy_step)
     if logger:
         logger.finalize()
+    channel.close()
 
 
 @register_algorithm(decoupled=True)
 def main(runtime, cfg: Dict[str, Any]):
     """Trainer process body + player spawn (reference sac_decoupled.py:356-545)."""
     runtime.seed_everything(cfg.seed)
+    knobs = decoupled_knobs(cfg)
 
     if "minedojo" in str(cfg.env.wrapper.get("_target_", "")).lower():
         raise ValueError("MineDojo is not supported by the SAC agent")
@@ -435,66 +487,45 @@ def main(runtime, cfg: Dict[str, Any]):
     ratio_state = state["ratio"] if state else None
 
     ctx = mp.get_context("spawn")
-    data_q: mp.Queue = ctx.Queue()
-    resp_q: mp.Queue = ctx.Queue()
-    # free-slot queues for the shm rings (queues must be created before the
-    # spawn — they cannot ride another queue); unused on transport=queue
-    data_free_q: mp.Queue = ctx.Queue()
-    resp_free_q: mp.Queue = ctx.Queue()
-    saved_platform = os.environ.get("JAX_PLATFORMS")
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    try:
-        player_proc = ctx.Process(
-            target=_player_loop,
-            args=(cfg, data_q, resp_q, data_free_q, resp_free_q, counters, ratio_state, runtime.world_size),
-            daemon=False,
-        )
-        player_proc.start()
-    finally:
-        if saved_platform is None:
-            os.environ.pop("JAX_PLATFORMS", None)
-        else:
-            os.environ["JAX_PLATFORMS"] = saved_platform
+    hub, channels, procs, env_shards = spawn_players(
+        cfg, runtime, ctx, _player_loop, extra_args=(counters, ratio_state, runtime.world_size), knobs=knobs
+    )
+    fanin = FanIn(channels)
 
     # a SIGTERM delivered to the trainer only (per-process preemption) is
-    # forwarded to the player, which owns the checkpoint files and runs the
-    # emergency-save path; the trainer just keeps answering until "stop"
-    preemption = PreemptionHandler(forward_to=[player_proc]).install()
+    # forwarded to every player; the lead owns the checkpoint files and
+    # runs the emergency-save path
+    preemption = PreemptionHandler(forward_to=list(procs)).install()
 
-    def _player_msg(what: str):
-        """Queue get that notices a dead player within ~a second. The
-        trainer owns no run dir, so its final dump lands next to the run
-        root with a distinctive name (partial state: params + optimizer)."""
+    def _dump_and_raise(e: PeerDiedError, what: str):
+        path = None
         try:
-            return queue_get_from_peer(
-                data_q,
-                timeout=_QUEUE_TIMEOUT_S,
-                peer_alive=child_alive(player_proc),
-                who="player",
-                detail_fn=lambda: f"exitcode={player_proc.exitcode}",
-            )
-        except PeerDiedError as e:
-            path = None
-            try:
-                from sheeprl_tpu.utils.ckpt_format import save_state
+            from sheeprl_tpu.utils.ckpt_format import save_state
 
-                dump_dir = os.path.join(str(cfg.root_dir), str(cfg.run_name))
-                os.makedirs(dump_dir, exist_ok=True)
-                path = save_state(
-                    os.path.join(dump_dir, "emergency_trainer_0.ckpt"),
-                    _np_tree({"agent": params, "opt_states": opt_states}),
-                )
-            except Exception:
-                pass
-            raise RuntimeError(
-                f"decoupled player process died (exitcode={player_proc.exitcode}) while the "
-                f"trainer waited for a {what} message; trainer params/optimizer dumped to {path} "
-                "(partial state: resume from the last regular ckpt_*.ckpt instead)"
-            ) from e
+            dump_dir = os.path.join(str(cfg.root_dir), str(cfg.run_name))
+            os.makedirs(dump_dir, exist_ok=True)
+            path = save_state(
+                os.path.join(dump_dir, "emergency_trainer_0.ckpt"),
+                _np_tree({"agent": params, "opt_states": opt_states}),
+            )
+        except Exception:
+            pass
+        raise RuntimeError(
+            f"decoupled player process died (all {knobs['num_players']} players gone: {e}) while "
+            f"the trainer waited for a {what} message; trainer params/optimizer dumped to {path} "
+            "(partial state: resume from the last regular ckpt_*.ckpt instead)"
+        ) from e
 
     try:
-        tag, observation_space, action_space = _player_msg("init")
-        assert tag == "init", f"expected init, got {tag}"
+        try:
+            _, init_frames = fanin.gather(timeout=_QUEUE_TIMEOUT_S, data_tag="init")
+        except PeerDiedError as e:
+            params = opt_states = None
+            _dump_and_raise(e, "init")
+        first = next(iter(init_frames.values()))
+        observation_space, action_space = first.extra
+        for f in init_frames.values():
+            f.release()
 
         actor, critic, params, target_entropy = build_agent(
             runtime, cfg, observation_space, action_space, state["agent"] if state else None
@@ -524,46 +555,53 @@ def main(runtime, cfg: Dict[str, Any]):
 
         # trainer-side recompile watch — see ppo_decoupled: the jitted
         # train_fn retraces in THIS process, so the count must ride the
-        # update messages to reach the player's telemetry
+        # params frames to reach the lead's telemetry
         from sheeprl_tpu.obs import RecompileMonitor
 
         trainer_mon = RecompileMonitor(name="sac_decoupled_trainer").install()
 
-        use_shm = decoupled_transport_setting(cfg) == "shm"
-        sample_rx = ShmReceiver(data_free_q) if use_shm else None
-        params_tx = ShmSender(resp_free_q) if use_shm else None
+        def _on_control(pid: int, frame) -> None:
+            """``ckpt_req`` from the lead: answer with the full agent +
+            optimizer state (pickled trees — checkpoint cadence only, and
+            the resume path needs the real pytree structure back)."""
+            tag = frame.tag
+            frame.release()
+            if tag != "ckpt_req":
+                return
+            fanin.send_to(
+                pid,
+                "ckpt_state",
+                extra=({"agent": _np_tree(params), "opt_states": _np_tree(opt_states)},),
+            )
 
-        resp_q.put(("params", _np_tree(params["actor"])))
+        # initial actor weights to every player (seq 0; round seqs start at 1)
+        fanin.broadcast("params", arrays=_flat_leaves(_np_tree(params["actor"])), seq=0)
 
         while True:
-            with trace_scope("ipc_wait_rollout"):
-                msg = _player_msg("rollout")
-            if msg[0] == "stop":
-                break
-            if msg[0] == "ckpt_req":
-                maybe_drop_or_delay_send(
-                    resp_q.put,
-                    ("ckpt_state", {"agent": _np_tree(params), "opt_states": _np_tree(opt_states)}),
-                )
-                continue
-            if msg[0] == "data_shm":
-                _, arena_info, slot, leaves_meta, g, iter_num = msg
-                sample = sample_rx.unpack(arena_info, slot, leaves_meta, copy=False)
-            else:
-                _, sample, g, iter_num = msg
-                slot = None
+            try:
+                with trace_scope("ipc_wait_rollout"):
+                    seq, frames = fanin.gather(timeout=_QUEUE_TIMEOUT_S, on_control=_on_control)
+            except PeerDiedError as e:
+                _dump_and_raise(e, "rollout")
+            if not frames:
+                break  # every player stopped
+            # all players derive g/iter_num from the same global schedule
+            g, iter_num = next(iter(frames.values())).extra
+            gs = {f.extra[0] for f in frames.values()}
+            if len(gs) != 1:
+                raise RuntimeError(f"fan-in desync: players disagree on gradient steps {gs}")
 
-            # np.array (not asarray): materialize private rows so a shm slot
-            # can be handed back right after (views die with the copy)
-            data = {
-                k: np.array(v, dtype=np.float32).reshape(
-                    g, cfg.algo.per_rank_batch_size * runtime.world_size, *v.shape[2:]
-                )
-                for k, v in sample.items()
-            }
-            if msg[0] == "data_shm":
-                del sample
-                sample_rx.release(slot)
+            # per-player shard -> (g, local_batch, ...) then concat along the
+            # batch axis in player-id order (np.array materializes private
+            # rows so the transport buffers can be handed back right after)
+            shards: Dict[int, Dict[str, np.ndarray]] = {}
+            for pid, frame in frames.items():
+                shards[pid] = {
+                    k: np.array(v, dtype=np.float32).reshape(g, -1, *v.shape[2:])
+                    for k, v in frame.arrays.items()
+                }
+                frame.release()
+            data = assemble_shards(shards, axis=1)
             # shard the batch axis over the mesh so each device trains on
             # its own rows (GSPMD inserts the grad psums)
             data = runtime.shard_batch(data, axis=1)
@@ -584,38 +622,26 @@ def main(runtime, cfg: Dict[str, Any]):
             train_metrics["trainer_compiles"] = trainer_mon.compiles
             trainer_mon.mark_warmup_complete()  # first update done: further compiles are retraces
 
-            sent = False
-            if params_tx is not None:
-                sent = params_tx.send(
-                    lambda m: maybe_drop_or_delay_send(resp_q.put, m),
-                    "update_shm",
-                    _flat_leaves(_np_tree(params["actor"])),
-                    (train_metrics,),
-                    acquire_slot=lambda: queue_get_from_peer(
-                        resp_free_q,
-                        timeout=_QUEUE_TIMEOUT_S,
-                        peer_alive=child_alive(player_proc),
-                        who="player",
-                    ),
-                )
-            if not sent:
-                maybe_drop_or_delay_send(
-                    resp_q.put, ("update", _np_tree(params["actor"]), train_metrics)
-                )
+            stats = fanin.stats(knobs["backend"])
+            stats["events"] = fanin.events[-8:]
+            fanin.broadcast(
+                "params",
+                arrays=_flat_leaves(_np_tree(params["actor"])),
+                seq=seq,
+                extra_fn=lambda pid: (train_metrics, stats if pid == 0 else None),
+            )
             hard_exit_point("trainer_exit")  # fault site: trainer crash after replying
 
         trainer_mon.uninstall()
-        # the player still runs its test episode + logger shutdown after the
+        # the lead still runs its test episode + logger shutdown after the
         # stop sentinel — give it ample time before the terminate fallback
-        player_proc.join(timeout=3600.0)
+        for proc in procs:
+            proc.join(timeout=3600.0)
     finally:
         preemption.uninstall()
-        try:
-            if use_shm:
-                sample_rx.close()
-                params_tx.close()
-        except NameError:  # death before the endpoints were created
-            pass
-        if player_proc.is_alive():
-            player_proc.terminate()
-            player_proc.join()
+        fanin.close()
+        hub.close()
+        for proc in procs:
+            if proc.is_alive():
+                proc.terminate()
+                proc.join()

@@ -57,7 +57,30 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
-__all__ = ["KeyStream", "PipelinedCollector", "RolloutPayload", "credit_timer", "detach_copy"]
+__all__ = [
+    "KeyStream",
+    "PipelinedCollector",
+    "RolloutPayload",
+    "credit_timer",
+    "detach_copy",
+    "resolve_overlap_setting",
+]
+
+
+def resolve_overlap_setting(cfg) -> bool:
+    """Resolve ``algo.overlap_collect`` (``true``/``false``/``auto``).
+
+    ``auto`` enables the pipeline only where it can win: the collector
+    thread needs a host core of its own, and on a single-core host the
+    overlap degenerates to time-slicing plus handoff overhead (measured
+    0.67-0.81x in BENCH_r05) — those hosts stay on the bit-exact serial
+    path."""
+    import os
+
+    val = cfg.algo.get("overlap_collect", False)
+    if isinstance(val, str) and val.strip().lower() == "auto":
+        return (os.cpu_count() or 1) > 1
+    return bool(val)
 
 
 class KeyStream:

@@ -51,7 +51,8 @@ __all__ = ["ShmArena", "ShmSender", "ShmReceiver", "decoupled_transport_setting"
 
 def decoupled_transport_setting(cfg) -> str:
     """Resolve ``algo.decoupled_transport`` with its env override to
-    "shm" or "queue"."""
+    "shm", "queue" or "tcp" (kept for backward compatibility — the
+    canonical resolver is :func:`sheeprl_tpu.parallel.transport.transport_setting`)."""
     val = cfg.algo.get("decoupled_transport", "shm")
     env = os.environ.get("SHEEPRL_DECOUPLED_TRANSPORT")
     if env is not None:
@@ -59,6 +60,8 @@ def decoupled_transport_setting(cfg) -> str:
     s = str(val).lower()
     if s in ("queue", "pickle", "off", "0", "false", "no"):
         return "queue"
+    if s in ("tcp", "socket", "net"):
+        return "tcp"
     return "shm"
 
 

@@ -1,0 +1,1187 @@
+"""Pluggable rollout transport + N-player fan-in for the decoupled topologies.
+
+The decoupled PPO/SAC pairs were hard-wired to exactly ONE player process
+feeding one trainer over same-host ``multiprocessing`` primitives, so
+aggregate env throughput could never scale past a single host's cores no
+matter how fast the trainer got (BENCH_r05: the trainer starved at 0.573x
+coupled on 1 host core).  IMPALA (Espeholt et al., 2018) and SEED RL
+(Espeholt et al., 2020) establish the fix — many actor processes
+streaming rollouts into one centralized learner — and this module
+supplies the plumbing:
+
+- :class:`Channel` — one duplex player<->trainer link with a uniform
+  frame API (``send(tag, arrays, extra, seq)`` / ``recv() -> Frame``)
+  over three interchangeable backends (``algo.decoupled_transport``):
+
+  * ``queue`` — the legacy pickled ``mp.Queue`` pair, now BOUNDED so a
+    fast sender backpressures instead of ballooning the pipe;
+  * ``shm``   — the PR-3 SharedMemory ring (zero-copy payloads, queue
+    messages carry metadata only, ring occupancy = flow control);
+  * ``tcp``   — NEW: a socket stream of length-prefixed frames with
+    ``recv_into`` preallocated buffers, credit-window backpressure and
+    an optional compression gate.  Works on localhost today and across
+    hosts unchanged (``algo.tcp_host``/``algo.tcp_port``).
+
+- :class:`TcpListener` — the trainer's accept endpoint: players identify
+  themselves with a hello frame, and a player that loses its connection
+  reconnects with exponential backoff and is re-adopted in place (the
+  trainer resends its last params broadcast; both directions dedupe by
+  ``(tag, seq)``, so a frame lost mid-flight is retried, never skipped).
+
+- :class:`FanIn` — the trainer-side N-player assembly: one ``data``
+  frame per alive player per round, deterministic arrival-order-
+  independent layout (shards concatenated in player-id order), per-player
+  liveness, and graceful degradation — a crashed player SHRINKS the
+  fan-in (recorded in the transport stats that ride telemetry) instead of
+  killing the run; only the death of the LAST player is fatal.
+
+- :class:`ParamsFollower` — the player-side half of the seq-numbered
+  trainer->players params broadcast: rollout k acts on EXACTLY the params
+  of update ``k - 1 - lag`` (``algo.decoupled_params_lag``), reusing
+  PR 3's fixed-lag idea so per-player staleness is bounded AND
+  deterministic (never a race on "whatever arrived last").
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import queue as queue_mod
+import socket
+import struct
+import threading
+import time
+import zlib
+from collections import OrderedDict, deque
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from sheeprl_tpu.parallel.shm_ring import ShmReceiver, ShmSender
+from sheeprl_tpu.resilience.faults import get_injector, maybe_drop_or_delay_send
+from sheeprl_tpu.resilience.peer import PeerDiedError, queue_get_from_peer
+
+__all__ = [
+    "Channel",
+    "ChannelSpec",
+    "FanIn",
+    "Frame",
+    "ParamsFollower",
+    "QueueChannel",
+    "ShmChannel",
+    "TcpChannel",
+    "TcpListener",
+    "TransportHub",
+    "assemble_shards",
+    "make_transport",
+    "split_envs",
+    "transport_setting",
+]
+
+_BACKENDS = ("queue", "shm", "tcp")
+
+
+def transport_setting(cfg) -> str:
+    """Resolve ``algo.decoupled_transport`` (env override
+    ``SHEEPRL_DECOUPLED_TRANSPORT``) to one of ``queue|shm|tcp``."""
+    val = cfg.algo.get("decoupled_transport", "shm")
+    env = os.environ.get("SHEEPRL_DECOUPLED_TRANSPORT")
+    if env is not None:
+        val = env
+    s = str(val).lower()
+    if s in ("queue", "pickle", "off", "0", "false", "no"):
+        return "queue"
+    if s in ("tcp", "socket", "net"):
+        return "tcp"
+    return "shm"
+
+
+def split_envs(total: int, num_players: int) -> List[Tuple[int, int]]:
+    """Deterministic env sharding: ``[(offset, count), ...]`` per player,
+    remainder distributed to the first players."""
+    if num_players < 1:
+        raise ValueError(f"num_players must be >= 1, got {num_players}")
+    if total < num_players:
+        raise ValueError(f"cannot split {total} envs across {num_players} players")
+    base, rem = divmod(total, num_players)
+    out, off = [], 0
+    for p in range(num_players):
+        n = base + (1 if p < rem else 0)
+        out.append((off, n))
+        off += n
+    return out
+
+
+def assemble_shards(
+    arrays_by_pid: Dict[int, Dict[str, np.ndarray]], axis: int = 1
+) -> Dict[str, np.ndarray]:
+    """Concatenate per-player shards in PLAYER-ID order: the global batch
+    layout depends only on which players contributed, never on shard
+    arrival order."""
+    pids = sorted(arrays_by_pid)
+    first = arrays_by_pid[pids[0]]
+    if len(pids) == 1:
+        return dict(first)
+    return {k: np.concatenate([arrays_by_pid[p][k] for p in pids], axis=axis) for k in first}
+
+
+# --------------------------------------------------------------------- frames
+class Frame:
+    """One received transport message.
+
+    ``arrays`` values may be VIEWS into transport-owned buffers (a shm
+    slot, a tcp receive buffer): valid only until :meth:`release`.  Call
+    sites that keep data past the release must copy (``np.array``).
+    Array-less frames auto-release.
+    """
+
+    __slots__ = ("tag", "seq", "extra", "arrays", "_release_cb")
+
+    def __init__(self, tag: str, seq: int = -1, extra: Tuple = (), arrays=None, release_cb=None):
+        self.tag = tag
+        self.seq = int(seq)
+        self.extra = tuple(extra)
+        self.arrays: Dict[str, np.ndarray] = arrays or {}
+        self._release_cb = release_cb
+
+    def release(self) -> None:
+        cb, self._release_cb = self._release_cb, None
+        if cb is not None:
+            cb()
+
+    def arrays_copy(self) -> Dict[str, np.ndarray]:
+        return {k: np.array(v) for k, v in self.arrays.items()}
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"Frame({self.tag!r}, seq={self.seq}, keys={list(self.arrays)})"
+
+
+class Channel:
+    """One duplex link between a player and the trainer.
+
+    ``peer_alive``/``who`` configure the liveness polling used by every
+    blocking operation (see :func:`~sheeprl_tpu.resilience.peer.queue_get_from_peer`);
+    the trainer attaches them after the spawn via :meth:`set_peer`.
+    """
+
+    def __init__(self, peer_alive: Optional[Callable[[], bool]] = None, who: str = "peer"):
+        self.peer_alive = peer_alive or (lambda: True)
+        self.who = who
+        self.detail_fn: Optional[Callable[[], str]] = None
+        self.bytes_sent = 0
+        self.bytes_recv = 0
+        self.frames_sent = 0
+        self.frames_recv = 0
+
+    def set_peer(self, peer_alive, who: str, detail_fn=None) -> None:
+        self.peer_alive = peer_alive
+        self.who = who
+        self.detail_fn = detail_fn
+
+    # subclass API -----------------------------------------------------
+    def send(self, tag, arrays=None, extra=(), seq=-1, timeout=600.0) -> None:
+        raise NotImplementedError
+
+    def recv(self, timeout: float) -> Frame:
+        raise NotImplementedError
+
+    def depth(self) -> Optional[int]:
+        """Receive-side fan-in queue depth (None when unknowable)."""
+        return None
+
+    def close(self) -> None:
+        pass
+
+    # helpers ----------------------------------------------------------
+    def _count_payload(self, arrays) -> int:
+        n = sum(int(np.asarray(a).nbytes) for _, a in arrays) if arrays else 0
+        self.bytes_sent += n
+        self.frames_sent += 1
+        return n
+
+
+def _put_with_peer(q, item, timeout: float, peer_alive, who: str) -> None:
+    """Bounded-queue put with peer-liveness polling (backpressure that
+    notices a dead peer instead of hanging on a full pipe)."""
+    deadline = time.monotonic() + timeout
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise queue_mod.Full
+        try:
+            q.put(item, timeout=min(0.5, remaining))
+            return
+        except queue_mod.Full:
+            if not peer_alive():
+                raise PeerDiedError(who) from None
+
+
+class QueueChannel(Channel):
+    """Legacy pickled-queue backend over a BOUNDED ``mp.Queue`` pair."""
+
+    _PICKLED = "__frame__"
+
+    def __init__(self, send_q, recv_q, **kw):
+        super().__init__(**kw)
+        self._send_q = send_q
+        self._recv_q = recv_q
+
+    def send(self, tag, arrays=None, extra=(), seq=-1, timeout=600.0) -> None:
+        payload = {k: np.asarray(v) for k, v in arrays} if arrays else None
+        self._count_payload(arrays)
+        maybe_drop_or_delay_send(
+            lambda m: _put_with_peer(self._send_q, m, timeout, self.peer_alive, self.who),
+            (self._PICKLED, tag, seq, tuple(extra), payload),
+        )
+
+    def _raw_recv(self, timeout: float):
+        return queue_get_from_peer(
+            self._recv_q,
+            timeout=timeout,
+            peer_alive=self.peer_alive,
+            who=self.who,
+            detail_fn=self.detail_fn,
+        )
+
+    def recv(self, timeout: float) -> Frame:
+        msg = self._raw_recv(timeout)
+        return self._decode(msg)
+
+    def _decode(self, msg) -> Frame:
+        assert msg[0] == self._PICKLED, f"unexpected message {msg[0]!r}"
+        _, tag, seq, extra, payload = msg
+        self.frames_recv += 1
+        if payload:
+            self.bytes_recv += sum(int(v.nbytes) for v in payload.values())
+        return Frame(tag, seq, extra, payload)
+
+    def depth(self) -> Optional[int]:
+        try:
+            return self._recv_q.qsize()
+        except (NotImplementedError, OSError):
+            return None
+
+
+class ShmChannel(QueueChannel):
+    """SharedMemory-ring backend: payloads ride the PR-3 fixed-slot ring,
+    the bounded control queue carries metadata only; payloads below the
+    64 KB gate (or over the slot size) fall back to the pickled path
+    transparently."""
+
+    _SHM = "__shm_frame__"
+
+    def __init__(self, send_q, recv_q, tx_free_q, rx_free_q, *, window=2, min_bytes=65536, **kw):
+        super().__init__(send_q, recv_q, **kw)
+        # ring slots == credit window: both mean "payloads in flight"
+        self._tx = ShmSender(tx_free_q, n_slots=max(2, int(window)), min_bytes=min_bytes)
+        self._rx = ShmReceiver(rx_free_q)
+
+    def send(self, tag, arrays=None, extra=(), seq=-1, timeout=600.0) -> None:
+        if arrays:
+            arrays = [(k, np.asarray(v)) for k, v in arrays]
+            sent = self._tx.send(
+                lambda m: maybe_drop_or_delay_send(
+                    lambda mm: _put_with_peer(self._send_q, mm, timeout, self.peer_alive, self.who),
+                    m,
+                ),
+                self._SHM,
+                arrays,
+                (tag, seq, tuple(extra)),
+                acquire_slot=lambda: queue_get_from_peer(
+                    self._tx._free_q, timeout=timeout, peer_alive=self.peer_alive, who=self.who
+                ),
+            )
+            if sent:
+                self._count_payload(arrays)
+                return
+        super().send(tag, arrays=arrays, extra=extra, seq=seq, timeout=timeout)
+
+    def recv(self, timeout: float) -> Frame:
+        msg = self._raw_recv(timeout)
+        if msg[0] != self._SHM:
+            return self._decode(msg)
+        _, info, slot, leaves, tag, seq, extra = msg
+        views = self._rx.unpack(info, slot, leaves, copy=False)
+        self.frames_recv += 1
+        self.bytes_recv += sum(int(v.nbytes) for v in views.values())
+        return Frame(tag, seq, extra, views, release_cb=lambda: self._rx.release(slot))
+
+    def close(self) -> None:
+        self._tx.close()
+        self._rx.close()
+
+
+# ----------------------------------------------------------------- tcp wire
+_HDR = struct.Struct("!2sBII")  # magic, flags, meta_len, payload_len
+_MAGIC = b"SR"
+_FLAG_COMPRESSED = 1
+_CREDIT_TAG = "__credit__"
+_HELLO_TAG = "__hello__"
+
+
+def _shutdown_close(sock: Optional[socket.socket]) -> None:
+    """Shutdown THEN close: a plain ``close`` does not wake a thread
+    blocked in ``recv`` on the same socket; the shutdown does."""
+    if sock is None:
+        return
+    try:
+        sock.shutdown(socket.SHUT_RDWR)
+    except OSError:
+        pass
+    try:
+        sock.close()
+    except OSError:
+        pass
+
+
+def _recv_exact_into(sock: socket.socket, mv: memoryview) -> None:
+    """Fill ``mv`` from the socket (sockets here are BLOCKING: a frame is
+    read whole; ``close()`` from another thread is the wakeup)."""
+    got = 0
+    while got < len(mv):
+        n = sock.recv_into(mv[got:], len(mv) - got)
+        if n == 0:
+            raise ConnectionResetError("peer closed the stream")
+        got += n
+
+
+def _send_frame(sock, lock, tag, seq, extra, arrays, compress_min: int) -> int:
+    """Serialize + write one frame under ``lock``; returns payload bytes."""
+    leaves: List[Tuple] = []
+    bufs: List[np.ndarray] = []
+    off = 0
+    for key, arr in arrays or []:
+        a = np.ascontiguousarray(arr)
+        leaves.append((key, tuple(a.shape), str(a.dtype), off, int(a.nbytes)))
+        bufs.append(a.reshape(-1))  # 1-d view: 0-d scalars have no byte view
+        off += int(a.nbytes)
+    flags = 0
+    blob: Optional[bytes] = None
+    if compress_min and 0 < compress_min <= off:
+        blob = zlib.compress(b"".join(memoryview(b).cast("B") for b in bufs), 1)
+        flags |= _FLAG_COMPRESSED
+    meta = pickle.dumps((tag, int(seq), tuple(extra), leaves, off), protocol=pickle.HIGHEST_PROTOCOL)
+    payload_len = len(blob) if blob is not None else off
+    header = _HDR.pack(_MAGIC, flags, len(meta), payload_len)
+    with lock:
+        sock.sendall(header + meta)
+        if blob is not None:
+            sock.sendall(blob)
+        else:
+            for b in bufs:
+                if b.nbytes:
+                    sock.sendall(memoryview(b).cast("B"))
+    return off
+
+
+class _BufferPool:
+    """Reusable receive buffers (the ``recv_into`` targets): frames borrow
+    a buffer and hand it back on release, so steady state allocates
+    nothing — the pool grows to credit-window depth and stops."""
+
+    def __init__(self):
+        self._bufs: List[bytearray] = []
+        self._lock = threading.Lock()
+
+    def take(self, nbytes: int) -> bytearray:
+        with self._lock:
+            for i, b in enumerate(self._bufs):
+                if len(b) >= nbytes:
+                    return self._bufs.pop(i)
+        return bytearray(max(nbytes, 4096))
+
+    def give(self, buf: bytearray) -> None:
+        with self._lock:
+            if len(self._bufs) < 8:
+                self._bufs.append(buf)
+
+
+def _read_frame(sock, pool: _BufferPool) -> Tuple[str, int, Tuple, List[Tuple], Any]:
+    """Read one frame; returns ``(tag, seq, extra, leaves, buffer)`` where
+    ``buffer`` backs the array views (return it to ``pool`` on release;
+    decompressed frames own a private bytes object instead)."""
+    hdr = bytearray(_HDR.size)
+    _recv_exact_into(sock, memoryview(hdr))
+    magic, flags, meta_len, payload_len = _HDR.unpack(bytes(hdr))
+    if magic != _MAGIC:
+        raise ConnectionResetError(f"bad frame magic {magic!r} (stream desync)")
+    meta_buf = bytearray(meta_len)
+    _recv_exact_into(sock, memoryview(meta_buf))
+    tag, seq, extra, leaves, raw_len = pickle.loads(bytes(meta_buf))
+    buf: Any = None
+    if payload_len:
+        buf = pool.take(payload_len)
+        _recv_exact_into(sock, memoryview(buf)[:payload_len])
+        if flags & _FLAG_COMPRESSED:
+            raw = zlib.decompress(memoryview(buf)[:payload_len])
+            assert len(raw) == raw_len
+            pool.give(buf)
+            buf = raw  # private bytes: not pooled, release is a no-op
+    return tag, seq, extra, leaves, buf
+
+
+def _views_from(leaves: Sequence[Tuple], buf) -> Dict[str, np.ndarray]:
+    out: Dict[str, np.ndarray] = {}
+    for key, shape, dtype, off, nbytes in leaves:
+        dt = np.dtype(dtype)
+        count = int(np.prod(shape, dtype=np.int64)) if shape else 1
+        out[key] = np.frombuffer(buf, dtype=dt, count=count, offset=off).reshape(shape)
+    return out
+
+
+class TcpChannel(Channel):
+    """Socket-stream backend: length-prefixed frames, ``recv_into``
+    preallocated buffers, credit-window backpressure, optional
+    compression, and reconnect-with-backoff (player side) / re-adoption
+    (trainer side, via :class:`TcpListener`).
+
+    A background reader thread drains the socket continuously —
+    dispatching credit frames to the send window and queueing payload
+    frames for :meth:`recv` — so a sender blocked on credit can never
+    deadlock against an unread inbound credit.
+    """
+
+    def __init__(
+        self,
+        *,
+        sock: Optional[socket.socket] = None,
+        address: Optional[Tuple[str, int]] = None,
+        player_id: int = -1,
+        window: int = 2,
+        compress_min: int = 0,
+        reconnect: bool = False,
+        reconnect_timeout: float = 10.0,
+        track_resend: bool = False,
+        **kw,
+    ):
+        super().__init__(**kw)
+        self._address = address
+        self._player_id = int(player_id)
+        self._window = max(1, int(window))
+        self._compress_min = int(compress_min)
+        self._reconnect = bool(reconnect)
+        self._reconnect_timeout = float(reconnect_timeout)
+        self._track_resend = bool(track_resend)
+        self._sock: Optional[socket.socket] = sock
+        self._send_lock = threading.RLock()
+        self._cond = threading.Condition()
+        self._credits = self._window
+        self._gen = 0
+        self._dead: Optional[str] = None
+        self._inbox: "queue_mod.Queue[Frame]" = queue_mod.Queue()
+        self._pool = _BufferPool()
+        self._last_seq: Dict[str, int] = {}
+        self._last_broadcast: Optional[Tuple[str, int, Tuple, List[Tuple[str, np.ndarray]]]] = None
+        self._stop = threading.Event()
+        self._reader: Optional[threading.Thread] = None
+        if self._sock is None:
+            if address is None:
+                raise ValueError("TcpChannel needs a socket or an address")
+            self._sock = self._dial()
+        self._configure(self._sock)
+        self._start_reader()
+
+    # ------------------------------------------------------------ lifecycle
+    @staticmethod
+    def _configure(sock: socket.socket) -> None:
+        # BLOCKING sockets: frames are read whole (a read timeout mid-frame
+        # would desync the stream); close() from another thread unblocks
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        sock.settimeout(None)
+
+    def _dial(self) -> socket.socket:
+        sock = socket.create_connection(self._address, timeout=10.0)
+        _send_frame(sock, self._send_lock, _HELLO_TAG, 0, (self._player_id,), None, 0)
+        self._configure(sock)
+        return sock
+
+    def _start_reader(self) -> None:
+        self._reader = threading.Thread(
+            target=self._reader_loop, name=f"sheeprl-tcp-reader-{self._player_id}", daemon=True
+        )
+        self._reader.start()
+
+    def adopt_socket(self, sock: socket.socket) -> None:
+        """Trainer side: swap in a reconnected player's fresh socket (the
+        listener calls this from its accept thread), reset the credit
+        window and re-send the last tracked broadcast frame (the one that
+        may have died with the old connection — the peer dedupes)."""
+        self._configure(sock)
+        with self._cond:
+            old, self._sock = self._sock, sock
+            self._gen += 1
+            self._credits = self._window
+            self._dead = None
+            self._cond.notify_all()
+        _shutdown_close(old)
+        if self._last_broadcast is not None:
+            tag, seq, extra, arrays = self._last_broadcast
+            try:
+                _send_frame(sock, self._send_lock, tag, seq, extra, arrays, self._compress_min)
+            except OSError:
+                pass  # the reader notices and the next adoption retries
+
+    def _mark_dead(self, reason: str) -> None:
+        with self._cond:
+            self._dead = reason
+            self._cond.notify_all()
+        self._inbox.put(Frame("__dead__", extra=(reason,)))
+
+    def _handle_disconnect(self, err: Exception) -> bool:
+        """Reader-thread recovery. True = a fresh socket is live (resume
+        reading), False = channel is dead."""
+        if self._stop.is_set():
+            return False
+        if self._reconnect:
+            delay = 0.1
+            deadline = time.monotonic() + self._reconnect_timeout
+            while not self._stop.is_set() and time.monotonic() < deadline:
+                if not self.peer_alive():
+                    break
+                try:
+                    sock = self._dial()
+                except OSError:
+                    time.sleep(delay)
+                    delay = min(delay * 2, 2.0)
+                    continue
+                with self._cond:
+                    old, self._sock = self._sock, sock
+                    self._gen += 1
+                    self._credits = self._window
+                    self._cond.notify_all()
+                _shutdown_close(old)
+                return True
+            self._mark_dead(f"reconnect failed after {type(err).__name__}: {err}")
+            return False
+        # trainer side: wait for the listener to adopt a reconnection
+        gen = self._gen
+        with self._cond:
+            self._cond.wait_for(
+                lambda: self._gen != gen or self._stop.is_set() or self._dead,
+                timeout=self._reconnect_timeout,
+            )
+            if self._gen != gen and self._dead is None:
+                return True
+        self._mark_dead(f"connection lost ({type(err).__name__}: {err})")
+        return False
+
+    def _reader_loop(self) -> None:
+        while not self._stop.is_set():
+            sock = self._sock
+            try:
+                tag, seq, extra, leaves, buf = _read_frame(sock, self._pool)
+            except (OSError, ConnectionError, EOFError, pickle.UnpicklingError, zlib.error) as e:
+                if self._stop.is_set():
+                    return
+                if sock is not self._sock:
+                    continue  # a newer socket was adopted while we were blocked
+                if not self._handle_disconnect(e):
+                    return
+                continue
+            if tag == _CREDIT_TAG:
+                with self._cond:
+                    self._credits += 1
+                    self._cond.notify_all()
+                continue
+            if seq >= 0 and seq <= self._last_seq.get(tag, -1):
+                # duplicate after a reconnect replay — drop (credits were
+                # reset on both sides when the connection swapped)
+                if buf is not None and isinstance(buf, bytearray):
+                    self._pool.give(buf)
+                continue
+            if seq >= 0:
+                self._last_seq[tag] = seq
+            arrays = _views_from(leaves, buf if buf is not None else b"") if leaves else {}
+            nbytes = sum(int(v.nbytes) for v in arrays.values())
+            self.bytes_recv += nbytes
+            self.frames_recv += 1
+            release_cb = None
+            if arrays:
+                pooled = buf if isinstance(buf, bytearray) else None
+
+                def release_cb(pooled=pooled):
+                    if pooled is not None:
+                        self._pool.give(pooled)
+                    self._send_credit()
+
+            self._inbox.put(Frame(tag, seq, extra, arrays, release_cb=release_cb))
+
+    def _send_credit(self) -> None:
+        try:
+            _send_frame(self._sock, self._send_lock, _CREDIT_TAG, 0, (), None, 0)
+        except OSError:
+            pass  # the reconnect path resets the window wholesale
+
+    # ------------------------------------------------------------------ api
+    def send(self, tag, arrays=None, extra=(), seq=-1, timeout=600.0) -> None:
+        inj = get_injector()
+        if inj.armed:
+            if inj.fire("net_delay"):
+                time.sleep(inj.arg("net_delay"))
+            if inj.fire("net_drop"):
+                self._drop_connection()
+        arrays = [(k, np.asarray(v)) for k, v in arrays] if arrays else None
+        needs_credit = bool(arrays)
+        deadline = time.monotonic() + timeout
+        while True:
+            with self._cond:
+                if needs_credit:
+                    while self._credits <= 0 and self._dead is None:
+                        if time.monotonic() > deadline:
+                            raise queue_mod.Full
+                        if not self.peer_alive():
+                            raise PeerDiedError(self.who)
+                        self._cond.wait(timeout=0.2)
+                if self._dead is not None:
+                    raise PeerDiedError(self.who, self._dead)
+                gen = self._gen
+                sock = self._sock
+                if needs_credit:
+                    self._credits -= 1
+            try:
+                nbytes = _send_frame(sock, self._send_lock, tag, seq, extra, arrays, self._compress_min)
+            except OSError:
+                # wait for the reader's reconnect/adoption, then retry the
+                # WHOLE frame (the peer dedupes a frame that did land)
+                with self._cond:
+                    ok = self._cond.wait_for(
+                        lambda: self._gen != gen or self._dead is not None,
+                        timeout=max(deadline - time.monotonic(), 0.0),
+                    )
+                    if self._dead is not None or not ok:
+                        raise PeerDiedError(self.who, self._dead or "send timeout") from None
+                continue
+            self.bytes_sent += nbytes
+            self.frames_sent += 1
+            if self._track_resend and arrays and seq >= 0:
+                self._last_broadcast = (tag, int(seq), tuple(extra), arrays)
+            return
+
+    def _drop_connection(self) -> None:
+        """``net_drop`` fault: sever the live connection abruptly (the
+        reader sees the reset and runs the reconnect/adoption path).
+        ``self._sock`` is read ONCE: the reader can reconnect and swap in
+        a fresh socket between two statements, and closing the fresh one
+        by accident would strand the reader in a recv that nothing wakes."""
+        _shutdown_close(self._sock)
+
+    def recv(self, timeout: float) -> Frame:
+        deadline = time.monotonic() + timeout
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise queue_mod.Empty
+            try:
+                frame = self._inbox.get(timeout=min(0.5, remaining))
+            except queue_mod.Empty:
+                if not self.peer_alive():
+                    detail = self.detail_fn() if self.detail_fn else ""
+                    raise PeerDiedError(self.who, detail) from None
+                continue
+            if frame.tag == "__dead__":
+                self._inbox.put(frame)  # keep surfacing for later callers
+                raise PeerDiedError(self.who, frame.extra[0] if frame.extra else "")
+            return frame
+
+    def depth(self) -> Optional[int]:
+        return self._inbox.qsize()
+
+    def close(self) -> None:
+        self._stop.set()
+        with self._cond:
+            self._cond.notify_all()
+        _shutdown_close(self._sock)
+        if self._reader is not None and self._reader is not threading.current_thread():
+            self._reader.join(timeout=5.0)
+
+
+class TcpListener:
+    """Trainer-side accept endpoint: players greet with a hello frame
+    carrying their player id; a known id reconnecting is adopted into its
+    existing channel (see :meth:`TcpChannel.adopt_socket`)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, *, window: int = 2, compress_min: int = 0):
+        self._srv = socket.create_server((host, port), backlog=64)
+        self._srv.settimeout(0.5)
+        self.address: Tuple[str, int] = self._srv.getsockname()[:2]
+        self._window = window
+        self._compress_min = compress_min
+        self._channels: Dict[int, TcpChannel] = {}
+        self._cond = threading.Condition()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._accept_loop, name="sheeprl-tcp-accept", daemon=True)
+        self._thread.start()
+
+    def _accept_loop(self) -> None:
+        pool = _BufferPool()
+        while not self._stop.is_set():
+            try:
+                sock, _ = self._srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            try:
+                sock.settimeout(10.0)
+                tag, _, extra, _, _ = _read_frame(sock, pool)
+                if tag != _HELLO_TAG:
+                    raise ConnectionResetError(f"expected hello, got {tag!r}")
+                pid = int(extra[0])
+            except (OSError, ConnectionError, pickle.UnpicklingError, IndexError, ValueError):
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                continue
+            with self._cond:
+                existing = self._channels.get(pid)
+                if existing is not None:
+                    existing.adopt_socket(sock)
+                else:
+                    self._channels[pid] = TcpChannel(
+                        sock=sock,
+                        player_id=pid,
+                        window=self._window,
+                        compress_min=self._compress_min,
+                        reconnect=False,
+                        track_resend=True,
+                    )
+                self._cond.notify_all()
+
+    def channel(self, player_id: int, timeout: float = 60.0, peer_alive=None) -> TcpChannel:
+        """Block until ``player_id`` has connected (polling ``peer_alive``
+        so a player that died before dialing surfaces as such)."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while player_id not in self._channels:
+                if peer_alive is not None and not peer_alive():
+                    raise PeerDiedError(f"player[{player_id}]", "died before connecting")
+                if not self._cond.wait(timeout=min(0.5, max(deadline - time.monotonic(), 0.01))):
+                    if time.monotonic() > deadline:
+                        raise TimeoutError(f"player {player_id} never connected")
+            return self._channels[player_id]
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        self._thread.join(timeout=5.0)
+        for ch in self._channels.values():
+            ch.close()
+
+
+# ------------------------------------------------------------ spec + hub
+class ChannelSpec:
+    """Picklable recipe for the PLAYER side of one channel (rides the
+    spawn args; sockets cannot, queues can only as Process arguments)."""
+
+    def __init__(
+        self,
+        backend: str,
+        player_id: int,
+        *,
+        to_trainer_q=None,
+        to_player_q=None,
+        data_free_q=None,
+        resp_free_q=None,
+        address: Optional[Tuple[str, int]] = None,
+        window: int = 2,
+        min_bytes: int = 65536,
+        compress_min: int = 0,
+    ):
+        self.backend = backend
+        self.player_id = int(player_id)
+        self.to_trainer_q = to_trainer_q
+        self.to_player_q = to_player_q
+        self.data_free_q = data_free_q
+        self.resp_free_q = resp_free_q
+        self.address = address
+        self.window = window
+        self.min_bytes = min_bytes
+        self.compress_min = compress_min
+
+    def player_channel(self, peer_alive=None, who: str = "trainer") -> Channel:
+        """Build the player-side endpoint (call INSIDE the child)."""
+        if self.backend == "tcp":
+            return TcpChannel(
+                address=self.address,
+                player_id=self.player_id,
+                window=self.window,
+                compress_min=self.compress_min,
+                reconnect=True,
+                peer_alive=peer_alive,
+                who=who,
+            )
+        if self.backend == "shm":
+            return ShmChannel(
+                self.to_trainer_q,
+                self.to_player_q,
+                self.data_free_q,
+                self.resp_free_q,
+                window=self.window,
+                min_bytes=self.min_bytes,
+                peer_alive=peer_alive,
+                who=who,
+            )
+        return QueueChannel(self.to_trainer_q, self.to_player_q, peer_alive=peer_alive, who=who)
+
+
+class TransportHub:
+    """Trainer-side owner of all per-player channels."""
+
+    def __init__(self, backend: str, listener: Optional[TcpListener], channels: Dict[int, Channel]):
+        self.backend = backend
+        self._listener = listener
+        self._channels = channels
+
+    def channel(self, player_id: int, timeout: float = 120.0, peer_alive=None) -> Channel:
+        if self._listener is not None and player_id not in self._channels:
+            ch = self._listener.channel(player_id, timeout=timeout, peer_alive=peer_alive)
+            self._channels[player_id] = ch
+        return self._channels[player_id]
+
+    def close(self) -> None:
+        for ch in self._channels.values():
+            ch.close()
+        if self._listener is not None:
+            self._listener.close()
+
+
+def make_transport(
+    ctx,
+    backend: str,
+    num_players: int,
+    *,
+    window: int = 2,
+    min_bytes: int = 65536,
+    compress_min: int = 0,
+    host: str = "127.0.0.1",
+    port: int = 0,
+) -> Tuple[TransportHub, List[ChannelSpec]]:
+    """Create the trainer hub + per-player specs for ``backend``.
+
+    Queues must exist before the spawn (they cannot ride another queue),
+    so this runs in the trainer before any player process starts.
+    """
+    if backend not in _BACKENDS:
+        raise ValueError(f"unknown transport backend {backend!r}; known: {_BACKENDS}")
+    specs: List[ChannelSpec] = []
+    channels: Dict[int, Channel] = {}
+    listener = None
+    if backend == "tcp":
+        listener = TcpListener(host, port, window=window, compress_min=compress_min)
+        for pid in range(num_players):
+            specs.append(
+                ChannelSpec(
+                    "tcp", pid, address=listener.address, window=window, compress_min=compress_min
+                )
+            )
+    else:
+        for pid in range(num_players):
+            to_t = ctx.Queue(maxsize=window + 2)
+            to_p = ctx.Queue(maxsize=window + 2)
+            data_free = ctx.Queue() if backend == "shm" else None
+            resp_free = ctx.Queue() if backend == "shm" else None
+            specs.append(
+                ChannelSpec(
+                    backend,
+                    pid,
+                    to_trainer_q=to_t,
+                    to_player_q=to_p,
+                    data_free_q=data_free,
+                    resp_free_q=resp_free,
+                    window=window,
+                    min_bytes=min_bytes,
+                )
+            )
+            if backend == "shm":
+                # trainer sends through ITS ring (resp_free) and releases
+                # rollout slots back into the player's ring (data_free)
+                channels[pid] = ShmChannel(
+                    to_p,
+                    to_t,
+                    resp_free,
+                    data_free,
+                    window=window,
+                    min_bytes=min_bytes,
+                    who=f"player[{pid}]",
+                )
+            else:
+                channels[pid] = QueueChannel(to_p, to_t, who=f"player[{pid}]")
+    return TransportHub(backend, listener, channels), specs
+
+
+# ------------------------------------------------------------------ fan-in
+class FanIn:
+    """Trainer-side N-player shard assembly with per-player liveness.
+
+    ``gather`` returns one ``data`` frame per live player for the next
+    round (FIFO per channel keeps per-player rounds ordered; cross-player
+    arrival order does not matter — callers assemble in player-id order).
+    A player death SHRINKS the fan-in: the pid moves to ``dead``, a shrink
+    event is recorded for telemetry, and the round completes with the
+    survivors.  Only losing the LAST live player raises."""
+
+    def __init__(self, channels: Dict[int, Channel], *, env_steps_per_frame: Optional[Dict[int, int]] = None):
+        self.channels = dict(channels)
+        self.stopped: set = set()
+        self.dead: Dict[int, str] = {}
+        self.events: List[Dict[str, Any]] = []  # shrink log (rides telemetry)
+        self._steps_per_frame = env_steps_per_frame or {}
+        self._last_data_seq: Dict[int, int] = {}
+        self._t0 = time.monotonic()
+        self._frames: Dict[int, int] = {pid: 0 for pid in self.channels}
+
+    # ------------------------------------------------------------ liveness
+    @property
+    def live(self) -> List[int]:
+        return sorted(pid for pid in self.channels if pid not in self.dead and pid not in self.stopped)
+
+    def mark_dead(self, pid: int, reason: str) -> None:
+        if pid in self.dead or pid in self.stopped:
+            return
+        # a player that exited CLEANLY finished its work: its final "stop"
+        # frame can be destroyed by a TCP reset (unread inbound data at
+        # close), so a zero exit code counts as a stop, not a death
+        ch = self.channels.get(pid)
+        detail = ""
+        if ch is not None and ch.detail_fn is not None:
+            try:
+                detail = ch.detail_fn() or ""
+            except Exception:
+                detail = ""
+        if "exitcode=0" in detail.replace(" ", ""):
+            self.stopped.add(pid)
+            return
+        self.dead[pid] = reason
+        self.events.append(
+            {"event": "player_dead", "player": pid, "reason": reason, "live": len(self.live)}
+        )
+
+    def _require_live(self, who: str = "player") -> None:
+        if not self.live and not self.stopped:
+            detail = "; ".join(f"player[{p}]: {r}" for p, r in self.dead.items())
+            raise PeerDiedError(who, detail)
+
+    # -------------------------------------------------------------- gather
+    def gather(
+        self,
+        *,
+        timeout: float,
+        data_tag: str = "data",
+        on_control: Optional[Callable[[int, Frame], None]] = None,
+    ) -> Tuple[Optional[int], "OrderedDict[int, Frame]"]:
+        """Collect the next ``data_tag`` frame from every live player.
+
+        Returns ``(seq, frames-by-pid sorted)``; ``(None, {})`` once every
+        player has stopped.  Control frames (anything except ``data_tag``
+        and ``stop``) are handed to ``on_control`` as they arrive."""
+        got: Dict[int, Frame] = {}
+        deadline = time.monotonic() + timeout
+        while True:
+            pending = [pid for pid in self.live if pid not in got]
+            if not pending:
+                break
+            for pid in pending:
+                ch = self.channels[pid]
+                try:
+                    frame = ch.recv(timeout=0.05)
+                except queue_mod.Empty:
+                    continue
+                except PeerDiedError as e:
+                    self.mark_dead(pid, str(e))
+                    continue
+                if frame.tag == "stop":
+                    self.stopped.add(pid)
+                    frame.release()
+                elif frame.tag == data_tag:
+                    if frame.seq >= 0 and frame.seq <= self._last_data_seq.get(pid, -1):
+                        frame.release()  # reconnect replay duplicate
+                        continue
+                    self._last_data_seq[pid] = frame.seq
+                    if data_tag == "data":  # init/control rounds don't count toward sps
+                        self._frames[pid] = self._frames.get(pid, 0) + 1
+                    got[pid] = frame
+                elif on_control is not None:
+                    on_control(pid, frame)
+                else:
+                    frame.release()
+            if time.monotonic() > deadline:
+                for f in got.values():
+                    f.release()
+                raise queue_mod.Empty
+        self._require_live()
+        if not got:
+            return None, OrderedDict()
+        seqs = sorted({f.seq for f in got.values()})
+        if len(seqs) != 1:
+            raise RuntimeError(f"fan-in round desync: players delivered seqs {seqs}")
+        return seqs[0], OrderedDict(sorted(got.items()))
+
+    # ----------------------------------------------------------- broadcast
+    def broadcast(
+        self,
+        tag: str,
+        arrays,
+        seq: int = -1,
+        extra_fn: Optional[Callable[[int], Tuple]] = None,
+        timeout: float = 600.0,
+    ) -> None:
+        """Send the same payload to every live player (per-player extras
+        via ``extra_fn`` — e.g. metrics/opt-state for the lead only).  A
+        send failure marks that player dead and the broadcast continues."""
+        for pid in self.live:
+            extra = extra_fn(pid) if extra_fn is not None else ()
+            try:
+                self.channels[pid].send(tag, arrays=arrays, extra=extra, seq=seq, timeout=timeout)
+            except (PeerDiedError, queue_mod.Full, OSError) as e:
+                self.mark_dead(pid, f"broadcast failed: {e}")
+        self._require_live()
+
+    def send_to(self, pid: int, tag: str, arrays=None, extra=(), seq=-1, timeout: float = 600.0) -> None:
+        try:
+            self.channels[pid].send(tag, arrays=arrays, extra=extra, seq=seq, timeout=timeout)
+        except (PeerDiedError, queue_mod.Full, OSError) as e:
+            self.mark_dead(pid, f"send failed: {e}")
+
+    # ----------------------------------------------------------- telemetry
+    def stats(self, backend: str) -> Dict[str, Any]:
+        """One snapshot for the telemetry sink's ``transport`` key."""
+        elapsed = max(time.monotonic() - self._t0, 1e-6)
+        per_player: Dict[str, Any] = {}
+        bytes_total = 0
+        for pid, ch in self.channels.items():
+            bytes_total += ch.bytes_recv + ch.bytes_sent
+            entry: Dict[str, Any] = {
+                "frames": self._frames.get(pid, 0),
+                "bytes_in": ch.bytes_recv,
+                "bytes_out": ch.bytes_sent,
+                "alive": pid not in self.dead and pid not in self.stopped,
+            }
+            spf = self._steps_per_frame.get(pid)
+            if spf:
+                entry["sps"] = round(self._frames.get(pid, 0) * spf / elapsed, 2)
+            depth = ch.depth()
+            if depth is not None:
+                entry["depth"] = depth
+            per_player[str(pid)] = entry
+        return {
+            "backend": backend,
+            "players": per_player,
+            "num_players": len(self.channels),
+            "live": len(self.live),
+            "deaths": len(self.dead),
+            "bytes_per_s": round(bytes_total / elapsed, 1),
+            "fan_in_depth": sum(
+                ch.depth() or 0 for pid, ch in self.channels.items() if pid not in self.dead
+            ),
+        }
+
+    def close(self) -> None:
+        for ch in self.channels.values():
+            ch.close()
+
+
+# ------------------------------------------------------------ params side
+class ParamsFollower:
+    """Player-side fixed-lag adoption of the seq-numbered params broadcast.
+
+    Rollout ``k`` acts on EXACTLY the params of update ``k - 1 - lag``
+    (during warmup: the initial broadcast) — deterministic and bounded,
+    like PR 3's in-process ``_ParamsBus`` but across the transport.  The
+    trainer broadcasts every version in order, so waiting for the exact
+    target sequence is a drain, not a race."""
+
+    def __init__(
+        self,
+        channel: Channel,
+        *,
+        lag: int,
+        initial_seq: int,
+        timeout: float = 600.0,
+        on_stale: Optional[Callable[[Frame], None]] = None,
+    ):
+        if lag < 0:
+            raise ValueError(f"decoupled_params_lag must be >= 0, got {lag}")
+        self.lag = int(lag)
+        self._chan = channel
+        self._initial = int(initial_seq)
+        self._timeout = float(timeout)
+        self.current_seq = int(initial_seq)
+        self.staleness_log: List[Tuple[int, int]] = []  # (round, staleness)
+        self._pending: "deque[Frame]" = deque()
+        # called (pre-release) for fresh versions drained past without
+        # adoption — a checkpoint barrier skipping the lag lets the lead
+        # still account their metrics
+        self.on_stale = on_stale
+
+    def _next_frame(self, timeout: float) -> Frame:
+        if self._pending:
+            return self._pending.popleft()
+        return self._chan.recv(timeout=timeout)
+
+    def wait_tag(self, tag: str, timeout: Optional[float] = None) -> Frame:
+        """Receive until ``tag`` arrives, stashing params frames for the
+        fixed-lag schedule (trainer sends are ordered, but a params
+        broadcast may precede the awaited control reply)."""
+        deadline = time.monotonic() + (timeout or self._timeout)
+        stash: List[Frame] = []
+        try:
+            while True:
+                frame = self._next_frame(max(deadline - time.monotonic(), 0.01))
+                if frame.tag == tag:
+                    return frame
+                stash.append(frame)
+        finally:
+            self._pending.extend(stash)
+
+    def _take_exact(self, target: int, timeout: Optional[float] = None) -> Frame:
+        """Drain the params stream up to EXACTLY ``target`` (the broadcast
+        is ordered, so this is a walk, not a race): reconnect duplicates
+        are dropped, fresh intermediate versions go through ``on_stale``."""
+        while True:
+            frame = self.wait_tag("params", timeout=timeout)
+            if frame.seq <= self.current_seq:
+                frame.release()  # reconnect replay duplicate
+                continue
+            if frame.seq < target:
+                self.current_seq = frame.seq
+                if self.on_stale is not None:
+                    self.on_stale(frame)
+                frame.release()
+                continue
+            if frame.seq > target:
+                raise RuntimeError(
+                    f"params broadcast overshot the fixed lag: got seq {frame.seq}, "
+                    f"waiting for {target}"
+                )
+            self.current_seq = target
+            return frame
+
+    def params_for_round(self, round_k: int) -> Optional[Frame]:
+        """The params frame rollout ``round_k`` must act on, or None when
+        the fixed-lag target predates the current version (warmup, or a
+        checkpoint barrier already jumped ahead: keep the current
+        weights).  Caller copies out of the frame and releases it.
+        Staleness ``(k-1) - adopted_seq`` is logged either way and is
+        bounded by ``lag`` once past warmup."""
+        target = round_k - 1 - self.lag
+        frame = self._take_exact(target) if target > self.current_seq else None
+        self.staleness_log.append((round_k, max(0, (round_k - 1) - self.current_seq)))
+        return frame
+
+    def advance_to(self, target_seq: int, timeout: Optional[float] = None) -> Optional[Frame]:
+        """Collapse the pipeline to ``target_seq`` (checkpoint barrier:
+        the lead player needs the params/opt-state of the update it is
+        about to persist; shutdown drain: closing a socket with an UNREAD
+        inbound broadcast risks a TCP reset that destroys the in-flight
+        frames).  Returns the target frame (None if already adopted)."""
+        if target_seq <= self.current_seq:
+            return None
+        return self._take_exact(target_seq, timeout=timeout)
+
+    @property
+    def max_staleness_seen(self) -> int:
+        return max((s for _, s in self.staleness_log), default=0)

@@ -28,9 +28,14 @@ Instrumented sites:
 ``queue_delay``             a decoupled IPC send sleeps ``arg`` seconds first
 ``env_step_raise``          the env-step guard's inner ``env.step`` raises
 ``player_exit``             the decoupled player hard-exits (``os._exit(13)``)
-                            at its iteration boundary
+                            at its iteration boundary; with ``num_players>1``
+                            the ``arg`` selects WHICH player dies (default 0)
 ``trainer_exit``            the decoupled trainer hard-exits (``os._exit(13)``)
                             after answering an update
+``net_drop``                the tcp transport severs its live connection
+                            before a send (models a dropped link; exercises
+                            reconnect-with-backoff + frame replay/dedupe)
+``net_delay``               a tcp transport send sleeps ``arg`` seconds first
 ==========================  ====================================================
 
 ``fault_point(name)`` returns True exactly when the armed site fires (a
@@ -57,6 +62,8 @@ KNOWN_SITES = (
     "env_step_raise",
     "player_exit",
     "trainer_exit",
+    "net_drop",
+    "net_delay",
 )
 
 
@@ -141,9 +148,22 @@ def maybe_drop_or_delay_send(put_fn, payload) -> None:
     put_fn(payload)
 
 
-def hard_exit_point(name: str) -> None:
+def hard_exit_point(name: str, index: int = 0) -> None:
     """Process-death site (``player_exit`` / ``trainer_exit``): exits with
     a distinctive code, bypassing atexit/finally — the point is to model a
-    crash, not a shutdown."""
-    if fault_point(name):
+    crash, not a shutdown.
+
+    ``index`` identifies WHICH instance this call site belongs to (the
+    decoupled player id); the spec's ``arg`` selects the target, so
+    ``player_exit:2:1`` kills player 1 at its 2nd iteration while its
+    siblings — who inherit the same ``SHEEPRL_FAULTS`` — keep running.
+    The default arg 0 preserves the 1x1 behavior (player 0 is the only
+    player)."""
+    inj = get_injector()
+    if not inj.armed:
+        return
+    site = inj._sites.get(name)
+    if site is not None and int(site["arg"]) != int(index):
+        return
+    if inj.fire(name):
         os._exit(13)

@@ -8,7 +8,39 @@ import time
 import numpy as np
 import pytest
 
-from sheeprl_tpu.parallel.pipeline import KeyStream, PipelinedCollector, RolloutPayload
+from sheeprl_tpu.parallel.pipeline import (
+    KeyStream,
+    PipelinedCollector,
+    RolloutPayload,
+    resolve_overlap_setting,
+)
+
+
+class _AlgoCfg(dict):
+    def get(self, k, d=None):
+        return dict.get(self, k, d)
+
+
+class _Cfg:
+    def __init__(self, overlap):
+        self.algo = _AlgoCfg(overlap_collect=overlap)
+
+
+@pytest.mark.parametrize(
+    "value,cores,expected",
+    [
+        (True, 1, True),
+        (False, 8, False),
+        ("auto", 1, False),  # single-core hosts stay on the bit-exact serial path
+        ("auto", 8, True),
+        ("AUTO", 2, True),
+    ],
+)
+def test_resolve_overlap_setting_auto_gate(monkeypatch, value, cores, expected):
+    import os
+
+    monkeypatch.setattr(os, "cpu_count", lambda: cores)
+    assert resolve_overlap_setting(_Cfg(value)) is expected
 
 
 class _Runtime:
