@@ -96,6 +96,7 @@ TPU_V5E_BF16_PEAK_FLOPS = 197e12
 SECTIONS = [
     ("dv3", 60),
     ("loop", 60),
+    ("replay", 120),
     ("ppo", 100),
     ("sac", 60),
     ("a2c", 100),
@@ -545,6 +546,28 @@ def bench_loop():
     }
 
 
+def bench_replay():
+    """Replay-sampling ladder (benchmarks/bench_replay_sampling.py):
+    per-batch cost of the uniform vs prioritized on-device samplers at
+    cache sizes 1e4 -> 1e6, plus the write-side costs prioritization adds
+    (max-priority seeding per append, TD-driven update_priorities).  The
+    headline is the largest-cache sample-cost ratio — what one gradient
+    step pays for O(log n) proportional sampling over the O(1) uniform
+    gather."""
+    from benchmarks.bench_replay_sampling import run_ladder
+
+    rows = run_ladder(sizes=(10_000, 100_000, 1_000_000), batch=256, n_iters=10)
+    top = rows[-1]
+    return {
+        "metric": "prioritized_over_uniform_sample_cost_1e6",
+        "value": top["prioritized_over_uniform"],
+        "uniform_sample_ms": top["uniform_sample_ms"],
+        "prioritized_sample_ms": top["prioritized_sample_ms"],
+        "update_priorities_ms": top["update_priorities_ms"],
+        "rows": rows,
+    }
+
+
 def child_main(section, out_path):
     """Run one section with all output redirected to the log file."""
     global _CHILD_OUT_PATH
@@ -582,6 +605,7 @@ def child_main(section, out_path):
     metric = {
         "dv3": bench_dv3,
         "loop": bench_loop,
+        "replay": bench_replay,
         "ppo": bench_ppo,
         "sac": bench_sac,
         "a2c": bench_a2c,

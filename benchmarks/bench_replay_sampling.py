@@ -1,0 +1,145 @@
+"""Replay-sampling ladder: uniform vs prioritized DeviceReplayCache draws.
+
+Times the per-batch cost of the on-device samplers at several cache
+sizes (1e4 → 1e6 transitions) so the sum-tree's O(log n) descent can be
+compared against the O(1) uniform gather it rides next to — the question
+a PER adopter actually asks is "what does prioritization cost per
+gradient step at MY buffer size".  Also times the two write-side costs
+prioritization adds: max-priority seeding per append and a TD-driven
+``update_priorities`` per train step.
+
+Numbers are wall-clock per dispatched op with ``block_until_ready`` —
+on the CPU backend of a 1-core container they are upper bounds dominated
+by scatter/gather kernel time; on a real TPU the tree ops ride HBM
+bandwidth next to the ring gathers.
+
+    python benchmarks/bench_replay_sampling.py [--out results/replay_sampling.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _bench(fn, n_iters: int, warmup: int = 3) -> float:
+    import jax
+
+    for _ in range(warmup):
+        jax.block_until_ready(fn())
+    t0 = time.perf_counter()
+    for _ in range(n_iters):
+        out = fn()
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / n_iters
+
+
+def run_ladder(sizes=(10_000, 100_000, 1_000_000), batch=256, n_iters=20, feat=8):
+    import jax
+
+    from sheeprl_tpu.data.device_buffer import DeviceReplayCache
+
+    rows = []
+    for cap in sizes:
+        n_envs = 1
+        caches = {}
+        for prioritized in (False, True):
+            cache = DeviceReplayCache(cap, n_envs, prioritized=prioritized, per_alpha=0.6)
+            rng = np.random.default_rng(0)
+            block = 4096
+            t = 0
+            while t < cap:
+                n = min(block, cap - t)
+                cache.add(
+                    {
+                        "observations": rng.standard_normal((n, n_envs, feat)).astype(np.float32),
+                        "actions": rng.standard_normal((n, n_envs, 2)).astype(np.float32),
+                        "rewards": rng.standard_normal((n, n_envs, 1)).astype(np.float32),
+                        "terminated": np.zeros((n, n_envs, 1), np.uint8),
+                        "next_observations": rng.standard_normal((n, n_envs, feat)).astype(
+                            np.float32
+                        ),
+                    }
+                )
+                t += n
+            caches[prioritized] = cache
+
+        keys = iter(jax.random.split(jax.random.PRNGKey(0), 10_000))
+        uni_s = _bench(
+            lambda: caches[False].sample_transitions(1, batch, next(keys))["rewards"], n_iters
+        )
+        per_s = _bench(
+            lambda: caches[True].sample_transitions_per(1, batch, next(keys), beta=0.4)[0][
+                "rewards"
+            ],
+            n_iters,
+        )
+        idx = np.arange(batch, dtype=np.int32)
+        td = np.abs(np.random.default_rng(1).standard_normal(batch)).astype(np.float32)
+        upd_s = _bench(
+            lambda: (caches[True].update_priorities(idx, td), caches[True]._tree.tree)[1],
+            n_iters,
+        )
+        row_np = np.zeros((1, n_envs, feat), np.float32)
+        seed_row = {
+            "observations": row_np,
+            "actions": np.zeros((1, n_envs, 2), np.float32),
+            "rewards": np.zeros((1, n_envs, 1), np.float32),
+            "terminated": np.zeros((1, n_envs, 1), np.uint8),
+            "next_observations": row_np,
+        }
+        app_uni = _bench(
+            lambda: (caches[False].add(seed_row), caches[False]._bufs["rewards"])[1], n_iters
+        )
+        app_per = _bench(
+            lambda: (caches[True].add(seed_row), caches[True]._tree.tree)[1], n_iters
+        )
+        rows.append(
+            {
+                "capacity": cap,
+                "batch": batch,
+                "uniform_sample_ms": round(uni_s * 1e3, 4),
+                "prioritized_sample_ms": round(per_s * 1e3, 4),
+                "prioritized_over_uniform": round(per_s / uni_s, 3) if uni_s else None,
+                "update_priorities_ms": round(upd_s * 1e3, 4),
+                "append_uniform_ms": round(app_uni * 1e3, 4),
+                "append_prioritized_ms": round(app_per * 1e3, 4),
+                "tree_depth": caches[True]._tree.depth,
+            }
+        )
+        print(json.dumps(rows[-1]))
+    return rows
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--sizes", default="10000,100000,1000000")
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--iters", type=int, default=20)
+    args = ap.parse_args()
+    sizes = tuple(int(s) for s in args.sizes.split(","))
+    import jax
+
+    rows = run_ladder(sizes=sizes, batch=args.batch, n_iters=args.iters)
+    result = {
+        "metric": "replay_sampling_ladder",
+        "backend": jax.default_backend(),
+        "rows": rows,
+    }
+    if args.out:
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=2)
+        print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1009,6 +1009,10 @@ def main(runtime, cfg: Dict[str, Any]):
             }
             if cfg.buffer.checkpoint:
                 ckpt_state["rb"] = rb
+            if device_cache is not None and getattr(device_cache, "prioritized", False):
+                # sequence-start priorities (decayed on sample) are not
+                # derivable from the host buffer — ride the snapshot
+                ckpt_state["replay_priority"] = device_cache.priority_state()
             return ckpt_state
 
         ckpt_mgr.maybe_checkpoint(

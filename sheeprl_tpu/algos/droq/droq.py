@@ -19,13 +19,20 @@ import optax
 
 from sheeprl_tpu.algos.droq.agent import build_agent, droq_ensemble_apply
 from sheeprl_tpu.algos.sac.agent import SACPlayer, actor_action_and_log_prob
-from sheeprl_tpu.algos.sac.loss import critic_loss, entropy_loss, policy_loss
+from sheeprl_tpu.algos.sac.loss import (
+    critic_loss,
+    critic_loss_weighted,
+    entropy_loss,
+    policy_loss,
+    td_error_abs,
+)
 from sheeprl_tpu.algos.sac.sac import _make_optimizer
 from sheeprl_tpu.algos.sac.utils import prepare_obs, test
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.data.device_buffer import maybe_create_for_transitions
 from sheeprl_tpu.obs import setup_observability, trace_scope
+from sheeprl_tpu.replay import per_beta_schedule, rate_limiter_from_cfg
 from sheeprl_tpu.resilience import CheckpointManager
 from sheeprl_tpu.utils.callback import load_checkpoint, restore_buffer
 from sheeprl_tpu.utils.env import make_env
@@ -37,13 +44,19 @@ from sheeprl_tpu.utils.utils import MetricFetchGate, device_get_metrics, Ratio, 
 from sheeprl_tpu.optim import restore_opt_states
 
 
-def make_train_fn(runtime, actor, critic, txs, cfg: Dict[str, Any], target_entropy: float):
+def make_train_fn(
+    runtime, actor, critic, txs, cfg: Dict[str, Any], target_entropy: float, prioritized: bool = False
+):
     gamma = float(cfg.algo.gamma)
     tau = float(cfg.algo.tau)
     num_critics = int(cfg.algo.critic.n)
     actor_tx, critic_tx, alpha_tx = txs
 
     def train(params, opt_states, critic_data, actor_data, key):
+        """``prioritized`` consumes ``critic_data["is_weights"]`` and
+        returns per-minibatch |TD| for the priority updates (the actor
+        batch stays unweighted — see loss.critic_loss_weighted); the
+        False path traces exactly the pre-PER computation."""
         alpha = jnp.exp(params["log_alpha"])
 
         # ---------------- G critic minibatches (Algorithm 2, lines 5-9)
@@ -62,23 +75,37 @@ def make_train_fn(runtime, actor, critic, txs, cfg: Dict[str, Any], target_entro
                 batch["rewards"] + (1 - batch["terminated"]) * gamma * min_qf_next
             )
 
-            def qf_loss_fn(cp):
-                q = droq_ensemble_apply(critic, cp, batch["observations"], batch["actions"], k_drop)
-                return critic_loss(q, target, num_critics)
+            if prioritized:
 
-            qf_loss, grads = jax.value_and_grad(qf_loss_fn)(cparams)
+                def qf_loss_fn_w(cp):
+                    q = droq_ensemble_apply(critic, cp, batch["observations"], batch["actions"], k_drop)
+                    return (
+                        critic_loss_weighted(q, target, num_critics, batch["is_weights"]),
+                        td_error_abs(q, target),
+                    )
+
+                (qf_loss, td_abs), grads = jax.value_and_grad(qf_loss_fn_w, has_aux=True)(cparams)
+            else:
+
+                def qf_loss_fn(cp):
+                    q = droq_ensemble_apply(critic, cp, batch["observations"], batch["actions"], k_drop)
+                    return critic_loss(q, target, num_critics)
+
+                qf_loss, grads = jax.value_and_grad(qf_loss_fn)(cparams)
+                td_abs = None
             updates, copt = critic_tx.update(grads, copt, cparams)
             cparams = optax.apply_updates(cparams, updates)
             ctarget = optax.incremental_update(cparams, ctarget, tau)  # EMA per step
-            return (cparams, ctarget, copt), qf_loss
+            return (cparams, ctarget, copt), ((qf_loss, td_abs) if prioritized else qf_loss)
 
         g = critic_data["rewards"].shape[0]
         keys = jax.random.split(key, g + 3)
-        (new_critic, new_target, new_critic_opt), qf_losses = jax.lax.scan(
+        (new_critic, new_target, new_critic_opt), critic_ys = jax.lax.scan(
             critic_step,
             (params["critic"], params["target_critic"], opt_states["critic"]),
             (critic_data, keys[:g]),
         )
+        qf_losses, td_abs = critic_ys if prioritized else (critic_ys, None)
 
         # ---------------- single actor + alpha update on a separate batch
         def actor_loss_fn(ap):
@@ -108,6 +135,9 @@ def make_train_fn(runtime, actor, critic, txs, cfg: Dict[str, Any], target_entro
             "Loss/policy_loss": actor_loss,
             "Loss/alpha_loss": alpha_loss,
         }
+        if prioritized:
+            # (G, B) |TD| rides back for update_priorities — stays on device
+            return new_params, new_opts, metrics, td_abs
         return new_params, new_opts, metrics
 
     return runtime.setup_step(train, donate_argnums=(0, 1))
@@ -197,6 +227,17 @@ def main(runtime, cfg: Dict[str, Any]):
     device_cache = maybe_create_for_transitions(
         cfg, runtime, rb, state if state and cfg.buffer.checkpoint else None
     )
+    # prioritized replay + samples-per-insert rate control (see sac.py —
+    # DroQ shares the same critic-side PER semantics)
+    prioritized = device_cache is not None and device_cache.prioritized
+    beta_fn = per_beta_schedule(
+        cfg.buffer.get("per_beta", 0.4),
+        cfg.buffer.get("per_beta_end", 1.0),
+        int(cfg.algo.total_steps),
+    )
+    limiter = rate_limiter_from_cfg(cfg, default_min_size=max(int(cfg.algo.learning_starts), 1))
+    if limiter is not None and state is not None and state.get("rate_limiter"):
+        limiter.load_state_dict(state["rate_limiter"])
 
     last_train = 0
     train_step = 0
@@ -220,7 +261,10 @@ def main(runtime, cfg: Dict[str, Any]):
     ckpt_mgr = CheckpointManager(
         runtime, cfg, log_dir, observability=observability, last_checkpoint=last_checkpoint
     )
-    train_fn = make_train_fn(runtime, actor, critic, (actor_tx, critic_tx, alpha_tx), cfg, target_entropy)
+    train_fn = make_train_fn(
+        runtime, actor, critic, (actor_tx, critic_tx, alpha_tx), cfg, target_entropy,
+        prioritized=prioritized,
+    )
 
     step_data: Dict[str, np.ndarray] = {}
     obs = envs.reset(seed=cfg.seed)[0]
@@ -267,6 +311,8 @@ def main(runtime, cfg: Dict[str, Any]):
             step_data["next_observations"] = flat_next_obs[np.newaxis]
         step_data["rewards"] = rewards[np.newaxis].astype(np.float32)
         rb.add(step_data, validate_args=cfg.buffer.validate_args)
+        if limiter is not None:
+            limiter.insert(total_envs)
         if device_cache is not None:
             device_cache.add(step_data)
         obs = next_obs
@@ -275,21 +321,39 @@ def main(runtime, cfg: Dict[str, Any]):
             per_rank_gradient_steps = ratio(
                 (policy_step - prefill_steps + policy_steps_per_iter) / world_size
             )
+            bs = cfg.algo.per_rank_batch_size * world_size
+            if limiter is not None and per_rank_gradient_steps > 0:
+                # sample-side throttle: clip the granted critic minibatches
+                # to the SPI budget (DroQ's high replay ratio is exactly the
+                # regime where training outruns collection)
+                allowed = limiter.sample_allowance(per_rank_gradient_steps * bs) // bs
+                if allowed < per_rank_gradient_steps:
+                    limiter.sample_stalls += 1
+                per_rank_gradient_steps = allowed
             if per_rank_gradient_steps > 0:
                 g = per_rank_gradient_steps
-                bs = cfg.algo.per_rank_batch_size * world_size
+                sample_idx = None
                 if device_cache is not None and device_cache.can_sample_transitions(
                     cfg.buffer.sample_next_obs
                 ):
                     # on-device gathers + casts; nothing crosses the link
-                    critic_data = {
-                        k: v.astype(jnp.float32)
-                        for k, v in device_cache.sample_transitions(
+                    if prioritized:
+                        sampled, sample_idx = device_cache.sample_transitions_per(
                             g, bs, runtime.next_key(),
+                            beta_fn(policy_step),
                             sample_next_obs=cfg.buffer.sample_next_obs,
                             obs_keys=("observations",),
-                        ).items()
-                    }
+                        )
+                        critic_data = {k: v.astype(jnp.float32) for k, v in sampled.items()}
+                    else:
+                        critic_data = {
+                            k: v.astype(jnp.float32)
+                            for k, v in device_cache.sample_transitions(
+                                g, bs, runtime.next_key(),
+                                sample_next_obs=cfg.buffer.sample_next_obs,
+                                obs_keys=("observations",),
+                            ).items()
+                        }
                     actor_data = {
                         k: v[0].astype(jnp.float32)
                         for k, v in device_cache.sample_transitions(
@@ -304,6 +368,10 @@ def main(runtime, cfg: Dict[str, Any]):
                         k: np.asarray(v, np.float32).reshape(g, bs, *v.shape[2:])
                         for k, v in critic_sample.items()
                     }
+                    if prioritized:
+                        # the cache bailed at runtime: train unweighted on
+                        # the uniform host sample, no priorities to update
+                        critic_data["is_weights"] = np.ones((g, bs, 1), np.float32)
                     actor_sample = rb.sample(batch_size=bs, sample_next_obs=cfg.buffer.sample_next_obs)
                     actor_data = {
                         k: np.asarray(v, np.float32).reshape(bs, *v.shape[2:])
@@ -313,10 +381,19 @@ def main(runtime, cfg: Dict[str, Any]):
                     # on its own rows (GSPMD inserts the grad psums)
                     critic_data = runtime.shard_batch(critic_data, axis=1)
                     actor_data = runtime.shard_batch(actor_data, axis=0)
+                if limiter is not None:
+                    limiter.sample(g * bs)
                 with timer("Time/train_time", SumMetric, sync_on_compute=cfg.metric.sync_on_compute):
-                    params, opt_states, train_metrics = train_fn(
-                        params, opt_states, critic_data, actor_data, runtime.next_key()
-                    )
+                    if prioritized:
+                        params, opt_states, train_metrics, td_abs = train_fn(
+                            params, opt_states, critic_data, actor_data, runtime.next_key()
+                        )
+                    else:
+                        params, opt_states, train_metrics = train_fn(
+                            params, opt_states, critic_data, actor_data, runtime.next_key()
+                        )
+                if sample_idx is not None:
+                    device_cache.update_priorities(sample_idx, td_abs)
                 player.params = params["actor"]
                 cumulative_per_rank_gradient_steps += g
                 train_step += world_size
@@ -329,7 +406,16 @@ def main(runtime, cfg: Dict[str, Any]):
         if cfg.metric.log_level > 0 and (
             policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters
         ):
-            observability.on_log(policy_step, train_step)
+            replay_extra = None
+            if prioritized or limiter is not None:
+                replay_rec: Dict[str, Any] = {}
+                if prioritized:
+                    replay_rec["prioritized"] = True
+                    replay_rec["beta"] = round(beta_fn(policy_step), 4)
+                if limiter is not None:
+                    replay_rec["limiter"] = limiter.stats()
+                replay_extra = {"replay": replay_rec}
+            observability.on_log(policy_step, train_step, extra=replay_extra)
             if logger:
                 if aggregator and not aggregator.disabled:
                     logger.log_metrics(aggregator.compute(), policy_step)
@@ -371,6 +457,10 @@ def main(runtime, cfg: Dict[str, Any]):
             }
             if cfg.buffer.checkpoint:
                 ckpt_state["rb"] = rb
+            if device_cache is not None and device_cache.prioritized:
+                ckpt_state["replay_priority"] = device_cache.priority_state()
+            if limiter is not None:
+                ckpt_state["rate_limiter"] = limiter.state_dict()
             return ckpt_state
 
         ckpt_mgr.maybe_checkpoint(

@@ -18,6 +18,27 @@ def critic_loss(qf_values: jax.Array, next_qf_value: jax.Array, num_critics: int
     )
 
 
+def critic_loss_weighted(
+    qf_values: jax.Array, next_qf_value: jax.Array, num_critics: int, weights: jax.Array
+) -> jax.Array:
+    """Prioritized-replay critic loss: per-sample squared errors scaled by
+    the β-annealed IS weights (Schaul et al., 2016, Alg. 1 line 11;
+    weights are batch-max normalized so they only ever scale DOWN).  The
+    actor/alpha objectives stay unweighted — PER corrects the TD update's
+    sampling bias, and the policy terms are expectations under the
+    current policy, not the replay distribution."""
+    return sum(
+        (weights * (qf_values[..., i : i + 1] - next_qf_value) ** 2).mean()
+        for i in range(num_critics)
+    )
+
+
+def td_error_abs(qf_values: jax.Array, next_qf_value: jax.Array) -> jax.Array:
+    """Per-sample |δ| driving the priority updates: the ensemble-mean
+    absolute TD error, shape (B,)."""
+    return jnp.abs(qf_values - next_qf_value).mean(-1)
+
+
 def entropy_loss(log_alpha: jax.Array, logprobs: jax.Array, target_entropy: jax.Array) -> jax.Array:
     # Eq. 17
     return (-log_alpha * (jax.lax.stop_gradient(logprobs) + target_entropy)).mean()

@@ -31,12 +31,19 @@ from sheeprl_tpu.algos.sac.agent import (
     build_agent,
     critic_ensemble_apply,
 )
-from sheeprl_tpu.algos.sac.loss import critic_loss, entropy_loss, policy_loss
+from sheeprl_tpu.algos.sac.loss import (
+    critic_loss,
+    critic_loss_weighted,
+    entropy_loss,
+    policy_loss,
+    td_error_abs,
+)
 from sheeprl_tpu.algos.sac.utils import prepare_obs, test
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.data.device_buffer import maybe_create_for_transitions
 from sheeprl_tpu.obs import setup_observability, trace_scope
+from sheeprl_tpu.replay import per_beta_schedule, rate_limiter_from_cfg
 from sheeprl_tpu.resilience import CheckpointManager
 from sheeprl_tpu.utils.callback import load_checkpoint, restore_buffer
 from sheeprl_tpu.utils.env import make_env
@@ -54,7 +61,9 @@ def _make_optimizer(optim_cfg: Dict[str, Any], precision: str = "32-true") -> op
     return build_optimizer(optim_cfg, precision=precision)
 
 
-def make_train_fn(runtime, actor, critic, txs, cfg: Dict[str, Any], target_entropy: float):
+def make_train_fn(
+    runtime, actor, critic, txs, cfg: Dict[str, Any], target_entropy: float, prioritized: bool = False
+):
     gamma = float(cfg.algo.gamma)
     tau = float(cfg.algo.tau)
     num_critics = int(cfg.algo.critic.n)
@@ -65,7 +74,10 @@ def make_train_fn(runtime, actor, critic, txs, cfg: Dict[str, Any], target_entro
         data: (G, B, ...) pytree; one scan step per gradient step;
         do_ema: (G,) bool — per-step target soft-update flags (the reference
         EMAs once per env iteration, so the flags carry each gradient
-        step's originating-iteration schedule through the scan)."""
+        step's originating-iteration schedule through the scan).
+        ``prioritized`` additionally consumes ``data["is_weights"]`` and
+        returns the per-step |TD| for the priority updates — the False
+        path traces exactly the pre-PER computation."""
 
         def one_step(carry, inp):
             params, opt_states = carry
@@ -84,11 +96,26 @@ def make_train_fn(runtime, actor, critic, txs, cfg: Dict[str, Any], target_entro
             next_qf_value = batch["rewards"] + (1 - batch["terminated"]) * gamma * min_qf_next
             next_qf_value = jax.lax.stop_gradient(next_qf_value)
 
-            def qf_loss_fn(cp):
-                qf_values = critic_ensemble_apply(critic, cp, batch["observations"], batch["actions"])
-                return critic_loss(qf_values, next_qf_value, num_critics)
+            if prioritized:
 
-            qf_loss, qf_grads = jax.value_and_grad(qf_loss_fn)(params["critic"])
+                def qf_loss_fn_w(cp):
+                    qf_values = critic_ensemble_apply(critic, cp, batch["observations"], batch["actions"])
+                    loss = critic_loss_weighted(
+                        qf_values, next_qf_value, num_critics, batch["is_weights"]
+                    )
+                    return loss, td_error_abs(qf_values, next_qf_value)
+
+                (qf_loss, td_abs), qf_grads = jax.value_and_grad(qf_loss_fn_w, has_aux=True)(
+                    params["critic"]
+                )
+            else:
+
+                def qf_loss_fn(cp):
+                    qf_values = critic_ensemble_apply(critic, cp, batch["observations"], batch["actions"])
+                    return critic_loss(qf_values, next_qf_value, num_critics)
+
+                qf_loss, qf_grads = jax.value_and_grad(qf_loss_fn)(params["critic"])
+                td_abs = None
             updates, new_critic_opt = critic_tx.update(qf_grads, opt_states["critic"], params["critic"])
             new_critic = optax.apply_updates(params["critic"], updates)
 
@@ -127,19 +154,25 @@ def make_train_fn(runtime, actor, critic, txs, cfg: Dict[str, Any], target_entro
                 "log_alpha": new_log_alpha,
             }
             new_opt_states = {"actor": new_actor_opt, "critic": new_critic_opt, "alpha": new_alpha_opt}
-            return (new_params, new_opt_states), jnp.stack([qf_loss, actor_loss, alpha_loss])
+            losses = jnp.stack([qf_loss, actor_loss, alpha_loss])
+            ys = (losses, td_abs) if prioritized else losses
+            return (new_params, new_opt_states), ys
 
         g = data["rewards"].shape[0]
         keys = jax.random.split(key, g)
-        (params, opt_states), losses = jax.lax.scan(
+        (params, opt_states), ys = jax.lax.scan(
             one_step, (params, opt_states), (data, keys, do_ema)
         )
+        losses, td_abs = ys if prioritized else (ys, None)
         mean_losses = losses.mean(0)
         metrics = {
             "Loss/value_loss": mean_losses[0],
             "Loss/policy_loss": mean_losses[1],
             "Loss/alpha_loss": mean_losses[2],
         }
+        if prioritized:
+            # (G, B) |TD| rides back for update_priorities — stays on device
+            return params, opt_states, metrics, td_abs
         return params, opt_states, metrics
 
     return runtime.setup_step(train, donate_argnums=(0, 1))
@@ -250,6 +283,21 @@ def main(runtime, cfg: Dict[str, Any]):
     device_cache = maybe_create_for_transitions(
         cfg, runtime, rb, state if state and cfg.buffer.checkpoint else None
     )
+    # prioritized replay (replay/priority_tree.py): lives with the device
+    # cache; False (default) keeps the uniform samplers bit-exact
+    prioritized = device_cache is not None and device_cache.prioritized
+    beta_fn = per_beta_schedule(
+        cfg.buffer.get("per_beta", 0.4),
+        cfg.buffer.get("per_beta_end", 1.0),
+        int(cfg.algo.total_steps),
+    )
+    # samples-per-insert rate control (replay/rate_limiter.py): in the
+    # coupled loop the limiter clips the ratio-granted gradient steps when
+    # sampling runs ahead of collection (inserts can't be blocked — the
+    # loop IS the collector), and its stats ride telemetry
+    limiter = rate_limiter_from_cfg(cfg, default_min_size=max(int(cfg.algo.learning_starts), 1))
+    if limiter is not None and state is not None and state.get("rate_limiter"):
+        limiter.load_state_dict(state["rate_limiter"])
 
     last_train = 0
     train_step = 0
@@ -274,7 +322,8 @@ def main(runtime, cfg: Dict[str, Any]):
         runtime, cfg, log_dir, observability=observability, last_checkpoint=last_checkpoint
     )
     train_fn = make_train_fn(
-        runtime, actor, critic, (actor_tx, critic_tx, alpha_tx), cfg, target_entropy
+        runtime, actor, critic, (actor_tx, critic_tx, alpha_tx), cfg, target_entropy,
+        prioritized=prioritized,
     )
     ema_every = cfg.algo.critic.target_network_frequency // policy_steps_per_iter + 1
 
@@ -348,6 +397,8 @@ def main(runtime, cfg: Dict[str, Any]):
             step_data["next_observations"] = flat_next_obs[np.newaxis]
         step_data["rewards"] = rewards[np.newaxis].astype(np.float32)
         rb.add(step_data, validate_args=cfg.buffer.validate_args)
+        if limiter is not None:
+            limiter.insert(total_envs)
         if device_cache is not None:
             if dispatch_batch > 1:
                 pending_cache_rows.append(dict(step_data))
@@ -369,32 +420,55 @@ def main(runtime, cfg: Dict[str, Any]):
                 # dispatch reproduces the reference's per-iteration EMA
                 # cadence and step accounting exactly
                 pending_iters.extend([iter_num] * per_rank_gradient_steps)
-            if pending_iters and (
+            batch_unit = cfg.algo.per_rank_batch_size * world_size
+            dispatch_ready = bool(pending_iters) and (
                 len(pending_iters) >= dispatch_batch or iter_num == total_iters
-            ):
-                g = len(pending_iters)
+            )
+            g_take = len(pending_iters)
+            if limiter is not None and dispatch_ready:
+                # sample-side throttle: dispatch only the gradient steps the
+                # SPI budget allows; the rest stay pending until collection
+                # catches up (recorded as a sampler stall for telemetry)
+                g_take = min(g_take, limiter.sample_allowance(g_take * batch_unit) // batch_unit)
+                if g_take == 0:
+                    limiter.sample_stalls += 1
+                    dispatch_ready = False
+            if dispatch_ready:
+                g = g_take
                 ema_flags = np.asarray(
-                    [it % ema_every == 0 for it in pending_iters], dtype=bool
+                    [it % ema_every == 0 for it in pending_iters[:g]], dtype=bool
                 )
-                iters_in_window = len(set(pending_iters))
-                pending_iters = []
-                batch_total = g * cfg.algo.per_rank_batch_size * world_size
+                iters_in_window = len(set(pending_iters[:g]))
+                pending_iters = pending_iters[g:]
+                batch_total = g * batch_unit
                 if device_cache is not None:
                     flush_cache_rows()  # sampled content must match the host rb
+                sample_idx = None
                 if device_cache is not None and device_cache.can_sample_transitions(
                     cfg.buffer.sample_next_obs
                 ):
                     # on-device gather + cast; nothing crosses the link
-                    data = {
-                        k: v.astype(jnp.float32)
-                        for k, v in device_cache.sample_transitions(
+                    if prioritized:
+                        sampled, sample_idx = device_cache.sample_transitions_per(
                             g,
-                            cfg.algo.per_rank_batch_size * world_size,
+                            batch_unit,
                             runtime.next_key(),
+                            beta_fn(policy_step),
                             sample_next_obs=cfg.buffer.sample_next_obs,
                             obs_keys=("observations",),
-                        ).items()
-                    }
+                        )
+                        data = {k: v.astype(jnp.float32) for k, v in sampled.items()}
+                    else:
+                        data = {
+                            k: v.astype(jnp.float32)
+                            for k, v in device_cache.sample_transitions(
+                                g,
+                                batch_unit,
+                                runtime.next_key(),
+                                sample_next_obs=cfg.buffer.sample_next_obs,
+                                obs_keys=("observations",),
+                            ).items()
+                        }
                 else:
                     sample = rb.sample(
                         batch_size=batch_total,
@@ -404,21 +478,41 @@ def main(runtime, cfg: Dict[str, Any]):
                     # dispatch each; jit transfers the numpy batch in one copy
                     data = {
                         k: np.asarray(v, dtype=np.float32).reshape(
-                            g, cfg.algo.per_rank_batch_size * world_size, *v.shape[2:]
+                            g, batch_unit, *v.shape[2:]
                         )
                         for k, v in sample.items()
                     }
+                    if prioritized:
+                        # the cache bailed at runtime (budget / key-set
+                        # change): train unweighted on the uniform host
+                        # sample, no priorities to update
+                        data["is_weights"] = np.ones((g, batch_unit, 1), np.float32)
                     # shard the batch axis over the mesh so each device
                     # trains on its own rows (GSPMD inserts the grad psums)
                     data = runtime.shard_batch(data, axis=1)
+                if limiter is not None:
+                    limiter.sample(batch_total)
                 with timer("Time/train_time", SumMetric, sync_on_compute=cfg.metric.sync_on_compute):
-                    params, opt_states, train_metrics = train_fn(
-                        params,
-                        opt_states,
-                        data,
-                        runtime.next_key(),
-                        jnp.asarray(ema_flags),
-                    )
+                    if prioritized:
+                        params, opt_states, train_metrics, td_abs = train_fn(
+                            params,
+                            opt_states,
+                            data,
+                            runtime.next_key(),
+                            jnp.asarray(ema_flags),
+                        )
+                    else:
+                        params, opt_states, train_metrics = train_fn(
+                            params,
+                            opt_states,
+                            data,
+                            runtime.next_key(),
+                            jnp.asarray(ema_flags),
+                        )
+                if sample_idx is not None:
+                    # priority feedback: |TD| of every gradient step lands
+                    # back in the tree — one device dispatch, no host sync
+                    device_cache.update_priorities(sample_idx, td_abs)
                 player.params = params["actor"]
                 cumulative_per_rank_gradient_steps += g
                 train_step += world_size * iters_in_window
@@ -431,7 +525,16 @@ def main(runtime, cfg: Dict[str, Any]):
         if cfg.metric.log_level > 0 and (
             policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters
         ):
-            observability.on_log(policy_step, train_step)
+            replay_extra = None
+            if prioritized or limiter is not None:
+                replay_rec: Dict[str, Any] = {}
+                if prioritized:
+                    replay_rec["prioritized"] = True
+                    replay_rec["beta"] = round(beta_fn(policy_step), 4)
+                if limiter is not None:
+                    replay_rec["limiter"] = limiter.stats()
+                replay_extra = {"replay": replay_rec}
+            observability.on_log(policy_step, train_step, extra=replay_extra)
             if logger:
                 if aggregator and not aggregator.disabled:
                     logger.log_metrics(aggregator.compute(), policy_step)
@@ -475,6 +578,12 @@ def main(runtime, cfg: Dict[str, Any]):
             }
             if cfg.buffer.checkpoint:
                 ckpt_state["rb"] = rb
+            if device_cache is not None and device_cache.prioritized:
+                # tree state is NOT derivable from the host buffer — it
+                # rides the snapshot so a resume keeps its priorities
+                ckpt_state["replay_priority"] = device_cache.priority_state()
+            if limiter is not None:
+                ckpt_state["rate_limiter"] = limiter.state_dict()
             return ckpt_state
 
         ckpt_mgr.maybe_checkpoint(

@@ -25,9 +25,10 @@ staleness machinery), with the off-policy twists of the reference:
 from __future__ import annotations
 
 import multiprocessing as mp
+import time
 import warnings
 import os
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
@@ -48,6 +49,13 @@ from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.obs import setup_observability, trace_scope
 from sheeprl_tpu.parallel.transport import FanIn, ParamsFollower, assemble_shards, split_envs
+from sheeprl_tpu.replay import (
+    ReplayServer,
+    ReplayWriter,
+    per_beta_schedule,
+    rate_limiter_from_cfg,
+    remote_replay_setting,
+)
 from sheeprl_tpu.resilience import (
     CheckpointManager,
     PeerDiedError,
@@ -69,6 +77,13 @@ def _player_loop(
     cfg, spec, state_counters, ratio_state, world_size: int, env_offset: int, n_local_envs: int
 ) -> None:
     """Player process body (reference sac_decoupled.py:33-353)."""
+    if remote_replay_setting(cfg):
+        # Reverb-style experience path: this player streams raw
+        # transitions into the trainer-resident replay service instead of
+        # sampling its own buffer shard (replay/service.py)
+        return _player_loop_remote(
+            cfg, spec, state_counters, world_size, env_offset, n_local_envs
+        )
     import gymnasium as gym
     from gymnasium.vector import AsyncVectorEnv, AutoresetMode, SyncVectorEnv
 
@@ -460,6 +475,347 @@ def _player_loop(
     channel.close()
 
 
+def _player_loop_remote(
+    cfg, spec, state_counters, world_size: int, env_offset: int, n_local_envs: int
+) -> None:
+    """Remote-replay player body: env stepping + ``ReplayWriter`` inserts.
+
+    No local buffer, no Ratio, no sampled-batch shipping — the trainer
+    owns the replay service and the training cadence.  Params adoption is
+    opportunistic (newest broadcast wins): with the trainer free-running
+    on its own clock there is no per-round lock-step to pin a fixed lag
+    to, and the insert-credit window already bounds how far a player can
+    run ahead of the last update it saw."""
+    import gymnasium as gym
+    from gymnasium.vector import AsyncVectorEnv, AutoresetMode, SyncVectorEnv
+
+    from sheeprl_tpu.cli import install_stack_dumper
+    from sheeprl_tpu.parallel.mesh import MeshRuntime
+
+    player_id = spec.player_id
+    lead = player_id == 0
+    knobs = decoupled_knobs(cfg)
+    install_stack_dumper(suffix=f".player{player_id}")
+
+    if cfg.metric.log_level == 0 or not lead:
+        MetricAggregator.disabled = True
+        timer.disabled = True
+    if cfg.metric.get("disable_timer", False):
+        timer.disabled = True
+
+    runtime = MeshRuntime(devices=1, accelerator="cpu", precision=cfg.fabric.precision)
+    runtime.launch()
+    runtime.seed_everything(cfg.seed + player_id)
+
+    logger = get_logger(runtime, cfg) if lead else None
+    if lead:
+        log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name)
+        runtime.print(f"Log dir: {log_dir}")
+    else:
+        log_dir = os.path.join(str(cfg.root_dir), str(cfg.run_name), f"player_{player_id}")
+    observability = setup_observability(runtime, cfg, log_dir if lead else None, logger=logger)
+    if logger:
+        logger.log_hyperparams(cfg)
+
+    thunks = [
+        make_env(cfg, cfg.seed + env_offset + i, 0, log_dir, "train", vector_env_idx=env_offset + i)
+        for i in range(n_local_envs)
+    ]
+    envs = (
+        SyncVectorEnv(thunks, autoreset_mode=AutoresetMode.SAME_STEP)
+        if cfg.env.sync_env
+        else AsyncVectorEnv(thunks, context="spawn", autoreset_mode=AutoresetMode.SAME_STEP)
+    )
+    action_space = envs.single_action_space
+    observation_space = envs.single_observation_space
+    if not isinstance(action_space, gym.spaces.Box):
+        raise ValueError("Only continuous action space is supported for the SAC agent")
+    mlp_keys = list(cfg.algo.mlp_keys.encoder)
+
+    channel = spec.player_channel(peer_alive=parent_alive, who="trainer")
+    channel.send("init", extra=(observation_space, action_space))
+
+    actor, _critic, params, _ = build_agent(runtime, cfg, observation_space, action_space)
+    actor_treedef = jax.tree_util.tree_structure(params["actor"])
+
+    start_iter, policy_step, last_log, last_checkpoint = state_counters
+    writer = ReplayWriter(channel, n_local_envs, initial_credits=knobs["window"])
+
+    train_step = 0
+    last_train = 0
+    train_time_window = 0.0
+    trainer_compiles = None
+    latest_replay_stats = None
+    current_params_seq = -1
+    aggregator = None
+    if not MetricAggregator.disabled:
+        aggregator = instantiate(dict(cfg.metric.aggregator))
+
+    player = None  # built on the initial broadcast
+
+    def _account_params_extra(frame) -> None:
+        nonlocal train_step, train_time_window, trainer_compiles, latest_replay_stats
+        if frame.seq > 0:
+            train_step += world_size  # seq 0 is the initial broadcast, not an update
+        if not lead or not frame.extra:
+            return
+        train_metrics, replay_stats = frame.extra
+        metrics = dict(train_metrics or {})
+        if replay_stats is not None:
+            latest_replay_stats = replay_stats
+        train_time_window += metrics.pop("train_time", 0.0)
+        trainer_compiles = metrics.pop("trainer_compiles", trainer_compiles)
+        if aggregator and not aggregator.disabled:
+            for k, v in metrics.items():
+                aggregator.update(k, v)
+
+    def _handle_frames(wait_tag: Optional[str] = None):
+        """Drain the writer's queued frames: adopt the NEWEST params
+        broadcast, account every update's extras, hand back the first
+        ``wait_tag`` frame (caller releases it)."""
+        nonlocal current_params_seq, player
+        wanted = None
+        newest = None
+        while writer.frames:
+            frame = writer.frames.popleft()
+            if frame.tag == "params":
+                if frame.seq > current_params_seq:
+                    _account_params_extra(frame)
+                    if newest is not None:
+                        newest.release()
+                    newest = frame
+                    current_params_seq = frame.seq
+                else:
+                    frame.release()  # reconnect replay duplicate
+            elif wait_tag is not None and frame.tag == wait_tag and wanted is None:
+                wanted = frame
+            else:
+                frame.release()
+        if newest is not None:
+            new_params = _unflat_leaves(actor_treedef, newest.arrays_copy())
+            newest.release()
+            if player is None:
+                host_cpu = jax.local_devices(backend="cpu")[0]
+                player = SACPlayer(
+                    actor,
+                    new_params,
+                    lambda obs: prepare_obs(obs, mlp_keys=mlp_keys, num_envs=n_local_envs),
+                    device=host_cpu,
+                )
+            else:
+                player.params = new_params
+        return wanted
+
+    def _wait_tag(tag: str, timeout: float):
+        deadline = time.monotonic() + timeout
+        while True:
+            frame = _handle_frames(wait_tag=tag)
+            if frame is not None:
+                return frame
+            if time.monotonic() > deadline:
+                raise RuntimeError(f"timed out waiting for a {tag!r} frame from the trainer")
+            writer.pump(0.2)
+
+    def _die_with_dump(e: Exception, policy_step_now: int, iter_now: int):
+        path = None
+        if lead and ckpt_mgr is not None and player is not None:
+            path = ckpt_mgr.emergency_dump(
+                policy_step_now,
+                {
+                    "actor": player.params,
+                    "iter_num": iter_now * world_size,
+                    "policy_step": policy_step_now,
+                },
+            )
+        raise RuntimeError(
+            f"remote replay server (decoupled trainer process) died at "
+            f"policy_step={policy_step_now}; the player's last-known actor weights were "
+            f"dumped to {path} (partial state: resume from the last regular ckpt_*.ckpt "
+            "instead)"
+        ) from e
+
+    ckpt_mgr = (
+        CheckpointManager(runtime, cfg, log_dir, observability=observability, last_checkpoint=last_checkpoint)
+        if lead
+        else None
+    )
+    preemption = None if lead else PreemptionHandler().install()
+    if lead:
+        save_configs(cfg, log_dir)
+
+    # initial actor weights (trainer broadcasts seq=0 after the init round)
+    try:
+        deadline = time.monotonic() + _QUEUE_TIMEOUT_S
+        while player is None:
+            writer.pump(0.2)
+            _handle_frames()
+            if player is None and time.monotonic() > deadline:
+                raise RuntimeError("initial params broadcast never arrived")
+    except PeerDiedError as e:
+        raise RuntimeError(
+            f"remote replay server died before the initial params broadcast reached "
+            f"player {player_id}"
+        ) from e
+
+    total_envs = int(cfg.env.num_envs)
+    policy_steps_per_iter = int(total_envs)
+    total_iters = int(cfg.algo.total_steps // policy_steps_per_iter) if not cfg.dry_run else 1
+    learning_starts = cfg.algo.learning_starts // policy_steps_per_iter if not cfg.dry_run else 0
+    if start_iter > 1:
+        learning_starts += start_iter
+
+    step_data: Dict[str, np.ndarray] = {}
+    obs = envs.reset(seed=cfg.seed + env_offset)[0]
+
+    for iter_num in range(start_iter, total_iters + 1):
+        observability.on_iteration(policy_step)
+        hard_exit_point("player_exit", index=player_id)  # fault site: a player crash
+        policy_step += policy_steps_per_iter
+
+        with timer("Time/env_interaction_time", SumMetric, sync_on_compute=False):
+            if iter_num <= learning_starts:
+                actions = envs.action_space.sample()
+            else:
+                actions = np.asarray(player.get_actions(obs, runtime.next_key()))
+            next_obs, rewards, terminated, truncated, infos = envs.step(
+                actions.reshape(envs.action_space.shape)
+            )
+            rewards = rewards.reshape(n_local_envs, -1)
+
+        if lead and cfg.metric.log_level > 0 and "final_info" in infos:
+            ep = infos["final_info"].get("episode")
+            if ep is not None:
+                for i in np.nonzero(infos["final_info"]["_episode"])[0]:
+                    if aggregator and not aggregator.disabled:
+                        aggregator.update("Rewards/rew_avg", float(ep["r"][i]))
+                        aggregator.update("Game/ep_len_avg", float(ep["l"][i]))
+                    runtime.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={float(ep['r'][i])}")
+
+        real_next_obs = {k: np.array(v) for k, v in next_obs.items()}
+        if "final_obs" in infos:
+            for idx in np.nonzero(infos["_final_obs"])[0]:
+                for k, v in infos["final_obs"][idx].items():
+                    real_next_obs[k][idx] = v
+        flat_next_obs = np.concatenate([real_next_obs[k] for k in mlp_keys], axis=-1).astype(np.float32)
+
+        step_data["terminated"] = terminated.reshape(1, n_local_envs, -1).astype(np.uint8)
+        step_data["truncated"] = truncated.reshape(1, n_local_envs, -1).astype(np.uint8)
+        step_data["actions"] = actions.reshape(1, n_local_envs, -1).astype(np.float32)
+        step_data["observations"] = np.concatenate([obs[k] for k in mlp_keys], axis=-1).astype(np.float32)[
+            np.newaxis
+        ]
+        if not cfg.buffer.sample_next_obs:
+            step_data["next_observations"] = flat_next_obs[np.newaxis]
+        step_data["rewards"] = rewards[np.newaxis].astype(np.float32)
+
+        # ------------------------------------------ insert (credit-gated)
+        try:
+            with trace_scope("replay_insert"):
+                writer.append(dict(step_data), timeout=_QUEUE_TIMEOUT_S)
+            writer.pump(0.01)
+        except PeerDiedError as e:
+            _die_with_dump(e, policy_step, iter_num)
+        _handle_frames()
+        obs = next_obs
+
+        # ------------------------------------------ checkpoint (lead)
+        if lead and ckpt_mgr.should_checkpoint(policy_step, is_last=iter_num == total_iters):
+            try:
+                channel.send("ckpt_req", timeout=_QUEUE_TIMEOUT_S)
+                frame = _wait_tag("ckpt_state", _QUEUE_TIMEOUT_S)
+            except PeerDiedError as e:
+                _die_with_dump(e, policy_step, iter_num)
+            full_state = frame.extra[0]
+            frame.release()
+
+            def _ckpt_state():
+                state = {
+                    "agent": full_state["agent"],
+                    "opt_states": full_state["opt_states"],
+                    "ratio": full_state["ratio"],
+                    "replay_server": full_state["replay_server"],
+                    "iter_num": iter_num * world_size,
+                    "batch_size": cfg.algo.per_rank_batch_size * world_size,
+                    "last_log": last_log * world_size,
+                    "last_checkpoint": ckpt_mgr.last_checkpoint * world_size,
+                }
+                if full_state.get("rb") is not None:
+                    # top-level key: the snapshot machinery materializes
+                    # buffers only there
+                    state["rb"] = full_state["rb"]
+                return state
+
+            ckpt_mgr.checkpoint_now(policy_step=policy_step, state_fn=_ckpt_state)
+            if ckpt_mgr.preempted:
+                runtime.print(
+                    f"Preemption signal: emergency checkpoint written, stopping at iter {iter_num}"
+                )
+                break
+        if preemption is not None and preemption.preempted:
+            break  # non-lead worker: stop inserting, the fan-in shrinks
+
+        # ------------------------------------------ logging (lead)
+        if lead and cfg.metric.log_level > 0 and (
+            policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters
+        ):
+            replay_rec = dict(latest_replay_stats or {})
+            replay_rec["writer"] = writer.stats()
+            extra = {"trainer_compiles": trainer_compiles, "replay": replay_rec}
+            observability.on_log(
+                policy_step, train_step, train_time_s=train_time_window, extra=extra
+            )
+            if logger:
+                if aggregator and not aggregator.disabled:
+                    logger.log_metrics(aggregator.compute(), policy_step)
+                    aggregator.reset()
+                if not timer.disabled:
+                    timer_metrics = timer.compute()
+                    if train_time_window > 0:
+                        logger.log_metrics(
+                            {"Time/sps_train": (train_step - last_train) / train_time_window},
+                            policy_step,
+                        )
+                        train_time_window = 0.0
+                    if timer_metrics.get("Time/env_interaction_time", 0) > 0:
+                        logger.log_metrics(
+                            {
+                                "Time/sps_env_interaction": (
+                                    (policy_step - last_log) * cfg.env.action_repeat
+                                )
+                                / timer_metrics["Time/env_interaction_time"]
+                            },
+                            policy_step,
+                        )
+                    timer.reset()
+            last_log = policy_step
+            last_train = train_step
+
+    # drain leftovers so an unread broadcast can't RST the connection at
+    # close (see ppo_decoupled), then send the stop sentinel
+    try:
+        writer.pump(0.5)
+        _handle_frames()
+    except Exception:
+        pass
+    try:
+        channel.send("stop")
+    except Exception:
+        pass  # a dead trainer cannot receive it; exit anyway
+    if ckpt_mgr is not None:
+        ckpt_mgr.close()
+    if preemption is not None:
+        preemption.uninstall()
+    envs.close()
+    observability.close()
+    if lead and cfg.algo.run_test:
+        test_rew = test(player, runtime, cfg, log_dir)
+        if logger:
+            logger.log_metrics({"Test/cumulative_reward": test_rew}, policy_step)
+    if logger:
+        logger.finalize()
+    channel.close()
+
+
 @register_algorithm(decoupled=True)
 def main(runtime, cfg: Dict[str, Any]):
     """Trainer process body + player spawn (reference sac_decoupled.py:356-545)."""
@@ -485,6 +841,11 @@ def main(runtime, cfg: Dict[str, Any]):
         state["last_checkpoint"] // runtime.world_size if state else 0,
     )
     ratio_state = state["ratio"] if state else None
+
+    if remote_replay_setting(cfg):
+        # Reverb-style topology: the replay buffer lives HERE, players
+        # stream raw transitions into it (replay/service.py)
+        return _main_remote(runtime, cfg, knobs, state, counters, ratio_state)
 
     ctx = mp.get_context("spawn")
     hub, channels, procs, env_shards = spawn_players(
@@ -640,6 +1001,249 @@ def main(runtime, cfg: Dict[str, Any]):
     finally:
         preemption.uninstall()
         fanin.close()
+        hub.close()
+        for proc in procs:
+            if proc.is_alive():
+                proc.terminate()
+                proc.join()
+
+
+def _main_remote(runtime, cfg: Dict[str, Any], knobs, state, counters, ratio_state):
+    """Remote-replay trainer body: owns the ReplayServer AND the training
+    cadence.
+
+    The trainer free-runs: each loop pumps player inserts into the
+    buffer, advances the ``Ratio`` schedule on the global INSERT clock
+    (one transition == one policy step, exactly the coupled loop's
+    accounting), clips the granted gradient steps to the rate limiter's
+    budget, trains, and broadcasts refreshed actor weights (seq = update
+    round; players adopt the newest).  Insert credits stop flowing
+    whenever the limiter's error budget is exhausted — a slow trainer
+    therefore throttles its players instead of silently training on an
+    ever-staler ratio."""
+    start_iter = counters[0]
+
+    ctx = mp.get_context("spawn")
+    hub, channels, procs, env_shards = spawn_players(
+        cfg, runtime, ctx, _player_loop, extra_args=(counters, ratio_state, runtime.world_size), knobs=knobs
+    )
+
+    preemption = PreemptionHandler(forward_to=list(procs)).install()
+    params = opt_states = None
+
+    def _dump_and_raise(e: Exception, what: str):
+        path = None
+        try:
+            from sheeprl_tpu.utils.ckpt_format import save_state
+
+            if params is not None:
+                dump_dir = os.path.join(str(cfg.root_dir), str(cfg.run_name))
+                os.makedirs(dump_dir, exist_ok=True)
+                path = save_state(
+                    os.path.join(dump_dir, "emergency_trainer_0.ckpt"),
+                    _np_tree({"agent": params, "opt_states": opt_states}),
+                )
+        except Exception:
+            pass
+        raise RuntimeError(
+            f"decoupled player process died (all {knobs['num_players']} players gone: {e}) "
+            f"while the remote replay trainer waited for a {what}; trainer params/optimizer "
+            f"dumped to {path} (partial state: resume from the last regular ckpt_*.ckpt instead)"
+        ) from e
+
+    try:
+        # ---- init round: every player announces its spaces first (FIFO
+        # per channel guarantees init precedes any rb_insert)
+        spaces = None
+        for pid, ch in channels.items():
+            deadline = time.monotonic() + _QUEUE_TIMEOUT_S
+            while True:
+                try:
+                    frame = ch.recv(timeout=max(deadline - time.monotonic(), 0.01))
+                except PeerDiedError as e:
+                    _dump_and_raise(e, "init message")
+                if frame.tag == "init":
+                    spaces = frame.extra
+                    frame.release()
+                    break
+                frame.release()
+        observation_space, action_space = spaces
+
+        actor, critic, params, target_entropy = build_agent(
+            runtime, cfg, observation_space, action_space, state["agent"] if state else None
+        )
+        params = runtime.replicate(
+            runtime.to_param_dtype(params, exclude=("target_critic", "log_alpha"))
+        )
+        actor_tx = _make_optimizer(cfg.algo.actor.optimizer, runtime.precision)
+        critic_tx = _make_optimizer(cfg.algo.critic.optimizer, runtime.precision)
+        alpha_tx = _make_optimizer(cfg.algo.alpha.optimizer, runtime.precision)
+        if state is not None:
+            opt_states = restore_opt_states(
+                state["opt_states"], params, runtime.precision, key_map={"alpha": "log_alpha"}
+            )
+        else:
+            opt_states = runtime.replicate(
+                {
+                    "actor": actor_tx.init(params["actor"]),
+                    "critic": critic_tx.init(params["critic"]),
+                    "alpha": alpha_tx.init(params["log_alpha"]),
+                }
+            )
+        prioritized = bool(cfg.buffer.get("prioritized", False))
+        train_fn = make_train_fn(
+            runtime, actor, critic, (actor_tx, critic_tx, alpha_tx), cfg, target_entropy,
+            prioritized=prioritized,
+        )
+        total_envs = int(cfg.env.num_envs)
+        ema_every = cfg.algo.critic.target_network_frequency // total_envs + 1
+
+        learning_starts_t = int(cfg.algo.learning_starts) if not cfg.dry_run else 0
+        limiter = rate_limiter_from_cfg(cfg, default_min_size=max(learning_starts_t, 1))
+        buffer_size = cfg.buffer.size // total_envs if not cfg.dry_run else 1
+        server = ReplayServer(
+            max(buffer_size, 1),
+            env_shards,
+            channels,
+            obs_keys=("observations",),
+            limiter=limiter,
+            prioritized=prioritized,
+            per_alpha=float(cfg.buffer.get("per_alpha", 0.6)),
+            per_eps=float(cfg.buffer.get("per_eps", 1e-6)),
+            device=runtime.device,
+            credit_window=knobs["window"],
+        )
+        if state is not None and state.get("replay_server") is not None:
+            server.load_state_dict(state["replay_server"], rb_state=state.get("rb"))
+        beta_fn = per_beta_schedule(
+            cfg.buffer.get("per_beta", 0.4),
+            cfg.buffer.get("per_beta_end", 1.0),
+            int(cfg.algo.total_steps),
+        )
+        ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
+        if ratio_state is not None:
+            ratio.load_state_dict(ratio_state)
+
+        from sheeprl_tpu.obs import RecompileMonitor
+
+        trainer_mon = RecompileMonitor(name="sac_remote_replay_trainer").install()
+
+        batch_unit = int(cfg.algo.per_rank_batch_size) * runtime.world_size
+        need_rows = 2 if cfg.buffer.sample_next_obs else 1
+        update_round = 0
+        pending_g = 0
+        # FIXED dispatch size: the free-running loop grants a different g
+        # every pass, and every distinct g is a fresh XLA trace of the
+        # train scan — dispatching in exact dispatch_batch-sized chunks
+        # keeps it to one trace (leftover steps wait for the next grants)
+        dispatch_g = max(1, int(cfg.algo.get("dispatch_batch", 1)))
+        last_metrics: Dict[str, Any] = {}
+
+        def _broadcast_params(seq: int, extras) -> None:
+            arrays = _flat_leaves(_np_tree(params["actor"]))
+            for pid in server.live:
+                try:
+                    channels[pid].send(
+                        "params",
+                        arrays=arrays,
+                        extra=extras(pid),
+                        seq=seq,
+                        timeout=_QUEUE_TIMEOUT_S,
+                    )
+                except Exception as e:  # noqa: BLE001 — mark the player dead, keep serving the rest
+                    server._mark_dead(pid, f"params broadcast failed: {e}")
+
+        def _on_control(pid: int, frame) -> None:
+            tag = frame.tag
+            frame.release()
+            if tag != "ckpt_req":
+                return
+            try:
+                reply = {
+                    "agent": _np_tree(params),
+                    "opt_states": _np_tree(opt_states),
+                    "ratio": ratio.state_dict(),
+                    "replay_server": server.state_dict(),
+                }
+                if cfg.buffer.checkpoint:
+                    # the trainer-resident buffer rides to the lead pickled
+                    # (checkpoint cadence only; disable buffer.checkpoint
+                    # for buffers too big to ship over the transport)
+                    reply["rb"] = server.rb
+                channels[pid].send("ckpt_state", extra=(reply,), timeout=_QUEUE_TIMEOUT_S)
+            except (PeerDiedError, OSError) as e:
+                server._mark_dead(pid, f"ckpt_state reply failed: {e}")
+
+        # initial weights (players block on this before stepping)
+        _broadcast_params(0, lambda pid: ())
+
+        while not server.all_stopped:
+            try:
+                server.pump(0.05, on_control=_on_control)
+            except PeerDiedError as e:
+                _dump_and_raise(e, "replay insert")
+            # fault site: the whole replay service dies with the trainer
+            hard_exit_point("replay_server_exit")
+            clock = server.total_inserts  # transitions == policy steps
+            if clock >= learning_starts_t and server.data_ready(need_rows):
+                pending_g += ratio(max(clock - learning_starts_t, 0) + total_envs)
+            g = pending_g
+            if limiter is not None and g > 0:
+                g = min(g, limiter.sample_allowance(g * batch_unit) // batch_unit)
+            # one whole chunk per pass: a partial chunk waits for more
+            # grants, a backlog drains across passes (pumping in between)
+            g = dispatch_g if g >= dispatch_g else 0
+            if g <= 0:
+                continue
+            with trace_scope("replay_sample"):
+                data, sample_idx = server.sample(
+                    g,
+                    batch_unit,
+                    runtime.next_key(),
+                    beta_fn(clock),
+                    sample_next_obs=cfg.buffer.sample_next_obs,
+                    obs_keys=("observations",),
+                )
+            if sample_idx is None:
+                data = runtime.shard_batch(data, axis=1)
+            iter_equiv = clock // total_envs
+            ema_flags = jnp.full((g,), iter_equiv % ema_every == 0)
+            with timer("Time/train_time", SumMetric, sync_on_compute=cfg.metric.sync_on_compute):
+                if prioritized:
+                    params, opt_states, train_metrics, td_abs = train_fn(
+                        params, opt_states, data, runtime.next_key(), ema_flags
+                    )
+                else:
+                    params, opt_states, train_metrics = train_fn(
+                        params, opt_states, data, runtime.next_key(), ema_flags
+                    )
+                train_metrics = device_get_metrics(train_metrics)
+            if sample_idx is not None:
+                server.update_priorities(sample_idx, td_abs)
+            pending_g -= g
+            if not timer.disabled:
+                train_metrics["train_time"] = float(timer.compute().get("Time/train_time", 0.0))
+                timer.reset()
+            train_metrics["trainer_compiles"] = trainer_mon.compiles
+            trainer_mon.mark_warmup_complete()
+            last_metrics = train_metrics
+            update_round += 1
+            stats = server.stats()
+            stats["beta"] = round(beta_fn(clock), 4)
+            stats["events"] = server.events[-8:]
+            _broadcast_params(
+                update_round,
+                lambda pid: (last_metrics, stats if pid == 0 else None),
+            )
+            server.grant_credits()  # sampling freed SPI budget: resume inserts
+
+        trainer_mon.uninstall()
+        # the lead still runs its test episode + logger shutdown after the
+        # stop sentinel — give it ample time before the terminate fallback
+        for proc in procs:
+            proc.join(timeout=3600.0)
+    finally:
+        preemption.uninstall()
         hub.close()
         for proc in procs:
             if proc.is_alive():

@@ -128,27 +128,25 @@ def _append_window(bufs, block, pos, mask, valid, *, n_envs):
     return jax.lax.fori_loop(0, t_len, body, bufs)
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("n_samples", "batch_size", "cap", "n_envs", "next_keys"),
-)
-def _sample_transitions(bufs, key, pos, filled, *, n_samples, batch_size, cap, n_envs, next_keys):
-    """Gather (n_samples, batch, *feat) flat transitions, mirroring
-    ``ReplayBuffer.sample``: rows uniform over stored history (the row at
-    the write head excluded when next-obs are gathered — its successor is
-    stale), env uniform per element, next row = (row + 1) % cap.  SAC-family
-    buffers add all envs in lockstep, so pos/filled are shared scalars here
-    (the caller passes per-env vectors; element 0 is used)."""
-    flat = n_samples * batch_size
-    k_env, k_row = jax.random.split(key)
-    envs = jax.random.randint(k_env, (flat,), 0, n_envs)
+def _transition_window(pos, filled, *, cap, next_keys):
+    """Masked index space shared by the flat-transition samplers: the
+    oldest stored row (``base``) and the count of sampleable rows — the
+    row at the write head is excluded when next-obs are gathered (its
+    successor is stale).  SAC-family buffers add all envs in lockstep, so
+    pos/filled are shared scalars (element 0 of the per-env vectors).
+    Hoisted so the uniform and prioritized samplers agree on validity by
+    construction instead of forking the mask logic."""
     p0 = pos[0]
     f0 = filled[0]
     count = f0 - (1 if next_keys else 0)
     base = jnp.where(f0 >= cap, p0, 0)
-    u = jax.random.uniform(k_row, (flat,))
-    offs = jnp.minimum((u * count).astype(jnp.int32), count - 1)
-    rows = (base + offs) % cap
+    return base, count
+
+
+def _gather_transitions(bufs, rows, envs, *, n_samples, batch_size, cap, next_keys):
+    """Flat-transition gather shared by the uniform and prioritized
+    samplers: (flat,) row/env indices -> (n_samples, batch, *feat) dicts,
+    next row = (row + 1) % cap for ``next_keys``."""
     out = {}
     for k, buf in bufs.items():
         g = buf[rows, envs]  # (flat, *feat)
@@ -159,6 +157,60 @@ def _sample_transitions(bufs, key, pos, filled, *, n_samples, batch_size, cap, n
             g = bufs[k][nrows, envs]
             out[f"next_{k}"] = g.reshape(n_samples, batch_size, *bufs[k].shape[2:])
     return out
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("n_samples", "batch_size", "cap", "n_envs", "next_keys"),
+)
+def _sample_transitions(bufs, key, pos, filled, *, n_samples, batch_size, cap, n_envs, next_keys):
+    """Gather (n_samples, batch, *feat) flat transitions, mirroring
+    ``ReplayBuffer.sample``: rows uniform over stored history, env uniform
+    per element (see :func:`_transition_window` for the validity mask)."""
+    flat = n_samples * batch_size
+    k_env, k_row = jax.random.split(key)
+    envs = jax.random.randint(k_env, (flat,), 0, n_envs)
+    base, count = _transition_window(pos, filled, cap=cap, next_keys=next_keys)
+    u = jax.random.uniform(k_row, (flat,))
+    offs = jnp.minimum((u * count).astype(jnp.int32), count - 1)
+    rows = (base + offs) % cap
+    return _gather_transitions(
+        bufs, rows, envs, n_samples=n_samples, batch_size=batch_size, cap=cap, next_keys=next_keys
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("n_samples", "batch_size", "cap", "n_envs", "next_keys", "depth"),
+)
+def _sample_transitions_prioritized(
+    bufs, tree, key, pos, filled, beta, *, n_samples, batch_size, cap, n_envs, next_keys, depth
+):
+    """Proportional prioritized counterpart of :func:`_sample_transitions`:
+    (row, env) cells drawn from the sum-tree (leaf = row * n_envs + env),
+    validity by construction — unwritten cells carry zero priority, and
+    the per-env write-head row is zeroed in a functional tree copy when
+    next-obs are gathered (same exclusion as :func:`_transition_window`).
+    Returns the batch dict + ``is_weights`` (β-annealed, batch-max
+    normalized) and the sampled leaf indices for ``update_priorities``."""
+    from sheeprl_tpu.replay.priority_tree import _tree_sample, _tree_zeroed
+
+    flat = n_samples * batch_size
+    # live-cell count N for the IS correction w = (N * P(i))^-beta
+    n_live = jnp.sum(filled) - (n_envs if next_keys else 0)
+    t = tree
+    if next_keys:
+        head_rows = (pos - 1) % cap  # per-env newest row: its successor is stale
+        head_leaves = head_rows * n_envs + jnp.arange(n_envs)
+        t = _tree_zeroed(t, head_leaves, jnp.ones((n_envs,), bool), depth=depth)
+    leaves, w = _tree_sample(t, key, beta, n_live, n=flat, depth=depth)
+    rows = leaves // n_envs
+    envs = leaves % n_envs
+    out = _gather_transitions(
+        bufs, rows, envs, n_samples=n_samples, batch_size=batch_size, cap=cap, next_keys=next_keys
+    )
+    out["is_weights"] = w.reshape(n_samples, batch_size, 1)
+    return out, leaves.reshape(n_samples, batch_size)
 
 
 def _gather_windows(bufs, key, pos, filled, *, n_samples, batch_size, seq_len, cap, n_envs):
@@ -202,6 +254,44 @@ def _sample(bufs, key, pos, filled, *, n_samples, batch_size, seq_len, cap, n_en
     )
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("n_samples", "batch_size", "seq_len", "cap", "n_envs", "depth"),
+)
+def _sample_prioritized(
+    bufs, tree, key, pos, filled, beta, *, n_samples, batch_size, seq_len, cap, n_envs, depth
+):
+    """Prioritized sequence-START sampling (Dreamer family, behind
+    ``buffer.prioritized``): window starts drawn proportional to their
+    cell's priority instead of uniformly.  Validity matches
+    :func:`_gather_windows` exactly — the L-1 rows immediately preceding
+    each env's write head cannot start a full window (zeroed in a
+    functional tree copy; unwritten cells already carry zero priority).
+    Returns the window batch + the sampled start leaves (the caller may
+    decay them — recency-biased replay without a TD signal)."""
+    from sheeprl_tpu.replay.priority_tree import _tree_sample, _tree_zeroed
+
+    flat = n_samples * batch_size
+    t = tree
+    if seq_len > 1:
+        offs = jnp.arange(1, seq_len)  # (L-1,)
+        inv_rows = (pos[None, :] - offs[:, None]) % cap  # (L-1, n_envs)
+        inv_leaves = (inv_rows * n_envs + jnp.arange(n_envs)[None, :]).reshape(-1)
+        t = _tree_zeroed(t, inv_leaves, jnp.ones(inv_leaves.shape, bool), depth=depth)
+    n_live = jnp.sum(jnp.maximum(filled - seq_len + 1, 0))
+    leaves, _w = _tree_sample(t, key, beta, n_live, n=flat, depth=depth)
+    starts = leaves // n_envs
+    envs = leaves % n_envs
+    t_idx = (starts[:, None] + jnp.arange(seq_len)[None, :]) % cap  # (flat, L)
+    e_idx = envs[:, None]
+    out = {}
+    for k, buf in bufs.items():
+        g = buf[t_idx, e_idx]  # (flat, L, *feat)
+        g = g.reshape(n_samples, batch_size, seq_len, *buf.shape[2:])
+        out[k] = jnp.swapaxes(g, 1, 2)  # (n_samples, L, B, *feat)
+    return out, leaves
+
+
 @contextlib.contextmanager
 def sequence_batches(rb, device_cache, runtime, n_samples, batch_size, seq_len, key, **sample_kwargs):
     """Uniform train-loop feed: yields an iterable of per-gradient-step
@@ -212,7 +302,13 @@ def sequence_batches(rb, device_cache, runtime, n_samples, batch_size, seq_len, 
     the cache path only exists for plain sequential buffers, where they
     are no-ops."""
     if device_cache is not None and device_cache.can_sample(seq_len):
-        yield device_cache.sample(n_samples, batch_size, seq_len, key)
+        if getattr(device_cache, "prioritized", False) and device_cache._tree is not None:
+            # prioritized sequence-START sampling (Dreamer family): biased
+            # by design like DV2's prioritize_ends — no IS reweighting of
+            # the world-model losses, so β is irrelevant here
+            yield device_cache.sample_per(n_samples, batch_size, seq_len, key, beta=0.0)
+        else:
+            yield device_cache.sample(n_samples, batch_size, seq_len, key)
         return
     from sheeprl_tpu.data.feed import batched_feed
 
@@ -238,6 +334,8 @@ def maybe_create_for_transitions(cfg, runtime, rb, state=None):
     )
     if cache is not None and state is not None:
         cache.load_from_replay(rb)
+        if cache.prioritized:
+            cache.load_priority_state(state.get("replay_priority"))
     return cache
 
 
@@ -274,6 +372,12 @@ def maybe_create_for(cfg, runtime, rb, state=None):
                 + "; keeping the host feed path"
             )
         else:
+            if cfg.buffer.get("prioritized", False):
+                print(
+                    "DeviceReplayCache: buffer.prioritized=True ignored on the "
+                    "env-sharded cache (per-device sum-trees would need a "
+                    "cross-device mass reduction per draw); sampling stays uniform"
+                )
             cache = ShardedDeviceReplayCache(rb.buffer_size, rb.n_envs, runtime)
             print(
                 f"DeviceReplayCache: env-sharded replay window enabled "
@@ -282,6 +386,8 @@ def maybe_create_for(cfg, runtime, rb, state=None):
             )
     if cache is not None and state is not None:
         cache.load_from(rb)
+        if cache.prioritized:
+            cache.load_priority_state(state.get("replay_priority"))
     return cache
 
 
@@ -300,6 +406,10 @@ class DeviceReplayCache:
         device=None,
         budget_bytes: Optional[int] = None,
         conservative: bool = False,
+        prioritized: bool = False,
+        per_alpha: float = 0.6,
+        per_eps: float = 1e-6,
+        per_decay: Optional[float] = None,
     ):
         if capacity <= 0 or n_envs <= 0:
             raise ValueError(f"capacity ({capacity}) and n_envs ({n_envs}) must be positive")
@@ -308,6 +418,14 @@ class DeviceReplayCache:
         self._device = device
         self._budget = budget_bytes
         self._conservative = conservative
+        # prioritized replay (Schaul et al., 2016): a device sum-tree over
+        # the (row, env) cells rides next to the rings; False keeps the
+        # uniform samplers untouched (bit-exact with the pre-PER code)
+        self.prioritized = bool(prioritized)
+        self.per_alpha = float(per_alpha)
+        self.per_eps = float(per_eps)
+        self.per_decay = per_decay if per_decay is None else float(per_decay)
+        self._tree = None
         self._bufs: Optional[Dict[str, jax.Array]] = None
         self._pos = np.zeros(n_envs, dtype=np.int32)
         self._filled = np.zeros(n_envs, dtype=np.int32)
@@ -412,7 +530,37 @@ class DeviceReplayCache:
             k: self._zeros((self.capacity, self.n_envs, *v.shape[2:]), _store_dtype(v.dtype))
             for k, v in row.items()
         }
+        self._ensure_tree()
         return True
+
+    def _ensure_tree(self) -> None:
+        if self.prioritized and self._tree is None:
+            from sheeprl_tpu.replay.priority_tree import PriorityTree
+
+            self._tree = PriorityTree(
+                self.capacity * self.n_envs,
+                alpha=self.per_alpha,
+                eps=self.per_eps,
+                device=self._device,
+            )
+
+    def _seed_tree_window(
+        self, start: np.ndarray, t_len: int, mask_np: np.ndarray, valid: Optional[np.ndarray] = None
+    ) -> None:
+        """Priority-seed the cells just written (max-priority insert,
+        Schaul §3.3) — also what keeps ring OVERWRITE correct: the evicted
+        transition's stale priority is replaced, never sampled again.
+        ``valid`` mirrors the padded windowed append (padding rows leave
+        the tree untouched, and the pad keeps this write's trace count
+        matching ``_append_window``'s)."""
+        if self._tree is None:
+            return
+        rows = (start[None, :] + np.arange(t_len)[:, None]) % self.capacity  # (T, n_envs)
+        leaves = rows * self.n_envs + np.arange(self.n_envs)[None, :]
+        active = np.broadcast_to(mask_np[None, :], leaves.shape)
+        if valid is not None:
+            active = active & valid[:, None]
+        self._tree.seed_max(leaves.reshape(-1), np.ascontiguousarray(active).reshape(-1))
 
     # ---- array-placement hooks (the sharded subclass overrides ONLY these)
     def _zeros(self, shape, dtype):
@@ -476,6 +624,7 @@ class DeviceReplayCache:
             self._bufs = _append(
                 self._bufs, row, jnp.asarray(self._pos), jnp.asarray(mask_np), n_envs=self.n_envs
             )
+            self._seed_tree_window(self._pos, 1, mask_np)
         else:
             # pad to the fixed dispatch length (masked tail) so a short
             # final flush reuses the steady-state trace instead of
@@ -501,6 +650,7 @@ class DeviceReplayCache:
                 jnp.asarray(valid),
                 n_envs=self.n_envs,
             )
+            self._seed_tree_window(start, pad, mask_np, valid=valid)
         self._pos[idx] = (self._pos[idx] + advance) % self.capacity
         self._filled[idx] = np.minimum(self._filled[idx] + advance, self.capacity)
 
@@ -547,6 +697,7 @@ class DeviceReplayCache:
         self._filled = np.asarray(
             [b.buffer_size if b.full else b._pos for b in subs], dtype=np.int32
         )
+        self._reseed_tree_filled()
 
     # ------------------------------------------------------------- read
     def can_sample(self, seq_len: int) -> bool:
@@ -612,6 +763,114 @@ class DeviceReplayCache:
         need = 2 if sample_next_obs else 1
         return self.active and self._bufs is not None and bool(np.all(self._filled >= need))
 
+    # ------------------------------------------------- prioritized replay
+    def _reseed_tree_filled(self) -> None:
+        """Resume fallback: every stored cell enters at the initial
+        priority (uniform-at-start) — used when no saved tree state is
+        available; ``load_priority_state`` overwrites it when one is."""
+        if not self.prioritized or self._bufs is None:
+            return
+        self._ensure_tree()
+        base = np.where(self._filled >= self.capacity, self._pos, 0)  # (n_envs,)
+        offs = (np.arange(self.capacity)[:, None] - base[None, :]) % self.capacity
+        stored = offs < self._filled[None, :]  # (cap, n_envs) cell-filled mask
+        vals = stored.astype(np.float32).reshape(-1)
+        n = self.capacity * self.n_envs
+        self._tree.set_priorities(np.arange(n), vals)
+
+    def update_priorities(self, idx, td_abs) -> None:
+        """TD-error feedback hook for the train loops: ``idx`` is the
+        leaf-index array returned by the prioritized samplers (any shape),
+        ``td_abs`` the matching |δ|.  Stays on device end to end."""
+        if self._tree is None:
+            return
+        idx = jnp.asarray(idx).reshape(-1)
+        self._tree.update(idx, jnp.asarray(td_abs).reshape(-1))
+
+    def sample_transitions_per(
+        self,
+        n_samples: int,
+        batch_size: int,
+        key,
+        beta: float,
+        sample_next_obs: bool = False,
+        obs_keys: Sequence[str] = (),
+    ):
+        """Prioritized flat-transition draw: like :meth:`sample_transitions`
+        plus an ``is_weights`` key (n_samples, batch, 1); returns
+        ``(batch_dict, idx)`` where ``idx`` feeds
+        :meth:`update_priorities` after the train step."""
+        if batch_size <= 0 or n_samples <= 0:
+            raise ValueError(
+                f"'batch_size' ({batch_size}) and 'n_samples' ({n_samples}) must be both greater than 0"
+            )
+        need = 2 if sample_next_obs else 1
+        if not (self.active and self._bufs is not None and int(self._filled.min()) >= need):
+            raise ValueError("Not enough data in the device cache, add first")
+        if self._tree is None:
+            raise RuntimeError("prioritized sampling requested on a cache built without prioritized=True")
+        return _sample_transitions_prioritized(
+            self._bufs,
+            self._tree.tree,
+            jnp.asarray(key),
+            jnp.asarray(self._pos),
+            jnp.asarray(self._filled),
+            jnp.asarray(float(beta), jnp.float32),
+            n_samples=int(n_samples),
+            batch_size=int(batch_size),
+            cap=self.capacity,
+            n_envs=self.n_envs,
+            next_keys=tuple(obs_keys) if sample_next_obs else (),
+            depth=self._tree.depth,
+        )
+
+    def sample_per(
+        self, n_samples: int, batch_size: int, seq_len: int, key, beta: float
+    ) -> List[Dict[str, jax.Array]]:
+        """Prioritized sequence-start draw (Dreamer family): same output
+        layout as :meth:`sample`; start cells drawn proportional to
+        priority.  With ``per_decay`` set, sampled starts are decayed
+        afterwards — recency-biased replay without a TD signal (fresh
+        windows keep max priority until visited)."""
+        if not self.can_sample(seq_len):
+            raise ValueError(
+                f"Cannot sample a sequence of length {seq_len}. "
+                f"Data added so far: {int(self._filled.min())}"
+            )
+        if self._tree is None:
+            raise RuntimeError("prioritized sampling requested on a cache built without prioritized=True")
+        out, leaves = _sample_prioritized(
+            self._bufs,
+            self._tree.tree,
+            jnp.asarray(key),
+            jnp.asarray(self._pos),
+            jnp.asarray(self._filled),
+            jnp.asarray(float(beta), jnp.float32),
+            n_samples=int(n_samples),
+            batch_size=int(batch_size),
+            seq_len=int(seq_len),
+            cap=self.capacity,
+            n_envs=self.n_envs,
+            depth=self._tree.depth,
+        )
+        if self.per_decay is not None:
+            self._tree.scale(leaves, self.per_decay)
+        return [{k: v[i] for k, v in out.items()} for i in range(n_samples)]
+
+    def priority_state(self) -> Optional[Dict[str, Any]]:
+        """Checkpoint payload for the tree (None when not prioritized) —
+        rides the CheckpointManager snapshot next to the host buffer."""
+        return self._tree.state_dict() if self._tree is not None else None
+
+    def load_priority_state(self, state: Optional[Dict[str, Any]]) -> None:
+        if not self.prioritized or not self.active or self._bufs is None:
+            return
+        self._ensure_tree()
+        if state is None:
+            self._reseed_tree_filled()
+        else:
+            self._tree.load_state_dict(state)
+
     def load_from_replay(self, rb) -> None:
         """Refill from a plain (flat-transition) ``ReplayBuffer``."""
         if not self.active:
@@ -643,19 +902,27 @@ class DeviceReplayCache:
         filled = self.capacity if rb.full else pos
         self._pos = np.full(self.n_envs, pos, dtype=np.int32)
         self._filled = np.full(self.n_envs, filled, dtype=np.int32)
+        self._reseed_tree_filled()
 
     # ------------------------------------------------------------ factory
     @classmethod
     def maybe_create(cls, cfg, runtime, capacity: int, n_envs: int) -> Optional["DeviceReplayCache"]:
         """Create when gating allows (see module docstring), else None."""
         mode = device_cache_setting(cfg)
+        prioritized = bool(cfg.buffer.get("prioritized", False))
         if mode == "off":
+            if prioritized:
+                print(
+                    "DeviceReplayCache: buffer.prioritized=True ignored — "
+                    "buffer.device_cache=False disables the device sampler "
+                    "(the sum-tree lives with the cache); sampling stays uniform"
+                )
             return None
         if runtime.device_count != 1 or jax.process_count() != 1:
             # multi-device: sequence replay may still get the env-sharded
             # variant — maybe_create_for handles (and reports) that case
             return None
-        if mode == "auto" and runtime.device.platform == "cpu":
+        if mode == "auto" and runtime.device.platform == "cpu" and not prioritized:
             return None  # host-platform run: device_put is free, no win
         budget_gb = float(cfg.buffer.get("device_cache_budget_gb", 6.0))
         cache = cls(
@@ -664,10 +931,16 @@ class DeviceReplayCache:
             device=runtime.device,
             budget_bytes=int(budget_gb * 1e9) if mode == "auto" else None,
             conservative=mode == "auto",
+            prioritized=prioritized,
+            per_alpha=float(cfg.buffer.get("per_alpha", 0.6)),
+            per_eps=float(cfg.buffer.get("per_eps", 1e-6)),
+            per_decay=cfg.buffer.get("per_decay_on_sample", None),
         )
         print(
             f"DeviceReplayCache: HBM-resident replay window enabled "
-            f"(capacity {capacity} x {n_envs} envs, mode={mode})"
+            f"(capacity {capacity} x {n_envs} envs, mode={mode}"
+            + (", prioritized" if prioritized else "")
+            + ")"
         )
         return cache
 

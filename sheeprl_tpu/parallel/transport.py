@@ -58,9 +58,14 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from sheeprl_tpu.parallel.shm_ring import ShmReceiver, ShmSender
+from sheeprl_tpu.replay.service import RB_CREDIT_TAG, RB_INSERT_TAG
 from sheeprl_tpu.resilience.faults import get_injector, maybe_drop_or_delay_send
 from sheeprl_tpu.resilience.peer import PeerDiedError, queue_get_from_peer
 
+# frame-tag vocabulary over these channels: "init"/"data"/"params"/
+# "ckpt_req"/"ckpt_state"/"stop" (the fan-in protocol) plus the replay
+# service's RB_INSERT_TAG/RB_CREDIT_TAG (player→trainer raw-experience
+# inserts and the trainer's rate-limiter credit grants; replay/service.py)
 __all__ = [
     "Channel",
     "ChannelSpec",
@@ -68,6 +73,8 @@ __all__ = [
     "Frame",
     "ParamsFollower",
     "QueueChannel",
+    "RB_CREDIT_TAG",
+    "RB_INSERT_TAG",
     "ShmChannel",
     "TcpChannel",
     "TcpListener",

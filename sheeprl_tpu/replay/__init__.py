@@ -1,0 +1,48 @@
+"""Reverb-style replay subsystem: device-resident prioritized sampling,
+samples-per-insert rate control, and a remote replay service for the
+N-player decoupled topology.
+
+Three pillars (Cassirer et al., 2021; Schaul et al., 2016 — see PAPERS.md):
+
+- :mod:`sheeprl_tpu.replay.priority_tree` — a JAX binary sum-tree living
+  in device memory alongside the ``DeviceReplayCache`` rings: O(log n)
+  proportional sampling inside the jitted sample step, β-annealed
+  importance-sampling weights, batched priority updates from the train
+  steps' TD errors;
+- :mod:`sheeprl_tpu.replay.rate_limiter` — a SamplesPerInsert limiter
+  with Reverb semantics (target ratio + error budget) that throttles
+  whichever side of the collect/train pipeline runs ahead, in coupled
+  loops and across the decoupled transport (credit messages);
+- :mod:`sheeprl_tpu.replay.service` — the ReplayWriter/ReplayServer pair
+  that runs the buffer in the trainer process and accepts inserts from N
+  players over the PR-4 ``queue|shm|tcp`` transports, so decoupled
+  off-policy runs get player→replay-writer→prioritized-sampler instead
+  of ad-hoc sampled-batch shipping.
+"""
+
+from sheeprl_tpu.replay.priority_tree import (
+    PriorityTree,
+    per_beta_schedule,
+    priority_from_td,
+)
+from sheeprl_tpu.replay.rate_limiter import RateLimiter, rate_limiter_from_cfg
+from sheeprl_tpu.replay.service import (
+    RB_CREDIT_TAG,
+    RB_INSERT_TAG,
+    ReplayServer,
+    ReplayWriter,
+    remote_replay_setting,
+)
+
+__all__ = [
+    "PriorityTree",
+    "per_beta_schedule",
+    "priority_from_td",
+    "RateLimiter",
+    "rate_limiter_from_cfg",
+    "RB_CREDIT_TAG",
+    "RB_INSERT_TAG",
+    "ReplayServer",
+    "ReplayWriter",
+    "remote_replay_setting",
+]

@@ -1,0 +1,255 @@
+"""Device-resident binary sum-tree for proportional prioritized replay.
+
+Prioritized Experience Replay (Schaul et al., 2016) samples transition i
+with probability p_i^α / Σ p^α and corrects the induced bias with
+importance-sampling weights w_i = (N · P(i))^-β.  The classical host
+implementation is a mutable array-backed segment tree; here the tree is a
+single flat ``jax.Array`` living on the training device next to the
+``DeviceReplayCache`` rings, so sampling stays inside the jitted sample
+step — an O(log n) vectorized descent, no host round-trips — exactly the
+property that makes the device cache pay on remote-link TPU setups.
+
+Layout: 1-based heap in a ``(2·P,)`` float32 array where ``P`` is the
+leaf count padded to a power of two; index 0 is unused, the root (total
+mass) sits at 1, leaves at ``[P, 2·P)``.  All kernels take the depth
+``log2(P)`` statically, so the per-level loops unroll into a fixed
+gather/scatter chain XLA fuses well.
+
+Batched updates with duplicate leaf indices are safe: the leaf scatter
+picks one writer per duplicate (callers that can produce duplicates —
+``update_priorities`` with a batch that sampled the same transition
+twice — pass equal values per duplicate within one call), and parents
+are rebuilt bottom-up from the final child values, so the tree is always
+internally consistent.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["PriorityTree", "per_beta_schedule", "priority_from_td"]
+
+
+def priority_from_td(td_abs, alpha: float, eps: float):
+    """Schaul proportional priority: (|δ| + ε)^α (works on jnp or np)."""
+    return (abs(td_abs) + eps) ** alpha
+
+
+def per_beta_schedule(beta0: float, beta_end: float, total_steps: int):
+    """Linear β annealing (Schaul §3.4: anneal the IS correction toward 1
+    as training converges).  Returns ``step -> β`` on host floats."""
+    beta0 = float(beta0)
+    beta_end = float(beta_end)
+    span = max(int(total_steps), 1)
+
+    def beta(step: int) -> float:
+        frac = min(max(float(step) / span, 0.0), 1.0)
+        return beta0 + (beta_end - beta0) * frac
+
+    return beta
+
+
+def _write_impl(tree, leaf_idx, values, active, depth):
+    """Set ``leaf_idx`` to ``values`` where ``active``, keep the rest, and
+    rebuild the touched ancestor paths bottom-up."""
+    p = 1 << depth
+    node = leaf_idx.astype(jnp.int32) + p
+    cur = tree[node]
+    tree = tree.at[node].set(jnp.where(active, values.astype(tree.dtype), cur))
+    for _ in range(depth):
+        node = node >> 1
+        tree = tree.at[node].set(tree[2 * node] + tree[2 * node + 1])
+    return tree
+
+
+@functools.partial(jax.jit, donate_argnums=(0,), static_argnames=("depth",))
+def _tree_write(tree, leaf_idx, values, active, *, depth):
+    return _write_impl(tree, leaf_idx, values, active, depth)
+
+
+@functools.partial(jax.jit, static_argnames=("depth",))
+def _tree_zeroed(tree, leaf_idx, active, *, depth):
+    """Functional copy with ``leaf_idx`` zeroed where ``active`` — the
+    sampling-time exclusion mask (write-head rows whose next-obs is stale,
+    ring cells too close to the head to start a full sequence).  The
+    stored tree is untouched."""
+    return _write_impl(tree, leaf_idx, jnp.zeros(leaf_idx.shape, tree.dtype), active, depth)
+
+
+@functools.partial(jax.jit, static_argnames=("n", "depth"))
+def _tree_sample(tree, key, beta, count, *, n, depth):
+    """Draw ``n`` leaves proportional to priority + their IS weights.
+
+    ``count`` is the number of live transitions N in the IS correction
+    w_i = (N · P(i))^-β, normalized by the batch max (Schaul §3.4) so
+    weights only ever scale losses DOWN.
+    """
+    p = 1 << depth
+    total = tree[1]
+    u = jax.random.uniform(key, (n,)) * total
+    node = jnp.ones((n,), jnp.int32)
+    for _ in range(depth):
+        left = tree[2 * node]
+        go_right = u >= left
+        u = jnp.where(go_right, u - left, u)
+        node = 2 * node + go_right.astype(jnp.int32)
+    leaf = node - p
+    mass = tree[node]
+    # float-rounding guard: a draw can skid into a zero-mass leaf at a
+    # subtree boundary; fold it onto the heaviest neighbor direction by
+    # clamping the probability floor instead of resampling (probability
+    # ~ulp, bias unmeasurable, and the kernel stays branch-free)
+    probs = jnp.maximum(mass, jnp.finfo(tree.dtype).tiny) / jnp.maximum(total, jnp.finfo(tree.dtype).tiny)
+    w = (jnp.maximum(count.astype(tree.dtype), 1.0) * probs) ** (-beta)
+    w = w / jnp.max(w)
+    return leaf, w
+
+
+@functools.partial(jax.jit, donate_argnums=(0,), static_argnames=("depth",))
+def _tree_update(tree, max_p, leaf_idx, priorities, active, *, depth):
+    new_max = jnp.maximum(max_p, jnp.max(jnp.where(active, priorities, 0.0)))
+    tree = _write_impl(tree, leaf_idx, priorities, active, depth)
+    return tree, new_max
+
+
+class PriorityTree:
+    """Handle owning the device sum-tree + the running max priority.
+
+    ``n_leaves`` is the flat transition-cell count (the cache maps
+    ``(row, env) -> row * n_envs + env``).  ``max_priority`` stays a
+    device scalar: seeding appends and folding in TD updates never sync
+    to the host.
+    """
+
+    def __init__(
+        self,
+        n_leaves: int,
+        *,
+        alpha: float = 0.6,
+        eps: float = 1e-6,
+        device=None,
+        initial_priority: float = 1.0,
+    ):
+        if n_leaves <= 0:
+            raise ValueError(f"n_leaves must be positive, got {n_leaves}")
+        self.n_leaves = int(n_leaves)
+        self.alpha = float(alpha)
+        self.eps = float(eps)
+        self.depth = max(int(self.n_leaves - 1).bit_length(), 1)
+        self._device = device
+        with jax.default_device(device) if device is not None else _null():
+            self.tree = jnp.zeros(2 << self.depth, dtype=jnp.float32)
+            self.max_priority = jnp.asarray(float(initial_priority), dtype=jnp.float32)
+
+    # ------------------------------------------------------------- write
+    def seed_max(self, leaf_idx, active) -> None:
+        """Priority-seeded insert: new cells enter at the running max
+        priority so every transition is trained on at least once before
+        its priority can decay (Schaul §3.3 'new transitions arrive at
+        maximal priority')."""
+        leaf_idx = jnp.asarray(leaf_idx, jnp.int32)
+        vals = jnp.broadcast_to(self.max_priority, leaf_idx.shape)
+        self.tree = _tree_write(self.tree, leaf_idx, vals, jnp.asarray(active), depth=self.depth)
+
+    def update(self, leaf_idx, td_abs, active=None) -> None:
+        """TD-error feedback from the train step: p = (|δ| + ε)^α."""
+        leaf_idx = jnp.asarray(leaf_idx, jnp.int32)
+        if active is None:
+            active = jnp.ones(leaf_idx.shape, bool)
+        pri = priority_from_td(jnp.asarray(td_abs, jnp.float32).reshape(leaf_idx.shape), self.alpha, self.eps)
+        self.tree, self.max_priority = _tree_update(
+            self.tree, self.max_priority, leaf_idx, pri, jnp.asarray(active), depth=self.depth
+        )
+
+    def scale(self, leaf_idx, factor: float) -> None:
+        """Multiply the priorities at ``leaf_idx`` by ``factor`` (duplicate
+        indices scale once — gather-then-write).  Used for decay-on-sample
+        recency bias when no TD signal drives the priorities."""
+        leaf_idx = jnp.asarray(leaf_idx, jnp.int32).reshape(-1)
+        vals = self.priorities(leaf_idx) * jnp.float32(factor)
+        self.tree = _tree_write(
+            self.tree, leaf_idx, vals, jnp.ones(leaf_idx.shape, bool), depth=self.depth
+        )
+
+    def set_priorities(self, leaf_idx, priorities, active=None) -> None:
+        """Raw priority write (restore path / tests)."""
+        leaf_idx = jnp.asarray(leaf_idx, jnp.int32)
+        if active is None:
+            active = jnp.ones(leaf_idx.shape, bool)
+        self.tree = _tree_write(
+            self.tree, leaf_idx, jnp.asarray(priorities, jnp.float32), jnp.asarray(active), depth=self.depth
+        )
+
+    # ------------------------------------------------------------- read
+    def sample(
+        self, key, n: int, *, beta: float, count, exclude_idx=None, exclude_active=None
+    ) -> Tuple[jax.Array, jax.Array]:
+        """Proportional draw of ``n`` leaves (+ β-corrected IS weights).
+
+        ``exclude_idx``/``exclude_active`` zero those cells in a
+        functional copy first — the stored priorities survive (used for
+        the stale-next-obs head row and invalid sequence starts)."""
+        tree = self.tree
+        if exclude_idx is not None:
+            ex = jnp.asarray(exclude_idx, jnp.int32)
+            act = (
+                jnp.asarray(exclude_active)
+                if exclude_active is not None
+                else jnp.ones(ex.shape, bool)
+            )
+            tree = _tree_zeroed(tree, ex, act, depth=self.depth)
+        return _tree_sample(
+            tree,
+            jnp.asarray(key),
+            jnp.asarray(beta, jnp.float32),
+            jnp.asarray(count, jnp.float32),
+            n=int(n),
+            depth=self.depth,
+        )
+
+    def priorities(self, leaf_idx) -> jax.Array:
+        leaf = jnp.asarray(leaf_idx, jnp.int32) + (1 << self.depth)
+        return self.tree[leaf]
+
+    @property
+    def total(self) -> float:
+        return float(self.tree[1])
+
+    # ------------------------------------------------------- checkpoint
+    def state_dict(self) -> dict:
+        """Leaf priorities + running max as host numpy (rides the
+        CheckpointManager snapshot; internal nodes are derived state)."""
+        p = 1 << self.depth
+        return {
+            "leaves": np.asarray(self.tree[p : p + self.n_leaves]),
+            "max_priority": np.asarray(self.max_priority),
+            "alpha": self.alpha,
+            "eps": self.eps,
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        leaves = np.asarray(state["leaves"], np.float32)
+        if leaves.shape[0] != self.n_leaves:
+            raise ValueError(
+                f"priority state has {leaves.shape[0]} leaves, tree expects {self.n_leaves}"
+            )
+        p = 1 << self.depth
+        full = np.zeros(2 << self.depth, np.float32)
+        full[p : p + self.n_leaves] = leaves
+        # rebuild internal nodes host-side in one pass (resume cadence only)
+        for node in range(p - 1, 0, -1):
+            full[node] = full[2 * node] + full[2 * node + 1]
+        with jax.default_device(self._device) if self._device is not None else _null():
+            self.tree = jnp.asarray(full)
+            self.max_priority = jnp.asarray(float(state["max_priority"]), jnp.float32)
+
+
+def _null():
+    import contextlib
+
+    return contextlib.nullcontext()

@@ -1,0 +1,209 @@
+"""SamplesPerInsert rate control for the replay path (Reverb semantics).
+
+When collection and training are decoupled — across processes (the
+N-player topology) or merely across threads (the overlapped pipeline) —
+the effective replay ratio becomes an accident of relative process
+speeds: a fast trainer over-fits the early buffer, a fast collector
+starves training of gradient steps.  Reverb (Cassirer et al., 2021, §2.3)
+makes the ratio a first-class constraint: a limiter tracks the signed
+error between observed samples and the target ``samples_per_insert``
+ratio and blocks whichever side runs ahead once the error leaves a
+configured budget.
+
+Accounting (Reverb's ``SampleToInsertRatio``): with ``spi`` the target
+samples-per-insert, the tracked quantity is::
+
+    diff = inserts * spi - samples          # "sample credit"
+
+- an insert is allowed when ``diff + spi <= max_diff`` (collecting more
+  would let training fall too far behind);
+- a sample is allowed when ``diff - n >= min_diff`` AND at least
+  ``min_size_to_sample`` items were inserted (training more would race
+  ahead of collection);
+- ``error_buffer`` centers the ``[min_diff, max_diff]`` window on the
+  point where exactly ``min_size_to_sample`` items are in and none were
+  sampled, i.e. ``min_size_to_sample*spi ± error_buffer``.
+
+Units are TRANSITIONS on both sides (one env frame in, one sampled batch
+element out), so ``spi ≈ replay_ratio * batch_size / n_envs_per_step``
+relates it to the ``Ratio`` schedule's gradient-steps-per-policy-step.
+
+Single-thread (coupled) loops cannot block themselves: they use the
+non-blocking ``sample_allowance``/``insert_allowed`` queries to throttle
+whichever side is ahead (skip the gradient dispatch / hold the env step
+accounting).  Decoupled loops block for real: players wait on insert
+credits granted over the transport (see :mod:`sheeprl_tpu.replay.service`)
+and the trainer waits in :meth:`await_can_sample`.  Every stall is
+counted and timed — the stats ride the telemetry ``replay`` key so a
+throttled run is visible in ``telemetry.jsonl``, not just slow.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, Optional
+
+__all__ = ["RateLimiter", "rate_limiter_from_cfg"]
+
+
+class RateLimiter:
+    """Thread-safe samples-per-insert limiter with an error budget."""
+
+    def __init__(
+        self,
+        samples_per_insert: float,
+        *,
+        min_size_to_sample: int = 1,
+        error_buffer: Optional[float] = None,
+        min_diff: Optional[float] = None,
+        max_diff: Optional[float] = None,
+    ):
+        if samples_per_insert <= 0:
+            raise ValueError(f"samples_per_insert must be > 0, got {samples_per_insert}")
+        if min_size_to_sample < 1:
+            raise ValueError(f"min_size_to_sample must be >= 1, got {min_size_to_sample}")
+        self.spi = float(samples_per_insert)
+        self.min_size_to_sample = int(min_size_to_sample)
+        if error_buffer is None and min_diff is None and max_diff is None:
+            # a window this tight would deadlock a batched sampler; default
+            # to one batch-ish of slack on each side
+            error_buffer = max(self.spi, 1.0)
+        center = self.min_size_to_sample * self.spi
+        if error_buffer is not None:
+            if min_diff is not None or max_diff is not None:
+                raise ValueError("pass either error_buffer or explicit min_diff/max_diff, not both")
+            self.min_diff = center - float(error_buffer)
+            self.max_diff = center + float(error_buffer)
+        else:
+            self.min_diff = float(min_diff) if min_diff is not None else float("-inf")
+            self.max_diff = float(max_diff) if max_diff is not None else float("inf")
+        if self.min_diff > self.max_diff:
+            raise ValueError(f"min_diff ({self.min_diff}) > max_diff ({self.max_diff})")
+        self._cond = threading.Condition()
+        self.inserts = 0
+        self.samples = 0
+        self.insert_stalls = 0
+        self.sample_stalls = 0
+        self.insert_stall_s = 0.0
+        self.sample_stall_s = 0.0
+
+    # ----------------------------------------------------------- queries
+    def _diff(self) -> float:
+        return self.inserts * self.spi - self.samples
+
+    def can_insert(self, n: int = 1) -> bool:
+        with self._cond:
+            return self._can_insert(n)
+
+    def _can_insert(self, n: int) -> bool:
+        return self._diff() + n * self.spi <= self.max_diff
+
+    def can_sample(self, n: int = 1) -> bool:
+        with self._cond:
+            return self._can_sample(n)
+
+    def _can_sample(self, n: int) -> bool:
+        return self.inserts >= self.min_size_to_sample and self._diff() - n >= self.min_diff
+
+    def insert_allowance(self, max_n: int) -> int:
+        """How many of ``max_n`` inserts are allowed right now."""
+        with self._cond:
+            room = self.max_diff - self._diff()
+            return max(0, min(int(max_n), int(room // self.spi)))
+
+    def sample_allowance(self, max_n: int) -> int:
+        """How many of ``max_n`` samples are allowed right now (0 until
+        ``min_size_to_sample`` items are in)."""
+        with self._cond:
+            if self.inserts < self.min_size_to_sample:
+                return 0
+            return max(0, min(int(max_n), int(self._diff() - self.min_diff)))
+
+    # ----------------------------------------------------------- records
+    def insert(self, n: int = 1) -> None:
+        """Record ``n`` inserted items (never blocks; pair with
+        :meth:`await_can_insert` for enforcement)."""
+        with self._cond:
+            self.inserts += int(n)
+            self._cond.notify_all()
+
+    def sample(self, n: int = 1) -> None:
+        with self._cond:
+            self.samples += int(n)
+            self._cond.notify_all()
+
+    # ---------------------------------------------------------- blocking
+    def _await(self, check, n: int, timeout: Optional[float], stall_attr: str, alive) -> bool:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            if check(n):
+                return True
+            setattr(self, stall_attr + "_stalls", getattr(self, stall_attr + "_stalls") + 1)
+            t0 = time.monotonic()
+            try:
+                while not check(n):
+                    if alive is not None and not alive():
+                        return False
+                    remaining = None if deadline is None else deadline - time.monotonic()
+                    if remaining is not None and remaining <= 0:
+                        return False
+                    self._cond.wait(timeout=0.2 if remaining is None else min(0.2, remaining))
+                return True
+            finally:
+                setattr(
+                    self, stall_attr + "_stall_s", getattr(self, stall_attr + "_stall_s") + time.monotonic() - t0
+                )
+
+    def await_can_insert(self, n: int = 1, timeout: Optional[float] = None, alive=None) -> bool:
+        """Block until ``n`` inserts are allowed; False on timeout or when
+        ``alive()`` turns false.  Stall count/seconds are recorded."""
+        return self._await(self._can_insert, n, timeout, "insert", alive)
+
+    def await_can_sample(self, n: int = 1, timeout: Optional[float] = None, alive=None) -> bool:
+        return self._await(self._can_sample, n, timeout, "sample", alive)
+
+    # --------------------------------------------------------- telemetry
+    def stats(self) -> Dict[str, Any]:
+        with self._cond:
+            return {
+                "spi_target": self.spi,
+                "inserts": self.inserts,
+                "samples": self.samples,
+                "spi_observed": round(self.samples / self.inserts, 4) if self.inserts else None,
+                "error": round(self._diff(), 2),
+                "min_diff": self.min_diff,
+                "max_diff": self.max_diff,
+                "insert_stalls": self.insert_stalls,
+                "sample_stalls": self.sample_stalls,
+                "insert_stall_s": round(self.insert_stall_s, 3),
+                "sample_stall_s": round(self.sample_stall_s, 3),
+            }
+
+    # -------------------------------------------------------- checkpoint
+    def state_dict(self) -> Dict[str, int]:
+        with self._cond:
+            return {"inserts": self.inserts, "samples": self.samples}
+
+    def load_state_dict(self, state: Dict[str, int]) -> None:
+        with self._cond:
+            self.inserts = int(state["inserts"])
+            self.samples = int(state["samples"])
+            self._cond.notify_all()
+
+
+# fields accepted under ``buffer.rate_limiter`` (hydra dict)
+def rate_limiter_from_cfg(cfg, *, default_min_size: int = 1) -> Optional[RateLimiter]:
+    """Build a limiter from ``cfg.buffer.rate_limiter`` or return None
+    when rate control is off (``samples_per_insert`` null/absent)."""
+    rl_cfg = cfg.buffer.get("rate_limiter", None) or {}
+    spi = rl_cfg.get("samples_per_insert", None)
+    if spi is None:
+        return None
+    min_size = rl_cfg.get("min_size_to_sample", None)
+    error_buffer = rl_cfg.get("error_buffer", None)
+    return RateLimiter(
+        float(spi),
+        min_size_to_sample=int(min_size) if min_size is not None else int(default_min_size),
+        error_buffer=float(error_buffer) if error_buffer is not None else None,
+    )

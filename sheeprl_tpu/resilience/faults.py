@@ -36,6 +36,10 @@ Instrumented sites:
                             before a send (models a dropped link; exercises
                             reconnect-with-backoff + frame replay/dedupe)
 ``net_delay``               a tcp transport send sleeps ``arg`` seconds first
+``replay_server_exit``      the remote replay service's trainer process
+                            hard-exits between two pump rounds (models the
+                            whole buffer dying with the learner; players must
+                            surface a clear error + emergency dump, not hang)
 ==========================  ====================================================
 
 ``fault_point(name)`` returns True exactly when the armed site fires (a
@@ -64,6 +68,7 @@ KNOWN_SITES = (
     "trainer_exit",
     "net_drop",
     "net_delay",
+    "replay_server_exit",
 )
 
 

@@ -1,0 +1,170 @@
+"""E2E tests for the remote replay service topology (replay/service.py):
+decoupled SAC with player→replay-writer→prioritized-sampler experience
+path.  The quick queue-backend smoke + the replay_server_exit fault are
+tier-1; the full tcp run with limiter-throttle assertions is ``slow``
+(this container's tier-1 budget is tight and the transport-agnostic
+protocol is already covered by the unit suite)."""
+
+import glob
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from sheeprl_tpu.cli import run
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _args(tmp_path, name, extra=()):
+    return [
+        "exp=sac_decoupled",
+        "env=dummy",
+        "env.id=dummy_continuous",
+        "env.num_envs=2",
+        "env.sync_env=True",
+        "env.capture_video=False",
+        "fabric.accelerator=cpu",
+        "fabric.devices=1",
+        "metric.log_level=1",
+        f"metric.logger.root_dir={tmp_path}/logs",
+        "checkpoint.save_last=True",
+        "buffer.memmap=False",
+        "buffer.remote_replay=True",
+        "buffer.prioritized=True",
+        "algo.num_players=2",
+        "algo.per_rank_batch_size=4",
+        "algo.dense_units=8",
+        "algo.mlp_layers=1",
+        "algo.mlp_keys.encoder=[state]",
+        "algo.run_test=False",
+        "seed=0",
+        f"root_dir={tmp_path}/{name}",
+        *extra,
+    ]
+
+
+def _telemetry_replay(root):
+    tel = glob.glob(f"{root}/**/telemetry.jsonl", recursive=True)
+    assert tel, "lead player wrote no telemetry"
+    recs = [json.loads(line) for line in open(tel[0]) if line.strip()]
+    replay = [r["replay"] for r in recs if "replay" in r]
+    assert replay, "telemetry records carry no replay key"
+    return replay[-1]
+
+
+def test_remote_replay_n2_queue_smoke(tmp_path):
+    """Dry-run N=2 over the queue backend: the replay service path spins
+    up, trains, checkpoints through the ckpt_req/ckpt_state protocol."""
+    run(_args(tmp_path, "rrq", extra=["dry_run=True", "algo.decoupled_transport=queue"]))
+    ckpts = glob.glob(f"{tmp_path}/rrq/**/ckpt_*.ckpt", recursive=True)
+    assert ckpts, "remote-replay run produced no checkpoint"
+
+
+def test_remote_replay_server_exit_fault(tmp_path):
+    """The replay_server_exit fault kills the trainer (and with it the
+    whole buffer) between two pumps: players must fail with a CLEAR error
+    and exit — no hang.  Runs the real CLI in a subprocess (the fault
+    os._exit(13)s the main process)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["SHEEPRL_FAULTS"] = "replay_server_exit:5"
+    args = _args(
+        tmp_path,
+        "rrfault",
+        extra=[
+            "algo.total_steps=640",
+            "algo.learning_starts=8",
+            "algo.decoupled_transport=queue",
+            "metric.log_level=0",
+        ],
+    )
+    t0 = time.monotonic()
+    proc = subprocess.Popen(
+        [sys.executable, "sheeprl.py", *args],
+        cwd=_REPO_ROOT,
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+    try:
+        out, _ = proc.communicate(timeout=420)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        pytest.fail("replay_server_exit run hung instead of failing fast")
+    # hard_exit_point exits the trainer (main) process with 13; reading
+    # the inherited stdout to EOF above proves the players exited too
+    assert proc.returncode == 13, f"expected fault exit code 13, got {proc.returncode}\n{out[-2000:]}"
+    assert "remote replay server" in out, f"players died without the clear error:\n{out[-2000:]}"
+    assert time.monotonic() - t0 < 420
+
+
+@pytest.mark.slow
+def test_remote_replay_player_death_shrinks_service(tmp_path, monkeypatch):
+    """Killing a non-lead player mid-run shrinks the replay service's
+    fan-in (telemetry death count) while the run completes on the
+    survivors — the soak leg of the remote-replay fault matrix."""
+    monkeypatch.setenv("SHEEPRL_FAULTS", "player_exit:4:1")
+    run(
+        _args(
+            tmp_path,
+            "rrdeath",
+            extra=[
+                "algo.decoupled_transport=queue",
+                "algo.total_steps=64",
+                "algo.learning_starts=8",
+                "buffer.size=512",
+                "metric.log_every=8",
+            ],
+        )
+    )
+    monkeypatch.delenv("SHEEPRL_FAULTS")
+    ckpts = glob.glob(f"{tmp_path}/rrdeath/**/ckpt_*.ckpt", recursive=True)
+    assert ckpts, "run with a dead player wrote no checkpoint"
+    replay = _telemetry_replay(f"{tmp_path}/rrdeath")
+    assert replay.get("deaths", 0) == 1
+    assert replay["players"]["1"]["alive"] is False
+    assert replay["players"]["0"]["inserts"] > replay["players"]["1"]["inserts"]
+
+
+@pytest.mark.slow
+@pytest.mark.network
+def test_remote_replay_n2_tcp_with_limiter_throttle(tmp_path):
+    """Full N=2 run over tcp with a tight SamplesPerInsert budget: the
+    run completes, telemetry shows the replay service active AND the
+    limiter provably throttling (player insert stalls under a trainer
+    that cannot keep up with the SPI target)."""
+    run(
+        _args(
+            tmp_path,
+            "rrtcp",
+            extra=[
+                "algo.decoupled_transport=tcp",
+                "algo.total_steps=96",
+                "algo.learning_starts=16",
+                "buffer.size=512",
+                "buffer.rate_limiter.samples_per_insert=4",
+                "buffer.rate_limiter.error_buffer=32",
+                "buffer.rate_limiter.min_size_to_sample=16",
+                "metric.log_every=16",
+            ],
+        )
+    )
+    ckpts = glob.glob(f"{tmp_path}/rrtcp/**/ckpt_*.ckpt", recursive=True)
+    assert ckpts
+    replay = _telemetry_replay(f"{tmp_path}/rrtcp")
+    assert replay.get("remote") is True
+    assert replay.get("prioritized") is True
+    limiter = replay.get("limiter") or {}
+    assert limiter.get("inserts", 0) > 0
+    # observed SPI must track the target within the error budget
+    assert limiter.get("spi_observed") is not None
+    assert abs(limiter["spi_observed"] - 4.0) < 4.0
+    writer = replay.get("writer") or {}
+    # the throttle is visible: the trainer withheld credits and/or the
+    # lead player stalled waiting for them
+    assert writer.get("insert_stalls", 0) + replay.get("credit_grant_stalls", 0) > 0

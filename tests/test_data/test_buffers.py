@@ -252,6 +252,65 @@ class TestEpisodeBuffer:
         dirs = list((tmp_path / "eb2").glob("episode_*"))
         assert len(dirs) == len(eb.buffer) == 2
 
+    # ----- edge cases: windows at/below the episode length -----
+    def test_sample_at_exact_episode_length(self):
+        eb = EpisodeBuffer(100, 2, n_envs=1)
+        eb.add(_ep_data(6, 1, done_at=5))
+        s = eb.sample(8, sequence_length=6)  # window == episode length
+        assert s["observations"].shape == (1, 6, 8, 1)
+        # only one possible window: every sample is the full episode
+        np.testing.assert_array_equal(
+            s["observations"][0, :, 0, 0], s["observations"][0, :, 5, 0]
+        )
+
+    def test_sample_longer_than_any_episode_raises(self):
+        eb = EpisodeBuffer(100, 2, n_envs=1)
+        eb.add(_ep_data(6, 1, done_at=5))
+        with pytest.raises(RuntimeError, match="No valid episodes"):
+            eb.sample(4, sequence_length=7)
+
+    def test_sample_next_obs_needs_strictly_longer_episode(self):
+        eb = EpisodeBuffer(100, 2, n_envs=1, obs_keys=("observations",))
+        eb.add(_ep_data(6, 1, done_at=5))
+        # next-obs shifts the window by one: a length-6 episode cannot
+        # serve a length-6 window anymore
+        with pytest.raises(RuntimeError, match="No valid episodes"):
+            eb.sample(4, sequence_length=6, sample_next_obs=True)
+        s = eb.sample(4, sequence_length=5, sample_next_obs=True)
+        np.testing.assert_array_equal(
+            s["next_observations"][0, :, :, 0], s["observations"][0, :, :, 0] + 1
+        )
+
+    # ----- edge cases: eviction with in-progress episodes -----
+    def test_eviction_leaves_open_episodes_intact(self):
+        eb = EpisodeBuffer(10, 2, n_envs=2)
+        # env 1 accumulates an open (in-progress) episode across the
+        # evictions triggered by env 0's closed episodes
+        open_chunk = _ep_data(3, 2)
+        eb.add(open_chunk)  # both envs open
+        for _ in range(4):
+            eb.add(_ep_data(4, 1, done_at=3), env_idxes=[0])  # env 0 closes + evicts
+        assert len(eb.buffer) == 2  # stored episodes wrapped/evicted
+        assert len(eb._open_episodes[1]) == 1  # env 1's episode untouched
+        # closing env 1's episode afterwards stores the FULL accumulated run
+        tail = _ep_data(4, 1, done_at=3)
+        eb.add(tail, env_idxes=[1])
+        lengths = [e["terminated"].shape[0] for e in eb.buffer]
+        assert 3 + 4 in lengths
+
+    def test_incoming_episode_evicting_everything(self):
+        eb = EpisodeBuffer(10, 2, n_envs=1)
+        for _ in range(3):
+            eb.add(_ep_data(3, 1, done_at=2))
+        eb.add(_ep_data(10, 1, done_at=9))  # exactly buffer_size: evicts all
+        assert len(eb.buffer) == 1
+        assert len(eb) == 10
+
+    def test_episode_longer_than_buffer_rejected(self):
+        eb = EpisodeBuffer(8, 2, n_envs=1)
+        with pytest.raises(RuntimeError, match="too long"):
+            eb.add(_ep_data(9, 1, done_at=8))
+
 
 class TestMemmapArray:
     def test_ownership_and_pickle(self, tmp_path):

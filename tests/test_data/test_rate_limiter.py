@@ -1,0 +1,114 @@
+"""Unit tests for the SamplesPerInsert limiter (replay/rate_limiter.py)."""
+
+import threading
+import time
+
+import pytest
+
+from sheeprl_tpu.replay.rate_limiter import RateLimiter, rate_limiter_from_cfg
+
+
+def test_min_size_gates_sampling():
+    rl = RateLimiter(1.0, min_size_to_sample=10, error_buffer=100)
+    rl.insert(9)
+    assert not rl.can_sample(1)
+    rl.insert(1)
+    assert rl.can_sample(1)
+
+
+def test_spi_error_budget_window():
+    # spi=4, min_size=100, eb=40 -> diff window [360, 440]
+    rl = RateLimiter(4.0, min_size_to_sample=100, error_buffer=40)
+    rl.insert(100)  # diff = 400
+    assert rl.sample_allowance(1000) == 40  # down to min_diff=360
+    assert rl.insert_allowance(1000) == 10  # up to max_diff=440
+    rl.sample(40)  # diff = 360
+    assert not rl.can_sample(1)
+    rl.insert(1)  # diff = 364
+    assert rl.sample_allowance(1000) == 4  # one insert buys spi samples
+
+
+def test_observed_ratio_tracks_target():
+    rl = RateLimiter(2.0, min_size_to_sample=1, error_buffer=4)
+    total_s = 0
+    for _ in range(50):
+        rl.insert(1)
+        n = rl.sample_allowance(100)
+        rl.sample(n)
+        total_s += n
+    stats = rl.stats()
+    assert stats["inserts"] == 50
+    assert abs(stats["spi_observed"] - 2.0) <= 0.2
+    assert abs(stats["error"]) <= 4
+
+
+def test_await_can_sample_unblocks_on_insert_and_counts_stall():
+    rl = RateLimiter(1.0, min_size_to_sample=5, error_buffer=10)
+    result = {}
+
+    def sampler():
+        result["ok"] = rl.await_can_sample(1, timeout=10.0)
+
+    t = threading.Thread(target=sampler)
+    t.start()
+    time.sleep(0.1)
+    rl.insert(5)
+    t.join(timeout=5.0)
+    assert result["ok"]
+    stats = rl.stats()
+    assert stats["sample_stalls"] == 1
+    assert stats["sample_stall_s"] > 0
+
+
+def test_await_timeout_and_alive_abort():
+    rl = RateLimiter(1.0, min_size_to_sample=100, error_buffer=1)
+    t0 = time.monotonic()
+    assert not rl.await_can_sample(1, timeout=0.2)
+    assert time.monotonic() - t0 < 2.0
+    assert not rl.await_can_insert(10**9, timeout=5.0, alive=lambda: False)
+
+
+def test_insert_stall_accounting():
+    rl = RateLimiter(1.0, min_size_to_sample=1, error_buffer=2)
+    rl.insert(3)  # diff = 3 = max_diff
+    assert not rl.can_insert(1)
+    assert not rl.await_can_insert(1, timeout=0.1)
+    assert rl.stats()["insert_stalls"] == 1
+
+
+def test_state_roundtrip():
+    rl = RateLimiter(2.0, min_size_to_sample=2, error_buffer=8)
+    rl.insert(7)
+    rl.sample(3)
+    rl2 = RateLimiter(2.0, min_size_to_sample=2, error_buffer=8)
+    rl2.load_state_dict(rl.state_dict())
+    assert rl2.stats()["inserts"] == 7
+    assert rl2.stats()["samples"] == 3
+    assert rl2.sample_allowance(1000) == rl.sample_allowance(1000)
+
+
+def test_validation():
+    with pytest.raises(ValueError, match="samples_per_insert"):
+        RateLimiter(0.0)
+    with pytest.raises(ValueError, match="min_size_to_sample"):
+        RateLimiter(1.0, min_size_to_sample=0)
+    with pytest.raises(ValueError, match="either error_buffer"):
+        RateLimiter(1.0, error_buffer=1.0, min_diff=0.0)
+
+
+def test_from_cfg_disabled_and_enabled():
+    class _D(dict):
+        def get(self, k, default=None):
+            return dict.get(self, k, default)
+
+    class _Cfg:
+        def __init__(self, rl):
+            self.buffer = _D(rate_limiter=rl)
+
+    assert rate_limiter_from_cfg(_Cfg(None)) is None
+    assert rate_limiter_from_cfg(_Cfg(_D(samples_per_insert=None))) is None
+    rl = rate_limiter_from_cfg(
+        _Cfg(_D(samples_per_insert=2.0, min_size_to_sample=4, error_buffer=16.0))
+    )
+    assert rl is not None and rl.spi == 2.0 and rl.min_size_to_sample == 4
+    assert rl.max_diff - rl.min_diff == pytest.approx(32.0)

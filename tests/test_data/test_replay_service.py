@@ -1,0 +1,213 @@
+"""In-process unit tests for the remote replay service (replay/service.py):
+writer/server over real QueueChannel pairs (thread-local queue.Queue stands
+in for the mp.Queue — same put/get/qsize surface)."""
+
+import queue
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.parallel.transport import QueueChannel
+from sheeprl_tpu.replay import RateLimiter, ReplayServer, ReplayWriter
+from sheeprl_tpu.replay.service import RB_CREDIT_TAG, RB_INSERT_TAG
+
+
+def _channel_pair():
+    a, b = queue.Queue(maxsize=8), queue.Queue(maxsize=8)
+    player = QueueChannel(a, b, who="trainer")
+    trainer = QueueChannel(b, a, who="player")
+    return player, trainer
+
+
+def _step(t, n_envs, feat=3):
+    return {
+        "observations": np.full((1, n_envs, feat), t, np.float32),
+        "rewards": np.full((1, n_envs, 1), t, np.float32),
+        "next_observations": np.full((1, n_envs, feat), t + 1, np.float32),
+        "terminated": np.zeros((1, n_envs, 1), np.uint8),
+        "truncated": np.zeros((1, n_envs, 1), np.uint8),
+        "actions": np.zeros((1, n_envs, 2), np.float32),
+    }
+
+
+def _make(n_players=2, envs_per_player=1, buffer_size=16, limiter=None, prioritized=False):
+    players, trainer_chans = [], {}
+    shards = []
+    off = 0
+    for pid in range(n_players):
+        p, t = _channel_pair()
+        players.append(p)
+        trainer_chans[pid] = t
+        shards.append((off, envs_per_player))
+        off += envs_per_player
+    server = ReplayServer(
+        buffer_size, shards, trainer_chans, limiter=limiter, prioritized=prioritized,
+        credit_window=2,
+    )
+    writers = [
+        ReplayWriter(p, envs_per_player, initial_credits=2) for p in players
+    ]
+    return server, writers, players, trainer_chans
+
+
+def test_inserts_route_to_player_env_shards():
+    server, writers, _, _ = _make(n_players=2)
+    writers[0].append(_step(7, 1))
+    writers[1].append(_step(9, 1))
+    server.pump(0.2)
+    assert server.total_inserts == 2
+    assert server.inserts_by_player == {0: 1, 1: 1}
+    # player 0 -> env 0, player 1 -> env 1
+    assert float(server.rb.buffer[0]["observations"][0, 0, 0]) == 7.0
+    assert float(server.rb.buffer[1]["observations"][0, 0, 0]) == 9.0
+
+
+def test_credits_replenish_without_limiter():
+    server, writers, _, _ = _make(n_players=1)
+    w = writers[0]
+    for t in range(6):  # > initial window: only works if credits flow back
+        w.append(_step(t, 1), timeout=5.0)
+        server.pump(0.2)
+        w.pump(0.05)
+    assert server.total_inserts == 6
+    assert w.stalls == 0
+
+
+def test_limiter_withholds_credits_and_writer_stalls():
+    # spi=1, min_size=2, eb=2 -> max_diff=4: inserts stall once 4 ahead
+    limiter = RateLimiter(1.0, min_size_to_sample=2, error_buffer=2.0)
+    server, writers, _, _ = _make(n_players=1, limiter=limiter)
+    w = writers[0]
+    inserted = 0
+    for t in range(10):
+        try:
+            w.append(_step(t, 1), timeout=0.5)
+            inserted += 1
+            server.pump(0.1)
+            w.pump(0.05)
+        except queue.Full:
+            break
+    assert inserted < 10  # throttled before free-running
+    assert w.stalls >= 1 and w.stall_s > 0
+    assert server.credit_stall_players >= 1
+    # trainer samples -> budget frees -> credits flow again
+    limiter.sample(4)
+    server.grant_credits()
+    w.pump(0.2)
+    w.append(_step(99, 1), timeout=5.0)
+    server.pump(0.1)
+    assert server.total_inserts == inserted + 1
+    stats = server.stats()
+    assert stats["limiter"]["inserts"] == inserted + 1
+
+
+def test_sample_uniform_layout_and_limiter_accounting():
+    # budget generous enough that the 16-transition fill never throttles
+    limiter = RateLimiter(10.0, min_size_to_sample=1, error_buffer=1000.0)
+    server, writers, _, _ = _make(n_players=2, limiter=limiter)
+    for t in range(8):
+        for w in writers:
+            w.append(_step(t, 1))
+        server.pump(0.1)
+        for w in writers:
+            w.pump(0.01)
+    assert server.data_ready(2)
+    import jax
+
+    data, idx = server.sample(2, 4, jax.random.PRNGKey(0), beta=0.4)
+    assert idx is None  # uniform path
+    assert data["observations"].shape == (2, 4, 3)
+    assert limiter.stats()["samples"] == 8
+
+
+def test_sample_prioritized_returns_idx_and_weights():
+    server, writers, _, _ = _make(n_players=2, prioritized=True)
+    for t in range(8):
+        for w in writers:
+            w.append(_step(t, 1))
+        server.pump(0.1)
+        for w in writers:
+            w.pump(0.01)
+    import jax
+
+    data, idx = server.sample(1, 8, jax.random.PRNGKey(0), beta=0.5)
+    assert idx is not None and idx.shape == (1, 8)
+    assert data["is_weights"].shape == (1, 8, 1)
+    server.update_priorities(idx, np.zeros((1, 8), np.float32))  # no crash
+
+
+def test_stop_and_death_classification():
+    server, writers, players, trainer_chans = _make(n_players=2)
+    players[0].send("stop")
+    server.pump(0.2)
+    assert server.stopped == {0}
+    assert server.live == [1]
+    # a dead channel surfaces via PeerDiedError -> marked dead, not fatal
+    trainer_chans[1].set_peer(lambda: False, "player[1]", detail_fn=lambda: "exitcode=13")
+    server.pump(0.2)
+    assert 1 in server.dead
+    assert server.all_stopped
+
+
+def test_clean_exit_counts_as_stop_not_death():
+    server, writers, players, trainer_chans = _make(n_players=1)
+    trainer_chans[0].set_peer(lambda: False, "player[0]", detail_fn=lambda: "exitcode=0")
+    server.pump(0.2)
+    assert server.stopped == {0}
+    assert not server.dead
+
+
+def test_state_roundtrip_with_buffer():
+    limiter = RateLimiter(2.0, min_size_to_sample=1, error_buffer=50.0)
+    server, writers, _, _ = _make(n_players=2, limiter=limiter, prioritized=True)
+    for t in range(5):
+        for w in writers:
+            w.append(_step(t, 1))
+        server.pump(0.1)
+        for w in writers:
+            w.pump(0.01)
+    state = server.state_dict()
+    assert "rb" not in state  # buffer ships separately (top-level ckpt key)
+
+    limiter2 = RateLimiter(2.0, min_size_to_sample=1, error_buffer=50.0)
+    server2 = ReplayServer(
+        16, server.env_shards, {}, limiter=limiter2, prioritized=True, credit_window=2
+    )
+    server2.load_state_dict(state, rb_state=server.rb)
+    assert server2.total_inserts == server.total_inserts
+    assert server2.limiter.stats()["inserts"] == limiter.stats()["inserts"]
+    assert server2.cache._tree.total == pytest.approx(server.cache._tree.total)
+    np.testing.assert_allclose(
+        np.asarray(server2.rb.buffer[0]["observations"][:5, 0, 0]),
+        np.asarray(server.rb.buffer[0]["observations"][:5, 0, 0]),
+    )
+
+
+def test_writer_append_times_out_with_clear_error():
+    server, writers, _, _ = _make(n_players=1)
+    w = writers[0]
+    w.credits = 0
+    with pytest.raises(queue.Full, match="insert credits"):
+        w.append(_step(0, 1), timeout=0.3)
+
+
+def test_blocked_writer_unblocks_when_credit_arrives():
+    server, writers, players, trainer_chans = _make(n_players=1)
+    w = writers[0]
+    w.credits = 0
+    done = {}
+
+    def appender():
+        w.append(_step(1, 1), timeout=10.0)
+        done["ok"] = True
+
+    th = threading.Thread(target=appender)
+    th.start()
+    time.sleep(0.2)
+    trainer_chans[0].send(RB_CREDIT_TAG, extra=(1,))
+    th.join(timeout=5.0)
+    assert done.get("ok")
+    server.pump(0.2)
+    assert server.total_inserts == 1
