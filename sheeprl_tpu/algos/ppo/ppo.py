@@ -34,6 +34,7 @@ import optax
 from sheeprl_tpu.algos.ppo.agent import build_agent, evaluate_actions, get_values, PPOPlayer, sample_actions
 from sheeprl_tpu.algos.ppo.loss import entropy_loss, policy_loss, value_loss
 from sheeprl_tpu.algos.ppo.utils import normalize_obs, prepare_obs, test
+from sheeprl_tpu.algos.ppo.vtrace import vtrace
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.config.compose import _locate
 from sheeprl_tpu.data.buffers import ReplayBuffer
@@ -127,16 +128,57 @@ def make_update_fn(
     clip_vloss = bool(cfg.algo.clip_vloss)
     reduction = str(cfg.algo.loss_reduction)
     normalize_adv = bool(cfg.algo.normalize_advantages)
+    # V-trace off-policy correction (vtrace.py): replaces GAE with
+    # rho/c-clipped IS-weighted targets so per-shard policy lag in the
+    # decoupled fan-in is corrected instead of assumed-zero.  Off by
+    # default; with on-policy data the estimator is exactly GAE.
+    vt_cfg = cfg.algo.get("vtrace", None) or {}
+    use_vtrace = bool(vt_cfg.get("enabled", False))
+    vt_rho_clip = float(vt_cfg.get("rho_clip", 1.0))
+    vt_c_clip = float(vt_cfg.get("c_clip", 1.0))
 
     def _gae_and_flatten(params, data, next_obs):
-        """GAE on device, then flatten (T, E, ...) -> (T*E, ...)."""
+        """Value targets on device (GAE, or V-trace when enabled), then
+        flatten (T, E, ...) -> (T*E, ...).  A ``mask`` key in ``data``
+        (the mask-padded fan-in's env-validity columns) rides through the
+        flatten untouched — the minibatch losses consume it as weights."""
         norm_next_obs = normalize_obs(
             {k: next_obs[k].astype(jnp.float32) for k in obs_keys}, cnn_keys, obs_keys
         )
         next_values = get_values(module, params, norm_next_obs)
-        returns, advantages = gae(
-            data["rewards"], data["values"], data["dones"], next_values, gamma, gae_lambda
-        )
+        if use_vtrace:
+            # target-policy logprobs of the rollout actions under the
+            # CURRENT params: one extra forward pass over the rollout,
+            # the price of correcting per-shard staleness
+            t_len, n_env = data["rewards"].shape[:2]
+            flat_obs = normalize_obs(
+                {
+                    k: data[k].reshape(t_len * n_env, *data[k].shape[2:]).astype(jnp.float32)
+                    for k in obs_keys
+                },
+                cnn_keys,
+                obs_keys,
+            )
+            flat_actions = data["actions"].reshape(t_len * n_env, *data["actions"].shape[2:])
+            tgt_logprobs, _, _ = evaluate_actions(module, params, flat_obs, flat_actions)
+            log_rhos = tgt_logprobs.reshape(data["logprobs"].shape).astype(jnp.float32) - data[
+                "logprobs"
+            ].astype(jnp.float32)
+            returns, advantages = vtrace(
+                data["rewards"],
+                data["values"],
+                data["dones"],
+                next_values,
+                log_rhos,
+                gamma,
+                gae_lambda,
+                vt_rho_clip,
+                vt_c_clip,
+            )
+        else:
+            returns, advantages = gae(
+                data["rewards"], data["values"], data["dones"], next_values, gamma, gae_lambda
+            )
         data = {**data, "returns": returns, "advantages": advantages}
         n_total = data["rewards"].shape[0] * data["rewards"].shape[1]
         flat = {k: v.reshape(n_total, *v.shape[2:]) for k, v in data.items()}
@@ -183,12 +225,15 @@ def make_update_fn(
                 obs = {k: mb[k].astype(jnp.float32) for k in obs_keys}
                 obs = normalize_obs(obs, cnn_keys, obs_keys)
                 new_logprobs, entropy, new_values = evaluate_actions(module, p, obs, mb["actions"])
+                w = mb.get("mask")  # mask-padded fan-in: dead columns weigh 0
                 adv = mb["advantages"]
                 if normalize_adv:
-                    adv = normalize_tensor(adv)
-                pg = policy_loss(new_logprobs, mb["logprobs"], adv, clip_coef, reduction)
-                vl = value_loss(new_values, mb["values"], mb["returns"], clip_coef, clip_vloss, reduction)
-                ent = entropy_loss(entropy, reduction)
+                    adv = normalize_tensor(adv, mask=w > 0 if w is not None else None)
+                pg = policy_loss(new_logprobs, mb["logprobs"], adv, clip_coef, reduction, weights=w)
+                vl = value_loss(
+                    new_values, mb["values"], mb["returns"], clip_coef, clip_vloss, reduction, weights=w
+                )
+                ent = entropy_loss(entropy, reduction, weights=w)
                 total = pg + vf_coef * vl + ent_coef * ent
                 return total, jnp.stack([pg, vl, ent])
 
@@ -262,12 +307,15 @@ def make_update_fn(
             obs = {k: mb[k].astype(jnp.float32) for k in obs_keys}
             obs = normalize_obs(obs, cnn_keys, obs_keys)
             new_logprobs, entropy, new_values = evaluate_actions(module, p, obs, mb["actions"])
+            w = mb.get("mask")  # mask-padded fan-in: dead columns weigh 0
             adv = mb["advantages"]
             if normalize_adv:
-                adv = normalize_tensor(adv)
-            pg = policy_loss(new_logprobs, mb["logprobs"], adv, clip_coef, reduction)
-            vl = value_loss(new_values, mb["values"], mb["returns"], clip_coef, clip_vloss, reduction)
-            ent = entropy_loss(entropy, reduction)
+                adv = normalize_tensor(adv, mask=w > 0 if w is not None else None)
+            pg = policy_loss(new_logprobs, mb["logprobs"], adv, clip_coef, reduction, weights=w)
+            vl = value_loss(
+                new_values, mb["values"], mb["returns"], clip_coef, clip_vloss, reduction, weights=w
+            )
+            ent = entropy_loss(entropy, reduction, weights=w)
             total = pg + vf_coef * vl + ent_coef * ent
             return total, jnp.stack([pg, vl, ent])
 

@@ -36,6 +36,8 @@ from __future__ import annotations
 import copy
 import multiprocessing as mp
 import os
+import queue as queue_mod
+import time
 import warnings
 from typing import Any, Dict
 
@@ -51,8 +53,10 @@ from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.obs import setup_observability, trace_scope
 from sheeprl_tpu.parallel.transport import (
     FanIn,
+    HeartbeatSender,
+    JOIN_TAG,
     ParamsFollower,
-    assemble_shards,
+    assemble_shards_padded,
     make_transport,
     split_envs,
     transport_setting,
@@ -105,25 +109,54 @@ def _unflat_leaves(treedef, payload: Dict[str, np.ndarray]) -> Any:
 def decoupled_knobs(cfg) -> Dict[str, Any]:
     """The fan-in configuration surface, resolved with defaults (shared
     with sac_decoupled)."""
+    from sheeprl_tpu.resilience.supervisor import supervisor_knobs
+
     lag = int(cfg.algo.get("decoupled_params_lag", 1))
+    vt = cfg.algo.get("vtrace", None) or {}
+    vtrace_on = bool(vt.get("enabled", False))
+    supervisor = supervisor_knobs(cfg)
+    # soft-lag mode: players adopt the NEWEST available params instead of
+    # blocking for the exact fixed-lag target.  Implied by V-trace (the
+    # learner corrects variable staleness) and by supervision (a rejoined
+    # player resyncs its round clock off the broadcasts); max_lag is the
+    # soft bound past which a player still blocks.
+    soft_lag = vtrace_on or supervisor["enabled"]
+    max_lag = int(vt.get("max_lag", 4)) if vtrace_on else lag
     return {
         "backend": transport_setting(cfg),
         "num_players": int(cfg.algo.get("num_players", 1)),
         "lag": lag,
-        # a player may have up to lag+1 unacked shards in flight
-        "window": max(2, int(cfg.algo.get("transport_window", 0)) or lag + 1),
+        "vtrace": vtrace_on,
+        "soft_lag": soft_lag,
+        "max_lag": max_lag,
+        "supervisor": supervisor,
+        # peer-death polling cadence + protocol-wait ceiling (PR-2's
+        # hard-coded constants, now configurable)
+        "liveness_interval": float(cfg.algo.get("liveness_interval", 0.5)),
+        "liveness_timeout": float(cfg.algo.get("liveness_timeout", _QUEUE_TIMEOUT_S)),
+        # a player may have up to lag+1 unacked shards in flight (soft
+        # mode: up to max_lag+1)
+        "window": max(2, int(cfg.algo.get("transport_window", 0)) or max(lag, max_lag) + 1),
         "host": str(cfg.algo.get("tcp_host", "127.0.0.1")),
         "port": int(cfg.algo.get("tcp_port", 0)),
         "compress_min": 65536 if bool(cfg.algo.get("tcp_compress", False)) else 0,
     }
 
 
-def _player_loop(cfg, spec, state_counters, world_size: int, env_offset: int, n_local_envs: int) -> None:
+def _player_loop(
+    cfg, spec, state_counters, world_size: int, env_offset: int, n_local_envs: int, join: bool = False
+) -> None:
     """Player process body (reference ppo_decoupled.py:32-365).
 
     Runs on the host CPU backend (the parent exports JAX_PLATFORMS=cpu
     around the spawn): owns its SHARD of the envs; player 0 (the lead)
     additionally owns the logger, telemetry and checkpoint files.
+
+    ``join=True`` is the supervised-restart path: instead of the startup
+    ``init`` round the player announces itself with a ``join`` frame and
+    syncs its round clock + weights off the trainer's ``assign`` reply,
+    then keeps itself synced off the params broadcasts (a joiner that
+    boots slowly fast-forwards instead of falling behind forever).
     """
     import gymnasium as gym
     from gymnasium.vector import AsyncVectorEnv, AutoresetMode, SyncVectorEnv
@@ -189,17 +222,45 @@ def _player_loop(cfg, spec, state_counters, world_size: int, env_offset: int, n_
 
     # one duplex channel to the trainer over the configured backend
     channel = spec.player_channel(peer_alive=parent_alive, who="trainer")
+    timeout_s = knobs["liveness_timeout"]
+    # supervised pools get a liveness beacon so the trainer can tell
+    # "slow" from "silent" even without a process handle
+    heartbeat = (
+        HeartbeatSender(channel, interval=max(2 * knobs["liveness_interval"], 1.0))
+        if knobs["supervisor"]["enabled"]
+        else None
+    )
 
     # hand the agent blueprint to the trainer (reference broadcasts
     # agent_args from the player, :117); every player sends one so the
-    # trainer can proceed from whichever subset survives startup
-    channel.send("init", extra=(observation_space, actions_dim, is_continuous))
+    # trainer can proceed from whichever subset survives startup.  A
+    # supervised RESTART announces itself with a join frame instead and
+    # syncs its round clock off the trainer's assign reply below.
+    channel.send(JOIN_TAG if join else "init", extra=(observation_space, actions_dim, is_continuous))
 
     # inference-only agent; weights arrive on the params broadcast
     module, params = build_agent(runtime, actions_dim, is_continuous, cfg, observation_space)
     params_treedef = jax.tree_util.tree_structure(params)
 
     start_iter, policy_step, last_log, last_checkpoint = state_counters
+    policy_steps_per_iter = int(cfg.env.num_envs * cfg.algo.rollout_steps)
+    params_floor = start_iter - 1  # seq of the initial broadcast to wait for
+    if join:
+        # the assign reply carries (resume round, seq of the params frame
+        # the trainer ships this channel right after); counters are global
+        # functions of the round clock, so everything local re-derives
+        deadline = time.monotonic() + timeout_s
+        while True:
+            frame = channel.recv(timeout=max(deadline - time.monotonic(), 0.01))
+            if frame.tag == "assign":
+                break
+            frame.release()
+        resume_iter, params_floor = int(frame.extra[0]), int(frame.extra[1])
+        frame.release()
+        start_iter = max(start_iter, resume_iter)
+        policy_step = (start_iter - 1) * policy_steps_per_iter
+        last_log = policy_step  # a rejoined lead restarts its cadences
+        last_checkpoint = policy_step
 
     train_step = 0
     last_train = 0
@@ -238,8 +299,8 @@ def _player_loop(cfg, spec, state_counters, world_size: int, env_offset: int, n_
     follower = ParamsFollower(
         channel,
         lag=knobs["lag"],
-        initial_seq=start_iter - 2,
-        timeout=_QUEUE_TIMEOUT_S,
+        initial_seq=params_floor - 1,
+        timeout=timeout_s,
         on_stale=_apply_params_extra,
     )
 
@@ -275,10 +336,14 @@ def _player_loop(cfg, spec, state_counters, world_size: int, env_offset: int, n_
             "(partial state: resume from the last regular ckpt_*.ckpt instead)"
         ) from e
 
-    # initial weights (the trainer broadcasts seq = start_iter - 1);
-    # nothing to dump yet if the trainer dies here
+    # initial weights (the trainer broadcasts seq = start_iter - 1; a
+    # joiner waits for AT LEAST the seq its assign reply named — a net
+    # drop mid-handshake can replace the directed frame with the replay
+    # of a newer broadcast); nothing to dump yet if the trainer dies here
     try:
-        init_frame = follower.advance_to(start_iter - 1)
+        init_frame = (
+            follower.advance_to_at_least(params_floor) if join else follower.advance_to(params_floor)
+        )
     except PeerDiedError as e:
         raise RuntimeError(
             f"decoupled trainer process died before the initial params broadcast "
@@ -324,7 +389,6 @@ def _player_loop(cfg, spec, state_counters, world_size: int, env_offset: int, n_
         else None
     )
     preemption = None if lead else PreemptionHandler().install()
-    policy_steps_per_iter = int(cfg.env.num_envs * cfg.algo.rollout_steps)
     total_iters = cfg.algo.total_steps // policy_steps_per_iter if not cfg.dry_run else 1
     if lead and cfg.metric.log_level > 0 and cfg.metric.log_every % policy_steps_per_iter != 0:
         warnings.warn(
@@ -335,13 +399,30 @@ def _player_loop(cfg, spec, state_counters, world_size: int, env_offset: int, n_
     step_data: Dict[str, np.ndarray] = {}
     next_obs_np = envs.reset(seed=cfg.seed + env_offset)[0]
 
-    for iter_num in range(start_iter, total_iters + 1):
+    iter_num = start_iter - 1
+    while iter_num < total_iters:
+        iter_num += 1
+        if knobs["soft_lag"] and follower.current_seq + 1 > iter_num:
+            # resync: the broadcasts show the pool is rounds ahead of this
+            # player (a joiner that booted slowly, or a player that lost
+            # rounds to a reconnect) — fast-forward the clock instead of
+            # shipping shards for rounds the trainer already closed
+            iter_num = follower.current_seq + 1
+            policy_step = (iter_num - 1) * policy_steps_per_iter
+            if iter_num > total_iters:
+                break
         observability.on_iteration(policy_step)
         hard_exit_point("player_exit", index=player_id)  # fault site: a player crash
-        # fixed-lag params adoption: rollout k acts on EXACTLY the weights
-        # of update k - 1 - lag (warmup: the initial broadcast)
+        # params adoption: the strict path acts on EXACTLY the weights of
+        # update k - 1 - lag (warmup: the initial broadcast); the soft
+        # path (V-trace / supervised pools) adopts the newest available
+        # and only blocks past the max_lag soft bound — the learner's
+        # importance correction absorbs the variable staleness
         try:
-            frame = follower.params_for_round(iter_num)
+            if knobs["soft_lag"]:
+                frame = follower.adopt_newest(iter_num, knobs["max_lag"])
+            else:
+                frame = follower.params_for_round(iter_num)
         except PeerDiedError as e:
             _die_with_dump(e, policy_step, iter_num)
         new_params = _adopt(frame) if frame is not None else player.params
@@ -412,7 +493,16 @@ def _player_loop(cfg, spec, state_counters, world_size: int, env_offset: int, n_
         ]
         try:
             with trace_scope("ipc_send_shard"):
-                channel.send("data", arrays=arrays, extra=(need_ckpt,), seq=iter_num, timeout=_QUEUE_TIMEOUT_S)
+                # extra carries the BEHAVIOR-policy version this shard
+                # acted with: the trainer's V-trace correction + lag
+                # telemetry key off it
+                channel.send(
+                    "data",
+                    arrays=arrays,
+                    extra=(need_ckpt, follower.current_seq),
+                    seq=iter_num,
+                    timeout=timeout_s,
+                )
         except PeerDiedError as e:
             _die_with_dump(e, policy_step, iter_num)
 
@@ -509,6 +599,8 @@ def _player_loop(cfg, spec, state_counters, world_size: int, env_offset: int, n_
         channel.send("stop")
     except Exception:
         pass  # a dead trainer cannot receive it; exit anyway
+    if heartbeat is not None:
+        heartbeat.close()
     if ckpt_mgr is not None:
         ckpt_mgr.close()
     if preemption is not None:
@@ -542,6 +634,7 @@ def spawn_players(cfg, runtime, ctx, target, extra_args=(), knobs=None):
         compress_min=knobs["compress_min"],
         host=knobs["host"],
         port=knobs["port"],
+        poll_s=knobs["liveness_interval"],
     )
     procs = []
     # the env copies the parent's environ at start, so the override only
@@ -613,19 +706,45 @@ def main(runtime, cfg: Dict[str, Any]):
     )
 
     ctx = mp.get_context("spawn")
-    hub, channels, procs, env_shards = spawn_players(
+    hub, channels, proc_list, env_shards = spawn_players(
         cfg, runtime, ctx, _player_loop, extra_args=(counters, runtime.world_size), knobs=knobs
     )
+    procs: Dict[int, Any] = dict(enumerate(proc_list))
     rollout_steps = int(cfg.algo.rollout_steps)
-    fanin = FanIn(
-        channels,
-        env_steps_per_frame={pid: count * rollout_steps for pid, (_, count) in enumerate(env_shards)},
-    )
+    steps_per_frame = {pid: count * rollout_steps for pid, (_, count) in enumerate(env_shards)}
+    fanin = FanIn(channels, env_steps_per_frame=steps_per_frame)
 
     # a SIGTERM delivered to the trainer only (per-process preemption) is
     # forwarded to every player; the lead owns the checkpoint files and
     # runs the emergency-save path, the others drain out cleanly
-    preemption = PreemptionHandler(forward_to=list(procs)).install()
+    preemption = PreemptionHandler(forward_to=list(procs.values())).install()
+
+    # elastic pool: the supervisor restarts dead players (with backoff,
+    # under a restart budget) as JOIN-mode processes that re-man their
+    # deterministic env shard at the current round
+    supervisor = None
+    if knobs["supervisor"]["enabled"]:
+        from sheeprl_tpu.resilience import PlayerSupervisor
+
+        def _respawn_args(pid, spec):
+            offset, count = env_shards[pid]
+            return (cfg, spec, counters, runtime.world_size, offset, count, True)
+
+        supervisor = PlayerSupervisor(
+            ctx,
+            hub,
+            fanin,
+            _player_loop,
+            _respawn_args,
+            procs,
+            restart_budget=knobs["supervisor"]["restart_budget"],
+            backoff_base=knobs["supervisor"]["backoff_base"],
+            backoff_max=knobs["supervisor"]["backoff_max"],
+            heartbeat_timeout=knobs["supervisor"]["heartbeat_timeout"],
+            steps_per_frame=steps_per_frame,
+            preemption=preemption,
+            join_timeout=knobs["liveness_timeout"],
+        )
 
     def _dump_and_raise(e: PeerDiedError, what: str):
         """Every player died: final trainer dump + a clear error (the
@@ -697,24 +816,61 @@ def main(runtime, cfg: Dict[str, Any]):
         current_ent = float(cfg.algo.ent_coef)
 
         known_live = len(fanin.live)
+        last_completed_seq = start_iter - 1
+
+        def _on_control(pid, frame):
+            """Join handshake: a supervised restart announces itself with
+            a join frame; the reply is its round clock (skip the in-flight
+            round) + the current weights (a joiner missed every earlier
+            broadcast).  The env-shard assignment is implied by the pid —
+            the same deterministic ``split_envs`` slot it held before."""
+            if frame.tag == JOIN_TAG:
+                frame.release()
+                fanin.send_to(pid, "assign", extra=(last_completed_seq + 2, last_completed_seq))
+                fanin.send_to(
+                    pid, "params", arrays=_flat_leaves(_np_tree(params)), seq=last_completed_seq
+                )
+            else:
+                frame.release()
+
         while True:
+            if supervisor is not None:
+                supervisor.poll()
             # named span: the trainer idling for the next fan-in round (the
             # inverse of the players' ipc_wait_update stall)
             try:
                 with trace_scope("ipc_wait_rollout"):
-                    seq, frames = fanin.gather(timeout=_QUEUE_TIMEOUT_S)
+                    seq, frames = fanin.gather(timeout=_QUEUE_TIMEOUT_S, on_control=_on_control)
             except PeerDiedError as e:
+                if supervisor is not None and supervisor.recoverable():
+                    # the whole pool died at once but restarts are pending:
+                    # stay alive, the joiners' frames will form a round
+                    time.sleep(0.2)
+                    continue
                 _dump_and_raise(e, "rollout")
+            except queue_mod.Empty:
+                if supervisor is not None and (fanin.joining or supervisor.recoverable()):
+                    continue
+                raise
             if not frames:
                 break  # every player stopped
             if len(fanin.live) != known_live:
                 known_live = len(fanin.live)
                 runtime.print(
-                    f"fan-in shrank to {known_live} player(s) "
-                    f"(dead: {sorted(fanin.dead)}): batch reshapes, one XLA recompile"
+                    f"elastic fan-in now {known_live} player(s) "
+                    f"(dead: {sorted(fanin.dead)}, joining: {sorted(fanin.joining)}): "
+                    "mask-padded batch keeps its shape, no retrace"
                 )
             iter_num = seq
-            need_ckpt = bool(frames[0].extra[0]) if 0 in frames else False
+            need_ckpt = False
+            for pid, frame in frames.items():
+                extra = frame.extra or ()
+                if pid == 0 and extra:
+                    need_ckpt = bool(extra[0])
+                if len(extra) > 1:
+                    # behavior-policy version this shard acted with: the
+                    # lag histogram is the V-trace soft-bound telemetry
+                    fanin.note_lag(pid, (seq - 1) - int(extra[1]))
 
             # per-player shard -> materialized arrays (the astype/copy
             # below frees the transport buffers right after)
@@ -730,10 +886,18 @@ def main(runtime, cfg: Dict[str, Any]):
                     k[2:]: np.array(v) for k, v in frame.arrays.items() if k.startswith("o/")
                 }
                 frame.release()
-            # deterministic global layout: env axis concatenated in
-            # player-id order regardless of shard arrival order
-            local_data = assemble_shards(data_shards, axis=1)
-            final_obs = assemble_shards(obs_shards, axis=0)
+            # deterministic FIXED-WIDTH layout: each player's env columns
+            # land at its split_envs offset, missing players' columns are
+            # zero-filled and masked out of the losses — a pool shrink or
+            # grow changes only the mask, never the shape, so the jitted
+            # update is traced once and never recompiles on churn
+            local_data, env_mask = assemble_shards_padded(data_shards, env_shards, axis=1)
+            final_obs, _ = assemble_shards_padded(obs_shards, env_shards, axis=0)
+            local_data["mask"] = np.ascontiguousarray(
+                np.broadcast_to(env_mask[None, :, None], local_data["rewards"].shape).astype(
+                    np.float32
+                )
+            )
 
             # env-axis sharding feeds each mesh device only its columns
             # (the shard_map update path consumes this layout); an
@@ -787,6 +951,8 @@ def main(runtime, cfg: Dict[str, Any]):
             opt_np = _np_tree(opt_state) if need_ckpt else None
             stats = fanin.stats(knobs["backend"])
             stats["events"] = fanin.events[-8:]
+            if supervisor is not None:
+                stats["supervisor"] = supervisor.stats()
             fanin.broadcast(
                 "params",
                 arrays=_flat_leaves(_np_tree(params)),
@@ -798,18 +964,23 @@ def main(runtime, cfg: Dict[str, Any]):
                     stats if pid == 0 else None,
                 ),
             )
+            last_completed_seq = iter_num
             hard_exit_point("trainer_exit")  # fault site: trainer crash after replying
 
         trainer_mon.uninstall()
+        if supervisor is not None:
+            supervisor.close()
         # the lead still runs its test episode + logger shutdown after the
         # stop sentinel — give it ample time before the terminate fallback
-        for proc in procs:
+        for proc in procs.values():
             proc.join(timeout=3600.0)
     finally:
+        if supervisor is not None:
+            supervisor.close()
         preemption.uninstall()
         fanin.close()
         hub.close()
-        for proc in procs:
+        for proc in procs.values():
             if proc.is_alive():
                 proc.terminate()
                 proc.join()

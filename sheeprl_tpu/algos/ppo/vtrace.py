@@ -1,0 +1,119 @@
+"""V-trace off-policy correction (IMPALA, Espeholt et al., 2018) for the
+decoupled PPO learner.
+
+The N-player fan-in (PR 4) keeps every player in lockstep with the
+trainer's broadcast clock: rollout ``k`` must act on EXACTLY the params
+of update ``k - 1 - lag``, because the GAE targets assume the data is
+(nearly) on-policy.  That contract is what makes the pool rigid — a
+rejoining player whose weights are several updates old would poison the
+value targets.  V-trace removes the assumption: each timestep's TD error
+is reweighted by the CLIPPED importance ratio between the target policy
+(the learner's current weights) and the behavior policy (whatever the
+player acted with, recorded in the rollout's ``logprobs``), so per-shard
+policy lag becomes a *soft* bound — stale shards contribute less, they
+no longer corrupt.
+
+Estimator (the λ-generalized form, as in rlax/seed_rl's ``lambda_``):
+
+.. code::
+
+    rho_t = min(rho_clip, exp(log_rho_t))        # delta weight
+    c_t   = lam * min(c_clip, exp(log_rho_t))    # trace-cutting weight
+    delta_t = rho_t * (r_t + gamma * nd_t * V_{t+1} - V_t)
+    err_t   = delta_t + gamma * nd_t * c_t * err_{t+1}     (reverse scan)
+    vs_t    = V_t + err_t
+
+Returned ``advantages`` are the λ-discounted residuals ``err_t`` — the
+clipped-IS-weighted GAE.  This choice makes V-trace a STRICT
+generalization of the existing estimator: with on-policy data
+(``log_rhos == 0``) every weight collapses to ``rho_t = 1``,
+``c_t = lam`` and the recursion is *exactly*
+:func:`sheeprl_tpu.utils.utils.gae` (golden-output tested).  IMPALA's
+one-step policy-gradient advantage ``rho_t * (r_t + gamma*vs_{t+1} -
+V_t)`` is available as ``pg_advantage`` for callers that want the paper
+form; the two coincide when ``lam == 1``.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["vtrace", "vtrace_pg_advantage"]
+
+
+def _clipped_weights(log_rhos: jax.Array, rho_clip: float, c_clip: float, lam: float):
+    rhos = jnp.exp(log_rhos.astype(jnp.float32))
+    clipped_rhos = jnp.minimum(jnp.float32(rho_clip), rhos)
+    cs = lam * jnp.minimum(jnp.float32(c_clip), rhos)
+    return clipped_rhos, cs
+
+
+def vtrace(
+    rewards: jax.Array,
+    values: jax.Array,
+    dones: jax.Array,
+    next_value: jax.Array,
+    log_rhos: jax.Array,
+    gamma: float,
+    lam: float,
+    rho_clip: float = 1.0,
+    c_clip: float = 1.0,
+) -> Tuple[jax.Array, jax.Array]:
+    """V-trace targets + advantages over time-major inputs.
+
+    ``rewards``/``values``/``dones``/``log_rhos``: (T, B, 1);
+    ``next_value``: (B, 1).  ``log_rhos`` is ``log pi_target(a|s) -
+    log mu_behavior(a|s)`` of the rollout actions (zeros = on-policy).
+    Returns ``(vs, advantages)``, both (T, B, 1) float32 — drop-in for
+    the ``(returns, advantages)`` of :func:`~sheeprl_tpu.utils.utils.gae`,
+    to which this reduces exactly when ``log_rhos == 0``.
+    """
+    # f32 accumulation for the same reason gae() forces it: bf16 critics
+    # emit bf16 values and a low-precision scan carry drifts
+    values = values.astype(jnp.float32)
+    next_value = next_value.astype(jnp.float32)
+    rewards = rewards.astype(jnp.float32)
+    not_done = 1.0 - dones.astype(jnp.float32)
+    next_values = jnp.concatenate([values[1:], next_value[None]], axis=0)
+    clipped_rhos, cs = _clipped_weights(log_rhos, rho_clip, c_clip, lam)
+
+    def step(err, inp):
+        rew, nd, val, next_val, rho, c = inp
+        delta = rho * (rew + gamma * next_val * nd - val)
+        err = delta + gamma * nd * c * err
+        return err, err
+
+    _, errs = jax.lax.scan(
+        step,
+        jnp.zeros_like(next_value, dtype=jnp.float32),
+        (rewards, not_done, values, next_values, clipped_rhos, cs),
+        reverse=True,
+    )
+    vs = errs + values
+    return vs, errs
+
+
+def vtrace_pg_advantage(
+    rewards: jax.Array,
+    values: jax.Array,
+    dones: jax.Array,
+    next_value: jax.Array,
+    vs: jax.Array,
+    log_rhos: jax.Array,
+    gamma: float,
+    rho_clip: float = 1.0,
+) -> jax.Array:
+    """IMPALA's one-step policy-gradient advantage
+    ``rho_t * (r_t + gamma * vs_{t+1} - V_t)`` (eq. after (1) in the
+    paper), for callers that want the paper form instead of the
+    λ-residual :func:`vtrace` returns.  ``vs`` is the first output of
+    :func:`vtrace`."""
+    values = values.astype(jnp.float32)
+    rewards = rewards.astype(jnp.float32)
+    not_done = 1.0 - dones.astype(jnp.float32)
+    vs_next = jnp.concatenate([vs[1:], next_value[None].astype(jnp.float32)], axis=0)
+    rhos = jnp.minimum(jnp.float32(rho_clip), jnp.exp(log_rhos.astype(jnp.float32)))
+    return rhos * (rewards + gamma * not_done * vs_next - values)

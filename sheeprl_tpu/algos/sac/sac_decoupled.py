@@ -48,7 +48,14 @@ from sheeprl_tpu.algos.sac.utils import prepare_obs, test
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.obs import setup_observability, trace_scope
-from sheeprl_tpu.parallel.transport import FanIn, ParamsFollower, assemble_shards, split_envs
+from sheeprl_tpu.parallel.transport import (
+    FanIn,
+    HeartbeatSender,
+    JOIN_TAG,
+    ParamsFollower,
+    assemble_shards,
+    split_envs,
+)
 from sheeprl_tpu.replay import (
     ReplayServer,
     ReplayWriter,
@@ -74,7 +81,14 @@ from sheeprl_tpu.optim import restore_opt_states
 
 
 def _player_loop(
-    cfg, spec, state_counters, ratio_state, world_size: int, env_offset: int, n_local_envs: int
+    cfg,
+    spec,
+    state_counters,
+    ratio_state,
+    world_size: int,
+    env_offset: int,
+    n_local_envs: int,
+    join: bool = False,
 ) -> None:
     """Player process body (reference sac_decoupled.py:33-353)."""
     if remote_replay_setting(cfg):
@@ -82,7 +96,12 @@ def _player_loop(
         # transitions into the trainer-resident replay service instead of
         # sampling its own buffer shard (replay/service.py)
         return _player_loop_remote(
-            cfg, spec, state_counters, world_size, env_offset, n_local_envs
+            cfg, spec, state_counters, world_size, env_offset, n_local_envs, join=join
+        )
+    if join:
+        raise RuntimeError(
+            "supervised rejoin for sac_decoupled requires buffer.remote_replay=true "
+            "(a classic player owns a buffer shard that dies with it)"
         )
     import gymnasium as gym
     from gymnasium.vector import AsyncVectorEnv, AutoresetMode, SyncVectorEnv
@@ -476,7 +495,7 @@ def _player_loop(
 
 
 def _player_loop_remote(
-    cfg, spec, state_counters, world_size: int, env_offset: int, n_local_envs: int
+    cfg, spec, state_counters, world_size: int, env_offset: int, n_local_envs: int, join: bool = False
 ) -> None:
     """Remote-replay player body: env stepping + ``ReplayWriter`` inserts.
 
@@ -485,7 +504,12 @@ def _player_loop_remote(
     opportunistic (newest broadcast wins): with the trainer free-running
     on its own clock there is no per-round lock-step to pin a fixed lag
     to, and the insert-credit window already bounds how far a player can
-    run ahead of the last update it saw."""
+    run ahead of the last update it saw.
+
+    ``join=True`` (supervised restart): the player is STATELESS here, so
+    rejoin is nearly free — announce with a join frame, sync the step
+    clock off the trainer's assign reply (the server's insert clock), and
+    resume inserting on a fresh credit window."""
     import gymnasium as gym
     from gymnasium.vector import AsyncVectorEnv, AutoresetMode, SyncVectorEnv
 
@@ -533,7 +557,13 @@ def _player_loop_remote(
     mlp_keys = list(cfg.algo.mlp_keys.encoder)
 
     channel = spec.player_channel(peer_alive=parent_alive, who="trainer")
-    channel.send("init", extra=(observation_space, action_space))
+    timeout_s = knobs["liveness_timeout"]
+    heartbeat = (
+        HeartbeatSender(channel, interval=max(2 * knobs["liveness_interval"], 1.0))
+        if knobs["supervisor"]["enabled"]
+        else None
+    )
+    channel.send(JOIN_TAG if join else "init", extra=(observation_space, action_space))
 
     actor, _critic, params, _ = build_agent(runtime, cfg, observation_space, action_space)
     actor_treedef = jax.tree_util.tree_structure(params["actor"])
@@ -643,9 +673,27 @@ def _player_loop_remote(
     if lead:
         save_configs(cfg, log_dir)
 
-    # initial actor weights (trainer broadcasts seq=0 after the init round)
+    total_envs = int(cfg.env.num_envs)
+    if join:
+        # the assign reply carries the server's insert clock, so a
+        # rejoined player resumes at the pool's current step budget
+        # instead of replaying the whole schedule from iteration 1
+        try:
+            frame = _wait_tag("assign", timeout_s)
+        except PeerDiedError as e:
+            raise RuntimeError(
+                f"remote replay server died before answering player {player_id}'s join"
+            ) from e
+        server_inserts = int(frame.extra[0])
+        frame.release()
+        start_iter = max(start_iter, server_inserts // total_envs + 1)
+        policy_step = (start_iter - 1) * total_envs
+        last_log = policy_step
+
+    # initial actor weights (trainer broadcasts seq=0 after the init round;
+    # a joiner gets a directed copy with the assign reply)
     try:
-        deadline = time.monotonic() + _QUEUE_TIMEOUT_S
+        deadline = time.monotonic() + timeout_s
         while player is None:
             writer.pump(0.2)
             _handle_frames()
@@ -657,7 +705,6 @@ def _player_loop_remote(
             f"player {player_id}"
         ) from e
 
-    total_envs = int(cfg.env.num_envs)
     policy_steps_per_iter = int(total_envs)
     total_iters = int(cfg.algo.total_steps // policy_steps_per_iter) if not cfg.dry_run else 1
     learning_starts = cfg.algo.learning_starts // policy_steps_per_iter if not cfg.dry_run else 0
@@ -711,7 +758,7 @@ def _player_loop_remote(
         # ------------------------------------------ insert (credit-gated)
         try:
             with trace_scope("replay_insert"):
-                writer.append(dict(step_data), timeout=_QUEUE_TIMEOUT_S)
+                writer.append(dict(step_data), timeout=timeout_s)
             writer.pump(0.01)
         except PeerDiedError as e:
             _die_with_dump(e, policy_step, iter_num)
@@ -721,8 +768,8 @@ def _player_loop_remote(
         # ------------------------------------------ checkpoint (lead)
         if lead and ckpt_mgr.should_checkpoint(policy_step, is_last=iter_num == total_iters):
             try:
-                channel.send("ckpt_req", timeout=_QUEUE_TIMEOUT_S)
-                frame = _wait_tag("ckpt_state", _QUEUE_TIMEOUT_S)
+                channel.send("ckpt_req", timeout=timeout_s)
+                frame = _wait_tag("ckpt_state", timeout_s)
             except PeerDiedError as e:
                 _die_with_dump(e, policy_step, iter_num)
             full_state = frame.extra[0]
@@ -801,6 +848,8 @@ def _player_loop_remote(
         channel.send("stop")
     except Exception:
         pass  # a dead trainer cannot receive it; exit anyway
+    if heartbeat is not None:
+        heartbeat.close()
     if ckpt_mgr is not None:
         ckpt_mgr.close()
     if preemption is not None:
@@ -846,6 +895,13 @@ def main(runtime, cfg: Dict[str, Any]):
         # Reverb-style topology: the replay buffer lives HERE, players
         # stream raw transitions into it (replay/service.py)
         return _main_remote(runtime, cfg, knobs, state, counters, ratio_state)
+
+    if knobs["supervisor"]["enabled"]:
+        warnings.warn(
+            "algo.supervisor.enabled has no effect on classic sac_decoupled: a player's "
+            "buffer shard dies with it, so there is nothing lossless to restart into. "
+            "Set buffer.remote_replay=true for a self-healing SAC pool."
+        )
 
     ctx = mp.get_context("spawn")
     hub, channels, procs, env_shards = spawn_players(
@@ -963,6 +1019,16 @@ def main(runtime, cfg: Dict[str, Any]):
                 }
                 frame.release()
             data = assemble_shards(shards, axis=1)
+            # FIXED batch width: a dead player's missing sample columns are
+            # refilled by cycling the survivors' rows — replay draws are
+            # i.i.d., so the tile only re-weights samples slightly, and the
+            # train scan keeps its one XLA trace through a pool shrink
+            # (the pre-elastic path recompiled for every smaller batch)
+            total_batch = int(cfg.algo.per_rank_batch_size) * runtime.world_size
+            have = next(iter(data.values())).shape[1]
+            if have < total_batch:
+                idx = np.resize(np.arange(have), total_batch)
+                data = {k: v[:, idx] for k, v in data.items()}
             # shard the batch axis over the mesh so each device trains on
             # its own rows (GSPMD inserts the grad psums)
             data = runtime.shard_batch(data, axis=1)
@@ -1024,12 +1090,14 @@ def _main_remote(runtime, cfg: Dict[str, Any], knobs, state, counters, ratio_sta
     start_iter = counters[0]
 
     ctx = mp.get_context("spawn")
-    hub, channels, procs, env_shards = spawn_players(
+    hub, channels, proc_list, env_shards = spawn_players(
         cfg, runtime, ctx, _player_loop, extra_args=(counters, ratio_state, runtime.world_size), knobs=knobs
     )
+    procs: Dict[int, Any] = dict(enumerate(proc_list))
 
-    preemption = PreemptionHandler(forward_to=list(procs)).install()
+    preemption = PreemptionHandler(forward_to=list(procs.values())).install()
     params = opt_states = None
+    supervisor = None
 
     def _dump_and_raise(e: Exception, what: str):
         path = None
@@ -1115,6 +1183,32 @@ def _main_remote(runtime, cfg: Dict[str, Any], knobs, state, counters, ratio_sta
         )
         if state is not None and state.get("replay_server") is not None:
             server.load_state_dict(state["replay_server"], rb_state=state.get("rb"))
+
+        # elastic pool: remote-replay players are stateless writers, so a
+        # supervised restart is lossless — the buffer, limiter and clock
+        # all live here with the server
+        supervisor = None
+        if knobs["supervisor"]["enabled"]:
+            from sheeprl_tpu.resilience import PlayerSupervisor
+
+            def _respawn_args(pid, spec):
+                offset, count = env_shards[pid]
+                return (cfg, spec, counters, ratio_state, runtime.world_size, offset, count, True)
+
+            supervisor = PlayerSupervisor(
+                ctx,
+                hub,
+                server,
+                _player_loop,
+                _respawn_args,
+                procs,
+                restart_budget=knobs["supervisor"]["restart_budget"],
+                backoff_base=knobs["supervisor"]["backoff_base"],
+                backoff_max=knobs["supervisor"]["backoff_max"],
+                heartbeat_timeout=knobs["supervisor"]["heartbeat_timeout"],
+                preemption=preemption,
+                join_timeout=knobs["liveness_timeout"],
+            )
         beta_fn = per_beta_schedule(
             cfg.buffer.get("per_beta", 0.4),
             cfg.buffer.get("per_beta_end", 1.0),
@@ -1141,9 +1235,11 @@ def _main_remote(runtime, cfg: Dict[str, Any], knobs, state, counters, ratio_sta
 
         def _broadcast_params(seq: int, extras) -> None:
             arrays = _flat_leaves(_np_tree(params["actor"]))
-            for pid in server.live:
+            # server.channels, not the spawn-time dict: a supervised
+            # restart on the queue backend swaps in a fresh channel
+            for pid in server.broadcast_targets:
                 try:
-                    channels[pid].send(
+                    server.channels[pid].send(
                         "params",
                         arrays=arrays,
                         extra=extras(pid),
@@ -1156,6 +1252,24 @@ def _main_remote(runtime, cfg: Dict[str, Any], knobs, state, counters, ratio_sta
         def _on_control(pid: int, frame) -> None:
             tag = frame.tag
             frame.release()
+            if tag == JOIN_TAG:
+                # supervised restart dialed back in: sync its step clock to
+                # the server's insert clock and hand it the current actor
+                # (it missed every broadcast while dead); its credit window
+                # was already reset by begin_join
+                try:
+                    server.channels[pid].send(
+                        "assign", extra=(server.total_inserts,), timeout=_QUEUE_TIMEOUT_S
+                    )
+                    server.channels[pid].send(
+                        "params",
+                        arrays=_flat_leaves(_np_tree(params["actor"])),
+                        seq=update_round,
+                        timeout=_QUEUE_TIMEOUT_S,
+                    )
+                except Exception as e:  # noqa: BLE001
+                    server._mark_dead(pid, f"join reply failed: {e}")
+                return
             if tag != "ckpt_req":
                 return
             try:
@@ -1170,7 +1284,7 @@ def _main_remote(runtime, cfg: Dict[str, Any], knobs, state, counters, ratio_sta
                     # (checkpoint cadence only; disable buffer.checkpoint
                     # for buffers too big to ship over the transport)
                     reply["rb"] = server.rb
-                channels[pid].send("ckpt_state", extra=(reply,), timeout=_QUEUE_TIMEOUT_S)
+                server.channels[pid].send("ckpt_state", extra=(reply,), timeout=_QUEUE_TIMEOUT_S)
             except (PeerDiedError, OSError) as e:
                 server._mark_dead(pid, f"ckpt_state reply failed: {e}")
 
@@ -1178,9 +1292,14 @@ def _main_remote(runtime, cfg: Dict[str, Any], knobs, state, counters, ratio_sta
         _broadcast_params(0, lambda pid: ())
 
         while not server.all_stopped:
+            if supervisor is not None:
+                supervisor.poll()
             try:
                 server.pump(0.05, on_control=_on_control)
             except PeerDiedError as e:
+                if supervisor is not None and supervisor.recoverable():
+                    time.sleep(0.2)
+                    continue
                 _dump_and_raise(e, "replay insert")
             # fault site: the whole replay service dies with the trainer
             hard_exit_point("replay_server_exit")
@@ -1231,6 +1350,8 @@ def _main_remote(runtime, cfg: Dict[str, Any], knobs, state, counters, ratio_sta
             stats = server.stats()
             stats["beta"] = round(beta_fn(clock), 4)
             stats["events"] = server.events[-8:]
+            if supervisor is not None:
+                stats["supervisor"] = supervisor.stats()
             _broadcast_params(
                 update_round,
                 lambda pid: (last_metrics, stats if pid == 0 else None),
@@ -1238,14 +1359,18 @@ def _main_remote(runtime, cfg: Dict[str, Any], knobs, state, counters, ratio_sta
             server.grant_credits()  # sampling freed SPI budget: resume inserts
 
         trainer_mon.uninstall()
+        if supervisor is not None:
+            supervisor.close()
         # the lead still runs its test episode + logger shutdown after the
         # stop sentinel — give it ample time before the terminate fallback
-        for proc in procs:
+        for proc in procs.values():
             proc.join(timeout=3600.0)
     finally:
+        if supervisor is not None:
+            supervisor.close()
         preemption.uninstall()
         hub.close()
-        for proc in procs:
+        for proc in procs.values():
             if proc.is_alive():
                 proc.terminate()
                 proc.join()

@@ -71,6 +71,9 @@ __all__ = [
     "ChannelSpec",
     "FanIn",
     "Frame",
+    "HB_TAG",
+    "HeartbeatSender",
+    "JOIN_TAG",
     "ParamsFollower",
     "QueueChannel",
     "RB_CREDIT_TAG",
@@ -80,10 +83,18 @@ __all__ = [
     "TcpListener",
     "TransportHub",
     "assemble_shards",
+    "assemble_shards_padded",
     "make_transport",
     "split_envs",
     "transport_setting",
 ]
+
+# elastic-pool control tags: a (re)joining player announces itself with a
+# JOIN_TAG frame and waits for the trainer's "assign" reply (env shard +
+# round clock); HB_TAG frames are array-less liveness heartbeats a player
+# thread emits so the supervisor can see silence, not just process death
+JOIN_TAG = "join"
+HB_TAG = "hb"
 
 _BACKENDS = ("queue", "shm", "tcp")
 
@@ -132,6 +143,41 @@ def assemble_shards(
     return {k: np.concatenate([arrays_by_pid[p][k] for p in pids], axis=axis) for k in first}
 
 
+def assemble_shards_padded(
+    arrays_by_pid: Dict[int, Dict[str, np.ndarray]],
+    env_shards: Sequence[Tuple[int, int]],
+    axis: int = 1,
+) -> Tuple[Dict[str, np.ndarray], np.ndarray]:
+    """Fixed-width fan-in assembly for the elastic pool: every key gets the
+    FULL env-axis width (the sum of ALL shard counts, present or not) with
+    each player's columns written at its deterministic ``env_shards``
+    offset and missing players' columns zero-filled.  Returns
+    ``(arrays, env_mask)`` where ``env_mask`` is a float32 ``(total,)``
+    validity vector (1 = a live player contributed that column).
+
+    The point is the SHAPE: a pool shrink or grow changes only the mask,
+    never the batch layout, so the jitted update is traced once and never
+    recompiles on churn (the pre-elastic concat-of-survivors assembly paid
+    one full XLA retrace per pool-size change)."""
+    if not arrays_by_pid:
+        raise ValueError("assemble_shards_padded needs at least one shard")
+    total = sum(count for _, count in env_shards)
+    first = arrays_by_pid[min(arrays_by_pid)]
+    out: Dict[str, np.ndarray] = {}
+    for k, v in first.items():
+        shape = list(v.shape)
+        shape[axis] = total
+        out[k] = np.zeros(shape, dtype=v.dtype)
+    env_mask = np.zeros((total,), np.float32)
+    for pid in sorted(arrays_by_pid):
+        offset, count = env_shards[pid]
+        idx = (slice(None),) * axis + (slice(offset, offset + count),)
+        for k, v in arrays_by_pid[pid].items():
+            out[k][idx] = v
+        env_mask[offset : offset + count] = 1.0
+    return out, env_mask
+
+
 # --------------------------------------------------------------------- frames
 class Frame:
     """One received transport message.
@@ -171,9 +217,17 @@ class Channel:
     the trainer attaches them after the spawn via :meth:`set_peer`.
     """
 
-    def __init__(self, peer_alive: Optional[Callable[[], bool]] = None, who: str = "peer"):
+    def __init__(
+        self,
+        peer_alive: Optional[Callable[[], bool]] = None,
+        who: str = "peer",
+        poll_s: float = 0.5,
+    ):
         self.peer_alive = peer_alive or (lambda: True)
         self.who = who
+        # liveness poll cadence while blocked on the peer (the PR-2
+        # hard-coded _PEER_POLL_S, now configurable: algo.liveness_interval)
+        self.poll_s = float(poll_s)
         self.detail_fn: Optional[Callable[[], str]] = None
         self.bytes_sent = 0
         self.bytes_recv = 0
@@ -195,6 +249,11 @@ class Channel:
     def depth(self) -> Optional[int]:
         """Receive-side fan-in queue depth (None when unknowable)."""
         return None
+
+    def reset_for_rejoin(self) -> None:
+        """Clear dead-peer state ahead of a supervised player restart (the
+        fresh process is about to take this endpoint over).  Base channels
+        keep no such state."""
 
     def close(self) -> None:
         pass
@@ -223,6 +282,23 @@ def _put_with_peer(q, item, timeout: float, peer_alive, who: str) -> None:
                 raise PeerDiedError(who) from None
 
 
+def _cancel_queue_join(q) -> None:
+    """Detach an ``mp.Queue``'s feeder thread from interpreter exit.
+
+    A peer that died mid-stream leaves buffered frames in the pipe that
+    nobody will ever read; without this, ``multiprocessing``'s atexit
+    finalizer joins the feeder thread — blocked forever in ``_send`` on
+    the full pipe — and the WHOLE process hangs at shutdown (observed on
+    the elastic-pool respawn path, which abandons the dead player's
+    queue pair wholesale).  No-op for plain ``queue.Queue`` test doubles."""
+    cancel = getattr(q, "cancel_join_thread", None)
+    if cancel is not None:
+        try:
+            cancel()
+        except (OSError, ValueError):
+            pass
+
+
 class QueueChannel(Channel):
     """Legacy pickled-queue backend over a BOUNDED ``mp.Queue`` pair."""
 
@@ -248,6 +324,7 @@ class QueueChannel(Channel):
             peer_alive=self.peer_alive,
             who=self.who,
             detail_fn=self.detail_fn,
+            poll_s=self.poll_s,
         )
 
     def recv(self, timeout: float) -> Frame:
@@ -267,6 +344,12 @@ class QueueChannel(Channel):
             return self._recv_q.qsize()
         except (NotImplementedError, OSError):
             return None
+
+    def close(self) -> None:
+        # by close time the protocol is done (or the peer is dead):
+        # undelivered frames must not wedge interpreter exit
+        _cancel_queue_join(self._send_q)
+        _cancel_queue_join(self._recv_q)
 
 
 class ShmChannel(QueueChannel):
@@ -314,6 +397,7 @@ class ShmChannel(QueueChannel):
         return Frame(tag, seq, extra, views, release_cb=lambda: self._rx.release(slot))
 
     def close(self) -> None:
+        super().close()
         self._tx.close()
         self._rx.close()
 
@@ -512,7 +596,16 @@ class TcpChannel(Channel):
         """Trainer side: swap in a reconnected player's fresh socket (the
         listener calls this from its accept thread), reset the credit
         window and re-send the last tracked broadcast frame (the one that
-        may have died with the old connection — the peer dedupes)."""
+        may have died with the old connection — the peer dedupes).
+
+        Also the REVIVAL path for a player that died outright and was
+        restarted by the supervisor minutes later: by then the reader
+        thread has marked the channel dead and exited, so the dead state
+        is cleared (stale ``__dead__`` markers drained from the inbox)
+        and a fresh reader started."""
+        if self._stop.is_set():
+            _shutdown_close(sock)
+            return
         self._configure(sock)
         with self._cond:
             old, self._sock = self._sock, sock
@@ -521,6 +614,20 @@ class TcpChannel(Channel):
             self._dead = None
             self._cond.notify_all()
         _shutdown_close(old)
+        # drain dead-markers queued while the connection was down, keeping
+        # any real frames (there should be none, but order is preserved)
+        survivors = []
+        while True:
+            try:
+                f = self._inbox.get_nowait()
+            except queue_mod.Empty:
+                break
+            if f.tag != "__dead__":
+                survivors.append(f)
+        for f in survivors:
+            self._inbox.put(f)
+        if self._reader is None or not self._reader.is_alive():
+            self._start_reader()
         if self._last_broadcast is not None:
             tag, seq, extra, arrays = self._last_broadcast
             try:
@@ -583,7 +690,15 @@ class TcpChannel(Channel):
                 if sock is not self._sock:
                     continue  # a newer socket was adopted while we were blocked
                 if not self._handle_disconnect(e):
-                    return
+                    # channel is dead: PARK instead of exiting — a
+                    # supervisor revival adopts a fresh socket (bumping
+                    # _gen, clearing _dead) and this same thread resumes;
+                    # close() sets _stop and notifies
+                    gen = self._gen
+                    with self._cond:
+                        self._cond.wait_for(lambda: self._stop.is_set() or self._gen != gen)
+                    if self._stop.is_set():
+                        return
                 continue
             if tag == _CREDIT_TAG:
                 with self._cond:
@@ -672,6 +787,26 @@ class TcpChannel(Channel):
         by accident would strand the reader in a recv that nothing wakes."""
         _shutdown_close(self._sock)
 
+    def reset_for_rejoin(self) -> None:
+        """Supervisor revival: forget the old connection's death (the
+        restarted player has not dialed yet — until it does, ``recv`` must
+        report Empty against the new process's liveness predicate instead
+        of replaying the stale ``__dead__`` marker)."""
+        with self._cond:
+            self._dead = None
+            self._credits = self._window  # the fresh peer's window is full
+            self._cond.notify_all()
+        survivors = []
+        while True:
+            try:
+                f = self._inbox.get_nowait()
+            except queue_mod.Empty:
+                break
+            if f.tag != "__dead__":
+                survivors.append(f)
+        for f in survivors:
+            self._inbox.put(f)
+
     def recv(self, timeout: float) -> Frame:
         deadline = time.monotonic() + timeout
         while True:
@@ -679,7 +814,7 @@ class TcpChannel(Channel):
             if remaining <= 0:
                 raise queue_mod.Empty
             try:
-                frame = self._inbox.get(timeout=min(0.5, remaining))
+                frame = self._inbox.get(timeout=min(self.poll_s, remaining))
             except queue_mod.Empty:
                 if not self.peer_alive():
                     detail = self.detail_fn() if self.detail_fn else ""
@@ -797,6 +932,7 @@ class ChannelSpec:
         window: int = 2,
         min_bytes: int = 65536,
         compress_min: int = 0,
+        poll_s: float = 0.5,
     ):
         self.backend = backend
         self.player_id = int(player_id)
@@ -808,6 +944,7 @@ class ChannelSpec:
         self.window = window
         self.min_bytes = min_bytes
         self.compress_min = compress_min
+        self.poll_s = poll_s
 
     def player_channel(self, peer_alive=None, who: str = "trainer") -> Channel:
         """Build the player-side endpoint (call INSIDE the child)."""
@@ -820,6 +957,7 @@ class ChannelSpec:
                 reconnect=True,
                 peer_alive=peer_alive,
                 who=who,
+                poll_s=self.poll_s,
             )
         if self.backend == "shm":
             return ShmChannel(
@@ -831,23 +969,101 @@ class ChannelSpec:
                 min_bytes=self.min_bytes,
                 peer_alive=peer_alive,
                 who=who,
+                poll_s=self.poll_s,
             )
-        return QueueChannel(self.to_trainer_q, self.to_player_q, peer_alive=peer_alive, who=who)
+        return QueueChannel(
+            self.to_trainer_q, self.to_player_q, peer_alive=peer_alive, who=who, poll_s=self.poll_s
+        )
 
 
 class TransportHub:
     """Trainer-side owner of all per-player channels."""
 
-    def __init__(self, backend: str, listener: Optional[TcpListener], channels: Dict[int, Channel]):
+    def __init__(
+        self,
+        backend: str,
+        listener: Optional[TcpListener],
+        channels: Dict[int, Channel],
+        *,
+        ctx=None,
+        window: int = 2,
+        min_bytes: int = 65536,
+        compress_min: int = 0,
+        poll_s: float = 0.5,
+    ):
         self.backend = backend
         self._listener = listener
         self._channels = channels
+        self._ctx = ctx
+        self._window = window
+        self._min_bytes = min_bytes
+        self._compress_min = compress_min
+        self._poll_s = poll_s
 
     def channel(self, player_id: int, timeout: float = 120.0, peer_alive=None) -> Channel:
         if self._listener is not None and player_id not in self._channels:
             ch = self._listener.channel(player_id, timeout=timeout, peer_alive=peer_alive)
             self._channels[player_id] = ch
         return self._channels[player_id]
+
+    def respawn_spec(self, player_id: int) -> ChannelSpec:
+        """A fresh :class:`ChannelSpec` for restarting player
+        ``player_id`` after its process died (the supervisor's half of the
+        rejoin path).
+
+        - ``tcp``: the spec just names the listener address — the restarted
+          player dials in and the listener adopts the fresh socket into the
+          EXISTING trainer channel (reviving it if it was marked dead);
+        - ``queue``/``shm``: the dead process may have left half-consumed
+          frames (or, for shm, leaked ring slots it held) in the old
+          endpoints, so those are torn down and a brand-new queue/ring pair
+          is built; callers must re-fetch :meth:`channel` afterwards."""
+        if self.backend == "tcp":
+            return ChannelSpec(
+                "tcp",
+                player_id,
+                address=self._listener.address,
+                window=self._window,
+                compress_min=self._compress_min,
+                poll_s=self._poll_s,
+            )
+        old = self._channels.pop(player_id, None)
+        if old is not None:
+            try:
+                old.close()
+            except Exception:
+                pass
+        to_t = self._ctx.Queue(maxsize=self._window + 2)
+        to_p = self._ctx.Queue(maxsize=self._window + 2)
+        data_free = self._ctx.Queue() if self.backend == "shm" else None
+        resp_free = self._ctx.Queue() if self.backend == "shm" else None
+        spec = ChannelSpec(
+            self.backend,
+            player_id,
+            to_trainer_q=to_t,
+            to_player_q=to_p,
+            data_free_q=data_free,
+            resp_free_q=resp_free,
+            window=self._window,
+            min_bytes=self._min_bytes,
+            poll_s=self._poll_s,
+        )
+        if self.backend == "shm":
+            self._channels[player_id] = ShmChannel(
+                to_p,
+                to_t,
+                resp_free,
+                data_free,
+                window=self._window,
+                min_bytes=self._min_bytes,
+                who=f"player[{player_id}]",
+                poll_s=self._poll_s,
+            )
+        else:
+            self._channels[player_id] = QueueChannel(
+                to_p, to_t, who=f"player[{player_id}]", poll_s=self._poll_s
+            )
+        return spec
 
     def close(self) -> None:
         for ch in self._channels.values():
@@ -866,6 +1082,7 @@ def make_transport(
     compress_min: int = 0,
     host: str = "127.0.0.1",
     port: int = 0,
+    poll_s: float = 0.5,
 ) -> Tuple[TransportHub, List[ChannelSpec]]:
     """Create the trainer hub + per-player specs for ``backend``.
 
@@ -882,7 +1099,12 @@ def make_transport(
         for pid in range(num_players):
             specs.append(
                 ChannelSpec(
-                    "tcp", pid, address=listener.address, window=window, compress_min=compress_min
+                    "tcp",
+                    pid,
+                    address=listener.address,
+                    window=window,
+                    compress_min=compress_min,
+                    poll_s=poll_s,
                 )
             )
     else:
@@ -901,6 +1123,7 @@ def make_transport(
                     resp_free_q=resp_free,
                     window=window,
                     min_bytes=min_bytes,
+                    poll_s=poll_s,
                 )
             )
             if backend == "shm":
@@ -914,37 +1137,70 @@ def make_transport(
                     window=window,
                     min_bytes=min_bytes,
                     who=f"player[{pid}]",
+                    poll_s=poll_s,
                 )
             else:
-                channels[pid] = QueueChannel(to_p, to_t, who=f"player[{pid}]")
-    return TransportHub(backend, listener, channels), specs
+                channels[pid] = QueueChannel(to_p, to_t, who=f"player[{pid}]", poll_s=poll_s)
+    hub = TransportHub(
+        backend,
+        listener,
+        channels,
+        ctx=ctx,
+        window=window,
+        min_bytes=min_bytes,
+        compress_min=compress_min,
+        poll_s=poll_s,
+    )
+    return hub, specs
 
 
 # ------------------------------------------------------------------ fan-in
 class FanIn:
-    """Trainer-side N-player shard assembly with per-player liveness.
+    """Trainer-side N-player shard assembly with per-player liveness AND
+    runtime pool membership.
 
     ``gather`` returns one ``data`` frame per live player for the next
     round (FIFO per channel keeps per-player rounds ordered; cross-player
     arrival order does not matter — callers assemble in player-id order).
     A player death SHRINKS the fan-in: the pid moves to ``dead``, a shrink
     event is recorded for telemetry, and the round completes with the
-    survivors.  Only losing the LAST live player raises."""
+    survivors.  Only losing the LAST live player raises (and even that is
+    survivable while a rejoin is pending).
+
+    The pool GROWS through :meth:`begin_join`: a (re)started player is
+    polled opportunistically — its data frames are stashed, never awaited
+    — until one lands whose seq matches the round being assembled; that
+    round it GRADUATES to full membership (a ``player_rejoin`` event).
+    Joiners therefore can never stall the survivors, and a joiner that
+    came up mid-round simply lands one round later."""
 
     def __init__(self, channels: Dict[int, Channel], *, env_steps_per_frame: Optional[Dict[int, int]] = None):
         self.channels = dict(channels)
         self.stopped: set = set()
         self.dead: Dict[int, str] = {}
-        self.events: List[Dict[str, Any]] = []  # shrink log (rides telemetry)
+        self.joining: Dict[int, float] = {}  # pid -> join start (monotonic)
+        self.events: List[Dict[str, Any]] = []  # shrink/grow log (rides telemetry)
+        self.rejoins = 0
+        self.last_seen: Dict[int, float] = {}  # any-frame liveness (heartbeats)
+        self.lag_hist: Dict[int, int] = {}  # behavior-policy lag -> rounds seen
+        self._lag_by_pid: Dict[int, int] = {}
         self._steps_per_frame = env_steps_per_frame or {}
         self._last_data_seq: Dict[int, int] = {}
+        self._stash: Dict[int, Frame] = {}  # joiners' early data frames
+        self._seen_since_join: set = set()  # joiners that have sent anything yet
         self._t0 = time.monotonic()
         self._frames: Dict[int, int] = {pid: 0 for pid in self.channels}
 
     # ------------------------------------------------------------ liveness
     @property
     def live(self) -> List[int]:
-        return sorted(pid for pid in self.channels if pid not in self.dead and pid not in self.stopped)
+        """Full (round-mandatory) members: not dead, not stopped, not
+        still joining."""
+        return sorted(
+            pid
+            for pid in self.channels
+            if pid not in self.dead and pid not in self.stopped and pid not in self.joining
+        )
 
     def mark_dead(self, pid: int, reason: str) -> None:
         if pid in self.dead or pid in self.stopped:
@@ -959,6 +1215,10 @@ class FanIn:
                 detail = ch.detail_fn() or ""
             except Exception:
                 detail = ""
+        self.joining.pop(pid, None)
+        stale = self._stash.pop(pid, None)
+        if stale is not None:
+            stale.release()
         if "exitcode=0" in detail.replace(" ", ""):
             self.stopped.add(pid)
             return
@@ -967,12 +1227,69 @@ class FanIn:
             {"event": "player_dead", "player": pid, "reason": reason, "live": len(self.live)}
         )
 
+    def begin_join(self, pid: int, channel: Optional[Channel] = None, steps_per_frame: Optional[int] = None) -> None:
+        """Admit player ``pid`` to the pool as a JOINER (a restarted dead
+        player taking back its slot, or a brand-new pid growing the pool).
+        It becomes round-mandatory only once a data frame of its own lands
+        on the round being gathered."""
+        if channel is not None:
+            self.channels[pid] = channel
+        self.dead.pop(pid, None)
+        self.stopped.discard(pid)
+        self._seen_since_join.discard(pid)
+        now = time.monotonic()
+        self.joining[pid] = now
+        self.last_seen[pid] = now
+        self._frames.setdefault(pid, 0)
+        if steps_per_frame:
+            self._steps_per_frame[pid] = steps_per_frame
+        self.events.append({"event": "player_join", "player": pid, "live": len(self.live)})
+
+    def note_lag(self, pid: int, lag: int) -> None:
+        """Record one round's behavior-policy lag for ``pid`` (the V-trace
+        soft-bound telemetry: how stale the weights this shard acted with
+        were, in update rounds)."""
+        lag = max(0, int(lag))
+        self.lag_hist[lag] = self.lag_hist.get(lag, 0) + 1
+        self._lag_by_pid[pid] = lag
+
     def _require_live(self, who: str = "player") -> None:
-        if not self.live and not self.stopped:
+        if not self.live and not self.stopped and not self.joining:
             detail = "; ".join(f"player[{p}]: {r}" for p, r in self.dead.items())
             raise PeerDiedError(who, detail)
 
     # -------------------------------------------------------------- gather
+    def _poll_joining(self, data_tag: str, on_control) -> None:
+        """Opportunistic drain of joiners' channels: data frames are
+        stashed for graduation, control frames flow as usual; a joiner is
+        never awaited."""
+        for pid in list(self.joining):
+            ch = self.channels[pid]
+            try:
+                frame = ch.recv(timeout=0.01)
+            except queue_mod.Empty:
+                continue
+            except PeerDiedError as e:
+                self.mark_dead(pid, f"died while joining: {e}")
+                continue
+            self.last_seen[pid] = time.monotonic()
+            self._seen_since_join.add(pid)
+            if frame.tag == "stop":
+                self.joining.pop(pid, None)
+                self.stopped.add(pid)
+                frame.release()
+            elif frame.tag == data_tag:
+                old = self._stash.pop(pid, None)
+                if old is not None:
+                    old.release()
+                self._stash[pid] = frame
+            elif frame.tag == HB_TAG:
+                frame.release()
+            elif on_control is not None:
+                on_control(pid, frame)
+            else:
+                frame.release()
+
     def gather(
         self,
         *,
@@ -980,17 +1297,25 @@ class FanIn:
         data_tag: str = "data",
         on_control: Optional[Callable[[int, Frame], None]] = None,
     ) -> Tuple[Optional[int], "OrderedDict[int, Frame]"]:
-        """Collect the next ``data_tag`` frame from every live player.
+        """Collect the next ``data_tag`` frame from every live player (plus
+        any joiner whose stashed frame matches the round).
 
         Returns ``(seq, frames-by-pid sorted)``; ``(None, {})`` once every
-        player has stopped.  Control frames (anything except ``data_tag``
-        and ``stop``) are handed to ``on_control`` as they arrive."""
+        player has stopped.  Control frames (anything except ``data_tag``,
+        ``stop`` and heartbeats) are handed to ``on_control`` as they
+        arrive."""
         got: Dict[int, Frame] = {}
         deadline = time.monotonic() + timeout
         while True:
+            self._poll_joining(data_tag, on_control)
             pending = [pid for pid in self.live if pid not in got]
             if not pending:
-                break
+                if got or not self.joining:
+                    break
+                if self._stash:
+                    # every full member is gone but (re)joins are pending:
+                    # the round forms from the joiners' stashed frames
+                    break
             for pid in pending:
                 ch = self.channels[pid]
                 try:
@@ -1000,8 +1325,11 @@ class FanIn:
                 except PeerDiedError as e:
                     self.mark_dead(pid, str(e))
                     continue
+                self.last_seen[pid] = time.monotonic()
                 if frame.tag == "stop":
                     self.stopped.add(pid)
+                    frame.release()
+                elif frame.tag == HB_TAG:
                     frame.release()
                 elif frame.tag == data_tag:
                     if frame.seq >= 0 and frame.seq <= self._last_data_seq.get(pid, -1):
@@ -1020,12 +1348,35 @@ class FanIn:
                     f.release()
                 raise queue_mod.Empty
         self._require_live()
-        if not got:
+        if not got and not self._stash:
             return None, OrderedDict()
-        seqs = sorted({f.seq for f in got.values()})
-        if len(seqs) != 1:
-            raise RuntimeError(f"fan-in round desync: players delivered seqs {seqs}")
-        return seqs[0], OrderedDict(sorted(got.items()))
+        if got:
+            seqs = sorted({f.seq for f in got.values()})
+            if len(seqs) != 1:
+                raise RuntimeError(f"fan-in round desync: players delivered seqs {seqs}")
+            round_seq = seqs[0]
+        else:
+            round_seq = min(f.seq for f in self._stash.values())
+        # graduate joiners whose stashed frame matches this round; release
+        # stale stashes (the joiner resyncs its clock off the params
+        # broadcasts it keeps receiving and lands on a later round)
+        for pid in sorted(list(self._stash)):
+            frame = self._stash[pid]
+            if frame.seq == round_seq:
+                del self._stash[pid]
+                self.joining.pop(pid, None)
+                self._last_data_seq[pid] = frame.seq
+                if data_tag == "data":
+                    self._frames[pid] = self._frames.get(pid, 0) + 1
+                got[pid] = frame
+                self.rejoins += 1
+                self.events.append(
+                    {"event": "player_rejoin", "player": pid, "round": round_seq, "live": len(self.live)}
+                )
+            elif frame.seq < round_seq:
+                del self._stash[pid]
+                frame.release()
+        return round_seq, OrderedDict(sorted(got.items()))
 
     # ----------------------------------------------------------- broadcast
     def broadcast(
@@ -1036,10 +1387,13 @@ class FanIn:
         extra_fn: Optional[Callable[[int], Tuple]] = None,
         timeout: float = 600.0,
     ) -> None:
-        """Send the same payload to every live player (per-player extras
+        """Send the same payload to every live AND joining player (a
+        joiner needs the params flow to sync its clock before it
+        graduates — but only once it has dialed in and sent SOMETHING, or
+        a tcp send would stall the round on its boot; per-player extras
         via ``extra_fn`` — e.g. metrics/opt-state for the lead only).  A
         send failure marks that player dead and the broadcast continues."""
-        for pid in self.live:
+        for pid in self.live + sorted(p for p in self.joining if p in self._seen_since_join):
             extra = extra_fn(pid) if extra_fn is not None else ()
             try:
                 self.channels[pid].send(tag, arrays=arrays, extra=extra, seq=seq, timeout=timeout)
@@ -1057,6 +1411,7 @@ class FanIn:
     def stats(self, backend: str) -> Dict[str, Any]:
         """One snapshot for the telemetry sink's ``transport`` key."""
         elapsed = max(time.monotonic() - self._t0, 1e-6)
+        now = time.monotonic()
         per_player: Dict[str, Any] = {}
         bytes_total = 0
         for pid, ch in self.channels.items():
@@ -1073,13 +1428,20 @@ class FanIn:
             depth = ch.depth()
             if depth is not None:
                 entry["depth"] = depth
+            if pid in self.last_seen:
+                entry["last_seen_age_s"] = round(now - self.last_seen[pid], 2)
+            if pid in self._lag_by_pid:
+                entry["lag"] = self._lag_by_pid[pid]
             per_player[str(pid)] = entry
         return {
             "backend": backend,
             "players": per_player,
             "num_players": len(self.channels),
             "live": len(self.live),
+            "joining": len(self.joining),
             "deaths": len(self.dead),
+            "rejoins": self.rejoins,
+            "lag_hist": {str(k): v for k, v in sorted(self.lag_hist.items())},
             "bytes_per_s": round(bytes_total / elapsed, 1),
             "fan_in_depth": sum(
                 ch.depth() or 0 for pid, ch in self.channels.items() if pid not in self.dead
@@ -1179,6 +1541,56 @@ class ParamsFollower:
         self.staleness_log.append((round_k, max(0, (round_k - 1) - self.current_seq)))
         return frame
 
+    def adopt_newest(
+        self, round_k: int, max_lag: int, timeout: Optional[float] = None
+    ) -> Optional[Frame]:
+        """SOFT-bound adoption for the V-trace path: drain every params
+        frame that has already arrived and hand back the newest (None when
+        nothing fresh arrived — keep acting on the current weights).  The
+        call blocks ONLY while acting would exceed ``max_lag`` updates of
+        staleness; within the bound a missing broadcast never stalls the
+        rollout, because the learner's importance correction absorbs the
+        extra lag.  Superseded intermediate versions go through
+        ``on_stale`` (the lead still accounts their metrics)."""
+        held: List[Frame] = []
+        newest: Optional[Frame] = None
+        target_min = round_k - 1 - max(0, int(max_lag))
+        deadline = time.monotonic() + (timeout or self._timeout)
+        try:
+            while True:
+                best = newest.seq if newest is not None else self.current_seq
+                blocking = best < target_min
+                try:
+                    frame = self._next_frame(
+                        max(deadline - time.monotonic(), 0.01) if blocking else 0.01
+                    )
+                except queue_mod.Empty:
+                    if blocking and time.monotonic() < deadline:
+                        continue
+                    if blocking:
+                        raise RuntimeError(
+                            f"params broadcast stalled past the soft lag bound: round "
+                            f"{round_k} needs seq >= {target_min}, have {best}"
+                        ) from None
+                    break
+                if frame.tag != "params":
+                    held.append(frame)
+                    continue
+                if frame.seq <= best:
+                    frame.release()  # reconnect replay duplicate
+                    continue
+                if newest is not None:
+                    if self.on_stale is not None:
+                        self.on_stale(newest)
+                    newest.release()
+                newest = frame
+        finally:
+            self._pending.extend(held)
+        if newest is not None:
+            self.current_seq = newest.seq
+        self.staleness_log.append((round_k, max(0, (round_k - 1) - self.current_seq)))
+        return newest
+
     def advance_to(self, target_seq: int, timeout: Optional[float] = None) -> Optional[Frame]:
         """Collapse the pipeline to ``target_seq`` (checkpoint barrier:
         the lead player needs the params/opt-state of the update it is
@@ -1189,6 +1601,56 @@ class ParamsFollower:
             return None
         return self._take_exact(target_seq, timeout=timeout)
 
+    def advance_to_at_least(self, target_seq: int, timeout: Optional[float] = None) -> Optional[Frame]:
+        """Like :meth:`advance_to` but tolerant of reconnect gaps: a
+        params frame LOST to a severed connection is replaced by the
+        trainer's replay of its NEWEST broadcast, so the stream may
+        legitimately skip past the target — the first frame at or beyond
+        it is adopted (the join path's initial weights, where exactness
+        would misread a mid-handshake net drop as protocol corruption)."""
+        if target_seq <= self.current_seq:
+            return None
+        while True:
+            frame = self.wait_tag("params", timeout=timeout)
+            if frame.seq <= self.current_seq:
+                frame.release()  # reconnect replay duplicate
+                continue
+            if frame.seq < target_seq:
+                self.current_seq = frame.seq
+                if self.on_stale is not None:
+                    self.on_stale(frame)
+                frame.release()
+                continue
+            self.current_seq = frame.seq
+            return frame
+
     @property
     def max_staleness_seen(self) -> int:
         return max((s for _, s in self.staleness_log), default=0)
+
+
+class HeartbeatSender:
+    """Player-side liveness beacon: a daemon thread sending one array-less
+    :data:`HB_TAG` frame every ``interval`` seconds, so the trainer-side
+    supervisor can distinguish "slow" from "silent" even for remote (tcp)
+    players it has no process handle for.  Send failures are swallowed —
+    a dead trainer surfaces through the protocol paths that already
+    handle it, not through the heartbeat."""
+
+    def __init__(self, channel: Channel, interval: float = 2.0):
+        self._chan = channel
+        self._interval = max(0.1, float(interval))
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, name="sheeprl-heartbeat", daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._interval):
+            try:
+                self._chan.send(HB_TAG, timeout=self._interval)
+            except Exception:
+                pass
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2.0)

@@ -205,6 +205,13 @@ class ReplayServer:
         self._outstanding = {pid: self.credit_window for pid in self.channels}
         self.stopped: set = set()
         self.dead: Dict[int, str] = {}
+        # elastic-pool bookkeeping (the supervisor's expected surface —
+        # remote players are stateless writers, so ``joining`` is
+        # transient: a revived pid is fully live the moment it reconnects)
+        self.joining: Dict[int, float] = {}
+        self.last_seen: Dict[int, float] = {}
+        self._awaiting_first_frame: set = set()
+        self.rejoins = 0
         self.events: List[Dict[str, Any]] = []
         self.total_inserts = 0  # transitions (the trainer's policy-step clock)
         self.inserts_by_player = {pid: 0 for pid in self.channels}
@@ -231,6 +238,7 @@ class ReplayServer:
                 detail = ""
         # a clean exit means the player finished; its stop frame may have
         # been destroyed by a TCP reset (see FanIn.mark_dead)
+        self._awaiting_first_frame.discard(pid)
         if "exitcode=0" in detail.replace(" ", ""):
             self.stopped.add(pid)
             return
@@ -238,10 +246,34 @@ class ReplayServer:
         self.events.append(
             {"event": "player_dead", "player": pid, "reason": reason, "live": len(self.live)}
         )
-        if not self.live and not self.stopped:
+        if not self.live and not self.stopped and not self.joining:
             raise PeerDiedError(
                 "player", "; ".join(f"player[{p}]: {r}" for p, r in self.dead.items())
             )
+
+    # the supervisor calls the public name (FanIn parity)
+    def mark_dead(self, pid: int, reason: str) -> None:
+        self._mark_dead(pid, reason)
+
+    def begin_join(self, pid: int, channel=None, steps_per_frame: Optional[int] = None) -> None:
+        """Re-admit a restarted player (the supervisor's revival hook).
+
+        The stale credit window died with the old process: a fresh
+        :class:`ReplayWriter` comes up believing it holds the full initial
+        window, so ``_outstanding`` is RESET to match — without this the
+        server would under-grant forever (it thinks credits are still in
+        flight) and a rejoined player would deadlock on its first stall."""
+        if channel is not None:
+            self.channels[pid] = channel
+        self.dead.pop(pid, None)
+        self.stopped.discard(pid)
+        self._outstanding[pid] = self.credit_window
+        self.inserts_by_player.setdefault(pid, 0)
+        # until its first frame lands, sends to a tcp joiner would stall
+        # on a socket it has not dialed yet — broadcasts skip it
+        self._awaiting_first_frame.add(pid)
+        self.rejoins += 1
+        self.events.append({"event": "player_rejoin", "player": pid, "live": len(self.live)})
 
     # ---------------------------------------------------------------- pump
     def pump(self, budget_s: float = 0.05, on_control: Optional[Callable] = None) -> int:
@@ -263,6 +295,8 @@ class ReplayServer:
                     self._mark_dead(pid, str(e))
                     continue
                 any_frame = True
+                self.last_seen[pid] = time.monotonic()
+                self._awaiting_first_frame.discard(pid)
                 if frame.tag == "stop":
                     self.stopped.add(pid)
                     frame.release()
@@ -300,6 +334,8 @@ class ReplayServer:
         credits already in flight) allows.  Withholding here is what makes
         a stalled trainer throttle its players."""
         for pid in list(self.live):
+            if pid in self._awaiting_first_frame:
+                continue  # revived player still dialing back in
             offset, count = self.env_shards[pid]
             while self._outstanding[pid] < self.credit_window:
                 if self.limiter is not None:
@@ -414,8 +450,16 @@ class ReplayServer:
             },
             "live": len(self.live),
             "deaths": len(self.dead),
+            "rejoins": self.rejoins,
             "credit_grant_stalls": self.credit_stall_players,
         }
         if self.limiter is not None:
             rec["limiter"] = self.limiter.stats()
         return rec
+
+    @property
+    def broadcast_targets(self):
+        """Live players safe to push params at (a revived tcp player that
+        has not dialed back yet is excluded — a send would stall on its
+        dead socket until the reconnect)."""
+        return [p for p in self.live if p not in self._awaiting_first_frame]

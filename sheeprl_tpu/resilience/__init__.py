@@ -41,12 +41,18 @@ from sheeprl_tpu.resilience.peer import (
     queue_get_from_peer,
 )
 from sheeprl_tpu.resilience.preemption import PreemptionHandler
+from sheeprl_tpu.resilience.supervisor import (
+    PlayerSupervisor,
+    strip_player_faults,
+    supervisor_knobs,
+)
 
 __all__ = [
     "AsyncCheckpointWriter",
     "CheckpointManager",
     "FaultInjector",
     "PeerDiedError",
+    "PlayerSupervisor",
     "PreemptionHandler",
     "child_alive",
     "fault_arg",
@@ -59,4 +65,6 @@ __all__ = [
     "parent_alive",
     "queue_get_from_peer",
     "resolve_auto_resume",
+    "strip_player_faults",
+    "supervisor_knobs",
 ]

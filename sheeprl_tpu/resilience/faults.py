@@ -73,11 +73,19 @@ KNOWN_SITES = (
 
 
 class FaultInjector:
-    """Parsed ``SHEEPRL_FAULTS`` spec + per-site hit counters."""
+    """Parsed ``SHEEPRL_FAULTS`` spec + per-entry hit counters.
+
+    A site may appear MULTIPLE times in the spec (e.g. a chaos schedule
+    ``player_exit:3:1,player_exit:7:2`` kills player 1 at its 3rd
+    iteration and player 2 at its 7th): each entry keeps its own hit
+    counter and fires once.  For indexed sites (``player_exit``), only
+    entries whose ``arg`` matches the calling instance count hits, so
+    sibling players sharing the env var are unaffected."""
 
     def __init__(self, spec: str = ""):
         self._lock = threading.Lock()
-        self._sites: Dict[str, Dict[str, float]] = {}
+        self._sites: Dict[str, list] = {}
+        self._last_arg: Dict[str, float] = {}
         for entry in (spec or "").split(","):
             entry = entry.strip()
             if not entry:
@@ -90,26 +98,38 @@ class FaultInjector:
                 )
             after = int(parts[1]) if len(parts) > 1 and parts[1] else 1
             arg = float(parts[2]) if len(parts) > 2 and parts[2] else 0.0
-            self._sites[name] = {"after": max(1, after), "hits": 0, "arg": arg, "fired": 0}
+            self._sites.setdefault(name, []).append(
+                {"after": max(1, after), "hits": 0, "arg": arg, "fired": 0}
+            )
 
-    def fire(self, name: str) -> bool:
-        """Count a hit of ``name``; True exactly when its threshold is
-        reached (one-shot)."""
+    def fire(self, name: str, index: Optional[int] = None) -> bool:
+        """Count a hit of ``name``; True exactly when one entry's
+        threshold is reached (each entry is a one-shot).  ``index``
+        restricts the hit to entries targeting that instance (the
+        decoupled player id) — entries for other indices are untouched."""
         if not self._sites:
             return False
         with self._lock:
-            site = self._sites.get(name)
-            if site is None or site["fired"]:
+            entries = self._sites.get(name)
+            if not entries:
                 return False
-            site["hits"] += 1
-            if site["hits"] >= site["after"]:
-                site["fired"] = 1
-                return True
+            for e in entries:
+                if index is not None and int(e["arg"]) != int(index):
+                    continue
+                if e["fired"]:
+                    continue
+                e["hits"] += 1
+                if e["hits"] >= e["after"]:
+                    e["fired"] = 1
+                    self._last_arg[name] = e["arg"]
+                    return True
             return False
 
     def arg(self, name: str) -> float:
-        site = self._sites.get(name)
-        return float(site["arg"]) if site else 0.0
+        if name in self._last_arg:
+            return float(self._last_arg[name])
+        entries = self._sites.get(name)
+        return float(entries[0]["arg"]) if entries else 0.0
 
     @property
     def armed(self) -> bool:
@@ -163,12 +183,12 @@ def hard_exit_point(name: str, index: int = 0) -> None:
     ``player_exit:2:1`` kills player 1 at its 2nd iteration while its
     siblings — who inherit the same ``SHEEPRL_FAULTS`` — keep running.
     The default arg 0 preserves the 1x1 behavior (player 0 is the only
-    player)."""
+    player).  Repeated entries form a kill SCHEDULE
+    (``player_exit:3:1,player_exit:7:2``); the supervisor strips a
+    respawned player's own entries from the child env so a restart does
+    not immediately re-fire the fault that killed it."""
     inj = get_injector()
     if not inj.armed:
         return
-    site = inj._sites.get(name)
-    if site is not None and int(site["arg"]) != int(index):
-        return
-    if inj.fire(name):
+    if inj.fire(name, index=index):
         os._exit(13)

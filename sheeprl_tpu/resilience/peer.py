@@ -17,8 +17,12 @@ import queue as queue_mod
 import time
 from typing import Any, Callable, Optional
 
-# liveness poll cadence while waiting on the peer; short enough that a
-# dead peer is noticed promptly, long enough to stay off the profile
+# default liveness poll cadence while waiting on the peer; short enough
+# that a dead peer is noticed promptly, long enough to stay off the
+# profile.  The decoupled topologies override it per-run via
+# ``algo.liveness_interval`` (wired through the transport ChannelSpecs);
+# the companion ``algo.liveness_timeout`` replaces the hard-coded 600 s
+# protocol-wait ceiling in the decoupled loops.
 _PEER_POLL_S = 0.5
 
 

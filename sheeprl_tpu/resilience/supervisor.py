@@ -1,0 +1,243 @@
+"""Trainer-side player-pool supervision for the elastic decoupled topology.
+
+The PR-4 fan-in degrades gracefully on player death but can only
+*shrink*: a crashed player is gone for the rest of the run.  This module
+closes the loop — a :class:`PlayerSupervisor` owned by the trainer
+watches the pool (process handles for local players, transport
+heartbeats for remote ones), and when a player dies it RESTARTS it with
+exponential backoff under a restart budget.  The restarted process comes
+up in ``join`` mode: it announces itself with a ``join`` frame, the
+trainer replies with its deterministic env-shard assignment and the
+current round clock, and the fan-in grows back
+(:meth:`~sheeprl_tpu.parallel.transport.FanIn.begin_join`) without the
+survivors ever stalling.
+
+Supervision policy:
+
+- a player that exited CLEANLY (exitcode 0 — it finished its work or
+  drained out under preemption) is never restarted;
+- each death schedules a restart after ``backoff_base * 2**n`` seconds
+  (``n`` = that player's prior restarts, capped at ``backoff_max``) —
+  a crash-looping player backs off instead of spinning;
+- ``restart_budget`` bounds TOTAL restarts across the pool; once spent,
+  further deaths degrade to the PR-4 shrink behavior;
+- a pending preemption disables restarts (the pool is draining);
+- when respawning player ``p``, any ``player_exit`` fault entries
+  targeting ``p`` are stripped from the child's ``SHEEPRL_FAULTS`` — a
+  chaos-schedule kill fires once, it does not execute the replacement.
+
+Pool-size / restart / backoff state rides telemetry via :meth:`stats`
+(merged into the transport record the lead already ships).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from sheeprl_tpu.resilience.faults import ENV_VAR as FAULTS_ENV_VAR
+from sheeprl_tpu.resilience.peer import child_alive
+
+__all__ = ["PlayerSupervisor", "strip_player_faults", "supervisor_knobs"]
+
+
+def supervisor_knobs(cfg) -> Dict[str, Any]:
+    """The supervision configuration surface (``algo.supervisor.*``),
+    resolved with defaults (shared by ppo_decoupled / sac_decoupled)."""
+    sup = cfg.algo.get("supervisor", None) or {}
+    return {
+        "enabled": bool(sup.get("enabled", False)),
+        "restart_budget": int(sup.get("restart_budget", 8)),
+        "backoff_base": float(sup.get("backoff_base", 0.5)),
+        "backoff_max": float(sup.get("backoff_max", 30.0)),
+        "heartbeat_timeout": float(sup.get("heartbeat_timeout", 60.0)),
+    }
+
+
+def strip_player_faults(spec: str, player_id: int) -> str:
+    """Remove ``player_exit`` entries targeting ``player_id`` from a
+    ``SHEEPRL_FAULTS`` spec (the restarted player must not inherit the
+    kill that felled its predecessor)."""
+    kept = []
+    for entry in (spec or "").split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        parts = entry.split(":")
+        if parts[0] == "player_exit":
+            arg = int(float(parts[2])) if len(parts) > 2 and parts[2] else 0
+            if arg == int(player_id):
+                continue
+        kept.append(entry)
+    return ",".join(kept)
+
+
+class PlayerSupervisor:
+    """Watches + restarts the decoupled player pool.
+
+    ``make_args(pid, spec)`` must return the full ``Process`` args tuple
+    for a player coming up in JOIN mode (the caller owns the player-loop
+    signature); ``procs`` is the live pid->Process map, mutated in place
+    so the trainer's shutdown join/terminate sweep sees replacements.
+    """
+
+    def __init__(
+        self,
+        ctx,
+        hub,
+        fanin,
+        target: Callable,
+        make_args: Callable[[int, Any], tuple],
+        procs: Dict[int, Any],
+        *,
+        restart_budget: int = 8,
+        backoff_base: float = 0.5,
+        backoff_max: float = 30.0,
+        heartbeat_timeout: float = 60.0,
+        steps_per_frame: Optional[Dict[int, int]] = None,
+        preemption=None,
+        join_timeout: float = 600.0,
+    ):
+        self._ctx = ctx
+        self._hub = hub
+        self._fanin = fanin
+        self._target = target
+        self._make_args = make_args
+        self.procs = procs
+        self.restart_budget = int(restart_budget)
+        self.backoff_base = float(backoff_base)
+        self.backoff_max = float(backoff_max)
+        self.heartbeat_timeout = float(heartbeat_timeout)
+        self._steps_per_frame = steps_per_frame or {}
+        self._preemption = preemption
+        self._join_timeout = float(join_timeout)
+        self.total_restarts = 0
+        self.restarts_by_pid: Dict[int, int] = {}
+        self._next_attempt: Dict[int, float] = {}  # pid -> earliest respawn time
+        self.events: List[Dict[str, Any]] = []
+        self._closed = False
+
+    # ------------------------------------------------------------- status
+    @property
+    def budget_remaining(self) -> int:
+        return max(0, self.restart_budget - self.total_restarts)
+
+    def recoverable(self) -> bool:
+        """True while a restart is pending or possible — the trainer keeps
+        the run alive through a total pool loss instead of aborting."""
+        if self._closed or self.budget_remaining <= 0:
+            return False
+        if self._preemption is not None and self._preemption.preempted:
+            return False
+        return bool(self._next_attempt) or any(
+            pid in self._fanin.dead for pid in self.procs
+        )
+
+    # --------------------------------------------------------------- poll
+    def poll(self) -> int:
+        """One supervision pass (the trainer calls this once per round):
+        detect deaths the fan-in has not seen yet, schedule restarts with
+        backoff, and execute the ones whose backoff elapsed.  Returns the
+        number of players respawned this pass."""
+        if self._closed:
+            return 0
+        now = time.monotonic()
+        draining = self._preemption is not None and self._preemption.preempted
+        # 1) proactive death detection: a proc that died between fan-in
+        # rounds (the channel only notices when the trainer blocks on it)
+        for pid, proc in list(self.procs.items()):
+            if proc.is_alive() or pid in self._fanin.stopped or pid in self._fanin.joining:
+                continue
+            if proc.exitcode == 0:
+                # clean exits surface as stops through the protocol; never
+                # restart them
+                continue
+            if pid not in self._fanin.dead:
+                self._fanin.mark_dead(pid, f"process died (exitcode={proc.exitcode})")
+            if pid not in self._next_attempt and not draining and self.budget_remaining > 0:
+                n = self.restarts_by_pid.get(pid, 0)
+                delay = min(self.backoff_base * (2**n), self.backoff_max)
+                self._next_attempt[pid] = now + delay
+                self.events.append(
+                    {"event": "restart_scheduled", "player": pid, "delay_s": round(delay, 2)}
+                )
+        # 2) heartbeat silence for players without a live process handle
+        # (remote/tcp workers): silence past the timeout is a death
+        for pid in list(self._fanin.live):
+            proc = self.procs.get(pid)
+            if proc is not None:
+                continue
+            age = now - self._fanin.last_seen.get(pid, now)
+            if age > self.heartbeat_timeout:
+                self._fanin.mark_dead(pid, f"no heartbeat for {age:.1f}s")
+        # 3) execute due restarts
+        respawned = 0
+        if not draining:
+            for pid, due in sorted(self._next_attempt.items()):
+                if now < due or self.budget_remaining <= 0:
+                    continue
+                del self._next_attempt[pid]
+                self._respawn(pid)
+                respawned += 1
+        return respawned
+
+    # ------------------------------------------------------------ respawn
+    def _respawn(self, pid: int) -> None:
+        spec = self._hub.respawn_spec(pid)
+        self.total_restarts += 1
+        self.restarts_by_pid[pid] = self.restarts_by_pid.get(pid, 0) + 1
+        # children must land on the host CPU backend (same dance as
+        # spawn_players) and must not re-fire the kill that felled their
+        # predecessor
+        saved_platform = os.environ.get("JAX_PLATFORMS")
+        saved_faults = os.environ.get(FAULTS_ENV_VAR)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        if saved_faults:
+            os.environ[FAULTS_ENV_VAR] = strip_player_faults(saved_faults, pid)
+        try:
+            proc = self._ctx.Process(target=self._target, args=self._make_args(pid, spec), daemon=False)
+            proc.start()
+        finally:
+            if saved_platform is None:
+                os.environ.pop("JAX_PLATFORMS", None)
+            else:
+                os.environ["JAX_PLATFORMS"] = saved_platform
+            if saved_faults is None:
+                os.environ.pop(FAULTS_ENV_VAR, None)
+            else:
+                os.environ[FAULTS_ENV_VAR] = saved_faults
+        self.procs[pid] = proc
+        if self._preemption is not None:
+            self._preemption.add_child(proc)
+        ch = self._hub.channel(pid, timeout=self._join_timeout, peer_alive=proc.is_alive)
+        ch.set_peer(
+            child_alive(proc),
+            f"player[{pid}]",
+            detail_fn=lambda proc=proc: f"exitcode={proc.exitcode}",
+        )
+        ch.reset_for_rejoin()
+        self._fanin.begin_join(pid, channel=ch, steps_per_frame=self._steps_per_frame.get(pid))
+        self.events.append(
+            {
+                "event": "player_restart",
+                "player": pid,
+                "attempt": self.restarts_by_pid[pid],
+                "budget_remaining": self.budget_remaining,
+            }
+        )
+
+    # ---------------------------------------------------------- telemetry
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "restarts": self.total_restarts,
+            "budget_remaining": self.budget_remaining,
+            "pending_restarts": len(self._next_attempt),
+            "restarts_by_player": {str(p): n for p, n in sorted(self.restarts_by_pid.items())},
+            "events": self.events[-8:],
+        }
+
+    def close(self) -> None:
+        """Stop supervising (run teardown): pending restarts are dropped."""
+        self._closed = True
+        self._next_attempt.clear()

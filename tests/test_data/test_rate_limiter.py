@@ -112,3 +112,38 @@ def test_from_cfg_disabled_and_enabled():
     )
     assert rl is not None and rl.spi == 2.0 and rl.min_size_to_sample == 4
     assert rl.max_diff - rl.min_diff == pytest.approx(32.0)
+
+
+# ----------------------------------------------------------- pool churn
+def test_limiter_accounting_is_churn_proof():
+    """ISSUE 6 satellite: the limiter tracks only RECORDED inserts/samples
+    (pure totals), so a player dying between a credit grant and its use
+    cannot wedge the window — reclaiming in-flight credits is the
+    server's job (ReplayServer.begin_join), and sampling alone must
+    always reopen insert room."""
+    from sheeprl_tpu.replay.rate_limiter import RateLimiter
+
+    rl = RateLimiter(2.0, min_size_to_sample=2, error_buffer=4.0)
+    rl.insert(3)  # player A
+    rl.insert(2)  # player B dies right after this insert
+    before = rl.insert_allowance(100)
+    assert rl.can_sample(4)
+    rl.sample(6)
+    assert rl.insert_allowance(100) > before  # no dead-player deadlock
+    assert rl.stats()["error"] == 2 * 5 - 6
+
+
+def test_limiter_state_survives_writer_restart_mid_window():
+    """A rejoining player resumes against the SAME limiter state: the
+    checkpoint counters are insert/sample totals, not per-player windows,
+    so a restart never double-counts or loses budget."""
+    from sheeprl_tpu.replay.rate_limiter import RateLimiter
+
+    rl = RateLimiter(1.0, min_size_to_sample=1, error_buffer=8.0)
+    rl.insert(5)
+    rl.sample(2)
+    state = rl.state_dict()
+    rl2 = RateLimiter(1.0, min_size_to_sample=1, error_buffer=8.0)
+    rl2.load_state_dict(state)
+    assert rl2.insert_allowance(100) == rl.insert_allowance(100)
+    assert rl2.sample_allowance(100) == rl.sample_allowance(100)

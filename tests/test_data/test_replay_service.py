@@ -211,3 +211,93 @@ def test_blocked_writer_unblocks_when_credit_arrives():
     assert done.get("ok")
     server.pump(0.2)
     assert server.total_inserts == 1
+
+
+# ------------------------------------------------------------ pool churn
+from sheeprl_tpu.resilience.peer import PeerDiedError  # noqa: E402
+
+
+def test_dead_player_mid_credit_does_not_block_survivors():
+    """ISSUE 6 satellite: a player dying with its credit window in flight
+    must not eat the limiter budget forever — pending-credit accounting
+    sums LIVE players only, so the survivor keeps inserting."""
+    limiter = RateLimiter(1.0, min_size_to_sample=1, error_buffer=6.0)
+    server, writers, _, _ = _make(n_players=2, limiter=limiter)
+    server.mark_dead(1, "simulated crash")
+    assert server._outstanding[1] == 2  # stale in-flight credits remain
+    w = writers[0]
+    for t in range(5):
+        w.append(_step(t, 1), timeout=5.0)
+        server.pump(0.2)
+        w.pump(0.05)
+    assert server.total_inserts == 5
+    assert server.stats()["deaths"] == 1
+
+
+def test_rejoining_writer_resumes_on_fresh_credit_window():
+    """A restarted writer believes it holds the full initial window;
+    begin_join must RESET the server's outstanding count to match, or the
+    server under-grants forever and the rejoiner deadlocks on its first
+    stall."""
+    server, writers, players, chans = _make(n_players=2)
+    server.mark_dead(1, "crash")
+    server._outstanding[1] = 0  # worst case: every credit consumed pre-death
+    p, t = _channel_pair()
+    server.begin_join(1, channel=t)
+    assert server._outstanding[1] == server.credit_window
+    assert 1 in server.live and not server.dead
+    w1 = ReplayWriter(p, 1, initial_credits=2)
+    # first inserts flow on the writer's own initial window...
+    w1.append(_step(5, 1), timeout=5.0)
+    assert server.pump(0.2) == 1
+    # ...and grants resume once its first frame landed
+    server.grant_credits()
+    w1.pump(0.2)
+    for t_ in range(4):
+        w1.append(_step(6 + t_, 1), timeout=5.0)
+        server.pump(0.2)
+        w1.pump(0.05)
+    assert server.inserts_by_player[1] == 5
+    ev = [e["event"] for e in server.events]
+    assert "player_dead" in ev and "player_rejoin" in ev
+    assert server.stats()["rejoins"] == 1
+
+
+def test_broadcast_targets_skip_rejoiner_until_it_dials_in():
+    server, writers, players, chans = _make(n_players=2)
+    server.mark_dead(1, "crash")
+    server.begin_join(1, channel=chans[1])
+    assert server.broadcast_targets == [0]
+    writers[1].append(_step(1, 1))
+    server.pump(0.2)
+    assert server.broadcast_targets == [0, 1]
+
+
+def test_grant_credits_waits_for_rejoiner_to_dial_in():
+    """Granting to a revived tcp player before it reconnects would stall
+    on the dead socket: grants must wait for its first frame."""
+    server, writers, players, chans = _make(n_players=1)
+    with pytest.raises(PeerDiedError):
+        server.mark_dead(0, "crash")
+    p, t = _channel_pair()
+    server.begin_join(0, channel=t)
+    server._outstanding[0] = 0
+    server.grant_credits()
+    assert server._outstanding[0] == 0  # withheld: still awaiting first frame
+    w = ReplayWriter(p, 1, initial_credits=2)
+    w.append(_step(3, 1))
+    assert server.pump(0.2) == 1
+    server.grant_credits()
+    assert server._outstanding[0] > 0
+
+
+def test_last_writer_death_recoverable_through_rejoin():
+    server, writers, players, chans = _make(n_players=1)
+    with pytest.raises(PeerDiedError):
+        server.mark_dead(0, "crash")
+    p, t = _channel_pair()
+    server.begin_join(0, channel=t)
+    assert server.live == [0] and not server.all_stopped
+    w = ReplayWriter(p, 1, initial_credits=2)
+    w.append(_step(3, 1))
+    assert server.pump(0.2) == 1

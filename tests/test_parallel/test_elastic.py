@@ -1,0 +1,464 @@
+"""Elastic player pools (ISSUE 6 tentpole): mask-padded fan-in assembly,
+the join/graduate protocol, supervisor restart policy, the multi-entry
+fault schedule, and the chaos smoke/soak that prove kill -> backoff ->
+restart -> rejoin end to end with zero post-warmup XLA retraces."""
+
+import glob
+import json
+import multiprocessing as mp
+import os
+import queue
+import time
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.parallel.transport import (
+    FanIn,
+    JOIN_TAG,
+    QueueChannel,
+    assemble_shards_padded,
+    make_transport,
+)
+from sheeprl_tpu.resilience.faults import FaultInjector
+from sheeprl_tpu.resilience.peer import PeerDiedError
+from sheeprl_tpu.resilience.supervisor import PlayerSupervisor, strip_player_faults
+
+
+# ------------------------------------------------------ padded assembly
+def test_assemble_shards_padded_fixed_width_and_mask():
+    shards = {
+        0: {"x": np.full((3, 2, 4), 1.0, np.float32)},
+        2: {"x": np.full((3, 1, 4), 3.0, np.float32)},
+    }
+    env_shards = [(0, 2), (2, 2), (4, 1)]  # player 1 (cols 2:4) is dead
+    out, mask = assemble_shards_padded(shards, env_shards, axis=1)
+    assert out["x"].shape == (3, 5, 4)
+    np.testing.assert_array_equal(mask, [1, 1, 0, 0, 1])
+    assert (out["x"][:, :2] == 1.0).all()
+    assert (out["x"][:, 2:4] == 0.0).all()  # dead columns zero-filled
+    assert (out["x"][:, 4:] == 3.0).all()
+
+
+def test_assemble_shards_padded_full_pool_matches_concat():
+    rng = np.random.default_rng(0)
+    shards = {p: {"x": rng.normal(size=(2, 3, 2)).astype(np.float32)} for p in range(3)}
+    env_shards = [(0, 3), (3, 3), (6, 3)]
+    out, mask = assemble_shards_padded(shards, env_shards, axis=1)
+    np.testing.assert_array_equal(out["x"], np.concatenate([shards[p]["x"] for p in range(3)], 1))
+    assert mask.all()
+
+
+def test_assemble_shards_padded_axis0_for_obs():
+    shards = {1: {"o": np.full((2, 3), 5.0, np.float32)}}
+    out, mask = assemble_shards_padded(shards, [(0, 2), (2, 2)], axis=0)
+    assert out["o"].shape == (4, 3)
+    assert (out["o"][:2] == 0).all() and (out["o"][2:] == 5.0).all()
+    np.testing.assert_array_equal(mask, [0, 0, 1, 1])
+
+
+# -------------------------------------------------------- fan-in joins
+def _pair(backend="queue", num_players=1, **kw):
+    ctx = mp.get_context("spawn")
+    kw.setdefault("min_bytes", 0)
+    hub, specs = make_transport(ctx, backend, num_players, **kw)
+    players = [s.player_channel() for s in specs]
+    trainers = [hub.channel(i, timeout=10) for i in range(num_players)]
+    return hub, players, trainers
+
+
+def test_fanin_joiner_graduates_on_matching_round():
+    hub, players, trainers = _pair(num_players=2)
+    try:
+        fanin = FanIn({i: trainers[i] for i in range(2)})
+        fanin.mark_dead(1, "crash")
+        assert fanin.live == [0]
+        # restart: same channel (queue survives), join begins
+        fanin.begin_join(1, channel=trainers[1])
+        assert fanin.joining and fanin.live == [0]
+        # round 5: survivor mandatory, joiner's frame matches -> graduates
+        players[0].send("data", arrays=[("x", np.ones((2, 2), np.float32))], seq=5)
+        players[1].send("data", arrays=[("x", np.ones((2, 2), np.float32))], seq=5)
+        time.sleep(0.1)
+        seq, frames = fanin.gather(timeout=10)
+        assert seq == 5 and list(frames) == [0, 1]
+        for f in frames.values():
+            f.release()
+        assert fanin.live == [0, 1] and not fanin.joining and fanin.rejoins == 1
+        assert any(e["event"] == "player_rejoin" for e in fanin.events)
+        stats = fanin.stats("queue")
+        assert stats["rejoins"] == 1 and stats["live"] == 2
+    finally:
+        for c in players + trainers:
+            c.close()
+        hub.close()
+
+
+def test_fanin_joiner_never_stalls_survivors_and_stale_frames_drop():
+    hub, players, trainers = _pair(num_players=2)
+    try:
+        fanin = FanIn({i: trainers[i] for i in range(2)})
+        fanin.mark_dead(1, "crash")
+        fanin.begin_join(1, channel=trainers[1])
+        # joiner sends a STALE round (3) while the pool is on round 7: the
+        # round completes with the survivor alone, the stale frame drops
+        players[1].send("data", arrays=[("x", np.zeros((1, 1), np.float32))], seq=3)
+        players[0].send("data", arrays=[("x", np.ones((1, 1), np.float32))], seq=7)
+        time.sleep(0.1)
+        seq, frames = fanin.gather(timeout=10)
+        assert seq == 7 and list(frames) == [0]
+        for f in frames.values():
+            f.release()
+        assert 1 in fanin.joining  # still joining, not dead, not graduated
+        # next round it lands in sync and graduates
+        players[0].send("data", arrays=[("x", np.ones((1, 1), np.float32))], seq=8)
+        players[1].send("data", arrays=[("x", np.ones((1, 1), np.float32))], seq=8)
+        time.sleep(0.1)
+        seq, frames = fanin.gather(timeout=10)
+        assert seq == 8 and list(frames) == [0, 1]
+        for f in frames.values():
+            f.release()
+    finally:
+        for c in players + trainers:
+            c.close()
+        hub.close()
+
+
+def test_fanin_total_loss_recovers_through_joiner():
+    """Losing every full member is survivable while a join is pending:
+    the next round forms from the joiner's stashed frame."""
+    hub, players, trainers = _pair(num_players=1)
+    try:
+        fanin = FanIn({0: trainers[0]})
+        fanin.mark_dead(0, "crash")
+        with pytest.raises(PeerDiedError):
+            fanin._require_live()
+        fanin.begin_join(0, channel=trainers[0])
+        fanin._require_live()  # joiner pending: no longer fatal
+        players[0].send("data", arrays=[("x", np.ones((1, 1), np.float32))], seq=4)
+        time.sleep(0.1)
+        seq, frames = fanin.gather(timeout=10)
+        assert seq == 4 and list(frames) == [0]
+        for f in frames.values():
+            f.release()
+        assert fanin.live == [0]
+    finally:
+        for c in players + trainers:
+            c.close()
+        hub.close()
+
+
+def test_broadcast_skips_joiner_until_first_frame():
+    hub, players, trainers = _pair(num_players=2)
+    try:
+        fanin = FanIn({i: trainers[i] for i in range(2)})
+        fanin.mark_dead(1, "crash")
+        fanin.begin_join(1, channel=trainers[1])
+        fanin.broadcast("params", arrays=[("0", np.ones(4, np.float32))], seq=9)
+        players[0].recv(timeout=5).release()
+        with pytest.raises(queue.Empty):
+            players[1].recv(timeout=0.3)  # silent joiner: no broadcast yet
+        # the joiner announces itself (a join frame counts as traffic)
+        players[1].send(JOIN_TAG, extra=("blueprint",))
+        time.sleep(0.1)
+        seen = []
+        fanin._poll_joining("data", lambda pid, f: (seen.append((pid, f.tag)), f.release()))
+        assert seen == [(1, JOIN_TAG)]
+        fanin.broadcast("params", arrays=[("0", np.ones(4, np.float32))], seq=10)
+        assert players[1].recv(timeout=5).seq == 10
+    finally:
+        for c in players + trainers:
+            c.close()
+        hub.close()
+
+
+# ------------------------------------------------------- fault schedule
+def test_fault_injector_multi_entry_schedule():
+    inj = FaultInjector("player_exit:2:1,player_exit:3:2,net_delay:1:0.5")
+    # player 1 fires on ITS 2nd hit; player 2's entry is untouched by it
+    assert not inj.fire("player_exit", index=1)
+    assert inj.fire("player_exit", index=1)
+    assert not inj.fire("player_exit", index=1)  # one-shot
+    assert not inj.fire("player_exit", index=2)
+    assert not inj.fire("player_exit", index=2)
+    assert inj.fire("player_exit", index=2)
+    assert inj.fire("net_delay") and inj.arg("net_delay") == 0.5
+
+
+def test_strip_player_faults_removes_only_that_players_kills():
+    spec = "player_exit:3:1,player_exit:9:2,net_drop:5,ckpt_truncate"
+    assert strip_player_faults(spec, 1) == "player_exit:9:2,net_drop:5,ckpt_truncate"
+    assert strip_player_faults(spec, 0) == spec
+    assert strip_player_faults("player_exit", 0) == ""  # bare entry targets 0
+
+
+# ----------------------------------------------------------- supervisor
+class _FakeProc:
+    def __init__(self, alive=True, exitcode=None):
+        self._alive = alive
+        self.exitcode = exitcode
+        self.started = False
+
+    def is_alive(self):
+        return self._alive
+
+    def start(self):
+        self.started = True
+        self._alive = True
+        self.exitcode = None
+
+
+class _FakeCtx:
+    def __init__(self):
+        self.spawned = []
+
+    def Process(self, target=None, args=(), daemon=False):
+        proc = _FakeProc()
+        self.spawned.append((target, args))
+        return proc
+
+
+class _FakeHub:
+    backend = "queue"
+
+    def __init__(self, channels):
+        self._channels = channels
+        self.respawned = []
+
+    def respawn_spec(self, pid):
+        self.respawned.append(pid)
+        return f"spec-{pid}"
+
+    def channel(self, pid, timeout=0, peer_alive=None):
+        return self._channels[pid]
+
+
+def _supervised(n=2, budget=3, backoff=0.05):
+    chans = {}
+    players = []
+    for pid in range(n):
+        a, b = queue.Queue(8), queue.Queue(8)
+        players.append(QueueChannel(a, b))
+        chans[pid] = QueueChannel(b, a)
+    fanin = FanIn(chans)
+    hub = _FakeHub(chans)
+    ctx = _FakeCtx()
+    procs = {pid: _FakeProc() for pid in range(n)}
+    sup = PlayerSupervisor(
+        ctx,
+        hub,
+        fanin,
+        target=lambda *a: None,
+        make_args=lambda pid, spec: (pid, spec, True),
+        procs=procs,
+        restart_budget=budget,
+        backoff_base=backoff,
+        backoff_max=1.0,
+    )
+    return sup, fanin, hub, ctx, procs, players
+
+
+def test_supervisor_restarts_dead_player_with_backoff():
+    sup, fanin, hub, ctx, procs, _ = _supervised()
+    procs[1]._alive = False
+    procs[1].exitcode = 13
+    assert sup.poll() == 0  # first pass: death detected, restart SCHEDULED
+    assert 1 in fanin.dead and any(e["event"] == "restart_scheduled" for e in sup.events)
+    time.sleep(0.08)  # backoff elapses
+    assert sup.poll() == 1
+    assert hub.respawned == [1]
+    assert ctx.spawned[0][1] == (1, "spec-1", True)  # join-mode args
+    assert 1 in fanin.joining and 1 not in fanin.dead
+    assert sup.total_restarts == 1 and sup.budget_remaining == 2
+
+
+def test_supervisor_clean_exit_never_restarts():
+    sup, fanin, hub, ctx, procs, _ = _supervised()
+    procs[0]._alive = False
+    procs[0].exitcode = 0
+    sup.poll()
+    time.sleep(0.08)
+    assert sup.poll() == 0 and not hub.respawned and sup.total_restarts == 0
+
+
+def test_supervisor_budget_caps_restarts():
+    sup, fanin, hub, ctx, procs, _ = _supervised(budget=1)
+    procs[0]._alive = False
+    procs[0].exitcode = 13
+    sup.poll()
+    time.sleep(0.08)
+    assert sup.poll() == 1
+    # the replacement dies too: budget is spent, pool degrades to shrink
+    procs[0]._alive = False
+    procs[0].exitcode = 13
+    fanin.joining.clear()  # it never graduated
+    sup.poll()
+    time.sleep(0.2)
+    assert sup.poll() == 0
+    assert sup.total_restarts == 1 and not sup.recoverable()
+
+
+def test_supervisor_exponential_backoff_per_player():
+    sup, fanin, hub, ctx, procs, _ = _supervised(budget=5, backoff=0.2)
+    for attempt, expected_delay in ((1, 0.2), (2, 0.4)):
+        procs[0]._alive = False
+        procs[0].exitcode = 13
+        fanin.joining.pop(0, None)
+        fanin.dead.pop(0, None)
+        sup.poll()
+        sched = [e for e in sup.events if e["event"] == "restart_scheduled"]
+        assert sched[-1]["delay_s"] == pytest.approx(expected_delay)
+        assert sup.poll() == 0  # backoff not elapsed yet
+        time.sleep(expected_delay + 0.1)
+        assert sup.poll() == 1
+
+
+# --------------------------------------------------------- chaos smoke
+def _transport_records(root):
+    recs, compiles = [], []
+    for t in sorted(
+        glob.glob(f"{root}/**/telemetry.jsonl", recursive=True), key=os.path.getmtime
+    ):
+        for line in open(t):
+            rec = json.loads(line)
+            if "transport" in rec:
+                recs.append(rec["transport"])
+            if rec.get("trainer_compiles") is not None:
+                compiles.append(rec["trainer_compiles"])
+    return recs, compiles
+
+
+@pytest.mark.chaos
+def test_chaos_smoke_kill_one_rejoin_one_queue(tmp_path, monkeypatch):
+    """Tier-1 deterministic chaos: kill player 1 at its 3rd iteration over
+    the queue backend with the supervisor armed; the run must complete
+    with the pool RECOVERED to 2 (a recorded rejoin) and the trainer must
+    not retrace XLA after warmup (mask-padded fan-in)."""
+    from sheeprl_tpu.cli import run
+
+    monkeypatch.setenv("SHEEPRL_FAULTS", "player_exit:3:1")
+    run(
+        [
+            "exp=ppo_decoupled",
+            "env=dummy",
+            "env.sync_env=True",
+            "env.capture_video=False",
+            "fabric.accelerator=cpu",
+            "fabric.devices=1",
+            "metric.log_level=1",
+            "metric.log_every=64",
+            f"metric.logger.root_dir={tmp_path}/logs",
+            "checkpoint.save_last=True",
+            "buffer.memmap=False",
+            "seed=0",
+            "algo.per_rank_batch_size=4",
+            "algo.dense_units=8",
+            "algo.mlp_layers=1",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.total_steps=9600",
+            "algo.num_players=2",
+            "algo.decoupled_transport=queue",
+            "algo.run_test=False",
+            "algo.vtrace.enabled=True",
+            "algo.supervisor.enabled=True",
+            "algo.supervisor.backoff_base=0.1",
+            f"root_dir={tmp_path}/run",
+            "env.num_envs=4",
+            "algo.rollout_steps=4",
+            "algo.update_epochs=1",
+        ]
+    )
+    assert glob.glob(f"{tmp_path}/run/**/ckpt_*.ckpt", recursive=True)
+    recs, compiles = _transport_records(f"{tmp_path}/run")
+    assert recs, "no transport telemetry"
+    last = recs[-1]
+    assert last["rejoins"] == 1, f"rejoin never happened: {last}"
+    assert last["live"] + last["joining"] == 2, f"pool did not recover: {last}"
+    assert last["supervisor"]["restarts"] == 1
+    assert last["lag_hist"], "behavior-lag histogram missing"
+    # zero post-warmup recompiles across the shrink AND the grow: the
+    # compile counter must plateau right after warmup
+    assert len(compiles) >= 3
+    assert compiles[-1] == compiles[1], f"XLA retraced on churn: {compiles}"
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+@pytest.mark.network
+def test_chaos_soak_randomized_tcp_n4():
+    """The ISSUE 6 acceptance soak: N=4 over tcp, a seeded random schedule
+    of >=3 kills (+ tcp net noise), supervisor on — the run completes,
+    the pool recovers to 4, and the audit passes."""
+    from scripts.chaos_soak import main as soak_main
+
+    rc = soak_main(
+        [
+            "--players",
+            "4",
+            "--transport",
+            "tcp",
+            "--kills",
+            "3",
+            "--kill-span",
+            "220",
+            "--total-steps",
+            "19200",
+            "--seed",
+            "7",
+            "--root-dir",
+            "/tmp/sheeprl_chaos_soak_test",
+        ]
+    )
+    assert rc == 0
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_sac_remote_replay_rejoin(tmp_path, monkeypatch):
+    """Remote-replay SAC churn: a killed writer is restarted and resumes
+    inserting on a fresh credit window; the service records the rejoin
+    and the run completes."""
+    from sheeprl_tpu.cli import run
+
+    monkeypatch.setenv("SHEEPRL_FAULTS", "player_exit:4:1")
+    run(
+        [
+            "exp=sac_decoupled",
+            "env=dummy",
+            "env.id=dummy_continuous",
+            "env.sync_env=True",
+            "env.capture_video=False",
+            "fabric.accelerator=cpu",
+            "fabric.devices=1",
+            "metric.log_level=1",
+            "metric.log_every=64",
+            f"metric.logger.root_dir={tmp_path}/logs",
+            "checkpoint.save_last=True",
+            "buffer.memmap=False",
+            "buffer.remote_replay=True",
+            "seed=0",
+            "algo.per_rank_batch_size=4",
+            "algo.dense_units=8",
+            "algo.mlp_layers=1",
+            "algo.total_steps=600",
+            "algo.learning_starts=8",
+            "buffer.size=512",
+            "algo.num_players=2",
+            "algo.decoupled_transport=queue",
+            "algo.run_test=False",
+            "algo.supervisor.enabled=True",
+            "algo.supervisor.backoff_base=0.1",
+            f"root_dir={tmp_path}/run",
+            "env.num_envs=2",
+        ]
+    )
+    recs = []
+    for t in glob.glob(f"{tmp_path}/run/**/telemetry.jsonl", recursive=True):
+        for line in open(t):
+            rec = json.loads(line)
+            if "replay" in rec:
+                recs.append(rec["replay"])
+    assert recs
+    last = recs[-1]
+    assert last.get("rejoins", 0) >= 1, f"writer never rejoined: {last}"
+    assert last["live"] == 2
