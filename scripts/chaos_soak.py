@@ -1,25 +1,35 @@
-"""Chaos soak for the self-healing N-player topology.
+"""Chaos soak for the self-healing N-player topology AND the training
+health sentinel.
 
-Drives one decoupled run under a RANDOMIZED kill/restart schedule built
-from the existing ``SHEEPRL_FAULTS`` sites (player_exit entries at random
-iterations against random players, optional net_drop/net_delay noise on
-the tcp transport), with the supervisor armed so every kill turns into a
-backoff-restart-rejoin cycle.  After the run it audits the lead's
-telemetry: the pool must RECOVER to the launch size, every scheduled kill
-must appear as a death, rejoins must match, the trainer must not have
-retraced XLA after warmup (mask-padded fan-in), and the final reward must
-be finite.
+``--mode topology`` (default) drives one decoupled run under a RANDOMIZED
+kill/restart schedule built from the existing ``SHEEPRL_FAULTS`` sites
+(player_exit entries at random iterations against random players,
+optional net_drop/net_delay noise on the tcp transport), with the
+supervisor armed so every kill turns into a backoff-restart-rejoin
+cycle.  After the run it audits the lead's telemetry: the pool must
+RECOVER to the launch size, every scheduled kill must appear as a death,
+rejoins must match, the trainer must not have retraced XLA after warmup
+(mask-padded fan-in), and the final reward must be finite.
 
-This is the acceptance harness for ISSUE 6 ("an N=4 tcp ppo_decoupled
-run with >=3 player deaths and >=2 rejoins completes and the pool
-recovers"), runnable standalone::
+``--mode health`` is the ISSUE 7 acceptance harness: with ``nan_inject``
+armed (``--fault`` picks nan_inject/loss_spike/rb_corrupt), a coupled
+SAC run and an N=2 decoupled PPO run must both detect the anomaly within
+one update, skip it, trip the consecutive-skip budget, roll back to the
+last good checkpoint, and finish rc=0 — with the ``health`` telemetry
+key recording the verdicts and the rollback event (and the transport
+stats recording the rollback broadcast round for the decoupled run).
+
+Topology acceptance (ISSUE 6) runnable standalone::
 
     python scripts/chaos_soak.py --players 4 --transport tcp --kills 3 \
         --total-steps 19200 --seed 7
 
-and wrapped by the ``chaos``-marked pytest soak
-(tests/test_parallel/test_elastic.py).  The schedule is a pure function
-of ``--seed``, so a failing soak reproduces exactly.
+Health acceptance (ISSUE 7)::
+
+    python scripts/chaos_soak.py --mode health --seed 7
+
+both wrapped by ``chaos``/``slow``-marked pytest soaks.  The schedules
+are pure functions of ``--seed``, so a failing soak reproduces exactly.
 """
 
 from __future__ import annotations
@@ -116,10 +126,176 @@ def audit(transports, compiles, *, players: int, kills: int, min_rejoins: int = 
     return failures
 
 
+def read_health(root_dir: str):
+    """All ``health`` sections (top-level and transport-nested) plus
+    transport rollback counters from a run's telemetry files."""
+    health, rollback_rounds = [], 0
+    for path in sorted(
+        glob.glob(os.path.join(root_dir, "**", "telemetry.jsonl"), recursive=True),
+        key=os.path.getmtime,
+    ):
+        for line in open(path):
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if rec.get("health"):
+                health.append(rec["health"])
+            tr = rec.get("transport") or {}
+            if tr.get("health"):
+                health.append(tr["health"])
+            rollback_rounds = max(rollback_rounds, tr.get("rollbacks", 0))
+    return health, rollback_rounds
+
+
+def audit_health(health, rollback_rounds, *, budget: int, decoupled: bool) -> list:
+    failures = []
+    if not health:
+        return ["no health telemetry found (sentinel not wired?)"]
+    last = max(health, key=lambda h: h.get("updates", 0))
+    if last.get("skips", 0) < budget:
+        failures.append(f"only {last.get('skips', 0)} skips for a {budget}-skip fault window")
+    if last.get("rollbacks", 0) < 1:
+        failures.append("no rollback recorded despite a tripped budget")
+    if not last.get("last_ok", False):
+        failures.append("run ended on an anomalous verdict (no recovery)")
+    if decoupled and rollback_rounds < 1:
+        failures.append("transport stats did not record the rollback broadcast round")
+    return failures
+
+
+def _run_health_leg(args, faults: str, cli_args: list, leg_root: str, *, decoupled: bool) -> list:
+    import shutil
+
+    shutil.rmtree(leg_root, ignore_errors=True)
+    os.environ["SHEEPRL_FAULTS"] = faults
+    from sheeprl_tpu.cli import run
+
+    try:
+        run(cli_args)
+    finally:
+        os.environ.pop("SHEEPRL_FAULTS", None)
+    health, rb_rounds = read_health(leg_root)
+    failures = audit_health(health, rb_rounds, budget=3, decoupled=decoupled)
+    last = max(health, key=lambda h: h.get("updates", 0)) if health else {}
+    print(
+        json.dumps(
+            {
+                "leg": os.path.basename(leg_root),
+                "skips": last.get("skips"),
+                "rollbacks": last.get("rollbacks"),
+                "last_rollback": last.get("last_rollback"),
+                "ckpt_tags": last.get("ckpt_tags"),
+                "transport_rollback_rounds": rb_rounds,
+                "failures": failures,
+            },
+            indent=2,
+        )
+    )
+    return failures
+
+
+def run_health_mode(args) -> int:
+    """ISSUE 7 acceptance: coupled SAC + N=2 decoupled PPO under the
+    chosen update fault; both must skip, roll back and finish rc=0."""
+    base = args.root_dir
+    fault = args.fault
+    sentinel = [
+        "algo.sentinel.enabled=True",
+        "algo.sentinel.warmup=6",
+        "algo.sentinel.skip_budget=3",
+        "algo.sentinel.good_after=4",
+    ]
+    common = [
+        "env=dummy",
+        "env.sync_env=True",
+        "env.capture_video=False",
+        "fabric.accelerator=cpu",
+        "fabric.devices=1",
+        "metric.log_level=1",
+        "metric.log_every=64",
+        "checkpoint.save_last=True",
+        "buffer.memmap=False",
+        f"seed={args.seed}",
+        "algo.run_test=False",
+    ]
+    failures = _run_health_leg(
+        args,
+        f"{fault}:20:3" if fault != "rb_corrupt" else "rb_corrupt:20",
+        common
+        + sentinel
+        + [
+            "exp=sac",
+            "env.id=dummy_continuous",
+            "env.num_envs=4",
+            f"metric.logger.root_dir={base}/sac/logs",
+            "checkpoint.every=16",
+            "algo.total_steps=512",
+            "algo.learning_starts=16",
+            "algo.per_rank_batch_size=8",
+            "algo.hidden_size=8",
+            "algo.mlp_keys.encoder=[state]",
+            f"root_dir={base}/sac/run",
+        ],
+        f"{base}/sac",
+        decoupled=False,
+    )
+    failures += _run_health_leg(
+        args,
+        f"{fault}:12:3" if fault != "rb_corrupt" else "rb_corrupt:12",
+        common
+        + sentinel
+        + [
+            "exp=ppo_decoupled",
+            "env.num_envs=4",
+            f"metric.logger.root_dir={base}/dec/logs",
+            "checkpoint.every=128",
+            "algo.total_steps=1024",
+            "algo.rollout_steps=4",
+            "algo.num_players=2",
+            f"algo.decoupled_transport={args.transport}",
+            "algo.per_rank_batch_size=4",
+            "algo.update_epochs=1",
+            "algo.dense_units=8",
+            "algo.mlp_layers=1",
+            "algo.mlp_keys.encoder=[state]",
+            f"root_dir={base}/dec/run",
+        ],
+        f"{base}/dec",
+        decoupled=True,
+    )
+    if not args.keep:
+        import shutil
+
+        shutil.rmtree(base, ignore_errors=True)
+    if failures:
+        print("HEALTH CHAOS SOAK FAILED", file=sys.stderr)
+        return 1
+    print("health chaos soak passed")
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--mode",
+        default="topology",
+        choices=("topology", "health"),
+        help="topology: kill/rejoin soak (ISSUE 6); health: training sentinel proof (ISSUE 7)",
+    )
+    ap.add_argument(
+        "--fault",
+        default="nan_inject",
+        choices=("nan_inject", "loss_spike", "rb_corrupt"),
+        help="health mode: which update fault arms the sentinel's adversary",
+    )
     ap.add_argument("--players", type=int, default=4)
-    ap.add_argument("--transport", default="tcp", choices=("queue", "shm", "tcp"))
+    ap.add_argument(
+        "--transport",
+        default=None,
+        choices=("queue", "shm", "tcp"),
+        help="default: tcp for topology mode, queue for health mode",
+    )
     ap.add_argument("--kills", type=int, default=3)
     ap.add_argument("--net-drops", type=int, default=1)
     ap.add_argument("--net-delays", type=int, default=1)
@@ -129,6 +305,14 @@ def main(argv=None) -> int:
     ap.add_argument("--root-dir", default="/tmp/sheeprl_chaos_soak")
     ap.add_argument("--keep", action="store_true", help="keep the run dir for inspection")
     args = ap.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if args.mode == "health":
+        if args.root_dir == "/tmp/sheeprl_chaos_soak":
+            args.root_dir = "/tmp/sheeprl_chaos_health"
+        args.transport = args.transport or "queue"
+        return run_health_mode(args)
+    args.transport = args.transport or "tcp"
 
     rng = random.Random(args.seed)
     kill_entries, _ = build_kill_schedule(

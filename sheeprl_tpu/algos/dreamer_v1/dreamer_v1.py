@@ -33,6 +33,7 @@ from sheeprl_tpu.ops.dyn_bptt import dyn_bptt_setting, dyn_rssm_sequence_v1, ext
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
 from sheeprl_tpu.obs import setup_observability, trace_scope
 from sheeprl_tpu.resilience import CheckpointManager
+from sheeprl_tpu.resilience.sentinel import guard_update, restore_like
 from sheeprl_tpu.utils.callback import load_checkpoint, restore_buffer
 from sheeprl_tpu.utils.distribution import Bernoulli, Independent, Normal
 from sheeprl_tpu.utils.env import make_env
@@ -309,7 +310,8 @@ def make_train_fn(runtime, world_model, actor, critic, txs, cfg, is_continuous, 
         }
         return new_params, new_opt_states, metrics
 
-    return runtime.setup_step(train, donate_argnums=(0, 1))
+    # training health sentinel hook (resilience/sentinel.py)
+    return guard_update(runtime, train, cfg, n_state=2, donate_argnums=(0, 1))
 
 
 @register_algorithm()
@@ -446,6 +448,9 @@ def main(runtime, cfg: Dict[str, Any]):
     train_fn = make_train_fn(
         runtime, world_model, actor, critic, (wm_tx, actor_tx, critic_tx), cfg, is_continuous, actions_dim
     )
+    health = train_fn.health.bind(ckpt_mgr=ckpt_mgr, select=("agent", "opt_states"))
+    if health.enabled:
+        observability.health_stats = health.stats
 
     # initial zero-action buffer row (reference dreamer_v1.py:543-552)
     step_data: Dict[str, np.ndarray] = {}
@@ -554,6 +559,10 @@ def main(runtime, cfg: Dict[str, Any]):
                             )
                             cumulative_per_rank_gradient_steps += 1
                     train_step += world_size
+                rolled = health.tick()
+                if rolled is not None:
+                    params = restore_like(params, rolled["agent"])
+                    opt_states = restore_like(opt_states, rolled["opt_states"])
                 player.params = {"world_model": params["world_model"], "actor": params["actor"]}
                 if aggregator and not aggregator.disabled and metric_fetch_gate():
                     with trace_scope("block_until_ready"):

@@ -42,6 +42,7 @@ from sheeprl_tpu.data.buffers import (
 )
 from sheeprl_tpu.obs import setup_observability, trace_scope
 from sheeprl_tpu.resilience import CheckpointManager
+from sheeprl_tpu.resilience.sentinel import guard_update, restore_like
 from sheeprl_tpu.utils.callback import load_checkpoint, restore_buffer
 from sheeprl_tpu.utils.distribution import (
     Bernoulli,
@@ -395,7 +396,8 @@ def make_train_fn(runtime, world_model, actor, critic, txs, cfg, is_continuous, 
         }
         return new_params, new_opt_states, metrics
 
-    return runtime.setup_step(train, donate_argnums=(0, 1))
+    # training health sentinel hook (resilience/sentinel.py)
+    return guard_update(runtime, train, cfg, n_state=2, donate_argnums=(0, 1))
 
 
 @register_algorithm()
@@ -552,6 +554,9 @@ def main(runtime, cfg: Dict[str, Any]):
     train_fn = make_train_fn(
         runtime, world_model, actor, critic, (wm_tx, actor_tx, critic_tx), cfg, is_continuous, actions_dim
     )
+    health = train_fn.health.bind(ckpt_mgr=ckpt_mgr, select=("agent", "opt_states"))
+    if health.enabled:
+        observability.health_stats = health.stats
 
     @jax.jit
     def _hard_update(critic_params):
@@ -682,6 +687,10 @@ def main(runtime, cfg: Dict[str, Any]):
                             )
                             cumulative_per_rank_gradient_steps += 1
                     train_step += world_size
+                rolled = health.tick()
+                if rolled is not None:
+                    params = restore_like(params, rolled["agent"])
+                    opt_states = restore_like(opt_states, rolled["opt_states"])
                 player.params = {"world_model": params["world_model"], "actor": params["actor"]}
                 if aggregator and not aggregator.disabled and metric_fetch_gate():
                     with trace_scope("block_until_ready"):

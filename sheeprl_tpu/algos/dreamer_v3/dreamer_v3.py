@@ -52,6 +52,7 @@ from sheeprl_tpu.ops.dyn_bptt import (
 )
 from sheeprl_tpu.obs import setup_observability, trace_scope
 from sheeprl_tpu.resilience import CheckpointManager
+from sheeprl_tpu.resilience.sentinel import guard_update, restore_like
 from sheeprl_tpu.utils.callback import load_checkpoint, restore_buffer
 from sheeprl_tpu.utils.distribution import (
     BernoulliSafeMode,
@@ -622,7 +623,10 @@ def make_train_fn(runtime, world_model, actor, critic, txs, cfg, is_continuous, 
         }
         return new_params, new_opt_states, actor_aux["moments"], metrics
 
-    return runtime.setup_step(train, donate_argnums=(0, 1, 2))
+    # training health sentinel hook (resilience/sentinel.py); params,
+    # opt states AND the return-normalization moments are all predicated
+    # on the verdict
+    return guard_update(runtime, train, cfg, n_state=3, donate_argnums=(0, 1, 2))
 
 
 @register_algorithm()
@@ -789,6 +793,9 @@ def main(runtime, cfg: Dict[str, Any]):
     train_fn = make_train_fn(
         runtime, world_model, actor, critic, (wm_tx, actor_tx, critic_tx), cfg, is_continuous, actions_dim
     )
+    health = train_fn.health.bind(ckpt_mgr=ckpt_mgr, select=("agent", "opt_states", "moments"))
+    if health.enabled:
+        observability.health_stats = health.stats
 
     @jax.jit
     def _ema(critic_params, target_params, tau):
@@ -932,6 +939,11 @@ def main(runtime, cfg: Dict[str, Any]):
                         for batch in feed:
                             _grad_step(batch)
                     train_step += world_size
+                rolled = health.tick()
+                if rolled is not None:
+                    params = restore_like(params, rolled["agent"])
+                    opt_states = restore_like(opt_states, rolled["opt_states"])
+                    moments_state = restore_like(moments_state, rolled["moments"])
                 player.params = {"world_model": params["world_model"], "actor": params["actor"]}
                 # metric.fetch_every amortizes the per-iteration device
                 # sync of the losses dict on high-latency links (1 =

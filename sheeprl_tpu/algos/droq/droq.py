@@ -34,6 +34,7 @@ from sheeprl_tpu.data.device_buffer import maybe_create_for_transitions
 from sheeprl_tpu.obs import setup_observability, trace_scope
 from sheeprl_tpu.replay import per_beta_schedule, rate_limiter_from_cfg
 from sheeprl_tpu.resilience import CheckpointManager
+from sheeprl_tpu.resilience.sentinel import guard_update, restore_like
 from sheeprl_tpu.utils.callback import load_checkpoint, restore_buffer
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -134,13 +135,17 @@ def make_train_fn(
             "Loss/value_loss": qf_losses.mean(),
             "Loss/policy_loss": actor_loss,
             "Loss/alpha_loss": alpha_loss,
+            # actor+alpha grad norm (critic grads live inside the scan;
+            # its health is covered by the value loss + update norm)
+            "Grads/agent": optax.global_norm((actor_grads, alpha_grad)),
         }
         if prioritized:
             # (G, B) |TD| rides back for update_priorities — stays on device
             return new_params, new_opts, metrics, td_abs
         return new_params, new_opts, metrics
 
-    return runtime.setup_step(train, donate_argnums=(0, 1))
+    # training health sentinel hook (resilience/sentinel.py)
+    return guard_update(runtime, train, cfg, n_state=2, donate_argnums=(0, 1))
 
 
 @register_algorithm()
@@ -265,6 +270,9 @@ def main(runtime, cfg: Dict[str, Any]):
         runtime, actor, critic, (actor_tx, critic_tx, alpha_tx), cfg, target_entropy,
         prioritized=prioritized,
     )
+    health = train_fn.health.bind(ckpt_mgr=ckpt_mgr, select=("agent", "opt_states"))
+    if health.enabled:
+        observability.health_stats = health.stats
 
     step_data: Dict[str, np.ndarray] = {}
     obs = envs.reset(seed=cfg.seed)[0]
@@ -394,6 +402,10 @@ def main(runtime, cfg: Dict[str, Any]):
                         )
                 if sample_idx is not None:
                     device_cache.update_priorities(sample_idx, td_abs)
+                rolled = health.tick()
+                if rolled is not None:
+                    params = restore_like(params, rolled["agent"])
+                    opt_states = restore_like(opt_states, rolled["opt_states"])
                 player.params = params["actor"]
                 cumulative_per_rank_gradient_steps += g
                 train_step += world_size

@@ -37,6 +37,7 @@ from sheeprl_tpu.ops.dyn_bptt import dyn_bptt_setting, dyn_rssm_sequence_v1, ext
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
 from sheeprl_tpu.obs import setup_observability, trace_scope
 from sheeprl_tpu.resilience import CheckpointManager
+from sheeprl_tpu.resilience.sentinel import guard_update, restore_like
 from sheeprl_tpu.utils.callback import load_checkpoint, restore_buffer
 from sheeprl_tpu.utils.distribution import Bernoulli, Independent, Normal
 from sheeprl_tpu.utils.env import make_env
@@ -363,7 +364,8 @@ def make_train_fn(runtime, world_model, actor, critic, ensemble, txs, cfg, is_co
         }
         return new_params, new_opt_states, metrics
 
-    return runtime.setup_step(train, donate_argnums=(0, 1))
+    # training health sentinel hook (resilience/sentinel.py)
+    return guard_update(runtime, train, cfg, n_state=2, donate_argnums=(0, 1))
 
 
 @register_algorithm()
@@ -507,6 +509,13 @@ def main(runtime, cfg: Dict[str, Any]):
         is_continuous,
         actions_dim,
     )
+    # training health: params components are checkpointed under their own
+    # top-level keys (no "agent"), so the rollback select mirrors them
+    health = train_fn.health.bind(
+        ckpt_mgr=ckpt_mgr, select=tuple(params) + ("opt_states",)
+    )
+    if health.enabled:
+        observability.health_stats = health.stats
 
     # initial zero-action buffer row (reference p2e_dv1_exploration.py:520-530)
     step_data: Dict[str, np.ndarray] = {}
@@ -615,6 +624,10 @@ def main(runtime, cfg: Dict[str, Any]):
                             )
                             cumulative_per_rank_gradient_steps += 1
                     train_step += world_size
+                rolled = health.tick()
+                if rolled is not None:
+                    params = restore_like(params, {k: rolled[k] for k in params})
+                    opt_states = restore_like(opt_states, rolled["opt_states"])
                 player.params = {
                     "world_model": params["world_model"],
                     "actor": params["actor_exploration"],

@@ -29,6 +29,7 @@ from sheeprl_tpu.data.device_buffer import maybe_create_for, sequence_batches
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
 from sheeprl_tpu.obs import setup_observability, trace_scope
 from sheeprl_tpu.resilience import CheckpointManager
+from sheeprl_tpu.resilience.sentinel import guard_update, restore_like
 from sheeprl_tpu.utils.callback import load_checkpoint, restore_buffer
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -206,6 +207,12 @@ def main(runtime, cfg: Dict[str, Any]):
     train_fn = make_train_fn(
         runtime, world_model, actor, critic, (wm_tx, actor_tx, critic_tx), cfg, is_continuous, actions_dim
     )
+    health = train_fn.health.bind(
+        ckpt_mgr=ckpt_mgr,
+        select=("world_model", "actor_task", "critic_task", "opt_states"),
+    )
+    if health.enabled:
+        observability.health_stats = health.stats
 
     # initial zero-action buffer row
     step_data: Dict[str, np.ndarray] = {}
@@ -311,6 +318,15 @@ def main(runtime, cfg: Dict[str, Any]):
                             )
                             cumulative_per_rank_gradient_steps += 1
                     train_step += world_size
+                rolled = health.tick()
+                if rolled is not None:
+                    for k_live, k_ckpt in (
+                        ("world_model", "world_model"), ("actor", "actor_task"), ("critic", "critic_task")
+                    ):
+                        dv1_params[k_live] = restore_like(dv1_params[k_live], rolled[k_ckpt])
+                        opt_states[k_live] = restore_like(
+                            opt_states[k_live], rolled["opt_states"][k_ckpt]
+                        )
                 player.params = {
                     "world_model": dv1_params["world_model"],
                     "actor": dv1_params["actor"],

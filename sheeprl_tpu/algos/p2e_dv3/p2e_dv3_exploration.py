@@ -48,6 +48,7 @@ from sheeprl_tpu.ops.dyn_bptt import (
 )
 from sheeprl_tpu.obs import setup_observability, trace_scope
 from sheeprl_tpu.resilience import CheckpointManager
+from sheeprl_tpu.resilience.sentinel import guard_update, restore_like
 from sheeprl_tpu.utils.callback import load_checkpoint, restore_buffer
 from sheeprl_tpu.utils.distribution import (
     BernoulliSafeMode,
@@ -560,7 +561,9 @@ def make_train_fn(
                 metrics[f"Rewards/intrinsic_{name}"] = expl_aux["per_critic"][name]["reward_mean"]
         return new_params, new_opt_states, task_aux["moments"], expl_aux["moments"], metrics
 
-    return runtime.setup_step(train, donate_argnums=(0, 1, 2, 3))
+    # training health sentinel hook (resilience/sentinel.py); both
+    # moments states are predicated on the verdict alongside params/opt
+    return guard_update(runtime, train, cfg, n_state=4, donate_argnums=(0, 1, 2, 3))
 
 
 def expand_exploration_metric_keys(cfg, critics_cfg) -> None:
@@ -752,6 +755,13 @@ def main(runtime, cfg: Dict[str, Any]):
         is_continuous,
         actions_dim,
     )
+    # training health: params components are checkpointed under their own
+    # top-level keys (no "agent"), so the rollback select mirrors them
+    health = train_fn.health.bind(
+        ckpt_mgr=ckpt_mgr, select=tuple(params) + ("opt_states", "moments_task", "moments_exploration",)
+    )
+    if health.enabled:
+        observability.health_stats = health.stats
 
     @jax.jit
     def _ema(src, dst, tau):
@@ -895,6 +905,12 @@ def main(runtime, cfg: Dict[str, Any]):
                             )
                             cumulative_per_rank_gradient_steps += 1
                     train_step += world_size
+                rolled = health.tick()
+                if rolled is not None:
+                    params = restore_like(params, {k: rolled[k] for k in params})
+                    opt_states = restore_like(opt_states, rolled["opt_states"])
+                    moments_task = restore_like(moments_task, rolled["moments_task"])
+                    moments_expl = restore_like(moments_expl, rolled["moments_exploration"])
                 player.params = {
                     "world_model": params["world_model"],
                     "actor": params["actor_exploration"],

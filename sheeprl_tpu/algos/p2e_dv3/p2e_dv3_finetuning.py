@@ -29,6 +29,7 @@ from sheeprl_tpu.data.device_buffer import maybe_create_for, sequence_batches
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
 from sheeprl_tpu.obs import setup_observability, trace_scope
 from sheeprl_tpu.resilience import CheckpointManager
+from sheeprl_tpu.resilience.sentinel import guard_update, restore_like
 from sheeprl_tpu.utils.callback import load_checkpoint, restore_buffer
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -214,6 +215,12 @@ def main(runtime, cfg: Dict[str, Any]):
     train_fn = make_train_fn(
         runtime, world_model, actor, critic, (wm_tx, actor_tx, critic_tx), cfg, is_continuous, actions_dim
     )
+    health = train_fn.health.bind(
+        ckpt_mgr=ckpt_mgr,
+        select=("world_model", "actor_task", "critic_task", "opt_states", "moments_task"),
+    )
+    if health.enabled:
+        observability.health_stats = health.stats
 
     @jax.jit
     def _ema(critic_params, target_params, tau):
@@ -331,6 +338,16 @@ def main(runtime, cfg: Dict[str, Any]):
                             )
                             cumulative_per_rank_gradient_steps += 1
                     train_step += world_size
+                rolled = health.tick()
+                if rolled is not None:
+                    for k_live, k_ckpt in (
+                        ("world_model", "world_model"), ("actor", "actor_task"), ("critic", "critic_task")
+                    ):
+                        dv3_params[k_live] = restore_like(dv3_params[k_live], rolled[k_ckpt])
+                        opt_states[k_live] = restore_like(
+                            opt_states[k_live], rolled["opt_states"][k_ckpt]
+                        )
+                    moments_state = restore_like(moments_state, rolled["moments_task"])
                 player.params = {
                     "world_model": dv3_params["world_model"],
                     "actor": dv3_params["actor"],

@@ -41,6 +41,7 @@ from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.obs import setup_observability, trace_scope
 from sheeprl_tpu.parallel.pipeline import OnPolicyCollector, PipelinedCollector, detach_copy, resolve_overlap_setting
 from sheeprl_tpu.resilience import CheckpointManager
+from sheeprl_tpu.resilience.sentinel import guard_update, restore_like
 from sheeprl_tpu.utils.callback import load_checkpoint
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -244,7 +245,9 @@ def make_update_fn(
                 grads, losses = grad_fn(params, mb)
                 # DDP gradient all-reduce (+ averaged losses for logging)
                 grads = jax.lax.pmean(grads, "data")
-                losses = jax.lax.pmean(losses, "data")
+                losses = jnp.concatenate(
+                    [jax.lax.pmean(losses, "data"), optax.global_norm(grads)[None]]
+                )
                 updates, opt_state = tx.update(grads, opt_state, params)
                 params = optax.apply_updates(params, updates)
                 return (params, opt_state), losses
@@ -277,6 +280,7 @@ def make_update_fn(
                 "Loss/policy_loss": mean_losses[0],
                 "Loss/value_loss": mean_losses[1],
                 "Loss/entropy_loss": mean_losses[2],
+                "Grads/agent": mean_losses[3],
             }
             return params, opt_state, metrics
 
@@ -324,6 +328,9 @@ def make_update_fn(
         def mb_step(carry, mb):
             params, opt_state = carry
             grads, losses = grad_fn(params, mb)
+            # pre-clip global grad norm rides the metrics for telemetry and
+            # the training sentinel's z-score monitor
+            losses = jnp.concatenate([losses, optax.global_norm(grads)[None]])
             updates, opt_state = tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             return (params, opt_state), losses
@@ -354,10 +361,14 @@ def make_update_fn(
             "Loss/policy_loss": mean_losses[0],
             "Loss/value_loss": mean_losses[1],
             "Loss/entropy_loss": mean_losses[2],
+            "Grads/agent": mean_losses[3],
         }
         return params, opt_state, metrics
 
-    return runtime.setup_step(update, donate_argnums=(0, 1))
+    # training health sentinel (resilience/sentinel.py): the shared hook
+    # every update builder routes through — off (default) returns the
+    # plain jitted step untouched
+    return guard_update(runtime, update, cfg, n_state=2, donate_argnums=(0, 1))
 
 
 def _set_lr(opt_state, lr):
@@ -509,6 +520,12 @@ def main(runtime, cfg: Dict[str, Any]):
         runtime, cfg, log_dir, observability=observability, last_checkpoint=last_checkpoint
     )
     update_fn = make_update_fn(runtime, module, tx, cfg, obs_keys)
+    # training health: anomalous updates are skipped inside the jitted
+    # step; a tripped skip budget rolls params/optimizer back to the last
+    # good checkpoint (howto/resilience.md "Training health & rollback")
+    health = update_fn.health.bind(ckpt_mgr=ckpt_mgr, select=("agent", "optimizer"))
+    if health.enabled:
+        observability.health_stats = health.stats
 
     lr0 = float(cfg.algo.optimizer.get("learning_rate", cfg.algo.optimizer.get("lr", 1e-3)))
     current_lr = lr0
@@ -592,6 +609,11 @@ def main(runtime, cfg: Dict[str, Any]):
             )
         pipeline.publish(iter_num, params)
         train_step += world_size
+
+        rolled = health.tick()
+        if rolled is not None:
+            params = restore_like(params, rolled["agent"])
+            opt_state = restore_like(opt_state, rolled["optimizer"])
 
         if aggregator and not aggregator.disabled and metric_fetch_gate():
             # materializing metrics blocks on the update; only pay that

@@ -68,6 +68,7 @@ from sheeprl_tpu.resilience import (
     child_alive,
     hard_exit_point,
     parent_alive,
+    restore_like,
 )
 from sheeprl_tpu.utils.callback import load_checkpoint
 from sheeprl_tpu.utils.env import make_env
@@ -270,6 +271,7 @@ def _player_loop(
     latest_transport_stats = None
     latest_train_metrics: Dict[str, Any] = {}
     latest_opt_np = None
+    lead_health = None  # lead-side checkpoint health tagger (bound below)
     aggregator = None
     if not MetricAggregator.disabled:
         aggregator = instantiate(dict(cfg.metric.aggregator))
@@ -290,6 +292,10 @@ def _player_loop(
         latest_info_scalars = dict(info_scalars or {})
         if transport_stats is not None:
             latest_transport_stats = transport_stats
+            if lead_health is not None:
+                # the trainer's sentinel verdicts ride the broadcast; fold
+                # them into the lead's good/quarantine checkpoint tagging
+                lead_health.apply_remote(transport_stats.get("health"))
         train_time_window += latest_info_scalars.pop("train_time", 0.0)
         trainer_compiles = latest_info_scalars.pop("trainer_compiles", trainer_compiles)
         if aggregator and not aggregator.disabled:
@@ -388,6 +394,14 @@ def _player_loop(
         if lead
         else None
     )
+    if lead:
+        from sheeprl_tpu.resilience.sentinel import TrainHealth, sentinel_setting
+
+        lead_health = TrainHealth(runtime, sentinel_setting(cfg)).bind(ckpt_mgr=ckpt_mgr)
+        if lead_health.enabled:
+            observability.health_stats = lead_health.stats
+        else:
+            lead_health = None
     preemption = None if lead else PreemptionHandler().install()
     total_iters = cfg.algo.total_steps // policy_steps_per_iter if not cfg.dry_run else 1
     if lead and cfg.metric.log_level > 0 and cfg.metric.log_every % policy_steps_per_iter != 0:
@@ -796,6 +810,12 @@ def main(runtime, cfg: Dict[str, Any]):
             else restore_opt_states(state["optimizer"], params, runtime.precision)
         )
         update_fn = make_update_fn(runtime, module, tx, cfg, obs_keys)
+        # training health: the trainer owns the verdicts; the checkpoint
+        # FILES live with the lead player, so rollback scans the run root
+        # for the last good-tagged checkpoint (sidecar written by the lead)
+        health = update_fn.health.bind(
+            scan_root=str(cfg.root_dir), select=("agent", "optimizer")
+        )
 
         # trainer-side recompile watch: the jitted update lives in THIS
         # process, so its retraces are invisible to the lead's telemetry
@@ -921,6 +941,16 @@ def main(runtime, cfg: Dict[str, Any]):
                 )
                 train_metrics = device_get_metrics(train_metrics)
 
+            rolled = health.tick()
+            if rolled is not None:
+                # rollback-to-last-good: restore, then the normal params
+                # broadcast below ships the restored weights — every
+                # player re-adopts through its ParamsFollower with no
+                # special protocol round
+                params = restore_like(params, rolled["agent"])
+                opt_state = restore_like(opt_state, rolled["optimizer"])
+                fanin.note_rollback(iter_num)
+
             info_scalars = {
                 "Info/learning_rate": current_lr,
                 "Info/clip_coef": current_clip,
@@ -953,6 +983,8 @@ def main(runtime, cfg: Dict[str, Any]):
             stats["events"] = fanin.events[-8:]
             if supervisor is not None:
                 stats["supervisor"] = supervisor.stats()
+            if health.enabled:
+                stats["health"] = health.stats()
             fanin.broadcast(
                 "params",
                 arrays=_flat_leaves(_np_tree(params)),

@@ -40,6 +40,7 @@ from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.obs import setup_observability, trace_scope
 from sheeprl_tpu.parallel.pipeline import OnPolicyCollector, PipelinedCollector, RolloutPayload, detach_copy, resolve_overlap_setting
 from sheeprl_tpu.resilience import CheckpointManager
+from sheeprl_tpu.resilience.sentinel import guard_update, restore_like
 from sheeprl_tpu.utils.callback import load_checkpoint
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -198,7 +199,8 @@ def make_update_fn(runtime, module, tx, cfg: Dict[str, Any], obs_keys: Sequence[
             )(params, opt_state, data, next_values, key, clip_coef, ent_coef)
         return _core(params, opt_state, data, next_values, key, clip_coef, ent_coef, None)
 
-    return runtime.setup_step(update, donate_argnums=(0, 1))
+    # training health sentinel hook (resilience/sentinel.py)
+    return guard_update(runtime, update, cfg, n_state=2, donate_argnums=(0, 1))
 
 
 class RecurrentCollector(OnPolicyCollector):
@@ -435,6 +437,9 @@ def main(runtime, cfg: Dict[str, Any]):
         runtime, cfg, log_dir, observability=observability, last_checkpoint=last_checkpoint
     )
     update_fn = make_update_fn(runtime, module, tx, cfg, obs_keys)
+    health = update_fn.health.bind(ckpt_mgr=ckpt_mgr, select=("agent", "optimizer"))
+    if health.enabled:
+        observability.health_stats = health.stats
 
     lr0 = float(cfg.algo.optimizer.get("learning_rate", cfg.algo.optimizer.get("lr", 1e-3)))
     current_lr = lr0
@@ -515,6 +520,11 @@ def main(runtime, cfg: Dict[str, Any]):
             )
         pipeline.publish(iter_num, params)
         train_step += world_size
+
+        rolled = health.tick()
+        if rolled is not None:
+            params = restore_like(params, rolled["agent"])
+            opt_state = restore_like(opt_state, rolled["optimizer"])
 
         if aggregator and not aggregator.disabled and metric_fetch_gate():
             with trace_scope("block_until_ready"):

@@ -45,6 +45,7 @@ from sheeprl_tpu.data.device_buffer import maybe_create_for_transitions
 from sheeprl_tpu.obs import setup_observability, trace_scope
 from sheeprl_tpu.replay import per_beta_schedule, rate_limiter_from_cfg
 from sheeprl_tpu.resilience import CheckpointManager
+from sheeprl_tpu.resilience.sentinel import guard_update, restore_like
 from sheeprl_tpu.utils.callback import load_checkpoint, restore_buffer
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -154,7 +155,10 @@ def make_train_fn(
                 "log_alpha": new_log_alpha,
             }
             new_opt_states = {"actor": new_actor_opt, "critic": new_critic_opt, "alpha": new_alpha_opt}
-            losses = jnp.stack([qf_loss, actor_loss, alpha_loss])
+            # pre-clip global grad norm (all components): telemetry + the
+            # training sentinel's z-score monitor
+            grad_norm = optax.global_norm((qf_grads, actor_grads, alpha_grad))
+            losses = jnp.stack([qf_loss, actor_loss, alpha_loss, grad_norm])
             ys = (losses, td_abs) if prioritized else losses
             return (new_params, new_opt_states), ys
 
@@ -169,13 +173,15 @@ def make_train_fn(
             "Loss/value_loss": mean_losses[0],
             "Loss/policy_loss": mean_losses[1],
             "Loss/alpha_loss": mean_losses[2],
+            "Grads/agent": mean_losses[3],
         }
         if prioritized:
             # (G, B) |TD| rides back for update_priorities — stays on device
             return params, opt_states, metrics, td_abs
         return params, opt_states, metrics
 
-    return runtime.setup_step(train, donate_argnums=(0, 1))
+    # training health sentinel hook (resilience/sentinel.py)
+    return guard_update(runtime, train, cfg, n_state=2, donate_argnums=(0, 1))
 
 
 @register_algorithm()
@@ -325,6 +331,12 @@ def main(runtime, cfg: Dict[str, Any]):
         runtime, actor, critic, (actor_tx, critic_tx, alpha_tx), cfg, target_entropy,
         prioritized=prioritized,
     )
+    # training health: anomalous gradient dispatches are skipped inside
+    # the jitted scan; a tripped skip budget rolls agent+optimizer back to
+    # the last good checkpoint and re-seeds the update key stream
+    health = train_fn.health.bind(ckpt_mgr=ckpt_mgr, select=("agent", "opt_states"))
+    if health.enabled:
+        observability.health_stats = health.stats
     ema_every = cfg.algo.critic.target_network_frequency // policy_steps_per_iter + 1
 
     step_data: Dict[str, np.ndarray] = {}
@@ -513,6 +525,10 @@ def main(runtime, cfg: Dict[str, Any]):
                     # priority feedback: |TD| of every gradient step lands
                     # back in the tree — one device dispatch, no host sync
                     device_cache.update_priorities(sample_idx, td_abs)
+                rolled = health.tick()
+                if rolled is not None:
+                    params = restore_like(params, rolled["agent"])
+                    opt_states = restore_like(opt_states, rolled["opt_states"])
                 player.params = params["actor"]
                 cumulative_per_rank_gradient_steps += g
                 train_step += world_size * iters_in_window

@@ -69,6 +69,7 @@ from sheeprl_tpu.resilience import (
     PreemptionHandler,
     hard_exit_point,
     parent_alive,
+    restore_like,
 )
 from sheeprl_tpu.utils.callback import load_checkpoint, restore_buffer
 from sheeprl_tpu.utils.env import make_env
@@ -173,6 +174,7 @@ def _player_loop(
     train_time_window = 0.0
     trainer_compiles = None  # trainer-side XLA compile count (rides the params frames)
     latest_transport_stats = None
+    lead_health = None  # lead-side checkpoint health tagger (bound below)
     aggregator = None
     if not MetricAggregator.disabled:
         aggregator = instantiate(dict(cfg.metric.aggregator))
@@ -187,6 +189,8 @@ def _player_loop(
         metrics = dict(train_metrics or {})
         if transport_stats is not None:
             latest_transport_stats = transport_stats
+            if lead_health is not None:
+                lead_health.apply_remote(transport_stats.get("health"))
         train_time_window += metrics.pop("train_time", 0.0)
         trainer_compiles = metrics.pop("trainer_compiles", trainer_compiles)
         if aggregator and not aggregator.disabled:
@@ -286,6 +290,14 @@ def _player_loop(
         if lead
         else None
     )
+    if lead:
+        from sheeprl_tpu.resilience.sentinel import TrainHealth, sentinel_setting
+
+        lead_health = TrainHealth(runtime, sentinel_setting(cfg)).bind(ckpt_mgr=ckpt_mgr)
+        if lead_health.enabled:
+            observability.health_stats = lead_health.stats
+        else:
+            lead_health = None
     preemption = None if lead else PreemptionHandler().install()
     policy_steps_per_iter = int(total_envs)
     total_iters = int(cfg.algo.total_steps // policy_steps_per_iter) if not cfg.dry_run else 1
@@ -576,6 +588,7 @@ def _player_loop_remote(
     train_time_window = 0.0
     trainer_compiles = None
     latest_replay_stats = None
+    lead_health = None  # lead-side checkpoint health tagger (bound below)
     current_params_seq = -1
     aggregator = None
     if not MetricAggregator.disabled:
@@ -593,6 +606,8 @@ def _player_loop_remote(
         metrics = dict(train_metrics or {})
         if replay_stats is not None:
             latest_replay_stats = replay_stats
+            if lead_health is not None:
+                lead_health.apply_remote(replay_stats.get("health"))
         train_time_window += metrics.pop("train_time", 0.0)
         trainer_compiles = metrics.pop("trainer_compiles", trainer_compiles)
         if aggregator and not aggregator.disabled:
@@ -669,6 +684,14 @@ def _player_loop_remote(
         if lead
         else None
     )
+    if lead:
+        from sheeprl_tpu.resilience.sentinel import TrainHealth, sentinel_setting
+
+        lead_health = TrainHealth(runtime, sentinel_setting(cfg)).bind(ckpt_mgr=ckpt_mgr)
+        if lead_health.enabled:
+            observability.health_stats = lead_health.stats
+        else:
+            lead_health = None
     preemption = None if lead else PreemptionHandler().install()
     if lead:
         save_configs(cfg, log_dir)
@@ -968,6 +991,12 @@ def main(runtime, cfg: Dict[str, Any]):
         train_fn = make_train_fn(
             runtime, actor, critic, (actor_tx, critic_tx, alpha_tx), cfg, target_entropy
         )
+        # training health: verdicts live here; the lead player owns the
+        # checkpoint files, so rollback scans the run root for the last
+        # good-tagged checkpoint
+        health = train_fn.health.bind(
+            scan_root=str(cfg.root_dir), select=("agent", "opt_states")
+        )
         ema_every = cfg.algo.critic.target_network_frequency // int(cfg.env.num_envs) + 1
 
         # trainer-side recompile watch — see ppo_decoupled: the jitted
@@ -1043,6 +1072,13 @@ def main(runtime, cfg: Dict[str, Any]):
                     jnp.full((data["rewards"].shape[0],), iter_num % ema_every == 0),
                 )
                 train_metrics = device_get_metrics(train_metrics)
+            rolled = health.tick()
+            if rolled is not None:
+                # rollback-to-last-good; the broadcast below ships the
+                # restored actor so every player re-adopts immediately
+                params = restore_like(params, rolled["agent"])
+                opt_states = restore_like(opt_states, rolled["opt_states"])
+                fanin.note_rollback(seq)
             if not timer.disabled:
                 train_metrics["train_time"] = float(timer.compute().get("Time/train_time", 0.0))
                 timer.reset()
@@ -1051,6 +1087,8 @@ def main(runtime, cfg: Dict[str, Any]):
 
             stats = fanin.stats(knobs["backend"])
             stats["events"] = fanin.events[-8:]
+            if health.enabled:
+                stats["health"] = health.stats()
             fanin.broadcast(
                 "params",
                 arrays=_flat_leaves(_np_tree(params["actor"])),
@@ -1162,6 +1200,9 @@ def _main_remote(runtime, cfg: Dict[str, Any], knobs, state, counters, ratio_sta
         train_fn = make_train_fn(
             runtime, actor, critic, (actor_tx, critic_tx, alpha_tx), cfg, target_entropy,
             prioritized=prioritized,
+        )
+        health = train_fn.health.bind(
+            scan_root=str(cfg.root_dir), select=("agent", "opt_states")
         )
         total_envs = int(cfg.env.num_envs)
         ema_every = cfg.algo.critic.target_network_frequency // total_envs + 1
@@ -1339,6 +1380,15 @@ def _main_remote(runtime, cfg: Dict[str, Any], knobs, state, counters, ratio_sta
                 train_metrics = device_get_metrics(train_metrics)
             if sample_idx is not None:
                 server.update_priorities(sample_idx, td_abs)
+            rolled = health.tick()
+            if rolled is not None:
+                params = restore_like(params, rolled["agent"])
+                opt_states = restore_like(opt_states, rolled["opt_states"])
+                # the anomalous window's inserts are suspect: de-prioritize
+                # everything written since the last verdict-clean horizon
+                server.quarantine_recent()
+            elif health.enabled and health.last_ok:
+                server.mark_health_horizon()
             pending_g -= g
             if not timer.disabled:
                 train_metrics["train_time"] = float(timer.compute().get("Time/train_time", 0.0))
@@ -1350,6 +1400,8 @@ def _main_remote(runtime, cfg: Dict[str, Any], knobs, state, counters, ratio_sta
             stats = server.stats()
             stats["beta"] = round(beta_fn(clock), 4)
             stats["events"] = server.events[-8:]
+            if health.enabled:
+                stats["health"] = health.stats()
             if supervisor is not None:
                 stats["supervisor"] = supervisor.stats()
             _broadcast_params(

@@ -28,6 +28,7 @@ from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.data.device_buffer import maybe_create_for_transitions
 from sheeprl_tpu.obs import setup_observability, trace_scope
 from sheeprl_tpu.resilience import CheckpointManager
+from sheeprl_tpu.resilience.sentinel import guard_update, restore_like
 from sheeprl_tpu.utils.callback import load_checkpoint, restore_buffer
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -228,7 +229,8 @@ def make_train_fn(runtime, modules, txs, cfg: Dict[str, Any], target_entropy: fl
         }
         return params, opt_states, metrics
 
-    return runtime.setup_step(train, donate_argnums=(0, 1))
+    # training health sentinel hook (resilience/sentinel.py)
+    return guard_update(runtime, train, cfg, n_state=2, donate_argnums=(0, 1))
 
 
 @register_algorithm()
@@ -360,6 +362,9 @@ def main(runtime, cfg: Dict[str, Any]):
     train_fn = make_train_fn(
         runtime, modules, (critic_tx, actor_tx, alpha_tx, encoder_tx, decoder_tx), cfg, target_entropy
     )
+    health = train_fn.health.bind(ckpt_mgr=ckpt_mgr, select=("agent", "opt_states"))
+    if health.enabled:
+        observability.health_stats = health.stats
 
     step_data: Dict[str, np.ndarray] = {}
     obs = envs.reset(seed=cfg.seed)[0]
@@ -452,6 +457,10 @@ def main(runtime, cfg: Dict[str, Any]):
                         runtime.next_key(),
                         jnp.asarray(cumulative_per_rank_gradient_steps),
                     )
+                rolled = health.tick()
+                if rolled is not None:
+                    params = restore_like(params, rolled["agent"])
+                    opt_states = restore_like(opt_states, rolled["opt_states"])
                 player.params = {"encoder": params["critic"]["encoder"], "actor": params["actor"]}
                 cumulative_per_rank_gradient_steps += g
                 train_step += world_size

@@ -213,7 +213,20 @@ class ReplayBuffer:
                 )
             batch_idxes = self._rng.integers(0, max_pos, size=(batch_size * n_samples,), dtype=np.intp)
         out = self._get_samples(batch_idxes, sample_next_obs=sample_next_obs, clone=clone)
-        return {k: v.reshape(n_samples, batch_size, *v.shape[1:]) for k, v in out.items()}
+        out = {k: v.reshape(n_samples, batch_size, *v.shape[1:]) for k, v in out.items()}
+        # fault site (resilience/faults.py): scribble this replay batch
+        # with garbage — silent data corruption reaching the learner, the
+        # adversary the training sentinel's z-score monitor must catch
+        from sheeprl_tpu.resilience.faults import fault_arg, fault_point
+
+        if fault_point("rb_corrupt"):
+            scale = fault_arg("rb_corrupt") or 1e8
+            for k, v in out.items():
+                if v.dtype.kind == "f":
+                    # copy first: the views may alias the live buffer
+                    noise = self._rng.standard_normal(v.shape).astype(v.dtype)
+                    out[k] = np.asarray(noise * v.dtype.type(scale))
+        return out
 
     def _get_samples(
         self, batch_idxes: np.ndarray, sample_next_obs: bool = False, clone: bool = False

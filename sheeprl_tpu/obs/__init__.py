@@ -82,6 +82,11 @@ class Observability:
         # CheckpointManager (resilience/manager.py) attaches itself here so
         # every telemetry record carries a "ckpt" section
         self.ckpt_stats: Optional[Any] = None
+        # zero-arg provider of training-health stats; the sentinel's
+        # TrainHealth (resilience/sentinel.py) attaches itself here so the
+        # records carry a "health" section (verdicts, skip/rollback
+        # counters, z-scores)
+        self.health_stats: Optional[Any] = None
         if not self.enabled:
             return
         self._world_size = max(1, int(world_size))
@@ -129,6 +134,11 @@ class Observability:
         if self.ckpt_stats is not None:
             try:
                 extra = {**(extra or {}), "ckpt": self.ckpt_stats()}
+            except Exception:
+                pass
+        if self.health_stats is not None:
+            try:
+                extra = {**(extra or {}), "health": self.health_stats()}
             except Exception:
                 pass
         record = make_record(
@@ -181,6 +191,11 @@ class Observability:
                     scalars[f"{name}_{q}"] = pct[q]
         if scalars:
             self._logger.log_metrics(scalars, step)
+
+    def flush(self) -> None:
+        """fsync buffered telemetry lines (preemption/emergency paths)."""
+        if self.enabled and self.sink is not None:
+            self.sink.flush()
 
     def close(self) -> None:
         if not self.enabled:

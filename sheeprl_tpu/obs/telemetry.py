@@ -112,6 +112,19 @@ class TelemetrySink:
         self._file = None
         self._open()
 
+    def flush(self) -> None:
+        """Crash-safe flush: push buffered lines through the kernel to
+        disk (``fsync``).  Called on the preemption/emergency-checkpoint
+        paths so a post-mortem never loses the tail records — the ones
+        that explain the crash."""
+        if self._file is None:
+            return
+        try:
+            self._file.flush()
+            os.fsync(self._file.fileno())
+        except (OSError, ValueError):
+            pass
+
     def close(self) -> None:
         if self._file is not None:
             self._file.close()

@@ -249,9 +249,18 @@ class MeshRuntime:
         random.seed(seed)
         np.random.seed(seed)
         os.environ["PYTHONHASHSEED"] = str(seed)
+        self._seed = int(seed)
         self._key = jax.random.PRNGKey(seed)
         self._np_key_rng = np.random.Generator(np.random.PCG64(seed))
         return self._key
+
+    def reseed_key_stream(self, salt: int) -> None:
+        """Re-derive the host key stream deterministically from the run
+        seed and ``salt`` (the sentinel's rollback ordinal): after a
+        rollback-to-last-good, replaying the exact keys would re-draw the
+        same sample indices/noise that fed the anomaly."""
+        base = int(getattr(self, "_seed", 0) or 0)
+        self._np_key_rng = np.random.Generator(np.random.PCG64([base, 0x5E47, int(salt)]))
 
     def next_key(self, num: int = 1):
         """Fresh independent PRNG keys for the host-side loop (jitted code

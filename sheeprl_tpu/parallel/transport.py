@@ -1181,6 +1181,7 @@ class FanIn:
         self.joining: Dict[int, float] = {}  # pid -> join start (monotonic)
         self.events: List[Dict[str, Any]] = []  # shrink/grow log (rides telemetry)
         self.rejoins = 0
+        self.rollbacks = 0  # sentinel rollback-to-last-good broadcast rounds
         self.last_seen: Dict[int, float] = {}  # any-frame liveness (heartbeats)
         self.lag_hist: Dict[int, int] = {}  # behavior-policy lag -> rounds seen
         self._lag_by_pid: Dict[int, int] = {}
@@ -1401,6 +1402,16 @@ class FanIn:
                 self.mark_dead(pid, f"broadcast failed: {e}")
         self._require_live()
 
+    def note_rollback(self, round_seq: int) -> None:
+        """Record a training-sentinel rollback: the next broadcast of this
+        round ships the RESTORED params, and every live player re-adopts
+        them through its ParamsFollower — no special protocol round, but
+        the event must be visible in the transport telemetry."""
+        self.rollbacks += 1
+        self.events.append(
+            {"event": "rollback", "round": round_seq, "rollbacks": self.rollbacks}
+        )
+
     def send_to(self, pid: int, tag: str, arrays=None, extra=(), seq=-1, timeout: float = 600.0) -> None:
         try:
             self.channels[pid].send(tag, arrays=arrays, extra=extra, seq=seq, timeout=timeout)
@@ -1441,6 +1452,7 @@ class FanIn:
             "joining": len(self.joining),
             "deaths": len(self.dead),
             "rejoins": self.rejoins,
+            "rollbacks": self.rollbacks,
             "lag_hist": {str(k): v for k, v in sorted(self.lag_hist.items())},
             "bytes_per_s": round(bytes_total / elapsed, 1),
             "fan_in_depth": sum(

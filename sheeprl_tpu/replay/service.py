@@ -216,6 +216,11 @@ class ReplayServer:
         self.total_inserts = 0  # transitions (the trainer's policy-step clock)
         self.inserts_by_player = {pid: 0 for pid in self.channels}
         self.credit_stall_players = 0  # grant attempts refused by the limiter
+        # training-sentinel quarantine bookkeeping: ring rows written per
+        # env since the last verdict-clean horizon (mark_health_horizon)
+        self._rows_since_mark = np.zeros(total_envs, dtype=np.int64)
+        self.quarantines = 0
+        self.quarantined_rows = 0
 
     # ------------------------------------------------------------ liveness
     @property
@@ -316,6 +321,21 @@ class ReplayServer:
         arrays = frame.arrays_copy()  # transport buffers go back on release
         frame.release()
         t_len = next(iter(arrays.values())).shape[0]
+        # fault site (resilience/faults.py): a poisoned replay batch
+        # entering the service — scribble this insert frame's payload
+        from sheeprl_tpu.resilience.faults import fault_arg, fault_point
+
+        if fault_point("rb_corrupt"):
+            scale = fault_arg("rb_corrupt") or 1e8
+            arrays = {
+                k: (
+                    np.random.default_rng(0).standard_normal(v.shape).astype(v.dtype)
+                    * v.dtype.type(scale)
+                    if v.dtype.kind == "f"
+                    else v
+                )
+                for k, v in arrays.items()
+            }
         indices = list(range(offset, offset + count))
         self.rb.add(arrays, indices=indices)
         if self.cache is not None:
@@ -323,6 +343,7 @@ class ReplayServer:
         n = t_len * count
         self.total_inserts += n
         self.inserts_by_player[pid] += n
+        self._rows_since_mark[offset : offset + count] += t_len
         if self.limiter is not None:
             self.limiter.insert(n)
         self._outstanding[pid] = max(0, self._outstanding[pid] - 1)
@@ -401,6 +422,50 @@ class ReplayServer:
         if self.cache is not None and idx is not None:
             self.cache.update_priorities(idx, td_abs)
 
+    # ------------------------------------------------------- health hooks
+    def mark_health_horizon(self) -> None:
+        """Sentinel hook: the latest update dispatched on this buffer was
+        verdict-clean, so everything written up to now is trusted — resets
+        the quarantine window."""
+        self._rows_since_mark[:] = 0
+
+    def quarantine_recent(self) -> int:
+        """Rollback hook: the inserts newer than the last verdict-clean
+        horizon are suspect (they fed — or were concurrent with — the
+        anomalous updates).  On the prioritized path their sum-tree
+        priorities drop to the epsilon floor, so the sampler effectively
+        never draws them again (the ring overwrites them in time).  The
+        uniform path has no per-row mask — the event is still recorded so
+        the telemetry shows the exposure.  Returns rows quarantined."""
+        rows = 0
+        if self.cache is not None and getattr(self.cache, "_tree", None) is not None:
+            import jax.numpy as jnp
+
+            n_envs = self.total_envs
+            cap = self.cache.capacity
+            idx_list = []
+            for env in range(n_envs):
+                r = int(min(self._rows_since_mark[env], cap))
+                if r <= 0:
+                    continue
+                pos = int(self.cache._pos[env])
+                recent = (pos - 1 - np.arange(r)) % cap
+                idx_list.append(recent * n_envs + env)
+                rows += r
+            if idx_list:
+                idx = np.concatenate(idx_list)
+                # |TD| = 0 -> priority (0 + eps)^alpha: the floor
+                self.cache.update_priorities(jnp.asarray(idx), jnp.zeros(len(idx), jnp.float32))
+        else:
+            rows = int(self._rows_since_mark.sum())
+        self.quarantines += 1
+        self.quarantined_rows += rows
+        self._rows_since_mark[:] = 0
+        self.events.append(
+            {"event": "replay_quarantine", "rows": rows, "prioritized": self.prioritized}
+        )
+        return rows
+
     # --------------------------------------------------------- checkpoint
     def state_dict(self) -> Dict[str, Any]:
         """Tree + limiter + clock (plain numpy/dicts).  The buffer itself
@@ -452,6 +517,8 @@ class ReplayServer:
             "deaths": len(self.dead),
             "rejoins": self.rejoins,
             "credit_grant_stalls": self.credit_stall_players,
+            "quarantines": self.quarantines,
+            "quarantined_rows": self.quarantined_rows,
         }
         if self.limiter is not None:
             rec["limiter"] = self.limiter.stats()

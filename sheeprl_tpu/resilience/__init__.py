@@ -33,7 +33,17 @@ from sheeprl_tpu.resilience.faults import (
     hard_exit_point,
     maybe_drop_or_delay_send,
 )
-from sheeprl_tpu.resilience.manager import CheckpointManager
+from sheeprl_tpu.resilience.manager import CheckpointManager, NonFiniteCheckpointError
+from sheeprl_tpu.resilience.sentinel import (
+    CheckpointHealthTags,
+    GuardedUpdate,
+    TrainHealth,
+    TrainingDivergedError,
+    find_last_good,
+    guard_update,
+    restore_like,
+    sentinel_setting,
+)
 from sheeprl_tpu.resilience.peer import (
     PeerDiedError,
     child_alive,
@@ -49,8 +59,17 @@ from sheeprl_tpu.resilience.supervisor import (
 
 __all__ = [
     "AsyncCheckpointWriter",
+    "CheckpointHealthTags",
     "CheckpointManager",
     "FaultInjector",
+    "GuardedUpdate",
+    "NonFiniteCheckpointError",
+    "TrainHealth",
+    "TrainingDivergedError",
+    "find_last_good",
+    "guard_update",
+    "restore_like",
+    "sentinel_setting",
     "PeerDiedError",
     "PlayerSupervisor",
     "PreemptionHandler",

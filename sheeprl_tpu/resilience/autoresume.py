@@ -40,11 +40,18 @@ def list_checkpoints(scan_root: str) -> List[str]:
 
 
 def find_latest_resumable(scan_root: str) -> Optional[str]:
-    """Newest checkpoint under ``scan_root`` that validates; corrupt ones
-    are skipped with a warning. None when nothing usable exists."""
+    """Newest checkpoint under ``scan_root`` that validates; corrupt,
+    non-finite (poisoned params — see ``spot_check_finite``) and
+    sentinel-quarantined ones are skipped with a warning. None when
+    nothing usable exists."""
+    from sheeprl_tpu.resilience.sentinel import is_quarantined
+
     for ckpt in list_checkpoints(scan_root):
+        if is_quarantined(ckpt):
+            warnings.warn(f"auto-resume: skipping quarantined checkpoint {ckpt}")
+            continue
         try:
-            validate_checkpoint(ckpt)
+            validate_checkpoint(ckpt, check_finite=True)
             return ckpt
         except CheckpointCorruptError as e:
             warnings.warn(f"auto-resume: skipping corrupt checkpoint ({e})")

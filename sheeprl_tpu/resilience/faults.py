@@ -40,6 +40,19 @@ Instrumented sites:
                             hard-exits between two pump rounds (models the
                             whole buffer dying with the learner; players must
                             surface a clear error + emergency dump, not hang)
+``nan_inject``              the training sentinel's adversary: starting at the
+                            N-th update dispatch, ``arg`` (default 1)
+                            CONSECUTIVE dispatches consume a NaN-poisoned
+                            batch, so the produced grads/params are non-finite
+                            (fires in ``GuardedUpdate``, resilience/sentinel.py;
+                            ``nan_inject:8:3`` trips a skip_budget of 3)
+``loss_spike``              like ``nan_inject`` but finite: float batch leaves
+                            are scaled by ``arg`` (default 1e4), producing a
+                            loss/grad spike the z-score monitor must flag
+``rb_corrupt``              a replay batch is scribbled with garbage at the
+                            buffer layer (``ReplayBuffer.sample`` / a remote
+                            ``rb_insert`` frame) — models silent data
+                            corruption reaching the learner
 ==========================  ====================================================
 
 ``fault_point(name)`` returns True exactly when the armed site fires (a
@@ -69,6 +82,9 @@ KNOWN_SITES = (
     "net_drop",
     "net_delay",
     "replay_server_exit",
+    "nan_inject",
+    "loss_spike",
+    "rb_corrupt",
 )
 
 

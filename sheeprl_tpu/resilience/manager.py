@@ -37,6 +37,41 @@ from sheeprl_tpu.resilience.preemption import PreemptionHandler
 from sheeprl_tpu.utils.callback import CheckpointCallback
 
 
+class NonFiniteCheckpointError(RuntimeError):
+    """A checkpoint save was refused because the agent params contain
+    non-finite values (``checkpoint.allow_nonfinite=false``, the default):
+    persisting NaN/inf weights turns one bad update into a poisoned
+    resume point that ``resume_from=auto`` would ride forever."""
+
+    def __init__(self, path: str, bad_leaves):
+        self.path = str(path)
+        self.bad_leaves = list(bad_leaves)
+        shown = ", ".join(self.bad_leaves[:5])
+        more = f" (+{len(self.bad_leaves) - 5} more)" if len(self.bad_leaves) > 5 else ""
+        super().__init__(
+            f"refusing to save non-finite params to {self.path}: offending leaves "
+            f"[{shown}]{more}; fix the divergence (or enable the training sentinel, "
+            "algo.sentinel.enabled=true) — set checkpoint.allow_nonfinite=true only "
+            "to capture a post-mortem snapshot on purpose"
+        )
+
+
+def _nonfinite_leaves(tree) -> list:
+    """Dot-paths of non-finite float leaves in a host (numpy) pytree."""
+    import jax
+    import numpy as np
+
+    bad = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        try:
+            arr = np.asarray(leaf)
+        except Exception:
+            continue
+        if arr.dtype.kind == "f" and not np.isfinite(arr).all():
+            bad.append(jax.tree_util.keystr(path))
+    return bad
+
+
 class CheckpointManager:
     def __init__(
         self,
@@ -52,7 +87,12 @@ class CheckpointManager:
         self.every = int(ckpt_cfg.every)
         self.save_last = bool(ckpt_cfg.save_last)
         self.async_save = bool(ckpt_cfg.get("async_save", True))
+        self.allow_nonfinite = bool(ckpt_cfg.get("allow_nonfinite", False))
         self.log_dir = log_dir
+        # training-health sentinel hook (resilience/sentinel.py): when a
+        # TrainHealth binds itself here, every save is tagged in the
+        # good/pending/quarantined sidecar
+        self.health = None
         self.last_checkpoint = int(last_checkpoint)
         self.cb = CheckpointCallback(keep_last=ckpt_cfg.keep_last)
         self.writer = (
@@ -66,6 +106,7 @@ class CheckpointManager:
         self.last_stall_s = 0.0
         self.total_stall_s = 0.0
         self._sync_write_s = 0.0
+        self._observability = observability
         if observability is not None:
             observability.ckpt_stats = self.stats
 
@@ -115,16 +156,34 @@ class CheckpointManager:
             return None
         path = self.ckpt_path(policy_step)
         t0 = time.perf_counter()
+        host_state = self.cb.snapshot(state_fn())
+        if not self.allow_nonfinite and "agent" in host_state:
+            bad = _nonfinite_leaves(host_state["agent"])
+            if bad:
+                raise NonFiniteCheckpointError(path, bad)
         if self.writer is not None:
-            host_state = self.cb.snapshot(state_fn())
             self.writer.submit(path, host_state)
         else:
-            self.cb.write(path, self.cb.snapshot(state_fn()))
+            self.cb.write(path, host_state)
             self._sync_write_s += time.perf_counter() - t0
         self.last_stall_s = time.perf_counter() - t0
         self.total_stall_s += self.last_stall_s
         self.saves += 1
+        if self.health is not None:
+            self.health.note_checkpoint(path)
+        if self.preempted:
+            # crash-safe telemetry: the forced pre-exit save is the last
+            # chance to land the tail records that explain the shutdown
+            self._flush_telemetry()
         return path
+
+    def _flush_telemetry(self) -> None:
+        obs = self._observability
+        if obs is not None and hasattr(obs, "flush"):
+            try:
+                obs.flush()
+            except Exception:
+                pass
 
     def emergency_dump(self, policy_step: int, state: Dict[str, Any]) -> Optional[str]:
         """Best-effort synchronous dump of whatever state the caller still
@@ -140,6 +199,9 @@ class CheckpointManager:
             "checkpoint",
             f"emergency_{policy_step}_{self._runtime.global_rank}.ckpt",
         )
+        # the post-mortem depends on the telemetry tail more than on this
+        # dump succeeding — fsync the buffered records first
+        self._flush_telemetry()
         try:
             if self.writer is not None:
                 self.writer.wait()

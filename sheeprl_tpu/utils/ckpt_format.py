@@ -241,13 +241,71 @@ def _count_leaves(spec: Any) -> int:
     return 0
 
 
-def validate_checkpoint(path: Union[str, os.PathLike]) -> Dict[str, Any]:
+def _leaf_indices_under(spec: Any, key: Optional[str]) -> list:
+    """Leaf indices referenced under top-level ``key`` of a dict-rooted
+    manifest tree (the whole tree when ``key`` is absent)."""
+    if key is not None and spec.get("__t__") == "dict" and key in spec["items"]:
+        spec = spec["items"][key]
+    out: list = []
+
+    def walk(s):
+        t = s["__t__"]
+        if t == "leaf":
+            out.append(s["i"])
+        elif t in ("namedtuple", "tuple", "list"):
+            for c in s["items"]:
+                walk(c)
+        elif t == "dict":
+            for c in s["items"].values():
+                walk(c)
+
+    walk(spec)
+    return out
+
+
+def spot_check_finite(path: Union[str, os.PathLike], max_leaves: int = 8) -> None:
+    """Finite spot-check of a v1 checkpoint's ``agent`` subtree (the whole
+    tree when there is none): up to ``max_leaves`` float leaves are read
+    and tested with ``np.isfinite``.  A poisoned checkpoint — NaN/inf
+    params written before the sentinel (or with it disabled) — raises
+    :class:`CheckpointCorruptError`, so ``resume_from=auto`` and the
+    sentinel's rollback skip it instead of resuming divergence.  Pre-v1
+    pickles are skipped (no manifest to walk)."""
+    if not is_v1(path):
+        return
+    try:
+        with np.load(path, allow_pickle=False) as npz:
+            doc = json.loads(bytes(npz["manifest"]))
+            indices = _leaf_indices_under(doc["tree"], "agent")
+            checked = 0
+            for i in indices:
+                if checked >= max_leaves:
+                    break
+                arr = npz[f"leaf_{i}"]
+                if arr.dtype.kind != "f":
+                    continue
+                checked += 1
+                if not np.isfinite(arr).all():
+                    raise CheckpointCorruptError(
+                        path, f"non-finite values in leaf_{i} (poisoned params)"
+                    )
+    except CheckpointCorruptError:
+        raise
+    except (zipfile.BadZipFile, EOFError, OSError, KeyError, ValueError, json.JSONDecodeError) as e:
+        raise CheckpointCorruptError(path, f"{type(e).__name__}: {e}") from e
+
+
+def validate_checkpoint(
+    path: Union[str, os.PathLike], check_finite: bool = False
+) -> Dict[str, Any]:
     """Validate a v1 checkpoint WITHOUT materializing it: zip central
     directory + per-member CRCs, manifest parses, and every leaf the
     manifest references exists as a zip member. Raises
     :class:`CheckpointCorruptError` on any failure; returns a small summary
     dict on success. This is the gate auto-resume runs before trusting a
-    checkpoint found on disk."""
+    checkpoint found on disk.  ``check_finite=True`` additionally runs
+    :func:`spot_check_finite` over the ``agent`` subtree so poisoned (but
+    structurally intact) checkpoints fail too."""
     path = Path(path)
     try:
         if path.stat().st_size == 0:
@@ -280,4 +338,6 @@ def validate_checkpoint(path: Union[str, os.PathLike]) -> Dict[str, Any]:
     top_keys = (
         sorted(doc["tree"]["items"].keys()) if doc["tree"].get("__t__") == "dict" else []
     )
+    if check_finite:
+        spot_check_finite(path)
     return {"version": doc["version"], "n_leaves": n_leaves, "keys": top_keys}

@@ -1,0 +1,595 @@
+"""Training health sentinel tests (ISSUE 7).
+
+Unit level: detector z-score math, skip-budget hysteresis, good/
+quarantine checkpoint tagging, rollback restoring bit-exact params, the
+non-finite checkpoint refusal, the finite spot-check, crash-safe
+telemetry flush, and the replay-service quarantine bookkeeping.
+
+E2E level (tier-1, tiny CPU runs through the real CLI): a ``nan_inject``
+run detects/skips/rolls back and finishes rc=0 with ``health`` telemetry,
+and a sentinel-on-no-anomaly run is bit-exact with a sentinel-off run
+(golden md5) with a flat post-warmup compile counter.
+"""
+
+import glob
+import hashlib
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.resilience.sentinel import (
+    CheckpointHealthTags,
+    TrainHealth,
+    detector_step,
+    find_last_good,
+    guard_update,
+    init_sentinel_state,
+    is_quarantined,
+    restore_like,
+    sentinel_setting,
+)
+from sheeprl_tpu.utils.ckpt_format import (
+    CheckpointCorruptError,
+    save_state,
+    spot_check_finite,
+    validate_checkpoint,
+)
+
+_KNOBS = dict(z_max=4.0, ema_alpha=0.1, warmup=5, skip_budget=2)
+
+
+# --------------------------------------------------------------------------- #
+# detector math
+# --------------------------------------------------------------------------- #
+def test_detector_warmup_then_flags_nan_and_spike():
+    st = init_sentinel_state(2)
+    for i in range(10):
+        ok, st = detector_step(st, jnp.array([1.0 + 0.01 * i, 2.0]), **_KNOBS)
+        assert bool(ok), f"healthy update {i} flagged"
+    mean_before = np.asarray(st.mean).copy()
+    # non-finite flags immediately and never pollutes the baseline
+    ok, st = detector_step(st, jnp.array([np.nan, 2.0]), **_KNOBS)
+    assert not bool(ok) and int(st.consec_skips) == 1 and not bool(st.tripped)
+    np.testing.assert_array_equal(np.asarray(st.mean), mean_before)
+    # a large UPWARD spike flags
+    ok, st = detector_step(st, jnp.array([50.0, 2.0]), **_KNOBS)
+    assert not bool(ok) and bool(st.tripped)  # second consecutive skip = budget
+    # recovery resets the consecutive counter (hysteresis)
+    ok, st = detector_step(st, jnp.array([1.1, 2.0]), **_KNOBS)
+    assert bool(ok) and int(st.consec_skips) == 0 and not bool(st.tripped)
+    assert int(st.total_skips) == 2
+
+
+def test_detector_is_one_sided():
+    """Early training legitimately moves losses tens of sigma DOWNWARD;
+    only upward excursions (divergence) may flag."""
+    st = init_sentinel_state(1)
+    for _ in range(8):
+        ok, st = detector_step(st, jnp.array([10.0]), **_KNOBS)
+    ok, _ = detector_step(st, jnp.array([0.001]), **_KNOBS)  # -100x move
+    assert bool(ok), "downward move must not flag"
+    ok, _ = detector_step(st, jnp.array([1000.0]), **_KNOBS)
+    assert not bool(ok), "upward spike must flag"
+
+
+def test_detector_extended_warmup_via_negative_count():
+    st = init_sentinel_state(1, count0=-5)
+    # warmup=5 plus 5 extra: 10 updates where even wild z passes (finite)
+    vals = [1.0, 100.0, 0.5, 80.0, 1.0, 90.0, 1.0, 1.0, 1.0, 1.0]
+    for v in vals:
+        ok, st = detector_step(st, jnp.array([v]), **_KNOBS)
+        assert bool(ok)
+
+
+# --------------------------------------------------------------------------- #
+# guarded update wrapper
+# --------------------------------------------------------------------------- #
+class _Runtime:
+    def setup_step(self, fn, donate_argnums=(), static_argnums=()):
+        return jax.jit(fn, donate_argnums=donate_argnums, static_argnums=static_argnums)
+
+    def reseed_key_stream(self, salt):
+        self.reseeded = salt
+
+
+def _cfg(enabled=True, **over):
+    node = {"enabled": enabled, "warmup": 3, "skip_budget": 2, "z_max": 5.0, "good_after": 1}
+    node.update(over)
+
+    class Cfg:
+        class algo:
+            @staticmethod
+            def get(k, d=None):
+                return {"sentinel": node}.get(k, d)
+
+    return Cfg()
+
+
+def _toy_update(params, opt, data, key):
+    g = jnp.mean(data["x"])
+    new = jax.tree_util.tree_map(lambda p: p - 0.01 * g, params)
+    return new, opt, {"Loss/l": g}
+
+
+def _fresh_state():
+    return {"w": jnp.ones((4,))}, {"count": jnp.zeros((), jnp.int32)}
+
+
+def test_guarded_update_skips_anomalous_and_keeps_params():
+    fn = guard_update(_Runtime(), _toy_update, _cfg(), n_state=2, donate_argnums=(0, 1))
+    params, opt = _fresh_state()
+    for i in range(5):
+        params, opt, _ = fn(params, opt, {"x": jnp.ones(3) * (1 + 0.01 * i)}, None)
+    good = np.asarray(params["w"]).copy()
+    params, opt, _ = fn(params, opt, {"x": jnp.full(3, np.nan)}, None)
+    np.testing.assert_array_equal(np.asarray(params["w"]), good)
+    assert int(jax.device_get(fn.health.device_state.total_skips)) == 1
+    params, opt, _ = fn(params, opt, {"x": jnp.ones(3)}, None)
+    assert not np.array_equal(np.asarray(params["w"]), good)  # training resumed
+
+
+def test_guarded_update_bit_exact_with_sentinel_off():
+    fn_off = guard_update(_Runtime(), _toy_update, _cfg(False), n_state=2, donate_argnums=(0, 1))
+    fn_on = guard_update(_Runtime(), _toy_update, _cfg(True), n_state=2, donate_argnums=(0, 1))
+    p1, o1 = _fresh_state()
+    p2, o2 = _fresh_state()
+    for i in range(8):
+        d = {"x": jnp.ones(3) * (1 + 0.01 * i)}
+        p1, o1, _ = fn_off(p1, o1, d, None)
+        p2, o2, _ = fn_on(p2, o2, d, None)
+    np.testing.assert_array_equal(np.asarray(p1["w"]), np.asarray(p2["w"]))
+
+
+def test_guarded_update_off_has_no_wrapper_state():
+    fn = guard_update(_Runtime(), _toy_update, _cfg(False), n_state=2, donate_argnums=(0, 1))
+    assert not fn.enabled and not fn.health.enabled
+    params, opt = _fresh_state()
+    out = fn(params, opt, {"x": jnp.ones(3)}, None)
+    assert len(out) == 3 and fn.health.device_state is None
+
+
+def test_nan_inject_fault_poisons_consecutive_dispatches(monkeypatch):
+    monkeypatch.setenv("SHEEPRL_FAULTS", "nan_inject:2:3")
+    fn = guard_update(_Runtime(), _toy_update, _cfg(), n_state=2, donate_argnums=(0, 1))
+    params, opt = _fresh_state()
+    for _ in range(5):
+        params, opt, _ = fn(params, opt, {"x": jnp.ones(3)}, None)
+    # dispatches 2,3,4 poisoned -> 3 skips, budget (2) tripped on device
+    assert int(jax.device_get(fn.health.device_state.total_skips)) == 3
+    assert bool(jax.device_get(fn.health.device_state.tripped)) is False  # reset by ok #5
+
+
+# --------------------------------------------------------------------------- #
+# checkpoint tagging + rollback target search
+# --------------------------------------------------------------------------- #
+def _write_ckpt(dirpath, name, value=1.0):
+    path = os.path.join(dirpath, name)
+    save_state(path, {"agent": {"w": np.full((4,), value, np.float32)},
+                      "optimizer": {"count": np.zeros((), np.int32)}})
+    # distinct mtimes: the good-path ordering sorts by mtime
+    t = time.time()
+    os.utime(path, (t, t))
+    time.sleep(0.01)
+    return path
+
+
+def test_tags_lifecycle_promote_anomaly_quarantine(tmp_path):
+    d = str(tmp_path)
+    tags = CheckpointHealthTags(d)
+    p1 = _write_ckpt(d, "ckpt_10_0.ckpt")
+    tags.note_save(p1, healthy_marker=5)
+    assert tags.status(p1) == "pending"
+    tags.promote(healthy_marker=6, good_after=3)
+    assert tags.status(p1) == "pending"  # not enough healthy updates yet
+    tags.promote(healthy_marker=8, good_after=3)
+    assert tags.status(p1) == "good"
+    # a later save + an anomaly: the pending promotion count restarts
+    p2 = _write_ckpt(d, "ckpt_20_0.ckpt")
+    tags.note_save(p2, healthy_marker=8)
+    tags.note_anomaly(healthy_marker=9)
+    tags.promote(healthy_marker=11, good_after=3)
+    assert tags.status(p2) == "pending"  # restarted at 9, needs 12
+    assert tags.quarantine_pending() == ["ckpt_20_0.ckpt"]
+    assert tags.status(p2) == "quarantined" and tags.status(p1) == "good"
+    # persistence round-trip + auto-resume helper
+    tags2 = CheckpointHealthTags(d)
+    assert tags2.status(p2) == "quarantined"
+    assert is_quarantined(p2) and not is_quarantined(p1)
+
+
+def test_find_last_good_prefers_good_and_skips_quarantined(tmp_path):
+    d = str(tmp_path)
+    tags = CheckpointHealthTags(d)
+    p_good = _write_ckpt(d, "ckpt_10_0.ckpt")
+    p_pending = _write_ckpt(d, "ckpt_20_0.ckpt")
+    p_quar = _write_ckpt(d, "ckpt_30_0.ckpt")
+    tags.note_save(p_good, 0)
+    tags.promote(99, 1)
+    tags.note_save(p_pending, 99)
+    tags.note_save(p_quar, 99)
+    tags._tags[os.path.basename(p_quar)]["status"] = "quarantined"
+    tags._save()
+    assert find_last_good(d) == p_good
+    # with no good tag at all, the newest non-quarantined validated+finite wins
+    tags._tags[os.path.basename(p_good)]["status"] = "quarantined"
+    tags._save()
+    assert find_last_good(d) == p_pending
+
+
+def test_find_last_good_skips_poisoned(tmp_path):
+    d = str(tmp_path)
+    ok = _write_ckpt(d, "ckpt_10_0.ckpt")
+    bad = os.path.join(d, "ckpt_20_0.ckpt")
+    save_state(bad, {"agent": {"w": np.full((4,), np.nan, np.float32)}})
+    assert find_last_good(d) == ok
+
+
+def test_rollback_restores_bit_exact_params(tmp_path):
+    """The full trip path: budget trips inside the jitted update, tick()
+    loads the last good checkpoint and the restored params are bitwise
+    the saved ones; the PRNG stream is re-seeded."""
+    d = str(tmp_path)
+    golden = np.asarray([0.5, -1.25, 3.0, 0.125], np.float32)
+    path = _write_ckpt(d, "ckpt_10_0.ckpt")
+    save_state(path, {"agent": {"w": golden}, "optimizer": {"count": np.zeros((), np.int32)}})
+    tags = CheckpointHealthTags(d)
+    tags.note_save(path, 0)
+    tags.promote(99, 1)  # good
+
+    rt = _Runtime()
+    fn = guard_update(rt, _toy_update, _cfg(skip_budget=2), n_state=2, donate_argnums=(0, 1))
+    fn.health._scan_root = d
+    fn.health._select = ("agent", "optimizer")
+    params, opt = _fresh_state()
+    for i in range(4):
+        params, opt, _ = fn(params, opt, {"x": jnp.ones(3)}, None)
+        assert fn.health.tick() is None
+    for _ in range(2):  # two consecutive NaN batches = budget
+        params, opt, _ = fn(params, opt, {"x": jnp.full(3, np.nan)}, None)
+    rolled = fn.health.tick()
+    assert rolled is not None
+    params = restore_like(params, rolled["agent"])
+    np.testing.assert_array_equal(np.asarray(params["w"]), golden)
+    assert fn.health.rollbacks == 1 and rt.reseeded == 1
+    # the device detector re-armed with an extended warmup
+    assert int(jax.device_get(fn.health.device_state.count)) < 0
+
+
+def test_trainhealth_raises_when_no_checkpoint_exists(tmp_path):
+    fn = guard_update(_Runtime(), _toy_update, _cfg(skip_budget=1), n_state=2, donate_argnums=(0, 1))
+    fn.health._scan_root = str(tmp_path)  # empty dir
+    params, opt = _fresh_state()
+    params, opt, _ = fn(params, opt, {"x": jnp.full(3, np.nan)}, None)
+    from sheeprl_tpu.resilience.sentinel import TrainingDivergedError
+
+    with pytest.raises(TrainingDivergedError):
+        fn.health.tick()
+
+
+# --------------------------------------------------------------------------- #
+# non-finite checkpoint refusal + finite spot-check + auto-resume
+# --------------------------------------------------------------------------- #
+def test_spot_check_finite_flags_poisoned_agent(tmp_path):
+    good = os.path.join(tmp_path, "g.ckpt")
+    save_state(good, {"agent": {"w": np.ones(3, np.float32)}, "iter_num": 7})
+    spot_check_finite(good)  # no raise
+    bad = os.path.join(tmp_path, "b.ckpt")
+    save_state(bad, {"agent": {"w": np.asarray([1.0, np.inf, 0.0], np.float32)}})
+    with pytest.raises(CheckpointCorruptError, match="non-finite"):
+        spot_check_finite(bad)
+    with pytest.raises(CheckpointCorruptError):
+        validate_checkpoint(bad, check_finite=True)
+    validate_checkpoint(bad)  # structurally fine without the finite check
+
+
+def test_autoresume_skips_quarantined_and_poisoned(tmp_path):
+    from sheeprl_tpu.resilience.autoresume import find_latest_resumable
+
+    d = str(tmp_path)
+    ok = _write_ckpt(d, "ckpt_10_0.ckpt")
+    poisoned = os.path.join(d, "ckpt_20_0.ckpt")
+    save_state(poisoned, {"agent": {"w": np.full(3, np.nan, np.float32)}})
+    quar = _write_ckpt(d, "ckpt_30_0.ckpt")
+    tags = CheckpointHealthTags(d)
+    tags.note_save(quar, 0)
+    tags.quarantine_pending()
+    assert find_latest_resumable(d) == ok
+
+
+class _MgrRuntime:
+    is_global_zero = True
+    global_rank = 0
+
+
+def _mgr(tmp_path, allow_nonfinite=False, async_save=False):
+    from sheeprl_tpu.resilience.manager import CheckpointManager
+
+    class _CkptCfg(dict):
+        __getattr__ = dict.__getitem__
+
+    cfg = type(
+        "C",
+        (),
+        {
+            "checkpoint": _CkptCfg(
+                every=1,
+                save_last=True,
+                keep_last=5,
+                async_save=async_save,
+                allow_nonfinite=allow_nonfinite,
+            )
+        },
+    )()
+    return CheckpointManager(_MgrRuntime(), cfg, str(tmp_path))
+
+
+def test_manager_refuses_nonfinite_params(tmp_path):
+    from sheeprl_tpu.resilience.manager import NonFiniteCheckpointError
+
+    mgr = _mgr(tmp_path)
+    bad_state = {"agent": {"actor": {"w": np.asarray([1.0, np.nan], np.float32)}}, "iter_num": 3}
+    with pytest.raises(NonFiniteCheckpointError, match="actor"):
+        mgr.checkpoint_now(policy_step=8, state_fn=lambda: bad_state)
+    mgr.close()
+    # opt-out records the snapshot anyway (post-mortem capture)
+    mgr2 = _mgr(tmp_path, allow_nonfinite=True)
+    path = mgr2.checkpoint_now(policy_step=8, state_fn=lambda: bad_state)
+    mgr2.close()
+    assert os.path.exists(path)
+
+
+def test_emergency_dump_bypasses_finite_check(tmp_path):
+    mgr = _mgr(tmp_path)
+    path = mgr.emergency_dump(5, {"agent": {"w": np.asarray([np.inf], np.float32)}})
+    assert path is not None and os.path.exists(path)
+    mgr.close()
+
+
+# --------------------------------------------------------------------------- #
+# crash-safe telemetry flush
+# --------------------------------------------------------------------------- #
+def test_telemetry_sink_flush_fsyncs(tmp_path):
+    from sheeprl_tpu.obs.telemetry import TelemetrySink
+
+    sink = TelemetrySink(str(tmp_path / "t.jsonl"))
+    sink.write({"v": 1, "x": 1})
+    sink.flush()  # must not raise, file durable
+    with open(tmp_path / "t.jsonl") as f:
+        assert json.loads(f.readline())["x"] == 1
+    sink.close()
+    sink.flush()  # after close: no-op, no raise
+
+
+def test_manager_flushes_telemetry_on_preemption(tmp_path):
+    mgr = _mgr(tmp_path)
+    flushed = []
+
+    class _Obs:
+        def flush(self):
+            flushed.append(True)
+
+    mgr._observability = _Obs()
+    mgr.preemption.set()
+    mgr.checkpoint_now(policy_step=8, state_fn=lambda: {"iter_num": 1})
+    assert flushed, "forced preemption save must flush the telemetry sink"
+    mgr.close()
+
+
+# --------------------------------------------------------------------------- #
+# rb_corrupt fault site
+# --------------------------------------------------------------------------- #
+def test_rb_corrupt_scribbles_sampled_batch(monkeypatch):
+    from sheeprl_tpu.data.buffers import ReplayBuffer
+
+    rb = ReplayBuffer(16, 2, obs_keys=("observations",))
+    step = {
+        "observations": np.ones((1, 2, 3), np.float32),
+        "rewards": np.ones((1, 2, 1), np.float32),
+        "terminated": np.zeros((1, 2, 1), np.uint8),
+        "truncated": np.zeros((1, 2, 1), np.uint8),
+    }
+    for _ in range(8):
+        rb.add(step)
+    clean = rb.sample(batch_size=4)
+    assert float(np.abs(clean["rewards"]).max()) <= 1.0
+    monkeypatch.setenv("SHEEPRL_FAULTS", "rb_corrupt")
+    corrupt = rb.sample(batch_size=4)
+    assert float(np.abs(corrupt["rewards"]).max()) > 1e6, "batch must be scribbled"
+    monkeypatch.delenv("SHEEPRL_FAULTS")
+    clean2 = rb.sample(batch_size=4)  # one-shot: next sample clean again
+    assert float(np.abs(clean2["rewards"]).max()) <= 1.0
+
+
+# --------------------------------------------------------------------------- #
+# replay service quarantine bookkeeping (uniform path)
+# --------------------------------------------------------------------------- #
+def test_replay_server_quarantine_bookkeeping():
+    from sheeprl_tpu.replay.service import ReplayServer
+
+    server = ReplayServer(32, [(0, 2)], {}, obs_keys=("observations",))
+    server._rows_since_mark[:] = 5
+    rows = server.quarantine_recent()
+    assert rows == 10 and server.quarantines == 1
+    assert server._rows_since_mark.sum() == 0
+    assert server.events[-1]["event"] == "replay_quarantine"
+    server.mark_health_horizon()
+    assert server.stats()["quarantines"] == 1
+
+
+# --------------------------------------------------------------------------- #
+# EnvStepGuard: restart-with-backoff timing (the double-fault re-raise and
+# truncation paths are covered in test_resilience.py)
+# --------------------------------------------------------------------------- #
+def test_env_guard_restart_applies_backoff():
+    import gymnasium as gym
+
+    from sheeprl_tpu.envs.wrappers import EnvStepGuard
+
+    class _Crashy(gym.Env):
+        observation_space = gym.spaces.Box(-1, 1, (2,), dtype=np.float32)
+        action_space = gym.spaces.Discrete(2)
+        crash_at = None
+
+        def __init__(self):
+            self.t = 0
+
+        def reset(self, *, seed=None, options=None):
+            self.t = 0
+            return np.zeros(2, dtype=np.float32), {}
+
+        def step(self, action):
+            self.t += 1
+            if _Crashy.crash_at is not None and self.t >= _Crashy.crash_at:
+                raise ValueError("simulated env crash")
+            return np.full(2, self.t, np.float32), 1.0, False, False, {}
+
+    env = EnvStepGuard(_Crashy(), _Crashy, env_idx=0, backoff_s=0.2)
+    env.reset()
+    env.step(0)
+    _Crashy.crash_at = 2
+    t0 = time.monotonic()
+    obs, _, _, truncated, info = env.step(1)
+    elapsed = time.monotonic() - t0
+    assert truncated and info["env_restarted"]
+    assert elapsed >= 0.2, f"rebuild must back off (took {elapsed:.3f}s)"
+
+
+# --------------------------------------------------------------------------- #
+# e2e (tiny CPU runs through the real CLI)
+# --------------------------------------------------------------------------- #
+from sheeprl_tpu.cli import run as cli_run
+
+
+def _a2c_args(root, *, sentinel, total_steps=384, seed=11, extra=()):
+    return [
+        "exp=a2c",
+        "env=dummy",
+        "env.num_envs=4",
+        "env.sync_env=True",
+        "env.capture_video=False",
+        "fabric.accelerator=cpu",
+        "fabric.devices=1",
+        "metric.log_level=1",
+        "metric.log_every=64",
+        f"metric.logger.root_dir={root}/logs",
+        "checkpoint.every=64",
+        "checkpoint.save_last=True",
+        "buffer.memmap=False",
+        f"seed={seed}",
+        f"algo.total_steps={total_steps}",
+        "algo.rollout_steps=8",
+        "algo.per_rank_batch_size=16",
+        "algo.dense_units=8",
+        "algo.mlp_layers=1",
+        "algo.mlp_keys.encoder=[state]",
+        "algo.run_test=False",
+        "algo.overlap_collect=False",
+        f"algo.sentinel.enabled={sentinel}",
+        f"root_dir={root}/run",
+        *extra,
+    ]
+
+
+def _health_records(root):
+    out = []
+    for t in sorted(glob.glob(f"{root}/**/telemetry.jsonl", recursive=True)):
+        for line in open(t):
+            rec = json.loads(line)
+            if "health" in rec:
+                out.append(rec)
+    return out
+
+
+def _agent_md5(root):
+    from sheeprl_tpu.utils.callback import load_checkpoint
+
+    ckpts = sorted(glob.glob(f"{root}/**/ckpt_*.ckpt", recursive=True), key=os.path.getmtime)
+    st = load_checkpoint(ckpts[-1], select=("agent",))
+    h = hashlib.md5()
+    for leaf in jax.tree_util.tree_leaves(st["agent"]):
+        h.update(np.ascontiguousarray(leaf).tobytes())
+    return h.hexdigest()
+
+
+def test_e2e_nan_inject_skip_and_rollback(tmp_path, monkeypatch):
+    """Chaos proof (coupled): nan_inject arms 3 consecutive poisoned
+    dispatches; the run detects within one update, skips, trips the
+    budget, rolls back to the last good checkpoint, finishes rc=0, and
+    telemetry records the verdicts and the rollback event."""
+    monkeypatch.setenv("SHEEPRL_FAULTS", "nan_inject:10:3")
+    root = str(tmp_path / "nanrun")
+    cli_run(
+        _a2c_args(
+            root,
+            sentinel="True",
+            total_steps=768,
+            extra=(
+                "algo.sentinel.warmup=6",
+                "algo.sentinel.skip_budget=3",
+                "algo.sentinel.good_after=2",
+            ),
+        )
+    )
+    monkeypatch.delenv("SHEEPRL_FAULTS")
+    recs = _health_records(root)
+    assert recs, "telemetry must carry health records"
+    last = recs[-1]["health"]
+    assert last["skips"] >= 3
+    assert last["rollbacks"] >= 1
+    assert last["last_rollback"]["consecutive_skips"] >= 3
+    assert last["last_ok"] is True  # training recovered
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_health_chaos_soak_both_topologies(tmp_path):
+    """The full ISSUE 7 acceptance harness: coupled SAC + N=2 decoupled
+    PPO under nan_inject, audited from telemetry (scripts/chaos_soak.py
+    --mode health). Subprocess: the decoupled leg spawns players."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("SHEEPRL_FAULTS", None)
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(repo, "scripts", "chaos_soak.py"),
+            "--mode",
+            "health",
+            "--seed",
+            "7",
+            "--root-dir",
+            str(tmp_path / "health"),
+        ],
+        cwd=repo,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=900,
+    )
+    assert proc.returncode == 0, proc.stdout[-3000:] + proc.stderr[-3000:]
+    assert "health chaos soak passed" in proc.stdout
+
+
+def test_e2e_sentinel_on_no_anomaly_bit_exact_and_compile_flat(tmp_path):
+    """Acceptance: sentinel-on with no anomaly is bit-exact with
+    sentinel-off (golden md5) and the post-warmup compile counter stays
+    flat."""
+    off_root = str(tmp_path / "off")
+    on_root = str(tmp_path / "on")
+    cli_run(_a2c_args(off_root, sentinel="False"))
+    cli_run(_a2c_args(on_root, sentinel="True"))
+    assert _agent_md5(off_root) == _agent_md5(on_root)
+    compiles = [
+        (r.get("compiles") or {}).get("post_warmup")
+        for r in _health_records(on_root)
+        if (r.get("compiles") or {}).get("post_warmup") is not None
+    ]
+    assert compiles and all(c == 0 for c in compiles), compiles
