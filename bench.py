@@ -104,6 +104,7 @@ SECTIONS = [
     ("dv3", 60),
     ("loop", 60),
     ("replay", 120),
+    ("serve", 90),
     ("ppo", 100),
     ("sac", 60),
     ("a2c", 100),
@@ -553,6 +554,29 @@ def bench_loop():
     }
 
 
+def bench_serve():
+    """Inference-service ladder (benchmarks/bench_inference.py): request
+    latency p50/p95 + actions/s for 1/2/4 env workers x batch deadline,
+    remote (deadline-batched server over queue channels) vs a direct-call
+    local policy baseline.  On this 1-core container the remote/local
+    throughput ratio is a LOWER bound (server + workers + jit time-slice
+    one core); the batch-size histogram shifting right with worker count
+    is the portable batching signal."""
+    from benchmarks.bench_inference import run_grid
+
+    result = run_grid(n_requests=int(os.environ.get("BENCH_SERVE_REQUESTS", 256)))
+    return {
+        "metric": "inference_serving_remote_over_local_throughput",
+        "value": result["remote_over_local_throughput"],
+        "unit": "x",
+        "best_remote": result["best_remote"],
+        "local_actions_per_s": result["local_baseline"]["actions_per_s"],
+        "remote_p50_ms": result["grid"][0]["client_latency_ms"]["p50"],
+        "grid": result["grid"],
+        "host_cpu_count": result["host_cpu_count"],
+    }
+
+
 def bench_replay():
     """Replay-sampling ladder (benchmarks/bench_replay_sampling.py):
     per-batch cost of the uniform vs prioritized on-device samplers at
@@ -719,6 +743,7 @@ def child_main(section, out_path):
         "dv3": bench_dv3,
         "loop": bench_loop,
         "replay": bench_replay,
+        "serve": bench_serve,
         "ppo": bench_ppo,
         "sac": bench_sac,
         "a2c": bench_a2c,

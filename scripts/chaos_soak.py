@@ -24,11 +24,23 @@ Topology acceptance (ISSUE 6) runnable standalone::
     python scripts/chaos_soak.py --players 4 --transport tcp --kills 3 \
         --total-steps 19200 --seed 7
 
+``--mode serve`` is the ISSUE 8 acceptance harness: an
+``algo.inference=remote`` N-player run under a randomized server-kill
+schedule (+ tcp net noise) — every kill must show breaker trip -> local
+fallback -> supervisor respawn -> half-open re-promotion with a clean
+request-id audit — plus a deterministic sub-leg offering the hot-swap
+watcher a nan-POISONED checkpoint (must be refused) and a good one
+(must swap).
+
 Health acceptance (ISSUE 7)::
 
     python scripts/chaos_soak.py --mode health --seed 7
 
-both wrapped by ``chaos``/``slow``-marked pytest soaks.  The schedules
+Serve acceptance (ISSUE 8)::
+
+    python scripts/chaos_soak.py --mode serve --seed 7
+
+all wrapped by ``chaos``/``slow``-marked pytest soaks.  The schedules
 are pure functions of ``--seed``, so a failing soak reproduces exactly.
 """
 
@@ -275,13 +287,181 @@ def run_health_mode(args) -> int:
     return 0
 
 
+def read_serve(root_dir: str):
+    """Last client-side ``serve`` record and server-side
+    ``transport.serve`` record from a run's telemetry files."""
+    client, server = None, None
+    for path in sorted(
+        glob.glob(os.path.join(root_dir, "**", "telemetry.jsonl"), recursive=True),
+        key=os.path.getmtime,
+    ):
+        for line in open(path):
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if rec.get("serve"):
+                client = rec["serve"]
+            if (rec.get("transport") or {}).get("serve"):
+                server = rec["transport"]["serve"]
+    return client, server
+
+
+def audit_serve(client, server, *, kills: int) -> list:
+    failures = []
+    if client is None or server is None:
+        return ["no serve telemetry found (inference=remote not wired?)"]
+    if client.get("breaker_trips", 0) < 1:
+        failures.append("breaker never tripped despite the server kill")
+    if client.get("local_fallbacks", 0) < 1:
+        failures.append("no local fallbacks recorded")
+    if client.get("breaker_promotions", 0) < 1:
+        failures.append("breaker never re-promoted after the respawn")
+    if client.get("breaker") != "closed":
+        failures.append(f"run ended with the breaker {client.get('breaker')!r}")
+    if client.get("unaccounted", 0) != 0:
+        failures.append(f"request-id audit failed: {client.get('unaccounted')} unaccounted")
+    if server.get("respawns", 0) < kills:
+        failures.append(f"only {server.get('respawns', 0)} respawns for {kills} server kills")
+    if not server.get("batches"):
+        failures.append("server never dispatched a batch")
+    return failures
+
+
+def run_serve_hot_swap_leg(root: str) -> list:
+    """Deterministic sub-leg: a nan-POISONED checkpoint offered for
+    hot-swap must be refused (finite spot-check), a good one swapped."""
+    import time
+
+    import numpy as np
+
+    from sheeprl_tpu.serve import InferenceServer, agent_params_loader
+    from sheeprl_tpu.utils.ckpt_format import save_state
+
+    ckpt_dir = os.path.join(root, "hot_swap", "checkpoint")
+    os.makedirs(ckpt_dir, exist_ok=True)
+    good = save_state(
+        os.path.join(ckpt_dir, "ckpt_100_0.ckpt"),
+        {"agent": {"w": np.full((4,), 2.0, np.float32)}},
+    )
+    time.sleep(0.02)
+    save_state(
+        os.path.join(ckpt_dir, "ckpt_200_0.ckpt"),
+        {"agent": {"w": np.full((4,), np.nan, np.float32)}},  # poisoned, newer
+    )
+    loader = agent_params_loader("agent")
+    srv = InferenceServer(lambda p, o, k: {"actions": o["x"] + p["w"][0]}, {"w": np.zeros(4)})
+    srv.watch(os.path.join(root, "hot_swap"), loader, interval_s=1e6)
+    import warnings as _warnings
+
+    with _warnings.catch_warnings():
+        _warnings.simplefilter("ignore")
+        swapped = srv.poll_hot_swap()
+    st = srv.stats()["swaps"]
+    failures = []
+    if st["refused_invalid"] < 1:
+        failures.append("nan-poisoned checkpoint was NOT refused")
+    if swapped != os.path.abspath(good) or st["applied"] != 1:
+        failures.append(f"good checkpoint not swapped in (swapped={swapped}, stats={st})")
+    srv.close()
+    return failures
+
+
+def run_serve_mode(args) -> int:
+    """ISSUE 8 acceptance soak: a remote-inference N-player run under a
+    randomized server-kill (+ tcp net noise) schedule — breakers must
+    trip to the local fallback, the supervisor must respawn the server,
+    breakers must re-promote, and the request-id audit must be clean —
+    plus the poisoned-checkpoint hot-swap refusal sub-leg."""
+    import shutil
+
+    rng = random.Random(args.seed)
+    kills = max(1, min(args.kills, 2))  # enough batches must fit between kills
+    entries = []
+    at = 0
+    for _ in range(kills):
+        at += rng.randrange(30, 80)
+        entries.append(f"server_exit:{at}")
+    if args.transport == "tcp":
+        entries += build_net_noise(rng, args.net_drops, args.net_delays)
+    faults = ",".join(entries)
+    print(f"serve chaos schedule (seed {args.seed}): SHEEPRL_FAULTS={faults}")
+
+    shutil.rmtree(args.root_dir, ignore_errors=True)
+    os.environ["SHEEPRL_FAULTS"] = faults
+    from sheeprl_tpu.cli import run
+
+    try:
+        run(
+            [
+                "exp=ppo_decoupled",
+                "env=dummy",
+                "env.sync_env=True",
+                "env.capture_video=False",
+                "fabric.accelerator=cpu",
+                "fabric.devices=1",
+                "metric.log_level=1",
+                "metric.log_every=64",
+                f"metric.logger.root_dir={args.root_dir}/logs",
+                "checkpoint.save_last=True",
+                "buffer.memmap=False",
+                f"seed={args.seed}",
+                "algo.per_rank_batch_size=4",
+                "algo.dense_units=8",
+                "algo.mlp_layers=1",
+                "algo.mlp_keys.encoder=[state]",
+                f"algo.total_steps={args.total_steps}",
+                f"algo.num_players={args.players}",
+                f"algo.decoupled_transport={args.transport}",
+                "algo.run_test=False",
+                "algo.inference=remote",
+                "algo.serve.request_timeout_s=0.25",
+                "algo.serve.max_retries=1",
+                "algo.serve.breaker_threshold=2",
+                "algo.serve.breaker_cooldown_s=1.0",
+                "algo.serve.restart_backoff_s=0.2",
+                f"algo.serve.restart_budget={kills + 1}",
+                f"root_dir={args.root_dir}/run",
+                "env.num_envs=4",
+                "algo.rollout_steps=4",
+                "algo.update_epochs=1",
+            ]
+        )
+    finally:
+        os.environ.pop("SHEEPRL_FAULTS", None)
+
+    client, server = read_serve(os.path.join(args.root_dir, "run"))
+    failures = audit_serve(client, server, kills=kills)
+    failures += run_serve_hot_swap_leg(args.root_dir)
+    print(
+        json.dumps(
+            {
+                "client": client,
+                "server": {k: v for k, v in (server or {}).items() if k != "batch_hist"},
+                "failures": failures,
+            },
+            indent=2,
+        )
+    )
+    if not args.keep:
+        shutil.rmtree(args.root_dir, ignore_errors=True)
+    if failures:
+        print("SERVE CHAOS SOAK FAILED", file=sys.stderr)
+        return 1
+    print("serve chaos soak passed")
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument(
         "--mode",
         default="topology",
-        choices=("topology", "health"),
-        help="topology: kill/rejoin soak (ISSUE 6); health: training sentinel proof (ISSUE 7)",
+        choices=("topology", "health", "serve"),
+        help=(
+            "topology: kill/rejoin soak (ISSUE 6); health: training sentinel proof "
+            "(ISSUE 7); serve: inference-service failure envelope (ISSUE 8)"
+        ),
     )
     ap.add_argument(
         "--fault",
@@ -312,6 +492,15 @@ def main(argv=None) -> int:
             args.root_dir = "/tmp/sheeprl_chaos_health"
         args.transport = args.transport or "queue"
         return run_health_mode(args)
+    if args.mode == "serve":
+        if args.root_dir == "/tmp/sheeprl_chaos_soak":
+            args.root_dir = "/tmp/sheeprl_chaos_serve"
+        args.transport = args.transport or "queue"
+        if args.players == 4:
+            args.players = 2  # the serve envelope needs breadth, not depth
+        if args.total_steps == 19200:
+            args.total_steps = 9600
+        return run_serve_mode(args)
     args.transport = args.transport or "tcp"
 
     rng = random.Random(args.seed)

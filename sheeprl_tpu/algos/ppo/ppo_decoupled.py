@@ -145,7 +145,14 @@ def decoupled_knobs(cfg) -> Dict[str, Any]:
 
 
 def _player_loop(
-    cfg, spec, state_counters, world_size: int, env_offset: int, n_local_envs: int, join: bool = False
+    cfg,
+    spec,
+    state_counters,
+    world_size: int,
+    env_offset: int,
+    n_local_envs: int,
+    join: bool = False,
+    infer_spec=None,
 ) -> None:
     """Player process body (reference ppo_decoupled.py:32-365).
 
@@ -158,6 +165,12 @@ def _player_loop(
     syncs its round clock + weights off the trainer's ``assign`` reply,
     then keeps itself synced off the params broadcasts (a joiner that
     boots slowly fast-forwards instead of falling behind forever).
+
+    ``infer_spec`` (``algo.inference=remote``) is a SECOND channel to the
+    trainer-side InferenceServer: actions come from the centralized
+    policy through the client failure envelope (deadline/retry/hedge/
+    breaker), with THIS player's policy — still following the params
+    broadcast exactly as in local mode — as the breaker's warm fallback.
     """
     import gymnasium as gym
     from gymnasium.vector import AsyncVectorEnv, AutoresetMode, SyncVectorEnv
@@ -371,6 +384,29 @@ def _player_loop(
     )
     init_frame.release()
 
+    # centralized inference (algo.inference=remote): actions come from the
+    # trainer-side server through the client envelope; `acting` keeps the
+    # local path LITERALLY the pre-serve call (bit-exactness contract)
+    infer_client = None
+    acting = player
+    if infer_spec is not None:
+        from sheeprl_tpu.serve import PPO_OUT_KEYS, InferenceClient, RemoteActor, inference_knobs
+
+        ik = inference_knobs(cfg)
+        infer_client = InferenceClient(
+            infer_spec.player_channel(peer_alive=parent_alive, who="inference server"),
+            player_id,
+            request_timeout_s=ik["request_timeout_s"],
+            max_retries=ik["max_retries"],
+            backoff_base_s=ik["backoff_base_s"],
+            hedge_s=ik["hedge_s"],
+            breaker_threshold=ik["breaker_threshold"],
+            breaker_cooldown_s=ik["breaker_cooldown_s"],
+        )
+        acting = RemoteActor(infer_client, player, obs_keys, PPO_OUT_KEYS)
+        if lead:
+            observability.serve_stats = infer_client.stats
+
     if lead:
         save_configs(cfg, log_dir)
 
@@ -447,7 +483,7 @@ def _player_loop(
             policy_step += cfg.env.num_envs
 
             with timer("Time/env_interaction_time", SumMetric, sync_on_compute=False):
-                flat_actions, real_actions, logprobs, values = player.get_actions(
+                flat_actions, real_actions, logprobs, values = acting.get_actions(
                     next_obs_np, runtime.next_key()
                 )
                 # only the action array is awaited before the env step; the
@@ -615,6 +651,8 @@ def _player_loop(
         pass  # a dead trainer cannot receive it; exit anyway
     if heartbeat is not None:
         heartbeat.close()
+    if infer_client is not None:
+        infer_client.close()
     if ckpt_mgr is not None:
         ckpt_mgr.close()
     if preemption is not None:
@@ -630,11 +668,17 @@ def _player_loop(
     channel.close()
 
 
-def spawn_players(cfg, runtime, ctx, target, extra_args=(), knobs=None):
+def spawn_players(cfg, runtime, ctx, target, extra_args=(), knobs=None, with_inference=False):
     """Create the transport + spawn ``num_players`` player processes
     pinned to the host CPU backend (shared with sac_decoupled).
 
-    Returns ``(hub, fanin_channels, procs, env_shards)``.
+    ``with_inference=True`` (``algo.inference=remote``) additionally
+    builds a SECOND transport of the same backend for the inference
+    service and hands each player its spec (trailing ``(join=False,
+    infer_spec)`` positionals on the player-loop signature).
+
+    Returns ``(hub, fanin_channels, procs, env_shards, infer_hub)``
+    (``infer_hub`` is None without inference).
     """
     knobs = knobs or decoupled_knobs(cfg)
     num_players = knobs["num_players"]
@@ -650,6 +694,21 @@ def spawn_players(cfg, runtime, ctx, target, extra_args=(), knobs=None):
         port=knobs["port"],
         poll_s=knobs["liveness_interval"],
     )
+    infer_hub = infer_specs = None
+    if with_inference:
+        # a deeper window than the rollout fan-in: retries + hedges can put
+        # several small frames in flight per player (port 0: the inference
+        # listener never collides with the configured rollout port)
+        infer_hub, infer_specs = make_transport(
+            ctx,
+            knobs["backend"],
+            num_players,
+            window=max(4, knobs["window"]),
+            compress_min=knobs["compress_min"],
+            host=knobs["host"],
+            port=0,
+            poll_s=knobs["liveness_interval"],
+        )
     procs = []
     # the env copies the parent's environ at start, so the override only
     # affects the children
@@ -657,11 +716,10 @@ def spawn_players(cfg, runtime, ctx, target, extra_args=(), knobs=None):
     os.environ["JAX_PLATFORMS"] = "cpu"
     try:
         for pid, (offset, count) in enumerate(env_shards):
-            proc = ctx.Process(
-                target=target,
-                args=(cfg, specs[pid]) + tuple(extra_args) + (offset, count),
-                daemon=False,
-            )
+            args = (cfg, specs[pid]) + tuple(extra_args) + (offset, count)
+            if infer_specs is not None:
+                args += (False, infer_specs[pid])
+            proc = ctx.Process(target=target, args=args, daemon=False)
             proc.start()
             procs.append(proc)
     finally:
@@ -679,7 +737,7 @@ def spawn_players(cfg, runtime, ctx, target, extra_args=(), knobs=None):
             detail_fn=lambda proc=proc: f"exitcode={proc.exitcode}",
         )
         channels[pid] = ch
-    return hub, channels, procs, env_shards
+    return hub, channels, procs, env_shards, infer_hub
 
 
 @register_algorithm(decoupled=True)
@@ -719,9 +777,18 @@ def main(runtime, cfg: Dict[str, Any]):
         state["last_checkpoint"] // runtime.world_size if state else 0,
     )
 
+    from sheeprl_tpu.serve import inference_setting
+
+    inference = inference_setting(cfg, knobs["num_players"])
     ctx = mp.get_context("spawn")
-    hub, channels, proc_list, env_shards = spawn_players(
-        cfg, runtime, ctx, _player_loop, extra_args=(counters, runtime.world_size), knobs=knobs
+    hub, channels, proc_list, env_shards, infer_hub = spawn_players(
+        cfg,
+        runtime,
+        ctx,
+        _player_loop,
+        extra_args=(counters, runtime.world_size),
+        knobs=knobs,
+        with_inference=inference == "remote",
     )
     procs: Dict[int, Any] = dict(enumerate(proc_list))
     rollout_steps = int(cfg.algo.rollout_steps)
@@ -737,12 +804,22 @@ def main(runtime, cfg: Dict[str, Any]):
     # under a restart budget) as JOIN-mode processes that re-man their
     # deterministic env shard at the current round
     supervisor = None
+    serve_box: Dict[str, Any] = {"server": None}  # filled once the agent exists
+
     if knobs["supervisor"]["enabled"]:
         from sheeprl_tpu.resilience import PlayerSupervisor
 
         def _respawn_args(pid, spec):
             offset, count = env_shards[pid]
-            return (cfg, spec, counters, runtime.world_size, offset, count, True)
+            args = (cfg, spec, counters, runtime.world_size, offset, count, True)
+            if infer_hub is not None:
+                # fresh inference endpoints for the replacement process; the
+                # server re-attaches the rebuilt trainer-side channel
+                ispec = infer_hub.respawn_spec(pid)
+                if serve_box["server"] is not None:
+                    serve_box["server"].attach(pid, infer_hub.channel(pid))
+                args += (ispec,)
+            return args
 
         supervisor = PlayerSupervisor(
             ctx,
@@ -824,6 +901,37 @@ def main(runtime, cfg: Dict[str, Any]):
 
         trainer_mon = RecompileMonitor(name="ppo_decoupled_trainer").install()
 
+        # centralized inference: the server thread shares this process's
+        # params (swap_params per round is a reference swap — the bucketed
+        # traces never retrace) and serves the players' obs frames over
+        # the second transport; a dead serving loop is respawned by the
+        # ServeSupervisor in drain-recover mode under a restart budget
+        serve_server = serve_sup = None
+        if infer_hub is not None:
+            from sheeprl_tpu.resilience import ServeSupervisor
+            from sheeprl_tpu.serve import InferenceServer, inference_knobs, make_ppo_policy_fn
+
+            ik = inference_knobs(cfg)
+            serve_server = InferenceServer(
+                make_ppo_policy_fn(module, cfg.algo.cnn_keys.encoder),
+                params,
+                deadline_ms=ik["deadline_ms"],
+                max_batch=ik["max_batch"],
+                seed=cfg.seed + 1,
+                name="ppo",
+            )
+            for pid, proc in procs.items():
+                ch = infer_hub.channel(pid, timeout=_QUEUE_TIMEOUT_S, peer_alive=proc.is_alive)
+                ch.set_peer(child_alive(proc), f"player[{pid}]")
+                serve_server.attach(pid, ch)
+            serve_server.start()
+            serve_box["server"] = serve_server
+            serve_sup = ServeSupervisor(
+                serve_server,
+                restart_budget=ik["restart_budget"],
+                backoff_base=ik["restart_backoff_s"],
+            )
+
         # initial weights to every player (reference broadcast, :126)
         fanin.broadcast("params", arrays=_flat_leaves(_np_tree(params)), seq=start_iter - 1)
 
@@ -856,6 +964,8 @@ def main(runtime, cfg: Dict[str, Any]):
         while True:
             if supervisor is not None:
                 supervisor.poll()
+            if serve_sup is not None:
+                serve_sup.poll()
             # named span: the trainer idling for the next fan-in round (the
             # inverse of the players' ipc_wait_update stall)
             try:
@@ -978,11 +1088,20 @@ def main(runtime, cfg: Dict[str, Any]):
                     max_decay_steps=total_iters, power=1.0,
                 )
 
+            if serve_server is not None:
+                # the fresh weights serve the NEXT requests (between-batch
+                # swap: zero dropped requests, zero retraces)
+                serve_server.swap_params(params)
+
             opt_np = _np_tree(opt_state) if need_ckpt else None
             stats = fanin.stats(knobs["backend"])
             stats["events"] = fanin.events[-8:]
             if supervisor is not None:
                 stats["supervisor"] = supervisor.stats()
+            if serve_server is not None:
+                stats["serve"] = serve_server.stats()
+                if serve_sup is not None:
+                    stats["serve"]["supervisor"] = serve_sup.stats()
             if health.enabled:
                 stats["health"] = health.stats()
             fanin.broadcast(
@@ -1002,6 +1121,9 @@ def main(runtime, cfg: Dict[str, Any]):
         trainer_mon.uninstall()
         if supervisor is not None:
             supervisor.close()
+        if serve_server is not None:
+            # graceful drain: pending requests answered, then stop frames
+            serve_server.close()
         # the lead still runs its test episode + logger shutdown after the
         # stop sentinel — give it ample time before the terminate fallback
         for proc in procs.values():
@@ -1009,9 +1131,13 @@ def main(runtime, cfg: Dict[str, Any]):
     finally:
         if supervisor is not None:
             supervisor.close()
+        if serve_box.get("server") is not None:
+            serve_box["server"].close(timeout=2.0)
         preemption.uninstall()
         fanin.close()
         hub.close()
+        if infer_hub is not None:
+            infer_hub.close()
         for proc in procs.values():
             if proc.is_alive():
                 proc.terminate()

@@ -90,12 +90,19 @@ def _player_loop(
     env_offset: int,
     n_local_envs: int,
     join: bool = False,
+    infer_spec=None,
 ) -> None:
-    """Player process body (reference sac_decoupled.py:33-353)."""
+    """Player process body (reference sac_decoupled.py:33-353).
+
+    ``infer_spec`` (``algo.inference=remote``) routes acting through the
+    trainer-side InferenceServer with this player's own actor — still
+    adopting every params broadcast — as the breaker's local fallback."""
     if remote_replay_setting(cfg):
         # Reverb-style experience path: this player streams raw
         # transitions into the trainer-resident replay service instead of
-        # sampling its own buffer shard (replay/service.py)
+        # sampling its own buffer shard (replay/service.py).  Centralized
+        # inference is not wired on this path (the free-running trainer
+        # has no between-rounds boundary to swap at) — see howto/serving.md.
         return _player_loop_remote(
             cfg, spec, state_counters, world_size, env_offset, n_local_envs, join=join
         )
@@ -197,11 +204,15 @@ def _player_loop(
             for k, v in metrics.items():
                 aggregator.update(k, v)
 
+    # protocol-wait ceiling: the PR-6 liveness knobs, not the hard-coded
+    # module constant — a hung broadcast fails fast with a clear error
+    # when the operator tightens algo.liveness_timeout
+    timeout_s = knobs["liveness_timeout"]
     follower = ParamsFollower(
         channel,
         lag=knobs["lag"],
         initial_seq=-1,
-        timeout=_QUEUE_TIMEOUT_S,
+        timeout=timeout_s,
         on_stale=_apply_params_extra,
     )
 
@@ -253,6 +264,28 @@ def _player_loop(
         device=host_cpu,
     )
     init_frame.release()
+
+    # centralized inference (algo.inference=remote) — see ppo_decoupled:
+    # `acting` keeps the local path literally the pre-serve call
+    infer_client = None
+    acting = player
+    if infer_spec is not None:
+        from sheeprl_tpu.serve import SAC_OUT_KEYS, InferenceClient, RemoteActor, inference_knobs
+
+        ik = inference_knobs(cfg)
+        infer_client = InferenceClient(
+            infer_spec.player_channel(peer_alive=parent_alive, who="inference server"),
+            player_id,
+            request_timeout_s=ik["request_timeout_s"],
+            max_retries=ik["max_retries"],
+            backoff_base_s=ik["backoff_base_s"],
+            hedge_s=ik["hedge_s"],
+            breaker_threshold=ik["breaker_threshold"],
+            breaker_cooldown_s=ik["breaker_cooldown_s"],
+        )
+        acting = RemoteActor(infer_client, player, mlp_keys, SAC_OUT_KEYS)
+        if lead:
+            observability.serve_stats = infer_client.stats
 
     if lead:
         save_configs(cfg, log_dir)
@@ -334,7 +367,7 @@ def _player_loop(
             if iter_num <= learning_starts:
                 actions = envs.action_space.sample()
             else:
-                actions = np.asarray(player.get_actions(obs, runtime.next_key()))
+                actions = np.asarray(acting.get_actions(obs, runtime.next_key()))
             next_obs, rewards, terminated, truncated, infos = envs.step(
                 actions.reshape(envs.action_space.shape)
             )
@@ -385,7 +418,7 @@ def _player_loop(
                     with trace_scope("ipc_send_shard"):
                         channel.send(
                             "data", arrays=sample, extra=(g, iter_num), seq=update_round,
-                            timeout=_QUEUE_TIMEOUT_S,
+                            timeout=timeout_s,
                         )
                     # fixed-lag adoption: after shipping round u, act on the
                     # actor of update u - lag (lag 0 = the lock-step protocol)
@@ -402,7 +435,7 @@ def _player_loop(
         # and save_last still checkpoint)
         if lead and ckpt_mgr.should_checkpoint(policy_step, is_last=iter_num == total_iters):
             try:
-                channel.send("ckpt_req", timeout=_QUEUE_TIMEOUT_S)
+                channel.send("ckpt_req", timeout=timeout_s)
                 frame = follower.wait_tag("ckpt_state")
             except PeerDiedError as e:
                 _die_with_dump(e, policy_step, iter_num)
@@ -491,6 +524,8 @@ def _player_loop(
         channel.send("stop")
     except Exception:
         pass  # a dead trainer cannot receive it; exit anyway
+    if infer_client is not None:
+        infer_client.close()
     if ckpt_mgr is not None:
         ckpt_mgr.close()
     if preemption is not None:
@@ -926,9 +961,18 @@ def main(runtime, cfg: Dict[str, Any]):
             "Set buffer.remote_replay=true for a self-healing SAC pool."
         )
 
+    from sheeprl_tpu.serve import inference_setting
+
+    inference = inference_setting(cfg, knobs["num_players"])
     ctx = mp.get_context("spawn")
-    hub, channels, procs, env_shards = spawn_players(
-        cfg, runtime, ctx, _player_loop, extra_args=(counters, ratio_state, runtime.world_size), knobs=knobs
+    hub, channels, procs, env_shards, infer_hub = spawn_players(
+        cfg,
+        runtime,
+        ctx,
+        _player_loop,
+        extra_args=(counters, ratio_state, runtime.world_size),
+        knobs=knobs,
+        with_inference=inference == "remote",
     )
     fanin = FanIn(channels)
 
@@ -1006,6 +1050,34 @@ def main(runtime, cfg: Dict[str, Any]):
 
         trainer_mon = RecompileMonitor(name="sac_decoupled_trainer").install()
 
+        # centralized inference — see ppo_decoupled: the server thread
+        # serves the players' obs frames with THIS process's actor params
+        # (swapped between batches each round)
+        serve_server = serve_sup = None
+        if infer_hub is not None:
+            from sheeprl_tpu.resilience import ServeSupervisor, child_alive
+            from sheeprl_tpu.serve import InferenceServer, inference_knobs, make_sac_policy_fn
+
+            ik = inference_knobs(cfg)
+            serve_server = InferenceServer(
+                make_sac_policy_fn(actor, cfg.algo.mlp_keys.encoder),
+                params["actor"],
+                deadline_ms=ik["deadline_ms"],
+                max_batch=ik["max_batch"],
+                seed=cfg.seed + 1,
+                name="sac",
+            )
+            for pid, proc in enumerate(procs):
+                ch = infer_hub.channel(pid, timeout=_QUEUE_TIMEOUT_S, peer_alive=proc.is_alive)
+                ch.set_peer(child_alive(proc), f"player[{pid}]")
+                serve_server.attach(pid, ch)
+            serve_server.start()
+            serve_sup = ServeSupervisor(
+                serve_server,
+                restart_budget=ik["restart_budget"],
+                backoff_base=ik["restart_backoff_s"],
+            )
+
         def _on_control(pid: int, frame) -> None:
             """``ckpt_req`` from the lead: answer with the full agent +
             optimizer state (pickled trees — checkpoint cadence only, and
@@ -1024,6 +1096,8 @@ def main(runtime, cfg: Dict[str, Any]):
         fanin.broadcast("params", arrays=_flat_leaves(_np_tree(params["actor"])), seq=0)
 
         while True:
+            if serve_sup is not None:
+                serve_sup.poll()
             try:
                 with trace_scope("ipc_wait_rollout"):
                     seq, frames = fanin.gather(timeout=_QUEUE_TIMEOUT_S, on_control=_on_control)
@@ -1085,8 +1159,15 @@ def main(runtime, cfg: Dict[str, Any]):
             train_metrics["trainer_compiles"] = trainer_mon.compiles
             trainer_mon.mark_warmup_complete()  # first update done: further compiles are retraces
 
+            if serve_server is not None:
+                serve_server.swap_params(params["actor"])
+
             stats = fanin.stats(knobs["backend"])
             stats["events"] = fanin.events[-8:]
+            if serve_server is not None:
+                stats["serve"] = serve_server.stats()
+                if serve_sup is not None:
+                    stats["serve"]["supervisor"] = serve_sup.stats()
             if health.enabled:
                 stats["health"] = health.stats()
             fanin.broadcast(
@@ -1098,6 +1179,8 @@ def main(runtime, cfg: Dict[str, Any]):
             hard_exit_point("trainer_exit")  # fault site: trainer crash after replying
 
         trainer_mon.uninstall()
+        if serve_server is not None:
+            serve_server.close()  # graceful drain: answer pending, send stops
         # the lead still runs its test episode + logger shutdown after the
         # stop sentinel — give it ample time before the terminate fallback
         for proc in procs:
@@ -1106,6 +1189,8 @@ def main(runtime, cfg: Dict[str, Any]):
         preemption.uninstall()
         fanin.close()
         hub.close()
+        if infer_hub is not None:
+            infer_hub.close()
         for proc in procs:
             if proc.is_alive():
                 proc.terminate()
@@ -1127,8 +1212,16 @@ def _main_remote(runtime, cfg: Dict[str, Any], knobs, state, counters, ratio_sta
     ever-staler ratio."""
     start_iter = counters[0]
 
+    from sheeprl_tpu.serve import inference_setting
+
+    if inference_setting(cfg, knobs["num_players"]) == "remote":
+        warnings.warn(
+            "algo.inference=remote is not wired for the remote-replay SAC topology "
+            "(the free-running trainer has no between-rounds boundary to swap served "
+            "params at); players act locally — see howto/serving.md."
+        )
     ctx = mp.get_context("spawn")
-    hub, channels, proc_list, env_shards = spawn_players(
+    hub, channels, proc_list, env_shards, _ = spawn_players(
         cfg, runtime, ctx, _player_loop, extra_args=(counters, ratio_state, runtime.world_size), knobs=knobs
     )
     procs: Dict[int, Any] = dict(enumerate(proc_list))

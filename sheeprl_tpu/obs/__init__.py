@@ -87,6 +87,11 @@ class Observability:
         # records carry a "health" section (verdicts, skip/rollback
         # counters, z-scores)
         self.health_stats: Optional[Any] = None
+        # zero-arg provider of inference-serving stats; the serve client
+        # and/or server (serve/) attach here so the records carry a
+        # "serve" section (p50/p95 latency, queue depth, batch-size
+        # histogram, breaker state, dedupe/audit counters)
+        self.serve_stats: Optional[Any] = None
         if not self.enabled:
             return
         self._world_size = max(1, int(world_size))
@@ -139,6 +144,11 @@ class Observability:
         if self.health_stats is not None:
             try:
                 extra = {**(extra or {}), "health": self.health_stats()}
+            except Exception:
+                pass
+        if self.serve_stats is not None:
+            try:
+                extra = {**(extra or {}), "serve": self.serve_stats()}
             except Exception:
                 pass
         record = make_record(

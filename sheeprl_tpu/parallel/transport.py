@@ -66,6 +66,8 @@ from sheeprl_tpu.resilience.peer import PeerDiedError, queue_get_from_peer
 # "ckpt_req"/"ckpt_state"/"stop" (the fan-in protocol) plus the replay
 # service's RB_INSERT_TAG/RB_CREDIT_TAG (player→trainer raw-experience
 # inserts and the trainer's rate-limiter credit grants; replay/service.py)
+# and the inference service's INFER_REQ_TAG/INFER_REP_TAG (env-worker
+# observation frames and the server's action replies; serve/service.py)
 __all__ = [
     "Channel",
     "ChannelSpec",
@@ -73,6 +75,8 @@ __all__ = [
     "Frame",
     "HB_TAG",
     "HeartbeatSender",
+    "INFER_REP_TAG",
+    "INFER_REQ_TAG",
     "JOIN_TAG",
     "ParamsFollower",
     "QueueChannel",
@@ -95,6 +99,14 @@ __all__ = [
 # thread emits so the supervisor can see silence, not just process death
 JOIN_TAG = "join"
 HB_TAG = "hb"
+
+# inference-service tags (serve/): an env worker ships one observation
+# frame per request (seq = its monotonic request id — the dedupe key on
+# BOTH sides), the server answers with the action arrays under the same
+# seq; late/duplicate replies drop by id, duplicate requests answer from
+# the server's acted cache
+INFER_REQ_TAG = "infer_req"
+INFER_REP_TAG = "infer_rep"
 
 _BACKENDS = ("queue", "shm", "tcp")
 

@@ -53,6 +53,7 @@ from sheeprl_tpu.resilience.peer import (
 from sheeprl_tpu.resilience.preemption import PreemptionHandler
 from sheeprl_tpu.resilience.supervisor import (
     PlayerSupervisor,
+    ServeSupervisor,
     strip_player_faults,
     supervisor_knobs,
 )
@@ -73,6 +74,7 @@ __all__ = [
     "PeerDiedError",
     "PlayerSupervisor",
     "PreemptionHandler",
+    "ServeSupervisor",
     "child_alive",
     "fault_arg",
     "fault_point",

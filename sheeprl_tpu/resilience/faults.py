@@ -53,6 +53,15 @@ Instrumented sites:
                             buffer layer (``ReplayBuffer.sample`` / a remote
                             ``rb_insert`` frame) — models silent data
                             corruption reaching the learner
+``server_exit``             the inference server's serving loop dies abruptly
+                            between two batches, dropping its in-flight
+                            requests (serve/service.py; clients must trip
+                            their breakers to the local fallback policy and
+                            re-promote once the supervisor respawns it)
+``infer_delay``             the inference server sleeps ``arg`` seconds
+                            before answering a batch (models a slow/hung
+                            batch; exercises client deadlines, hedged
+                            resend and the retry dedupe)
 ==========================  ====================================================
 
 ``fault_point(name)`` returns True exactly when the armed site fires (a
@@ -85,6 +94,8 @@ KNOWN_SITES = (
     "nan_inject",
     "loss_spike",
     "rb_corrupt",
+    "server_exit",
+    "infer_delay",
 )
 
 

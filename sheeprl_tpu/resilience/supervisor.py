@@ -39,7 +39,7 @@ from typing import Any, Callable, Dict, List, Optional
 from sheeprl_tpu.resilience.faults import ENV_VAR as FAULTS_ENV_VAR
 from sheeprl_tpu.resilience.peer import child_alive
 
-__all__ = ["PlayerSupervisor", "strip_player_faults", "supervisor_knobs"]
+__all__ = ["PlayerSupervisor", "ServeSupervisor", "strip_player_faults", "supervisor_knobs"]
 
 
 def supervisor_knobs(cfg) -> Dict[str, Any]:
@@ -241,3 +241,66 @@ class PlayerSupervisor:
         """Stop supervising (run teardown): pending restarts are dropped."""
         self._closed = True
         self._next_attempt.clear()
+
+
+class ServeSupervisor:
+    """Restart policy for a dead inference server (serve/service.py).
+
+    The serving loop is a thread of the trainer process, so "death" means
+    the loop aborted (the ``server_exit`` fault, or an unexpected
+    exception) while the params and the request channels live on.  The
+    trainer polls this once per round: a dead server is respawned in
+    DRAIN-RECOVER mode (the reborn loop answers the request backlog
+    sitting in the channels — dedupe-checked — before resuming deadline
+    batching) with exponential backoff under a restart budget.  Once the
+    budget is spent the serving plane stays down and every client rides
+    its local fallback policy for the rest of the run."""
+
+    def __init__(self, server, *, restart_budget: int = 3, backoff_base: float = 0.5, backoff_max: float = 10.0):
+        self.server = server
+        self.restart_budget = int(restart_budget)
+        self.backoff_base = float(backoff_base)
+        self.backoff_max = float(backoff_max)
+        self.restarts = 0
+        self._next_attempt: Optional[float] = None
+        self.events: List[Dict[str, Any]] = []
+
+    @property
+    def budget_remaining(self) -> int:
+        return max(0, self.restart_budget - self.restarts)
+
+    def poll(self) -> bool:
+        """One pass; True when the server was respawned this call."""
+        if self.server.alive:
+            self._next_attempt = None
+            return False
+        if self.budget_remaining <= 0:
+            return False
+        now = time.monotonic()
+        if self._next_attempt is None:
+            delay = min(self.backoff_base * (2 ** self.restarts), self.backoff_max)
+            self._next_attempt = now + delay
+            self.events.append(
+                {
+                    "event": "server_restart_scheduled",
+                    "delay_s": round(delay, 2),
+                    "reason": self.server.dead_reason,
+                }
+            )
+            return False
+        if now < self._next_attempt:
+            return False
+        self._next_attempt = None
+        self.restarts += 1
+        self.server.respawn()
+        self.events.append(
+            {"event": "server_restart", "attempt": self.restarts, "budget_remaining": self.budget_remaining}
+        )
+        return True
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "restarts": self.restarts,
+            "budget_remaining": self.budget_remaining,
+            "events": self.events[-8:],
+        }

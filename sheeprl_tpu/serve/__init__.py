@@ -1,0 +1,85 @@
+"""sheeprl_tpu.serve — fault-tolerant SEED-style centralized inference.
+
+The serving plane that turns the N-player topology into a production
+policy endpoint (ROADMAP item 2): env workers ship observation frames
+over the ``queue|shm|tcp`` Channel API, one trainer/TPU-side
+:class:`~sheeprl_tpu.serve.service.InferenceServer` batches them
+(deadline + max-batch, bucketed batch sizes = one XLA trace each) and
+streams actions back; each worker's
+:class:`~sheeprl_tpu.serve.client.InferenceClient` owns the failure
+envelope — per-request deadlines, retry with exponential backoff,
+optional hedged resend, and a circuit breaker that trips to the LOCAL
+fallback policy (the last-adopted params broadcast) and re-promotes to
+remote when the server comes back.  The server survives checkpoint
+churn too: the hot-swap watcher validates newly good-tagged checkpoints
+(PR-7 ``health_tags.json``) and swaps params between batches, refusing
+quarantined/corrupt candidates.
+
+Wiring: ``algo.inference = local | remote | auto`` in the decoupled
+loops (``local`` — the default — is bit-exact with the pre-serve
+players); ``scripts/serve_policy.py`` points the same server at a
+checkpoint for offline/production serving.  See ``howto/serving.md``.
+"""
+
+from sheeprl_tpu.serve.client import CircuitBreaker, InferenceClient, RemoteActor
+from sheeprl_tpu.serve.policy import (
+    PPO_OUT_KEYS,
+    SAC_OUT_KEYS,
+    agent_params_loader,
+    make_ppo_policy_fn,
+    make_sac_policy_fn,
+)
+from sheeprl_tpu.serve.service import InferenceServer, bucket_for
+
+__all__ = [
+    "CircuitBreaker",
+    "InferenceClient",
+    "InferenceServer",
+    "PPO_OUT_KEYS",
+    "RemoteActor",
+    "SAC_OUT_KEYS",
+    "agent_params_loader",
+    "bucket_for",
+    "inference_knobs",
+    "inference_setting",
+    "make_ppo_policy_fn",
+    "make_sac_policy_fn",
+]
+
+
+def inference_setting(cfg, num_players: int = 1) -> str:
+    """Resolve ``algo.inference`` (env override ``SHEEPRL_INFERENCE``)
+    to ``local`` | ``remote``.  ``auto`` goes remote only when there is
+    a fan-out for the server to amortize over (num_players > 1)."""
+    import os
+
+    val = cfg.algo.get("inference", "local")
+    env = os.environ.get("SHEEPRL_INFERENCE")
+    if env is not None:
+        val = env
+    s = str(val).lower()
+    if s in ("remote", "server", "seed"):
+        return "remote"
+    if s in ("auto",):
+        return "remote" if int(num_players) > 1 else "local"
+    return "local"
+
+
+def inference_knobs(cfg) -> dict:
+    """The ``algo.serve.*`` configuration surface, resolved with
+    defaults (shared by both decoupled loops and the standalone
+    server)."""
+    serve = cfg.algo.get("serve", None) or {}
+    return {
+        "deadline_ms": float(serve.get("deadline_ms", 5.0)),
+        "max_batch": int(serve.get("max_batch", 64)),
+        "request_timeout_s": float(serve.get("request_timeout_s", 2.0)),
+        "max_retries": int(serve.get("max_retries", 2)),
+        "backoff_base_s": float(serve.get("backoff_base_s", 0.05)),
+        "hedge_s": float(serve.get("hedge_ms", 0.0)) / 1e3,
+        "breaker_threshold": int(serve.get("breaker_threshold", 3)),
+        "breaker_cooldown_s": float(serve.get("breaker_cooldown_s", 3.0)),
+        "watch_interval_s": float(serve.get("watch_interval_s", 2.0)),
+        "restart_budget": int(serve.get("restart_budget", 3)),
+        "restart_backoff_s": float(serve.get("restart_backoff_s", 0.5)),
+    }

@@ -1,0 +1,301 @@
+"""Player/env-worker side of the SEED-style inference service.
+
+:class:`InferenceClient` wraps one duplex transport channel to the
+:class:`~sheeprl_tpu.serve.service.InferenceServer` and owns the WHOLE
+failure envelope of a remote action request, so the env loop never
+stalls on a sick serving plane:
+
+- **per-request deadline** — every request gets ``request_timeout_s`` to
+  come back; a late reply is dropped by request id, never mistaken for a
+  fresh one;
+- **retry + exponential backoff** — a timed-out request is re-sent with
+  the SAME request id (the server's dedupe cache answers from cache if
+  the first copy was actually acted, so a retry can never double-act an
+  observation) up to ``max_retries`` times, sleeping
+  ``backoff_base_s * 2**attempt`` between attempts;
+- **hedged resend** — optionally (``hedge_s > 0``) the request is
+  re-sent once mid-attempt after ``hedge_s`` of silence, cutting the
+  tail latency of a slow batch without waiting for the full timeout
+  (same id: the duplicate is deduped server-side, the second reply is
+  dropped client-side);
+- **circuit breaker → local fallback** — ``breaker_threshold``
+  consecutive request failures trip the breaker OPEN: requests stop
+  going remote and the caller serves actions from the LOCAL policy (the
+  last-adopted params broadcast — every decoupled player still follows
+  the params stream precisely so this fallback is always warm).  After
+  ``breaker_cooldown_s`` the breaker goes HALF-OPEN: exactly one probe
+  request tries the remote path again — success re-promotes to CLOSED
+  (remote serving resumes seamlessly), failure re-opens for another
+  cooldown.
+
+Every decision is counted (:meth:`InferenceClient.stats`) and rides the
+telemetry ``serve`` key, so the request-id audit — every request either
+used a remote reply or a local action, none lost, none double-acted —
+is checkable from the JSONL alone.
+"""
+
+from __future__ import annotations
+
+import queue as queue_mod
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from sheeprl_tpu.parallel.transport import INFER_REP_TAG, INFER_REQ_TAG
+from sheeprl_tpu.resilience.peer import PeerDiedError
+
+__all__ = ["CircuitBreaker", "InferenceClient", "RemoteActor"]
+
+
+class CircuitBreaker:
+    """closed -> (threshold consecutive failures) -> open -> (cooldown)
+    -> half_open -> one probe -> closed | open."""
+
+    def __init__(self, threshold: int = 3, cooldown_s: float = 3.0):
+        self.threshold = max(1, int(threshold))
+        self.cooldown_s = float(cooldown_s)
+        self.state = "closed"
+        self.failures = 0  # consecutive
+        self.trips = 0
+        self.reopens = 0
+        self.promotions = 0  # half_open -> closed recoveries
+        self._opened_at = 0.0
+
+    def allow_remote(self) -> bool:
+        """True when a request may try the remote path; transitions
+        open -> half_open once the cooldown has elapsed."""
+        if self.state == "closed":
+            return True
+        if self.state == "open":
+            if time.monotonic() - self._opened_at >= self.cooldown_s:
+                self.state = "half_open"
+                return True
+            return False
+        return True  # half_open: the single in-flight probe
+
+    def record_success(self) -> None:
+        if self.state == "half_open":
+            self.promotions += 1
+        self.state = "closed"
+        self.failures = 0
+
+    def record_failure(self) -> None:
+        self.failures += 1
+        if self.state == "half_open":
+            self.state = "open"
+            self.reopens += 1
+            self._opened_at = time.monotonic()
+        elif self.state == "closed" and self.failures >= self.threshold:
+            self.state = "open"
+            self.trips += 1
+            self._opened_at = time.monotonic()
+
+
+class InferenceClient:
+    """One env worker's remote-inference endpoint (see module docstring).
+
+    ``infer(arrays)`` returns ``(outputs, source)`` where ``outputs`` is
+    the reply's array dict (``None`` when the caller must act locally)
+    and ``source`` is ``"remote"`` | ``"local"``.  The caller owns the
+    local policy — this class only decides WHICH path serves the step.
+    """
+
+    def __init__(
+        self,
+        channel,
+        client_id: int,
+        *,
+        request_timeout_s: float = 2.0,
+        max_retries: int = 2,
+        backoff_base_s: float = 0.05,
+        hedge_s: float = 0.0,
+        breaker_threshold: int = 3,
+        breaker_cooldown_s: float = 3.0,
+    ):
+        self._chan = channel
+        self.client_id = int(client_id)
+        self.request_timeout_s = float(request_timeout_s)
+        self.max_retries = max(0, int(max_retries))
+        self.backoff_base_s = float(backoff_base_s)
+        self.hedge_s = float(hedge_s)
+        self.breaker = CircuitBreaker(breaker_threshold, breaker_cooldown_s)
+        self._next_id = 1
+        self._last_arrays: List[Tuple[str, np.ndarray]] = []  # hedge resend payload
+        self._last_rows = 0
+        self._server_stopped = False  # server sent its drain "stop" frame
+        # counters (the telemetry audit surface)
+        self.requests = 0
+        self.remote_used = 0
+        self.local_fallbacks = 0
+        self.retries = 0
+        self.hedges = 0
+        self.stale_replies = 0
+        self.send_failures = 0
+        self._lat = _LatencyWindow()
+
+    # ------------------------------------------------------------------ wire
+    def _send(self, req_id: int, arrays: List[Tuple[str, np.ndarray]], rows: int) -> None:
+        self._chan.send(
+            INFER_REQ_TAG,
+            arrays=arrays,
+            extra=(self.client_id, int(rows)),
+            seq=req_id,
+            timeout=self.request_timeout_s,
+        )
+
+    def _await_reply(self, req_id: int, timeout: float) -> Optional[Dict[str, np.ndarray]]:
+        """Wait for the reply to EXACTLY ``req_id``; hedge-duplicates and
+        late replies to earlier ids are dropped by seq."""
+        deadline = time.monotonic() + timeout
+        hedged = False
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return None
+            if self.hedge_s > 0 and not hedged and timeout - remaining >= self.hedge_s:
+                hedged = True
+                self.hedges += 1
+                try:
+                    # same id: the server dedupes, the extra reply drops here
+                    self._chan.send(INFER_REQ_TAG, arrays=self._last_arrays,
+                                    extra=(self.client_id, self._last_rows),
+                                    seq=req_id, timeout=remaining)
+                except Exception:
+                    pass  # a failed hedge is just a missing optimization
+            try:
+                frame = self._chan.recv(timeout=min(remaining, self.hedge_s or remaining, 0.25))
+            except queue_mod.Empty:
+                continue
+            except PeerDiedError:
+                return None
+            if frame.tag == "stop":
+                frame.release()
+                self._server_stopped = True
+                return None
+            if frame.tag != INFER_REP_TAG or frame.seq != req_id:
+                self.stale_replies += 1
+                frame.release()
+                continue
+            out = frame.arrays_copy()
+            frame.release()
+            return out
+
+    def _try_remote(self, arrays, rows: int, probe: bool = False) -> Optional[Dict[str, np.ndarray]]:
+        req_id = self._next_id
+        self._next_id += 1
+        self._last_arrays, self._last_rows = arrays, rows
+        attempts = 1 if probe else self.max_retries + 1
+        t0 = time.monotonic()
+        for attempt in range(attempts):
+            try:
+                self._send(req_id, arrays, rows)
+            except (PeerDiedError, queue_mod.Full, OSError):
+                self.send_failures += 1
+                return None
+            out = self._await_reply(req_id, self.request_timeout_s)
+            if out is not None:
+                self._lat.add(time.monotonic() - t0)
+                return out
+            if self._server_stopped:
+                return None
+            if attempt + 1 < attempts:
+                self.retries += 1
+                time.sleep(min(self.backoff_base_s * (2 ** attempt), 1.0))
+        return None
+
+    # ------------------------------------------------------------------- api
+    def infer(self, arrays: List[Tuple[str, np.ndarray]], rows: int) -> Tuple[Optional[Dict[str, np.ndarray]], str]:
+        """One observation frame through the failure envelope."""
+        self.requests += 1
+        if self._server_stopped or not self.breaker.allow_remote():
+            self.local_fallbacks += 1
+            return None, "local"
+        out = self._try_remote(arrays, rows, probe=self.breaker.state == "half_open")
+        if out is not None:
+            self.breaker.record_success()
+            self.remote_used += 1
+            return out, "remote"
+        self.breaker.record_failure()
+        self.local_fallbacks += 1
+        return None, "local"
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "role": "client",
+            "client_id": self.client_id,
+            "breaker": self.breaker.state,
+            "breaker_trips": self.breaker.trips,
+            "breaker_reopens": self.breaker.reopens,
+            "breaker_promotions": self.breaker.promotions,
+            "requests": self.requests,
+            "remote_used": self.remote_used,
+            "local_fallbacks": self.local_fallbacks,
+            # the audit invariant: every request was served exactly once
+            "unaccounted": self.requests - self.remote_used - self.local_fallbacks,
+            "retries": self.retries,
+            "hedges": self.hedges,
+            "stale_replies": self.stale_replies,
+            "send_failures": self.send_failures,
+            "latency_ms": self._lat.percentiles(),
+        }
+
+    def close(self) -> None:
+        try:
+            self._chan.close()
+        except Exception:
+            pass
+
+
+class _LatencyWindow:
+    """Bounded request-latency sample for p50/p95 (thread-safe)."""
+
+    def __init__(self, depth: int = 512):
+        self._depth = depth
+        self._buf: List[float] = []
+        self._lock = threading.Lock()
+
+    def add(self, seconds: float) -> None:
+        with self._lock:
+            self._buf.append(float(seconds))
+            if len(self._buf) > self._depth:
+                del self._buf[: len(self._buf) - self._depth]
+
+    def percentiles(self) -> Dict[str, float]:
+        with self._lock:
+            buf = list(self._buf)
+        if not buf:
+            return {}
+        arr = np.sort(np.asarray(buf))
+        return {
+            "p50": round(float(np.percentile(arr, 50)) * 1e3, 3),
+            "p95": round(float(np.percentile(arr, 95)) * 1e3, 3),
+            "n": len(buf),
+        }
+
+
+class RemoteActor:
+    """Adapter from a player's ``get_actions(obs, key)`` call to the
+    client envelope: ships the raw obs dict, maps the reply back to the
+    local player's output tuple, and falls back to the local policy
+    (``player.get_actions``) whenever the envelope says so.
+
+    ``out_keys`` names the reply arrays IN ORDER; a single-key reply is
+    returned bare so SAC's one-array contract survives."""
+
+    def __init__(self, client: InferenceClient, player, obs_keys, out_keys):
+        self._client = client
+        self._player = player
+        self._obs_keys = list(obs_keys)
+        self._out_keys = list(out_keys)
+
+    def get_actions(self, obs: Dict[str, np.ndarray], key=None):
+        arrays = [(k, np.asarray(obs[k])) for k in self._obs_keys]
+        rows = int(arrays[0][1].shape[0]) if arrays else 1
+        out, source = self._client.infer(arrays, rows)
+        if source == "local" or out is None:
+            return self._player.get_actions(obs, key)
+        if len(self._out_keys) == 1:
+            return out[self._out_keys[0]]
+        return tuple(out[k] for k in self._out_keys)

@@ -1,0 +1,95 @@
+"""Policy adapters for the inference service.
+
+The server is policy-agnostic: it dispatches
+``policy_fn(params, obs_dict, key) -> Dict[str, np.ndarray]`` on a
+zero-padded bucket-sized observation batch.  These factories build that
+callable for the two decoupled families (one jitted apply; the bucketed
+batch shapes give it one XLA trace per bucket), plus the checkpoint
+loaders the standalone server (scripts/serve_policy.py) and the hot-swap
+watcher use.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Sequence
+
+import numpy as np
+
+__all__ = [
+    "PPO_OUT_KEYS",
+    "SAC_OUT_KEYS",
+    "make_ppo_policy_fn",
+    "make_sac_policy_fn",
+    "agent_params_loader",
+]
+
+# reply-array vocabulary, in the order of the local players' return tuples
+PPO_OUT_KEYS = ("flat_actions", "real_actions", "logprobs", "values")
+SAC_OUT_KEYS = ("actions",)
+
+
+def make_ppo_policy_fn(
+    module, cnn_keys: Sequence[str], *, greedy: bool = False, device=None
+) -> Callable[[Any, Dict[str, np.ndarray], Any], Dict[str, np.ndarray]]:
+    """Batched PPO acting: raw obs dict -> the PPOPlayer output tuple as
+    named arrays (the row count is whatever the bucket says)."""
+    import jax
+
+    from sheeprl_tpu.algos.ppo.agent import sample_actions
+    from sheeprl_tpu.algos.ppo.utils import prepare_obs
+
+    sample = jax.jit(lambda p, o, k: sample_actions(module, p, o, k, greedy))
+
+    def policy_fn(params, obs: Dict[str, np.ndarray], key) -> Dict[str, np.ndarray]:
+        rows = int(next(iter(obs.values())).shape[0])
+        prepared = prepare_obs(obs, cnn_keys=list(cnn_keys), num_envs=rows)
+        if device is not None:
+            prepared = jax.device_put(prepared, device)
+            key = jax.device_put(key, device)
+        out = sample(params, prepared, key)
+        return {k: np.asarray(v) for k, v in zip(PPO_OUT_KEYS, out)}
+
+    return policy_fn
+
+
+def make_sac_policy_fn(
+    actor, mlp_keys: Sequence[str], *, greedy: bool = False, device=None
+) -> Callable[[Any, Dict[str, np.ndarray], Any], Dict[str, np.ndarray]]:
+    """Batched SAC acting (actor only — critics never serve)."""
+    import jax
+
+    from sheeprl_tpu.algos.sac.agent import actor_action_and_log_prob, actor_greedy_action
+    from sheeprl_tpu.algos.sac.utils import prepare_obs
+
+    if greedy:
+        apply = jax.jit(lambda p, o, k: actor_greedy_action(actor, p, o))
+    else:
+        apply = jax.jit(lambda p, o, k: actor_action_and_log_prob(actor, p, o, k)[0])
+
+    def policy_fn(params, obs: Dict[str, np.ndarray], key) -> Dict[str, np.ndarray]:
+        rows = int(next(iter(obs.values())).shape[0])
+        prepared = prepare_obs(obs, mlp_keys=list(mlp_keys), num_envs=rows)
+        if device is not None:
+            prepared = jax.device_put(prepared, device)
+            key = jax.device_put(key, device)
+        return {SAC_OUT_KEYS[0]: np.asarray(apply(params, prepared, key))}
+
+    return policy_fn
+
+
+def agent_params_loader(subtree: str = "agent") -> Callable[[str], Any]:
+    """A ``load_params_fn`` for the hot-swap watcher: pull one subtree
+    out of a validated checkpoint (``agent`` for PPO; SAC serves
+    ``agent.actor``, spelled ``"agent/actor"``)."""
+    from sheeprl_tpu.utils.callback import load_checkpoint
+
+    parts = [p for p in str(subtree).split("/") if p]
+
+    def load(path: str) -> Any:
+        state = load_checkpoint(path)
+        node = state
+        for p in parts:
+            node = node[p]
+        return node
+
+    return load

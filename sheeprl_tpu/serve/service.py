@@ -1,0 +1,477 @@
+"""Trainer/TPU-side half of the SEED-style inference service.
+
+SEED RL (Espeholt et al., 2020) centralizes the policy: env workers ship
+OBSERVATIONS, one accelerator-resident server batches them, runs the
+policy once, and streams ACTIONS back — the params broadcast to N
+workers disappears and a single TPU serves hundreds of dumb CPU env
+loops.  :class:`InferenceServer` is that server, built on the existing
+``queue|shm|tcp`` Channel API (``infer_req``/``infer_rep`` frames), with
+the robustness envelope the papers do not ship:
+
+- **deadline + max-batch adaptive batching** — requests accumulate until
+  the oldest is ``deadline_ms`` old or ``max_batch`` rows are pending,
+  whichever first; a lone worker never waits out a full batch, a burst
+  never fragments into single-row dispatches;
+- **bucketed batch sizes** — the formed batch is zero-padded UP to the
+  next bucket (powers of two by default) so every dispatch reuses one of
+  ``log2(max_batch)`` XLA traces; partial batches ride mask-padded (the
+  pad rows' outputs are sliced off, PR-6 pattern) and the post-warmup
+  compile counter stays flat no matter how ragged the traffic;
+- **request-id dedupe** — a bounded per-client cache of answered
+  requests: a retry/hedge duplicate (client envelope) or a tcp reconnect
+  replay is answered FROM CACHE, so one observation is never acted
+  twice;
+- **graceful drain** — SIGTERM (or :meth:`close`) answers everything
+  pending, then sends each client a ``stop`` frame before the sockets
+  close;
+- **validated hot checkpoint swap** — :meth:`watch` points the server at
+  a run root: newly ``good``-tagged checkpoints (the PR-7
+  ``health_tags.json`` sidecar) are spot-checked (zip CRCs + manifest +
+  finiteness) and swapped in BETWEEN batches with zero dropped requests;
+  quarantined or corrupt candidates are refused and logged, once each;
+- **crash + respawn** — the ``server_exit`` fault site models the
+  serving plane dying between batches (in-flight requests lost); the
+  :class:`ServeSupervisor` (resilience/supervisor.py) respawns it in
+  drain-recover mode: the reborn loop first answers the backlog sitting
+  in the channels (dedupe-checked) before resuming deadline batching.
+
+``policy_fn(params, obs_dict, key) -> Dict[str, np.ndarray]`` is the
+single pluggable: build one with
+:func:`~sheeprl_tpu.serve.policy.make_ppo_policy_fn` /
+:func:`~sheeprl_tpu.serve.policy.make_sac_policy_fn` or bring your own.
+"""
+
+from __future__ import annotations
+
+import os
+import queue as queue_mod
+import threading
+import time
+import warnings
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from sheeprl_tpu.parallel.transport import INFER_REP_TAG, INFER_REQ_TAG
+from sheeprl_tpu.resilience.faults import get_injector
+from sheeprl_tpu.resilience.peer import PeerDiedError
+
+__all__ = ["InferenceServer", "bucket_for"]
+
+
+def bucket_for(rows: int, buckets: Tuple[int, ...]) -> int:
+    """Smallest bucket >= rows; an oversize batch (one request bigger
+    than every bucket) is dispatched at its own width."""
+    for b in buckets:
+        if rows <= b:
+            return b
+    return rows
+
+
+def _default_buckets(max_batch: int) -> Tuple[int, ...]:
+    out, b = [], 1
+    while b < max_batch:
+        out.append(b)
+        b *= 2
+    out.append(int(max_batch))
+    return tuple(out)
+
+
+class _Request:
+    __slots__ = ("client_id", "req_id", "rows", "arrays", "t_arrival")
+
+    def __init__(self, client_id: int, req_id: int, rows: int, arrays: Dict[str, np.ndarray]):
+        self.client_id = client_id
+        self.req_id = req_id
+        self.rows = rows
+        self.arrays = arrays
+        self.t_arrival = time.monotonic()
+
+
+class InferenceServer:
+    """Deadline-batched centralized policy serving (see module docstring)."""
+
+    def __init__(
+        self,
+        policy_fn: Callable[[Any, Dict[str, np.ndarray], Any], Dict[str, np.ndarray]],
+        params: Any,
+        *,
+        deadline_ms: float = 5.0,
+        max_batch: int = 64,
+        buckets: Optional[Tuple[int, ...]] = None,
+        dedupe_depth: int = 256,
+        seed: int = 0,
+        name: str = "serve",
+    ):
+        self._policy_fn = policy_fn
+        self._params = params
+        self.deadline_s = max(0.0, float(deadline_ms)) / 1e3
+        self.max_batch = max(1, int(max_batch))
+        self.buckets = tuple(buckets) if buckets else _default_buckets(self.max_batch)
+        self.dedupe_depth = int(dedupe_depth)
+        self.name = name
+        self._seed = int(seed)
+        self._channels: Dict[int, Any] = {}
+        self._lock = threading.RLock()  # params swap + channel map + stats
+        self._pending: List[_Request] = []
+        self._stop = threading.Event()
+        self._drain = threading.Event()
+        self._dead: Optional[str] = None
+        self._thread: Optional[threading.Thread] = None
+        self._recover_until = 0.0  # drain-recover window after a respawn
+        self._batch_count = 0
+        self._key = None  # lazily built on the serving thread (jax import)
+        # dedupe: per client, answered req_id -> cached reply arrays
+        self._acted: Dict[int, "dict[int, List[Tuple[str, np.ndarray]]]"] = {}
+        # hot-swap watch state
+        self._watch_root: Optional[str] = None
+        self._watch_interval = 2.0
+        self._load_params_fn: Optional[Callable[[str], Any]] = None
+        self._last_watch = 0.0
+        self._current_ckpt: Optional[str] = None
+        self._refused: Dict[str, str] = {}  # path -> reason (log once)
+        # counters (the telemetry surface)
+        self.requests = 0
+        self.acted = 0
+        self.replies = 0
+        self.dedup_hits = 0
+        self.rows_served = 0
+        self.batches = 0
+        self.batch_hist: Dict[int, int] = {}
+        self.swaps_applied = 0
+        self.swaps_refused_quarantined = 0
+        self.swaps_refused_invalid = 0
+        self.deaths = 0
+        self.respawns = 0
+        self.recovered_backlog = 0
+        self._lat: List[float] = []  # bounded request latency window
+
+    # ------------------------------------------------------------ lifecycle
+    def attach(self, client_id: int, channel) -> None:
+        """Register one client's duplex channel (callable any time; the
+        serving loop picks it up on its next poll)."""
+        with self._lock:
+            self._channels[int(client_id)] = channel
+            self._acted.setdefault(int(client_id), {})
+
+    def start(self) -> "InferenceServer":
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._dead = None
+        self._thread = threading.Thread(
+            target=self._serve_loop, name=f"sheeprl-infer-{self.name}", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    @property
+    def alive(self) -> bool:
+        return self._thread is not None and self._thread.is_alive() and self._dead is None
+
+    @property
+    def dead_reason(self) -> Optional[str]:
+        return self._dead
+
+    def respawn(self) -> None:
+        """Restart a DEAD serving loop in drain-recover mode: the reborn
+        thread first answers the request backlog sitting unread in the
+        channels (dedupe-checked — an already-acted id is served from
+        cache), then resumes normal deadline batching.  The params and
+        the dedupe cache live with the owning process, not the serving
+        thread, so both survive the crash."""
+        if self.alive or self._stop.is_set():
+            return
+        self.respawns += 1
+        self._recover_until = time.monotonic() + 1.0
+        self.start()
+
+    def watch(self, run_root: str, load_params_fn: Callable[[str], Any], *, interval_s: float = 2.0) -> None:
+        """Arm the hot-swap watcher: between batches, newly good-tagged
+        checkpoints under ``run_root`` are validated and swapped in;
+        quarantined/corrupt candidates are refused (once each, logged)."""
+        self._watch_root = str(run_root)
+        self._load_params_fn = load_params_fn
+        self._watch_interval = float(interval_s)
+        self._last_watch = time.monotonic()  # first tick a full interval out
+
+    def swap_params(self, params: Any, source: str = "direct") -> None:
+        """Swap the served params between batches (same tree/shape/dtype
+        -> the bucketed jit traces are all reused, zero retraces)."""
+        with self._lock:
+            self._params = params
+        if source != "direct":
+            self._current_ckpt = source
+
+    def request_drain(self) -> None:
+        """Begin graceful drain: answer everything pending, then send
+        each client a ``stop`` frame.  (The SIGTERM path for standalone
+        serving; scripts/serve_policy.py installs the handler.)"""
+        self._drain.set()
+
+    def close(self, timeout: float = 10.0) -> None:
+        self._drain.set()
+        t = self._thread
+        if t is not None and t.is_alive() and t is not threading.current_thread():
+            t.join(timeout=timeout)
+        self._stop.set()
+        with self._lock:
+            channels = list(self._channels.values())
+        for ch in channels:
+            try:
+                ch.close()
+            except Exception:
+                pass
+
+    # ------------------------------------------------------------ the loop
+    def _serve_loop(self) -> None:
+        try:
+            while not self._stop.is_set():
+                got = self._poll_requests()
+                recovering = time.monotonic() < self._recover_until
+                if recovering and got:
+                    self.recovered_backlog += got
+                batch = self._form_batch(force=self._drain.is_set() or recovering)
+                if batch:
+                    inj = get_injector()
+                    if inj.armed and inj.fire("server_exit"):
+                        # crash between batches (site counts FORMED batches,
+                        # so `server_exit:N` dies before its N-th dispatch):
+                        # the in-flight requests die with the loop — clients
+                        # time out, retry, and trip their breakers
+                        with self._lock:
+                            self._pending = []
+                        self.deaths += 1
+                        self._dead = "server_exit fault injected"
+                        return
+                    self._run_batch(batch)
+                elif self._drain.is_set() and not self._pending:
+                    self._send_stops()
+                    return
+                else:
+                    self._maybe_hot_swap()
+                    if not got:
+                        time.sleep(min(self.deadline_s / 2 if self.deadline_s else 0.001, 0.01))
+        except Exception as e:  # pragma: no cover - defensive
+            self._dead = f"{type(e).__name__}: {e}"
+            self.deaths += 1
+
+    def _poll_requests(self) -> int:
+        """Drain whatever is sitting on the client channels (non-blocking
+        sweep); dedupe duplicates straight from cache."""
+        got = 0
+        with self._lock:
+            channels = list(self._channels.items())
+        for cid, ch in channels:
+            for _ in range(64):  # bounded sweep: a flooding client cannot starve siblings
+                try:
+                    frame = ch.recv(timeout=0.0005)
+                except queue_mod.Empty:
+                    break
+                except PeerDiedError:
+                    break
+                if frame.tag != INFER_REQ_TAG:
+                    frame.release()  # stray control frame: not ours to route
+                    continue
+                self.requests += 1
+                req_cid = int(frame.extra[0]) if frame.extra else cid
+                rows = int(frame.extra[1]) if len(frame.extra) > 1 else 1
+                cache = self._acted.setdefault(req_cid, {})
+                if frame.seq in cache:
+                    # retry/hedge/reconnect duplicate of an ACTED request:
+                    # answer from cache, never act it twice
+                    self.dedup_hits += 1
+                    self._reply(req_cid, frame.seq, cache[frame.seq])
+                    frame.release()
+                    continue
+                req = _Request(req_cid, frame.seq, rows, frame.arrays_copy())
+                frame.release()
+                self._pending.append(req)
+                got += 1
+        return got
+
+    def _form_batch(self, force: bool = False) -> List[_Request]:
+        if not self._pending:
+            return []
+        rows = sum(r.rows for r in self._pending)
+        oldest_age = time.monotonic() - self._pending[0].t_arrival
+        # SEED-style early dispatch: clients are synchronous (one request
+        # in flight each), so once EVERY attached client is represented in
+        # the pending set nothing more can arrive until we reply — waiting
+        # out the deadline would be pure added latency
+        covered = bool(self._channels) and len(
+            {r.client_id for r in self._pending}
+        ) >= len(self._channels)
+        if not force and not covered and rows < self.max_batch and oldest_age < self.deadline_s:
+            return []
+        batch: List[_Request] = []
+        taken = 0
+        while self._pending:
+            nxt = self._pending[0]
+            if batch and taken + nxt.rows > self.max_batch:
+                break
+            batch.append(self._pending.pop(0))
+            taken += nxt.rows
+        return batch
+
+    def _next_key(self):
+        import jax
+
+        if self._key is None:
+            self._key = jax.random.PRNGKey(self._seed)
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def _run_batch(self, batch: List[_Request]) -> None:
+        rows = sum(r.rows for r in batch)
+        bucket = bucket_for(rows, self.buckets)
+        keys = list(batch[0].arrays.keys())
+        obs: Dict[str, np.ndarray] = {}
+        for k in keys:
+            parts = [r.arrays[k] for r in batch]
+            cat = np.concatenate(parts, axis=0) if len(parts) > 1 else np.asarray(parts[0])
+            if bucket > rows:  # mask-pad up to the bucket: one trace per bucket
+                pad = np.zeros((bucket - rows,) + cat.shape[1:], dtype=cat.dtype)
+                cat = np.concatenate([cat, pad], axis=0)
+            obs[k] = cat
+        with self._lock:
+            params = self._params
+        t0 = time.monotonic()
+        out = self._policy_fn(params, obs, self._next_key())
+        inj = get_injector()
+        if inj.armed and inj.fire("infer_delay"):
+            time.sleep(inj.arg("infer_delay"))
+        self.batches += 1
+        self.batch_hist[bucket] = self.batch_hist.get(bucket, 0) + 1
+        offset = 0
+        now = time.monotonic()
+        for r in batch:
+            sliced = [(k, np.asarray(v[offset : offset + r.rows])) for k, v in out.items()]
+            offset += r.rows
+            cache = self._acted.setdefault(r.client_id, {})
+            cache[r.req_id] = sliced
+            while len(cache) > self.dedupe_depth:
+                cache.pop(next(iter(cache)))
+            self.acted += 1
+            self.rows_served += r.rows
+            self._lat.append(now - r.t_arrival)
+            self._reply(r.client_id, r.req_id, sliced)
+        if len(self._lat) > 512:
+            del self._lat[: len(self._lat) - 512]
+        del t0  # latency is request-arrival to reply; compute time rides it
+
+    def _reply(self, client_id: int, req_id: int, arrays: List[Tuple[str, np.ndarray]]) -> None:
+        ch = self._channels.get(client_id)
+        if ch is None:
+            return
+        try:
+            ch.send(INFER_REP_TAG, arrays=arrays, extra=(client_id,), seq=req_id, timeout=5.0)
+            self.replies += 1
+        except (PeerDiedError, queue_mod.Full, OSError):
+            pass  # a gone client re-requests or falls back locally
+
+    def _send_stops(self) -> None:
+        with self._lock:
+            channels = list(self._channels.values())
+        for ch in channels:
+            try:
+                ch.send("stop", timeout=2.0)
+            except Exception:
+                pass
+
+    # ------------------------------------------------------------- hot swap
+    def _maybe_hot_swap(self) -> None:
+        if self._watch_root is None or self._load_params_fn is None:
+            return
+        now = time.monotonic()
+        if now - self._last_watch < self._watch_interval:
+            return
+        self._last_watch = now
+        self.poll_hot_swap()
+
+    def poll_hot_swap(self) -> Optional[str]:
+        """One watcher tick (also callable directly, e.g. from tests or
+        the trainer between rounds): walk the checkpoints under the watch
+        root newest-first down to the one being served; refuse
+        quarantined/corrupt candidates (remembered, logged once each),
+        hold off on ``pending``-tagged ones (the sentinel has not judged
+        them yet — they may promote on a later tick), swap in the first
+        acceptable one.  Returns the path swapped in, or None."""
+        from sheeprl_tpu.resilience.autoresume import list_checkpoints
+        from sheeprl_tpu.resilience.sentinel import CheckpointHealthTags
+        from sheeprl_tpu.utils.ckpt_format import (
+            CheckpointCorruptError,
+            spot_check_finite,
+            validate_checkpoint,
+        )
+
+        tags_by_dir: Dict[str, CheckpointHealthTags] = {}
+        for path in list_checkpoints(self._watch_root):  # newest first
+            apath = os.path.abspath(path)
+            if apath == self._current_ckpt:
+                return None  # nothing acceptable newer than what we serve
+            if apath in self._refused:
+                continue
+            d = os.path.dirname(apath)
+            if d not in tags_by_dir:
+                tags_by_dir[d] = CheckpointHealthTags(d)
+            status = tags_by_dir[d].status(apath)
+            if status == "quarantined":
+                self.swaps_refused_quarantined += 1
+                self._refused[apath] = "quarantined"
+                warnings.warn(f"serve hot-swap REFUSED quarantined checkpoint {path}")
+                continue
+            if status == "pending":
+                continue  # not refused: it may promote to good later
+            try:
+                validate_checkpoint(path)
+                spot_check_finite(path)
+            except (CheckpointCorruptError, OSError) as e:
+                self.swaps_refused_invalid += 1
+                self._refused[apath] = f"invalid: {e}"
+                warnings.warn(f"serve hot-swap REFUSED corrupt checkpoint {path} ({e})")
+                continue
+            try:
+                params = self._load_params_fn(path)
+            except Exception as e:
+                self.swaps_refused_invalid += 1
+                self._refused[apath] = f"load failed: {e}"
+                warnings.warn(f"serve hot-swap REFUSED unloadable checkpoint {path} ({e})")
+                continue
+            self.swap_params(params, source=apath)
+            self.swaps_applied += 1
+            return apath
+        return None
+
+    # ------------------------------------------------------------ telemetry
+    def stats(self) -> Dict[str, Any]:
+        lat = {}
+        if self._lat:
+            arr = np.sort(np.asarray(self._lat))
+            lat = {
+                "p50": round(float(np.percentile(arr, 50)) * 1e3, 3),
+                "p95": round(float(np.percentile(arr, 95)) * 1e3, 3),
+                "n": len(self._lat),
+            }
+        state = "dead" if self._dead else ("draining" if self._drain.is_set() else "serving")
+        return {
+            "role": "server",
+            "state": state,
+            "requests": self.requests,
+            "acted": self.acted,
+            "replies": self.replies,
+            "dedup_hits": self.dedup_hits,
+            "rows_served": self.rows_served,
+            "batches": self.batches,
+            "batch_hist": {str(k): v for k, v in sorted(self.batch_hist.items())},
+            "queue_depth": len(self._pending),
+            "latency_ms": lat,
+            "swaps": {
+                "applied": self.swaps_applied,
+                "refused_quarantined": self.swaps_refused_quarantined,
+                "refused_invalid": self.swaps_refused_invalid,
+                "current": os.path.basename(self._current_ckpt) if self._current_ckpt else None,
+            },
+            "deaths": self.deaths,
+            "respawns": self.respawns,
+            "recovered_backlog": self.recovered_backlog,
+        }

@@ -1,0 +1,172 @@
+"""InferenceClient failure-envelope suite: breaker state machine,
+deadline + retry + backoff, hedged resend dedupe, local fallback, and
+the request-id audit invariant."""
+
+import multiprocessing as mp
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.parallel.transport import INFER_REP_TAG, INFER_REQ_TAG, make_transport
+from sheeprl_tpu.serve import CircuitBreaker, InferenceClient, InferenceServer
+
+pytestmark = pytest.mark.serve
+
+
+# ------------------------------------------------------------------ breaker
+def test_breaker_trips_after_threshold_and_half_opens():
+    b = CircuitBreaker(threshold=3, cooldown_s=0.1)
+    assert b.allow_remote()
+    b.record_failure(), b.record_failure()
+    assert b.state == "closed" and b.allow_remote()
+    b.record_failure()
+    assert b.state == "open" and b.trips == 1
+    assert not b.allow_remote()  # cooling down
+    time.sleep(0.12)
+    assert b.allow_remote() and b.state == "half_open"
+    b.record_success()
+    assert b.state == "closed" and b.promotions == 1
+
+
+def test_breaker_reopens_on_failed_probe():
+    b = CircuitBreaker(threshold=1, cooldown_s=0.05)
+    b.record_failure()
+    assert b.state == "open"
+    time.sleep(0.06)
+    assert b.allow_remote()  # the probe
+    b.record_failure()
+    assert b.state == "open" and b.reopens == 1
+    b.record_success()  # eventually a probe lands
+    assert b.state == "closed"
+
+
+def test_breaker_success_resets_consecutive_failures():
+    b = CircuitBreaker(threshold=3)
+    b.record_failure(), b.record_failure()
+    b.record_success()
+    b.record_failure(), b.record_failure()
+    assert b.state == "closed"  # never 3 CONSECUTIVE
+
+
+# ---------------------------------------------------------------- envelope
+def _echo_rig(**client_kw):
+    ctx = mp.get_context("spawn")
+    hub, specs = make_transport(ctx, "queue", 1, window=8, min_bytes=0)
+
+    def policy_fn(params, obs, key):
+        return {"actions": obs["state"] * 2.0}
+
+    srv = InferenceServer(policy_fn, None, deadline_ms=1.0, max_batch=8)
+    srv.attach(0, hub.channel(0, timeout=5))
+    client_kw.setdefault("request_timeout_s", 5.0)
+    c = InferenceClient(specs[0].player_channel(), 0, **client_kw)
+    return srv, c, hub
+
+
+def _obs(rows=1, fill=1.0):
+    return [("state", np.full((rows, 2), fill, np.float32))]
+
+
+def test_remote_happy_path_and_audit():
+    srv, c, hub = _echo_rig()
+    srv.start()
+    try:
+        for i in range(5):
+            out, src = c.infer(_obs(fill=float(i)), 1)
+            assert src == "remote"
+            np.testing.assert_allclose(out["actions"], 2.0 * i)
+        st = c.stats()
+        assert st["requests"] == 5 and st["remote_used"] == 5
+        assert st["unaccounted"] == 0 and st["breaker"] == "closed"
+        assert st["latency_ms"]["n"] == 5
+    finally:
+        srv.close(), c.close(), hub.close()
+
+
+def test_dead_server_times_out_retries_then_falls_back_local():
+    srv, c, hub = _echo_rig(request_timeout_s=0.1, max_retries=2, backoff_base_s=0.01)
+    # server never started: every attempt times out
+    try:
+        t0 = time.monotonic()
+        out, src = c.infer(_obs(), 1)
+        assert out is None and src == "local"
+        st = c.stats()
+        assert st["retries"] == 2 and st["local_fallbacks"] == 1
+        assert time.monotonic() - t0 >= 0.3  # 3 attempts x 0.1s + backoffs
+    finally:
+        srv.close(), c.close(), hub.close()
+
+
+def test_breaker_opens_then_serves_local_without_waiting():
+    srv, c, hub = _echo_rig(
+        request_timeout_s=0.05, max_retries=0, breaker_threshold=2, breaker_cooldown_s=60.0
+    )
+    try:
+        c.infer(_obs(), 1), c.infer(_obs(), 1)  # 2 failures -> open
+        assert c.breaker.state == "open" and c.breaker.trips == 1
+        t0 = time.monotonic()
+        out, src = c.infer(_obs(), 1)
+        assert src == "local" and time.monotonic() - t0 < 0.04  # no remote wait at all
+    finally:
+        srv.close(), c.close(), hub.close()
+
+
+def test_half_open_probe_repromotes_when_server_returns():
+    srv, c, hub = _echo_rig(
+        request_timeout_s=0.1, max_retries=0, breaker_threshold=1, breaker_cooldown_s=0.2
+    )
+    try:
+        out, src = c.infer(_obs(), 1)
+        assert src == "local" and c.breaker.state == "open"
+        srv.start()  # the server comes back
+        time.sleep(0.25)  # cooldown elapses -> next request is the probe
+        out, src = c.infer(_obs(fill=3.0), 1)
+        assert src == "remote" and c.breaker.state == "closed"
+        assert c.breaker.promotions == 1
+        np.testing.assert_allclose(out["actions"], 6.0)
+    finally:
+        srv.close(), c.close(), hub.close()
+
+
+def test_hedged_resend_dedupes_and_single_reply_used(monkeypatch):
+    """infer_delay slows the first batch past the hedge trigger: the
+    hedge duplicate is answered FROM CACHE server-side, and whichever
+    reply arrives second is dropped client-side by request id."""
+    monkeypatch.setenv("SHEEPRL_FAULTS", "infer_delay:1:0.3")
+    from sheeprl_tpu.resilience.faults import get_injector
+
+    get_injector()
+    srv, c, hub = _echo_rig(request_timeout_s=2.0, hedge_s=0.05)
+    srv.start()
+    try:
+        out, src = c.infer(_obs(fill=4.0), 1)
+        assert src == "remote"
+        np.testing.assert_allclose(out["actions"], 8.0)
+        assert c.hedges == 1
+        # the duplicate was never double-acted
+        assert srv.dedup_hits == 1 and srv.acted == 1
+        # the second (cached) reply to the same id is dropped on arrival
+        out, src = c.infer(_obs(fill=1.0), 1)
+        assert src == "remote"
+        assert c.stats()["stale_replies"] >= 1
+    finally:
+        srv.close(), c.close(), hub.close()
+
+
+def test_server_drain_stop_frame_sends_client_local_permanently():
+    srv, c, hub = _echo_rig(request_timeout_s=1.0)
+    srv.start()
+    try:
+        assert c.infer(_obs(), 1)[1] == "remote"
+        srv.request_drain()
+        time.sleep(0.3)  # stop frames land
+        out, src = c.infer(_obs(), 1)
+        assert src == "local"
+        # subsequent requests go local immediately, no timeout burn
+        t0 = time.monotonic()
+        assert c.infer(_obs(), 1)[1] == "local"
+        assert time.monotonic() - t0 < 0.1
+    finally:
+        srv.close(), c.close(), hub.close()

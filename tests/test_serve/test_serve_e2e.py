@@ -1,0 +1,179 @@
+"""End-to-end serving acceptance (ISSUE 8):
+
+- tier-1 deterministic smoke: one server, two queue-backend clients,
+  server killed mid-run -> clients trip to local fallback, the
+  ServeSupervisor respawns it in drain-recover mode, breakers half-open
+  and re-promote, the run completes rc=0 with a clean request-id audit;
+- ``algo.inference=local`` (the default) golden: the serve config
+  surface is inert — two local runs with wildly different serve knobs
+  produce bit-identical agents and no ``serve`` telemetry;
+- the randomized serve soak (scripts/chaos_soak.py --mode serve) under
+  the ``slow`` marker.
+"""
+
+import glob
+import hashlib
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.serve
+
+
+def _base_args(tmp_path, sub, total_steps=4800, extra=()):
+    return [
+        "exp=ppo_decoupled",
+        "env=dummy",
+        "env.sync_env=True",
+        "env.capture_video=False",
+        "fabric.accelerator=cpu",
+        "fabric.devices=1",
+        "metric.log_level=1",
+        "metric.log_every=64",
+        f"metric.logger.root_dir={tmp_path}/{sub}/logs",
+        "checkpoint.save_last=True",
+        "buffer.memmap=False",
+        "seed=0",
+        "algo.per_rank_batch_size=4",
+        "algo.dense_units=8",
+        "algo.mlp_layers=1",
+        "algo.mlp_keys.encoder=[state]",
+        f"algo.total_steps={total_steps}",
+        "algo.num_players=2",
+        "algo.decoupled_transport=queue",
+        "algo.run_test=False",
+        f"root_dir={tmp_path}/{sub}/run",
+        "env.num_envs=4",
+        "algo.rollout_steps=4",
+        "algo.update_epochs=1",
+    ] + list(extra)
+
+
+def _records(root):
+    out = []
+    for t in sorted(glob.glob(f"{root}/**/telemetry.jsonl", recursive=True)):
+        for line in open(t):
+            out.append(json.loads(line))
+    return out
+
+
+def _agent_md5(root):
+    from sheeprl_tpu.utils.callback import load_checkpoint
+
+    ckpts = sorted(glob.glob(f"{root}/**/ckpt_*.ckpt", recursive=True), key=os.path.getmtime)
+    st = load_checkpoint(ckpts[-1], select=("agent",))
+    h = hashlib.md5()
+    for leaf in jax.tree_util.tree_leaves(st["agent"]):
+        h.update(np.ascontiguousarray(leaf).tobytes())
+    return h.hexdigest()
+
+
+@pytest.mark.chaos
+def test_serve_smoke_server_kill_fallback_respawn(tmp_path, monkeypatch):
+    """The ISSUE 8 chaos acceptance: with server_exit armed, the serve
+    smoke shows breaker trip -> local fallback -> server respawn ->
+    breaker half-open re-promotion, with zero lost/double-acted
+    observations (request-id audit in telemetry) and rc=0."""
+    from sheeprl_tpu.cli import run
+
+    monkeypatch.setenv("SHEEPRL_FAULTS", "server_exit:40")
+    run(
+        _base_args(
+            tmp_path,
+            "chaos",
+            total_steps=9600,
+            extra=(
+                "algo.inference=remote",
+                "algo.serve.request_timeout_s=0.25",
+                "algo.serve.max_retries=1",
+                "algo.serve.breaker_threshold=2",
+                "algo.serve.breaker_cooldown_s=1.0",
+                "algo.serve.restart_backoff_s=0.2",
+            ),
+        )
+    )
+    recs = _records(f"{tmp_path}/chaos/run")
+    assert recs, "no telemetry"
+    last = recs[-1]
+    client = last.get("serve")
+    server = (last.get("transport") or {}).get("serve")
+    assert client and server, "serve telemetry missing"
+    # the failure envelope fired end to end
+    assert client["breaker_trips"] >= 1, client
+    assert client["local_fallbacks"] >= 1, client
+    assert client["breaker_promotions"] >= 1, client
+    assert client["breaker"] == "closed", client  # re-promoted by run end
+    assert server["deaths"] == 1 and server["respawns"] == 1, server
+    assert server["supervisor"]["restarts"] == 1, server
+    # request-id audit: every lead request served exactly once (remote or
+    # local), none lost; duplicates answered from cache, never re-acted
+    assert client["unaccounted"] == 0, client
+    assert client["requests"] == client["remote_used"] + client["local_fallbacks"]
+    assert server["state"] == "serving"
+    # bucketed batching did the serving (not row-by-row fallback)
+    assert server["batches"] > 0 and server["batch_hist"], server
+    assert server["latency_ms"].get("p50") is not None
+
+
+def test_inference_local_default_is_inert_and_bit_exact(tmp_path):
+    """The bit-exactness contract: ``algo.inference=local`` (default)
+    routes acting through LITERALLY the pre-serve call — the serve config
+    surface must be inert (identical agent md5 under wildly different
+    serve knobs) and no serve telemetry may appear."""
+    from sheeprl_tpu.cli import run
+
+    run(_base_args(tmp_path, "a", extra=("algo.inference=local",)))
+    run(
+        _base_args(
+            tmp_path,
+            "b",
+            extra=(
+                # default local + exotic serve knobs: all must be dead config
+                "algo.serve.deadline_ms=50",
+                "algo.serve.max_batch=2",
+                "algo.serve.breaker_threshold=1",
+                "algo.serve.hedge_ms=10",
+            ),
+        )
+    )
+    assert _agent_md5(f"{tmp_path}/a/run") == _agent_md5(f"{tmp_path}/b/run")
+    for sub in ("a", "b"):
+        for rec in _records(f"{tmp_path}/{sub}/run"):
+            assert "serve" not in rec, "local mode must not emit serve telemetry"
+            assert "serve" not in (rec.get("transport") or {})
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_serve_chaos_soak_randomized(tmp_path):
+    """Randomized serve soak: server kill + net noise + a nan-poisoned
+    checkpoint offered for hot-swap, audited from telemetry
+    (scripts/chaos_soak.py --mode serve)."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("SHEEPRL_FAULTS", None)
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(repo, "scripts", "chaos_soak.py"),
+            "--mode",
+            "serve",
+            "--seed",
+            "7",
+            "--root-dir",
+            str(tmp_path / "serve_soak"),
+        ],
+        cwd=repo,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=900,
+    )
+    assert proc.returncode == 0, proc.stdout[-3000:] + proc.stderr[-3000:]
+    assert "serve chaos soak passed" in proc.stdout

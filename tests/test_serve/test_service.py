@@ -1,0 +1,335 @@
+"""InferenceServer unit suite (ISSUE 8 tentpole): deadline/max-batch
+batching, bucket padding (one trace per bucket), request-id dedupe,
+graceful drain, validated hot checkpoint swap (refusing quarantined and
+corrupt candidates), and the server_exit -> drain-recover respawn path."""
+
+import json
+import multiprocessing as mp
+import os
+import queue
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.parallel.transport import INFER_REP_TAG, INFER_REQ_TAG, make_transport
+from sheeprl_tpu.serve import InferenceClient, InferenceServer, bucket_for
+
+pytestmark = pytest.mark.serve
+
+
+def _counting_policy(shapes_seen):
+    """A policy that records the batch widths it is dispatched with (the
+    bucket-trace proxy) and returns sum(obs)+params per row."""
+
+    def policy_fn(params, obs, key):
+        x = obs["state"]
+        shapes_seen.append(int(x.shape[0]))
+        return {"actions": x.sum(axis=tuple(range(1, x.ndim)), keepdims=True) + params}
+
+    return policy_fn
+
+
+def _rig(n_clients=1, **server_kw):
+    ctx = mp.get_context("spawn")
+    hub, specs = make_transport(ctx, "queue", n_clients, window=8, min_bytes=0)
+    shapes = []
+    server_kw.setdefault("deadline_ms", 2.0)
+    server_kw.setdefault("max_batch", 8)
+    srv = InferenceServer(_counting_policy(shapes), np.float32(1.0), **server_kw)
+    player_chs = [s.player_channel() for s in specs]
+    for i in range(n_clients):
+        srv.attach(i, hub.channel(i, timeout=5))
+    return srv, player_chs, hub, shapes
+
+
+def _obs(rows, fill=1.0):
+    return [("state", np.full((rows, 3), fill, np.float32))]
+
+
+# ----------------------------------------------------------------- buckets
+def test_bucket_for_powers_of_two_and_oversize():
+    buckets = (1, 2, 4, 8)
+    assert bucket_for(1, buckets) == 1
+    assert bucket_for(3, buckets) == 4
+    assert bucket_for(8, buckets) == 8
+    assert bucket_for(13, buckets) == 13  # oversize: served at own width
+
+
+def test_padded_batches_reuse_bucket_shapes():
+    """Ragged request sizes must land on bucket widths only — the proxy
+    for 'one XLA trace per bucket, flat compile counter'."""
+    srv, (pc,), hub, shapes = _rig()
+    srv.start()
+    c = InferenceClient(pc, 0, request_timeout_s=5.0)
+    try:
+        for rows in (1, 2, 3, 5, 3, 1, 7, 5):
+            out, src = c.infer(_obs(rows), rows)
+            assert src == "remote" and out["actions"].shape == (rows, 1)
+        assert set(shapes) <= {1, 2, 4, 8}, shapes
+        # the ragged sizes 3/5/7 all rode the 4- and 8-buckets
+        assert 4 in shapes and 8 in shapes
+    finally:
+        srv.close()
+        c.close()
+        hub.close()
+
+
+def test_padding_rows_do_not_leak_into_replies():
+    srv, (pc,), hub, _ = _rig()
+    srv.start()
+    c = InferenceClient(pc, 0, request_timeout_s=5.0)
+    try:
+        out, _ = c.infer(_obs(3, fill=2.0), 3)
+        np.testing.assert_allclose(out["actions"], np.full((3, 1), 6.0 + 1.0))
+    finally:
+        srv.close()
+        c.close()
+        hub.close()
+
+
+# ---------------------------------------------------------------- batching
+def test_deadline_coalesces_concurrent_requests():
+    """Two clients firing together inside one deadline window must share
+    a dispatch (rows coalesced), not pay one batch each."""
+    srv, chs, hub, shapes = _rig(n_clients=2, deadline_ms=150.0)
+    srv.start()
+    clients = [InferenceClient(chs[i], i, request_timeout_s=5.0) for i in range(2)]
+    try:
+        outs = [None, None]
+
+        def fire(i):
+            outs[i] = clients[i].infer(_obs(2, fill=float(i)), 2)
+
+        ts = [threading.Thread(target=fire, args=(i,)) for i in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert all(o is not None and o[1] == "remote" for o in outs)
+        assert srv.batches == 1 and shapes == [4], (srv.batches, shapes)
+    finally:
+        srv.close()
+        for c in clients:
+            c.close()
+        hub.close()
+
+
+def test_max_batch_dispatches_without_waiting_deadline():
+    srv, (pc,), hub, _ = _rig(deadline_ms=10_000.0, max_batch=4)
+    srv.start()
+    c = InferenceClient(pc, 0, request_timeout_s=5.0)
+    try:
+        t0 = time.monotonic()
+        out, src = c.infer(_obs(4), 4)  # rows == max_batch: immediate
+        assert src == "remote"
+        assert time.monotonic() - t0 < 5.0
+    finally:
+        srv.close()
+        c.close()
+        hub.close()
+
+
+# ------------------------------------------------------------------ dedupe
+def test_duplicate_request_answered_from_cache_never_double_acted():
+    srv, (pc,), hub, _ = _rig()
+    srv.start()
+    try:
+        pc.send(INFER_REQ_TAG, arrays=_obs(2), extra=(0, 2), seq=1)
+        f1 = pc.recv(timeout=5)
+        assert f1.tag == INFER_REP_TAG and f1.seq == 1
+        first = {k: np.array(v) for k, v in f1.arrays.items()}
+        f1.release()
+        acted_before = srv.acted
+        # a retry/hedge/reconnect duplicate of the SAME request id
+        pc.send(INFER_REQ_TAG, arrays=_obs(2), extra=(0, 2), seq=1)
+        f2 = pc.recv(timeout=5)
+        assert f2.seq == 1
+        np.testing.assert_array_equal(f2.arrays["actions"], first["actions"])
+        f2.release()
+        assert srv.acted == acted_before, "duplicate was ACTED instead of served from cache"
+        assert srv.dedup_hits == 1
+    finally:
+        srv.close()
+        hub.close()
+
+
+# ------------------------------------------------------------------- drain
+def test_graceful_drain_answers_pending_then_sends_stop():
+    srv, (pc,), hub, _ = _rig(deadline_ms=10_000.0)  # deadline alone would never fire
+    srv.start()
+    try:
+        pc.send(INFER_REQ_TAG, arrays=_obs(2), extra=(0, 2), seq=1)
+        time.sleep(0.1)
+        srv.request_drain()
+        f = pc.recv(timeout=5)
+        assert f.tag == INFER_REP_TAG and f.seq == 1  # answered, not dropped
+        f.release()
+        g = pc.recv(timeout=5)
+        assert g.tag == "stop"
+        g.release()
+        t0 = time.monotonic()
+        while srv._thread.is_alive() and time.monotonic() - t0 < 5:
+            time.sleep(0.01)
+        assert not srv._thread.is_alive()
+        assert srv.stats()["state"] == "draining"
+    finally:
+        srv.close()
+        hub.close()
+
+
+# ------------------------------------------------------- crash + respawn
+def test_server_exit_fault_kills_loop_and_respawn_recovers_backlog(monkeypatch):
+    monkeypatch.setenv("SHEEPRL_FAULTS", "server_exit:1")
+    from sheeprl_tpu.resilience.faults import get_injector
+
+    get_injector()  # rebuild with the spec armed
+    srv, (pc,), hub, _ = _rig()
+    srv.start()
+    try:
+        pc.send(INFER_REQ_TAG, arrays=_obs(2), extra=(0, 2), seq=1)
+        t0 = time.monotonic()
+        while srv.alive and time.monotonic() - t0 < 5:
+            time.sleep(0.01)
+        assert not srv.alive and "server_exit" in srv.dead_reason
+        assert srv.deaths == 1
+        with pytest.raises(queue.Empty):
+            pc.recv(timeout=0.3)  # the in-flight request died with the loop
+        # client retries the same id into the dead server's channels...
+        monkeypatch.setenv("SHEEPRL_FAULTS", "")
+        get_injector()
+        pc.send(INFER_REQ_TAG, arrays=_obs(2), extra=(0, 2), seq=1)
+        pc.send(INFER_REQ_TAG, arrays=_obs(2), extra=(0, 2), seq=2)
+        # ...and the respawned loop drain-recovers the backlog
+        srv.respawn()
+        seen = set()
+        for _ in range(2):
+            f = pc.recv(timeout=5)
+            assert f.tag == INFER_REP_TAG
+            seen.add(f.seq)
+            f.release()
+        assert seen == {1, 2}
+        assert srv.respawns == 1 and srv.recovered_backlog >= 2
+    finally:
+        srv.close()
+        hub.close()
+
+
+# ---------------------------------------------------------------- hot swap
+def _write_ckpt(path, value):
+    from sheeprl_tpu.utils.ckpt_format import save_state
+
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    return save_state(path, {"agent": {"w": np.full((4,), value, np.float32)}})
+
+
+def test_hot_swap_refuses_quarantined_and_corrupt_swaps_good(tmp_path):
+    """The hot-swap acceptance: a quarantined and a truncated candidate
+    are refused (logged, counted), a good-tagged one swaps in between
+    batches with zero dropped requests."""
+    from sheeprl_tpu.resilience.sentinel import CheckpointHealthTags
+    from sheeprl_tpu.serve import agent_params_loader
+
+    ckpt_dir = tmp_path / "run" / "checkpoint"
+    initial = _write_ckpt(str(ckpt_dir / "ckpt_100_0.ckpt"), 1.0)
+    srv, (pc,), hub, _ = _rig()
+    loader = agent_params_loader("agent")
+    srv.swap_params(loader(initial)["w"][0], source=os.path.abspath(initial))
+    # huge interval: the background watcher never ticks on its own — the
+    # test drives poll_hot_swap explicitly so the refusal walk is observable
+    srv.watch(str(tmp_path / "run"), lambda p: loader(p)["w"][0], interval_s=1e6)
+    srv.start()
+    c = InferenceClient(pc, 0, request_timeout_s=5.0)
+    try:
+        out, _ = c.infer(_obs(1, fill=0.0), 1)
+        np.testing.assert_allclose(out["actions"], 1.0)
+
+        tags = CheckpointHealthTags(str(ckpt_dir))
+        # newest -> oldest on mtime: corrupt > quarantined > good
+        good = _write_ckpt(str(ckpt_dir / "ckpt_200_0.ckpt"), 5.0)
+        tags.note_save(good, 0)
+        tags.promote(10, 1)  # -> good
+        time.sleep(0.02)
+        quarantined = _write_ckpt(str(ckpt_dir / "ckpt_300_0.ckpt"), 7.0)
+        tags._load()
+        tags.note_save(quarantined, 0)
+        tags.quarantine_pending()
+        time.sleep(0.02)
+        corrupt = str(ckpt_dir / "ckpt_400_0.ckpt")
+        _write_ckpt(corrupt, 9.0)
+        with open(corrupt, "r+b") as f:
+            f.truncate(os.path.getsize(corrupt) // 2)  # torn write
+
+        with pytest.warns(UserWarning, match="REFUSED"):
+            swapped = srv.poll_hot_swap()
+        assert swapped == os.path.abspath(good)
+        st = srv.stats()["swaps"]
+        assert st["applied"] == 1
+        assert st["refused_quarantined"] == 1
+        assert st["refused_invalid"] == 1
+        assert st["current"] == os.path.basename(good)
+        # zero dropped requests: serving continues on the swapped params
+        out, src = c.infer(_obs(1, fill=0.0), 1)
+        assert src == "remote"
+        np.testing.assert_allclose(out["actions"], 5.0)
+    finally:
+        srv.close()
+        c.close()
+        hub.close()
+
+
+def test_hot_swap_holds_off_pending_until_promoted(tmp_path):
+    from sheeprl_tpu.resilience.sentinel import CheckpointHealthTags
+    from sheeprl_tpu.serve import agent_params_loader
+
+    ckpt_dir = tmp_path / "run" / "checkpoint"
+    loader = agent_params_loader("agent")
+    srv, (pc,), hub, _ = _rig()
+    srv.watch(str(tmp_path / "run"), lambda p: loader(p)["w"][0], interval_s=0.01)
+    pending = _write_ckpt(str(ckpt_dir / "ckpt_100_0.ckpt"), 3.0)
+    tags = CheckpointHealthTags(str(ckpt_dir))
+    tags.note_save(pending, 0)
+    try:
+        assert srv.poll_hot_swap() is None  # pending: not refused, not taken
+        assert srv.stats()["swaps"]["applied"] == 0
+        tags.promote(10, 1)
+        assert srv.poll_hot_swap() == os.path.abspath(pending)
+    finally:
+        srv.close()
+        hub.close()
+
+
+def test_swap_params_keeps_compile_counter_flat():
+    """Params swap between batches must not retrace the bucketed policy
+    dispatch (same tree/shape/dtype -> jit cache hit)."""
+    import jax
+
+    from sheeprl_tpu.obs import RecompileMonitor
+
+    ctx = mp.get_context("spawn")
+    hub, specs = make_transport(ctx, "queue", 1, window=8, min_bytes=0)
+    apply = jax.jit(lambda p, x: x @ p)
+
+    def policy_fn(params, obs, key):
+        return {"actions": np.asarray(apply(params, obs["state"]))}
+
+    mon = RecompileMonitor(name="serve_swap_test").install()
+    try:
+        srv = InferenceServer(policy_fn, np.eye(3, dtype=np.float32), deadline_ms=1.0, max_batch=4)
+        srv.attach(0, hub.channel(0, timeout=5))
+        srv.start()
+        c = InferenceClient(specs[0].player_channel(), 0, request_timeout_s=5.0)
+        for i in range(3):
+            c.infer(_obs(2, fill=float(i)), 2)
+        mon.mark_warmup_complete()
+        for i in range(4):
+            srv.swap_params(np.eye(3, dtype=np.float32) * (i + 2))
+            out, src = c.infer(_obs(2, fill=1.0), 2)
+            assert src == "remote"
+        assert mon.snapshot().get("post_warmup", 0) == 0, mon.snapshot()
+        srv.close()
+        c.close()
+        hub.close()
+    finally:
+        mon.uninstall()
