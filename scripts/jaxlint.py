@@ -1,0 +1,22 @@
+#!/usr/bin/env python3
+"""Repo entry point for the jaxlint static pass (ISSUE 9).
+
+Equivalent invocations::
+
+    python scripts/jaxlint.py sheeprl_tpu/
+    python -m sheeprl_tpu.analysis sheeprl_tpu/
+    jaxlint sheeprl_tpu/          # console script (pip install -e .)
+
+See ``howto/static-analysis.md`` for the checker catalog, suppression
+syntax and baseline semantics.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from sheeprl_tpu.analysis.lint import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

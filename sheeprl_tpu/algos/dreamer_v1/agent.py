@@ -269,7 +269,9 @@ class PlayerDV1:
                 k2,
                 mask,
             )
-            if not greedy:
+            # greedy is static_argnums=8: this branch specializes the trace,
+            # it does not concretize a tracer
+            if not greedy:  # jaxlint: disable=retrace-branch
                 # expl_amount is traced so the decay schedule does not
                 # retrigger compilation; amount 0 is a no-op
                 actions = add_exploration_noise(

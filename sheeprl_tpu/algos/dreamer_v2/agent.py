@@ -625,7 +625,9 @@ class PlayerDV2:
                 k2,
                 mask,
             )
-            if expl_amount > 0.0 and not greedy:
+            # greedy/expl_amount are static_argnums=(7, 8): static trace
+            # specialization, not tracer concretization
+            if expl_amount > 0.0 and not greedy:  # jaxlint: disable=retrace-branch
                 actions = add_exploration_noise(
                     actions, k3, expl_amount, self.actions_dim, self.actor_module.is_continuous
                 )

@@ -571,7 +571,9 @@ def make_train_fn(runtime, world_model, actor, critic, txs, cfg, is_continuous, 
         lambda_vals = actor_aux["lambda_values"]
 
         def critic_loss_fn(critic_params):
-            if _heads_fusible([critic_params, params["target_critic"]], (critic, critic)):
+            # _heads_fusible reads only static metadata (tree structure +
+            # leaf shapes), so this is a compile-time specialization
+            if _heads_fusible([critic_params, params["target_critic"]], (critic, critic)):  # jaxlint: disable=retrace-branch
                 q_logits, tgt_logits = fused_mlp_heads(
                     [critic_params, params["target_critic"]], traj,
                     float(critic.eps), resolve_activation(critic.act), traj_dtype,

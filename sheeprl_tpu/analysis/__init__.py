@@ -1,0 +1,49 @@
+"""sheeprl_tpu.analysis — JAX-hazard correctness tooling (ISSUE 9).
+
+Two pillars:
+
+- :mod:`sheeprl_tpu.analysis.lint` (+ :mod:`.checkers`) — ``jaxlint``, an
+  AST static-analysis pass over the repo with JAX-specific checkers for
+  the bug classes every concurrency PR has shipped at least once:
+  use-after-donate, zero-copy host aliasing, PRNG key reuse, host syncs
+  in hot loops, and retrace hazards.  Run as ``python -m
+  sheeprl_tpu.analysis <paths>`` / the ``jaxlint`` console script /
+  ``scripts/jaxlint.py``.  Inline ``# jaxlint: disable=<check>``
+  suppressions plus a committed baseline file keep the pass
+  clean-by-default over ``sheeprl_tpu/`` in tier-1.
+- :mod:`sheeprl_tpu.analysis.sanitizers` — opt-in runtime sanitizers
+  (``SHEEPRL_SANITIZE=1``): a donation sanitizer that turns intermittent
+  use-after-donate into deterministic failures, a host-alias guard for
+  zero-copy uploads of borrowed host memory, scoped
+  ``jax.transfer_guard`` wiring for the hot-loop trace scopes, and the
+  thread/channel/shm leak registry behind the suite-wide pytest sweep.
+"""
+
+from sheeprl_tpu.analysis.lint import CHECKS, Finding, lint_paths, main
+from sheeprl_tpu.analysis.sanitizers import (
+    DonationSanitizerError,
+    HostAliasError,
+    check_host_sources,
+    guard_donation,
+    leak_registry,
+    sanitize_enabled,
+    session_leak_report,
+    shm_orphans,
+    transfer_sanitizer,
+)
+
+__all__ = [
+    "CHECKS",
+    "Finding",
+    "lint_paths",
+    "main",
+    "DonationSanitizerError",
+    "HostAliasError",
+    "check_host_sources",
+    "guard_donation",
+    "leak_registry",
+    "sanitize_enabled",
+    "session_leak_report",
+    "shm_orphans",
+    "transfer_sanitizer",
+]

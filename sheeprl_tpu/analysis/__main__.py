@@ -1,0 +1,8 @@
+"""``python -m sheeprl_tpu.analysis`` — run the jaxlint static pass."""
+
+import sys
+
+from sheeprl_tpu.analysis.lint import main
+
+if __name__ == "__main__":
+    sys.exit(main())

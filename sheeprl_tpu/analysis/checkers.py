@@ -1,0 +1,912 @@
+"""jaxlint checkers — the five JAX-hazard families, as AST passes.
+
+Every checker is a HEURISTIC tuned to this repo's idioms; each docstring
+states exactly what it matches and what it deliberately does not, because
+the triage contract (fix / suppress inline / baseline with a why) only
+works when the rule is predictable.  Golden positive/negative snippet
+pairs in ``tests/test_analysis/test_lint.py`` pin each rule.
+
+Shared machinery: import-alias resolution (``np``/``jnp``/``jax`` spelled
+any way), a parent map for context-sensitive matches, and a tiny abstract
+interpreter that walks statement lists in program order with copy/merge
+at branches and a double pass over loop bodies (so a hazard created at
+the bottom of a loop is seen by a use at its top on the next iteration).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+RawFinding = Tuple[int, int, str, str]  # (line, col, check, message)
+
+_SCOPE_TYPES = (ast.FunctionDef, ast.AsyncFunctionDef)
+_SKIP_DESCEND = _SCOPE_TYPES + (ast.Lambda, ast.ClassDef)
+
+# attribute reads that are safe on a donated/deleted jax.Array (metadata
+# lives on the Python object, not the buffer)
+_SAFE_DONATED_ATTRS = {"shape", "dtype", "ndim", "size", "sharding", "is_deleted", "device", "devices"}
+
+# reads of a traced value through these never force concretization
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "aval", "sharding"}
+_STATIC_CALLS = {"isinstance", "len", "getattr", "hasattr", "callable", "type", "id"}
+
+# functions that materialize a private copy of their argument — the
+# blessed fix idiom for both the donation and the aliasing classes
+_CLEANSE_QUALS = {
+    "numpy.copy",
+    "numpy.array",
+    "numpy.ascontiguousarray",
+    "jax.numpy.copy",
+    "copy.deepcopy",
+}
+_CLEANSE_NAMES = {"detach_copy", "deepcopy"}
+
+_KEYISH_NAME = re.compile(r"(^|_)(key|keys|rng|rngs)$")
+
+# jax.random callables that DERIVE rather than consume (fold_in is exempt
+# by design: fold_in(key, i) with distinct i is the blessed per-step idiom)
+_PRNG_NONCONSUMING = {"PRNGKey", "key", "fold_in", "wrap_key_data", "key_data", "clone", "key_impl"}
+
+# entry points whose function-valued arguments get traced
+_TRACE_ENTRY_QUALS = {
+    "jax.jit",
+    "jax.vmap",
+    "jax.pmap",
+    "jax.grad",
+    "jax.value_and_grad",
+    "jax.checkpoint",
+    "jax.remat",
+    "jax.lax.scan",
+    "jax.lax.while_loop",
+    "jax.lax.cond",
+    "jax.lax.fori_loop",
+    "jax.lax.associative_scan",
+}
+_TRACE_ENTRY_NAMES = {"jit", "shard_map", "scan", "guard_update", "scan_remat", "checkpoint", "remat"}
+_TRACE_ENTRY_ATTRS = {"setup_step"}
+
+
+class ModuleContext:
+    """Alias table + parent links for one parsed module."""
+
+    def __init__(self, tree: ast.Module):
+        self.tree = tree
+        self.aliases: Dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        self.aliases[a.asname] = a.name
+                    else:
+                        head = a.name.split(".")[0]
+                        self.aliases[head] = head
+            elif isinstance(node, ast.ImportFrom) and node.module and node.level == 0:
+                for a in node.names:
+                    self.aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+            for child in ast.iter_child_nodes(node):
+                child._jaxlint_parent = node  # type: ignore[attr-defined]
+
+    def qual(self, node: Optional[ast.AST]) -> Optional[str]:
+        """Canonical dotted name ('jax.numpy.asarray') or None."""
+        if isinstance(node, ast.Name):
+            return self.aliases.get(node.id, node.id)
+        if isinstance(node, ast.Attribute):
+            base = self.qual(node.value)
+            return f"{base}.{node.attr}" if base else None
+        return None
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return getattr(node, "_jaxlint_parent", None)
+
+    def ancestors(self, node: ast.AST) -> Iterable[ast.AST]:
+        cur = self.parent(node)
+        while cur is not None:
+            yield cur
+            cur = self.parent(cur)
+
+
+def _walk_shallow(node: ast.AST) -> Iterable[ast.AST]:
+    """Walk ``node`` without descending into nested function/class scopes
+    (they are analyzed as scopes of their own)."""
+    stack = [node]
+    first = True
+    while stack:
+        n = stack.pop()
+        if not first and isinstance(n, _SKIP_DESCEND):
+            continue
+        first = False
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _scopes(tree: ast.Module) -> List[ast.AST]:
+    """The module plus every function definition, at any nesting depth."""
+    return [tree] + [n for n in ast.walk(tree) if isinstance(n, _SCOPE_TYPES)]
+
+
+def _assigned_names(stmt: ast.AST) -> Set[str]:
+    """Names this statement (re)binds, shallow."""
+    out: Set[str] = set()
+    for n in _walk_shallow(stmt):
+        if isinstance(n, ast.Name) and isinstance(n.ctx, (ast.Store, ast.Del)):
+            out.add(n.id)
+    return out
+
+
+def _in_cleanse_call(ctx: ModuleContext, node: ast.AST, stop: ast.AST) -> bool:
+    """True when ``node`` sits inside the arguments of a copy-materializing
+    call (np.copy / np.array / detach_copy / x.copy() / deepcopy)."""
+    for anc in ctx.ancestors(node):
+        if isinstance(anc, ast.Call):
+            q = ctx.qual(anc.func)
+            if q in _CLEANSE_QUALS or (q and q.split(".")[-1] in _CLEANSE_NAMES):
+                return True
+            if isinstance(anc.func, ast.Attribute) and anc.func.attr == "copy":
+                return True
+        if anc is stop:
+            break
+    return False
+
+
+def _int_tuple_literal(node: ast.AST) -> Optional[Tuple[int, ...]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        vals = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                vals.append(e.value)
+            else:
+                return None
+        return tuple(vals)
+    return None
+
+
+def _terminates(body: Sequence[ast.stmt]) -> bool:
+    """Control flow cannot fall out of the bottom of this block."""
+    return bool(body) and isinstance(body[-1], (ast.Return, ast.Raise, ast.Break, ast.Continue))
+
+
+def _merge_branches(pre: Dict[str, int], stmt: ast.If, s_body: Dict[str, int], s_else: Dict[str, int]) -> Dict[str, int]:
+    """Post-If state: a branch that ends in return/raise/break/continue
+    contributes nothing to the fall-through (an early-returning arm's
+    consumptions/donations cannot reach the code below the If)."""
+    body_falls = not _terminates(stmt.body)
+    else_falls = not _terminates(stmt.orelse)
+    if body_falls and else_falls:
+        return {**s_else, **s_body}
+    if body_falls:
+        return s_body
+    if else_falls:
+        return s_else
+    return dict(pre)  # neither falls through: below the If is dead-ish code
+
+
+def _body_lists(stmt: ast.AST) -> List[List[ast.stmt]]:
+    """Nested statement lists of a compound statement (order matters)."""
+    lists = []
+    for field in ("body", "orelse", "finalbody"):
+        b = getattr(stmt, field, None)
+        if b:
+            lists.append(b)
+    for h in getattr(stmt, "handlers", []) or []:
+        if h.body:
+            lists.append(h.body)
+    return lists
+
+
+# =====================================================================
+# (a) use-after-donate
+# =====================================================================
+def check_donation(ctx: ModuleContext) -> List[RawFinding]:
+    """Flags reads of a variable that was passed at a ``donate_argnums``
+    position of a donating dispatch, after that dispatch, unless the name
+    was reassigned or the read happens inside a copy-materializing call
+    (``detach_copy``/``np.copy``/``.copy()`` — the repo's fix idiom).
+
+    Donating dispatchers are recognized syntactically: a name assigned
+    from ``jax.jit(...)`` / ``jax.pmap(...)`` / ``*.setup_step(...)`` /
+    ``guard_update(...)`` carrying a LITERAL ``donate_argnums``.  Cross-
+    function donation (``update_fn = make_update_fn(...)``) is invisible
+    to this pass — the runtime donation sanitizer covers that half.
+    Metadata reads (``.shape``/``.dtype``/``.is_deleted``) are exempt:
+    they live on the Python object, not the donated buffer.
+    """
+    findings: List[RawFinding] = []
+    seen: Set[Tuple[int, int]] = set()
+
+    module_donors = _collect_donors(ctx, ctx.tree.body)
+    for scope in _scopes(ctx.tree):
+        body = scope.body if isinstance(scope, _SCOPE_TYPES) else ctx.tree.body
+        # module-level donors stay callable from any function in the file
+        donors = {**module_donors, **_collect_donors(ctx, body)}
+        if not donors:
+            continue
+        _sim_donation(ctx, body, donors, {}, findings, seen)
+    return findings
+
+
+def _collect_donors(ctx: ModuleContext, body: Sequence[ast.stmt]) -> Dict[str, Tuple[int, ...]]:
+    donors: Dict[str, Tuple[int, ...]] = {}
+    for stmt in body:
+        for n in _walk_shallow(stmt):
+            if not (isinstance(n, ast.Assign) and len(n.targets) == 1 and isinstance(n.targets[0], ast.Name)):
+                continue
+            call = n.value
+            if not isinstance(call, ast.Call):
+                continue
+            q = ctx.qual(call.func) or ""
+            leaf = q.split(".")[-1]
+            is_dispatcher = (
+                q in ("jax.jit", "jax.pmap")
+                or leaf in ("setup_step", "guard_update")
+                or (isinstance(call.func, ast.Attribute) and call.func.attr in ("setup_step",))
+            )
+            if not is_dispatcher:
+                continue
+            for kw in call.keywords:
+                if kw.arg == "donate_argnums":
+                    positions = _int_tuple_literal(kw.value)
+                    if positions:
+                        donors[n.targets[0].id] = positions
+    return donors
+
+
+def _sim_donation(
+    ctx: ModuleContext,
+    body: Sequence[ast.stmt],
+    donors: Dict[str, Tuple[int, ...]],
+    state: Dict[str, int],
+    findings: List[RawFinding],
+    seen: Set[Tuple[int, int]],
+) -> Dict[str, int]:
+    for stmt in body:
+        if isinstance(stmt, _SKIP_DESCEND):
+            continue
+        if isinstance(stmt, ast.If):
+            _sim_stmt_donation(ctx, stmt.test, donors, state, findings, seen, expr_only=True)
+            s1 = _sim_donation(ctx, stmt.body, donors, dict(state), findings, seen)
+            s2 = _sim_donation(ctx, stmt.orelse, donors, dict(state), findings, seen)
+            state = _merge_branches(state, stmt, s1, s2)
+            continue
+        if isinstance(stmt, (ast.For, ast.While)):
+            # two passes: a donation at the bottom of the body must be
+            # visible to a read at its top on the next iteration
+            state = _sim_donation(ctx, stmt.body, donors, state, findings, seen)
+            state = _sim_donation(ctx, stmt.body, donors, state, findings, seen)
+            state = _sim_donation(ctx, stmt.orelse, donors, state, findings, seen)
+            continue
+        if isinstance(stmt, (ast.With, ast.AsyncWith, ast.Try)):
+            _sim_stmt_donation(ctx, stmt, donors, state, findings, seen, header_only=True)
+            for blist in _body_lists(stmt):
+                state = _sim_donation(ctx, blist, donors, state, findings, seen)
+            continue
+        _sim_stmt_donation(ctx, stmt, donors, state, findings, seen)
+    return state
+
+
+def _sim_stmt_donation(
+    ctx: ModuleContext,
+    stmt: ast.AST,
+    donors: Dict[str, Tuple[int, ...]],
+    state: Dict[str, int],
+    findings: List[RawFinding],
+    seen: Set[Tuple[int, int]],
+    expr_only: bool = False,
+    header_only: bool = False,
+) -> None:
+    if header_only:
+        nodes: List[ast.AST] = []
+        for item in getattr(stmt, "items", []) or []:
+            nodes.extend(_walk_shallow(item.context_expr))
+    else:
+        nodes = list(_walk_shallow(stmt))
+
+    # 1) reads of already-donated names
+    for n in nodes:
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load) and n.id in state:
+            parent = ctx.parent(n)
+            if isinstance(parent, ast.Attribute) and parent.attr in _SAFE_DONATED_ATTRS:
+                continue
+            if _in_cleanse_call(ctx, n, stmt):
+                # the blessed re-materialize idiom: treat as re-blessing
+                state.pop(n.id, None)
+                continue
+            key = (n.lineno, n.col_offset)
+            if key not in seen:
+                seen.add(key)
+                findings.append(
+                    (
+                        n.lineno,
+                        n.col_offset,
+                        "use-after-donate",
+                        f"'{n.id}' was donated to a jitted dispatch at line {state[n.id]} "
+                        f"and is read again here — its buffer belongs to XLA now "
+                        f"(copy it BEFORE the donating call, or reassign from the outputs)",
+                    )
+                )
+    if expr_only:
+        return
+    # 2) donations performed by this statement
+    for n in nodes:
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Name) and n.func.id in donors:
+            for pos in donors[n.func.id]:
+                if pos < len(n.args) and isinstance(n.args[pos], ast.Name):
+                    state[n.args[pos].id] = n.lineno
+    # 3) rebinds clear the donated mark
+    for name in _assigned_names(stmt):
+        state.pop(name, None)
+
+
+# =====================================================================
+# (b) zero-copy aliasing
+# =====================================================================
+_SINK_QUALS = {"jax.device_put", "jax.numpy.asarray"}
+_SINK_ATTRS = {"shard_batch", "replicate"}  # MeshRuntime device_put helpers
+
+
+def check_zero_copy(ctx: ModuleContext) -> List[RawFinding]:
+    """Flags ``jax.device_put`` / ``jnp.asarray`` (and the MeshRuntime
+    ``shard_batch``/``replicate`` helpers) whose source is BORROWED host
+    memory: ``np.frombuffer``, ``np.memmap``, a member of an ``np.load``
+    npz handle, a ``memoryview``, or an ``ShmArena.unpack`` slot view
+    without ``copy=True``.  CPU ``device_put`` zero-copy aliases such
+    memory WITHOUT keeping its owner alive — when the owner is freed
+    (npz closed, shm slot recycled, buffer GC'd) the device array reads
+    freed memory (the PR-3/PR-7 heap-corruption class).
+
+    Plain ndarray views (slices) are deliberately NOT flagged: a numpy
+    view holds a reference to its base, so the aliased memory cannot be
+    freed under it.  The hazardous class is exactly the buffers whose
+    lifetime numpy does NOT manage.  ``jnp.array`` copies by default and
+    is therefore a sink only if called with ``copy=False``.
+    """
+    findings: List[RawFinding] = []
+    for scope in _scopes(ctx.tree):
+        body = scope.body if isinstance(scope, _SCOPE_TYPES) else ctx.tree.body
+        _sim_zero_copy(ctx, body, {}, set(), findings)
+    return findings
+
+
+def _classify_borrowed(ctx: ModuleContext, node: ast.AST, npz_vars: Set[str]) -> Optional[str]:
+    if isinstance(node, ast.Call):
+        q = ctx.qual(node.func) or ""
+        if q == "numpy.frombuffer":
+            return "np.frombuffer view"
+        if q == "numpy.memmap":
+            return "np.memmap window"
+        if q == "memoryview":
+            return "memoryview"
+        if isinstance(node.func, ast.Attribute) and node.func.attr == "unpack":
+            for kw in node.keywords:
+                if kw.arg == "copy" and isinstance(kw.value, ast.Constant) and kw.value.value is True:
+                    return None
+            return "shm-ring slot view (unpack without copy=True)"
+    if isinstance(node, ast.Subscript):
+        base = node.value
+        if isinstance(base, ast.Name) and base.id in npz_vars:
+            return "npz member (np.load handle)"
+        if isinstance(base, ast.Call) and (ctx.qual(base.func) or "") == "numpy.load":
+            return "npz member (np.load handle)"
+    return None
+
+
+def _is_np_load(ctx: ModuleContext, node: ast.AST) -> bool:
+    return isinstance(node, ast.Call) and (ctx.qual(node.func) or "") == "numpy.load"
+
+
+def _sink_call(ctx: ModuleContext, node: ast.AST) -> Optional[str]:
+    """Returns a human name when ``node`` is a device-upload sink call."""
+    if not isinstance(node, ast.Call):
+        return None
+    q = ctx.qual(node.func) or ""
+    if q in _SINK_QUALS:
+        return q.replace("numpy", "np").replace("jax.np", "jnp")
+    if q == "jax.numpy.array":
+        for kw in node.keywords:
+            if kw.arg == "copy" and isinstance(kw.value, ast.Constant) and kw.value.value is False:
+                return "jnp.array(copy=False)"
+        return None
+    if isinstance(node.func, ast.Attribute) and node.func.attr in _SINK_ATTRS:
+        return f".{node.func.attr}"
+    return None
+
+
+def _sim_zero_copy(
+    ctx: ModuleContext,
+    body: Sequence[ast.stmt],
+    borrowed: Dict[str, str],
+    npz_vars: Set[str],
+    findings: List[RawFinding],
+) -> None:
+    for stmt in body:
+        if isinstance(stmt, _SKIP_DESCEND):
+            continue
+        # with np.load(...) as npz: members of npz die at scope exit
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                if _is_np_load(ctx, item.context_expr) and isinstance(item.optional_vars, ast.Name):
+                    npz_vars.add(item.optional_vars.id)
+        # sinks + sources inside this statement
+        for n in _walk_shallow(stmt):
+            sink = _sink_call(ctx, n)
+            if sink and n.args:
+                arg = n.args[0]
+                hits = _borrowed_exprs(ctx, arg, borrowed, npz_vars)
+                for line, col, kind in hits:
+                    findings.append(
+                        (
+                            line,
+                            col,
+                            "zero-copy-alias",
+                            f"{sink} source is a {kind}: CPU device_put zero-copy aliases it "
+                            f"without keeping the owner alive — copy first (np.copy / "
+                            f"jnp.array(..., copy=True)) or keep the owner on host_refs",
+                        )
+                    )
+        # track borrowed bindings
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+            tgt = stmt.targets[0]
+            if isinstance(tgt, ast.Name):
+                if _is_np_load(ctx, stmt.value):
+                    npz_vars.add(tgt.id)
+                    borrowed.pop(tgt.id, None)
+                else:
+                    kind = _classify_borrowed(ctx, stmt.value, npz_vars)
+                    if kind:
+                        borrowed[tgt.id] = kind
+                        npz_vars.discard(tgt.id)
+                    else:
+                        borrowed.pop(tgt.id, None)
+                        npz_vars.discard(tgt.id)
+        else:
+            for name in _assigned_names(stmt):
+                borrowed.pop(name, None)
+                npz_vars.discard(name)
+        for blist in _body_lists(stmt):
+            _sim_zero_copy(ctx, blist, borrowed, npz_vars, findings)
+
+
+def _borrowed_exprs(
+    ctx: ModuleContext, expr: ast.AST, borrowed: Dict[str, str], npz_vars: Set[str]
+) -> List[Tuple[int, int, str]]:
+    """Borrowed sources reachable in a sink's first argument without
+    passing through a copy-materializing call."""
+    hits: List[Tuple[int, int, str]] = []
+    stack: List[ast.AST] = [expr]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, ast.Call):
+            q = ctx.qual(n.func) or ""
+            if q in _CLEANSE_QUALS or (q and q.split(".")[-1] in _CLEANSE_NAMES):
+                continue  # a copy between source and sink: safe
+            if isinstance(n.func, ast.Attribute) and n.func.attr == "copy":
+                continue
+        kind = _classify_borrowed(ctx, n, npz_vars)
+        if kind:
+            hits.append((n.lineno, n.col_offset, kind))
+            continue
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load) and n.id in borrowed:
+            hits.append((n.lineno, n.col_offset, borrowed[n.id]))
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+    return hits
+
+
+# =====================================================================
+# (c) PRNG hygiene
+# =====================================================================
+def check_prng(ctx: ModuleContext) -> List[RawFinding]:
+    """Two rules.  ``prng-reuse``: the same key NAME consumed by two
+    ``jax.random`` draws (or two ``key=``/``rng=`` keyword passes) without
+    an intervening reassignment — identical randomness where independent
+    streams were intended.  ``fold_in`` is exempt (per-index derivation is
+    the blessed multi-use idiom) and so is ``PRNGKey``.  Loop bodies are
+    walked twice, so drawing from an un-split key every iteration flags.
+    ``prng-discard``: a bare ``jax.random.split(...)`` expression
+    statement — keys were derived and immediately dropped.
+
+    Only key-ish names are tracked (assigned from ``jax.random.*`` or
+    matching ``key``/``rng``/``*_key``/``*_rng``), so passing unrelated
+    values through ``key=``-less calls never flags.
+    """
+    findings: List[RawFinding] = []
+    seen: Set[Tuple[int, int]] = set()
+    for scope in _scopes(ctx.tree):
+        body = scope.body if isinstance(scope, _SCOPE_TYPES) else ctx.tree.body
+        keyish: Set[str] = set()
+        if isinstance(scope, _SCOPE_TYPES):
+            for a in list(scope.args.args) + list(scope.args.kwonlyargs) + list(scope.args.posonlyargs):
+                if _KEYISH_NAME.search(a.arg):
+                    keyish.add(a.arg)
+        _sim_prng(ctx, body, keyish, {}, findings, seen)
+    return findings
+
+
+def _prng_consumptions(ctx: ModuleContext, stmt: ast.AST) -> List[Tuple[str, int, int, str]]:
+    """(name, line, col, how) key consumptions in one statement."""
+    out: List[Tuple[str, int, int, str]] = []
+    for n in _walk_shallow(stmt):
+        if not isinstance(n, ast.Call):
+            continue
+        q = ctx.qual(n.func) or ""
+        if q.startswith("jax.random."):
+            leaf = q.split(".")[-1]
+            if leaf in _PRNG_NONCONSUMING:
+                continue
+            if n.args and isinstance(n.args[0], ast.Name):
+                out.append((n.args[0].id, n.lineno, n.col_offset, f"jax.random.{leaf}"))
+        for kw in n.keywords:
+            if kw.arg in ("key", "rng", "rng_key", "seed_key") and isinstance(kw.value, ast.Name):
+                out.append((kw.value.id, n.lineno, n.col_offset, f"{kw.arg}= of a call"))
+    return out
+
+
+def _sim_prng(
+    ctx: ModuleContext,
+    body: Sequence[ast.stmt],
+    keyish: Set[str],
+    consumed: Dict[str, int],
+    findings: List[RawFinding],
+    seen: Set[Tuple[int, int]],
+) -> Dict[str, int]:
+    for stmt in body:
+        if isinstance(stmt, _SKIP_DESCEND):
+            continue
+        if isinstance(stmt, ast.If):
+            s1 = _sim_prng(ctx, stmt.body, keyish, dict(consumed), findings, seen)
+            s2 = _sim_prng(ctx, stmt.orelse, keyish, dict(consumed), findings, seen)
+            consumed = _merge_branches(consumed, stmt, s1, s2)
+            continue
+        if isinstance(stmt, (ast.For, ast.While)):
+            consumed = _sim_prng(ctx, stmt.body, keyish, consumed, findings, seen)
+            consumed = _sim_prng(ctx, stmt.body, keyish, consumed, findings, seen)
+            consumed = _sim_prng(ctx, stmt.orelse, keyish, consumed, findings, seen)
+            continue
+        if isinstance(stmt, (ast.With, ast.AsyncWith, ast.Try)):
+            for blist in _body_lists(stmt):
+                consumed = _sim_prng(ctx, blist, keyish, consumed, findings, seen)
+            continue
+        # discarded split
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+            q = ctx.qual(stmt.value.func) or ""
+            if q == "jax.random.split":
+                key = (stmt.lineno, stmt.col_offset)
+                if key not in seen:
+                    seen.add(key)
+                    findings.append(
+                        (stmt.lineno, stmt.col_offset, "prng-discard", "jax.random.split result is discarded")
+                    )
+        # track keyish bindings from jax.random results
+        for n in _walk_shallow(stmt):
+            if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call):
+                q = ctx.qual(n.value.func) or ""
+                if q.startswith("jax.random."):
+                    for t in n.targets:
+                        for tn in ast.walk(t):
+                            if isinstance(tn, ast.Name):
+                                keyish.add(tn.id)
+        # consumptions
+        for name, line, col, how in _prng_consumptions(ctx, stmt):
+            if name not in keyish and not _KEYISH_NAME.search(name):
+                continue
+            keyish.add(name)
+            if name in consumed:
+                key = (line, col)
+                if key not in seen:
+                    seen.add(key)
+                    findings.append(
+                        (
+                            line,
+                            col,
+                            "prng-reuse",
+                            f"key '{name}' already consumed at line {consumed[name]} is consumed "
+                            f"again by {how} without a split/reassignment — both draws see "
+                            f"IDENTICAL randomness",
+                        )
+                    )
+            else:
+                consumed[name] = line
+        # rebinds reset
+        for name in _assigned_names(stmt):
+            consumed.pop(name, None)
+    return consumed
+
+
+# =====================================================================
+# (d) host-sync-in-hot-path
+# =====================================================================
+_HOT_SCOPE_CALLS = {"trace_scope", "hot_scope", "transfer_sanitizer"}
+
+
+def check_host_sync(ctx: ModuleContext) -> List[RawFinding]:
+    """Flags device→host sync points inside loop bodies or ``obs.trace``
+    hot scopes: ``.item()`` on a device-ish value, ``float()``/``int()``/
+    ``bool()`` of one, ``np.asarray``/``np.array`` of one,
+    ``jax.device_get``, and implicit truthiness (``if x:``) on one.  Each
+    such site stalls the dispatch pipeline once PER ITERATION — the class
+    the ``metric.fetch_every`` gate and ``start_async_host_copy`` exist
+    to amortize.
+
+    "Device-ish" = the name was assigned (anywhere in the enclosing
+    function — flow-insensitive on purpose) from a ``jax.*``/``jnp.*``
+    call.  Intended sync points (the action fetch of an env loop) get an
+    inline suppression naming the check, which doubles as documentation.
+    """
+    findings: List[RawFinding] = []
+    for scope in _scopes(ctx.tree):
+        body = scope.body if isinstance(scope, _SCOPE_TYPES) else ctx.tree.body
+        deviceish: Set[str] = set()
+        for stmt in body:
+            if isinstance(stmt, _SKIP_DESCEND):
+                continue  # nested defs are scopes of their own
+            for n in _walk_shallow(stmt):
+                if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call):
+                    q = ctx.qual(n.value.func) or ""
+                    if q.startswith("jax.") and not q.startswith(("jax.device_get", "jax.tree_util")):
+                        for t in n.targets:
+                            if isinstance(t, ast.Name):
+                                deviceish.add(t.id)
+        for stmt in body:
+            if isinstance(stmt, _SKIP_DESCEND):
+                continue
+            for n in _walk_shallow(stmt):
+                site = _host_sync_site(ctx, n, deviceish)
+                if site and _in_hot_context(ctx, n, scope):
+                    findings.append(site)
+    return findings
+
+
+def _is_hot_with(ctx: ModuleContext, stmt: ast.AST) -> bool:
+    for item in getattr(stmt, "items", []) or []:
+        e = item.context_expr
+        if isinstance(e, ast.Call):
+            q = ctx.qual(e.func) or ""
+            if q.split(".")[-1] in _HOT_SCOPE_CALLS:
+                return True
+    return False
+
+
+def _in_hot_context(ctx: ModuleContext, node: ast.AST, scope: ast.AST) -> bool:
+    """Inside a loop body or a ``trace_scope``/``hot_scope`` with-block of
+    the SAME function scope (closures called from a loop are invisible —
+    documented heuristic boundary)."""
+    for anc in ctx.ancestors(node):
+        if anc is scope or isinstance(anc, _SKIP_DESCEND):
+            return False
+        if isinstance(anc, (ast.For, ast.AsyncFor, ast.While)):
+            return True
+        if isinstance(anc, (ast.With, ast.AsyncWith)) and _is_hot_with(ctx, anc):
+            return True
+    return False
+
+
+def _host_sync_site(ctx: ModuleContext, n: ast.AST, deviceish: Set[str]) -> Optional[RawFinding]:
+    def _deviceish_expr(e: ast.AST) -> bool:
+        if isinstance(e, ast.Name):
+            return e.id in deviceish
+        if isinstance(e, ast.Call):
+            q = ctx.qual(e.func) or ""
+            return q.startswith("jax.numpy.")
+        if isinstance(e, (ast.Subscript, ast.Attribute)):
+            return _deviceish_expr(e.value)
+        return False
+
+    # implicit truthiness on a device-ish name
+    if isinstance(n, (ast.If, ast.While)) and isinstance(n.test, ast.Name) and n.test.id in deviceish:
+        return (
+            n.test.lineno,
+            n.test.col_offset,
+            "host-sync",
+            f"implicit truthiness of device array '{n.test.id}' in a hot path blocks on the "
+            f"device (fetch an explicit host flag instead)",
+        )
+    if not isinstance(n, ast.Call):
+        return None
+    q = ctx.qual(n.func) or ""
+    if isinstance(n.func, ast.Attribute) and n.func.attr == "item" and not n.args:
+        if _deviceish_expr(n.func.value):
+            return (n.lineno, n.col_offset, "host-sync", ".item() on a device array syncs per iteration")
+        return None
+    if q in ("float", "int", "bool") and len(n.args) == 1 and _deviceish_expr(n.args[0]):
+        return (
+            n.lineno,
+            n.col_offset,
+            "host-sync",
+            f"{q}() of a device value syncs per iteration (fetch once outside the loop, or gate "
+            f"with metric.fetch_every)",
+        )
+    if q in ("numpy.asarray", "numpy.array") and len(n.args) >= 1 and _deviceish_expr(n.args[0]):
+        return (
+            n.lineno,
+            n.col_offset,
+            "host-sync",
+            "np.asarray of a device array in a hot path is a blocking device→host copy "
+            "(start_async_host_copy + fetch late, or hoist out of the loop)",
+        )
+    if q == "jax.device_get":
+        return (
+            n.lineno,
+            n.col_offset,
+            "host-sync",
+            "jax.device_get in a hot path blocks per iteration (batch fetches, see "
+            "utils.device_get_metrics)",
+        )
+    return None
+
+
+# =====================================================================
+# (e) retrace hazards
+# =====================================================================
+def check_retrace(ctx: ModuleContext) -> List[RawFinding]:
+    """Inside functions that get TRACED (decorated with / passed to
+    ``jax.jit``, ``setup_step``, ``guard_update``, ``shard_map``,
+    ``lax.scan`` & friends — nested defs inherit tracedness):
+
+    - ``retrace-fstring``: an f-string / ``str()`` whose expression reads
+      a function parameter or a jnp-derived local.  Formatting a tracer
+      either raises (concretization) or, with static shapes, silently
+      bakes the VALUE into the trace — one recompile per distinct value.
+    - ``retrace-branch``: ``if``/``while`` whose test reads a parameter
+      or jnp-derived local directly.  Metadata tests (``.shape``,
+      ``.dtype``, ``is None``, ``isinstance``, ``len``) are static and
+      exempt; value tests need ``jnp.where``/``lax.cond``.
+    - ``retrace-set-iter``: iterating a ``set`` (literal or call, unless
+      wrapped in ``sorted``) while tracing — pytree leaf order then varies
+      per interpreter run, defeating the compilation cache.
+    """
+    findings: List[RawFinding] = []
+    traced = _traced_functions(ctx)
+    for fn in traced:
+        params = {a.arg for a in list(fn.args.args) + list(fn.args.kwonlyargs) + list(fn.args.posonlyargs)}
+        for va in (fn.args.vararg, fn.args.kwarg):
+            if va is not None:
+                params.add(va.arg)
+        tracedish = set(params)
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call):
+                q = ctx.qual(n.value.func) or ""
+                if q.startswith(("jax.numpy.", "jax.lax.", "jax.nn.")):
+                    for t in n.targets:
+                        for tn in ast.walk(t):
+                            if isinstance(tn, ast.Name):
+                                tracedish.add(tn.id)
+        _scan_retrace(ctx, fn, tracedish, findings)
+    # dedupe (nested traced fns are walked by their parent too)
+    return sorted(set(findings))
+
+
+def _traced_functions(ctx: ModuleContext) -> List[ast.AST]:
+    by_name: Dict[str, List[ast.AST]] = {}
+    for n in ast.walk(ctx.tree):
+        if isinstance(n, _SCOPE_TYPES):
+            by_name.setdefault(n.name, []).append(n)
+    traced: Set[ast.AST] = set()
+
+    def q_is_entry(q: str) -> bool:
+        return (
+            q in _TRACE_ENTRY_QUALS
+            or q.split(".")[-1] in _TRACE_ENTRY_NAMES
+            or q.split(".")[-1] in _TRACE_ENTRY_ATTRS
+        )
+
+    for n in ast.walk(ctx.tree):
+        if isinstance(n, _SCOPE_TYPES):
+            for dec in n.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                q = ctx.qual(target) or ""
+                if q_is_entry(q):
+                    traced.add(n)
+                elif q in ("functools.partial", "partial") and isinstance(dec, ast.Call) and dec.args:
+                    # @partial(jax.jit, static_argnums=...) — traced iff the
+                    # partial'd callable is itself a trace entry point
+                    if q_is_entry(ctx.qual(dec.args[0]) or ""):
+                        traced.add(n)
+        if isinstance(n, ast.Call):
+            q = ctx.qual(n.func) or ""
+            if not q_is_entry(q):
+                continue
+            for arg in list(n.args) + [kw.value for kw in n.keywords]:
+                if isinstance(arg, ast.Name) and arg.id in by_name:
+                    traced.update(by_name[arg.id])
+    # nested defs of traced functions are traced as part of them
+    out: Set[ast.AST] = set(traced)
+    for fn in traced:
+        for inner in ast.walk(fn):
+            if isinstance(inner, _SCOPE_TYPES) and inner is not fn:
+                out.add(inner)
+    return sorted(out, key=lambda f: f.lineno)
+
+
+def _name_is_static_use(ctx: ModuleContext, name: ast.Name, stop: ast.AST) -> bool:
+    for anc in ctx.ancestors(name):
+        # metadata reads anywhere up the chain (x.shape[0], data["k"].ndim)
+        if isinstance(anc, ast.Attribute) and anc.attr in _STATIC_ATTRS:
+            return True
+        if isinstance(anc, ast.Call):
+            q = ctx.qual(anc.func) or ""
+            if q.split(".")[-1] in _STATIC_CALLS:
+                return True
+        if isinstance(anc, ast.Compare) and all(isinstance(op, (ast.Is, ast.IsNot)) for op in anc.ops):
+            return True
+        if anc is stop:
+            break
+    return False
+
+
+def _scan_retrace(ctx: ModuleContext, fn: ast.AST, tracedish: Set[str], findings: List[RawFinding]) -> None:
+    for n in ast.walk(fn):
+        if isinstance(n, ast.JoinedStr):
+            for v in n.values:
+                if isinstance(v, ast.FormattedValue):
+                    for sub in ast.walk(v.value):
+                        if (
+                            isinstance(sub, ast.Name)
+                            and isinstance(sub.ctx, ast.Load)
+                            and sub.id in tracedish
+                            and not _name_is_static_use(ctx, sub, n)
+                        ):
+                            findings.append(
+                                (
+                                    n.lineno,
+                                    n.col_offset,
+                                    "retrace-fstring",
+                                    f"traced value '{sub.id}' formatted into a string inside a "
+                                    f"traced function (concretization error or silent retrace "
+                                    f"per value — format OUTSIDE the jitted fn)",
+                                )
+                            )
+                            break
+        elif isinstance(n, (ast.If, ast.While)):
+            for sub in ast.walk(n.test):
+                if (
+                    isinstance(sub, ast.Name)
+                    and isinstance(sub.ctx, ast.Load)
+                    and sub.id in tracedish
+                    and not _name_is_static_use(ctx, sub, n.test)
+                ):
+                    findings.append(
+                        (
+                            n.lineno,
+                            n.col_offset,
+                            "retrace-branch",
+                            f"Python branch on traced value '{sub.id}' inside a traced function "
+                            f"(TracerBoolConversionError or per-shape retrace — use jnp.where / "
+                            f"lax.cond, or mark the arg static)",
+                        )
+                    )
+                    break
+        elif isinstance(n, ast.For):
+            it = n.iter
+            if isinstance(it, ast.Set) or (
+                isinstance(it, ast.Call) and (ctx.qual(it.func) or "").split(".")[-1] == "set"
+            ):
+                findings.append(
+                    (
+                        n.lineno,
+                        n.col_offset,
+                        "retrace-set-iter",
+                        "iterating a set while tracing: pytree/arg order becomes "
+                        "run-dependent and defeats the compilation cache (sort it)",
+                    )
+                )
+
+
+# =====================================================================
+# entry point
+# =====================================================================
+_ALL_CHECKERS = (check_donation, check_zero_copy, check_prng, check_host_sync, check_retrace)
+
+
+def run_checkers(
+    tree: ast.Module, source: str, select: Optional[Set[str]] = None
+) -> List[RawFinding]:
+    ctx = ModuleContext(tree)
+    out: List[RawFinding] = []
+    for checker in _ALL_CHECKERS:
+        for f in checker(ctx):
+            if select is None or f[2] in select:
+                out.append(f)
+    return out

@@ -1,0 +1,329 @@
+"""jaxlint — the AST static-analysis pass over JAX-hazard bug classes.
+
+Engine only: findings, inline suppressions, the committed baseline, file
+walking and the CLI.  The JAX-specific checkers live in
+:mod:`sheeprl_tpu.analysis.checkers`.
+
+Design notes
+------------
+- **Checks are heuristics.** Static analysis cannot prove a ``device_put``
+  source is freed or that a jitted callee donates; each checker encodes
+  the repo's idioms (``runtime.setup_step(..., donate_argnums=...)``,
+  ``ShmArena.unpack``, ``np.load`` members, ``trace_scope`` hot phases)
+  and errs toward flagging.  The escape hatches are first-class:
+  triage every finding into a FIX, an inline suppression with the check
+  name, or a baseline entry with a justification — never ignore one.
+- **Suppressions**: ``# jaxlint: disable=check-a,check-b`` on the flagged
+  line, ``# jaxlint: disable-next=...`` on the line above it, or
+  ``# jaxlint: disable-file=...`` anywhere in the file.  ``all`` matches
+  every check.
+- **Baseline**: a committed JSON file of fingerprinted findings that are
+  accepted (with a ``why``) rather than fixed.  Fingerprints hash the
+  *source text* of the flagged line (not its line number), so unrelated
+  edits above a baselined site do not invalidate it.  Stale entries are
+  reported on stderr; ``--write-baseline`` regenerates the file.
+
+Exit codes: 0 clean, 1 unsuppressed findings, 2 usage/IO error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import hashlib
+import io
+import json
+import os
+import re
+import sys
+import tokenize
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+# ordered catalog: check id -> one-line description (the docs table and
+# --list-checks are generated from this, so it cannot drift)
+CHECKS: Dict[str, str] = {
+    "use-after-donate": (
+        "read of a variable passed at a donate_argnums position after the donating "
+        "dispatch, without an intervening detach_copy/np.copy/reassignment"
+    ),
+    "zero-copy-alias": (
+        "device_put/jnp.asarray whose source is borrowed host memory (np.frombuffer, "
+        "np.memmap, npz member, shm-ring slot view) without an explicit copy"
+    ),
+    "prng-reuse": (
+        "the same PRNG key consumed by two traced draws without a split/reassignment "
+        "in between (identical randomness, silently)"
+    ),
+    "prng-discard": "jax.random.split result discarded (the split paid for keys nobody uses)",
+    "host-sync": (
+        ".item()/float()/bool()/np.asarray/device_get/implicit truthiness on a device "
+        "array inside a loop body or obs.trace hot scope (hidden device sync per step)"
+    ),
+    "retrace-fstring": (
+        "traced value formatted into a string inside a jitted/traced function "
+        "(concretization error, or a silent retrace per distinct value)"
+    ),
+    "retrace-branch": (
+        "Python branching on a traced value inside a jitted/traced function "
+        "(TracerBoolConversionError, or shape-dependent retraces)"
+    ),
+    "retrace-set-iter": (
+        "iteration over a set while building pytrees inside a traced function "
+        "(non-deterministic leaf order => cache misses across runs)"
+    ),
+    "parse-error": "file does not parse (reported, never baselined silently)",
+}
+
+_EXCLUDE_DIRS = {"__pycache__", ".git", ".pytest_cache", "node_modules"}
+_SUPPRESS_RE = re.compile(r"#\s*jaxlint:\s*(disable|disable-next|disable-file)\s*=\s*([A-Za-z0-9_,\- ]+)")
+
+
+@dataclasses.dataclass
+class Finding:
+    path: str  # normalized relative posix path
+    line: int
+    col: int
+    check: str
+    message: str
+    line_text: str = ""
+    occurrence: int = 0  # index among identical (path, check, line_text) findings
+
+    @property
+    def fingerprint(self) -> str:
+        norm = " ".join(self.line_text.split())
+        raw = f"{self.path}::{self.check}::{norm}::{self.occurrence}"
+        return hashlib.sha1(raw.encode()).hexdigest()[:16]
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.check}: {self.message}"
+
+
+# --------------------------------------------------------------- suppressions
+def _parse_suppressions(source: str) -> Tuple[Dict[int, Set[str]], Set[str]]:
+    """Per-line and file-level suppressed check sets.
+
+    Comment-aware (tokenize), so a ``# jaxlint:`` inside a string literal
+    does not suppress anything.  ``disable`` applies to the comment's own
+    line (and, for a comment-only line, to the next code line — the
+    natural place above a multi-line statement); ``disable-next`` to the
+    following line only.
+    """
+    per_line: Dict[int, Set[str]] = {}
+    file_level: Set[str] = set()
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _SUPPRESS_RE.search(tok.string)
+            if not m:
+                continue
+            mode = m.group(1)
+            checks = {c.strip() for c in m.group(2).split(",") if c.strip()}
+            lineno = tok.start[0]
+            own_line_is_comment_only = tok.line.strip().startswith("#")
+            if mode == "disable-file":
+                file_level |= checks
+            elif mode == "disable-next":
+                per_line.setdefault(lineno + 1, set()).update(checks)
+            else:  # disable
+                per_line.setdefault(lineno, set()).update(checks)
+                if own_line_is_comment_only:
+                    per_line.setdefault(lineno + 1, set()).update(checks)
+    except tokenize.TokenError:
+        pass  # the ast parse will report the real problem
+    return per_line, file_level
+
+
+def _suppressed(f: Finding, per_line: Dict[int, Set[str]], file_level: Set[str]) -> bool:
+    for checks in (file_level, per_line.get(f.line, ())):
+        if f.check in checks or "all" in checks:
+            return True
+    return False
+
+
+# ------------------------------------------------------------------ baseline
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), "jaxlint_baseline.json")
+
+
+def load_baseline(path: Optional[str]) -> Dict[str, dict]:
+    """fingerprint -> entry.  Missing file = empty baseline."""
+    if not path or not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        doc = json.load(f)
+    if doc.get("version") != 1:
+        raise ValueError(f"unknown baseline version {doc.get('version')!r} in {path}")
+    return {e["fingerprint"]: e for e in doc.get("entries", [])}
+
+
+def write_baseline(path: str, findings: Sequence[Finding], old: Dict[str, dict]) -> None:
+    """Regenerate the baseline from the current findings, carrying each
+    surviving entry's ``why`` forward; new entries get a TODO placeholder
+    the reviewer must replace with a justification."""
+    entries = []
+    for f in sorted(findings, key=lambda x: (x.path, x.line, x.check)):
+        prev = old.get(f.fingerprint, {})
+        entries.append(
+            {
+                "fingerprint": f.fingerprint,
+                "check": f.check,
+                "path": f.path,
+                "line": f.line,
+                "line_text": " ".join(f.line_text.split()),
+                "why": prev.get("why", "TODO: justify or fix"),
+            }
+        )
+    with open(path, "w") as fp:
+        json.dump({"version": 1, "entries": entries}, fp, indent=2, sort_keys=False)
+        fp.write("\n")
+
+
+# ------------------------------------------------------------------- running
+def _norm_path(path: str, root: Optional[str] = None) -> str:
+    """Repo-stable identity for baselines: relative to ``root`` (default
+    cwd) when under it, absolute otherwise; always posix separators."""
+    base = os.path.abspath(root or os.getcwd())
+    ap = os.path.abspath(path)
+    if ap.startswith(base + os.sep):
+        ap = ap[len(base) + 1 :]
+    return ap.replace(os.sep, "/")
+
+
+def iter_py_files(paths: Sequence[str]) -> Iterable[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+        elif os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(d for d in dirnames if d not in _EXCLUDE_DIRS)
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        yield os.path.join(dirpath, fn)
+        else:
+            raise FileNotFoundError(p)
+
+
+def lint_source(
+    source: str, path: str, select: Optional[Set[str]] = None, root: Optional[str] = None
+) -> List[Finding]:
+    """All unsuppressed findings for one file's source text."""
+    import ast
+
+    from sheeprl_tpu.analysis.checkers import run_checkers
+
+    rel = _norm_path(path, root)
+    lines = source.splitlines()
+
+    def line_text(n: int) -> str:
+        return lines[n - 1] if 1 <= n <= len(lines) else ""
+
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [
+            Finding(rel, int(e.lineno or 1), int(e.offset or 0), "parse-error", str(e.msg), line_text(int(e.lineno or 1)))
+        ]
+    raw = run_checkers(tree, source, select=select)
+    per_line, file_level = _parse_suppressions(source)
+    findings: List[Finding] = []
+    occ: Dict[Tuple[str, str], int] = {}
+    for line, col, check, message in sorted(raw, key=lambda r: (r[0], r[1], r[2])):
+        text = line_text(line)
+        key = (check, " ".join(text.split()))
+        f = Finding(rel, line, col, check, message, text, occ.get(key, 0))
+        occ[key] = occ.get(key, 0) + 1
+        if not _suppressed(f, per_line, file_level):
+            findings.append(f)
+    return findings
+
+
+def lint_paths(
+    paths: Sequence[str], select: Optional[Set[str]] = None, root: Optional[str] = None
+) -> List[Finding]:
+    findings: List[Finding] = []
+    for fn in iter_py_files(paths):
+        with open(fn, encoding="utf-8") as f:
+            source = f.read()
+        findings.extend(lint_source(source, fn, select=select, root=root))
+    return findings
+
+
+# ----------------------------------------------------------------------- CLI
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="jaxlint",
+        description="JAX-hazard static analysis: donation, aliasing, PRNG, host-sync, retrace checks.",
+    )
+    ap.add_argument("paths", nargs="*", default=["sheeprl_tpu"], help="files/directories to lint")
+    ap.add_argument("--baseline", default=None, help="baseline JSON (default: the committed in-package file)")
+    ap.add_argument("--no-baseline", action="store_true", help="ignore the baseline entirely")
+    ap.add_argument("--write-baseline", action="store_true", help="accept current findings into the baseline")
+    ap.add_argument("--select", default=None, help="comma-separated check ids to run (default: all)")
+    ap.add_argument("--json", action="store_true", help="machine-readable findings on stdout")
+    ap.add_argument("--list-checks", action="store_true", help="print the checker catalog and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_checks:
+        for check, desc in CHECKS.items():
+            print(f"{check:18s} {desc}")
+        return 0
+
+    select: Optional[Set[str]] = None
+    if args.select:
+        select = {c.strip() for c in args.select.split(",") if c.strip()}
+        unknown = select - set(CHECKS)
+        if unknown:
+            print(f"jaxlint: unknown checks: {', '.join(sorted(unknown))}", file=sys.stderr)
+            return 2
+
+    try:
+        findings = lint_paths(args.paths, select=select)
+    except FileNotFoundError as e:
+        print(f"jaxlint: no such file or directory: {e}", file=sys.stderr)
+        return 2
+
+    baseline_path = args.baseline or default_baseline_path()
+    baseline = {} if args.no_baseline else load_baseline(baseline_path)
+
+    if args.write_baseline:
+        write_baseline(baseline_path, findings, baseline)
+        print(f"jaxlint: wrote {len(findings)} entries to {baseline_path}", file=sys.stderr)
+        return 0
+
+    fresh = [f for f in findings if f.fingerprint not in baseline]
+    matched = {f.fingerprint for f in findings if f.fingerprint in baseline}
+    stale = [e for fp, e in baseline.items() if fp not in matched]
+
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "findings": [dataclasses.asdict(f) | {"fingerprint": f.fingerprint} for f in fresh],
+                    "baselined": len(findings) - len(fresh),
+                    "stale_baseline": [e["fingerprint"] for e in stale],
+                },
+                indent=2,
+            )
+        )
+    else:
+        for f in fresh:
+            print(f.render())
+    if stale:
+        print(
+            f"jaxlint: {len(stale)} stale baseline entr{'y' if len(stale) == 1 else 'ies'} "
+            f"(fixed or moved — refresh with --write-baseline):",
+            file=sys.stderr,
+        )
+        for e in stale:
+            print(f"  {e['path']}: {e['check']}: {e.get('line_text', '')!r}", file=sys.stderr)
+    if fresh:
+        n_files = len({f.path for f in fresh})
+        print(f"jaxlint: {len(fresh)} finding(s) in {n_files} file(s)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

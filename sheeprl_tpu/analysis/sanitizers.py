@@ -1,0 +1,396 @@
+"""Runtime sanitizers — the dynamic half of the jaxlint tooling.
+
+Everything here is **opt-in** via ``SHEEPRL_SANITIZE=1`` (off = zero
+overhead: the hooks return the undecorated objects / null contexts, and
+the leak registry is a couple of dict ops per long-lived resource).  Four
+pieces:
+
+- **Donation sanitizer** (:func:`guard_donation`, wired inside
+  ``MeshRuntime.setup_step``): on CPU/GPU backends XLA often cannot honor
+  ``donate_argnums``, so a use-after-donate reads *recycled* memory at a
+  timing-dependent step instead of failing — the PR-3 class.  The
+  sanitizer waits for the dispatch, then deletes every donated device
+  leaf (and NaN-poisons donated host numpy leaves), so ANY later touch
+  raises ``Array has been deleted`` deterministically, on every backend.
+- **Host-alias guard** (:func:`check_host_sources`, wired inside
+  ``MeshRuntime.shard_batch``/``replicate``): refuses device uploads
+  whose numpy source is memory numpy does not own — ``np.memmap``
+  windows, ``np.frombuffer`` over a bytearray/mmap/shm slot, mmap-mode
+  npz members.  CPU ``device_put`` zero-copy aliases these WITHOUT
+  keeping the owner alive (the PR-7 freed-npz heap corruption).
+- **Transfer guard** (:func:`transfer_sanitizer`, composed into
+  ``obs.trace_scope``): scoped ``jax.transfer_guard("disallow")`` around
+  hot-loop phases, with an explicit allowlist for the phases whose whole
+  point is a transfer (``block_until_ready`` metric fetches, IPC waits).
+  Implicit host syncs inside guarded scopes then fail loudly instead of
+  silently stalling the step.
+- **Leak registry** (:data:`leak_registry`, fed by
+  ``parallel/transport.py``, ``parallel/shm_ring.py``,
+  ``parallel/pipeline.py`` and ``data/feed.py``): tracks live channels,
+  shm segments and worker threads; :func:`session_leak_report` backs the
+  suite-wide pytest sweep (tests/conftest.py) that fails the session on
+  orphaned ``/dev/shm`` segments or still-alive worker threads — the
+  PR-6 leaked-feeder-thread hang, caught at test time.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import threading
+import weakref
+from contextlib import contextmanager, nullcontext
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+__all__ = [
+    "DonationSanitizerError",
+    "HostAliasError",
+    "LeakRegistry",
+    "allowed_transfer_scopes",
+    "check_host_sources",
+    "guard_donation",
+    "leak_registry",
+    "sanitize_enabled",
+    "session_leak_report",
+    "shm_orphans",
+    "sweep_leaks",
+    "transfer_sanitizer",
+]
+
+_TRUTHY = ("1", "true", "yes", "on")
+
+
+class DonationSanitizerError(RuntimeError):
+    """A donated buffer was touched after its donating dispatch."""
+
+
+class HostAliasError(RuntimeError):
+    """A device upload zero-copy aliases host memory numpy does not own."""
+
+
+def sanitize_enabled() -> bool:
+    """``SHEEPRL_SANITIZE`` env gate, read per call (cheap: one dict
+    lookup) so tests and subprocess children can toggle it."""
+    return os.environ.get("SHEEPRL_SANITIZE", "").strip().lower() in _TRUTHY
+
+
+# ===================================================================== #
+# donation sanitizer
+# ===================================================================== #
+def _leaf_pointer(leaf: Any) -> Optional[int]:
+    """Host/device buffer address when obtainable (CPU single-device
+    arrays and numpy); None otherwise."""
+    try:
+        import numpy as np
+
+        if isinstance(leaf, np.ndarray):
+            return leaf.ctypes.data if leaf.size else None
+        fn = getattr(leaf, "unsafe_buffer_pointer", None)
+        if fn is not None:
+            return int(fn())
+    except Exception:
+        pass
+    return None
+
+
+def guard_donation(fn, donate_argnums: Tuple[int, ...], where: str = "jitted step"):
+    """Wrap a jitted dispatch so donated inputs die DETERMINISTICALLY.
+
+    After the wrapped call, the outputs are materialized
+    (``block_until_ready`` — sanitize mode trades the async-dispatch
+    overlap for determinism), then every ``jax.Array`` leaf of each
+    donated argument is ``.delete()``-d and every float numpy leaf is
+    NaN-poisoned.  Leaves whose buffer is shared with an output
+    (passthrough / already-honored donation) are left alone — the
+    sanitizer must never corrupt a correct program.  A later touch of a
+    deleted leaf raises jax's "Array has been deleted" RuntimeError at
+    the EXACT offending line, instead of a heisenbug three PRs later.
+    """
+    donate_argnums = tuple(donate_argnums)
+    if not donate_argnums:
+        return fn
+
+    def sanitized(*args, **kwargs):
+        import jax
+        import numpy as np
+
+        out = fn(*args, **kwargs)
+        jax.block_until_ready(out)
+        out_leaves = jax.tree_util.tree_leaves(out)
+        out_ids = {id(l) for l in out_leaves}
+        out_ptrs = {p for p in (_leaf_pointer(l) for l in out_leaves) if p is not None}
+        for i in donate_argnums:
+            if i >= len(args):
+                continue
+            for leaf in jax.tree_util.tree_leaves(args[i]):
+                if id(leaf) in out_ids:
+                    continue
+                ptr = _leaf_pointer(leaf)
+                if ptr is not None and ptr in out_ptrs:
+                    continue  # buffer shared with an output: not ours to kill
+                if isinstance(leaf, np.ndarray):
+                    # poison donated HOST references: CPU device_put may
+                    # have zero-copy aliased this buffer; a reuse now
+                    # reads NaN instead of plausible stale numbers
+                    if ptr is not None and leaf.flags.writeable and leaf.dtype.kind == "f":
+                        leaf.fill(np.nan)
+                    continue
+                delete = getattr(leaf, "delete", None)
+                deleted = getattr(leaf, "is_deleted", None)
+                if delete is not None and (deleted is None or not deleted()):
+                    try:
+                        delete()
+                    except Exception:
+                        pass  # sharded/committed-elsewhere leaves: skip
+        return out
+
+    sanitized._donation_sanitizer = where  # introspectable in tests
+    sanitized._jitted = getattr(fn, "_jitted", None)
+    return sanitized
+
+
+# ===================================================================== #
+# host-alias guard
+# ===================================================================== #
+def _borrowed_base(arr: Any) -> Optional[str]:
+    """Why ``arr``'s memory is NOT owned by the numpy view chain, or None.
+
+    A plain ndarray view keeps its base ndarray alive via refcount — safe.
+    The hazardous class is buffers whose lifetime numpy does not manage:
+    file-backed memmaps, ``frombuffer`` over mmap/bytearray/memoryview
+    (shm slots come in through exactly that path), npz zip members opened
+    with ``mmap_mode``.
+    """
+    import mmap
+
+    import numpy as np
+
+    if isinstance(arr, np.memmap):
+        return "np.memmap window"
+    base = arr
+    while isinstance(base, np.ndarray):
+        if isinstance(base, np.memmap):
+            return "np.memmap window"
+        if base.base is None:
+            return None  # owns its data
+        base = base.base
+    if isinstance(base, mmap.mmap):
+        return "mmap-backed buffer (np.load(mmap_mode=...) member or shm slot)"
+    if isinstance(base, (bytearray, memoryview)):
+        return f"{type(base).__name__}-backed np.frombuffer view"
+    if base is not None:
+        return f"{type(base).__name__}-backed buffer"
+    return None
+
+
+def check_host_sources(tree: Any, where: str = "device upload") -> None:
+    """Raise :class:`HostAliasError` when any numpy leaf of ``tree`` is a
+    view over borrowed (non-numpy-owned) memory.  No-op unless
+    ``SHEEPRL_SANITIZE`` is on.  Wired into ``MeshRuntime.shard_batch``
+    and ``MeshRuntime.replicate`` — the two upload funnels of the algo
+    loops."""
+    if not sanitize_enabled():
+        return
+    import jax
+    import numpy as np
+
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        if not isinstance(leaf, np.ndarray):
+            continue
+        why = _borrowed_base(leaf)
+        if why:
+            pretty = jax.tree_util.keystr(path) or "<root>"
+            raise HostAliasError(
+                f"{where}: leaf {pretty} is a {why}. CPU device_put would zero-copy alias "
+                f"memory whose owner can be freed/recycled under the device array (the "
+                f"freed-npz/shm heap-corruption class). Materialize a copy first "
+                f"(np.copy / jnp.array(..., copy=True)) or keep the owner alive on host_refs."
+            )
+
+
+# ===================================================================== #
+# transfer guard
+# ===================================================================== #
+# phases whose very purpose is a device<->host transfer (implicit fetches
+# included): guard must not fire there
+_ALLOW_SCOPES = {
+    "block_until_ready",  # the gated metrics fetch (device_get_metrics)
+    "action_fetch",  # env-loop action/logprob/value fetch
+    "ipc_wait_update",
+    "ipc_wait_rollout",
+    "replay_sample",  # prioritized draw ships indices/weights host-side
+}
+# phases that must stay transfer-silent apart from EXPLICIT device_put
+_DISALLOW_SCOPES = {
+    "host_to_device",  # rollout upload: device_put (explicit) only
+    "ipc_send_shard",  # rollout serialization: numpy only, no device reads
+    "replay_insert",
+}
+
+
+def _env_scope_set(var: str) -> set:
+    raw = os.environ.get(var, "")
+    return {s.strip() for s in raw.split(",") if s.strip()}
+
+
+def allowed_transfer_scopes() -> set:
+    return _ALLOW_SCOPES | _env_scope_set("SHEEPRL_SANITIZE_ALLOW")
+
+
+def transfer_sanitizer(name: str):
+    """Transfer-guard context for trace scope ``name`` under sanitize
+    mode: ``disallow`` (implicit transfers raise; explicit
+    device_put/device_get still work) for the known transfer-silent
+    phases, ``allow`` for the allowlisted fetch phases (so they keep
+    working inside an outer disallow scope), inert otherwise.  Extend via
+    ``SHEEPRL_SANITIZE_ALLOW`` / ``SHEEPRL_SANITIZE_DISALLOW``
+    (comma-separated scope names)."""
+    if not sanitize_enabled():
+        return nullcontext()
+    import jax
+
+    if name in allowed_transfer_scopes():
+        return jax.transfer_guard("allow")
+    if name in (_DISALLOW_SCOPES | _env_scope_set("SHEEPRL_SANITIZE_DISALLOW")):
+        return jax.transfer_guard("disallow")
+    return nullcontext()
+
+
+# ===================================================================== #
+# leak registry
+# ===================================================================== #
+class LeakRegistry:
+    """Weak registry of long-lived resources (threads / channels / shm
+    segments).  Producers register on creation and unregister on clean
+    close; whatever is still live at sweep time is a leak candidate.
+    Always on — the cost is one dict write per resource lifetime."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._next = 0
+        self._items: Dict[int, Tuple[str, str, Any, str]] = {}  # token -> (kind, name, ref, where)
+
+    def register(self, kind: str, name: str, obj: Any = None, where: str = "") -> int:
+        ref: Any = None
+        if obj is not None:
+            try:
+                ref = weakref.ref(obj)
+            except TypeError:
+                ref = lambda _o=obj: _o  # unweakrefable: hold strongly (rare; shm names pass None)
+        with self._lock:
+            self._next += 1
+            token = self._next
+            self._items[token] = (kind, name, ref, where)
+        return token
+
+    def unregister(self, token: Optional[int]) -> None:
+        if token is None:
+            return
+        with self._lock:
+            self._items.pop(token, None)
+
+    def live(self, kind: Optional[str] = None) -> List[Tuple[str, str, str]]:
+        """(kind, name, where) entries whose object is still alive (or has
+        no tracked object).  GC'd objects are pruned — an abandoned,
+        collectable endpoint is not a leak."""
+        out: List[Tuple[str, str, str]] = []
+        with self._lock:
+            items = list(self._items.items())
+        dead = []
+        for token, (k, name, ref, where) in items:
+            obj = ref() if ref is not None else True
+            if obj is None:
+                dead.append(token)
+                continue
+            if isinstance(obj, threading.Thread) and not obj.is_alive():
+                dead.append(token)
+                continue
+            if kind is None or k == kind:
+                out.append((k, name, where))
+        with self._lock:
+            for token in dead:
+                self._items.pop(token, None)
+        return out
+
+
+leak_registry = LeakRegistry()
+
+
+def shm_orphans(prefix: str = "sheeprl_") -> List[str]:
+    """Names of ``/dev/shm`` segments left behind by this framework."""
+    return sorted(os.path.basename(p) for p in glob.glob(f"/dev/shm/{prefix}*"))
+
+
+def _worker_threads(include_daemon: bool) -> List[threading.Thread]:
+    out = []
+    for t in threading.enumerate():
+        if t is threading.main_thread() or not t.is_alive():
+            continue
+        name = t.name or ""
+        ours = name.startswith("sheeprl")
+        if not t.daemon or (include_daemon and ours):
+            out.append(t)
+    return out
+
+
+def sweep_leaks(include_daemon_threads: bool = True) -> Dict[str, List[str]]:
+    """One leak snapshot: orphaned shm segments, alive worker threads
+    (non-daemon always; sheeprl-named daemons when asked), and registry
+    entries still live.  Empty dict = clean."""
+    report: Dict[str, List[str]] = {}
+    orphans = shm_orphans()
+    if orphans:
+        report["shm_orphans"] = orphans
+    threads = _worker_threads(include_daemon_threads)
+    if threads:
+        report["threads"] = [f"{t.name} (daemon={t.daemon})" for t in threads]
+    live = leak_registry.live()
+    if live:
+        report["registry"] = [f"{k}:{name}" + (f" [{where}]" if where else "") for k, name, where in live]
+    return report
+
+
+def session_leak_report(grace_s: float = 2.0) -> Dict[str, List[str]]:
+    """End-of-suite sweep (tests/conftest.py session fixture).
+
+    Gives in-flight teardown a short grace period (GC + thread joins race
+    the fixture), then reports only the HARD failures a human must look
+    at: orphaned ``/dev/shm`` segments (PR-3 class) and still-alive
+    NON-daemon threads (the PR-6 exit-hang class — a daemon thread cannot
+    block interpreter exit, a non-daemon one does).  Registry leftovers
+    and lingering daemon threads ride along as informational keys
+    (``*_warn``) so the failure message shows the whole picture."""
+    import gc
+    import time
+
+    deadline = time.monotonic() + grace_s
+    while time.monotonic() < deadline:
+        gc.collect()
+        hard_threads = [t for t in _worker_threads(include_daemon=False)]
+        if not shm_orphans() and not hard_threads:
+            break
+        time.sleep(0.1)
+    report: Dict[str, List[str]] = {}
+    orphans = shm_orphans()
+    if orphans:
+        report["shm_orphans"] = orphans
+    hard = _worker_threads(include_daemon=False)
+    if hard:
+        report["nondaemon_threads"] = [t.name for t in hard]
+    soft = [t for t in _worker_threads(include_daemon=True) if t.daemon]
+    if soft:
+        report["daemon_threads_warn"] = [t.name for t in soft]
+    live = leak_registry.live()
+    if live:
+        report["registry_warn"] = [f"{k}:{name}" + (f" [{where}]" if where else "") for k, name, where in live]
+    return report
+
+
+@contextmanager
+def registered(kind: str, name: str, obj: Any = None, where: str = ""):
+    """Scope a registration to a with-block (test helper)."""
+    token = leak_registry.register(kind, name, obj, where)
+    try:
+        yield token
+    finally:
+        leak_registry.unregister(token)

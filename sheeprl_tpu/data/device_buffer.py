@@ -151,7 +151,7 @@ def _gather_transitions(bufs, rows, envs, *, n_samples, batch_size, cap, next_ke
     for k, buf in bufs.items():
         g = buf[rows, envs]  # (flat, *feat)
         out[k] = g.reshape(n_samples, batch_size, *buf.shape[2:])
-    if next_keys:
+    if next_keys:  # jaxlint: disable=retrace-branch — static obs-key tuple, not a tracer
         nrows = (rows + 1) % cap
         for k in next_keys:
             g = bufs[k][nrows, envs]
@@ -199,7 +199,7 @@ def _sample_transitions_prioritized(
     # live-cell count N for the IS correction w = (N * P(i))^-beta
     n_live = jnp.sum(filled) - (n_envs if next_keys else 0)
     t = tree
-    if next_keys:
+    if next_keys:  # jaxlint: disable=retrace-branch — static obs-key tuple, not a tracer
         head_rows = (pos - 1) % cap  # per-env newest row: its successor is stale
         head_leaves = head_rows * n_envs + jnp.arange(n_envs)
         t = _tree_zeroed(t, head_leaves, jnp.ones((n_envs,), bool), depth=depth)
@@ -273,7 +273,7 @@ def _sample_prioritized(
 
     flat = n_samples * batch_size
     t = tree
-    if seq_len > 1:
+    if seq_len > 1:  # jaxlint: disable=retrace-branch — static (python int) window length
         offs = jnp.arange(1, seq_len)  # (L-1,)
         inv_rows = (pos[None, :] - offs[:, None]) % cap  # (L-1, n_envs)
         inv_leaves = (inv_rows * n_envs + jnp.arange(n_envs)[None, :]).reshape(-1)

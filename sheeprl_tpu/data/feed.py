@@ -70,7 +70,12 @@ class DevicePrefetcher:
         self._queue: "queue.Queue" = queue.Queue(maxsize=depth)
         self._stop = threading.Event()
         self._error: Optional[BaseException] = None
-        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread = threading.Thread(target=self._worker, name="sheeprl-prefetcher", daemon=True)
+        from sheeprl_tpu.analysis.sanitizers import leak_registry
+
+        self._leak_token = leak_registry.register(
+            "thread", "sheeprl-prefetcher", self._thread, where="DevicePrefetcher"
+        )
         self._thread.start()
 
     def _worker(self) -> None:
@@ -127,6 +132,10 @@ class DevicePrefetcher:
                 self._queue.get_nowait()
             except queue.Empty:
                 break
+        from sheeprl_tpu.analysis.sanitizers import leak_registry
+
+        leak_registry.unregister(getattr(self, "_leak_token", None))
+        self._leak_token = None
 
     def __enter__(self) -> "DevicePrefetcher":
         return self

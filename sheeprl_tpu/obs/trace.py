@@ -26,7 +26,7 @@ from __future__ import annotations
 
 import os
 import warnings
-from contextlib import nullcontext
+from contextlib import ExitStack, contextmanager, nullcontext
 from typing import Optional
 
 try:  # profiler is part of core jax, but keep obs importable without it
@@ -37,10 +37,32 @@ except Exception:  # pragma: no cover - only hit on broken jax installs
 
 def trace_scope(name: str):
     """Context manager annotating the enclosed host-side phase in any
-    active jax.profiler trace. No-op-cheap when nothing is tracing."""
+    active jax.profiler trace. No-op-cheap when nothing is tracing.
+
+    Under ``SHEEPRL_SANITIZE=1`` the scope additionally carries the
+    transfer-guard policy for its name (analysis/sanitizers.py): phases
+    that must stay transfer-silent (``host_to_device`` uploads, IPC
+    serialization) run under ``jax.transfer_guard("disallow")`` so an
+    implicit device→host sync fails loudly at its source; the allowlisted
+    fetch phases (``block_until_ready`` & friends) re-allow explicitly.
+    Sanitize off: the guard import never happens — the annotation is the
+    whole cost, exactly as before."""
+    if os.environ.get("SHEEPRL_SANITIZE", "").strip().lower() in ("1", "true", "yes", "on"):
+        return _sanitized_scope(name)
     if _TraceAnnotation is None:
         return nullcontext()
     return _TraceAnnotation(name)
+
+
+@contextmanager
+def _sanitized_scope(name: str):
+    from sheeprl_tpu.analysis.sanitizers import transfer_sanitizer
+
+    with ExitStack() as stack:
+        if _TraceAnnotation is not None:
+            stack.enter_context(_TraceAnnotation(name))
+        stack.enter_context(transfer_sanitizer(name))
+        yield
 
 
 _ACTIVE_TRACE_DIR: Optional[str] = None

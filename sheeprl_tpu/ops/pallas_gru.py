@@ -97,7 +97,7 @@ def fused_gru_cell(
     b, hidden = h.shape
     inp = jnp.concatenate([h, x], axis=-1)
     kdim = inp.shape[-1]
-    if use_ln and (gamma is None or beta is None):
+    if use_ln and (gamma is None or beta is None):  # jaxlint: disable=retrace-branch — static kernel config
         raise ValueError("use_ln=True requires gamma and beta")
     if gamma is None:
         gamma = jnp.ones((3 * hidden,), jnp.float32)
@@ -116,7 +116,8 @@ def fused_gru_cell(
     # compile time ("ran out of memory in memory space vmem").
     itemsize = jnp.dtype(matmul_dtype).itemsize
     vmem_budget = 10 * 2**20 - 4 * block_b * 3 * hidden  # minus f32 accumulator
-    while block_k > 128 and 2 * block_k * 3 * hidden * itemsize > vmem_budget:
+    # static tile-size search over python ints (runs at trace time, once)
+    while block_k > 128 and 2 * block_k * 3 * hidden * itemsize > vmem_budget:  # jaxlint: disable=retrace-branch
         block_k //= 2
     nb = -(-b // block_b)
     nk = -(-kdim // block_k)

@@ -29,6 +29,12 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+def _sanitize_enabled() -> bool:
+    """Local alias kept import-lazy: the sanitizers module pulls in the
+    analysis package, which mesh must not pay for on the hot import path."""
+    return os.environ.get("SHEEPRL_SANITIZE", "").strip().lower() in ("1", "true", "yes", "on")
+
+
 _PRECISIONS = ("32-true", "bf16-mixed", "bf16-true")
 _STRATEGIES = ("auto", "dp", "ddp", "fsdp")
 _PLAYER_DEVICES = ("auto", "cpu", "accelerator")
@@ -329,6 +335,10 @@ class MeshRuntime:
 
         Every leaf's ``axis`` dim must be divisible by world_size.
         """
+        if _sanitize_enabled():
+            from sheeprl_tpu.analysis.sanitizers import check_host_sources
+
+            check_host_sources(batch, "shard_batch")
         return jax.device_put(batch, self.batch_sharding(axis))
 
     def replicate(self, tree: Any) -> Any:
@@ -339,6 +349,10 @@ class MeshRuntime:
         divisible by the mesh size (scalars and indivisible leaves stay
         replicated): the ZeRO-3 layout, with XLA inserting the weight
         all-gathers and gradient reduce-scatters during jit."""
+        if _sanitize_enabled():
+            from sheeprl_tpu.analysis.sanitizers import check_host_sources
+
+            check_host_sources(tree, "replicate")
         if self._strategy != "fsdp" or self.world_size == 1:
             return jax.device_put(tree, self.replicated)
         ws = self.world_size
@@ -382,6 +396,17 @@ class MeshRuntime:
                 return jitted(*args, **kw)
 
         wrapped._jitted = jitted
+        if donate_argnums and _sanitize_enabled():
+            # donation sanitizer (SHEEPRL_SANITIZE=1): deletes/poisons the
+            # donated inputs after each dispatch so a use-after-donate
+            # fails deterministically at the offending line on EVERY
+            # backend — on CPU/GPU unhonored donation otherwise turns the
+            # same bug into timing-dependent memory recycling.  Off path:
+            # this branch is never entered, the returned callable is the
+            # exact pre-sanitizer object (zero overhead).
+            from sheeprl_tpu.analysis.sanitizers import guard_donation
+
+            return guard_donation(wrapped, donate_argnums, where=getattr(fn, "__name__", "step"))
         return wrapped
 
     # ------------------------------------------------------------------ #

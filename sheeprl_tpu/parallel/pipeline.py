@@ -332,6 +332,11 @@ class PipelinedCollector:
             self._thread = threading.Thread(
                 target=self._worker, name="sheeprl-collector", daemon=True
             )
+            from sheeprl_tpu.analysis.sanitizers import leak_registry
+
+            self._leak_token = leak_registry.register(
+                "thread", "sheeprl-collector", self._thread, where="PipelinedCollector"
+            )
             self._thread.start()
 
     # ------------------------------------------------------------- worker
@@ -442,6 +447,10 @@ class PipelinedCollector:
 
                 warnings.warn("PipelinedCollector: collector thread did not join within timeout")
             self._thread = None
+            from sheeprl_tpu.analysis.sanitizers import leak_registry
+
+            leak_registry.unregister(getattr(self, "_leak_token", None))
+            self._leak_token = None
 
     @property
     def closed(self) -> bool:

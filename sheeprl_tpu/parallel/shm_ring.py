@@ -83,6 +83,14 @@ class ShmArena:
         self.slot_bytes = int(slot_bytes)
         self._owner = owner
         self._closed = False
+        # leak accounting (analysis/sanitizers.py): a segment that never
+        # reaches close() shows up by NAME in the suite-wide sweep (and,
+        # independently, as a /dev/shm orphan)
+        from sheeprl_tpu.analysis.sanitizers import leak_registry
+
+        self._leak_token = leak_registry.register(
+            "shm", shm.name, self, where="owner" if owner else "attached"
+        )
         # belt-and-braces: a process killed by an unhandled exception still
         # unlinks (SIGKILL can't run this — the surviving peer's close does)
         atexit.register(self.close)
@@ -133,6 +141,10 @@ class ShmArena:
             atexit.unregister(self.close)
         except Exception:
             pass
+        from sheeprl_tpu.analysis.sanitizers import leak_registry
+
+        leak_registry.unregister(getattr(self, "_leak_token", None))
+        self._leak_token = None
 
     # ------------------------------------------------------------- pack/read
     def pack(self, slot: int, arrays: Sequence[Tuple[str, np.ndarray]]) -> Optional[List[Tuple]]:

@@ -245,6 +245,19 @@ class Channel:
         self.bytes_recv = 0
         self.frames_sent = 0
         self.frames_recv = 0
+        # leak accounting (analysis/sanitizers.py): a channel that is never
+        # close()d and never collected shows up in the suite-wide sweep
+        from sheeprl_tpu.analysis.sanitizers import leak_registry
+
+        self._leak_token = leak_registry.register(
+            "channel", type(self).__name__, self, where=who
+        )
+
+    def _leak_unregister(self) -> None:
+        from sheeprl_tpu.analysis.sanitizers import leak_registry
+
+        leak_registry.unregister(getattr(self, "_leak_token", None))
+        self._leak_token = None
 
     def set_peer(self, peer_alive, who: str, detail_fn=None) -> None:
         self.peer_alive = peer_alive
@@ -268,7 +281,7 @@ class Channel:
         keep no such state."""
 
     def close(self) -> None:
-        pass
+        self._leak_unregister()
 
     # helpers ----------------------------------------------------------
     def _count_payload(self, arrays) -> int:
@@ -362,6 +375,7 @@ class QueueChannel(Channel):
         # undelivered frames must not wedge interpreter exit
         _cancel_queue_join(self._send_q)
         _cancel_queue_join(self._recv_q)
+        self._leak_unregister()
 
 
 class ShmChannel(QueueChannel):
@@ -847,6 +861,7 @@ class TcpChannel(Channel):
         _shutdown_close(self._sock)
         if self._reader is not None and self._reader is not threading.current_thread():
             self._reader.join(timeout=5.0)
+        self._leak_unregister()
 
 
 class TcpListener:
@@ -864,6 +879,11 @@ class TcpListener:
         self._cond = threading.Condition()
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._accept_loop, name="sheeprl-tcp-accept", daemon=True)
+        from sheeprl_tpu.analysis.sanitizers import leak_registry
+
+        self._leak_token = leak_registry.register(
+            "thread", "sheeprl-tcp-accept", self._thread, where=f"TcpListener {self.address}"
+        )
         self._thread.start()
 
     def _accept_loop(self) -> None:
@@ -924,6 +944,9 @@ class TcpListener:
         self._thread.join(timeout=5.0)
         for ch in self._channels.values():
             ch.close()
+        from sheeprl_tpu.analysis.sanitizers import leak_registry
+
+        leak_registry.unregister(getattr(self, "_leak_token", None))
 
 
 # ------------------------------------------------------------ spec + hub

@@ -102,6 +102,9 @@ class CheckpointCallback:
                     # mid-run checkpoint's content races the update chain)
                     host_state[k] = jax.tree_util.tree_map(
                         lambda x: np.array(x) if isinstance(x, np.ndarray) else x,
+                        # checkpoint snapshot cadence (checkpoint.every),
+                        # not a per-step path
+                        # jaxlint: disable-next=host-sync
                         jax.device_get(v),
                     )
         finally:

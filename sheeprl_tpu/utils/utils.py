@@ -311,6 +311,9 @@ def device_get_metrics(metrics: Dict[str, Any]) -> Dict[str, float]:
         out.update({k: float(v) for k, v in zip(keys, vals)})
     for k, v in metrics.items():  # non-scalar metrics keep their full value
         if k not in out:
+            # the leftover NON-scalar metrics; the scalars above already
+            # rode the one batched fetch
+            # jaxlint: disable-next=host-sync
             out[k] = jax.device_get(v)
     return out
 
@@ -350,6 +353,9 @@ def transfer_tree(tree: Any, device) -> Any:
         groups.setdefault(jnp.asarray(leaves[i]).dtype, []).append(i)
     for dt, idxs in groups.items():
         flat = jnp.concatenate([jnp.ravel(leaves[i]) for i in idxs])
+        # this IS the designed single cross-backend copy per dtype group
+        # (see docstring)
+        # jaxlint: disable-next=host-sync
         host = np.asarray(flat)  # the single cross-backend copy per dtype
         off = 0
         for i in idxs:

@@ -1,0 +1,590 @@
+"""jaxlint checker + engine tests (ISSUE 9): one golden POSITIVE and one
+golden NEGATIVE snippet per check, suppression semantics, baseline
+fingerprint semantics, and CLI exit codes.  Pure AST — no jax dispatches."""
+
+import json
+import textwrap
+
+import pytest
+
+from sheeprl_tpu.analysis.lint import (
+    CHECKS,
+    Finding,
+    default_baseline_path,
+    lint_paths,
+    lint_source,
+    load_baseline,
+    main,
+)
+
+
+def _lint(snippet: str, path: str = "probe.py"):
+    return lint_source(textwrap.dedent(snippet), path)
+
+
+def _checks(findings):
+    return [f.check for f in findings]
+
+
+# ------------------------------------------------------------------ goldens
+class TestUseAfterDonate:
+    def test_positive_read_after_donating_dispatch(self):
+        out = _lint(
+            """
+            import jax
+
+            def bug(runtime, p, x):
+                f = runtime.setup_step(lambda a, b: a + b, donate_argnums=(0,))
+                out = f(p, x)
+                return p.sum() + out
+            """
+        )
+        assert _checks(out) == ["use-after-donate"]
+        assert "'p'" in out[0].message
+
+    def test_positive_jax_jit_spelling(self):
+        out = _lint(
+            """
+            import jax
+
+            def bug(step, p, x):
+                f = jax.jit(step, donate_argnums=(0, 1))
+                y = f(p, x)
+                return x.mean()
+            """
+        )
+        assert _checks(out) == ["use-after-donate"]
+
+    def test_negative_reassigned_from_outputs(self):
+        out = _lint(
+            """
+            import jax
+
+            def ok(runtime, p, x):
+                f = runtime.setup_step(lambda a, b: (a + b, b), donate_argnums=(0,))
+                for _ in range(3):
+                    p, aux = f(p, x)
+                return p
+            """
+        )
+        assert out == []
+
+    def test_negative_copy_before_donate_idiom(self):
+        out = _lint(
+            """
+            import numpy as np
+
+            def ok(runtime, publish, p, x):
+                f = runtime.setup_step(lambda a, b: a + b, donate_argnums=(0,))
+                publish(np.copy(p))
+                p = f(p, x)
+                return p
+            """
+        )
+        assert out == []
+
+    def test_metadata_reads_are_exempt(self):
+        out = _lint(
+            """
+            def ok(runtime, p, x):
+                f = runtime.setup_step(lambda a, b: a + b, donate_argnums=(0,))
+                y = f(p, x)
+                return p.shape, p.dtype, y
+            """
+        )
+        assert out == []
+
+    def test_loop_carries_donation_across_iterations(self):
+        out = _lint(
+            """
+            def bug(runtime, p, x, log):
+                f = runtime.setup_step(lambda a, b: a + b, donate_argnums=(0,))
+                for _ in range(3):
+                    y = f(p, x)      # iteration 2 re-donates an already-dead p
+                return y
+            """
+        )
+        assert "use-after-donate" in _checks(out)
+
+    def test_early_return_branch_does_not_poison_fallthrough(self):
+        out = _lint(
+            """
+            def ok(runtime, p, x, fast):
+                f = runtime.setup_step(lambda a, b: a + b, donate_argnums=(0,))
+                if fast:
+                    y = f(p, x)
+                    return y
+                return p.sum()
+            """
+        )
+        assert out == []
+
+
+class TestZeroCopyAlias:
+    def test_positive_frombuffer(self):
+        out = _lint(
+            """
+            import jax
+            import numpy as np
+
+            def bug(buf):
+                arr = np.frombuffer(buf, dtype=np.float32)
+                return jax.device_put(arr)
+            """
+        )
+        assert _checks(out) == ["zero-copy-alias"]
+
+    def test_positive_npz_member(self):
+        out = _lint(
+            """
+            import jax.numpy as jnp
+            import numpy as np
+
+            def bug(path):
+                npz = np.load(path)
+                w = npz["w"]
+                return jnp.asarray(w)
+            """
+        )
+        assert _checks(out) == ["zero-copy-alias"]
+        assert "npz member" in out[0].message
+
+    def test_positive_shm_unpack_view(self):
+        out = _lint(
+            """
+            import jax
+
+            def bug(arena, slot, leaves):
+                views = arena.unpack(slot, leaves)
+                return jax.device_put(views)
+            """
+        )
+        assert _checks(out) == ["zero-copy-alias"]
+
+    def test_negative_copy_cleanses(self):
+        out = _lint(
+            """
+            import jax
+            import numpy as np
+
+            def ok(path, arena, slot, leaves):
+                npz = np.load(path)
+                w = np.copy(npz["w"])
+                views = arena.unpack(slot, leaves, copy=True)
+                return jax.device_put(w), jax.device_put(views)
+            """
+        )
+        assert out == []
+
+    def test_negative_plain_ndarray_view_not_flagged(self):
+        # a numpy view refcounts its base: lifetime is safe, deliberately clean
+        out = _lint(
+            """
+            import jax
+            import numpy as np
+
+            def ok(x):
+                v = x[2:]
+                return jax.device_put(v)
+            """
+        )
+        assert out == []
+
+
+class TestPrng:
+    def test_positive_reuse(self):
+        out = _lint(
+            """
+            import jax
+
+            def bug(key):
+                a = jax.random.normal(key, (3,))
+                b = jax.random.uniform(key, (3,))
+                return a + b
+            """
+        )
+        assert _checks(out) == ["prng-reuse"]
+
+    def test_positive_reuse_across_loop_iterations(self):
+        out = _lint(
+            """
+            import jax
+
+            def bug(key, n):
+                out = []
+                for _ in range(n):
+                    out.append(jax.random.normal(key, (3,)))
+                return out
+            """
+        )
+        assert _checks(out) == ["prng-reuse"]
+
+    def test_positive_discarded_split(self):
+        out = _lint(
+            """
+            import jax
+
+            def bug(key):
+                jax.random.split(key)
+                return key
+            """
+        )
+        assert _checks(out) == ["prng-discard"]
+
+    def test_negative_split_then_draw(self):
+        out = _lint(
+            """
+            import jax
+
+            def ok(key):
+                k1, k2 = jax.random.split(key)
+                a = jax.random.normal(k1, (3,))
+                b = jax.random.uniform(k2, (3,))
+                return a + b
+            """
+        )
+        assert out == []
+
+    def test_negative_fold_in_per_index(self):
+        out = _lint(
+            """
+            import jax
+
+            def ok(key, n):
+                out = []
+                for i in range(n):
+                    out.append(jax.random.normal(jax.random.fold_in(key, i), (3,)))
+                return out
+            """
+        )
+        assert out == []
+
+    def test_negative_loop_resplit(self):
+        out = _lint(
+            """
+            import jax
+
+            def ok(key, n):
+                out = []
+                for _ in range(n):
+                    key, sub = jax.random.split(key)
+                    out.append(jax.random.normal(sub, (3,)))
+                return out
+            """
+        )
+        assert out == []
+
+    def test_negative_mutually_exclusive_branches(self):
+        out = _lint(
+            """
+            import jax
+
+            def ok(key, continuous):
+                if continuous:
+                    return jax.random.normal(key, (3,))
+                return jax.random.uniform(key, (3,))
+            """
+        )
+        assert out == []
+
+
+class TestHostSync:
+    def test_positive_float_in_loop(self):
+        out = _lint(
+            """
+            import jax.numpy as jnp
+
+            def bug(n):
+                total = jnp.zeros(())
+                out = []
+                for i in range(n):
+                    total = jnp.add(total, i)
+                    out.append(float(total))
+                return out
+            """
+        )
+        assert _checks(out) == ["host-sync"]
+
+    def test_positive_item_and_device_get_in_trace_scope(self):
+        out = _lint(
+            """
+            import jax
+            import jax.numpy as jnp
+            from sheeprl_tpu.obs import trace_scope
+
+            def bug(metrics, n):
+                loss = jnp.zeros(())
+                with trace_scope("train_dispatch"):
+                    x = loss.item()
+                    y = jax.device_get(metrics)
+                return x, y
+            """
+        )
+        assert sorted(_checks(out)) == ["host-sync", "host-sync"]
+
+    def test_positive_implicit_truthiness(self):
+        out = _lint(
+            """
+            import jax.numpy as jnp
+
+            def bug(xs):
+                flag = jnp.any(xs)
+                for _ in range(3):
+                    if flag:
+                        break
+                return flag
+            """
+        )
+        assert _checks(out) == ["host-sync"]
+
+    def test_negative_sync_outside_loop(self):
+        out = _lint(
+            """
+            import jax.numpy as jnp
+
+            def ok(xs):
+                total = jnp.sum(xs)
+                return float(total)
+            """
+        )
+        assert out == []
+
+    def test_negative_numpy_work_in_loop(self):
+        out = _lint(
+            """
+            import numpy as np
+
+            def ok(n):
+                acc = 0.0
+                for i in range(n):
+                    acc += float(np.sin(i))
+                return acc
+            """
+        )
+        assert out == []
+
+
+class TestRetrace:
+    def test_positive_all_three(self):
+        out = _lint(
+            """
+            import jax
+
+            def build():
+                def step(x, y):
+                    if x > 0:
+                        y = y + 1
+                    label = f"step {x}"
+                    d = {}
+                    for k in {"a", "b"}:
+                        d[k] = y
+                    return d, label
+                return jax.jit(step)
+            """
+        )
+        assert sorted(_checks(out)) == ["retrace-branch", "retrace-fstring", "retrace-set-iter"]
+
+    def test_positive_setup_step_entry(self):
+        out = _lint(
+            """
+            def build(runtime):
+                def update(params, x):
+                    if params["w"].sum() > 0:
+                        x = x + 1
+                    return params, x
+                return runtime.setup_step(update, donate_argnums=(0,))
+            """
+        )
+        assert "retrace-branch" in _checks(out)
+
+    def test_negative_static_tests(self):
+        out = _lint(
+            """
+            import jax
+            import jax.numpy as jnp
+
+            def build():
+                def step(x, y):
+                    if x.shape[0] > 2:
+                        y = y + 1
+                    if y is None:
+                        y = 0
+                    if isinstance(x, tuple):
+                        return y
+                    for k in sorted({"a", "b"}):
+                        y = y + len(k)
+                    return jnp.where(x > 0, y + 1, y)
+                return jax.jit(step)
+            """
+        )
+        assert out == []
+
+    def test_negative_untraced_function_free_to_branch(self):
+        out = _lint(
+            """
+            def plain(x, y):
+                if x > 0:
+                    return f"value {x}"
+                return y
+            """
+        )
+        assert out == []
+
+
+# ----------------------------------------------------------- suppressions
+class TestSuppressions:
+    SNIPPET = """
+    import jax
+
+    def bug(key):
+        a = jax.random.normal(key, (3,))
+        b = jax.random.uniform(key, (3,)){}
+        return a + b
+    """
+
+    def test_inline_disable(self):
+        assert _lint(self.SNIPPET.format("  # jaxlint: disable=prng-reuse")) == []
+
+    def test_inline_disable_all(self):
+        assert _lint(self.SNIPPET.format("  # jaxlint: disable=all")) == []
+
+    def test_wrong_check_name_does_not_suppress(self):
+        assert _checks(_lint(self.SNIPPET.format("  # jaxlint: disable=host-sync"))) == ["prng-reuse"]
+
+    def test_disable_next_line(self):
+        out = _lint(
+            """
+            import jax
+
+            def bug(key):
+                a = jax.random.normal(key, (3,))
+                # jaxlint: disable-next=prng-reuse
+                b = jax.random.uniform(key, (3,))
+                return a + b
+            """
+        )
+        assert out == []
+
+    def test_comment_only_disable_covers_next_code_line(self):
+        out = _lint(
+            """
+            import jax
+
+            def bug(key):
+                a = jax.random.normal(key, (3,))
+                # jaxlint: disable=prng-reuse
+                b = jax.random.uniform(key, (3,))
+                return a + b
+            """
+        )
+        assert out == []
+
+    def test_file_level_disable(self):
+        out = _lint("# jaxlint: disable-file=prng-reuse\n" + textwrap.dedent(self.SNIPPET.format("")))
+        assert out == []
+
+    def test_directive_inside_string_is_inert(self):
+        out = _lint(
+            """
+            import jax
+
+            MSG = "# jaxlint: disable-file=prng-reuse"
+
+            def bug(key):
+                a = jax.random.normal(key, (3,))
+                b = jax.random.uniform(key, (3,))
+                return a + b
+            """
+        )
+        assert _checks(out) == ["prng-reuse"]
+
+
+# --------------------------------------------------------------- baseline
+BUGGY = """
+import jax
+
+def bug(key):
+    a = jax.random.normal(key, (3,))
+    b = jax.random.uniform(key, (3,))
+    return a + b
+"""
+
+
+class TestBaselineAndCli:
+    def test_findings_fail_then_baseline_then_clean(self, tmp_path, capsys):
+        f = tmp_path / "mod.py"
+        f.write_text(BUGGY)
+        baseline = tmp_path / "base.json"
+        assert main([str(f), "--baseline", str(baseline)]) == 1
+        assert main([str(f), "--baseline", str(baseline), "--write-baseline"]) == 0
+        doc = json.loads(baseline.read_text())
+        assert doc["version"] == 1 and len(doc["entries"]) == 1
+        assert doc["entries"][0]["check"] == "prng-reuse"
+        assert doc["entries"][0]["why"]  # a justification slot is mandatory
+        capsys.readouterr()
+        assert main([str(f), "--baseline", str(baseline)]) == 0
+
+    def test_baseline_survives_line_shift_but_not_code_change(self, tmp_path):
+        f = tmp_path / "mod.py"
+        f.write_text(BUGGY)
+        baseline = tmp_path / "base.json"
+        main([str(f), "--baseline", str(baseline), "--write-baseline"])
+        # unrelated edit above the finding: fingerprint (text-keyed) holds
+        f.write_text("import os\n" + BUGGY)
+        assert main([str(f), "--baseline", str(baseline)]) == 0
+        # the flagged line itself changes: stale entry + fresh finding
+        f.write_text(BUGGY.replace("uniform(key, (3,))", "uniform(key, (4,))"))
+        assert main([str(f), "--baseline", str(baseline)]) == 1
+
+    def test_stale_entries_reported(self, tmp_path, capsys):
+        f = tmp_path / "mod.py"
+        f.write_text(BUGGY)
+        baseline = tmp_path / "base.json"
+        main([str(f), "--baseline", str(baseline), "--write-baseline"])
+        f.write_text("x = 1\n")  # bug fixed: entry goes stale
+        assert main([str(f), "--baseline", str(baseline)]) == 0
+        assert "stale baseline" in capsys.readouterr().err
+
+    def test_parse_error_reported(self, tmp_path):
+        f = tmp_path / "broken.py"
+        f.write_text("def oops(:\n")
+        out = lint_paths([str(f)])
+        assert [x.check for x in out] == ["parse-error"]
+        assert main([str(f), "--no-baseline"]) == 1
+
+    def test_select_and_unknown_check(self, tmp_path):
+        f = tmp_path / "mod.py"
+        f.write_text(BUGGY)
+        assert main([str(f), "--no-baseline", "--select", "host-sync"]) == 0
+        assert main([str(f), "--no-baseline", "--select", "prng-reuse"]) == 1
+        assert main([str(f), "--select", "not-a-check"]) == 2
+
+    def test_missing_path_is_usage_error(self):
+        assert main(["/nonexistent/deeply/missing.py"]) == 2
+
+    def test_list_checks_covers_catalog(self, capsys):
+        assert main(["--list-checks"]) == 0
+        out = capsys.readouterr().out
+        for check in CHECKS:
+            assert check in out
+
+    def test_json_output(self, tmp_path, capsys):
+        f = tmp_path / "mod.py"
+        f.write_text(BUGGY)
+        assert main([str(f), "--no-baseline", "--json"]) == 1
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["findings"][0]["check"] == "prng-reuse"
+        assert doc["findings"][0]["fingerprint"]
+
+    def test_default_baseline_is_the_committed_empty_file(self):
+        # the committed tree lints clean WITHOUT accumulated baseline debt:
+        # every accepted hazard is an inline suppression at its site
+        entries = load_baseline(default_baseline_path())
+        assert entries == {}
+
+    def test_fingerprint_distinguishes_identical_lines(self):
+        src = "import jax\n\ndef f(key):\n    jax.random.split(key)\n    jax.random.split(key)\n"
+        out = lint_source(src, "p.py")
+        discards = [f for f in out if f.check == "prng-discard"]
+        assert len(discards) == 2
+        assert discards[0].fingerprint != discards[1].fingerprint

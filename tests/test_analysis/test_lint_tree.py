@@ -1,0 +1,46 @@
+"""Tier-1 lint gate (ISSUE 9 acceptance): the full jaxlint pass over
+``sheeprl_tpu/`` must report ZERO unsuppressed, unbaselined findings —
+i.e. ``python -m sheeprl_tpu.analysis sheeprl_tpu/`` exits 0.  Pure AST:
+the whole tree lints in well under a second."""
+
+import os
+
+import pytest
+
+from sheeprl_tpu.analysis.lint import default_baseline_path, lint_paths, load_baseline
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+PKG = os.path.join(REPO_ROOT, "sheeprl_tpu")
+
+
+@pytest.mark.lint
+def test_tree_has_zero_unsuppressed_findings():
+    findings = lint_paths([PKG], root=REPO_ROOT)
+    baseline = load_baseline(default_baseline_path())
+    fresh = [f for f in findings if f.fingerprint not in baseline]
+    assert not fresh, "jaxlint regressions (fix, suppress inline with a why, or baseline):\n" + "\n".join(
+        f.render() for f in fresh
+    )
+
+
+@pytest.mark.lint
+def test_cli_module_entrypoint_exits_zero():
+    import subprocess
+    import sys
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "sheeprl_tpu.analysis", "sheeprl_tpu"],
+        cwd=REPO_ROOT,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+@pytest.mark.lint
+def test_baseline_entries_all_carry_a_justification():
+    # a baseline entry without a real why is just a muted bug
+    for entry in load_baseline(default_baseline_path()).values():
+        why = entry.get("why", "")
+        assert why and not why.startswith("TODO"), f"unjustified baseline entry: {entry}"

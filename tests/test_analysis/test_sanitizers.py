@@ -1,0 +1,271 @@
+"""Runtime-sanitizer tests (ISSUE 9 pillar 2): the donation sanitizer
+turns a crafted use-after-donate into a deterministic failure, the
+host-alias guard refuses borrowed upload sources (the freed-npz /
+memmap / shm-slot class), transfer-guard policy rides trace scopes, the
+off path is the undecorated pre-sanitizer object, and the leak registry
+backs the suite-wide sweep."""
+
+import threading
+import zipfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.analysis.sanitizers import (
+    HostAliasError,
+    allowed_transfer_scopes,
+    check_host_sources,
+    guard_donation,
+    leak_registry,
+    sanitize_enabled,
+    session_leak_report,
+    shm_orphans,
+    sweep_leaks,
+    transfer_sanitizer,
+)
+from sheeprl_tpu.parallel.mesh import MeshRuntime
+
+
+@pytest.fixture
+def runtime():
+    return MeshRuntime(devices=1, accelerator="cpu").launch()
+
+
+@pytest.fixture
+def sanitize(monkeypatch):
+    monkeypatch.setenv("SHEEPRL_SANITIZE", "1")
+    assert sanitize_enabled()
+
+
+# ------------------------------------------------------------ donation
+class TestDonationSanitizer:
+    def test_crafted_use_after_donate_trips_deterministically(self, runtime, sanitize):
+        f = runtime.setup_step(lambda p, x: (p + x, (p * x).sum()), donate_argnums=(0,))
+        p = jnp.ones((4,))
+        x = jnp.full((4,), 2.0)
+        out, s = f(p, x)
+        np.testing.assert_allclose(np.asarray(out), 3.0)
+        # whether or not this backend/jax version honors the donation
+        # natively, under the sanitizer the touch MUST fail at the
+        # offending line, every run — never silently read recycled memory
+        with pytest.raises(RuntimeError, match="deleted"):
+            np.asarray(p)
+
+    def test_outputs_and_fresh_args_survive(self, runtime, sanitize):
+        f = runtime.setup_step(lambda p, x: (p + x, x * 2), donate_argnums=(0,))
+        p, x = jnp.ones((4,)), jnp.ones((4,))
+        out, y = f(p, x)
+        # non-donated arg and outputs stay fully usable
+        np.testing.assert_allclose(np.asarray(x), 1.0)
+        np.testing.assert_allclose(np.asarray(out), 2.0)
+        np.testing.assert_allclose(np.asarray(y), 2.0)
+
+    def test_passthrough_output_is_not_killed(self, runtime, sanitize):
+        # a donated arg returned unchanged may SHARE its buffer with the
+        # output; the sanitizer must never corrupt a correct program
+        f = runtime.setup_step(lambda p, x: (p, p + x), donate_argnums=(0,))
+        p = jnp.ones((4,))
+        out_p, out_s = f(p, jnp.ones((4,)))
+        np.testing.assert_allclose(np.asarray(out_p), 1.0)
+        np.testing.assert_allclose(np.asarray(out_s), 2.0)
+
+    def test_donated_host_numpy_is_nan_poisoned(self, runtime, sanitize):
+        f = runtime.setup_step(lambda p, x: p + x, donate_argnums=(0,))
+        p_host = np.ones((4,), np.float32)
+        out = f(p_host, jnp.ones((4,)))
+        np.testing.assert_allclose(np.asarray(out), 2.0)
+        # the host reference was poisoned: a reuse reads NaN loudly, not
+        # plausible stale numbers (CPU device_put may have aliased it)
+        assert np.isnan(p_host).all()
+
+    def test_chained_state_reassignment_stays_green(self, runtime, sanitize):
+        # the algo-loop idiom: state flows through the donating dispatch
+        f = runtime.setup_step(lambda p, o, x: (p + x, o + 1, (p - o).sum()), donate_argnums=(0, 1))
+        p, o = jnp.zeros((4,)), jnp.zeros((4,))
+        for i in range(4):
+            p, o, m = f(p, o, jnp.ones((4,)))
+        np.testing.assert_allclose(np.asarray(p), 4.0)
+        np.testing.assert_allclose(np.asarray(o), 4.0)
+
+    def test_off_path_is_the_undecorated_step(self, runtime):
+        # sanitize off: setup_step returns the exact pre-sanitizer object —
+        # no wrapper frame, donated args untouched => zero overhead, which
+        # is what keeps the bench perf gate silent with sanitizers in-tree
+        f = runtime.setup_step(lambda p, x: p + x, donate_argnums=(0,))
+        assert not hasattr(f, "_donation_sanitizer")
+        assert hasattr(f, "_jitted")
+        host = np.ones((4,), np.float32)
+        f(host, jnp.ones((4,)))
+        assert not np.isnan(host).any()  # off path never poisons host refs
+
+    def test_wrapper_preserves_jitted_handle(self, runtime, sanitize):
+        f = runtime.setup_step(lambda p, x: p + x, donate_argnums=(0,))
+        assert hasattr(f, "_donation_sanitizer")
+        assert f._jitted is not None  # the FLOPs probe reaches through
+
+    def test_guard_donation_noop_without_donations(self):
+        fn = lambda x: x
+        assert guard_donation(fn, ()) is fn
+
+
+# ---------------------------------------------------------- host aliasing
+class TestHostAliasGuard:
+    def test_freed_npz_zero_copy_alias_trips(self, tmp_path, runtime, sanitize):
+        # the PR-7 loader class: zero-copy view over the npz member's raw
+        # bytes — the owner (the zip read buffer) dies with the loader scope
+        path = tmp_path / "ckpt.npz"
+        np.savez(path, w=np.arange(8, dtype=np.float32))
+        with zipfile.ZipFile(path) as z:
+            raw = z.read("w.npy")
+        alias = np.frombuffer(raw, dtype=np.float32, offset=128)
+        with pytest.raises(HostAliasError, match="backed"):
+            runtime.shard_batch({"w": alias})
+
+    def test_npy_mmap_member_trips(self, tmp_path, runtime, sanitize):
+        path = tmp_path / "w.npy"
+        np.save(path, np.arange(8, dtype=np.float32))
+        w = np.load(path, mmap_mode="r")
+        with pytest.raises(HostAliasError, match="memmap"):
+            runtime.replicate({"agent": {"w": w}})
+
+    def test_shm_slot_view_trips(self, sanitize):
+        from sheeprl_tpu.parallel.shm_ring import ShmArena
+
+        arena = ShmArena.create(1, 4096)
+        try:
+            leaves = arena.pack(0, [("obs", np.ones((4, 4), np.float32))])
+            views = arena.unpack(0, leaves, copy=False)
+            with pytest.raises(HostAliasError):
+                check_host_sources(views, "rollout upload")
+            del views  # zero-copy views must die before the mapping closes
+            # the blessed fix materializes copies: passes
+            check_host_sources(arena.unpack(0, leaves, copy=True), "rollout upload")
+        finally:
+            arena.close()
+
+    def test_owned_arrays_and_views_pass(self, runtime, sanitize):
+        x = np.ones((8, 4), np.float32)
+        # owned arrays, refcounted ndarray views and device arrays all pass
+        check_host_sources({"a": x, "b": x[2:], "c": jnp.ones((3,))}, "upload")
+        runtime.shard_batch({"a": np.ones((8, 2), np.float32)})
+
+    def test_off_mode_is_inert(self, tmp_path):
+        path = tmp_path / "w.npy"
+        np.save(path, np.arange(8, dtype=np.float32))
+        check_host_sources({"w": np.load(path, mmap_mode="r")}, "upload")  # no raise
+
+
+# ---------------------------------------------------------- transfer guard
+class TestTransferGuard:
+    def test_disallow_scope_sets_policy(self, sanitize):
+        from sheeprl_tpu.obs import trace_scope
+
+        with trace_scope("host_to_device"):
+            assert jax.config.jax_transfer_guard_device_to_host == "disallow"
+            # explicit transfers stay allowed under "disallow"
+            jax.device_put(np.ones(4))
+        assert jax.config.jax_transfer_guard_device_to_host is None
+
+    def test_allowlisted_scope_reallows(self, sanitize):
+        from sheeprl_tpu.obs import trace_scope
+
+        with trace_scope("host_to_device"):
+            with trace_scope("block_until_ready"):
+                assert jax.config.jax_transfer_guard_device_to_host == "allow"
+                np.asarray(jnp.ones(3))  # the intended fetch keeps working
+            assert jax.config.jax_transfer_guard_device_to_host == "disallow"
+
+    def test_unlisted_scope_and_off_mode_are_inert(self, sanitize, monkeypatch):
+        from sheeprl_tpu.obs import trace_scope
+
+        with trace_scope("some_phase"):
+            assert jax.config.jax_transfer_guard_device_to_host is None
+        monkeypatch.setenv("SHEEPRL_SANITIZE", "0")
+        with trace_scope("host_to_device"):
+            assert jax.config.jax_transfer_guard_device_to_host is None
+
+    def test_env_extends_allowlist(self, sanitize, monkeypatch):
+        monkeypatch.setenv("SHEEPRL_SANITIZE_ALLOW", "my_scope,other")
+        assert "my_scope" in allowed_transfer_scopes()
+        with transfer_sanitizer("my_scope"):
+            assert jax.config.jax_transfer_guard_device_to_host == "allow"
+
+
+# ------------------------------------------------------------- leak sweep
+class TestLeakRegistry:
+    def test_shm_arena_rides_registry_and_orphan_sweep(self):
+        from sheeprl_tpu.parallel.shm_ring import ShmArena
+
+        arena = ShmArena.create(1, 4096)
+        name = arena.info["name"]
+        try:
+            assert any(n == name for _, n, _ in leak_registry.live("shm"))
+            assert name in shm_orphans()  # segment exists while open
+            assert name in sweep_leaks().get("shm_orphans", [])
+        finally:
+            arena.close()
+        assert all(n != name for _, n, _ in leak_registry.live("shm"))
+        assert name not in shm_orphans()
+
+    def test_channel_registration_lifecycle(self):
+        import queue as queue_mod
+
+        from sheeprl_tpu.parallel.transport import QueueChannel
+
+        ch = QueueChannel(queue_mod.Queue(), queue_mod.Queue())
+        assert any(k == "channel" for k, _, _ in leak_registry.live("channel"))
+        ch.close()
+        assert not any(
+            k == "channel" and n == "QueueChannel" for k, n, _ in leak_registry.live("channel")
+        )
+
+    def test_collected_objects_are_not_leaks(self):
+        class Obj:
+            pass
+
+        o = Obj()
+        leak_registry.register("channel", "ghost", o, where="test")
+        del o
+        import gc
+
+        gc.collect()
+        assert not any(n == "ghost" for _, n, _ in leak_registry.live())
+
+    def test_session_report_catches_nondaemon_thread(self):
+        release = threading.Event()
+        t = threading.Thread(target=release.wait, name="stuck-feeder", daemon=False)
+        t.start()
+        try:
+            report = session_leak_report(grace_s=0.0)
+            assert "stuck-feeder" in report.get("nondaemon_threads", [])
+        finally:
+            release.set()
+            t.join(timeout=5)
+        report = session_leak_report(grace_s=0.0)
+        assert "stuck-feeder" not in report.get("nondaemon_threads", [])
+
+    def test_session_report_catches_shm_orphan(self):
+        from multiprocessing import shared_memory
+
+        seg = shared_memory.SharedMemory(create=True, size=1024, name="sheeprl_leaktest_seg")
+        try:
+            report = session_leak_report(grace_s=0.0)
+            assert "sheeprl_leaktest_seg" in report.get("shm_orphans", [])
+        finally:
+            seg.close()
+            seg.unlink()
+
+    def test_worker_daemon_threads_are_warnings_not_failures(self):
+        release = threading.Event()
+        t = threading.Thread(target=release.wait, name="sheeprl-test-daemon", daemon=True)
+        t.start()
+        try:
+            report = session_leak_report(grace_s=0.0)
+            assert "sheeprl-test-daemon" in report.get("daemon_threads_warn", [])
+            hard = {k: v for k, v in report.items() if not k.endswith("_warn")}
+            assert "sheeprl-test-daemon" not in str(hard)
+        finally:
+            release.set()
+            t.join(timeout=5)

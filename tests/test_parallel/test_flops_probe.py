@@ -16,11 +16,20 @@ in benchmarks/results/scaling_r4_flops.json.
 import os
 import sys
 
+import pytest
+
 # benchmarks/ is deliberately not a package (scripts, excluded from
 # packaging); make its import work under any pytest invocation
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
 
 
+# slow-marked (ISSUE 9 tooling pass): the two full DV3 compiles cost ~60s,
+# the single largest tier-1 line item, guarding a compile-structure property
+# that only moves when the sharded train path itself is edited — run it via
+# `-m slow` (or directly) when touching the mesh/shard_map/conv-stack code.
+# Tier-1's 870s budget was overrun at PR 9 (888s measured) and per-PR test
+# growth had to come out of somewhere that is not a behavioral smoke.
+@pytest.mark.slow
 def test_dv3_per_device_flops_scale_with_mesh():
     from benchmarks.flops_probe import probe_dv
 

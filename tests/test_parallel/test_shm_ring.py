@@ -12,7 +12,11 @@ from sheeprl_tpu.parallel.shm_ring import ShmArena, ShmReceiver, ShmSender
 
 
 def _segment_exists(name: str) -> bool:
-    return os.path.exists(f"/dev/shm/{name}")
+    # shared sweep helper (ISSUE 9): same source of truth as the suite-wide
+    # session leak fixture in conftest.py, instead of an ad-hoc stat
+    from sheeprl_tpu.analysis.sanitizers import shm_orphans
+
+    return name in shm_orphans()
 
 
 def _payload(seed=0, rows=16):
