@@ -36,9 +36,22 @@ Health acceptance (ISSUE 7)::
 
     python scripts/chaos_soak.py --mode health --seed 7
 
+``--mode integrity`` is the ISSUE 10 acceptance harness: on each of the
+three transports, a decoupled run under injected ``bit_flip`` faults
+(data frames at both players + a lead-directed params broadcast) must
+DETECT every flip at the receive boundary (``integrity`` telemetry:
+corrupt_detected >= injected, silent_accepted == 0), recover via the
+retransmit / digest-skip machinery (retrans_failed == 0) and finish
+rc=0; plus an rb_insert leg (``rb_corrupt`` quarantined at ingest) and
+a paired off-vs-crc leg whose final agent params must be bit-exact.
+
 Serve acceptance (ISSUE 8)::
 
     python scripts/chaos_soak.py --mode serve --seed 7
+
+Integrity acceptance (ISSUE 10)::
+
+    python scripts/chaos_soak.py --mode integrity --seed 7
 
 all wrapped by ``chaos``/``slow``-marked pytest soaks.  The schedules
 are pure functions of ``--seed``, so a failing soak reproduces exactly.
@@ -452,15 +465,253 @@ def run_serve_mode(args) -> int:
     return 0
 
 
+# ------------------------------------------------------------- integrity
+def _ppo_integrity_args(args, root: str, integrity: str, transport: str, total_steps: int):
+    return [
+        "exp=ppo_decoupled",
+        "env=dummy",
+        "env.sync_env=True",
+        "env.capture_video=False",
+        "fabric.accelerator=cpu",
+        "fabric.devices=1",
+        "metric.log_level=1",
+        "metric.log_every=64",
+        f"metric.logger.root_dir={root}/logs",
+        "checkpoint.save_last=True",
+        "buffer.memmap=False",
+        f"seed={args.seed}",
+        "algo.per_rank_batch_size=4",
+        "algo.dense_units=8",
+        "algo.mlp_layers=1",
+        "algo.mlp_keys.encoder=[state]",
+        f"algo.total_steps={total_steps}",
+        "algo.num_players=2",
+        f"algo.decoupled_transport={transport}",
+        f"algo.transport_integrity={integrity}",
+        "algo.run_test=False",
+        f"root_dir={root}/run",
+        "env.num_envs=4",
+        "algo.rollout_steps=4",
+        "algo.update_epochs=1",
+    ]
+
+
+def read_integrity(root_dir: str):
+    """Last lead ``integrity`` record + the trainer-side counters that
+    ride ``transport.integrity`` / ``replay.integrity``, + the last
+    ``replay`` record (for the ingest-quarantine leg)."""
+    lead, trainer, replay = {}, {}, {}
+    for path in sorted(
+        glob.glob(os.path.join(root_dir, "**", "telemetry.jsonl"), recursive=True),
+        key=os.path.getmtime,
+    ):
+        for line in open(path):
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if "integrity" in rec:
+                lead = rec["integrity"]
+            tr = rec.get("transport") or {}
+            if "integrity" in tr:
+                trainer = tr["integrity"]
+            rp = rec.get("replay") or {}
+            if rp:
+                replay = rp
+                if "integrity" in rp:
+                    trainer = rp["integrity"]
+    return lead, trainer, replay
+
+
+def audit_integrity(lead, trainer, *, data_flips: int, params_flips: int, transport: str) -> list:
+    """Every injected flip must be DETECTED somewhere (data flips at the
+    trainer's receive boundary, the lead-directed params flip at the
+    lead's), every retransmission must have recovered, and nothing may
+    have been silently accepted: detections >= injections, with the
+    injection counters themselves riding the same telemetry."""
+    failures = []
+    if not lead or not trainer:
+        return [f"[{transport}] integrity telemetry missing (lead={bool(lead)}, trainer={bool(trainer)})"]
+    if trainer.get("frames_corrupt", 0) < data_flips:
+        failures.append(
+            f"[{transport}] trainer detected {trainer.get('frames_corrupt')} corrupt data "
+            f"frames for {data_flips} injected"
+        )
+    lead_detected = lead.get("frames_corrupt", 0) + lead.get("params_digest_mismatch", 0)
+    if lead_detected < params_flips:
+        failures.append(
+            f"[{transport}] lead detected {lead_detected} corrupt params broadcasts "
+            f"for {params_flips} injected"
+        )
+    for side, rec in (("lead", lead), ("trainer", trainer)):
+        if rec.get("retrans_failed", 0):
+            failures.append(f"[{transport}] {side} gave up on {rec['retrans_failed']} retransmissions")
+    detected = trainer.get("corrupt_detected", 0) + lead.get("corrupt_detected", 0)
+    injected = data_flips + params_flips
+    silent = injected - detected
+    if silent > 0:
+        failures.append(f"[{transport}] silent_accepted={silent} (injected {injected}, detected {detected})")
+    return failures
+
+
+def _load_agent_tree(root: str):
+    """Newest checkpoint's agent subtree as a flat list of arrays (file
+    md5s are useless here: the zip layer stamps wall-clock timestamps)."""
+    import numpy as np
+
+    from sheeprl_tpu.utils.ckpt_format import load_state
+
+    ckpts = sorted(
+        glob.glob(os.path.join(root, "**", "ckpt_*.ckpt"), recursive=True),
+        key=os.path.getmtime,
+    )
+    if not ckpts:
+        return None
+    state = load_state(ckpts[-1], select=("agent",))
+    import jax
+
+    return [np.asarray(x) for x in jax.tree_util.tree_leaves(state["agent"])]
+
+
+def run_integrity_mode(args) -> int:
+    """ISSUE 10 acceptance soak: on every transport backend, a decoupled
+    run under injected ``bit_flip`` faults must DETECT every flip at the
+    receive boundary, recover through the retransmit/digest-skip paths,
+    and finish rc=0 with the ``integrity`` telemetry proving it.  Plus:
+    an rb_insert leg (remote-replay SAC + ``rb_corrupt`` must be
+    quarantined at ingest, not silently absorbed) and a paired
+    off-vs-crc leg whose final agent params must be BIT-EXACT (crc mode
+    perturbs nothing; off mode constructs the pre-integrity objects)."""
+    import shutil
+
+    import numpy as np
+
+    from sheeprl_tpu.cli import run
+
+    from sheeprl_tpu.resilience.integrity import reset_integrity_stats
+
+    total_steps = 2560 if args.total_steps == 19200 else args.total_steps
+    failures = []
+    # one data flip at each player's Nth and Mth shard, one params flip
+    # on the trainer's odd-numbered params send — with 2 players the odd
+    # sends go to player 0, so the detection lands in the LEAD's
+    # telemetry (FanIn.broadcast iterates live pids in order).  The hit
+    # counts DIFFER per leg on purpose: the trainer process hosts every
+    # leg, and the fault injector is a process-wide singleton keyed on
+    # the spec string — an identical spec would stay consumed.
+    for idx, transport in enumerate(("queue", "shm", "tcp")):
+        faults = f"bit_flip@data:{4 + idx},bit_flip@data:{8 + idx},bit_flip@params:{5 + 2 * idx}"
+        root = os.path.join(args.root_dir, transport)
+        shutil.rmtree(root, ignore_errors=True)
+        print(f"integrity leg [{transport}]: SHEEPRL_FAULTS={faults}")
+        reset_integrity_stats()  # trainer-side counters are per-process
+        os.environ["SHEEPRL_FAULTS"] = faults
+        try:
+            run(_ppo_integrity_args(args, root, "digest", transport, total_steps))
+        except SystemExit as e:
+            if e.code not in (0, None):
+                failures.append(f"[{transport}] run exited rc={e.code}")
+        finally:
+            os.environ.pop("SHEEPRL_FAULTS", None)
+        lead, trainer, _ = read_integrity(os.path.join(root, "run"))
+        failures += audit_integrity(
+            lead, trainer, data_flips=4, params_flips=1, transport=transport
+        )
+        print(json.dumps({"transport": transport, "lead": lead, "trainer": trainer}))
+
+    # ---- rb_insert leg: rb_corrupt must be detected at ingest
+    root = os.path.join(args.root_dir, "rb")
+    shutil.rmtree(root, ignore_errors=True)
+    print("integrity leg [rb_insert]: SHEEPRL_FAULTS=rb_corrupt:12")
+    reset_integrity_stats()
+    os.environ["SHEEPRL_FAULTS"] = "rb_corrupt:12"
+    try:
+        run(
+            [
+                "exp=sac_decoupled",
+                "env=dummy",
+                "env.id=dummy_continuous",
+                "env.num_envs=2",
+                "env.sync_env=True",
+                "env.capture_video=False",
+                "fabric.accelerator=cpu",
+                "fabric.devices=1",
+                "metric.log_level=1",
+                "metric.log_every=64",
+                f"metric.logger.root_dir={root}/logs",
+                "checkpoint.save_last=True",
+                "buffer.memmap=False",
+                "buffer.remote_replay=True",
+                "buffer.prioritized=True",
+                "algo.num_players=2",
+                "algo.per_rank_batch_size=4",
+                "algo.dense_units=8",
+                "algo.mlp_layers=1",
+                "algo.mlp_keys.encoder=[state]",
+                "algo.total_steps=640",
+                "algo.learning_starts=8",
+                "algo.decoupled_transport=queue",
+                "algo.transport_integrity=crc",
+                "algo.run_test=False",
+                f"seed={args.seed}",
+                f"root_dir={root}/run",
+            ]
+        )
+    except SystemExit as e:
+        if e.code not in (0, None):
+            failures.append(f"[rb_insert] run exited rc={e.code}")
+    finally:
+        os.environ.pop("SHEEPRL_FAULTS", None)
+    _, _, replay = read_integrity(os.path.join(root, "run"))
+    if replay.get("inserts_quarantined", 0) < 1:
+        failures.append(
+            f"[rb_insert] rb_corrupt was not quarantined at ingest "
+            f"(inserts_quarantined={replay.get('inserts_quarantined')})"
+        )
+    print(json.dumps({"leg": "rb_insert", "inserts_quarantined": replay.get("inserts_quarantined")}))
+
+    # ---- paired off/crc leg: crc mode must be bit-exact with off mode
+    trees = {}
+    for integrity in ("off", "crc"):
+        root = os.path.join(args.root_dir, f"exact_{integrity}")
+        shutil.rmtree(root, ignore_errors=True)
+        try:
+            run(_ppo_integrity_args(args, root, integrity, "queue", 640))
+        except SystemExit as e:
+            if e.code not in (0, None):
+                failures.append(f"[bit-exact/{integrity}] run exited rc={e.code}")
+        trees[integrity] = _load_agent_tree(root)
+    if trees.get("off") is None or trees.get("crc") is None:
+        failures.append("[bit-exact] a paired run produced no checkpoint")
+    elif not all(np.array_equal(a, b) for a, b in zip(trees["off"], trees["crc"])):
+        failures.append("[bit-exact] transport_integrity=crc changed the trained agent params")
+    else:
+        print(json.dumps({"leg": "bit-exact", "leaves": len(trees["off"]), "equal": True}))
+
+    if not args.keep:
+        import shutil as _sh
+
+        _sh.rmtree(args.root_dir, ignore_errors=True)
+    if failures:
+        for f in failures:
+            print(f"FAIL: {f}", file=sys.stderr)
+        print("INTEGRITY CHAOS SOAK FAILED", file=sys.stderr)
+        return 1
+    print("integrity chaos soak passed")
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument(
         "--mode",
         default="topology",
-        choices=("topology", "health", "serve"),
+        choices=("topology", "health", "serve", "integrity"),
         help=(
             "topology: kill/rejoin soak (ISSUE 6); health: training sentinel proof "
-            "(ISSUE 7); serve: inference-service failure envelope (ISSUE 8)"
+            "(ISSUE 7); serve: inference-service failure envelope (ISSUE 8); "
+            "integrity: bit_flip detection/recovery on all three transports + "
+            "rb_insert quarantine + off-vs-crc bit-exactness (ISSUE 10)"
         ),
     )
     ap.add_argument(
@@ -492,6 +743,10 @@ def main(argv=None) -> int:
             args.root_dir = "/tmp/sheeprl_chaos_health"
         args.transport = args.transport or "queue"
         return run_health_mode(args)
+    if args.mode == "integrity":
+        if args.root_dir == "/tmp/sheeprl_chaos_soak":
+            args.root_dir = "/tmp/sheeprl_chaos_integrity"
+        return run_integrity_mode(args)
     if args.mode == "serve":
         if args.root_dir == "/tmp/sheeprl_chaos_soak":
             args.root_dir = "/tmp/sheeprl_chaos_serve"

@@ -112,6 +112,8 @@ def decoupled_knobs(cfg) -> Dict[str, Any]:
     with sac_decoupled)."""
     from sheeprl_tpu.resilience.supervisor import supervisor_knobs
 
+    from sheeprl_tpu.resilience.integrity import integrity_setting
+
     lag = int(cfg.algo.get("decoupled_params_lag", 1))
     vt = cfg.algo.get("vtrace", None) or {}
     vtrace_on = bool(vt.get("enabled", False))
@@ -141,6 +143,14 @@ def decoupled_knobs(cfg) -> Dict[str, Any]:
         "host": str(cfg.algo.get("tcp_host", "127.0.0.1")),
         "port": int(cfg.algo.get("tcp_port", 0)),
         "compress_min": 65536 if bool(cfg.algo.get("tcp_compress", False)) else 0,
+        # end-to-end data-integrity guard (resilience/integrity.py):
+        # off = undecorated pre-integrity transport, crc = checksummed
+        # frames on every backend, digest = crc + content-digest-verified
+        # params adoption
+        "integrity": integrity_setting(cfg),
+        # tcp length-prefix sanity cap (a corrupted prefix must not turn
+        # into a multi-GB allocation)
+        "max_frame_bytes": int(cfg.algo.get("tcp_max_frame_mb", 1024)) << 20,
     }
 
 
@@ -298,7 +308,9 @@ def _player_loop(
         train_step += 1
         if not lead or not frame.extra:
             return
-        train_metrics, opt_np, info_scalars, transport_stats = frame.extra
+        # slot 4 (when present) is the params content digest — consumed
+        # by the follower's verification, not by the accounting here
+        train_metrics, opt_np, info_scalars, transport_stats = frame.extra[:4]
         latest_train_metrics = train_metrics or {}
         if opt_np is not None:
             latest_opt_np = opt_np
@@ -321,6 +333,7 @@ def _player_loop(
         initial_seq=params_floor - 1,
         timeout=timeout_s,
         on_stale=_apply_params_extra,
+        digest_slot=4 if knobs["integrity"] == "digest" else None,
     )
 
     def _adopt(frame) -> Any:
@@ -603,6 +616,14 @@ def _player_loop(
                 extra = {"trainer_compiles": trainer_compiles}
                 if latest_transport_stats is not None:
                     extra["transport"] = latest_transport_stats
+                if knobs["integrity"] != "off":
+                    # this process's boundary counters (params digest
+                    # checks, frame verifications on the player side);
+                    # the trainer's ride extra["transport"]["integrity"]
+                    from sheeprl_tpu.resilience.integrity import integrity_stats
+
+                    extra["integrity"] = integrity_stats().as_dict()
+                    extra["integrity"]["params_digest_skips"] = follower.digest_skips
                 observability.on_log(
                     policy_step,
                     train_step,
@@ -693,6 +714,8 @@ def spawn_players(cfg, runtime, ctx, target, extra_args=(), knobs=None, with_inf
         host=knobs["host"],
         port=knobs["port"],
         poll_s=knobs["liveness_interval"],
+        integrity=knobs["integrity"],
+        max_frame_bytes=knobs["max_frame_bytes"],
     )
     infer_hub = infer_specs = None
     if with_inference:
@@ -708,6 +731,8 @@ def spawn_players(cfg, runtime, ctx, target, extra_args=(), knobs=None, with_inf
             host=knobs["host"],
             port=0,
             poll_s=knobs["liveness_interval"],
+            integrity=knobs["integrity"],
+            max_frame_bytes=knobs["max_frame_bytes"],
         )
     procs = []
     # the env copies the parent's environ at start, so the override only
@@ -932,8 +957,29 @@ def main(runtime, cfg: Dict[str, Any]):
                 backoff_base=ik["restart_backoff_s"],
             )
 
+        # params digest (algo.transport_integrity=digest): one content
+        # digest per broadcast, computed from the SOURCE arrays on the
+        # trainer and verified at every player's adoption — catches
+        # corruption anywhere on the path, including copies the frame
+        # checksum no longer covers
+        digest_mode = knobs["integrity"] == "digest"
+
+        def _params_digest(arrays):
+            if not digest_mode:
+                return None
+            from sheeprl_tpu.resilience.integrity import content_digest
+
+            return content_digest(arrays)
+
         # initial weights to every player (reference broadcast, :126)
-        fanin.broadcast("params", arrays=_flat_leaves(_np_tree(params)), seq=start_iter - 1)
+        init_arrays = _flat_leaves(_np_tree(params))
+        init_digest = _params_digest(init_arrays)
+        fanin.broadcast(
+            "params",
+            arrays=init_arrays,
+            seq=start_iter - 1,
+            extra_fn=(lambda pid: (None, None, None, None, init_digest)) if digest_mode else None,
+        )
 
         policy_steps_per_iter = int(cfg.env.num_envs * cfg.algo.rollout_steps)
         total_iters = cfg.algo.total_steps // policy_steps_per_iter if not cfg.dry_run else 1
@@ -955,8 +1001,14 @@ def main(runtime, cfg: Dict[str, Any]):
             if frame.tag == JOIN_TAG:
                 frame.release()
                 fanin.send_to(pid, "assign", extra=(last_completed_seq + 2, last_completed_seq))
+                join_arrays = _flat_leaves(_np_tree(params))
+                join_digest = _params_digest(join_arrays)
                 fanin.send_to(
-                    pid, "params", arrays=_flat_leaves(_np_tree(params)), seq=last_completed_seq
+                    pid,
+                    "params",
+                    arrays=join_arrays,
+                    seq=last_completed_seq,
+                    extra=(None, None, None, None, join_digest) if digest_mode else (),
                 )
             else:
                 frame.release()
@@ -1104,16 +1156,26 @@ def main(runtime, cfg: Dict[str, Any]):
                     stats["serve"]["supervisor"] = serve_sup.stats()
             if health.enabled:
                 stats["health"] = health.stats()
+            if knobs["integrity"] != "off":
+                # the trainer process's boundary counters (data-frame
+                # verifications, retransmit traffic): they reach the
+                # lead's telemetry under transport.integrity
+                from sheeprl_tpu.resilience.integrity import integrity_stats
+
+                stats["integrity"] = integrity_stats().as_dict()
+            bcast_arrays = _flat_leaves(_np_tree(params))
+            bcast_digest = _params_digest(bcast_arrays)
             fanin.broadcast(
                 "params",
-                arrays=_flat_leaves(_np_tree(params)),
+                arrays=bcast_arrays,
                 seq=iter_num,
                 extra_fn=lambda pid: (
                     train_metrics,
                     opt_np if pid == 0 else None,
                     info_scalars,
                     stats if pid == 0 else None,
-                ),
+                )
+                + ((bcast_digest,) if digest_mode else ()),
             )
             last_completed_seq = iter_num
             hard_exit_point("trainer_exit")  # fault site: trainer crash after replying

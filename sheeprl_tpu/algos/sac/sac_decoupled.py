@@ -192,7 +192,9 @@ def _player_loop(
         train_step += world_size
         if not lead or not frame.extra:
             return
-        train_metrics, transport_stats = frame.extra
+        # slot 2 (when present) is the params content digest — consumed
+        # by the follower's verification, not by the accounting here
+        train_metrics, transport_stats = frame.extra[:2]
         metrics = dict(train_metrics or {})
         if transport_stats is not None:
             latest_transport_stats = transport_stats
@@ -214,6 +216,7 @@ def _player_loop(
         initial_seq=-1,
         timeout=timeout_s,
         on_stale=_apply_params_extra,
+        digest_slot=2 if knobs["integrity"] == "digest" else None,
     )
 
     def _adopt(frame) -> None:
@@ -475,6 +478,11 @@ def _player_loop(
             extra = {"trainer_compiles": trainer_compiles}
             if latest_transport_stats is not None:
                 extra["transport"] = latest_transport_stats
+            if knobs["integrity"] != "off":
+                from sheeprl_tpu.resilience.integrity import integrity_stats
+
+                extra["integrity"] = integrity_stats().as_dict()
+                extra["integrity"]["params_digest_skips"] = follower.digest_skips
             observability.on_log(
                 policy_step,
                 train_step,
@@ -637,7 +645,9 @@ def _player_loop_remote(
             train_step += world_size  # seq 0 is the initial broadcast, not an update
         if not lead or not frame.extra:
             return
-        train_metrics, replay_stats = frame.extra
+        # slot 2 (when present) is the params content digest — consumed
+        # by _params_frame_ok, not by the accounting here
+        train_metrics, replay_stats = frame.extra[:2]
         metrics = dict(train_metrics or {})
         if replay_stats is not None:
             latest_replay_stats = replay_stats
@@ -649,6 +659,23 @@ def _player_loop_remote(
             for k, v in metrics.items():
                 aggregator.update(k, v)
 
+    digest_mode = knobs["integrity"] == "digest"
+
+    def _params_frame_ok(frame) -> bool:
+        """Digest-verified adoption (algo.transport_integrity=digest):
+        recompute the content digest over the received arrays; a
+        mismatch skips this broadcast (the next one re-syncs)."""
+        if not digest_mode or len(frame.extra) <= 2 or frame.extra[2] is None:
+            return True
+        from sheeprl_tpu.resilience.integrity import content_digest, integrity_stats
+
+        st = integrity_stats()
+        st.params_digest_checked += 1
+        if content_digest(list(frame.arrays.items())) == int(frame.extra[2]):
+            return True
+        st.params_digest_mismatch += 1
+        return False
+
     def _handle_frames(wait_tag: Optional[str] = None):
         """Drain the writer's queued frames: adopt the NEWEST params
         broadcast, account every update's extras, hand back the first
@@ -659,14 +686,14 @@ def _player_loop_remote(
         while writer.frames:
             frame = writer.frames.popleft()
             if frame.tag == "params":
-                if frame.seq > current_params_seq:
+                if frame.seq > current_params_seq and _params_frame_ok(frame):
                     _account_params_extra(frame)
                     if newest is not None:
                         newest.release()
                     newest = frame
                     current_params_seq = frame.seq
                 else:
-                    frame.release()  # reconnect replay duplicate
+                    frame.release()  # reconnect replay duplicate / corrupt
             elif wait_tag is not None and frame.tag == wait_tag and wanted is None:
                 wanted = frame
             else:
@@ -866,6 +893,10 @@ def _player_loop_remote(
             replay_rec = dict(latest_replay_stats or {})
             replay_rec["writer"] = writer.stats()
             extra = {"trainer_compiles": trainer_compiles, "replay": replay_rec}
+            if knobs["integrity"] != "off":
+                from sheeprl_tpu.resilience.integrity import integrity_stats
+
+                extra["integrity"] = integrity_stats().as_dict()
             observability.on_log(
                 policy_step, train_step, train_time_s=train_time_window, extra=extra
             )
@@ -1092,8 +1123,27 @@ def main(runtime, cfg: Dict[str, Any]):
                 extra=({"agent": _np_tree(params), "opt_states": _np_tree(opt_states)},),
             )
 
+        # params digest (algo.transport_integrity=digest) — see
+        # ppo_decoupled: computed once per broadcast from the source
+        # arrays, verified at every player's adoption
+        digest_mode = knobs["integrity"] == "digest"
+
+        def _params_digest(arrays):
+            if not digest_mode:
+                return None
+            from sheeprl_tpu.resilience.integrity import content_digest
+
+            return content_digest(arrays)
+
         # initial actor weights to every player (seq 0; round seqs start at 1)
-        fanin.broadcast("params", arrays=_flat_leaves(_np_tree(params["actor"])), seq=0)
+        init_arrays = _flat_leaves(_np_tree(params["actor"]))
+        init_digest = _params_digest(init_arrays)
+        fanin.broadcast(
+            "params",
+            arrays=init_arrays,
+            seq=0,
+            extra_fn=(lambda pid: (None, None, init_digest)) if digest_mode else None,
+        )
 
         while True:
             if serve_sup is not None:
@@ -1170,11 +1220,18 @@ def main(runtime, cfg: Dict[str, Any]):
                     stats["serve"]["supervisor"] = serve_sup.stats()
             if health.enabled:
                 stats["health"] = health.stats()
+            if knobs["integrity"] != "off":
+                from sheeprl_tpu.resilience.integrity import integrity_stats
+
+                stats["integrity"] = integrity_stats().as_dict()
+            bcast_arrays = _flat_leaves(_np_tree(params["actor"]))
+            bcast_digest = _params_digest(bcast_arrays)
             fanin.broadcast(
                 "params",
-                arrays=_flat_leaves(_np_tree(params["actor"])),
+                arrays=bcast_arrays,
                 seq=seq,
-                extra_fn=lambda pid: (train_metrics, stats if pid == 0 else None),
+                extra_fn=lambda pid: (train_metrics, stats if pid == 0 else None)
+                + ((bcast_digest,) if digest_mode else ()),
             )
             hard_exit_point("trainer_exit")  # fault site: trainer crash after replying
 
@@ -1314,6 +1371,7 @@ def _main_remote(runtime, cfg: Dict[str, Any], knobs, state, counters, ratio_sta
             per_eps=float(cfg.buffer.get("per_eps", 1e-6)),
             device=runtime.device,
             credit_window=knobs["window"],
+            integrity=knobs["integrity"],
         )
         if state is not None and state.get("replay_server") is not None:
             server.load_state_dict(state["replay_server"], rb_state=state.get("rb"))
@@ -1367,16 +1425,30 @@ def _main_remote(runtime, cfg: Dict[str, Any], knobs, state, counters, ratio_sta
         dispatch_g = max(1, int(cfg.algo.get("dispatch_batch", 1)))
         last_metrics: Dict[str, Any] = {}
 
-        def _broadcast_params(seq: int, extras) -> None:
+        digest_mode = knobs["integrity"] == "digest"
+
+        def _actor_arrays_digest():
             arrays = _flat_leaves(_np_tree(params["actor"]))
+            if not digest_mode:
+                return arrays, None
+            from sheeprl_tpu.resilience.integrity import content_digest
+
+            return arrays, content_digest(arrays)
+
+        def _broadcast_params(seq: int, extras) -> None:
+            arrays, digest = _actor_arrays_digest()
             # server.channels, not the spawn-time dict: a supervised
             # restart on the queue backend swaps in a fresh channel
             for pid in server.broadcast_targets:
                 try:
+                    extra = extras(pid)
+                    if digest_mode:
+                        # digest rides slot 2 of every params frame's extra
+                        extra = (tuple(extra) + (None, None))[:2] + (digest,)
                     server.channels[pid].send(
                         "params",
                         arrays=arrays,
-                        extra=extras(pid),
+                        extra=extra,
                         seq=seq,
                         timeout=_QUEUE_TIMEOUT_S,
                     )
@@ -1395,9 +1467,11 @@ def _main_remote(runtime, cfg: Dict[str, Any], knobs, state, counters, ratio_sta
                     server.channels[pid].send(
                         "assign", extra=(server.total_inserts,), timeout=_QUEUE_TIMEOUT_S
                     )
+                    arrays, digest = _actor_arrays_digest()
                     server.channels[pid].send(
                         "params",
-                        arrays=_flat_leaves(_np_tree(params["actor"])),
+                        arrays=arrays,
+                        extra=(None, None, digest) if digest_mode else (),
                         seq=update_round,
                         timeout=_QUEUE_TIMEOUT_S,
                     )
@@ -1497,6 +1571,10 @@ def _main_remote(runtime, cfg: Dict[str, Any], knobs, state, counters, ratio_sta
                 stats["health"] = health.stats()
             if supervisor is not None:
                 stats["supervisor"] = supervisor.stats()
+            if knobs["integrity"] != "off":
+                from sheeprl_tpu.resilience.integrity import integrity_stats
+
+                stats["integrity"] = integrity_stats().as_dict()
             _broadcast_params(
                 update_round,
                 lambda pid: (last_metrics, stats if pid == 0 else None),

@@ -171,6 +171,13 @@ class ShmArena:
             off += nbytes
         return leaves
 
+    def region(self, slot: int, nbytes: int) -> memoryview:
+        """Raw byte view of ``slot``'s first ``nbytes`` — the integrity
+        layer's receive-side fast path (one contiguous checksum instead
+        of a per-leaf walk; resilience/integrity.py:region_digest)."""
+        base = slot * self.slot_bytes
+        return memoryview(self._shm.buf)[base : base + int(nbytes)]
+
     def unpack(self, slot: int, leaves: Sequence[Tuple], copy: bool = False) -> Dict[str, np.ndarray]:
         """Rebuild the payload from ``slot``.  ``copy=False`` returns
         zero-copy views INTO the slot — valid only until the slot is
@@ -265,6 +272,10 @@ class ShmReceiver:
                 self._arena.close()
             self._arena = ShmArena.attach(info)
         return self._arena.unpack(slot, leaves, copy=copy)
+
+    def region(self, slot: int, nbytes: int) -> Optional[memoryview]:
+        """Contiguous byte view of an attached slot (integrity layer)."""
+        return self._arena.region(slot, nbytes) if self._arena is not None else None
 
     def release(self, slot: int) -> None:
         self._free_q.put(slot)

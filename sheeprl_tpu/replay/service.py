@@ -38,6 +38,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from sheeprl_tpu.resilience.integrity import FrameCorruptError
 from sheeprl_tpu.resilience.peer import PeerDiedError
 
 # wire tags of the replay service (the transport treats tags opaquely;
@@ -169,6 +170,8 @@ class ReplayServer:
         memmap: bool = False,
         memmap_dir: Optional[str] = None,
         credit_window: int = 2,
+        integrity: str = "off",
+        ingest_max_abs: float = 1e6,
     ):
         from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer
         from sheeprl_tpu.data.device_buffer import DeviceReplayCache
@@ -221,6 +224,17 @@ class ReplayServer:
         self._rows_since_mark = np.zeros(total_envs, dtype=np.int64)
         self.quarantines = 0
         self.quarantined_rows = 0
+        # ingest validation (algo.transport_integrity != off): schema +
+        # bounds + finiteness checks on every rb_insert BEFORE it can
+        # reach the learner (resilience/integrity.py) — the boundary
+        # where the rb_corrupt fault class is *detected* instead of
+        # silently absorbed
+        self._ingest_guard = None
+        if str(integrity) != "off":
+            from sheeprl_tpu.resilience.integrity import IngestGuard
+
+            self._ingest_guard = IngestGuard(max_abs=ingest_max_abs)
+        self.inserts_quarantined = 0
 
     # ------------------------------------------------------------ liveness
     @property
@@ -299,6 +313,14 @@ class ReplayServer:
                 except PeerDiedError as e:
                     self._mark_dead(pid, str(e))
                     continue
+                except FrameCorruptError as e:
+                    # unrecoverable frame corruption (integrity layer
+                    # give-up): the frame is lost, the channel and the
+                    # service keep running — FanIn.gather parity
+                    self.events.append(
+                        {"event": "frame_corrupt_dropped", "player": pid, "detail": str(e)}
+                    )
+                    continue
                 any_frame = True
                 self.last_seen[pid] = time.monotonic()
                 self._awaiting_first_frame.discard(pid)
@@ -336,11 +358,51 @@ class ReplayServer:
                 )
                 for k, v in arrays.items()
             }
+        # ingest validation AFTER the fault site, so rb_corrupt (and real
+        # SDC that slipped past the wire checksum) is DETECTED here:
+        # schema violations cannot be stored at all; value violations
+        # (non-finite / absurd magnitude) are quarantined — on the
+        # prioritized path they are written but immediately floored to
+        # the epsilon priority (the sampler effectively never draws
+        # them; the ring overwrites them in time), on the uniform path
+        # (no per-row mask) they are dropped outright
+        reason = None
+        if self._ingest_guard is not None:
+            from sheeprl_tpu.resilience.integrity import integrity_stats
+
+            st = integrity_stats()
+            st.inserts_checked += 1
+            reason = self._ingest_guard.check(arrays)
+            if reason is not None:
+                st.inserts_quarantined += 1
+                self.inserts_quarantined += 1
+                self._outstanding[pid] = max(0, self._outstanding[pid] - 1)
+                self.events.append(
+                    {"event": "insert_quarantined", "player": pid, "reason": reason}
+                )
+                if self.cache is None or "schema" in reason or "dtype" in reason or "shape" in reason or "key set" in reason:
+                    return 0  # unstorable / uniform path: drop the frame
         indices = list(range(offset, offset + count))
         self.rb.add(arrays, indices=indices)
         if self.cache is not None:
             self.cache.add(arrays, indices=indices)
         n = t_len * count
+        if reason is not None and self.cache is not None:
+            # epsilon-priority-floor quarantine (same mechanism as
+            # quarantine_recent): the rows were written to keep the ring
+            # clocks consistent, but their priorities drop to the floor
+            import jax.numpy as jnp
+
+            cap = self.cache.capacity
+            n_envs = self.total_envs
+            idx_list = []
+            for env in range(offset, offset + count):
+                pos = int(self.cache._pos[env])
+                recent = (pos - 1 - np.arange(min(t_len, cap))) % cap
+                idx_list.append(recent * n_envs + env)
+            idx = np.concatenate(idx_list)
+            self.cache.update_priorities(jnp.asarray(idx), jnp.zeros(len(idx), jnp.float32))
+            self.quarantined_rows += t_len * count
         self.total_inserts += n
         self.inserts_by_player[pid] += n
         self._rows_since_mark[offset : offset + count] += t_len
@@ -519,6 +581,7 @@ class ReplayServer:
             "credit_grant_stalls": self.credit_stall_players,
             "quarantines": self.quarantines,
             "quarantined_rows": self.quarantined_rows,
+            "inserts_quarantined": self.inserts_quarantined,
         }
         if self.limiter is not None:
             rec["limiter"] = self.limiter.stats()

@@ -51,7 +51,9 @@ def find_latest_resumable(scan_root: str) -> Optional[str]:
             warnings.warn(f"auto-resume: skipping quarantined checkpoint {ckpt}")
             continue
         try:
-            validate_checkpoint(ckpt, check_finite=True)
+            # check_digests: bit rot behind a self-consistent zip (the
+            # manifest's per-leaf content digests) fails here too
+            validate_checkpoint(ckpt, check_finite=True, check_digests=True)
             return ckpt
         except CheckpointCorruptError as e:
             warnings.warn(f"auto-resume: skipping corrupt checkpoint ({e})")

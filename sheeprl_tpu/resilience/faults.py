@@ -62,6 +62,22 @@ Instrumented sites:
                             before answering a batch (models a slow/hung
                             batch; exercises client deadlines, hedged
                             resend and the retry dedupe)
+``bit_flip``                one bit of an OUTGOING transport frame payload is
+                            flipped after its checksum was computed (a copy —
+                            the sender's own buffers stay intact): models NIC/
+                            DMA/shm silent data corruption that the integrity
+                            layer (``algo.transport_integrity``) must detect
+                            at the receiver.  Optionally TAG-SCOPED with the
+                            ``@`` qualifier: ``bit_flip@data:3`` corrupts the
+                            3rd ``data`` frame, ``bit_flip@params:2`` the 2nd
+                            params broadcast, ``bit_flip@rb_insert:5`` a
+                            replay insert (resilience/integrity.py)
+``bit_flip_ckpt``           the just-written checkpoint zip is REWRITTEN with
+                            one bit of a leaf's payload flipped and the zip
+                            member CRC recomputed to match — a self-consistent
+                            archive whose CONTENT rotted, which only the
+                            manifest's per-leaf digests can catch
+                            (utils/ckpt_format.py)
 ==========================  ====================================================
 
 ``fault_point(name)`` returns True exactly when the armed site fires (a
@@ -96,6 +112,8 @@ KNOWN_SITES = (
     "rb_corrupt",
     "server_exit",
     "infer_delay",
+    "bit_flip",
+    "bit_flip_ckpt",
 )
 
 
@@ -107,7 +125,13 @@ class FaultInjector:
     iteration and player 2 at its 7th): each entry keeps its own hit
     counter and fires once.  For indexed sites (``player_exit``), only
     entries whose ``arg`` matches the calling instance count hits, so
-    sibling players sharing the env var are unaffected."""
+    sibling players sharing the env var are unaffected.
+
+    Sites that need sub-addressing beyond a numeric arg use the ``@``
+    QUALIFIER: ``bit_flip@params:2`` arms the ``bit_flip`` site scoped
+    to frames whose tag is ``params`` — entries without a qualifier
+    match every call, entries with one count hits only when the call
+    site's ``qualifier`` equals it."""
 
     def __init__(self, spec: str = ""):
         self._lock = threading.Lock()
@@ -118,7 +142,7 @@ class FaultInjector:
             if not entry:
                 continue
             parts = entry.split(":")
-            name = parts[0]
+            name, _, qualifier = parts[0].partition("@")
             if name not in KNOWN_SITES:
                 raise ValueError(
                     f"unknown fault site {name!r}; known: {', '.join(KNOWN_SITES)}"
@@ -126,14 +150,22 @@ class FaultInjector:
             after = int(parts[1]) if len(parts) > 1 and parts[1] else 1
             arg = float(parts[2]) if len(parts) > 2 and parts[2] else 0.0
             self._sites.setdefault(name, []).append(
-                {"after": max(1, after), "hits": 0, "arg": arg, "fired": 0}
+                {
+                    "after": max(1, after),
+                    "hits": 0,
+                    "arg": arg,
+                    "fired": 0,
+                    "qualifier": qualifier or None,
+                }
             )
 
-    def fire(self, name: str, index: Optional[int] = None) -> bool:
+    def fire(self, name: str, index: Optional[int] = None, qualifier: Optional[str] = None) -> bool:
         """Count a hit of ``name``; True exactly when one entry's
         threshold is reached (each entry is a one-shot).  ``index``
         restricts the hit to entries targeting that instance (the
-        decoupled player id) — entries for other indices are untouched."""
+        decoupled player id); ``qualifier`` is the call site's
+        sub-address (e.g. the frame tag) — entries armed with an ``@``
+        qualifier only count hits that match it."""
         if not self._sites:
             return False
         with self._lock:
@@ -142,6 +174,8 @@ class FaultInjector:
                 return False
             for e in entries:
                 if index is not None and int(e["arg"]) != int(index):
+                    continue
+                if e["qualifier"] is not None and e["qualifier"] != qualifier:
                     continue
                 if e["fired"]:
                     continue

@@ -423,7 +423,11 @@ class InferenceServer:
             if status == "pending":
                 continue  # not refused: it may promote to good later
             try:
-                validate_checkpoint(path)
+                # check_digests: the hot-swap path re-verifies the
+                # manifest's per-leaf content digests before the params
+                # can ever be served (bit-rotted-but-self-consistent
+                # archives are refused, not just truncated ones)
+                validate_checkpoint(path, check_digests=True)
                 spot_check_finite(path)
             except (CheckpointCorruptError, OSError) as e:
                 self.swaps_refused_invalid += 1

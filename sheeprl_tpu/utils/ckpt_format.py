@@ -161,12 +161,28 @@ def _sweep_orphan_tmps(folder: Path, keep: Path) -> None:
 
 def save_state(path: Union[str, os.PathLike], state: Any) -> str:
     """Write ``state`` (host-side pytree) to ``path`` atomically (tmp file +
-    rename); orphaned tmps from previously killed writers are swept first."""
+    rename); orphaned tmps from previously killed writers are swept first.
+
+    The manifest records a per-leaf CONTENT digest (``leaf_crc``,
+    resilience/integrity.py): the zip's member CRCs catch truncation and
+    raw in-archive bit rot, but a rewritten/re-zipped archive is
+    self-consistent at the zip layer — only a content digest pins the
+    leaves to what the writer actually held in memory, so
+    ``validate_checkpoint(check_digests=True)`` rejects bit-rotted
+    checkpoints, not just truncated ones."""
     from sheeprl_tpu.resilience.faults import fault_point
+    from sheeprl_tpu.resilience.integrity import CHECKSUM_IMPL, leaf_digest
 
     leaves: list = []
     tree = _encode(state, leaves)
-    manifest = json.dumps({"version": FORMAT_VERSION, "tree": tree}).encode()
+    manifest = json.dumps(
+        {
+            "version": FORMAT_VERSION,
+            "tree": tree,
+            "leaf_crc": [leaf_digest(arr) for arr in leaves],
+            "crc_impl": CHECKSUM_IMPL,
+        }
+    ).encode()
     path = Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
     tmp = path.with_suffix(path.suffix + ".tmp")
@@ -190,7 +206,31 @@ def save_state(path: Union[str, os.PathLike], state: Any) -> str:
         size = os.path.getsize(path)
         with open(path, "r+b") as f:
             f.truncate(max(1, size // 2))
+    # bit-rot harness: rewrite the archive with one leaf bit flipped and
+    # the zip member CRC recomputed to match — a SELF-CONSISTENT zip
+    # whose content rotted, detectable only by the manifest leaf digests
+    if fault_point("bit_flip_ckpt"):
+        _bitflip_zip_leaf(path)
     return str(path)
+
+
+def _bitflip_zip_leaf(path: Union[str, os.PathLike], member: str = "leaf_0.npy") -> None:
+    """``bit_flip_ckpt`` fault body (also used directly by tests): flip
+    one bit in ``member``'s array payload and rewrite the zip so every
+    member CRC is VALID again — ``zipfile.testzip`` passes, only the
+    manifest's content digests can tell."""
+    path = str(path)
+    with zipfile.ZipFile(path) as z:
+        names = z.namelist()
+        if member not in names:
+            return
+        contents = {n: z.read(n) for n in names}
+    data = bytearray(contents[member])
+    data[-1] ^= 0x01  # last byte: array data, never the .npy header
+    contents[member] = bytes(data)
+    with zipfile.ZipFile(path, "w", compression=zipfile.ZIP_STORED) as z:
+        for n in names:
+            z.writestr(n, contents[n])
 
 
 def is_v1(path: Union[str, os.PathLike]) -> bool:
@@ -296,7 +336,7 @@ def spot_check_finite(path: Union[str, os.PathLike], max_leaves: int = 8) -> Non
 
 
 def validate_checkpoint(
-    path: Union[str, os.PathLike], check_finite: bool = False
+    path: Union[str, os.PathLike], check_finite: bool = False, check_digests: bool = False
 ) -> Dict[str, Any]:
     """Validate a v1 checkpoint WITHOUT materializing it: zip central
     directory + per-member CRCs, manifest parses, and every leaf the
@@ -305,7 +345,12 @@ def validate_checkpoint(
     dict on success. This is the gate auto-resume runs before trusting a
     checkpoint found on disk.  ``check_finite=True`` additionally runs
     :func:`spot_check_finite` over the ``agent`` subtree so poisoned (but
-    structurally intact) checkpoints fail too."""
+    structurally intact) checkpoints fail too.  ``check_digests=True``
+    re-verifies every leaf against the manifest's per-leaf content
+    digests (``leaf_crc``): bit rot that left a SELF-CONSISTENT zip
+    behind (content + member CRC rewritten together) fails here and
+    nowhere else.  Checkpoints older than the digest layer (no
+    ``leaf_crc`` key) skip the digest pass silently."""
     path = Path(path)
     try:
         if path.stat().st_size == 0:
@@ -338,6 +383,40 @@ def validate_checkpoint(
     top_keys = (
         sorted(doc["tree"]["items"].keys()) if doc["tree"].get("__t__") == "dict" else []
     )
+    if check_digests:
+        _check_leaf_digests(path, doc, n_leaves)
     if check_finite:
         spot_check_finite(path)
     return {"version": doc["version"], "n_leaves": n_leaves, "keys": top_keys}
+
+
+def _check_leaf_digests(path: Union[str, os.PathLike], doc: Dict[str, Any], n_leaves: int) -> None:
+    """Verify every leaf's content against the manifest's ``leaf_crc``."""
+    from sheeprl_tpu.resilience.integrity import CHECKSUM_IMPL, leaf_digest
+
+    digests = doc.get("leaf_crc")
+    if digests is None:
+        return  # pre-digest checkpoint: nothing recorded to verify against
+    if doc.get("crc_impl", CHECKSUM_IMPL) != CHECKSUM_IMPL:
+        return  # written under a different checksum implementation
+    if len(digests) != n_leaves:
+        raise CheckpointCorruptError(
+            path, f"manifest records {len(digests)} leaf digests for {n_leaves} leaves"
+        )
+    try:
+        with np.load(path, allow_pickle=False) as npz:
+            for i, want in enumerate(digests):
+                got = leaf_digest(npz[f"leaf_{i}"])
+                if int(got) != int(want):
+                    from sheeprl_tpu.resilience.integrity import integrity_stats
+
+                    integrity_stats().ckpt_digest_failures += 1
+                    raise CheckpointCorruptError(
+                        path,
+                        f"leaf_{i} content digest mismatch ({got} != {want}): "
+                        "bit rot behind a self-consistent zip",
+                    )
+    except CheckpointCorruptError:
+        raise
+    except (zipfile.BadZipFile, EOFError, OSError, KeyError, ValueError, json.JSONDecodeError) as e:
+        raise CheckpointCorruptError(path, f"{type(e).__name__}: {e}") from e

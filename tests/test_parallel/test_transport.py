@@ -2,7 +2,12 @@
 exercised over all three ``algo.decoupled_transport`` backends —
 roundtrip, backpressure, oversize fallback, peer death mid-stream — plus
 the fan-in determinism / staleness-bound / reconnect guarantees and the
-N-player end-to-end runs."""
+N-player end-to-end runs.
+
+The ISSUE 10 corrupt-frame legs of the conformance contract (flipped
+bit detected + recovered in order, off-mode constructs the undecorated
+classes, zero silent deliveries) run identically over the same three
+backends in the companion ``test_integrity.py``."""
 
 import glob
 import json

@@ -421,6 +421,72 @@ def test_replay_server_quarantine_bookkeeping():
     assert server.stats()["quarantines"] == 1
 
 
+class _InsertFrame:
+    """Minimal stand-in for a transport rb_insert frame."""
+
+    def __init__(self, arrays):
+        self._arrays = arrays
+
+    def arrays_copy(self):
+        return {k: np.array(v) for k, v in self._arrays.items()}
+
+    def release(self):
+        pass
+
+
+def _insert_step(scale=1.0):
+    return {
+        "observations": np.full((1, 2, 3), scale, np.float32),
+        "rewards": np.full((1, 2, 1), scale, np.float32),
+        "terminated": np.zeros((1, 2, 1), np.uint8),
+        "truncated": np.zeros((1, 2, 1), np.uint8),
+    }
+
+
+def test_rb_corrupt_detected_at_ingest(monkeypatch):
+    """ISSUE 10 satellite: the rb_corrupt fault used to flow straight
+    into the learner silently; with the ingest guard armed
+    (algo.transport_integrity != off) the scribbled insert is DETECTED —
+    quarantined + counted — and clean inserts still land."""
+    from sheeprl_tpu.replay.service import ReplayServer
+    from sheeprl_tpu.resilience.integrity import integrity_stats, reset_integrity_stats
+
+    reset_integrity_stats()
+    server = ReplayServer(32, [(0, 2)], {0: None}, obs_keys=("observations",), integrity="crc")
+    n = server._ingest(0, _InsertFrame(_insert_step()))
+    assert n == 2 and server.total_inserts == 2  # clean insert locks the schema
+    monkeypatch.setenv("SHEEPRL_FAULTS", "rb_corrupt")
+    n = server._ingest(0, _InsertFrame(_insert_step()))
+    monkeypatch.delenv("SHEEPRL_FAULTS")
+    assert n == 0, "scribbled insert must not reach the buffer (uniform path)"
+    assert server.inserts_quarantined == 1
+    assert server.events[-1]["event"] == "insert_quarantined"
+    assert integrity_stats().inserts_quarantined >= 1
+    # service keeps running: the next clean insert lands normally
+    n = server._ingest(0, _InsertFrame(_insert_step()))
+    assert n == 2 and server.total_inserts == 4
+    assert server.stats()["inserts_quarantined"] == 1
+
+
+def test_ingest_guard_rejects_schema_and_bounds():
+    from sheeprl_tpu.resilience.integrity import IngestGuard
+
+    g = IngestGuard(max_abs=1e6)
+    clean = {"observations": np.ones((4, 2, 3), np.float32)}
+    assert g.check(clean) is None  # locks the schema
+    assert g.check({"observations": np.ones((2, 2, 3), np.float32)}) is None  # T may vary
+    bad_key = {"obs": np.ones((4, 2, 3), np.float32)}
+    assert "key set" in g.check(bad_key)
+    bad_dtype = {"observations": np.ones((4, 2, 3), np.float64)}
+    assert "dtype" in g.check(bad_dtype)
+    bad_shape = {"observations": np.ones((4, 2, 5), np.float32)}
+    assert "shape" in g.check(bad_shape)
+    nonfinite = {"observations": np.full((4, 2, 3), np.nan, np.float32)}
+    assert "non-finite" in g.check(nonfinite)
+    huge = {"observations": np.full((4, 2, 3), 1e8, np.float32)}
+    assert "bound" in g.check(huge)
+
+
 # --------------------------------------------------------------------------- #
 # EnvStepGuard: restart-with-backoff timing (the double-fault re-raise and
 # truncation paths are covered in test_resilience.py)
