@@ -69,10 +69,10 @@ and a >20% regression in the metric's better-direction fails the run
 loudly (stderr + exit 3).  Known-noisy metrics are exempt via the
 justified skip-list in ``benchmarks/bench_gate_skiplist.json``.
 
-Env overrides: BENCH_BUDGET_S, BENCH_SKIP_PPO/SAC/A2C/DV3/DEC/LOOP/FANIN,
-BENCH_PPO_STEPS, BENCH_SAC_STEPS, BENCH_A2C_STEPS, BENCH_DV3_STEPS,
-BENCH_FANIN_STEPS, BENCH_PLATFORM (cpu for local tests), BENCH_SKIP_GATE,
-BENCH_GATE_THRESHOLD (fraction, default 0.20).
+Env overrides: BENCH_BUDGET_S, BENCH_SKIP_PPO/SAC/A2C/DV3/DEC/LOOP/FANIN/
+JAXENV, BENCH_PPO_STEPS, BENCH_SAC_STEPS, BENCH_A2C_STEPS, BENCH_DV3_STEPS,
+BENCH_FANIN_STEPS, BENCH_JAXENV_STEPS, BENCH_PLATFORM (cpu for local
+tests), BENCH_SKIP_GATE, BENCH_GATE_THRESHOLD (fraction, default 0.20).
 """
 
 import json
@@ -103,6 +103,7 @@ TPU_V5E_BF16_PEAK_FLOPS = 197e12
 SECTIONS = [
     ("dv3", 60),
     ("loop", 60),
+    ("jaxenv", 60),
     ("replay", 120),
     ("serve", 90),
     ("ppo", 100),
@@ -607,6 +608,32 @@ def bench_serve():
     }
 
 
+def bench_jaxenv():
+    """Device-resident env ladder (benchmarks/bench_jaxenv.py, ISSUE 11):
+    env-steps/s of host SyncVectorEnv vs JaxVectorEnv vs the fused
+    collect (policy included) at 16/256/4096 parallel envs.  Headline is
+    the 256-env fused-over-sync ratio (the >=10x acceptance bar); the
+    fused legs also record their post-warmup compile delta, which must
+    stay 0 — a retrace in the rollout program would silently eat the
+    speedup on a real accelerator."""
+    from benchmarks.bench_jaxenv import run_ladder
+
+    rows = run_ladder(budget_steps=int(os.environ.get("BENCH_JAXENV_STEPS", 6400)))
+    mid = next(r for r in rows if r["num_envs"] == 256)
+    return {
+        "metric": "jaxenv_fused_over_sync_speedup_256",
+        "value": mid.get("fused_over_sync"),
+        "unit": "x",
+        # self-relative tier ratio on this host, not a reference comparison
+        "vs_baseline": None,
+        "fused_env_sps_256": mid["fused_env_sps"],
+        "sync_env_sps_256": mid["sync_env_sps"],
+        "post_warmup_compiles": sum(r["fused_post_warmup_compiles"] for r in rows),
+        "rows": rows,
+        "host_cpu_count": os.cpu_count(),
+    }
+
+
 def bench_replay():
     """Replay-sampling ladder (benchmarks/bench_replay_sampling.py):
     per-batch cost of the uniform vs prioritized on-device samplers at
@@ -772,6 +799,7 @@ def child_main(section, out_path):
     metric = {
         "dv3": bench_dv3,
         "loop": bench_loop,
+        "jaxenv": bench_jaxenv,
         "replay": bench_replay,
         "serve": bench_serve,
         "ppo": bench_ppo,

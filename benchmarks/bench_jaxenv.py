@@ -1,0 +1,301 @@
+"""Device-resident env ladder (ISSUE 11): collect env-steps/s of the three
+tiers, same tiny PPO policy everywhere (apples to apples — the number
+being replaced is the COLLECT rate, not raw random-action stepping).
+
+Per parallel-env count (16 / 256 / 4096):
+
+- ``sync``   — the host collect path: jitted ``PPOPlayer`` batch policy +
+               gymnasium ``SyncVectorEnv`` over the REAL host CartPole-v1
+               + per-step numpy buffer writes (what ``OnPolicyCollector``
+               pays per step).  The 4096-env rung is skipped and RECORDED
+               as skipped — constructing 4096 Python envs alone exceeds
+               the section budget, which is itself the point;
+- ``jaxvec`` — same player + :class:`JaxVectorEnv`: one jitted program
+               steps all envs per call, numpy at the API boundary (the
+               drop-in tier);
+- ``fused``  — :class:`FusedOnPolicyCollector`: policy-step + env-step +
+               buffer-append as one ``lax.scan`` per rollout, zero host
+               round trips.
+
+Each row also carries raw random-action stepping rates (``*_raw_sps``)
+for the env-only picture, and the fused leg's post-warmup XLA compile
+delta, which must be ZERO (the flat-counter acceptance contract).
+Headline: ``fused_over_sync`` at 256 envs (the ISSUE's >=10x bar).
+
+Standalone: ``python benchmarks/bench_jaxenv.py [--out results.json]``;
+bench.py wires :func:`run_ladder` as its ``jaxenv`` section under the
+PR-6 perf-regression gate.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+ROLLOUT_STEPS = 32
+
+
+def _policy(n_envs: int):
+    """(runtime, player) — the tiny PPO MLP jitted for an n_envs batch."""
+    from sheeprl_tpu.algos.ppo.agent import PPOPlayer, build_agent
+    from sheeprl_tpu.config import compose
+    from sheeprl_tpu.parallel.mesh import MeshRuntime
+
+    cfg = compose(
+        overrides=[
+            "exp=a2c",
+            "env=jax_cartpole",
+            f"env.num_envs={n_envs}",
+            "algo.dense_units=64",
+            "algo.mlp_layers=1",
+            "algo.mlp_keys.encoder=[state]",
+            "metric.log_level=0",
+        ]
+    )
+    runtime = MeshRuntime(devices=1)
+    runtime.launch()
+    runtime.seed_everything(0)
+    import gymnasium as gym
+
+    obs_space = gym.spaces.Dict({"state": gym.spaces.Box(-np.inf, np.inf, (4,), np.float32)})
+    module, params = build_agent(runtime, (2,), False, cfg, obs_space)
+    player = PPOPlayer(
+        module, params, lambda obs: {"state": np.asarray(obs["state"], np.float32).reshape(n_envs, -1)}
+    )
+    return cfg, runtime, player
+
+
+def _collect_loop(envs, runtime, player, n_envs: int, n_steps: int) -> float:
+    """The host collect data path: policy dispatch -> action fetch -> env
+    step -> numpy buffer writes, per vector step (OnPolicyCollector's
+    per-step costs without the aggregator/bookkeeping)."""
+    obs, _ = envs.reset(seed=0)
+    obs = obs if isinstance(obs, dict) else {"state": obs}
+    buf = {}
+    # warm BOTH jitted programs (policy sample + vector env step) before
+    # the timed window, then reset to a clean episode state
+    _, real, _, _ = player.get_actions(obs, runtime.next_key())
+    envs.step(np.asarray(real).reshape(n_envs))
+    obs, _ = envs.reset(seed=0)
+    obs = obs if isinstance(obs, dict) else {"state": obs}
+    tic = time.perf_counter()
+    for t in range(n_steps):
+        flat, real, logprobs, values = player.get_actions(obs, runtime.next_key())
+        real_np = np.asarray(real)
+        nobs, rewards, term, trunc, _ = envs.step(real_np.reshape(n_envs))
+        buf["obs"] = np.asarray(obs["state"])
+        buf["actions"] = np.asarray(flat)
+        buf["logprobs"] = np.asarray(logprobs)
+        buf["values"] = np.asarray(values)
+        buf["rewards"] = np.asarray(rewards, np.float32)
+        buf["dones"] = (term | trunc).astype(np.uint8)
+        obs = nobs if isinstance(nobs, dict) else {"state": nobs}
+    dt = time.perf_counter() - tic
+    return n_steps * n_envs / dt
+
+
+def _time_host_collect(n_envs: int, n_steps: int) -> float:
+    import gymnasium as gym
+    from gymnasium.vector import AutoresetMode, SyncVectorEnv
+
+    class DictObs(gym.ObservationWrapper):
+        def __init__(self, env):
+            super().__init__(env)
+            self.observation_space = gym.spaces.Dict({"state": env.observation_space})
+
+        def observation(self, obs):
+            return {"state": obs}
+
+    envs = SyncVectorEnv(
+        [lambda: DictObs(gym.make("CartPole-v1")) for _ in range(n_envs)],
+        autoreset_mode=AutoresetMode.SAME_STEP,
+    )
+    cfg, runtime, player = _policy(n_envs)
+    try:
+        return _collect_loop(envs, runtime, player, n_envs, n_steps)
+    finally:
+        envs.close()
+
+
+def _time_jaxvec_collect(n_envs: int, n_steps: int) -> float:
+    from sheeprl_tpu.envs.jax import JaxVectorEnv, make_jax_env
+
+    envs = JaxVectorEnv(make_jax_env("jax_cartpole"), n_envs, seed=0)
+    cfg, runtime, player = _policy(n_envs)
+    try:
+        return _collect_loop(envs, runtime, player, n_envs, n_steps)
+    finally:
+        envs.close()
+
+
+def _time_host_raw(n_envs: int, n_steps: int) -> float:
+    """Raw random-action SyncVectorEnv stepping (env-only reference)."""
+    import gymnasium as gym
+    from gymnasium.vector import AutoresetMode, SyncVectorEnv
+
+    envs = SyncVectorEnv(
+        [lambda: gym.make("CartPole-v1") for _ in range(n_envs)],
+        autoreset_mode=AutoresetMode.SAME_STEP,
+    )
+    try:
+        envs.reset(seed=0)
+        acts = np.random.default_rng(0).integers(0, 2, size=(n_steps, n_envs))
+        envs.step(acts[0])
+        tic = time.perf_counter()
+        for t in range(n_steps):
+            envs.step(acts[t])
+        dt = time.perf_counter() - tic
+    finally:
+        envs.close()
+    return n_steps * n_envs / dt
+
+
+def _time_jaxvec_raw(n_envs: int, n_steps: int) -> float:
+    from sheeprl_tpu.envs.jax import JaxVectorEnv, make_jax_env
+
+    ve = JaxVectorEnv(make_jax_env("jax_cartpole"), n_envs, seed=0)
+    ve.reset(seed=0)
+    acts = np.random.default_rng(0).integers(0, 2, size=(n_steps, n_envs))
+    ve.step(acts[0])  # compile
+    tic = time.perf_counter()
+    for t in range(n_steps):
+        ve.step(acts[t])
+    dt = time.perf_counter() - tic
+    ve.close()
+    return n_steps * n_envs / dt
+
+
+def _make_fused_collector(n_envs: int):
+    from sheeprl_tpu.algos.ppo.agent import build_agent
+    from sheeprl_tpu.config import compose
+    from sheeprl_tpu.envs.jax.collect import FusedOnPolicyCollector
+    from sheeprl_tpu.parallel.mesh import MeshRuntime
+    from sheeprl_tpu.utils.env import make_train_envs
+
+    cfg = compose(
+        overrides=[
+            "exp=a2c",
+            "env=jax_cartpole",
+            f"env.num_envs={n_envs}",
+            "algo.env_backend=jax",
+            f"algo.rollout_steps={ROLLOUT_STEPS}",
+            "algo.dense_units=64",
+            "algo.mlp_layers=1",
+            "algo.mlp_keys.encoder=[state]",
+            "metric.log_level=0",
+        ]
+    )
+    runtime = MeshRuntime(devices=1)
+    runtime.launch()
+    runtime.seed_everything(0)
+    envs = make_train_envs(cfg, runtime, None)
+    module, params = build_agent(
+        runtime, (envs.single_action_space.n,), False, cfg, envs.single_observation_space
+    )
+    return FusedOnPolicyCollector(
+        envs=envs,
+        module=module,
+        params=params,
+        cfg=cfg,
+        runtime=runtime,
+        obs_keys=["state"],
+        total_envs=n_envs,
+        world_size=1,
+    )
+
+
+def _time_fused(n_envs: int, n_rollouts: int):
+    """(env-steps/s, post-warmup compile delta) of the fused collect."""
+    import jax
+
+    from sheeprl_tpu.obs import RecompileMonitor
+
+    collector = _make_fused_collector(n_envs)
+    rng = np.random.default_rng(0)
+
+    def key():
+        return rng.integers(0, 2**32, size=(2,), dtype=np.uint32)
+
+    monitor = RecompileMonitor(name=f"jaxenv:{n_envs}", warn=False).install()
+    try:
+        payload = collector.collect(0, True, key)  # warmup (trace + compile)
+        jax.block_until_ready(payload.data["rewards"])
+        warm_compiles = monitor.snapshot().get("total", 0)
+        tic = time.perf_counter()
+        for i in range(n_rollouts):
+            payload = collector.collect(i + 1, True, key)
+        jax.block_until_ready(payload.data["rewards"])
+        dt = time.perf_counter() - tic
+        compile_delta = monitor.snapshot().get("total", 0) - warm_compiles
+    finally:
+        monitor.uninstall()
+    return n_rollouts * ROLLOUT_STEPS * n_envs / dt, compile_delta
+
+
+def run_ladder(sizes=(16, 256, 4096), budget_steps: int = 6400):
+    """One row per env count; collect env-steps/s per tier + ratios."""
+    rows = []
+    for n in sizes:
+        n_steps = max(budget_steps // n, 8)
+        row = {"num_envs": n, "rollout_steps": ROLLOUT_STEPS}
+        if n <= 1024:
+            row["sync_env_sps"] = round(_time_host_collect(n, n_steps), 1)
+            row["sync_raw_sps"] = round(_time_host_raw(n, n_steps), 1)
+        else:
+            # recorded, not silent: the host rung is the cost being replaced
+            row["sync_env_sps"] = None
+            row["sync_skipped"] = (
+                f"constructing {n} Python envs exceeds the section budget; "
+                "the 256-env rung carries the host baseline"
+            )
+        row["jaxvec_env_sps"] = round(_time_jaxvec_collect(n, max(n_steps, 32)), 1)
+        row["jaxvec_raw_sps"] = round(_time_jaxvec_raw(n, max(n_steps, 64)), 1)
+        fused_sps, compile_delta = _time_fused(
+            n, n_rollouts=max(budget_steps // (ROLLOUT_STEPS * n), 3)
+        )
+        row["fused_env_sps"] = round(fused_sps, 1)
+        row["fused_post_warmup_compiles"] = int(compile_delta)
+        if row["sync_env_sps"]:
+            row["jaxvec_over_sync"] = round(row["jaxvec_env_sps"] / row["sync_env_sps"], 2)
+            row["fused_over_sync"] = round(row["fused_env_sps"] / row["sync_env_sps"], 2)
+        rows.append(row)
+    return rows
+
+
+def main(out_path=None):
+    rows = run_ladder()
+    doc = {
+        "benchmark": "jaxenv_ladder",
+        "rows": rows,
+        "host_cpu_count": os.cpu_count(),
+        "notes": (
+            "collect env-steps/s, same tiny PPO policy in every tier: sync = "
+            "jitted batch policy + gymnasium SyncVectorEnv(CartPole-v1) + numpy "
+            "buffer writes (the host OnPolicyCollector data path); jaxvec = same "
+            "policy + JaxVectorEnv (one dispatch per step); fused = "
+            "FusedOnPolicyCollector lax.scan rollouts (zero host round trips). "
+            "*_raw_sps = random-action env-only stepping for reference. "
+            "fused_post_warmup_compiles must be 0 (flat-counter contract)."
+        ),
+    }
+    text = json.dumps(doc, indent=2)
+    print(text)
+    if out_path:
+        with open(out_path, "w") as f:
+            f.write(text + "\n")
+    return doc
+
+
+if __name__ == "__main__":
+    import argparse
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", default=None)
+    args = p.parse_args()
+    main(args.out)

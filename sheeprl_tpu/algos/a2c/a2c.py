@@ -28,7 +28,7 @@ from sheeprl_tpu.parallel.pipeline import OnPolicyCollector, PipelinedCollector,
 from sheeprl_tpu.resilience import CheckpointManager
 from sheeprl_tpu.resilience.sentinel import guard_update, restore_like
 from sheeprl_tpu.utils.callback import load_checkpoint
-from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.utils.env import make_train_envs, resolve_env_backend
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
@@ -163,19 +163,14 @@ def main(runtime, cfg: Dict[str, Any]):
     if logger:
         logger.log_hyperparams(cfg)
 
-    from gymnasium.vector import AsyncVectorEnv, AutoresetMode, SyncVectorEnv
     import gymnasium as gym
 
     total_envs = cfg.env.num_envs * world_size
-    thunks = [
-        make_env(cfg, cfg.seed + i, 0, log_dir if runtime.is_global_zero else None, "train", vector_env_idx=i)
-        for i in range(total_envs)
-    ]
-    envs = (
-        SyncVectorEnv(thunks, autoreset_mode=AutoresetMode.SAME_STEP)
-        if cfg.env.sync_env
-        else AsyncVectorEnv(thunks, context="spawn", autoreset_mode=AutoresetMode.SAME_STEP)
-    )
+    # env backend dispatch (howto/jax-envs.md): host = the gymnasium
+    # vector stack (bit-exact pre-backend behavior), jax = device-resident
+    # envs + the fused collect path below
+    env_backend = resolve_env_backend(cfg)
+    envs = make_train_envs(cfg, runtime, log_dir)
     observation_space = envs.single_observation_space
     if not isinstance(observation_space, gym.spaces.Dict):
         raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
@@ -248,39 +243,67 @@ def main(runtime, cfg: Dict[str, Any]):
     # staleness <= 1); False keeps the serial pre-pipeline order bit-exact;
     # "auto" turns it on only where a spare host core exists for the
     # collector thread (single-core hosts stay serial)
-    overlap = resolve_overlap_setting(cfg)
+    overlap = resolve_overlap_setting(cfg)  # always off on the jax backend
     if overlap:
         # the player's device_put is a no-op on a same-device tree, so its
         # initial weights alias the buffers update 1 donates — detach them
         # before the collector thread starts acting on them
         player.params = detach_copy(params)
-    collector = OnPolicyCollector(
-        envs=envs,
-        player=player,
-        rb=rb,
-        cfg=cfg,
-        runtime=runtime,
-        obs_keys=obs_keys,
-        total_envs=total_envs,
-        world_size=world_size,
-        aggregator=aggregator,
-        policy_step=policy_step,
-    )
+    if env_backend == "jax":
+        # fused collect (envs/jax/collect.py): policy + env + append as
+        # one lax.scan per rollout; the payload is born on device
+        from sheeprl_tpu.envs.jax.collect import FusedOnPolicyCollector
 
-    def _pack(payload):
-        # env-axis sharding: each mesh device receives only its columns; on
-        # the overlapped path this runs on the collector thread, so the
-        # host->device upload of rollout t+1 overlaps train step t
-        local_data = {k: v.astype(jnp.float32) for k, v in payload.data.items()}
-        # np.array (copy), not asarray: SyncVectorEnv mutates its obs
-        # buffer in place and CPU device_put zero-copy aliases host memory
-        host_next_obs = {k: np.array(payload.next_obs[k]) for k in obs_keys}
-        # the upload sources must outlive the update that reads them —
-        # device_put's zero-copy alias does not keep them alive itself
-        payload.host_refs.append((local_data, host_next_obs))
-        with trace_scope("host_to_device"):
-            payload.data = runtime.shard_batch(local_data, axis=1)
-            payload.next_obs = runtime.shard_batch(host_next_obs, axis=0)
+        collector = FusedOnPolicyCollector(
+            envs=envs,
+            module=module,
+            params=params,
+            cfg=cfg,
+            runtime=runtime,
+            obs_keys=obs_keys,
+            total_envs=total_envs,
+            world_size=world_size,
+            aggregator=aggregator,
+            policy_step=policy_step,
+        )
+        observability.jaxenv_stats = collector.stats
+        adopt_params_fn = collector.adopt
+
+        def _pack(payload):
+            # already device arrays; only the mesh layout is (re)applied
+            with trace_scope("host_to_device"):
+                payload.data = runtime.shard_batch(dict(payload.data), axis=1)
+                payload.next_obs = runtime.shard_batch(dict(payload.next_obs), axis=0)
+
+    else:
+        collector = OnPolicyCollector(
+            envs=envs,
+            player=player,
+            rb=rb,
+            cfg=cfg,
+            runtime=runtime,
+            obs_keys=obs_keys,
+            total_envs=total_envs,
+            world_size=world_size,
+            aggregator=aggregator,
+            policy_step=policy_step,
+        )
+        adopt_params_fn = lambda p: setattr(player, "params", p)
+
+        def _pack(payload):
+            # env-axis sharding: each mesh device receives only its columns; on
+            # the overlapped path this runs on the collector thread, so the
+            # host->device upload of rollout t+1 overlaps train step t
+            local_data = {k: v.astype(jnp.float32) for k, v in payload.data.items()}
+            # np.array (copy), not asarray: SyncVectorEnv mutates its obs
+            # buffer in place and CPU device_put zero-copy aliases host memory
+            host_next_obs = {k: np.array(payload.next_obs[k]) for k in obs_keys}
+            # the upload sources must outlive the update that reads them —
+            # device_put's zero-copy alias does not keep them alive itself
+            payload.host_refs.append((local_data, host_next_obs))
+            with trace_scope("host_to_device"):
+                payload.data = runtime.shard_batch(local_data, axis=1)
+                payload.next_obs = runtime.shard_batch(host_next_obs, axis=0)
 
     pipeline = PipelinedCollector(
         runtime,
@@ -290,7 +313,7 @@ def main(runtime, cfg: Dict[str, Any]):
         total_iters=total_iters,
         overlap=overlap,
         seed=cfg.seed,
-        adopt_params_fn=lambda p: setattr(player, "params", p),
+        adopt_params_fn=adopt_params_fn,
     )
     metric_fetch_gate = MetricFetchGate(cfg.metric.get("fetch_every", 1))
 

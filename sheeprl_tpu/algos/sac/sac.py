@@ -47,7 +47,7 @@ from sheeprl_tpu.replay import per_beta_schedule, rate_limiter_from_cfg
 from sheeprl_tpu.resilience import CheckpointManager
 from sheeprl_tpu.resilience.sentinel import guard_update, restore_like
 from sheeprl_tpu.utils.callback import load_checkpoint, restore_buffer
-from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.utils.env import make_train_envs, resolve_env_backend
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
@@ -187,7 +187,6 @@ def make_train_fn(
 @register_algorithm()
 def main(runtime, cfg: Dict[str, Any]):
     import gymnasium as gym
-    from gymnasium.vector import AsyncVectorEnv, AutoresetMode, SyncVectorEnv
 
     if "minedojo" in str(cfg.env.wrapper.get("_target_", "")).lower():
         raise ValueError("MineDojo is not supported by the SAC agent")
@@ -209,15 +208,12 @@ def main(runtime, cfg: Dict[str, Any]):
         logger.log_hyperparams(cfg)
 
     total_envs = cfg.env.num_envs * world_size
-    thunks = [
-        make_env(cfg, cfg.seed + i, 0, log_dir if runtime.is_global_zero else None, "train", vector_env_idx=i)
-        for i in range(total_envs)
-    ]
-    envs = (
-        SyncVectorEnv(thunks, autoreset_mode=AutoresetMode.SAME_STEP)
-        if cfg.env.sync_env
-        else AsyncVectorEnv(thunks, context="spawn", autoreset_mode=AutoresetMode.SAME_STEP)
-    )
+    # env backend dispatch (howto/jax-envs.md): SAC's off-policy loop is
+    # step-at-a-time, so env_backend=jax rides the JaxVectorEnv adapter
+    # (all envs stepped by ONE jitted program per iteration) rather than a
+    # fused rollout scan — the loop body runs unchanged either way
+    resolve_env_backend(cfg)
+    envs = make_train_envs(cfg, runtime, log_dir)
     action_space = envs.single_action_space
     observation_space = envs.single_observation_space
     if not isinstance(action_space, gym.spaces.Box):

@@ -1,0 +1,169 @@
+"""Classic-control dynamics as pure-JAX envs (CartPole / Pendulum class).
+
+Same physics as the gymnasium references (cartpole.py / pendulum.py),
+re-derived as pure functions so ``vmap`` batches thousands of instances
+and the fused collector scans them inside one XLA program.
+
+Domain randomization rides the PRNG: with ``randomize=True`` each reset
+draws per-episode physics scale factors from its key, so a ``vmap`` over
+reset keys is a parameter SWEEP — every parallel env integrates a
+slightly different plant, one compiled program covering the whole
+distribution (the scenario-diversity play of ROADMAP item 2).
+
+Observations are dict pytrees keyed ``"state"`` — the same shape/key
+contract the host envs expose after ``make_env``'s dict-ification, so
+``algo.mlp_keys.encoder=[state]`` works unchanged on either backend.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.envs.jax.core import JaxEnv
+
+
+class CartPoleJax(JaxEnv):
+    """CartPole-v1 dynamics (Barto-Sutton-Anderson, Euler integration).
+
+    State pytree: ``{"x": (4,) f32, "params": (2,) f32}`` — ``params``
+    holds the per-episode (pole_length_scale, pole_mass_scale) factors
+    (both exactly 1.0 when ``randomize=False``, so the deterministic
+    variant pays nothing for the randomization axis).
+    """
+
+    GRAVITY = 9.8
+    MASSCART = 1.0
+    MASSPOLE = 0.1
+    LENGTH = 0.5  # half pole length
+    FORCE_MAG = 10.0
+    TAU = 0.02
+    X_THRESHOLD = 2.4
+    THETA_THRESHOLD = 12 * 2 * np.pi / 360
+
+    def __init__(self, randomize: bool = False, randomize_scale: float = 0.3, max_episode_steps: int = 500):
+        self.randomize = bool(randomize)
+        self.randomize_scale = float(randomize_scale)
+        self.max_episode_steps = int(max_episode_steps)
+        self._conf = (self.randomize, self.randomize_scale, self.max_episode_steps)
+        self.observation_space = gym.spaces.Dict(
+            {
+                "state": gym.spaces.Box(-np.inf, np.inf, shape=(4,), dtype=np.float32),
+            }
+        )
+        self.action_space = gym.spaces.Discrete(2)
+
+    def _obs(self, x: jax.Array) -> Dict[str, jax.Array]:
+        return {"state": x}
+
+    def reset(self, key: jax.Array):
+        k_state, k_params = jax.random.split(key)
+        x = jax.random.uniform(k_state, (4,), jnp.float32, -0.05, 0.05)
+        if self.randomize:
+            s = self.randomize_scale
+            params = jax.random.uniform(k_params, (2,), jnp.float32, 1.0 - s, 1.0 + s)
+        else:
+            params = jnp.ones((2,), jnp.float32)
+        state = {"x": x, "params": params}
+        return state, self._obs(x)
+
+    def step(self, state, action, key):
+        del key  # deterministic dynamics; randomness enters at reset
+        x, x_dot, theta, theta_dot = state["x"]
+        length = self.LENGTH * state["params"][0]
+        masspole = self.MASSPOLE * state["params"][1]
+        total_mass = self.MASSCART + masspole
+        polemass_length = masspole * length
+
+        force = jnp.where(action.astype(jnp.int32) == 1, self.FORCE_MAG, -self.FORCE_MAG)
+        costheta = jnp.cos(theta)
+        sintheta = jnp.sin(theta)
+        temp = (force + polemass_length * theta_dot**2 * sintheta) / total_mass
+        thetaacc = (self.GRAVITY * sintheta - costheta * temp) / (
+            length * (4.0 / 3.0 - masspole * costheta**2 / total_mass)
+        )
+        xacc = temp - polemass_length * thetaacc * costheta / total_mass
+
+        x = x + self.TAU * x_dot
+        x_dot = x_dot + self.TAU * xacc
+        theta = theta + self.TAU * theta_dot
+        theta_dot = theta_dot + self.TAU * thetaacc
+        new_x = jnp.stack([x, x_dot, theta, theta_dot]).astype(jnp.float32)
+
+        terminated = (
+            (jnp.abs(x) > self.X_THRESHOLD) | (jnp.abs(theta) > self.THETA_THRESHOLD)
+        )
+        reward = jnp.float32(1.0)
+        new_state = {"x": new_x, "params": state["params"]}
+        return new_state, self._obs(new_x), reward, terminated, {}
+
+
+class PendulumJax(JaxEnv):
+    """Pendulum-v1 dynamics (torque-limited swing-up, never terminates).
+
+    State pytree: ``{"th": (), "thdot": (), "params": (2,)}`` with
+    ``params = (g_scale, l_scale)`` per-episode randomization factors.
+    Obs is the standard ``(cos th, sin th, thdot)`` triple under
+    ``"state"``; episodes end only by truncation (default 200 steps).
+    """
+
+    MAX_SPEED = 8.0
+    MAX_TORQUE = 2.0
+    DT = 0.05
+    G = 10.0
+    M = 1.0
+    L = 1.0
+
+    def __init__(self, randomize: bool = False, randomize_scale: float = 0.3, max_episode_steps: int = 200):
+        self.randomize = bool(randomize)
+        self.randomize_scale = float(randomize_scale)
+        self.max_episode_steps = int(max_episode_steps)
+        self._conf = (self.randomize, self.randomize_scale, self.max_episode_steps)
+        self.observation_space = gym.spaces.Dict(
+            {
+                "state": gym.spaces.Box(
+                    np.array([-1.0, -1.0, -self.MAX_SPEED], np.float32),
+                    np.array([1.0, 1.0, self.MAX_SPEED], np.float32),
+                    dtype=np.float32,
+                ),
+            }
+        )
+        self.action_space = gym.spaces.Box(-self.MAX_TORQUE, self.MAX_TORQUE, shape=(1,), dtype=np.float32)
+
+    def _obs(self, th: jax.Array, thdot: jax.Array) -> Dict[str, jax.Array]:
+        return {"state": jnp.stack([jnp.cos(th), jnp.sin(th), thdot]).astype(jnp.float32)}
+
+    def reset(self, key: jax.Array):
+        k_state, k_params = jax.random.split(key)
+        high = jnp.array([jnp.pi, 1.0], jnp.float32)
+        init = jax.random.uniform(k_state, (2,), jnp.float32, -1.0, 1.0) * high
+        if self.randomize:
+            s = self.randomize_scale
+            params = jax.random.uniform(k_params, (2,), jnp.float32, 1.0 - s, 1.0 + s)
+        else:
+            params = jnp.ones((2,), jnp.float32)
+        state = {"th": init[0], "thdot": init[1], "params": params}
+        return state, self._obs(state["th"], state["thdot"])
+
+    def step(self, state, action, key):
+        del key  # deterministic dynamics; randomness enters at reset
+        th, thdot = state["th"], state["thdot"]
+        g = self.G * state["params"][0]
+        length = self.L * state["params"][1]
+        u = jnp.clip(action.reshape(()), -self.MAX_TORQUE, self.MAX_TORQUE)
+
+        norm_th = jnp.mod(th + jnp.pi, 2 * jnp.pi) - jnp.pi
+        cost = norm_th**2 + 0.1 * thdot**2 + 0.001 * u**2
+
+        newthdot = thdot + (3.0 * g / (2.0 * length) * jnp.sin(th) + 3.0 / (self.M * length**2) * u) * self.DT
+        newthdot = jnp.clip(newthdot, -self.MAX_SPEED, self.MAX_SPEED)
+        newth = th + newthdot * self.DT
+
+        new_state = {"th": newth, "thdot": newthdot, "params": state["params"]}
+        reward = (-cost).astype(jnp.float32)
+        terminated = jnp.zeros((), bool)
+        return new_state, self._obs(newth, newthdot), reward, terminated, {}

@@ -1,0 +1,348 @@
+"""Fused collect: policy-step + env-step + buffer-append as ONE XLA program.
+
+The real prize of device-resident envs (``algo.env_backend=jax``).  The
+host collectors (``parallel/pipeline.py``) pay, per env step: a jitted
+policy dispatch, an action fetch, a Python vector-env loop, and a numpy
+buffer write — then one host->device upload per rollout.  Here the whole
+rollout is a single ``lax.scan`` over ``algo.rollout_steps``:
+
+- the policy samples actions from the CURRENT obs (same agent module the
+  update trains — no separate player network, no weight transfer);
+- ``core.vector_step`` advances all N envs with auto-reset folded in;
+- truncation bootstrapping (reward += gamma * V(final_obs), exactly the
+  host collectors' fixed-shape substitute-rows scheme) runs on device;
+- the per-step records stack into the (T, N, ...) rollout layout the
+  update functions already consume — the "buffer append" is the scan's
+  output stacking, there is no buffer.
+
+One dispatch per rollout, zero host round trips, one trace (fixed
+shapes — the post-warmup compile counter stays flat, asserted in tests
+and the bench ladder).
+
+Episode returns/lengths accumulate on device inside the scan; the host
+fetches them at the existing ``metric.fetch_every`` cadence (same
+SUBSAMPLING semantics as the losses fetch: skipped rollouts' episode
+events are dropped, not deferred — ``configs/metric/default.yaml``).
+
+The collectors below expose the exact ``collect(iter_num, inline,
+key_fn)`` contract of ``OnPolicyCollector`` / ``RecurrentCollector``, so
+the loops drive them through the same ``PipelinedCollector`` scaffold
+(always on its serial path: ``resolve_overlap_setting`` forces the
+overlap OFF for this backend — there is no host work left to overlap).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.envs.jax.core import tree_select, vector_reset, vector_step
+from sheeprl_tpu.envs.jax.vector import JaxVectorEnv
+from sheeprl_tpu.parallel.pipeline import RolloutPayload
+from sheeprl_tpu.utils.utils import MetricFetchGate
+
+__all__ = ["FusedOnPolicyCollector", "FusedRecurrentCollector"]
+
+
+class _FusedCollectorBase:
+    """Shared scaffolding: params adoption, episode-event fetch cadence,
+    policy-step accounting, telemetry counters."""
+
+    def __init__(
+        self,
+        *,
+        envs: JaxVectorEnv,
+        module: Any,
+        params: Any,
+        cfg: Any,
+        runtime: Any,
+        obs_keys: Sequence[str],
+        total_envs: int,
+        world_size: int,
+        aggregator: Any = None,
+        policy_step: int = 0,
+    ):
+        self.envs = envs
+        self.jax_env = envs.env
+        self.module = module
+        self.params = params
+        self.cfg = cfg
+        self.runtime = runtime
+        self.obs_keys = list(obs_keys)
+        self.total_envs = int(total_envs)
+        self.world_size = int(world_size)
+        self.aggregator = aggregator
+        self.policy_step = int(policy_step)
+        self.max_episode_steps = envs._max_steps
+        self.rollout_steps = int(cfg.algo.rollout_steps)
+        # device env state: seeded from the run seed, SAME key discipline
+        # as JaxVectorEnv/JaxToGymEnv (core.py module docstring)
+        self._env_base = jax.random.PRNGKey(int(cfg.seed))
+        self._jinit = jax.jit(lambda base: self._initial_carry(base))
+        # commit the initial carry to the mesh-replicated layout: rollout
+        # outputs inherit the params' NamedSharding, so an uncommitted
+        # first carry would make collect #2 a different arg-sharding
+        # signature — one extra compile, breaking the flat-counter contract
+        self._carry = jax.device_put(self._jinit(self._env_base), runtime.replicated)
+        self._rollout = jax.jit(self._rollout_fn)
+        # device->host episode-event fetch cadence (metric.fetch_every)
+        self._event_gate = MetricFetchGate(cfg.metric.get("fetch_every", 1))
+        self._log_events = int(cfg.metric.get("log_level", 1)) > 0
+        # telemetry counters (obs/__init__.py "jaxenv" record section)
+        self._n_rollouts = 0
+        self._n_episodes = 0
+        self._n_event_fetches = 0
+
+    # subclasses implement
+    def _initial_carry(self, base):
+        raise NotImplementedError
+
+    def _rollout_fn(self, params, carry, key, env_base):
+        raise NotImplementedError
+
+    def adopt(self, params: Any) -> None:
+        """Params handoff target for ``PipelinedCollector``'s adopt hook —
+        the fused program acts on whatever was last adopted (serial path:
+        exactly the previous iteration's update, the host loops' order)."""
+        self.params = params
+
+    def _apply_events(self, events: Dict[str, Any], step_start: int) -> None:
+        """Fetch + emit on-device episode events at the fetch cadence."""
+        if not self._log_events or self.aggregator is None:
+            return
+        if not self._event_gate():
+            return
+        self._n_event_fetches += 1
+        done = np.asarray(events["done"])  # (T, N)
+        if not done.any():
+            return
+        ep_ret = np.asarray(events["ep_return"])
+        ep_len = np.asarray(events["ep_length"])
+        per_step = self.total_envs  # policy steps per scan step (global)
+        for t, i in zip(*np.nonzero(done)):
+            self._n_episodes += 1
+            ep_rew = float(ep_ret[t, i])
+            if self.aggregator and "Rewards/rew_avg" in self.aggregator:
+                self.aggregator.update("Rewards/rew_avg", ep_rew)
+            if self.aggregator and "Game/ep_len_avg" in self.aggregator:
+                self.aggregator.update("Game/ep_len_avg", float(ep_len[t, i]))
+            self.runtime.print(
+                f"Rank-0: policy_step={step_start + (int(t) + 1) * per_step}, "
+                f"reward_env_{int(i)}={ep_rew}"
+            )
+
+    def stats(self) -> Dict[str, Any]:
+        """Telemetry provider (``jaxenv`` key in telemetry.jsonl)."""
+        return {
+            "backend": "jax",
+            "fused": True,
+            "env": type(self.jax_env).__name__,
+            "num_envs": self.total_envs,
+            "rollout_steps": self.rollout_steps,
+            "rollouts": self._n_rollouts,
+            "env_steps": self._n_rollouts * self.rollout_steps * self.total_envs,
+            "episodes_reported": self._n_episodes,
+            "event_fetches": self._n_event_fetches,
+        }
+
+
+class FusedOnPolicyCollector(_FusedCollectorBase):
+    """Fused drop-in for the PPO/A2C ``OnPolicyCollector.collect``."""
+
+    def _initial_carry(self, base):
+        return vector_reset(self.jax_env, base, self.total_envs)
+
+    def _rollout_fn(self, params, carry, key, env_base):
+        from sheeprl_tpu.algos.ppo.agent import get_values, sample_actions
+        from sheeprl_tpu.algos.ppo.utils import normalize_obs
+
+        cfg = self.cfg
+        env = self.jax_env
+        obs_keys = tuple(self.obs_keys)
+        cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
+        gamma = float(cfg.algo.gamma)
+        clip_rewards = bool(cfg.env.clip_rewards)
+        max_steps = self.max_episode_steps
+        discrete = not self.module.is_continuous
+
+        def norm(obs):
+            return normalize_obs({k: obs[k].astype(jnp.float32) for k in obs_keys}, cnn_keys, obs_keys)
+
+        def step_fn(vstate, k_pol):
+            obs = vstate["obs"]
+            flat, real, logprobs, values = sample_actions(self.module, params, norm(obs), k_pol)
+            act = real[..., 0] if discrete else flat
+            new_vstate, out = vector_step(env, vstate, act, env_base, max_steps)
+            rewards = out["reward"][:, None]
+            if max_steps:
+                # truncation bootstrap — the host collectors' fixed-shape
+                # scheme: value the full env batch with terminal rows
+                # substituted, add gamma * V only on truncated rows.  The
+                # critic forward rides a lax.cond so the (common) steps
+                # with no truncation skip it at runtime — the host path
+                # likewise only values on actual truncations
+                def _bootstrap():
+                    real_next = tree_select(out["truncated"], out["final_obs"], out["obs"])
+                    return get_values(self.module, params, norm(real_next))
+
+                vals = jax.lax.cond(
+                    out["truncated"].any(),
+                    _bootstrap,
+                    lambda: jnp.zeros((out["reward"].shape[0], 1), jnp.float32),
+                )
+                rewards = rewards + gamma * vals * out["truncated"][:, None].astype(jnp.float32)
+            if clip_rewards:
+                rewards = jnp.tanh(rewards)
+            rec = {k: obs[k].astype(jnp.float32) for k in obs_keys}
+            rec.update(
+                dones=out["done"][:, None].astype(jnp.float32),
+                values=values.astype(jnp.float32),
+                actions=flat.astype(jnp.float32),
+                logprobs=logprobs.astype(jnp.float32),
+                rewards=rewards.astype(jnp.float32),
+            )
+            ev = {"done": out["done"], "ep_return": out["ep_return"], "ep_length": out["ep_length"]}
+            return new_vstate, (rec, ev)
+
+        keys = jax.random.split(jnp.asarray(key), self.rollout_steps)
+        carry, (data, events) = jax.lax.scan(step_fn, carry, keys)
+        return carry, data, events
+
+    def collect(self, iter_num: int, inline: bool, key_fn) -> RolloutPayload:
+        from sheeprl_tpu.utils.metric import SumMetric
+        from sheeprl_tpu.utils.timer import timer
+
+        payload = RolloutPayload(iter_num)
+        step_start = self.policy_step
+        with timer("Time/env_interaction_time", SumMetric, sync_on_compute=False):
+            self._carry, data, events = self._rollout(self.params, self._carry, key_fn(), self._env_base)
+        self._n_rollouts += 1
+        self.policy_step += self.rollout_steps * self.total_envs
+        self._apply_events(events, step_start)
+        payload.data = data
+        payload.next_obs = {k: self._carry["obs"][k] for k in self.obs_keys}
+        payload.policy_step_end = self.policy_step
+        return payload
+
+
+class FusedRecurrentCollector(_FusedCollectorBase):
+    """Fused drop-in for ``RecurrentCollector.collect`` (recurrent PPO):
+    the scan carry additionally threads (hx, cx, prev_actions), captures
+    the PRE-action recurrent state per step (what the update conditions
+    on) and zeroes it on done (``algo.reset_recurrent_state_on_done``),
+    and the payload carries the bootstrap ``next_values`` extra."""
+
+    def _initial_carry(self, base):
+        h = self.module.rnn_hidden_size
+        a = sum(self.module.actions_dim)
+        return {
+            "vstate": vector_reset(self.jax_env, base, self.total_envs),
+            "hx": jnp.zeros((self.total_envs, h), jnp.float32),
+            "cx": jnp.zeros((self.total_envs, h), jnp.float32),
+            "prev_actions": jnp.zeros((1, self.total_envs, a), jnp.float32),
+        }
+
+    def _rollout_fn(self, params, carry, key, env_base):
+        from sheeprl_tpu.algos.ppo.utils import normalize_obs
+        from sheeprl_tpu.algos.ppo_recurrent.agent import get_values, sample_actions
+
+        cfg = self.cfg
+        env = self.jax_env
+        obs_keys = tuple(self.obs_keys)
+        cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
+        gamma = float(cfg.algo.gamma)
+        clip_rewards = bool(cfg.env.clip_rewards)
+        reset_on_done = bool(cfg.algo.reset_recurrent_state_on_done)
+        max_steps = self.max_episode_steps
+        discrete = not self.module.is_continuous
+        n = self.total_envs
+
+        def norm(obs):
+            # (T=1, B, ...) layout — what the recurrent module consumes
+            # (host parity: ppo_recurrent.utils.prepare_obs)
+            return normalize_obs(
+                {k: obs[k][None].astype(jnp.float32) for k in obs_keys}, cnn_keys, obs_keys
+            )
+
+        def step_fn(c, k_pol):
+            vstate = c["vstate"]
+            obs = vstate["obs"]
+            prev_hx, prev_cx, prev_actions = c["hx"], c["cx"], c["prev_actions"]
+            flat, real, logprobs, values, (hx, cx) = sample_actions(
+                self.module, params, norm(obs), prev_actions, prev_hx, prev_cx, k_pol
+            )
+            act = real.reshape(n, -1)[..., 0] if discrete else flat.reshape(n, -1)
+            new_vstate, out = vector_step(env, vstate, act, env_base, max_steps)
+            rewards = out["reward"][:, None]
+            if max_steps:
+                # host parity: the bootstrap values use the POST-action
+                # recurrent state and the just-taken actions; the forward
+                # rides a lax.cond — no-truncation steps skip it at runtime
+                def _bootstrap():
+                    real_next = tree_select(out["truncated"], out["final_obs"], out["obs"])
+                    return get_values(self.module, params, norm(real_next), flat, hx, cx).reshape(n, -1)[
+                        :, :1
+                    ]
+
+                vals = jax.lax.cond(
+                    out["truncated"].any(),
+                    _bootstrap,
+                    lambda: jnp.zeros((n, 1), jnp.float32),
+                )
+                rewards = rewards + gamma * vals * out["truncated"][:, None].astype(jnp.float32)
+            if clip_rewards:
+                rewards = jnp.tanh(rewards)
+            new_prev_actions = flat if flat.ndim == 3 else flat[None]
+            if reset_on_done:
+                keep = (1.0 - out["done"].astype(jnp.float32))[:, None]
+                hx = hx * keep
+                cx = cx * keep
+                new_prev_actions = new_prev_actions * keep[None]
+            rec = {k: obs[k].astype(jnp.float32) for k in obs_keys}
+            rec.update(
+                dones=out["done"][:, None].astype(jnp.float32),
+                values=values.reshape(n, -1).astype(jnp.float32),
+                actions=flat.reshape(n, -1).astype(jnp.float32),
+                logprobs=logprobs.reshape(n, -1).astype(jnp.float32),
+                rewards=rewards.astype(jnp.float32),
+                prev_hx=prev_hx.astype(jnp.float32),
+                prev_cx=prev_cx.astype(jnp.float32),
+                prev_actions=prev_actions.reshape(n, -1).astype(jnp.float32),
+            )
+            ev = {"done": out["done"], "ep_return": out["ep_return"], "ep_length": out["ep_length"]}
+            new_c = {"vstate": new_vstate, "hx": hx, "cx": cx, "prev_actions": new_prev_actions}
+            return new_c, (rec, ev)
+
+        keys = jax.random.split(jnp.asarray(key), self.rollout_steps)
+        carry, (data, events) = jax.lax.scan(step_fn, carry, keys)
+        next_values = get_values(
+            self.module,
+            params,
+            norm(carry["vstate"]["obs"]),
+            carry["prev_actions"],
+            carry["hx"],
+            carry["cx"],
+        ).reshape(n, -1)
+        return carry, data, events, next_values
+
+    def collect(self, iter_num: int, inline: bool, key_fn) -> RolloutPayload:
+        from sheeprl_tpu.utils.metric import SumMetric
+        from sheeprl_tpu.utils.timer import timer
+
+        payload = RolloutPayload(iter_num)
+        step_start = self.policy_step
+        with timer("Time/env_interaction_time", SumMetric, sync_on_compute=False):
+            self._carry, data, events, next_values = self._rollout(
+                self.params, self._carry, key_fn(), self._env_base
+            )
+        self._n_rollouts += 1
+        self.policy_step += self.rollout_steps * self.total_envs
+        self._apply_events(events, step_start)
+        payload.data = data
+        payload.next_obs = {k: self._carry["vstate"]["obs"][k] for k in self.obs_keys}
+        payload.extras["next_values"] = next_values
+        payload.policy_step_end = self.policy_step
+        return payload

@@ -1,0 +1,214 @@
+"""Device-resident environment core: the ``JaxEnv`` protocol + the
+vectorized auto-reset machinery (ROADMAP item 2).
+
+Every bench round since PR 3 measured the same wall: the host env step.
+``SyncVectorEnv`` bounds the decoupled ratio, the overlap pipeline has
+nothing to overlap *with* (0.67-0.81x on 1-core hosts), and the N-player
+fan-in stays Python-bound.  A :class:`JaxEnv` removes the wall instead of
+hiding it: dynamics are pure jax functions over pytree state, so
+thousands of parallel envs ride ONE ``vmap``, auto-reset folds into the
+step via ``lax.select`` (no host round trip at episode boundaries), and
+the whole policy-step + env-step + buffer-append loop compiles into a
+single XLA program (``envs/jax/collect.py``).
+
+Design rules (every env family must hold them):
+
+- ``reset``/``step`` are PURE: state in, state out, all pytrees of
+  fixed-shape arrays — jit/vmap/scan-safe by construction;
+- ALL randomness flows through explicit PRNG keys.  Domain randomization
+  is therefore just an extra key axis: an env that draws its layout /
+  physics params at ``reset`` sweeps a *distribution* of scenarios under
+  one ``vmap`` over reset keys, one compiled program;
+- episode-boundary bookkeeping (auto-reset, time-limit truncation,
+  episode return/length) lives HERE, not in the env families — one
+  implementation, shared semantics, matching the gymnasium SAME_STEP
+  autoreset mode the host path uses (``utils/env.py``).
+
+Key discipline (pinned by the autoreset-parity golden test): every key
+consumed by env ``i`` derives from the run ``base`` key as
+
+- initial reset:      ``fold_in(fold_in(fold_in(base, 0), i), 0)``
+- step ``t`` (global): ``split(fold_in(fold_in(fold_in(base, 1), t), i))``
+  -> ``(k_step, k_reset)`` — ``k_reset`` seeds the auto-reset episode.
+
+The host-side :class:`~sheeprl_tpu.envs.jax.gym_adapter.JaxToGymEnv`
+mirrors the same chains, so a ``JaxVectorEnv`` rollout and a gymnasium
+``SyncVectorEnv`` over the adapter produce bit-identical trajectories —
+the parity test that keeps the two stacks honest.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+# fold_in tags separating the initial-reset chain from the step chain
+RESET_TAG = 0
+STEP_TAG = 1
+
+
+class JaxEnv:
+    """Protocol/base class for device-resident environments.
+
+    Subclasses implement single-env (unbatched) semantics; batching is
+    the caller's ``vmap``.  ``info`` dicts must have a FIXED key set and
+    fixed-shape array values (scan/vmap requirement); return ``{}`` when
+    there is nothing to report.
+    """
+
+    #: gymnasium spaces describing ONE env (host-side metadata only —
+    #: never consumed inside jit)
+    observation_space: Any = None
+    action_space: Any = None
+    #: steps after which an episode truncates (None = never); consumed by
+    #: the vector wrapper, NOT by the env's own ``step``
+    max_episode_steps: Optional[int] = None
+    #: hashable config tuple set by subclasses — envs are passed as STATIC
+    #: jit arguments (``gym_adapter``), so two instances with the same
+    #: config must share one compiled executable instead of recompiling
+    #: per vector slot
+    _conf: Tuple = ()
+
+    def __hash__(self) -> int:
+        return hash((type(self), self._conf))
+
+    def __eq__(self, other: Any) -> bool:
+        return type(other) is type(self) and other._conf == self._conf
+
+    def reset(self, key: jax.Array) -> Tuple[Any, Dict[str, jax.Array]]:
+        """``key -> (state, obs)``; draws initial state (and any
+        domain-randomized params) from ``key``."""
+        raise NotImplementedError
+
+    def step(
+        self, state: Any, action: jax.Array, key: jax.Array
+    ) -> Tuple[Any, Dict[str, jax.Array], jax.Array, jax.Array, Dict[str, jax.Array]]:
+        """``(state, action, key) -> (state, obs, reward, terminated, info)``.
+
+        ``terminated`` is the MDP-terminal signal only; time-limit
+        truncation is the vector wrapper's job (the env never sees it).
+        """
+        raise NotImplementedError
+
+
+def tree_select(pred: jax.Array, on_true: Any, on_false: Any) -> Any:
+    """Per-env ``jnp.where`` over matching pytrees.
+
+    ``pred`` is a ``(N,)`` bool vector; leaves are ``(N, ...)`` — the
+    predicate broadcasts over each leaf's trailing dims.  This is the
+    auto-reset fold: done envs take the freshly-reset leaf, live envs
+    keep the stepped one, no host involvement.
+    """
+
+    def _sel(a, b):
+        shaped = pred.reshape(pred.shape + (1,) * (a.ndim - pred.ndim))
+        return jnp.where(shaped, a, b)
+
+    return jax.tree_util.tree_map(_sel, on_true, on_false)
+
+
+def initial_reset_key(base: jax.Array, env_index) -> jax.Array:
+    """Reset key of env ``env_index``'s FIRST episode (see key discipline
+    in the module docstring)."""
+    return jax.random.fold_in(jax.random.fold_in(jax.random.fold_in(base, RESET_TAG), env_index), 0)
+
+
+def step_keys(base: jax.Array, gstep, env_index) -> Tuple[jax.Array, jax.Array]:
+    """``(k_step, k_reset)`` for env ``env_index`` at global step
+    ``gstep``: ``k_step`` drives the dynamics, ``k_reset`` seeds the
+    auto-reset episode if this step ends one."""
+    k = jax.random.fold_in(jax.random.fold_in(jax.random.fold_in(base, STEP_TAG), gstep), env_index)
+    ks = jax.random.split(k)
+    return ks[0], ks[1]
+
+
+def vector_reset(env: JaxEnv, base: jax.Array, num_envs: int) -> Dict[str, Any]:
+    """Reset ``num_envs`` parallel envs; returns the vector state pytree.
+
+    The vector state carries, besides the batched env state and current
+    obs, the per-env episode accounting (steps since reset, running
+    return/length) and the GLOBAL step counter feeding the key chain.
+    """
+    keys = jax.vmap(lambda i: initial_reset_key(base, i))(jnp.arange(num_envs))
+    state, obs = jax.vmap(env.reset)(keys)
+    zf = jnp.zeros((num_envs,), jnp.float32)
+    zi = jnp.zeros((num_envs,), jnp.int32)
+    return {
+        "env": state,
+        "obs": obs,
+        "t": zi,  # per-env steps since reset (time-limit clock)
+        "ep_return": zf,
+        "ep_length": zi,
+        "gstep": jnp.zeros((), jnp.int32),  # global step (key chain)
+    }
+
+
+def vector_step(
+    env: JaxEnv,
+    vstate: Dict[str, Any],
+    actions: jax.Array,
+    base: jax.Array,
+    max_episode_steps: Optional[int] = None,
+) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """One auto-resetting step of every parallel env (SAME_STEP semantics).
+
+    Returns ``(new_vstate, out)`` where ``out`` is a dict of batched
+    arrays::
+
+        obs        post-autoreset observation (what the policy acts on
+                   next; reset obs where the episode ended — exactly the
+                   gymnasium SAME_STEP contract)
+        reward, terminated, truncated, done
+        final_obs  the PRE-reset terminal observation (valid where done;
+                   the truncation bootstrap and final_obs info use it)
+        ep_return / ep_length
+                   the episode totals INCLUDING this step (valid where
+                   done — the RecordEpisodeStatistics ``r``/``l`` fields)
+
+    Everything is fixed-shape; "valid where done" fields are dense with a
+    mask, never ragged — the scan/telemetry consumers slice them.
+    """
+    num_envs = vstate["t"].shape[0]
+    idx = jnp.arange(num_envs)
+    k_step, k_reset = jax.vmap(lambda i: step_keys(base, vstate["gstep"], i))(idx)
+
+    new_env, obs, reward, terminated, _info = jax.vmap(env.step)(vstate["env"], actions, k_step)
+    reward = reward.astype(jnp.float32).reshape(num_envs)
+    terminated = terminated.reshape(num_envs).astype(bool)
+
+    t = vstate["t"] + 1
+    limit = max_episode_steps if max_episode_steps is not None else env.max_episode_steps
+    if limit:
+        truncated = (t >= jnp.int32(limit)) & ~terminated
+    else:
+        truncated = jnp.zeros_like(terminated)
+    done = terminated | truncated
+
+    reset_env, reset_obs = jax.vmap(env.reset)(k_reset)
+    next_env = tree_select(done, reset_env, new_env)
+    next_obs = tree_select(done, reset_obs, obs)
+
+    ep_return = vstate["ep_return"] + reward
+    ep_length = vstate["ep_length"] + 1
+
+    out = {
+        "obs": next_obs,
+        "reward": reward,
+        "terminated": terminated,
+        "truncated": truncated,
+        "done": done,
+        "final_obs": obs,
+        "ep_return": ep_return,
+        "ep_length": ep_length,
+    }
+    new_vstate = {
+        "env": next_env,
+        "obs": next_obs,
+        "t": jnp.where(done, 0, t),
+        "ep_return": jnp.where(done, 0.0, ep_return),
+        "ep_length": jnp.where(done, 0, ep_length),
+        "gstep": vstate["gstep"] + 1,
+    }
+    return new_vstate, out

@@ -1,0 +1,119 @@
+"""Procedurally-generated gridworld/maze as a pure-JAX env.
+
+The layout IS the random draw: every ``reset(key)`` samples a fresh wall
+pattern, start cell and goal cell from the key, so domain randomization
+costs nothing beyond the key axis — ``vmap`` over 4096 reset keys steps
+4096 DIFFERENT mazes in one XLA program, and a curriculum/PBT sweep is
+just a different key schedule (ROADMAP items 2 and 5).
+
+Everything is fixed-shape jit-safe machinery:
+
+- walls: ``(size, size)`` bernoulli(density) bool grid; the start and
+  goal cells are force-cleared after sampling;
+- start/goal cells: categorical draws over the FREE-cell mask (masked
+  logits — no rejection loops);
+- movement: 4 discrete actions; hitting a wall or the border is a no-op
+  step (the agent stays put);
+- observation (``"state"``): the egocentric ``view x view`` wall window
+  (dynamic_slice over a wall-padded grid) ++ normalized position ++
+  normalized goal offset — a flat f32 vector, MLP-encoder ready.
+
+Reward: ``+1`` on reaching the goal (terminates), small per-step cost
+otherwise; episodes truncate at ``max_episode_steps`` (random layouts
+are not guaranteed solvable — truncation, not reachability analysis, is
+the contract, exactly like procgen-style task distributions).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.envs.jax.core import JaxEnv
+
+# action index -> (drow, dcol)
+_MOVES = np.array([[-1, 0], [1, 0], [0, -1], [0, 1]], np.int32)
+
+
+class GridWorldJax(JaxEnv):
+    """Procedural maze: layout drawn from the reset key.
+
+    State pytree: ``{"walls": (S, S) bool, "pos": (2,) i32, "goal": (2,) i32}``.
+    """
+
+    def __init__(
+        self,
+        size: int = 9,
+        view: int = 5,
+        wall_density: float = 0.25,
+        step_cost: float = 0.01,
+        max_episode_steps: int = 128,
+    ):
+        if view % 2 != 1:
+            raise ValueError(f"view must be odd, got {view}")
+        self.size = int(size)
+        self.view = int(view)
+        self.wall_density = float(wall_density)
+        self.step_cost = float(step_cost)
+        self.max_episode_steps = int(max_episode_steps)
+        self._conf = (self.size, self.view, self.wall_density, self.step_cost, self.max_episode_steps)
+        obs_dim = self.view * self.view + 4
+        self.observation_space = gym.spaces.Dict(
+            {"state": gym.spaces.Box(-np.inf, np.inf, shape=(obs_dim,), dtype=np.float32)}
+        )
+        self.action_space = gym.spaces.Discrete(4)
+
+    # ------------------------------------------------------------- helpers
+    def _sample_free_cell(self, key: jax.Array, walls: jax.Array, exclude: jax.Array = None) -> jax.Array:
+        """Random cell index (2,) over free (non-wall) cells; ``exclude``
+        optionally removes one cell (the start, when drawing the goal)."""
+        free = ~walls.reshape(-1)
+        if exclude is not None:
+            flat_ex = exclude[0] * self.size + exclude[1]
+            free = free & (jnp.arange(self.size * self.size) != flat_ex)
+        # masked categorical: every free cell equally likely, no loops.
+        # degenerate draws (all walls) cannot happen: reset clears start/goal
+        logits = jnp.where(free, 0.0, -jnp.inf)
+        flat = jax.random.categorical(key, logits)
+        return jnp.stack([flat // self.size, flat % self.size]).astype(jnp.int32)
+
+    def _obs(self, state) -> Dict[str, jax.Array]:
+        pad = self.view // 2
+        # border reads as wall: pad the grid with True then slice the
+        # egocentric window around pos (dynamic_slice is jit/vmap native)
+        padded = jnp.pad(state["walls"], pad, constant_values=True)
+        window = jax.lax.dynamic_slice(
+            padded.astype(jnp.float32), (state["pos"][0], state["pos"][1]), (self.view, self.view)
+        )
+        denom = jnp.float32(max(self.size - 1, 1))
+        pos = state["pos"].astype(jnp.float32) / denom
+        offset = (state["goal"] - state["pos"]).astype(jnp.float32) / denom
+        return {"state": jnp.concatenate([window.reshape(-1), pos, offset]).astype(jnp.float32)}
+
+    # ------------------------------------------------------------- protocol
+    def reset(self, key: jax.Array):
+        k_walls, k_start, k_goal = jax.random.split(key, 3)
+        walls = jax.random.bernoulli(k_walls, self.wall_density, (self.size, self.size))
+        start = self._sample_free_cell(k_start, walls)
+        goal = self._sample_free_cell(k_goal, walls, exclude=start)
+        # force-clear both cells (the masked draws already avoid walls, but
+        # an all-wall row/grid degenerate draw must still land on a free cell)
+        walls = walls.at[start[0], start[1]].set(False)
+        walls = walls.at[goal[0], goal[1]].set(False)
+        state = {"walls": walls, "pos": start, "goal": goal}
+        return state, self._obs(state)
+
+    def step(self, state, action, key):
+        del key  # deterministic dynamics; the LAYOUT is the random axis
+        delta = jnp.asarray(_MOVES)[action.astype(jnp.int32)]
+        proposed = jnp.clip(state["pos"] + delta, 0, self.size - 1)
+        blocked = state["walls"][proposed[0], proposed[1]]
+        pos = jnp.where(blocked, state["pos"], proposed)
+        reached = jnp.all(pos == state["goal"])
+        reward = jnp.where(reached, 1.0, -self.step_cost).astype(jnp.float32)
+        new_state = {"walls": state["walls"], "pos": pos, "goal": state["goal"]}
+        return new_state, self._obs(new_state), reward, reached, {}

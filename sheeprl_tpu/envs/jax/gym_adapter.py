@@ -1,0 +1,129 @@
+"""Host-side gymnasium adapter over a :class:`JaxEnv`.
+
+Two jobs:
+
+1. ``env_backend=host`` compatibility: the jax env families are ordinary
+   gym envs through this adapter, so ``make_env``'s wrapper chain, the
+   test-episode rollout, video capture and the Sync/Async vector envs all
+   work unchanged (``configs/env/jax_*.yaml`` point their ``wrapper``
+   target at :func:`make_gym_env`);
+2. the autoreset-parity oracle: the adapter consumes EXACTLY the key
+   chains of ``core.py`` (initial-reset / per-step / auto-reset keys), so
+   a gymnasium ``SyncVectorEnv`` over pinned adapters and a
+   ``JaxVectorEnv`` produce bit-identical trajectories — the golden test
+   that keeps the device-resident fast path semantically honest.
+
+The single-env step/reset functions are jitted with the env as a STATIC
+argument; :class:`JaxEnv` instances hash by (type, config), so N adapter
+instances over the same env family share ONE compiled executable instead
+of recompiling per vector slot.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, Optional
+
+import gymnasium as gym
+import jax
+import numpy as np
+
+from sheeprl_tpu.envs.jax.core import RESET_TAG, JaxEnv, initial_reset_key, step_keys
+
+
+@partial(jax.jit, static_argnums=0)
+def _jit_reset(env: JaxEnv, key):
+    return env.reset(key)
+
+
+@partial(jax.jit, static_argnums=0)
+def _jit_step(env: JaxEnv, state, action, key):
+    return env.step(state, action, key)
+
+
+class JaxToGymEnv(gym.Env):
+    """One :class:`JaxEnv` behind the standard ``gym.Env`` API.
+
+    ``seed`` / ``env_index`` pin the adapter to the shared key
+    discipline: ``base = PRNGKey(seed)``; with ``pin_keys=True`` the
+    chain additionally ignores ``reset(seed=...)`` overrides so a
+    lockstep gymnasium vector run replays the exact ``JaxVectorEnv``
+    trajectory (the parity test's configuration).  The default
+    (``pin_keys=False``) honors ``reset(seed=...)`` like any gym env —
+    what ``make_env``'s per-env seeding expects.
+    """
+
+    metadata = {"render_modes": ["rgb_array"], "render_fps": 30}
+    render_mode = "rgb_array"
+
+    def __init__(self, env: JaxEnv, seed: int = 0, env_index: int = 0, pin_keys: bool = False):
+        self.jax_env = env
+        self.observation_space = env.observation_space
+        self.action_space = env.action_space
+        self._env_index = int(env_index)
+        self._pin_keys = bool(pin_keys)
+        self._base = jax.random.PRNGKey(int(seed))
+        self._gstep = 0  # global step ordinal (the vector env's gstep)
+        self._reset_count = 0
+        self._t = 0  # steps since reset (time-limit clock)
+        self._state = None
+        self._pending_reset_key = None  # autoreset key stashed at done
+
+    # ------------------------------------------------------------------ api
+    def reset(self, seed: Optional[int] = None, options: Optional[dict] = None):
+        super().reset(seed=seed)
+        if seed is not None and not self._pin_keys:
+            self._base = jax.random.PRNGKey(int(seed))
+            self._reset_count = 0
+            self._pending_reset_key = None
+        if self._pending_reset_key is not None:
+            # gymnasium's SAME_STEP machinery resetting us right after the
+            # terminal step: consume the SAME k_reset the fused/vector path
+            # derives from that step's key — episodes line up bit-exactly
+            key = self._pending_reset_key
+            self._pending_reset_key = None
+        elif self._reset_count == 0:
+            key = initial_reset_key(self._base, self._env_index)
+        else:
+            key = jax.random.fold_in(
+                jax.random.fold_in(jax.random.fold_in(self._base, RESET_TAG), self._env_index),
+                self._reset_count,
+            )
+        self._reset_count += 1
+        self._t = 0
+        self._state, obs = _jit_reset(self.jax_env, key)
+        return {k: np.asarray(v) for k, v in obs.items()}, {}
+
+    def step(self, action):
+        k_step, k_reset = step_keys(self._base, self._gstep, self._env_index)
+        self._gstep += 1
+        act = np.asarray(action)
+        self._state, obs, reward, terminated, _info = _jit_step(self.jax_env, self._state, act, k_step)
+        self._t += 1
+        terminated = bool(terminated)
+        limit = self.jax_env.max_episode_steps
+        truncated = bool(limit) and self._t >= int(limit) and not terminated
+        if terminated or truncated:
+            self._pending_reset_key = k_reset
+        return (
+            {k: np.asarray(v) for k, v in obs.items()},
+            float(reward),
+            terminated,
+            truncated,
+            {},
+        )
+
+    def render(self):
+        return np.zeros((64, 64, 3), dtype=np.uint8)
+
+    def close(self):
+        pass
+
+
+def make_gym_env(id: str, seed: int = 0, **kwargs: Any) -> gym.Env:
+    """``env.wrapper`` factory for the jax env families on the HOST path
+    (``configs/env/jax_*.yaml``): resolves ``id`` through the jax env
+    registry and wraps it for gymnasium."""
+    from sheeprl_tpu.envs.jax import make_jax_env
+
+    return JaxToGymEnv(make_jax_env(id, **kwargs), seed=seed)

@@ -1,0 +1,126 @@
+"""``JaxVectorEnv`` — device-resident envs behind the gymnasium vector API.
+
+The drop-in tier of ROADMAP item 2: every existing loop (PPO/A2C/SAC/
+recurrent, decoupled players, the serve workers) steps a vector env
+through ``reset``/``step`` and reads SAME_STEP autoreset infos
+(``final_obs`` / ``final_info`` with episode statistics).  This class
+reproduces that exact contract while the N envs live on the accelerator:
+
+- one jitted program per ``step`` call steps ALL envs (vmap) with
+  auto-reset folded in (``core.vector_step``) — no per-env Python loop,
+  no episode-boundary host round trip;
+- outputs come back as numpy (this adapter IS the host boundary; the
+  fused collector in ``collect.py`` is the zero-round-trip tier);
+- info structure mirrors gymnasium's SAME_STEP vector envs wrapped in
+  ``RecordEpisodeStatistics`` — pinned by the autoreset-parity golden
+  test against a real gymnasium ``SyncVectorEnv`` over the
+  ``JaxToGymEnv`` adapter.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+import gymnasium as gym
+import jax
+import numpy as np
+
+from sheeprl_tpu.envs.jax.core import JaxEnv, vector_reset, vector_step
+
+
+class JaxVectorEnv(gym.vector.VectorEnv):
+    """Vectorized auto-resetting view of one :class:`JaxEnv` family.
+
+    All ``num_envs`` instances share the dynamics family; per-env variety
+    (procedural layouts, randomized physics) comes from each env's reset
+    key — domain randomization as a key axis.
+    """
+
+    metadata = {"autoreset_mode": gym.vector.AutoresetMode.SAME_STEP}
+
+    def __init__(
+        self,
+        env: JaxEnv,
+        num_envs: int,
+        seed: int = 0,
+        max_episode_steps: Optional[int] = None,
+    ):
+        self.env = env
+        self.num_envs = int(num_envs)
+        self._seed = int(seed)
+        self._max_steps = max_episode_steps if max_episode_steps is not None else env.max_episode_steps
+        self.single_observation_space = env.observation_space
+        self.single_action_space = env.action_space
+        self.observation_space = gym.vector.utils.batch_space(env.observation_space, self.num_envs)
+        self.action_space = gym.vector.utils.batch_space(env.action_space, self.num_envs)
+        self._discrete = isinstance(env.action_space, gym.spaces.Discrete)
+        # one trace each; fixed shapes, so the compile counter stays flat
+        self._jreset = jax.jit(lambda base: vector_reset(env, base, self.num_envs))
+        self._jstep = jax.jit(
+            lambda vstate, actions, base: vector_step(env, vstate, actions, base, self._max_steps)
+        )
+        self._vstate = None
+        self._episode_start_ts = 0.0
+
+    # ------------------------------------------------------------------ api
+    def reset(self, *, seed: Optional[int] = None, options: Optional[dict] = None):
+        if seed is not None:
+            self._seed = int(seed)
+        self._base = jax.random.PRNGKey(self._seed)
+        self._vstate = self._jreset(self._base)
+        # seeded spaces: envs.action_space.sample() (SAC warmup) is
+        # deterministic given the run seed, like the host path's per-env
+        # space seeding in make_env
+        self.action_space.seed(self._seed)
+        self.single_action_space.seed(self._seed)
+        self._episode_start_ts = time.perf_counter()
+        obs = {k: np.asarray(v) for k, v in self._vstate["obs"].items()}
+        return obs, {}
+
+    def step(self, actions):
+        if self._vstate is None:
+            raise RuntimeError("JaxVectorEnv.step called before reset()")
+        acts = np.asarray(actions)
+        if self._discrete:
+            acts = acts.reshape(self.num_envs).astype(np.int32)
+        else:
+            acts = acts.reshape(self.num_envs, *self.single_action_space.shape).astype(np.float32)
+        self._vstate, out = self._jstep(self._vstate, acts, self._base)
+
+        obs = {k: np.asarray(v) for k, v in out["obs"].items()}
+        reward = np.asarray(out["reward"], dtype=np.float64).reshape(self.num_envs)
+        terminated = np.asarray(out["terminated"]).reshape(self.num_envs)
+        truncated = np.asarray(out["truncated"]).reshape(self.num_envs)
+        done = terminated | truncated
+
+        infos: Dict[str, Any] = {}
+        if done.any():
+            final_obs_np = {k: np.asarray(v) for k, v in out["final_obs"].items()}
+            final_obs = np.full(self.num_envs, None, dtype=object)
+            for i in np.nonzero(done)[0]:
+                final_obs[i] = {k: v[i] for k, v in final_obs_np.items()}
+            ep_r = np.where(done, np.asarray(out["ep_return"], dtype=np.float64), 0.0)
+            ep_l = np.where(done, np.asarray(out["ep_length"]), 0)
+            ep_t = np.where(done, round(time.perf_counter() - self._episode_start_ts, 6), 0.0)
+            infos["final_obs"] = final_obs
+            infos["_final_obs"] = done.copy()
+            infos["final_info"] = {
+                "episode": {
+                    "r": ep_r,
+                    "_r": done.copy(),
+                    "l": ep_l,
+                    "_l": done.copy(),
+                    "t": ep_t,
+                    "_t": done.copy(),
+                },
+                "_episode": done.copy(),
+            }
+            infos["_final_info"] = done.copy()
+        return obs, reward, terminated, truncated, infos
+
+    def close_extras(self, **kwargs):
+        self._vstate = None
+
+    def __repr__(self) -> str:
+        return f"JaxVectorEnv({type(self.env).__name__}, num_envs={self.num_envs})"

@@ -92,6 +92,11 @@ class Observability:
         # "serve" section (p50/p95 latency, queue depth, batch-size
         # histogram, breaker state, dedupe/audit counters)
         self.serve_stats: Optional[Any] = None
+        # zero-arg provider of device-resident env stats; the fused
+        # collectors (envs/jax/collect.py) attach here so the records
+        # carry a "jaxenv" section (backend, env family, env-step and
+        # episode-event counters) when algo.env_backend=jax
+        self.jaxenv_stats: Optional[Any] = None
         if not self.enabled:
             return
         self._world_size = max(1, int(world_size))
@@ -149,6 +154,11 @@ class Observability:
         if self.serve_stats is not None:
             try:
                 extra = {**(extra or {}), "serve": self.serve_stats()}
+            except Exception:
+                pass
+        if self.jaxenv_stats is not None:
+            try:
+                extra = {**(extra or {}), "jaxenv": self.jaxenv_stats()}
             except Exception:
                 pass
         record = make_record(

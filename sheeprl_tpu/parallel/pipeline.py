@@ -74,13 +74,28 @@ def resolve_overlap_setting(cfg) -> bool:
     thread needs a host core of its own, and on a single-core host the
     overlap degenerates to time-slicing plus handoff overhead (measured
     0.67-0.81x in BENCH_r05) — those hosts stay on the bit-exact serial
-    path."""
+    path.
+
+    With ``algo.env_backend=jax`` the overlap resolves to OFF regardless:
+    the fused collect IS the device program — there is no host env work
+    left to overlap, and the pipeline thread would only add handoff
+    latency.  A one-line notice is emitted when the setting would
+    otherwise have enabled it."""
     import os
+    import sys
 
     val = cfg.algo.get("overlap_collect", False)
-    if isinstance(val, str) and val.strip().lower() == "auto":
-        return (os.cpu_count() or 1) > 1
-    return bool(val)
+    is_auto = isinstance(val, str) and val.strip().lower() == "auto"
+    resolved = (os.cpu_count() or 1) > 1 if is_auto else bool(val)
+    if str(cfg.algo.get("env_backend", "host") or "host").lower() == "jax":
+        if resolved:
+            print(
+                "overlap_collect resolved to off: env_backend=jax runs the fused "
+                "device collect — no host env stepping left to overlap.",
+                file=sys.stderr,
+            )
+        return False
+    return resolved
 
 
 class KeyStream:

@@ -248,3 +248,114 @@ def make_vector_env(
     if cfg.env.sync_env:
         return gym.vector.SyncVectorEnv(thunks, autoreset_mode=mode)
     return gym.vector.AsyncVectorEnv(thunks, context="spawn", autoreset_mode=mode)
+
+
+# --------------------------------------------------------------------------- #
+# env backend dispatch (ROADMAP item 2: device-resident jax envs)
+# --------------------------------------------------------------------------- #
+_ENV_BACKENDS = ("host", "jax")
+
+
+def resolve_env_backend(cfg: Dict[str, Any]) -> str:
+    """``algo.env_backend`` (``host`` | ``jax``), validated.
+
+    ``jax`` additionally requires (clear config errors, not silent no-ops):
+
+    - a registered jax env family (``sheeprl_tpu.envs.jax``) behind
+      ``env.id`` — arbitrary host gym envs cannot run inside jit;
+    - ``env.restart_on_crash`` OFF: the ``EnvStepGuard`` rebuild-on-crash
+      machinery wraps host ``env.step`` calls that no longer exist — a
+      device-resident env either computes or the whole program faults,
+      so arming the guard would be a silent no-op;
+    - the ``env_step_raise`` fault site unarmed, for the same reason (the
+      site lives inside ``EnvStepGuard``; arming it against a fused
+      collect would never fire and void the chaos test it belongs to).
+    """
+    backend = str(cfg.algo.get("env_backend", "host") or "host").lower()
+    if backend not in _ENV_BACKENDS:
+        raise ValueError(f"algo.env_backend must be one of {_ENV_BACKENDS}, got '{backend}'")
+    if backend == "jax":
+        from sheeprl_tpu.envs.jax import is_jax_env_id
+
+        if not is_jax_env_id(cfg.env.id):
+            from sheeprl_tpu.envs.jax import JAX_ENV_REGISTRY
+
+            raise ValueError(
+                f"algo.env_backend=jax requires a registered jax env family, got env.id="
+                f"'{cfg.env.id}'; available: {', '.join(sorted(JAX_ENV_REGISTRY))} "
+                "(use env=jax_cartpole / jax_pendulum / jax_gridworld, or env_backend=host)"
+            )
+        if cfg.env.get("restart_on_crash", False):
+            raise ValueError(
+                "env.restart_on_crash=true is incompatible with algo.env_backend=jax: "
+                "device-resident envs have no host env.step for EnvStepGuard to guard — "
+                "the restart machinery would be silently armed as a no-op. Set "
+                "env.restart_on_crash=false (the jax_* env configs' default) or use "
+                "env_backend=host."
+            )
+        from sheeprl_tpu.resilience.faults import ENV_VAR
+
+        spec = ",".join(
+            s for s in (os.environ.get(ENV_VAR, ""), str(cfg.get("faults") or "")) if s
+        )
+        if "env_step_raise" in spec:
+            raise ValueError(
+                "the env_step_raise fault site is armed but algo.env_backend=jax has no "
+                "host env step to raise from — the fault would silently never fire. "
+                "Disarm it or use env_backend=host."
+            )
+    return backend
+
+
+def make_jax_env_from_cfg(cfg: Dict[str, Any]):
+    """Construct the raw :class:`JaxEnv` the env config describes.
+
+    The ``env.wrapper`` node is the single source of truth for family
+    kwargs on BOTH backends: the host path instantiates its ``_target_``
+    (the :func:`~sheeprl_tpu.envs.jax.gym_adapter.make_gym_env` adapter),
+    the device path strips the adapter-only keys and feeds the rest to
+    the registry constructor.
+    """
+    from sheeprl_tpu.envs.jax import make_jax_env
+
+    wrapper = dict(cfg.env.wrapper)
+    kwargs = {k: v for k, v in wrapper.items() if k not in ("_target_", "id", "seed", "rank")}
+    return make_jax_env(str(cfg.env.id), **kwargs)
+
+
+def make_train_envs(
+    cfg: Dict[str, Any],
+    runtime,
+    log_dir: Optional[str],
+    prefix: str = "train",
+) -> gym.vector.VectorEnv:
+    """The training vector env, dispatched on ``algo.env_backend``.
+
+    ``host`` builds exactly the Sync/Async gymnasium stack the loops
+    always built (bit-exact with the pre-dispatch inline construction);
+    ``jax`` returns a :class:`~sheeprl_tpu.envs.jax.vector.JaxVectorEnv`
+    stepping all envs on device behind the same gymnasium-style API.
+    """
+    total_envs = cfg.env.num_envs * runtime.world_size
+    if resolve_env_backend(cfg) == "jax":
+        from sheeprl_tpu.envs.jax import JaxVectorEnv
+
+        max_steps = cfg.env.max_episode_steps if cfg.env.get("max_episode_steps") else None
+        return JaxVectorEnv(
+            make_jax_env_from_cfg(cfg), total_envs, seed=cfg.seed, max_episode_steps=max_steps
+        )
+    thunks = [
+        make_env(
+            cfg,
+            cfg.seed + i,
+            0,
+            log_dir if runtime.is_global_zero else None,
+            prefix,
+            vector_env_idx=i,
+        )
+        for i in range(total_envs)
+    ]
+    mode = gym.vector.AutoresetMode.SAME_STEP
+    if cfg.env.sync_env:
+        return gym.vector.SyncVectorEnv(thunks, autoreset_mode=mode)
+    return gym.vector.AsyncVectorEnv(thunks, context="spawn", autoreset_mode=mode)

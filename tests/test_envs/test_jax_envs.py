@@ -1,0 +1,173 @@
+"""Unit tests for the device-resident env families (sheeprl_tpu/envs/jax/).
+
+Protocol conformance, determinism, auto-reset bookkeeping and the
+domain-randomization-as-key-axis contract. Everything here is tiny and
+jit-once — tier-1 unit scale."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.envs.jax import (
+    CartPoleJax,
+    GridWorldJax,
+    PendulumJax,
+    make_jax_env,
+    vector_reset,
+    vector_step,
+)
+
+FAMILIES = ["jax_cartpole", "jax_pendulum", "jax_gridworld"]
+
+
+def _zero_actions(env, n):
+    if hasattr(env.action_space, "n"):
+        return jnp.zeros((n,), jnp.int32)
+    return jnp.zeros((n, *env.action_space.shape), jnp.float32)
+
+
+@pytest.mark.parametrize("env_id", FAMILIES)
+def test_protocol_shapes_and_dtypes(env_id):
+    env = make_jax_env(env_id)
+    key = jax.random.PRNGKey(0)
+    state, obs = env.reset(key)
+    (obs_key,) = env.observation_space.spaces.keys()
+    assert obs_key == "state"
+    assert obs["state"].shape == env.observation_space["state"].shape
+    assert obs["state"].dtype == jnp.float32
+    act = _zero_actions(env, 1)[0]
+    state2, obs2, reward, terminated, info = env.step(state, act, jax.random.PRNGKey(1))
+    assert obs2["state"].shape == obs["state"].shape
+    assert reward.dtype == jnp.float32
+    assert terminated.dtype == bool and terminated.shape == ()
+    # state is a fixed-structure pytree: jit/scan carry requirement
+    assert jax.tree_util.tree_structure(state) == jax.tree_util.tree_structure(state2)
+
+
+@pytest.mark.parametrize("env_id", FAMILIES)
+def test_reset_deterministic_per_key(env_id):
+    env = make_jax_env(env_id)
+    s1, o1 = env.reset(jax.random.PRNGKey(3))
+    s2, o2 = env.reset(jax.random.PRNGKey(3))
+    for a, b in zip(jax.tree_util.tree_leaves((s1, o1)), jax.tree_util.tree_leaves((s2, o2))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    _, o3 = env.reset(jax.random.PRNGKey(4))
+    assert not np.array_equal(np.asarray(o1["state"]), np.asarray(o3["state"]))
+
+
+def test_cartpole_terminates_out_of_bounds():
+    env = CartPoleJax()
+    state, _ = env.reset(jax.random.PRNGKey(0))
+    # push the cart hard right until |x| > threshold
+    terminated = False
+    for _ in range(300):
+        state, _, _, term, _ = env.step(state, jnp.int32(1), jax.random.PRNGKey(0))
+        if bool(term):
+            terminated = True
+            break
+    assert terminated
+
+
+def test_pendulum_never_terminates_and_truncates():
+    env = PendulumJax(max_episode_steps=7)
+    base = jax.random.PRNGKey(1)
+    vs = vector_reset(env, base, 2)
+    acts = jnp.zeros((2, 1), jnp.float32)
+    for t in range(7):
+        vs, out = vector_step(env, vs, acts, base)
+        assert not np.asarray(out["terminated"]).any()
+    assert np.asarray(out["truncated"]).all()
+    assert np.asarray(out["done"]).all()
+    # auto-reset folded in: counters cleared, episode stats reported
+    assert np.asarray(vs["t"]).tolist() == [0, 0]
+    assert np.asarray(out["ep_length"]).tolist() == [7, 7]
+
+
+def test_gridworld_layout_is_drawn_from_key():
+    env = GridWorldJax(size=7)
+    s1, _ = env.reset(jax.random.PRNGKey(0))
+    s2, _ = env.reset(jax.random.PRNGKey(1))
+    s3, _ = env.reset(jax.random.PRNGKey(0))
+    assert not np.array_equal(np.asarray(s1["walls"]), np.asarray(s2["walls"]))
+    np.testing.assert_array_equal(np.asarray(s1["walls"]), np.asarray(s3["walls"]))
+    # start/goal always free and distinct
+    for s in (s1, s2):
+        walls = np.asarray(s["walls"])
+        pos, goal = np.asarray(s["pos"]), np.asarray(s["goal"])
+        assert not walls[pos[0], pos[1]]
+        assert not walls[goal[0], goal[1]]
+        assert not np.array_equal(pos, goal)
+
+
+def test_gridworld_goal_terminates_with_reward():
+    env = GridWorldJax(size=5, wall_density=0.0)
+    state, _ = env.reset(jax.random.PRNGKey(2))
+    # walk a manhattan path to the goal: rows then cols
+    for _ in range(12):
+        pos, goal = np.asarray(state["pos"]), np.asarray(state["goal"])
+        if pos[0] < goal[0]:
+            a = 1
+        elif pos[0] > goal[0]:
+            a = 0
+        elif pos[1] < goal[1]:
+            a = 3
+        else:
+            a = 2
+        state, _, reward, term, _ = env.step(state, jnp.int32(a), jax.random.PRNGKey(0))
+        if bool(term):
+            assert float(reward) == pytest.approx(1.0)
+            return
+    pytest.fail("goal never reached on an empty 5x5 grid")
+
+
+def test_gridworld_walls_block_movement():
+    env = GridWorldJax(size=5, wall_density=0.0)
+    state, _ = env.reset(jax.random.PRNGKey(2))
+    walls = jnp.zeros((5, 5), bool).at[0, 1].set(True)
+    state = {"walls": walls, "pos": jnp.array([0, 0], jnp.int32), "goal": jnp.array([4, 4], jnp.int32)}
+    # right into the wall: stays; up off the grid: stays
+    s2, _, _, _, _ = env.step(state, jnp.int32(3), jax.random.PRNGKey(0))
+    np.testing.assert_array_equal(np.asarray(s2["pos"]), [0, 0])
+    s3, _, _, _, _ = env.step(state, jnp.int32(0), jax.random.PRNGKey(0))
+    np.testing.assert_array_equal(np.asarray(s3["pos"]), [0, 0])
+
+
+@pytest.mark.parametrize("cls", [CartPoleJax, PendulumJax])
+def test_domain_randomization_is_a_key_axis(cls):
+    env = cls(randomize=True)
+    s1, _ = env.reset(jax.random.PRNGKey(0))
+    s2, _ = env.reset(jax.random.PRNGKey(1))
+    assert not np.array_equal(np.asarray(s1["params"]), np.asarray(s2["params"]))
+    # one vmap over keys = a parameter sweep, one compiled program
+    keys = jax.random.split(jax.random.PRNGKey(7), 8)
+    states, _ = jax.vmap(env.reset)(keys)
+    assert len(np.unique(np.asarray(states["params"])[:, 0])) > 1
+    # the deterministic variant pins params to exactly 1.0
+    det, _ = cls(randomize=False).reset(jax.random.PRNGKey(0))
+    np.testing.assert_array_equal(np.asarray(det["params"]), 1.0)
+
+
+def test_vector_step_autoreset_matches_reset_obs():
+    """The post-done obs is EXACTLY the reset obs of the step's k_reset —
+    the lax.select fold, not a stale or stepped obs."""
+    from sheeprl_tpu.envs.jax.core import step_keys
+
+    env = PendulumJax(max_episode_steps=3)
+    base = jax.random.PRNGKey(5)
+    vs = vector_reset(env, base, 2)
+    acts = jnp.zeros((2, 1), jnp.float32)
+    for _ in range(3):
+        gstep_before = int(vs["gstep"])
+        vs, out = vector_step(env, vs, acts, base)
+    assert np.asarray(out["done"]).all()
+    for i in range(2):
+        _, k_reset = step_keys(base, gstep_before, i)
+        _, expected = env.reset(k_reset)
+        np.testing.assert_array_equal(
+            np.asarray(out["obs"]["state"][i]), np.asarray(expected["state"])
+        )
+        # final_obs keeps the pre-reset terminal observation
+        assert not np.array_equal(
+            np.asarray(out["final_obs"]["state"][i]), np.asarray(out["obs"]["state"][i])
+        )
