@@ -112,6 +112,7 @@ SECTIONS = [
     ("dec", 300),
     ("fanin", 140),
     ("transport", 120),
+    ("mesh", 560),
 ]
 
 
@@ -495,6 +496,49 @@ def bench_transport():
     }
 
 
+def bench_mesh():
+    """Sharded-train ladder (ISSUE 12): PPO + compact DV3 update step at
+    1/2/4/8 host-platform mesh devices, DP and FSDP legs.  Runs in a
+    dedicated subprocess because the virtual mesh needs
+    ``xla_force_host_platform_device_count`` set BEFORE backend init,
+    which this child cannot guarantee for itself.  On a 1-core container
+    the ladder is a strong-scaling OVERHEAD measurement (ideal normalized
+    step time ~1.0 at every size — see the bench module docstring); the
+    headline is the 8-device DP PPO step so the perf-regression gate
+    holds the partitioning-overhead line across rounds."""
+    import subprocess
+    import tempfile
+
+    out = os.path.join(tempfile.mkdtemp(prefix="sheeprl_bench_mesh_"), "mesh.json")
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["JAX_PLATFORMS"] = "cpu"
+    steps = os.environ.get("BENCH_MESH_STEPS", "4")
+    subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmarks", "bench_sharded_train.py"),
+         "--steps", steps, "--out", out],
+        check=True,
+        env=env,
+        timeout=540,
+    )
+    with open(out) as f:
+        data = json.load(f)
+    legs = data["legs"]
+    by = {(r["algo"], r["strategy"], r["devices"]): r for r in legs}
+    head = by[("ppo", "dp", 8)]
+    return {
+        "metric": "mesh_ppo_dp8_step_ms",
+        "value": head["step_ms"],
+        "unit": "ms",
+        "vs_baseline": None,
+        "ppo_dp8_vs_ideal": head["achieved_vs_ideal"],
+        "dv3_dp8_vs_ideal": by[("dv3", "dp", 8)]["achieved_vs_ideal"],
+        "dv3_fsdp8_step_ms": by[("dv3", "fsdp", 8)]["step_ms"],
+        "legs": legs,
+        "host_cpu_count": os.cpu_count(),
+    }
+
+
 def bench_loop():
     """Replay-feed cost per gradient step at DV3-S shapes: host buffer
     sample + upload (what every gradient step paid before round 4's
@@ -807,6 +851,8 @@ def child_main(section, out_path):
         "a2c": bench_a2c,
         "dec": bench_dec,
         "fanin": bench_fanin,
+        "transport": bench_transport,
+        "mesh": bench_mesh,
     }[section]()
     with open(out_path, "w") as f:
         json.dump(metric, f)

@@ -1,0 +1,257 @@
+"""Sharded-train ladder: PPO + compact DreamerV3 update step time at
+1/2/4/8 mesh devices, DP and FSDP legs, on the virtual host-platform mesh.
+
+All "devices" share ONE physical core, so wall-clock cannot improve with
+mesh size; with the global batch fixed (strong scaling) the IDEAL sharded
+program keeps normalized step time at ~1.0 at every mesh size — the
+ladder measures the partitioning/collective overhead of the 2-D
+("data", "fsdp") mesh path (parallel/sharding.py), which is exactly the
+term that would also tax a real pod.  ``achieved_vs_ideal`` is
+t(1 device) / t(N devices) with ideal 1.0 on this box (N on a real pod).
+
+One leg per algo additionally records the ``Compiled.cost_analysis()``
+collective-bytes estimate (the telemetry ``mesh`` key's opt-in field) —
+the cross-device traffic the compiled update would move per dispatch.
+
+Writes benchmarks/results/sharded_train_r12.json; wired as bench.py's
+``mesh`` section under the PR-6 perf gate.
+
+Usage: python benchmarks/bench_sharded_train.py [--steps N] [--out PATH]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+if "xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
+    os.environ["XLA_FLAGS"] += " --xla_force_host_platform_device_count=8"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+
+LADDER = (1, 2, 4, 8)
+FSDP_LADDER = (2, 8)  # fsdp == dp at 1 device; 2/8 bracket the overhead
+
+
+def _time_step(step, carry, n_warm=2, n_steps=6):
+    for _ in range(n_warm):
+        carry = step(carry)
+        jax.block_until_ready(carry)
+    tic = time.perf_counter()
+    for _ in range(n_steps):
+        carry = step(carry)
+    jax.block_until_ready(carry)
+    return (time.perf_counter() - tic) / n_steps
+
+
+def bench_ppo(devices: int, strategy: str, steps: int, want_cost: bool = False):
+    """Full PPO update on a `devices`-wide mesh (shard_map DDP core under
+    dp, GSPMD + layout constraints under fsdp); global rollout fixed at
+    T=64 x 32 envs."""
+    import gymnasium as gym
+
+    from sheeprl_tpu.algos.ppo.agent import build_agent
+    from sheeprl_tpu.algos.ppo.ppo import build_ppo_optimizer, make_update_fn
+    from sheeprl_tpu.config import compose
+    from sheeprl_tpu.parallel.mesh import MeshRuntime
+    from sheeprl_tpu.parallel.sharding import collective_bytes_estimate
+
+    cfg = compose(
+        overrides=[
+            "exp=ppo",
+            "env=dummy",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.cnn_keys.encoder=[]",
+            "env.num_envs=32",
+            "algo.rollout_steps=64",
+            "algo.per_rank_batch_size=128",
+            "algo.update_epochs=2",
+        ]
+    )
+    runtime = MeshRuntime(devices=devices, strategy=strategy, accelerator="cpu").launch()
+    runtime.seed_everything(0)
+    obs_space = gym.spaces.Dict({"state": gym.spaces.Box(-1, 1, (64,), np.float32)})
+    module, params = build_agent(runtime, (4,), False, cfg, obs_space)
+    params = runtime.replicate(params)
+    tx = build_ppo_optimizer(cfg.algo.optimizer, cfg.algo.max_grad_norm, runtime.precision)
+    opt_state = runtime.replicate(tx.init(params))
+    update_fn = make_update_fn(runtime, module, tx, cfg, ["state"])
+
+    T, E = 64, 32
+    rng = np.random.default_rng(0)
+    data = {
+        "state": jnp.asarray(rng.normal(size=(T, E, 64)).astype(np.float32)),
+        "values": jnp.asarray(rng.normal(size=(T, E, 1)).astype(np.float32)),
+        "rewards": jnp.asarray(rng.normal(size=(T, E, 1)).astype(np.float32)),
+        "dones": jnp.zeros((T, E, 1), jnp.float32),
+        "logprobs": jnp.asarray(rng.normal(size=(T, E, 1)).astype(np.float32)),
+        "actions": jnp.asarray(rng.integers(0, 4, size=(T, E, 1)).astype(np.float32)),
+    }
+    data = runtime.shard_batch(data, axis=1)
+    next_obs = runtime.shard_batch(
+        {"state": jnp.asarray(rng.normal(size=(E, 64)).astype(np.float32))}, axis=0
+    )
+    args = (params, opt_state, data, next_obs, runtime.next_key(),
+            jnp.float32(0.2), jnp.float32(0.0), jnp.float32(3e-4))
+    cost = None
+    if want_cost and update_fn._jitted is not None:
+        cost = collective_bytes_estimate(update_fn._jitted.lower(*args).compile())
+
+    def step(carry):
+        params, opt_state = carry
+        params, opt_state, _ = update_fn(
+            params, opt_state, data, next_obs, runtime.next_key(),
+            jnp.float32(0.2), jnp.float32(0.0), jnp.float32(3e-4),
+        )
+        return params, opt_state
+
+    dt = _time_step(step, (params, opt_state), n_steps=steps)
+    return dt, T * E, cost
+
+
+def bench_dv3(devices: int, strategy: str, steps: int, want_cost: bool = False):
+    """Compact DreamerV3 train step (wm + imagination + actor + critic) on
+    a `devices`-wide mesh; global batch fixed at B=16 x T=8 pixels."""
+    import gymnasium as gym
+
+    from sheeprl_tpu.algos.dreamer_v3.agent import build_agent
+    from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import _make_optimizer, make_train_fn
+    from sheeprl_tpu.algos.dreamer_v3.utils import init_moments
+    from sheeprl_tpu.config import compose
+    from sheeprl_tpu.parallel.mesh import MeshRuntime
+    from sheeprl_tpu.parallel.sharding import collective_bytes_estimate
+
+    cfg = compose(
+        overrides=[
+            "exp=dreamer_v3",
+            "env=dummy",
+            "env.num_envs=1",
+            "algo.cnn_keys.encoder=[rgb]",
+            "algo.mlp_keys.encoder=[]",
+            "algo.per_rank_batch_size=16",
+            "algo.per_rank_sequence_length=8",
+            "algo.horizon=4",
+            "algo.world_model.recurrent_model.recurrent_state_size=128",
+            "algo.world_model.representation_model.hidden_size=128",
+            "algo.world_model.transition_model.hidden_size=128",
+            "algo.world_model.encoder.cnn_channels_multiplier=4",
+            "algo.dense_units=128",
+            "algo.mlp_layers=1",
+        ]
+    )
+    runtime = MeshRuntime(devices=devices, strategy=strategy, accelerator="cpu").launch()
+    runtime.seed_everything(0)
+    obs_space = gym.spaces.Dict({"rgb": gym.spaces.Box(0, 255, (64, 64, 3), np.uint8)})
+    world_model, actor, critic, params = build_agent(runtime, (6,), True, cfg, obs_space)
+    params = runtime.replicate(params)
+    wm_tx = _make_optimizer(cfg.algo.world_model.optimizer, cfg.algo.world_model.clip_gradients)
+    actor_tx = _make_optimizer(cfg.algo.actor.optimizer, cfg.algo.actor.clip_gradients)
+    critic_tx = _make_optimizer(cfg.algo.critic.optimizer, cfg.algo.critic.clip_gradients)
+    opt_states = runtime.replicate(
+        {
+            "world_model": wm_tx.init(params["world_model"]),
+            "actor": actor_tx.init(params["actor"]),
+            "critic": critic_tx.init(params["critic"]),
+        }
+    )
+    moments = runtime.replicate(init_moments())
+    train_fn = make_train_fn(
+        runtime, world_model, actor, critic, (wm_tx, actor_tx, critic_tx), cfg, True, (6,)
+    )
+    T, B = 8, 16
+    rng = np.random.default_rng(0)
+    data = {
+        "rgb": jnp.asarray(rng.integers(0, 255, size=(T, B, 64, 64, 3), dtype=np.uint8)),
+        "actions": jnp.asarray(rng.normal(size=(T, B, 6)).astype(np.float32)),
+        "rewards": jnp.asarray(rng.normal(size=(T, B, 1)).astype(np.float32)),
+        "terminated": jnp.zeros((T, B, 1), jnp.float32),
+        "truncated": jnp.zeros((T, B, 1), jnp.float32),
+        "is_first": jnp.zeros((T, B, 1), jnp.float32),
+    }
+    data = runtime.shard_batch(data, axis=1)
+    cost = None
+    if want_cost and train_fn._jitted is not None:
+        cost = collective_bytes_estimate(
+            train_fn._jitted.lower(params, opt_states, moments, data, runtime.next_key()).compile()
+        )
+
+    def step(carry):
+        params, opt_states, moments = carry
+        params, opt_states, moments, _ = train_fn(
+            params, opt_states, moments, data, runtime.next_key()
+        )
+        return params, opt_states, moments
+
+    dt = _time_step(step, (params, opt_states, moments), n_steps=steps)
+    return dt, T * B, cost
+
+
+def run_ladder(steps: int):
+    rows = []
+    base = {}
+    for algo, fn in (("ppo", bench_ppo), ("dv3", bench_dv3)):
+        legs = [("dp", d) for d in LADDER] + [("fsdp", d) for d in FSDP_LADDER]
+        for strategy, d in legs:
+            want_cost = strategy == "dp" and d == 8
+            dt, frames, cost = fn(d, strategy, steps, want_cost=want_cost)
+            key = (algo, strategy, d)
+            if strategy == "dp" and d == 1:
+                base[algo] = dt
+            row = {
+                "algo": algo,
+                "strategy": strategy,
+                "devices": d,
+                "step_ms": round(dt * 1e3, 2),
+                "frames_per_s": round(frames / dt, 1),
+                # strong scaling on a shared core: ideal == 1.0 (see module
+                # docstring); on a real pod ideal == devices
+                "achieved_vs_ideal": round(base[algo] / dt, 3) if algo in base else None,
+            }
+            if cost is not None:
+                row["collective_bytes_estimate"] = cost
+            rows.append(row)
+            print(json.dumps(row))
+    return rows
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=4)
+    ap.add_argument(
+        "--out",
+        default=os.path.join(os.path.dirname(__file__), "results", "sharded_train_r12.json"),
+    )
+    args = ap.parse_args()
+    if len(jax.devices()) < max(LADDER):
+        raise RuntimeError(
+            f"need {max(LADDER)} host devices; set "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count={max(LADDER)}"
+        )
+    rows = run_ladder(args.steps)
+    out = {
+        "metric": "sharded_train_ladder",
+        "legs": rows,
+        "host_cpu_count": os.cpu_count(),
+        "note": (
+            "virtual host-platform mesh on a shared core: normalized strong-"
+            "scaling ladder (ideal achieved_vs_ideal == 1.0 here, == N on a pod); "
+            "fsdp legs run the GSPMD+layout-constraint ZeRO program"
+        ),
+    }
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({"metric": "sharded_train_written", "out": args.out}))
+
+
+if __name__ == "__main__":
+    main()

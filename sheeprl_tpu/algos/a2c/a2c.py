@@ -121,17 +121,22 @@ def make_update_fn(runtime, module, tx, cfg: Dict[str, Any], obs_keys: Sequence[
         if runtime.ddp_gate(data["rewards"].shape[1], "A2C"):
             # rank-local DDP core: the epoch-shuffle gather cannot stay
             # sharded under GSPMD (it would replicate the whole update on
-            # every device — see ppo.py's _update_shard_map)
+            # every device — see ppo.py's _update_shard_map).  Specs and
+            # the gradient pmean cover BOTH mesh axes (parallel/sharding):
+            # every device is a batch shard regardless of the (d, f) split,
+            # and the reduction lowers to explicit jax.lax collectives.
             from jax.sharding import PartitionSpec as SMP
 
-            data_specs = jax.tree_util.tree_map(lambda _: SMP(None, "data"), data)
-            obs_specs = jax.tree_util.tree_map(lambda _: SMP("data"), next_obs)
+            from sheeprl_tpu.parallel.sharding import BATCH_AXES
+
+            data_specs = jax.tree_util.tree_map(lambda _: SMP(None, BATCH_AXES), data)
+            obs_specs = jax.tree_util.tree_map(lambda _: SMP(BATCH_AXES), next_obs)
 
             def body(params, opt_state, data, next_obs, key):
-                rank_key = jax.random.fold_in(key, jax.lax.axis_index("data"))
+                rank_key = jax.random.fold_in(key, runtime.layout.flat_rank())
                 return _core(
                     params, opt_state, data, next_obs, rank_key,
-                    mb_size // world_size, "data",
+                    mb_size // world_size, BATCH_AXES,
                 )
 
             return shard_map(

@@ -53,11 +53,16 @@ def make_train_fn(
     num_critics = int(cfg.algo.critic.n)
     actor_tx, critic_tx, alpha_tx = txs
 
-    def train(params, opt_states, critic_data, actor_data, key):
+    def _core(params, opt_states, critic_data, actor_data, key, dp_axes):
         """``prioritized`` consumes ``critic_data["is_weights"]`` and
         returns per-minibatch |TD| for the priority updates (the actor
         batch stays unweighted — see loss.critic_loss_weighted); the
-        False path traces exactly the pre-PER computation."""
+        False path traces exactly the pre-PER computation.  ``dp_axes``
+        is the shard_map DDP core: batch rows are device-local and every
+        component gradient carries an explicit ``pmean`` (see sac.py)."""
+        if dp_axes is not None:
+            # per-shard noise stream (dropout masks, action sampling)
+            key = jax.random.fold_in(key, runtime.layout.flat_rank())
         alpha = jnp.exp(params["log_alpha"])
 
         # ---------------- G critic minibatches (Algorithm 2, lines 5-9)
@@ -94,6 +99,10 @@ def make_train_fn(
 
                 qf_loss, grads = jax.value_and_grad(qf_loss_fn)(cparams)
                 td_abs = None
+            if dp_axes is not None:
+                # explicit DDP gradient all-reduce (NCCL-equivalent psum)
+                grads = jax.lax.pmean(grads, dp_axes)
+                qf_loss = jax.lax.pmean(qf_loss, dp_axes)
             updates, copt = critic_tx.update(grads, copt, cparams)
             cparams = optax.apply_updates(cparams, updates)
             ctarget = optax.incremental_update(cparams, ctarget, tau)  # EMA per step
@@ -115,12 +124,18 @@ def make_train_fn(
             return policy_loss(alpha, logp, q.mean(-1, keepdims=True)), logp
 
         (actor_loss, logp), actor_grads = jax.value_and_grad(actor_loss_fn, has_aux=True)(params["actor"])
+        if dp_axes is not None:
+            actor_grads = jax.lax.pmean(actor_grads, dp_axes)
+            actor_loss = jax.lax.pmean(actor_loss, dp_axes)
         updates, new_actor_opt = actor_tx.update(actor_grads, opt_states["actor"], params["actor"])
         new_actor = optax.apply_updates(params["actor"], updates)
 
         alpha_loss, alpha_grad = jax.value_and_grad(lambda la: entropy_loss(la, logp, target_entropy))(
             params["log_alpha"]
         )
+        if dp_axes is not None:
+            alpha_grad = jax.lax.pmean(alpha_grad, dp_axes)
+            alpha_loss = jax.lax.pmean(alpha_loss, dp_axes)
         updates, new_alpha_opt = alpha_tx.update(alpha_grad, opt_states["alpha"], params["log_alpha"])
         new_log_alpha = optax.apply_updates(params["log_alpha"], updates)
 
@@ -143,6 +158,30 @@ def make_train_fn(
             # (G, B) |TD| rides back for update_priorities — stays on device
             return new_params, new_opts, metrics, td_abs
         return new_params, new_opts, metrics
+
+    def train(params, opt_states, critic_data, actor_data, key):
+        if runtime.ddp_gate(critic_data["rewards"].shape[1], "DroQ"):
+            # explicit DDP core over the flattened batch axes (see sac.py)
+            from jax.sharding import PartitionSpec as SMP
+
+            from sheeprl_tpu.parallel.sharding import BATCH_AXES
+            from sheeprl_tpu.utils.jax_compat import shard_map
+
+            critic_specs = jax.tree_util.tree_map(lambda _: SMP(None, BATCH_AXES), critic_data)
+            actor_specs = jax.tree_util.tree_map(lambda _: SMP(BATCH_AXES), actor_data)
+            td_spec = (SMP(None, BATCH_AXES),) if prioritized else ()
+
+            def body(params, opt_states, critic_data, actor_data, key):
+                return _core(params, opt_states, critic_data, actor_data, key, BATCH_AXES)
+
+            return shard_map(
+                body,
+                mesh=runtime.mesh,
+                in_specs=(SMP(), SMP(), critic_specs, actor_specs, SMP()),
+                out_specs=(SMP(), SMP(), SMP()) + td_spec,
+                check_vma=False,
+            )(params, opt_states, critic_data, actor_data, key)
+        return _core(params, opt_states, critic_data, actor_data, key, None)
 
     # training health sentinel hook (resilience/sentinel.py)
     return guard_update(runtime, train, cfg, n_state=2, donate_argnums=(0, 1))

@@ -205,16 +205,20 @@ def make_update_fn(
         global minibatch, which coincides when world_size == 1)."""
         from jax.sharding import PartitionSpec as SMP
 
+        from sheeprl_tpu.parallel.sharding import BATCH_AXES
+
         per_rank_mb = mb_size // world_size
-        data_specs = jax.tree_util.tree_map(lambda _: SMP(None, "data"), data)
-        obs_specs = jax.tree_util.tree_map(lambda _: SMP("data"), next_obs)
+        data_specs = jax.tree_util.tree_map(lambda _: SMP(None, BATCH_AXES), data)
+        obs_specs = jax.tree_util.tree_map(lambda _: SMP(BATCH_AXES), next_obs)
 
         def body(params, opt_state, data, next_obs, key, clip_coef, ent_coef):
-            rank = jax.lax.axis_index("data")
+            # flattened (data, fsdp) shard index: the specs above split the
+            # batch over BOTH mesh axes, so rank-local logic follows suit
+            rank = runtime.layout.flat_rank()
             flat, n_local = _gae_and_flatten(params, data, next_obs)
             if share_data:
                 flat = jax.tree_util.tree_map(
-                    lambda x: jax.lax.all_gather(x, "data", axis=0, tiled=True), flat
+                    lambda x: jax.lax.all_gather(x, BATCH_AXES, axis=0, tiled=True), flat
                 )
                 n_rows = n_local * world_size
                 num_minibatches = max(1, -(-n_rows // mb_size))
@@ -244,9 +248,9 @@ def make_update_fn(
                 params, opt_state = carry
                 grads, losses = grad_fn(params, mb)
                 # DDP gradient all-reduce (+ averaged losses for logging)
-                grads = jax.lax.pmean(grads, "data")
+                grads = jax.lax.pmean(grads, BATCH_AXES)
                 losses = jnp.concatenate(
-                    [jax.lax.pmean(losses, "data"), optax.global_norm(grads)[None]]
+                    [jax.lax.pmean(losses, BATCH_AXES), optax.global_norm(grads)[None]]
                 )
                 updates, opt_state = tx.update(grads, opt_state, params)
                 params = optax.apply_updates(params, updates)

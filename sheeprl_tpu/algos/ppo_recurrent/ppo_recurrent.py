@@ -182,18 +182,20 @@ def make_update_fn(runtime, module, tx, cfg: Dict[str, Any], obs_keys: Sequence[
             # definition) with a pmean per minibatch step
             from jax.sharding import PartitionSpec as SMP
 
-            data_specs = jax.tree_util.tree_map(lambda _: SMP(None, "data"), data)
+            from sheeprl_tpu.parallel.sharding import BATCH_AXES
+
+            data_specs = jax.tree_util.tree_map(lambda _: SMP(None, BATCH_AXES), data)
 
             def body(params, opt_state, data, next_values, key, clip_coef, ent_coef):
-                rank_key = jax.random.fold_in(key, jax.lax.axis_index("data"))
+                rank_key = jax.random.fold_in(key, runtime.layout.flat_rank())
                 return _core(
-                    params, opt_state, data, next_values, rank_key, clip_coef, ent_coef, "data"
+                    params, opt_state, data, next_values, rank_key, clip_coef, ent_coef, BATCH_AXES
                 )
 
             return shard_map(
                 body,
                 mesh=runtime.mesh,
-                in_specs=(SMP(), SMP(), data_specs, SMP("data"), SMP(), SMP(), SMP()),
+                in_specs=(SMP(), SMP(), data_specs, SMP(BATCH_AXES), SMP(), SMP(), SMP()),
                 out_specs=(SMP(), SMP(), SMP()),
                 check_vma=False,
             )(params, opt_state, data, next_values, key, clip_coef, ent_coef)

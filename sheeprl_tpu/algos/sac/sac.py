@@ -70,7 +70,7 @@ def make_train_fn(
     num_critics = int(cfg.algo.critic.n)
     actor_tx, critic_tx, alpha_tx = txs
 
-    def train(params, opt_states, data, key, do_ema):
+    def _core(params, opt_states, data, key, do_ema, dp_axes):
         """params: {actor, critic, target_critic, log_alpha};
         data: (G, B, ...) pytree; one scan step per gradient step;
         do_ema: (G,) bool — per-step target soft-update flags (the reference
@@ -78,11 +78,22 @@ def make_train_fn(
         step's originating-iteration schedule through the scan).
         ``prioritized`` additionally consumes ``data["is_weights"]`` and
         returns the per-step |TD| for the priority updates — the False
-        path traces exactly the pre-PER computation."""
+        path traces exactly the pre-PER computation.
+
+        ``dp_axes`` (the shard_map DDP core): each device runs this on its
+        own batch rows with an explicit gradient ``pmean`` after every
+        component's grad — per-shard means of equal-sized shards compose
+        to the exact global-batch mean, so the decomposition is the
+        single-device computation, now lowered to ``jax.lax`` collectives
+        instead of whatever GSPMD propagation resolves."""
 
         def one_step(carry, inp):
             params, opt_states = carry
             batch, k, do_ema_step = inp
+            if dp_axes is not None:
+                # per-shard noise stream: identical keys would sample the
+                # SAME action noise pattern on every batch shard
+                k = jax.random.fold_in(k, runtime.layout.flat_rank())
             k1, k2 = jax.random.split(k)
             alpha = jnp.exp(params["log_alpha"])
 
@@ -117,6 +128,10 @@ def make_train_fn(
 
                 qf_loss, qf_grads = jax.value_and_grad(qf_loss_fn)(params["critic"])
                 td_abs = None
+            if dp_axes is not None:
+                # explicit DDP gradient all-reduce (NCCL-equivalent psum)
+                qf_grads = jax.lax.pmean(qf_grads, dp_axes)
+                qf_loss = jax.lax.pmean(qf_loss, dp_axes)
             updates, new_critic_opt = critic_tx.update(qf_grads, opt_states["critic"], params["critic"])
             new_critic = optax.apply_updates(params["critic"], updates)
 
@@ -136,6 +151,9 @@ def make_train_fn(
             (actor_loss, logp), actor_grads = jax.value_and_grad(actor_loss_fn, has_aux=True)(
                 params["actor"]
             )
+            if dp_axes is not None:
+                actor_grads = jax.lax.pmean(actor_grads, dp_axes)
+                actor_loss = jax.lax.pmean(actor_loss, dp_axes)
             updates, new_actor_opt = actor_tx.update(actor_grads, opt_states["actor"], params["actor"])
             new_actor = optax.apply_updates(params["actor"], updates)
 
@@ -145,6 +163,9 @@ def make_train_fn(
                 return entropy_loss(la, logp, target_entropy)
 
             alpha_loss, alpha_grad = jax.value_and_grad(alpha_loss_fn)(params["log_alpha"])
+            if dp_axes is not None:
+                alpha_grad = jax.lax.pmean(alpha_grad, dp_axes)
+                alpha_loss = jax.lax.pmean(alpha_loss, dp_axes)
             updates, new_alpha_opt = alpha_tx.update(alpha_grad, opt_states["alpha"], params["log_alpha"])
             new_log_alpha = optax.apply_updates(params["log_alpha"], updates)
 
@@ -179,6 +200,33 @@ def make_train_fn(
             # (G, B) |TD| rides back for update_priorities — stays on device
             return params, opt_states, metrics, td_abs
         return params, opt_states, metrics
+
+    def train(params, opt_states, data, key, do_ema):
+        if runtime.ddp_gate(data["rewards"].shape[1], "SAC"):
+            # explicit DDP core (shard_map over the flattened batch axes):
+            # each device scans its own batch rows and the per-component
+            # grad pmeans ARE the gradient all-reduce — the collectives
+            # appear verbatim in the lowered program instead of hinging on
+            # GSPMD propagation of the sampled batch's layout
+            from jax.sharding import PartitionSpec as SMP
+
+            from sheeprl_tpu.parallel.sharding import BATCH_AXES
+            from sheeprl_tpu.utils.jax_compat import shard_map
+
+            data_specs = jax.tree_util.tree_map(lambda _: SMP(None, BATCH_AXES), data)
+            td_spec = (SMP(None, BATCH_AXES),) if prioritized else ()
+
+            def body(params, opt_states, data, key, do_ema):
+                return _core(params, opt_states, data, key, do_ema, BATCH_AXES)
+
+            return shard_map(
+                body,
+                mesh=runtime.mesh,
+                in_specs=(SMP(), SMP(), data_specs, SMP(), SMP()),
+                out_specs=(SMP(), SMP(), SMP()) + td_spec,
+                check_vma=False,
+            )(params, opt_states, data, key, do_ema)
+        return _core(params, opt_states, data, key, do_ema, None)
 
     # training health sentinel hook (resilience/sentinel.py)
     return guard_update(runtime, train, cfg, n_state=2, donate_argnums=(0, 1))

@@ -26,9 +26,13 @@ Gating: ``buffer.device_cache`` (True / False / "auto"; env override
 meshes when the estimated footprint fits ``buffer.device_cache_budget_gb``
 (default 6.0) — exactly the remote-link regime where it pays.  Multi-host
 data parallelism keeps the host path (each process feeds its own shard).
-Single-process multi-device meshes can opt in (``device_cache=True``) to
-:class:`ShardedDeviceReplayCache` — env-sharded rings with per-device
-sampling inside a ``shard_map`` — for sequence replay.
+Single-process multi-device meshes route to
+:class:`ShardedDeviceReplayCache` — env-sharded rings over the mesh batch
+axes — when opted in (``device_cache=True``) or whenever
+``buffer.prioritized`` needs the device sampler: uniform draws stay
+device-local (stratified), prioritized ones run per-shard sum-trees with
+one psum'd total-mass reduction per draw (howto/sharding.md), for both
+the sequence and flat-transition buffer families.
 """
 
 from __future__ import annotations
@@ -332,10 +336,66 @@ def maybe_create_for_transitions(cfg, runtime, rb, state=None):
     cache = DeviceReplayCache.maybe_create(
         cfg, runtime, capacity=rb.buffer_size, n_envs=rb.n_envs
     )
+    if cache is None:
+        # multi-device: the env-sharded cache keeps transitions (and the
+        # PER sum-trees) on the mesh — uniform draws stay device-local,
+        # prioritized ones pay one psum'd mass reduction per draw
+        cache = _maybe_create_sharded(cfg, runtime, rb.buffer_size, rb.n_envs)
     if cache is not None and state is not None:
         cache.load_from_replay(rb)
         if cache.prioritized:
             cache.load_priority_state(state.get("replay_priority"))
+    return cache
+
+
+def _maybe_create_sharded(cfg, runtime, capacity: int, n_envs: int):
+    """Shared multi-device gating for both buffer families: the env-sharded
+    cache applies on single-process multi-device meshes when explicitly
+    opted in (``buffer.device_cache=True``) OR when ``buffer.prioritized``
+    requires the device sampler (the sum-trees live with the cache —
+    there is no host PER path to fall back to, so blockers are a hard
+    config error rather than a silent uniform downgrade)."""
+    mode = device_cache_setting(cfg)
+    prioritized = bool(cfg.buffer.get("prioritized", False))
+    if runtime.device_count <= 1:
+        return None
+    if mode == "off" or not (mode == "on" or prioritized):
+        return None
+    blockers = []
+    if jax.process_count() != 1:
+        blockers.append("multi-process run (each process feeds its own shard)")
+    if n_envs % runtime.device_count:
+        blockers.append(f"n_envs ({n_envs}) not divisible by {runtime.device_count} devices")
+    if blockers:
+        if prioritized:
+            # PER without the device sampler would silently train on a
+            # different (uniform) distribution — refuse loudly instead
+            raise ValueError(
+                "buffer.prioritized=True needs the env-sharded device cache on a "
+                "multi-device mesh, which this run cannot build: " + "; ".join(blockers)
+            )
+        print(
+            "DeviceReplayCache: buffer.device_cache=True ignored — "
+            + "; ".join(blockers)
+            + "; keeping the host feed path"
+        )
+        return None
+    cache = ShardedDeviceReplayCache(
+        capacity,
+        n_envs,
+        runtime,
+        prioritized=prioritized,
+        per_alpha=float(cfg.buffer.get("per_alpha", 0.6)),
+        per_eps=float(cfg.buffer.get("per_eps", 1e-6)),
+        per_decay=cfg.buffer.get("per_decay_on_sample", None),
+    )
+    print(
+        f"DeviceReplayCache: env-sharded replay window enabled "
+        f"(capacity {capacity} x {n_envs} envs over "
+        f"{runtime.device_count} devices"
+        + (", prioritized per-shard sum-trees" if prioritized else "")
+        + ")"
+    )
     return cache
 
 
@@ -353,37 +413,8 @@ def maybe_create_for(cfg, runtime, rb, state=None):
     cache = DeviceReplayCache.maybe_create(
         cfg, runtime, capacity=rb.buffer_size, n_envs=rb.n_envs
     )
-    if cache is None and device_cache_setting(cfg) == "on" and runtime.device_count > 1:
-        # opt-in env-sharded variant for single-process data-parallel meshes;
-        # explicit opt-in gets NO budget gate (matching maybe_create's mode=="on")
-        blockers = []
-        if jax.process_count() != 1:
-            blockers.append("multi-process run")
-        if runtime.mesh.shape.get("data") != runtime.device_count:
-            blockers.append("mesh devices not all on the 'data' axis")
-        if rb.n_envs % runtime.device_count:
-            blockers.append(
-                f"n_envs ({rb.n_envs}) not divisible by {runtime.device_count} devices"
-            )
-        if blockers:
-            print(
-                "DeviceReplayCache: buffer.device_cache=True ignored — "
-                + "; ".join(blockers)
-                + "; keeping the host feed path"
-            )
-        else:
-            if cfg.buffer.get("prioritized", False):
-                print(
-                    "DeviceReplayCache: buffer.prioritized=True ignored on the "
-                    "env-sharded cache (per-device sum-trees would need a "
-                    "cross-device mass reduction per draw); sampling stays uniform"
-                )
-            cache = ShardedDeviceReplayCache(rb.buffer_size, rb.n_envs, runtime)
-            print(
-                f"DeviceReplayCache: env-sharded replay window enabled "
-                f"(capacity {rb.buffer_size} x {rb.n_envs} envs over "
-                f"{runtime.device_count} devices)"
-            )
+    if cache is None:
+        cache = _maybe_create_sharded(cfg, runtime, rb.buffer_size, rb.n_envs)
     if cache is not None and state is not None:
         cache.load_from(rb)
         if cache.prioritized:
@@ -912,15 +943,18 @@ class DeviceReplayCache:
         prioritized = bool(cfg.buffer.get("prioritized", False))
         if mode == "off":
             if prioritized:
-                print(
-                    "DeviceReplayCache: buffer.prioritized=True ignored — "
-                    "buffer.device_cache=False disables the device sampler "
-                    "(the sum-tree lives with the cache); sampling stays uniform"
+                # the sum-tree lives with the cache — disabling the cache
+                # while asking for PER is a config contradiction, not a
+                # silent downgrade to uniform sampling
+                raise ValueError(
+                    "buffer.prioritized=True requires the device sampler, but "
+                    "buffer.device_cache=False disables it; drop one of the two "
+                    "(device_cache=auto enables the cache wherever PER needs it)"
                 )
             return None
         if runtime.device_count != 1 or jax.process_count() != 1:
-            # multi-device: sequence replay may still get the env-sharded
-            # variant — maybe_create_for handles (and reports) that case
+            # multi-device: both buffer families route to the env-sharded
+            # variant via _maybe_create_sharded (prioritized included)
             return None
         if mode == "auto" and runtime.device.platform == "cpu" and not prioritized:
             return None  # host-platform run: device_put is free, no win
@@ -946,39 +980,91 @@ class DeviceReplayCache:
 
 
 class ShardedDeviceReplayCache(DeviceReplayCache):
-    """Env-sharded cache for single-process data-parallel meshes.
+    """Env-sharded cache for single-process multi-device meshes.
 
     Each device holds the rings of ``n_envs / n_devices`` environments
-    (buffers sharded ``P(None, "data")`` over the env axis) and samples
-    its ``batch / n_devices`` rows from its OWN envs inside a
-    ``shard_map`` — appends and gathers stay device-local, and the
-    sampled batch comes out already sharded on the batch axis exactly as
-    ``runtime.batch_sharding(axis=1)`` lays it out for the train step.
+    (buffers sharded ``P(None, BATCH_AXES)`` over the env axis) and
+    uniform sampling draws each device's ``batch / n_devices`` rows from
+    its OWN envs inside a ``shard_map`` — appends and gathers stay
+    device-local, and the sampled batch comes out already sharded on the
+    batch axis exactly as ``runtime.batch_sharding(axis=1)`` lays it out
+    for the train step.
 
-    Sampling semantics vs the host path: env choice becomes STRATIFIED
-    (exactly batch/n_devices rows from each device's env subset) instead
-    of globally uniform — identical marginals, slightly lower variance.
-    Start-window validity per env is unchanged.  Opt-in only
-    (``buffer.device_cache=True`` on a multi-device mesh); "auto" stays
-    single-device, where the remote-link win actually lives.  Storage
-    and ring/append/refill logic are inherited — this class overrides
-    only the array-placement hooks and the sampler.
-    """
+    Uniform sampling semantics vs the host path: env choice becomes
+    STRATIFIED (exactly batch/n_devices rows from each device's env
+    subset) instead of globally uniform — identical marginals, slightly
+    lower variance.  Start-window validity per env is unchanged.
 
-    def __init__(self, capacity: int, n_envs: int, runtime, budget_bytes: Optional[int] = None):
+    **Prioritized** sampling is fully supported via per-shard sub-trees
+    (:class:`~sheeprl_tpu.replay.priority_tree.ShardedPriorityTree`):
+    each draw costs ONE psum'd total-mass reduction placing every shard's
+    mass interval in the global CDF, each shard descends its own sub-tree
+    for the draws it owns, and the batch is assembled with a masked psum
+    — so the sampled marginals are IDENTICAL to a single global sum-tree
+    (pinned by tests/test_parallel/test_sharding.py).  The assembled PER
+    batch is replicated (the psum is the price of exact global
+    proportionality); the train step's batch constraint re-slices it.
+
+    Storage and ring/append/refill logic are inherited — this class
+    overrides only the array-placement hooks, the tree flavor, and the
+    samplers."""
+
+    def __init__(
+        self,
+        capacity: int,
+        n_envs: int,
+        runtime,
+        budget_bytes: Optional[int] = None,
+        prioritized: bool = False,
+        per_alpha: float = 0.6,
+        per_eps: float = 1e-6,
+        per_decay: Optional[float] = None,
+    ):
         n_dev = runtime.device_count
-        if runtime.mesh.shape.get("data") != n_dev:
-            raise ValueError("sharded cache needs every mesh device on the 'data' axis")
         if n_envs % n_dev:
             raise ValueError(f"n_envs ({n_envs}) must divide over {n_dev} devices")
-        super().__init__(capacity, n_envs, device=None, budget_bytes=budget_bytes)
+        super().__init__(
+            capacity,
+            n_envs,
+            device=None,
+            budget_bytes=budget_bytes,
+            prioritized=prioritized,
+            per_alpha=per_alpha,
+            per_eps=per_eps,
+            per_decay=per_decay,
+        )
         self._runtime = runtime
         self._n_dev = n_dev
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        self._env_sharding = NamedSharding(runtime.mesh, P(None, "data"))
-        self._row_sharding = NamedSharding(runtime.mesh, P("data"))
+        from sheeprl_tpu.parallel.sharding import BATCH_AXES
+
+        self._axes = BATCH_AXES
+        self._fsdp_size = int(runtime.mesh.shape[BATCH_AXES[1]])
+        self._env_sharding = NamedSharding(runtime.mesh, P(None, BATCH_AXES))
+        self._row_sharding = NamedSharding(runtime.mesh, P(BATCH_AXES))
         self._sharded_sample_fns = {}
+
+    def _ensure_tree(self) -> None:
+        if self.prioritized and self._tree is None:
+            from sheeprl_tpu.replay.priority_tree import ShardedPriorityTree
+
+            self._tree = ShardedPriorityTree(
+                self.capacity,
+                self.n_envs,
+                self._n_dev,
+                self._runtime.mesh,
+                alpha=self.per_alpha,
+                eps=self.per_eps,
+            )
+
+    def _flat_rank(self):
+        """Flattened shard index inside a shard_map body (the env slice
+        this device owns — matches the P(None, BATCH_AXES) split order)."""
+        return (
+            jax.lax.axis_index(self._axes[0]) * self._fsdp_size
+            + jax.lax.axis_index(self._axes[1])
+        )
 
     # ---- placement hooks: same logic as the base, sharded arrays
     def _per_device_envs(self) -> int:
@@ -986,7 +1072,9 @@ class ShardedDeviceReplayCache(DeviceReplayCache):
         return self.n_envs // self._n_dev
 
     def _zeros(self, shape, dtype):
-        return jax.device_put(np.zeros(shape, dtype), self._env_sharding)
+        # device-native zeros: the rings are donated by _append, and a
+        # donated buffer must never zero-copy alias a host numpy temp
+        return jax.device_put(jnp.zeros(shape, dtype), self._env_sharding)
 
     def _put_host(self, host: np.ndarray) -> jax.Array:
         return jax.device_put(host, self._env_sharding)
@@ -1021,22 +1109,240 @@ class ShardedDeviceReplayCache(DeviceReplayCache):
         from jax.sharding import PartitionSpec as P
 
         mesh = self._runtime.mesh
+        axes = self._axes
         cap, n_envs, n_dev = self.capacity, self.n_envs, self._n_dev
 
         def body(bufs_l, key, pos_l, filled_l):
             # per-device independent stream; each device samples its own envs
-            k = jax.random.fold_in(key, jax.lax.axis_index("data"))
+            k = jax.random.fold_in(key, self._flat_rank())
             return _gather_windows(
                 bufs_l, k, pos_l, filled_l,
                 n_samples=n_samples, batch_size=batch_size // n_dev,
                 seq_len=seq_len, cap=cap, n_envs=n_envs // n_dev,
             )
 
-        buf_specs = {k: P(None, "data") for k in self._bufs}
-        out_specs = {k: P(None, None, "data") for k in self._bufs}
+        buf_specs = {k: P(None, axes) for k in self._bufs}
+        out_specs = {k: P(None, None, axes) for k in self._bufs}
         sharded = shard_map(
             body, mesh=mesh,
-            in_specs=(buf_specs, P(), P("data"), P("data")),
+            in_specs=(buf_specs, P(), P(axes), P(axes)),
             out_specs=out_specs,
+            check_vma=False,
+        )
+        return jax.jit(sharded)
+
+    # --------------------------------------------- sharded flat transitions
+    def sample_transitions(
+        self,
+        n_samples: int,
+        batch_size: int,
+        key,
+        sample_next_obs: bool = False,
+        obs_keys: Sequence[str] = (),
+    ) -> Dict[str, jax.Array]:
+        """Stratified uniform flat-transition draw: each device gathers
+        ``batch / n_devices`` rows from its own env columns (same
+        marginals as the global uniform draw; zero collectives)."""
+        if batch_size <= 0 or n_samples <= 0:
+            raise ValueError(
+                f"'batch_size' ({batch_size}) and 'n_samples' ({n_samples}) must be both greater than 0"
+            )
+        if batch_size % self._n_dev:
+            raise ValueError(f"batch_size ({batch_size}) must divide over {self._n_dev} devices")
+        need = 2 if sample_next_obs else 1
+        if not (self.active and self._bufs is not None and int(self._filled.min()) >= need):
+            raise ValueError("Not enough data in the device cache, add first")
+        nk = tuple(obs_keys) if sample_next_obs else ()
+        geom = ("transitions", int(n_samples), int(batch_size), nk, tuple(sorted(self._bufs)))
+        fn = self._sharded_sample_fns.get(geom)
+        if fn is None:
+            fn = self._build_sharded_sample_transitions(int(n_samples), int(batch_size), nk)
+            self._sharded_sample_fns[geom] = fn
+        return fn(self._bufs, jnp.asarray(key), jnp.asarray(self._pos), jnp.asarray(self._filled))
+
+    def _build_sharded_sample_transitions(self, n_samples, batch_size, next_keys):
+        from jax.sharding import PartitionSpec as P
+
+        mesh = self._runtime.mesh
+        axes = self._axes
+        cap, n_dev = self.capacity, self._n_dev
+        n_local = self.n_envs // n_dev
+        b_local = batch_size // n_dev
+
+        def body(bufs_l, key, pos_l, filled_l):
+            k = jax.random.fold_in(key, self._flat_rank())
+            flat = n_samples * b_local
+            k_env, k_row = jax.random.split(k)
+            envs = jax.random.randint(k_env, (flat,), 0, n_local)
+            base, count = _transition_window(pos_l, filled_l, cap=cap, next_keys=next_keys)
+            u = jax.random.uniform(k_row, (flat,))
+            offs = jnp.minimum((u * count).astype(jnp.int32), count - 1)
+            rows = (base + offs) % cap
+            return _gather_transitions(
+                bufs_l, rows, envs,
+                n_samples=n_samples, batch_size=b_local, cap=cap, next_keys=next_keys,
+            )
+
+        buf_specs = {k: P(None, axes) for k in self._bufs}
+        out_keys = list(self._bufs) + [f"next_{k}" for k in next_keys]
+        out_specs = {k: P(None, axes) for k in out_keys}
+        sharded = shard_map(
+            body, mesh=mesh,
+            in_specs=(buf_specs, P(), P(axes), P(axes)),
+            out_specs=out_specs,
+            check_vma=False,
+        )
+        return jax.jit(sharded)
+
+    # ------------------------------------------------- sharded prioritized
+    def sample_transitions_per(
+        self,
+        n_samples: int,
+        batch_size: int,
+        key,
+        beta: float,
+        sample_next_obs: bool = False,
+        obs_keys: Sequence[str] = (),
+    ):
+        if batch_size <= 0 or n_samples <= 0:
+            raise ValueError(
+                f"'batch_size' ({batch_size}) and 'n_samples' ({n_samples}) must be both greater than 0"
+            )
+        need = 2 if sample_next_obs else 1
+        if not (self.active and self._bufs is not None and int(self._filled.min()) >= need):
+            raise ValueError("Not enough data in the device cache, add first")
+        if self._tree is None:
+            raise RuntimeError("prioritized sampling requested on a cache built without prioritized=True")
+        nk = tuple(obs_keys) if sample_next_obs else ()
+        geom = ("per_transitions", int(n_samples), int(batch_size), nk, tuple(sorted(self._bufs)))
+        fn = self._sharded_sample_fns.get(geom)
+        if fn is None:
+            fn = self._build_sharded_per(int(n_samples), int(batch_size), None, nk)
+            self._sharded_sample_fns[geom] = fn
+        out, leaves = fn(
+            self._bufs,
+            self._tree.trees,
+            jnp.asarray(key),
+            jnp.asarray(self._pos),
+            jnp.asarray(self._filled),
+            jnp.asarray(float(beta), jnp.float32),
+        )
+        return out, leaves
+
+    def sample_per(
+        self, n_samples: int, batch_size: int, seq_len: int, key, beta: float
+    ) -> List[Dict[str, jax.Array]]:
+        if not self.can_sample(seq_len):
+            raise ValueError(
+                f"Cannot sample a sequence of length {seq_len}. "
+                f"Data added so far: {int(self._filled.min())}"
+            )
+        if self._tree is None:
+            raise RuntimeError("prioritized sampling requested on a cache built without prioritized=True")
+        geom = ("per_windows", int(n_samples), int(batch_size), int(seq_len), tuple(sorted(self._bufs)))
+        fn = self._sharded_sample_fns.get(geom)
+        if fn is None:
+            fn = self._build_sharded_per(int(n_samples), int(batch_size), int(seq_len), ())
+            self._sharded_sample_fns[geom] = fn
+        out, leaves = fn(
+            self._bufs,
+            self._tree.trees,
+            jnp.asarray(key),
+            jnp.asarray(self._pos),
+            jnp.asarray(self._filled),
+            jnp.asarray(0.0, jnp.float32),
+        )
+        if self.per_decay is not None:
+            self._tree.scale(leaves, self.per_decay)
+        return [{k: v[i] for k, v in out.items()} for i in range(n_samples)]
+
+    def _build_sharded_per(self, n_samples, batch_size, seq_len, next_keys):
+        """One builder for both prioritized shapes: ``seq_len=None`` gives
+        the flat-transition sampler (+ IS weights), an int gives the
+        sequence-START sampler (Dreamer family; no IS reweighting).
+
+        The body runs per shard: zero this shard's invalid cells in a
+        functional sub-tree copy, draw globally via
+        :func:`~sheeprl_tpu.replay.priority_tree.shard_proportional_draw`
+        (ONE psum'd total-mass reduction), gather rows for the draws this
+        shard owns, and masked-psum the batch together — exact global
+        proportional marginals, replicated output."""
+        from jax.sharding import PartitionSpec as P
+
+        from sheeprl_tpu.replay.priority_tree import (
+            _tree_zeroed_local,
+            shard_proportional_draw,
+        )
+
+        mesh = self._runtime.mesh
+        axes = self._axes
+        cap, n_envs, n_dev = self.capacity, self.n_envs, self._n_dev
+        n_local = n_envs // n_dev
+        depth = self._tree.depth
+        flat = n_samples * batch_size
+        windows = seq_len is not None
+
+        def body(bufs_l, trees_l, key, pos_l, filled_l, beta):
+            r = self._flat_rank()
+            t = trees_l[0]
+            if windows and seq_len > 1:  # jaxlint: disable=retrace-branch — static window length
+                offs = jnp.arange(1, seq_len)  # (L-1,)
+                inv_rows = (pos_l[None, :] - offs[:, None]) % cap  # (L-1, n_local)
+                inv_leaves = (inv_rows * n_local + jnp.arange(n_local)[None, :]).reshape(-1)
+                t = _tree_zeroed_local(t, inv_leaves, depth)
+            if not windows and next_keys:  # jaxlint: disable=retrace-branch — static obs-key tuple
+                head_rows = (pos_l - 1) % cap  # per-env newest row: successor is stale
+                head_leaves = head_rows * n_local + jnp.arange(n_local)
+                t = _tree_zeroed_local(t, head_leaves, depth)
+            leaf, mass, own, total = shard_proportional_draw(
+                t, key, r, n_dev, axes, n=flat, depth=depth
+            )
+            rows = leaf // n_local
+            env_l = leaf % n_local
+            cell_global = rows * n_envs + (r * n_local + env_l)
+            leaves_out = jax.lax.psum(jnp.where(own, cell_global, 0), axes)
+
+            out = {}
+            if windows:
+                t_idx = (rows[:, None] + jnp.arange(seq_len)[None, :]) % cap  # (flat, L)
+                e_idx = env_l[:, None]
+                for k, buf in bufs_l.items():
+                    g = buf[t_idx, e_idx]  # (flat, L, *feat)
+                    m = own.reshape((flat,) + (1,) * (g.ndim - 1))
+                    g = jax.lax.psum(jnp.where(m, g, jnp.zeros((), g.dtype)), axes)
+                    g = g.reshape(n_samples, batch_size, seq_len, *buf.shape[2:])
+                    out[k] = jnp.swapaxes(g, 1, 2)  # (n_samples, L, B, *feat)
+            else:
+                gathered = _gather_transitions(
+                    bufs_l, rows, env_l,
+                    n_samples=n_samples, batch_size=batch_size, cap=cap, next_keys=next_keys,
+                )
+                own_b = own.reshape(n_samples, batch_size)
+                for k, g in gathered.items():
+                    m = own_b.reshape(own_b.shape + (1,) * (g.ndim - 2))
+                    out[k] = jax.lax.psum(jnp.where(m, g, jnp.zeros((), g.dtype)), axes)
+                # IS weights from the psum-assembled per-draw masses (all
+                # shards agree, so the batch-max normalization is global)
+                mass_global = jax.lax.psum(jnp.where(own, mass, 0.0), axes)
+                live_local = jnp.sum(filled_l) - (n_local if next_keys else 0)
+                n_live = jax.lax.psum(live_local.astype(jnp.float32), axes)
+                probs = jnp.maximum(mass_global, jnp.finfo(jnp.float32).tiny) / jnp.maximum(
+                    total, jnp.finfo(jnp.float32).tiny
+                )
+                w = (jnp.maximum(n_live, 1.0) * probs) ** (-beta)
+                w = w / jnp.max(w)
+                out["is_weights"] = w.reshape(n_samples, batch_size, 1)
+            return out, leaves_out.reshape(n_samples, batch_size)
+
+        buf_specs = {k: P(None, axes) for k in self._bufs}
+        out_keys = list(self._bufs) + [f"next_{k}" for k in next_keys]
+        if not windows:
+            out_keys.append("is_weights")
+        out_specs = ({k: P() for k in out_keys}, P())
+        sharded = shard_map(
+            body, mesh=mesh,
+            in_specs=(buf_specs, P(axes, None), P(), P(axes), P(axes), P()),
+            out_specs=out_specs,
+            check_vma=False,
         )
         return jax.jit(sharded)

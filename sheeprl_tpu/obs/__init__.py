@@ -97,6 +97,11 @@ class Observability:
         # carry a "jaxenv" section (backend, env family, env-step and
         # episode-event counters) when algo.env_backend=jax
         self.jaxenv_stats: Optional[Any] = None
+        # zero-arg provider of mesh-layout stats (axis names/sizes, FSDP
+        # param-shard bytes, per-update collective-bytes estimate);
+        # setup_observability wires MeshRuntime.mesh_telemetry here so
+        # every record carries a "mesh" section (howto/observability.md)
+        self.mesh_stats: Optional[Any] = None
         if not self.enabled:
             return
         self._world_size = max(1, int(world_size))
@@ -159,6 +164,11 @@ class Observability:
         if self.jaxenv_stats is not None:
             try:
                 extra = {**(extra or {}), "jaxenv": self.jaxenv_stats()}
+            except Exception:
+                pass
+        if self.mesh_stats is not None:
+            try:
+                extra = {**(extra or {}), "mesh": self.mesh_stats()}
             except Exception:
                 pass
         record = make_record(
@@ -244,7 +254,7 @@ def setup_observability(runtime, cfg, log_dir: Optional[str], logger: Any = None
     # the whole-run metric.profile trace (cli.py) and the windowed scheduler
     # cannot nest — the flag wins
     every_n = 0 if metric_cfg.get("profile", False) else int(metric_cfg.get("profile_every_n", 0) or 0)
-    return Observability(
+    obs = Observability(
         enabled=True,
         telemetry_path=os.path.join(log_dir, "telemetry.jsonl"),
         telemetry_max_bytes=int(metric_cfg.get("telemetry_max_bytes", 32 * 1024 * 1024)),
@@ -260,3 +270,5 @@ def setup_observability(runtime, cfg, log_dir: Optional[str], logger: Any = None
         logger=logger if metric_cfg.get("telemetry_tb_mirror", False) else None,
         name=str(cfg.get("algo", {}).get("name", "run")),
     )
+    obs.mesh_stats = getattr(runtime, "mesh_telemetry", None)
+    return obs

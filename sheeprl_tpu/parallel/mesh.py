@@ -28,6 +28,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from sheeprl_tpu.parallel.sharding import BATCH_AXES, ShardingLayout, build_mesh
+
 
 def _sanitize_enabled() -> bool:
     """Local alias kept import-lazy: the sanitizers module pulls in the
@@ -52,6 +54,7 @@ class MeshRuntime:
         precision: str = "32-true",
         player_device: str = "auto",
         player_params_cutoff_mb: float = 4.0,
+        mesh_shape: Any = "auto",
         **kwargs: Any,
     ):
         if precision not in _PRECISIONS:
@@ -69,9 +72,11 @@ class MeshRuntime:
         self._precision = precision
         self._player_device = player_device
         self._player_cutoff_mb = float(player_params_cutoff_mb)
+        self._mesh_shape = mesh_shape
         self._player_choice_logged = False
         self._launched = False
         self._mesh: Optional[Mesh] = None
+        self._layout: Optional[ShardingLayout] = None
         self._key: Optional[jax.Array] = None
 
     # ------------------------------------------------------------------ #
@@ -148,12 +153,16 @@ class MeshRuntime:
             raise RuntimeError(f"Requested {n} devices but only {len(devices)} are available")
         devices = devices[:n]
 
-        # One mesh axis: DP and FSDP both lay the batch over "data"; FSDP
-        # additionally shards the parameters over the same axis (ZeRO-style)
-        # in ``replicate`` — XLA's sharding propagation turns that into
-        # all-gather-on-use + reduce-scatter-of-grads without any change to
-        # the jitted train steps.
-        self._mesh = Mesh(np.asarray(devices), axis_names=("data",))
+        # Two mesh axes (parallel/sharding.py): batches shard over the
+        # flattened ("data", "fsdp") axes — every device is a DP worker —
+        # while params/opt-state replicate under dp and shard ZeRO-style
+        # over "fsdp" under ``strategy=fsdp``.  ``mesh_shape=auto``
+        # reproduces the pre-2-D layouts bit-exactly (all devices on one
+        # axis); explicit ``[d, f]`` shapes lay a pod as d-way data x
+        # f-way param sharding, with jit lowering the cross-shard
+        # reductions to ``jax.lax`` collectives over ICI/DCN.
+        self._mesh = build_mesh(devices, self._mesh_shape, self._strategy)
+        self._layout = ShardingLayout(self._mesh)
         self._launched = True
         return self
 
@@ -165,8 +174,25 @@ class MeshRuntime:
 
     @property
     def world_size(self) -> int:
-        """Number of data-parallel workers (mesh data-axis size)."""
-        return self.mesh.shape["data"]
+        """Number of data-parallel workers (batch shards) — the flattened
+        (data x fsdp) device count: the batch sharding always covers both
+        axes, so every device owns a batch shard."""
+        return self.layout.n_shards
+
+    @property
+    def layout(self) -> ShardingLayout:
+        """Canonical PartitionSpec vocabulary for this mesh."""
+        if not self._launched:
+            self.launch()
+        return self._layout
+
+    @property
+    def data_size(self) -> int:
+        return self.layout.data_size
+
+    @property
+    def fsdp_size(self) -> int:
+        return self.layout.fsdp_size
 
     @property
     def device_count(self) -> int:
@@ -299,32 +325,33 @@ class MeshRuntime:
         all-gather and destroy a ZeRO (fsdp) layout.  When it returns False
         on a multi-device mesh, warns that the update runs on the
         replicated GSPMD fallback (correct, but every device computes the
-        FULL update).  One gate shared by ppo/a2c/ppo_recurrent so the
-        fsdp guard and the warning cannot drift per algo."""
+        FULL update) — except under fsdp, where the GSPMD path with the
+        layout constraints IS the intended ZeRO program, not a fallback.
+        One gate shared by ppo/a2c/ppo_recurrent/sac/droq so the fsdp
+        guard and the warning cannot drift per algo."""
         if self.world_size == 1:
             return False
-        if self._strategy != "fsdp" and batch_axis_size % self.world_size == 0:
+        if self._strategy == "fsdp":
+            # not a fallback: the jit path with guard_update's boundary
+            # constraints lowers to the ZeRO all-gather/reduce-scatter
+            # program — silence here, the layout is by design
+            return False
+        if batch_axis_size % self.world_size == 0:
             return True
         import warnings
 
-        reason = (
-            "strategy=fsdp keeps params sharded, which the DDP shard_map core does not support"
-            if self._strategy == "fsdp"
-            else f"batch axis {batch_axis_size} is not divisible by world_size={self.world_size}"
-        )
         warnings.warn(
             f"multi-device {algo or 'train'} update falling back to the replicated GSPMD "
             f"path (correct, but every device computes the FULL update — no DP speedup): "
-            f"{reason}."
+            f"batch axis {batch_axis_size} is not divisible by world_size={self.world_size}."
         )
         return False
 
     def batch_sharding(self, axis: int = 0) -> NamedSharding:
-        """Sharding that splits ``axis`` over the data axis (per-device
-        minibatch split; pass to device_put / DevicePrefetcher so batches
-        land already distributed)."""
-        spec = tuple([None] * axis + ["data"])
-        return NamedSharding(self.mesh, P(*spec))
+        """Sharding that splits ``axis`` over the flattened batch axes
+        (data x fsdp — one shard per device; pass to device_put /
+        DevicePrefetcher so batches land already distributed)."""
+        return self.layout.batch_sharding(axis)
 
     @property
     def replicated(self) -> NamedSharding:
@@ -345,35 +372,55 @@ class MeshRuntime:
         """Place params/opt-state on the mesh.
 
         Default strategies replicate every leaf. Under ``strategy="fsdp"``
-        each leaf is sharded over the data axis on its LARGEST dimension
-        divisible by the mesh size (scalars and indivisible leaves stay
-        replicated): the ZeRO-3 layout, with XLA inserting the weight
-        all-gathers and gradient reduce-scatters during jit."""
+        each leaf is sharded over the **fsdp** axis on its LARGEST
+        dimension divisible by the axis size (scalars and indivisible
+        leaves stay replicated): the ZeRO-3 layout, with XLA inserting the
+        weight all-gathers and gradient reduce-scatters during jit.  The
+        per-leaf rule lives in :meth:`ShardingLayout.param_spec` so the
+        in-jit boundary constraints agree with this placement by
+        construction."""
         if _sanitize_enabled():
             from sheeprl_tpu.analysis.sanitizers import check_host_sources
 
             check_host_sources(tree, "replicate")
-        if self._strategy != "fsdp" or self.world_size == 1:
+        if self._strategy != "fsdp" or self.fsdp_size == 1:
+            if self._strategy == "fsdp" and self.world_size > 1:
+                import warnings
+
+                warnings.warn(
+                    "strategy=fsdp with a size-1 'fsdp' mesh axis keeps params "
+                    "replicated (plain DP); set fabric.mesh_shape to give the "
+                    "fsdp axis a real size (auto puts every device on it)."
+                )
             return jax.device_put(tree, self.replicated)
-        ws = self.world_size
+        layout = self.layout
+        return jax.tree_util.tree_map(
+            lambda leaf: jax.device_put(leaf, layout.param_sharding(leaf)), tree
+        )
 
-        def place(leaf: Any) -> Any:
-            # shard the LARGEST divisible dim: picking the first one can hit
-            # a small leading axis (e.g. a conv kernel's spatial dim),
-            # producing tiny shards and halo all-gathers
-            shape = getattr(leaf, "shape", ())
-            best = max(
-                (d for d, s in enumerate(shape) if s >= ws and s % ws == 0),
-                key=lambda d: shape[d],
-                default=None,
-            )
-            if best is not None:
-                spec = [None] * len(shape)
-                spec[best] = "data"
-                return jax.device_put(leaf, NamedSharding(self.mesh, P(*spec)))
-            return jax.device_put(leaf, self.replicated)
+    def mesh_telemetry(self, params: Any = None, compiled: Any = None) -> Dict[str, Any]:
+        """The telemetry record's ``mesh`` key (howto/observability.md):
+        axis names/sizes, the achieved per-device FSDP param-shard bytes
+        (when ``params`` is passed), and a best-effort per-update
+        cross-device traffic estimate from ``Compiled.cost_analysis()``
+        (when a compiled update is passed)."""
+        out: Dict[str, Any] = dict(self.layout.describe())
+        out["strategy"] = self._strategy
+        # extras stashed by the first guarded-update dispatch (sentinel.py):
+        # param bytes, FSDP shard bytes, opt-in collective-bytes estimate
+        out.update(getattr(self, "_mesh_extra", None) or {})
+        if params is not None:
+            total = self._player_params_nbytes(params)
+            out["param_bytes_total"] = int(total)
+            if self._strategy == "fsdp" and self.fsdp_size > 1:
+                out["param_bytes_per_device"] = self.layout.param_shard_bytes(params)
+        if compiled is not None:
+            from sheeprl_tpu.parallel.sharding import collective_bytes_estimate
 
-        return jax.tree_util.tree_map(place, tree)
+            est = collective_bytes_estimate(compiled)
+            if est is not None:
+                out["collective_bytes_estimate"] = est
+        return out
 
     def setup_step(
         self,

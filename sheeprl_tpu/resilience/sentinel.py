@@ -711,6 +711,30 @@ class TrainHealth:
         self.last_ok = bool(snapshot.get("last_ok", True))
 
 
+def _constrain_boundaries(runtime, update: Callable, n_state: int) -> Callable:
+    """Pin the update's state outputs to the mesh's canonical layout
+    (``with_sharding_constraint`` at the update boundary): on a
+    multi-device mesh every returned state tree (params, opt-state,
+    moments, ...) is constrained to the ZeRO ``fsdp`` layout under
+    ``strategy=fsdp`` and to replicated otherwise, so the reduce-scatter/
+    all-gather structure of the lowered program is explicit instead of an
+    accident of GSPMD propagation.  Single-device runs return ``update``
+    UNTOUCHED — the wrapped fn is the exact pre-PR traced program, which
+    is what keeps the 1-device path bit-exact."""
+    layout = getattr(runtime, "layout", None)
+    if layout is None or runtime.world_size == 1:
+        return update
+    fsdp = getattr(runtime, "strategy", "") == "fsdp" and runtime.fsdp_size > 1
+
+    def constrained(*args):
+        out = update(*args)
+        state_out = tuple(layout.constrain_state(t, fsdp=fsdp) for t in out[:n_state])
+        return (*state_out, *out[n_state:])
+
+    constrained.__name__ = getattr(update, "__name__", "update")
+    return constrained
+
+
 # ------------------------------------------------------------- the one hook
 class GuardedUpdate:
     """Callable wrapper around an algo's raw update/train function — the
@@ -730,7 +754,10 @@ class GuardedUpdate:
     def __init__(self, runtime, update: Callable, cfg, *, n_state: int, donate_argnums):
         scfg = sentinel_setting(cfg)
         self._runtime = runtime
-        self._update = update
+        self._update = update  # raw update: eval_shape'd for the stat keys
+        # multi-device: the dispatched program additionally pins state
+        # outputs to the canonical mesh layout (single-device: identity)
+        update = _constrain_boundaries(runtime, update, int(n_state))
         self._n_state = int(n_state)
         self._faults = _UpdateFaults()
         self.health = TrainHealth(runtime, scfg)
@@ -773,6 +800,18 @@ class GuardedUpdate:
                 jax.tree_util.tree_map(sel, s_new, s_old)
                 for s_new, s_old in zip(state_out, args[:n])
             )
+            layout = getattr(runtime, "layout", None)
+            if layout is not None and runtime.world_size > 1:
+                # the verdict state must stay REPLICATED on the mesh: the
+                # host polls it every check_every dispatches, and a sharded
+                # (or device-0-pinned) layout would turn that poll into a
+                # cross-device fetch on the hot path (asserted by tests)
+                from sheeprl_tpu.utils.jax_compat import with_sharding_constraint
+
+                new_sentinel = SentinelState(*(
+                    with_sharding_constraint(leaf, layout.replicated)
+                    for leaf in new_sentinel
+                ))
             return (new_sentinel, *selected, metrics, *rest)
 
         self._holder = holder
@@ -797,11 +836,48 @@ class GuardedUpdate:
         )
         return keys + ["update_norm"]
 
+    def _note_mesh_telemetry(self, args) -> None:
+        """First-dispatch hook: stash the mesh layout extras (param bytes,
+        achieved FSDP shard bytes, opt-in collective-bytes estimate) on the
+        runtime so ``MeshRuntime.mesh_telemetry`` — the telemetry record's
+        ``mesh`` key — reports them without the loops threading params
+        through the observability layer."""
+        runtime = self._runtime
+        layout = getattr(runtime, "layout", None)
+        if layout is None or getattr(runtime, "_mesh_extra", None) is not None:
+            return
+        try:
+            extra: Dict[str, Any] = {
+                "param_bytes_total": int(runtime._player_params_nbytes(args[0]))
+            }
+            if getattr(runtime, "strategy", "") == "fsdp" and runtime.fsdp_size > 1:
+                extra["param_bytes_per_device"] = layout.param_shard_bytes(args[0])
+            if not self.enabled and os.environ.get(
+                "SHEEPRL_MESH_COST_TELEMETRY", ""
+            ).strip() in ("1", "true", "on"):
+                # opt-in: one AOT lower+compile of the update (hits the
+                # persistent compilation cache when armed) for the
+                # cross-device traffic estimate from cost_analysis();
+                # sentinel-on programs take the extra state arg, so only
+                # the off path can lower from the raw update args
+                jitted = getattr(self._fn, "_jitted", None)
+                if jitted is not None:
+                    from sheeprl_tpu.parallel.sharding import collective_bytes_estimate
+
+                    est = collective_bytes_estimate(jitted.lower(*args).compile())
+                    if est is not None:
+                        extra["collective_bytes_estimate"] = est
+            runtime._mesh_extra = extra
+        except Exception:
+            runtime._mesh_extra = {}
+
     def __call__(self, *args):
         args = self._faults.apply(args, self._n_state)
         if not self.enabled:
+            self._note_mesh_telemetry(args)
             return self._fn(*args)
         if self.health.device_state is None:
+            self._note_mesh_telemetry(args)
             keys = self._resolve_stat_keys(args)
             self._holder["keys"] = keys
             self.health.stat_keys = keys

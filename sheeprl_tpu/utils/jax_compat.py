@@ -36,7 +36,13 @@ def shard_map(
 ) -> Callable:
     """``jax.shard_map`` when available; otherwise the
     ``jax.experimental.shard_map`` original, with ``check_vma`` mapped to
-    its old name ``check_rep``."""
+    its old name ``check_rep``.
+
+    Both branches accept the 2-D-mesh call sites (parallel/sharding.py):
+    ``PartitionSpec`` entries may be TUPLES of axis names (the flattened
+    ``("data", "fsdp")`` batch split) and bodies may issue collectives
+    over tuple axis names — long-standing jax semantics on both sides of
+    the API move, pinned per branch by tests/test_utils/test_jax_compat.py."""
     if hasattr(jax, "shard_map"):
         return jax.shard_map(
             f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=check_vma, **kwargs
@@ -46,3 +52,26 @@ def shard_map(
     return _shard_map(
         f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=check_vma, **kwargs
     )
+
+
+def with_sharding_constraint(x: Any, sharding: Any) -> Any:
+    """``jax.lax.with_sharding_constraint`` where it exists (0.4.x and
+    current); the ``jax.experimental.pjit`` original otherwise.  Layout
+    pins at update boundaries (ShardingLayout.constrain_state) route
+    through here so the FSDP path runs on every jax in the window."""
+    if hasattr(jax.lax, "with_sharding_constraint"):
+        return jax.lax.with_sharding_constraint(x, sharding)
+    from jax.experimental.pjit import with_sharding_constraint as _wsc
+
+    return _wsc(x, sharding)
+
+
+def flat_axis_index(axis_names, axis_sizes) -> Any:
+    """Flattened (row-major) device index over multiple mesh axes, inside
+    a ``shard_map``/``pmap`` body.  Tuple-axis ``jax.lax.axis_index`` only
+    landed after 0.4.x, so the flat index is composed from per-axis calls
+    — identical semantics on every supported jax."""
+    idx = jax.lax.axis_index(axis_names[0])
+    for name, size in zip(axis_names[1:], axis_sizes[1:]):
+        idx = idx * int(size) + jax.lax.axis_index(name)
+    return idx

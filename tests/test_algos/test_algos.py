@@ -347,10 +347,13 @@ def test_dreamer_v3_device_cache(standard_args, tmp_path):
     _run(args + [f"checkpoint.resume_from={ckpts[-1]}"])
 
 
-def test_dreamer_v3_sharded_device_cache(standard_args, tmp_path):
+@pytest.mark.parametrize("prioritized", ["False", "True"])
+def test_dreamer_v3_sharded_device_cache(standard_args, tmp_path, prioritized):
     """End-to-end DV3 on a 2-device DP mesh with the env-sharded cache
     (buffer.device_cache=True opts multi-device meshes into
-    ShardedDeviceReplayCache; env.num_envs=2 divides over the devices)."""
+    ShardedDeviceReplayCache; env.num_envs=2 divides over the devices).
+    The prioritized leg runs sequence-START PER on the per-shard
+    sum-trees — the path that used to fall back to uniform."""
     args = standard_args + _dv3_tiny_args() + [
         "exp=dreamer_v3",
         "env=dummy",
@@ -359,14 +362,15 @@ def test_dreamer_v3_sharded_device_cache(standard_args, tmp_path):
         "algo.cnn_keys.encoder=[rgb]",
         "algo.per_rank_batch_size=1",  # x world_size 2 -> global batch 2
         "buffer.device_cache=True",
+        f"buffer.prioritized={prioritized}",
         "fabric.devices=2",
         "fabric.accelerator=cpu",
-        f"root_dir={tmp_path}/dv3shcache",
+        f"root_dir={tmp_path}/dv3shcache{prioritized}",
     ]
     _run(args)
     import glob
 
-    assert glob.glob(f"{tmp_path}/dv3shcache/**/ckpt_*.ckpt", recursive=True)
+    assert glob.glob(f"{tmp_path}/dv3shcache{prioritized}/**/ckpt_*.ckpt", recursive=True)
 
 
 def test_dreamer_v3_fused_gru(standard_args, tmp_path):

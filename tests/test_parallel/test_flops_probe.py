@@ -23,12 +23,15 @@ import pytest
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
 
 
-# slow-marked (ISSUE 9 tooling pass): the two full DV3 compiles cost ~60s,
+# slow-marked (ISSUE 9 tooling pass): the two full DV3 compiles cost ~30-60s,
 # the single largest tier-1 line item, guarding a compile-structure property
 # that only moves when the sharded train path itself is edited — run it via
 # `-m slow` (or directly) when touching the mesh/shard_map/conv-stack code.
-# Tier-1's 870s budget was overrun at PR 9 (888s measured) and per-PR test
-# growth had to come out of somewhere that is not a behavioral smoke.
+# Tier-1's 870s budget has no slack, so per-PR growth cannot land here.
+# Last refreshed at PR 12 (2-D ("data","fsdp") mesh + guard_update layout
+# constraints): GREEN in 31s on the 1-core container, 8-device/1-device
+# per-device-FLOPs ratio 0.141 (ideal 0.125, gate < 0.3) — the 2-D mesh
+# did not reintroduce silent replication into the DV3 train step.
 @pytest.mark.slow
 def test_dv3_per_device_flops_scale_with_mesh():
     from benchmarks.flops_probe import probe_dv

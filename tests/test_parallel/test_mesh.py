@@ -15,7 +15,9 @@ def test_launch_auto_single_device():
 def test_launch_8_device_dp_mesh():
     rt = MeshRuntime(devices=8, strategy="dp", accelerator="cpu").launch()
     assert rt.world_size == 8
-    assert rt.mesh.axis_names == ("data",)
+    # 2-D mesh, auto shape: dp lays every device on the data axis
+    assert rt.mesh.axis_names == ("data", "fsdp")
+    assert rt.data_size == 8 and rt.fsdp_size == 1
 
 
 def test_fsdp_param_sharding_and_train_step():
@@ -33,8 +35,10 @@ def test_fsdp_param_sharding_and_train_step():
     }
     placed = rt.replicate(params)
     # the LARGEST divisible dim is sharded (dim 1, 32 > 16) — avoids tiny
-    # shards on small leading axes like conv spatial dims
-    assert placed["w"].sharding.spec == jax.sharding.PartitionSpec(None, "data")
+    # shards on small leading axes like conv spatial dims; auto mesh_shape
+    # under fsdp puts every device on the fsdp axis
+    assert rt.fsdp_size == 8
+    assert placed["w"].sharding.spec == jax.sharding.PartitionSpec(None, "fsdp")
     assert placed["b"].sharding.spec == jax.sharding.PartitionSpec()
 
     tx = optax.sgd(0.1)
@@ -105,7 +109,8 @@ def test_shard_batch_and_psum_semantics():
     rt = MeshRuntime(devices=8, strategy="dp", accelerator="cpu").launch()
     batch = {"x": np.arange(16, dtype=np.float32).reshape(16, 1)}
     sharded = rt.shard_batch(batch)
-    assert sharded["x"].sharding.spec == jax.sharding.PartitionSpec("data")
+    # batches always shard over the flattened (data, fsdp) axes
+    assert sharded["x"].sharding.spec == jax.sharding.PartitionSpec(("data", "fsdp"))
 
     # a jitted global mean over the sharded batch == DDP-style all-reduce
     step = rt.setup_step(lambda b: b["x"].mean())
