@@ -111,7 +111,7 @@ SECTIONS = [
     ("a2c", 100),
     ("dec", 300),
     ("fanin", 140),
-    ("transport", 120),
+    ("transport", 240),
     ("mesh", 560),
 ]
 
@@ -478,10 +478,34 @@ def bench_transport():
     "Data integrity" documents the breakdown).  The headline is the
     crc-mode 1 MB shm time so the perf-regression gate holds the line
     across rounds."""
-    from benchmarks.bench_shm_transport import run_integrity_ladder
+    import tempfile
 
-    rows = run_integrity_ladder(n_msgs=int(os.environ.get("BENCH_TRANSPORT_MSGS", 150)))
+    from benchmarks.bench_shm_transport import run_integrity_ladder, run_tracing_ladder
+
+    n_msgs = int(os.environ.get("BENCH_TRANSPORT_MSGS", 150))
+    rows = run_integrity_ladder(n_msgs=n_msgs)
     top = rows[-1]  # the 1 MB row
+    # paired flight-tracing leg (ISSUE 13): sampled tracing must hold <2%
+    # on the 1 MB shm rung; the recorded flight streams double as a
+    # trace-export smoke — obs.report merges them into a trace.json whose
+    # path rides bench_last.jsonl
+    flight_root = tempfile.mkdtemp(prefix="sheeprl_bench_flight_")
+    trace_rows = run_tracing_ladder(n_msgs=n_msgs, flight_dir=flight_root)
+    trace_path = None
+    try:
+        from sheeprl_tpu.obs.report import generate_report
+
+        out_dir = os.path.join(REPO, "benchmarks", "results")
+        os.makedirs(out_dir, exist_ok=True)
+        trace_path = os.path.join(out_dir, "trace_last.json")
+        generate_report(flight_root, out=trace_path)
+    except Exception as e:  # the ladder numbers stand on their own
+        print(f"trace export skipped: {type(e).__name__}: {e}", file=sys.stderr)
+        trace_path = None
+    finally:
+        import shutil
+
+        shutil.rmtree(flight_root, ignore_errors=True)
     return {
         "metric": "transport_crc_shm_1mb_ms",
         "value": round(top["shm_crc_us_per_msg"] / 1e3, 4),
@@ -491,6 +515,9 @@ def bench_transport():
         "tcp_crc_overhead_pct": top["tcp_crc_overhead_pct"],
         "checksum_impl": top["checksum_impl"],
         "coverage_bytes": top["coverage_bytes"],
+        "tracing_shm_1mb_overhead_pct": trace_rows[-1]["shm_tracing_overhead_pct"],
+        "tracing_rows": trace_rows,
+        "trace_export_path": trace_path,
         "rows": rows,
         "host_cpu_count": os.cpu_count(),
     }
@@ -907,6 +934,7 @@ def main():
         os._exit(1)
 
     signal.signal(signal.SIGTERM, _on_term)
+    section_wall_s = {}
     _note(event="start", budget_s=BUDGET_S)
     for section, est_s in SECTIONS:
         if os.environ.get(f"BENCH_SKIP_{section.upper()}"):
@@ -945,7 +973,8 @@ def main():
             metrics[section] = metric
             if section != "dv3":  # dv3 is deferred to close the stream
                 _emit(section)
-            _note(event="done", section=section, section_s=round(time.perf_counter() - t0, 1), **metric)
+            section_wall_s[section] = round(time.perf_counter() - t0, 1)
+            _note(event="done", section=section, section_s=section_wall_s[section], **metric)
         except subprocess.TimeoutExpired:
             # the measurement may have completed during interpreter teardown
             if _harvest(section):
@@ -962,6 +991,15 @@ def main():
     for key in [s for s, _ in SECTIONS if s != "dv3"] + ["dv3"]:
         _emit(key)
     _note(event="end", total_s=round(time.perf_counter() - T_START, 1), emitted=list(metrics))
+    # one machine-readable summary of the whole run: per-section
+    # wall-seconds (from the per-section done events) + the trace-export
+    # path the transport section produced, so a perf investigation can
+    # jump from bench_last.jsonl straight into perfetto
+    _note(
+        event="sections",
+        wall_s=dict(section_wall_s),
+        trace_export_path=(metrics.get("transport") or {}).get("trace_export_path"),
+    )
     # perf-regression gate vs the previous committed BENCH_r*.json: loud
     # failure (stderr + non-zero exit) on >20% regressions of directional
     # headline metrics, skip-list exempt (benchmarks/bench_gate_skiplist.json)

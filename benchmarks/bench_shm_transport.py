@@ -117,7 +117,13 @@ def _run_mode(mode: str, payload, n_msgs: int) -> float:
 
 
 # ----------------------------------------------------- crc-overhead legs
-def _chan_consumer(spec, ack_q, n_msgs):
+def _chan_consumer(spec, ack_q, n_msgs, flight_dir=None):
+    if flight_dir:
+        # receive-side recorder: the tracing leg must pay BOTH halves of
+        # the cost (marker strip + recv record), like a real player does
+        from sheeprl_tpu.obs import flight
+
+        flight.configure("bench_rx", flight_dir, mode="sampled")
     chan = spec.player_channel()
     try:
         for _ in range(n_msgs):
@@ -130,15 +136,25 @@ def _chan_consumer(spec, ack_q, n_msgs):
         chan.close()
 
 
-def _run_channel_mode(backend: str, payload, n_msgs: int, integrity: str) -> float:
+def _run_channel_mode(
+    backend: str, payload, n_msgs: int, integrity: str, tracing: str = "off", flight_dir=None
+) -> float:
     """Seconds/message through the REAL Channel API (hub -> player
-    direction), identical code paths apart from ``integrity`` — so the
-    off-vs-crc delta measures exactly what the integrity layer adds
-    (checksum at send, verification at receive) and nothing else."""
+    direction), identical code paths apart from ``integrity``/``tracing``
+    — so the paired delta measures exactly what the guard layer adds
+    (checksum or trace records at send, verification/recv records at
+    receive) and nothing else."""
     ctx = mp.get_context("spawn")
-    hub, specs = make_transport(ctx, backend, 1, min_bytes=0, integrity=integrity)
+    if tracing != "off":
+        from sheeprl_tpu.obs import flight
+
+        flight.configure("bench_tx", flight_dir, mode=tracing)
+    hub, specs = make_transport(ctx, backend, 1, min_bytes=0, integrity=integrity, tracing=tracing)
     ack_q = ctx.Queue()
-    proc = ctx.Process(target=_chan_consumer, args=(specs[0], ack_q, n_msgs))
+    proc = ctx.Process(
+        target=_chan_consumer,
+        args=(specs[0], ack_q, n_msgs, flight_dir if tracing != "off" else None),
+    )
     proc.start()
     try:
         chan = hub.channel(0, timeout=60, peer_alive=proc.is_alive)
@@ -156,6 +172,10 @@ def _run_channel_mode(backend: str, payload, n_msgs: int, integrity: str) -> flo
         proc.join(timeout=30)
         if proc.is_alive():
             proc.terminate()
+        if tracing != "off":
+            from sheeprl_tpu.obs import flight
+
+            flight.close_recorder()
 
 
 def run_integrity_ladder(n_msgs: int = 150, sizes_mb=(0.25, 1), repeats: int = 3):
@@ -195,6 +215,52 @@ def run_integrity_ladder(n_msgs: int = 150, sizes_mb=(0.25, 1), repeats: int = 3
     return rows
 
 
+def run_tracing_ladder(n_msgs: int = 150, sizes_mb=(0.25, 1), repeats: int = 3, flight_dir=None):
+    """Paired off-vs-sampled flight-tracing legs (ISSUE 13 acceptance:
+    sampled tracing holds <2% on the 1 MB shm rung).  Same interleaved
+    min-of-N protocol as :func:`run_integrity_ladder` — single runs swing
+    20-30% on a shared host.  With ``flight_dir`` set, both endpoints
+    record real flight streams there (the honest cost: marker append +
+    two records per message + chunked JSONL writes), and the caller can
+    run ``obs.report`` over it to export a trace.json."""
+    import shutil
+    import tempfile
+
+    own_dir = flight_dir is None
+    flight_dir = flight_dir or tempfile.mkdtemp(prefix="sheeprl_bench_flight_")
+    rows = []
+    try:
+        for size_mb in sizes_mb:
+            payload = _payload(int(size_mb * (1 << 20)))
+            actual = sum(int(a.nbytes) for _, a in payload)
+            n = max(min(n_msgs, int(64e6 / max(actual, 1))), 30)
+            row = {"payload_mb": round(actual / (1 << 20), 3), "msgs": n, "repeats": repeats}
+            for backend in ("shm",):
+                best = {"off": float("inf"), "on": float("inf")}
+                for _ in range(repeats):
+                    best["off"] = min(
+                        best["off"], _run_channel_mode(backend, payload, n, "off")
+                    )
+                    best["on"] = min(
+                        best["on"],
+                        _run_channel_mode(
+                            backend, payload, n, "off", tracing="sampled",
+                            flight_dir=os.path.join(flight_dir, "flight"),
+                        ),
+                    )
+                row[f"{backend}_off_us_per_msg"] = round(best["off"] * 1e6, 1)
+                row[f"{backend}_tracing_us_per_msg"] = round(best["on"] * 1e6, 1)
+                row[f"{backend}_tracing_overhead_pct"] = round(
+                    (best["on"] / best["off"] - 1.0) * 100, 2
+                )
+            rows.append(row)
+            print(json.dumps(row), flush=True)
+    finally:
+        if own_dir:
+            shutil.rmtree(flight_dir, ignore_errors=True)
+    return rows
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default=None)
@@ -203,6 +269,11 @@ def main() -> int:
         "--integrity",
         action="store_true",
         help="also run the paired off-vs-crc Channel-API legs (ISSUE 10)",
+    )
+    ap.add_argument(
+        "--tracing",
+        action="store_true",
+        help="also run the paired off-vs-sampled flight-tracing legs (ISSUE 13)",
     )
     args = ap.parse_args()
 
@@ -229,6 +300,9 @@ def main() -> int:
 
     if args.integrity:
         results["integrity"] = run_integrity_ladder(n_msgs=args.msgs)
+
+    if args.tracing:
+        results["tracing"] = run_tracing_ladder(n_msgs=args.msgs)
 
     if args.out:
         os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)

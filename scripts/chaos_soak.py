@@ -102,21 +102,15 @@ def build_net_noise(rng: random.Random, n_drops: int, n_delays: int):
 
 def read_telemetry(root_dir: str):
     """Every ``transport``-keyed record + reward/compile scalars from the
-    run's telemetry JSONL files."""
+    run's telemetry JSONL files (shared reader: obs/reader.py)."""
+    from sheeprl_tpu.obs.reader import iter_run_records
+
     transports, compiles = [], []
-    for path in sorted(
-        glob.glob(os.path.join(root_dir, "**", "telemetry.jsonl"), recursive=True),
-        key=os.path.getmtime,
-    ):
-        for line in open(path):
-            try:
-                rec = json.loads(line)
-            except ValueError:
-                continue
-            if "transport" in rec:
-                transports.append(rec["transport"])
-            if rec.get("trainer_compiles") is not None:
-                compiles.append(rec["trainer_compiles"])
+    for rec in iter_run_records(root_dir):
+        if "transport" in rec:
+            transports.append(rec["transport"])
+        if rec.get("trainer_compiles") is not None:
+            compiles.append(rec["trainer_compiles"])
     return transports, compiles
 
 
@@ -154,22 +148,15 @@ def audit(transports, compiles, *, players: int, kills: int, min_rejoins: int = 
 def read_health(root_dir: str):
     """All ``health`` sections (top-level and transport-nested) plus
     transport rollback counters from a run's telemetry files."""
+    from sheeprl_tpu.obs.reader import iter_run_records, key_path
+
     health, rollback_rounds = [], 0
-    for path in sorted(
-        glob.glob(os.path.join(root_dir, "**", "telemetry.jsonl"), recursive=True),
-        key=os.path.getmtime,
-    ):
-        for line in open(path):
-            try:
-                rec = json.loads(line)
-            except ValueError:
-                continue
-            if rec.get("health"):
-                health.append(rec["health"])
-            tr = rec.get("transport") or {}
-            if tr.get("health"):
-                health.append(tr["health"])
-            rollback_rounds = max(rollback_rounds, tr.get("rollbacks", 0))
+    for rec in iter_run_records(root_dir):
+        if rec.get("health"):
+            health.append(rec["health"])
+        if key_path(rec, "transport.health"):
+            health.append(rec["transport"]["health"])
+        rollback_rounds = max(rollback_rounds, key_path(rec, "transport.rollbacks", 0))
     return health, rollback_rounds
 
 
@@ -303,20 +290,14 @@ def run_health_mode(args) -> int:
 def read_serve(root_dir: str):
     """Last client-side ``serve`` record and server-side
     ``transport.serve`` record from a run's telemetry files."""
+    from sheeprl_tpu.obs.reader import iter_run_records, key_path
+
     client, server = None, None
-    for path in sorted(
-        glob.glob(os.path.join(root_dir, "**", "telemetry.jsonl"), recursive=True),
-        key=os.path.getmtime,
-    ):
-        for line in open(path):
-            try:
-                rec = json.loads(line)
-            except ValueError:
-                continue
-            if rec.get("serve"):
-                client = rec["serve"]
-            if (rec.get("transport") or {}).get("serve"):
-                server = rec["transport"]["serve"]
+    for rec in iter_run_records(root_dir):
+        if rec.get("serve"):
+            client = rec["serve"]
+        if key_path(rec, "transport.serve"):
+            server = rec["transport"]["serve"]
     return client, server
 
 
@@ -500,26 +481,20 @@ def read_integrity(root_dir: str):
     """Last lead ``integrity`` record + the trainer-side counters that
     ride ``transport.integrity`` / ``replay.integrity``, + the last
     ``replay`` record (for the ingest-quarantine leg)."""
+    from sheeprl_tpu.obs.reader import iter_run_records
+
     lead, trainer, replay = {}, {}, {}
-    for path in sorted(
-        glob.glob(os.path.join(root_dir, "**", "telemetry.jsonl"), recursive=True),
-        key=os.path.getmtime,
-    ):
-        for line in open(path):
-            try:
-                rec = json.loads(line)
-            except ValueError:
-                continue
-            if "integrity" in rec:
-                lead = rec["integrity"]
-            tr = rec.get("transport") or {}
-            if "integrity" in tr:
-                trainer = tr["integrity"]
-            rp = rec.get("replay") or {}
-            if rp:
-                replay = rp
-                if "integrity" in rp:
-                    trainer = rp["integrity"]
+    for rec in iter_run_records(root_dir):
+        if "integrity" in rec:
+            lead = rec["integrity"]
+        tr = rec.get("transport") or {}
+        if "integrity" in tr:
+            trainer = tr["integrity"]
+        rp = rec.get("replay") or {}
+        if rp:
+            replay = rp
+            if "integrity" in rp:
+                trainer = rp["integrity"]
     return lead, trainer, replay
 
 

@@ -50,7 +50,7 @@ from sheeprl_tpu.algos.ppo.ppo import build_ppo_optimizer, make_update_fn
 from sheeprl_tpu.algos.ppo.utils import prepare_obs, test
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
-from sheeprl_tpu.obs import setup_observability, trace_scope
+from sheeprl_tpu.obs import flight, setup_observability, trace_scope
 from sheeprl_tpu.parallel.transport import (
     FanIn,
     HeartbeatSender,
@@ -151,6 +151,9 @@ def decoupled_knobs(cfg) -> Dict[str, Any]:
         # tcp length-prefix sanity cap (a corrupted prefix must not turn
         # into a multi-GB allocation)
         "max_frame_bytes": int(cfg.algo.get("tcp_max_frame_mb", 1024)) << 20,
+        # fleet flight recorder (obs/flight.py): off constructs the
+        # undecorated channel classes, sampled/full the traced variants
+        "tracing": flight.tracing_setting(cfg),
     }
 
 
@@ -198,6 +201,10 @@ def _player_loop(
         timer.disabled = True
     if cfg.metric.get("disable_timer", False):
         timer.disabled = True
+    # per-process flight recorder: EVERY player records its own stream
+    # (obs.report merges them); must precede setup_observability so the
+    # lead's recorder carries the player role, not "main"
+    flight.configure_from_cfg(cfg, role=f"player{player_id}")
 
     runtime = MeshRuntime(devices=1, accelerator="cpu", precision=cfg.fabric.precision)
     runtime.launch()
@@ -490,6 +497,8 @@ def _player_loop(
             _die_with_dump(e, policy_step, iter_num)
         new_params = _adopt(frame) if frame is not None else player.params
 
+        collect_span = flight.span("collect", round=iter_num)
+        collect_span.__enter__()
         for _ in range(cfg.algo.rollout_steps):
             # policy steps are GLOBAL (all players advance in lockstep
             # modulo the lag), so counters keep the 1x1 meaning
@@ -543,6 +552,7 @@ def _player_loop(
                             aggregator.update("Game/ep_len_avg", ep_len)
                         runtime.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}")
 
+        collect_span.__exit__(None, None, None)
         # --------------------------------------------- ship the shard
         # preemption rides the cadence: a pending SIGTERM makes
         # should_checkpoint True, so this shard also requests the trainer
@@ -576,7 +586,7 @@ def _player_loop(
         # stall as seen from the player
         if need_ckpt:
             try:
-                with trace_scope("ipc_wait_update"):
+                with trace_scope("ipc_wait_update"), flight.span("params_wait", round=iter_num):
                     frame = follower.advance_to(iter_num)
             except PeerDiedError as e:
                 _die_with_dump(e, policy_step, iter_num)
@@ -687,6 +697,7 @@ def _player_loop(
     if logger:
         logger.finalize()
     channel.close()
+    flight.close_recorder()
 
 
 def spawn_players(cfg, runtime, ctx, target, extra_args=(), knobs=None, with_inference=False):
@@ -716,6 +727,7 @@ def spawn_players(cfg, runtime, ctx, target, extra_args=(), knobs=None, with_inf
         poll_s=knobs["liveness_interval"],
         integrity=knobs["integrity"],
         max_frame_bytes=knobs["max_frame_bytes"],
+        tracing=knobs["tracing"],
     )
     infer_hub = infer_specs = None
     if with_inference:
@@ -733,6 +745,7 @@ def spawn_players(cfg, runtime, ctx, target, extra_args=(), knobs=None, with_inf
             poll_s=knobs["liveness_interval"],
             integrity=knobs["integrity"],
             max_frame_bytes=knobs["max_frame_bytes"],
+            tracing=knobs["tracing"],
         )
     procs = []
     # the env copies the parent's environ at start, so the override only
@@ -783,6 +796,7 @@ def main(runtime, cfg: Dict[str, Any]):
 
     runtime.seed_everything(cfg.seed)
     knobs = decoupled_knobs(cfg)
+    flight.configure_from_cfg(cfg, role="trainer")
 
     state = None
     if cfg.checkpoint.resume_from:
@@ -1021,7 +1035,7 @@ def main(runtime, cfg: Dict[str, Any]):
             # named span: the trainer idling for the next fan-in round (the
             # inverse of the players' ipc_wait_update stall)
             try:
-                with trace_scope("ipc_wait_rollout"):
+                with trace_scope("ipc_wait_rollout"), flight.span("fanin_wait"):
                     seq, frames = fanin.gather(timeout=_QUEUE_TIMEOUT_S, on_control=_on_control)
             except PeerDiedError as e:
                 if supervisor is not None and supervisor.recoverable():
@@ -1054,6 +1068,8 @@ def main(runtime, cfg: Dict[str, Any]):
                     # lag histogram is the V-trace soft-bound telemetry
                     fanin.note_lag(pid, (seq - 1) - int(extra[1]))
 
+            assembly_span = flight.span("batch_assembly", round=iter_num, shards=len(frames))
+            assembly_span.__enter__()
             # per-player shard -> materialized arrays (the astype/copy
             # below frees the transport buffers right after)
             data_shards: Dict[int, Dict[str, np.ndarray]] = {}
@@ -1090,7 +1106,9 @@ def main(runtime, cfg: Dict[str, Any]):
             else:
                 device_next_obs = {k: jnp.asarray(v) for k, v in final_obs.items()}
 
-            with timer("Time/train_time", SumMetric, sync_on_compute=cfg.metric.sync_on_compute):
+            assembly_span.__exit__(None, None, None)
+            with timer("Time/train_time", SumMetric, sync_on_compute=cfg.metric.sync_on_compute), \
+                    flight.span("train_dispatch", round=iter_num):
                 params, opt_state, train_metrics = update_fn(
                     params,
                     opt_state,
@@ -1198,6 +1216,7 @@ def main(runtime, cfg: Dict[str, Any]):
         preemption.uninstall()
         fanin.close()
         hub.close()
+        flight.close_recorder()
         if infer_hub is not None:
             infer_hub.close()
         for proc in procs.values():

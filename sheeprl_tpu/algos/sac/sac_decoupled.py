@@ -47,7 +47,7 @@ from sheeprl_tpu.algos.sac.sac import _make_optimizer, make_train_fn
 from sheeprl_tpu.algos.sac.utils import prepare_obs, test
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
-from sheeprl_tpu.obs import setup_observability, trace_scope
+from sheeprl_tpu.obs import flight, setup_observability, trace_scope
 from sheeprl_tpu.parallel.transport import (
     FanIn,
     HeartbeatSender,
@@ -128,6 +128,7 @@ def _player_loop(
     if cfg.metric.get("disable_timer", False):
         timer.disabled = True
 
+    flight.configure_from_cfg(cfg, role=f"player{player_id}")
     runtime = MeshRuntime(devices=1, accelerator="cpu", precision=cfg.fabric.precision)
     runtime.launch()
     runtime.seed_everything(cfg.seed + player_id)
@@ -547,6 +548,7 @@ def _player_loop(
     if logger:
         logger.finalize()
     channel.close()
+    flight.close_recorder()
 
 
 def _player_loop_remote(
@@ -582,6 +584,7 @@ def _player_loop_remote(
     if cfg.metric.get("disable_timer", False):
         timer.disabled = True
 
+    flight.configure_from_cfg(cfg, role=f"player{player_id}")
     runtime = MeshRuntime(devices=1, accelerator="cpu", precision=cfg.fabric.precision)
     runtime.launch()
     runtime.seed_everything(cfg.seed + player_id)
@@ -699,6 +702,7 @@ def _player_loop_remote(
             else:
                 frame.release()
         if newest is not None:
+            flight.fleet_event("broadcast_adopt", seq=int(newest.seq))
             new_params = _unflat_leaves(actor_treedef, newest.arrays_copy())
             newest.release()
             if player is None:
@@ -952,6 +956,7 @@ def _player_loop_remote(
     if logger:
         logger.finalize()
     channel.close()
+    flight.close_recorder()
 
 
 @register_algorithm(decoupled=True)
@@ -959,6 +964,7 @@ def main(runtime, cfg: Dict[str, Any]):
     """Trainer process body + player spawn (reference sac_decoupled.py:356-545)."""
     runtime.seed_everything(cfg.seed)
     knobs = decoupled_knobs(cfg)
+    flight.configure_from_cfg(cfg, role="trainer")
 
     if "minedojo" in str(cfg.env.wrapper.get("_target_", "")).lower():
         raise ValueError("MineDojo is not supported by the SAC agent")
@@ -1149,7 +1155,7 @@ def main(runtime, cfg: Dict[str, Any]):
             if serve_sup is not None:
                 serve_sup.poll()
             try:
-                with trace_scope("ipc_wait_rollout"):
+                with trace_scope("ipc_wait_rollout"), flight.span("fanin_wait"):
                     seq, frames = fanin.gather(timeout=_QUEUE_TIMEOUT_S, on_control=_on_control)
             except PeerDiedError as e:
                 _dump_and_raise(e, "rollout")
@@ -1164,6 +1170,8 @@ def main(runtime, cfg: Dict[str, Any]):
             # per-player shard -> (g, local_batch, ...) then concat along the
             # batch axis in player-id order (np.array materializes private
             # rows so the transport buffers can be handed back right after)
+            assembly_span = flight.span("batch_assembly", round=int(seq), shards=len(frames))
+            assembly_span.__enter__()
             shards: Dict[int, Dict[str, np.ndarray]] = {}
             for pid, frame in frames.items():
                 shards[pid] = {
@@ -1185,7 +1193,9 @@ def main(runtime, cfg: Dict[str, Any]):
             # shard the batch axis over the mesh so each device trains on
             # its own rows (GSPMD inserts the grad psums)
             data = runtime.shard_batch(data, axis=1)
-            with timer("Time/train_time", SumMetric, sync_on_compute=cfg.metric.sync_on_compute):
+            assembly_span.__exit__(None, None, None)
+            with timer("Time/train_time", SumMetric, sync_on_compute=cfg.metric.sync_on_compute), \
+                    flight.span("train_dispatch", round=int(seq)):
                 params, opt_states, train_metrics = train_fn(
                     params,
                     opt_states,
@@ -1248,6 +1258,7 @@ def main(runtime, cfg: Dict[str, Any]):
         hub.close()
         if infer_hub is not None:
             infer_hub.close()
+        flight.close_recorder()
         for proc in procs:
             if proc.is_alive():
                 proc.terminate()
@@ -1437,6 +1448,9 @@ def _main_remote(runtime, cfg: Dict[str, Any], knobs, state, counters, ratio_sta
 
         def _broadcast_params(seq: int, extras) -> None:
             arrays, digest = _actor_arrays_digest()
+            flight.fleet_event(
+                "broadcast_publish", tag="params", seq=int(seq), n=len(server.broadcast_targets)
+            )
             # server.channels, not the spawn-time dict: a supervised
             # restart on the queue backend swaps in a fresh channel
             for pid in server.broadcast_targets:
@@ -1535,7 +1549,8 @@ def _main_remote(runtime, cfg: Dict[str, Any], knobs, state, counters, ratio_sta
                 data = runtime.shard_batch(data, axis=1)
             iter_equiv = clock // total_envs
             ema_flags = jnp.full((g,), iter_equiv % ema_every == 0)
-            with timer("Time/train_time", SumMetric, sync_on_compute=cfg.metric.sync_on_compute):
+            with timer("Time/train_time", SumMetric, sync_on_compute=cfg.metric.sync_on_compute), \
+                    flight.span("train_dispatch", round=update_round + 1):
                 if prioritized:
                     params, opt_states, train_metrics, td_abs = train_fn(
                         params, opt_states, data, runtime.next_key(), ema_flags
@@ -1593,6 +1608,7 @@ def _main_remote(runtime, cfg: Dict[str, Any], knobs, state, counters, ratio_sta
             supervisor.close()
         preemption.uninstall()
         hub.close()
+        flight.close_recorder()
         for proc in procs.values():
             if proc.is_alive():
                 proc.terminate()

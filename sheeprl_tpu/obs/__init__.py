@@ -24,6 +24,8 @@ import os
 import time
 from typing import Any, Dict, Optional
 
+from sheeprl_tpu.obs import flight
+from sheeprl_tpu.obs.flight import FlightRecorder, fleet_event, tracing_setting
 from sheeprl_tpu.obs.telemetry import (
     TelemetrySink,
     device_memory_stats,
@@ -36,9 +38,13 @@ from sheeprl_tpu.obs.trace import ProfileScheduler, start_trace, stop_trace, tra
 from sheeprl_tpu.obs.xla_stats import RecompileMonitor, compiled_flops, mfu_percent, peak_flops
 
 __all__ = [
+    "FlightRecorder",
     "Observability",
+    "fleet_event",
+    "flight",
     "setup_observability",
     "trace_scope",
+    "tracing_setting",
     "start_trace",
     "stop_trace",
     "ProfileScheduler",
@@ -171,6 +177,15 @@ class Observability:
                 extra = {**(extra or {}), "mesh": self.mesh_stats()}
             except Exception:
                 pass
+        recorder = flight.get_recorder()
+        if recorder is not None:
+            # flight-recorder counters ride the telemetry under "trace",
+            # and the log cadence doubles as the recorder's flush beat
+            try:
+                extra = {**(extra or {}), "trace": recorder.stats()}
+                recorder.flush()
+            except Exception:
+                pass
         record = make_record(
             step=policy_step,
             train_step=train_step,
@@ -226,6 +241,9 @@ class Observability:
         """fsync buffered telemetry lines (preemption/emergency paths)."""
         if self.enabled and self.sink is not None:
             self.sink.flush()
+        recorder = flight.get_recorder()
+        if recorder is not None:
+            recorder.flush()
 
     def close(self) -> None:
         if not self.enabled:
@@ -271,4 +289,9 @@ def setup_observability(runtime, cfg, log_dir: Optional[str], logger: Any = None
         name=str(cfg.get("algo", {}).get("name", "run")),
     )
     obs.mesh_stats = getattr(runtime, "mesh_telemetry", None)
+    # flight recorder (ISSUE 13): the coupled loops get their process
+    # recorder here (role "main"); the decoupled loops configure their
+    # own role BEFORE calling this, which wins — first configure sticks
+    if flight.get_recorder() is None and tracing_setting(cfg) != "off":
+        flight.configure_from_cfg(cfg, role="main")
     return obs

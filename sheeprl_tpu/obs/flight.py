@@ -1,0 +1,480 @@
+"""Fleet flight recorder — per-process span/event tracing with cross-process
+trace-context propagation (ISSUE 13).
+
+PR 1's telemetry is strictly per-process: every process can report its own
+sps/timers/compiles, but none of the cross-process causal chains the fleet
+runs on — broadcast seq 41 leaving the trainer and landing on player 3, a
+retransmission storm preceding a rollback, a serve request's
+client→batch→reply lifecycle — is observable anywhere.  IMPALA/SEED-style
+decoupled topologies (Espeholt et al., 2018; 2020) live or die on exactly
+these latencies (actor→learner data age, learner→actor params staleness,
+inference round-trip), so this module gives every process a
+:class:`FlightRecorder` and makes the existing transports carry trace
+context:
+
+- **typed spans** (``collect``, ``train_dispatch``, ``batch_assembly``,
+  ``serve_batch``, ``replay_pump``, ``ckpt_write``) and **fleet events**
+  (broadcast publish/adopt with seq, retrans, rollback, breaker
+  transitions, supervisor respawns, join/shrink) recorded into a
+  per-process JSONL stream under ``<run_root>/flight/<role>.jsonl``;
+- **trace context over the wire**: payload frames carry a compact
+  ``(marker, role, trace_id, send_ts)`` tuple riding the established
+  frame ``extra`` slots (appended LAST, stripped at recv — the same
+  pattern as PR 10's digest slot, invisible to protocol code), so every
+  matched send/recv pair is two timestamped records in two streams;
+- **clock-offset estimation for free**: the matched pairs flow BOTH
+  directions (player→trainer data/hb frames, trainer→player params
+  broadcasts — the already-present join/hb handshake traffic), which is
+  exactly the NTP-style sample set the reader needs to estimate pairwise
+  clock offsets (min-RTT symmetric estimate, obs/report.py) and turn
+  cross-process latencies into real numbers instead of clock soup.
+
+``metric.tracing`` gates everything (default ``off``):
+
+- ``off`` — no recorder is ever constructed and the transport factories
+  build the UNDECORATED pre-PR channel classes (the PR-9/10 zero-overhead
+  pattern, type-identity asserted by test); the inline ``fleet_event``
+  hooks reduce to one module-global ``is None`` check;
+- ``sampled`` — the default for real runs: control-plane frames
+  (``params`` broadcasts, joins, checkpoints — low-rate, and the per-seq
+  fleet metrics need all of them) are traced completely; the DATA PLANE
+  (rollout ``data`` shards, ``infer_req``/``infer_rep``, ``rb_insert``,
+  heartbeats) is sampled 1-in-``metric.tracing_sample`` — clock-offset
+  estimation is a min over matched pairs, so sampled wire records lose
+  nothing there; pending records live in a bounded ring
+  (``metric.tracing_ring``) so a stalled disk can never grow memory;
+- ``full`` — every wire event recorded (tests/short investigations).
+
+Read the merged run with ``python -m sheeprl_tpu.obs.report <run_dir>``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+FLIGHT_SCHEMA = "sheeprl.flight/1"
+
+# wire marker: appended as the LAST element of a frame's ``extra`` tuple
+# by traced channels; receivers strip it before the frame reaches any
+# protocol code (so positional extra slots keep their meaning)
+TRACE_MARK = "__tr__"
+
+# control-plane tags are always traced (the per-seq fleet metrics need
+# every params broadcast and every join/checkpoint round — all low-rate,
+# once per update at most); the DATA PLANE — rollout shards, inference
+# traffic, replay inserts, heartbeats — is 1-in-N sampled in ``sampled``
+# mode.  Clock-offset estimation is a min over matched pairs, so sampled
+# wire records are exactly as good as complete ones there, and the
+# broadcast→adoption latency rides the publish/adopt EVENTS, which are
+# never sampled.
+_PROTOCOL_TAGS = frozenset(
+    {"params", "init", "assign", "join", "ckpt_req", "ckpt_state", "stop"}
+)
+
+_MODES = ("off", "sampled", "full")
+
+
+def tracing_setting(cfg) -> str:
+    """Resolve ``metric.tracing`` (env override ``SHEEPRL_TRACING``) to
+    ``off | sampled | full``."""
+    metric_cfg = cfg.get("metric", {}) if hasattr(cfg, "get") else {}
+    val = metric_cfg.get("tracing", "off") if hasattr(metric_cfg, "get") else "off"
+    env = os.environ.get("SHEEPRL_TRACING")
+    if env is not None:
+        val = env
+    s = str(val).strip().lower()
+    if s in ("off", "0", "false", "no", "none", ""):
+        return "off"
+    if s in ("full", "all", "2"):
+        return "full"
+    return "sampled"
+
+
+class FlightRecorder:
+    """One process's flight stream: bounded pending ring, chunked JSONL
+    writes, thread-safe (transport reader threads + serve threads record
+    concurrently with the loop)."""
+
+    def __init__(
+        self,
+        role: str,
+        path: Optional[str] = None,
+        *,
+        mode: str = "sampled",
+        sample_every: int = 8,
+        ring: int = 4096,
+        flush_chunk: int = 256,
+        flush_interval_s: float = 5.0,
+    ):
+        from sheeprl_tpu.obs.telemetry import TelemetrySink
+
+        self.role = str(role)
+        self.pid = os.getpid()
+        self.mode = mode if mode in _MODES else "sampled"
+        self.sample_every = max(1, int(sample_every)) if self.mode != "full" else 1
+        self.path = path
+        self._sink = TelemetrySink(path) if path else None
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._write_lock = threading.Lock()
+        self._pending: List[Dict[str, Any]] = []
+        self._ring = max(64, int(ring))
+        self._flush_chunk = max(1, int(flush_chunk))
+        self._flush_interval = float(flush_interval_s)
+        self._tid = 0
+        self._tag_counts: Dict[str, int] = {}
+        # stats (ride the lead's telemetry under the "trace" key)
+        self.records = 0
+        self.dropped = 0
+        self.sends = 0
+        self.recvs = 0
+        self.spans = 0
+        self.events = 0
+        self._closed = False
+        # JSON serialization + the write syscalls live on a background
+        # writer thread: the hot-path cost of a record is ONE short
+        # lock-protected list append (the paired tracing bench leg's <2%
+        # bound does not survive inline json.dumps bursts on the wire
+        # path; on a ping-pong the writer runs while the process would
+        # otherwise idle in recv)
+        self._writer: Optional[threading.Thread] = None
+        if self._sink is not None:
+            self._writer = threading.Thread(
+                target=self._writer_loop, name=f"sheeprl-flight-{self.role}", daemon=True
+            )
+            self._writer.start()
+        self._append(
+            {"k": "meta", "ts": time.time(), "mode": self.mode, "sample": self.sample_every}
+        )
+
+    # ----------------------------------------------------------- recording
+    def _append(self, rec: Dict[str, Any]) -> None:
+        rec["schema"] = FLIGHT_SCHEMA
+        rec["role"] = self.role
+        rec["pid"] = self.pid
+        with self._lock:
+            if self._closed:
+                return
+            self._pending.append(rec)
+            self.records += 1
+            if len(self._pending) > self._ring:
+                del self._pending[0]
+                self.dropped += 1
+            if len(self._pending) >= self._flush_chunk:
+                self._cond.notify()
+
+    def _writer_loop(self) -> None:
+        while True:
+            with self._lock:
+                self._cond.wait(timeout=self._flush_interval)
+                drained, self._pending = self._pending, []
+                closed = self._closed
+            self._write_out(drained)
+            if closed:
+                return
+
+    def _write_out(self, drained: List[Dict[str, Any]]) -> None:
+        if self._sink is None or not drained:
+            return
+        # serializes the writer thread against an emergency flush()
+        with self._write_lock:
+            for rec in drained:
+                try:
+                    self._sink.write(rec)
+                except OSError:
+                    self.dropped += 1
+
+    def span_done(self, name: str, t0: float, t1: float, attrs: Optional[Dict] = None) -> None:
+        self.spans += 1
+        rec: Dict[str, Any] = {"k": "span", "name": name, "t0": t0, "t1": t1}
+        if attrs:
+            rec["a"] = attrs
+        self._append(rec)
+
+    def event(self, name: str, **attrs) -> None:
+        self.events += 1
+        rec: Dict[str, Any] = {"k": "event", "name": name, "ts": time.time()}
+        if attrs:
+            rec["a"] = attrs
+        self._append(rec)
+
+    def _sampled(self, tag: str) -> bool:
+        """One shared 1-in-N decision per non-protocol tag (protocol tags
+        always pass — the per-seq fleet metrics need every round)."""
+        if self.sample_every <= 1 or tag in _PROTOCOL_TAGS:
+            return True
+        n = self._tag_counts.get(tag, 0)
+        self._tag_counts[tag] = n + 1
+        return n % self.sample_every == 0
+
+    def sampled_event(self, name: str, key: Optional[str] = None, **attrs) -> None:
+        """An event on a hot path: subject to the same 1-in-N gate as the
+        wire events (``key`` defaults to the event name)."""
+        if not self._sampled(key or name):
+            return
+        self.event(name, **attrs)
+
+    # ------------------------------------------------------------- tracing
+    def trace_send(self, tag: str, seq: int, nbytes: int) -> Optional[Tuple]:
+        """Record one wire send; returns the marker tuple to append to the
+        frame's ``extra`` (None when sampled out — the receiver then has
+        nothing to strip and records nothing, by construction)."""
+        if not self._sampled(tag):
+            return None
+        with self._lock:
+            self._tid += 1
+            tid = self._tid
+        ts = time.time()
+        self.sends += 1
+        self._append({"k": "send", "tag": tag, "seq": int(seq), "tid": tid, "ts": ts, "nb": nbytes})
+        return (TRACE_MARK, self.role, tid, ts)
+
+    def trace_recv(self, tag: str, seq: int, ctx: Tuple, nbytes: int) -> None:
+        """Record the matched receive of a marker-carrying frame."""
+        _, src_role, tid, ts_send = ctx
+        self.recvs += 1
+        self._append(
+            {
+                "k": "recv",
+                "tag": tag,
+                "seq": int(seq),
+                "tid": tid,
+                "src": src_role,
+                "ts_send": ts_send,
+                "ts": time.time(),
+                "nb": nbytes,
+            }
+        )
+
+    # ----------------------------------------------------------- lifecycle
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "role": self.role,
+            "mode": self.mode,
+            "records": self.records,
+            "dropped": self.dropped,
+            "sends": self.sends,
+            "recvs": self.recvs,
+            "spans": self.spans,
+            "events": self.events,
+            "path": self.path,
+        }
+
+    def flush(self) -> None:
+        """Synchronous drain + fsync (preemption/emergency paths — the
+        caller may be about to die, so the writer thread cannot be
+        trusted to get another slice)."""
+        with self._lock:
+            drained, self._pending = self._pending, []
+        self._write_out(drained)
+        if self._sink is not None:
+            self._sink.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            self._cond.notify()
+        if self._writer is not None and self._writer is not threading.current_thread():
+            self._writer.join(timeout=5.0)
+        with self._lock:
+            drained, self._pending = self._pending, []
+        self._write_out(drained)
+        if self._sink is not None:
+            self._sink.flush()
+            self._sink.close()
+
+
+# ------------------------------------------------------- process singleton
+_RECORDER: Optional[FlightRecorder] = None
+_ATEXIT_INSTALLED = False
+
+
+def get_recorder() -> Optional[FlightRecorder]:
+    return _RECORDER
+
+
+def _install_atexit() -> None:
+    """Flush-on-exit safety net: loops close their recorder explicitly,
+    but a process that exits early (preemption drain, fault injection)
+    must not lose the tail records that explain why."""
+    global _ATEXIT_INSTALLED
+    if _ATEXIT_INSTALLED:
+        return
+    import atexit
+
+    atexit.register(close_recorder)
+    _ATEXIT_INSTALLED = True
+
+
+def configure(
+    role: str,
+    flight_dir: Optional[str],
+    *,
+    mode: str = "sampled",
+    sample_every: int = 8,
+    ring: int = 4096,
+) -> Optional[FlightRecorder]:
+    """Install this process's recorder (replacing any previous one).
+    ``mode='off'`` tears down and installs nothing."""
+    global _RECORDER
+    if _RECORDER is not None:
+        _RECORDER.close()
+        _RECORDER = None
+    if mode == "off":
+        return None
+    path = None
+    if flight_dir:
+        os.makedirs(flight_dir, exist_ok=True)
+        path = os.path.join(flight_dir, f"{role}.jsonl")
+    _RECORDER = FlightRecorder(role, path, mode=mode, sample_every=sample_every, ring=ring)
+    _install_atexit()
+    return _RECORDER
+
+
+def configure_from_cfg(cfg, role: str) -> Optional[FlightRecorder]:
+    """Build the recorder for ``role`` from ``cfg.metric.tracing*``.  The
+    flight dir is derived from ``root_dir``/``run_name`` alone so EVERY
+    process of a decoupled run (lead, workers, trainer) can compute it
+    without coordination; the reader globs ``**/flight/*.jsonl`` anyway."""
+    mode = tracing_setting(cfg)
+    if mode == "off":
+        return None
+    metric_cfg = cfg.get("metric", {}) if hasattr(cfg, "get") else {}
+    flight_dir = os.path.join(str(cfg.root_dir), str(cfg.run_name), "flight")
+    return configure(
+        role,
+        flight_dir,
+        mode=mode,
+        sample_every=int(metric_cfg.get("tracing_sample", 8) or 1),
+        ring=int(metric_cfg.get("tracing_ring", 4096)),
+    )
+
+
+def close_recorder() -> None:
+    global _RECORDER
+    if _RECORDER is not None:
+        _RECORDER.close()
+        _RECORDER = None
+
+
+# ------------------------------------------------------------ cheap hooks
+def fleet_event(name: str, **attrs) -> None:
+    """Record a fleet event on this process's track.  One global ``is
+    None`` test when tracing is off — cheap enough for protocol code."""
+    rec = _RECORDER
+    if rec is not None:
+        rec.event(name, **attrs)
+
+
+def sampled_event(name: str, key: Optional[str] = None, **attrs) -> None:
+    rec = _RECORDER
+    if rec is not None:
+        rec.sampled_event(name, key, **attrs)
+
+
+class _Span:
+    __slots__ = ("_rec", "_name", "_attrs", "_t0")
+
+    def __init__(self, rec: FlightRecorder, name: str, attrs: Optional[Dict]):
+        self._rec = rec
+        self._name = name
+        self._attrs = attrs
+
+    def __enter__(self):
+        self._t0 = time.time()
+        return self
+
+    def __exit__(self, *exc):
+        self._rec.span_done(self._name, self._t0, time.time(), self._attrs)
+        return False
+
+
+class _NoopSpan:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOOP_SPAN = _NoopSpan()
+
+
+def span(name: str, **attrs):
+    """Context manager recording one typed span on this process's track
+    (no-op constant when tracing is off)."""
+    rec = _RECORDER
+    if rec is None:
+        return _NOOP_SPAN
+    return _Span(rec, name, attrs or None)
+
+
+# -------------------------------------------------------- traced channels
+# ``metric.tracing != off`` swaps these dynamically-built subclasses in
+# for the transport channel classes (the PR-10 integrity pattern: ``off``
+# constructs the UNDECORATED classes, zero overhead by construction,
+# type-identity asserted).  The traced ``send`` appends the trace marker
+# to the frame's extras; the traced ``recv`` strips it and records the
+# matched receive, so protocol code never sees the marker.
+_TRACED_CACHE: Dict[type, type] = {}
+
+
+def _strip_marker(extra: Tuple) -> Tuple[Tuple, Optional[Tuple]]:
+    if (
+        extra
+        and isinstance(extra[-1], tuple)
+        and len(extra[-1]) == 4
+        and extra[-1][0] == TRACE_MARK
+    ):
+        return extra[:-1], extra[-1]
+    return extra, None
+
+
+def traced_cls(base: type) -> type:
+    """The tracing variant of a Channel class (cached per base)."""
+    cls = _TRACED_CACHE.get(base)
+    if cls is not None:
+        return cls
+
+    def send(self, tag, arrays=None, extra=(), seq=-1, timeout=600.0):
+        rec = _RECORDER
+        if rec is not None and not tag.startswith("__"):
+            nbytes = sum(int(a.nbytes) for _, a in arrays) if arrays else 0
+            ctx = rec.trace_send(tag, seq, nbytes)
+            if ctx is not None:
+                extra = tuple(extra) + (ctx,)
+        return base.send(self, tag, arrays=arrays, extra=extra, seq=seq, timeout=timeout)
+
+    def recv(self, timeout):
+        frame = base.recv(self, timeout)
+        stripped, ctx = _strip_marker(frame.extra)
+        if ctx is not None:
+            frame.extra = stripped
+            rec = _RECORDER
+            if rec is not None:
+                nbytes = sum(int(v.nbytes) for v in frame.arrays.values())
+                rec.trace_recv(frame.tag, frame.seq, ctx, nbytes)
+        return frame
+
+    cls = type(
+        "Traced" + base.__name__,
+        (base,),
+        {"send": send, "recv": recv, "__module__": __name__},
+    )
+    _TRACED_CACHE[base] = cls
+    return cls
+
+
+def channel_cls(base: type, tracing: str) -> type:
+    """Transport-factory helper: the class to construct for ``tracing``
+    (``off`` returns ``base`` itself — the undecorated object)."""
+    if not tracing or tracing == "off":
+        return base
+    return traced_cls(base)

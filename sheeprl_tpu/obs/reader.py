@@ -1,0 +1,146 @@
+"""One schema-tolerant reader for every JSONL stream the framework emits.
+
+Telemetry parsing had quietly been re-implemented five times — the chaos
+soak's audit helpers, the transport/serve/replay test helpers, the bench
+harness — each with its own glob + ``json.loads`` + key-walk loop and its
+own silent-skip semantics.  This module is the ONE implementation they
+all share:
+
+- :func:`iter_jsonl` / :func:`read_jsonl` / :func:`last_jsonl` — parse one
+  file, skipping blank and corrupt lines (a crash mid-write leaves a torn
+  tail line; a reader must shrug, not raise);
+- :func:`key_path` — dotted-path lookup (``"transport.supervisor.restarts"``)
+  with a default, tolerant of missing intermediate keys and non-dict hops;
+- :func:`telemetry_files` / :func:`iter_run_records` — every
+  ``telemetry.jsonl`` under a run root (rotated ``.1`` backups included,
+  oldest first) and a flat record iterator over them;
+- :func:`collect_key` — all values of one dotted key across a run;
+- :func:`flight_files` / :func:`read_flight` — the flight-recorder streams
+  (``**/flight/*.jsonl``, obs/flight.py) a run's processes wrote.
+
+Everything here is stdlib-only (no jax import) so the ``obs.report`` CLI
+and the chaos-soak audits stay fast to start.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Any, Dict, Iterable, Iterator, List, Optional
+
+__all__ = [
+    "collect_key",
+    "flight_files",
+    "iter_jsonl",
+    "iter_run_records",
+    "key_path",
+    "last_jsonl",
+    "read_flight",
+    "read_jsonl",
+    "telemetry_files",
+]
+
+
+def iter_jsonl(path: str) -> Iterator[Dict[str, Any]]:
+    """Yield each parseable JSON object in ``path``; blank lines, torn
+    tail lines and non-object rows are skipped (schema tolerance: a
+    reader of crash-era telemetry must never raise on the file that
+    explains the crash)."""
+    try:
+        f = open(path)
+    except OSError:
+        return
+    with f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict):
+                yield rec
+
+
+def read_jsonl(path: str) -> List[Dict[str, Any]]:
+    return list(iter_jsonl(path))
+
+
+def last_jsonl(path: str) -> Optional[Dict[str, Any]]:
+    last = None
+    for rec in iter_jsonl(path):
+        last = rec
+    return last
+
+
+def key_path(record: Any, path: str, default: Any = None) -> Any:
+    """Dotted-path lookup: ``key_path(rec, "transport.health.skips", 0)``.
+    Returns ``default`` when any hop is missing or not a mapping."""
+    cur = record
+    for part in path.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return default
+        cur = cur[part]
+    return cur
+
+
+def _with_backups(paths: Iterable[str]) -> List[str]:
+    """Each file preceded by its rotated ``.1`` backup (older records
+    first), keeping the caller's file order."""
+    out: List[str] = []
+    for p in paths:
+        if os.path.exists(p + ".1"):
+            out.append(p + ".1")
+        out.append(p)
+    return out
+
+
+def telemetry_files(root_dir: str, include_backups: bool = False) -> List[str]:
+    """Every ``telemetry.jsonl`` under ``root_dir``, oldest-modified
+    first (the chaos audits want the LAST record of the NEWEST file to
+    win a max/last reduction)."""
+    paths = sorted(
+        glob.glob(os.path.join(root_dir, "**", "telemetry.jsonl"), recursive=True),
+        key=os.path.getmtime,
+    )
+    return _with_backups(paths) if include_backups else paths
+
+
+def iter_run_records(root_dir: str, include_backups: bool = False) -> Iterator[Dict[str, Any]]:
+    """Every telemetry record of a run, file by file (oldest first)."""
+    for path in telemetry_files(root_dir, include_backups=include_backups):
+        yield from iter_jsonl(path)
+
+
+def collect_key(root_dir: str, path: str, *, include_backups: bool = False) -> List[Any]:
+    """All values of dotted key ``path`` present across a run's telemetry
+    (records without the key are skipped, not None-padded)."""
+    _MISSING = object()
+    out = []
+    for rec in iter_run_records(root_dir, include_backups=include_backups):
+        val = key_path(rec, path, _MISSING)
+        if val is not _MISSING:
+            out.append(val)
+    return out
+
+
+# ------------------------------------------------------------- flight side
+def flight_files(run_dir: str) -> List[str]:
+    """Every flight-recorder stream under ``run_dir`` (obs/flight.py
+    writes ``<root>/<run_name>/flight/<role>.jsonl``; the lead's copy may
+    sit one version-dir deeper — the recursive glob finds both)."""
+    return sorted(
+        glob.glob(os.path.join(run_dir, "**", "flight", "*.jsonl"), recursive=True),
+        key=os.path.getmtime,
+    )
+
+
+def read_flight(run_dir: str) -> List[Dict[str, Any]]:
+    """All flight records of a run, concatenated (each record carries its
+    own ``role``/``pid``, so file identity does not matter)."""
+    out: List[Dict[str, Any]] = []
+    for path in flight_files(run_dir):
+        out.extend(iter_jsonl(path))
+    return out

@@ -1,0 +1,499 @@
+"""Merge a run's per-process flight streams into ONE correlated timeline.
+
+Every process of a run records spans/events/wire-traces into its own
+``flight/<role>.jsonl`` (obs/flight.py).  This module is the lead-side
+aggregator that turns those N clocks into one timeline:
+
+1. **pairwise clock-offset estimation** — matched send/recv pairs flow in
+   BOTH directions between each player and the trainer (data/hb frames
+   forward, params broadcasts back), so for each role pair the classic
+   NTP-style symmetric estimate applies: with ``d_ab`` the MINIMUM
+   observed ``recv_ts - send_ts`` for a→b frames and ``d_ba`` the same
+   for b→a, ``offset(b) - offset(a) = (d_ab - d_ba) / 2`` (exact when the
+   two min-latency paths are symmetric; the residual is bounded by the
+   one-way latency asymmetry, reported as ``rtt_bound``).  Offsets are
+   propagated over the pair graph from a reference role (the trainer),
+   and every timestamp is corrected before any cross-process subtraction
+   — latencies come out as real numbers, not clock soup;
+2. **fleet metrics no single process can compute** — per-seq
+   broadcast→adoption latency (the MEASURED params staleness behind the
+   fixed/soft-lag contracts), serve request lifecycle split by
+   remote/local/retry/hedge outcome, replay insert→first-sample age, and
+   rollback propagation time (sentinel trip → every player adopting the
+   restored params);
+3. **perfetto export** — ``trace.json`` in the Chrome trace-event format
+   (one track per process; spans as complete events, fleet events as
+   instant annotations on the offending track, params broadcasts as flow
+   arrows), loadable in https://ui.perfetto.dev or ``chrome://tracing``.
+
+CLI::
+
+    python -m sheeprl_tpu.obs.report <run_dir> [--out trace.json] [--json summary.json]
+
+stdlib-only (no jax): starts in milliseconds, runs on any laptop.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+from sheeprl_tpu.obs.reader import read_flight
+
+__all__ = ["estimate_offsets", "fleet_metrics", "generate_report", "main", "to_chrome_trace"]
+
+# event names rendered as instant ANNOTATIONS on the perfetto track (the
+# sentinel/integrity/supervisor vocabulary; everything else is cat=fleet)
+ANNOTATION_EVENTS = frozenset(
+    {
+        "rollback",
+        "sentinel_skip",
+        "sentinel_rollback",
+        "net_drop",
+        "reconnect",
+        "readopt",
+        "broadcast_replay",
+        "retrans_request",
+        "retrans_serve",
+        "retrans_failed",
+        "frame_corrupt_dropped",
+        "params_digest_skip",
+        "insert_quarantined",
+        "player_dead",
+        "player_join",
+        "player_rejoin",
+        "supervisor_respawn",
+        "server_respawn",
+        "breaker",
+    }
+)
+
+
+def _percentiles(vals: List[float]) -> Dict[str, float]:
+    if not vals:
+        return {}
+    xs = sorted(vals)
+
+    def q(p: float) -> float:
+        i = min(int(p * (len(xs) - 1) + 0.5), len(xs) - 1)
+        return xs[i]
+
+    return {
+        "n": len(xs),
+        "p50": round(q(0.50), 6),
+        "p95": round(q(0.95), 6),
+        "max": round(xs[-1], 6),
+    }
+
+
+# ------------------------------------------------------------ clock offsets
+def estimate_offsets(
+    records: List[Dict[str, Any]], ref: Optional[str] = None
+) -> Dict[str, Any]:
+    """Per-role clock offsets relative to ``ref`` (default: ``trainer``
+    when present, else the role with the most peer links).
+
+    Returns ``{"ref": role, "offset_s": {role: off}, "pairs": {...},
+    "unlinked": [...]}`` where ``t_corrected = t_local - offset_s[role]``.
+    """
+    roles = sorted({r.get("role") for r in records if r.get("role")})
+    # min observed one-way delta per DIRECTED pair (src -> dst)
+    deltas: Dict[Tuple[str, str], float] = {}
+    for r in records:
+        if r.get("k") != "recv":
+            continue
+        src, dst = r.get("src"), r.get("role")
+        if not src or not dst or src == dst:
+            continue
+        try:
+            d = float(r["ts"]) - float(r["ts_send"])
+        except (KeyError, TypeError, ValueError):
+            continue
+        key = (src, dst)
+        if key not in deltas or d < deltas[key]:
+            deltas[key] = d
+    # undirected pair graph where BOTH directions were observed
+    pair_offset: Dict[Tuple[str, str], float] = {}  # (a, b) -> offset_b - offset_a
+    pair_rtt: Dict[Tuple[str, str], float] = {}
+    links: Dict[str, List[str]] = {role: [] for role in roles}
+    for (a, b), d_ab in deltas.items():
+        if (b, a) not in deltas or (b, a) in pair_offset:
+            continue
+        d_ba = deltas[(b, a)]
+        pair_offset[(a, b)] = (d_ab - d_ba) / 2.0
+        pair_rtt[(a, b)] = d_ab + d_ba
+        links[a].append(b)
+        links[b].append(a)
+    if ref is None:
+        ref = "trainer" if "trainer" in roles else None
+        if ref is None and roles:
+            ref = max(roles, key=lambda r: len(links.get(r, [])))
+    offsets: Dict[str, float] = {}
+    if ref is not None:
+        offsets[ref] = 0.0
+        frontier = [ref]
+        while frontier:
+            a = frontier.pop()
+            for b in links.get(a, []):
+                if b in offsets:
+                    continue
+                if (a, b) in pair_offset:
+                    offsets[b] = offsets[a] + pair_offset[(a, b)]
+                else:
+                    offsets[b] = offsets[a] - pair_offset[(b, a)]
+                frontier.append(b)
+    unlinked = [role for role in roles if role not in offsets]
+    for role in unlinked:
+        offsets[role] = 0.0  # no two-way traffic: best effort, flagged
+    return {
+        "ref": ref,
+        "offset_s": {k: round(v, 6) for k, v in offsets.items()},
+        "pairs": {
+            f"{a}->{b}": {"offset_s": round(off, 6), "rtt_bound_s": round(pair_rtt[(a, b)], 6)}
+            for (a, b), off in sorted(pair_offset.items())
+        },
+        "unlinked": unlinked,
+    }
+
+
+def _corr(ts: float, role: str, offsets: Dict[str, float]) -> float:
+    return float(ts) - offsets.get(role, 0.0)
+
+
+# ------------------------------------------------------------ fleet metrics
+def _events(records, name):
+    return [r for r in records if r.get("k") == "event" and r.get("name") == name]
+
+
+def fleet_metrics(records: List[Dict[str, Any]], clock: Dict[str, Any]) -> Dict[str, Any]:
+    """The cross-process numbers no single stream can produce (clock
+    offsets already estimated in ``clock``)."""
+    off = clock["offset_s"]
+
+    # --- per-seq broadcast -> adoption latency (measured params staleness)
+    publishes: Dict[int, Tuple[str, float]] = {}
+    for r in _events(records, "broadcast_publish"):
+        a = r.get("a") or {}
+        if a.get("tag", "params") == "params" and a.get("seq") is not None:
+            seq = int(a["seq"])
+            if seq not in publishes:  # rollback re-broadcasts keep the first publish
+                publishes[seq] = (r["role"], _corr(r["ts"], r["role"], off))
+    broadcast: Dict[str, Any] = {}
+    lat_all: List[float] = []
+    for r in _events(records, "broadcast_adopt"):
+        a = r.get("a") or {}
+        if a.get("seq") is None:
+            continue
+        seq = int(a["seq"])
+        pub = publishes.get(seq)
+        if pub is None:
+            continue
+        lat = _corr(r["ts"], r["role"], off) - pub[1]
+        entry = broadcast.setdefault(str(seq), {"publish_role": pub[0], "adopt_latency_s": {}})
+        entry["adopt_latency_s"][r["role"]] = round(lat, 6)
+        lat_all.append(lat)
+    # --- serve request lifecycle (client-side outcomes)
+    serve_by_outcome: Dict[str, int] = {}
+    serve_lat: List[float] = []
+    for r in _events(records, "serve_request"):
+        a = r.get("a") or {}
+        key = a.get("source", "?")
+        if a.get("retries"):
+            key += "+retry"
+        if a.get("hedged"):
+            key += "+hedge"
+        serve_by_outcome[key] = serve_by_outcome.get(key, 0) + 1
+        if a.get("lat_s") is not None:
+            serve_lat.append(float(a["lat_s"]))
+    serve_spans = [r for r in records if r.get("k") == "span" and r.get("name") == "serve_batch"]
+
+    # --- replay insert -> first-sample age (server-local: one clock)
+    inserts = sorted(_events(records, "replay_insert"), key=lambda r: r["ts"])
+    samples = sorted(_events(records, "replay_sample"), key=lambda r: r["ts"])
+    ages: List[float] = []
+    si = 0
+    for ins in inserts:
+        while si < len(samples) and samples[si]["ts"] < ins["ts"]:
+            si += 1
+        if si < len(samples):
+            ages.append(samples[si]["ts"] - ins["ts"])
+
+    # --- rollback propagation: trip -> every player on restored params
+    rollbacks = []
+    for r in _events(records, "rollback") + _events(records, "sentinel_rollback"):
+        a = r.get("a") or {}
+        rnd = a.get("round")
+        t0 = _corr(r["ts"], r["role"], off)
+        prop: Dict[str, float] = {}
+        if rnd is not None:
+            for ad in _events(records, "broadcast_adopt"):
+                aa = ad.get("a") or {}
+                if aa.get("seq") is None or int(aa["seq"]) < int(rnd):
+                    continue
+                t1 = _corr(ad["ts"], ad["role"], off)
+                if t1 >= t0 and ad["role"] not in prop:
+                    prop[ad["role"]] = round(t1 - t0, 6)
+        rollbacks.append(
+            {"role": r["role"], "round": rnd, "name": r["name"], "propagation_s": prop}
+        )
+
+    # --- annotation/event census per role (the storm-spotting table)
+    event_counts: Dict[str, Dict[str, int]] = {}
+    for r in records:
+        if r.get("k") != "event":
+            continue
+        by_role = event_counts.setdefault(r["name"], {})
+        by_role[r["role"]] = by_role.get(r["role"], 0) + 1
+
+    span_summary: Dict[str, Dict[str, Any]] = {}
+    for r in records:
+        if r.get("k") != "span":
+            continue
+        s = span_summary.setdefault(r["name"], {"n": 0, "total_s": 0.0})
+        s["n"] += 1
+        s["total_s"] = round(s["total_s"] + (float(r["t1"]) - float(r["t0"])), 6)
+
+    return {
+        "broadcast": {
+            "published": len(publishes),
+            "per_seq": broadcast,
+            "adoption_latency_s": _percentiles(lat_all),
+        },
+        "serve": {
+            "requests_by_outcome": serve_by_outcome,
+            "request_latency_s": _percentiles(serve_lat),
+            "batches": len(serve_spans),
+        },
+        "replay": {"insert_to_first_sample_s": _percentiles(ages)},
+        "rollbacks": rollbacks,
+        "events": event_counts,
+        "spans": span_summary,
+    }
+
+
+# ---------------------------------------------------------- perfetto export
+def _role_order(roles: List[str]) -> List[str]:
+    def key(role: str):
+        if role == "trainer":
+            return (0, role)
+        if role.startswith("player"):
+            return (1, role)
+        return (2, role)
+
+    return sorted(roles, key=key)
+
+
+def to_chrome_trace(
+    records: List[Dict[str, Any]], clock: Dict[str, Any]
+) -> Dict[str, Any]:
+    """Chrome trace-event / perfetto-loadable JSON: one process track per
+    role, spans as complete ('X') events, fleet events as instant ('i')
+    annotations, matched params send/recv pairs as flow ('s'/'f') arrows."""
+    off = clock["offset_s"]
+    roles = _role_order(sorted({r["role"] for r in records if r.get("role")}))
+    pids = {role: i + 1 for i, role in enumerate(roles)}
+    stamped = [r for r in records if r.get("ts") is not None or r.get("t0") is not None]
+    if not stamped:
+        return {"traceEvents": [], "displayTimeUnit": "ms"}
+    t_base = min(
+        _corr(r["ts"] if r.get("ts") is not None else r["t0"], r.get("role", ""), off)
+        for r in stamped
+    )
+
+    def us(ts: float, role: str) -> float:
+        return round((_corr(ts, role, off) - t_base) * 1e6, 1)
+
+    events: List[Dict[str, Any]] = []
+    for role in roles:
+        events.append(
+            {"ph": "M", "name": "process_name", "pid": pids[role], "tid": 0, "args": {"name": role}}
+        )
+        events.append(
+            {
+                "ph": "M",
+                "name": "process_sort_index",
+                "pid": pids[role],
+                "tid": 0,
+                "args": {"sort_index": pids[role]},
+            }
+        )
+    flow_id = 0
+    open_flows: Dict[Tuple[str, int], int] = {}  # (src_role, tid) -> flow id
+    for r in records:
+        role = r.get("role")
+        if role not in pids:
+            continue
+        pid = pids[role]
+        kind = r.get("k")
+        if kind == "span":
+            events.append(
+                {
+                    "ph": "X",
+                    "name": r["name"],
+                    "cat": "span",
+                    "pid": pid,
+                    "tid": 0,
+                    "ts": us(r["t0"], role),
+                    "dur": round(max(float(r["t1"]) - float(r["t0"]), 0.0) * 1e6, 1),
+                    "args": r.get("a") or {},
+                }
+            )
+        elif kind == "event":
+            events.append(
+                {
+                    "ph": "i",
+                    "s": "p",
+                    "name": r["name"],
+                    "cat": "annotation" if r["name"] in ANNOTATION_EVENTS else "fleet",
+                    "pid": pid,
+                    "tid": 0,
+                    "ts": us(r["ts"], role),
+                    "args": r.get("a") or {},
+                }
+            )
+        elif kind == "send":
+            events.append(
+                {
+                    "ph": "i",
+                    "s": "t",
+                    "name": f"send:{r.get('tag')}",
+                    "cat": "wire",
+                    "pid": pid,
+                    "tid": 0,
+                    "ts": us(r["ts"], role),
+                    "args": {"seq": r.get("seq"), "bytes": r.get("nb")},
+                }
+            )
+            if r.get("tag") == "params":
+                flow_id += 1
+                open_flows[(role, r.get("tid"))] = flow_id
+                events.append(
+                    {
+                        "ph": "s",
+                        "name": "params",
+                        "cat": "flow",
+                        "id": flow_id,
+                        "pid": pid,
+                        "tid": 0,
+                        "ts": us(r["ts"], role),
+                    }
+                )
+        elif kind == "recv":
+            events.append(
+                {
+                    "ph": "i",
+                    "s": "t",
+                    "name": f"recv:{r.get('tag')}",
+                    "cat": "wire",
+                    "pid": pid,
+                    "tid": 0,
+                    "ts": us(r["ts"], role),
+                    "args": {"seq": r.get("seq"), "src": r.get("src"), "bytes": r.get("nb")},
+                }
+            )
+            fid = open_flows.get((r.get("src"), r.get("tid")))
+            if fid is not None and r.get("tag") == "params":
+                events.append(
+                    {
+                        "ph": "f",
+                        "bp": "e",
+                        "name": "params",
+                        "cat": "flow",
+                        "id": fid,
+                        "pid": pid,
+                        "tid": 0,
+                        "ts": us(r["ts"], role),
+                    }
+                )
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+# -------------------------------------------------------------------- CLI
+def generate_report(run_dir: str, out: Optional[str] = None) -> Dict[str, Any]:
+    """Read every flight stream under ``run_dir``, merge, write the
+    perfetto trace and return the summary dict."""
+    records = read_flight(run_dir)
+    clock = estimate_offsets(records)
+    metrics = fleet_metrics(records, clock)
+    trace = to_chrome_trace(records, clock)
+    out = out or os.path.join(run_dir, "trace.json")
+    with open(out, "w") as f:
+        json.dump(trace, f)
+    roles = sorted({r["role"] for r in records if r.get("role")})
+    return {
+        "run_dir": run_dir,
+        "trace_json": out,
+        "records": len(records),
+        "roles": roles,
+        "clock": clock,
+        "metrics": metrics,
+    }
+
+
+def _print_summary(summary: Dict[str, Any]) -> None:
+    m = summary["metrics"]
+    print(f"flight report: {summary['records']} records from {len(summary['roles'])} "
+          f"process stream(s) under {summary['run_dir']}")
+    print(f"  roles: {', '.join(summary['roles']) or '(none)'}")
+    clock = summary["clock"]
+    if clock["offset_s"]:
+        offs = ", ".join(f"{r}={v * 1e3:+.3f}ms" for r, v in sorted(clock["offset_s"].items()))
+        print(f"  clock offsets (ref {clock['ref']}): {offs}")
+        if clock["unlinked"]:
+            print(f"  WARNING: no two-way traffic for {clock['unlinked']} (offset assumed 0)")
+    bl = m["broadcast"]["adoption_latency_s"]
+    if bl:
+        print(
+            f"  broadcast->adoption latency: p50 {bl['p50'] * 1e3:.2f}ms  "
+            f"p95 {bl['p95'] * 1e3:.2f}ms  max {bl['max'] * 1e3:.2f}ms  "
+            f"(n={bl['n']}, {m['broadcast']['published']} broadcasts)"
+        )
+    if m["serve"]["requests_by_outcome"]:
+        print(f"  serve outcomes: {m['serve']['requests_by_outcome']}  "
+              f"latency {m['serve']['request_latency_s']}")
+    ra = m["replay"]["insert_to_first_sample_s"]
+    if ra:
+        print(f"  replay insert->first-sample age: p50 {ra['p50'] * 1e3:.2f}ms max {ra['max'] * 1e3:.2f}ms")
+    for rb in m["rollbacks"]:
+        print(f"  rollback ({rb['name']}, round {rb['round']}): propagation {rb['propagation_s']}")
+    if m["events"]:
+        print("  events by track:")
+        for name, by_role in sorted(m["events"].items()):
+            print(f"    {name:24s} {by_role}")
+    if m["spans"]:
+        print("  spans:")
+        for name, s in sorted(m["spans"].items()):
+            print(f"    {name:24s} n={s['n']:<6d} total={s['total_s']:.3f}s")
+    print(f"  perfetto trace: {summary['trace_json']} "
+          f"({len(json.load(open(summary['trace_json']))['traceEvents'])} events) — "
+          "load in https://ui.perfetto.dev")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("run_dir", help="run root holding flight/*.jsonl streams")
+    ap.add_argument("--out", default=None, help="trace.json path (default <run_dir>/trace.json)")
+    ap.add_argument("--json", default=None, help="also write the summary dict as JSON here")
+    args = ap.parse_args(argv)
+    if not os.path.isdir(args.run_dir):
+        print(f"error: {args.run_dir} is not a directory", file=sys.stderr)
+        return 2
+    summary = generate_report(args.run_dir, out=args.out)
+    _print_summary(summary)
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(summary, f, indent=2)
+    if not summary["records"]:
+        print(
+            "no flight records found — was the run started with metric.tracing=sampled|full?",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

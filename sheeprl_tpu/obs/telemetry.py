@@ -26,10 +26,16 @@ import time
 from typing import Any, Dict, List, Optional
 
 TELEMETRY_SCHEMA_VERSION = 1
+# versioned schema stamp carried by EVERY record (ISSUE 13): readers
+# route on the string ("sheeprl.telemetry/1", "sheeprl.flight/1", ...)
+# instead of guessing from key shapes; bump the suffix on breaking
+# layout changes.  "v" stays for pre-13 consumers.
+TELEMETRY_SCHEMA = f"sheeprl.telemetry/{TELEMETRY_SCHEMA_VERSION}"
 
 # field -> allowed python types after json round-trip (None = nullable)
 _NUM = (int, float)
 TELEMETRY_REQUIRED_FIELDS: Dict[str, tuple] = {
+    "schema": (str,),
     "v": (int,),
     "ts": _NUM,
     "step": (int,),
@@ -61,6 +67,8 @@ def validate_record(record: Any) -> List[str]:
             )
     if not errors and record["v"] != TELEMETRY_SCHEMA_VERSION:
         errors.append(f"schema version {record['v']} != {TELEMETRY_SCHEMA_VERSION}")
+    if not errors and record["schema"] != TELEMETRY_SCHEMA:
+        errors.append(f"schema {record['schema']!r} != {TELEMETRY_SCHEMA!r}")
     return errors
 
 
@@ -198,6 +206,7 @@ def make_record(
     """Assemble a schema-valid telemetry record (single source of truth for
     the field set — keep in sync with TELEMETRY_REQUIRED_FIELDS)."""
     record: Dict[str, Any] = {
+        "schema": TELEMETRY_SCHEMA,
         "v": TELEMETRY_SCHEMA_VERSION,
         "ts": round(time.time(), 3),
         "step": int(step),

@@ -57,6 +57,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from sheeprl_tpu.obs import flight
 from sheeprl_tpu.parallel.shm_ring import ShmReceiver, ShmSender
 from sheeprl_tpu.replay.service import RB_CREDIT_TAG, RB_INSERT_TAG
 from sheeprl_tpu.resilience.faults import get_injector, maybe_drop_or_delay_send
@@ -717,6 +718,7 @@ class TcpChannel(Channel):
             self._inbox.put(f)
         if self._reader is None or not self._reader.is_alive():
             self._start_reader()
+        flight.fleet_event("readopt", player=self._player_id)
         self._resend_last_broadcast(sock)
 
     def _resend_last_broadcast(self, sock: socket.socket) -> None:
@@ -757,6 +759,7 @@ class TcpChannel(Channel):
                     self._credits = self._window
                     self._cond.notify_all()
                 _shutdown_close(old)
+                flight.fleet_event("reconnect", who=self.who)
                 return True
             self._mark_dead(f"reconnect failed after {type(err).__name__}: {err}")
             return False
@@ -834,6 +837,7 @@ class TcpChannel(Channel):
             if inj.fire("net_delay"):
                 time.sleep(inj.arg("net_delay"))
             if inj.fire("net_drop"):
+                flight.fleet_event("net_drop", who=self.who)
                 self._drop_connection()
         arrays = [(k, np.asarray(v)) for k, v in arrays] if arrays else None
         crc: Optional[int] = None
@@ -1006,6 +1010,7 @@ class _ResendRing:
         if self._payload_digest(arrays, self._coverage) != crc:
             return  # mutated since the original send: refuse (see above)
         self._istats.retrans_served += 1
+        flight.fleet_event("retrans_serve", tag=tag, seq=int(seq))
         self._resend_now(tag, int(seq), extra, arrays, crc)
 
     def _resend_now(self, tag: str, seq: int, extra, arrays, crc: int) -> None:
@@ -1031,6 +1036,7 @@ class _QueueIntegrityMixin(_ResendRing):
     # ------------------------------------------------------------- sending
     def _request_retrans(self, tag: str, seq: int) -> None:
         self._istats.retrans_requested += 1
+        flight.fleet_event("retrans_request", tag=tag, seq=int(seq))
         self._awaiting = [tag, int(seq), time.monotonic() + _RETRANS_TIMEOUT_S, 0]
         try:
             _put_with_peer(
@@ -1048,6 +1054,7 @@ class _QueueIntegrityMixin(_ResendRing):
         tag, seq = self._awaiting[0], self._awaiting[1]
         self._awaiting = None
         self._istats.retrans_failed += 1
+        flight.fleet_event("retrans_failed", tag=tag, seq=int(seq))
         self._held.sort(key=lambda f: f.seq)
         self._iq_ready.extend(self._held)
         self._held = []
@@ -1336,6 +1343,7 @@ class CrcTcpChannel(_ResendRing, TcpChannel):
     # ------------------------------------------------------------ receiving
     def _request_tcp_retrans(self, tag: str, seq: int, retries: int = 0) -> None:
         self._istats.retrans_requested += 1
+        flight.fleet_event("retrans_request", tag=tag, seq=int(seq))
         with self._await_lock:
             self._tcp_await = [tag, int(seq), time.monotonic() + _RETRANS_TIMEOUT_S, retries]
         try:
@@ -1360,6 +1368,7 @@ class CrcTcpChannel(_ResendRing, TcpChannel):
                 return
             self._tcp_await = None
         self._istats.retrans_failed += 1
+        flight.fleet_event("retrans_failed", tag=aw[0], seq=int(aw[1]))
         self._flush_tcp_held()
         self._inbox.put(
             Frame("__corrupt__", extra=(aw[0], aw[1], "retransmission never arrived"))
@@ -1519,6 +1528,7 @@ class TcpListener:
         compress_min: int = 0,
         integrity: str = "off",
         max_frame_bytes: int = TCP_MAX_FRAME_BYTES,
+        tracing: str = "off",
     ):
         self._srv = socket.create_server((host, port), backlog=64)
         self._srv.settimeout(0.5)
@@ -1526,6 +1536,7 @@ class TcpListener:
         self._window = window
         self._compress_min = compress_min
         self._integrity = str(integrity)
+        self._tracing = str(tracing)
         self._max_frame_bytes = int(max_frame_bytes)
         self._channels: Dict[int, TcpChannel] = {}
         self._cond = threading.Condition()
@@ -1564,7 +1575,9 @@ class TcpListener:
                 if existing is not None:
                     existing.adopt_socket(sock)
                 else:
-                    cls = CrcTcpChannel if self._integrity != "off" else TcpChannel
+                    cls = flight.channel_cls(
+                        CrcTcpChannel if self._integrity != "off" else TcpChannel, self._tracing
+                    )
                     self._channels[pid] = cls(
                         sock=sock,
                         player_id=pid,
@@ -1624,6 +1637,7 @@ class ChannelSpec:
         poll_s: float = 0.5,
         integrity: str = "off",
         max_frame_bytes: int = TCP_MAX_FRAME_BYTES,
+        tracing: str = "off",
     ):
         self.backend = backend
         self.player_id = int(player_id)
@@ -1638,14 +1652,17 @@ class ChannelSpec:
         self.poll_s = poll_s
         self.integrity = integrity
         self.max_frame_bytes = int(max_frame_bytes)
+        self.tracing = tracing
 
     def player_channel(self, peer_alive=None, who: str = "trainer") -> Channel:
         """Build the player-side endpoint (call INSIDE the child).  With
         ``integrity=off`` the UNDECORATED pre-integrity classes are
-        constructed — zero overhead by construction (PR-9 pattern)."""
+        constructed — zero overhead by construction (PR-9 pattern); the
+        same holds for ``tracing=off`` vs the flight-traced variants."""
         crc = getattr(self, "integrity", "off") != "off"
+        tracing = getattr(self, "tracing", "off")
         if self.backend == "tcp":
-            cls = CrcTcpChannel if crc else TcpChannel
+            cls = flight.channel_cls(CrcTcpChannel if crc else TcpChannel, tracing)
             return cls(
                 address=self.address,
                 player_id=self.player_id,
@@ -1658,7 +1675,7 @@ class ChannelSpec:
                 max_frame_bytes=getattr(self, "max_frame_bytes", TCP_MAX_FRAME_BYTES),
             )
         if self.backend == "shm":
-            cls = CrcShmChannel if crc else ShmChannel
+            cls = flight.channel_cls(CrcShmChannel if crc else ShmChannel, tracing)
             return cls(
                 self.to_trainer_q,
                 self.to_player_q,
@@ -1670,7 +1687,7 @@ class ChannelSpec:
                 who=who,
                 poll_s=self.poll_s,
             )
-        cls = CrcQueueChannel if crc else QueueChannel
+        cls = flight.channel_cls(CrcQueueChannel if crc else QueueChannel, tracing)
         return cls(
             self.to_trainer_q, self.to_player_q, peer_alive=peer_alive, who=who, poll_s=self.poll_s
         )
@@ -1692,6 +1709,7 @@ class TransportHub:
         poll_s: float = 0.5,
         integrity: str = "off",
         max_frame_bytes: int = TCP_MAX_FRAME_BYTES,
+        tracing: str = "off",
     ):
         self.backend = backend
         self._listener = listener
@@ -1703,6 +1721,7 @@ class TransportHub:
         self._poll_s = poll_s
         self._integrity = integrity
         self._max_frame_bytes = int(max_frame_bytes)
+        self._tracing = tracing
 
     def channel(self, player_id: int, timeout: float = 120.0, peer_alive=None) -> Channel:
         if self._listener is not None and player_id not in self._channels:
@@ -1732,6 +1751,7 @@ class TransportHub:
                 poll_s=self._poll_s,
                 integrity=self._integrity,
                 max_frame_bytes=self._max_frame_bytes,
+                tracing=self._tracing,
             )
         old = self._channels.pop(player_id, None)
         if old is not None:
@@ -1754,10 +1774,11 @@ class TransportHub:
             min_bytes=self._min_bytes,
             poll_s=self._poll_s,
             integrity=self._integrity,
+            tracing=self._tracing,
         )
         crc = self._integrity != "off"
         if self.backend == "shm":
-            cls = CrcShmChannel if crc else ShmChannel
+            cls = flight.channel_cls(CrcShmChannel if crc else ShmChannel, self._tracing)
             self._channels[player_id] = cls(
                 to_p,
                 to_t,
@@ -1769,7 +1790,7 @@ class TransportHub:
                 poll_s=self._poll_s,
             )
         else:
-            cls = CrcQueueChannel if crc else QueueChannel
+            cls = flight.channel_cls(CrcQueueChannel if crc else QueueChannel, self._tracing)
             self._channels[player_id] = cls(
                 to_p, to_t, who=f"player[{player_id}]", poll_s=self._poll_s
             )
@@ -1795,13 +1816,15 @@ def make_transport(
     poll_s: float = 0.5,
     integrity: str = "off",
     max_frame_bytes: int = TCP_MAX_FRAME_BYTES,
+    tracing: str = "off",
 ) -> Tuple[TransportHub, List[ChannelSpec]]:
     """Create the trainer hub + per-player specs for ``backend``.
 
     Queues must exist before the spawn (they cannot ride another queue),
     so this runs in the trainer before any player process starts.
     ``integrity`` (``algo.transport_integrity``) selects the checksummed
-    channel variants; ``off`` constructs the undecorated classes.
+    channel variants; ``tracing`` (``metric.tracing``) the flight-traced
+    ones; ``off`` constructs the undecorated classes either way.
     """
     if backend not in _BACKENDS:
         raise ValueError(f"unknown transport backend {backend!r}; known: {_BACKENDS}")
@@ -1817,6 +1840,7 @@ def make_transport(
             compress_min=compress_min,
             integrity=integrity,
             max_frame_bytes=max_frame_bytes,
+            tracing=tracing,
         )
         for pid in range(num_players):
             specs.append(
@@ -1829,6 +1853,7 @@ def make_transport(
                     poll_s=poll_s,
                     integrity=integrity,
                     max_frame_bytes=max_frame_bytes,
+                    tracing=tracing,
                 )
             )
     else:
@@ -1849,12 +1874,13 @@ def make_transport(
                     min_bytes=min_bytes,
                     poll_s=poll_s,
                     integrity=integrity,
+                    tracing=tracing,
                 )
             )
             if backend == "shm":
                 # trainer sends through ITS ring (resp_free) and releases
                 # rollout slots back into the player's ring (data_free)
-                cls = CrcShmChannel if crc else ShmChannel
+                cls = flight.channel_cls(CrcShmChannel if crc else ShmChannel, tracing)
                 channels[pid] = cls(
                     to_p,
                     to_t,
@@ -1866,7 +1892,7 @@ def make_transport(
                     poll_s=poll_s,
                 )
             else:
-                qcls = CrcQueueChannel if crc else QueueChannel
+                qcls = flight.channel_cls(CrcQueueChannel if crc else QueueChannel, tracing)
                 channels[pid] = qcls(to_p, to_t, who=f"player[{pid}]", poll_s=poll_s)
     hub = TransportHub(
         backend,
@@ -1879,6 +1905,7 @@ def make_transport(
         poll_s=poll_s,
         integrity=integrity,
         max_frame_bytes=max_frame_bytes,
+        tracing=tracing,
     )
     return hub, specs
 
@@ -1921,6 +1948,12 @@ class FanIn:
         self._t0 = time.monotonic()
         self._frames: Dict[int, int] = {pid: 0 for pid in self.channels}
 
+    def _record_event(self, entry: Dict[str, Any]) -> None:
+        """One pool event: the bounded telemetry log AND (when tracing)
+        the flight recorder's fleet track share every call site."""
+        self.events.append(entry)
+        flight.fleet_event(entry["event"], **{k: v for k, v in entry.items() if k != "event"})
+
     # ------------------------------------------------------------ liveness
     @property
     def live(self) -> List[int]:
@@ -1953,7 +1986,7 @@ class FanIn:
             self.stopped.add(pid)
             return
         self.dead[pid] = reason
-        self.events.append(
+        self._record_event(
             {"event": "player_dead", "player": pid, "reason": reason, "live": len(self.live)}
         )
 
@@ -1973,7 +2006,7 @@ class FanIn:
         self._frames.setdefault(pid, 0)
         if steps_per_frame:
             self._steps_per_frame[pid] = steps_per_frame
-        self.events.append({"event": "player_join", "player": pid, "live": len(self.live)})
+        self._record_event({"event": "player_join", "player": pid, "live": len(self.live)})
 
     def note_lag(self, pid: int, lag: int) -> None:
         """Record one round's behavior-policy lag for ``pid`` (the V-trace
@@ -2002,7 +2035,7 @@ class FanIn:
             except FrameCorruptError as e:
                 # unrecoverable corruption (retransmit exhausted): the
                 # frame is lost, the channel itself stays usable
-                self.events.append(
+                self._record_event(
                     {"event": "frame_corrupt_dropped", "player": pid, "detail": str(e)}
                 )
                 continue
@@ -2060,7 +2093,7 @@ class FanIn:
                 except queue_mod.Empty:
                     continue
                 except FrameCorruptError as e:
-                    self.events.append(
+                    self._record_event(
                         {"event": "frame_corrupt_dropped", "player": pid, "detail": str(e)}
                     )
                     continue
@@ -2112,7 +2145,7 @@ class FanIn:
                     self._frames[pid] = self._frames.get(pid, 0) + 1
                 got[pid] = frame
                 self.rejoins += 1
-                self.events.append(
+                self._record_event(
                     {"event": "player_rejoin", "player": pid, "round": round_seq, "live": len(self.live)}
                 )
             elif frame.seq < round_seq:
@@ -2135,7 +2168,13 @@ class FanIn:
         a tcp send would stall the round on its boot; per-player extras
         via ``extra_fn`` — e.g. metrics/opt-state for the lead only).  A
         send failure marks that player dead and the broadcast continues."""
-        for pid in self.live + sorted(p for p in self.joining if p in self._seen_since_join):
+        targets = self.live + sorted(p for p in self.joining if p in self._seen_since_join)
+        if seq >= 0:
+            # the fleet timeline's publish edge: every player's matching
+            # broadcast_adopt event (ParamsFollower) subtracts this
+            # timestamp (clock-corrected) for the per-seq latency metric
+            flight.fleet_event("broadcast_publish", tag=tag, seq=int(seq), n=len(targets))
+        for pid in targets:
             extra = extra_fn(pid) if extra_fn is not None else ()
             try:
                 self.channels[pid].send(tag, arrays=arrays, extra=extra, seq=seq, timeout=timeout)
@@ -2149,7 +2188,7 @@ class FanIn:
         them through its ParamsFollower — no special protocol round, but
         the event must be visible in the transport telemetry."""
         self.rollbacks += 1
-        self.events.append(
+        self._record_event(
             {"event": "rollback", "round": round_seq, "rollbacks": self.rollbacks}
         )
 
@@ -2260,6 +2299,7 @@ class ParamsFollower:
             return True
         st.params_digest_mismatch += 1
         self.digest_skips += 1
+        flight.fleet_event("params_digest_skip", seq=int(frame.seq))
         return False
 
     def _next_frame(self, timeout: float) -> Frame:
@@ -2310,6 +2350,7 @@ class ParamsFollower:
                 frame.release()
                 return None
             self.current_seq = target
+            flight.fleet_event("broadcast_adopt", seq=int(target))
             return frame
 
     def params_for_round(self, round_k: int) -> Optional[Frame]:
@@ -2374,6 +2415,7 @@ class ParamsFollower:
             self._pending.extend(held)
         if newest is not None:
             self.current_seq = newest.seq
+            flight.fleet_event("broadcast_adopt", seq=int(newest.seq))
         self.staleness_log.append((round_k, max(0, (round_k - 1) - self.current_seq)))
         return newest
 
@@ -2411,6 +2453,7 @@ class ParamsFollower:
                 frame.release()
                 continue
             self.current_seq = frame.seq
+            flight.fleet_event("broadcast_adopt", seq=int(frame.seq))
             return frame
 
     @property

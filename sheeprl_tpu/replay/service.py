@@ -38,6 +38,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from sheeprl_tpu.obs import flight
 from sheeprl_tpu.resilience.integrity import FrameCorruptError
 from sheeprl_tpu.resilience.peer import PeerDiedError
 
@@ -301,6 +302,7 @@ class ReplayServer:
         (``ckpt_req`` etc.) go to ``on_control``; runs on the caller's
         thread — bounded by ``budget_s``, never blocks on an idle player."""
         got = 0
+        t_pump = time.time()
         deadline = time.monotonic() + budget_s
         while True:
             any_frame = False
@@ -336,6 +338,11 @@ class ReplayServer:
             self.grant_credits()
             if not any_frame or time.monotonic() > deadline:
                 break
+        if got:
+            rec = flight.get_recorder()
+            if rec is not None:
+                rec.span_done("replay_pump", t_pump, time.time(), {"transitions": got})
+                rec.sampled_event("replay_insert", "rb_insert", total=self.total_inserts)
         return got
 
     def _ingest(self, pid: int, frame) -> int:
@@ -380,6 +387,7 @@ class ReplayServer:
                 self.events.append(
                     {"event": "insert_quarantined", "player": pid, "reason": reason}
                 )
+                flight.fleet_event("insert_quarantined", player=pid, reason=reason)
                 if self.cache is None or "schema" in reason or "dtype" in reason or "shape" in reason or "key set" in reason:
                     return 0  # unstorable / uniform path: drop the frame
         indices = list(range(offset, offset + count))
@@ -478,6 +486,7 @@ class ReplayServer:
                 data["is_weights"] = np.ones((g, batch_size, 1), np.float32)
         if self.limiter is not None:
             self.limiter.sample(g * batch_size)
+        flight.sampled_event("replay_sample", "replay_sample", total=self.total_inserts)
         return data, idx
 
     def update_priorities(self, idx, td_abs) -> None:

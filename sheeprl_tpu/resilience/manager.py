@@ -154,18 +154,21 @@ class CheckpointManager:
         self.last_checkpoint = policy_step
         if not self._runtime.is_global_zero:
             return None
+        from sheeprl_tpu.obs import flight
+
         path = self.ckpt_path(policy_step)
         t0 = time.perf_counter()
-        host_state = self.cb.snapshot(state_fn())
-        if not self.allow_nonfinite and "agent" in host_state:
-            bad = _nonfinite_leaves(host_state["agent"])
-            if bad:
-                raise NonFiniteCheckpointError(path, bad)
-        if self.writer is not None:
-            self.writer.submit(path, host_state)
-        else:
-            self.cb.write(path, host_state)
-            self._sync_write_s += time.perf_counter() - t0
+        with flight.span("ckpt_write", step=policy_step, async_save=self.async_save):
+            host_state = self.cb.snapshot(state_fn())
+            if not self.allow_nonfinite and "agent" in host_state:
+                bad = _nonfinite_leaves(host_state["agent"])
+                if bad:
+                    raise NonFiniteCheckpointError(path, bad)
+            if self.writer is not None:
+                self.writer.submit(path, host_state)
+            else:
+                self.cb.write(path, host_state)
+                self._sync_write_s += time.perf_counter() - t0
         self.last_stall_s = time.perf_counter() - t0
         self.total_stall_s += self.last_stall_s
         self.saves += 1

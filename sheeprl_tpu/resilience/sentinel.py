@@ -576,6 +576,11 @@ class TrainHealth:
                 f"sentinel: skipped {delta_skips} anomalous update(s) "
                 f"(consecutive={int(consec)}, z={self.last_z})"
             )
+            from sheeprl_tpu.obs import flight
+
+            flight.fleet_event(
+                "sentinel_skip", skipped=int(delta_skips), consecutive=int(consec)
+            )
         elif self._tags is not None:
             self._tags.promote(self.healthy_marker, self.cfg["good_after"])
         if bool(tripped):
@@ -636,6 +641,14 @@ class TrainHealth:
         warnings.warn(
             f"sentinel: rollback #{self.rollbacks} to {target} after {consec} consecutive "
             f"anomalous updates ({len(quarantined)} pending checkpoint(s) quarantined)"
+        )
+        from sheeprl_tpu.obs import flight
+
+        flight.fleet_event(
+            "sentinel_rollback",
+            ckpt=os.path.basename(target),
+            consecutive_skips=consec,
+            rollbacks=self.rollbacks,
         )
         for fn in self._on_rollback:
             try:

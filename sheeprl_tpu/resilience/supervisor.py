@@ -226,6 +226,11 @@ class PlayerSupervisor:
                 "budget_remaining": self.budget_remaining,
             }
         )
+        from sheeprl_tpu.obs import flight
+
+        flight.fleet_event(
+            "supervisor_respawn", player=pid, attempt=self.restarts_by_pid[pid]
+        )
 
     # ---------------------------------------------------------- telemetry
     def stats(self) -> Dict[str, Any]:
@@ -296,6 +301,9 @@ class ServeSupervisor:
         self.events.append(
             {"event": "server_restart", "attempt": self.restarts, "budget_remaining": self.budget_remaining}
         )
+        from sheeprl_tpu.obs import flight
+
+        flight.fleet_event("server_respawn", attempt=self.restarts)
         return True
 
     def stats(self) -> Dict[str, Any]:

@@ -43,6 +43,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from sheeprl_tpu.obs import flight
 from sheeprl_tpu.parallel.transport import INFER_REP_TAG, INFER_REQ_TAG
 from sheeprl_tpu.resilience.peer import PeerDiedError
 
@@ -76,8 +77,10 @@ class CircuitBreaker:
         return True  # half_open: the single in-flight probe
 
     def record_success(self) -> None:
-        if self.state == "half_open":
-            self.promotions += 1
+        if self.state != "closed":
+            if self.state == "half_open":
+                self.promotions += 1
+            flight.fleet_event("breaker", state="closed", from_state=self.state)
         self.state = "closed"
         self.failures = 0
 
@@ -87,10 +90,12 @@ class CircuitBreaker:
             self.state = "open"
             self.reopens += 1
             self._opened_at = time.monotonic()
+            flight.fleet_event("breaker", state="open", from_state="half_open")
         elif self.state == "closed" and self.failures >= self.threshold:
             self.state = "open"
             self.trips += 1
             self._opened_at = time.monotonic()
+            flight.fleet_event("breaker", state="open", from_state="closed")
 
 
 class InferenceClient:
@@ -211,14 +216,32 @@ class InferenceClient:
         self.requests += 1
         if self._server_stopped or not self.breaker.allow_remote():
             self.local_fallbacks += 1
+            flight.sampled_event("serve_request", "serve_request", source="local")
             return None, "local"
+        t0 = time.monotonic()
+        retries0, hedges0 = self.retries, self.hedges
         out = self._try_remote(arrays, rows, probe=self.breaker.state == "half_open")
         if out is not None:
             self.breaker.record_success()
             self.remote_used += 1
+            flight.sampled_event(
+                "serve_request",
+                "serve_request",
+                source="remote",
+                retries=self.retries - retries0,
+                hedged=self.hedges > hedges0,
+                lat_s=round(time.monotonic() - t0, 6),
+            )
             return out, "remote"
         self.breaker.record_failure()
         self.local_fallbacks += 1
+        flight.sampled_event(
+            "serve_request",
+            "serve_request",
+            source="local",
+            retries=self.retries - retries0,
+            hedged=self.hedges > hedges0,
+        )
         return None, "local"
 
     def stats(self) -> Dict[str, Any]:

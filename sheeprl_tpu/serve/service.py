@@ -52,6 +52,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from sheeprl_tpu.obs import flight
 from sheeprl_tpu.parallel.transport import INFER_REP_TAG, INFER_REQ_TAG
 from sheeprl_tpu.resilience.faults import get_injector
 from sheeprl_tpu.resilience.peer import PeerDiedError
@@ -242,6 +243,7 @@ class InferenceServer:
                             self._pending = []
                         self.deaths += 1
                         self._dead = "server_exit fault injected"
+                        flight.fleet_event("server_exit", deaths=self.deaths)
                         return
                     self._run_batch(batch)
                 elif self._drain.is_set() and not self._pending:
@@ -324,6 +326,8 @@ class InferenceServer:
     def _run_batch(self, batch: List[_Request]) -> None:
         rows = sum(r.rows for r in batch)
         bucket = bucket_for(rows, self.buckets)
+        batch_span = flight.span("serve_batch", rows=rows, bucket=bucket)
+        batch_span.__enter__()
         keys = list(batch[0].arrays.keys())
         obs: Dict[str, np.ndarray] = {}
         for k in keys:
@@ -358,6 +362,7 @@ class InferenceServer:
         if len(self._lat) > 512:
             del self._lat[: len(self._lat) - 512]
         del t0  # latency is request-arrival to reply; compute time rides it
+        batch_span.__exit__(None, None, None)
 
     def _reply(self, client_id: int, req_id: int, arrays: List[Tuple[str, np.ndarray]]) -> None:
         ch = self._channels.get(client_id)

@@ -48,10 +48,10 @@ def _args(tmp_path, name, extra=()):
 
 
 def _telemetry_replay(root):
-    tel = glob.glob(f"{root}/**/telemetry.jsonl", recursive=True)
-    assert tel, "lead player wrote no telemetry"
-    recs = [json.loads(line) for line in open(tel[0]) if line.strip()]
-    replay = [r["replay"] for r in recs if "replay" in r]
+    from sheeprl_tpu.obs.reader import collect_key, telemetry_files
+
+    assert telemetry_files(root), "lead player wrote no telemetry"
+    replay = collect_key(root, "replay")
     assert replay, "telemetry records carry no replay key"
     return replay[-1]
 

@@ -315,16 +315,14 @@ def test_supervisor_exponential_backoff_per_player():
 
 # --------------------------------------------------------- chaos smoke
 def _transport_records(root):
+    from sheeprl_tpu.obs.reader import iter_run_records
+
     recs, compiles = [], []
-    for t in sorted(
-        glob.glob(f"{root}/**/telemetry.jsonl", recursive=True), key=os.path.getmtime
-    ):
-        for line in open(t):
-            rec = json.loads(line)
-            if "transport" in rec:
-                recs.append(rec["transport"])
-            if rec.get("trainer_compiles") is not None:
-                compiles.append(rec["trainer_compiles"])
+    for rec in iter_run_records(root):
+        if "transport" in rec:
+            recs.append(rec["transport"])
+        if rec.get("trainer_compiles") is not None:
+            compiles.append(rec["trainer_compiles"])
     return recs, compiles
 
 
@@ -452,12 +450,9 @@ def test_sac_remote_replay_rejoin(tmp_path, monkeypatch):
             "env.num_envs=2",
         ]
     )
-    recs = []
-    for t in glob.glob(f"{tmp_path}/run/**/telemetry.jsonl", recursive=True):
-        for line in open(t):
-            rec = json.loads(line)
-            if "replay" in rec:
-                recs.append(rec["replay"])
+    from sheeprl_tpu.obs.reader import collect_key
+
+    recs = collect_key(f"{tmp_path}/run", "replay")
     assert recs
     last = recs[-1]
     assert last.get("rejoins", 0) >= 1, f"writer never rejoined: {last}"

@@ -452,12 +452,12 @@ def _dec_args(tmp_path, tag, *, algo="ppo", players=2, transport="tcp", total=64
 
 
 def _transport_telemetry(tmp_path, tag):
+    from sheeprl_tpu.obs.reader import iter_run_records
+
     recs = []
-    for t in glob.glob(f"{tmp_path}/{tag}/**/telemetry.jsonl", recursive=True):
-        for line in open(t):
-            rec = json.loads(line)
-            if "transport" in rec:
-                recs.append(rec["transport"])
+    for rec in iter_run_records(f"{tmp_path}/{tag}"):
+        if "transport" in rec:
+            recs.append(rec["transport"])
     return recs
 
 

@@ -53,11 +53,9 @@ def _base_args(tmp_path, sub, total_steps=4800, extra=()):
 
 
 def _records(root):
-    out = []
-    for t in sorted(glob.glob(f"{root}/**/telemetry.jsonl", recursive=True)):
-        for line in open(t):
-            out.append(json.loads(line))
-    return out
+    from sheeprl_tpu.obs.reader import iter_run_records
+
+    return list(iter_run_records(root))
 
 
 def _agent_md5(root):

@@ -1,0 +1,275 @@
+"""Fleet flight recorder units (ISSUE 13 tentpole): recorder semantics,
+wire trace-context propagation over channels, tracing-off type identity
+(the PR-9/10 zero-overhead contract), clock-offset estimation math, and
+the perfetto exporter's structure."""
+
+import json
+import os
+import queue
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.obs import flight
+from sheeprl_tpu.obs.flight import FLIGHT_SCHEMA, TRACE_MARK, FlightRecorder
+from sheeprl_tpu.obs.report import estimate_offsets, fleet_metrics, generate_report, to_chrome_trace
+
+pytestmark = pytest.mark.trace
+
+
+@pytest.fixture(autouse=True)
+def _clean_recorder():
+    flight.close_recorder()
+    yield
+    flight.close_recorder()
+
+
+# ------------------------------------------------------------- recorder
+def test_recorder_records_and_flushes(tmp_path):
+    rec = FlightRecorder("trainer", str(tmp_path / "trainer.jsonl"), mode="full")
+    with_span = flight._Span(rec, "train_dispatch", {"round": 3})
+    with with_span:
+        pass
+    rec.event("rollback", round=7)
+    ctx = rec.trace_send("params", 5, 1024)
+    assert ctx is not None and ctx[0] == TRACE_MARK and ctx[1] == "trainer"
+    rec.trace_recv("data", 5, (TRACE_MARK, "player0", 1, 123.0), 2048)
+    rec.close()
+    rows = [json.loads(l) for l in open(tmp_path / "trainer.jsonl")]
+    assert [r["k"] for r in rows] == ["meta", "span", "event", "send", "recv"]
+    assert all(r["schema"] == FLIGHT_SCHEMA and r["role"] == "trainer" for r in rows)
+    assert rows[1]["name"] == "train_dispatch" and rows[1]["a"] == {"round": 3}
+    assert rows[4]["src"] == "player0" and rows[4]["ts_send"] == 123.0
+
+
+def test_recorder_sampling_gates_hot_tags_not_protocol(tmp_path):
+    rec = FlightRecorder("p", str(tmp_path / "p.jsonl"), mode="sampled", sample_every=4)
+    # control-plane tag: every send traced (the per-seq metrics need it)
+    assert all(rec.trace_send("params", i, 0) is not None for i in range(8))
+    # data-plane tags: 1-in-4
+    for tag in ("infer_req", "data"):
+        hits = [rec.trace_send(tag, i, 0) is not None for i in range(8)]
+        assert hits == [True, False, False, False, True, False, False, False], tag
+    rec.close()
+
+
+def test_recorder_ring_bounds_memory(tmp_path):
+    rec = FlightRecorder(
+        "p", str(tmp_path / "p.jsonl"), mode="full", ring=64, flush_chunk=10_000,
+        flush_interval_s=1e9,
+    )
+    for i in range(200):
+        rec.event("e", i=i)
+    assert rec.dropped > 0
+    assert len(rec._pending) <= 64
+    rec.close()
+
+
+def test_close_then_event_is_dropped(tmp_path):
+    rec = FlightRecorder("p", str(tmp_path / "p.jsonl"), mode="full")
+    rec.close()
+    rec.event("late")  # no raise, no write
+    assert len([l for l in open(tmp_path / "p.jsonl")]) == 1  # just the meta row
+
+
+def test_module_hooks_are_noops_when_off():
+    assert flight.get_recorder() is None
+    flight.fleet_event("anything", x=1)  # no raise
+    with flight.span("anything"):
+        pass
+    assert flight.tracing_setting({"metric": {}}) == "off"
+    assert flight.tracing_setting({"metric": {"tracing": "sampled"}}) == "sampled"
+    assert flight.tracing_setting({"metric": {"tracing": "full"}}) == "full"
+
+
+# ------------------------------------------------- traced channel layer
+def test_tracing_off_type_identity():
+    """The PR-9/10 zero-overhead contract: ``off`` returns the UNDECORATED
+    classes — no subclass, no wrapper, nothing to pay for."""
+    from sheeprl_tpu.parallel.transport import (
+        CrcQueueChannel,
+        QueueChannel,
+        ShmChannel,
+        TcpChannel,
+    )
+
+    for base in (QueueChannel, ShmChannel, TcpChannel, CrcQueueChannel):
+        assert flight.channel_cls(base, "off") is base
+        traced = flight.channel_cls(base, "sampled")
+        assert traced is not base and issubclass(traced, base)
+        # cached: one traced class per base, and full/sampled share it
+        assert flight.channel_cls(base, "full") is traced
+
+
+def test_tracing_off_sink_identity(tmp_path):
+    """``metric.tracing=off`` constructs NO recorder and NO sink file."""
+    cfg = {"metric": {"tracing": "off"}}
+
+    class _Cfg(dict):
+        root_dir = str(tmp_path)
+        run_name = "run"
+
+    assert flight.configure_from_cfg(_Cfg(cfg), role="main") is None
+    assert flight.get_recorder() is None
+    assert not os.path.exists(tmp_path / "run" / "flight")
+
+
+def test_traced_channel_marker_roundtrip(tmp_path):
+    """The marker rides extras on the wire and is STRIPPED before the
+    frame reaches protocol code; matched send/recv records land in the
+    stream."""
+    from sheeprl_tpu.parallel.transport import QueueChannel
+
+    rec = flight.configure("player0", str(tmp_path / "flight"), mode="full")
+    cls = flight.channel_cls(QueueChannel, "full")
+    q1, q2 = queue.Queue(), queue.Queue()
+    a, b = cls(q1, q2), cls(q2, q1)
+    a.send("data", arrays=[("x", np.arange(4.0))], extra=(True, 3), seq=9)
+    frame = b.recv(timeout=2)
+    assert frame.extra == (True, 3), "marker must be stripped before delivery"
+    assert frame.seq == 9
+    # control tags are never marked
+    a.send("stop")
+    assert b.recv(timeout=2).extra == ()
+    a.close()
+    b.close()
+    flight.close_recorder()
+    rows = [json.loads(l) for l in open(tmp_path / "flight" / "player0.jsonl")]
+    kinds = [(r["k"], r.get("tag")) for r in rows if r["k"] in ("send", "recv")]
+    assert ("send", "data") in kinds and ("recv", "data") in kinds
+
+
+def test_untraced_receiver_tolerates_marked_frame():
+    """A marker that reaches an undecorated receiver (mixed-config edge)
+    rides as a trailing extra element — protocol code indexing extras by
+    position is unaffected."""
+    from sheeprl_tpu.parallel.transport import QueueChannel
+
+    q1, q2 = queue.Queue(), queue.Queue()
+    a = QueueChannel(q1, q2)
+    a.send("data", extra=(1, 2, (TRACE_MARK, "p", 1, 0.0)), seq=0, arrays=[("x", np.zeros(1))])
+    b = QueueChannel(q2, q1)
+    frame = b.recv(timeout=2)
+    assert frame.extra[:2] == (1, 2)
+    a.close()
+    b.close()
+
+
+# ----------------------------------------------------- offsets + report
+def _wire(src, dst, tid, ts_send, ts_recv, tag="data", seq=0):
+    return [
+        {"schema": FLIGHT_SCHEMA, "k": "send", "role": src, "pid": 1, "tag": tag, "seq": seq,
+         "tid": tid, "ts": ts_send, "nb": 0},
+        {"schema": FLIGHT_SCHEMA, "k": "recv", "role": dst, "pid": 2, "tag": tag, "seq": seq,
+         "tid": tid, "src": src, "ts_send": ts_send, "ts": ts_recv, "nb": 0},
+    ]
+
+
+def test_offset_estimation_recovers_known_skew():
+    """player0's clock runs +0.5 s ahead of the trainer's; symmetric
+    min-latency traffic both ways must recover the offset to ~us."""
+    skew, lat = 0.5, 0.01
+    records = []
+    for i in range(5):
+        t = 100.0 + i  # true time, trainer clock == true
+        records += _wire("trainer", "player0", i, t, t + lat + skew)  # fwd
+        records += _wire("player0", "trainer", 100 + i, t + skew, t + lat)  # bwd
+    clock = estimate_offsets(records)
+    assert clock["ref"] == "trainer"
+    assert clock["offset_s"]["trainer"] == 0.0
+    assert abs(clock["offset_s"]["player0"] - skew) < 1e-6
+    assert not clock["unlinked"]
+
+
+def test_offset_unlinked_role_flagged():
+    records = _wire("trainer", "player0", 1, 1.0, 1.1)  # one direction only
+    clock = estimate_offsets(records)
+    assert "player0" in clock["unlinked"]
+    assert clock["offset_s"]["player0"] == 0.0
+
+
+def _event(role, name, ts, **attrs):
+    rec = {"schema": FLIGHT_SCHEMA, "k": "event", "role": role, "pid": 1, "name": name, "ts": ts}
+    if attrs:
+        rec["a"] = attrs
+    return rec
+
+
+def test_broadcast_latency_is_clock_corrected():
+    """A +0.5 s player clock must NOT inflate the adoption latency: the
+    corrected number is the true 0.1 s."""
+    skew, lat = 0.5, 0.001
+    records = []
+    for i in range(4):
+        t = 10.0 + i
+        records += _wire("trainer", "player0", i, t, t + lat + skew)
+        records += _wire("player0", "trainer", 100 + i, t + skew, t + lat)
+    records.append(_event("trainer", "broadcast_publish", 20.0, tag="params", seq=41, n=1))
+    records.append(_event("player0", "broadcast_adopt", 20.1 + skew, seq=41))
+    clock = estimate_offsets(records)
+    metrics = fleet_metrics(records, clock)
+    per_seq = metrics["broadcast"]["per_seq"]
+    assert "41" in per_seq
+    lat41 = per_seq["41"]["adopt_latency_s"]["player0"]
+    assert abs(lat41 - 0.1) < 1e-3, f"clock soup: got {lat41}"
+
+
+def test_rollback_propagation_measured():
+    records = [
+        _event("trainer", "rollback", 5.0, round=7),
+        _event("trainer", "broadcast_publish", 5.01, tag="params", seq=7, n=2),
+        _event("player0", "broadcast_adopt", 5.2, seq=7),
+        _event("player1", "broadcast_adopt", 5.4, seq=8),
+    ]
+    metrics = fleet_metrics(records, estimate_offsets(records))
+    rb = metrics["rollbacks"][0]
+    assert rb["round"] == 7
+    assert abs(rb["propagation_s"]["player0"] - 0.2) < 1e-6
+    assert abs(rb["propagation_s"]["player1"] - 0.4) < 1e-6  # seq 8 >= round 7 counts
+
+
+def test_chrome_trace_structure():
+    records = [
+        _event("trainer", "rollback", 2.0, round=3),
+        {"schema": FLIGHT_SCHEMA, "k": "span", "role": "player0", "pid": 2, "name": "collect",
+         "t0": 1.0, "t1": 1.5, "a": {"round": 1}},
+    ] + _wire("trainer", "player0", 1, 1.0, 1.01, tag="params", seq=3)
+    trace = to_chrome_trace(records, estimate_offsets(records))
+    evts = trace["traceEvents"]
+    names = {(e["ph"], e.get("name")) for e in evts}
+    assert ("M", "process_name") in names
+    assert ("X", "collect") in names
+    assert ("i", "rollback") in names
+    # params broadcasts become flow arrows
+    assert ("s", "params") in names and ("f", "params") in names
+    rollback = next(e for e in evts if e.get("name") == "rollback" and e["ph"] == "i")
+    assert rollback["cat"] == "annotation"
+    # every timestamp is non-negative microseconds from the run origin
+    assert all(e.get("ts", 0) >= 0 for e in evts)
+    json.dumps(trace)  # serializable as-is
+
+
+def test_generate_report_end_to_end(tmp_path):
+    flight_dir = tmp_path / "run" / "flight"
+    os.makedirs(flight_dir)
+    rows = (
+        _wire("trainer", "player0", 1, 1.0, 1.01, tag="params", seq=2)
+        + _wire("player0", "trainer", 9, 1.02, 1.03)
+        + [
+            _event("trainer", "broadcast_publish", 1.0, tag="params", seq=2, n=1),
+            _event("player0", "broadcast_adopt", 1.05, seq=2),
+        ]
+    )
+    by_role = {"trainer": [], "player0": []}
+    for r in rows:
+        by_role[r["role"]].append(r)
+    for role, rs in by_role.items():
+        with open(flight_dir / f"{role}.jsonl", "w") as f:
+            for r in rs:
+                f.write(json.dumps(r) + "\n")
+    summary = generate_report(str(tmp_path / "run"))
+    assert summary["roles"] == ["player0", "trainer"]
+    assert os.path.exists(summary["trace_json"])
+    data = json.load(open(summary["trace_json"]))
+    assert isinstance(data["traceEvents"], list) and data["traceEvents"]
+    assert "2" in summary["metrics"]["broadcast"]["per_seq"]

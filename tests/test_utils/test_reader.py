@@ -1,0 +1,93 @@
+"""obs/reader.py — the ONE schema-tolerant JSONL reader (ISSUE 13
+satellite): parsing tolerance, dotted key paths, run-level iteration, and
+the flight-stream glob."""
+
+import json
+import os
+
+import pytest
+
+from sheeprl_tpu.obs.reader import (
+    collect_key,
+    flight_files,
+    iter_jsonl,
+    iter_run_records,
+    key_path,
+    last_jsonl,
+    read_flight,
+    read_jsonl,
+    last_jsonl as _last,  # noqa: F401 - alias exercised below
+    telemetry_files,
+)
+
+pytestmark = pytest.mark.trace
+
+
+def _write(path, lines):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        f.write("\n".join(lines))
+
+
+def test_iter_jsonl_skips_blank_torn_and_nonobject(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    _write(
+        path,
+        [
+            json.dumps({"a": 1}),
+            "",
+            '{"torn": tr',  # crash mid-write
+            "[1, 2, 3]",  # parseable but not an object
+            json.dumps({"a": 2}),
+        ],
+    )
+    assert [r["a"] for r in iter_jsonl(path)] == [1, 2]
+    assert read_jsonl(path)[-1] == {"a": 2}
+    assert last_jsonl(path) == {"a": 2}
+
+
+def test_iter_jsonl_missing_file_yields_nothing(tmp_path):
+    assert read_jsonl(str(tmp_path / "nope.jsonl")) == []
+    assert last_jsonl(str(tmp_path / "nope.jsonl")) is None
+
+
+def test_key_path_walks_and_defaults():
+    rec = {"transport": {"supervisor": {"restarts": 3}, "live": 2}}
+    assert key_path(rec, "transport.supervisor.restarts") == 3
+    assert key_path(rec, "transport.live") == 2
+    assert key_path(rec, "transport.missing", default=-1) == -1
+    assert key_path(rec, "transport.live.deeper", default="d") == "d"  # non-dict hop
+    assert key_path(None, "anything", default=0) == 0
+
+
+def test_run_iteration_and_collect(tmp_path):
+    a = str(tmp_path / "v0" / "telemetry.jsonl")
+    b = str(tmp_path / "v1" / "telemetry.jsonl")
+    _write(a, [json.dumps({"step": 1, "transport": {"live": 2}})])
+    _write(b, [json.dumps({"step": 2}), json.dumps({"step": 3, "transport": {"live": 1}})])
+    os.utime(a, (1, 1))  # a is the OLDER file
+    files = telemetry_files(str(tmp_path))
+    assert files == [a, b]
+    assert [r["step"] for r in iter_run_records(str(tmp_path))] == [1, 2, 3]
+    # records without the key are skipped, not padded
+    assert collect_key(str(tmp_path), "transport.live") == [2, 1]
+
+
+def test_rotated_backups_come_first(tmp_path):
+    path = str(tmp_path / "telemetry.jsonl")
+    _write(path + ".1", [json.dumps({"step": 0})])
+    _write(path, [json.dumps({"step": 1})])
+    assert [r["step"] for r in iter_run_records(str(tmp_path), include_backups=True)] == [0, 1]
+
+
+def test_flight_glob(tmp_path):
+    p = str(tmp_path / "run" / "flight" / "trainer.jsonl")
+    q = str(tmp_path / "run" / "version_0" / "flight" / "player0.jsonl")
+    _write(p, [json.dumps({"k": "event", "role": "trainer", "name": "x", "ts": 1.0})])
+    _write(q, [json.dumps({"k": "event", "role": "player0", "name": "y", "ts": 2.0})])
+    assert sorted(os.path.basename(f) for f in flight_files(str(tmp_path))) == [
+        "player0.jsonl",
+        "trainer.jsonl",
+    ]
+    roles = {r["role"] for r in read_flight(str(tmp_path))}
+    assert roles == {"trainer", "player0"}
