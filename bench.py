@@ -708,22 +708,30 @@ def bench_jaxenv():
 def bench_replay():
     """Replay-sampling ladder (benchmarks/bench_replay_sampling.py):
     per-batch cost of the uniform vs prioritized on-device samplers at
-    cache sizes 1e4 -> 1e6, plus the write-side costs prioritization adds
-    (max-priority seeding per append, TD-driven update_priorities).  The
-    headline is the largest-cache sample-cost ratio — what one gradient
-    step pays for O(log n) proportional sampling over the O(1) uniform
-    gather."""
-    from benchmarks.bench_replay_sampling import run_ladder
+    cache sizes 1e4 -> 1e6, in BOTH data-plane kernel modes
+    (buffer.per_kernel=lax|pallas, interleaved min-of-N legs), plus the
+    write-side costs prioritization adds and the params-digest cost
+    ladder (host CRC walk vs the one-dispatch device digest).  The
+    headline stays the r07-comparable largest-cache lax sample-cost
+    ratio; the pallas legs ride alongside (the fused-exclusion descent's
+    win shows on the next-obs legs, where the lax path pays a functional
+    tree copy per draw)."""
+    from benchmarks.bench_replay_sampling import run_digest_ladder, run_ladder
 
     rows = run_ladder(sizes=(10_000, 100_000, 1_000_000), batch=256, n_iters=10)
+    digest_rows = run_digest_ladder()
     top = rows[-1]
     return {
         "metric": "prioritized_over_uniform_sample_cost_1e6",
         "value": top["prioritized_over_uniform"],
+        "pallas_over_uniform": top["pallas_over_uniform"],
+        "nobs_pallas_over_lax": top["nobs_pallas_over_lax"],
         "uniform_sample_ms": top["uniform_sample_ms"],
         "prioritized_sample_ms": top["prioritized_sample_ms"],
+        "prioritized_pallas_ms": top["prioritized_pallas_ms"],
         "update_priorities_ms": top["update_priorities_ms"],
         "rows": rows,
+        "digest_rows": digest_rows,
     }
 
 

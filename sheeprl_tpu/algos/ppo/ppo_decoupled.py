@@ -61,6 +61,7 @@ from sheeprl_tpu.parallel.transport import (
     split_envs,
     transport_setting,
 )
+from sheeprl_tpu.resilience.integrity import params_digest_fn
 from sheeprl_tpu.resilience import (
     CheckpointManager,
     PeerDiedError,
@@ -148,6 +149,11 @@ def decoupled_knobs(cfg) -> Dict[str, Any]:
         # frames on every backend, digest = crc + content-digest-verified
         # params adoption
         "integrity": integrity_setting(cfg),
+        # batched device digest for params broadcasts (integrity.py
+        # stream_digest_batched): one cached jit dispatch per message
+        # instead of the per-leaf host CRC walk — pays when the leaves
+        # are device-resident or numerous; both ends gate on this knob
+        "params_digest_device": bool(cfg.algo.get("params_digest_device", False)),
         # tcp length-prefix sanity cap (a corrupted prefix must not turn
         # into a multi-GB allocation)
         "max_frame_bytes": int(cfg.algo.get("tcp_max_frame_mb", 1024)) << 20,
@@ -341,6 +347,9 @@ def _player_loop(
         timeout=timeout_s,
         on_stale=_apply_params_extra,
         digest_slot=4 if knobs["integrity"] == "digest" else None,
+        digest_fn=params_digest_fn(
+            knobs["integrity"] == "digest", knobs["params_digest_device"]
+        ),
     )
 
     def _adopt(frame) -> Any:
@@ -977,13 +986,7 @@ def main(runtime, cfg: Dict[str, Any]):
         # corruption anywhere on the path, including copies the frame
         # checksum no longer covers
         digest_mode = knobs["integrity"] == "digest"
-
-        def _params_digest(arrays):
-            if not digest_mode:
-                return None
-            from sheeprl_tpu.resilience.integrity import content_digest
-
-            return content_digest(arrays)
+        _params_digest = params_digest_fn(digest_mode, knobs["params_digest_device"])
 
         # initial weights to every player (reference broadcast, :126)
         init_arrays = _flat_leaves(_np_tree(params))

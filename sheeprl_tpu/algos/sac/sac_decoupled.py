@@ -71,6 +71,7 @@ from sheeprl_tpu.resilience import (
     parent_alive,
     restore_like,
 )
+from sheeprl_tpu.resilience.integrity import params_digest_fn
 from sheeprl_tpu.utils.callback import load_checkpoint, restore_buffer
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -218,6 +219,9 @@ def _player_loop(
         timeout=timeout_s,
         on_stale=_apply_params_extra,
         digest_slot=2 if knobs["integrity"] == "digest" else None,
+        digest_fn=params_digest_fn(
+            knobs["integrity"] == "digest", knobs["params_digest_device"]
+        ),
     )
 
     def _adopt(frame) -> None:
@@ -663,6 +667,7 @@ def _player_loop_remote(
                 aggregator.update(k, v)
 
     digest_mode = knobs["integrity"] == "digest"
+    _digest = params_digest_fn(digest_mode, knobs["params_digest_device"])
 
     def _params_frame_ok(frame) -> bool:
         """Digest-verified adoption (algo.transport_integrity=digest):
@@ -670,11 +675,11 @@ def _player_loop_remote(
         mismatch skips this broadcast (the next one re-syncs)."""
         if not digest_mode or len(frame.extra) <= 2 or frame.extra[2] is None:
             return True
-        from sheeprl_tpu.resilience.integrity import content_digest, integrity_stats
+        from sheeprl_tpu.resilience.integrity import integrity_stats
 
         st = integrity_stats()
         st.params_digest_checked += 1
-        if content_digest(list(frame.arrays.items())) == int(frame.extra[2]):
+        if _digest(list(frame.arrays.items())) == int(frame.extra[2]):
             return True
         st.params_digest_mismatch += 1
         return False
@@ -1133,13 +1138,7 @@ def main(runtime, cfg: Dict[str, Any]):
         # ppo_decoupled: computed once per broadcast from the source
         # arrays, verified at every player's adoption
         digest_mode = knobs["integrity"] == "digest"
-
-        def _params_digest(arrays):
-            if not digest_mode:
-                return None
-            from sheeprl_tpu.resilience.integrity import content_digest
-
-            return content_digest(arrays)
+        _params_digest = params_digest_fn(digest_mode, knobs["params_digest_device"])
 
         # initial actor weights to every player (seq 0; round seqs start at 1)
         init_arrays = _flat_leaves(_np_tree(params["actor"]))
@@ -1380,6 +1379,7 @@ def _main_remote(runtime, cfg: Dict[str, Any], knobs, state, counters, ratio_sta
             prioritized=prioritized,
             per_alpha=float(cfg.buffer.get("per_alpha", 0.6)),
             per_eps=float(cfg.buffer.get("per_eps", 1e-6)),
+            per_kernel=str(cfg.buffer.get("per_kernel", "lax")),
             device=runtime.device,
             credit_window=knobs["window"],
             integrity=knobs["integrity"],
@@ -1437,14 +1437,11 @@ def _main_remote(runtime, cfg: Dict[str, Any], knobs, state, counters, ratio_sta
         last_metrics: Dict[str, Any] = {}
 
         digest_mode = knobs["integrity"] == "digest"
+        _params_digest = params_digest_fn(digest_mode, knobs["params_digest_device"])
 
         def _actor_arrays_digest():
             arrays = _flat_leaves(_np_tree(params["actor"]))
-            if not digest_mode:
-                return arrays, None
-            from sheeprl_tpu.resilience.integrity import content_digest
-
-            return arrays, content_digest(arrays)
+            return arrays, _params_digest(arrays)
 
         def _broadcast_params(seq: int, extras) -> None:
             arrays, digest = _actor_arrays_digest()

@@ -64,7 +64,19 @@ _TRACE_ENTRY_QUALS = {
     "jax.lax.fori_loop",
     "jax.lax.associative_scan",
 }
-_TRACE_ENTRY_NAMES = {"jit", "shard_map", "scan", "guard_update", "scan_remat", "checkpoint", "remat"}
+_TRACE_ENTRY_NAMES = {
+    "jit",
+    "shard_map",
+    "scan",
+    "guard_update",
+    "scan_remat",
+    "checkpoint",
+    "remat",
+    # Pallas kernel bodies are traced contexts too: the function handed to
+    # pl.pallas_call is traced per compile (interpret mode included), so
+    # the retrace/host-sync/prng hazards apply verbatim inside it
+    "pallas_call",
+}
 _TRACE_ENTRY_ATTRS = {"setup_step"}
 
 
@@ -809,6 +821,15 @@ def _traced_functions(ctx: ModuleContext) -> List[ast.AST]:
             if not q_is_entry(q):
                 continue
             for arg in list(n.args) + [kw.value for kw in n.keywords]:
+                # functools.partial(kernel, ...) handed to an entry point
+                # (the pallas_call/scan idiom for static kernel config)
+                # traces the partial'd callable
+                if (
+                    isinstance(arg, ast.Call)
+                    and (ctx.qual(arg.func) or "").split(".")[-1] == "partial"
+                    and arg.args
+                ):
+                    arg = arg.args[0]
                 if isinstance(arg, ast.Name) and arg.id in by_name:
                     traced.update(by_name[arg.id])
     # nested defs of traced functions are traced as part of them

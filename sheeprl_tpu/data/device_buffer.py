@@ -147,10 +147,19 @@ def _transition_window(pos, filled, *, cap, next_keys):
     return base, count
 
 
-def _gather_transitions(bufs, rows, envs, *, n_samples, batch_size, cap, next_keys):
+def _gather_transitions(bufs, rows, envs, *, n_samples, batch_size, cap, next_keys, kernel="lax"):
     """Flat-transition gather shared by the uniform and prioritized
     samplers: (flat,) row/env indices -> (n_samples, batch, *feat) dicts,
-    next row = (row + 1) % cap for ``next_keys``."""
+    next row = (row + 1) % cap for ``next_keys``.  ``kernel="pallas"``
+    fuses every key's gather (+ the next-row fan) into ONE
+    ops/pallas_gather.py kernel — identical bytes, one launch."""
+    if kernel == "pallas":
+        from sheeprl_tpu.ops.pallas_gather import gather_transitions_fused
+
+        flat = gather_transitions_fused(bufs, rows, envs, next_keys=next_keys)
+        return {
+            k: g.reshape(n_samples, batch_size, *g.shape[1:]) for k, g in flat.items()
+        }
     out = {}
     for k, buf in bufs.items():
         g = buf[rows, envs]  # (flat, *feat)
@@ -165,9 +174,11 @@ def _gather_transitions(bufs, rows, envs, *, n_samples, batch_size, cap, next_ke
 
 @functools.partial(
     jax.jit,
-    static_argnames=("n_samples", "batch_size", "cap", "n_envs", "next_keys"),
+    static_argnames=("n_samples", "batch_size", "cap", "n_envs", "next_keys", "kernel"),
 )
-def _sample_transitions(bufs, key, pos, filled, *, n_samples, batch_size, cap, n_envs, next_keys):
+def _sample_transitions(
+    bufs, key, pos, filled, *, n_samples, batch_size, cap, n_envs, next_keys, kernel="lax"
+):
     """Gather (n_samples, batch, *feat) flat transitions, mirroring
     ``ReplayBuffer.sample``: rows uniform over stored history, env uniform
     per element (see :func:`_transition_window` for the validity mask)."""
@@ -179,16 +190,18 @@ def _sample_transitions(bufs, key, pos, filled, *, n_samples, batch_size, cap, n
     offs = jnp.minimum((u * count).astype(jnp.int32), count - 1)
     rows = (base + offs) % cap
     return _gather_transitions(
-        bufs, rows, envs, n_samples=n_samples, batch_size=batch_size, cap=cap, next_keys=next_keys
+        bufs, rows, envs, n_samples=n_samples, batch_size=batch_size, cap=cap,
+        next_keys=next_keys, kernel=kernel,
     )
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("n_samples", "batch_size", "cap", "n_envs", "next_keys", "depth"),
+    static_argnames=("n_samples", "batch_size", "cap", "n_envs", "next_keys", "depth", "kernel"),
 )
 def _sample_transitions_prioritized(
-    bufs, tree, key, pos, filled, beta, *, n_samples, batch_size, cap, n_envs, next_keys, depth
+    bufs, tree, key, pos, filled, beta, *, n_samples, batch_size, cap, n_envs, next_keys, depth,
+    kernel="lax",
 ):
     """Proportional prioritized counterpart of :func:`_sample_transitions`:
     (row, env) cells drawn from the sum-tree (leaf = row * n_envs + env),
@@ -196,31 +209,48 @@ def _sample_transitions_prioritized(
     the per-env write-head row is zeroed in a functional tree copy when
     next-obs are gathered (same exclusion as :func:`_transition_window`).
     Returns the batch dict + ``is_weights`` (β-annealed, batch-max
-    normalized) and the sampled leaf indices for ``update_priorities``."""
+    normalized) and the sampled leaf indices for ``update_priorities``.
+
+    ``kernel="pallas"`` runs the whole draw through the fused
+    ops/pallas_per.py descent (head-row exclusions folded in — no
+    functional tree copy) + one fused multi-key gather."""
     from sheeprl_tpu.replay.priority_tree import _tree_sample, _tree_zeroed
 
     flat = n_samples * batch_size
     # live-cell count N for the IS correction w = (N * P(i))^-beta
     n_live = jnp.sum(filled) - (n_envs if next_keys else 0)
-    t = tree
-    if next_keys:  # jaxlint: disable=retrace-branch — static obs-key tuple, not a tracer
-        head_rows = (pos - 1) % cap  # per-env newest row: its successor is stale
-        head_leaves = head_rows * n_envs + jnp.arange(n_envs)
-        t = _tree_zeroed(t, head_leaves, jnp.ones((n_envs,), bool), depth=depth)
-    leaves, w = _tree_sample(t, key, beta, n_live, n=flat, depth=depth)
+    if kernel == "pallas":  # jaxlint: disable=retrace-branch — static kernel-selection string
+        from sheeprl_tpu.ops.pallas_per import sum_tree_sample
+
+        head_leaves = None
+        if next_keys:  # jaxlint: disable=retrace-branch — static obs-key tuple, not a tracer
+            head_rows = (pos - 1) % cap  # per-env newest row: its successor is stale
+            head_leaves = head_rows * n_envs + jnp.arange(n_envs)
+        leaves, w = sum_tree_sample(
+            tree, key, beta, n_live, n=flat, depth=depth, exclude_idx=head_leaves
+        )
+    else:
+        t = tree
+        if next_keys:  # jaxlint: disable=retrace-branch — static obs-key tuple, not a tracer
+            head_rows = (pos - 1) % cap  # per-env newest row: its successor is stale
+            head_leaves = head_rows * n_envs + jnp.arange(n_envs)
+            t = _tree_zeroed(t, head_leaves, jnp.ones((n_envs,), bool), depth=depth)
+        leaves, w = _tree_sample(t, key, beta, n_live, n=flat, depth=depth)
     rows = leaves // n_envs
     envs = leaves % n_envs
     out = _gather_transitions(
-        bufs, rows, envs, n_samples=n_samples, batch_size=batch_size, cap=cap, next_keys=next_keys
+        bufs, rows, envs, n_samples=n_samples, batch_size=batch_size, cap=cap,
+        next_keys=next_keys, kernel=kernel,
     )
     out["is_weights"] = w.reshape(n_samples, batch_size, 1)
     return out, leaves.reshape(n_samples, batch_size)
 
 
-def _gather_windows(bufs, key, pos, filled, *, n_samples, batch_size, seq_len, cap, n_envs):
+def _gather_windows(bufs, key, pos, filled, *, n_samples, batch_size, seq_len, cap, n_envs, kernel="lax"):
     """Core window gather shared by the single-device jit and the
     per-device body of the sharded sampler (shapes are whatever the
-    caller's shard holds)."""
+    caller's shard holds).  ``kernel="pallas"`` fuses every key's window
+    gather into ONE ops/pallas_gather.py kernel (identical bytes)."""
     flat = n_samples * batch_size
     k_env, k_start = jax.random.split(key)
     envs = jax.random.randint(k_env, (flat,), 0, n_envs)
@@ -230,6 +260,24 @@ def _gather_windows(bufs, key, pos, filled, *, n_samples, batch_size, seq_len, c
     u = jax.random.uniform(k_start, (flat,))
     offs = jnp.minimum((u * c_e).astype(jnp.int32), c_e - 1)
     starts = (base[envs] + offs) % cap
+    return _window_gather_out(
+        bufs, starts, envs, n_samples=n_samples, batch_size=batch_size, seq_len=seq_len,
+        cap=cap, kernel=kernel,
+    )
+
+
+def _window_gather_out(bufs, starts, envs, *, n_samples, batch_size, seq_len, cap, kernel):
+    """(flat,) starts/envs -> {k: (n_samples, L, B, *feat)} — the shared
+    tail of the uniform and prioritized sequence samplers."""
+    if kernel == "pallas":
+        from sheeprl_tpu.ops.pallas_gather import gather_windows_fused
+
+        flat_out = gather_windows_fused(bufs, starts, envs, seq_len=seq_len)
+        out = {}
+        for k, g in flat_out.items():
+            g = g.reshape(n_samples, batch_size, seq_len, *g.shape[2:])
+            out[k] = jnp.swapaxes(g, 1, 2)  # (n_samples, L, B, *feat)
+        return out
     t_idx = (starts[:, None] + jnp.arange(seq_len)[None, :]) % cap  # (flat, L)
     e_idx = envs[:, None]
     out = {}
@@ -241,9 +289,9 @@ def _gather_windows(bufs, key, pos, filled, *, n_samples, batch_size, seq_len, c
 
 
 @functools.partial(
-    jax.jit, static_argnames=("n_samples", "batch_size", "seq_len", "cap", "n_envs")
+    jax.jit, static_argnames=("n_samples", "batch_size", "seq_len", "cap", "n_envs", "kernel")
 )
-def _sample(bufs, key, pos, filled, *, n_samples, batch_size, seq_len, cap, n_envs):
+def _sample(bufs, key, pos, filled, *, n_samples, batch_size, seq_len, cap, n_envs, kernel="lax"):
     """Gather (n_samples, seq_len, batch, *feat) sequence windows.
 
     Valid starts per env mirror SequentialReplayBuffer.sample: the stored
@@ -254,45 +302,55 @@ def _sample(bufs, key, pos, filled, *, n_samples, batch_size, seq_len, cap, n_en
     return _gather_windows(
         bufs, key, pos, filled,
         n_samples=n_samples, batch_size=batch_size, seq_len=seq_len,
-        cap=cap, n_envs=n_envs,
+        cap=cap, n_envs=n_envs, kernel=kernel,
     )
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("n_samples", "batch_size", "seq_len", "cap", "n_envs", "depth"),
+    static_argnames=("n_samples", "batch_size", "seq_len", "cap", "n_envs", "depth", "kernel"),
 )
 def _sample_prioritized(
-    bufs, tree, key, pos, filled, beta, *, n_samples, batch_size, seq_len, cap, n_envs, depth
+    bufs, tree, key, pos, filled, beta, *, n_samples, batch_size, seq_len, cap, n_envs, depth,
+    kernel="lax",
 ):
     """Prioritized sequence-START sampling (Dreamer family, behind
     ``buffer.prioritized``): window starts drawn proportional to their
     cell's priority instead of uniformly.  Validity matches
     :func:`_gather_windows` exactly — the L-1 rows immediately preceding
     each env's write head cannot start a full window (zeroed in a
-    functional tree copy; unwritten cells already carry zero priority).
+    functional tree copy on the lax path; folded into the fused descent
+    as mass corrections on the pallas path — the L-1 rows are distinct
+    modulo a capacity ``can_sample`` bounds below by the window length,
+    so the distinct-exclusions contract holds by construction).
     Returns the window batch + the sampled start leaves (the caller may
     decay them — recency-biased replay without a TD signal)."""
     from sheeprl_tpu.replay.priority_tree import _tree_sample, _tree_zeroed
 
     flat = n_samples * batch_size
-    t = tree
+    n_live = jnp.sum(jnp.maximum(filled - seq_len + 1, 0))
+    inv_leaves = None
     if seq_len > 1:  # jaxlint: disable=retrace-branch — static (python int) window length
         offs = jnp.arange(1, seq_len)  # (L-1,)
         inv_rows = (pos[None, :] - offs[:, None]) % cap  # (L-1, n_envs)
         inv_leaves = (inv_rows * n_envs + jnp.arange(n_envs)[None, :]).reshape(-1)
-        t = _tree_zeroed(t, inv_leaves, jnp.ones(inv_leaves.shape, bool), depth=depth)
-    n_live = jnp.sum(jnp.maximum(filled - seq_len + 1, 0))
-    leaves, _w = _tree_sample(t, key, beta, n_live, n=flat, depth=depth)
+    if kernel == "pallas":  # jaxlint: disable=retrace-branch — static kernel-selection string
+        from sheeprl_tpu.ops.pallas_per import sum_tree_sample
+
+        leaves, _w = sum_tree_sample(
+            tree, key, beta, n_live, n=flat, depth=depth, exclude_idx=inv_leaves
+        )
+    else:
+        t = tree
+        if inv_leaves is not None:
+            t = _tree_zeroed(t, inv_leaves, jnp.ones(inv_leaves.shape, bool), depth=depth)
+        leaves, _w = _tree_sample(t, key, beta, n_live, n=flat, depth=depth)
     starts = leaves // n_envs
     envs = leaves % n_envs
-    t_idx = (starts[:, None] + jnp.arange(seq_len)[None, :]) % cap  # (flat, L)
-    e_idx = envs[:, None]
-    out = {}
-    for k, buf in bufs.items():
-        g = buf[t_idx, e_idx]  # (flat, L, *feat)
-        g = g.reshape(n_samples, batch_size, seq_len, *buf.shape[2:])
-        out[k] = jnp.swapaxes(g, 1, 2)  # (n_samples, L, B, *feat)
+    out = _window_gather_out(
+        bufs, starts, envs, n_samples=n_samples, batch_size=batch_size, seq_len=seq_len,
+        cap=cap, kernel=kernel,
+    )
     return out, leaves
 
 
@@ -388,6 +446,7 @@ def _maybe_create_sharded(cfg, runtime, capacity: int, n_envs: int):
         per_alpha=float(cfg.buffer.get("per_alpha", 0.6)),
         per_eps=float(cfg.buffer.get("per_eps", 1e-6)),
         per_decay=cfg.buffer.get("per_decay_on_sample", None),
+        kernel=str(cfg.buffer.get("per_kernel", "lax")),
     )
     print(
         f"DeviceReplayCache: env-sharded replay window enabled "
@@ -441,6 +500,7 @@ class DeviceReplayCache:
         per_alpha: float = 0.6,
         per_eps: float = 1e-6,
         per_decay: Optional[float] = None,
+        kernel: str = "lax",
     ):
         if capacity <= 0 or n_envs <= 0:
             raise ValueError(f"capacity ({capacity}) and n_envs ({n_envs}) must be positive")
@@ -456,6 +516,12 @@ class DeviceReplayCache:
         self.per_alpha = float(per_alpha)
         self.per_eps = float(per_eps)
         self.per_decay = per_decay if per_decay is None else float(per_decay)
+        from sheeprl_tpu.replay.priority_tree import resolve_per_kernel
+
+        # data-plane kernel selection (buffer.per_kernel): routes the
+        # sum-tree descent/scatter AND the batch gathers through the fused
+        # ops/ kernels; "lax" keeps the pre-kernel paths bit-exact
+        self.kernel = resolve_per_kernel(kernel)
         self._tree = None
         self._bufs: Optional[Dict[str, jax.Array]] = None
         self._pos = np.zeros(n_envs, dtype=np.int32)
@@ -573,6 +639,7 @@ class DeviceReplayCache:
                 alpha=self.per_alpha,
                 eps=self.per_eps,
                 device=self._device,
+                kernel=self.kernel,
             )
 
     def _seed_tree_window(
@@ -757,6 +824,7 @@ class DeviceReplayCache:
             seq_len=int(seq_len),
             cap=self.capacity,
             n_envs=self.n_envs,
+            kernel=self.kernel,
         )
         return [{k: v[i] for k, v in out.items()} for i in range(n_samples)]
 
@@ -788,6 +856,7 @@ class DeviceReplayCache:
             cap=self.capacity,
             n_envs=self.n_envs,
             next_keys=tuple(obs_keys) if sample_next_obs else (),
+            kernel=self.kernel,
         )
 
     def can_sample_transitions(self, sample_next_obs: bool = False) -> bool:
@@ -853,6 +922,7 @@ class DeviceReplayCache:
             n_envs=self.n_envs,
             next_keys=tuple(obs_keys) if sample_next_obs else (),
             depth=self._tree.depth,
+            kernel=self.kernel,
         )
 
     def sample_per(
@@ -883,6 +953,7 @@ class DeviceReplayCache:
             cap=self.capacity,
             n_envs=self.n_envs,
             depth=self._tree.depth,
+            kernel=self.kernel,
         )
         if self.per_decay is not None:
             self._tree.scale(leaves, self.per_decay)
@@ -969,6 +1040,7 @@ class DeviceReplayCache:
             per_alpha=float(cfg.buffer.get("per_alpha", 0.6)),
             per_eps=float(cfg.buffer.get("per_eps", 1e-6)),
             per_decay=cfg.buffer.get("per_decay_on_sample", None),
+            kernel=str(cfg.buffer.get("per_kernel", "lax")),
         )
         print(
             f"DeviceReplayCache: HBM-resident replay window enabled "
@@ -1019,6 +1091,7 @@ class ShardedDeviceReplayCache(DeviceReplayCache):
         per_alpha: float = 0.6,
         per_eps: float = 1e-6,
         per_decay: Optional[float] = None,
+        kernel: str = "lax",
     ):
         n_dev = runtime.device_count
         if n_envs % n_dev:
@@ -1032,6 +1105,7 @@ class ShardedDeviceReplayCache(DeviceReplayCache):
             per_alpha=per_alpha,
             per_eps=per_eps,
             per_decay=per_decay,
+            kernel=kernel,
         )
         self._runtime = runtime
         self._n_dev = n_dev
@@ -1056,6 +1130,7 @@ class ShardedDeviceReplayCache(DeviceReplayCache):
                 self._runtime.mesh,
                 alpha=self.per_alpha,
                 eps=self.per_eps,
+                kernel=self.kernel,
             )
 
     def _flat_rank(self):
@@ -1111,6 +1186,7 @@ class ShardedDeviceReplayCache(DeviceReplayCache):
         mesh = self._runtime.mesh
         axes = self._axes
         cap, n_envs, n_dev = self.capacity, self.n_envs, self._n_dev
+        kernel = self.kernel
 
         def body(bufs_l, key, pos_l, filled_l):
             # per-device independent stream; each device samples its own envs
@@ -1118,7 +1194,7 @@ class ShardedDeviceReplayCache(DeviceReplayCache):
             return _gather_windows(
                 bufs_l, k, pos_l, filled_l,
                 n_samples=n_samples, batch_size=batch_size // n_dev,
-                seq_len=seq_len, cap=cap, n_envs=n_envs // n_dev,
+                seq_len=seq_len, cap=cap, n_envs=n_envs // n_dev, kernel=kernel,
             )
 
         buf_specs = {k: P(None, axes) for k in self._bufs}
@@ -1168,6 +1244,7 @@ class ShardedDeviceReplayCache(DeviceReplayCache):
         cap, n_dev = self.capacity, self._n_dev
         n_local = self.n_envs // n_dev
         b_local = batch_size // n_dev
+        kernel = self.kernel
 
         def body(bufs_l, key, pos_l, filled_l):
             k = jax.random.fold_in(key, self._flat_rank())
@@ -1181,6 +1258,7 @@ class ShardedDeviceReplayCache(DeviceReplayCache):
             return _gather_transitions(
                 bufs_l, rows, envs,
                 n_samples=n_samples, batch_size=b_local, cap=cap, next_keys=next_keys,
+                kernel=kernel,
             )
 
         buf_specs = {k: P(None, axes) for k in self._bufs}
@@ -1281,22 +1359,34 @@ class ShardedDeviceReplayCache(DeviceReplayCache):
         depth = self._tree.depth
         flat = n_samples * batch_size
         windows = seq_len is not None
+        kernel = self.kernel
 
         def body(bufs_l, trees_l, key, pos_l, filled_l, beta):
             r = self._flat_rank()
             t = trees_l[0]
+            # shard-local sampling exclusions (invalid window starts /
+            # stale-next-obs head rows): the lax path pre-zeroes a
+            # functional sub-tree copy; the pallas path folds them into
+            # the fused descent as mass corrections (no copy)
+            excl = None
             if windows and seq_len > 1:  # jaxlint: disable=retrace-branch — static window length
                 offs = jnp.arange(1, seq_len)  # (L-1,)
                 inv_rows = (pos_l[None, :] - offs[:, None]) % cap  # (L-1, n_local)
-                inv_leaves = (inv_rows * n_local + jnp.arange(n_local)[None, :]).reshape(-1)
-                t = _tree_zeroed_local(t, inv_leaves, depth)
+                excl = (inv_rows * n_local + jnp.arange(n_local)[None, :]).reshape(-1)
             if not windows and next_keys:  # jaxlint: disable=retrace-branch — static obs-key tuple
                 head_rows = (pos_l - 1) % cap  # per-env newest row: successor is stale
-                head_leaves = head_rows * n_local + jnp.arange(n_local)
-                t = _tree_zeroed_local(t, head_leaves, depth)
-            leaf, mass, own, total = shard_proportional_draw(
-                t, key, r, n_dev, axes, n=flat, depth=depth
-            )
+                excl = head_rows * n_local + jnp.arange(n_local)
+            if kernel == "pallas":
+                leaf, mass, own, total = shard_proportional_draw(
+                    t, key, r, n_dev, axes, n=flat, depth=depth,
+                    kernel="pallas", exclude_idx=excl,
+                )
+            else:
+                if excl is not None:
+                    t = _tree_zeroed_local(t, excl, depth)
+                leaf, mass, own, total = shard_proportional_draw(
+                    t, key, r, n_dev, axes, n=flat, depth=depth
+                )
             rows = leaf // n_local
             env_l = leaf % n_local
             cell_global = rows * n_envs + (r * n_local + env_l)

@@ -1,0 +1,141 @@
+"""Fused Pallas gather kernels for the replay-cache data plane.
+
+The lax samplers (data/device_buffer.py) assemble a draw as per-key
+XLA gathers over a ``seq_len``-strided index fan: ``_gather_windows``
+builds a (flat, L) ring-index matrix and issues one advanced-indexing
+gather PER BUFFER KEY, ``_gather_transitions`` likewise plus a second
+fan for the ``next_*`` rows.  These kernels fuse one whole draw into a
+SINGLE ``pallas_call``: every buffer key rides as one input ref and one
+output ref of the same kernel, the ring/window index arithmetic is
+computed ONCE, and each key's gather happens in the same program — a
+prioritized sequence draw becomes one kernel launch instead of a
+per-key gather chain.
+
+The gathers move bytes untouched, so outputs are BIT-IDENTICAL to the
+lax path's for the same indices — ``per_kernel=pallas`` changes the
+execution shape, never the sampled data.
+
+Like ops/pallas_per.py these are gridless single-program kernels
+(interpret mode executes them as fused jax ops on any backend; a large
+interpret grid costs ~1 ms PER STEP, so a (flat × L)-grid DMA design —
+the natural compiled-TPU evolution via ``PrefetchScalarGridSpec``, one
+(1, 1, F) block copy per window row with the ring offset computed in
+the index map — is documented in howto/performance.md but not the
+default).  VMEM residency bounds compiled-mode use to rings that fit
+on-chip; interpret mode has no such bound.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from sheeprl_tpu.ops.pallas_per import resolve_interpret
+
+__all__ = [
+    "gather_transitions_fused",
+    "gather_windows_fused",
+]
+
+
+def _flat2(buf):
+    """(cap, n_envs, *feat) -> (cap * n_envs, F) view (F >= 1)."""
+    cap, n_envs = buf.shape[:2]
+    feat = int(np.prod(buf.shape[2:], dtype=np.int64) or 1)
+    return buf.reshape(cap * n_envs, feat)
+
+
+def _windows_kernel(*refs, n_keys, seq_len, cap, n_envs):
+    starts_ref, envs_ref = refs[0], refs[1]
+    buf_refs = refs[2 : 2 + n_keys]
+    out_refs = refs[2 + n_keys :]
+    starts = starts_ref[:]
+    envs = envs_ref[:]
+    # one index fan for every key: (flat, L) ring rows -> flat cell ids
+    t_idx = (starts[:, None] + jnp.arange(seq_len)[None, :]) % cap
+    cell = (t_idx * n_envs + envs[:, None]).reshape(-1)
+    for b_ref, o_ref in zip(buf_refs, out_refs):
+        flat, feat = o_ref.shape[0], o_ref.shape[-1]
+        o_ref[:] = jnp.take(b_ref[:], cell, axis=0).reshape(flat, seq_len, feat)
+
+
+def gather_windows_fused(
+    bufs: Dict[str, jax.Array],
+    starts,
+    envs,
+    *,
+    seq_len: int,
+    interpret: Optional[bool] = None,
+) -> Dict[str, jax.Array]:
+    """All keys' (flat, L, *feat) sequence windows in ONE kernel.
+
+    ``bufs[k]`` is (cap, n_envs, *feat); ``starts``/``envs`` are (flat,)
+    ring starts and env columns; windows wrap modulo the capacity."""
+    keys = list(bufs)
+    first = bufs[keys[0]]
+    cap, n_envs = first.shape[:2]
+    flat = starts.shape[0]
+    flats = [_flat2(bufs[k]) for k in keys]
+    out = pl.pallas_call(
+        functools.partial(
+            _windows_kernel, n_keys=len(keys), seq_len=int(seq_len), cap=cap, n_envs=n_envs
+        ),
+        out_shape=tuple(
+            jax.ShapeDtypeStruct((flat, int(seq_len), f.shape[1]), f.dtype) for f in flats
+        ),
+        interpret=resolve_interpret(interpret),
+    )(jnp.asarray(starts, jnp.int32), jnp.asarray(envs, jnp.int32), *flats)
+    return {
+        k: o.reshape((flat, int(seq_len)) + bufs[k].shape[2:]) for k, o in zip(keys, out)
+    }
+
+
+def _transitions_kernel(*refs, n_keys, n_next, cap, n_envs):
+    rows_ref, envs_ref = refs[0], refs[1]
+    buf_refs = refs[2 : 2 + n_keys + n_next]
+    out_refs = refs[2 + n_keys + n_next :]
+    rows = rows_ref[:]
+    envs = envs_ref[:]
+    cell = rows * n_envs + envs
+    ncell = ((rows + 1) % cap) * n_envs + envs
+    for i, (b_ref, o_ref) in enumerate(zip(buf_refs, out_refs)):
+        o_ref[:] = jnp.take(b_ref[:], cell if i < n_keys else ncell, axis=0)
+
+
+def gather_transitions_fused(
+    bufs: Dict[str, jax.Array],
+    rows,
+    envs,
+    *,
+    next_keys: Sequence[str] = (),
+    interpret: Optional[bool] = None,
+) -> Dict[str, jax.Array]:
+    """All keys' flat-transition rows (+ ``next_<k>`` successor rows for
+    ``next_keys``) in ONE kernel.  Successor row = (row + 1) % cap, same
+    contract as the lax ``_gather_transitions``."""
+    keys = list(bufs)
+    nxt = list(next_keys)
+    first = bufs[keys[0]]
+    cap, n_envs = first.shape[:2]
+    flat = rows.shape[0]
+    flats = [_flat2(bufs[k]) for k in keys] + [_flat2(bufs[k]) for k in nxt]
+    out = pl.pallas_call(
+        functools.partial(
+            _transitions_kernel, n_keys=len(keys), n_next=len(nxt), cap=cap, n_envs=n_envs
+        ),
+        out_shape=tuple(
+            jax.ShapeDtypeStruct((flat, f.shape[1]), f.dtype) for f in flats
+        ),
+        interpret=resolve_interpret(interpret),
+    )(jnp.asarray(rows, jnp.int32), jnp.asarray(envs, jnp.int32), *flats)
+    res = {}
+    for k, o in zip(keys, out[: len(keys)]):
+        res[k] = o.reshape((flat,) + bufs[k].shape[2:])
+    for k, o in zip(nxt, out[len(keys) :]):
+        res[f"next_{k}"] = o.reshape((flat,) + bufs[k].shape[2:])
+    return res

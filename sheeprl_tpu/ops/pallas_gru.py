@@ -59,7 +59,7 @@ def _gru_kernel(h_ref, inp_ref, w_ref, gamma_ref, beta_ref, out_ref, acc_ref, *,
     @pl.when(k == nk - 1)
     def _finish():
         parts = acc_ref[:]
-        if use_ln:
+        if use_ln:  # jaxlint: disable=retrace-branch — static kernel config (python bool)
             mean = parts.mean(axis=-1, keepdims=True)
             var = ((parts - mean) ** 2).mean(axis=-1, keepdims=True)
             parts = (parts - mean) * jax.lax.rsqrt(var + eps)

@@ -1,0 +1,300 @@
+"""Fused Pallas kernels for the prioritized-replay sum-tree data plane.
+
+The lax path (replay/priority_tree.py) runs a proportional draw as a
+chain of per-level gathers over the heap array and — when sampling-time
+exclusions apply (stale next-obs head rows, invalid sequence starts) —
+pays a FUNCTIONAL COPY of the whole tree first (``_tree_zeroed``: an
+O(tree) scatter + ancestor rebuild per draw batch, 8 MB at the 1e6-leaf
+rung).  These kernels fuse the whole draw into ONE program:
+
+- :func:`sum_tree_sample`: all ``n`` draws descend the tree in one
+  kernel, with exclusions applied as ON-THE-FLY CORRECTIONS instead of a
+  tree copy — at each level the excluded mass under the left child is
+  subtracted from the stored prefix sum (an excluded leaf's ancestor at
+  level L is just ``(leaf + P) >> (depth - L)``, so the correction is a
+  tiny (n, E) compare-and-sum against the E excluded leaves).  The
+  no-exclusion descent is op-for-op identical to the lax ``_descend`` and
+  therefore bit-exact on the same key; with exclusions the arithmetic is
+  ``stored_sum - excluded_mass`` instead of the rebuilt zeroed sums, so
+  parity is exact arithmetic (integer-valued f32 priorities: bit-exact)
+  and otherwise within float rounding of a subtree boundary — a draw can
+  flip leaf only when it lands within ~1 ulp of a boundary.
+- :func:`sum_tree_write` / :func:`sum_tree_update`: the fused
+  scatter-update for ``_tree_write``/``_tree_update`` — leaf scatter +
+  bottom-up ancestor rebuild (+ running-max fold for updates) in one
+  kernel, same one-writer-per-duplicate semantics (inactive lanes parked
+  at heap slot 0), bit-exact with the lax path.
+- :func:`sum_tree_descend`: the raw (un-jitted) corrected descent for
+  use INSIDE ``shard_map`` bodies — the per-shard counterpart that
+  composes with ``shard_proportional_draw`` (each shard descends its own
+  sub-tree for all n draws; exclusions stay shard-local).
+
+Exclusion contract: excluded leaf indices must be DISTINCT where active
+(a duplicate would subtract its mass twice).  Every data-plane caller
+satisfies this by construction — head rows are one leaf per env, and the
+L-1 pre-head sequence starts are distinct rows modulo a capacity that
+``can_sample`` already bounds below by the sequence length.
+
+Kernels are SINGLE-PROGRAM pallas_calls (no grid): tree, draws and
+outputs live in one VMEM residency, which bounds the compiled-mode tree
+at roughly VMEM size (2M leaves ≈ 8 MB f32 — above that a compiled
+kernel needs an HBM tree + per-level DMA, not written yet because this
+container cannot compile TPU kernels).  ``interpret=True`` (the default
+off-TPU) runs them anywhere; interpret mode executes the body as plain
+traced jax ops, so the fused-exclusion path is ALSO the fast path on
+CPU — measured 8.5x over the lax zeroed-copy sample at the 1e6 rung
+(see benchmarks/results/replay_sampling_r14.json).  Large interpret
+grids are pathological (~1 ms per grid step): keep these kernels
+gridless.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+__all__ = [
+    "resolve_interpret",
+    "sum_tree_descend",
+    "sum_tree_sample",
+    "sum_tree_scatter",
+    "sum_tree_update",
+    "sum_tree_write",
+]
+
+
+def resolve_interpret(interpret: Optional[bool]) -> bool:
+    """``None`` -> interpreter mode everywhere but a real TPU backend."""
+    if interpret is None:
+        return jax.default_backend() != "tpu"
+    return bool(interpret)
+
+
+# --------------------------------------------------------------- descent
+def _corrected_descent(tree, u, excl, eact, emass, depth):
+    """Root-to-leaf descent with excluded-mass corrections (see module
+    docstring).  ``tree`` is the heap VALUES array; with ``eact`` all
+    False this is op-for-op the lax ``_descend``."""
+    p = 1 << depth
+    node = jnp.ones(u.shape, jnp.int32)
+    enode = excl.astype(jnp.int32) + p
+    for lvl in range(depth):
+        child = 2 * node
+        left = jnp.take(tree, child)
+        if excl.shape[0]:  # static — compiled away when no exclusions ride
+            anc = enode >> (depth - 1 - lvl)
+            corr = jnp.sum(
+                jnp.where(anc[None, :] == child[:, None], emass[None, :], 0.0), axis=1
+            )
+            left = left - corr
+        go_right = u >= left
+        u = jnp.where(go_right, u - left, u)
+        node = child + go_right.astype(jnp.int32)
+    return node - p, jnp.take(tree, node)
+
+
+def _excluded_mass(tree, excl, eact, depth):
+    p = 1 << depth
+    return jnp.where(eact, jnp.take(tree, excl.astype(jnp.int32) + p), 0.0)
+
+
+def _sample_kernel(tree_ref, r01_ref, beta_ref, count_ref, excl_ref, eact_ref, leaf_ref, w_ref, *, depth):
+    tree = tree_ref[:]
+    emass = _excluded_mass(tree, excl_ref[:], eact_ref[:], depth)
+    total = tree[1] - jnp.sum(emass)
+    u = r01_ref[:] * total
+    leaf, mass = _corrected_descent(tree, u, excl_ref[:], eact_ref[:], emass, depth)
+    # identical IS-weight formulas to the lax _tree_sample (same rounding
+    # guard: a draw that skids into a zero-mass leaf keeps a tiny floor)
+    tiny = jnp.finfo(tree.dtype).tiny
+    probs = jnp.maximum(mass, tiny) / jnp.maximum(total, tiny)
+    w = (jnp.maximum(count_ref[0], 1.0) * probs) ** (-beta_ref[0])
+    leaf_ref[:] = leaf
+    w_ref[:] = w / jnp.max(w)
+
+
+def _descend_kernel(tree_ref, u_ref, excl_ref, eact_ref, leaf_ref, mass_ref, *, depth):
+    tree = tree_ref[:]
+    emass = _excluded_mass(tree, excl_ref[:], eact_ref[:], depth)
+    leaf, mass = _corrected_descent(tree, u_ref[:], excl_ref[:], eact_ref[:], emass, depth)
+    leaf_ref[:] = leaf
+    mass_ref[:] = mass
+
+
+def _write_body(tree, leaf_idx, values, active, depth):
+    """Scatter + bottom-up ancestor rebuild — the exact ``_write_impl``
+    arithmetic (one writer per duplicate, inactive lanes parked at the
+    unused heap slot 0) so lax and pallas trees stay bit-identical."""
+    p = 1 << depth
+    node = jnp.where(active, leaf_idx.astype(jnp.int32) + p, 0)
+    tree = tree.at[node].set(jnp.where(active, values.astype(tree.dtype), tree[0]))
+    for _ in range(depth):
+        node = node >> 1
+        tree = tree.at[node].set(tree[2 * node] + tree[2 * node + 1])
+    return tree
+
+
+def _write_kernel(tree_ref, leaf_ref, val_ref, act_ref, out_ref, *, depth):
+    out_ref[:] = _write_body(tree_ref[:], leaf_ref[:], val_ref[:], act_ref[:], depth)
+
+
+def _update_kernel(tree_ref, maxp_ref, leaf_ref, pri_ref, act_ref, out_ref, newmax_ref, *, depth):
+    act = act_ref[:]
+    pri = pri_ref[:]
+    newmax_ref[0] = jnp.maximum(maxp_ref[0], jnp.max(jnp.where(act, pri, 0.0)))
+    out_ref[:] = _write_body(tree_ref[:], leaf_ref[:], pri, act, depth)
+
+
+# ----------------------------------------------------------- public API
+def _excl_args(n, exclude_idx, exclude_active):
+    """Normalize the (possibly absent) exclusion pair to static-shape
+    device args: no exclusions ride as one inactive dummy lane."""
+    if exclude_idx is None:
+        return jnp.zeros((1,), jnp.int32), jnp.zeros((1,), bool)
+    excl = jnp.asarray(exclude_idx, jnp.int32).reshape(-1)
+    if exclude_active is None:
+        eact = jnp.ones(excl.shape, bool)
+    else:
+        eact = jnp.asarray(exclude_active).reshape(excl.shape)
+    return excl, eact
+
+
+@functools.partial(jax.jit, static_argnames=("n", "depth", "interpret"))
+def _sample_jit(tree, key, beta, count, excl, eact, *, n, depth, interpret):
+    # the uniforms consume the key exactly like the lax _tree_sample
+    # (u = uniform(key, (n,)) * total — total is applied inside the kernel)
+    r01 = jax.random.uniform(key, (n,))
+    return pl.pallas_call(
+        functools.partial(_sample_kernel, depth=depth),
+        out_shape=(
+            jax.ShapeDtypeStruct((n,), jnp.int32),
+            jax.ShapeDtypeStruct((n,), tree.dtype),
+        ),
+        interpret=interpret,
+    )(tree, r01, beta.reshape(1), count.reshape(1), excl, eact)
+
+
+def sum_tree_sample(
+    tree,
+    key,
+    beta,
+    count,
+    *,
+    n: int,
+    depth: int,
+    exclude_idx=None,
+    exclude_active=None,
+    interpret: Optional[bool] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Fused proportional draw: ``n`` leaves + batch-max-normalized IS
+    weights in ONE kernel, exclusions folded into the descent (no tree
+    copy).  Same key consumption and weight formulas as the lax
+    ``_tree_zeroed`` + ``_tree_sample`` pair."""
+    excl, eact = _excl_args(n, exclude_idx, exclude_active)
+    return _sample_jit(
+        tree,
+        jnp.asarray(key),
+        jnp.asarray(beta, tree.dtype),
+        jnp.asarray(count, tree.dtype),
+        excl,
+        eact,
+        n=n,
+        depth=depth,
+        interpret=resolve_interpret(interpret),
+    )
+
+
+def sum_tree_descend(
+    tree,
+    u,
+    *,
+    depth: int,
+    exclude_idx=None,
+    exclude_active=None,
+    interpret: Optional[bool] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Raw fused descent ``u in [0, total) -> (leaf, mass)`` — un-jitted,
+    for use inside ``shard_map`` bodies (the caller owns the collective
+    that placed ``u`` in this shard's interval)."""
+    excl, eact = _excl_args(u.shape[0], exclude_idx, exclude_active)
+    return pl.pallas_call(
+        functools.partial(_descend_kernel, depth=depth),
+        out_shape=(
+            jax.ShapeDtypeStruct(u.shape, jnp.int32),
+            jax.ShapeDtypeStruct(u.shape, tree.dtype),
+        ),
+        interpret=resolve_interpret(interpret),
+    )(tree, u, excl, eact)
+
+
+def sum_tree_scatter(tree, leaf_idx, values, active, *, depth: int, interpret: Optional[bool] = None):
+    """Raw (un-jitted) fused scatter-update for use INSIDE ``shard_map``
+    bodies — the per-shard counterpart of :func:`sum_tree_write` (the
+    outer jit owns donation there, so no aliasing is declared)."""
+    return pl.pallas_call(
+        functools.partial(_write_kernel, depth=depth),
+        out_shape=jax.ShapeDtypeStruct(tree.shape, tree.dtype),
+        interpret=resolve_interpret(interpret),
+    )(
+        tree,
+        jnp.asarray(leaf_idx, jnp.int32),
+        jnp.asarray(values, tree.dtype),
+        jnp.asarray(active),
+    )
+
+
+@functools.partial(jax.jit, donate_argnums=(0,), static_argnames=("depth", "interpret"))
+def _write_jit(tree, leaf_idx, values, active, *, depth, interpret):
+    return pl.pallas_call(
+        functools.partial(_write_kernel, depth=depth),
+        out_shape=jax.ShapeDtypeStruct(tree.shape, tree.dtype),
+        input_output_aliases={0: 0},
+        interpret=interpret,
+    )(tree, leaf_idx, values, active)
+
+
+def sum_tree_write(tree, leaf_idx, values, active, *, depth: int, interpret: Optional[bool] = None):
+    """Fused scatter-update (set leaves + rebuild touched ancestors) in
+    one donated kernel — bit-exact with the lax ``_tree_write``."""
+    return _write_jit(
+        tree,
+        jnp.asarray(leaf_idx, jnp.int32),
+        jnp.asarray(values, tree.dtype),
+        jnp.asarray(active),
+        depth=depth,
+        interpret=resolve_interpret(interpret),
+    )
+
+
+@functools.partial(jax.jit, donate_argnums=(0,), static_argnames=("depth", "interpret"))
+def _update_jit(tree, max_p, leaf_idx, priorities, active, *, depth, interpret):
+    tree, new_max = pl.pallas_call(
+        functools.partial(_update_kernel, depth=depth),
+        out_shape=(
+            jax.ShapeDtypeStruct(tree.shape, tree.dtype),
+            jax.ShapeDtypeStruct((1,), tree.dtype),
+        ),
+        input_output_aliases={0: 0},
+        interpret=interpret,
+    )(tree, max_p.reshape(1), leaf_idx, priorities, active)
+    return tree, new_max[0]
+
+
+def sum_tree_update(
+    tree, max_p, leaf_idx, priorities, active, *, depth: int, interpret: Optional[bool] = None
+):
+    """Fused priority update: scatter + rebuild + running-max fold in one
+    donated kernel — bit-exact with the lax ``_tree_update``."""
+    return _update_jit(
+        tree,
+        jnp.asarray(max_p, tree.dtype),
+        jnp.asarray(leaf_idx, jnp.int32),
+        jnp.asarray(priorities, tree.dtype),
+        jnp.asarray(active),
+        depth=depth,
+        interpret=resolve_interpret(interpret),
+    )

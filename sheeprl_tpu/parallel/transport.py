@@ -2264,6 +2264,7 @@ class ParamsFollower:
         timeout: float = 600.0,
         on_stale: Optional[Callable[[Frame], None]] = None,
         digest_slot: Optional[int] = None,
+        digest_fn: Optional[Callable] = None,
     ):
         if lag < 0:
             raise ValueError(f"decoupled_params_lag must be >= 0, got {lag}")
@@ -2285,6 +2286,10 @@ class ParamsFollower:
         # re-syncs, so the fixed/soft-lag walk is preserved, one round of
         # extra staleness at most)
         self.digest_slot = digest_slot
+        # the digest implementation must MATCH the trainer's (host
+        # content_digest by default; the batched device digest when
+        # algo.params_digest_device routes both ends through it)
+        self.digest_fn = digest_fn or content_digest
         self.digest_skips = 0
 
     def _digest_ok(self, frame: Frame) -> bool:
@@ -2295,7 +2300,7 @@ class ParamsFollower:
             return True  # sender did not digest this frame (e.g. crc-only mode)
         st = integrity_stats()
         st.params_digest_checked += 1
-        if content_digest(list(frame.arrays.items())) == int(frame.extra[slot]):
+        if self.digest_fn(list(frame.arrays.items())) == int(frame.extra[slot]):
             return True
         st.params_digest_mismatch += 1
         self.digest_skips += 1

@@ -37,8 +37,19 @@ __all__ = [
     "ShardedPriorityTree",
     "per_beta_schedule",
     "priority_from_td",
+    "resolve_per_kernel",
     "shard_proportional_draw",
 ]
+
+
+def resolve_per_kernel(value) -> str:
+    """Validate ``buffer.per_kernel``: ``lax`` (default — the gather/
+    scatter-chain kernels below, bit-exact with the pre-kernel tree) or
+    ``pallas`` (ops/pallas_per.py fused kernels, interpret mode off-TPU)."""
+    s = str(value).lower()
+    if s not in ("lax", "pallas"):
+        raise ValueError(f"buffer.per_kernel must be 'lax' or 'pallas', got {value!r}")
+    return s
 
 
 def priority_from_td(td_abs, alpha: float, eps: float):
@@ -162,12 +173,14 @@ class PriorityTree:
         eps: float = 1e-6,
         device=None,
         initial_priority: float = 1.0,
+        kernel: str = "lax",
     ):
         if n_leaves <= 0:
             raise ValueError(f"n_leaves must be positive, got {n_leaves}")
         self.n_leaves = int(n_leaves)
         self.alpha = float(alpha)
         self.eps = float(eps)
+        self.kernel = resolve_per_kernel(kernel)
         self.depth = max(int(self.n_leaves - 1).bit_length(), 1)
         self._device = device
         with jax.default_device(device) if device is not None else _null():
@@ -175,6 +188,16 @@ class PriorityTree:
             self.max_priority = jnp.asarray(float(initial_priority), dtype=jnp.float32)
 
     # ------------------------------------------------------------- write
+    def _write_tree(self, leaf_idx, values, active):
+        """Route one scatter-update through the configured kernel (same
+        semantics either way; pallas fuses scatter + rebuild into one
+        ops/pallas_per.py program)."""
+        if self.kernel == "pallas":
+            from sheeprl_tpu.ops.pallas_per import sum_tree_write
+
+            return sum_tree_write(self.tree, leaf_idx, values, active, depth=self.depth)
+        return _tree_write(self.tree, leaf_idx, values, active, depth=self.depth)
+
     def seed_max(self, leaf_idx, active) -> None:
         """Priority-seeded insert: new cells enter at the running max
         priority so every transition is trained on at least once before
@@ -182,7 +205,7 @@ class PriorityTree:
         maximal priority')."""
         leaf_idx = jnp.asarray(leaf_idx, jnp.int32)
         vals = jnp.broadcast_to(self.max_priority, leaf_idx.shape)
-        self.tree = _tree_write(self.tree, leaf_idx, vals, jnp.asarray(active), depth=self.depth)
+        self.tree = self._write_tree(leaf_idx, vals, jnp.asarray(active))
 
     def update(self, leaf_idx, td_abs, active=None) -> None:
         """TD-error feedback from the train step: p = (|δ| + ε)^α."""
@@ -190,6 +213,13 @@ class PriorityTree:
         if active is None:
             active = jnp.ones(leaf_idx.shape, bool)
         pri = priority_from_td(jnp.asarray(td_abs, jnp.float32).reshape(leaf_idx.shape), self.alpha, self.eps)
+        if self.kernel == "pallas":
+            from sheeprl_tpu.ops.pallas_per import sum_tree_update
+
+            self.tree, self.max_priority = sum_tree_update(
+                self.tree, self.max_priority, leaf_idx, pri, jnp.asarray(active), depth=self.depth
+            )
+            return
         self.tree, self.max_priority = _tree_update(
             self.tree, self.max_priority, leaf_idx, pri, jnp.asarray(active), depth=self.depth
         )
@@ -200,17 +230,15 @@ class PriorityTree:
         recency bias when no TD signal drives the priorities."""
         leaf_idx = jnp.asarray(leaf_idx, jnp.int32).reshape(-1)
         vals = self.priorities(leaf_idx) * jnp.float32(factor)
-        self.tree = _tree_write(
-            self.tree, leaf_idx, vals, jnp.ones(leaf_idx.shape, bool), depth=self.depth
-        )
+        self.tree = self._write_tree(leaf_idx, vals, jnp.ones(leaf_idx.shape, bool))
 
     def set_priorities(self, leaf_idx, priorities, active=None) -> None:
         """Raw priority write (restore path / tests)."""
         leaf_idx = jnp.asarray(leaf_idx, jnp.int32)
         if active is None:
             active = jnp.ones(leaf_idx.shape, bool)
-        self.tree = _tree_write(
-            self.tree, leaf_idx, jnp.asarray(priorities, jnp.float32), jnp.asarray(active), depth=self.depth
+        self.tree = self._write_tree(
+            leaf_idx, jnp.asarray(priorities, jnp.float32), jnp.asarray(active)
         )
 
     # ------------------------------------------------------------- read
@@ -221,7 +249,23 @@ class PriorityTree:
 
         ``exclude_idx``/``exclude_active`` zero those cells in a
         functional copy first — the stored priorities survive (used for
-        the stale-next-obs head row and invalid sequence starts)."""
+        the stale-next-obs head row and invalid sequence starts).  The
+        pallas kernel applies the same exclusions as in-descent mass
+        corrections instead (no tree copy; excluded indices must be
+        distinct where active — true for every data-plane caller)."""
+        if self.kernel == "pallas":
+            from sheeprl_tpu.ops.pallas_per import sum_tree_sample
+
+            return sum_tree_sample(
+                self.tree,
+                key,
+                jnp.asarray(beta, jnp.float32),
+                jnp.asarray(count, jnp.float32),
+                n=int(n),
+                depth=self.depth,
+                exclude_idx=exclude_idx,
+                exclude_active=exclude_active,
+            )
         tree = self.tree
         if exclude_idx is not None:
             ex = jnp.asarray(exclude_idx, jnp.int32)
@@ -284,7 +328,19 @@ def _null():
 
 
 # --------------------------------------------------------------------- sharded
-def shard_proportional_draw(tree, key, rank, n_shards, axes, *, n, depth):
+def shard_proportional_draw(
+    tree,
+    key,
+    rank,
+    n_shards,
+    axes,
+    *,
+    n,
+    depth,
+    kernel: str = "lax",
+    exclude_idx=None,
+    exclude_active=None,
+):
     """Globally-proportional draw from per-shard sub-trees, callable ONLY
     inside a ``shard_map`` body (it issues collectives over ``axes``).
 
@@ -302,8 +358,22 @@ def shard_proportional_draw(tree, key, rank, n_shards, axes, *, n, depth):
     Returns ``(local_leaf, mass, own, total)``: the shard-local leaf and
     its mass for ALL n draws (garbage where ``own`` is False — mask
     before any cross-shard assembly), the ownership mask, and the global
-    total mass (replicated)."""
-    m_local = tree[1]
+    total mass (replicated).
+
+    ``kernel="pallas"`` descends each shard's sub-tree through the fused
+    ops/pallas_per.py kernel and folds shard-local sampling exclusions
+    into the descent as mass corrections (``exclude_idx`` — the lax path
+    instead expects the caller to pre-zero a functional sub-tree copy,
+    the historical contract, so exclusions are pallas-only here)."""
+    if kernel == "pallas":
+        from sheeprl_tpu.ops.pallas_per import _excl_args, _excluded_mass, sum_tree_descend
+
+        excl, eact = _excl_args(n, exclude_idx, exclude_active)
+        m_local = tree[1] - jnp.sum(_excluded_mass(tree, excl, eact, depth))
+    else:
+        if exclude_idx is not None:
+            raise ValueError("exclude_idx on the lax path: pre-zero the sub-tree instead")
+        m_local = tree[1]
     masses = jax.lax.psum(
         jnp.zeros((n_shards,), tree.dtype).at[rank].set(m_local), axes
     )
@@ -320,7 +390,12 @@ def shard_proportional_draw(tree, key, rank, n_shards, axes, *, n, depth):
     # cumsum rounding can make (hi - lo) exceed this shard's own mass by
     # an ulp; keep the local descent strictly inside the sub-tree
     u_loc = jnp.clip(u - lo, 0.0, m_local * (1.0 - 1e-7))
-    leaf, mass = _descend(tree, u_loc, depth)
+    if kernel == "pallas":
+        leaf, mass = sum_tree_descend(
+            tree, u_loc, depth=depth, exclude_idx=excl, exclude_active=eact
+        )
+    else:
+        leaf, mass = _descend(tree, u_loc, depth)
     return leaf, mass, own, total
 
 
@@ -351,6 +426,7 @@ class ShardedPriorityTree:
         alpha: float = 0.6,
         eps: float = 1e-6,
         initial_priority: float = 1.0,
+        kernel: str = "lax",
     ):
         from sheeprl_tpu.parallel.sharding import BATCH_AXES
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -365,6 +441,7 @@ class ShardedPriorityTree:
         self.n_leaves_local = self.capacity * self.n_local_envs
         self.alpha = float(alpha)
         self.eps = float(eps)
+        self.kernel = resolve_per_kernel(kernel)
         self.depth = max(int(self.n_leaves_local - 1).bit_length(), 1)
         self._mesh = mesh
         self._axes = BATCH_AXES
@@ -393,11 +470,17 @@ class ShardedPriorityTree:
 
         axes, n_shards, depth = self._axes, self.n_shards, self.depth
         fsdp = int(self._mesh.shape[self._axes[1]])
+        kernel = self.kernel
 
         def body(trees, max_p, shard_ids, local_leaf, values, active, track_max):
             r = jax.lax.axis_index(axes[0]) * fsdp + jax.lax.axis_index(axes[1])
             act = active & (shard_ids == r)
-            t = _write_impl(trees[0], local_leaf, values, act, depth)
+            if kernel == "pallas":
+                from sheeprl_tpu.ops.pallas_per import sum_tree_scatter
+
+                t = sum_tree_scatter(trees[0], local_leaf, values, act, depth=depth)
+            else:
+                t = _write_impl(trees[0], local_leaf, values, act, depth)
             # running max across every shard's accepted writes: pmax keeps
             # it replicated without a host sync (track_max=False for raw
             # set/scale writes, matching PriorityTree semantics)

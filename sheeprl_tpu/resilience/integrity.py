@@ -56,20 +56,25 @@ from sheeprl_tpu.resilience.faults import get_injector
 
 __all__ = [
     "DEFAULT_COVERAGE",
+    "DEVICE_DIGEST_IMPL",
     "FrameCorruptError",
     "IngestGuard",
     "IntegrityStats",
     "content_digest",
     "default_coverage",
+    "device_digest_supported",
     "integrity_setting",
     "integrity_stats",
     "leaf_digest",
+    "leaf_digest_batched",
     "maybe_bit_flip",
     "maybe_bit_flip_region",
+    "params_digest_fn",
     "region_checksum",
     "region_digest",
     "reset_integrity_stats",
     "stream_digest",
+    "stream_digest_batched",
 ]
 
 # --------------------------------------------------------------- checksum
@@ -265,6 +270,190 @@ def leaf_digest(arr: np.ndarray) -> int:
     if not a.nbytes:
         return 0
     return _extend(0, memoryview(a).cast("B"))
+
+
+# -------------------------------------------------------- device digests
+# PR-10's measurement: crc/digest-mode cost is a fixed ~25-30 us/message
+# of PYTHON constants — per-leaf header builds + crc extends — not
+# checksum throughput.  For pytree-shaped payloads whose digest both
+# sides compute from config (params broadcasts, checkpoint leaves) the
+# fix is to fold the WHOLE pytree's sampled-page checksum into ONE
+# device program: per-message python cost collapses to one cached jit
+# dispatch + one scalar fetch, independent of leaf count.  The device
+# digest is NOT CRC32C (bytewise CRC is serial and hostile to vector
+# units); it is a position-weighted 32-bit word hash ("xsum32"): each
+# sampled u32 word is multiplied by a per-position odd weight and
+# summed mod 2^32, per-leaf hashes are folded with per-leaf odd weights
+# plus a host-computed header constant (key/shape/dtype/index).  Any
+# single bit flip in a sampled word changes the sum by bit * odd-weight
+# != 0 mod 2^32 — detection-grade for the SDC/bit-rot class this layer
+# guards, deterministic across processes, and self-consistent because
+# BOTH ends call this same function (the wire fast path keeps host
+# CRC32C — region_digest over a contiguous buffer stays unbeatable
+# there, and wire frames are verified from raw bytes, not pytrees).
+DEVICE_DIGEST_IMPL = "xsum32-device-v1"
+_DD_LOCK = threading.Lock()
+_DD_PROGRAMS: Dict[tuple, object] = {}
+
+
+def device_digest_supported(arrays) -> bool:
+    """True when every leaf's dtype survives a jnp round-trip losslessly
+    on this backend (itemsize <= 4, non-object): wider dtypes would be
+    silently downcast with x64 disabled, leaving corruption in the lost
+    bits undetectable — callers fall back to the host path instead."""
+    for _, a in arrays:
+        dt = np.dtype(getattr(a, "dtype", np.float64))
+        if dt.kind in ("O", "U", "S", "M", "m") or dt.itemsize > 4:
+            return False
+    return True
+
+
+def _word_intervals(n_words: int, coverage: int):
+    """Per-leaf sampled geometry in u32-word space: the byte geometry of
+    :func:`_sample_intervals` with word-aligned edges."""
+    if n_words <= 0:
+        return []
+    return [
+        (s // 4, min(-(-e // 4), n_words))
+        for s, e in _sample_intervals(n_words * 4, coverage)
+    ]
+
+
+def _build_digest_program(struct, coverage: int, per_leaf: bool):
+    import jax
+    import jax.numpy as jnp
+
+    def to_words(x):
+        x = x.reshape(-1)
+        dt = np.dtype(x.dtype)
+        if dt == np.bool_:
+            x = x.astype(jnp.uint8)
+            dt = np.dtype(np.uint8)
+        if dt.itemsize == 4:
+            return jax.lax.bitcast_convert_type(x, jnp.uint32)
+        if dt.itemsize == 2:
+            h = jax.lax.bitcast_convert_type(x, jnp.uint16).astype(jnp.uint32)
+            if h.size % 2:
+                h = jnp.concatenate([h, jnp.zeros(1, jnp.uint32)])
+            return h[0::2] | (h[1::2] << 16)
+        b = jax.lax.bitcast_convert_type(x, jnp.uint8).astype(jnp.uint32)
+        pad = (-b.size) % 4
+        if pad:
+            b = jnp.concatenate([b, jnp.zeros(pad, jnp.uint32)])
+        b = b.reshape(-1, 4)
+        return b[:, 0] | (b[:, 1] << 8) | (b[:, 2] << 16) | (b[:, 3] << 24)
+
+    # static per-leaf constants: header hash + sampled-word positions and
+    # their odd weights (numpy, folded into the program as constants)
+    leaf_meta = []
+    for i, (key, shape, dtype_str, n_words) in enumerate(struct):
+        hdr = zlib.crc32(b"%d|%s|%s|%s" % (i, key.encode(), str(shape).encode(), dtype_str.encode()))
+        ivs = _word_intervals(n_words, coverage)
+        pos = (
+            np.concatenate([np.arange(s, e, dtype=np.int64) for s, e in ivs])
+            if ivs
+            else np.zeros(0, np.int64)
+        )
+        w = ((pos.astype(np.uint64) * np.uint64(2654435761) + np.uint64(0x9E3779B1)) | np.uint64(1)).astype(
+            np.uint32
+        )
+        lw = np.uint32(((np.uint64(i) * np.uint64(0x85EBCA6B) + np.uint64(0xC2B2AE35)) | np.uint64(1)) & np.uint64(0xFFFFFFFF))
+        leaf_meta.append((hdr, ivs, pos, w, lw))
+
+    def program(*leaves):
+        hashes = []
+        for (hdr, ivs, pos, w, lw), x in zip(leaf_meta, leaves):
+            if pos.size == 0:
+                hashes.append(jnp.uint32(hdr))
+                continue
+            words = to_words(x)
+            sampled = jnp.concatenate(
+                [jax.lax.slice_in_dim(words, s, e) for s, e in ivs]
+            )
+            h = jnp.sum(sampled * jnp.asarray(w), dtype=jnp.uint32)
+            hashes.append(h ^ jnp.uint32(hdr))
+        hv = jnp.stack(hashes)
+        if per_leaf:
+            return hv
+        lws = jnp.asarray(np.array([m[4] for m in leaf_meta], np.uint32))
+        return jnp.sum(hv * lws, dtype=jnp.uint32)
+
+    return jax.jit(program)
+
+
+def _digest_program_for(arrays, coverage: int, per_leaf: bool):
+    struct = tuple(
+        (key, tuple(np.shape(a)), np.dtype(a.dtype).str, (int(np.prod(np.shape(a), dtype=np.int64) or 1) * np.dtype(a.dtype).itemsize + 3) // 4 if np.size(a) else 0)
+        for key, a in arrays
+    )
+    cache_key = (struct, int(coverage), bool(per_leaf))
+    fn = _DD_PROGRAMS.get(cache_key)
+    if fn is None:
+        with _DD_LOCK:
+            fn = _DD_PROGRAMS.get(cache_key)
+            if fn is None:
+                fn = _build_digest_program(struct, int(coverage), per_leaf)
+                _DD_PROGRAMS[cache_key] = fn
+    return fn
+
+
+def stream_digest_batched(
+    arrays: Sequence[Tuple[str, np.ndarray]], coverage: Optional[int] = None
+) -> int:
+    """One-dispatch device digest of a whole pytree payload (sampled-page
+    coverage per leaf, same budget semantics as :func:`content_digest`).
+    Deterministic for a given payload + coverage; BOTH ends must use this
+    function (``algo.params_digest_device`` gates sender and verifier
+    together).  Accepts host numpy or device arrays — on CPU backends the
+    ``jnp.asarray`` staging is zero-copy."""
+    import jax.numpy as jnp
+
+    if coverage is None:
+        coverage = default_coverage()
+    if not device_digest_supported(arrays):
+        # a >4-byte dtype would be silently narrowed by jnp staging —
+        # corruption in the dropped bits undetectable; refuse loudly so
+        # callers keep such payloads on the host digest
+        raise ValueError("stream_digest_batched: unsupported leaf dtype (itemsize > 4)")
+    fn = _digest_program_for(arrays, coverage, per_leaf=False)
+    return int(fn(*[jnp.asarray(a) for _, a in arrays]))
+
+
+def params_digest_fn(digest_mode: bool, device: bool):
+    """The ONE params-broadcast digest chooser, shared by the trainer
+    (digest at send) and every player (recompute at adoption) so both
+    sides agree by construction.  ``device`` routes supported payloads
+    through :func:`stream_digest_batched`; unsupported dtypes fall back
+    to the host :func:`content_digest` DETERMINISTICALLY (the decision
+    depends only on the payload's dtypes, which both ends see
+    identically).  Returns ``arrays -> Optional[int]``."""
+    if not digest_mode:
+        return lambda arrays: None
+    if not device:
+        return content_digest
+
+    def _digest(arrays):
+        if device_digest_supported(arrays):
+            return stream_digest_batched(arrays)
+        return content_digest(arrays)
+
+    return _digest
+
+
+def leaf_digest_batched(leaves: Sequence[np.ndarray]) -> List[int]:
+    """Per-leaf FULL-coverage device digests for the checkpoint manifest
+    (``checkpoint.device_digests``): one program for every leaf instead of
+    a per-leaf python CRC loop.  Values are :data:`DEVICE_DIGEST_IMPL`
+    hashes — the manifest's ``crc_impl`` records which implementation
+    wrote it, and validation recomputes with the same one."""
+    import jax.numpy as jnp
+
+    arrays = [(f"leaf_{i}", a) for i, a in enumerate(leaves)]
+    if not device_digest_supported(arrays):
+        raise ValueError("leaf_digest_batched: unsupported leaf dtype (itemsize > 4)")
+    fn = _digest_program_for(arrays, 0, per_leaf=True)
+    out = np.asarray(fn(*[jnp.asarray(a) for _, a in arrays]))
+    return [int(v) for v in out]
 
 
 # ------------------------------------------------------------------ errors

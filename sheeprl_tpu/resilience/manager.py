@@ -94,7 +94,10 @@ class CheckpointManager:
         # good/pending/quarantined sidecar
         self.health = None
         self.last_checkpoint = int(last_checkpoint)
-        self.cb = CheckpointCallback(keep_last=ckpt_cfg.keep_last)
+        self.cb = CheckpointCallback(
+            keep_last=ckpt_cfg.keep_last,
+            device_digests=bool(ckpt_cfg.get("device_digests", False)),
+        )
         self.writer = (
             AsyncCheckpointWriter(self.cb.write)
             if self.async_save and runtime.is_global_zero

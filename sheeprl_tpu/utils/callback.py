@@ -25,8 +25,11 @@ import numpy as np
 class CheckpointCallback:
     """keep-last-N checkpoint writer."""
 
-    def __init__(self, keep_last: Optional[int] = None):
+    def __init__(self, keep_last: Optional[int] = None, device_digests: bool = False):
         self.keep_last = keep_last
+        # checkpoint.device_digests: manifest leaf digests via ONE batched
+        # device program instead of the per-leaf host CRC walk
+        self.device_digests = bool(device_digests)
 
     # ------------------------------------------------------------------ #
     # buffer consistency (reference _ckpt_rb / _experiment_consistent_rb)
@@ -118,7 +121,7 @@ class CheckpointCallback:
         from sheeprl_tpu.utils.ckpt_format import save_state
 
         path = Path(ckpt_path)
-        save_state(path, host_state)
+        save_state(path, host_state, device_digests=self.device_digests)
         if self.keep_last:
             self._delete_old_checkpoints(path.parent)
         return str(path)

@@ -159,7 +159,7 @@ def _sweep_orphan_tmps(folder: Path, keep: Path) -> None:
         pass
 
 
-def save_state(path: Union[str, os.PathLike], state: Any) -> str:
+def save_state(path: Union[str, os.PathLike], state: Any, *, device_digests: bool = False) -> str:
     """Write ``state`` (host-side pytree) to ``path`` atomically (tmp file +
     rename); orphaned tmps from previously killed writers are swept first.
 
@@ -171,16 +171,16 @@ def save_state(path: Union[str, os.PathLike], state: Any) -> str:
     ``validate_checkpoint(check_digests=True)`` rejects bit-rotted
     checkpoints, not just truncated ones."""
     from sheeprl_tpu.resilience.faults import fault_point
-    from sheeprl_tpu.resilience.integrity import CHECKSUM_IMPL, leaf_digest
 
     leaves: list = []
     tree = _encode(state, leaves)
+    leaf_crc, crc_impl = _leaf_digests(leaves, device_digests)
     manifest = json.dumps(
         {
             "version": FORMAT_VERSION,
             "tree": tree,
-            "leaf_crc": [leaf_digest(arr) for arr in leaves],
-            "crc_impl": CHECKSUM_IMPL,
+            "leaf_crc": leaf_crc,
+            "crc_impl": crc_impl,
         }
     ).encode()
     path = Path(path)
@@ -212,6 +212,26 @@ def save_state(path: Union[str, os.PathLike], state: Any) -> str:
     if fault_point("bit_flip_ckpt"):
         _bitflip_zip_leaf(path)
     return str(path)
+
+
+def _leaf_digests(leaves, device: bool):
+    """Manifest content digests for ``leaves``: the per-leaf host CRC walk
+    by default, or ONE batched device program (``checkpoint.device_digests``
+    — integrity.leaf_digest_batched) when every leaf dtype survives the
+    device round-trip losslessly.  The manifest's ``crc_impl`` records
+    which implementation wrote it, so validation always recomputes with
+    the matching one regardless of the reader's config."""
+    from sheeprl_tpu.resilience.integrity import (
+        CHECKSUM_IMPL,
+        DEVICE_DIGEST_IMPL,
+        device_digest_supported,
+        leaf_digest,
+        leaf_digest_batched,
+    )
+
+    if device and leaves and device_digest_supported([("", a) for a in leaves]):
+        return leaf_digest_batched(leaves), DEVICE_DIGEST_IMPL
+    return [leaf_digest(arr) for arr in leaves], CHECKSUM_IMPL
 
 
 def _bitflip_zip_leaf(path: Union[str, os.PathLike], member: str = "leaf_0.npy") -> None:
@@ -391,13 +411,23 @@ def validate_checkpoint(
 
 
 def _check_leaf_digests(path: Union[str, os.PathLike], doc: Dict[str, Any], n_leaves: int) -> None:
-    """Verify every leaf's content against the manifest's ``leaf_crc``."""
-    from sheeprl_tpu.resilience.integrity import CHECKSUM_IMPL, leaf_digest
+    """Verify every leaf's content against the manifest's ``leaf_crc``,
+    recomputing with the implementation that WROTE the manifest (host CRC
+    or the batched device digest) — a checkpoint written with
+    ``device_digests`` on validates on a reader that has it off, and
+    vice versa."""
+    from sheeprl_tpu.resilience.integrity import (
+        CHECKSUM_IMPL,
+        DEVICE_DIGEST_IMPL,
+        leaf_digest,
+        leaf_digest_batched,
+    )
 
     digests = doc.get("leaf_crc")
     if digests is None:
         return  # pre-digest checkpoint: nothing recorded to verify against
-    if doc.get("crc_impl", CHECKSUM_IMPL) != CHECKSUM_IMPL:
+    impl = doc.get("crc_impl", CHECKSUM_IMPL)
+    if impl not in (CHECKSUM_IMPL, DEVICE_DIGEST_IMPL):
         return  # written under a different checksum implementation
     if len(digests) != n_leaves:
         raise CheckpointCorruptError(
@@ -405,8 +435,10 @@ def _check_leaf_digests(path: Union[str, os.PathLike], doc: Dict[str, Any], n_le
         )
     try:
         with np.load(path, allow_pickle=False) as npz:
+            if impl == DEVICE_DIGEST_IMPL:
+                got_all = leaf_digest_batched([npz[f"leaf_{i}"] for i in range(n_leaves)])
             for i, want in enumerate(digests):
-                got = leaf_digest(npz[f"leaf_{i}"])
+                got = got_all[i] if impl == DEVICE_DIGEST_IMPL else leaf_digest(npz[f"leaf_{i}"])
                 if int(got) != int(want):
                     from sheeprl_tpu.resilience.integrity import integrity_stats
 

@@ -364,6 +364,65 @@ class TestHostSync:
         assert out == []
 
 
+class TestPallasKernelsAreTraced:
+    """ISSUE 14: a function handed to ``pl.pallas_call`` — bare or
+    ``functools.partial``-wrapped — is a traced context: the retrace/
+    host-sync/prng hazards apply inside the kernel body."""
+
+    def test_positive_kernel_body_retrace(self):
+        out = _lint(
+            """
+            from jax.experimental import pallas as pl
+
+            def build(x):
+                def kernel(x_ref, o_ref):
+                    if x_ref:
+                        o_ref[:] = x_ref[:]
+                    label = f"block {x_ref}"
+                return pl.pallas_call(kernel, out_shape=None)(x)
+            """
+        )
+        assert "retrace-branch" in _checks(out)
+        assert "retrace-fstring" in _checks(out)
+
+    def test_positive_partial_wrapped_kernel(self):
+        out = _lint(
+            """
+            import functools
+            from jax.experimental import pallas as pl
+
+            def build(x, depth):
+                def kernel(x_ref, o_ref, *, depth):
+                    label = f"descend {x_ref}"
+                    o_ref[:] = x_ref[:]
+                return pl.pallas_call(functools.partial(kernel, depth=depth))(x)
+            """
+        )
+        assert "retrace-fstring" in _checks(out)
+
+    def test_negative_clean_kernel_and_unlinked_fn(self):
+        out = _lint(
+            """
+            import functools
+            import jax.numpy as jnp
+            from jax.experimental import pallas as pl
+
+            def build(x, depth):
+                def kernel(x_ref, o_ref, *, depth):
+                    # static python config + pure jnp ops: clean
+                    v = x_ref[:]
+                    for _ in range(depth):
+                        v = jnp.maximum(v, v)
+                    o_ref[:] = v
+                def helper(a):
+                    # NOT handed to pallas_call: free to format its arg
+                    return f"{a}"
+                return pl.pallas_call(functools.partial(kernel, depth=depth))(x), helper(1)
+            """
+        )
+        assert _checks(out) == []
+
+
 class TestRetrace:
     def test_positive_all_three(self):
         out = _lint(

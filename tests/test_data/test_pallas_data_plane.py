@@ -1,0 +1,226 @@
+"""Golden parity for the fused data-plane kernels (ISSUE 14):
+``per_kernel=pallas`` (ops/pallas_per.py + ops/pallas_gather.py, interpret
+mode on this backend) against the lax path on the SAME key — descent,
+fused exclusion, scatter-update, and the multi-key batch gathers — plus
+the duplicate-index semantics units for ``scale``/``set_priorities``
+(mirroring the PR-12 ``_write_impl`` masked-duplicate regression).
+
+Parity notes: writes and no-exclusion draws are bit-exact by construction
+(identical arithmetic).  Excluded draws use stored-sum-minus-excluded-mass
+corrections instead of the rebuilt zeroed tree, so integer-valued f32
+priorities (exact subtraction) pin bit-parity and float priorities get a
+distribution-level check."""
+
+import jax
+import numpy as np
+import pytest
+
+from sheeprl_tpu.data.device_buffer import DeviceReplayCache
+from sheeprl_tpu.replay.priority_tree import PriorityTree, resolve_per_kernel
+
+KERNELS = ("lax", "pallas")
+
+
+def _pair(n=64, alpha=1.0, eps=0.0, pri=None):
+    tl = PriorityTree(n, alpha=alpha, eps=eps, kernel="lax")
+    tp = PriorityTree(n, alpha=alpha, eps=eps, kernel="pallas")
+    if pri is not None:
+        tl.set_priorities(np.arange(n), pri)
+        tp.set_priorities(np.arange(n), pri)
+    return tl, tp
+
+
+# ----------------------------------------------------------------- kernels
+def test_resolve_per_kernel_validates():
+    assert resolve_per_kernel("lax") == "lax"
+    assert resolve_per_kernel("PALLAS") == "pallas"
+    with pytest.raises(ValueError, match="per_kernel"):
+        resolve_per_kernel("triton")
+
+
+def test_write_and_update_bit_exact():
+    rng = np.random.default_rng(0)
+    pri = rng.random(64).astype(np.float32)
+    tl, tp = _pair(pri=pri)
+    np.testing.assert_array_equal(np.asarray(tl.tree), np.asarray(tp.tree))
+    # masked + duplicate update through both kernels
+    idx = np.array([3, 3, 9, 60], np.int32)
+    td = np.array([2.0, 2.0, 0.5, 7.0], np.float32)
+    act = np.array([True, False, True, True])
+    tl.update(idx, td, act)
+    tp.update(idx, td, act)
+    np.testing.assert_array_equal(np.asarray(tl.tree), np.asarray(tp.tree))
+    assert float(tl.max_priority) == float(tp.max_priority)
+    tl.seed_max(np.array([1, 2]), np.ones(2, bool))
+    tp.seed_max(np.array([1, 2]), np.ones(2, bool))
+    np.testing.assert_array_equal(np.asarray(tl.tree), np.asarray(tp.tree))
+
+
+def test_sample_bit_exact_without_exclusion():
+    rng = np.random.default_rng(1)
+    pri = rng.random(128).astype(np.float32) + 0.01
+    tl, tp = _pair(128, pri=pri)
+    for seed in range(3):
+        k = jax.random.PRNGKey(seed)
+        ll, wl = tl.sample(k, 256, beta=0.4, count=100)
+        lp, wp = tp.sample(k, 256, beta=0.4, count=100)
+        np.testing.assert_array_equal(np.asarray(ll), np.asarray(lp))
+        np.testing.assert_allclose(np.asarray(wl), np.asarray(wp), rtol=1e-6)
+
+
+def test_sample_excluded_bit_exact_on_exact_arithmetic():
+    # integer-valued f32 priorities: stored-sum-minus-mass == rebuilt sums
+    rng = np.random.default_rng(2)
+    pri = rng.integers(0, 9, 64).astype(np.float32)
+    tl, tp = _pair(pri=pri)
+    ex = np.array([3, 17, 40], np.int32)
+    k = jax.random.PRNGKey(7)
+    ll, wl = tl.sample(k, 512, beta=1.0, count=60, exclude_idx=ex)
+    lp, wp = tp.sample(k, 512, beta=1.0, count=60, exclude_idx=ex)
+    np.testing.assert_array_equal(np.asarray(ll), np.asarray(lp))
+    np.testing.assert_allclose(np.asarray(wl), np.asarray(wp), rtol=1e-6)
+    assert not np.isin(np.asarray(lp), ex).any()
+    # stored tree untouched by the fused exclusion (no copy, no write)
+    assert float(tp.priorities(3)) == float(pri[3])
+
+
+def test_pallas_excluded_distribution_matches_analytic():
+    rng = np.random.default_rng(3)
+    pri = (rng.uniform(0.1, 3.0, 32)).astype(np.float32)
+    _, tp = _pair(32, pri=pri)
+    ex = np.array([0, 5], np.int32)
+    leaf, _ = tp.sample(jax.random.PRNGKey(0), 40000, beta=1.0, count=30, exclude_idx=ex)
+    counts = np.bincount(np.asarray(leaf), minlength=32)
+    want = pri.copy()
+    want[ex] = 0.0
+    want /= want.sum()
+    assert counts[0] == 0 and counts[5] == 0
+    assert np.abs(counts / counts.sum() - want).max() < 0.01
+
+
+# -------------------------------------------- duplicate-index semantics unit
+@pytest.mark.parametrize("kernel", KERNELS)
+def test_scale_duplicate_indices_scale_once(kernel):
+    """`scale` documents gather-then-write: duplicates decay ONCE per
+    call, not once per occurrence."""
+    t = PriorityTree(8, kernel=kernel)
+    t.set_priorities(np.arange(8), np.full(8, 2.0, np.float32))
+    t.scale(np.array([3, 3, 3, 5]), 0.5)
+    pri = np.asarray(t.priorities(np.arange(8)))
+    np.testing.assert_allclose(pri, [2, 2, 2, 1, 2, 1, 2, 2])
+    assert t.total == pytest.approx(float(pri.sum()))
+
+
+@pytest.mark.parametrize("kernel", KERNELS)
+def test_set_priorities_masked_duplicate_cannot_drop_active_write(kernel):
+    """The PR-12 `_write_impl` regression, at the public API: an INACTIVE
+    duplicate of an active leaf must not win the one-writer-per-duplicate
+    scatter and drop the active write."""
+    t = PriorityTree(8, kernel=kernel)
+    t.set_priorities(np.arange(8), np.ones(8, np.float32))
+    idx = np.array([4, 4], np.int32)
+    vals = np.array([9.0, 123.0], np.float32)
+    act = np.array([True, False])
+    t.set_priorities(idx, vals, act)
+    assert float(t.priorities(4)) == pytest.approx(9.0)
+    assert t.total == pytest.approx(16.0)
+    # ancestors rebuilt consistently
+    tree = np.asarray(t.tree)
+    p = 1 << t.depth
+    for node in range(1, p):
+        assert tree[node] == pytest.approx(tree[2 * node] + tree[2 * node + 1])
+
+
+@pytest.mark.parametrize("kernel", KERNELS)
+def test_set_priorities_equal_duplicates_write_once(kernel):
+    t = PriorityTree(8, kernel=kernel)
+    t.set_priorities(np.array([2, 2, 2]), np.array([3.0, 3.0, 3.0], np.float32))
+    assert float(t.priorities(2)) == pytest.approx(3.0)
+    assert t.total == pytest.approx(3.0)
+
+
+# -------------------------------------------------------- cache-level parity
+def _fill(kernel, prioritized, cap=32, n_envs=2):
+    c = DeviceReplayCache(cap, n_envs, prioritized=prioritized, per_alpha=1.0, per_eps=0.0, kernel=kernel)
+    rng = np.random.default_rng(0)
+    for t in range(24):
+        c.add(
+            {
+                "obs": rng.normal(size=(1, n_envs, 3)).astype(np.float32),
+                "rew": np.full((1, n_envs, 1), t, np.float32),
+                "done": np.zeros((1, n_envs, 1), np.uint8),
+            }
+        )
+    return c
+
+
+def test_cache_uniform_samplers_bit_exact():
+    cl, cp = _fill("lax", False), _fill("pallas", False)
+    k = jax.random.PRNGKey(11)
+    ol = cl.sample_transitions(2, 8, k, sample_next_obs=True, obs_keys=("obs",))
+    op = cp.sample_transitions(2, 8, k, sample_next_obs=True, obs_keys=("obs",))
+    assert set(ol) == set(op)
+    for key in ol:
+        np.testing.assert_array_equal(np.asarray(ol[key]), np.asarray(op[key]), err_msg=key)
+    for a, b in zip(cl.sample(2, 8, 4, k), cp.sample(2, 8, 4, k)):
+        for key in a:
+            np.testing.assert_array_equal(np.asarray(a[key]), np.asarray(b[key]), err_msg=key)
+
+
+def test_cache_prioritized_samplers_match():
+    cl, cp = _fill("lax", True), _fill("pallas", True)
+    k = jax.random.PRNGKey(5)
+    bl, il = cl.sample_transitions_per(2, 8, k, beta=0.4, sample_next_obs=True, obs_keys=("obs",))
+    bp, ip = cp.sample_transitions_per(2, 8, k, beta=0.4, sample_next_obs=True, obs_keys=("obs",))
+    np.testing.assert_array_equal(np.asarray(il), np.asarray(ip))
+    for key in bl:
+        np.testing.assert_allclose(
+            np.asarray(bl[key]), np.asarray(bp[key]), rtol=1e-6, err_msg=key
+        )
+    # sequence-START draw + decay-on-sample through both kernels
+    sl = cl.sample_per(2, 8, 4, k, beta=0.0)
+    sp = cp.sample_per(2, 8, 4, k, beta=0.0)
+    for a, b in zip(sl, sp):
+        for key in a:
+            np.testing.assert_allclose(
+                np.asarray(a[key]), np.asarray(b[key]), rtol=1e-6, err_msg=key
+            )
+    # windows stay contiguous through the fused gather
+    rw = np.asarray(sp[0]["rew"])[:, :, 0]
+    assert set(np.unique(rw[1:] - rw[:-1])) <= {1.0}
+    # TD feedback through the pallas update kernel keeps trees in lockstep
+    idx = np.asarray(il).reshape(-1)
+    td = np.abs(np.random.default_rng(9).standard_normal(idx.shape[0])).astype(np.float32)
+    cl.update_priorities(idx, td)
+    cp.update_priorities(idx, td)
+    np.testing.assert_allclose(
+        np.asarray(cl._tree.tree), np.asarray(cp._tree.tree), rtol=1e-6
+    )
+
+
+def test_fused_gather_kernels_unit_parity():
+    """Direct kernel-vs-advanced-indexing parity incl. ring wraparound."""
+    from sheeprl_tpu.ops.pallas_gather import gather_transitions_fused, gather_windows_fused
+
+    rng = np.random.default_rng(0)
+    cap, n_envs = 16, 3
+    bufs = {
+        "a": jax.numpy.asarray(rng.standard_normal((cap, n_envs, 4)).astype(np.float32)),
+        "b": jax.numpy.asarray(rng.integers(0, 99, (cap, n_envs, 1)).astype(np.int32)),
+    }
+    starts = jax.numpy.asarray(np.array([14, 2, 15, 0], np.int32))  # wraps
+    envs = jax.numpy.asarray(np.array([0, 2, 1, 1], np.int32))
+    out = gather_windows_fused(bufs, starts, envs, seq_len=4)
+    for k, buf in bufs.items():
+        b = np.asarray(buf)
+        want = np.stack(
+            [b[(np.asarray(starts)[i] + np.arange(4)) % cap, np.asarray(envs)[i]] for i in range(4)]
+        )
+        np.testing.assert_array_equal(np.asarray(out[k]), want, err_msg=k)
+    tout = gather_transitions_fused(bufs, starts, envs, next_keys=("a",))
+    for i in range(4):
+        s, e = int(np.asarray(starts)[i]), int(np.asarray(envs)[i])
+        np.testing.assert_array_equal(np.asarray(tout["a"][i]), np.asarray(bufs["a"])[s, e])
+        np.testing.assert_array_equal(
+            np.asarray(tout["next_a"][i]), np.asarray(bufs["a"])[(s + 1) % cap, e]
+        )

@@ -7,6 +7,7 @@ import os
 import sys
 
 import jax
+import pytest
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
 
@@ -18,7 +19,14 @@ def test_entry_lowers():
     assert jax.jit(fn).lower(*args) is not None
 
 
+@pytest.mark.slow
 def test_dryrun_multichip_two_devices():
+    """Slow-marked at ISSUE 14's tier-1 budget pass: 38.5s of the 870s
+    budget AND a pre-existing environmental failure on this container
+    (multichip/XLA — part of the 14-failure baseline since the seed), so
+    inside tier-1 it burned the single largest time slice guarding
+    nothing.  Run `-m slow` (or on a real multichip host, where it
+    passes) when touching __graft_entry__.py or the mesh bring-up."""
     import __graft_entry__ as g
 
     g.dryrun_multichip(2)

@@ -220,6 +220,133 @@ def test_params_follower_digest_ok_when_absent():
         pc.close(), tc.close(), hub.close()
 
 
+# ------------------------------------------------------- batched device digest
+def test_stream_digest_batched_detects_flips_and_structure():
+    """ISSUE 14: the one-dispatch device digest (xsum32) is deterministic
+    and catches single-bit flips at either stream edge, sub-4-byte-dtype
+    flips, and shape/key changes — the SDC classes the params digest
+    guards."""
+    from sheeprl_tpu.resilience.integrity import stream_digest_batched
+
+    rng = np.random.default_rng(0)
+    arrays = [
+        ("w", rng.standard_normal((32, 16)).astype(np.float32)),
+        ("b", rng.standard_normal((16,)).astype(np.float32)),
+        ("mask", rng.random(33) > 0.5),
+        ("idx", rng.integers(0, 9, 13).astype(np.int32)),
+        ("half", rng.standard_normal(7).astype(np.float16)),
+        ("scalar", np.float32(1.25)),
+        ("empty", np.zeros((0, 3), np.float32)),
+    ]
+    d = stream_digest_batched(arrays)
+    assert d == stream_digest_batched(arrays) and 0 <= d < 2**32
+    for i, byte in ((0, 0), (0, -1), (2, 0), (4, 1)):
+        mod = list(arrays)
+        k, a = mod[i]
+        b = a.copy()
+        b.reshape(-1).view(np.uint8)[byte] ^= 0x04
+        mod[i] = (k, b)
+        assert stream_digest_batched(mod) != d, (i, byte)
+    mod = list(arrays)
+    mod[0] = ("w", arrays[0][1].reshape(16, 32))
+    assert stream_digest_batched(mod) != d  # shape folded
+    mod = list(arrays)
+    mod[0] = ("w2", arrays[0][1])
+    assert stream_digest_batched(mod) != d  # key folded
+    # device arrays digest identically to their host copies (the trainer
+    # may digest the device tree, players the received numpy arrays)
+    import jax.numpy as jnp
+
+    staged = [(k, jnp.asarray(a)) for k, a in arrays]
+    assert stream_digest_batched(staged) == d
+
+
+def test_stream_digest_batched_refuses_lossy_dtypes():
+    from sheeprl_tpu.resilience.integrity import (
+        device_digest_supported,
+        params_digest_fn,
+        stream_digest_batched,
+    )
+
+    wide = [("x", np.zeros(4, np.float64))]
+    assert not device_digest_supported(wide)
+    with pytest.raises(ValueError, match="dtype"):
+        stream_digest_batched(wide)
+    # the params chooser falls back to the host digest deterministically
+    assert params_digest_fn(True, True)(wide) == content_digest(wide)
+    ok = [("x", np.zeros(4, np.float32))]
+    assert params_digest_fn(True, True)(ok) == stream_digest_batched(ok)
+    assert params_digest_fn(False, True)(ok) is None
+
+
+def test_params_follower_device_digest_fn_skip_and_match():
+    """algo.params_digest_device: follower verifies with the SAME batched
+    device digest the trainer shipped — matches adopt, mismatches skip."""
+    from sheeprl_tpu.resilience.integrity import params_digest_fn
+
+    reset_integrity_stats()
+    digest = params_digest_fn(True, True)
+    hub, (pc,), (tc,) = _pair("queue", integrity="off", window=16)
+    try:
+        fol = ParamsFollower(pc, lag=0, initial_seq=0, digest_slot=0, digest_fn=digest)
+
+        def send_params(seq, tamper=False):
+            arrays = [("0", np.full(16, seq, np.float32))]
+            d = digest(arrays)
+            if tamper:
+                d ^= 0x1
+            tc.send("params", arrays=arrays, extra=(d,), seq=seq)
+
+        send_params(1)
+        f = fol.params_for_round(2)
+        assert f is not None and f.seq == 1
+        f.release()
+        send_params(2, tamper=True)
+        assert fol.params_for_round(3) is None
+        assert fol.digest_skips == 1
+    finally:
+        pc.close(), tc.close(), hub.close()
+
+
+def test_checkpoint_device_digests_roundtrip_and_bitrot(tmp_path):
+    """checkpoint.device_digests: ONE batched program writes the manifest
+    leaf digests (crc_impl records the impl), validation recomputes with
+    the matching impl regardless of reader config, and the bit-rot fault
+    (self-consistent zip, rotted content) is still refused."""
+    import json as _json
+    import zipfile as _zf
+
+    from sheeprl_tpu.resilience.integrity import DEVICE_DIGEST_IMPL
+    from sheeprl_tpu.utils.ckpt_format import (
+        CheckpointCorruptError,
+        _bitflip_zip_leaf,
+        load_state,
+        save_state,
+        validate_checkpoint,
+    )
+
+    state = {
+        "agent": {"w": np.arange(12, dtype=np.float32).reshape(3, 4), "s": np.int32(3)},
+        "iter": np.int32(7),
+    }
+    path = tmp_path / "dev.ckpt"
+    save_state(path, state, device_digests=True)
+    with _zf.ZipFile(path) as z:
+        with z.open("manifest.npy") as f:
+            doc = _json.loads(bytes(np.lib.format.read_array(f)))
+    assert doc["crc_impl"] == DEVICE_DIGEST_IMPL
+    validate_checkpoint(path, check_digests=True)  # device-impl recompute
+    loaded = load_state(path)
+    np.testing.assert_array_equal(loaded["agent"]["w"], state["agent"]["w"])
+    _bitflip_zip_leaf(path)
+    with pytest.raises(CheckpointCorruptError, match="digest"):
+        validate_checkpoint(path, check_digests=True)
+    # host-impl checkpoints still validate (reader config irrelevant)
+    path2 = tmp_path / "host.ckpt"
+    save_state(path2, state, device_digests=False)
+    validate_checkpoint(path2, check_digests=True)
+
+
 # ----------------------------------------------------------- fault grammar
 def test_fault_qualifier_grammar():
     from sheeprl_tpu.resilience.faults import FaultInjector
