@@ -176,19 +176,63 @@ def audit_health(health, rollback_rounds, *, budget: int, decoupled: bool) -> li
     return failures
 
 
-def _run_health_leg(args, faults: str, cli_args: list, leg_root: str, *, decoupled: bool) -> list:
+def audit_alerts(leg_root: str, *, expect_rule: str = None) -> list:
+    """ISSUE 15: with the live metrics plane armed, an injected fault
+    must fire its matching alert rule (a typed ``alert`` fleet event in
+    the flight streams AND a ``sheeprl.alert/1`` record in telemetry),
+    and a clean leg must fire NOTHING — false alarms train operators to
+    ignore the channel."""
+    from sheeprl_tpu.obs.reader import read_alerts, read_flight
+
+    flight_alerts = [
+        r for r in read_flight(leg_root) if r.get("k") == "event" and r.get("name") == "alert"
+    ]
+    fired = sorted(
+        {
+            (r.get("a") or {}).get("rule")
+            for r in flight_alerts
+            if (r.get("a") or {}).get("state") == "firing"
+        }
+    )
+    failures = []
+    if expect_rule is None:
+        if fired:
+            failures.append(f"clean leg fired alert rules {fired} (expected none)")
+        return failures
+    if expect_rule not in fired:
+        failures.append(f"fault leg never fired rule {expect_rule!r} (fired: {fired})")
+    # the same transitions must be queryable post-hoc from the telemetry
+    # stream (the sink interleaves alert records)
+    tel_rules = {a.get("rule") for a in read_alerts(leg_root) if a.get("state") == "firing"}
+    if expect_rule not in tel_rules:
+        failures.append(
+            f"rule {expect_rule!r} missing from the telemetry alert records ({sorted(tel_rules)})"
+        )
+    return failures
+
+
+def _run_health_leg(
+    args, faults: str, cli_args: list, leg_root: str, *, decoupled: bool, expect_alert: str = None
+) -> list:
     import shutil
 
     shutil.rmtree(leg_root, ignore_errors=True)
-    os.environ["SHEEPRL_FAULTS"] = faults
+    if faults:
+        os.environ["SHEEPRL_FAULTS"] = faults
     from sheeprl_tpu.cli import run
 
     try:
         run(cli_args)
     finally:
         os.environ.pop("SHEEPRL_FAULTS", None)
+    if not faults:
+        # clean leg: only the zero-false-fires audit applies
+        failures = audit_alerts(leg_root, expect_rule=None)
+        print(json.dumps({"leg": os.path.basename(leg_root), "failures": failures}, indent=2))
+        return failures
     health, rb_rounds = read_health(leg_root)
     failures = audit_health(health, rb_rounds, budget=3, decoupled=decoupled)
+    failures += audit_alerts(leg_root, expect_rule=expect_alert)
     last = max(health, key=lambda h: h.get("updates", 0)) if health else {}
     print(
         json.dumps(
@@ -226,6 +270,10 @@ def run_health_mode(args) -> int:
         "fabric.devices=1",
         "metric.log_level=1",
         "metric.log_every=64",
+        # ISSUE 15: the live plane rides every health leg — the injected
+        # fault must fire its alert rule, and the clean leg none
+        "metric.live=on",
+        "metric.tracing=sampled",
         "checkpoint.save_last=True",
         "buffer.memmap=False",
         f"seed={args.seed}",
@@ -251,6 +299,7 @@ def run_health_mode(args) -> int:
         ],
         f"{base}/sac",
         decoupled=False,
+        expect_alert="sentinel_skip_streak",
     )
     failures += _run_health_leg(
         args,
@@ -275,6 +324,30 @@ def run_health_mode(args) -> int:
         ],
         f"{base}/dec",
         decoupled=True,
+        expect_alert="sentinel_skip_streak",
+    )
+    # clean leg (no faults): the sentinel stays armed and the live plane
+    # must fire ZERO alert rules — the channel stays trustworthy
+    failures += _run_health_leg(
+        args,
+        "",
+        common
+        + sentinel
+        + [
+            "exp=sac",
+            "env.id=dummy_continuous",
+            "env.num_envs=4",
+            f"metric.logger.root_dir={base}/clean/logs",
+            "checkpoint.every=64",
+            "algo.total_steps=256",
+            "algo.learning_starts=16",
+            "algo.per_rank_batch_size=8",
+            "algo.hidden_size=8",
+            "algo.mlp_keys.encoder=[state]",
+            f"root_dir={base}/clean/run",
+        ],
+        f"{base}/clean",
+        decoupled=False,
     )
     if not args.keep:
         import shutil

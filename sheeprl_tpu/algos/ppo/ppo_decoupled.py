@@ -50,6 +50,7 @@ from sheeprl_tpu.algos.ppo.ppo import build_ppo_optimizer, make_update_fn
 from sheeprl_tpu.algos.ppo.utils import prepare_obs, test
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.obs import fleet as obs_fleet
 from sheeprl_tpu.obs import flight, setup_observability, trace_scope
 from sheeprl_tpu.parallel.transport import (
     FanIn,
@@ -211,6 +212,10 @@ def _player_loop(
     # (obs.report merges them); must precede setup_observability so the
     # lead's recorder carries the player role, not "main"
     flight.configure_from_cfg(cfg, role=f"player{player_id}")
+    # live metrics plane (ISSUE 15): every player serves its own
+    # /metrics + /status and piggybacks a compact summary on the data
+    # frames it already ships (the lead's /status shows the whole fleet)
+    live = obs_fleet.configure_from_cfg(cfg, role=f"player{player_id}")
 
     runtime = MeshRuntime(devices=1, accelerator="cpu", precision=cfg.fabric.precision)
     runtime.launch()
@@ -576,12 +581,17 @@ def _player_loop(
         try:
             with trace_scope("ipc_send_shard"):
                 # extra carries the BEHAVIOR-policy version this shard
-                # acted with: the trainer's V-trace correction + lag
-                # telemetry key off it
+                # acted with (the trainer's V-trace correction + lag
+                # telemetry key off it) and, when the live plane is on,
+                # this player's compact metrics summary (ISSUE 15)
                 channel.send(
                     "data",
                     arrays=arrays,
-                    extra=(need_ckpt, follower.current_seq),
+                    extra=(
+                        need_ckpt,
+                        follower.current_seq,
+                        live.beat(policy_step) if live is not None else None,
+                    ),
                     seq=iter_num,
                     timeout=timeout_s,
                 )
@@ -707,6 +717,7 @@ def _player_loop(
         logger.finalize()
     channel.close()
     flight.close_recorder()
+    obs_fleet.close_live()
 
 
 def spawn_players(cfg, runtime, ctx, target, extra_args=(), knobs=None, with_inference=False):
@@ -806,6 +817,7 @@ def main(runtime, cfg: Dict[str, Any]):
     runtime.seed_everything(cfg.seed)
     knobs = decoupled_knobs(cfg)
     flight.configure_from_cfg(cfg, role="trainer")
+    live = obs_fleet.configure_from_cfg(cfg, role="trainer")
 
     state = None
     if cfg.checkpoint.resume_from:
@@ -1070,6 +1082,9 @@ def main(runtime, cfg: Dict[str, Any]):
                     # behavior-policy version this shard acted with: the
                     # lag histogram is the V-trace soft-bound telemetry
                     fanin.note_lag(pid, (seq - 1) - int(extra[1]))
+                if len(extra) > 2:
+                    # the player's piggybacked live-metrics summary
+                    fanin.note_summary(pid, extra[2])
 
             assembly_span = flight.span("batch_assembly", round=iter_num, shards=len(frames))
             assembly_span.__enter__()
@@ -1184,6 +1199,17 @@ def main(runtime, cfg: Dict[str, Any]):
                 from sheeprl_tpu.resilience.integrity import integrity_stats
 
                 stats["integrity"] = integrity_stats().as_dict()
+            if live is not None:
+                # the trainer's own live plane: /status + alert rules see
+                # the fleet view every round (the transport key is where
+                # the health/lag/integrity/fleet stats live)
+                live.observe(
+                    {
+                        "ts": time.time(),
+                        "step": iter_num * policy_steps_per_iter,
+                        "transport": stats,
+                    }
+                )
             bcast_arrays = _flat_leaves(_np_tree(params))
             bcast_digest = _params_digest(bcast_arrays)
             fanin.broadcast(
@@ -1220,6 +1246,7 @@ def main(runtime, cfg: Dict[str, Any]):
         fanin.close()
         hub.close()
         flight.close_recorder()
+        obs_fleet.close_live()
         if infer_hub is not None:
             infer_hub.close()
         for proc in procs.values():

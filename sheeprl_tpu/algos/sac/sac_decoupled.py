@@ -47,6 +47,7 @@ from sheeprl_tpu.algos.sac.sac import _make_optimizer, make_train_fn
 from sheeprl_tpu.algos.sac.utils import prepare_obs, test
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.obs import fleet as obs_fleet
 from sheeprl_tpu.obs import flight, setup_observability, trace_scope
 from sheeprl_tpu.parallel.transport import (
     FanIn,
@@ -130,6 +131,7 @@ def _player_loop(
         timer.disabled = True
 
     flight.configure_from_cfg(cfg, role=f"player{player_id}")
+    live = obs_fleet.configure_from_cfg(cfg, role=f"player{player_id}")
     runtime = MeshRuntime(devices=1, accelerator="cpu", precision=cfg.fabric.precision)
     runtime.launch()
     runtime.seed_everything(cfg.seed + player_id)
@@ -424,8 +426,17 @@ def _player_loop(
                 sample = [(k, np.asarray(v)) for k, v in sample.items()]
                 try:
                     with trace_scope("ipc_send_shard"):
+                        # slot 2: this player's live-metrics summary
+                        # (ISSUE 15) — None when the plane is off
                         channel.send(
-                            "data", arrays=sample, extra=(g, iter_num), seq=update_round,
+                            "data",
+                            arrays=sample,
+                            extra=(
+                                g,
+                                iter_num,
+                                live.beat(policy_step) if live is not None else None,
+                            ),
+                            seq=update_round,
                             timeout=timeout_s,
                         )
                     # fixed-lag adoption: after shipping round u, act on the
@@ -553,6 +564,7 @@ def _player_loop(
         logger.finalize()
     channel.close()
     flight.close_recorder()
+    obs_fleet.close_live()
 
 
 def _player_loop_remote(
@@ -589,6 +601,7 @@ def _player_loop_remote(
         timer.disabled = True
 
     flight.configure_from_cfg(cfg, role=f"player{player_id}")
+    live = obs_fleet.configure_from_cfg(cfg, role=f"player{player_id}")
     runtime = MeshRuntime(devices=1, accelerator="cpu", precision=cfg.fabric.precision)
     runtime.launch()
     runtime.seed_everything(cfg.seed + player_id)
@@ -852,7 +865,11 @@ def _player_loop_remote(
         # ------------------------------------------ insert (credit-gated)
         try:
             with trace_scope("replay_insert"):
-                writer.append(dict(step_data), timeout=timeout_s)
+                writer.append(
+                    dict(step_data),
+                    timeout=timeout_s,
+                    summary=live.beat(policy_step) if live is not None else None,
+                )
             writer.pump(0.01)
         except PeerDiedError as e:
             _die_with_dump(e, policy_step, iter_num)
@@ -962,6 +979,7 @@ def _player_loop_remote(
         logger.finalize()
     channel.close()
     flight.close_recorder()
+    obs_fleet.close_live()
 
 
 @register_algorithm(decoupled=True)
@@ -970,6 +988,7 @@ def main(runtime, cfg: Dict[str, Any]):
     runtime.seed_everything(cfg.seed)
     knobs = decoupled_knobs(cfg)
     flight.configure_from_cfg(cfg, role="trainer")
+    obs_fleet.configure_from_cfg(cfg, role="trainer")
 
     if "minedojo" in str(cfg.env.wrapper.get("_target_", "")).lower():
         raise ValueError("MineDojo is not supported by the SAC agent")
@@ -1161,10 +1180,14 @@ def main(runtime, cfg: Dict[str, Any]):
             if not frames:
                 break  # every player stopped
             # all players derive g/iter_num from the same global schedule
-            g, iter_num = next(iter(frames.values())).extra
+            # (slot 2, when present, is the player's live-metrics summary)
+            g, iter_num = next(iter(frames.values())).extra[:2]
             gs = {f.extra[0] for f in frames.values()}
             if len(gs) != 1:
                 raise RuntimeError(f"fan-in desync: players disagree on gradient steps {gs}")
+            for pid, frame in frames.items():
+                if len(frame.extra) > 2:
+                    fanin.note_summary(pid, frame.extra[2])
 
             # per-player shard -> (g, local_batch, ...) then concat along the
             # batch axis in player-id order (np.array materializes private
@@ -1233,6 +1256,11 @@ def main(runtime, cfg: Dict[str, Any]):
                 from sheeprl_tpu.resilience.integrity import integrity_stats
 
                 stats["integrity"] = integrity_stats().as_dict()
+            live = obs_fleet.get_live()
+            if live is not None:
+                live.observe(
+                    {"ts": time.time(), "step": int(iter_num) * int(cfg.env.num_envs), "transport": stats}
+                )
             bcast_arrays = _flat_leaves(_np_tree(params["actor"]))
             bcast_digest = _params_digest(bcast_arrays)
             fanin.broadcast(
@@ -1258,6 +1286,7 @@ def main(runtime, cfg: Dict[str, Any]):
         if infer_hub is not None:
             infer_hub.close()
         flight.close_recorder()
+        obs_fleet.close_live()
         for proc in procs:
             if proc.is_alive():
                 proc.terminate()
@@ -1587,6 +1616,12 @@ def _main_remote(runtime, cfg: Dict[str, Any], knobs, state, counters, ratio_sta
                 from sheeprl_tpu.resilience.integrity import integrity_stats
 
                 stats["integrity"] = integrity_stats().as_dict()
+            live = obs_fleet.get_live()
+            if live is not None:
+                # the remote-replay lead files these under "replay", so
+                # the trainer's plane observes the same spelling (one
+                # alert-rule key covers both processes)
+                live.observe({"ts": time.time(), "step": int(clock), "replay": stats})
             _broadcast_params(
                 update_round,
                 lambda pid: (last_metrics, stats if pid == 0 else None),
@@ -1606,6 +1641,7 @@ def _main_remote(runtime, cfg: Dict[str, Any], knobs, state, counters, ratio_sta
         preemption.uninstall()
         hub.close()
         flight.close_recorder()
+        obs_fleet.close_live()
         for proc in procs.values():
             if proc.is_alive():
                 proc.terminate()

@@ -24,7 +24,7 @@ import os
 import time
 from typing import Any, Dict, Optional
 
-from sheeprl_tpu.obs import flight
+from sheeprl_tpu.obs import fleet, flight
 from sheeprl_tpu.obs.flight import FlightRecorder, fleet_event, tracing_setting
 from sheeprl_tpu.obs.telemetry import (
     TelemetrySink,
@@ -40,6 +40,7 @@ from sheeprl_tpu.obs.xla_stats import RecompileMonitor, compiled_flops, mfu_perc
 __all__ = [
     "FlightRecorder",
     "Observability",
+    "fleet",
     "fleet_event",
     "flight",
     "setup_observability",
@@ -119,7 +120,10 @@ class Observability:
         self._last_ts = time.perf_counter()
         self.recompile = RecompileMonitor(name=name).install()
         if telemetry_path:
-            self.sink = TelemetrySink(telemetry_path, max_bytes=telemetry_max_bytes)
+            # metric.live=off: fleet.make_sink returns the UNDECORATED
+            # TelemetrySink (type identity, zero overhead); live=on tees
+            # every record into this process's MetricsHub + alert rules
+            self.sink = fleet.make_sink(telemetry_path, max_bytes=telemetry_max_bytes)
         if profile_dir and profile_every_n > 0:
             self.scheduler = ProfileScheduler(profile_dir, profile_every_n, profile_num_iters)
 
@@ -254,12 +258,23 @@ class Observability:
             self.sink.close()
         if self.recompile is not None:
             self.recompile.uninstall()
+        # the live plane outlives the sink only until run teardown: a
+        # sequential in-process run (bench legs, chaos soak) must not
+        # inherit the previous run's hub/alert state or endpoint
+        fleet.close_live()
 
 
 def setup_observability(runtime, cfg, log_dir: Optional[str], logger: Any = None) -> Observability:
     """Build the run's Observability from ``cfg.metric``. Rank-0 only (each
     process observes itself; the decoupled player wires its own)."""
     metric_cfg = cfg.get("metric", {}) if hasattr(cfg, "get") else {}
+    # live metrics plane (ISSUE 15): like the flight recorder, the first
+    # configure sticks — decoupled players/trainers install their own
+    # role BEFORE calling this, so "main" only lands on coupled loops.
+    # Constructed before the enabled gate: the plane still serves the
+    # /status endpoint when this process owns no telemetry sink.
+    if runtime.is_global_zero and fleet.get_live() is None and fleet.live_setting(cfg):
+        fleet.configure_from_cfg(cfg, role="main")
     enabled = (
         runtime.is_global_zero
         and log_dir is not None

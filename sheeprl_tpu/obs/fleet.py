@@ -1,0 +1,448 @@
+"""The per-process LIVE observability plane (ISSUE 15).
+
+Composes the pieces of :mod:`sheeprl_tpu.obs.metrics` into one object per
+process — the :class:`LivePlane` — and gives every role the same three
+surfaces while a run is still going:
+
+- **the hub**: every telemetry record tees into an in-memory
+  :class:`~sheeprl_tpu.obs.metrics.MetricsHub` ring the instant the sink
+  writes it (``LiveTelemetrySink`` below — zero new instrumentation call
+  sites; processes without a sink feed the hub directly with
+  :meth:`LivePlane.observe`/:meth:`LivePlane.beat`);
+- **the alert engine**: the default rule pack (+ ``metric.alert_rules``
+  overrides) evaluated on every observation, state changes firing as
+  typed fleet events, stderr lines, and ``sheeprl.alert/1`` records
+  interleaved into the telemetry stream;
+- **the HTTP endpoint**: ``/metrics`` (Prometheus text exposition 0.0.4)
+  and ``/status`` (one JSON snapshot: latest record, alert states, fleet
+  summaries) served from a daemon thread.  The bound port is announced in
+  ``<root>/<run_name>/live/<role>.json`` so ``python -m
+  sheeprl_tpu.obs.top`` (and tests using ephemeral ports) can discover
+  endpoints without configuration.
+
+``metric.live=off`` (the default) constructs NOTHING: no plane, no
+threads, and :func:`make_sink` returns the undecorated
+:class:`~sheeprl_tpu.obs.telemetry.TelemetrySink` — the PR-9/10/13
+type-identity pattern, asserted by test.
+
+Fleet aggregation rides frames the transports already send (the
+PR-10/13 extra-slot pattern, no new connections): each player appends
+its compact :meth:`LivePlane.beat` summary to the ``data`` frames it
+ships, the trainer folds them into the transport stats via
+``FanIn.note_summary``, and those stats already reach the lead on the
+params broadcast — so the lead's ``/status`` shows the whole fleet.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional
+
+from sheeprl_tpu.obs.metrics import ALERT_SCHEMA, AlertEngine, MetricsHub
+from sheeprl_tpu.obs.telemetry import TelemetrySink, host_rss_mb
+
+STATUS_SCHEMA = "sheeprl.status/1"
+
+__all__ = [
+    "LiveEndpoint",
+    "LivePlane",
+    "LiveTelemetrySink",
+    "close_live",
+    "configure",
+    "configure_from_cfg",
+    "get_live",
+    "live_setting",
+    "make_sink",
+    "resolve_live_port",
+]
+
+
+def live_setting(cfg) -> bool:
+    """Resolve ``metric.live`` (env override ``SHEEPRL_LIVE``) to a
+    bool."""
+    metric_cfg = cfg.get("metric", {}) if hasattr(cfg, "get") else {}
+    val = metric_cfg.get("live", "off") if hasattr(metric_cfg, "get") else "off"
+    env = os.environ.get("SHEEPRL_LIVE")
+    if env is not None:
+        val = env
+    return str(val).strip().lower() not in ("off", "0", "false", "no", "none", "")
+
+
+def resolve_live_port(base: int, role: str) -> int:
+    """Deterministic per-role port layout so the fleet's endpoints never
+    collide on one host and ``obs.top`` can find the lead without a
+    lookup: lead (``main``/``player0``) binds the base port, the trainer
+    base+1, player ``k`` base+1+k.  ``base=0`` keeps every role
+    ephemeral (the announce file carries the real port)."""
+    base = int(base)
+    if base <= 0:
+        return 0
+    if role in ("main", "player0", "lead"):
+        return base
+    if role == "trainer":
+        return base + 1
+    if role.startswith("player"):
+        try:
+            return base + 1 + int(role[len("player"):])
+        except ValueError:
+            pass
+    return 0
+
+
+# ---------------------------------------------------------------- endpoint
+class _LiveHandler(BaseHTTPRequestHandler):
+    server_version = "sheeprl-live/1"
+
+    def do_GET(self):  # noqa: N802 (stdlib API name)
+        plane = getattr(self.server, "plane", None)
+        if plane is None:
+            self._reply(503, "text/plain", b"live plane closed\n")
+            return
+        path = self.path.split("?", 1)[0]
+        if path in ("/metrics", "/metrics/"):
+            body = plane.prometheus_text().encode()
+            self._reply(200, "text/plain; version=0.0.4; charset=utf-8", body)
+        elif path in ("/status", "/status/"):
+            body = (json.dumps(plane.status(), default=str) + "\n").encode()
+            self._reply(200, "application/json", body)
+        elif path in ("/", "/healthz"):
+            self._reply(200, "text/plain", b"ok\n")
+        else:
+            self._reply(404, "text/plain", b"try /metrics or /status\n")
+
+    def _reply(self, code: int, ctype: str, body: bytes) -> None:
+        try:
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
+    def log_message(self, fmt, *args):  # silence per-request stderr spam
+        pass
+
+
+class LiveEndpoint:
+    """One process's ``/metrics`` + ``/status`` HTTP server (daemon
+    threads only — the run's exit never waits on it)."""
+
+    def __init__(self, plane: "LivePlane", host: str = "127.0.0.1", port: int = 0):
+        self._server = ThreadingHTTPServer((host, int(port)), _LiveHandler)
+        self._server.daemon_threads = True
+        self._server.plane = plane
+        self.host, self.port = self._server.server_address[:2]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            kwargs={"poll_interval": 0.25},
+            name=f"sheeprl-live-{plane.role}",
+            daemon=True,
+        )
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def close(self) -> None:
+        try:
+            self._server.plane = None
+            self._server.shutdown()
+            self._server.server_close()
+        except OSError:
+            pass
+        self._thread.join(timeout=5.0)
+
+
+# ------------------------------------------------------------------ plane
+class LivePlane:
+    """Hub + alert engine + endpoint for ONE process (see module
+    docstring).  All methods are cheap and thread-safe."""
+
+    def __init__(
+        self,
+        role: str,
+        *,
+        history: int = 512,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        alerts: bool = True,
+        extra_rules=(),
+        announce_dir: Optional[str] = None,
+        serve: bool = True,
+    ):
+        self.role = str(role)
+        self.hub = MetricsHub(capacity=history, role=self.role)
+        self.alerts: Optional[AlertEngine] = (
+            AlertEngine(role=self.role, extra_rules=extra_rules) if alerts else None
+        )
+        self._lock = threading.Lock()
+        self._fleet: Dict[str, Dict[str, Any]] = {}
+        self._beat_prev: Optional[tuple] = None
+        self._beat_sps: Optional[float] = None
+        self._announce_path: Optional[str] = None
+        self.endpoint: Optional[LiveEndpoint] = None
+        if serve:
+            self.endpoint = LiveEndpoint(self, host=host, port=port)
+            if announce_dir:
+                self._announce(announce_dir)
+
+    def _announce(self, announce_dir: str) -> None:
+        try:
+            os.makedirs(announce_dir, exist_ok=True)
+            path = os.path.join(announce_dir, f"{self.role}.json")
+            with open(path, "w") as f:
+                json.dump(
+                    {
+                        "schema": "sheeprl.live_endpoint/1",
+                        "role": self.role,
+                        "pid": os.getpid(),
+                        "host": self.endpoint.host,
+                        "port": self.endpoint.port,
+                        "url": self.endpoint.url,
+                        "ts": round(time.time(), 3),
+                    },
+                    f,
+                )
+            self._announce_path = path
+        except OSError:
+            self._announce_path = None
+
+    # ---------------------------------------------------------- observing
+    def observe(self, record: Dict[str, Any]) -> List[Dict[str, Any]]:
+        """Fold one record into the hub + evaluate the rules; returns the
+        alert records for any state transitions (the tee-ing sink appends
+        them to the telemetry stream; sink-less roles drop them — the
+        fleet event + stderr line already happened)."""
+        self.hub.observe(record)
+        if self.alerts is None:
+            return []
+        return self.alerts.observe(record)
+
+    def beat(self, step: int, **extra) -> Dict[str, Any]:
+        """Self-report for roles without a telemetry sink (non-lead
+        players, the trainer between records): derives this role's sps
+        from successive calls, feeds the hub under ``beat.*`` (names no
+        default alert rule matches — a player's per-iteration cadence is
+        far noisier than the lead's log-interval records), and returns
+        the compact summary dict the transports piggyback."""
+        now = time.time()
+        with self._lock:
+            if self._beat_prev is not None:
+                dt = now - self._beat_prev[0]
+                dstep = step - self._beat_prev[1]
+                if dt > 0 and dstep > 0:
+                    self._beat_sps = round(dstep / dt, 2)
+            self._beat_prev = (now, int(step))
+            sps = self._beat_sps
+        rec: Dict[str, Any] = {"ts": now, "beat": {"step": int(step), **extra}}
+        if sps is not None:
+            rec["beat"]["sps"] = sps
+        rss = host_rss_mb()
+        if rss is not None:
+            rec["beat"]["rss_mb"] = rss
+        self.observe(rec)
+        return self.summary()
+
+    def summary(self) -> Dict[str, Any]:
+        """This role's compact fleet summary (a few scalars — it rides
+        pickled frame extras, so keep it small)."""
+        with self._lock:
+            prev = self._beat_prev
+            sps = self._beat_sps
+        out: Dict[str, Any] = {"role": self.role, "pid": os.getpid()}
+        if prev is not None:
+            out["step"] = prev[1]
+        if sps is not None:
+            out["sps"] = sps
+        rss = host_rss_mb()
+        if rss is not None:
+            out["rss_mb"] = rss
+        if self.alerts is not None:
+            firing = self.alerts.stats()["firing"]
+            if firing:
+                out["alerts_firing"] = firing
+        if self.endpoint is not None:
+            out["port"] = self.endpoint.port
+        return out
+
+    def note_peer_summary(self, who: str, summary: Dict[str, Any]) -> None:
+        """Fold a peer role's piggybacked summary into this process's
+        fleet view (the trainer calls this per player via
+        ``FanIn.note_summary``; the lead's view arrives whole inside the
+        transport stats)."""
+        if isinstance(summary, dict):
+            with self._lock:
+                self._fleet[str(who)] = dict(summary)
+
+    def fleet_view(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            return {k: dict(v) for k, v in self._fleet.items()}
+
+    # ------------------------------------------------------------ surfaces
+    def status(self) -> Dict[str, Any]:
+        """The ``/status`` JSON snapshot."""
+        record = self.hub.last_record()
+        out: Dict[str, Any] = {
+            "schema": STATUS_SCHEMA,
+            "role": self.role,
+            "pid": os.getpid(),
+            "host": socket.gethostname(),
+            "ts": round(time.time(), 3),
+            "uptime_s": round(self.hub.uptime_s(), 1),
+            "records_seen": self.hub.records_seen,
+            "record": record,
+            "fleet": self.fleet_view(),
+        }
+        for k in ("step", "sps"):
+            if isinstance(record, dict) and record.get(k) is not None:
+                out[k] = record[k]
+        if self.alerts is not None:
+            out["alerts"] = {
+                **self.alerts.stats(),
+                "active": self.alerts.active(),
+                "detail": self.alerts.as_dicts(),
+            }
+        return out
+
+    def prometheus_text(self) -> str:
+        lines = self.hub.prometheus_lines()
+        if self.alerts is not None:
+            lines += self.alerts.prometheus_lines()
+        lines.append("# TYPE sheeprl_live_records_seen counter")
+        lines.append(
+            f'sheeprl_live_records_seen{{role="{self.role}"}} {self.hub.records_seen}'
+        )
+        return "\n".join(lines) + "\n"
+
+    def close(self) -> None:
+        if self.endpoint is not None:
+            self.endpoint.close()
+            self.endpoint = None
+        if self._announce_path:
+            try:
+                os.unlink(self._announce_path)
+            except OSError:
+                pass
+            self._announce_path = None
+
+
+# ------------------------------------------------------- process singleton
+_LIVE: Optional[LivePlane] = None
+_ATEXIT_INSTALLED = False
+
+
+def get_live() -> Optional[LivePlane]:
+    return _LIVE
+
+
+def _install_atexit() -> None:
+    global _ATEXIT_INSTALLED
+    if _ATEXIT_INSTALLED:
+        return
+    import atexit
+
+    atexit.register(close_live)
+    _ATEXIT_INSTALLED = True
+
+
+def configure(
+    role: str,
+    *,
+    history: int = 512,
+    host: str = "127.0.0.1",
+    port: int = 0,
+    alerts: bool = True,
+    extra_rules=(),
+    announce_dir: Optional[str] = None,
+    serve: bool = True,
+) -> LivePlane:
+    """Install this process's live plane (replacing any previous one)."""
+    global _LIVE
+    if _LIVE is not None:
+        _LIVE.close()
+    _LIVE = LivePlane(
+        role,
+        history=history,
+        host=host,
+        port=port,
+        alerts=alerts,
+        extra_rules=extra_rules,
+        announce_dir=announce_dir,
+        serve=serve,
+    )
+    _install_atexit()
+    return _LIVE
+
+
+def configure_from_cfg(cfg, role: str) -> Optional[LivePlane]:
+    """Build the live plane for ``role`` from ``cfg.metric.live*``.  Like
+    the flight recorder, the announce dir derives from
+    ``root_dir``/``run_name`` alone, so every process of a decoupled run
+    computes it without coordination.  Returns None (and constructs
+    nothing) when ``metric.live=off``."""
+    if not live_setting(cfg):
+        return None
+    metric_cfg = cfg.get("metric", {}) if hasattr(cfg, "get") else {}
+    announce_dir = os.path.join(str(cfg.root_dir), str(cfg.run_name), "live")
+    extra_rules = metric_cfg.get("alert_rules", None) or ()
+    # OmegaConf list/dict nodes -> plain containers (rule dicts get
+    # mutated during the merge)
+    try:
+        from omegaconf import OmegaConf
+
+        if OmegaConf.is_config(extra_rules):
+            extra_rules = OmegaConf.to_container(extra_rules, resolve=True)
+    except Exception:
+        pass
+    return configure(
+        role,
+        history=int(metric_cfg.get("live_history", 512)),
+        host=str(metric_cfg.get("live_host", "127.0.0.1")),
+        port=resolve_live_port(int(metric_cfg.get("live_port", 0) or 0), role),
+        alerts=bool(metric_cfg.get("alerts", True)),
+        extra_rules=extra_rules,
+        announce_dir=announce_dir,
+    )
+
+
+def close_live() -> None:
+    global _LIVE
+    if _LIVE is not None:
+        _LIVE.close()
+        _LIVE = None
+
+
+# ---------------------------------------------------------------- tee sink
+class LiveTelemetrySink(TelemetrySink):
+    """A TelemetrySink that tees every record into the process's live
+    plane as it is written, and appends the alert records any rule
+    transitions produced — so ``telemetry.jsonl`` carries the exact
+    alert timeline the live plane saw.  Constructed ONLY when
+    ``metric.live=on`` (:func:`make_sink`)."""
+
+    def write(self, record: Dict[str, Any]) -> None:
+        super().write(record)
+        if record.get("schema") == ALERT_SCHEMA:
+            return  # never re-observe an alert record (no feedback loop)
+        plane = _LIVE
+        if plane is None:
+            return
+        for alert in plane.observe(record):
+            super().write(alert)
+
+
+def make_sink(path: str, max_bytes: int = 32 * 1024 * 1024) -> TelemetrySink:
+    """The telemetry sink for this process: the UNDECORATED
+    :class:`TelemetrySink` when no live plane is installed (type
+    identity — ``metric.live=off`` costs nothing), the tee-ing subclass
+    when one is."""
+    if _LIVE is None:
+        return TelemetrySink(path, max_bytes=max_bytes)
+    return LiveTelemetrySink(path, max_bytes=max_bytes)

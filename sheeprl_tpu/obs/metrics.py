@@ -1,0 +1,556 @@
+"""Live in-run metrics: ring-buffer time series + declarative alert rules.
+
+Everything the framework could previously *observe* was post-hoc:
+``telemetry.jsonl`` (ISSUE 1) and the flight recorder (ISSUE 13) are read
+after the run.  This module is the LIVE half (ISSUE 15): a
+:class:`MetricsHub` keeps a bounded in-memory window of every numeric
+telemetry key, fed by the EXISTING TelemetrySink record path (the tee
+lives in :mod:`sheeprl_tpu.obs.fleet` — zero new instrumentation call
+sites), and an :class:`AlertEngine` evaluates a declarative rule pack
+over each record as it lands, firing typed ``alert`` fleet events into
+the PR-13 flight recorder and one stderr line per state change.
+
+The rule grammar (``metric.alert_rules`` entries are dicts with these
+fields; unset fields take the defaults shown):
+
+====================  =======================================================
+``name``              unique rule id (same name as a default rule OVERRIDES
+                      it; ``enabled: false`` removes it)
+``kind``              ``threshold`` | ``increase`` | ``drop`` | ``absence``
+``key``               dotted telemetry key, or a list of alternatives (first
+                      present in the record wins — lets one rule cover the
+                      coupled ``health.skips`` and the decoupled
+                      ``transport.health.skips`` spellings)
+``op`` / ``value``    threshold comparison: ``> >= < <= == !=`` against a
+                      number (or a string for ``==``/``!=`` — e.g. the serve
+                      breaker state)
+``window``            trailing-window length in observations (``increase``:
+                      fire while the value grew anywhere inside the window;
+                      ``drop``: the baseline mean)
+``drop_pct``          ``drop`` kind: fire when the value falls more than
+                      this percentage below the trailing-window mean
+``for``               consecutive true evaluations required to fire
+                      (``for_count`` in code; debounces noisy conditions)
+``clear_for``         consecutive false evaluations required to resolve
+``severity``          ``warn`` | ``crit`` (annotation only)
+====================  =======================================================
+
+Alert state transitions are also written into the telemetry stream as
+their own record type (``schema: "sheeprl.alert/1"`` — obs/reader.py
+knows how to pick them out, and schema-tolerant readers skip them), so a
+post-hoc investigation sees exactly what the live plane saw.
+
+Stdlib-only (no jax import): the ``obs.top`` dashboard and unit tests
+stay fast to start.
+"""
+
+from __future__ import annotations
+
+import math
+import sys
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from sheeprl_tpu.obs import flight
+from sheeprl_tpu.obs.reader import key_path
+
+ALERT_SCHEMA = "sheeprl.alert/1"
+
+__all__ = [
+    "ALERT_SCHEMA",
+    "AlertEngine",
+    "AlertRule",
+    "MetricsHub",
+    "default_alert_pack",
+    "derive_keys",
+    "flatten_record",
+    "prometheus_name",
+]
+
+
+# ----------------------------------------------------------------- flatten
+def flatten_record(
+    record: Any, prefix: str = ""
+) -> Tuple[Dict[str, float], Dict[str, str]]:
+    """One telemetry record -> (numeric leaves, string leaves) keyed by
+    dotted path.  Bools become 0/1 gauges; NaN/inf, lists and None are
+    skipped (a time series of a list means a schema change, not a
+    metric)."""
+    nums: Dict[str, float] = {}
+    text: Dict[str, str] = {}
+    if not isinstance(record, dict):
+        return nums, text
+    for k, v in record.items():
+        key = f"{prefix}{k}"
+        if isinstance(v, dict):
+            n2, t2 = flatten_record(v, prefix=key + ".")
+            nums.update(n2)
+            text.update(t2)
+        elif isinstance(v, bool):
+            nums[key] = 1.0 if v else 0.0
+        elif isinstance(v, (int, float)):
+            f = float(v)
+            if math.isfinite(f):
+                nums[key] = f
+        elif isinstance(v, str):
+            text[key] = v
+    return nums, text
+
+
+def _hist_percentile(hist: Dict[Any, Any], q: float) -> Optional[float]:
+    """Percentile of a ``{value: count}`` histogram (e.g. the fan-in's
+    ``lag_hist``)."""
+    try:
+        items = sorted((float(k), int(v)) for k, v in hist.items() if int(v) > 0)
+    except (TypeError, ValueError):
+        return None
+    total = sum(c for _, c in items)
+    if total == 0:
+        return None
+    target = q * total
+    seen = 0
+    for val, count in items:
+        seen += count
+        if seen >= target:
+            return val
+    return items[-1][0]
+
+
+def derive_keys(record: Dict[str, Any]) -> Dict[str, float]:
+    """Computed gauges the alert rules want but no producer emits
+    directly; merged into the hub series (never written back into the
+    telemetry file)."""
+    out: Dict[str, float] = {}
+    hbm = record.get("hbm")
+    if isinstance(hbm, dict):
+        used = hbm.get("bytes_in_use")
+        limit = hbm.get("bytes_limit")
+        if isinstance(used, (int, float)) and isinstance(limit, (int, float)) and limit > 0:
+            out["hbm.used_frac"] = round(float(used) / float(limit), 4)
+    lag_hist = key_path(record, "transport.lag_hist")
+    if isinstance(lag_hist, dict) and lag_hist:
+        p95 = _hist_percentile(lag_hist, 0.95)
+        if p95 is not None:
+            out["transport.lag_p95"] = p95
+    return out
+
+
+# --------------------------------------------------------------- the hub
+class MetricsHub:
+    """Bounded in-process time-series window over the telemetry record
+    stream.  Thread-safe: the training loop (or the tee-ing sink) writes
+    while the HTTP endpoint thread reads."""
+
+    def __init__(self, capacity: int = 512, role: str = "main"):
+        self.role = str(role)
+        self.capacity = max(8, int(capacity))
+        self._lock = threading.RLock()
+        self._series: Dict[str, deque] = {}
+        self._text: Dict[str, str] = {}
+        self._last_record: Optional[Dict[str, Any]] = None
+        self.records_seen = 0
+        self._t0 = time.time()
+
+    def observe(self, record: Dict[str, Any]) -> Dict[str, float]:
+        """Fold one record into the window; returns the flat numeric view
+        (incl. derived keys) so the alert engine shares the one flatten."""
+        nums, text = flatten_record(record)
+        nums.update(derive_keys(record))
+        ts = record.get("ts")
+        ts = float(ts) if isinstance(ts, (int, float)) else time.time()
+        with self._lock:
+            for name, value in nums.items():
+                series = self._series.get(name)
+                if series is None:
+                    series = self._series[name] = deque(maxlen=self.capacity)
+                series.append((ts, value))
+            self._text.update(text)
+            self._last_record = record
+            self.records_seen += 1
+        return nums
+
+    # ------------------------------------------------------------ queries
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._series)
+
+    def latest(self, name: str, default: Any = None) -> Any:
+        with self._lock:
+            series = self._series.get(name)
+            if series:
+                return series[-1][1]
+            return self._text.get(name, default)
+
+    def series(self, name: str, n: Optional[int] = None) -> List[Tuple[float, float]]:
+        with self._lock:
+            points = list(self._series.get(name, ()))
+        return points[-n:] if n else points
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            return {name: s[-1][1] for name, s in self._series.items() if s}
+
+    def last_record(self) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            return self._last_record
+
+    def uptime_s(self) -> float:
+        return time.time() - self._t0
+
+    # --------------------------------------------------------- prometheus
+    def prometheus_lines(self) -> List[str]:
+        """Latest value of every series as Prometheus text-exposition
+        gauges (``sheeprl_<key>{role="<role>"} <value>``)."""
+        lines: List[str] = []
+        with self._lock:
+            items = sorted(
+                (name, s[-1]) for name, s in self._series.items() if s
+            )
+        for name, (ts, value) in items:
+            metric = prometheus_name(name)
+            lines.append(f"# TYPE {metric} gauge")
+            lines.append(f'{metric}{{role="{self.role}"}} {_fmt_value(value)}')
+        return lines
+
+
+def _fmt_value(v: float) -> str:
+    if float(v).is_integer() and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+def prometheus_name(key: str) -> str:
+    """Dotted telemetry key -> valid Prometheus metric name
+    (``[a-zA-Z_:][a-zA-Z0-9_:]*``, namespaced under ``sheeprl_``)."""
+    out = []
+    for ch in key:
+        out.append(ch if (ch.isascii() and (ch.isalnum() or ch == "_")) else "_")
+    name = "".join(out)
+    if name and name[0].isdigit():
+        name = "_" + name
+    return "sheeprl_" + name
+
+
+# ---------------------------------------------------------------- alerts
+_OPS: Dict[str, Callable[[Any, Any], bool]] = {
+    ">": lambda a, b: a > b,
+    ">=": lambda a, b: a >= b,
+    "<": lambda a, b: a < b,
+    "<=": lambda a, b: a <= b,
+    "==": lambda a, b: a == b,
+    "!=": lambda a, b: a != b,
+}
+
+_KINDS = ("threshold", "increase", "drop", "absence")
+
+
+class AlertRule:
+    """One declarative rule + its evaluation state (see module docstring
+    for the grammar)."""
+
+    def __init__(
+        self,
+        name: str,
+        kind: str,
+        key,
+        *,
+        op: str = ">",
+        value: Any = 0,
+        window: int = 6,
+        drop_pct: float = 30.0,
+        severity: str = "warn",
+        enabled: bool = True,
+        clear_for: int = 1,
+        **extra,
+    ):
+        if kind not in _KINDS:
+            raise ValueError(f"alert rule {name!r}: unknown kind {kind!r} (use {_KINDS})")
+        if op not in _OPS:
+            raise ValueError(f"alert rule {name!r}: unknown op {op!r}")
+        self.name = str(name)
+        self.kind = kind
+        self.keys: Tuple[str, ...] = (key,) if isinstance(key, str) else tuple(key)
+        self.op = op
+        self.value = value
+        self.window = max(2, int(window))
+        self.drop_pct = float(drop_pct)
+        self.severity = severity
+        self.enabled = bool(enabled)
+        # "for" is a python keyword; accept both spellings in rule dicts
+        self.for_count = max(1, int(extra.pop("for", extra.pop("for_count", 1))))
+        self.clear_for = max(1, int(clear_for))
+        extra.pop("comment", None)
+        if extra:
+            raise ValueError(f"alert rule {name!r}: unknown fields {sorted(extra)}")
+        # evaluation state
+        self.state = "ok"
+        self.fires = 0
+        self.resolves = 0
+        self.last_value: Any = None
+        self.since_ts: Optional[float] = None
+        self._streak = 0
+        self._clear_streak = 0
+        self._hist: deque = deque(maxlen=self.window + 1)
+
+    # ------------------------------------------------------------- evaluate
+    def _lookup(self, record: Dict[str, Any]) -> Any:
+        _MISSING = object()
+        for key in self.keys:
+            v = key_path(record, key, _MISSING)
+            if v is not _MISSING:
+                return v
+        return None
+
+    def _condition(self, record: Dict[str, Any]) -> Optional[bool]:
+        """True/False = evaluated; None = not evaluable this record (key
+        absent for a value rule — the rule idles, streaks hold)."""
+        raw = self._lookup(record)
+        if self.kind == "absence":
+            self.last_value = raw
+            return raw is None
+        if raw is None:
+            return None
+        self.last_value = raw
+        if self.kind == "threshold":
+            try:
+                return bool(_OPS[self.op](raw, self.value))
+            except TypeError:
+                return None
+        # numeric history kinds
+        if isinstance(raw, bool) or not isinstance(raw, (int, float)):
+            return None
+        self._hist.append(float(raw))
+        if self.kind == "increase":
+            if len(self._hist) < 2:
+                return False
+            return self._hist[-1] > self._hist[0]
+        # drop: current value vs the mean of the PRIOR window
+        if len(self._hist) < self._hist.maxlen:
+            return False
+        prior = list(self._hist)[:-1]
+        baseline = sum(prior) / len(prior)
+        if baseline <= 0:
+            return False
+        return self._hist[-1] < baseline * (1.0 - self.drop_pct / 100.0)
+
+    def observe(self, record: Dict[str, Any], ts: float) -> Optional[str]:
+        """Evaluate once; returns ``"firing"``/``"ok"`` on a state
+        TRANSITION, else None."""
+        cond = self._condition(record)
+        if cond is None:
+            return None
+        if cond:
+            self._streak += 1
+            self._clear_streak = 0
+        else:
+            self._clear_streak += 1
+            self._streak = 0
+        if self.state == "ok" and self._streak >= self.for_count:
+            self.state = "firing"
+            self.fires += 1
+            self.since_ts = ts
+            return "firing"
+        if self.state == "firing" and self._clear_streak >= self.clear_for:
+            self.state = "ok"
+            self.resolves += 1
+            self.since_ts = ts
+            return "ok"
+        return None
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "rule": self.name,
+            "kind": self.kind,
+            "key": self.keys[0] if len(self.keys) == 1 else list(self.keys),
+            "state": self.state,
+            "severity": self.severity,
+            "fires": self.fires,
+            "resolves": self.resolves,
+            "value": self.last_value,
+            "since_ts": self.since_ts,
+        }
+
+
+def default_alert_pack() -> List[Dict[str, Any]]:
+    """The shipped rule pack (howto/observability.md has the prose
+    table).  Keys list BOTH the coupled and the decoupled spelling where
+    the stats ride different telemetry slots."""
+    return [
+        {
+            # any post-warmup retrace is a perf bug (PR 1's detector
+            # WARNs; this makes it a typed, machine-readable event)
+            "name": "post_warmup_recompile",
+            "kind": "threshold",
+            "key": ["compiles.post_warmup"],
+            "op": ">",
+            "value": 0,
+            "severity": "warn",
+        },
+        {
+            # the sentinel skipped update(s) inside the trailing window —
+            # the precursor of a rollback (ISSUE 7)
+            "name": "sentinel_skip_streak",
+            "kind": "increase",
+            "key": ["health.skips", "transport.health.skips", "replay.health.skips"],
+            "window": 4,
+            "severity": "crit",
+        },
+        {
+            # serve client breaker tripped to the local-fallback policy
+            "name": "breaker_open",
+            "kind": "threshold",
+            "key": ["serve.breaker", "transport.serve.breaker"],
+            "op": "==",
+            "value": "open",
+            "severity": "crit",
+        },
+        {
+            # corrupt frames forcing retransmissions inside the window —
+            # a link/host going bad shows here before anything fails
+            "name": "retrans_sustained",
+            "kind": "increase",
+            "key": [
+                "integrity.retrans_requested",
+                "transport.integrity.retrans_requested",
+                "replay.integrity.retrans_requested",
+            ],
+            "window": 4,
+            "severity": "warn",
+        },
+        {
+            # soft-lag contract breach: p95 of the behavior-policy lag
+            # histogram past the V-trace max_lag default
+            "name": "params_lag_p95",
+            "kind": "threshold",
+            "key": ["transport.lag_p95"],
+            "op": ">",
+            "value": 4,
+            "severity": "warn",
+        },
+        {
+            # HBM high-water: >90% of the device limit in use
+            "name": "hbm_high_water",
+            "kind": "threshold",
+            "key": ["hbm.used_frac"],
+            "op": ">",
+            "value": 0.9,
+            "severity": "crit",
+        },
+        {
+            # sustained throughput collapse vs the trailing window (two
+            # consecutive breaches so one slow checkpoint interval
+            # cannot false-fire)
+            "name": "sps_drop",
+            "kind": "drop",
+            "key": ["sps"],
+            "window": 6,
+            "drop_pct": 30.0,
+            "for": 2,
+            "severity": "warn",
+        },
+    ]
+
+
+class AlertEngine:
+    """Evaluates the rule pack over each observed record; on every state
+    change it emits (a) one stderr line, (b) one typed ``alert`` fleet
+    event on this process's flight track, and (c) one ``sheeprl.alert/1``
+    record the caller may append to the telemetry stream."""
+
+    def __init__(
+        self,
+        rules: Optional[Sequence[Dict[str, Any]]] = None,
+        *,
+        role: str = "main",
+        extra_rules: Sequence[Dict[str, Any]] = (),
+    ):
+        base = {r["name"]: dict(r) for r in (rules if rules is not None else default_alert_pack())}
+        for r in extra_rules or ():
+            r = dict(r)
+            name = r.get("name")
+            if not name:
+                raise ValueError(f"metric.alert_rules entry without a name: {r}")
+            merged = dict(base.get(name, {}))
+            merged.update(r)
+            base[name] = merged
+        self.role = str(role)
+        self.rules: List[AlertRule] = [
+            AlertRule(**spec) for spec in base.values() if spec.get("enabled", True)
+        ]
+        self._lock = threading.RLock()
+
+    def observe(self, record: Dict[str, Any]) -> List[Dict[str, Any]]:
+        """Evaluate every rule against one record; returns the alert
+        records for this observation's state transitions (empty most of
+        the time)."""
+        ts = record.get("ts")
+        ts = float(ts) if isinstance(ts, (int, float)) else time.time()
+        out: List[Dict[str, Any]] = []
+        with self._lock:
+            for rule in self.rules:
+                transition = rule.observe(record, ts)
+                if transition is None:
+                    continue
+                alert = {
+                    "schema": ALERT_SCHEMA,
+                    "ts": round(ts, 3),
+                    "rule": rule.name,
+                    "state": transition,
+                    "severity": rule.severity,
+                    "value": _jsonable(rule.last_value),
+                    "step": record.get("step"),
+                    "role": self.role,
+                }
+                out.append(alert)
+                flight.fleet_event(
+                    "alert",
+                    rule=rule.name,
+                    state=transition,
+                    severity=rule.severity,
+                    value=_jsonable(rule.last_value),
+                )
+                print(
+                    f"[sheeprl.alert] {self.role}: rule {rule.name!r} -> {transition.upper()} "
+                    f"(value={rule.last_value!r}, severity={rule.severity})",
+                    file=sys.stderr,
+                )
+        return out
+
+    # ------------------------------------------------------------- queries
+    def active(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [r.as_dict() for r in self.rules if r.state == "firing"]
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "rules": len(self.rules),
+                "firing": sum(1 for r in self.rules if r.state == "firing"),
+                "fires_total": sum(r.fires for r in self.rules),
+                "resolves_total": sum(r.resolves for r in self.rules),
+            }
+
+    def as_dicts(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [r.as_dict() for r in self.rules]
+
+    def prometheus_lines(self) -> List[str]:
+        lines = ["# TYPE sheeprl_alert_firing gauge"]
+        with self._lock:
+            for r in self.rules:
+                lines.append(
+                    f'sheeprl_alert_firing{{role="{self.role}",rule="{r.name}",'
+                    f'severity="{r.severity}"}} {1 if r.state == "firing" else 0}'
+                )
+            lines.append("# TYPE sheeprl_alerts_fired_total counter")
+            total = sum(r.fires for r in self.rules)
+        lines.append(f'sheeprl_alerts_fired_total{{role="{self.role}"}} {total}')
+        return lines
+
+
+def _jsonable(v: Any) -> Any:
+    if v is None or isinstance(v, (bool, int, float, str)):
+        return v
+    return str(v)

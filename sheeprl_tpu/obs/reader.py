@@ -36,10 +36,35 @@ __all__ = [
     "iter_run_records",
     "key_path",
     "last_jsonl",
+    "read_alerts",
     "read_flight",
     "read_jsonl",
+    "record_kind",
     "telemetry_files",
 ]
+
+# known record families interleaved in the telemetry stream (ISSUE 15:
+# the live plane appends "sheeprl.alert/1" records next to the
+# "sheeprl.telemetry/N" ones; future kinds must be SKIPPED, not fatal)
+SCHEMA_ALERT_PREFIX = "sheeprl.alert/"
+SCHEMA_TELEMETRY_PREFIX = "sheeprl.telemetry/"
+
+
+def record_kind(record: Any) -> str:
+    """The record family of one stream row: ``"telemetry"``, ``"alert"``,
+    an unknown family's bare name (``"sheeprl.x/3"`` -> ``"x"``), or
+    ``"unversioned"`` for pre-13 records without a schema stamp."""
+    if not isinstance(record, dict):
+        return "unversioned"
+    schema = record.get("schema")
+    if not isinstance(schema, str):
+        return "unversioned"
+    if schema.startswith(SCHEMA_TELEMETRY_PREFIX):
+        return "telemetry"
+    if schema.startswith(SCHEMA_ALERT_PREFIX):
+        return "alert"
+    name = schema.split("/", 1)[0]
+    return name.split(".", 1)[-1] if "." in name else name
 
 
 def iter_jsonl(path: str) -> Iterator[Dict[str, Any]]:
@@ -108,10 +133,25 @@ def telemetry_files(root_dir: str, include_backups: bool = False) -> List[str]:
     return _with_backups(paths) if include_backups else paths
 
 
-def iter_run_records(root_dir: str, include_backups: bool = False) -> Iterator[Dict[str, Any]]:
-    """Every telemetry record of a run, file by file (oldest first)."""
+def iter_run_records(
+    root_dir: str, include_backups: bool = False, kinds: Optional[Iterable[str]] = None
+) -> Iterator[Dict[str, Any]]:
+    """Every record of a run's telemetry stream, file by file (oldest
+    first).  ``kinds`` filters by :func:`record_kind` (e.g.
+    ``("telemetry",)`` drops interleaved alert records and any future
+    family an older reader doesn't know); the default keeps every row —
+    existing consumers are key-tolerant by construction."""
+    wanted = frozenset(kinds) if kinds is not None else None
     for path in telemetry_files(root_dir, include_backups=include_backups):
-        yield from iter_jsonl(path)
+        for rec in iter_jsonl(path):
+            if wanted is None or record_kind(rec) in wanted:
+                yield rec
+
+
+def read_alerts(root_dir: str, include_backups: bool = False) -> List[Dict[str, Any]]:
+    """Every alert record (``sheeprl.alert/1``, obs/metrics.py) the live
+    plane interleaved into a run's telemetry stream, oldest first."""
+    return list(iter_run_records(root_dir, include_backups=include_backups, kinds=("alert",)))
 
 
 def collect_key(root_dir: str, path: str, *, include_backups: bool = False) -> List[Any]:

@@ -25,11 +25,14 @@ import os
 import time
 from typing import Any, Dict, List, Optional
 
-TELEMETRY_SCHEMA_VERSION = 1
+TELEMETRY_SCHEMA_VERSION = 2
 # versioned schema stamp carried by EVERY record (ISSUE 13): readers
-# route on the string ("sheeprl.telemetry/1", "sheeprl.flight/1", ...)
-# instead of guessing from key shapes; bump the suffix on breaking
-# layout changes.  "v" stays for pre-13 consumers.
+# route on the string ("sheeprl.telemetry/2", "sheeprl.flight/1",
+# "sheeprl.alert/1", ...) instead of guessing from key shapes; bump the
+# suffix on breaking layout changes.  "v" stays for pre-13 consumers.
+# v2 (ISSUE 15): "hbm" is ABSENT on backends that report no memory
+# stats (it was a null that broke naive consumers), and alert records
+# ("sheeprl.alert/1", obs/metrics.py) may interleave in the stream.
 TELEMETRY_SCHEMA = f"sheeprl.telemetry/{TELEMETRY_SCHEMA_VERSION}"
 
 # field -> allowed python types after json round-trip (None = nullable)
@@ -45,9 +48,12 @@ TELEMETRY_REQUIRED_FIELDS: Dict[str, tuple] = {
     "sps_train": _NUM + (type(None),),
     "timers_s": (dict,),
     "timer_percentiles_s": (dict,),
-    "hbm": (dict, type(None)),
     "host_rss_mb": _NUM + (type(None),),
     "compiles": (dict,),
+}
+# present-if-reported fields (validated when present, never required)
+TELEMETRY_OPTIONAL_FIELDS: Dict[str, tuple] = {
+    "hbm": (dict,),
 }
 
 
@@ -61,6 +67,12 @@ def validate_record(record: Any) -> List[str]:
         if field not in record:
             errors.append(f"missing field '{field}'")
         elif not isinstance(record[field], types):
+            errors.append(
+                f"field '{field}' has type {type(record[field]).__name__}, "
+                f"expected one of {tuple(t.__name__ for t in types)}"
+            )
+    for field, types in TELEMETRY_OPTIONAL_FIELDS.items():
+        if field in record and not isinstance(record[field], types):
             errors.append(
                 f"field '{field}' has type {type(record[field]).__name__}, "
                 f"expected one of {tuple(t.__name__ for t in types)}"
@@ -184,9 +196,17 @@ def device_memory_stats(device: Any = None) -> Optional[Dict[str, int]]:
         stats = device.memory_stats()
     except Exception:
         return None
+    # CPU backends (and some tunnels) return None or {} — and a plugin
+    # may report a key with a None VALUE; the record must carry the key
+    # as ABSENT, never as a null a downstream consumer trips over
     if not stats:
         return None
-    return {k: int(stats[k]) for k in _HBM_KEYS if k in stats}
+    out = {}
+    for k in _HBM_KEYS:
+        v = stats.get(k)
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            out[k] = int(v)
+    return out or None
 
 
 def make_record(
@@ -216,10 +236,13 @@ def make_record(
         "sps_train": None if sps_train is None else round(float(sps_train), 2),
         "timers_s": {k: round(float(v), 6) for k, v in (timers_s or {}).items()},
         "timer_percentiles_s": timer_percentiles_s or {},
-        "hbm": hbm,
         "host_rss_mb": host_rss,
         "compiles": compiles or {},
     }
+    # v2: no-HBM backends OMIT the key (a null here broke naive
+    # downstream consumers computing used fractions)
+    if hbm is not None:
+        record["hbm"] = hbm
     if extra:
         record.update(extra)
     return record

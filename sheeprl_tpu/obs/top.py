@@ -1,0 +1,280 @@
+"""``python -m sheeprl_tpu.obs.top`` — a live terminal dashboard over the
+fleet metrics plane (ISSUE 15).
+
+Points at the LEAD's ``/status`` endpoint (obs/fleet.py) and re-renders
+one screen per refresh: run throughput, the per-player fleet table the
+lead aggregates from piggybacked summaries + transport stats, serve
+latency, replay SPI, and the alert-rule states.  Targets:
+
+- an URL (``http://127.0.0.1:8200``),
+- a run directory — the newest ``live/<role>.json`` announce file wins
+  (lead preferred), so ephemeral ports need no configuration,
+- with ``--post-hoc`` semantics for free: when no endpoint answers, the
+  last record of the run's ``telemetry.jsonl`` renders instead (marked
+  as such) — the same screen works on a finished run.
+
+Stdlib-only: no jax, no curses (ANSI clear + redraw keeps it dumb and
+portable); ``--once`` prints a single frame and exits (tests, piping).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+from sheeprl_tpu.obs.reader import iter_jsonl, key_path, last_jsonl, telemetry_files
+
+_LEAD_ROLES = ("player0", "main", "lead")
+
+
+# ------------------------------------------------------------- discovery
+def discover_status_url(target: str) -> Optional[str]:
+    """A ``/status`` URL for ``target`` (URL passthrough; run dirs search
+    their ``live/*.json`` announce files, lead roles preferred, newest
+    mtime breaking ties)."""
+    if target.startswith(("http://", "https://")):
+        return target.rstrip("/") + ("" if target.rstrip("/").endswith("/status") else "/status")
+    candidates = sorted(
+        glob.glob(os.path.join(target, "**", "live", "*.json"), recursive=True),
+        key=os.path.getmtime,
+        reverse=True,
+    )
+    def rank(path: str) -> int:
+        role = os.path.basename(path).rsplit(".", 1)[0]
+        return _LEAD_ROLES.index(role) if role in _LEAD_ROLES else len(_LEAD_ROLES)
+    for path in sorted(candidates, key=rank):
+        try:
+            with open(path) as f:
+                info = json.load(f)
+            url = info.get("url")
+            if url:
+                return url.rstrip("/") + "/status"
+        except (OSError, ValueError):
+            continue
+    return None
+
+
+def fetch_status(url: str, timeout: float = 2.0) -> Optional[Dict[str, Any]]:
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return json.loads(resp.read().decode())
+    except Exception:
+        return None
+
+
+def post_hoc_status(run_dir: str) -> Optional[Dict[str, Any]]:
+    """A status-shaped snapshot from the newest telemetry record on disk
+    (a finished or endpoint-less run)."""
+    files = telemetry_files(run_dir)
+    if not files:
+        return None
+    record = None
+    for rec in iter_jsonl(files[-1]):
+        if rec.get("schema", "").startswith("sheeprl.telemetry"):
+            record = rec
+    if record is None:
+        record = last_jsonl(files[-1])
+    if record is None:
+        return None
+    return {
+        "schema": "sheeprl.status/post-hoc",
+        "role": "post-hoc",
+        "ts": record.get("ts"),
+        "record": record,
+        "step": record.get("step"),
+        "sps": record.get("sps"),
+        "fleet": {},
+        "post_hoc": True,
+    }
+
+
+# ------------------------------------------------------------- rendering
+def _fmt(v: Any, nd: int = 1) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, bool):
+        return "yes" if v else "no"
+    if isinstance(v, float):
+        return f"{v:,.{nd}f}"
+    if isinstance(v, int):
+        return f"{v:,}"
+    return str(v)
+
+
+def _table(headers: List[str], rows: List[List[str]]) -> List[str]:
+    widths = [len(h) for h in headers]
+    for row in rows:
+        for i, cell in enumerate(row):
+            widths[i] = max(widths[i], len(cell))
+    fmt = "  ".join(f"{{:<{w}}}" for w in widths)
+    out = [fmt.format(*headers), fmt.format(*("-" * w for w in widths))]
+    out += [fmt.format(*row) for row in rows]
+    return out
+
+
+def render_status(status: Dict[str, Any]) -> str:
+    """One dashboard frame as plain text (ANSI-free: the caller owns the
+    screen)."""
+    record = status.get("record") or {}
+    lines: List[str] = []
+    tag = " (post-hoc: telemetry.jsonl)" if status.get("post_hoc") else ""
+    age = ""
+    rec_ts = record.get("ts")
+    if isinstance(rec_ts, (int, float)):
+        age = f"  record age {max(0.0, time.time() - rec_ts):.0f}s"
+    lines.append(
+        f"sheeprl obs.top — role {status.get('role')}  step {_fmt(status.get('step'))}  "
+        f"sps {_fmt(status.get('sps'))}  uptime {_fmt(status.get('uptime_s'))}s{age}{tag}"
+    )
+    compiles = record.get("compiles") or {}
+    hbm = record.get("hbm") or {}
+    lines.append(
+        f"compiles {_fmt(compiles.get('total'))} (post-warmup {_fmt(compiles.get('post_warmup'))})"
+        f"  host rss {_fmt(record.get('host_rss_mb'))} MB"
+        + (
+            f"  hbm {_fmt(hbm.get('bytes_in_use', 0) / 1e9, 2)}/"
+            f"{_fmt(hbm.get('bytes_limit', 0) / 1e9, 2)} GB"
+            if hbm
+            else ""
+        )
+    )
+
+    # ----------------------------------------------------- fleet table
+    players = key_path(record, "transport.players") or {}
+    fleet = dict(status.get("fleet") or {})
+    fleet.update(key_path(record, "transport.fleet") or {})
+    if players or fleet:
+        lines.append("")
+        lines.append(
+            f"fleet — live {_fmt(key_path(record, 'transport.live'))}"
+            f"/{_fmt(key_path(record, 'transport.num_players'))}"
+            f"  deaths {_fmt(key_path(record, 'transport.deaths'))}"
+            f"  rejoins {_fmt(key_path(record, 'transport.rejoins'))}"
+            f"  fan-in depth {_fmt(key_path(record, 'transport.fan_in_depth'))}"
+            f"  bytes/s {_fmt(key_path(record, 'transport.bytes_per_s'))}"
+        )
+        rows = []
+        for pid in sorted(set(players) | set(fleet), key=str):
+            p = players.get(pid, {}) if isinstance(players, dict) else {}
+            s = fleet.get(pid, fleet.get(str(pid), {}))
+            rows.append(
+                [
+                    str(pid),
+                    _fmt(p.get("sps", s.get("sps"))),
+                    _fmt(s.get("sps")),
+                    _fmt(p.get("frames")),
+                    _fmt(p.get("depth")),
+                    _fmt(p.get("lag")),
+                    _fmt(s.get("rss_mb")),
+                    _fmt(p.get("alive", True)),
+                ]
+            )
+        lines += _table(
+            ["player", "sps", "self-sps", "frames", "depth", "lag", "rss MB", "alive"],
+            rows,
+        )
+
+    # ------------------------------------------------------------ serve
+    serve = record.get("serve") or key_path(record, "transport.serve")
+    if isinstance(serve, dict):
+        lat = serve.get("latency_ms") or {}
+        lines.append("")
+        lines.append(
+            f"serve — state {serve.get('state', serve.get('breaker', '-'))}"
+            f"  requests {_fmt(serve.get('requests'))}"
+            f"  queue {_fmt(serve.get('queue_depth'))}"
+            f"  p50 {_fmt(lat.get('p50'))} ms  p95 {_fmt(lat.get('p95'))} ms"
+        )
+
+    # ----------------------------------------------------------- replay
+    replay = record.get("replay")
+    if isinstance(replay, dict):
+        limiter = replay.get("limiter") or {}
+        lines.append("")
+        lines.append(
+            f"replay — inserts {_fmt(replay.get('inserts'))}"
+            f"  spi {_fmt(limiter.get('spi_observed'))}/{_fmt(limiter.get('spi_target'))}"
+            f"  insert stalls {_fmt(limiter.get('insert_stalls'))}"
+            f"  quarantined {_fmt(replay.get('inserts_quarantined'))}"
+        )
+
+    # ----------------------------------------------------------- health
+    health = record.get("health") or key_path(record, "transport.health")
+    if isinstance(health, dict):
+        lines.append("")
+        lines.append(
+            f"health — updates {_fmt(health.get('updates'))}  skips {_fmt(health.get('skips'))}"
+            f"  rollbacks {_fmt(health.get('rollbacks'))}  last_ok {_fmt(health.get('last_ok'))}"
+        )
+
+    # ----------------------------------------------------------- alerts
+    alerts = status.get("alerts")
+    if isinstance(alerts, dict):
+        lines.append("")
+        active = alerts.get("active") or []
+        lines.append(
+            f"alerts — firing {_fmt(alerts.get('firing'))}/{_fmt(alerts.get('rules'))}"
+            f"  fired total {_fmt(alerts.get('fires_total'))}"
+        )
+        if active:
+            rows = [
+                [a.get("rule", "?"), a.get("severity", "-"), str(a.get("value")), _fmt(a.get("since_ts"))]
+                for a in active
+            ]
+            lines += _table(["rule", "severity", "value", "since"], rows)
+        else:
+            lines.append("  (none firing)")
+    return "\n".join(lines) + "\n"
+
+
+# ------------------------------------------------------------------- CLI
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m sheeprl_tpu.obs.top",
+        description="live terminal dashboard over a run's /status endpoint",
+    )
+    ap.add_argument(
+        "target",
+        help="status URL (http://host:port) or a run directory containing live/*.json",
+    )
+    ap.add_argument("--interval", type=float, default=2.0, help="refresh seconds")
+    ap.add_argument("--once", action="store_true", help="print one frame and exit")
+    ap.add_argument(
+        "--no-clear", action="store_true", help="append frames instead of redrawing"
+    )
+    args = ap.parse_args(argv)
+
+    url = discover_status_url(args.target)
+    is_dir = os.path.isdir(args.target)
+    while True:
+        status = fetch_status(url) if url else None
+        if status is None and is_dir:
+            if url is None:  # a run that started after us may have announced by now
+                url = discover_status_url(args.target)
+                status = fetch_status(url) if url else None
+            if status is None:
+                status = post_hoc_status(args.target)
+        if status is None:
+            frame = f"obs.top: no /status endpoint or telemetry under {args.target!r} (yet)\n"
+        else:
+            frame = render_status(status)
+        if not args.no_clear and not args.once:
+            sys.stdout.write("\x1b[2J\x1b[H")
+        sys.stdout.write(frame)
+        sys.stdout.flush()
+        if args.once:
+            return 0 if status is not None else 1
+        try:
+            time.sleep(max(0.2, args.interval))
+        except KeyboardInterrupt:
+            return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

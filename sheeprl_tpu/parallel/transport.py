@@ -1941,6 +1941,11 @@ class FanIn:
         self.last_seen: Dict[int, float] = {}  # any-frame liveness (heartbeats)
         self.lag_hist: Dict[int, int] = {}  # behavior-policy lag -> rounds seen
         self._lag_by_pid: Dict[int, int] = {}
+        # per-player live-metrics summaries (ISSUE 15): players piggyback
+        # their compact LivePlane.beat() dict on the data frames they
+        # already send; the loop hands it in via note_summary and the
+        # fleet view rides stats() to the lead's telemetry + /status
+        self.fleet: Dict[int, Dict[str, Any]] = {}
         self._steps_per_frame = env_steps_per_frame or {}
         self._last_data_seq: Dict[int, int] = {}
         self._stash: Dict[int, Frame] = {}  # joiners' early data frames
@@ -2015,6 +2020,14 @@ class FanIn:
         lag = max(0, int(lag))
         self.lag_hist[lag] = self.lag_hist.get(lag, 0) + 1
         self._lag_by_pid[pid] = lag
+
+    def note_summary(self, pid: int, summary: Any) -> None:
+        """Record one player's piggybacked live-metrics summary (the
+        extra slot after the behavior seq on ``data`` frames; tolerant of
+        anything that is not a dict — an old player simply never sends
+        one)."""
+        if isinstance(summary, dict):
+            self.fleet[pid] = summary
 
     def _require_live(self, who: str = "player") -> None:
         if not self.live and not self.stopped and not self.joining:
@@ -2224,7 +2237,7 @@ class FanIn:
             if pid in self._lag_by_pid:
                 entry["lag"] = self._lag_by_pid[pid]
             per_player[str(pid)] = entry
-        return {
+        out = {
             "backend": backend,
             "players": per_player,
             "num_players": len(self.channels),
@@ -2239,6 +2252,9 @@ class FanIn:
                 ch.depth() or 0 for pid, ch in self.channels.items() if pid not in self.dead
             ),
         }
+        if self.fleet:
+            out["fleet"] = {str(pid): dict(s) for pid, s in sorted(self.fleet.items())}
+        return out
 
     def close(self) -> None:
         for ch in self.channels.values():

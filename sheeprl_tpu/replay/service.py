@@ -105,9 +105,17 @@ class ReplayWriter:
             if time.monotonic() > deadline:
                 return
 
-    def append(self, step_data: Dict[str, np.ndarray], timeout: float = 600.0) -> None:
+    def append(
+        self,
+        step_data: Dict[str, np.ndarray],
+        timeout: float = 600.0,
+        summary: Optional[Dict[str, Any]] = None,
+    ) -> None:
         """Ship one ``(T, n_envs, *)`` block as an ``rb_insert`` frame;
-        blocks while no credit is available (limiter throttle)."""
+        blocks while no credit is available (limiter throttle).
+        ``summary`` (ISSUE 15) piggybacks this player's compact
+        live-metrics dict on the frame's extra — the server folds it into
+        its fleet view."""
         t_len = next(iter(step_data.values())).shape[0]
         if self.credits <= 0:
             self.stalls += 1
@@ -128,7 +136,7 @@ class ReplayWriter:
         self._chan.send(
             RB_INSERT_TAG,
             arrays=[(k, v) for k, v in step_data.items()],
-            extra=(t_len * self.n_envs,),
+            extra=(t_len * self.n_envs,) + ((summary,) if summary is not None else ()),
             seq=self.seq,
             timeout=timeout,
         )
@@ -221,6 +229,9 @@ class ReplayServer:
         self.events: List[Dict[str, Any]] = []
         self.total_inserts = 0  # transitions (the trainer's policy-step clock)
         self.inserts_by_player = {pid: 0 for pid in self.channels}
+        # per-player live-metrics summaries piggybacked on rb_insert
+        # frames (ISSUE 15); rides stats() to the lead's /status
+        self.fleet: Dict[int, Dict[str, Any]] = {}
         self.credit_stall_players = 0  # grant attempts refused by the limiter
         # training-sentinel quarantine bookkeeping: ring rows written per
         # env since the last verdict-clean horizon (mark_health_horizon)
@@ -349,6 +360,10 @@ class ReplayServer:
 
     def _ingest(self, pid: int, frame) -> int:
         offset, count = self.env_shards[pid]
+        extra = getattr(frame, "extra", ()) or ()
+        if len(extra) > 1 and isinstance(extra[1], dict):
+            # the player's piggybacked live-metrics summary (ISSUE 15)
+            self.fleet[pid] = dict(extra[1])
         arrays = frame.arrays_copy()  # transport buffers go back on release
         frame.release()
         t_len = next(iter(arrays.values())).shape[0]
@@ -596,6 +611,8 @@ class ReplayServer:
         }
         if self.limiter is not None:
             rec["limiter"] = self.limiter.stats()
+        if self.fleet:
+            rec["fleet"] = {str(pid): dict(s) for pid, s in sorted(self.fleet.items())}
         return rec
 
     @property

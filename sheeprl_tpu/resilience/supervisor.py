@@ -234,12 +234,44 @@ class PlayerSupervisor:
 
     # ---------------------------------------------------------- telemetry
     def stats(self) -> Dict[str, Any]:
-        return {
+        out = {
             "restarts": self.total_restarts,
             "budget_remaining": self.budget_remaining,
             "pending_restarts": len(self._next_attempt),
             "restarts_by_player": {str(p): n for p, n in sorted(self.restarts_by_pid.items())},
             "events": self.events[-8:],
+        }
+        alerts = self._active_alerts()
+        if alerts is not None:
+            out["alerts_firing"] = len(alerts)
+        return out
+
+    @staticmethod
+    def _active_alerts():
+        """The live plane's firing alert rules in this process (ISSUE 15),
+        or None when ``metric.live=off``."""
+        from sheeprl_tpu.obs import fleet
+
+        plane = fleet.get_live()
+        if plane is None or plane.alerts is None:
+            return None
+        return plane.alerts.active()
+
+    def autoscale_signal(self) -> Dict[str, Any]:
+        """The input surface for a telemetry-driven autoscaler (ROADMAP
+        item 3): one dict combining this pool's size/budget state with
+        the live alert plane — a future policy grows or shrinks the
+        elastic pool off exactly these signals (sps collapse, breaker
+        open, sustained retransmissions, lag breach) instead of rereading
+        telemetry files mid-run."""
+        alerts = self._active_alerts()
+        return {
+            "live_players": len(self._fanin.live),
+            "pool_size": len(self.procs),
+            "pending_restarts": len(self._next_attempt),
+            "restart_budget_remaining": self.budget_remaining,
+            "alerts": alerts if alerts is not None else [],
+            "alerts_available": alerts is not None,
         }
 
     def close(self) -> None:

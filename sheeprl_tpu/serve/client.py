@@ -249,6 +249,10 @@ class InferenceClient:
             "role": "client",
             "client_id": self.client_id,
             "breaker": self.breaker.state,
+            # numeric mirror of the state string: the live metrics plane
+            # (obs/metrics.py) exports gauges, and /metrics consumers
+            # alert on `sheeprl_serve_breaker_open` without string rules
+            "breaker_open": 0 if self.breaker.state == "closed" else 1,
             "breaker_trips": self.breaker.trips,
             "breaker_reopens": self.breaker.reopens,
             "breaker_promotions": self.breaker.promotions,

@@ -1,0 +1,144 @@
+"""obs/fleet.py — the per-process live plane (ISSUE 15): off-path type
+identity, the tee-ing sink, the /metrics + /status endpoint, port layout,
+beat/summary piggybacking, and announce-file discovery."""
+
+import json
+import os
+import urllib.request
+
+import pytest
+
+from sheeprl_tpu.obs import fleet
+from sheeprl_tpu.obs.fleet import (
+    LiveTelemetrySink,
+    live_setting,
+    make_sink,
+    resolve_live_port,
+)
+from sheeprl_tpu.obs.metrics import ALERT_SCHEMA
+from sheeprl_tpu.obs.telemetry import TelemetrySink, make_record
+
+pytestmark = pytest.mark.live
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    fleet.close_live()
+    yield
+    fleet.close_live()
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=5) as resp:
+        return resp.status, resp.headers.get("Content-Type", ""), resp.read().decode()
+
+
+# ----------------------------------------------------------- off = free
+def test_off_sink_is_type_identical_to_pre_live_sink(tmp_path):
+    """metric.live=off constructs the UNDECORATED pre-PR TelemetrySink —
+    the PR-9/10/13 zero-overhead pattern."""
+    sink = make_sink(str(tmp_path / "t.jsonl"))
+    assert type(sink) is TelemetrySink
+    sink.write(make_record(step=1, train_step=0))
+    sink.close()
+
+
+def test_live_setting_resolution(monkeypatch):
+    class Cfg(dict):
+        pass
+
+    assert live_setting({"metric": {"live": "off"}}) is False
+    assert live_setting({"metric": {"live": "on"}}) is True
+    assert live_setting({}) is False
+    monkeypatch.setenv("SHEEPRL_LIVE", "on")
+    assert live_setting({"metric": {"live": "off"}}) is True
+
+
+def test_resolve_live_port_layout():
+    assert resolve_live_port(8200, "main") == 8200
+    assert resolve_live_port(8200, "player0") == 8200
+    assert resolve_live_port(8200, "trainer") == 8201
+    assert resolve_live_port(8200, "player3") == 8204
+    assert resolve_live_port(0, "trainer") == 0
+
+
+# ------------------------------------------------------------- tee sink
+def test_tee_sink_feeds_hub_and_interleaves_alert_records(tmp_path):
+    plane = fleet.configure("lead", serve=False)
+    path = str(tmp_path / "telemetry.jsonl")
+    sink = make_sink(path)
+    assert isinstance(sink, LiveTelemetrySink)
+    sink.write(make_record(step=1, train_step=0, sps=100.0))
+    sink.write(
+        make_record(step=2, train_step=1, sps=100.0, extra={"compiles": {"post_warmup": 1}})
+    )
+    sink.close()
+    rows = [json.loads(l) for l in open(path)]
+    schemas = [r["schema"] for r in rows]
+    # the alert record lands NEXT TO the record that fired it
+    assert schemas.count(ALERT_SCHEMA) == 1
+    assert plane.hub.records_seen == 2
+    assert plane.hub.latest("sps") == 100.0
+    # an alert record written back through the sink is never re-observed
+    sink2 = make_sink(path)
+    sink2.write(rows[-1])
+    sink2.close()
+    assert plane.hub.records_seen == 2
+
+
+# ------------------------------------------------------------- endpoint
+@pytest.mark.network
+def test_endpoint_serves_metrics_and_status(tmp_path):
+    plane = fleet.configure("player0", announce_dir=str(tmp_path / "live"))
+    plane.observe(make_record(step=10, train_step=3, sps=42.0))
+    url = plane.endpoint.url
+
+    code, ctype, body = _get(url + "/status")
+    assert code == 200 and ctype.startswith("application/json")
+    status = json.loads(body)
+    assert status["role"] == "player0" and status["record"]["sps"] == 42.0
+    assert status["alerts"]["rules"]
+
+    code, ctype, body = _get(url + "/metrics")
+    assert code == 200 and "version=0.0.4" in ctype
+    assert 'sheeprl_sps{role="player0"} 42' in body
+
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(url + "/nope")
+    assert ei.value.code == 404
+
+    # announce file carries the real (ephemeral) port, and closes away
+    ann_path = tmp_path / "live" / "player0.json"
+    ann = json.load(open(ann_path))
+    assert ann["port"] == plane.endpoint.port and ann["url"] == url
+    fleet.close_live()
+    assert not ann_path.exists()
+
+
+# --------------------------------------------------------- beat/summary
+def test_beat_derives_sps_and_summary_stays_compact():
+    plane = fleet.configure("player1", serve=False)
+    s0 = plane.beat(0)
+    assert s0["role"] == "player1" and "sps" not in s0  # first call: no rate yet
+    import time
+
+    time.sleep(0.05)
+    s1 = plane.beat(500)
+    assert s1["sps"] > 0
+    assert plane.hub.latest("beat.sps") == s1["sps"]
+    # compact: a few scalars only — it rides pickled frame extras
+    assert len(json.dumps(s1)) < 256
+
+
+def test_peer_summaries_reach_status():
+    plane = fleet.configure("trainer", serve=False)
+    plane.note_peer_summary("1", {"sps": 5.0, "step": 100})
+    plane.note_peer_summary("junk", "not-a-dict")
+    status = plane.status()
+    assert status["fleet"] == {"1": {"sps": 5.0, "step": 100}}
+
+
+def test_configure_from_cfg_off_constructs_nothing(tmp_path):
+    cfg = {"metric": {"live": "off"}}
+    assert fleet.configure_from_cfg(cfg, role="main") is None
+    assert fleet.get_live() is None

@@ -1,0 +1,228 @@
+"""obs/metrics.py — the live metrics hub + declarative alert engine
+(ISSUE 15): flatten/derive units, ring bounds, Prometheus rendering, rule
+kinds + debounce, pack merge semantics, and the typed alert outputs."""
+
+import json
+
+import pytest
+
+from sheeprl_tpu.obs import flight
+from sheeprl_tpu.obs.metrics import (
+    ALERT_SCHEMA,
+    AlertEngine,
+    AlertRule,
+    MetricsHub,
+    default_alert_pack,
+    derive_keys,
+    flatten_record,
+    prometheus_name,
+)
+
+pytestmark = pytest.mark.live
+
+
+# --------------------------------------------------------------- flatten
+def test_flatten_numeric_and_text_leaves():
+    nums, text = flatten_record(
+        {
+            "step": 5,
+            "sps": 10.5,
+            "ok": True,
+            "name": "ppo",
+            "none": None,
+            "list": [1, 2],
+            "nested": {"a": {"b": 2}},
+            "bad": float("nan"),
+        }
+    )
+    assert nums == {"step": 5.0, "sps": 10.5, "ok": 1.0, "nested.a.b": 2.0}
+    assert text == {"name": "ppo"}
+
+
+def test_derived_keys_hbm_fraction_and_lag_p95():
+    d = derive_keys(
+        {
+            "hbm": {"bytes_in_use": 75, "bytes_limit": 100},
+            "transport": {"lag_hist": {"1": 90, "7": 10}},
+        }
+    )
+    assert d["hbm.used_frac"] == 0.75
+    assert d["transport.lag_p95"] == 7
+    # absent inputs derive nothing (CPU backends omit hbm entirely, v2)
+    assert derive_keys({"hbm": None}) == {}
+
+
+# ------------------------------------------------------------------- hub
+def test_hub_series_ring_is_bounded_and_latest_wins():
+    hub = MetricsHub(capacity=8, role="r")
+    for i in range(50):
+        hub.observe({"ts": float(i), "sps": float(i)})
+    assert hub.latest("sps") == 49.0
+    assert len(hub.series("sps")) == 8
+    assert hub.records_seen == 50
+    assert hub.last_record()["sps"] == 49.0
+
+
+def test_hub_prometheus_lines_are_valid_exposition():
+    hub = MetricsHub(role="lead")
+    hub.observe({"ts": 1.0, "sps": 12.5, "timers_s": {"Time/train_time": 0.25}})
+    text = "\n".join(hub.prometheus_lines())
+    assert '# TYPE sheeprl_sps gauge' in text
+    assert 'sheeprl_sps{role="lead"} 12.5' in text
+    # slashes sanitize into legal metric-name characters
+    assert 'sheeprl_timers_s_Time_train_time{role="lead"} 0.25' in text
+
+
+def test_prometheus_name_sanitization():
+    assert prometheus_name("a.b/c-d") == "sheeprl_a_b_c_d"
+    assert prometheus_name("9lives")[len("sheeprl_"):][0] == "_"
+
+
+# ------------------------------------------------------------ rule kinds
+def _obs(rule, record):
+    return rule.observe(record, ts=1.0)
+
+
+def test_threshold_rule_fires_and_resolves():
+    r = AlertRule("t", "threshold", "x", op=">", value=10)
+    assert _obs(r, {"x": 5}) is None
+    assert _obs(r, {"x": 11}) == "firing"
+    assert r.state == "firing"
+    assert _obs(r, {"x": 11}) is None  # no re-fire while firing
+    assert _obs(r, {"x": 3}) == "ok"
+    assert r.fires == 1 and r.resolves == 1
+
+
+def test_threshold_rule_on_strings():
+    r = AlertRule("b", "threshold", "serve.breaker", op="==", value="open")
+    assert _obs(r, {"serve": {"breaker": "closed"}}) is None
+    assert _obs(r, {"serve": {"breaker": "open"}}) == "firing"
+    assert _obs(r, {"serve": {"breaker": "half-open"}}) == "ok"
+
+
+def test_key_alternatives_first_present_wins():
+    r = AlertRule("t", "threshold", ["health.skips", "transport.health.skips"], op=">", value=0)
+    assert _obs(r, {"transport": {"health": {"skips": 2}}}) == "firing"
+
+
+def test_increase_rule_uses_trailing_window():
+    r = AlertRule("i", "increase", "skips", window=3)
+    for v in (0, 0, 0):
+        assert _obs(r, {"skips": v}) is None
+    assert _obs(r, {"skips": 2}) == "firing"  # grew within the window
+    # holds while the growth is still inside the window, then resolves
+    # once the whole window is flat again
+    assert _obs(r, {"skips": 2}) is None
+    assert _obs(r, {"skips": 2}) is None
+    assert _obs(r, {"skips": 2}) == "ok"
+    assert r.state == "ok"
+
+
+def test_drop_rule_needs_full_window_and_for_count():
+    r = AlertRule("d", "drop", "sps", window=4, drop_pct=30, **{"for": 2})
+    for _ in range(4):
+        assert _obs(r, {"sps": 100.0}) is None
+    # one bad sample is debounced (for=2) — a checkpoint stall can't fire
+    assert _obs(r, {"sps": 50.0}) is None
+    assert _obs(r, {"sps": 50.0}) == "firing"
+
+
+def test_absence_rule_counts_consecutive_missing():
+    r = AlertRule("a", "absence", "sps", **{"for": 2})
+    assert _obs(r, {"sps": 1}) is None
+    assert _obs(r, {}) is None
+    assert _obs(r, {}) == "firing"
+    assert _obs(r, {"sps": 1}) == "ok"
+
+
+def test_missing_key_idles_value_rules():
+    r = AlertRule("t", "threshold", "x", op=">", value=0, **{"for": 2})
+    assert _obs(r, {"x": 5}) is None
+    assert _obs(r, {}) is None  # not evaluable: streak holds, no decay
+    assert _obs(r, {"x": 5}) == "firing"
+
+
+def test_unknown_rule_fields_and_kinds_refused():
+    with pytest.raises(ValueError):
+        AlertRule("x", "nope", "k")
+    with pytest.raises(ValueError):
+        AlertRule("x", "threshold", "k", banana=1)
+
+
+# ---------------------------------------------------------------- engine
+def test_engine_emits_alert_records_and_fleet_events(tmp_path):
+    rec = flight.configure("tester", str(tmp_path), mode="full")
+    try:
+        eng = AlertEngine(role="tester")
+        out = eng.observe({"ts": 3.0, "step": 7, "compiles": {"post_warmup": 2}})
+        assert len(out) == 1
+        alert = out[0]
+        assert alert["schema"] == ALERT_SCHEMA
+        assert alert["rule"] == "post_warmup_recompile"
+        assert alert["state"] == "firing" and alert["step"] == 7
+        assert eng.active()[0]["rule"] == "post_warmup_recompile"
+        assert eng.stats()["firing"] == 1
+        rec.flush()
+        events = [
+            r
+            for r in (json.loads(l) for l in open(tmp_path / "tester.jsonl"))
+            if r.get("k") == "event" and r.get("name") == "alert"
+        ]
+        assert events and events[0]["a"]["rule"] == "post_warmup_recompile"
+    finally:
+        flight.close_recorder()
+
+
+def test_engine_rule_merge_override_and_disable():
+    eng = AlertEngine(
+        role="r",
+        extra_rules=[
+            {"name": "sps_drop", "enabled": False},
+            {"name": "hbm_high_water", "value": 0.5},
+            {"name": "custom_floor", "kind": "threshold", "key": "sps", "op": "<", "value": 1},
+        ],
+    )
+    names = {r.name for r in eng.rules}
+    assert "sps_drop" not in names
+    assert "custom_floor" in names
+    hbm = next(r for r in eng.rules if r.name == "hbm_high_water")
+    assert hbm.value == 0.5
+
+
+def test_engine_prometheus_alert_gauges():
+    eng = AlertEngine(role="r")
+    eng.observe({"ts": 1.0, "compiles": {"post_warmup": 1}})
+    text = "\n".join(eng.prometheus_lines())
+    assert 'sheeprl_alert_firing{role="r",rule="post_warmup_recompile",severity="warn"} 1' in text
+    assert 'sheeprl_alerts_fired_total{role="r"} 1' in text
+
+
+def test_default_pack_names_cover_the_issue_list():
+    names = {r["name"] for r in default_alert_pack()}
+    assert {
+        "post_warmup_recompile",
+        "sentinel_skip_streak",
+        "breaker_open",
+        "retrans_sustained",
+        "params_lag_p95",
+        "hbm_high_water",
+        "sps_drop",
+    } <= names
+
+
+def test_clean_telemetry_stream_fires_nothing():
+    """A steady healthy record stream must not fire a single default
+    rule (the zero-false-fires contract the chaos soak audits)."""
+    eng = AlertEngine(role="r")
+    for i in range(20):
+        fired = eng.observe(
+            {
+                "ts": float(i),
+                "step": i * 100,
+                "sps": 100.0 + (i % 3),  # benign jitter
+                "compiles": {"total": 4, "post_warmup": 0},
+                "health": {"skips": 0, "rollbacks": 0},
+                "transport": {"lag_hist": {"1": 5 + i}},
+            }
+        )
+        assert fired == [], fired

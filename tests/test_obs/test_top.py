@@ -1,0 +1,115 @@
+"""obs/top.py — the terminal dashboard (ISSUE 15): rendering from a
+status snapshot, endpoint discovery via announce files, and the post-hoc
+telemetry fallback."""
+
+import json
+import os
+
+import pytest
+
+from sheeprl_tpu.obs import fleet
+from sheeprl_tpu.obs.telemetry import make_record
+from sheeprl_tpu.obs.top import (
+    discover_status_url,
+    fetch_status,
+    main as top_main,
+    post_hoc_status,
+    render_status,
+)
+
+pytestmark = pytest.mark.live
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    fleet.close_live()
+    yield
+    fleet.close_live()
+
+
+def _status():
+    return {
+        "schema": "sheeprl.status/1",
+        "role": "player0",
+        "step": 4096,
+        "sps": 123.4,
+        "uptime_s": 12.0,
+        "record": {
+            "ts": 0.0,
+            "step": 4096,
+            "sps": 123.4,
+            "compiles": {"total": 4, "post_warmup": 0},
+            "host_rss_mb": 512.0,
+            "transport": {
+                "live": 2,
+                "num_players": 2,
+                "deaths": 0,
+                "rejoins": 0,
+                "fan_in_depth": 1,
+                "bytes_per_s": 1000.0,
+                "players": {
+                    "0": {"sps": 60.0, "frames": 10, "depth": 0, "alive": True},
+                    "1": {"sps": 61.5, "frames": 10, "depth": 1, "alive": True},
+                },
+                "fleet": {"1": {"sps": 1500.0, "rss_mb": 256.0}},
+                "serve": {"state": "serving", "requests": 42, "queue_depth": 0,
+                          "latency_ms": {"p50": 1.5, "p95": 3.0}},
+            },
+            "replay": {"inserts": 999, "limiter": {"spi_observed": 3.9, "spi_target": 4.0,
+                                                   "insert_stalls": 2}},
+            "health": {"updates": 10, "skips": 1, "rollbacks": 0, "last_ok": True},
+        },
+        "fleet": {},
+        "alerts": {
+            "rules": 7,
+            "firing": 1,
+            "fires_total": 1,
+            "active": [{"rule": "sentinel_skip_streak", "severity": "crit", "value": 1}],
+        },
+    }
+
+
+def test_render_status_contains_every_section():
+    frame = render_status(_status())
+    assert "role player0" in frame and "4,096" in frame
+    # the fleet table carries both players' throughput
+    assert "60.0" in frame and "61.5" in frame and "1,500.0" in frame
+    assert "serve" in frame and "p95 3.0 ms" in frame
+    assert "replay" in frame and "3.9" in frame
+    assert "health" in frame and "skips 1" in frame
+    assert "sentinel_skip_streak" in frame
+
+
+@pytest.mark.network
+def test_discovery_and_once_frame_against_a_live_endpoint(tmp_path, capsys):
+    plane = fleet.configure("player0", announce_dir=str(tmp_path / "run" / "live"))
+    plane.observe(make_record(step=7, train_step=1, sps=9.0))
+    url = discover_status_url(str(tmp_path))
+    assert url and url.endswith("/status")
+    status = fetch_status(url)
+    assert status["role"] == "player0"
+    rc = top_main([str(tmp_path), "--once"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "role player0" in out
+
+
+def test_post_hoc_fallback_reads_last_telemetry(tmp_path):
+    run_dir = tmp_path / "run" / "v0"
+    os.makedirs(run_dir)
+    with open(run_dir / "telemetry.jsonl", "w") as f:
+        f.write(json.dumps(make_record(step=1, train_step=0, sps=5.0)) + "\n")
+        f.write(json.dumps(make_record(step=2, train_step=1, sps=6.0)) + "\n")
+        # an interleaved alert record must not become "the last record"
+        f.write(json.dumps({"schema": "sheeprl.alert/1", "rule": "x", "state": "firing"}) + "\n")
+    status = post_hoc_status(str(tmp_path))
+    assert status["post_hoc"] is True
+    assert status["record"]["sps"] == 6.0
+    frame = render_status(status)
+    assert "post-hoc" in frame
+
+
+def test_discovery_none_when_nothing_announced(tmp_path):
+    assert discover_status_url(str(tmp_path)) is None
+    assert post_hoc_status(str(tmp_path)) is None
+    assert top_main([str(tmp_path), "--once"]) == 1

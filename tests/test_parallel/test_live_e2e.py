@@ -1,0 +1,201 @@
+"""ISSUE 15 acceptance: ONE N=2 decoupled tcp run with the live metrics
+plane on (`metric.live=on`, ephemeral ports) and `nan_inject` armed must
+show, WHILE RUNNING, a lead `/status` JSON carrying BOTH players'
+throughput (fan-in sps + piggybacked self-reported summaries) and a
+`/metrics` body that parses as valid Prometheus text exposition — and,
+post-run, exactly the `sentinel_skip_streak` alert rule fired (typed
+fleet events in flight/, `sheeprl.alert/1` records in telemetry).
+
+The run is a subprocess so the parent can poll the endpoints mid-run;
+one run feeds every assertion (tier-1 has ~1 minute of budget headroom,
+not three)."""
+
+import glob
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from sheeprl_tpu.obs.reader import read_alerts, read_flight
+
+pytestmark = [pytest.mark.live, pytest.mark.network]
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_METRIC_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*"  # metric name
+    r"(\{[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"\\]*\"(,[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"\\]*\")*\})?"
+    r" [-+]?(?:[0-9]*\.?[0-9]+(?:[eE][-+]?[0-9]+)?|NaN|Inf|-Inf)"  # value
+    r"( [0-9]+)?$"  # optional timestamp
+)
+
+
+def assert_prometheus_exposition(body: str) -> int:
+    """Every non-comment line must match the text exposition 0.0.4 sample
+    grammar; every sample's metric name must have a preceding # TYPE."""
+    typed = set()
+    samples = 0
+    for line in body.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            parts = line.split()
+            assert parts[1] in ("TYPE", "HELP"), f"bad comment line: {line!r}"
+            if parts[1] == "TYPE":
+                assert parts[3] in ("gauge", "counter", "histogram", "summary"), line
+                typed.add(parts[2])
+            continue
+        assert _METRIC_LINE.match(line), f"invalid exposition line: {line!r}"
+        name = re.split(r"[{ ]", line, 1)[0]
+        assert name in typed, f"sample {name!r} missing its # TYPE line"
+        samples += 1
+    return samples
+
+
+def _fetch(url, timeout=3.0):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read().decode()
+
+
+@pytest.fixture(scope="module")
+def live_run(tmp_path_factory):
+    tmp_path = tmp_path_factory.mktemp("live_e2e")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("SHEEPRL_FAULTS", None)
+    env["SHEEPRL_FAULTS"] = "nan_inject:12:3"
+    proc = subprocess.Popen(
+        [
+            sys.executable,
+            "sheeprl.py",
+            "exp=ppo_decoupled",
+            "env=dummy",
+            "env.sync_env=True",
+            "env.capture_video=False",
+            "fabric.accelerator=cpu",
+            "fabric.devices=1",
+            "metric.log_level=1",
+            "metric.log_every=64",
+            f"metric.logger.root_dir={tmp_path}/logs",
+            "metric.live=on",  # ephemeral ports; discovery via live/*.json
+            "metric.tracing=sampled",
+            "checkpoint.save_last=True",
+            "checkpoint.every=128",
+            "buffer.memmap=False",
+            "seed=7",
+            "algo.per_rank_batch_size=4",
+            "algo.dense_units=8",
+            "algo.mlp_layers=1",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.total_steps=1024",
+            "algo.rollout_steps=4",
+            "algo.num_players=2",
+            "algo.decoupled_transport=tcp",
+            "algo.update_epochs=1",
+            "algo.run_test=False",
+            "algo.sentinel.enabled=True",
+            "algo.sentinel.warmup=6",
+            "algo.sentinel.skip_budget=3",
+            "algo.sentinel.good_after=4",
+            "env.num_envs=4",
+            f"root_dir={tmp_path}/run",
+        ],
+        cwd=_REPO_ROOT,
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+
+    # ---- mid-run: discover the LEAD's endpoint off its announce file
+    lead_url = None
+    deadline = time.monotonic() + 300
+    while time.monotonic() < deadline and lead_url is None:
+        assert proc.poll() is None, f"run died early:\n{proc.stdout.read()[-3000:]}"
+        for path in glob.glob(f"{tmp_path}/run/**/live/player0.json", recursive=True):
+            try:
+                lead_url = json.load(open(path))["url"]
+            except (OSError, ValueError, KeyError):
+                pass
+        time.sleep(0.2)
+    assert lead_url, "lead never announced its live endpoint"
+
+    # ---- poll /status until the fleet view shows BOTH players (the
+    # run is short — a finished process just ends the polling window)
+    status = metrics_body = last_candidate = None
+    while time.monotonic() < deadline and proc.poll() is None:
+        try:
+            candidate = json.loads(_fetch(lead_url + "/status", timeout=1.0))
+        except Exception:
+            time.sleep(0.1)
+            continue
+        last_candidate = candidate
+        players = (candidate.get("record") or {}).get("transport", {}).get("players", {})
+        fleet = (candidate.get("record") or {}).get("transport", {}).get("fleet", {})
+        if (
+            {"0", "1"} <= set(players)
+            and all(players[p].get("sps") for p in ("0", "1"))
+            and {"0", "1"} <= set(fleet)
+        ):
+            status = candidate
+            metrics_body = _fetch(lead_url + "/metrics", timeout=2.0)
+            break
+        time.sleep(0.1)
+    out, _ = proc.communicate(timeout=600)
+    assert proc.returncode == 0, f"rc={proc.returncode}\n{out[-3000:]}"
+    assert status is not None, (
+        f"lead /status never showed both players; last snapshot:\n"
+        f"{json.dumps(last_candidate)[:2000]}\n{out[-2000:]}"
+    )
+    return {"root": str(tmp_path), "status": status, "metrics": metrics_body, "out": out}
+
+
+def test_lead_status_shows_both_players_throughput(live_run):
+    status = live_run["status"]
+    tr = status["record"]["transport"]
+    # the fan-in's per-player sps (computed from frames the trainer saw)
+    for pid in ("0", "1"):
+        assert tr["players"][pid]["sps"] > 0, tr["players"]
+    # the piggybacked self-reported summaries (no new connections): both
+    # players' own step/sps dicts rode the data frames to the trainer and
+    # the params broadcast back to the lead
+    for pid in ("0", "1"):
+        assert tr["fleet"][pid]["role"] == f"player{pid}"
+        assert tr["fleet"][pid].get("sps", 0) > 0, tr["fleet"]
+    # the status schema carries the alert plane
+    assert status["schema"] == "sheeprl.status/1"
+    assert status["alerts"]["rules"] >= 7
+
+
+def test_metrics_endpoint_is_valid_prometheus_exposition(live_run):
+    samples = assert_prometheus_exposition(live_run["metrics"])
+    assert samples >= 10, f"suspiciously few samples ({samples})"
+    body = live_run["metrics"]
+    assert 'sheeprl_sps{role="player0"}' in body
+    assert 'sheeprl_alert_firing{role="player0",rule="sentinel_skip_streak"' in body
+
+
+def test_nan_inject_fires_exactly_the_sentinel_skip_rule(live_run):
+    root = f"{live_run['root']}/run"
+    # typed alert fleet events in the flight streams
+    fired = sorted(
+        {
+            (r.get("a") or {}).get("rule")
+            for r in read_flight(root)
+            if r.get("k") == "event"
+            and r.get("name") == "alert"
+            and (r.get("a") or {}).get("state") == "firing"
+        }
+    )
+    assert fired == ["sentinel_skip_streak"], fired
+    # and the lead's telemetry stream carries the same timeline as
+    # sheeprl.alert/1 records (post-hoc view == live view)
+    tel = [(a["rule"], a["state"]) for a in read_alerts(root)]
+    assert ("sentinel_skip_streak", "firing") in tel, tel
+    rules = {r for r, _ in tel}
+    assert rules == {"sentinel_skip_streak"}, rules

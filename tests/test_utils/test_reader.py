@@ -91,3 +91,44 @@ def test_flight_glob(tmp_path):
     ]
     roles = {r["role"] for r in read_flight(str(tmp_path))}
     assert roles == {"trainer", "player0"}
+
+
+# ----------------------------------------------- ISSUE 15: record kinds
+def test_record_kind_routes_known_and_unknown_schemas():
+    from sheeprl_tpu.obs.reader import record_kind
+
+    assert record_kind({"schema": "sheeprl.telemetry/2"}) == "telemetry"
+    assert record_kind({"schema": "sheeprl.telemetry/1"}) == "telemetry"
+    assert record_kind({"schema": "sheeprl.alert/1"}) == "alert"
+    assert record_kind({"schema": "sheeprl.future_thing/9"}) == "future_thing"
+    assert record_kind({"no": "schema"}) == "unversioned"
+    assert record_kind("junk") == "unversioned"
+
+
+def test_old_readers_skip_interleaved_record_types(tmp_path):
+    """The v2 stream interleaves alert records (and may grow more kinds):
+    every pre-15 reader entry point must shrug — iterate them as plain
+    dicts, skip them in key collection, and never raise."""
+    from sheeprl_tpu.obs.reader import read_alerts
+
+    run = tmp_path / "v0"
+    rows = [
+        {"schema": "sheeprl.telemetry/2", "v": 2, "ts": 1.0, "step": 1, "sps": 10.0},
+        {"schema": "sheeprl.alert/1", "ts": 1.1, "rule": "sps_drop", "state": "firing"},
+        {"schema": "sheeprl.someday/3", "ts": 1.2, "mystery": True},
+        {"schema": "sheeprl.telemetry/2", "v": 2, "ts": 2.0, "step": 2, "sps": 11.0},
+        {"schema": "sheeprl.alert/1", "ts": 2.1, "rule": "sps_drop", "state": "ok"},
+    ]
+    _write(str(run / "telemetry.jsonl"), [json.dumps(r) for r in rows])
+
+    # the un-filtered iterator yields every row (back-compat)
+    assert len(list(iter_run_records(str(tmp_path)))) == 5
+    # kind filtering drops the non-telemetry rows
+    tele = list(iter_run_records(str(tmp_path), kinds=("telemetry",)))
+    assert [r["step"] for r in tele] == [1, 2]
+    # key collection over a mixed stream skips key-less rows (old
+    # consumers: the chaos audits, bench harvesters)
+    assert collect_key(str(tmp_path), "sps") == [10.0, 11.0]
+    # and the new alert accessor sees exactly the alert timeline
+    alerts = read_alerts(str(tmp_path))
+    assert [(a["rule"], a["state"]) for a in alerts] == [("sps_drop", "firing"), ("sps_drop", "ok")]

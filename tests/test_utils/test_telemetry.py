@@ -43,9 +43,14 @@ def test_validate_record_catches_problems():
 
 
 def test_schema_covers_issue_fields():
-    """The acceptance criteria name step/sps/HBM/compile-count records."""
-    for field in ("step", "sps", "hbm", "compiles", "timer_percentiles_s", "host_rss_mb"):
+    """The acceptance criteria name step/sps/HBM/compile-count records.
+    v2 (ISSUE 15): hbm moved to the optional set — backends that report
+    no memory stats OMIT the key instead of writing a null."""
+    from sheeprl_tpu.obs.telemetry import TELEMETRY_OPTIONAL_FIELDS
+
+    for field in ("step", "sps", "compiles", "timer_percentiles_s", "host_rss_mb"):
         assert field in TELEMETRY_REQUIRED_FIELDS
+    assert "hbm" in TELEMETRY_OPTIONAL_FIELDS
 
 
 def test_sink_append_and_read(tmp_path):
@@ -97,7 +102,7 @@ def test_records_carry_versioned_schema():
     from sheeprl_tpu.obs.telemetry import TELEMETRY_SCHEMA
 
     rec = _record()
-    assert rec["schema"] == TELEMETRY_SCHEMA == "sheeprl.telemetry/1"
+    assert rec["schema"] == TELEMETRY_SCHEMA == "sheeprl.telemetry/2"
     rec["schema"] = "sheeprl.telemetry/999"
     assert any("schema" in e for e in validate_record(rec))
 
@@ -152,3 +157,75 @@ def test_sink_flush_tolerates_closed_file(tmp_path):
     sink.write(_record())
     sink.close()
     sink.flush()  # closed: no-op, no raise
+
+
+# ----------------------------------------------- ISSUE 15 satellites
+def test_device_memory_stats_guards_none_and_junk_values():
+    """CPU/tunnel backends: memory_stats() may return None, {}, raise, or
+    report None-valued keys — the probe must yield None (the v2 record
+    then OMITS the hbm key) instead of leaking a null downstream."""
+    from sheeprl_tpu.obs.telemetry import device_memory_stats
+
+    class Dev:
+        def __init__(self, ret=None, raise_=False):
+            self._ret, self._raise = ret, raise_
+
+        def memory_stats(self):
+            if self._raise:
+                raise RuntimeError("unsupported")
+            return self._ret
+
+    assert device_memory_stats(Dev(None)) is None
+    assert device_memory_stats(Dev({})) is None
+    assert device_memory_stats(Dev(raise_=True)) is None
+    # a plugin reporting a None VALUE must not produce int(None)
+    assert device_memory_stats(Dev({"bytes_in_use": None})) is None
+    out = device_memory_stats(Dev({"bytes_in_use": 7, "bytes_limit": None, "junk": 1}))
+    assert out == {"bytes_in_use": 7}
+
+
+def test_record_omits_hbm_when_absent_and_validates():
+    rec = _record()
+    assert "hbm" not in rec  # no device handed in -> no key, not a null
+    assert validate_record(rec) == []
+    rec2 = _record(hbm={"bytes_in_use": 5})
+    assert rec2["hbm"] == {"bytes_in_use": 5}
+    assert validate_record(rec2) == []
+    rec2["hbm"] = "junk"
+    assert any("hbm" in e for e in validate_record(rec2))
+
+
+def test_rotation_boundary_with_tailing_reader(tmp_path):
+    """ISSUE 15 satellite: a reader tailing the stream while the sink
+    rotates mid-write must see NO dropped and NO duplicated records in
+    any scan that includes the backup generation."""
+    from sheeprl_tpu.obs.reader import iter_jsonl, telemetry_files
+
+    run_dir = tmp_path / "v0"
+    os.makedirs(run_dir)
+    path = str(run_dir / "telemetry.jsonl")
+    one_line = len(json.dumps(_record(), separators=(",", ":"))) + 1
+    # rotate every ~4 records; 10 writes => exactly one rotation boundary
+    # inside the window both generations still cover
+    sink = TelemetrySink(path, max_bytes=int(one_line * 4.5))
+    seen_scans = []
+    for i in range(10):
+        sink.write(_record(step=i))
+        # the tailing reader re-scans after EVERY write — including the
+        # writes that triggered the rename — through the same
+        # backup-aware file discovery the hub/report consumers use
+        steps = []
+        for f in telemetry_files(str(tmp_path), include_backups=True):
+            steps += [r["step"] for r in iter_jsonl(f)]
+        seen_scans.append(steps)
+    sink.close()
+    for scan in seen_scans:
+        # each scan is duplicate-free and a CONTIGUOUS tail-window of
+        # what had been written (single-generation rotation may age out
+        # the oldest records, never tear the middle)
+        assert len(scan) == len(set(scan)), f"duplicates across rotation: {scan}"
+        assert scan == sorted(scan), f"out-of-order read: {scan}"
+        assert scan == list(range(scan[0], scan[-1] + 1)), f"hole in scan: {scan}"
+    # the final scan ends at the last write and covers both generations
+    assert seen_scans[-1][-1] == 9
+    assert len(seen_scans[-1]) > 4
