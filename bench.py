@@ -70,9 +70,10 @@ loudly (stderr + exit 3).  Known-noisy metrics are exempt via the
 justified skip-list in ``benchmarks/bench_gate_skiplist.json``.
 
 Env overrides: BENCH_BUDGET_S, BENCH_SKIP_PPO/SAC/A2C/DV3/DEC/LOOP/FANIN/
-JAXENV, BENCH_PPO_STEPS, BENCH_SAC_STEPS, BENCH_A2C_STEPS, BENCH_DV3_STEPS,
-BENCH_FANIN_STEPS, BENCH_JAXENV_STEPS, BENCH_PLATFORM (cpu for local
-tests), BENCH_SKIP_GATE, BENCH_GATE_THRESHOLD (fraction, default 0.20).
+JAXENV/SUPERBENCH, BENCH_PPO_STEPS, BENCH_SAC_STEPS, BENCH_A2C_STEPS,
+BENCH_DV3_STEPS, BENCH_FANIN_STEPS, BENCH_JAXENV_STEPS, BENCH_SUPER_STEPS,
+BENCH_PLATFORM (cpu for local tests), BENCH_SKIP_GATE,
+BENCH_GATE_THRESHOLD (fraction, default 0.20).
 """
 
 import json
@@ -113,6 +114,7 @@ SECTIONS = [
     ("fanin", 140),
     ("transport", 240),
     ("mesh", 560),
+    ("superbench", 200),
 ]
 
 
@@ -249,6 +251,19 @@ def bench_a2c():
         n_warm,
         n_long,
     )
+    # paired A/B (ISSUE 16): the streaming time ledger's overhead on the
+    # SAME loop — metric.ledger is the only delta vs the telemetry leg,
+    # so the ratio isolates the span-stack pushes/pops + bucket banking.
+    rate_ledger, *_ = _cli_steady_rate(
+        [
+            "exp=a2c_benchmarks",
+            *tele,
+            "metric.ledger=on",
+            "root_dir=/tmp/sheeprl_tpu_bench/a2c_ledger",
+        ],
+        n_warm,
+        n_long,
+    )
     value = round(rate * FULL_STEPS, 2)
     return {
         "metric": "a2c_cartpole_benchmark_wallclock",
@@ -266,6 +281,9 @@ def bench_a2c():
         # 1-core box — the committed obs_live_r15.json holds the
         # interleaved min-of-N measurement the bound was proven with)
         "live_overhead_pct": round((rate_live / rate_tel - 1.0) * 100.0, 2),
+        "ledger_ms_per_step": round(rate_ledger * 1e3, 3),
+        # the ISSUE 16 <2% bound, same single-run-pair noise caveat
+        "ledger_overhead_pct": round((rate_ledger / rate_tel - 1.0) * 100.0, 2),
         "host_cpu_count": os.cpu_count(),
     }
 
@@ -593,6 +611,47 @@ def bench_mesh():
     }
 
 
+def bench_superbench():
+    """The composed fleet (ISSUE 16): jax-env players x2 -> tcp fan-in ->
+    dp8 mesh-sharded trainer, with flight spans, the live plane, and the
+    streaming time ledger all ON.  Headline is FLEET frames/s (gated:
+    higher is better); the line also names the run's ledger bottleneck so
+    rounds compare on what the fleet waited for, not just how fast it
+    went.  Dedicated subprocess for the same reason as mesh: the virtual
+    8-device mesh needs ``xla_force_host_platform_device_count`` exported
+    BEFORE backend init."""
+    import subprocess
+    import tempfile
+
+    out = os.path.join(tempfile.mkdtemp(prefix="sheeprl_bench_super_"), "super.json")
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["JAX_PLATFORMS"] = "cpu"
+    n_long = max(int(os.environ.get("BENCH_SUPER_STEPS", 1024)), 128)
+    n_warm = max(min(256, n_long // 2), 64)
+    subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmarks", "bench_superbench.py"),
+         "--warm", str(n_warm), "--steps", str(n_long), "--out", out],
+        check=True,
+        env=env,
+        timeout=540,
+    )
+    with open(out) as f:
+        data = json.load(f)
+    return {
+        "metric": "superbench_fleet_frames_per_s",
+        "value": data["fleet_frames_per_s"],
+        "unit": "frames/s",
+        "vs_baseline": None,
+        "bottleneck": data["bottleneck"],
+        "fleet_where_s": data["fleet_where_s"],
+        "roles_with_ledger": data["roles_with_ledger"],
+        "topology": data["topology"],
+        "measured_s": [data["warm_s"], data["long_s"]],
+        "host_cpu_count": os.cpu_count(),
+    }
+
+
 def bench_loop():
     """Replay-feed cost per gradient step at DV3-S shapes: host buffer
     sample + upload (what every gradient step paid before round 4's
@@ -915,6 +974,7 @@ def child_main(section, out_path):
         "fanin": bench_fanin,
         "transport": bench_transport,
         "mesh": bench_mesh,
+        "superbench": bench_superbench,
     }[section]()
     with open(out_path, "w") as f:
         json.dump(metric, f)
@@ -1053,6 +1113,21 @@ def main():
             )
             sys.stderr.flush()
             sys.exit(3)
+    # trend epilogue (ISSUE 16): cross-round headline table on STDERR
+    # (stdout is reserved for metric lines) — pure-stdlib script, shelled
+    # out so a bug in it can never corrupt the metric stream
+    try:
+        trend = subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts", "bench_trend.py")],
+            capture_output=True,
+            text=True,
+            timeout=30,
+        )
+        if trend.returncode == 0 and trend.stdout:
+            sys.stderr.write("\n" + trend.stdout)
+            sys.stderr.flush()
+    except (OSError, subprocess.SubprocessError):
+        pass
 
 
 if __name__ == "__main__":

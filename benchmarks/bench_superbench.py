@@ -1,0 +1,146 @@
+"""The first COMPOSED benchmark (ISSUE 16): every subsystem at once.
+
+One run wires the whole stack together — device-resident jax envs
+(``algo.env_backend=jax``) stepped inside each decoupled player, the
+N-player rollout fan-in over the socket transport, and a mesh-sharded
+trainer (``fabric.devices=8`` over the forced host-platform mesh) — with
+the full observability plane on: flight spans, the live metrics plane,
+and the streaming time ledger (``metric.ledger=on``).
+
+The headline is FLEET frames/s: total policy steps the fleet retires per
+steady-state wall-clock second, measured with the same warm/long
+differencing as bench.py's CLI protocols (the warm run pays compiles +
+process spawns; the extra steps of the long run are pure steady state).
+Alongside it rides the ledger's answer to "where did the time go": the
+per-role ``where`` breakdowns from the run's telemetry, summed into one
+fleet-level bucket table whose largest non-idle bucket is the NAMED
+bottleneck — recorded in the results JSON so rounds can be compared not
+just on how fast, but on what they were waiting for.
+
+Must run in its own interpreter with ``XLA_FLAGS=
+--xla_force_host_platform_device_count=8`` exported BEFORE backend init
+(bench.py's superbench section guarantees this).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# the time-ledger bucket names (obs/ledger.py BUCKETS, sans derived idle)
+_BUCKETS = ("compute", "transport", "params", "replay", "serve", "ckpt")
+
+
+def _overrides(root: str, run_name: str, steps: int) -> list:
+    return [
+        "exp=ppo_decoupled",
+        "env=jax_cartpole",
+        "algo.env_backend=jax",
+        # the fan-in env axis is what the dp8 mesh shards (ddp_gate on
+        # rewards.shape[1]) — keep it divisible by 8 so GSPMD shards the
+        # update for real instead of falling back to replication
+        "env.num_envs=8",
+        "env.capture_video=False",
+        "fabric.accelerator=cpu",
+        "fabric.devices=8",
+        "algo.num_players=2",
+        "algo.decoupled_transport=tcp",
+        "algo.rollout_steps=4",
+        "algo.update_epochs=1",
+        "algo.per_rank_batch_size=8",
+        "algo.dense_units=8",
+        "algo.mlp_layers=1",
+        "algo.mlp_keys.encoder=[state]",
+        "algo.run_test=False",
+        "metric.log_level=1",
+        "metric.log_every=64",
+        "metric.ledger=on",
+        "metric.live=on",
+        "checkpoint.every=100000",
+        "buffer.memmap=False",
+        "seed=3",
+        f"algo.total_steps={steps}",
+        f"root_dir={root}",
+        f"run_name={run_name}",
+    ]
+
+
+def fleet_where(root: str) -> dict:
+    """Sum the LAST ``where`` snapshot of every role found in the run's
+    telemetry into one fleet-level bucket table (ledger snapshots are
+    cumulative, so the last one per role covers that role's whole run)."""
+    per_role: dict = {}
+    for path in glob.glob(os.path.join(root, "**", "telemetry.jsonl"), recursive=True):
+        for line in open(path):
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            # a role's own snapshot, plus the trainer's breakdown that
+            # piggybacks to the lead player under transport/replay stats
+            candidates = [rec.get("where")]
+            for key in ("transport", "replay"):
+                sub = rec.get(key)
+                if isinstance(sub, dict):
+                    candidates.append(sub.get("where"))
+            for where in candidates:
+                if isinstance(where, dict) and where.get("role"):
+                    per_role[where["role"]] = where
+    fleet = {b: round(sum(float(w.get(b) or 0.0) for w in per_role.values()), 4) for b in _BUCKETS}
+    bottleneck = max(fleet, key=fleet.get) if any(fleet.values()) else None
+    return {"per_role": per_role, "fleet_s": fleet, "bottleneck": bottleneck}
+
+
+def run_superbench(n_warm: int, n_long: int, root: str) -> dict:
+    from sheeprl_tpu.cli import run
+
+    tic = time.perf_counter()
+    run(_overrides(root, "warm", n_warm))
+    t_warm = time.perf_counter() - tic
+    tic = time.perf_counter()
+    run(_overrides(root, "long", n_long))
+    t_long = time.perf_counter() - tic
+    # same conservative floor as bench.py: the extra steps cannot cost
+    # less than 20% of the long run's pro-rata share
+    steady = t_long - t_warm
+    floor = 0.2 * t_long * (n_long - n_warm) / n_long
+    if steady < floor:
+        steady = t_long * (n_long - n_warm) / n_long
+    frames_per_s = (n_long - n_warm) / max(steady, 1e-6)
+    where = fleet_where(os.path.join(root, "long"))
+    return {
+        "fleet_frames_per_s": round(frames_per_s, 1),
+        "bottleneck": where["bottleneck"],
+        "fleet_where_s": where["fleet_s"],
+        "roles_with_ledger": sorted(where["per_role"]),
+        "warm_s": round(t_warm, 2),
+        "long_s": round(t_long, 2),
+        "steps": [n_warm, n_long],
+        "topology": "jax-env players x2 -> tcp fan-in -> dp8 mesh trainer",
+        "host_cpu_count": os.cpu_count(),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--warm", type=int, default=256)
+    ap.add_argument("--steps", type=int, default=1024)
+    ap.add_argument("--root", default="/tmp/sheeprl_tpu_bench/superbench")
+    ap.add_argument("--out", required=True)
+    args = ap.parse_args()
+    result = run_superbench(args.warm, args.steps, args.root)
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=2)
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,147 @@
+"""Cross-round benchmark trend table — where is the perf line moving?
+
+Reads every committed ``BENCH_r*.json`` (the per-round benchmark capsules
+whose ``tail`` holds bench.py's JSON metric lines) and prints one row per
+headline metric with its value across rounds and a direction mark for the
+last hop: ``+`` improved, ``-`` regressed, ``=`` flat (<1% move), ``?``
+for metrics whose unit has no better-direction (same unit table as
+bench.py's perf gate).  A second section lists the one-off committed
+result files under ``benchmarks/results/*.json`` (proof-run artifacts
+like the round-16 superbench) with their top-level scalars.
+
+Pure stdlib on purpose: bench.py's parent process shells out to this as
+its epilogue (stderr only — stdout there is reserved for metric lines),
+and it must stay importable without jax.
+
+Usage: python scripts/bench_trend.py [--repo PATH] [--metric SUBSTR]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+_LOWER_IS_BETTER_UNITS = ("s", "ms")
+_HIGHER_IS_BETTER_UNITS = ("frames/s", "x", "steps/s")
+_FLAT_PCT = 1.0
+
+
+def load_rounds(repo: str):
+    """``[(round_name, {metric: {"value": .., "unit": ..}}), ...]`` oldest
+    first, parsed the same way as bench.py's gate (last occurrence of a
+    metric in the tail wins)."""
+    paths = sorted(
+        glob.glob(os.path.join(repo, "BENCH_r*.json")),
+        key=lambda p: int(re.search(r"r(\d+)", os.path.basename(p)).group(1)),
+    )
+    rounds = []
+    for path in paths:
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        metrics = {}
+        for line in str(doc.get("tail", "")).splitlines():
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if "metric" in rec and isinstance(rec.get("value"), (int, float)):
+                metrics[rec["metric"]] = {"value": float(rec["value"]), "unit": rec.get("unit")}
+        rounds.append((os.path.basename(path).replace("BENCH_", "").replace(".json", ""), metrics))
+    return rounds
+
+
+def _direction(unit: str):
+    if unit in _LOWER_IS_BETTER_UNITS:
+        return -1
+    if unit in _HIGHER_IS_BETTER_UNITS:
+        return +1
+    return 0
+
+
+def _mark(prev, cur, unit):
+    if prev is None or cur is None or not prev:
+        return " "
+    d = _direction(unit or "")
+    change_pct = (cur / prev - 1.0) * 100.0
+    if d == 0:
+        return "?"
+    if abs(change_pct) < _FLAT_PCT:
+        return "="
+    return "+" if (change_pct > 0) == (d > 0) else "-"
+
+
+def _fmt(v):
+    if v is None:
+        return "-"
+    return f"{v:g}" if abs(v) < 1e5 else f"{v:.3g}"
+
+
+def trend_table(rounds, metric_filter: str = "") -> str:
+    if not rounds:
+        return "no committed BENCH_r*.json rounds found\n"
+    names = []
+    for _, metrics in rounds:
+        for name in metrics:
+            if name not in names:
+                names.append(name)
+    if metric_filter:
+        names = [n for n in names if metric_filter in n]
+    heads = [r for r, _ in rounds]
+    width = max([len(n) for n in names] + [6]) if names else 6
+    out = ["bench trend (last-hop mark: + better, - worse, = flat, ? no direction)"]
+    out.append("  " + "metric".ljust(width) + "  unit      " + "  ".join(h.rjust(9) for h in heads))
+    for name in names:
+        vals = [m.get(name, {}).get("value") for _, m in rounds]
+        unit = next((m[name].get("unit") for _, m in rounds if name in m), "") or ""
+        prev = next((v for v in reversed(vals[:-1]) if v is not None), None)
+        mark = _mark(prev, vals[-1], unit) if len(vals) > 1 else " "
+        cells = "  ".join(_fmt(v).rjust(9) for v in vals)
+        out.append(f"{mark} {name.ljust(width)}  {unit.ljust(8)}  {cells}")
+    return "\n".join(out) + "\n"
+
+
+def results_table(repo: str) -> str:
+    paths = sorted(glob.glob(os.path.join(repo, "benchmarks", "results", "*.json")))
+    rows = []
+    for path in paths:
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if not isinstance(doc, dict):
+            continue
+        scalars = {k: v for k, v in doc.items() if isinstance(v, (int, float)) and not isinstance(v, bool)}
+        if not scalars:
+            continue
+        head = ", ".join(f"{k}={_fmt(float(v))}" for k, v in list(scalars.items())[:4])
+        rows.append(f"  {os.path.basename(path)}: {head}")
+    if not rows:
+        return ""
+    return "committed one-off results (benchmarks/results/):\n" + "\n".join(rows) + "\n"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--repo", default=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    ap.add_argument("--metric", default="", help="substring filter on metric names")
+    args = ap.parse_args(argv)
+    sys.stdout.write(trend_table(load_rounds(args.repo), args.metric))
+    extra = results_table(args.repo)
+    if extra:
+        sys.stdout.write("\n" + extra)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -23,7 +23,7 @@ from sheeprl_tpu.algos.ppo.ppo import _set_lr, build_ppo_optimizer
 from sheeprl_tpu.algos.ppo.utils import normalize_obs, prepare_obs, test
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
-from sheeprl_tpu.obs import setup_observability, trace_scope
+from sheeprl_tpu.obs import flight, setup_observability, trace_scope
 from sheeprl_tpu.parallel.pipeline import OnPolicyCollector, PipelinedCollector, detach_copy, resolve_overlap_setting
 from sheeprl_tpu.resilience import CheckpointManager
 from sheeprl_tpu.resilience.sentinel import guard_update, restore_like
@@ -327,7 +327,9 @@ def main(runtime, cfg: Dict[str, Any]):
         payload.apply_events(aggregator, runtime, cfg.metric.log_level)
         policy_step = payload.policy_step_end
 
-        with timer("Time/train_time", SumMetric, sync_on_compute=cfg.metric.sync_on_compute):
+        with timer("Time/train_time", SumMetric, sync_on_compute=cfg.metric.sync_on_compute), flight.span(
+            "train_step", round=iter_num
+        ):
             params, opt_state, train_metrics = update_fn(
                 params, opt_state, payload.data, payload.next_obs, runtime.next_key(), jnp.float32(current_lr)
             )

@@ -52,6 +52,7 @@ from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.obs import fleet as obs_fleet
 from sheeprl_tpu.obs import flight, setup_observability, trace_scope
+from sheeprl_tpu.obs import ledger as obs_ledger
 from sheeprl_tpu.parallel.transport import (
     FanIn,
     HeartbeatSender,
@@ -73,7 +74,7 @@ from sheeprl_tpu.resilience import (
     restore_like,
 )
 from sheeprl_tpu.utils.callback import load_checkpoint
-from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.utils.env import make_env, resolve_env_backend
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.registry import register_algorithm
@@ -216,6 +217,9 @@ def _player_loop(
     # /metrics + /status and piggybacks a compact summary on the data
     # frames it already ships (the lead's /status shows the whole fleet)
     live = obs_fleet.configure_from_cfg(cfg, role=f"player{player_id}")
+    # time ledger (ISSUE 16): this player's wall-clock decomposition,
+    # fed by the same span call sites the flight recorder uses
+    obs_ledger.configure_from_cfg(cfg, role=f"player{player_id}")
 
     runtime = MeshRuntime(devices=1, accelerator="cpu", precision=cfg.fabric.precision)
     runtime.launch()
@@ -235,15 +239,30 @@ def _player_loop(
         logger.log_hyperparams(cfg)
 
     total_envs = int(cfg.env.num_envs)
-    thunks = [
-        make_env(cfg, cfg.seed + env_offset + i, 0, log_dir, "train", vector_env_idx=env_offset + i)
-        for i in range(n_local_envs)
-    ]
-    envs = (
-        SyncVectorEnv(thunks, autoreset_mode=AutoresetMode.SAME_STEP)
-        if cfg.env.sync_env
-        else AsyncVectorEnv(thunks, context="spawn", autoreset_mode=AutoresetMode.SAME_STEP)
-    )
+    if resolve_env_backend(cfg) == "jax":
+        # device-resident envs behind the same gymnasium vector API: the
+        # composed-fleet topology (ISSUE 16 superbench) — jax players ×
+        # fan-in × sharded trainer.  Each player owns its env shard.
+        from sheeprl_tpu.envs.jax import JaxVectorEnv
+        from sheeprl_tpu.utils.env import make_jax_env_from_cfg
+
+        max_steps = cfg.env.max_episode_steps if cfg.env.get("max_episode_steps") else None
+        envs = JaxVectorEnv(
+            make_jax_env_from_cfg(cfg),
+            n_local_envs,
+            seed=cfg.seed + env_offset,
+            max_episode_steps=max_steps,
+        )
+    else:
+        thunks = [
+            make_env(cfg, cfg.seed + env_offset + i, 0, log_dir, "train", vector_env_idx=env_offset + i)
+            for i in range(n_local_envs)
+        ]
+        envs = (
+            SyncVectorEnv(thunks, autoreset_mode=AutoresetMode.SAME_STEP)
+            if cfg.env.sync_env
+            else AsyncVectorEnv(thunks, context="spawn", autoreset_mode=AutoresetMode.SAME_STEP)
+        )
     observation_space = envs.single_observation_space
     if not isinstance(observation_space, gym.spaces.Dict):
         raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
@@ -579,11 +598,13 @@ def _player_loop(
             (f"o/{k}", np.asarray(next_obs_np[k])) for k in obs_keys
         ]
         try:
-            with trace_scope("ipc_send_shard"):
+            with trace_scope("ipc_send_shard"), flight.span("data_send", round=iter_num):
                 # extra carries the BEHAVIOR-policy version this shard
                 # acted with (the trainer's V-trace correction + lag
                 # telemetry key off it) and, when the live plane is on,
-                # this player's compact metrics summary (ISSUE 15)
+                # this player's compact metrics summary (ISSUE 15).
+                # data_send feeds the ledger's transport bucket — credit
+                # stalls on a slow trainer surface here.
                 channel.send(
                     "data",
                     arrays=arrays,
@@ -818,6 +839,7 @@ def main(runtime, cfg: Dict[str, Any]):
     knobs = decoupled_knobs(cfg)
     flight.configure_from_cfg(cfg, role="trainer")
     live = obs_fleet.configure_from_cfg(cfg, role="trainer")
+    trainer_ledger = obs_ledger.configure_from_cfg(cfg, role="trainer")
 
     state = None
     if cfg.checkpoint.resume_from:
@@ -1199,17 +1221,23 @@ def main(runtime, cfg: Dict[str, Any]):
                 from sheeprl_tpu.resilience.integrity import integrity_stats
 
                 stats["integrity"] = integrity_stats().as_dict()
+            if trainer_ledger is not None:
+                # piggyback the trainer's time breakdown on the stats the
+                # lead already logs: post-hoc readers get transport.where
+                # without a trainer-side telemetry file
+                stats["where"] = trainer_ledger.snapshot()
             if live is not None:
                 # the trainer's own live plane: /status + alert rules see
                 # the fleet view every round (the transport key is where
                 # the health/lag/integrity/fleet stats live)
-                live.observe(
-                    {
-                        "ts": time.time(),
-                        "step": iter_num * policy_steps_per_iter,
-                        "transport": stats,
-                    }
-                )
+                trainer_record = {
+                    "ts": time.time(),
+                    "step": iter_num * policy_steps_per_iter,
+                    "transport": stats,
+                }
+                if trainer_ledger is not None:
+                    trainer_record["where"] = trainer_ledger.snapshot()
+                live.observe(trainer_record)
             bcast_arrays = _flat_leaves(_np_tree(params))
             bcast_digest = _params_digest(bcast_arrays)
             fanin.broadcast(

@@ -49,6 +49,7 @@ from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.obs import fleet as obs_fleet
 from sheeprl_tpu.obs import flight, setup_observability, trace_scope
+from sheeprl_tpu.obs import ledger as obs_ledger
 from sheeprl_tpu.parallel.transport import (
     FanIn,
     HeartbeatSender,
@@ -132,6 +133,7 @@ def _player_loop(
 
     flight.configure_from_cfg(cfg, role=f"player{player_id}")
     live = obs_fleet.configure_from_cfg(cfg, role=f"player{player_id}")
+    obs_ledger.configure_from_cfg(cfg, role=f"player{player_id}")
     runtime = MeshRuntime(devices=1, accelerator="cpu", precision=cfg.fabric.precision)
     runtime.launch()
     runtime.seed_everything(cfg.seed + player_id)
@@ -373,7 +375,9 @@ def _player_loop(
         hard_exit_point("player_exit", index=player_id)  # fault site: a player crash
         policy_step += policy_steps_per_iter
 
-        with timer("Time/env_interaction_time", SumMetric, sync_on_compute=False):
+        with timer("Time/env_interaction_time", SumMetric, sync_on_compute=False), flight.span(
+            "collect", round=iter_num
+        ):
             if iter_num <= learning_starts:
                 actions = envs.action_space.sample()
             else:
@@ -425,7 +429,7 @@ def _player_loop(
                 )
                 sample = [(k, np.asarray(v)) for k, v in sample.items()]
                 try:
-                    with trace_scope("ipc_send_shard"):
+                    with trace_scope("ipc_send_shard"), flight.span("data_send", round=update_round):
                         # slot 2: this player's live-metrics summary
                         # (ISSUE 15) — None when the plane is off
                         channel.send(
@@ -602,6 +606,7 @@ def _player_loop_remote(
 
     flight.configure_from_cfg(cfg, role=f"player{player_id}")
     live = obs_fleet.configure_from_cfg(cfg, role=f"player{player_id}")
+    obs_ledger.configure_from_cfg(cfg, role=f"player{player_id}")
     runtime = MeshRuntime(devices=1, accelerator="cpu", precision=cfg.fabric.precision)
     runtime.launch()
     runtime.seed_everything(cfg.seed + player_id)
@@ -826,7 +831,9 @@ def _player_loop_remote(
         hard_exit_point("player_exit", index=player_id)  # fault site: a player crash
         policy_step += policy_steps_per_iter
 
-        with timer("Time/env_interaction_time", SumMetric, sync_on_compute=False):
+        with timer("Time/env_interaction_time", SumMetric, sync_on_compute=False), flight.span(
+            "collect", round=iter_num
+        ):
             if iter_num <= learning_starts:
                 actions = envs.action_space.sample()
             else:
@@ -864,7 +871,7 @@ def _player_loop_remote(
 
         # ------------------------------------------ insert (credit-gated)
         try:
-            with trace_scope("replay_insert"):
+            with trace_scope("replay_insert"), flight.span("data_send", round=iter_num):
                 writer.append(
                     dict(step_data),
                     timeout=timeout_s,
@@ -989,6 +996,7 @@ def main(runtime, cfg: Dict[str, Any]):
     knobs = decoupled_knobs(cfg)
     flight.configure_from_cfg(cfg, role="trainer")
     obs_fleet.configure_from_cfg(cfg, role="trainer")
+    obs_ledger.configure_from_cfg(cfg, role="trainer")
 
     if "minedojo" in str(cfg.env.wrapper.get("_target_", "")).lower():
         raise ValueError("MineDojo is not supported by the SAC agent")
@@ -1256,11 +1264,21 @@ def main(runtime, cfg: Dict[str, Any]):
                 from sheeprl_tpu.resilience.integrity import integrity_stats
 
                 stats["integrity"] = integrity_stats().as_dict()
+            led = obs_ledger.get_ledger()
+            if led is not None:
+                # piggyback the trainer's time breakdown on the stats the
+                # lead already logs (reaches telemetry as transport.where)
+                stats["where"] = led.snapshot()
             live = obs_fleet.get_live()
             if live is not None:
-                live.observe(
-                    {"ts": time.time(), "step": int(iter_num) * int(cfg.env.num_envs), "transport": stats}
-                )
+                trainer_record = {
+                    "ts": time.time(),
+                    "step": int(iter_num) * int(cfg.env.num_envs),
+                    "transport": stats,
+                }
+                if led is not None:
+                    trainer_record["where"] = led.snapshot()
+                live.observe(trainer_record)
             bcast_arrays = _flat_leaves(_np_tree(params["actor"]))
             bcast_digest = _params_digest(bcast_arrays)
             fanin.broadcast(
@@ -1616,12 +1634,18 @@ def _main_remote(runtime, cfg: Dict[str, Any], knobs, state, counters, ratio_sta
                 from sheeprl_tpu.resilience.integrity import integrity_stats
 
                 stats["integrity"] = integrity_stats().as_dict()
+            led = obs_ledger.get_ledger()
+            if led is not None:
+                stats["where"] = led.snapshot()
             live = obs_fleet.get_live()
             if live is not None:
                 # the remote-replay lead files these under "replay", so
                 # the trainer's plane observes the same spelling (one
                 # alert-rule key covers both processes)
-                live.observe({"ts": time.time(), "step": int(clock), "replay": stats})
+                trainer_record = {"ts": time.time(), "step": int(clock), "replay": stats}
+                if led is not None:
+                    trainer_record["where"] = led.snapshot()
+                live.observe(trainer_record)
             _broadcast_params(
                 update_round,
                 lambda pid: (last_metrics, stats if pid == 0 else None),

@@ -24,8 +24,9 @@ import os
 import time
 from typing import Any, Dict, Optional
 
-from sheeprl_tpu.obs import fleet, flight
+from sheeprl_tpu.obs import fleet, flight, ledger
 from sheeprl_tpu.obs.flight import FlightRecorder, fleet_event, tracing_setting
+from sheeprl_tpu.obs.ledger import TimeLedger, ledger_setting
 from sheeprl_tpu.obs.telemetry import (
     TelemetrySink,
     device_memory_stats,
@@ -43,7 +44,10 @@ __all__ = [
     "fleet",
     "fleet_event",
     "flight",
+    "ledger",
+    "ledger_setting",
     "setup_observability",
+    "TimeLedger",
     "trace_scope",
     "tracing_setting",
     "start_trace",
@@ -181,6 +185,15 @@ class Observability:
                 extra = {**(extra or {}), "mesh": self.mesh_stats()}
             except Exception:
                 pass
+        led = ledger.get_ledger()
+        if led is not None:
+            # the streaming time ledger's breakdown rides every record
+            # under "where" (ISSUE 16) — derived at record time, no
+            # post-hoc pass over the flight stream
+            try:
+                extra = {**(extra or {}), "where": led.snapshot()}
+            except Exception:
+                pass
         recorder = flight.get_recorder()
         if recorder is not None:
             # flight-recorder counters ride the telemetry under "trace",
@@ -262,6 +275,8 @@ class Observability:
         # sequential in-process run (bench legs, chaos soak) must not
         # inherit the previous run's hub/alert state or endpoint
         fleet.close_live()
+        # same for the time ledger — its window must open per run
+        ledger.close_ledger()
 
 
 def setup_observability(runtime, cfg, log_dir: Optional[str], logger: Any = None) -> Observability:
@@ -275,6 +290,11 @@ def setup_observability(runtime, cfg, log_dir: Optional[str], logger: Any = None
     # /status endpoint when this process owns no telemetry sink.
     if runtime.is_global_zero and fleet.get_live() is None and fleet.live_setting(cfg):
         fleet.configure_from_cfg(cfg, role="main")
+    # time ledger (ISSUE 16): same first-configure-sticks pattern — the
+    # decoupled roles install theirs before reaching this call.  Every
+    # rank ledgers itself (cheap, in-memory, no endpoint).
+    if ledger.get_ledger() is None and ledger.ledger_setting(cfg):
+        ledger.configure(role="main" if runtime.is_global_zero else f"rank{getattr(runtime, 'global_rank', 0)}")
     enabled = (
         runtime.is_global_zero
         and log_dir is not None

@@ -43,7 +43,14 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional
 
-from sheeprl_tpu.obs.metrics import ALERT_SCHEMA, AlertEngine, MetricsHub
+from sheeprl_tpu.obs import ledger as _ledger
+from sheeprl_tpu.obs.metrics import (
+    ALERT_SCHEMA,
+    AlertEngine,
+    MetricsHub,
+    SLOTracker,
+    slo_burn_rules,
+)
 from sheeprl_tpu.obs.telemetry import TelemetrySink, host_rss_mb
 
 STATUS_SCHEMA = "sheeprl.status/1"
@@ -174,11 +181,20 @@ class LivePlane:
         port: int = 0,
         alerts: bool = True,
         extra_rules=(),
+        slos=(),
         announce_dir: Optional[str] = None,
         serve: bool = True,
     ):
         self.role = str(role)
         self.hub = MetricsHub(capacity=history, role=self.role)
+        # SLO tracker (ISSUE 16): evaluated on every record BEFORE the
+        # alert engine so the generated budget_burn rules see the fresh
+        # slo.<name>.burn gauges in the same observation
+        self.slos = SLOTracker(extra_slos=slos)
+        if alerts:
+            # user extra_rules come LAST so a metric.alert_rules entry
+            # can still override/disable a generated burn rule by name
+            extra_rules = list(slo_burn_rules(self.slos.slos)) + list(extra_rules or ())
         self.alerts: Optional[AlertEngine] = (
             AlertEngine(role=self.role, extra_rules=extra_rules) if alerts else None
         )
@@ -220,6 +236,9 @@ class LivePlane:
         alert records for any state transitions (the tee-ing sink appends
         them to the telemetry stream; sink-less roles drop them — the
         fleet event + stderr line already happened)."""
+        section = self.slos.observe(record)
+        if section:
+            record = {**record, "slo": section}
         self.hub.observe(record)
         if self.alerts is None:
             return []
@@ -309,6 +328,11 @@ class LivePlane:
                 "active": self.alerts.active(),
                 "detail": self.alerts.as_dicts(),
             }
+        out["slos"] = self.slos.as_dicts()
+        # this role's time ledger, when metric.ledger=on (ISSUE 16)
+        led = _ledger.get_ledger()
+        if led is not None:
+            out["where"] = led.snapshot()
         return out
 
     def prometheus_text(self) -> str:
@@ -360,6 +384,7 @@ def configure(
     port: int = 0,
     alerts: bool = True,
     extra_rules=(),
+    slos=(),
     announce_dir: Optional[str] = None,
     serve: bool = True,
 ) -> LivePlane:
@@ -374,6 +399,7 @@ def configure(
         port=port,
         alerts=alerts,
         extra_rules=extra_rules,
+        slos=slos,
         announce_dir=announce_dir,
         serve=serve,
     )
@@ -392,6 +418,7 @@ def configure_from_cfg(cfg, role: str) -> Optional[LivePlane]:
     metric_cfg = cfg.get("metric", {}) if hasattr(cfg, "get") else {}
     announce_dir = os.path.join(str(cfg.root_dir), str(cfg.run_name), "live")
     extra_rules = metric_cfg.get("alert_rules", None) or ()
+    slos = metric_cfg.get("slos", None) or ()
     # OmegaConf list/dict nodes -> plain containers (rule dicts get
     # mutated during the merge)
     try:
@@ -399,6 +426,8 @@ def configure_from_cfg(cfg, role: str) -> Optional[LivePlane]:
 
         if OmegaConf.is_config(extra_rules):
             extra_rules = OmegaConf.to_container(extra_rules, resolve=True)
+        if OmegaConf.is_config(slos):
+            slos = OmegaConf.to_container(slos, resolve=True)
     except Exception:
         pass
     return configure(
@@ -408,6 +437,7 @@ def configure_from_cfg(cfg, role: str) -> Optional[LivePlane]:
         port=resolve_live_port(int(metric_cfg.get("live_port", 0) or 0), role),
         alerts=bool(metric_cfg.get("alerts", True)),
         extra_rules=extra_rules,
+        slos=slos,
         announce_dir=announce_dir,
     )
 

@@ -363,6 +363,17 @@ def close_recorder() -> None:
 
 
 # ------------------------------------------------------------ cheap hooks
+# the time ledger (obs/ledger.py, ISSUE 16) rides the SAME span call
+# sites: spans feed it exclusive-time buckets even when tracing is off.
+# Registered via set_ledger (not an import — obs.ledger imports us)
+_LEDGER = None
+
+
+def set_ledger(led) -> None:
+    global _LEDGER
+    _LEDGER = led
+
+
 def fleet_event(name: str, **attrs) -> None:
     """Record a fleet event on this process's track.  One global ``is
     None`` test when tracing is off — cheap enough for protocol code."""
@@ -378,19 +389,26 @@ def sampled_event(name: str, key: Optional[str] = None, **attrs) -> None:
 
 
 class _Span:
-    __slots__ = ("_rec", "_name", "_attrs", "_t0")
+    __slots__ = ("_rec", "_led", "_name", "_attrs", "_t0")
 
-    def __init__(self, rec: FlightRecorder, name: str, attrs: Optional[Dict]):
+    def __init__(self, rec: Optional[FlightRecorder], name: str, attrs: Optional[Dict], led=None):
         self._rec = rec
+        self._led = led
         self._name = name
         self._attrs = attrs
 
     def __enter__(self):
+        if self._led is not None:
+            self._led.push(self._name)
         self._t0 = time.time()
         return self
 
     def __exit__(self, *exc):
-        self._rec.span_done(self._name, self._t0, time.time(), self._attrs)
+        t1 = time.time()
+        if self._rec is not None:
+            self._rec.span_done(self._name, self._t0, t1, self._attrs)
+        if self._led is not None:
+            self._led.pop(self._name, self._t0, t1)
         return False
 
 
@@ -409,11 +427,13 @@ _NOOP_SPAN = _NoopSpan()
 
 def span(name: str, **attrs):
     """Context manager recording one typed span on this process's track
-    (no-op constant when tracing is off)."""
+    and/or feeding the time ledger's buckets (no-op constant when BOTH
+    tracing and the ledger are off — the type-identity off-path)."""
     rec = _RECORDER
-    if rec is None:
+    led = _LEDGER
+    if rec is None and led is None:
         return _NOOP_SPAN
-    return _Span(rec, name, attrs or None)
+    return _Span(rec, name, attrs or None, led=led)
 
 
 # -------------------------------------------------------- traced channels

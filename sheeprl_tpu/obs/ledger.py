@@ -1,0 +1,202 @@
+"""Streaming time-accounting ledger — "where did the wall-clock go" (ISSUE 16).
+
+The flight recorder (ISSUE 13) captures typed spans; the live plane
+(ISSUE 15) captures gauges — but neither *attributes* a role's wall-clock
+to a culprit while the run is going.  This module decomposes every
+process's elapsed time into EXCLUSIVE buckets at record time (no post-hoc
+pass over the flight stream):
+
+=========  ==============================================================
+bucket     spans folded into it
+=========  ==============================================================
+compute    ``collect``, ``batch_assembly``, ``train_dispatch``,
+           ``train_step`` — the role doing its actual job
+transport  ``fanin_wait``, ``data_send``, ``broadcast`` — waiting on or
+           feeding the wire
+params     ``params_wait`` — blocked on the params broadcast (staleness
+           barrier, follower adoption)
+replay     ``replay_pump``, ``replay_wait`` — replay-service traffic
+serve      ``serve_wait`` (client side), ``serve_batch`` (server side)
+ckpt       ``ckpt_write``
+idle       derived: window minus everything above (setup, logging, gaps)
+=========  ==============================================================
+
+Exclusive means NESTED spans never double-count: each thread keeps a
+span stack, a child's duration is subtracted from its parent's bucket
+(``serve_wait`` inside ``collect`` moves that time from *compute* to
+*serve*), so the buckets sum to the instrumented wall-clock by
+construction — the acceptance bound is that buckets + idle land within
+5% of the role's measured window.
+
+``metric.ledger`` gates everything (default ``off``): off constructs
+nothing and :func:`sheeprl_tpu.obs.flight.span` keeps returning the
+module-constant no-op span — the PR-9/10/13/15 type-identity pattern.
+On, the ledger rides the SAME ``flight.span`` call sites (zero new
+instrumentation), and the breakdown surfaces as a ``where`` key in
+telemetry, a section on ``/status`` and a time-bar in ``obs.top`` —
+tracing itself may stay off; span timing feeds the ledger either way.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, Optional
+
+WHERE_SCHEMA = "sheeprl.where/1"
+
+# ordered: the obs.top time-bar and docs render buckets in this order
+BUCKETS = ("compute", "transport", "params", "replay", "serve", "ckpt", "idle")
+
+# span name -> bucket (spans not listed are still stack-tracked so their
+# children subtract correctly, but their exclusive time lands in idle)
+SPAN_BUCKETS: Dict[str, str] = {
+    "collect": "compute",
+    "batch_assembly": "compute",
+    "train_dispatch": "compute",
+    "train_step": "compute",
+    "fanin_wait": "transport",
+    "data_send": "transport",
+    "broadcast": "transport",
+    "params_wait": "params",
+    "replay_pump": "replay",
+    "replay_wait": "replay",
+    "serve_wait": "serve",
+    "serve_batch": "serve",
+    "ckpt_write": "ckpt",
+}
+
+__all__ = [
+    "BUCKETS",
+    "SPAN_BUCKETS",
+    "TimeLedger",
+    "WHERE_SCHEMA",
+    "close_ledger",
+    "configure",
+    "configure_from_cfg",
+    "get_ledger",
+    "ledger_setting",
+]
+
+
+def ledger_setting(cfg) -> bool:
+    """Resolve ``metric.ledger`` (env override ``SHEEPRL_LEDGER``) to a
+    bool."""
+    metric_cfg = cfg.get("metric", {}) if hasattr(cfg, "get") else {}
+    val = metric_cfg.get("ledger", "off") if hasattr(metric_cfg, "get") else "off"
+    env = os.environ.get("SHEEPRL_LEDGER")
+    if env is not None:
+        val = env
+    return str(val).strip().lower() not in ("off", "0", "false", "no", "none", "")
+
+
+class TimeLedger:
+    """One process's streaming wall-clock decomposition.
+
+    Fed by :func:`sheeprl_tpu.obs.flight.span` enter/exit (push/pop
+    below); all methods are cheap and thread-safe.  The window opens at
+    construction — setup time before the first span is honest ``idle``.
+    """
+
+    def __init__(self, role: str):
+        self.role = str(role)
+        self._t0 = time.time()
+        self._lock = threading.Lock()
+        self._acc: Dict[str, float] = {b: 0.0 for b in BUCKETS if b != "idle"}
+        self._local = threading.local()
+        self.spans = 0
+
+    # ------------------------------------------------------------ feeding
+    def push(self, name: str) -> None:
+        """Span enter: open a child-time accumulator on this thread."""
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        stack.append(0.0)
+
+    def pop(self, name: str, t0: float, t1: float) -> None:
+        """Span exit: bank the span's EXCLUSIVE time (duration minus the
+        time its nested spans already banked) into its bucket."""
+        stack = getattr(self._local, "stack", None)
+        if not stack:
+            return  # unbalanced exit (ledger installed mid-span)
+        child = stack.pop()
+        dur = max(0.0, t1 - t0)
+        if stack:
+            stack[-1] += dur
+        exclusive = max(0.0, dur - child)
+        bucket = SPAN_BUCKETS.get(name)
+        with self._lock:
+            self.spans += 1
+            if bucket is not None:
+                self._acc[bucket] += exclusive
+
+    # ----------------------------------------------------------- reading
+    def snapshot(self) -> Dict[str, Any]:
+        """The ``where`` dict: cumulative seconds per bucket since the
+        window opened, ``idle`` derived as the unaccounted remainder.
+        Buckets therefore sum to ``window_s`` exactly unless spans
+        overlap ACROSS threads (then they sum to more — which is itself
+        a signal the coverage test bounds)."""
+        now = time.time()
+        window = max(1e-9, now - self._t0)
+        with self._lock:
+            acc = dict(self._acc)
+            spans = self.spans
+        covered = sum(acc.values())
+        out: Dict[str, Any] = {
+            "schema": WHERE_SCHEMA,
+            "role": self.role,
+            "window_s": round(window, 4),
+            "spans": spans,
+        }
+        for b, v in acc.items():
+            out[b] = round(v, 4)
+        out["idle"] = round(max(0.0, window - covered), 4)
+        return out
+
+    def bottleneck(self) -> Optional[str]:
+        """The largest non-idle bucket (None before any span landed)."""
+        with self._lock:
+            acc = dict(self._acc)
+        if not any(v > 0 for v in acc.values()):
+            return None
+        return max(acc, key=acc.get)
+
+
+# ------------------------------------------------------- process singleton
+_LEDGER: Optional[TimeLedger] = None
+
+
+def get_ledger() -> Optional[TimeLedger]:
+    return _LEDGER
+
+
+def configure(role: str) -> TimeLedger:
+    """Install this process's ledger (replacing any previous one) and
+    register it with the span hook in :mod:`sheeprl_tpu.obs.flight`."""
+    global _LEDGER
+    from sheeprl_tpu.obs import flight
+
+    _LEDGER = TimeLedger(role)
+    flight.set_ledger(_LEDGER)
+    return _LEDGER
+
+
+def configure_from_cfg(cfg, role: str) -> Optional[TimeLedger]:
+    """Build the ledger for ``role`` from ``cfg.metric.ledger``; returns
+    None (and constructs NOTHING — :func:`flight.span` keeps its no-op
+    constant) when off."""
+    if not ledger_setting(cfg):
+        return None
+    return configure(role)
+
+
+def close_ledger() -> None:
+    global _LEDGER
+    if _LEDGER is not None:
+        from sheeprl_tpu.obs import flight
+
+        _LEDGER = None
+        flight.set_ledger(None)

@@ -16,7 +16,9 @@ fields; unset fields take the defaults shown):
 ====================  =======================================================
 ``name``              unique rule id (same name as a default rule OVERRIDES
                       it; ``enabled: false`` removes it)
-``kind``              ``threshold`` | ``increase`` | ``drop`` | ``absence``
+``kind``              ``threshold`` | ``increase`` | ``drop`` | ``absence`` |
+                      ``budget_burn`` (threshold over an SLO's burn rate,
+                      defaults ``op: ">=", value: 1.0`` — budget exhausted)
 ``key``               dotted telemetry key, or a list of alternatives (first
                       present in the record wins — lets one rule cover the
                       coupled ``health.skips`` and the decoupled
@@ -63,10 +65,14 @@ __all__ = [
     "AlertEngine",
     "AlertRule",
     "MetricsHub",
+    "SLO",
+    "SLOTracker",
     "default_alert_pack",
+    "default_slo_pack",
     "derive_keys",
     "flatten_record",
     "prometheus_name",
+    "slo_burn_rules",
 ]
 
 
@@ -243,7 +249,7 @@ _OPS: Dict[str, Callable[[Any, Any], bool]] = {
     "!=": lambda a, b: a != b,
 }
 
-_KINDS = ("threshold", "increase", "drop", "absence")
+_KINDS = ("threshold", "increase", "drop", "absence", "budget_burn")
 
 
 class AlertRule:
@@ -274,6 +280,13 @@ class AlertRule:
         self.keys: Tuple[str, ...] = (key,) if isinstance(key, str) else tuple(key)
         self.op = op
         self.value = value
+        if kind == "budget_burn":
+            # burn rate = bad_frac / error_budget (SLOTracker); >= 1.0
+            # means the budget is exhausted — the natural default trip
+            if self.value == 0:
+                self.value = 1.0
+            if self.op == ">":
+                self.op = ">="
         self.window = max(2, int(window))
         self.drop_pct = float(drop_pct)
         self.severity = severity
@@ -313,7 +326,7 @@ class AlertRule:
         if raw is None:
             return None
         self.last_value = raw
-        if self.kind == "threshold":
+        if self.kind in ("threshold", "budget_burn"):
             try:
                 return bool(_OPS[self.op](raw, self.value))
             except TypeError:
@@ -554,3 +567,199 @@ def _jsonable(v: Any) -> Any:
     if v is None or isinstance(v, (bool, int, float, str)):
         return v
     return str(v)
+
+
+# ------------------------------------------------------------------- SLOs
+class SLO:
+    """One declarative service-level objective evaluated live.
+
+    Grammar (``metric.slos`` entries; same merge-by-name semantics as
+    the alert rules — overriding a default SLO's ``target`` tightens it,
+    ``enabled: false`` removes it):
+
+    ==============  =====================================================
+    ``name``        unique id (the telemetry section key: ``slo.<name>``)
+    ``key``         dotted telemetry key, or a list of alternatives
+    ``percentile``  optional: appends ``.p<percentile>`` to every key
+                    (so ``key: serve.latency_ms, percentile: 99`` reads
+                    the producer's ``p99`` summary gauge)
+    ``target``      the objective the value must meet
+    ``op``          comparison that means "good" (default ``<=``)
+    ``window``      trailing evaluations the budget is measured over
+                    (default 32 observations)
+    ``budget``      error budget: tolerated bad fraction of the window
+                    (default 0.05 — "95% of observations in objective")
+    ==============  =====================================================
+
+    Each observation where the key is present is classified good/bad;
+    ``bad_frac`` is the bad share of the trailing window and the **burn
+    rate** is ``bad_frac / budget`` — ≥ 1.0 means the budget is spent,
+    which is exactly what the ``budget_burn`` alert kind trips on.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        key,
+        target,
+        *,
+        op: str = "<=",
+        percentile: Optional[int] = None,
+        window: int = 32,
+        budget: float = 0.05,
+        enabled: bool = True,
+        **extra,
+    ):
+        if op not in _OPS:
+            raise ValueError(f"slo {name!r}: unknown op {op!r}")
+        extra.pop("comment", None)
+        if extra:
+            raise ValueError(f"slo {name!r}: unknown fields {sorted(extra)}")
+        self.name = str(name)
+        keys = (key,) if isinstance(key, str) else tuple(key)
+        if percentile is not None:
+            keys = tuple(f"{k}.p{int(percentile)}" for k in keys)
+        self.keys: Tuple[str, ...] = keys
+        self.target = target
+        self.op = op
+        self.window = max(2, int(window))
+        self.budget = min(1.0, max(1e-6, float(budget)))
+        self.enabled = bool(enabled)
+        # evaluation state
+        self.last_value: Any = None
+        self.observations = 0
+        self.breaches = 0
+        self._hist: deque = deque(maxlen=self.window)
+
+    def observe(self, record: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+        """Classify one record; returns this SLO's section dict, or None
+        when no key is present (the SLO idles — budget state holds)."""
+        _MISSING = object()
+        raw = _MISSING
+        for key in self.keys:
+            raw = key_path(record, key, _MISSING)
+            if raw is not _MISSING:
+                break
+        if raw is _MISSING or raw is None:
+            return None
+        try:
+            good = bool(_OPS[self.op](raw, self.target))
+        except TypeError:
+            return None
+        self.last_value = raw
+        self.observations += 1
+        if not good:
+            self.breaches += 1
+        self._hist.append(0 if good else 1)
+        return self.section()
+
+    def section(self) -> Dict[str, Any]:
+        n = len(self._hist)
+        bad = sum(self._hist)
+        bad_frac = (bad / n) if n else 0.0
+        burn = bad_frac / self.budget
+        return {
+            "value": _jsonable(self.last_value),
+            "target": _jsonable(self.target),
+            "op": self.op,
+            "window": n,
+            "bad": bad,
+            "bad_frac": round(bad_frac, 4),
+            "budget": self.budget,
+            "burn": round(burn, 4),
+            "budget_left": round(max(0.0, 1.0 - burn), 4),
+            "state": "breach" if burn >= 1.0 else "ok",
+        }
+
+
+def default_slo_pack() -> List[Dict[str, Any]]:
+    """The shipped objectives (howto/observability.md has the prose
+    table); like the alert pack, keys list both the coupled and the
+    decoupled telemetry spellings."""
+    return [
+        {
+            # serving plane: p99 request round-trip at the client —
+            # ROADMAP item 1's latency objective
+            "name": "serve_p99",
+            "key": ["serve.latency_ms", "transport.serve.latency_ms"],
+            "percentile": 99,
+            "target": 250.0,
+            "budget": 0.05,
+        },
+        {
+            # params freshness: p95 of the broadcast->adoption lag
+            # histogram stays inside the V-trace max_lag contract
+            "name": "params_lag",
+            "key": ["transport.lag_p95"],
+            "target": 4.0,
+            "budget": 0.1,
+        },
+        {
+            # replay freshness: age of the oldest insert when the batch
+            # that first covers it is sampled
+            "name": "replay_age",
+            "key": ["replay.first_sample_age_s", "transport.replay.first_sample_age_s"],
+            "target": 30.0,
+            "budget": 0.1,
+        },
+    ]
+
+
+def slo_burn_rules(slos: Sequence["SLO"]) -> List[Dict[str, Any]]:
+    """One ``budget_burn`` alert rule per SLO, keyed on the burn gauge
+    the tracker merges into each record (``slo.<name>.burn``)."""
+    return [
+        {
+            "name": f"slo_{s.name}_burn",
+            "kind": "budget_burn",
+            "key": f"slo.{s.name}.burn",
+            "severity": "crit",
+            "clear_for": 2,
+        }
+        for s in slos
+    ]
+
+
+class SLOTracker:
+    """Evaluates the SLO pack over each observed record; returns the
+    ``slo`` section the live plane merges into the record BEFORE the
+    alert engine sees it — so ``budget_burn`` rules and the Prometheus
+    exposition both ride the ordinary gauge path."""
+
+    def __init__(
+        self,
+        slos: Optional[Sequence[Dict[str, Any]]] = None,
+        *,
+        extra_slos: Sequence[Dict[str, Any]] = (),
+    ):
+        base = {s["name"]: dict(s) for s in (slos if slos is not None else default_slo_pack())}
+        for s in extra_slos or ():
+            s = dict(s)
+            name = s.get("name")
+            if not name:
+                raise ValueError(f"metric.slos entry without a name: {s}")
+            merged = dict(base.get(name, {}))
+            merged.update(s)
+            base[name] = merged
+        self.slos: List[SLO] = [
+            SLO(**spec) for spec in base.values() if spec.get("enabled", True)
+        ]
+        self._lock = threading.RLock()
+
+    def observe(self, record: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
+        """One record -> the ``slo`` section ({} when no SLO's key was
+        present — the common case for beat records)."""
+        out: Dict[str, Dict[str, Any]] = {}
+        with self._lock:
+            for slo in self.slos:
+                section = slo.observe(record)
+                if section is not None:
+                    out[slo.name] = section
+        return out
+
+    def as_dicts(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [
+                {"name": s.name, "key": list(s.keys), "observations": s.observations, **s.section()}
+                for s in self.slos
+            ]

@@ -21,14 +21,27 @@ aggregator that turns those N clocks into one timeline:
    remote/local/retry/hedge outcome, replay insert→first-sample age, and
    rollback propagation time (sentinel trip → every player adopting the
    restored params);
-3. **perfetto export** — ``trace.json`` in the Chrome trace-event format
+3. **critical-path attribution** (ISSUE 16) — per iteration round, walk
+   the span DAG + matched send/recv pairs and reconstruct the chain that
+   actually gated the round: params adoption → player collect (serve
+   round-trips subtracted out) → data frame on the wire → trainer batch
+   assembly → train dispatch.  Sum per stage across rounds, and the
+   stage with the largest share IS the answer to "where did the time
+   go" — ``--why`` prints it as one sentence;
+4. **perfetto export** — ``trace.json`` in the Chrome trace-event format
    (one track per process; spans as complete events, fleet events as
-   instant annotations on the offending track, params broadcasts as flow
-   arrows), loadable in https://ui.perfetto.dev or ``chrome://tracing``.
+   instant annotations on the offending track, params broadcasts AND the
+   per-round critical path as flow arrows), loadable in
+   https://ui.perfetto.dev or ``chrome://tracing``.
+
+Roles the clock-offset BFS cannot link (no two-way traffic) are never
+silently mixed into cross-process numbers: their latencies are dropped
+from the fleet percentiles, listed per-seq under ``uncorrected``, and
+their perfetto track is renamed ``<role> (uncorrected)``.
 
 CLI::
 
-    python -m sheeprl_tpu.obs.report <run_dir> [--out trace.json] [--json summary.json]
+    python -m sheeprl_tpu.obs.report <run_dir> [--out trace.json] [--json summary.json] [--why]
 
 stdlib-only (no jax): starts in milliseconds, runs on any laptop.
 """
@@ -43,7 +56,14 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from sheeprl_tpu.obs.reader import read_flight
 
-__all__ = ["estimate_offsets", "fleet_metrics", "generate_report", "main", "to_chrome_trace"]
+__all__ = [
+    "critical_path",
+    "estimate_offsets",
+    "fleet_metrics",
+    "generate_report",
+    "main",
+    "to_chrome_trace",
+]
 
 # event names rendered as instant ANNOTATIONS on the perfetto track (the
 # sentinel/integrity/supervisor vocabulary; everything else is cat=fleet)
@@ -172,6 +192,9 @@ def fleet_metrics(records: List[Dict[str, Any]], clock: Dict[str, Any]) -> Dict[
     """The cross-process numbers no single stream can produce (clock
     offsets already estimated in ``clock``)."""
     off = clock["offset_s"]
+    # roles the offset BFS could not link: their cross-process numbers
+    # would mix uncorrected clocks — annotate + exclude, never blend
+    unlinked = set(clock.get("unlinked") or ())
 
     # --- per-seq broadcast -> adoption latency (measured params staleness)
     publishes: Dict[int, Tuple[str, float]] = {}
@@ -193,6 +216,10 @@ def fleet_metrics(records: List[Dict[str, Any]], clock: Dict[str, Any]) -> Dict[
             continue
         lat = _corr(r["ts"], r["role"], off) - pub[1]
         entry = broadcast.setdefault(str(seq), {"publish_role": pub[0], "adopt_latency_s": {}})
+        if r["role"] in unlinked or pub[0] in unlinked:
+            entry["adopt_latency_s"][r["role"]] = round(lat, 6)
+            entry.setdefault("uncorrected", []).append(r["role"])
+            continue  # keep the per-seq number visible, but NOT in percentiles
         entry["adopt_latency_s"][r["role"]] = round(lat, 6)
         lat_all.append(lat)
     # --- serve request lifecycle (client-side outcomes)
@@ -274,6 +301,211 @@ def fleet_metrics(records: List[Dict[str, Any]], clock: Dict[str, Any]) -> Dict[
     }
 
 
+# ----------------------------------------------------------- critical path
+# chain stage -> the time-ledger bucket it charges (obs/ledger.py), so the
+# streaming `where` breakdown and the post-hoc attribution speak one language
+CP_STAGE_BUCKETS = {
+    "params": "params",
+    "collect": "compute",
+    "serve": "serve",
+    "transport": "transport",
+    "assembly": "compute",
+    "dispatch": "compute",
+}
+# wire tags that carry the rollout payload player -> trainer
+_DATA_TAGS = frozenset({"data", "replay", "rollout"})
+
+
+def critical_path(records: List[Dict[str, Any]], clock: Dict[str, Any]) -> Dict[str, Any]:
+    """Reconstruct, per iteration round, the chain of work that gated the
+    round, and attribute each edge to a stage (``CP_STAGE_BUCKETS``).
+
+    The chain walked is the decoupled round's dependency spine:
+    ``params adoption -> player collect (minus nested serve round-trips)
+    -> serve wait -> data frame send->recv -> batch assembly -> train
+    dispatch``.  Per-player stages take the SLOWEST player (the round
+    cannot finish before its last shard); trainer stages add up.  All
+    cross-process edges are clock-corrected; edges touching a role the
+    offset BFS could not link are flagged ``uncorrected`` and excluded
+    from the aggregate shares.
+
+    Returns ``{"rounds", "per_stage_s", "share", "bottleneck", "chain",
+    "uncorrected_roles"}`` — ``bottleneck`` names the stage with the
+    largest share of summed round latency (``None`` when no rounds were
+    observed).
+    """
+    off = clock["offset_s"]
+    unlinked = set(clock.get("unlinked") or ())
+    spans = [r for r in records if r.get("k") == "span" and r.get("role")]
+
+    def attrs(s: Dict[str, Any]) -> Dict[str, Any]:
+        return s.get("a") or {}
+
+    def dur(s: Dict[str, Any]) -> float:
+        return max(0.0, float(s["t1"]) - float(s["t0"]))
+
+    # round -> stage -> list of (role, seconds, t_end_CORRECTED, uncorrected)
+    by_round: Dict[int, Dict[str, List[Tuple[str, float, float, bool]]]] = {}
+
+    def edge(rnd: int, stage: str, role: str, seconds: float, t_end: float, unc: bool = False) -> None:
+        by_round.setdefault(int(rnd), {}).setdefault(stage, []).append(
+            (role, max(0.0, seconds), t_end, unc)
+        )
+
+    # --- trainer-side round-keyed spans (they define the round set)
+    for s in spans:
+        rnd = attrs(s).get("round")
+        if rnd is None:
+            continue
+        if s["name"] in ("train_dispatch", "train_step"):
+            edge(rnd, "dispatch", s["role"], dur(s), _corr(float(s["t1"]), s["role"], off))
+        elif s["name"] == "batch_assembly":
+            edge(rnd, "assembly", s["role"], dur(s), _corr(float(s["t1"]), s["role"], off))
+
+    # --- player collect, with nested serve round-trips carved out (the
+    # remote-inference wait is serving-plane time, not env compute)
+    serve_windows: Dict[str, List[Tuple[float, float]]] = {}
+    for s in spans:
+        if s["name"] == "serve_wait":
+            serve_windows.setdefault(s["role"], []).append((float(s["t0"]), float(s["t1"])))
+    # per round, the GATING player is picked jointly on collect+serve (the
+    # round waits for its slowest shard, and that player's wall splits
+    # into env compute vs serve round-trips — picking per-stage maxima
+    # from different players would double-count)
+    collect_by_round: Dict[int, Dict[str, Tuple[float, float, float]]] = {}
+    for s in spans:
+        if s["name"] != "collect" or attrs(s).get("round") is None:
+            continue
+        rnd = int(attrs(s)["round"])
+        t0, t1 = float(s["t0"]), float(s["t1"])
+        serve_s = sum(
+            max(0.0, min(w1, t1) - max(w0, t0))
+            for w0, w1 in serve_windows.get(s["role"], ())
+            if w0 < t1 and w1 > t0
+        )
+        collect_by_round.setdefault(rnd, {})[s["role"]] = (
+            max(0.0, dur(s) - serve_s),
+            serve_s,
+            _corr(t1, s["role"], off),
+        )
+    for rnd, per_role in collect_by_round.items():
+        role, (compute_s, serve_s, t_end) = max(
+            per_role.items(), key=lambda kv: kv[1][0] + kv[1][1]
+        )
+        edge(rnd, "collect", role, compute_s, t_end)
+        if serve_s > 0.0:
+            edge(rnd, "serve", role, serve_s, t_end)
+
+    rounds_sorted = sorted(by_round)
+    if not rounds_sorted:
+        return {
+            "rounds": 0,
+            "per_stage_s": {},
+            "share": {},
+            "bottleneck": None,
+            "chain": [],
+            "uncorrected_roles": sorted(unlinked),
+        }
+
+    # --- data frames on the wire: every recv record carries the matched
+    # send timestamp, so the edge is one clock-corrected subtraction.
+    # Frames are matched to rounds by arrival order per source (the i-th
+    # shard a player ships belongs to the i-th observed round).
+    recv_by_src: Dict[str, List[Dict[str, Any]]] = {}
+    for r in records:
+        if (
+            r.get("k") == "recv"
+            and r.get("tag") in _DATA_TAGS
+            and r.get("ts_send") is not None
+            and r.get("src")
+            and r.get("role")
+        ):
+            recv_by_src.setdefault(r["src"], []).append(r)
+    for src, frames in recv_by_src.items():
+        frames.sort(key=lambda r: float(r["ts"]))
+        for i, fr in enumerate(frames):
+            if i >= len(rounds_sorted):
+                break
+            lat = _corr(float(fr["ts"]), fr["role"], off) - _corr(float(fr["ts_send"]), src, off)
+            unc = src in unlinked or fr["role"] in unlinked
+            edge(rounds_sorted[i], "transport", src, lat, _corr(float(fr["ts"]), fr["role"], off), unc)
+
+    # --- params adoption edges, matched to rounds by publish order
+    publishes: List[Tuple[int, str, float]] = []
+    seen_seq = set()
+    for r in _events(records, "broadcast_publish"):
+        a = r.get("a") or {}
+        if a.get("tag", "params") == "params" and a.get("seq") is not None:
+            seq = int(a["seq"])
+            if seq not in seen_seq:
+                seen_seq.add(seq)
+                publishes.append((seq, r["role"], _corr(r["ts"], r["role"], off)))
+    publishes.sort()
+    pub_by_seq = {seq: (role, ts) for seq, role, ts in publishes}
+    seq_to_round = {seq: rounds_sorted[i] for i, (seq, _, _) in enumerate(publishes) if i < len(rounds_sorted)}
+    for r in _events(records, "broadcast_adopt"):
+        a = r.get("a") or {}
+        if a.get("seq") is None:
+            continue
+        seq = int(a["seq"])
+        pub = pub_by_seq.get(seq)
+        rnd = seq_to_round.get(seq)
+        if pub is None or rnd is None:
+            continue
+        lat = _corr(r["ts"], r["role"], off) - pub[1]
+        unc = r["role"] in unlinked or pub[0] in unlinked
+        edge(rnd, "params", r["role"], lat, _corr(float(r["ts"]), r["role"], off), unc)
+
+    # --- per-round chain: slowest player gates the fan-in stages,
+    # trainer-side stages accumulate
+    chain: List[Dict[str, Any]] = []
+    per_stage: Dict[str, float] = {}
+    for rnd in rounds_sorted:
+        stages = by_round[rnd]
+        entry: Dict[str, Any] = {"round": rnd, "edges": {}}
+        total = 0.0
+        for stage in CP_STAGE_BUCKETS:
+            cands = stages.get(stage)
+            if not cands:
+                continue
+            usable = [c for c in cands if not c[3]]
+            if not usable:
+                entry["edges"][stage] = {"uncorrected": True, "roles": sorted({c[0] for c in cands})}
+                continue
+            if stage in ("assembly", "dispatch"):
+                role = usable[0][0]
+                seconds = sum(c[1] for c in usable)
+                t_end = max(c[2] for c in usable)
+            else:
+                role, seconds, t_end, _ = max(usable, key=lambda c: c[1])
+            entry["edges"][stage] = {"role": role, "s": round(seconds, 6), "t_end": t_end}
+            per_stage[stage] = per_stage.get(stage, 0.0) + seconds
+            total += seconds
+        entry["total_s"] = round(total, 6)
+        chain.append(entry)
+
+    grand = sum(per_stage.values())
+    share = {k: round(v / grand, 4) for k, v in per_stage.items()} if grand > 0 else {}
+    bottleneck = None
+    if share:
+        top = max(share, key=share.get)
+        bottleneck = {
+            "stage": top,
+            "bucket": CP_STAGE_BUCKETS[top],
+            "share": share[top],
+            "seconds": round(per_stage[top], 6),
+            "rounds": len(rounds_sorted),
+        }
+    return {
+        "rounds": len(rounds_sorted),
+        "per_stage_s": {k: round(v, 6) for k, v in per_stage.items()},
+        "share": share,
+        "bottleneck": bottleneck,
+        "chain": chain,
+        "uncorrected_roles": sorted(unlinked),
+    }
+
+
 # ---------------------------------------------------------- perfetto export
 def _role_order(roles: List[str]) -> List[str]:
     def key(role: str):
@@ -287,12 +519,16 @@ def _role_order(roles: List[str]) -> List[str]:
 
 
 def to_chrome_trace(
-    records: List[Dict[str, Any]], clock: Dict[str, Any]
+    records: List[Dict[str, Any]], clock: Dict[str, Any], cp: Optional[Dict[str, Any]] = None
 ) -> Dict[str, Any]:
     """Chrome trace-event / perfetto-loadable JSON: one process track per
     role, spans as complete ('X') events, fleet events as instant ('i')
-    annotations, matched params send/recv pairs as flow ('s'/'f') arrows."""
+    annotations, matched params send/recv pairs as flow ('s'/'f') arrows,
+    and (when ``cp`` is given) the per-round critical path as a chained
+    flow of 'critical_path' arrows.  Roles without clock correction are
+    renamed ``<role> (uncorrected)``."""
     off = clock["offset_s"]
+    unlinked = set(clock.get("unlinked") or ())
     roles = _role_order(sorted({r["role"] for r in records if r.get("role")}))
     pids = {role: i + 1 for i, role in enumerate(roles)}
     stamped = [r for r in records if r.get("ts") is not None or r.get("t0") is not None]
@@ -309,7 +545,13 @@ def to_chrome_trace(
     events: List[Dict[str, Any]] = []
     for role in roles:
         events.append(
-            {"ph": "M", "name": "process_name", "pid": pids[role], "tid": 0, "args": {"name": role}}
+            {
+                "ph": "M",
+                "name": "process_name",
+                "pid": pids[role],
+                "tid": 0,
+                "args": {"name": f"{role} (uncorrected)" if role in unlinked else role},
+            }
         )
         events.append(
             {
@@ -408,6 +650,38 @@ def to_chrome_trace(
                         "ts": us(r["ts"], role),
                     }
                 )
+    # the critical path as one chained flow per round: an arrow lands on
+    # the end of each gating edge in stage order, so perfetto draws the
+    # spine the round actually waited on
+    if cp:
+        cp_id = 1_000_000  # clear of the params flow id range
+        for entry in cp.get("chain", ()):
+            hops = [
+                (stage, e)
+                for stage, e in (
+                    (stage, entry["edges"].get(stage)) for stage in CP_STAGE_BUCKETS
+                )
+                if e is not None and not e.get("uncorrected") and e.get("role") in pids
+            ]
+            if len(hops) < 2:
+                continue
+            cp_id += 1
+            for i, (stage, e) in enumerate(hops):
+                ph = "s" if i == 0 else ("f" if i == len(hops) - 1 else "t")
+                ev = {
+                    "ph": ph,
+                    "name": "critical_path",
+                    "cat": "critical_path",
+                    "id": cp_id,
+                    "pid": pids[e["role"]],
+                    "tid": 0,
+                    # edge t_end is already clock-corrected by critical_path
+                    "ts": round((e["t_end"] - t_base) * 1e6, 1),
+                    "args": {"round": entry["round"], "stage": stage, "s": e["s"]},
+                }
+                if ph == "f":
+                    ev["bp"] = "e"
+                events.append(ev)
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 
@@ -418,7 +692,8 @@ def generate_report(run_dir: str, out: Optional[str] = None) -> Dict[str, Any]:
     records = read_flight(run_dir)
     clock = estimate_offsets(records)
     metrics = fleet_metrics(records, clock)
-    trace = to_chrome_trace(records, clock)
+    cp = critical_path(records, clock)
+    trace = to_chrome_trace(records, clock, cp=cp)
     out = out or os.path.join(run_dir, "trace.json")
     with open(out, "w") as f:
         json.dump(trace, f)
@@ -430,6 +705,7 @@ def generate_report(run_dir: str, out: Optional[str] = None) -> Dict[str, Any]:
         "roles": roles,
         "clock": clock,
         "metrics": metrics,
+        "critical_path": cp,
     }
 
 
@@ -467,9 +743,30 @@ def _print_summary(summary: Dict[str, Any]) -> None:
         print("  spans:")
         for name, s in sorted(m["spans"].items()):
             print(f"    {name:24s} n={s['n']:<6d} total={s['total_s']:.3f}s")
+    cp = summary.get("critical_path") or {}
+    if cp.get("share"):
+        shares = "  ".join(
+            f"{stage}={cp['share'][stage] * 100:.1f}%"
+            for stage in CP_STAGE_BUCKETS
+            if stage in cp["share"]
+        )
+        print(f"  critical path ({cp['rounds']} rounds): {shares}")
     print(f"  perfetto trace: {summary['trace_json']} "
           f"({len(json.load(open(summary['trace_json']))['traceEvents'])} events) — "
           "load in https://ui.perfetto.dev")
+
+
+def why_line(cp: Dict[str, Any]) -> str:
+    """One sentence naming the bottleneck stage and its share of summed
+    round latency — the ``--why`` answer."""
+    b = (cp or {}).get("bottleneck")
+    if not b:
+        return "why: no attributable rounds observed (need metric.tracing=sampled|full spans)"
+    return (
+        f"why: {b['stage']} ({b['bucket']} bucket) gated the run — "
+        f"{b['share'] * 100:.1f}% of critical-path time across {b['rounds']} round(s), "
+        f"{b['seconds']:.3f}s total"
+    )
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -477,12 +774,19 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("run_dir", help="run root holding flight/*.jsonl streams")
     ap.add_argument("--out", default=None, help="trace.json path (default <run_dir>/trace.json)")
     ap.add_argument("--json", default=None, help="also write the summary dict as JSON here")
+    ap.add_argument(
+        "--why",
+        action="store_true",
+        help="print one sentence naming the bottleneck stage of the run's critical path",
+    )
     args = ap.parse_args(argv)
     if not os.path.isdir(args.run_dir):
         print(f"error: {args.run_dir} is not a directory", file=sys.stderr)
         return 2
     summary = generate_report(args.run_dir, out=args.out)
     _print_summary(summary)
+    if args.why:
+        print(why_line(summary.get("critical_path")))
     if args.json:
         with open(args.json, "w") as f:
             json.dump(summary, f, indent=2)

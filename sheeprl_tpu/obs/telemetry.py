@@ -54,6 +54,8 @@ TELEMETRY_REQUIRED_FIELDS: Dict[str, tuple] = {
 # present-if-reported fields (validated when present, never required)
 TELEMETRY_OPTIONAL_FIELDS: Dict[str, tuple] = {
     "hbm": (dict,),
+    # streaming time-ledger breakdown (obs/ledger.py, metric.ledger=on)
+    "where": (dict,),
 }
 
 

@@ -28,7 +28,13 @@ import time
 import urllib.request
 from typing import Any, Dict, List, Optional
 
-from sheeprl_tpu.obs.reader import iter_jsonl, key_path, last_jsonl, telemetry_files
+from sheeprl_tpu.obs.reader import (
+    iter_jsonl,
+    key_path,
+    last_jsonl,
+    read_alerts,
+    telemetry_files,
+)
 
 _LEAD_ROLES = ("player0", "main", "lead")
 
@@ -82,7 +88,7 @@ def post_hoc_status(run_dir: str) -> Optional[Dict[str, Any]]:
         record = last_jsonl(files[-1])
     if record is None:
         return None
-    return {
+    status = {
         "schema": "sheeprl.status/post-hoc",
         "role": "post-hoc",
         "ts": record.get("ts"),
@@ -92,6 +98,31 @@ def post_hoc_status(run_dir: str) -> Optional[Dict[str, Any]]:
         "fleet": {},
         "post_hoc": True,
     }
+    # alert HISTORY from the interleaved sheeprl.alert/1 records: replay
+    # the firing/cleared transitions so a finished run still answers
+    # "what fired, when, and did it clear"
+    history = read_alerts(run_dir)
+    if history:
+        last_state: Dict[str, Dict[str, Any]] = {}
+        for a in history:
+            last_state[a.get("rule", "?")] = a
+        active = [a for a in last_state.values() if a.get("state") == "firing"]
+        status["alerts"] = {
+            "firing": len(active),
+            "rules": len(last_state),
+            "fires_total": sum(1 for a in history if a.get("state") == "firing"),
+            "active": [
+                {
+                    "rule": a.get("rule"),
+                    "severity": a.get("severity"),
+                    "value": a.get("value"),
+                    "since_ts": a.get("ts"),
+                }
+                for a in active
+            ],
+        }
+        status["alert_history"] = history[-8:]
+    return status
 
 
 # ------------------------------------------------------------- rendering
@@ -116,6 +147,43 @@ def _table(headers: List[str], rows: List[List[str]]) -> List[str]:
     out = [fmt.format(*headers), fmt.format(*("-" * w for w in widths))]
     out += [fmt.format(*row) for row in rows]
     return out
+
+
+# the time-ledger buckets in render order with their bar glyphs
+# (obs/ledger.py BUCKETS; idle renders dim as '.')
+_WHERE_GLYPHS = (
+    ("compute", "#"),
+    ("transport", "t"),
+    ("params", "p"),
+    ("replay", "r"),
+    ("serve", "s"),
+    ("ckpt", "k"),
+    ("idle", "."),
+)
+
+
+def _where_bar(where: Dict[str, Any], width: int = 50) -> List[str]:
+    """The time-ledger breakdown as one proportional text bar + legend."""
+    window = float(where.get("window_s") or 0.0)
+    vals = {k: float(where.get(k) or 0.0) for k, _ in _WHERE_GLYPHS}
+    total = sum(vals.values())
+    if total <= 0:
+        return [f"where — role {where.get('role', '-')}: (no time accounted yet)"]
+    bar = ""
+    for name, glyph in _WHERE_GLYPHS:
+        bar += glyph * int(round(vals[name] / total * width))
+    bar = (bar + "." * width)[:width]
+    legend = "  ".join(
+        f"{name} {vals[name] / total * 100:.0f}%"
+        for name, _ in _WHERE_GLYPHS
+        if vals[name] / total >= 0.005
+    )
+    return [
+        f"where — role {where.get('role', '-')}  window {window:.1f}s  "
+        f"spans {_fmt(where.get('spans'))}",
+        f"  [{bar}]",
+        f"  {legend}",
+    ]
 
 
 def render_status(status: Dict[str, Any]) -> str:
@@ -144,6 +212,12 @@ def render_status(status: Dict[str, Any]) -> str:
             else ""
         )
     )
+
+    # --------------------------------------------- where (time ledger)
+    where = status.get("where") or record.get("where")
+    if isinstance(where, dict):
+        lines.append("")
+        lines += _where_bar(where)
 
     # ----------------------------------------------------- fleet table
     players = key_path(record, "transport.players") or {}
@@ -213,6 +287,29 @@ def render_status(status: Dict[str, Any]) -> str:
             f"  rollbacks {_fmt(health.get('rollbacks'))}  last_ok {_fmt(health.get('last_ok'))}"
         )
 
+    # ------------------------------------------------------------- SLOs
+    slos = status.get("slos")
+    if not slos:
+        slo_section = record.get("slo")
+        if isinstance(slo_section, dict):
+            slos = [{"name": k, **v} for k, v in slo_section.items() if isinstance(v, dict)]
+    if slos:
+        lines.append("")
+        lines.append("slos — error budgets (burn >= 1 means the budget is spent)")
+        rows = [
+            [
+                str(s.get("name", "?")),
+                _fmt(s.get("value"), 3),
+                f"{s.get('op', '<=')} {_fmt(s.get('target'), 3)}",
+                _fmt(s.get("bad")) + "/" + _fmt(s.get("window")),
+                _fmt(s.get("burn"), 2),
+                _fmt(s.get("budget_left"), 3),
+                str(s.get("state", "-")),
+            ]
+            for s in slos
+        ]
+        lines += _table(["slo", "value", "target", "bad", "burn", "budget left", "state"], rows)
+
     # ----------------------------------------------------------- alerts
     alerts = status.get("alerts")
     if isinstance(alerts, dict):
@@ -224,12 +321,27 @@ def render_status(status: Dict[str, Any]) -> str:
         )
         if active:
             rows = [
-                [a.get("rule", "?"), a.get("severity", "-"), str(a.get("value")), _fmt(a.get("since_ts"))]
+                [a.get("rule") or "?", a.get("severity") or "-", _fmt(a.get("value")), _fmt(a.get("since_ts"))]
                 for a in active
             ]
             lines += _table(["rule", "severity", "value", "since"], rows)
         else:
             lines.append("  (none firing)")
+        history = status.get("alert_history")
+        if history:
+            lines.append("  history (oldest first):")
+            rows = [
+                [
+                    _fmt(a.get("ts")),
+                    a.get("rule") or "?",
+                    a.get("state") or "-",
+                    a.get("severity") or "-",
+                    _fmt(a.get("value")),
+                    _fmt(a.get("step")),
+                ]
+                for a in history
+            ]
+            lines += _table(["ts", "rule", "state", "severity", "value", "step"], rows)
     return "\n".join(lines) + "\n"
 
 
@@ -244,7 +356,12 @@ def main(argv=None) -> int:
         help="status URL (http://host:port) or a run directory containing live/*.json",
     )
     ap.add_argument("--interval", type=float, default=2.0, help="refresh seconds")
-    ap.add_argument("--once", action="store_true", help="print one frame and exit")
+    ap.add_argument(
+        "--once",
+        action="store_true",
+        help="print one frame and exit (nonzero when any alert is firing — a "
+        "scriptable health probe)",
+    )
     ap.add_argument(
         "--no-clear", action="store_true", help="append frames instead of redrawing"
     )
@@ -269,7 +386,10 @@ def main(argv=None) -> int:
         sys.stdout.write(frame)
         sys.stdout.flush()
         if args.once:
-            return 0 if status is not None else 1
+            if status is None:
+                return 1
+            firing = key_path(status, "alerts.firing") or 0
+            return 2 if firing else 0
         try:
             time.sleep(max(0.2, args.interval))
         except KeyboardInterrupt:

@@ -57,6 +57,8 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from sheeprl_tpu.obs import flight
+
 __all__ = [
     "KeyStream",
     "PipelinedCollector",
@@ -372,7 +374,8 @@ class PipelinedCollector:
                 if ok and self._adopt is not None:
                     self._adopt(params)
                 self.staleness_log.append((k, max(0, (k - 1) - version)))
-                payload = self._collect_fn(k, False, self._keys)
+                with flight.span("collect", round=k):
+                    payload = self._collect_fn(k, False, self._keys)
                 payload.params_version = version
                 self._pack_fn(payload)
                 while not self._stop.is_set():
@@ -400,7 +403,8 @@ class PipelinedCollector:
             if params is not None and self._adopt is not None:
                 self._adopt(params)
             self.staleness_log.append((k, max(0, (k - 1) - version)))
-            payload = self._collect_fn(k, True, self._runtime.next_key)
+            with flight.span("collect", round=k):
+                payload = self._collect_fn(k, True, self._runtime.next_key)
             payload.params_version = version
             self._pack_fn(payload)
             self._iter += 1

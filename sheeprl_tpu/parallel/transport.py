@@ -834,7 +834,10 @@ class TcpChannel(Channel):
     def send(self, tag, arrays=None, extra=(), seq=-1, timeout=600.0) -> None:
         inj = get_injector()
         if inj.armed:
-            if inj.fire("net_delay"):
+            # qualifier = the frame tag, so a fault spec can target one
+            # traffic class (``net_delay@data:5:0.3`` delays only the
+            # rollout shards — the critical-path attribution tests)
+            if inj.fire("net_delay", qualifier=tag):
                 time.sleep(inj.arg("net_delay"))
             if inj.fire("net_drop"):
                 flight.fleet_event("net_drop", who=self.who)
@@ -2187,12 +2190,15 @@ class FanIn:
             # broadcast_adopt event (ParamsFollower) subtracts this
             # timestamp (clock-corrected) for the per-seq latency metric
             flight.fleet_event("broadcast_publish", tag=tag, seq=int(seq), n=len(targets))
-        for pid in targets:
-            extra = extra_fn(pid) if extra_fn is not None else ()
-            try:
-                self.channels[pid].send(tag, arrays=arrays, extra=extra, seq=seq, timeout=timeout)
-            except (PeerDiedError, queue_mod.Full, OSError) as e:
-                self.mark_dead(pid, f"broadcast failed: {e}")
+        # ledger: the trainer's wire time fanning the payload out (credit
+        # stalls on a slow player land here, not in compute)
+        with flight.span("broadcast", tag=tag, n=len(targets)):
+            for pid in targets:
+                extra = extra_fn(pid) if extra_fn is not None else ()
+                try:
+                    self.channels[pid].send(tag, arrays=arrays, extra=extra, seq=seq, timeout=timeout)
+                except (PeerDiedError, queue_mod.Full, OSError) as e:
+                    self.mark_dead(pid, f"broadcast failed: {e}")
         self._require_live()
 
     def note_rollback(self, round_seq: int) -> None:
@@ -2334,14 +2340,16 @@ class ParamsFollower:
         broadcast may precede the awaited control reply)."""
         deadline = time.monotonic() + (timeout or self._timeout)
         stash: List[Frame] = []
-        try:
-            while True:
-                frame = self._next_frame(max(deadline - time.monotonic(), 0.01))
-                if frame.tag == tag:
-                    return frame
-                stash.append(frame)
-        finally:
-            self._pending.extend(stash)
+        # ledger: time blocked on the trainer's params/control stream
+        with flight.span("params_wait", tag=tag):
+            try:
+                while True:
+                    frame = self._next_frame(max(deadline - time.monotonic(), 0.01))
+                    if frame.tag == tag:
+                        return frame
+                    stash.append(frame)
+            finally:
+                self._pending.extend(stash)
 
     def _take_exact(self, target: int, timeout: Optional[float] = None) -> Optional[Frame]:
         """Drain the params stream up to EXACTLY ``target`` (the broadcast
@@ -2401,6 +2409,10 @@ class ParamsFollower:
         newest: Optional[Frame] = None
         target_min = round_k - 1 - max(0, int(max_lag))
         deadline = time.monotonic() + (timeout or self._timeout)
+        # ledger: the soft-lag drain is params-stream waiting (manual
+        # enter/exit — the adoption bookkeeping below stays outside)
+        wait_span = flight.span("params_wait", tag="params")
+        wait_span.__enter__()
         try:
             while True:
                 best = newest.seq if newest is not None else self.current_seq
@@ -2434,6 +2446,7 @@ class ParamsFollower:
                 newest = frame
         finally:
             self._pending.extend(held)
+            wait_span.__exit__(None, None, None)
         if newest is not None:
             self.current_seq = newest.seq
             flight.fleet_event("broadcast_adopt", seq=int(newest.seq))

@@ -233,6 +233,11 @@ class ReplayServer:
         # frames (ISSUE 15); rides stats() to the lead's /status
         self.fleet: Dict[int, Dict[str, Any]] = {}
         self.credit_stall_players = 0  # grant attempts refused by the limiter
+        # insert -> first-sample freshness (ISSUE 16): arrival times of
+        # inserts no sample() has run since; the next sample() reads the
+        # oldest as the first_sample_age_s SLO gauge and drains the list
+        self._unsampled_insert_ts: deque = deque(maxlen=1024)
+        self.first_sample_age_s: Optional[float] = None
         # training-sentinel quarantine bookkeeping: ring rows written per
         # env since the last verdict-clean horizon (mark_health_horizon)
         self._rows_since_mark = np.zeros(total_envs, dtype=np.int64)
@@ -430,6 +435,7 @@ class ReplayServer:
             self.quarantined_rows += t_len * count
         self.total_inserts += n
         self.inserts_by_player[pid] += n
+        self._unsampled_insert_ts.append(time.time())
         self._rows_since_mark[offset : offset + count] += t_len
         if self.limiter is not None:
             self.limiter.insert(n)
@@ -503,6 +509,11 @@ class ReplayServer:
                 data["is_weights"] = np.ones((g, batch_size, 1), np.float32)
         if self.limiter is not None:
             self.limiter.sample(g * batch_size)
+        if self._unsampled_insert_ts:
+            # freshness gauge: how stale was the OLDEST insert this is
+            # the first sample to cover (the replay_age SLO input)
+            self.first_sample_age_s = round(time.time() - self._unsampled_insert_ts[0], 4)
+            self._unsampled_insert_ts.clear()
         flight.sampled_event("replay_sample", "replay_sample", total=self.total_inserts)
         return data, idx
 
@@ -605,6 +616,7 @@ class ReplayServer:
             "deaths": len(self.dead),
             "rejoins": self.rejoins,
             "credit_grant_stalls": self.credit_stall_players,
+            "first_sample_age_s": self.first_sample_age_s,
             "quarantines": self.quarantines,
             "quarantined_rows": self.quarantined_rows,
             "inserts_quarantined": self.inserts_quarantined,

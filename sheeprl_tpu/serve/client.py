@@ -220,7 +220,10 @@ class InferenceClient:
             return None, "local"
         t0 = time.monotonic()
         retries0, hedges0 = self.retries, self.hedges
-        out = self._try_remote(arrays, rows, probe=self.breaker.state == "half_open")
+        # the ledger's serve bucket: remote round-trip time nested inside
+        # the player's collect span moves from compute to serve
+        with flight.span("serve_wait"):
+            out = self._try_remote(arrays, rows, probe=self.breaker.state == "half_open")
         if out is not None:
             self.breaker.record_success()
             self.remote_used += 1
@@ -298,6 +301,9 @@ class _LatencyWindow:
         return {
             "p50": round(float(np.percentile(arr, 50)) * 1e3, 3),
             "p95": round(float(np.percentile(arr, 95)) * 1e3, 3),
+            # the serving plane's SLO (obs/metrics.py serve_p99) reads
+            # this tail gauge
+            "p99": round(float(np.percentile(arr, 99)) * 1e3, 3),
             "n": len(buf),
         }
 

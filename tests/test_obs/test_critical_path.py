@@ -1,0 +1,142 @@
+"""Critical-path attribution (ISSUE 16, obs/report.py): synthetic streams
+where the planted bottleneck stage must be named — transport-bound
+(the ``net_delay`` analog), serve-bound (``infer_delay``), compute-bound
+(clean) — plus the uncorrected-role exclusion and the ``--why`` line."""
+
+import pytest
+
+from sheeprl_tpu.obs.report import (
+    CP_STAGE_BUCKETS,
+    critical_path,
+    to_chrome_trace,
+    why_line,
+)
+
+pytestmark = pytest.mark.slo
+
+CLOCK = {"offset_s": {"trainer": 0.0, "player0": 0.0, "player1": 0.0}, "unlinked": []}
+
+
+def _span(role, name, t0, t1, rnd=None):
+    rec = {"k": "span", "role": role, "name": name, "t0": t0, "t1": t1}
+    if rnd is not None:
+        rec["a"] = {"round": rnd}
+    return rec
+
+
+def _recv(ts_send, ts, src="player0", role="trainer", tag="data"):
+    return {"k": "recv", "tag": tag, "ts": ts, "ts_send": ts_send, "src": src, "role": role}
+
+
+def _fleet(rounds=3, collect_s=0.1, serve_s=0.0, wire_s=0.002, dispatch_s=0.01):
+    """A synthetic N=1-player fleet stream with tunable stage weights."""
+    records = []
+    for rnd in range(rounds):
+        t = float(rnd)
+        t_col = t + collect_s + serve_s
+        records.append(_span("player0", "collect", t, t_col, rnd))
+        if serve_s:
+            records.append(_span("player0", "serve_wait", t + collect_s, t_col))
+        records.append(_recv(t_col, t_col + wire_s))
+        records.append(_span("trainer", "batch_assembly", t_col + wire_s, t_col + wire_s + 0.005, rnd))
+        records.append(
+            _span("trainer", "train_dispatch", t_col + wire_s + 0.005, t_col + wire_s + 0.005 + dispatch_s, rnd)
+        )
+    return records
+
+
+def test_clean_run_is_compute_bound():
+    cp = critical_path(_fleet(collect_s=0.5), CLOCK)
+    assert cp["rounds"] == 3
+    b = cp["bottleneck"]
+    assert b["stage"] == "collect" and b["bucket"] == "compute"
+    assert b["share"] > 0.5
+    assert sum(cp["share"].values()) == pytest.approx(1.0, abs=0.01)
+
+
+def test_injected_net_delay_is_transport_bound():
+    cp = critical_path(_fleet(collect_s=0.05, wire_s=0.8), CLOCK)
+    assert cp["bottleneck"]["stage"] == "transport"
+    assert cp["bottleneck"]["bucket"] == "transport"
+
+
+def test_injected_infer_delay_is_serve_bound():
+    # serve round-trips nested INSIDE collect: the carve-out must move
+    # the time from compute to serve, not double-count it
+    cp = critical_path(_fleet(collect_s=0.05, serve_s=0.6), CLOCK)
+    assert cp["bottleneck"]["stage"] == "serve"
+    per = cp["per_stage_s"]
+    assert per["serve"] == pytest.approx(3 * 0.6, rel=0.01)
+    assert per["collect"] == pytest.approx(3 * 0.05, rel=0.01)
+
+
+def test_gating_player_chosen_jointly_not_per_stage():
+    # player0 is serve-bound, player1 is compute-bound and SLOWER overall;
+    # the round gates on player1, so its split must be used — taking
+    # per-stage maxima across different players would double-count
+    records = []
+    for rnd in range(2):
+        t = float(rnd)
+        records.append(_span("player0", "collect", t, t + 0.4, rnd))
+        records.append(_span("player0", "serve_wait", t + 0.1, t + 0.4))
+        records.append(_span("player1", "collect", t, t + 0.6, rnd))
+        records.append(_recv(t + 0.6, t + 0.602, src="player1"))
+        records.append(_span("trainer", "train_dispatch", t + 0.61, t + 0.62, rnd))
+    cp = critical_path(records, CLOCK)
+    for entry in cp["chain"]:
+        assert entry["edges"]["collect"]["role"] == "player1"
+        assert "serve" not in entry["edges"]  # the gating player had no serve time
+    assert cp["per_stage_s"]["collect"] == pytest.approx(1.2, rel=0.01)
+
+
+def test_uncorrected_roles_are_flagged_and_excluded_from_shares():
+    clock = {"offset_s": {"trainer": 0.0, "player0": 0.0}, "unlinked": ["player1"]}
+    records = _fleet(rounds=2, collect_s=0.1)
+    # a huge transport edge from the UNLINKED role: must not pollute shares
+    records.append(_recv(0.0, 50.0, src="player1"))
+    cp = critical_path(records, clock)
+    assert "player1" in cp["uncorrected_roles"]
+    assert cp["per_stage_s"]["transport"] < 1.0
+    assert cp["bottleneck"]["stage"] != "transport"
+
+
+def test_clock_offsets_are_applied_to_cross_process_edges():
+    # player clock runs 10s AHEAD of the trainer; offsets must cancel it
+    clock = {"offset_s": {"trainer": 0.0, "player0": 10.0}, "unlinked": []}
+    records = [
+        _span("player0", "collect", 10.0, 10.1, 0),
+        _recv(10.1, 0.105),  # raw delta is -9.995; corrected: 5ms
+        _span("trainer", "train_dispatch", 0.11, 0.12, 0),
+    ]
+    cp = critical_path(records, clock)
+    assert cp["per_stage_s"]["transport"] == pytest.approx(0.005, abs=1e-6)
+
+
+def test_empty_stream_names_nothing_and_why_says_so():
+    cp = critical_path([], {"offset_s": {}, "unlinked": []})
+    assert cp["rounds"] == 0 and cp["bottleneck"] is None
+    assert "metric.tracing" in why_line(cp)
+    assert "metric.tracing" in why_line(None)
+
+
+def test_why_line_names_stage_bucket_and_share():
+    cp = critical_path(_fleet(collect_s=0.5), CLOCK)
+    line = why_line(cp)
+    assert line.startswith("why: collect (compute bucket)")
+    assert "3 round(s)" in line
+
+
+def test_trace_export_gains_critical_path_flow_arrows():
+    records = _fleet(rounds=3)
+    cp = critical_path(records, CLOCK)
+    trace = to_chrome_trace(records, CLOCK, cp=cp)
+    flows = [e for e in trace["traceEvents"] if e.get("cat") == "critical_path"]
+    assert flows, "no critical-path flow events in the export"
+    phases = {e["ph"] for e in flows}
+    assert phases == {"s", "t", "f"}  # start -> step(s) -> finish per round
+    assert all(e["name"] == "critical_path" for e in flows)
+    assert all(e["args"]["stage"] in CP_STAGE_BUCKETS for e in flows)
+    finishes = [e for e in flows if e["ph"] == "f"]
+    assert all(e.get("bp") == "e" for e in finishes)
+    # one chained flow id per round
+    assert len({e["id"] for e in flows}) == 3

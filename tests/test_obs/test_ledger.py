@@ -1,0 +1,157 @@
+"""Streaming time-ledger units (ISSUE 16, obs/ledger.py): exclusive-time
+accounting with nested spans, the derived idle remainder, the gate's
+type-identity off-path, and the flight.span integration."""
+
+import time
+
+import pytest
+
+from sheeprl_tpu.obs import flight
+from sheeprl_tpu.obs import ledger as obs_ledger
+from sheeprl_tpu.obs.ledger import BUCKETS, SPAN_BUCKETS, TimeLedger
+
+pytestmark = pytest.mark.slo
+
+
+@pytest.fixture(autouse=True)
+def _clean_hooks():
+    flight.close_recorder()
+    obs_ledger.close_ledger()
+    yield
+    flight.close_recorder()
+    obs_ledger.close_ledger()
+
+
+# ---------------------------------------------------------------- accounting
+def test_nested_span_banks_exclusive_time_only():
+    led = TimeLedger("t")
+    # collect [0, 5] wrapping a serve_wait [1, 3]: the 2s round-trip is
+    # SERVE time, only the remaining 3s is env compute
+    led.push("collect")
+    led.push("serve_wait")
+    led.pop("serve_wait", 1.0, 3.0)
+    led.pop("collect", 0.0, 5.0)
+    snap = led.snapshot()
+    assert snap["serve"] == pytest.approx(2.0)
+    assert snap["compute"] == pytest.approx(3.0)
+
+
+def test_unmapped_span_subtracts_from_parent_but_banks_nothing():
+    led = TimeLedger("t")
+    assert "log_flush" not in SPAN_BUCKETS
+    led.push("collect")
+    led.push("log_flush")
+    led.pop("log_flush", 0.5, 1.5)
+    led.pop("collect", 0.0, 4.0)
+    snap = led.snapshot()
+    # the child's second still reduced the parent's exclusive share...
+    assert snap["compute"] == pytest.approx(3.0)
+    # ...but landed in no bucket (it becomes idle via the remainder)
+    assert sum(snap[b] for b in BUCKETS if b != "idle") == pytest.approx(3.0)
+
+
+def test_double_nesting_never_double_counts():
+    led = TimeLedger("t")
+    led.push("collect")
+    led.push("serve_wait")
+    led.push("params_wait")
+    led.pop("params_wait", 1.0, 2.0)
+    led.pop("serve_wait", 0.5, 3.0)
+    led.pop("collect", 0.0, 4.0)
+    snap = led.snapshot()
+    assert snap["params"] == pytest.approx(1.0)
+    assert snap["serve"] == pytest.approx(1.5)  # 2.5 total minus the 1.0 child
+    assert snap["compute"] == pytest.approx(1.5)  # 4.0 minus the 2.5 child
+    total = snap["params"] + snap["serve"] + snap["compute"]
+    assert total == pytest.approx(4.0)
+
+
+def test_unbalanced_pop_is_harmless():
+    # a ledger installed MID-span sees the exit without the enter
+    led = TimeLedger("t")
+    led.pop("collect", 0.0, 1.0)
+    snap = led.snapshot()
+    assert snap["compute"] == 0.0
+    assert snap["spans"] == 0
+
+
+def test_snapshot_schema_and_idle_remainder():
+    led = TimeLedger("player3")
+    time.sleep(0.01)  # window_s is rounded to 4 decimals — let it tick
+    led.push("train_step")
+    led.pop("train_step", 0.0, 0.001)
+    snap = led.snapshot()
+    assert snap["schema"] == obs_ledger.WHERE_SCHEMA
+    assert snap["role"] == "player3"
+    assert snap["spans"] == 1
+    assert snap["window_s"] > 0
+    assert snap["idle"] >= 0.0
+    for b in BUCKETS:
+        assert b in snap
+    # buckets + idle reconstruct the window (single-threaded: exactly)
+    covered = sum(snap[b] for b in BUCKETS)
+    assert covered == pytest.approx(snap["window_s"], rel=0.05)
+
+
+def test_bottleneck_names_largest_bucket():
+    led = TimeLedger("t")
+    assert led.bottleneck() is None
+    led.push("fanin_wait")
+    led.pop("fanin_wait", 0.0, 3.0)
+    led.push("train_step")
+    led.pop("train_step", 3.0, 4.0)
+    assert led.bottleneck() == "transport"
+
+
+def test_every_mapped_bucket_is_a_declared_bucket():
+    assert set(SPAN_BUCKETS.values()) <= set(BUCKETS)
+    assert "idle" not in SPAN_BUCKETS.values()  # idle is derived, never banked
+
+
+# -------------------------------------------------------------- gate + hooks
+def test_off_path_keeps_the_noop_span_constant():
+    # the PR-9/10/13/15 pattern: gate off -> flight.span returns the SAME
+    # module constant every call (type identity, not just equality)
+    s1 = flight.span("collect")
+    s2 = flight.span("train_step", round=3)
+    assert s1 is s2
+    assert s1 is flight._NOOP_SPAN
+
+
+def test_configure_from_cfg_off_constructs_nothing():
+    assert obs_ledger.configure_from_cfg({"metric": {"ledger": "off"}}, role="t") is None
+    assert obs_ledger.get_ledger() is None
+    assert flight.span("collect") is flight._NOOP_SPAN
+
+
+def test_configure_installs_and_close_restores_identity():
+    led = obs_ledger.configure_from_cfg({"metric": {"ledger": "on"}}, role="t")
+    assert led is not None and obs_ledger.get_ledger() is led
+    assert flight.span("collect") is not flight._NOOP_SPAN
+    obs_ledger.close_ledger()
+    assert obs_ledger.get_ledger() is None
+    assert flight.span("collect") is flight._NOOP_SPAN
+
+
+def test_ledger_setting_env_override(monkeypatch):
+    assert obs_ledger.ledger_setting({"metric": {"ledger": "on"}}) is True
+    assert obs_ledger.ledger_setting({"metric": {"ledger": "off"}}) is False
+    assert obs_ledger.ledger_setting({}) is False
+    monkeypatch.setenv("SHEEPRL_LEDGER", "on")
+    assert obs_ledger.ledger_setting({"metric": {"ledger": "off"}}) is True
+    monkeypatch.setenv("SHEEPRL_LEDGER", "off")
+    assert obs_ledger.ledger_setting({"metric": {"ledger": "on"}}) is False
+
+
+def test_flight_span_feeds_the_ledger_without_a_recorder():
+    led = obs_ledger.configure("t")
+    with flight.span("collect", round=0):
+        with flight.span("serve_wait"):
+            time.sleep(0.02)
+        time.sleep(0.01)
+    snap = led.snapshot()
+    assert snap["spans"] == 2
+    assert snap["serve"] > 0.0
+    assert snap["compute"] > 0.0
+    # exclusive accounting: buckets can never exceed the window
+    assert snap["serve"] + snap["compute"] <= snap["window_s"] * 1.05

@@ -1,0 +1,152 @@
+"""Declarative SLO tracking (ISSUE 16, obs/metrics.py): the objective
+grammar, error-budget burn math, merge-by-name semantics, the generated
+``budget_burn`` alert rules — and the live e2e where a tightened serve-p99
+objective breaches and fires its burn alert through the full LivePlane."""
+
+import pytest
+
+from sheeprl_tpu.obs.fleet import LivePlane
+from sheeprl_tpu.obs.metrics import (
+    SLO,
+    AlertEngine,
+    AlertRule,
+    SLOTracker,
+    default_slo_pack,
+    slo_burn_rules,
+)
+
+pytestmark = [pytest.mark.slo, pytest.mark.live]
+
+
+# ------------------------------------------------------------------- grammar
+def test_slo_classifies_good_and_bad():
+    slo = SLO("lat", "serve.ms", 100.0, window=4, budget=0.5)
+    assert slo.observe({"serve": {"ms": 50.0}})["state"] == "ok"
+    sec = slo.observe({"serve": {"ms": 200.0}})
+    assert sec["bad"] == 1 and sec["window"] == 2
+    assert sec["bad_frac"] == pytest.approx(0.5)
+    assert sec["burn"] == pytest.approx(1.0)  # 0.5 bad over a 0.5 budget
+    assert sec["state"] == "breach"
+
+
+def test_slo_idles_when_key_absent():
+    slo = SLO("lat", "serve.ms", 100.0)
+    assert slo.observe({"ts": 1.0}) is None
+    assert slo.observations == 0
+
+
+def test_slo_percentile_appends_key_suffix():
+    slo = SLO("p99", "serve.latency_ms", 250.0, percentile=99)
+    assert slo.keys == ("serve.latency_ms.p99",)
+    assert slo.observe({"serve": {"latency_ms": {"p99": 10.0}}})["state"] == "ok"
+
+
+def test_slo_key_alternatives_first_present_wins():
+    slo = SLO("lag", ["transport.lag_p95", "lag_p95"], 4.0)
+    sec = slo.observe({"lag_p95": 2.0})
+    assert sec is not None and sec["value"] == 2.0
+
+
+def test_slo_burn_is_windowed():
+    slo = SLO("lat", "v", 10, window=4, budget=0.25)
+    for v in (20, 20, 5, 5, 5, 5):  # the two breaches age out of the window
+        slo.observe({"v": v})
+    assert slo.section()["bad"] == 0
+    assert slo.section()["burn"] == 0.0
+
+
+def test_slo_rejects_unknown_op_and_fields():
+    with pytest.raises(ValueError):
+        SLO("x", "k", 1, op="~=")
+    with pytest.raises(ValueError):
+        SLO("x", "k", 1, percentil=99)  # typo'd field must not pass silently
+
+
+# ------------------------------------------------------------------- tracker
+def test_tracker_merge_by_name_tightens_and_disables():
+    tracker = SLOTracker(
+        extra_slos=[
+            {"name": "serve_p99", "target": 1.0},  # tighten the default 250ms
+            {"name": "replay_age", "enabled": False},  # remove a default
+            {"name": "custom", "key": "my.gauge", "target": 5.0},  # add one
+        ]
+    )
+    by_name = {s.name: s for s in tracker.slos}
+    assert by_name["serve_p99"].target == 1.0
+    assert "replay_age" not in by_name
+    assert by_name["custom"].keys == ("my.gauge",)
+    # defaults not mentioned are untouched
+    assert "params_lag" in by_name
+
+
+def test_tracker_observe_returns_slo_section():
+    tracker = SLOTracker()
+    out = tracker.observe({"serve": {"latency_ms": {"p99": 10.0}}})
+    assert "serve_p99" in out and out["serve_p99"]["state"] == "ok"
+    assert tracker.observe({"ts": 1.0}) == {}
+    dicts = tracker.as_dicts()
+    assert {d["name"] for d in dicts} == {s["name"] for s in default_slo_pack()}
+
+
+def test_burn_rules_generated_per_slo():
+    tracker = SLOTracker()
+    rules = slo_burn_rules(tracker.slos)
+    assert {r["name"] for r in rules} == {f"slo_{s.name}_burn" for s in tracker.slos}
+    for r in rules:
+        assert r["kind"] == "budget_burn"
+        assert r["key"].startswith("slo.") and r["key"].endswith(".burn")
+        assert r["severity"] == "crit"
+
+
+def test_budget_burn_kind_defaults_trip_at_one():
+    rule = AlertRule("b", "budget_burn", "slo.x.burn")
+    assert rule.op == ">=" and rule.value == 1.0
+    assert rule.observe({"slo": {"x": {"burn": 0.4}}}, 1.0) is None
+    assert rule.observe({"slo": {"x": {"burn": 1.0}}}, 2.0) == "firing"
+
+
+def test_budget_burn_via_alert_engine_rule_pack():
+    eng = AlertEngine(
+        rules=[],
+        extra_rules=[{"name": "slo_lat_burn", "kind": "budget_burn", "key": "slo.lat.burn"}],
+    )
+    assert eng.observe({"ts": 1.0, "slo": {"lat": {"burn": 20.0}}})[0]["state"] == "firing"
+
+
+# ------------------------------------------------------------------ live e2e
+def test_tightened_serve_p99_breach_fires_burn_alert_through_the_plane():
+    """The acceptance e2e: a serve-p99 objective tightened to an absurd
+    1ms breaches on ordinary latencies and the generated budget_burn rule
+    fires — all through the real LivePlane (SLO section merged into the
+    record BEFORE the alert engine evaluates it, /status renders both)."""
+    plane = LivePlane("trainer", serve=False, slos=[{"name": "serve_p99", "target": 0.001}])
+    try:
+        fired = []
+        for i in range(3):
+            rec = {"ts": 100.0 + i, "step": i, "serve": {"latency_ms": {"p99": 45.0}}}
+            fired += plane.observe(rec)
+        burn = [a for a in fired if a["rule"] == "slo_serve_p99_burn"]
+        assert burn and burn[0]["state"] == "firing" and burn[0]["severity"] == "crit"
+        status = plane.status()
+        slos = {s["name"]: s for s in status["slos"]}
+        assert slos["serve_p99"]["state"] == "breach"
+        assert slos["serve_p99"]["burn"] >= 1.0
+        assert status["alerts"]["firing"] >= 1
+        assert any(a["rule"] == "slo_serve_p99_burn" for a in status["alerts"]["active"])
+    finally:
+        plane.close()
+
+
+def test_untightened_plane_stays_quiet_on_the_same_traffic():
+    plane = LivePlane("trainer", serve=False)
+    try:
+        fired = []
+        for i in range(3):
+            fired += plane.observe(
+                {"ts": 100.0 + i, "step": i, "serve": {"latency_ms": {"p99": 45.0}}}
+            )
+        assert not [a for a in fired if a["rule"].startswith("slo_")]
+        slos = {s["name"]: s for s in plane.status()["slos"]}
+        assert slos["serve_p99"]["state"] == "ok"
+    finally:
+        plane.close()
