@@ -70,10 +70,10 @@ loudly (stderr + exit 3).  Known-noisy metrics are exempt via the
 justified skip-list in ``benchmarks/bench_gate_skiplist.json``.
 
 Env overrides: BENCH_BUDGET_S, BENCH_SKIP_PPO/SAC/A2C/DV3/DEC/LOOP/FANIN/
-JAXENV/SUPERBENCH, BENCH_PPO_STEPS, BENCH_SAC_STEPS, BENCH_A2C_STEPS,
+JAXENV/CKPT/SUPERBENCH, BENCH_PPO_STEPS, BENCH_SAC_STEPS, BENCH_A2C_STEPS,
 BENCH_DV3_STEPS, BENCH_FANIN_STEPS, BENCH_JAXENV_STEPS, BENCH_SUPER_STEPS,
-BENCH_PLATFORM (cpu for local tests), BENCH_SKIP_GATE,
-BENCH_GATE_THRESHOLD (fraction, default 0.20).
+BENCH_CKPT_MB (comma list of state sizes), BENCH_PLATFORM (cpu for local
+tests), BENCH_SKIP_GATE, BENCH_GATE_THRESHOLD (fraction, default 0.20).
 """
 
 import json
@@ -106,6 +106,7 @@ SECTIONS = [
     ("loop", 60),
     ("jaxenv", 60),
     ("replay", 120),
+    ("ckpt", 60),
     ("serve", 90),
     ("ppo", 100),
     ("sac", 60),
@@ -742,6 +743,37 @@ def bench_loop():
     }
 
 
+def bench_ckpt():
+    """Checkpoint-plane ladder (benchmarks/bench_ckpt.py, ISSUE 17):
+    single-zip vs sharded-directory save/restore at state sizes x fsdp
+    shard counts, interleaved min-of-N.  Headline is the widest rung's
+    restore-locality ratio (full assemble over one rank's slice reads) —
+    the portable signal on any host, since it counts bytes moved, not
+    cores; the save fan-out ratios ride alongside and are LOWER bounds
+    on a small container (thread-per-shard writers time-slice the cores
+    a pod would dedicate per host)."""
+    from benchmarks.bench_ckpt import run_ladder, summarize
+
+    sizes = tuple(
+        int(s) for s in os.environ.get("BENCH_CKPT_MB", "64,256").split(",")
+    )
+    rows = run_ladder(sizes_mb=sizes, n_iters=3)
+    summary = summarize(rows)
+    return {
+        "metric": "sharded_ckpt_full_over_slice_restore",
+        "value": summary["full_load_over_slice_load"],
+        "unit": "x",
+        # self-relative locality ratio, not a reference comparison
+        "vs_baseline": None,
+        "zip_over_sharded_save": summary["zip_over_sharded_save"],
+        "zip_over_max_shard_save": summary["zip_over_max_shard_save"],
+        "size_mb": summary["size_mb"],
+        "fsdp": summary["fsdp"],
+        "rows": rows,
+        "host_cpu_count": os.cpu_count(),
+    }
+
+
 def bench_serve():
     """Inference-service ladder (benchmarks/bench_inference.py): request
     latency p50/p95 + actions/s for 1/2/4 env workers x batch deadline,
@@ -966,6 +998,7 @@ def child_main(section, out_path):
         "loop": bench_loop,
         "jaxenv": bench_jaxenv,
         "replay": bench_replay,
+        "ckpt": bench_ckpt,
         "serve": bench_serve,
         "ppo": bench_ppo,
         "sac": bench_sac,

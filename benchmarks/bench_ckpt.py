@@ -1,0 +1,185 @@
+"""Checkpoint-plane ladder (ISSUE 17): single-zip vs sharded directory
+saves and restores, vs state size x fsdp shard count.
+
+The distributed checkpoint format (``resilience/sharded_ckpt.py``) buys
+two things over the single-zip v1 format it dispatches alongside:
+
+* **save fan-out** — each fsdp rank's shard file is written by its own
+  double-buffered async writer, so the save wall-clock is bounded by the
+  LARGEST shard (plus the manifest stitch), not the whole state.  On a
+  real pod the writers are separate processes on separate hosts; on this
+  single host the thread-per-shard fan-out is the same code path, so the
+  measured win is a LOWER bound set by how much the per-shard zip/fsync
+  work overlaps on the available cores (``host_cpu_count`` is recorded).
+* **restore locality** — ``load_sharded_slices(f', rank)`` reads only
+  the saved shard files that intersect the caller's slice
+  (``reshard_plan``), so a resharded restore moves ~1/f' of the bytes a
+  full assemble does.
+
+Every (size, fsdp) rung times four legs INTERLEAVED, min-of-N per leg
+(same discipline as bench_replay_sampling: interleaving decorrelates the
+page-cache and CPU-frequency drift a sequential A-then-B pair would bake
+into whichever leg ran second):
+
+* zip ``save_state`` / ``load_state`` — the f=1 baseline pair
+* sharded ``save_sharded(f)`` / ``load_sharded`` (global assemble)
+* ``load_sharded_slices(f, rank=0)`` — the per-process restore
+* ``validate_manifest`` — the refusal matrix's happy-path cost (what
+  autoresume pays per candidate before trusting it)
+
+The state is a synthetic model-shaped pytree (square matmul kernels +
+bias vectors + scalar step counters, all dims divisible by 8) — the
+format never inspects semantics, only shapes, so real agent states at
+the same byte count time identically (bench_ckpt_xl.py covers the real
+DV3-XL state for the zip path).
+
+Usage: python benchmarks/bench_ckpt.py \
+           [--sizes-mb 64 256] [--iters 3] [--fsdp 1 2 4 8] \
+           [--out benchmarks/results/ckpt_r17.json]
+"""
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_state(total_mb: int, seed: int = 0) -> dict:
+    """Model-shaped pytree of ~``total_mb`` MB: (1024, 1024) f32 kernels
+    (4 MB each, every dim divisible by 8 so all fsdp sizes shard them
+    equally) + small bias vectors + the scalar bookkeeping leaves a real
+    ``ckpt_state`` carries."""
+    rng = np.random.default_rng(seed)
+    n_layers = max(1, total_mb // 4)
+    params = {
+        f"layer_{i}": {
+            "kernel": rng.standard_normal((1024, 1024), dtype=np.float32),
+            "bias": rng.standard_normal((1024,), dtype=np.float32),
+        }
+        for i in range(n_layers)
+    }
+    return {"params": params, "iter_num": 1234, "batch_size": 64}
+
+
+def _timed(fn, timings: list) -> None:
+    tic = time.perf_counter()
+    fn()
+    timings.append(time.perf_counter() - tic)
+
+
+def run_ladder(sizes_mb=(64, 256), fsdp_sizes=(1, 2, 4, 8), n_iters=3) -> list:
+    from sheeprl_tpu.resilience.sharded_ckpt import (
+        load_sharded,
+        load_sharded_slices,
+        save_sharded,
+        validate_manifest,
+    )
+    from sheeprl_tpu.utils.ckpt_format import load_state, save_state
+
+    rows = []
+    for size_mb in sizes_mb:
+        state = build_state(size_mb)
+        actual_mb = (
+            sum(l["kernel"].nbytes + l["bias"].nbytes for l in state["params"].values())
+            / 1e6
+        )
+        root = tempfile.mkdtemp(prefix=f"bench_ckpt_{size_mb}_")
+        zip_path = os.path.join(root, "state.ckpt")
+        legs = {f: {"save": [], "load": [], "slice": [], "validate": []} for f in fsdp_sizes}
+        zip_save, zip_load = [], []
+        stats_by_f = {}
+        try:
+            for _ in range(n_iters):
+                # interleaved: one full pass of every leg per iteration
+                _timed(lambda: save_state(zip_path, state), zip_save)
+                _timed(lambda: load_state(zip_path), zip_load)
+                for f in fsdp_sizes:
+                    dpath = os.path.join(root, f"state_f{f}.dckpt")
+                    shutil.rmtree(dpath, ignore_errors=True)
+                    tic = time.perf_counter()
+                    stats_by_f[f] = save_sharded(dpath, state, fsdp_size=f)
+                    legs[f]["save"].append(time.perf_counter() - tic)
+                    _timed(lambda d=dpath: validate_manifest(d), legs[f]["validate"])
+                    _timed(lambda d=dpath: load_sharded(d), legs[f]["load"])
+                    _timed(
+                        lambda d=dpath, ff=f: load_sharded_slices(d, ff, 0),
+                        legs[f]["slice"],
+                    )
+        finally:
+            shutil.rmtree(root, ignore_errors=True)
+        sharded_rows = []
+        for f in fsdp_sizes:
+            st = stats_by_f[f]
+            sharded_rows.append(
+                {
+                    "fsdp": f,
+                    "save_s": round(min(legs[f]["save"]), 4),
+                    "load_s": round(min(legs[f]["load"]), 4),
+                    "slice_load_s": round(min(legs[f]["slice"]), 4),
+                    "validate_s": round(min(legs[f]["validate"]), 4),
+                    # from the save's own stats: the slowest single shard
+                    # writer (= the pod-scale save wall-clock, where each
+                    # shard has its own host) + the manifest stitch
+                    "max_shard_write_s": round(st["max_shard_write_s"], 4),
+                    "stitch_s": round(st["stitch_s"], 4),
+                }
+            )
+        rows.append(
+            {
+                "size_mb": round(actual_mb, 1),
+                "zip_save_s": round(min(zip_save), 4),
+                "zip_load_s": round(min(zip_load), 4),
+                "sharded": sharded_rows,
+            }
+        )
+    return rows
+
+
+def summarize(rows: list) -> dict:
+    """Headline ratios off the largest-size rung, widest fsdp."""
+    top = rows[-1]
+    widest = top["sharded"][-1]
+    return {
+        "size_mb": top["size_mb"],
+        "fsdp": widest["fsdp"],
+        # single-host wall ratio (thread fan-out; lower-bound on a small box)
+        "zip_over_sharded_save": round(top["zip_save_s"] / widest["save_s"], 3),
+        # pod-scale ratio: each shard writer on its own host, so the save
+        # costs max-shard + stitch
+        "zip_over_max_shard_save": round(
+            top["zip_save_s"] / (widest["max_shard_write_s"] + widest["stitch_s"]), 3
+        ),
+        # restore locality: full assemble vs one rank's slices
+        "full_load_over_slice_load": round(widest["load_s"] / widest["slice_load_s"], 3),
+        "host_cpu_count": os.cpu_count(),
+    }
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--sizes-mb", type=int, nargs="+", default=[64, 256])
+    parser.add_argument("--fsdp", type=int, nargs="+", default=[1, 2, 4, 8])
+    parser.add_argument("--iters", type=int, default=3)
+    parser.add_argument("--out", default=None, help="write the result JSON here")
+    args = parser.parse_args()
+
+    rows = run_ladder(tuple(args.sizes_mb), tuple(args.fsdp), args.iters)
+    result = {"rows": rows, "summary": summarize(rows)}
+    print(json.dumps(result, indent=2))
+    if args.out:
+        os.makedirs(os.path.dirname(args.out), exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=2)
+        print(f"wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -45,6 +45,16 @@ retransmit / digest-skip machinery (retrans_failed == 0) and finish
 rc=0; plus an rb_insert leg (``rb_corrupt`` quarantined at ingest) and
 a paired off-vs-crc leg whose final agent params must be bit-exact.
 
+``--mode ckpt`` is the ISSUE 17 acceptance harness: an fsdp (4x2 mesh)
+a2c run with ``checkpoint.sharded=true`` is SIGKILLed mid-shard-write
+(``ckpt_shard_kill``) — the manifest never commits, so the directory
+stays partial — then the SAME root is relaunched with
+``checkpoint.resume_from=auto`` onto a DIFFERENT mesh (2x4): auto-resume
+must refuse the partial directory, resume from the last COMPLETE
+manifest, reshard the restored state onto the new fsdp axis, and finish
+rc=0 — with the ``ckpt`` telemetry key carrying the per-shard write /
+manifest stitch stats in both phases.
+
 Serve acceptance (ISSUE 8)::
 
     python scripts/chaos_soak.py --mode serve --seed 7
@@ -52,6 +62,10 @@ Serve acceptance (ISSUE 8)::
 Integrity acceptance (ISSUE 10)::
 
     python scripts/chaos_soak.py --mode integrity --seed 7
+
+Sharded-checkpoint acceptance (ISSUE 17)::
+
+    python scripts/chaos_soak.py --mode ckpt --seed 7
 
 all wrapped by ``chaos``/``slow``-marked pytest soaks.  The schedules
 are pure functions of ``--seed``, so a failing soak reproduces exactly.
@@ -753,17 +767,184 @@ def run_integrity_mode(args) -> int:
     return 0
 
 
+# ------------------------------------------------------------------- ckpt
+def _ckpt_cli_code(root: str, mesh_shape: str, seed: int, total_steps: int, resume: bool) -> str:
+    """The a2c fsdp leg as a ``python -c`` payload: phase 1 must run in a
+    SUBPROCESS because ``ckpt_shard_kill`` SIGKILLs the writing process —
+    in-process it would take the soak harness down with it."""
+    cli = [
+        "exp=a2c",
+        "env=dummy",
+        "env.sync_env=True",
+        "env.capture_video=False",
+        "env.num_envs=8",
+        "fabric.accelerator=cpu",
+        "fabric.devices=8",
+        "fabric.strategy=fsdp",
+        f"fabric.mesh_shape={mesh_shape}",
+        "metric.log_level=1",
+        "metric.log_every=64",
+        f"metric.logger.root_dir={root}/logs",
+        "checkpoint.save_last=True",
+        "checkpoint.every=64",
+        "checkpoint.sharded=True",
+        "buffer.memmap=False",
+        f"seed={seed}",
+        f"algo.total_steps={total_steps}",
+        "algo.rollout_steps=8",
+        "algo.per_rank_batch_size=8",
+        "algo.dense_units=8",
+        "algo.mlp_layers=1",
+        "algo.mlp_keys.encoder=[state]",
+        "algo.run_test=False",
+        f"root_dir={root}/run",
+    ]
+    if resume:
+        cli.append("checkpoint.resume_from=auto")
+    return "import sys; sys.path.insert(0, {!r})\nfrom sheeprl_tpu.cli import run\nrun({!r})".format(
+        _REPO_ROOT, cli
+    )
+
+
+def _scan_dckpts(run_root: str):
+    """(complete, partial) sharded-checkpoint directories under a run
+    root: complete == the manifest committed (the rename is the atomicity
+    point), partial == a writer died before it."""
+    dckpts = sorted(glob.glob(os.path.join(run_root, "**", "ckpt_*.dckpt"), recursive=True))
+    complete = [d for d in dckpts if os.path.exists(os.path.join(d, "MANIFEST.json"))]
+    return complete, [d for d in dckpts if d not in complete]
+
+
+def read_ckpt_stats(root_dir: str):
+    """Every ``ckpt``-keyed telemetry record under a run root (the
+    CheckpointManager stats the PR-1 sink interleaves)."""
+    from sheeprl_tpu.obs.reader import iter_run_records
+
+    out = []
+    for rec in iter_run_records(root_dir):
+        if rec.get("ckpt"):
+            out.append(rec["ckpt"])
+    return out
+
+
+def run_ckpt_mode(args) -> int:
+    """ISSUE 17 acceptance: kill-mid-shard-write must leave a PARTIAL
+    directory auto-resume walks past, and the relaunch must reshard the
+    last COMPLETE manifest onto a different mesh and finish rc=0."""
+    import shutil
+    import subprocess
+
+    total_steps = 1280 if args.total_steps == 19200 else args.total_steps
+    base = args.root_dir
+    shutil.rmtree(base, ignore_errors=True)
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=8",
+    )
+    env.pop("SHEEPRL_FAULTS", None)
+    failures = []
+
+    # ---- phase 1: 4x2 mesh, killed during the SECOND checkpoint's shard
+    # writes (hits 1-2 are checkpoint #1's two shards; hit 3 is #2's first)
+    print("ckpt leg phase 1 (4x2): SHEEPRL_FAULTS=ckpt_shard_kill:3")
+    p1 = subprocess.run(
+        [sys.executable, "-c", _ckpt_cli_code(base, "4x2", args.seed, total_steps, resume=False)],
+        env=dict(env, SHEEPRL_FAULTS="ckpt_shard_kill:3"),
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+    if p1.returncode != -9:
+        failures.append(f"phase 1 exited rc={p1.returncode}, expected SIGKILL (-9)")
+    complete, partial = _scan_dckpts(os.path.join(base, "run"))
+    if not complete:
+        failures.append("phase 1 left no COMPLETE manifest before the kill")
+    if not partial:
+        failures.append("phase 1 left no partial directory (kill landed outside a save?)")
+    stats1 = read_ckpt_stats(os.path.join(base, "run"))
+    if not any(s.get("sharded") and s.get("shards") == 2 for s in stats1):
+        failures.append("phase 1 telemetry never carried 2-shard ckpt stats")
+    runs1 = set(glob.glob(os.path.join(base, "run", "*")))
+
+    # ---- phase 2: same root, DIFFERENT mesh (2x4 -> fsdp 2 becomes 4),
+    # resume_from=auto must refuse the partial dir and reshard the rest
+    print("ckpt leg phase 2 (2x4): checkpoint.resume_from=auto")
+    p2 = subprocess.run(
+        [sys.executable, "-c", _ckpt_cli_code(base, "2x4", args.seed, total_steps, resume=True)],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+    if p2.returncode != 0:
+        failures.append(
+            f"phase 2 exited rc={p2.returncode}: {p2.stdout[-1500:]}{p2.stderr[-1500:]}"
+        )
+    expect = f"auto-resume: resuming from {complete[-1]}" if complete else "auto-resume:"
+    if expect not in p2.stdout:
+        failures.append(f"phase 2 did not resume from the last complete manifest {complete[-1:]}")
+    if "skipping corrupt checkpoint" not in (p2.stdout + p2.stderr):
+        failures.append("phase 2 never reported walking past the partial directory")
+
+    # the relaunch re-sharded onto the new mesh: its committed manifests
+    # carry fsdp_size 4, and its telemetry a 4-shard ckpt section
+    complete2, _ = _scan_dckpts(os.path.join(base, "run"))
+    new_manifests = [d for d in complete2 if d not in complete]
+    if not new_manifests:
+        failures.append("phase 2 committed no new manifest")
+    else:
+        for d in new_manifests:
+            with open(os.path.join(d, "MANIFEST.json")) as f:
+                doc = json.load(f)
+            if int(doc["fsdp_size"]) != 4:
+                failures.append(f"{os.path.basename(d)} has fsdp_size {doc['fsdp_size']}, not 4")
+        from sheeprl_tpu.utils.ckpt_format import validate_checkpoint
+
+        validate_checkpoint(new_manifests[-1], check_finite=True, check_digests=True)
+    runs2 = sorted(set(glob.glob(os.path.join(base, "run", "*"))) - runs1)
+    stats2 = []
+    for rd in runs2:
+        stats2 += read_ckpt_stats(rd)
+    if not any(s.get("sharded") for s in stats2):
+        failures.append("phase 2 telemetry never carried sharded ckpt stats")
+
+    print(
+        json.dumps(
+            {
+                "phase1_rc": p1.returncode,
+                "complete": [os.path.basename(d) for d in complete],
+                "partial": [os.path.basename(d) for d in partial],
+                "phase2_rc": p2.returncode,
+                "new_manifests": [os.path.basename(d) for d in new_manifests],
+                "last_ckpt_stats": (stats2 or stats1 or [None])[-1],
+                "failures": failures,
+            },
+            indent=2,
+        )
+    )
+    if not args.keep:
+        shutil.rmtree(base, ignore_errors=True)
+    if failures:
+        print("CKPT CHAOS SOAK FAILED", file=sys.stderr)
+        return 1
+    print("ckpt chaos soak passed")
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument(
         "--mode",
         default="topology",
-        choices=("topology", "health", "serve", "integrity"),
+        choices=("topology", "health", "serve", "integrity", "ckpt"),
         help=(
             "topology: kill/rejoin soak (ISSUE 6); health: training sentinel proof "
             "(ISSUE 7); serve: inference-service failure envelope (ISSUE 8); "
             "integrity: bit_flip detection/recovery on all three transports + "
-            "rb_insert quarantine + off-vs-crc bit-exactness (ISSUE 10)"
+            "rb_insert quarantine + off-vs-crc bit-exactness (ISSUE 10); "
+            "ckpt: sharded-checkpoint kill-mid-shard + auto-resume onto a "
+            "different mesh (ISSUE 17)"
         ),
     )
     ap.add_argument(
@@ -799,6 +980,10 @@ def main(argv=None) -> int:
         if args.root_dir == "/tmp/sheeprl_chaos_soak":
             args.root_dir = "/tmp/sheeprl_chaos_integrity"
         return run_integrity_mode(args)
+    if args.mode == "ckpt":
+        if args.root_dir == "/tmp/sheeprl_chaos_soak":
+            args.root_dir = "/tmp/sheeprl_chaos_ckpt"
+        return run_ckpt_mode(args)
     if args.mode == "serve":
         if args.root_dir == "/tmp/sheeprl_chaos_soak":
             args.root_dir = "/tmp/sheeprl_chaos_serve"

@@ -118,7 +118,15 @@ def resume_from_checkpoint(cfg: dotdict) -> dotdict:
                 "keep_last": cfg.checkpoint.keep_last,
                 "save_last": cfg.checkpoint.save_last,
                 "async_save": cfg.checkpoint.get("async_save", True),
+                "sharded": cfg.checkpoint.get("sharded", False),
+                "device_digests": cfg.checkpoint.get("device_digests", False),
             },
+            # the mesh is a RESTART-TIME choice: sharded checkpoints restore
+            # with resharding (resilience/sharded_ckpt.py), so the resuming
+            # invocation's fabric section (devices/strategy/mesh_shape) wins
+            # over the saved one — a 4x2 run resumes onto 2x4, 8x1 or a
+            # single device without the old mesh pinning it
+            "fabric": {k: v for k, v in cfg.fabric.items()},
             "metric": {
                 "log_every": cfg.metric.log_every,
                 "log_level": cfg.metric.log_level,

@@ -38,6 +38,8 @@ __all__ = [
     "BATCH_AXES",
     "ShardingLayout",
     "parse_mesh_shape",
+    "shard_dim_for",
+    "shard_slice",
 ]
 
 DATA_AXIS = "data"
@@ -45,6 +47,42 @@ FSDP_AXIS = "fsdp"
 # the flattened batch axes: batch dims shard over data x fsdp together,
 # so world_size (the number of batch shards) is always every device
 BATCH_AXES = (DATA_AXIS, FSDP_AXIS)
+
+
+def shard_dim_for(shape: Sequence[int], fsdp_size: int) -> Optional[int]:
+    """The dim the fsdp axis shards for a leaf of ``shape``: its LARGEST
+    dim divisible by ``fsdp_size`` (picking the first divisible dim can
+    hit a small leading axis — e.g. a conv kernel's spatial dim —
+    producing tiny shards and halo all-gathers); None when the leaf stays
+    replicated (``fsdp_size`` 1, scalars, indivisible shapes).
+
+    Pure and deterministic in (shape, fsdp_size) alone — the SAME rule
+    drives :meth:`ShardingLayout.param_spec` (live placement) and the
+    sharded checkpoint plane (resilience/sharded_ckpt.py), so a shard
+    file written under one mesh maps onto any other mesh's layout
+    without recording per-leaf placement decisions."""
+    f = int(fsdp_size)
+    shape = tuple(int(s) for s in shape)
+    if f <= 1:
+        return None
+    return max(
+        (d for d, s in enumerate(shape) if s >= f and s % f == 0),
+        key=lambda d: shape[d],
+        default=None,
+    )
+
+
+def shard_slice(shape: Sequence[int], dim: int, n_shards: int, rank: int) -> Tuple[slice, ...]:
+    """Index tuple selecting shard ``rank`` of ``n_shards`` equal splits
+    along ``dim`` of a leaf of ``shape`` (the slice a device on fsdp
+    coordinate ``rank`` owns under :func:`shard_dim_for`'s layout)."""
+    size = int(shape[dim])
+    if size % int(n_shards):
+        raise ValueError(f"dim {dim} of {tuple(shape)} does not split into {n_shards} shards")
+    per = size // int(n_shards)
+    idx = [slice(None)] * len(shape)
+    idx[dim] = slice(int(rank) * per, (int(rank) + 1) * per)
+    return tuple(idx)
 
 
 def parse_mesh_shape(spec: Any, n_devices: int, strategy: str = "auto") -> Tuple[int, int]:
@@ -128,19 +166,13 @@ class ShardingLayout:
         return NamedSharding(self.mesh, P())
 
     def param_spec(self, shape: Sequence[int]) -> P:
-        """ZeRO layout for one leaf: its LARGEST dim divisible by the fsdp
-        axis is sharded over ``fsdp`` (picking the first divisible dim can
-        hit a small leading axis — e.g. a conv kernel's spatial dim —
-        producing tiny shards and halo all-gathers); scalars and
-        indivisible leaves stay replicated."""
-        f = self.fsdp_size
+        """ZeRO layout for one leaf: :func:`shard_dim_for`'s pick sharded
+        over ``fsdp``; scalars and indivisible leaves stay replicated.
+        The dim rule lives in the module-level helper so the sharded
+        checkpoint plane applies the identical rule without a mesh."""
         shape = tuple(shape)
-        best = max(
-            (d for d, s in enumerate(shape) if s >= f and s % f == 0),
-            key=lambda d: shape[d],
-            default=None,
-        )
-        if f == 1 or best is None:
+        best = shard_dim_for(shape, self.fsdp_size)
+        if best is None:
             return P()
         spec = [None] * len(shape)
         spec[best] = FSDP_AXIS

@@ -14,7 +14,10 @@ Five parts:
   corruption falls back to the previous one;
 - :mod:`~sheeprl_tpu.resilience.faults` + :mod:`~sheeprl_tpu.resilience.peer`
   — the fault-injection harness (``SHEEPRL_FAULTS``) and peer-death
-  detection for the decoupled topologies.
+  detection for the decoupled topologies;
+- :mod:`~sheeprl_tpu.resilience.sharded_ckpt` — distributed checkpoints
+  (``checkpoint.sharded``): per-fsdp-shard parallel writes stitched by a
+  manifest that commits last, and restore-with-resharding onto any mesh.
 
 See ``howto/resilience.md`` for the operational model.
 """
@@ -51,6 +54,13 @@ from sheeprl_tpu.resilience.peer import (
     queue_get_from_peer,
 )
 from sheeprl_tpu.resilience.preemption import PreemptionHandler
+from sheeprl_tpu.resilience.sharded_ckpt import (
+    load_sharded,
+    load_sharded_slices,
+    reshard_plan,
+    save_sharded,
+    validate_manifest,
+)
 from sheeprl_tpu.resilience.supervisor import (
     PlayerSupervisor,
     ServeSupervisor,
@@ -82,10 +92,15 @@ __all__ = [
     "get_injector",
     "hard_exit_point",
     "list_checkpoints",
+    "load_sharded",
+    "load_sharded_slices",
     "maybe_drop_or_delay_send",
     "parent_alive",
     "queue_get_from_peer",
     "resolve_auto_resume",
+    "reshard_plan",
+    "save_sharded",
     "strip_player_faults",
     "supervisor_knobs",
+    "validate_manifest",
 ]

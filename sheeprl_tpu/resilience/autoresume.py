@@ -24,11 +24,17 @@ from sheeprl_tpu.utils.ckpt_format import CheckpointCorruptError, validate_check
 
 
 def list_checkpoints(scan_root: str) -> List[str]:
-    """All ``ckpt_*.ckpt`` files under ``scan_root`` (recursive), newest
-    mtime first. Emergency peer-death dumps (``emergency_*.ckpt``) are
+    """All ``ckpt_*.ckpt`` files AND ``ckpt_*.dckpt`` sharded-checkpoint
+    directories under ``scan_root`` (recursive), newest mtime first.
+    Partial sharded directories (writer died before the manifest commit)
+    are listed too — the VALIDATION gate refuses them, which is exactly
+    how auto-resume walks past a crash-torn save to the previous
+    complete one.  Emergency peer-death dumps (``emergency_*.ckpt``) are
     intentionally excluded — they carry partial state."""
-    pattern = os.path.join(glob.escape(scan_root), "**", "ckpt_*.ckpt")
-    ckpts = glob.glob(pattern, recursive=True)
+    root = glob.escape(scan_root)
+    ckpts = glob.glob(os.path.join(root, "**", "ckpt_*.ckpt"), recursive=True) + glob.glob(
+        os.path.join(root, "**", "ckpt_*.dckpt"), recursive=True
+    )
 
     def _mtime(p: str) -> float:
         try:

@@ -24,6 +24,17 @@ Instrumented sites:
                             and SIGKILLs the process (writer killed mid-write)
 ``ckpt_truncate``           ``save_state`` truncates the FINAL ``.ckpt`` after
                             the atomic rename (torn block-device write)
+``ckpt_shard_kill``         one SHARD writer of a sharded checkpoint
+                            (``*.dckpt``, resilience/sharded_ckpt.py) is
+                            SIGKILLed with its shard file half-written — the
+                            manifest never commits, the directory stays
+                            partial, and auto-resume must walk past it to the
+                            last COMPLETE manifest
+``manifest_truncate``       a sharded checkpoint's committed ``MANIFEST.json``
+                            is truncated after its atomic rename (torn
+                            block-device write at the commit point itself);
+                            ``validate_manifest`` must refuse the directory
+
 ``queue_drop``              a decoupled IPC send is silently dropped
 ``queue_delay``             a decoupled IPC send sleeps ``arg`` seconds first
 ``env_step_raise``          the env-step guard's inner ``env.step`` raises
@@ -99,6 +110,8 @@ ENV_VAR = "SHEEPRL_FAULTS"
 KNOWN_SITES = (
     "ckpt_kill_mid_write",
     "ckpt_truncate",
+    "ckpt_shard_kill",
+    "manifest_truncate",
     "queue_drop",
     "queue_delay",
     "env_step_raise",

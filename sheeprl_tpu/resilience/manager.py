@@ -88,6 +88,11 @@ class CheckpointManager:
         self.save_last = bool(ckpt_cfg.save_last)
         self.async_save = bool(ckpt_cfg.get("async_save", True))
         self.allow_nonfinite = bool(ckpt_cfg.get("allow_nonfinite", False))
+        # checkpoint.sharded: write `.dckpt` directories (per-fsdp-shard
+        # parallel writes + manifest-commits-last, sharded_ckpt.py)
+        # instead of the single-process zip — the shard count is the live
+        # mesh's fsdp axis, so shard files mirror the device layout
+        self.sharded = bool(ckpt_cfg.get("sharded", False))
         self.log_dir = log_dir
         # training-health sentinel hook (resilience/sentinel.py): when a
         # TrainHealth binds itself here, every save is tagged in the
@@ -97,6 +102,7 @@ class CheckpointManager:
         self.cb = CheckpointCallback(
             keep_last=ckpt_cfg.keep_last,
             device_digests=bool(ckpt_cfg.get("device_digests", False)),
+            fsdp_size=int(getattr(runtime, "fsdp_size", 1)) if self.sharded else 1,
         )
         self.writer = (
             AsyncCheckpointWriter(self.cb.write)
@@ -129,10 +135,11 @@ class CheckpointManager:
 
     # --------------------------------------------------------------- saves
     def ckpt_path(self, policy_step: int) -> str:
+        suffix = "dckpt" if self.sharded else "ckpt"
         return os.path.join(
             self.log_dir or ".",
             "checkpoint",
-            f"ckpt_{policy_step}_{self._runtime.global_rank}.ckpt",
+            f"ckpt_{policy_step}_{self._runtime.global_rank}.{suffix}",
         )
 
     def maybe_checkpoint(
@@ -221,7 +228,9 @@ class CheckpointManager:
 
     # --------------------------------------------------------------- stats
     def stats(self) -> Dict[str, Any]:
-        """Telemetry payload: loop stall vs background write seconds."""
+        """Telemetry payload: loop stall vs background write seconds;
+        sharded saves add the per-shard write seconds and the manifest
+        stitch seconds of the latest committed checkpoint."""
         out: Dict[str, Any] = {
             "async": self.async_save,
             "saves": self.saves,
@@ -235,6 +244,15 @@ class CheckpointManager:
         else:
             out["last_write_s"] = round(self.last_stall_s, 6)
             out["total_write_s"] = round(self._sync_write_s, 6)
+        if self.sharded:
+            out["sharded"] = True
+            s = self.cb.last_sharded_stats
+            if s is not None:
+                out["shards"] = s["shards"]
+                out["last_shard_write_s"] = s["shard_write_s"]
+                out["last_max_shard_write_s"] = s["max_shard_write_s"]
+                out["last_stitch_s"] = s["stitch_s"]
+            out["total_stitch_s"] = round(self.cb.total_stitch_s, 6)
         return out
 
     # --------------------------------------------------------------- close

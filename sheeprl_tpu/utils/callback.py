@@ -25,11 +25,25 @@ import numpy as np
 class CheckpointCallback:
     """keep-last-N checkpoint writer."""
 
-    def __init__(self, keep_last: Optional[int] = None, device_digests: bool = False):
+    def __init__(
+        self,
+        keep_last: Optional[int] = None,
+        device_digests: bool = False,
+        fsdp_size: int = 1,
+    ):
         self.keep_last = keep_last
         # checkpoint.device_digests: manifest leaf digests via ONE batched
         # device program instead of the per-leaf host CRC walk
         self.device_digests = bool(device_digests)
+        # shard count for `.dckpt` directory targets (checkpoint.sharded):
+        # the mesh's fsdp axis size — the shard layout must match what
+        # the live params are actually split into
+        self.fsdp_size = max(1, int(fsdp_size))
+        # stats of the most recent sharded write (per-shard seconds +
+        # manifest stitch), read by CheckpointManager.stats(); written on
+        # the async writer thread, read from the loop — plain dict swap
+        self.last_sharded_stats: Optional[Dict[str, Any]] = None
+        self.total_stitch_s = 0.0
 
     # ------------------------------------------------------------------ #
     # buffer consistency (reference _ckpt_rb / _experiment_consistent_rb)
@@ -117,11 +131,25 @@ class CheckpointCallback:
     def write(self, ckpt_path: Union[str, os.PathLike], host_state: Dict[str, Any]) -> str:
         """Serialize an already-snapshotted host state to disk (manifest
         encoding + zip write — the slow half; safe off-thread) and apply the
-        keep-last retention policy."""
-        from sheeprl_tpu.utils.ckpt_format import save_state
-
+        keep-last retention policy.  A ``*.dckpt`` target routes to the
+        sharded plane (per-shard parallel writes + manifest-commits-last,
+        resilience/sharded_ckpt.py); anything else stays the v1 zip."""
         path = Path(ckpt_path)
-        save_state(path, host_state, device_digests=self.device_digests)
+        if str(path).endswith(".dckpt"):
+            from sheeprl_tpu.resilience.sharded_ckpt import save_sharded
+
+            stats = save_sharded(
+                path,
+                host_state,
+                fsdp_size=self.fsdp_size,
+                device_digests=self.device_digests,
+            )
+            self.total_stitch_s += stats["stitch_s"]
+            self.last_sharded_stats = stats
+        else:
+            from sheeprl_tpu.utils.ckpt_format import save_state
+
+            save_state(path, host_state, device_digests=self.device_digests)
         if self.keep_last:
             self._delete_old_checkpoints(path.parent)
         return str(path)
@@ -186,9 +214,15 @@ class CheckpointCallback:
         checkpoint: if every file in the kept window is corrupt (e.g. the
         latest write raced a crash), the newest candidate that still
         validates is spared even if it falls outside the window — a resume
-        must always have something to land on."""
+        must always have something to land on.  Sharded checkpoint
+        DIRECTORIES participate in the same window (``_is_valid``
+        dispatches; a partial directory counts as corrupt, so crashed
+        saves age out of the window like torn zips do)."""
         try:
-            ckpts = sorted(ckpt_folder.glob("ckpt_*.ckpt"), key=os.path.getmtime)
+            ckpts = sorted(
+                list(ckpt_folder.glob("ckpt_*.ckpt")) + list(ckpt_folder.glob("ckpt_*.dckpt")),
+                key=os.path.getmtime,
+            )
         except OSError:
             return
         if len(ckpts) <= self.keep_last:
@@ -204,7 +238,12 @@ class CheckpointCallback:
             if c == spare:
                 continue
             try:
-                os.unlink(c)
+                if c.is_dir():
+                    import shutil
+
+                    shutil.rmtree(c, ignore_errors=True)
+                else:
+                    os.unlink(c)
             except OSError:
                 pass
 
@@ -227,11 +266,21 @@ def load_checkpoint(
     the next save writes v1).  ``select`` limits a v1 load to the given
     top-level keys without reading the other leaves off disk.  A file that
     is neither a readable v1 zip nor a loadable pickle raises
-    :class:`~sheeprl_tpu.utils.ckpt_format.CheckpointCorruptError`."""
+    :class:`~sheeprl_tpu.utils.ckpt_format.CheckpointCorruptError`.
+
+    Sharded checkpoint DIRECTORIES (``*.dckpt``) load through
+    :func:`~sheeprl_tpu.resilience.sharded_ckpt.load_sharded`: global
+    leaves are re-assembled from the shard slices, so every existing
+    consumer — resume paths, the serve hot-swap loader, obs tooling —
+    reads sharded checkpoints through this same call."""
     from sheeprl_tpu.utils.ckpt_format import CheckpointCorruptError, is_v1, load_state
 
     if not os.path.exists(path):
         raise FileNotFoundError(f"checkpoint not found: {path}")
+    if os.path.isdir(path):
+        from sheeprl_tpu.resilience.sharded_ckpt import load_sharded
+
+        return load_sharded(path, select=select)
     if is_v1(path):
         return load_state(path, select=select)
     # is_v1 is False for BOTH pickles and truncated v1 zips: a file that

@@ -330,7 +330,13 @@ def spot_check_finite(path: Union[str, os.PathLike], max_leaves: int = 8) -> Non
     params written before the sentinel (or with it disabled) — raises
     :class:`CheckpointCorruptError`, so ``resume_from=auto`` and the
     sentinel's rollback skip it instead of resuming divergence.  Pre-v1
-    pickles are skipped (no manifest to walk)."""
+    pickles are skipped (no manifest to walk); sharded checkpoint
+    DIRECTORIES dispatch to the per-shard spot check."""
+    if os.path.isdir(path):
+        from sheeprl_tpu.resilience.sharded_ckpt import spot_check_finite_sharded
+
+        spot_check_finite_sharded(path, max_leaves=max_leaves)
+        return
     if not is_v1(path):
         return
     try:
@@ -370,7 +376,18 @@ def validate_checkpoint(
     digests (``leaf_crc``): bit rot that left a SELF-CONSISTENT zip
     behind (content + member CRC rewritten together) fails here and
     nowhere else.  Checkpoints older than the digest layer (no
-    ``leaf_crc`` key) skip the digest pass silently."""
+    ``leaf_crc`` key) skip the digest pass silently.
+
+    Sharded checkpoint DIRECTORIES (``*.dckpt``, resilience/sharded_ckpt.py)
+    dispatch to :func:`~sheeprl_tpu.resilience.sharded_ckpt.validate_manifest`
+    with the same raise/return contract, so every caller of this gate —
+    auto-resume, rollback's ``find_last_good``, keep-last retention, the
+    serve hot-swap watcher — handles both formats without knowing which
+    one it is looking at."""
+    if os.path.isdir(path):
+        from sheeprl_tpu.resilience.sharded_ckpt import validate_manifest
+
+        return validate_manifest(path, check_finite=check_finite, check_digests=check_digests)
     path = Path(path)
     try:
         if path.stat().st_size == 0:

@@ -245,3 +245,37 @@ def test_resume_honors_new_metric_knobs(tmp_path):
     assert merged.metric.log_level == 0
     assert merged.metric.fetch_every == 16
     assert merged.metric.disable_timer is True
+
+
+@pytest.mark.ckpt
+def test_resume_honors_new_fabric_mesh(tmp_path):
+    """The mesh is a RESTART-TIME choice (ISSUE 17): sharded checkpoints
+    restore with resharding, so the resuming invocation's fabric section
+    (devices/strategy/mesh_shape) must win over the checkpoint's saved
+    config — otherwise a 4x2 run could never resume onto 2x4 or one
+    device through the CLI."""
+    from sheeprl_tpu.cli import resume_from_checkpoint
+    from sheeprl_tpu.config.compose import compose
+    from sheeprl_tpu.utils.utils import dotdict
+
+    ckpt = _train_and_get_ckpt(tmp_path, root="cli_fabric")
+    cfg = dotdict(
+        compose(
+            overrides=_ppo_args(tmp_path, root="cli_fabric")
+            + [
+                f"checkpoint.resume_from={ckpt}",
+                "fabric.devices=8",
+                "fabric.strategy=fsdp",
+                "fabric.mesh_shape=2x4",
+                "checkpoint.sharded=True",
+            ]
+        )
+    )
+    merged = resume_from_checkpoint(cfg)
+    assert merged.fabric.devices == 8
+    assert merged.fabric.strategy == "fsdp"
+    assert merged.fabric.mesh_shape == "2x4"
+    # the checkpoint FORMAT follows the resuming invocation too: a resume
+    # chain can switch zip -> sharded (the loader dispatches on what it
+    # actually finds on disk, not on this flag)
+    assert merged.checkpoint.sharded is True

@@ -15,12 +15,32 @@ import glob
 import hashlib
 import json
 import os
+import time
 
 import jax
 import numpy as np
 import pytest
 
 pytestmark = pytest.mark.serve
+
+
+def _slowdown_factor() -> float:
+    """Measured box-speed anchor for the respawn smoke's deadlines
+    (ROADMAP PR-16 caveat: the same suite ran ~2x slower on a later box,
+    and absolute serve timeouts then sit inside LEGITIMATE request
+    latency, tripping breakers the assertions don't expect).  A fixed
+    CPU workload is timed against its reference-box seconds; the serve
+    timing knobs scale by the ratio, clamped to [1, 4] so a fast box
+    keeps the original envelope and a pathological one can't stretch the
+    test into the suite budget."""
+    t0 = time.perf_counter()
+    rng = np.random.default_rng(0)
+    a = rng.normal(size=(512, 512)).astype(np.float32)
+    for _ in range(20):
+        a = a @ a.T / 512.0
+    dt = time.perf_counter() - t0
+    _REF_S = 0.06  # the box class the 0.25s/1.0s knobs were tuned on
+    return min(4.0, max(1.0, dt / _REF_S))
 
 
 def _base_args(tmp_path, sub, total_steps=4800, extra=()):
@@ -69,14 +89,23 @@ def _agent_md5(root):
     return h.hexdigest()
 
 
+@pytest.mark.slow
 @pytest.mark.chaos
 def test_serve_smoke_server_kill_fallback_respawn(tmp_path, monkeypatch):
     """The ISSUE 8 chaos acceptance: with server_exit armed, the serve
     smoke shows breaker trip -> local fallback -> server respawn ->
     breaker half-open re-promotion, with zero lost/double-acted
-    observations (request-id audit in telemetry) and rc=0."""
+    observations (request-id audit in telemetry) and rc=0.
+
+    Split behind the ``slow`` marker (ISSUE 17 / ROADMAP PR-16 caveat):
+    the 9600-step respawn leg flaked IN-SUITE on ~2x-slower boxes —
+    breaker re-promotion raced the run's end — while the deterministic
+    respawn/drain-recover units in test_service.py keep the envelope
+    covered in tier-1.  The timing knobs additionally scale off the
+    measured box anchor so the leg is stable wherever it runs."""
     from sheeprl_tpu.cli import run
 
+    k = _slowdown_factor()
     monkeypatch.setenv("SHEEPRL_FAULTS", "server_exit:40")
     run(
         _base_args(
@@ -85,11 +114,11 @@ def test_serve_smoke_server_kill_fallback_respawn(tmp_path, monkeypatch):
             total_steps=9600,
             extra=(
                 "algo.inference=remote",
-                "algo.serve.request_timeout_s=0.25",
+                f"algo.serve.request_timeout_s={0.25 * k}",
                 "algo.serve.max_retries=1",
                 "algo.serve.breaker_threshold=2",
-                "algo.serve.breaker_cooldown_s=1.0",
-                "algo.serve.restart_backoff_s=0.2",
+                f"algo.serve.breaker_cooldown_s={1.0 * k}",
+                f"algo.serve.restart_backoff_s={0.2 * k}",
             ),
         )
     )

@@ -279,6 +279,53 @@ def test_hot_swap_refuses_quarantined_and_corrupt_swaps_good(tmp_path):
         hub.close()
 
 
+def _write_dckpt(path, value, fsdp_size=2):
+    from sheeprl_tpu.resilience.sharded_ckpt import save_sharded
+
+    save_sharded(path, {"agent": {"w": np.full((4,), value, np.float32)}}, fsdp_size=fsdp_size)
+    return str(path)
+
+
+@pytest.mark.ckpt
+def test_hot_swap_from_sharded_manifest_refuses_partial(tmp_path):
+    """The ISSUE-17 serve acceptance: the watcher swaps directly from a
+    good sharded MANIFEST (no zip in sight, zero dropped requests) and
+    refuses a partial directory — a writer that died before the commit
+    point — exactly like a torn zip."""
+    from sheeprl_tpu.resilience.sharded_ckpt import MANIFEST_NAME
+    from sheeprl_tpu.serve import agent_params_loader
+
+    ckpt_dir = tmp_path / "run" / "checkpoint"
+    os.makedirs(ckpt_dir)
+    initial = _write_dckpt(str(ckpt_dir / "ckpt_100_0.dckpt"), 1.0)
+    srv, (pc,), hub, _ = _rig()
+    loader = agent_params_loader("agent")
+    srv.swap_params(loader(initial)["w"][0], source=os.path.abspath(initial))
+    srv.watch(str(tmp_path / "run"), lambda p: loader(p)["w"][0], interval_s=1e6)
+    srv.start()
+    c = InferenceClient(pc, 0, request_timeout_s=5.0)
+    try:
+        out, _ = c.infer(_obs(1, fill=0.0), 1)
+        np.testing.assert_allclose(out["actions"], 1.0)
+        good = _write_dckpt(str(ckpt_dir / "ckpt_200_0.dckpt"), 5.0)
+        time.sleep(0.02)
+        partial = _write_dckpt(str(ckpt_dir / "ckpt_300_0.dckpt"), 9.0)
+        os.remove(os.path.join(partial, MANIFEST_NAME))  # crash mid-write
+        with pytest.warns(UserWarning, match="REFUSED"):
+            swapped = srv.poll_hot_swap()
+        assert swapped == os.path.abspath(good)
+        st = srv.stats()["swaps"]
+        assert st["applied"] == 1 and st["refused_invalid"] == 1
+        # zero dropped requests: serving continues on the swapped params
+        out, src = c.infer(_obs(1, fill=0.0), 1)
+        assert src == "remote"
+        np.testing.assert_allclose(out["actions"], 5.0)
+    finally:
+        srv.close()
+        c.close()
+        hub.close()
+
+
 def test_hot_swap_holds_off_pending_until_promoted(tmp_path):
     from sheeprl_tpu.resilience.sentinel import CheckpointHealthTags
     from sheeprl_tpu.serve import agent_params_loader

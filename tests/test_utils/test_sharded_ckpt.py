@@ -1,0 +1,405 @@
+"""Distributed sharded checkpointing (ISSUE 17): manifest atomicity,
+validation refusal matrix, restore-with-resharding golden parity, the
+new fault sites, and the manager/auto-resume/health-tag integration.
+
+Everything here is unit-scale (tier-1 has no budget slack): the meshes
+are the conftest's 8 fake CPU devices, states are KB-sized, and the only
+subprocess is the one ``ckpt_shard_kill`` test that must actually die.
+"""
+
+import hashlib
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.parallel.sharding import build_mesh, shard_dim_for, shard_slice
+from sheeprl_tpu.resilience.sharded_ckpt import (
+    MANIFEST_NAME,
+    load_sharded,
+    load_sharded_slices,
+    reshard_plan,
+    save_sharded,
+    validate_manifest,
+)
+from sheeprl_tpu.utils.callback import load_checkpoint
+from sheeprl_tpu.utils.ckpt_format import CheckpointCorruptError, validate_checkpoint
+
+pytestmark = pytest.mark.ckpt
+
+
+def _state(seed=0):
+    """A checkpoint-shaped state with the interesting leaf geometries:
+    divisible dims, a dim whose shard pick CHANGES with f ((4, 6): dim 1
+    under f=2, dim 0 under f=4), an indivisible leaf, scalars, ints,
+    nested containers."""
+    rng = np.random.default_rng(seed)
+    return {
+        "agent": {
+            "dense": {"w": rng.normal(size=(16, 32)).astype(np.float32)},
+            "w_flip": rng.normal(size=(4, 6)).astype(np.float32),
+            "b_odd": rng.normal(size=(3,)).astype(np.float32),
+            "scale": np.float32(0.5),
+        },
+        "optimizer": (
+            np.arange(64, dtype=np.int64).reshape(4, 16),
+            {"mu": rng.normal(size=(32,)).astype(np.float64)},
+        ),
+        "iter_num": 7,
+    }
+
+
+def _md5(tree) -> str:
+    import jax
+
+    h = hashlib.md5()
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        h.update(str(path).encode())
+        h.update(np.ascontiguousarray(np.asarray(leaf)).tobytes())
+    return h.hexdigest()
+
+
+def _save(tmp_path, state=None, f=2, name="ckpt_100_0.dckpt"):
+    path = str(tmp_path / name)
+    save_sharded(path, state if state is not None else _state(), fsdp_size=f)
+    return path
+
+
+# --------------------------------------------------------------------------- #
+# format + atomicity
+# --------------------------------------------------------------------------- #
+def test_roundtrip_bit_exact_and_layout(tmp_path):
+    state = _state()
+    path = _save(tmp_path, state, f=2)
+    names = sorted(os.listdir(path))
+    assert names == [MANIFEST_NAME, "shard_00000.npz", "shard_00001.npz"]
+    assert _md5(load_sharded(path)) == _md5(state)
+    # replicated leaves (odd dim, scalars, the int counter) live ONLY in
+    # shard 0; sharded leaves appear in every shard at 1/f size
+    doc = json.load(open(os.path.join(path, MANIFEST_NAME)))
+    with np.load(os.path.join(path, "shard_00001.npz")) as z1:
+        for name in z1.files:
+            i = int(name.split("_")[1])
+            leaf = doc["leaves"][i]
+            assert leaf["shard_dim"] is not None
+            assert z1[name].shape[leaf["shard_dim"]] * 2 == leaf["shape"][leaf["shard_dim"]]
+
+
+def test_validate_dispatch_and_stats_summary(tmp_path):
+    """The shared gate (`validate_checkpoint`) dispatches on the
+    directory, so every existing caller gets sharded support."""
+    path = _save(tmp_path)
+    info = validate_checkpoint(path, check_finite=True, check_digests=True)
+    assert info["shards"] == 2 and info["n_leaves"] == 6
+    assert "agent" in info["keys"]
+
+
+def test_select_restricts_shard_reads(tmp_path):
+    path = _save(tmp_path)
+    assert load_sharded(path, select=("iter_num",)) == {"iter_num": 7}
+    assert load_checkpoint(path, select=("iter_num",)) == {"iter_num": 7}
+
+
+def test_partial_dir_refused_and_walked_past(tmp_path):
+    """The atomicity point: a directory without a committed manifest is a
+    crash artifact — validation refuses it and auto-resume selects the
+    previous COMPLETE checkpoint."""
+    from sheeprl_tpu.resilience import find_latest_resumable
+
+    complete = _save(tmp_path / "run" / "checkpoint", name="ckpt_100_0.dckpt")
+    partial = _save(tmp_path / "run" / "checkpoint", name="ckpt_200_0.dckpt")
+    os.utime(partial, None)
+    os.remove(os.path.join(partial, MANIFEST_NAME))  # died before the commit
+    with pytest.raises(CheckpointCorruptError, match="partial sharded checkpoint"):
+        validate_checkpoint(partial)
+    with pytest.warns(UserWarning, match="skipping corrupt"):
+        assert find_latest_resumable(str(tmp_path / "run")) == complete
+
+
+def test_torn_manifest_refused(tmp_path):
+    path = _save(tmp_path)
+    mpath = os.path.join(path, MANIFEST_NAME)
+    with open(mpath, "r+b") as f:
+        f.truncate(os.path.getsize(mpath) // 2)
+    with pytest.raises(CheckpointCorruptError, match="torn manifest"):
+        validate_manifest(path)
+
+
+def test_missing_shard_refused(tmp_path):
+    path = _save(tmp_path)
+    os.remove(os.path.join(path, "shard_00001.npz"))
+    with pytest.raises(CheckpointCorruptError, match="missing shard"):
+        validate_manifest(path)
+
+
+def test_rotted_shard_digest_refused(tmp_path):
+    """Bit rot inside ONE shard file: the npz stays readable (zip CRC is
+    per-member but we rewrite it consistently), only the manifest's
+    per-member content digests can tell."""
+    import zipfile
+
+    path = _save(tmp_path)
+    fpath = os.path.join(path, "shard_00001.npz")
+    with zipfile.ZipFile(fpath) as z:
+        contents = {n: z.read(n) for n in z.namelist()}
+    victim = sorted(contents)[0]
+    data = bytearray(contents[victim])
+    data[-1] ^= 0x01
+    contents[victim] = bytes(data)
+    with zipfile.ZipFile(fpath, "w", compression=zipfile.ZIP_STORED) as z:
+        for n, c in contents.items():
+            z.writestr(n, c)
+    validate_manifest(path)  # structurally intact...
+    with pytest.raises(CheckpointCorruptError, match="content digest mismatch"):
+        validate_manifest(path, check_digests=True)  # ...but rotted
+
+
+def test_offmanifest_member_refused(tmp_path):
+    """A shard whose member set disagrees with the manifest's leaf table
+    (e.g. stale files from a half-swept re-save) is refused."""
+    path = _save(tmp_path)
+    fpath = os.path.join(path, "shard_00001.npz")
+    with np.load(fpath) as z:
+        members = {n: z[n] for n in z.files}
+    members["leaf_99"] = np.zeros(3)
+    np.savez(fpath, **members)
+    with pytest.raises(CheckpointCorruptError, match="off-manifest"):
+        validate_manifest(path)
+
+
+def test_nonfinite_spot_check(tmp_path):
+    state = _state()
+    state["agent"]["dense"]["w"][3, 5] = np.nan
+    path = _save(tmp_path, state)
+    validate_manifest(path)  # structure is fine
+    with pytest.raises(CheckpointCorruptError, match="non-finite"):
+        validate_manifest(path, check_finite=True)
+
+
+# --------------------------------------------------------------------------- #
+# restore-with-resharding golden parity
+# --------------------------------------------------------------------------- #
+def test_golden_reshard_4x2_to_2x4_8x1_1dev(tmp_path):
+    """The acceptance golden: params placed on a REAL 4x2 mesh, sharded-
+    saved, then restored onto 2x4, 8x1 and a single device — agent params
+    bit-exact (md5) in every direction, with per-rank slice loads
+    agreeing with each target mesh's own layout."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    from sheeprl_tpu.parallel.sharding import ShardingLayout
+
+    devices = jax.devices()
+    assert len(devices) >= 8, "conftest forces 8 fake CPU devices"
+    state = _state(seed=3)
+    ref = _md5(state["agent"])
+
+    src = ShardingLayout(build_mesh(devices[:8], "4x2"))
+    placed = jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, NamedSharding(src.mesh, src.param_spec(np.shape(x)))),
+        state["agent"],
+    )
+    host = jax.tree_util.tree_map(lambda x: np.array(x), jax.device_get(placed))
+    assert _md5(host) == ref  # placement itself is lossless
+    path = str(tmp_path / "ckpt_100_0.dckpt")
+    save_sharded(path, {"agent": host, "iter_num": 1}, fsdp_size=src.fsdp_size)
+
+    for mesh_shape, n_dev in (("2x4", 8), ("8x1", 8), ("1x1", 1)):
+        dst = ShardingLayout(build_mesh(devices[:n_dev], mesh_shape))
+        restored = load_sharded(path, select=("agent",))["agent"]
+        replaced = jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, NamedSharding(dst.mesh, dst.param_spec(np.shape(x)))),
+            restored,
+        )
+        back = jax.tree_util.tree_map(lambda x: np.array(x), jax.device_get(replaced))
+        assert _md5(back) == ref, f"restore into {mesh_shape} not bit-exact"
+        # per-rank slice loads must equal what the target layout's own
+        # rule assigns each fsdp coordinate
+        f_new = dst.fsdp_size
+        slices = [
+            load_sharded_slices(path, f_new, r, select=("agent",))["agent"]
+            for r in range(f_new)
+        ]
+        for keypath, leaf in jax.tree_util.tree_flatten_with_path(restored)[0]:
+            got = [
+                dict(jax.tree_util.tree_flatten_with_path(s)[0])[keypath] for s in slices
+            ]
+            dim = shard_dim_for(np.shape(leaf), f_new)
+            if dim is None:
+                for g in got:
+                    np.testing.assert_array_equal(g, leaf)
+            else:
+                for r, g in enumerate(got):
+                    np.testing.assert_array_equal(
+                        g, np.asarray(leaf)[shard_slice(np.shape(leaf), dim, f_new, r)]
+                    )
+
+
+def test_reshard_plan_covers_exactly():
+    """Slice-intersection arithmetic: every (f_old, f_new, rank) plan
+    tiles the new rank's range exactly, in order, with no overlap."""
+    for length in (8, 16, 24):
+        for f_old in (1, 2, 4, 8):
+            for f_new in (1, 2, 4, 8):
+                if length % f_old or length % f_new:
+                    continue
+                covered = []
+                for rank in range(f_new):
+                    per_old = length // f_old
+                    for old_rank, start, stop in reshard_plan(length, f_old, f_new, rank):
+                        covered.extend(range(old_rank * per_old + start, old_rank * per_old + stop))
+                assert covered == list(range(length)), (length, f_old, f_new)
+
+
+def test_slice_load_reads_only_intersecting_shards(tmp_path):
+    """A same-f restore of rank r must not touch the other ranks' shard
+    files at all (on a pod: each process pulls only its own bytes)."""
+    state = {"agent": {"w": np.arange(64.0, dtype=np.float32).reshape(8, 8)}}
+    path = _save(tmp_path, state, f=4)
+    for r in (0, 1, 2):  # leave only shard 3
+        os.remove(os.path.join(path, f"shard_0000{r}.npz"))
+    got = load_sharded_slices(path, 4, 3)["agent"]["w"]
+    # the dim rule ties toward the first max-size dim: (8, 8) shards dim 0
+    np.testing.assert_array_equal(got, np.arange(64.0, dtype=np.float32).reshape(8, 8)[6:, :])
+
+
+# --------------------------------------------------------------------------- #
+# fault sites
+# --------------------------------------------------------------------------- #
+def test_manifest_truncate_fault_site(tmp_path, monkeypatch):
+    """``manifest_truncate`` tears the committed manifest; the directory
+    must be refused and auto-resume must fall back."""
+    from sheeprl_tpu.resilience.faults import get_injector
+
+    complete = _save(tmp_path / "checkpoint", name="ckpt_100_0.dckpt")
+    monkeypatch.setenv("SHEEPRL_FAULTS", "manifest_truncate")
+    get_injector()
+    torn = _save(tmp_path / "checkpoint", name="ckpt_200_0.dckpt")
+    monkeypatch.setenv("SHEEPRL_FAULTS", "")
+    get_injector()
+    with pytest.raises(CheckpointCorruptError, match="torn manifest"):
+        validate_checkpoint(torn)
+    from sheeprl_tpu.resilience import find_latest_resumable
+
+    with pytest.warns(UserWarning, match="skipping corrupt"):
+        assert find_latest_resumable(str(tmp_path)) == complete
+
+
+def test_ckpt_shard_kill_leaves_partial_dir(tmp_path):
+    """``ckpt_shard_kill`` SIGKILLs the process with one shard file
+    half-written: the manifest never commits, and the next run's
+    auto-resume walks past the partial directory. Runs in a subprocess
+    because the site really does kill the writer."""
+    script = (
+        "import numpy as np\n"
+        "from sheeprl_tpu.resilience.sharded_ckpt import save_sharded\n"
+        "state = {'agent': {'w': np.zeros((64, 64), np.float32)}}\n"
+        f"save_sharded(r'{tmp_path}/ckpt_200_0.dckpt', state, fsdp_size=2)\n"
+        "print('UNREACHABLE')\n"
+    )
+    env = dict(os.environ, SHEEPRL_FAULTS="ckpt_shard_kill", JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-c", script], env=env, capture_output=True, text=True, timeout=120
+    )
+    assert proc.returncode == -9, proc.stderr  # SIGKILL, not a clean exit
+    assert "UNREACHABLE" not in proc.stdout
+    partial = tmp_path / "ckpt_200_0.dckpt"
+    assert partial.is_dir() and not (partial / MANIFEST_NAME).exists()
+    with pytest.raises(CheckpointCorruptError, match="partial sharded checkpoint"):
+        validate_checkpoint(partial)
+
+
+# --------------------------------------------------------------------------- #
+# manager + health-tag integration
+# --------------------------------------------------------------------------- #
+class _Runtime:
+    is_global_zero = True
+    global_rank = 0
+    fsdp_size = 2
+
+
+class _Cfg:
+    class checkpoint:
+        every = 10
+        save_last = False
+        keep_last = 2
+
+        @staticmethod
+        def get(key, default=None):
+            return {"async_save": False, "sharded": True}.get(key, default)
+
+
+def test_manager_sharded_path_stats_and_retention(tmp_path):
+    from sheeprl_tpu.resilience import CheckpointManager
+
+    mgr = CheckpointManager(_Runtime(), _Cfg(), str(tmp_path))
+    try:
+        paths = [
+            mgr.checkpoint_now(policy_step=s, state_fn=lambda: _state(seed=s))
+            for s in (10, 20, 30)
+        ]
+        assert all(p.endswith(".dckpt") for p in paths)
+        st = mgr.stats()
+        assert st["sharded"] and st["shards"] == 2
+        assert len(st["last_shard_write_s"]) == 2
+        assert st["last_stitch_s"] >= 0 and st["total_stitch_s"] > 0
+        # keep_last=2 retention removed the oldest DIRECTORY
+        assert not os.path.exists(paths[0])
+        for p in paths[1:]:
+            validate_checkpoint(p, check_digests=True)
+        assert _md5(load_checkpoint(paths[-1])["agent"]) == _md5(_state(seed=30)["agent"])
+    finally:
+        mgr.close()
+
+
+def test_health_tags_key_on_manifest_dir(tmp_path):
+    """PR-7 quarantine keys on the checkpoint BASENAME — for a sharded
+    checkpoint that is the manifest directory, so quarantine/resume
+    semantics carry over unchanged."""
+    from sheeprl_tpu.resilience import find_latest_resumable
+    from sheeprl_tpu.resilience.sentinel import CheckpointHealthTags, is_quarantined
+
+    ckpt_dir = tmp_path / "run" / "checkpoint"
+    good = _save(ckpt_dir, name="ckpt_100_0.dckpt")
+    bad = _save(ckpt_dir, name="ckpt_200_0.dckpt")
+    os.utime(bad, None)
+    tags = CheckpointHealthTags(str(ckpt_dir))
+    tags.note_save(bad, 0)
+    tags.quarantine_pending()
+    assert is_quarantined(bad) and not is_quarantined(good)
+    with pytest.warns(UserWarning, match="quarantined"):
+        assert find_latest_resumable(str(tmp_path / "run")) == good
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_ckpt_chaos_soak_kill_resume_reshard(tmp_path):
+    """The ISSUE 17 acceptance soak (scripts/chaos_soak.py --mode ckpt):
+    an fsdp a2c run SIGKILLed mid-shard-write leaves a partial .dckpt,
+    and the auto-resume relaunch onto a DIFFERENT mesh walks past it,
+    reshards the last complete manifest, and finishes rc=0."""
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("SHEEPRL_FAULTS", None)
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(repo, "scripts", "chaos_soak.py"),
+            "--mode",
+            "ckpt",
+            "--seed",
+            "7",
+            "--root-dir",
+            str(tmp_path / "ckpt_soak"),
+        ],
+        cwd=repo,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=900,
+    )
+    assert proc.returncode == 0, proc.stdout[-3000:] + proc.stderr[-3000:]
+    assert "ckpt chaos soak passed" in proc.stdout
