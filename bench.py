@@ -114,6 +114,7 @@ SECTIONS = [
     ("dec", 300),
     ("fanin", 140),
     ("transport", 240),
+    ("wire", 160),
     ("mesh", 560),
     ("superbench", 200),
 ]
@@ -569,6 +570,34 @@ def bench_transport():
     }
 
 
+def bench_wire():
+    """Wire-format v2 ladder (ISSUE 19): paired v1-vs-v2 legs through the
+    real Channel API at tree-shaped rungs up to 1 MB / 32 leaves,
+    streamed at a 6-frame window and interleaved min-of-N (the same
+    noise protocol as the transport section).  The headline is the 1 MB
+    tcp SPEEDUP of the scatter-gather codec over the pickled-metadata v1
+    path (gated: higher is better, unit "x"), so a regression in the v2
+    fast path — an extra copy sneaking into the gather list, a lost
+    socket-buffer tune — fails the perf gate even while both codecs stay
+    correct."""
+    from benchmarks.bench_shm_transport import run_wire_ladder
+
+    n_msgs = int(os.environ.get("BENCH_TRANSPORT_MSGS", 150))
+    rows = run_wire_ladder(n_msgs=n_msgs)
+    top = rows[-1]  # the 1 MB row
+    return {
+        "metric": "wire_v2_tcp_1mb_speedup_x",
+        "value": top["tcp_v2_speedup_x"],
+        "unit": "x",
+        "vs_baseline": None,
+        "tcp_v1_us_per_msg": top["tcp_v1_us_per_msg"],
+        "tcp_v2_us_per_msg": top["tcp_v2_us_per_msg"],
+        "shm_v2_speedup_x": top.get("shm_v2_speedup_x"),
+        "rows": rows,
+        "host_cpu_count": os.cpu_count(),
+    }
+
+
 def bench_mesh():
     """Sharded-train ladder (ISSUE 12): PPO + compact DV3 update step at
     1/2/4/8 host-platform mesh devices, DP and FSDP legs.  Runs in a
@@ -1006,6 +1035,7 @@ def child_main(section, out_path):
         "dec": bench_dec,
         "fanin": bench_fanin,
         "transport": bench_transport,
+        "wire": bench_wire,
         "mesh": bench_mesh,
         "superbench": bench_superbench,
     }[section]()

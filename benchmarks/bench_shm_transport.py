@@ -137,19 +137,26 @@ def _chan_consumer(spec, ack_q, n_msgs, flight_dir=None):
 
 
 def _run_channel_mode(
-    backend: str, payload, n_msgs: int, integrity: str, tracing: str = "off", flight_dir=None
+    backend: str,
+    payload,
+    n_msgs: int,
+    integrity: str,
+    tracing: str = "off",
+    flight_dir=None,
+    wire_format: str = "v1",
 ) -> float:
     """Seconds/message through the REAL Channel API (hub -> player
-    direction), identical code paths apart from ``integrity``/``tracing``
-    — so the paired delta measures exactly what the guard layer adds
-    (checksum or trace records at send, verification/recv records at
-    receive) and nothing else."""
+    direction), identical code paths apart from ``integrity``/``tracing``/
+    ``wire_format`` — so the paired delta measures exactly what the
+    toggled layer adds (or, for the wire codec, saves) and nothing else."""
     ctx = mp.get_context("spawn")
     if tracing != "off":
         from sheeprl_tpu.obs import flight
 
         flight.configure("bench_tx", flight_dir, mode=tracing)
-    hub, specs = make_transport(ctx, backend, 1, min_bytes=0, integrity=integrity, tracing=tracing)
+    hub, specs = make_transport(
+        ctx, backend, 1, min_bytes=0, integrity=integrity, tracing=tracing, wire_format=wire_format
+    )
     ack_q = ctx.Queue()
     proc = ctx.Process(
         target=_chan_consumer,
@@ -210,6 +217,104 @@ def run_integrity_ladder(n_msgs: int = 150, sizes_mb=(0.25, 1), repeats: int = 3
             row[f"{backend}_crc_overhead_pct"] = round(
                 (best["crc"] / best["off"] - 1.0) * 100, 2
             )
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+    return rows
+
+
+def _tree_payload(nbytes: int, n_leaves: int):
+    """Pytree-shaped payload: (matrix, bias) pairs like a params
+    broadcast or a dict-obs rollout shard.  Leaf COUNT is the axis the
+    wire format changes — v1 pays one pickle entry + one ``sendall``
+    per leaf, v2 a cached table row + one slot in a single gather write
+    — so the ladder must ship realistic trees, not four flat blocks."""
+    rng = np.random.default_rng(0)
+    pairs = max(n_leaves // 2, 1)
+    per = max(nbytes // (4 * pairs), 64)
+    payload = []
+    for i in range(pairs):
+        payload.append((f"p/{i:03d}/w", rng.normal(size=(per // 64, 64)).astype(np.float32)))
+        payload.append((f"p/{i:03d}/b", rng.normal(size=(64,)).astype(np.float32)))
+    return payload
+
+
+def _stream_consumer(spec, ack_q, n_msgs):
+    chan = spec.player_channel()
+    try:
+        for _ in range(n_msgs):
+            frame = chan.recv(timeout=60)
+            frame.release()
+            del frame  # drop the views before the arena teardown
+        ack_q.put(n_msgs)
+    finally:
+        chan.close()
+
+
+def _run_channel_stream(
+    backend: str, payload, n_msgs: int, wire_format: str, window: int = 6
+) -> float:
+    """Seconds/message at STREAMING rate: the sender keeps ``window``
+    frames in flight (the credit gate is the only brake) and the clock
+    stops when the consumer acks the last frame.  This is the honest
+    protocol for a transport whose job is overlapped rollout shipping —
+    a per-message ping-pong ack would serialize both codecs behind the
+    same context-switch floor and measure the scheduler, not the wire."""
+    ctx = mp.get_context("spawn")
+    hub, specs = make_transport(
+        ctx, backend, 1, min_bytes=0, window=window, wire_format=wire_format
+    )
+    ack_q = ctx.Queue()
+    proc = ctx.Process(target=_stream_consumer, args=(specs[0], ack_q, n_msgs))
+    proc.start()
+    try:
+        chan = hub.channel(0, timeout=60, peer_alive=proc.is_alive)
+        warm = n_msgs // 10 + 1
+        t0 = 0.0
+        for i in range(n_msgs):
+            if i == warm:
+                t0 = time.perf_counter()
+            chan.send("data", arrays=payload, seq=i, timeout=60)
+        ack_q.get(timeout=120)
+        return (time.perf_counter() - t0) / (n_msgs - warm)
+    finally:
+        hub.close()
+        proc.join(timeout=30)
+        if proc.is_alive():
+            proc.terminate()
+
+
+# (payload_mb, leaves) rungs: a small rollout shard, a dict-obs shard,
+# and a params-tree-sized broadcast — the 1 MB tcp rung is the ISSUE-19
+# acceptance headline
+WIRE_RUNGS = ((0.0625, 8), (0.25, 16), (1, 32))
+
+
+def run_wire_ladder(n_msgs: int = 150, rungs=WIRE_RUNGS, repeats: int = 3, backends=("tcp", "shm")):
+    """Paired v1-vs-v2 wire-format legs (ISSUE 19 acceptance: v2 holds
+    >= 1.5x on the 1 MB tcp rung).  Interleaved min-of-N, like
+    :func:`run_integrity_ladder` — the two codecs alternate within each
+    repeat so scheduler noise perturbs both sides equally, and the
+    per-mode minimum feeds the speedup ratio; each leg runs the
+    streaming protocol (:func:`_run_channel_stream`)."""
+    rows = []
+    for size_mb, n_leaves in rungs:
+        payload = _tree_payload(int(size_mb * (1 << 20)), n_leaves)
+        actual = sum(int(a.nbytes) for _, a in payload)
+        n = max(min(n_msgs, int(64e6 / max(actual, 1))), 30)
+        row = {
+            "payload_mb": round(actual / (1 << 20), 3),
+            "leaves": len(payload),
+            "msgs": n,
+            "repeats": repeats,
+        }
+        for backend in backends:
+            best = {"v1": float("inf"), "v2": float("inf")}
+            for _ in range(repeats):
+                for wf in ("v1", "v2"):
+                    best[wf] = min(best[wf], _run_channel_stream(backend, payload, n, wf))
+            row[f"{backend}_v1_us_per_msg"] = round(best["v1"] * 1e6, 1)
+            row[f"{backend}_v2_us_per_msg"] = round(best["v2"] * 1e6, 1)
+            row[f"{backend}_v2_speedup_x"] = round(best["v1"] / best["v2"], 3)
         rows.append(row)
         print(json.dumps(row), flush=True)
     return rows

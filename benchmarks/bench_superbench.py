@@ -52,6 +52,9 @@ def _overrides(root: str, run_name: str, steps: int) -> list:
         "fabric.devices=8",
         "algo.num_players=2",
         "algo.decoupled_transport=tcp",
+        # the v2 scatter-gather wire format + overlapped player send
+        # pipeline (ISSUE 19): the fleet composition runs the fast path
+        "algo.wire_format=v2",
         "algo.rollout_steps=4",
         "algo.update_epochs=1",
         "algo.per_rank_batch_size=8",

@@ -63,6 +63,7 @@ from sheeprl_tpu.parallel.transport import (
     split_envs,
     transport_setting,
 )
+from sheeprl_tpu.parallel.wire import OverlappedSender, wire_setting
 from sheeprl_tpu.resilience.integrity import params_digest_fn
 from sheeprl_tpu.resilience import (
     CheckpointManager,
@@ -128,6 +129,13 @@ def decoupled_knobs(cfg) -> Dict[str, Any]:
     # soft bound past which a player still blocks.
     soft_lag = vtrace_on or supervisor["enabled"]
     max_lag = int(vt.get("max_lag", 4)) if vtrace_on else lag
+    wire_format = wire_setting(cfg)
+    # params_digest_device=null follows the wire format: v2 broadcasts
+    # compute the digest once on device (the PR-14 path) so the frame
+    # ships without re-staging; v1 keeps the host walk default
+    pdd = cfg.algo.get("params_digest_device", None)
+    if pdd is None:
+        pdd = wire_format == "v2"
     return {
         "backend": transport_setting(cfg),
         "num_players": int(cfg.algo.get("num_players", 1)),
@@ -155,13 +163,18 @@ def decoupled_knobs(cfg) -> Dict[str, Any]:
         # stream_digest_batched): one cached jit dispatch per message
         # instead of the per-leaf host CRC walk — pays when the leaves
         # are device-resident or numerous; both ends gate on this knob
-        "params_digest_device": bool(cfg.algo.get("params_digest_device", False)),
+        "params_digest_device": bool(pdd),
         # tcp length-prefix sanity cap (a corrupted prefix must not turn
         # into a multi-GB allocation)
         "max_frame_bytes": int(cfg.algo.get("tcp_max_frame_mb", 1024)) << 20,
         # fleet flight recorder (obs/flight.py): off constructs the
         # undecorated channel classes, sampled/full the traced variants
         "tracing": flight.tracing_setting(cfg),
+        # transport wire format (parallel/wire.py): v1 = the bit-exact
+        # pickled path, v2 = cached-table scatter-gather frames with
+        # coalescing and the players' overlapped send pipeline
+        "wire_format": wire_format,
+        "coalesce_ms": float(cfg.algo.get("wire_coalesce_ms", 2.0)),
     }
 
 
@@ -291,6 +304,13 @@ def _player_loop(
         if knobs["supervisor"]["enabled"]
         else None
     )
+    # wire-format v2: the data shard goes through the overlapped
+    # device→wire pipeline — submit() snapshots inline, the sampled-CRC
+    # digest and the socket write run on the pipeline thread while this
+    # process is already collecting the next rollout.  Anything that must
+    # order after the shard (checkpoint barrier, stop frame, direct sends
+    # on this channel) flushes first.
+    ov_sender = OverlappedSender(channel) if knobs["wire_format"] == "v2" else None
 
     # hand the agent blueprint to the trainer (reference broadcasts
     # agent_args from the player, :117); every player sends one so the
@@ -605,17 +625,18 @@ def _player_loop(
                 # this player's compact metrics summary (ISSUE 15).
                 # data_send feeds the ledger's transport bucket — credit
                 # stalls on a slow trainer surface here.
-                channel.send(
-                    "data",
-                    arrays=arrays,
-                    extra=(
-                        need_ckpt,
-                        follower.current_seq,
-                        live.beat(policy_step) if live is not None else None,
-                    ),
-                    seq=iter_num,
-                    timeout=timeout_s,
+                send_extra = (
+                    need_ckpt,
+                    follower.current_seq,
+                    live.beat(policy_step) if live is not None else None,
                 )
+                if ov_sender is not None:
+                    # stage 1 (snapshot) runs here; stages 2-3 (digest +
+                    # socket write) overlap the next collect.  A failed
+                    # prior send re-raises from this submit.
+                    ov_sender.submit("data", arrays, extra=send_extra, seq=iter_num, timeout=timeout_s)
+                else:
+                    channel.send("data", arrays=arrays, extra=send_extra, seq=iter_num, timeout=timeout_s)
         except PeerDiedError as e:
             _die_with_dump(e, policy_step, iter_num)
 
@@ -627,6 +648,10 @@ def _player_loop(
         if need_ckpt:
             try:
                 with trace_scope("ipc_wait_update"), flight.span("params_wait", round=iter_num):
+                    if ov_sender is not None:
+                        # the barrier orders after the shard: drain the
+                        # pipeline so the trainer sees this round's data
+                        ov_sender.flush(timeout=timeout_s)
                     frame = follower.advance_to(iter_num)
             except PeerDiedError as e:
                 _die_with_dump(e, policy_step, iter_num)
@@ -709,6 +734,11 @@ def _player_loop(
     # answers the final shard too, and a socket closed with UNREAD data
     # resets the connection — destroying the broadcast mid-send on the
     # trainer and the stop sentinel below with it
+    if ov_sender is not None:
+        try:
+            ov_sender.flush(timeout=30.0)  # final shard out before the drain/stop
+        except Exception:
+            pass
     try:
         frame = follower.advance_to(iter_num, timeout=60.0)
         if frame is not None:
@@ -736,6 +766,8 @@ def _player_loop(
             logger.log_metrics({"Test/cumulative_reward": test_rew}, policy_step)
     if logger:
         logger.finalize()
+    if ov_sender is not None:
+        ov_sender.close()
     channel.close()
     flight.close_recorder()
     obs_fleet.close_live()
@@ -769,6 +801,8 @@ def spawn_players(cfg, runtime, ctx, target, extra_args=(), knobs=None, with_inf
         integrity=knobs["integrity"],
         max_frame_bytes=knobs["max_frame_bytes"],
         tracing=knobs["tracing"],
+        wire_format=knobs["wire_format"],
+        coalesce_ms=knobs["coalesce_ms"],
     )
     infer_hub = infer_specs = None
     if with_inference:
@@ -787,6 +821,8 @@ def spawn_players(cfg, runtime, ctx, target, extra_args=(), knobs=None, with_inf
             integrity=knobs["integrity"],
             max_frame_bytes=knobs["max_frame_bytes"],
             tracing=knobs["tracing"],
+            wire_format=knobs["wire_format"],
+            coalesce_ms=knobs["coalesce_ms"],
         )
     procs = []
     # the env copies the parent's environ at start, so the override only

@@ -58,6 +58,7 @@ from sheeprl_tpu.parallel.transport import (
     assemble_shards,
     split_envs,
 )
+from sheeprl_tpu.parallel.wire import OverlappedSender
 from sheeprl_tpu.replay import (
     ReplayServer,
     ReplayWriter,
@@ -176,6 +177,10 @@ def _player_loop(
 
     channel = spec.player_channel(peer_alive=parent_alive, who="trainer")
     channel.send("init", extra=(observation_space, action_space))
+    # wire-format v2: ship the sampled batch through the overlapped
+    # device→wire pipeline (snapshot inline, digest + socket write on the
+    # pipeline thread); flush before anything that must order after it
+    ov_sender = OverlappedSender(channel) if knobs["wire_format"] == "v2" else None
 
     actor, critic, params, _ = build_agent(runtime, cfg, observation_space, action_space)
     actor_treedef = jax.tree_util.tree_structure(params["actor"])
@@ -432,17 +437,19 @@ def _player_loop(
                     with trace_scope("ipc_send_shard"), flight.span("data_send", round=update_round):
                         # slot 2: this player's live-metrics summary
                         # (ISSUE 15) — None when the plane is off
-                        channel.send(
-                            "data",
-                            arrays=sample,
-                            extra=(
-                                g,
-                                iter_num,
-                                live.beat(policy_step) if live is not None else None,
-                            ),
-                            seq=update_round,
-                            timeout=timeout_s,
+                        send_extra = (
+                            g,
+                            iter_num,
+                            live.beat(policy_step) if live is not None else None,
                         )
+                        if ov_sender is not None:
+                            ov_sender.submit(
+                                "data", sample, extra=send_extra, seq=update_round, timeout=timeout_s
+                            )
+                        else:
+                            channel.send(
+                                "data", arrays=sample, extra=send_extra, seq=update_round, timeout=timeout_s
+                            )
                     # fixed-lag adoption: after shipping round u, act on the
                     # actor of update u - lag (lag 0 = the lock-step protocol)
                     with trace_scope("ipc_wait_update"):
@@ -458,6 +465,8 @@ def _player_loop(
         # and save_last still checkpoint)
         if lead and ckpt_mgr.should_checkpoint(policy_step, is_last=iter_num == total_iters):
             try:
+                if ov_sender is not None:
+                    ov_sender.flush(timeout=timeout_s)  # ckpt_req orders after the shard
                 channel.send("ckpt_req", timeout=timeout_s)
                 frame = follower.wait_tag("ckpt_state")
             except PeerDiedError as e:
@@ -541,6 +550,11 @@ def _player_loop(
 
     # drain the in-flight params broadcast before closing — see
     # ppo_decoupled: an unread broadcast at close resets the connection
+    if ov_sender is not None:
+        try:
+            ov_sender.flush(timeout=30.0)  # final shard out before the drain/stop
+        except Exception:
+            pass
     try:
         frame = follower.advance_to(update_round, timeout=60.0)
         if frame is not None:
@@ -566,6 +580,8 @@ def _player_loop(
             logger.log_metrics({"Test/cumulative_reward": test_rew}, policy_step)
     if logger:
         logger.finalize()
+    if ov_sender is not None:
+        ov_sender.close()
     channel.close()
     flight.close_recorder()
     obs_fleet.close_live()

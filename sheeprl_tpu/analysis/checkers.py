@@ -41,7 +41,7 @@ _CLEANSE_QUALS = {
     "jax.numpy.copy",
     "copy.deepcopy",
 }
-_CLEANSE_NAMES = {"detach_copy", "deepcopy"}
+_CLEANSE_NAMES = {"detach_copy", "deepcopy", "arrays_copy"}
 
 _KEYISH_NAME = re.compile(r"(^|_)(key|keys|rng|rngs)$")
 
@@ -396,6 +396,11 @@ def _classify_borrowed(ctx: ModuleContext, node: ast.AST, npz_vars: Set[str]) ->
                 if kw.arg == "copy" and isinstance(kw.value, ast.Constant) and kw.value.value is True:
                     return None
             return "shm-ring slot view (unpack without copy=True)"
+        if isinstance(node.func, ast.Attribute) and node.func.attr == "leaf_views":
+            # wire.leaf_views returns np.frombuffer views into a pooled
+            # recv arena — recycled on frame release, same lifetime class
+            # as a shm slot (ISSUE 19)
+            return "wire-arena view (leaf_views)"
     if isinstance(node, ast.Subscript):
         base = node.value
         if isinstance(base, ast.Name) and base.id in npz_vars:

@@ -209,6 +209,10 @@ class ShmSender:
         self._arena: Optional[ShmArena] = None
         self._disabled = False
         self.fallbacks = 0  # payloads that did not fit and went over the queue
+        # wire-format v2 hook (parallel/transport.py): maps the packed
+        # leaves to a cached-table reference before they ride the control
+        # queue; None ships the full per-leaf list (v1)
+        self.encode_leaves = None
 
     def _ensure_arena(self, arrays: Sequence[Tuple[str, np.ndarray]]) -> None:
         if self._arena is not None or self._disabled:
@@ -249,6 +253,8 @@ class ShmSender:
             self._free_q.put(slot)  # slot unused; hand it back
             self.fallbacks += 1
             return False
+        if self.encode_leaves is not None:
+            leaves = self.encode_leaves(leaves)
         put_fn((tag, self._arena.info, slot, leaves) + tuple(extra))
         return True
 

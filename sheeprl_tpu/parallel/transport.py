@@ -58,7 +58,9 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from sheeprl_tpu.obs import flight
+from sheeprl_tpu.parallel import wire
 from sheeprl_tpu.parallel.shm_ring import ShmReceiver, ShmSender
+from sheeprl_tpu.parallel.wire import COAL_TAG, WireFormatError, wire_setting
 from sheeprl_tpu.replay.service import RB_CREDIT_TAG, RB_INSERT_TAG
 from sheeprl_tpu.resilience.faults import get_injector, maybe_drop_or_delay_send
 from sheeprl_tpu.resilience.integrity import (
@@ -102,11 +104,14 @@ __all__ = [
     "TcpChannel",
     "TcpListener",
     "TransportHub",
+    "WireFormatError",
     "assemble_shards",
     "assemble_shards_padded",
     "make_transport",
     "split_envs",
     "transport_setting",
+    "wire_channel_cls",
+    "wire_setting",
 ]
 
 # elastic-pool control tags: a (re)joining player announces itself with a
@@ -261,6 +266,14 @@ class Channel:
         self.bytes_recv = 0
         self.frames_sent = 0
         self.frames_recv = 0
+        # per-stream accounting (ISSUE 19): which TAG dominates the wire,
+        # not just "transport" — FanIn.stats merges these across channels
+        # into the telemetry transport key for obs.top / critical-path
+        self.bytes_by_tag: Dict[str, int] = {}
+        self.frames_by_tag: Dict[str, int] = {}
+        # adaptive tcp_compress: payloads whose probe page did not shrink
+        # and therefore skipped the full zlib pass
+        self.compress_skipped = 0
         # leak accounting (analysis/sanitizers.py): a channel that is never
         # close()d and never collected shows up in the suite-wide sweep
         from sheeprl_tpu.analysis.sanitizers import leak_registry
@@ -300,10 +313,23 @@ class Channel:
         self._leak_unregister()
 
     # helpers ----------------------------------------------------------
-    def _count_payload(self, arrays) -> int:
-        n = sum(int(np.asarray(a).nbytes) for _, a in arrays) if arrays else 0
-        self.bytes_sent += n
+    def _note_send(self, tag, nbytes: int) -> None:
+        self.bytes_sent += nbytes
         self.frames_sent += 1
+        if tag and not tag.startswith("__"):
+            self.bytes_by_tag[tag] = self.bytes_by_tag.get(tag, 0) + nbytes
+            self.frames_by_tag[tag] = self.frames_by_tag.get(tag, 0) + 1
+
+    def _note_recv(self, tag, nbytes: int) -> None:
+        self.bytes_recv += nbytes
+        self.frames_recv += 1
+        if tag and not tag.startswith("__"):
+            self.bytes_by_tag[tag] = self.bytes_by_tag.get(tag, 0) + nbytes
+            self.frames_by_tag[tag] = self.frames_by_tag.get(tag, 0) + 1
+
+    def _count_payload(self, arrays, tag=None) -> int:
+        n = sum(int(np.asarray(a).nbytes) for _, a in arrays) if arrays else 0
+        self._note_send(tag, n)
         return n  # callers on the integrity path reuse this total
 
 
@@ -350,9 +376,19 @@ class QueueChannel(Channel):
         self._send_q = send_q
         self._recv_q = recv_q
 
+    def _wrap_payload(self, arrays):
+        """Queue-message payload container (v1: a dict; the v2 variant
+        ships the buffer-donating items tuple unchanged)."""
+        return {k: np.asarray(v) for k, v in arrays} if arrays else None
+
+    def _wire_payload(self, items):
+        """Integrity-path payload container for an already-normalized
+        ``[(key, array), ...]`` list (v1: a dict; v2: a donating tuple)."""
+        return dict(items)
+
     def send(self, tag, arrays=None, extra=(), seq=-1, timeout=600.0) -> None:
-        payload = {k: np.asarray(v) for k, v in arrays} if arrays else None
-        self._count_payload(arrays)
+        payload = self._wrap_payload(arrays)
+        self._count_payload(arrays, tag)
         maybe_drop_or_delay_send(
             lambda m: _put_with_peer(self._send_q, m, timeout, self.peer_alive, self.who),
             (self._PICKLED, tag, seq, tuple(extra), payload),
@@ -375,9 +411,9 @@ class QueueChannel(Channel):
     def _decode(self, msg) -> Frame:
         assert msg[0] == self._PICKLED, f"unexpected message {msg[0]!r}"
         _, tag, seq, extra, payload = msg
-        self.frames_recv += 1
-        if payload:
-            self.bytes_recv += sum(int(v.nbytes) for v in payload.values())
+        if payload is not None and not isinstance(payload, dict):
+            payload = dict(payload)  # v2 buffer-donating items tuple
+        self._note_recv(tag, sum(int(v.nbytes) for v in payload.values()) if payload else 0)
         return Frame(tag, seq, extra, payload)
 
     def depth(self) -> Optional[int]:
@@ -424,18 +460,22 @@ class ShmChannel(QueueChannel):
                 ),
             )
             if sent:
-                self._count_payload(arrays)
+                self._count_payload(arrays, tag)
                 return
         super().send(tag, arrays=arrays, extra=extra, seq=seq, timeout=timeout)
+
+    def _resolve_leaves(self, leaves):
+        """Leaf metadata as shipped on the control queue (v1: the full
+        per-leaf list; the v2 variant resolves a cached-table reference)."""
+        return leaves
 
     def recv(self, timeout: float) -> Frame:
         msg = self._raw_recv(timeout)
         if msg[0] != self._SHM:
             return self._decode(msg)
         _, info, slot, leaves, tag, seq, extra = msg
-        views = self._rx.unpack(info, slot, leaves, copy=False)
-        self.frames_recv += 1
-        self.bytes_recv += sum(int(v.nbytes) for v in views.values())
+        views = self._rx.unpack(info, slot, self._resolve_leaves(leaves), copy=False)
+        self._note_recv(tag, sum(int(v.nbytes) for v in views.values()))
         return Frame(tag, seq, extra, views, release_cb=lambda: self._rx.release(slot))
 
     def close(self) -> None:
@@ -498,11 +538,18 @@ def _recv_exact_into(sock: socket.socket, mv: memoryview) -> None:
         got += n
 
 
-def _send_frame(sock, lock, tag, seq, extra, arrays, compress_min: int, crc: Optional[int] = None) -> int:
+def _send_frame(
+    sock, lock, tag, seq, extra, arrays, compress_min: int, crc: Optional[int] = None, owner=None
+) -> int:
     """Serialize + write one frame under ``lock``; returns payload bytes.
     ``crc`` (integrity mode) rides the meta tuple and flips the
     :data:`_FLAG_INTEGRITY` header bit — it covers the UNCOMPRESSED
-    payload, so the receiver verifies after any decompression."""
+    payload, so the receiver verifies after any decompression.
+
+    Compression is ADAPTIVE when ``owner`` is supplied: a zlib probe of
+    the first page decides whether the payload shrinks at all (float
+    rollout data is incompressible — paying zlib to gain nothing was the
+    ISSUE-19 satellite); skips are counted on ``owner.compress_skipped``."""
     leaves: List[Tuple] = []
     bufs: List[np.ndarray] = []
     off = 0
@@ -514,8 +561,15 @@ def _send_frame(sock, lock, tag, seq, extra, arrays, compress_min: int, crc: Opt
     flags = 0
     blob: Optional[bytes] = None
     if compress_min and 0 < compress_min <= off:
-        blob = zlib.compress(b"".join(memoryview(b).cast("B") for b in bufs), 1)
-        flags |= _FLAG_COMPRESSED
+        byte_views = [memoryview(b).cast("B") for b in bufs]
+        if owner is not None:
+            blob = wire.probe_compress(byte_views, off)
+            if blob is None:
+                owner.compress_skipped += 1
+        else:
+            blob = zlib.compress(b"".join(byte_views), 1)
+        if blob is not None:
+            flags |= _FLAG_COMPRESSED
     meta_tuple: Tuple = (tag, int(seq), tuple(extra), leaves, off)
     if crc is not None:
         flags |= _FLAG_INTEGRITY
@@ -557,19 +611,25 @@ class _BufferPool:
 
 
 def _read_frame(
-    sock, pool: _BufferPool, max_frame_bytes: int = TCP_MAX_FRAME_BYTES
+    sock, pool: _BufferPool, max_frame_bytes: int = TCP_MAX_FRAME_BYTES, prefix: bytes = b""
 ) -> Tuple[str, int, Tuple, List[Tuple], Any, Optional[int]]:
     """Read one frame; returns ``(tag, seq, extra, leaves, buffer, crc)``
     where ``buffer`` backs the array views (return it to ``pool`` on
     release; decompressed frames own a private bytes object instead) and
     ``crc`` is the integrity checksum (None for plain frames).
 
+    ``prefix`` is header bytes the caller already consumed — the v2
+    reader peeks the 2-byte magic to dispatch between wire formats and
+    hands the peeked bytes back here for the v1 path.
+
     The length prefix is SANITY-BOUNDED before any allocation: a single
     corrupted prefix byte can otherwise ask for a multi-GB ``recv_into``
     buffer; an absurd length is treated as a stream desync (the existing
     reconnect machinery recovers)."""
     hdr = bytearray(_HDR.size)
-    _recv_exact_into(sock, memoryview(hdr))
+    if prefix:
+        hdr[: len(prefix)] = prefix
+    _recv_exact_into(sock, memoryview(hdr)[len(prefix) :])
     magic, flags, meta_len, payload_len = _HDR.unpack(bytes(hdr))
     if magic != _MAGIC:
         raise ConnectionResetError(f"bad frame magic {magic!r} (stream desync)")
@@ -726,9 +786,28 @@ class TcpChannel(Channel):
             return
         tag, seq, extra, arrays = self._last_broadcast
         try:
-            _send_frame(sock, self._send_lock, tag, seq, extra, arrays, self._compress_min)
+            self._wire_send(sock, tag, seq, extra, arrays)
         except OSError:
             pass  # the reader notices and the next adoption retries
+
+    # ------------------------------------------------------------- wire hooks
+    # The payload-bearing data path funnels through these two methods so
+    # ``algo.wire_format=v2`` can swap the framing without touching the
+    # credit/reconnect/integrity machinery around it (``wire_channel_cls``).
+    # Control frames (hello, credit, retrans) stay on the module-level v1
+    # helpers: they are arrayless, rare, and the listener's hello parse
+    # must work before it knows the peer's wire format.
+    def _wire_send(self, sock, tag, seq, extra, arrays, crc: Optional[int] = None) -> int:
+        return _send_frame(
+            sock, self._send_lock, tag, seq, extra, arrays, self._compress_min, crc=crc, owner=self
+        )
+
+    def _wire_read(self, sock) -> Tuple[str, int, Tuple, List[Tuple], Any, Optional[int]]:
+        return _read_frame(sock, self._pool, self._max_frame_bytes)
+
+    def _make_views(self, leaves, buf) -> Dict[str, np.ndarray]:
+        # hook: the v2 mixin substitutes precompiled view specs here
+        return _views_from(leaves, buf)
 
     def _mark_dead(self, reason: str) -> None:
         with self._cond:
@@ -779,7 +858,7 @@ class TcpChannel(Channel):
         while not self._stop.is_set():
             sock = self._sock
             try:
-                tag, seq, extra, leaves, buf, _ = _read_frame(sock, self._pool, self._max_frame_bytes)
+                tag, seq, extra, leaves, buf, _ = self._wire_read(sock)
             except (OSError, ConnectionError, EOFError, pickle.UnpicklingError, zlib.error) as e:
                 if self._stop.is_set():
                     return
@@ -809,10 +888,8 @@ class TcpChannel(Channel):
                 continue
             if seq >= 0:
                 self._last_seq[tag] = seq
-            arrays = _views_from(leaves, buf if buf is not None else b"") if leaves else {}
-            nbytes = sum(int(v.nbytes) for v in arrays.values())
-            self.bytes_recv += nbytes
-            self.frames_recv += 1
+            arrays = self._make_views(leaves, buf if buf is not None else b"") if leaves else {}
+            self._note_recv(tag, sum(int(v.nbytes) for v in arrays.values()))
             release_cb = None
             if arrays:
                 pooled = buf if isinstance(buf, bytearray) else None
@@ -864,9 +941,7 @@ class TcpChannel(Channel):
                 if needs_credit:
                     self._credits -= 1
             try:
-                nbytes = _send_frame(
-                    sock, self._send_lock, tag, seq, extra, arrays, self._compress_min, crc=crc
-                )
+                nbytes = self._wire_send(sock, tag, seq, extra, arrays, crc=crc)
             except OSError:
                 # wait for the reader's reconnect/adoption, then retry the
                 # WHOLE frame (the peer dedupes a frame that did land)
@@ -878,8 +953,7 @@ class TcpChannel(Channel):
                     if self._dead is not None or not ok:
                         raise PeerDiedError(self.who, self._dead or "send timeout") from None
                 continue
-            self.bytes_sent += nbytes
-            self.frames_sent += 1
+            self._note_send(tag, nbytes)
             if self._track_resend and arrays and seq >= 0:
                 self._last_broadcast = (tag, int(seq), tuple(extra), arrays)
             return
@@ -1151,9 +1225,9 @@ class _QueueIntegrityMixin(_ResendRing):
         if tag == _RETRANS_TAG:
             self._serve_retrans(*extra[:2])
             return None
-        self.frames_recv += 1
-        if payload:
-            self.bytes_recv += sum(int(v.nbytes) for v in payload.values())
+        if payload is not None and not isinstance(payload, dict):
+            payload = dict(payload)  # v2 buffer-donating items tuple
+        self._note_recv(tag, sum(int(v.nbytes) for v in payload.values()) if payload else 0)
         return Frame(tag, seq, extra, payload), crc
 
 
@@ -1171,17 +1245,17 @@ class CrcQueueChannel(_QueueIntegrityMixin, QueueChannel):
         crc = self._payload_digest(items, self._coverage)
         self._store_resend(tag, seq, extra, items, crc)
         wire = maybe_bit_flip(items, tag)  # fault site: AFTER the checksum
-        self._count_payload(items)
+        self._count_payload(items, tag)
         maybe_drop_or_delay_send(
             lambda m: _put_with_peer(self._send_q, m, timeout, self.peer_alive, self.who),
-            (self._PICKLED, tag, seq, tuple(extra), dict(wire), crc),
+            (self._PICKLED, tag, seq, tuple(extra), self._wire_payload(wire), crc),
         )
 
     def _resend_now(self, tag, seq, extra, arrays, crc) -> None:
         try:
             _put_with_peer(
                 self._send_q,
-                (self._PICKLED, tag, seq, extra, dict(arrays), crc),
+                (self._PICKLED, tag, seq, extra, self._wire_payload(list(arrays)), crc),
                 10.0,
                 self.peer_alive,
                 self.who,
@@ -1254,13 +1328,13 @@ class CrcShmChannel(_QueueIntegrityMixin, ShmChannel):
             if store:
                 self._store_resend(tag, seq, extra, items, crc)
             wire = maybe_bit_flip(items, tag) if faultable else items
-            base_put((QueueChannel._PICKLED, tag, seq, tuple(extra), dict(wire), crc))
+            base_put((QueueChannel._PICKLED, tag, seq, tuple(extra), self._wire_payload(list(wire)), crc))
 
     def send(self, tag, arrays=None, extra=(), seq=-1, timeout=600.0) -> None:
         if not arrays:
             return QueueChannel.send(self, tag, arrays=arrays, extra=extra, seq=seq, timeout=timeout)
         items = [(k, np.asarray(v)) for k, v in arrays]
-        total = self._count_payload(items)
+        total = self._count_payload(items, tag)
         self._send_items(tag, seq, extra, items, timeout, faultable=True, store=True, total=total)
 
     def _resend_now(self, tag, seq, extra, arrays, crc) -> None:
@@ -1277,10 +1351,9 @@ class CrcShmChannel(_QueueIntegrityMixin, ShmChannel):
         rest = msg[4:]
         tag, seq, extra = rest[:3]
         crc = rest[3] if len(rest) > 3 else None
-        views = self._rx.unpack(info, slot, leaves, copy=False)
+        views = self._rx.unpack(info, slot, self._resolve_leaves(leaves), copy=False)
         nbytes = sum(int(v.nbytes) for v in views.values())
-        self.frames_recv += 1
-        self.bytes_recv += nbytes
+        self._note_recv(tag, nbytes)
         # receive-side fast path: the slot IS the concatenated stream —
         # _verify_frame checksums it in one contiguous pass
         self._slot_region = self._rx.region(slot, nbytes)
@@ -1323,7 +1396,7 @@ class CrcTcpChannel(_ResendRing, TcpChannel):
 
     def _resend_now(self, tag, seq, extra, arrays, crc) -> None:
         try:
-            _send_frame(self._sock, self._send_lock, tag, seq, extra, arrays, self._compress_min, crc=crc)
+            self._wire_send(self._sock, tag, seq, extra, arrays, crc=crc)
         except OSError:
             pass  # reconnect resets the window wholesale
 
@@ -1339,7 +1412,7 @@ class CrcTcpChannel(_ResendRing, TcpChannel):
         if entry is not None:
             extra, arrays, crc = entry
         try:
-            _send_frame(sock, self._send_lock, tag, seq, extra, arrays, self._compress_min, crc=crc)
+            self._wire_send(sock, tag, seq, extra, arrays, crc=crc)
         except OSError:
             pass
 
@@ -1380,9 +1453,7 @@ class CrcTcpChannel(_ResendRing, TcpChannel):
     def _deliver_frame(self, tag, seq, extra, arrays, buf) -> None:
         if seq >= 0:
             self._last_seq[tag] = seq
-        nbytes = sum(int(v.nbytes) for v in arrays.values())
-        self.bytes_recv += nbytes
-        self.frames_recv += 1
+        self._note_recv(tag, sum(int(v.nbytes) for v in arrays.values()))
         release_cb = None
         if arrays:
             pooled = buf if isinstance(buf, bytearray) else None
@@ -1419,9 +1490,7 @@ class CrcTcpChannel(_ResendRing, TcpChannel):
         while not self._stop.is_set():
             sock = self._sock
             try:
-                tag, seq, extra, leaves, buf, crc = _read_frame(
-                    sock, self._pool, self._max_frame_bytes
-                )
+                tag, seq, extra, leaves, buf, crc = self._wire_read(sock)
             except (OSError, ConnectionError, EOFError, pickle.UnpicklingError, zlib.error) as e:
                 if self._stop.is_set():
                     return
@@ -1447,7 +1516,7 @@ class CrcTcpChannel(_ResendRing, TcpChannel):
                 if buf is not None and isinstance(buf, bytearray):
                     self._pool.give(buf)
                 continue
-            arrays = _views_from(leaves, buf if buf is not None else b"") if leaves else {}
+            arrays = self._make_views(leaves, buf if buf is not None else b"") if leaves else {}
             ok = True
             if arrays:
                 self._istats.frames_checked += 1
@@ -1493,9 +1562,7 @@ class CrcTcpChannel(_ResendRing, TcpChannel):
             if aw is not None and tag == aw[0] and seq > aw[1]:
                 # hold back: per-tag seq order is preserved across the
                 # retransmission (the fan-in round assembly relies on it)
-                nbytes = sum(int(v.nbytes) for v in arrays.values())
-                self.bytes_recv += nbytes
-                self.frames_recv += 1
+                self._note_recv(tag, sum(int(v.nbytes) for v in arrays.values()))
                 pooled = buf if isinstance(buf, bytearray) else None
 
                 def release_cb(pooled=pooled):
@@ -1517,6 +1584,445 @@ class CrcTcpChannel(_ResendRing, TcpChannel):
             self._deliver_frame(tag, seq, extra, arrays, buf)
 
 
+# ------------------------------------------------------- wire-format v2 layer
+# ``algo.wire_format = v2`` swaps these mixins over the plain/integrity
+# backends (``wire_channel_cls``, same construction-time pattern as the
+# integrity and tracing layers: ``v1`` returns the UNDECORATED class, so
+# the default path is bit-identical to the pre-v2 tree by construction).
+# The codec itself lives in ``parallel/wire.py``; this layer binds it to
+# the channel machinery: sent-table caching keyed to the connection
+# generation, dual-magic read dispatch, coalesced-batch delivery, and
+# the shm leaf-table reference scheme.
+#
+# Coalescing batches small same-destination frames (heartbeats, live
+# summaries, fused-collector inserts under the size gate) into one wire
+# frame under a size/deadline gate.  Batches are CREDIT-EXEMPT on both
+# sides — the batch is bounded by the coalescer's size gate, and the
+# subframes' consumers (fan-in bookkeeping, replay ingest credit flow)
+# provide their own backpressure — so a released subframe must never
+# return a window credit: delivery bypasses the pooled-buffer path
+# entirely (each subframe owns a private buffer).
+_COAL_ITEM_MAX_BYTES = 16 << 10  # a frame above this never coalesces
+_COAL_BATCH_MAX_BYTES = 64 << 10  # size gate: flush when the batch reaches this
+_V2_SOCK_BUF_BYTES = 8 << 20
+
+
+class _Coalescer:
+    """Size/deadline-gated batcher for one channel's small frames."""
+
+    def __init__(self, chan, deadline_s: float, max_bytes: int = _COAL_BATCH_MAX_BYTES):
+        self._chan = chan
+        self._deadline_s = max(float(deadline_s), 1e-4)
+        self._max_bytes = int(max_bytes)
+        self._lock = threading.Lock()
+        self._parts: List[bytes] = []
+        self._bytes = 0
+        self._oldest: Optional[float] = None
+        self._stop = threading.Event()
+        self.batches = 0
+        self._thread = threading.Thread(
+            target=self._tick, name="sheeprl-wire-coalesce", daemon=True
+        )
+        self._thread.start()
+
+    def add(self, tag, seq, extra, items) -> None:
+        entry = wire.encode_coalesced_entry(tag, seq, extra, items)
+        with self._lock:
+            self._parts.append(entry)
+            self._bytes += len(entry)
+            if self._oldest is None:
+                self._oldest = time.monotonic()
+            due = self._bytes >= self._max_bytes
+        if due:
+            self.flush()
+
+    def flush(self) -> None:
+        with self._lock:
+            parts, self._parts = self._parts, []
+            self._bytes = 0
+            self._oldest = None
+        if parts:
+            self._chan._send_coal(b"".join(parts))
+            self.batches += 1
+
+    def _tick(self) -> None:
+        while not self._stop.wait(self._deadline_s):
+            with self._lock:
+                due = (
+                    self._oldest is not None
+                    and time.monotonic() - self._oldest >= self._deadline_s
+                )
+            if due:
+                try:
+                    self.flush()
+                except Exception:
+                    pass  # peer death surfaces loudly on the direct send path
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2.0)
+        try:
+            self.flush()
+        except Exception:
+            pass
+
+
+class _WireV2TcpMixin:
+    """v2 framing over the socket backend: cached leaf tables, one
+    ``sendmsg`` gather write per frame, dual-magic read dispatch (control
+    frames stay v1), and optional frame coalescing."""
+
+    def __init__(self, *a, coalesce_ms: float = 0.0, **kw):
+        # reader-thread state must exist before super().__init__ starts
+        # the reader
+        self._v2_rx_tables: Dict[int, wire.CompiledTable] = {}
+        self._v2_sent: Optional[Tuple[int, set]] = None
+        self._v2_coal: Optional[_Coalescer] = None
+        # send-side caches, keyed on payload structure: the encoded leaf
+        # table + struct_id (the "cached per (tag, structure)" half of
+        # the v2 design) and the adaptive-compression verdict
+        self._v2_tx_cache: Dict[Tuple, Tuple[bytes, int]] = {}
+        # credit batching: releases accumulate and ship as ONE compact
+        # binary credit frame carrying the count (see _send_credit)
+        self._v2_credit_pend = 0
+        self._v2_credit_lock = threading.Lock()
+        super().__init__(*a, **kw)
+        self._v2_credit_k = max(1, self._window // 3)
+        if coalesce_ms and float(coalesce_ms) > 0:
+            self._v2_coal = _Coalescer(self, float(coalesce_ms) / 1000.0)
+
+    # ------------------------------------------------------------ socket tune
+    @staticmethod
+    def _configure(sock: socket.socket) -> None:
+        TcpChannel._configure(sock)
+        # large kernel buffers: the v2 rationale is one gather write per
+        # frame — on a loopback/1-core host that only pays off when a
+        # 1 MB frame fits the socket buffer instead of ping-ponging
+        # fill/drain context switches with the peer
+        for opt in (socket.SO_SNDBUF, socket.SO_RCVBUF):
+            try:
+                sock.setsockopt(socket.SOL_SOCKET, opt, _V2_SOCK_BUF_BYTES)
+            except OSError:
+                pass
+
+    # --------------------------------------------------------------- sending
+    def _wire_send(self, sock, tag, seq, extra, arrays, crc: Optional[int] = None) -> int:
+        if not arrays:
+            # arrayless frames keep the v1 format: nothing to scatter-
+            # gather, and the listener/het-peer hello parse stays simple
+            return _send_frame(
+                sock, self._send_lock, tag, seq, extra, arrays, self._compress_min, crc=crc, owner=self
+            )
+        # one flatten pass builds the byte views AND the structure key;
+        # the encoded table + struct_id come from the per-structure cache
+        # (steady-state streams repeat one geometry frame after frame, so
+        # re-encoding the table per send was pure hot-path overhead)
+        skey: List[Tuple] = []
+        bufs: List[memoryview] = []
+        total = 0
+        for key, a in arrays:
+            if not a.flags["C_CONTIGUOUS"]:
+                a = np.ascontiguousarray(a)
+            skey.append((key, a.shape, a.dtype.str))
+            if a.nbytes:
+                bufs.append(memoryview(a.reshape(-1)).cast("B"))
+            total += a.nbytes
+        cached = self._v2_tx_cache.get(tuple(skey))
+        if cached is None:
+            leaves, _bufs, _total = wire.build_leaves(arrays)
+            table_bytes = wire.encode_leaf_table(leaves)
+            if len(self._v2_tx_cache) >= 256:
+                self._v2_tx_cache.clear()  # unbounded-structure guard
+            cached = (table_bytes, zlib.crc32(table_bytes))
+            self._v2_tx_cache[tuple(skey)] = cached
+        table, struct_id = cached
+        extra_blob = (
+            pickle.dumps(tuple(extra), protocol=pickle.HIGHEST_PROTOCOL) if extra else b""
+        )
+        flags = 0
+        payload: List = bufs
+        payload_len = total
+        if self._compress_min and 0 < self._compress_min <= total:
+            # the probe is per-frame on purpose: compressibility is a
+            # CONTENT property (a zeroed buffer and a noise buffer share
+            # one geometry), so only the table/struct work is cacheable
+            blob = wire.probe_compress(bufs, total)
+            if blob is None:
+                self.compress_skipped += 1
+            else:
+                flags |= wire.F2_COMPRESSED
+                payload = [memoryview(blob)]
+                payload_len = len(blob)
+        with self._send_lock:
+            # sent-table cache is keyed to the connection generation: a
+            # reconnected/adopted socket starts a fresh stream, so the
+            # first frame of each structure re-ships its table
+            if self._v2_sent is None or self._v2_sent[0] != self._gen:
+                self._v2_sent = (self._gen, set())
+            sent_ids = self._v2_sent[1]
+            tbl = b"" if struct_id in sent_ids else table
+            if tbl:
+                flags |= wire.F2_TABLE
+            hdr = wire.pack_header_v2(flags, tag, struct_id, seq, extra_blob, tbl, payload_len, crc)
+            wire.sendmsg_all(sock, [hdr] + payload)
+            sent_ids.add(struct_id)  # only after the table actually landed
+        return total
+
+    def _coal_eligible(self, tag, arrays) -> bool:
+        if tag.startswith("__"):
+            return False  # control/protocol frames never coalesce
+        if not arrays:
+            return True
+        if self._integrity_send is not None:
+            # coalesced subframes carry no transport checksum: with
+            # integrity on, only arrayless frames batch (the replay
+            # layer's IngestGuard still validates rb_insert content)
+            return False
+        return sum(int(np.asarray(a).nbytes) for _, a in arrays) <= _COAL_ITEM_MAX_BYTES
+
+    def send(self, tag, arrays=None, extra=(), seq=-1, timeout=600.0) -> None:
+        coal = self._v2_coal
+        if coal is not None:
+            if self._coal_eligible(tag, arrays):
+                items = [(k, np.asarray(v)) for k, v in arrays] if arrays else None
+                self._count_payload(items, tag)
+                coal.add(tag, seq, extra, items)
+                return
+            # a direct frame must not overtake batched small ones:
+            # flush first so global send order is preserved
+            coal.flush()
+        super().send(tag, arrays=arrays, extra=extra, seq=seq, timeout=timeout)
+
+    def _send_coal(self, payload: bytes) -> None:
+        """Ship one coalesced batch (credit-exempt, best-effort across a
+        reconnect: heartbeats/summaries are refreshed by their senders)."""
+        deadline = time.monotonic() + 30.0
+        while True:
+            with self._cond:
+                if self._dead is not None:
+                    return
+                gen = self._gen
+                sock = self._sock
+            hdr = wire.pack_header_v2(
+                wire.F2_COALESCED, COAL_TAG, 0, -1, b"", b"", len(payload), None
+            )
+            try:
+                with self._send_lock:
+                    wire.sendmsg_all(sock, [hdr, payload])
+                return
+            except OSError:
+                with self._cond:
+                    ok = self._cond.wait_for(
+                        lambda: self._gen != gen or self._dead is not None,
+                        timeout=max(deadline - time.monotonic(), 0.0),
+                    )
+                    if self._dead is not None or not ok:
+                        return  # dropped with the connection
+
+    # -------------------------------------------------------------- receiving
+    def _make_views(self, leaves, buf) -> Dict[str, np.ndarray]:
+        spec = getattr(leaves, "views_spec", None)
+        if spec is None:
+            return _views_from(leaves, buf)
+        # precompiled per-structure spec: no dtype-string parse, no
+        # np.prod — just one frombuffer per leaf into the pooled arena
+        return {
+            key: np.frombuffer(buf, dtype=dt, count=count, offset=off).reshape(shape)
+            for key, shape, dt, off, count in spec
+        }
+
+    def _send_credit(self) -> None:
+        """Batched compact credits: releases accumulate until the batch
+        threshold (window//3), then ship as ONE fixed-size v2 header
+        whose ``seq`` field carries the count — no pickle, one write,
+        and a third of the peer's reader wakeups.  Holding back up to
+        k-1 credits shrinks the sender's effective window by at most
+        k-1 < window slots, so the flow can never deadlock."""
+        with self._v2_credit_lock:
+            self._v2_credit_pend += 1
+            if self._v2_credit_pend < self._v2_credit_k:
+                return
+            n, self._v2_credit_pend = self._v2_credit_pend, 0
+        try:
+            hdr = wire.pack_header_v2(0, _CREDIT_TAG, 0, n, b"", b"", 0, None)
+            with self._send_lock:
+                self._sock.sendall(hdr)
+        except OSError:
+            pass  # the reconnect path resets the window wholesale
+
+    def _deliver_sub(self, tag, seq, extra, leaves, buf) -> None:
+        """Deliver one coalesced subframe: normal per-tag dedupe, NO
+        credit and no pooled buffer (the subframe owns ``buf``)."""
+        if seq >= 0 and seq <= self._last_seq.get(tag, -1):
+            return
+        if seq >= 0:
+            self._last_seq[tag] = seq
+        arrays = _views_from(leaves, buf) if leaves else {}
+        self._note_recv(tag, sum(int(v.nbytes) for v in arrays.values()))
+        self._inbox.put(Frame(tag, seq, extra, arrays, release_cb=None))
+
+    def _wire_read(self, sock) -> Tuple[str, int, Tuple, List[Tuple], Any, Optional[int]]:
+        while True:
+            magic = bytearray(2)
+            _recv_exact_into(sock, memoryview(magic))
+            magic = bytes(magic)
+            if magic == _MAGIC:
+                return _read_frame(sock, self._pool, self._max_frame_bytes, prefix=magic)
+            if magic != wire.MAGIC_V2:
+                raise WireFormatError(f"bad frame magic {magic!r} (stream desync)")
+            hdr = bytearray(wire.HDR2.size)
+            hdr[:2] = magic
+            wire.recv_exact_into(sock, memoryview(hdr)[2:])
+            _, flags, tag_len, struct_id, seq, extra_len, table_len, payload_len, crc_u = (
+                wire.HDR2.unpack(bytes(hdr))
+            )
+            if (
+                extra_len > wire._MAX_EXTRA_BYTES
+                or table_len > wire._MAX_TABLE_BYTES
+                or payload_len > self._max_frame_bytes
+            ):
+                raise WireFormatError(
+                    f"v2 header asks for extra={extra_len} table={table_len} "
+                    f"payload={payload_len} bytes (cap {self._max_frame_bytes}): "
+                    "corrupted header / stream desync"
+                )
+            head = bytearray(tag_len + extra_len + table_len)
+            wire.recv_exact_into(sock, memoryview(head))
+            try:
+                tag = bytes(head[:tag_len]).decode("ascii")
+            except UnicodeDecodeError as e:
+                raise WireFormatError(f"undecodable v2 tag: {e}") from None
+            if tag == _CREDIT_TAG:
+                # compact batched credit: the count rides the seq field
+                # of a bodyless header — consumed here, never surfaced
+                with self._cond:
+                    self._credits += max(int(seq), 1)
+                    self._cond.notify_all()
+                continue
+            if extra_len:
+                try:
+                    extra = pickle.loads(bytes(head[tag_len : tag_len + extra_len]))
+                except Exception as e:
+                    raise WireFormatError(f"undecodable v2 extras: {e}") from None
+            else:
+                extra = ()
+            if flags & wire.F2_COALESCED:
+                buf = wire.read_payload_v2(sock, self._pool, payload_len, flags, payload_len)
+                try:
+                    # slice: pooled buffers can be LARGER than the payload
+                    subs = wire.decode_coalesced(
+                        memoryview(buf)[:payload_len] if buf is not None else b""
+                    )
+                finally:
+                    if isinstance(buf, bytearray):
+                        self._pool.give(buf)  # subframes copied out their bytes
+                for stag, sseq, sextra, sleaves, sbuf, _scrc in subs:
+                    self._deliver_sub(stag, sseq, sextra, sleaves, sbuf)
+                continue  # keep reading: the batch never surfaces as a frame
+            if table_len:
+                table = bytes(head[tag_len + extra_len :])
+                if zlib.crc32(table) != struct_id:
+                    # content-addressing check: a corrupt table must not
+                    # poison the cache under a valid id
+                    raise WireFormatError("leaf-table bytes do not match their struct_id")
+                leaves = wire.compile_table(wire.decode_leaf_table(table))
+                self._v2_rx_tables[struct_id] = leaves
+            else:
+                leaves = self._v2_rx_tables.get(struct_id)
+                if leaves is None:
+                    raise WireFormatError(
+                        f"unknown struct_id {struct_id:#x} (table never seen on this stream)"
+                    )
+            buf = wire.read_payload_v2(sock, self._pool, payload_len, flags, leaves.raw_len)
+            crc = int(crc_u) if flags & wire.F2_INTEGRITY else None
+            return tag, seq, extra, leaves, buf, crc
+
+    def close(self) -> None:
+        coal, self._v2_coal = self._v2_coal, None
+        if coal is not None:
+            coal.close()
+        super().close()
+
+
+class _WireV2QueueMixin:
+    """v2 over the pickled-queue backend: payloads ride as the buffer-
+    donating ``((key, array), ...)`` items tuple instead of a rebuilt
+    dict — the send side hands its normalized items straight to the
+    queue's out-of-band pickling with no container copy."""
+
+    def _wrap_payload(self, arrays):
+        return tuple((k, np.asarray(v)) for k, v in arrays) if arrays else None
+
+    def _wire_payload(self, items):
+        return tuple(items)
+
+
+class _WireV2ShmMixin(_WireV2QueueMixin):
+    """v2 over the shm ring: the payload bytes already ship zero-copy
+    through the slot, so v2 caches the per-structure LEAF TABLE — the
+    control-queue message carries a ``("__tbl__", struct_id[, table])``
+    reference instead of re-pickling the full per-leaf list each frame
+    (same content-addressed scheme as tcp, minus the socket)."""
+
+    def __init__(self, *a, **kw):
+        kw.pop("coalesce_ms", None)  # queue/shm sends are already one hop
+        self._v2_rx_tables: Dict[int, List[Tuple]] = {}
+        super().__init__(*a, **kw)
+        sent: set = set()
+
+        def encode_leaves(leaves):
+            # arena leaves are 4-tuples (key, shape, dtype, offset); the
+            # table codec derives offsets itself, in pack order
+            table = wire.encode_leaf_table([(k, s, d, 0, 0) for (k, s, d, _o) in leaves])
+            sid = zlib.crc32(table)
+            if sid in sent:
+                return ("__tbl__", sid)
+            sent.add(sid)
+            return ("__tbl__", sid, table)
+
+        self._tx.encode_leaves = encode_leaves
+
+    def _resolve_leaves(self, leaves):
+        if not (isinstance(leaves, tuple) and leaves and leaves[0] == "__tbl__"):
+            return leaves  # oversize fallback frames keep the plain list
+        sid = int(leaves[1])
+        if len(leaves) > 2:
+            table = leaves[2]
+            if zlib.crc32(table) != sid:
+                raise WireFormatError("shm leaf-table bytes do not match their struct_id")
+            self._v2_rx_tables[sid] = wire.decode_leaf_table(table)
+        decoded = self._v2_rx_tables.get(sid)
+        if decoded is None:
+            raise WireFormatError(f"unknown shm struct_id {sid:#x} (table never seen)")
+        return [(k, s, d, o) for (k, s, d, o, _nb) in decoded]
+
+
+_WIRE_CLS_CACHE: Dict[Tuple[type, str], type] = {}
+
+
+def wire_channel_cls(base: type, wire_format: str) -> type:
+    """Map a channel class to its ``wire_format`` variant.  ``v1``
+    returns ``base`` UNDECORATED — the off-path is type-identical to the
+    pre-v2 tree (the PR-9/10/13 zero-overhead-by-construction pattern,
+    asserted by test)."""
+    if wire_format != "v2":
+        return base
+    cached = _WIRE_CLS_CACHE.get((base, wire_format))
+    if cached is not None:
+        return cached
+    if issubclass(base, TcpChannel):
+        mixin: type = _WireV2TcpMixin
+    elif issubclass(base, ShmChannel):  # before QueueChannel: Shm subclasses it
+        mixin = _WireV2ShmMixin
+    elif issubclass(base, QueueChannel):
+        mixin = _WireV2QueueMixin
+    else:
+        raise ValueError(f"no v2 wire variant for {base.__name__}")
+    cls = type("V2" + base.__name__, (mixin, base), {"__module__": __name__})
+    _WIRE_CLS_CACHE[(base, wire_format)] = cls
+    return cls
+
+
 class TcpListener:
     """Trainer-side accept endpoint: players greet with a hello frame
     carrying their player id; a known id reconnecting is adopted into its
@@ -1532,6 +2038,8 @@ class TcpListener:
         integrity: str = "off",
         max_frame_bytes: int = TCP_MAX_FRAME_BYTES,
         tracing: str = "off",
+        wire_format: str = "v1",
+        coalesce_ms: float = 0.0,
     ):
         self._srv = socket.create_server((host, port), backlog=64)
         self._srv.settimeout(0.5)
@@ -1540,6 +2048,8 @@ class TcpListener:
         self._compress_min = compress_min
         self._integrity = str(integrity)
         self._tracing = str(tracing)
+        self._wire_format = str(wire_format)
+        self._coalesce_ms = float(coalesce_ms)
         self._max_frame_bytes = int(max_frame_bytes)
         self._channels: Dict[int, TcpChannel] = {}
         self._cond = threading.Condition()
@@ -1578,9 +2088,12 @@ class TcpListener:
                 if existing is not None:
                     existing.adopt_socket(sock)
                 else:
-                    cls = flight.channel_cls(
-                        CrcTcpChannel if self._integrity != "off" else TcpChannel, self._tracing
-                    )
+                    base = CrcTcpChannel if self._integrity != "off" else TcpChannel
+                    base = wire_channel_cls(base, self._wire_format)
+                    cls = flight.channel_cls(base, self._tracing)
+                    kw = {}
+                    if self._wire_format == "v2":
+                        kw["coalesce_ms"] = self._coalesce_ms
                     self._channels[pid] = cls(
                         sock=sock,
                         player_id=pid,
@@ -1589,6 +2102,7 @@ class TcpListener:
                         reconnect=False,
                         track_resend=True,
                         max_frame_bytes=self._max_frame_bytes,
+                        **kw,
                     )
                 self._cond.notify_all()
 
@@ -1641,6 +2155,8 @@ class ChannelSpec:
         integrity: str = "off",
         max_frame_bytes: int = TCP_MAX_FRAME_BYTES,
         tracing: str = "off",
+        wire_format: str = "v1",
+        coalesce_ms: float = 0.0,
     ):
         self.backend = backend
         self.player_id = int(player_id)
@@ -1656,16 +2172,22 @@ class ChannelSpec:
         self.integrity = integrity
         self.max_frame_bytes = int(max_frame_bytes)
         self.tracing = tracing
+        self.wire_format = str(wire_format)
+        self.coalesce_ms = float(coalesce_ms)
 
     def player_channel(self, peer_alive=None, who: str = "trainer") -> Channel:
         """Build the player-side endpoint (call INSIDE the child).  With
         ``integrity=off`` the UNDECORATED pre-integrity classes are
         constructed — zero overhead by construction (PR-9 pattern); the
-        same holds for ``tracing=off`` vs the flight-traced variants."""
+        same holds for ``tracing=off`` vs the flight-traced variants and
+        ``wire_format=v1`` vs the v2 wire classes."""
         crc = getattr(self, "integrity", "off") != "off"
         tracing = getattr(self, "tracing", "off")
+        wf = getattr(self, "wire_format", "v1")
         if self.backend == "tcp":
-            cls = flight.channel_cls(CrcTcpChannel if crc else TcpChannel, tracing)
+            base = wire_channel_cls(CrcTcpChannel if crc else TcpChannel, wf)
+            cls = flight.channel_cls(base, tracing)
+            kw = {"coalesce_ms": getattr(self, "coalesce_ms", 0.0)} if wf == "v2" else {}
             return cls(
                 address=self.address,
                 player_id=self.player_id,
@@ -1676,9 +2198,11 @@ class ChannelSpec:
                 who=who,
                 poll_s=self.poll_s,
                 max_frame_bytes=getattr(self, "max_frame_bytes", TCP_MAX_FRAME_BYTES),
+                **kw,
             )
         if self.backend == "shm":
-            cls = flight.channel_cls(CrcShmChannel if crc else ShmChannel, tracing)
+            base = wire_channel_cls(CrcShmChannel if crc else ShmChannel, wf)
+            cls = flight.channel_cls(base, tracing)
             return cls(
                 self.to_trainer_q,
                 self.to_player_q,
@@ -1690,7 +2214,8 @@ class ChannelSpec:
                 who=who,
                 poll_s=self.poll_s,
             )
-        cls = flight.channel_cls(CrcQueueChannel if crc else QueueChannel, tracing)
+        base = wire_channel_cls(CrcQueueChannel if crc else QueueChannel, wf)
+        cls = flight.channel_cls(base, tracing)
         return cls(
             self.to_trainer_q, self.to_player_q, peer_alive=peer_alive, who=who, poll_s=self.poll_s
         )
@@ -1713,6 +2238,8 @@ class TransportHub:
         integrity: str = "off",
         max_frame_bytes: int = TCP_MAX_FRAME_BYTES,
         tracing: str = "off",
+        wire_format: str = "v1",
+        coalesce_ms: float = 0.0,
     ):
         self.backend = backend
         self._listener = listener
@@ -1725,6 +2252,8 @@ class TransportHub:
         self._integrity = integrity
         self._max_frame_bytes = int(max_frame_bytes)
         self._tracing = tracing
+        self._wire_format = str(wire_format)
+        self._coalesce_ms = float(coalesce_ms)
 
     def channel(self, player_id: int, timeout: float = 120.0, peer_alive=None) -> Channel:
         if self._listener is not None and player_id not in self._channels:
@@ -1755,6 +2284,8 @@ class TransportHub:
                 integrity=self._integrity,
                 max_frame_bytes=self._max_frame_bytes,
                 tracing=self._tracing,
+                wire_format=self._wire_format,
+                coalesce_ms=self._coalesce_ms,
             )
         old = self._channels.pop(player_id, None)
         if old is not None:
@@ -1778,10 +2309,13 @@ class TransportHub:
             poll_s=self._poll_s,
             integrity=self._integrity,
             tracing=self._tracing,
+            wire_format=self._wire_format,
+            coalesce_ms=self._coalesce_ms,
         )
         crc = self._integrity != "off"
         if self.backend == "shm":
-            cls = flight.channel_cls(CrcShmChannel if crc else ShmChannel, self._tracing)
+            base = wire_channel_cls(CrcShmChannel if crc else ShmChannel, self._wire_format)
+            cls = flight.channel_cls(base, self._tracing)
             self._channels[player_id] = cls(
                 to_p,
                 to_t,
@@ -1793,7 +2327,8 @@ class TransportHub:
                 poll_s=self._poll_s,
             )
         else:
-            cls = flight.channel_cls(CrcQueueChannel if crc else QueueChannel, self._tracing)
+            base = wire_channel_cls(CrcQueueChannel if crc else QueueChannel, self._wire_format)
+            cls = flight.channel_cls(base, self._tracing)
             self._channels[player_id] = cls(
                 to_p, to_t, who=f"player[{player_id}]", poll_s=self._poll_s
             )
@@ -1820,6 +2355,8 @@ def make_transport(
     integrity: str = "off",
     max_frame_bytes: int = TCP_MAX_FRAME_BYTES,
     tracing: str = "off",
+    wire_format: str = "v1",
+    coalesce_ms: float = 0.0,
 ) -> Tuple[TransportHub, List[ChannelSpec]]:
     """Create the trainer hub + per-player specs for ``backend``.
 
@@ -1827,7 +2364,8 @@ def make_transport(
     so this runs in the trainer before any player process starts.
     ``integrity`` (``algo.transport_integrity``) selects the checksummed
     channel variants; ``tracing`` (``metric.tracing``) the flight-traced
-    ones; ``off`` constructs the undecorated classes either way.
+    ones; ``wire_format`` (``algo.wire_format``) the v2 scatter-gather
+    wire classes; ``off``/``v1`` constructs the undecorated classes.
     """
     if backend not in _BACKENDS:
         raise ValueError(f"unknown transport backend {backend!r}; known: {_BACKENDS}")
@@ -1844,6 +2382,8 @@ def make_transport(
             integrity=integrity,
             max_frame_bytes=max_frame_bytes,
             tracing=tracing,
+            wire_format=wire_format,
+            coalesce_ms=coalesce_ms,
         )
         for pid in range(num_players):
             specs.append(
@@ -1857,6 +2397,8 @@ def make_transport(
                     integrity=integrity,
                     max_frame_bytes=max_frame_bytes,
                     tracing=tracing,
+                    wire_format=wire_format,
+                    coalesce_ms=coalesce_ms,
                 )
             )
     else:
@@ -1878,12 +2420,14 @@ def make_transport(
                     poll_s=poll_s,
                     integrity=integrity,
                     tracing=tracing,
+                    wire_format=wire_format,
                 )
             )
             if backend == "shm":
                 # trainer sends through ITS ring (resp_free) and releases
                 # rollout slots back into the player's ring (data_free)
-                cls = flight.channel_cls(CrcShmChannel if crc else ShmChannel, tracing)
+                base = wire_channel_cls(CrcShmChannel if crc else ShmChannel, wire_format)
+                cls = flight.channel_cls(base, tracing)
                 channels[pid] = cls(
                     to_p,
                     to_t,
@@ -1895,7 +2439,8 @@ def make_transport(
                     poll_s=poll_s,
                 )
             else:
-                qcls = flight.channel_cls(CrcQueueChannel if crc else QueueChannel, tracing)
+                base = wire_channel_cls(CrcQueueChannel if crc else QueueChannel, wire_format)
+                qcls = flight.channel_cls(base, tracing)
                 channels[pid] = qcls(to_p, to_t, who=f"player[{pid}]", poll_s=poll_s)
     hub = TransportHub(
         backend,
@@ -1909,6 +2454,8 @@ def make_transport(
         integrity=integrity,
         max_frame_bytes=max_frame_bytes,
         tracing=tracing,
+        wire_format=wire_format,
+        coalesce_ms=coalesce_ms,
     )
     return hub, specs
 
@@ -2243,6 +2790,19 @@ class FanIn:
             if pid in self._lag_by_pid:
                 entry["lag"] = self._lag_by_pid[pid]
             per_player[str(pid)] = entry
+        # per-tag byte/rate breakdown (ISSUE 19): which logical stream —
+        # data shards, params broadcasts, heartbeats, live summaries —
+        # owns the wire.  Merged across player channels; control tags
+        # (``__``-prefixed) are excluded at count time.
+        bytes_by_tag: Dict[str, int] = {}
+        frames_by_tag: Dict[str, int] = {}
+        compress_skipped = 0
+        for ch in self.channels.values():
+            for tag, n in ch.bytes_by_tag.items():
+                bytes_by_tag[tag] = bytes_by_tag.get(tag, 0) + n
+            for tag, n in ch.frames_by_tag.items():
+                frames_by_tag[tag] = frames_by_tag.get(tag, 0) + n
+            compress_skipped += ch.compress_skipped
         out = {
             "backend": backend,
             "players": per_player,
@@ -2258,6 +2818,14 @@ class FanIn:
                 ch.depth() or 0 for pid, ch in self.channels.items() if pid not in self.dead
             ),
         }
+        if bytes_by_tag:
+            out["bytes_by_tag"] = dict(sorted(bytes_by_tag.items()))
+            out["frames_per_s_by_tag"] = {
+                tag: round(n / elapsed, 2) for tag, n in sorted(frames_by_tag.items())
+            }
+            out["top_stream"] = max(bytes_by_tag, key=bytes_by_tag.get)
+        if compress_skipped:
+            out["compress_skipped"] = compress_skipped
         if self.fleet:
             out["fleet"] = {str(pid): dict(s) for pid, s in sorted(self.fleet.items())}
         return out

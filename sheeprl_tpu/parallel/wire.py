@@ -1,0 +1,508 @@
+"""Wire-format v2: pickle-free scatter-gather framing for the transport.
+
+The v1 tcp wire format (``transport._send_frame``) pickles a per-frame
+meta tuple — ``(tag, seq, extra, leaves, total)`` — for EVERY payload
+frame and writes one ``sendall`` per leaf, so a rollout frame pays a
+pickle of its full leaf table plus one syscall per array even though the
+payload structure is identical round after round.  BENCH_r16's composed
+superbench named transport the fleet bottleneck; SEED RL and IMPALA both
+locate the actor→learner throughput fight exactly here, in the
+serialization/framing layer.
+
+v2 replaces the per-frame pickle with a binary header + a CACHED leaf
+table and ships the payload with vectored I/O:
+
+.. code-block:: text
+
+    offset  size  field
+    ------  ----  ------------------------------------------------------
+    0       2     magic "S2"
+    2       1     flags (1=compressed 2=integrity 4=has-table 8=coalesced)
+    3       1     tag length T
+    4       4     struct_id  (crc32 of the leaf-table bytes: content-
+                  addressed, so a stale receiver cache can never decode
+                  the wrong geometry)
+    8       8     seq (signed)
+    16      4     extra length E (pickled extras; empty tuple -> 0)
+    20      4     table length L (0 when the receiver already holds
+                  struct_id from an earlier frame of this connection)
+    24      4     payload length P (compressed length when flag 1)
+    28      8     integrity checksum (flag 2; 0 otherwise)
+    36      T     tag bytes (ascii)
+    36+T    E     extras (pickled tuple — control metadata, not payload)
+    ...     L     leaf table: n_leaves, then per leaf key/dtype/shape
+    ...     P     raw array bytes, leaves back-to-back (offsets/sizes are
+                  DERIVED from the table — they never ride the wire)
+
+The whole frame goes out as ONE ``socket.sendmsg`` gather call (header +
+every leaf buffer), so the hot path serializes nothing but the extras
+tuple and the first occurrence of each payload structure.  The receive
+side lands the payload into a pooled buffer exactly like v1 and rebuilds
+the leaf views zero-copy; a truncated or corrupt table raises
+:class:`WireFormatError` (a typed stream-desync, recovered by the
+existing reconnect machinery) — it can never mis-shape an array, because
+the decoded geometry is cross-checked against the payload length before
+any view is built.
+
+Also here: the coalesced-batch payload codec (many small same-
+destination frames inside one wire frame), the :class:`OverlappedSender`
+pipeline (device→host snapshot / digest / socket write as overlapped
+stages), and the ``algo.wire_format`` resolver.  The channel classes
+that USE this codec live in ``transport.py`` (``wire_channel_cls``) so
+the format layer stays import-light and socket-free.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import queue as queue_mod
+import socket
+import struct
+import threading
+import zlib
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+__all__ = [
+    "COAL_TAG",
+    "HDR2",
+    "MAGIC_V2",
+    "OverlappedSender",
+    "WireFormatError",
+    "build_leaves",
+    "decode_coalesced",
+    "compile_table",
+    "decode_leaf_table",
+    "encode_coalesced_entry",
+    "encode_leaf_table",
+    "leaf_views",
+    "pack_header_v2",
+    "read_payload_v2",
+    "sendmsg_all",
+    "wire_setting",
+]
+
+
+class WireFormatError(ConnectionResetError):
+    """A structurally invalid v2 frame (truncated/corrupt leaf table,
+    unknown struct_id, geometry/payload length mismatch).  Subclasses
+    :class:`ConnectionResetError` on purpose: the reader loops already
+    treat that as a stream desync and run the reconnect machinery, so a
+    corrupted header degrades to a reconnect, never to a mis-shaped
+    array or a crashed reader thread."""
+
+
+MAGIC_V2 = b"S2"
+# magic, flags, tag_len, struct_id, seq, extra_len, table_len,
+# payload_len, crc — see the module docstring for the layout
+HDR2 = struct.Struct("!2sBBIqIIIQ")
+
+F2_COMPRESSED = 1
+F2_INTEGRITY = 2
+F2_TABLE = 4
+F2_COALESCED = 8
+
+# tag of a coalesced batch frame (flag 8); the subframes inside carry
+# their own real tags
+COAL_TAG = "__coal__"
+
+_TABLE_HDR = struct.Struct("!H")  # n_leaves
+_LEAF_HDR = struct.Struct("!HBB")  # key_len, dtype_len, ndim
+_SUB_HDR = struct.Struct("!I")  # coalesced sub-entry length prefix
+
+# decode-side sanity bounds — anything past these is a desync, not data
+_MAX_LEAVES = 4096
+_MAX_NDIM = 16
+_MAX_EXTRA_BYTES = 64 << 20
+_MAX_TABLE_BYTES = 16 << 20
+
+# compression probe (adaptive tcp_compress): compress the first page and
+# skip the full pass unless it shrank below this ratio — float rollout
+# payloads are incompressible and v1 paid a full zlib pass to find out
+_PROBE_BYTES = 4096
+_PROBE_RATIO = 0.9
+
+
+def wire_setting(cfg) -> str:
+    """Resolve ``algo.wire_format`` (env override ``SHEEPRL_WIRE_FORMAT``)
+    to ``v1`` or ``v2``; v1 — the bit-exact pre-v2 path — is the default
+    until parity is proven per deployment."""
+    val = cfg.algo.get("wire_format", "v1")
+    env = os.environ.get("SHEEPRL_WIRE_FORMAT")
+    if env is not None:
+        val = env
+    s = str(val).lower()
+    if s in ("v2", "2", "sg", "scatter_gather"):
+        return "v2"
+    return "v1"
+
+
+# --------------------------------------------------------------- leaf table
+def build_leaves(
+    arrays: Optional[Sequence[Tuple[str, np.ndarray]]],
+) -> Tuple[List[Tuple], List[memoryview], int]:
+    """Flatten ``arrays`` once into ``(leaves, byte_views, total_bytes)``
+    with v1-compatible leaves ``(key, shape, dtype_str, offset, nbytes)``
+    — the views are zero-copy for already-contiguous inputs, so the
+    payload bytes are only ever touched by the socket."""
+    leaves: List[Tuple] = []
+    bufs: List[memoryview] = []
+    off = 0
+    for key, arr in arrays or []:
+        a = np.ascontiguousarray(arr)
+        nb = int(a.nbytes)
+        leaves.append((key, tuple(a.shape), str(a.dtype), off, nb))
+        if nb:
+            bufs.append(memoryview(a.reshape(-1)).cast("B"))
+        off += nb
+    return leaves, bufs, off
+
+
+def encode_leaf_table(leaves: Sequence[Tuple]) -> bytes:
+    """Binary leaf table: per leaf ``key_len,dtype_len,ndim,key,dtype,
+    dims`` — offsets and byte counts are derived at decode, so the table
+    is a pure structure description (cacheable per struct_id)."""
+    if len(leaves) > _MAX_LEAVES:
+        raise ValueError(f"too many leaves for one frame: {len(leaves)}")
+    parts = [_TABLE_HDR.pack(len(leaves))]
+    for key, shape, dtype, _off, _nb in leaves:
+        kb = str(key).encode("utf-8")
+        db = str(dtype).encode("ascii")
+        if len(kb) > 0xFFFF or len(db) > 0xFF or len(shape) > _MAX_NDIM:
+            raise ValueError(f"leaf {key!r} does not fit the table encoding")
+        parts.append(_LEAF_HDR.pack(len(kb), len(db), len(shape)))
+        parts.append(kb)
+        parts.append(db)
+        if shape:
+            parts.append(struct.pack(f"!{len(shape)}I", *shape))
+    return b"".join(parts)
+
+
+def decode_leaf_table(blob: bytes) -> List[Tuple]:
+    """Inverse of :func:`encode_leaf_table`; raises
+    :class:`WireFormatError` on ANY structural defect (truncation,
+    trailing garbage, absurd counts, non-numeric dtypes) — corrupt
+    metadata must surface as a typed stream error, never as an array of
+    the wrong shape."""
+    try:
+        view = memoryview(blob)
+        if len(view) < _TABLE_HDR.size:
+            raise WireFormatError("leaf table truncated before the leaf count")
+        (n_leaves,) = _TABLE_HDR.unpack_from(view, 0)
+        if n_leaves > _MAX_LEAVES:
+            raise WireFormatError(f"leaf table claims {n_leaves} leaves (cap {_MAX_LEAVES})")
+        pos = _TABLE_HDR.size
+        leaves: List[Tuple] = []
+        off = 0
+        for _ in range(n_leaves):
+            if pos + _LEAF_HDR.size > len(view):
+                raise WireFormatError("leaf table truncated inside a leaf header")
+            key_len, dtype_len, ndim = _LEAF_HDR.unpack_from(view, pos)
+            pos += _LEAF_HDR.size
+            if ndim > _MAX_NDIM:
+                raise WireFormatError(f"leaf claims {ndim} dims (cap {_MAX_NDIM})")
+            end = pos + key_len + dtype_len + 4 * ndim
+            if end > len(view):
+                raise WireFormatError("leaf table truncated inside a leaf body")
+            key = bytes(view[pos : pos + key_len]).decode("utf-8")
+            pos += key_len
+            dtype_str = bytes(view[pos : pos + dtype_len]).decode("ascii")
+            pos += dtype_len
+            shape = struct.unpack_from(f"!{ndim}I", view, pos) if ndim else ()
+            pos += 4 * ndim
+            try:
+                dt = np.dtype(dtype_str)
+            except Exception:
+                raise WireFormatError(f"leaf {key!r} carries undecodable dtype {dtype_str!r}") from None
+            if dt.hasobject:
+                raise WireFormatError(f"leaf {key!r} carries an object dtype")
+            count = 1
+            for d in shape:
+                count *= int(d)
+            nb = count * dt.itemsize
+            leaves.append((key, tuple(int(d) for d in shape), dtype_str, off, nb))
+            off += nb
+        if pos != len(view):
+            raise WireFormatError(f"{len(view) - pos} trailing bytes after the leaf table")
+        return leaves
+    except (UnicodeDecodeError, struct.error) as e:
+        raise WireFormatError(f"undecodable leaf table: {e}") from None
+
+
+def leaf_views(leaves: Sequence[Tuple], buf) -> Dict[str, np.ndarray]:
+    """Rebuild the payload dict as zero-copy VIEWS into ``buf`` (a
+    pooled recv arena or a decompressed private bytes object).  Views
+    are valid only until the frame's release — consumers that keep the
+    data must cleanse first (``Frame.arrays_copy`` / ``np.array``; the
+    jaxlint zero-copy-alias checker enforces this for device uploads)."""
+    out: Dict[str, np.ndarray] = {}
+    for key, shape, dtype, off, _nb in leaves:
+        dt = np.dtype(dtype)
+        count = int(np.prod(shape, dtype=np.int64)) if shape else 1
+        out[key] = np.frombuffer(buf, dtype=dt, count=count, offset=off).reshape(shape)
+    return out
+
+
+class CompiledTable(list):
+    """A decoded leaf table precompiled for the per-frame hot path: the
+    list body is the plain v1-compatible leaves (so every generic
+    consumer — retrans ring, coalesced delivery, tests — keeps working),
+    plus a ``views_spec`` with the ``np.dtype`` objects and element
+    counts already resolved and ``raw_len`` precomputed.  Tables are
+    decoded once per (stream, struct_id); frames of that structure then
+    build their views without re-parsing a dtype string or running
+    ``np.prod`` per leaf — at params-tree leaf counts that parse work
+    dominated the receive loop."""
+
+    __slots__ = ("views_spec", "raw_len")
+
+
+def compile_table(leaves: Sequence[Tuple]) -> CompiledTable:
+    out = CompiledTable(leaves)
+    out.views_spec = tuple(
+        (key, shape, np.dtype(dtype), off, int(np.prod(shape, dtype=np.int64)) if shape else 1)
+        for key, shape, dtype, off, _nb in leaves
+    )
+    out.raw_len = (leaves[-1][3] + leaves[-1][4]) if leaves else 0
+    return out
+
+
+# ------------------------------------------------------------ frame wire IO
+def pack_header_v2(
+    flags: int,
+    tag: str,
+    struct_id: int,
+    seq: int,
+    extra_blob: bytes,
+    table_blob: bytes,
+    payload_len: int,
+    crc: Optional[int],
+) -> bytes:
+    tagb = tag.encode("ascii")
+    if len(tagb) > 0xFF:
+        raise ValueError(f"frame tag too long for the wire: {tag!r}")
+    if crc is not None:
+        flags |= F2_INTEGRITY
+    hdr = HDR2.pack(
+        MAGIC_V2,
+        flags,
+        len(tagb),
+        struct_id & 0xFFFFFFFF,
+        int(seq),
+        len(extra_blob),
+        len(table_blob),
+        int(payload_len),
+        (int(crc) if crc is not None else 0) & 0xFFFFFFFFFFFFFFFF,
+    )
+    return hdr + tagb + extra_blob + table_blob
+
+
+_IOV_MAX = 512  # conservative vs the kernel's UIO_MAXIOV (1024)
+
+
+def sendmsg_all(sock, bufs: Sequence) -> None:
+    """Write every buffer with vectored I/O, handling partial sends —
+    the v2 replacement for v1's one-``sendall``-per-leaf loop (one
+    syscall per frame in the common case)."""
+    mvs: List[memoryview] = []
+    for b in bufs:
+        mv = b if isinstance(b, memoryview) else memoryview(b)
+        if mv.format != "B" or mv.ndim != 1:
+            mv = mv.cast("B")
+        if len(mv):
+            mvs.append(mv)
+    while mvs:
+        try:
+            n = sock.sendmsg(mvs[:_IOV_MAX])
+        except InterruptedError:
+            continue
+        while mvs and n >= len(mvs[0]):
+            n -= len(mvs[0])
+            mvs.pop(0)
+        if n and mvs:
+            mvs[0] = mvs[0][n:]
+
+
+def recv_exact_into(sock, mv: memoryview) -> None:
+    """Fill ``mv`` completely.  ``MSG_WAITALL`` asks the kernel to
+    assemble the whole buffer in ONE syscall instead of a Python loop
+    over socket-buffer-sized chunks — on a 1 MB payload that is the
+    difference between ~1 and ~16 reader wakeups; a short return (signal
+    delivery) falls back to the plain loop for the remainder."""
+    want = len(mv)
+    if not want:
+        return
+    try:
+        got = sock.recv_into(mv, want, socket.MSG_WAITALL)
+    except InterruptedError:
+        got = 0
+    if got == 0:
+        raise ConnectionResetError("peer closed the stream")
+    while got < want:
+        n = sock.recv_into(mv[got:], want - got)
+        if n == 0:
+            raise ConnectionResetError("peer closed the stream")
+        got += n
+
+
+def probe_compress(bufs: Sequence[memoryview], total: int) -> Optional[bytes]:
+    """Adaptive compression: zlib the first page and bail unless it
+    shrank (``None`` = ship raw; callers count the skip).  A payload
+    whose head page is incompressible (float rollouts) skips the full
+    pass it would have paid for nothing under v1."""
+    head = bytearray()
+    for mv in bufs:
+        take = min(len(mv), _PROBE_BYTES - len(head))
+        head += mv[:take]
+        if len(head) >= _PROBE_BYTES:
+            break
+    if len(head) >= 256 and len(zlib.compress(bytes(head), 1)) >= int(len(head) * _PROBE_RATIO):
+        return None
+    return zlib.compress(b"".join(bytes(mv) for mv in bufs), 1)
+
+
+def read_payload_v2(sock, pool, payload_len: int, flags: int, raw_len: int):
+    """Land the payload into a pooled buffer (decompressing to a private
+    bytes object when flagged) and cross-check its length against the
+    leaf-table geometry — the mis-shape guard."""
+    buf: Any = None
+    if payload_len:
+        buf = pool.take(payload_len)
+        recv_exact_into(sock, memoryview(buf)[:payload_len])
+        if flags & F2_COMPRESSED:
+            raw = zlib.decompress(memoryview(buf)[:payload_len])
+            pool.give(buf)
+            buf = raw
+            if len(raw) != raw_len:
+                raise WireFormatError(
+                    f"decompressed payload is {len(raw)} bytes, leaf table says {raw_len}"
+                )
+        elif payload_len != raw_len:
+            raise WireFormatError(
+                f"payload length {payload_len} does not match leaf-table geometry {raw_len}"
+            )
+    elif raw_len:
+        raise WireFormatError(f"empty payload for a {raw_len}-byte leaf table")
+    return buf
+
+
+# ----------------------------------------------------------- coalesced codec
+def encode_coalesced_entry(tag: str, seq: int, extra: Tuple, items) -> bytes:
+    """One subframe of a coalesced batch: a length-prefixed pickle of the
+    full frame tuple.  Subframes are SMALL by construction (heartbeats,
+    live summaries, fused-collector inserts below the coalesce gate), so
+    pickling them is not the hot path the v2 format removes — the win is
+    one wire frame + one syscall for the whole batch."""
+    if items is not None:
+        items = [(k, np.ascontiguousarray(a)) for k, a in items]
+    blob = pickle.dumps((tag, int(seq), tuple(extra), items), protocol=pickle.HIGHEST_PROTOCOL)
+    return _SUB_HDR.pack(len(blob)) + blob
+
+
+def decode_coalesced(payload) -> List[Tuple]:
+    """Parse a coalesced batch payload into v1-shaped frame tuples
+    ``(tag, seq, extra, leaves, buf, crc)`` — each subframe gets a
+    PRIVATE contiguous buffer (the batch buffer returns to the pool
+    immediately), so delivery and release need no special casing."""
+    mv = memoryview(payload)
+    out: List[Tuple] = []
+    pos = 0
+    while pos < len(mv):
+        if pos + _SUB_HDR.size > len(mv):
+            raise WireFormatError("coalesced batch truncated inside a length prefix")
+        (blen,) = _SUB_HDR.unpack_from(mv, pos)
+        pos += _SUB_HDR.size
+        if pos + blen > len(mv):
+            raise WireFormatError("coalesced batch truncated inside a subframe")
+        try:
+            tag, seq, extra, items = pickle.loads(bytes(mv[pos : pos + blen]))
+        except Exception as e:
+            raise WireFormatError(f"undecodable coalesced subframe: {e}") from None
+        pos += blen
+        leaves, bufs, total = build_leaves(items)
+        buf = b"".join(bytes(b) for b in bufs) if total else b""
+        out.append((str(tag), int(seq), tuple(extra), leaves, buf, None))
+    return out
+
+
+# --------------------------------------------------------- overlapped sender
+class OverlappedSender:
+    """The player's device→wire pipeline (3 overlapped stages inside the
+    existing ``collect`` span):
+
+    1. ``submit`` SNAPSHOTS the payload synchronously — the device→host
+       materialization plus a private copy of any leaf that aliases a
+       rollout buffer the next collect step will scribble over;
+    2./3. a worker thread runs the integrity digest and the socket write
+       (both live inside ``channel.send``) while the caller is already
+       collecting the next rollout.
+
+    Double-buffered by construction: at most one frame in flight on the
+    worker plus one being snapshotted by the caller; a second ``submit``
+    while one is queued blocks (the transport's credit window stays the
+    real backpressure).  ``flush()`` drains the pipeline and re-raises
+    any send failure — call it before anything that must order after the
+    data frame (checkpoint barriers, stop frames, direct sends on the
+    same channel)."""
+
+    def __init__(self, channel, name: str = "sheeprl-wire-sender"):
+        self._chan = channel
+        self._q: "queue_mod.Queue[Optional[tuple]]" = queue_mod.Queue(maxsize=1)
+        self._err: Optional[BaseException] = None
+        self._cond = threading.Condition()
+        self._pending = 0  # submitted, not yet fully sent
+        self._thread = threading.Thread(target=self._loop, name=name, daemon=True)
+        self._thread.start()
+        self.frames = 0
+
+    def _loop(self) -> None:
+        while True:
+            job = self._q.get()
+            if job is None:
+                return
+            tag, arrays, extra, seq, timeout = job
+            try:
+                self._chan.send(tag, arrays=arrays, extra=extra, seq=seq, timeout=timeout)
+            except BaseException as e:  # re-raised at the next submit/flush
+                self._err = e
+            finally:
+                with self._cond:
+                    self._pending -= 1
+                    self._cond.notify_all()
+
+    def _raise_pending(self) -> None:
+        if self._err is not None:
+            err, self._err = self._err, None
+            raise err
+
+    def submit(self, tag, arrays, extra=(), seq=-1, timeout: float = 600.0) -> None:
+        """Stage 1 (synchronous snapshot) + enqueue for stages 2-3."""
+        self._raise_pending()
+        # the snapshot: np.asarray materializes device/lazy leaves; leaves
+        # that are views of live buffers are copied so the next rollout
+        # step cannot mutate bytes the worker has not written yet
+        snap = []
+        for k, v in arrays or []:
+            a = np.asarray(v)
+            snap.append((k, np.array(a) if a.base is not None else a))
+        with self._cond:
+            self._pending += 1
+        self._q.put((tag, snap, tuple(extra), seq, timeout))
+        self.frames += 1
+
+    def flush(self, timeout: float = 600.0) -> None:
+        """Drain the pipeline; re-raises the worker's failure if any."""
+        with self._cond:
+            if not self._cond.wait_for(lambda: self._pending == 0, timeout=timeout):
+                raise TimeoutError("overlapped sender did not drain")
+        self._raise_pending()
+
+    def close(self) -> None:
+        try:
+            with self._cond:
+                self._cond.wait_for(lambda: self._pending == 0, timeout=5.0)
+        finally:
+            self._q.put(None)
+            self._thread.join(timeout=5.0)

@@ -176,6 +176,37 @@ class TestZeroCopyAlias:
         )
         assert out == []
 
+    def test_positive_wire_arena_view(self):
+        # wire-format v2 (ISSUE 19): leaf_views returns np.frombuffer
+        # views into a pooled recv arena — recycled on frame release
+        out = _lint(
+            """
+            import jax
+            from sheeprl_tpu.parallel import wire
+
+            def bug(leaves, buf):
+                views = wire.leaf_views(leaves, buf)
+                return jax.device_put(views)
+            """
+        )
+        assert _checks(out) == ["zero-copy-alias"]
+        assert "wire-arena view" in out[0].message
+
+    def test_negative_arrays_copy_cleanses_wire_view(self):
+        # the blessed cleanse on the v2 recv path: Frame.arrays_copy()
+        # materializes private arrays between the arena view and the sink
+        out = _lint(
+            """
+            import jax
+            from sheeprl_tpu.parallel import wire
+
+            def ok(frame, leaves, buf):
+                views = frame.arrays_copy(wire.leaf_views(leaves, buf))
+                return jax.device_put(views)
+            """
+        )
+        assert out == []
+
     def test_negative_plain_ndarray_view_not_flagged(self):
         # a numpy view refcounts its base: lifetime is safe, deliberately clean
         out = _lint(
