@@ -111,6 +111,7 @@ SECTIONS = [
     ("ppo", 100),
     ("sac", 60),
     ("a2c", 100),
+    ("swarm", 90),
     ("dec", 300),
     ("fanin", 140),
     ("transport", 240),
@@ -826,6 +827,64 @@ def bench_serve():
     }
 
 
+def bench_swarm():
+    """Saturation swarm vs the elastic in-process serve pool (scripts/
+    swarm.py; howto/serving.md "Autoscaling"): a clients x think-time
+    ladder of threaded session clients with lognormal think times drives
+    a synthetic recurrent-PPO session server pool (min 1 / max 3
+    workers) to saturation; per-rung actions/s, latency percentiles and
+    the measured grow/shrink trajectory are recorded.  On this 1-core
+    container every client thread, the pool workers and the jitted step
+    time-slice one core, so absolute latency percentiles are an UPPER
+    bound and the autoscaler mostly sees queue-depth pressure from GIL
+    contention — the portable signals are zero dropped requests, the
+    exactly-once session counters, and the grow/shrink events actually
+    firing under load (host_cpu_count is recorded)."""
+    from scripts.swarm import run_pool_swarm
+
+    steps = int(os.environ.get("BENCH_SWARM_STEPS", 20))
+    rows = []
+    for clients, think_ms in ((16, 5.0), (48, 2.0), (96, 1.0)):
+        report, stats = run_pool_swarm(
+            clients=clients,
+            steps=steps,
+            rows=1,
+            think_mean_ms=think_ms,
+            think_sigma=1.0,
+            pool_min=1,
+            pool_max=3,
+        )
+        d = report.as_dict()
+        scale = stats.get("autoscale") or {}
+        rows.append(
+            {
+                "clients": clients,
+                "think_mean_ms": think_ms,
+                "steps_per_client": steps,
+                "actions_per_s": d["actions_per_s"],
+                "latency_ms": d["latency_ms"],
+                "latency_hist": d["latency_hist"],
+                "dropped": d["dropped"],
+                "local_fallbacks": d["local_fallbacks"],
+                "session_losses": d["session_losses"],
+                "workers_final": stats.get("workers"),
+                "grows": scale.get("grows"),
+                "shrinks": scale.get("shrinks"),
+                "slo_state": d["slo"]["swarm_p99"]["state"],
+            }
+        )
+    heavy = rows[-1]
+    return {
+        "metric": "swarm_pool_actions_per_s_96c",
+        "value": heavy["actions_per_s"],
+        "unit": "actions/s",
+        "vs_baseline": None,
+        "dropped_total": sum(r["dropped"] for r in rows),
+        "rows": rows,
+        "host_cpu_count": os.cpu_count(),
+    }
+
+
 def bench_jaxenv():
     """Device-resident env ladder (benchmarks/bench_jaxenv.py, ISSUE 11):
     env-steps/s of host SyncVectorEnv vs JaxVectorEnv vs the fused
@@ -894,7 +953,7 @@ SKIPLIST_PATH = os.path.join(REPO, "benchmarks", "bench_gate_skiplist.json")
 
 # which direction is better, keyed by the metric line's ``unit``
 _LOWER_IS_BETTER_UNITS = ("s", "ms")
-_HIGHER_IS_BETTER_UNITS = ("frames/s", "x", "steps/s")
+_HIGHER_IS_BETTER_UNITS = ("frames/s", "x", "steps/s", "actions/s")
 
 
 def load_previous_round(repo=REPO):

@@ -55,6 +55,18 @@ manifest, reshard the restored state onto the new fsdp axis, and finish
 rc=0 — with the ``ckpt`` telemetry key carrying the per-shard write /
 manifest stitch stats in both phases.
 
+``--mode scale`` is the ISSUE 20 acceptance harness, two legs.  A
+decoupled run starts its player pool at the autoscaler MINIMUM (1 of
+3); forced gather pressure makes the telemetry-driven autoscaler grow
+it through the real supervisor spawn path, and the initially-spawned
+player is killed a few iterations in, while the pool is still scaling
+up — the pool must still converge to the maximum with the kill
+restarted, every decision a typed ``autoscale`` flight event.  Then a session swarm thrashes an elastic
+serve pool whose session cache is smaller than the client count — every
+client must ride out the ``session_lost`` storm by reopen-and-replay
+with zero drops — and a nan-poisoned hot-swap candidate must be refused
+by the session server.
+
 Serve acceptance (ISSUE 8)::
 
     python scripts/chaos_soak.py --mode serve --seed 7
@@ -66,6 +78,10 @@ Integrity acceptance (ISSUE 10)::
 Sharded-checkpoint acceptance (ISSUE 17)::
 
     python scripts/chaos_soak.py --mode ckpt --seed 7
+
+Elastic-scale acceptance (ISSUE 20)::
+
+    python scripts/chaos_soak.py --mode scale --seed 7
 
 all wrapped by ``chaos``/``slow``-marked pytest soaks.  The schedules
 are pure functions of ``--seed``, so a failing soak reproduces exactly.
@@ -537,6 +553,257 @@ def run_serve_mode(args) -> int:
     return 0
 
 
+# ------------------------------------------------------------- scale
+def read_scale(root_dir: str):
+    """Last transport record plus the run's ``autoscale`` flight events
+    and player scale-up/retire events (obs/reader.py)."""
+    from sheeprl_tpu.obs.reader import iter_run_records, read_flight
+
+    last = None
+    for rec in iter_run_records(root_dir):
+        if "transport" in rec:
+            last = rec["transport"]
+    events = [r for r in read_flight(root_dir) if r.get("k") == "event"]
+    scaling = [r for r in events if r.get("name") == "autoscale"]
+    spawns = [r for r in events if r.get("name") == "player_scale_up"]
+    deaths = [r for r in events if r.get("name") == "player_dead"]
+    return last, scaling, spawns, deaths
+
+
+def audit_scale(last, scaling, spawns, deaths, *, players: int, start_players: int) -> list:
+    """The elastic-pool convergence audit: the pool must START at the
+    autoscaler minimum, GROW on measured pressure (typed ``autoscale``
+    flight events, not inference), absorb the mid-scale-up kill, and end
+    converged at the configured maximum.  The kill can be healed by
+    EITHER actuator — the supervisor's budgeted restart, or (usually,
+    since the pool is under sustained pressure and the backoff-delayed
+    restart loses the race) the autoscaler's next grow refilling the
+    dead slot through the same join machinery; both count, what matters
+    is a real death and a reconverged pool."""
+    failures = []
+    if last is None:
+        return ["no transport telemetry found (did the lead die without re-mastering?)"]
+    grows = [e for e in scaling if (e.get("a") or {}).get("action") == "grow"]
+    need = players - start_players
+    if len(grows) < need:
+        failures.append(f"only {len(grows)} autoscale grow events for {need} needed slots")
+    if len(spawns) < need:
+        failures.append(f"only {len(spawns)} player_scale_up events for {need} needed slots")
+    first_sizes = [int((e.get("a") or {}).get("size", -1)) for e in grows]
+    if grows and start_players not in first_sizes:
+        failures.append(
+            f"no grow fired from the configured minimum {start_players} "
+            f"(sizes seen: {first_sizes}) — pool did not start small"
+        )
+    pool = last.get("live", 0) + last.get("joining", 0)
+    if pool < players:
+        failures.append(f"pool never converged: live+joining={pool} < {players}")
+    if not deaths:
+        failures.append("no player_dead flight event — the scheduled kill never landed")
+    restarts = (last.get("supervisor") or {}).get("restarts", 0)
+    if restarts < 1 and len(spawns) <= need:
+        failures.append(
+            f"the kill was never healed: supervisor restarts={restarts} and only "
+            f"{len(spawns)} scale-up spawns for {need} vacant slots (no refill)"
+        )
+    scale_stats = last.get("autoscale") or {}
+    if scale_stats.get("grows", 0) < need:
+        failures.append(f"telemetry autoscale.grows={scale_stats.get('grows')} < {need}")
+    return failures
+
+
+def run_scale_serve_leg(root: str, seed: int) -> list:
+    """Deterministic serving sub-leg: a session swarm against an elastic
+    pool whose session cache is DELIBERATELY smaller than the client
+    count — every client must survive the resulting ``session_lost``
+    storm by reopen-and-replay with zero dropped requests — plus the
+    nan-poisoned hot-swap candidate a session server must refuse."""
+    import time
+    import warnings as _warnings
+
+    import numpy as np
+
+    from scripts.swarm import run_pool_swarm, synthetic_session_parts
+    from sheeprl_tpu.serve import SessionInferenceServer, agent_params_loader
+    from sheeprl_tpu.utils.ckpt_format import save_state
+
+    failures = []
+    clients = 12
+    report, stats = run_pool_swarm(
+        clients=clients,
+        steps=8,
+        rows=1,
+        think_mean_ms=2.0,
+        think_sigma=1.0,
+        pool_min=1,
+        pool_max=2,
+        seed=seed,
+        session_capacity=clients // 3,  # thrash: forced LRU evictions
+        slo_target_ms=10_000.0,  # latency is not this leg's subject
+    )
+    d = report.as_dict()
+    if d["dropped"] != 0:
+        failures.append(f"{d['dropped']} requests dropped under session-cache thrash")
+    if d["session_losses"] < 1:
+        failures.append("tiny session cache never evicted a live session (session_lost unexercised)")
+    if d["session_reopens"] < d["session_losses"]:
+        failures.append(
+            f"{d['session_losses']} session losses but only {d['session_reopens']} reopens"
+        )
+    sess = (stats.get("sessions") or {})
+    if sess.get("evictions_lru", 0) < 1:
+        failures.append(f"no LRU evictions recorded: {sess}")
+
+    # hot-swap refusal on a SESSION server: the newer-but-poisoned
+    # candidate is refused, the older finite one applied (PR-8 contract
+    # carried over the session decorator)
+    params, session_fn, init_fn, _, _ = synthetic_session_parts(seed)
+    ckpt_dir = os.path.join(root, "scale_hot_swap", "checkpoint")
+    os.makedirs(ckpt_dir, exist_ok=True)
+    flat_params = {"agent": {"w": np.full((4,), 2.0, np.float32)}}
+    good = save_state(os.path.join(ckpt_dir, "ckpt_100_0.ckpt"), flat_params)
+    time.sleep(0.02)
+    poisoned = {"agent": {"w": np.full((4,), np.nan, np.float32)}}
+    save_state(os.path.join(ckpt_dir, "ckpt_200_0.ckpt"), poisoned)
+    srv = SessionInferenceServer(
+        None, params, session_policy_fn=session_fn, init_state_fn=init_fn, capacity=8
+    )
+    srv.watch(os.path.join(root, "scale_hot_swap"), agent_params_loader("agent"), interval_s=1e6)
+    with _warnings.catch_warnings():
+        _warnings.simplefilter("ignore")
+        swapped = srv.poll_hot_swap()
+    st = srv.stats()["swaps"]
+    if st["refused_invalid"] < 1:
+        failures.append("nan-poisoned checkpoint was NOT refused by the session server")
+    if swapped != os.path.abspath(good) or st["applied"] != 1:
+        failures.append(f"good checkpoint not swapped in (swapped={swapped}, stats={st})")
+    srv.close()
+    print(
+        json.dumps(
+            {
+                "swarm": {
+                    k: d[k]
+                    for k in (
+                        "dropped",
+                        "session_losses",
+                        "session_reopens",
+                        "actions_per_s",
+                        "latency_ms",
+                    )
+                },
+                "sessions": sess,
+                "pool_autoscale": stats.get("autoscale"),
+                "hot_swap": st,
+            },
+            indent=2,
+        )
+    )
+    return failures
+
+
+def run_scale_mode(args) -> int:
+    """ISSUE 20 acceptance soak, two legs.  TRAINING: a decoupled run
+    whose player pool starts at the autoscaler minimum (1), is grown by
+    the telemetry-driven autoscaler under forced gather pressure, loses
+    its initially-spawned player a few iterations in — while the pool is
+    still scaling up — and must still converge to the configured
+    maximum with the kill restarted — all asserted from typed flight
+    events and telemetry.  SERVING: the session-cache-thrash swarm plus
+    the poisoned hot-swap refusal (:func:`run_scale_serve_leg`)."""
+    import shutil
+
+    players = max(2, min(args.players, 3))
+    # the kill targets player 0 — the one slot spawned at startup.  The
+    # autoscaled slots come up through supervisor._launch, which strips
+    # their own player_exit entries (a respawned player must not re-fire
+    # its predecessor's kill), so only the initial spawn can die; its
+    # 6th own-iteration lands while the pool is still growing
+    faults = "player_exit:6:0"
+    print(f"scale chaos schedule (seed {args.seed}): SHEEPRL_FAULTS={faults}")
+
+    shutil.rmtree(args.root_dir, ignore_errors=True)
+    os.environ["SHEEPRL_FAULTS"] = faults
+    from sheeprl_tpu.cli import run
+
+    try:
+        run(
+            [
+                "exp=ppo_decoupled",
+                "env=dummy",
+                "env.sync_env=True",
+                "env.capture_video=False",
+                "fabric.accelerator=cpu",
+                "fabric.devices=1",
+                "metric.log_level=1",
+                "metric.log_every=64",
+                "metric.tracing=full",  # the audit reads typed flight events
+                f"metric.logger.root_dir={args.root_dir}/logs",
+                "checkpoint.save_last=True",
+                "buffer.memmap=False",
+                f"seed={args.seed}",
+                "algo.per_rank_batch_size=4",
+                "algo.dense_units=8",
+                "algo.mlp_layers=1",
+                "algo.mlp_keys.encoder=[state]",
+                f"algo.total_steps={args.total_steps}",
+                f"algo.num_players={players}",
+                f"algo.decoupled_transport={args.transport}",
+                "algo.run_test=False",
+                "algo.supervisor.enabled=True",
+                "algo.supervisor.backoff_base=0.1",
+                "algo.supervisor.restart_budget=3",
+                "algo.autoscaler.enabled=True",
+                "algo.autoscaler.min_players=1",
+                "algo.autoscaler.up_window_s=0.01",
+                "algo.autoscaler.up_cooldown_s=0.1",
+                "algo.autoscaler.down_window_s=600",
+                # always-pressure: every gather wait >= 0 — the pool must
+                # march from 1 to num_players through the real spawn path
+                "algo.autoscaler.gather_wait_pressure_s=0.0",
+                f"root_dir={args.root_dir}/run",
+                "env.num_envs=4",
+                "algo.rollout_steps=4",
+                "algo.update_epochs=1",
+            ]
+        )
+    finally:
+        os.environ.pop("SHEEPRL_FAULTS", None)
+
+    last, scaling, spawns, deaths = read_scale(os.path.join(args.root_dir, "run"))
+    failures = audit_scale(last, scaling, spawns, deaths, players=players, start_players=1)
+    print(
+        json.dumps(
+            {
+                "pool": {
+                    "live": (last or {}).get("live"),
+                    "joining": (last or {}).get("joining"),
+                    "deaths": (last or {}).get("deaths"),
+                    "rejoins": (last or {}).get("rejoins"),
+                },
+                "autoscale": (last or {}).get("autoscale"),
+                "supervisor": (last or {}).get("supervisor"),
+                "events": {
+                    "autoscale": [e.get("a") for e in scaling],
+                    "player_scale_up": len(spawns),
+                    "player_dead": len(deaths),
+                },
+                "failures": failures,
+            },
+            indent=2,
+        )
+    )
+    failures += run_scale_serve_leg(args.root_dir, args.seed)
+    if not args.keep:
+        shutil.rmtree(args.root_dir, ignore_errors=True)
+    if failures:
+        print("SCALE CHAOS SOAK FAILED", file=sys.stderr)
+        for f in failures:
+            print(f"  - {f}", file=sys.stderr)
+        return 1
+    print("scale chaos soak passed")
+    return 0
+
+
 # ------------------------------------------------------------- integrity
 def _ppo_integrity_args(args, root: str, integrity: str, transport: str, total_steps: int):
     return [
@@ -937,14 +1204,16 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--mode",
         default="topology",
-        choices=("topology", "health", "serve", "integrity", "ckpt"),
+        choices=("topology", "health", "serve", "integrity", "ckpt", "scale"),
         help=(
             "topology: kill/rejoin soak (ISSUE 6); health: training sentinel proof "
             "(ISSUE 7); serve: inference-service failure envelope (ISSUE 8); "
             "integrity: bit_flip detection/recovery on all three transports + "
             "rb_insert quarantine + off-vs-crc bit-exactness (ISSUE 10); "
             "ckpt: sharded-checkpoint kill-mid-shard + auto-resume onto a "
-            "different mesh (ISSUE 17)"
+            "different mesh (ISSUE 17); scale: elastic-pool autoscaler "
+            "convergence under a mid-scale-up kill + session-cache-thrash "
+            "swarm + poisoned hot-swap refusal (ISSUE 20)"
         ),
     )
     ap.add_argument(
@@ -984,6 +1253,15 @@ def main(argv=None) -> int:
         if args.root_dir == "/tmp/sheeprl_chaos_soak":
             args.root_dir = "/tmp/sheeprl_chaos_ckpt"
         return run_ckpt_mode(args)
+    if args.mode == "scale":
+        if args.root_dir == "/tmp/sheeprl_chaos_soak":
+            args.root_dir = "/tmp/sheeprl_chaos_scale"
+        args.transport = args.transport or "queue"
+        if args.players == 4:
+            args.players = 3
+        if args.total_steps == 19200:
+            args.total_steps = 4800
+        return run_scale_mode(args)
     if args.mode == "serve":
         if args.root_dir == "/tmp/sheeprl_chaos_soak":
             args.root_dir = "/tmp/sheeprl_chaos_serve"

@@ -61,25 +61,48 @@ def _load_run_cfg(ckpt_path: str):
         return dotdict(yaml_load(f.read()))
 
 
-def build_server(ckpt_path: str, *, greedy: bool = True, deadline_ms: float = 5.0, max_batch: int = 64):
-    """Checkpoint -> a ready (not yet started) InferenceServer + the
-    obs keys its requests must carry."""
+def build_server(
+    ckpt_path: str,
+    *,
+    greedy: bool = True,
+    deadline_ms: float = 5.0,
+    max_batch: int = 64,
+    session_capacity: int = 1024,
+    session_ttl_s: float = 300.0,
+):
+    """Checkpoint -> a ready (not yet started) server + the obs keys its
+    requests must carry.  Stateless families (PPO/SAC) get the PR-8
+    InferenceServer; recurrent families (recurrent PPO, Dreamer v3) get
+    the SESSION tier — clients must speak the session protocol
+    (SessionClient.step), because a recurrent policy served statelessly
+    is meaningless."""
     import gymnasium as gym
 
     from sheeprl_tpu.parallel.mesh import MeshRuntime
     from sheeprl_tpu.serve import (
-        InferenceServer,
         agent_params_loader,
+        make_dreamer_session_fns,
         make_ppo_policy_fn,
+        make_recurrent_ppo_session_fns,
         make_sac_policy_fn,
     )
+    from sheeprl_tpu.serve import build_server as _make_server
     from sheeprl_tpu.utils.env import make_env
 
     cfg = _load_run_cfg(ckpt_path)
     algo = str(cfg.algo.name)
-    family = "ppo" if algo.startswith(("ppo", "a2c")) else ("sac" if algo.startswith(("sac", "droq")) else None)
-    if family is None:
-        raise ValueError(f"serve_policy supports the PPO/SAC families, got algo={algo!r}")
+    if algo.startswith("ppo_recurrent"):
+        family = "ppo_recurrent"
+    elif algo.startswith("dreamer_v3"):
+        family = "dreamer_v3"
+    elif algo.startswith(("ppo", "a2c")):
+        family = "ppo"
+    elif algo.startswith(("sac", "droq")):
+        family = "sac"
+    else:
+        raise ValueError(
+            f"serve_policy supports the PPO/SAC/recurrent-PPO/Dreamer-v3 families, got algo={algo!r}"
+        )
 
     runtime = MeshRuntime(devices=1, accelerator="cpu", precision=cfg.fabric.get("precision", "32-true"))
     runtime.launch()
@@ -88,20 +111,59 @@ def build_server(ckpt_path: str, *, greedy: bool = True, deadline_ms: float = 5.
     observation_space, action_space = env.observation_space, env.action_space
     env.close()
 
+    is_continuous = isinstance(action_space, gym.spaces.Box)
+    is_multidiscrete = isinstance(action_space, gym.spaces.MultiDiscrete)
+    actions_dim = tuple(
+        action_space.shape
+        if is_continuous
+        else (action_space.nvec.tolist() if is_multidiscrete else [action_space.n])
+    )
+
+    policy_fn = session_policy_fn = init_state_fn = None
     if family == "ppo":
         from sheeprl_tpu.algos.ppo.agent import build_agent
 
-        is_continuous = isinstance(action_space, gym.spaces.Box)
-        is_multidiscrete = isinstance(action_space, gym.spaces.MultiDiscrete)
-        actions_dim = tuple(
-            action_space.shape
-            if is_continuous
-            else (action_space.nvec.tolist() if is_multidiscrete else [action_space.n])
-        )
         loader = agent_params_loader("agent")
         params = loader(ckpt_path)
         module, params = build_agent(runtime, actions_dim, is_continuous, cfg, observation_space, params)
         policy_fn = make_ppo_policy_fn(module, cfg.algo.cnn_keys.encoder, greedy=greedy)
+        obs_keys = list(cfg.algo.cnn_keys.encoder) + list(cfg.algo.mlp_keys.encoder)
+    elif family == "ppo_recurrent":
+        from sheeprl_tpu.algos.ppo_recurrent.agent import build_agent
+
+        loader = agent_params_loader("agent")
+        params = loader(ckpt_path)
+        module, params = build_agent(runtime, actions_dim, is_continuous, cfg, observation_space, params)
+        session_policy_fn, init_state_fn = make_recurrent_ppo_session_fns(module, greedy=greedy)
+        obs_keys = list(cfg.algo.cnn_keys.encoder) + list(cfg.algo.mlp_keys.encoder)
+    elif family == "dreamer_v3":
+        from sheeprl_tpu.algos.dreamer_v3.agent import build_agent
+
+        from sheeprl_tpu.utils.callback import load_checkpoint
+
+        def loader(path: str):
+            # Dreamer checkpoints carry top-level world_model/actor trees;
+            # serving needs exactly the player's composite
+            state = load_checkpoint(path)
+            return {"world_model": state["world_model"], "actor": state["actor"]}
+
+        state = loader(ckpt_path)
+        world_model, actor_mod, _, params = build_agent(
+            runtime, actions_dim, is_continuous, cfg, observation_space,
+            state["world_model"], state["actor"],
+        )
+        params = {"world_model": params["world_model"], "actor": params["actor"]}
+        wm_cfg = cfg.algo.world_model
+        session_policy_fn, init_state_fn = make_dreamer_session_fns(
+            world_model,
+            actor_mod,
+            actions_dim=actions_dim,
+            stochastic_size=int(wm_cfg.stochastic_size),
+            discrete_size=int(wm_cfg.discrete_size),
+            recurrent_state_size=int(wm_cfg.recurrent_model.recurrent_state_size),
+            decoupled_rssm=bool(wm_cfg.get("decoupled_rssm", False)),
+            greedy=greedy,
+        )
         obs_keys = list(cfg.algo.cnn_keys.encoder) + list(cfg.algo.mlp_keys.encoder)
     else:
         from sheeprl_tpu.algos.sac.agent import build_agent
@@ -116,8 +178,20 @@ def build_server(ckpt_path: str, *, greedy: bool = True, deadline_ms: float = 5.
         loader = agent_params_loader("agent/actor")
         obs_keys = list(cfg.algo.mlp_keys.encoder)
 
-    server = InferenceServer(
-        policy_fn, params, deadline_ms=deadline_ms, max_batch=max_batch, seed=int(cfg.get("seed", 0)), name=algo
+    server = _make_server(
+        policy_fn,
+        params,
+        session={
+            "enabled": session_policy_fn is not None,
+            "capacity": int(session_capacity),
+            "idle_ttl_s": float(session_ttl_s),
+        },
+        session_policy_fn=session_policy_fn,
+        init_state_fn=init_state_fn,
+        deadline_ms=deadline_ms,
+        max_batch=max_batch,
+        seed=int(cfg.get("seed", 0)),
+        name=algo,
     )
     server.swap_params(params, source=os.path.abspath(ckpt_path))
     return server, loader, obs_keys, observation_space
@@ -133,9 +207,13 @@ def run_selftest(server, obs_keys, observation_space, n_clients: int, n_requests
     from sheeprl_tpu.parallel.transport import make_transport
     from sheeprl_tpu.serve import InferenceClient
 
+    from sheeprl_tpu.serve import SessionClient, SessionInferenceServer
+
+    sessions = isinstance(server, SessionInferenceServer)
     ctx = mp.get_context("spawn")
     hub, specs = make_transport(ctx, "queue", n_clients, window=4, min_bytes=0)
-    clients = [InferenceClient(specs[i].player_channel(), i) for i in range(n_clients)]
+    make_client = (lambda ch, i: SessionClient(ch, i, seed=i)) if sessions else InferenceClient
+    clients = [make_client(specs[i].player_channel(), i) for i in range(n_clients)]
     for i in range(n_clients):
         server.attach(i, hub.channel(i, timeout=5))
     server.start()
@@ -149,10 +227,16 @@ def run_selftest(server, obs_keys, observation_space, n_clients: int, n_requests
                 k: rng.normal(size=(1,) + tuple(observation_space[k].shape)).astype(np.float32)
                 for k in obs_keys
             }
-            out, src = clients[cid].infer([(k, v) for k, v in obs.items()], 1)
+            arrays = [(k, v) for k, v in obs.items()]
+            if sessions:
+                out, src = clients[cid].step(arrays, 1)
+            else:
+                out, src = clients[cid].infer(arrays, 1)
             if src != "remote" or out is None:
                 failures.append(cid)
                 return
+        if sessions:
+            clients[cid].close_session()
 
     threads = [threading.Thread(target=drive, args=(i,)) for i in range(n_clients)]
     t0 = time.perf_counter()
@@ -183,6 +267,10 @@ def main(argv=None) -> int:
     ap.add_argument("--deadline-ms", type=float, default=5.0)
     ap.add_argument("--max-batch", type=int, default=64)
     ap.add_argument("--sample", action="store_true", help="sample actions instead of greedy")
+    ap.add_argument("--session-capacity", type=int, default=1024,
+                    help="session-cache LRU bound (recurrent families)")
+    ap.add_argument("--session-ttl", type=float, default=300.0,
+                    help="session idle TTL in seconds (recurrent families)")
     ap.add_argument(
         "--watch", action="store_true",
         help="hot-swap: watch the run root for newly good-tagged checkpoints",
@@ -199,6 +287,8 @@ def main(argv=None) -> int:
         greedy=not args.sample,
         deadline_ms=args.deadline_ms,
         max_batch=args.max_batch,
+        session_capacity=args.session_capacity,
+        session_ttl_s=args.session_ttl,
     )
     if args.watch:
         run_root = os.path.dirname(os.path.dirname(os.path.abspath(args.checkpoint)))

@@ -681,6 +681,15 @@ def _player_loop(
             # non-lead worker: nothing to save — drain out so the fan-in
             # shrinks cleanly instead of the trainer timing out on us
             break
+        if not lead:
+            # autoscaler shrink: the trainer retires this player by a
+            # control frame on the params channel; drain out exactly like
+            # a preempted non-lead (ship already done, stop frame below)
+            retire_frame = follower.poll_control("retire")
+            if retire_frame is not None:
+                retire_frame.release()
+                flight.fleet_event("player_retired", player=player_id, round=iter_num)
+                break
 
         # --------------------------------------------- logging (lead-side)
         if lead and cfg.metric.log_level > 0 and logger:
@@ -773,7 +782,9 @@ def _player_loop(
     obs_fleet.close_live()
 
 
-def spawn_players(cfg, runtime, ctx, target, extra_args=(), knobs=None, with_inference=False):
+def spawn_players(
+    cfg, runtime, ctx, target, extra_args=(), knobs=None, with_inference=False, start_players=None
+):
     """Create the transport + spawn ``num_players`` player processes
     pinned to the host CPU backend (shared with sac_decoupled).
 
@@ -782,11 +793,20 @@ def spawn_players(cfg, runtime, ctx, target, extra_args=(), knobs=None, with_inf
     service and hands each player its spec (trailing ``(join=False,
     infer_spec)`` positionals on the player-loop signature).
 
+    ``start_players`` (autoscaler: ``algo.autoscaler.min_players``)
+    starts the pool BELOW its configured size: the transport, env
+    shards and specs are built for all ``num_players`` slots, but only
+    the first ``start_players`` processes launch — the vacant slots are
+    grown into later via :meth:`PlayerSupervisor.spawn_player` (the
+    fixed-width padded batch assembly means a vacant slot is just a
+    masked column, never a retrace).  The lead (pid 0) always starts.
+
     Returns ``(hub, fanin_channels, procs, env_shards, infer_hub)``
     (``infer_hub`` is None without inference).
     """
     knobs = knobs or decoupled_knobs(cfg)
     num_players = knobs["num_players"]
+    start = num_players if start_players is None else max(1, min(int(start_players), num_players))
     total_envs = int(cfg.env.num_envs)
     env_shards = split_envs(total_envs, num_players)
     hub, specs = make_transport(
@@ -831,6 +851,8 @@ def spawn_players(cfg, runtime, ctx, target, extra_args=(), knobs=None, with_inf
     os.environ["JAX_PLATFORMS"] = "cpu"
     try:
         for pid, (offset, count) in enumerate(env_shards):
+            if pid >= start:
+                break  # vacant slot: the autoscaler grows into it later
             args = (cfg, specs[pid]) + tuple(extra_args) + (offset, count)
             if infer_specs is not None:
                 args += (False, infer_specs[pid])
@@ -898,6 +920,17 @@ def main(runtime, cfg: Dict[str, Any]):
     from sheeprl_tpu.serve import inference_setting
 
     inference = inference_setting(cfg, knobs["num_players"])
+
+    # elastic player pool (ROADMAP: serving/scale plane): the autoscaler
+    # needs the supervisor's join machinery to actuate, and only makes
+    # sense with a fan-out to flex
+    from sheeprl_tpu.scale import Autoscaler, autoscaler_knobs
+
+    ak = autoscaler_knobs(cfg)
+    autoscale_on = (
+        ak["enabled"] and knobs["supervisor"]["enabled"] and knobs["num_players"] > 1
+    )
+
     ctx = mp.get_context("spawn")
     hub, channels, proc_list, env_shards, infer_hub = spawn_players(
         cfg,
@@ -907,6 +940,7 @@ def main(runtime, cfg: Dict[str, Any]):
         extra_args=(counters, runtime.world_size),
         knobs=knobs,
         with_inference=inference == "remote",
+        start_players=ak["min_players"] if autoscale_on else None,
     )
     procs: Dict[int, Any] = dict(enumerate(proc_list))
     rollout_steps = int(cfg.algo.rollout_steps)
@@ -1025,14 +1059,25 @@ def main(runtime, cfg: Dict[str, Any]):
         # the second transport; a dead serving loop is respawned by the
         # ServeSupervisor in drain-recover mode under a restart budget
         serve_server = serve_sup = None
+        ik = None
         if infer_hub is not None:
             from sheeprl_tpu.resilience import ServeSupervisor
-            from sheeprl_tpu.serve import InferenceServer, inference_knobs, make_ppo_policy_fn
+            from sheeprl_tpu.serve import (
+                build_server,
+                inference_knobs,
+                make_ppo_policy_fn,
+                session_knobs,
+            )
 
             ik = inference_knobs(cfg)
-            serve_server = InferenceServer(
+            # feedforward PPO has no recurrent state, so even with the
+            # session knobs on this constructs the undecorated PR-8
+            # server (build_server requires the session adapters) —
+            # bit-exactness with the pre-session tree is structural
+            serve_server = build_server(
                 make_ppo_policy_fn(module, cfg.algo.cnn_keys.encoder),
                 params,
+                session=session_knobs(cfg),
                 deadline_ms=ik["deadline_ms"],
                 max_batch=ik["max_batch"],
                 seed=cfg.seed + 1,
@@ -1048,6 +1093,23 @@ def main(runtime, cfg: Dict[str, Any]):
                 serve_server,
                 restart_budget=ik["restart_budget"],
                 backoff_base=ik["restart_backoff_s"],
+            )
+
+        # player-pool autoscaler (the in-process serve flavor is
+        # scale.pool.ServePool): measured gather-wait pressure + firing
+        # alert NAMES in, supervisor spawn / retire orders + serve
+        # batching capacity out — every decision is a typed flight event
+        autoscaler = None
+        if autoscale_on and supervisor is not None:
+            autoscaler = Autoscaler(
+                min_size=ak["min_players"],
+                max_size=ak["max_players"] or knobs["num_players"],
+                up_window_s=ak["up_window_s"],
+                down_window_s=ak["down_window_s"],
+                up_cooldown_s=ak["up_cooldown_s"],
+                down_cooldown_s=ak["down_cooldown_s"],
+                event_budget=ak["event_budget"],
+                name="player_pool",
             )
 
         # params digest (algo.transport_integrity=digest): one content
@@ -1106,7 +1168,11 @@ def main(runtime, cfg: Dict[str, Any]):
             if serve_sup is not None:
                 serve_sup.poll()
             # named span: the trainer idling for the next fan-in round (the
-            # inverse of the players' ipc_wait_update stall)
+            # inverse of the players' ipc_wait_update stall); its duration
+            # is ALSO the autoscaler's pressure signal — a long wait means
+            # the pool is too small for the learner, a near-zero wait
+            # means shards are always ready (slack)
+            t_gather = time.monotonic()
             try:
                 with trace_scope("ipc_wait_rollout"), flight.span("fanin_wait"):
                     seq, frames = fanin.gather(timeout=_QUEUE_TIMEOUT_S, on_control=_on_control)
@@ -1121,6 +1187,7 @@ def main(runtime, cfg: Dict[str, Any]):
                 if supervisor is not None and (fanin.joining or supervisor.recoverable()):
                     continue
                 raise
+            gather_wait_s = time.monotonic() - t_gather
             if not frames:
                 break  # every player stopped
             if len(fanin.live) != known_live:
@@ -1239,11 +1306,54 @@ def main(runtime, cfg: Dict[str, Any]):
                 # swap: zero dropped requests, zero retraces)
                 serve_server.swap_params(params)
 
+            if autoscaler is not None:
+                # one control tick per round: classify this round's
+                # measured gather wait (plus any firing pressure alerts)
+                # and actuate through the SAME join machinery the
+                # supervisor uses for failure recovery
+                sig = supervisor.autoscale_signal()
+                alert_pressure = sorted(
+                    set(sig.get("alert_names") or ()) & set(ak["alert_pressure_names"])
+                )
+                pool_size = len(fanin.live) + len(fanin.joining)
+                pressure = bool(alert_pressure) or gather_wait_s >= ak["gather_wait_pressure_s"]
+                # never shrink while deaths are pending respawn: that is
+                # churn, not slack — the supervisor owns that transition
+                slack = (
+                    gather_wait_s <= ak["gather_wait_slack_s"]
+                    and not alert_pressure
+                    and int(sig.get("pending_restarts", 0)) == 0
+                )
+                reason = f"gather_wait={gather_wait_s * 1e3:.1f}ms"
+                if alert_pressure:
+                    reason += " alerts=" + ",".join(alert_pressure)
+                decision = autoscaler.observe(pool_size, pressure, slack, reason=reason)
+                if decision is not None:
+                    if decision["action"] == "grow":
+                        for pid in range(knobs["num_players"]):
+                            if pid in fanin.live or pid in fanin.joining:
+                                continue
+                            if supervisor.spawn_player(pid):
+                                break
+                    else:
+                        victim = max((p for p in fanin.live if p != 0), default=None)
+                        if victim is not None:
+                            fanin.send_to(victim, "retire")
+                    if serve_server is not None and ik is not None:
+                        # serve batching capacity tracks the pool: fewer
+                        # players need smaller max batches (bounded below
+                        # so a minimum pool still serves)
+                        npl = knobs["num_players"]
+                        tgt = int(decision["target"])
+                        serve_server.set_capacity(max(1, (ik["max_batch"] * tgt + npl - 1) // npl))
+
             opt_np = _np_tree(opt_state) if need_ckpt else None
             stats = fanin.stats(knobs["backend"])
             stats["events"] = fanin.events[-8:]
             if supervisor is not None:
                 stats["supervisor"] = supervisor.stats()
+            if autoscaler is not None:
+                stats["autoscale"] = autoscaler.stats()
             if serve_server is not None:
                 stats["serve"] = serve_server.stats()
                 if serve_sup is not None:

@@ -463,6 +463,17 @@ def default_alert_pack() -> List[Dict[str, Any]]:
             "for": 2,
             "severity": "warn",
         },
+        {
+            # the autoscaler spent its scale-event budget and went
+            # quiescent — a flapping pressure signal or an undersized
+            # budget; either way the pool no longer tracks load
+            "name": "autoscaler_budget_exhausted",
+            "kind": "threshold",
+            "key": ["autoscale.budget_exhausted", "transport.autoscale.budget_exhausted"],
+            "op": ">",
+            "value": 0,
+            "severity": "warn",
+        },
     ]
 
 

@@ -265,6 +265,41 @@ def render_status(status: Dict[str, Any]) -> str:
             f"  queue {_fmt(serve.get('queue_depth'))}"
             f"  p50 {_fmt(lat.get('p50'))} ms  p95 {_fmt(lat.get('p95'))} ms"
         )
+        # session cache (serve/sessions.py): only present on the session
+        # tier — the stateless server has no such key
+        sess = serve.get("sessions")
+        if isinstance(sess, dict):
+            lines.append(
+                f"sessions — entries {_fmt(sess.get('entries'))}/{_fmt(sess.get('capacity'))}"
+                f"  occupancy {_fmt(sess.get('occupancy'), 2)}"
+                f"  hit rate {_fmt(sess.get('hit_rate'), 3)}"
+                f"  evictions lru {_fmt(sess.get('evictions_lru'))}"
+                f" ttl {_fmt(sess.get('evictions_ttl'))}"
+                f"  losses {_fmt(serve.get('session_losses'))}"
+            )
+
+    # ------------------------------------------------------- autoscaler
+    scale = record.get("autoscale") or key_path(record, "transport.autoscale")
+    if isinstance(scale, dict):
+        last = scale.get("last_decision") or {}
+        cooldown = scale.get("cooldown") or {}
+        lines.append("")
+        lines.append(
+            f"autoscaler {scale.get('name', '-')} — bounds {_fmt(scale.get('min'))}"
+            f"..{_fmt(scale.get('max'))}"
+            f"  grows {_fmt(scale.get('grows'))}  shrinks {_fmt(scale.get('shrinks'))}"
+            f"  budget {_fmt(scale.get('events_used'))}/{_fmt(scale.get('event_budget'))}"
+            + ("  BUDGET EXHAUSTED" if scale.get("budget_exhausted") else "")
+        )
+        if last:
+            lines.append(
+                f"  last decision — {last.get('action', '-')} {_fmt(last.get('size'))}"
+                f"->{_fmt(last.get('target'))}  reason {last.get('reason', '-')}"
+            )
+        lines.append(
+            f"  cooldown — up {_fmt(cooldown.get('up_remaining_s'))}s"
+            f"  down {_fmt(cooldown.get('down_remaining_s'))}s"
+        )
 
     # ----------------------------------------------------------- replay
     replay = record.get("replay")

@@ -2902,6 +2902,31 @@ class ParamsFollower:
             return self._pending.popleft()
         return self._chan.recv(timeout=timeout)
 
+    def poll_control(self, tag: str) -> Optional[Frame]:
+        """Non-blocking sweep for a control frame ``tag`` (e.g. the
+        autoscaler's ``retire`` order): checks the stash first, then
+        drains whatever is immediately available on the channel, putting
+        everything else back on the pending deque IN ORDER so the
+        fixed-lag params walk is untouched.  Returns the matching frame
+        (caller releases it) or None."""
+        for i, frame in enumerate(self._pending):
+            if frame.tag == tag:
+                del self._pending[i]
+                return frame
+        stash: List[Frame] = []
+        found: Optional[Frame] = None
+        while found is None:
+            try:
+                frame = self._chan.recv(timeout=0.0)
+            except (queue_mod.Empty, PeerDiedError):
+                break
+            if frame.tag == tag:
+                found = frame
+            else:
+                stash.append(frame)
+        self._pending.extend(stash)
+        return found
+
     def wait_tag(self, tag: str, timeout: Optional[float] = None) -> Frame:
         """Receive until ``tag`` arrives, stashing params frames for the
         fixed-lag schedule (trainer sends are ordered, but a params

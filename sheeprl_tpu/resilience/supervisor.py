@@ -184,9 +184,47 @@ class PlayerSupervisor:
 
     # ------------------------------------------------------------ respawn
     def _respawn(self, pid: int) -> None:
-        spec = self._hub.respawn_spec(pid)
         self.total_restarts += 1
         self.restarts_by_pid[pid] = self.restarts_by_pid.get(pid, 0) + 1
+        self._launch(pid)
+        self.events.append(
+            {
+                "event": "player_restart",
+                "player": pid,
+                "attempt": self.restarts_by_pid[pid],
+                "budget_remaining": self.budget_remaining,
+            }
+        )
+        from sheeprl_tpu.obs import flight
+
+        flight.fleet_event(
+            "supervisor_respawn", player=pid, attempt=self.restarts_by_pid[pid]
+        )
+
+    def spawn_player(self, pid: int) -> bool:
+        """Scale-UP spawn (the autoscaler's grow actuation): bring player
+        ``pid`` — a vacant slot, either never started (the pool opened
+        below its configured maximum) or retired earlier — up in JOIN
+        mode.  NOT charged to the restart budget: growing on demand is
+        policy, not failure recovery.  Returns False when the slot is
+        still occupied by a live process or mid-join."""
+        if self._closed:
+            return False
+        proc = self.procs.get(pid)
+        if proc is not None and proc.is_alive():
+            return False
+        if pid in self._fanin.joining:
+            return False
+        self._next_attempt.pop(pid, None)
+        self._launch(pid)
+        self.events.append({"event": "player_scale_up", "player": pid})
+        from sheeprl_tpu.obs import flight
+
+        flight.fleet_event("player_scale_up", player=pid)
+        return True
+
+    def _launch(self, pid: int) -> None:
+        spec = self._hub.respawn_spec(pid)
         # children must land on the host CPU backend (same dance as
         # spawn_players) and must not re-fire the kill that felled their
         # predecessor
@@ -218,19 +256,6 @@ class PlayerSupervisor:
         )
         ch.reset_for_rejoin()
         self._fanin.begin_join(pid, channel=ch, steps_per_frame=self._steps_per_frame.get(pid))
-        self.events.append(
-            {
-                "event": "player_restart",
-                "player": pid,
-                "attempt": self.restarts_by_pid[pid],
-                "budget_remaining": self.budget_remaining,
-            }
-        )
-        from sheeprl_tpu.obs import flight
-
-        flight.fleet_event(
-            "supervisor_respawn", player=pid, attempt=self.restarts_by_pid[pid]
-        )
 
     # ---------------------------------------------------------- telemetry
     def stats(self) -> Dict[str, Any]:
@@ -244,6 +269,9 @@ class PlayerSupervisor:
         alerts = self._active_alerts()
         if alerts is not None:
             out["alerts_firing"] = len(alerts)
+            # the NAMES, not just the count: the autoscaler (and tests)
+            # key on specific rules, not a bare integer
+            out["alerts_firing_names"] = sorted(str(a.get("name", "?")) for a in alerts)
         return out
 
     @staticmethod
@@ -271,6 +299,7 @@ class PlayerSupervisor:
             "pending_restarts": len(self._next_attempt),
             "restart_budget_remaining": self.budget_remaining,
             "alerts": alerts if alerts is not None else [],
+            "alert_names": sorted(str(a.get("name", "?")) for a in alerts) if alerts else [],
             "alerts_available": alerts is not None,
         }
 

@@ -1,0 +1,33 @@
+"""sheeprl_tpu.scale — the elastic consumer of three producer surfaces.
+
+PR 15 built the signals (``PlayerSupervisor.autoscale_signal()``, the
+alert plane, queue depth + batch histograms on ``/status``); PR 6 built
+the actuator (the join machinery that grows a fan-in without stalling
+survivors); PR 8 built the serving plane those signals describe.  This
+subsystem closes the loop:
+
+- :mod:`~sheeprl_tpu.scale.autoscaler` — the hysteresis decision engine
+  (sustained pressure grows, sustained slack shrinks, per-direction
+  cooldowns, min/max bounds, a scale-event budget) plus its
+  configuration surface;
+- :mod:`~sheeprl_tpu.scale.pool` — an elastic pool of serving loops in
+  one process sharing the session cache, params, and jit traces, so
+  growing capacity never recompiles;
+- :mod:`~sheeprl_tpu.scale.swarm` — the saturation harness: hundreds of
+  threaded session clients with heavy-tailed think times, per-client
+  latency histograms, and a p99 SLO verdict (``scripts/swarm.py`` /
+  ``bench.py swarm``).
+"""
+
+from sheeprl_tpu.scale.autoscaler import Autoscaler, autoscaler_knobs
+from sheeprl_tpu.scale.pool import ServePool
+from sheeprl_tpu.scale.swarm import SwarmClient, SwarmReport, run_swarm
+
+__all__ = [
+    "Autoscaler",
+    "ServePool",
+    "SwarmClient",
+    "SwarmReport",
+    "autoscaler_knobs",
+    "run_swarm",
+]

@@ -1,0 +1,213 @@
+"""Telemetry-driven autoscaling for the elastic player pool + serving plane.
+
+The Ape-X lineage scales ACTOR count to match learner appetite; SEED RL
+scales the serving tier to match client load.  :class:`Autoscaler` is
+the decision engine both share: the caller feeds it one observation per
+control tick — a pressure bit, a slack bit, and the current size — and
+it answers with a grow/shrink decision (or None) under the stability
+machinery production autoscalers grow scars for:
+
+- **hysteresis windows** — pressure (slack) must hold CONTINUOUSLY for
+  ``up_window_s`` (``down_window_s``) before a decision fires; a single
+  noisy tick never scales anything, and any contradicting tick resets
+  the window;
+- **per-direction cooldowns** — after a grow, further grows wait out
+  ``up_cooldown_s`` (same for shrinks), so the controller observes the
+  effect of one actuation before stacking another.  Opposite directions
+  do NOT share a cooldown: a bad grow can be undone promptly;
+- **min/max bounds** — the pool never shrinks below ``min_size``
+  (availability floor) or grows past ``max_size`` (the spawned-slot
+  ceiling the transport hub was built with);
+- **a scale-event budget** — a defensive bound on TOTAL decisions per
+  run; a flapping signal exhausts the budget and the autoscaler goes
+  quiescent instead of thrashing the pool forever.
+
+Every decision lands three ways: a typed flight event (``autoscale``),
+the telemetry ``autoscale`` key (:meth:`Autoscaler.stats`, rendered by
+``obs.top``/``/status``), and — because the shipped alert pack gains an
+``autoscaler_budget_exhausted`` rule — the alert plane.
+
+The WIRING of signals to the pressure/slack bits is the caller's:
+``ppo_decoupled`` derives pressure from the learner's fan-in gather wait
+(players starving the learner — Ape-X appetite) and any of a set of
+firing alert names from ``autoscale_signal()``; the swarm/serve pool
+derives it from queue depth and p95 against the SLO.  Keeping the
+engine signal-agnostic is what lets one implementation drive both the
+player pool and the serving plane.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from sheeprl_tpu.obs import flight
+
+__all__ = ["Autoscaler", "autoscaler_knobs"]
+
+
+def autoscaler_knobs(cfg) -> Dict[str, Any]:
+    """The ``algo.autoscaler.*`` configuration surface, resolved with
+    defaults.  ``enabled=false`` (the default) keeps the control loop
+    out of the trainer entirely — the pre-PR topology is untouched."""
+    sc = cfg.algo.get("autoscaler", None) or {}
+    return {
+        "enabled": bool(sc.get("enabled", False)),
+        "min_players": int(sc.get("min_players", 1)),
+        "max_players": int(sc.get("max_players", 0)),  # 0 = the spawned pool size
+        "up_window_s": float(sc.get("up_window_s", 2.0)),
+        "down_window_s": float(sc.get("down_window_s", 5.0)),
+        "up_cooldown_s": float(sc.get("up_cooldown_s", 5.0)),
+        "down_cooldown_s": float(sc.get("down_cooldown_s", 10.0)),
+        "event_budget": int(sc.get("event_budget", 16)),
+        "gather_wait_pressure_s": float(sc.get("gather_wait_pressure_s", 0.05)),
+        "gather_wait_slack_s": float(sc.get("gather_wait_slack_s", 0.005)),
+        "alert_pressure_names": list(
+            sc.get("alert_pressure_names", ["serve_p99_slo", "breaker_open"])
+        ),
+    }
+
+
+class Autoscaler:
+    """The hysteresis grow/shrink decision engine (module docstring).
+
+    :meth:`observe` is the whole API: one call per control tick with the
+    current size and the tick's pressure/slack classification; the
+    return value is a decision dict (``action``/``reason``/``size``/
+    ``target``) when this tick crossed a hysteresis window, else None.
+    The CALLER actuates (spawn/retire/set_capacity) — the engine only
+    decides, so it is trivially unit-testable with a fake clock.
+    """
+
+    def __init__(
+        self,
+        *,
+        min_size: int = 1,
+        max_size: int = 8,
+        up_window_s: float = 2.0,
+        down_window_s: float = 5.0,
+        up_cooldown_s: float = 5.0,
+        down_cooldown_s: float = 10.0,
+        event_budget: int = 16,
+        name: str = "pool",
+    ):
+        self.min_size = max(0, int(min_size))
+        self.max_size = max(self.min_size, int(max_size))
+        self.up_window_s = float(up_window_s)
+        self.down_window_s = float(down_window_s)
+        self.up_cooldown_s = float(up_cooldown_s)
+        self.down_cooldown_s = float(down_cooldown_s)
+        self.event_budget = int(event_budget)
+        self.name = name
+        self._pressure_since: Optional[float] = None
+        self._slack_since: Optional[float] = None
+        self._last_up = -float("inf")
+        self._last_down = -float("inf")
+        self.events_used = 0
+        self.grows = 0
+        self.shrinks = 0
+        self.last_decision: Optional[Dict[str, Any]] = None
+        self.decisions: List[Dict[str, Any]] = []
+
+    # --------------------------------------------------------------- engine
+    def observe(
+        self,
+        size: int,
+        pressure: bool,
+        slack: bool,
+        reason: str = "",
+        now: Optional[float] = None,
+    ) -> Optional[Dict[str, Any]]:
+        """One control tick.  ``pressure`` and ``slack`` are this tick's
+        classification of the signal surface (both False = neutral; both
+        True is treated as pressure — growing is the safe error)."""
+        now = time.monotonic() if now is None else now
+        if pressure:
+            slack = False
+        # hysteresis: windows track CONTINUOUS runs; any contradicting
+        # or neutral tick resets the opposite run
+        self._pressure_since = (
+            (self._pressure_since if self._pressure_since is not None else now)
+            if pressure
+            else None
+        )
+        self._slack_since = (
+            (self._slack_since if self._slack_since is not None else now) if slack else None
+        )
+        if self.events_used >= self.event_budget:
+            return None
+        size = int(size)
+        if (
+            pressure
+            and size < self.max_size
+            and now - self._pressure_since >= self.up_window_s
+            and now - self._last_up >= self.up_cooldown_s
+        ):
+            self._last_up = now
+            self._pressure_since = None  # a fresh window per decision
+            return self._decide("grow", size, size + 1, reason or "pressure", now)
+        if (
+            slack
+            and size > self.min_size
+            and now - self._slack_since >= self.down_window_s
+            and now - self._last_down >= self.down_cooldown_s
+        ):
+            self._last_down = now
+            self._slack_since = None
+            return self._decide("shrink", size, size - 1, reason or "slack", now)
+        return None
+
+    def _decide(self, action: str, size: int, target: int, reason: str, now: float) -> Dict[str, Any]:
+        self.events_used += 1
+        if action == "grow":
+            self.grows += 1
+        else:
+            self.shrinks += 1
+        decision = {
+            "action": action,
+            "size": size,
+            "target": target,
+            "reason": reason,
+            "budget_remaining": self.event_budget - self.events_used,
+        }
+        self.last_decision = decision
+        self.decisions.append(decision)
+        flight.fleet_event(
+            "autoscale",
+            scaler=self.name,
+            action=action,
+            size=size,
+            target=target,
+            reason=reason,
+        )
+        return decision
+
+    # ------------------------------------------------------------ telemetry
+    def stats(self, now: Optional[float] = None) -> Dict[str, Any]:
+        """The telemetry ``autoscale`` key (obs.top renders it)."""
+        now = time.monotonic() if now is None else now
+        return {
+            "name": self.name,
+            "min": self.min_size,
+            "max": self.max_size,
+            "grows": self.grows,
+            "shrinks": self.shrinks,
+            "events_used": self.events_used,
+            "event_budget": self.event_budget,
+            "budget_exhausted": int(self.events_used >= self.event_budget),
+            "last_decision": self.last_decision,
+            "cooldown": {
+                "up_remaining_s": round(max(0.0, self.up_cooldown_s - (now - self._last_up)), 3),
+                "down_remaining_s": round(
+                    max(0.0, self.down_cooldown_s - (now - self._last_down)), 3
+                ),
+            },
+            "window": {
+                "pressure_held_s": round(now - self._pressure_since, 3)
+                if self._pressure_since is not None
+                else 0.0,
+                "slack_held_s": round(now - self._slack_since, 3)
+                if self._slack_since is not None
+                else 0.0,
+            },
+        }

@@ -1,0 +1,207 @@
+"""An elastic pool of serving loops behind one session cache.
+
+One :class:`~sheeprl_tpu.serve.service.InferenceServer` loop serializes
+its batches; under a client swarm the queue depth is the saturation
+signal.  :class:`ServePool` runs N such loops IN ONE PROCESS, sharing:
+
+- the **session cache / acted-cache / pending guard** (the ``shared``
+  dict of :class:`~sheeprl_tpu.serve.sessions.SessionInferenceServer`),
+  so a client channel can migrate between workers across a rebalance
+  without breaking the exactly-once contract — a request acted by the
+  old worker is answered from the shared cache by the new one;
+- the **policy closures** — every worker dispatches through the same
+  jitted step, so growing the pool reuses the warm per-bucket XLA
+  traces: the post-warmup compile counter stays flat across scale
+  events (asserted by the swarm e2e test);
+- the **params** — :meth:`swap_params` swaps all workers between
+  batches (hot-swap semantics unchanged).
+
+Scaling is driven by an :class:`~sheeprl_tpu.scale.autoscaler.Autoscaler`
+consuming the pool's own measured pressure (aggregate queue depth per
+worker against ``queue_high``/``queue_low``): :meth:`control_tick` is
+the whole control loop.  Growing spawns a worker and rebalances the
+most-loaded clients onto it; shrinking retires the youngest worker
+QUIETLY (it answers everything pending, then exits WITHOUT stop-framing
+its clients) and hands its channels to the survivors.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+from sheeprl_tpu.scale.autoscaler import Autoscaler
+
+__all__ = ["ServePool"]
+
+
+class ServePool:
+    """Elastic in-process serving pool (module docstring).
+
+    ``factory(index, shared)`` builds one (not yet started) serving loop
+    — typically a :class:`SessionInferenceServer` closing over ONE
+    jitted policy step; ``shared`` is this pool's cross-worker state
+    dict, passed through verbatim.
+    """
+
+    def __init__(
+        self,
+        factory: Callable[[int, Dict[str, Any]], Any],
+        *,
+        min_workers: int = 1,
+        max_workers: int = 4,
+        autoscaler: Optional[Autoscaler] = None,
+        queue_high: int = 8,
+        queue_low: int = 1,
+        name: str = "serve_pool",
+    ):
+        self._factory = factory
+        self.min_workers = max(1, int(min_workers))
+        self.max_workers = max(self.min_workers, int(max_workers))
+        self.autoscaler = autoscaler or Autoscaler(
+            min_size=self.min_workers, max_size=self.max_workers, name=name
+        )
+        self.queue_high = int(queue_high)
+        self.queue_low = int(queue_low)
+        self.name = name
+        self.shared: Dict[str, Any] = {}
+        self.workers: List[Any] = []
+        self._assignment: Dict[int, Any] = {}  # client_id -> worker
+        self._channels: Dict[int, Any] = {}  # client_id -> channel (for migration)
+        self._next_index = 0
+        self._lock = threading.RLock()
+        self.rebalanced = 0
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> "ServePool":
+        with self._lock:
+            while len(self.workers) < self.min_workers:
+                self._spawn_worker()
+        return self
+
+    def _spawn_worker(self):
+        w = self._factory(self._next_index, self.shared)
+        self._next_index += 1
+        self.workers.append(w)
+        w.start()
+        return w
+
+    def attach(self, client_id: int, channel) -> None:
+        """Register one client with the least-loaded worker."""
+        with self._lock:
+            w = min(self.workers, key=self._load_of)
+            self._channels[int(client_id)] = channel
+            self._assignment[int(client_id)] = w
+            w.attach(client_id, channel)
+
+    def _load_of(self, w) -> int:
+        return sum(1 for ww in self._assignment.values() if ww is w)
+
+    def _migrate(self, client_id: int, src, dst) -> None:
+        # order matters: drop from the old worker's map first — a frame
+        # the old loop already swept is still answered exactly once via
+        # the SHARED acted-cache when the client retries against dst
+        src.detach(client_id)
+        dst.attach(client_id, self._channels[client_id])
+        self._assignment[client_id] = dst
+        self.rebalanced += 1
+
+    # -------------------------------------------------------------- scaling
+    def grow(self) -> bool:
+        with self._lock:
+            if len(self.workers) >= self.max_workers:
+                return False
+            w = self._spawn_worker()
+            # rebalance: pull clients off the most-loaded survivors until
+            # the newcomer carries its fair share
+            fair = max(1, len(self._assignment) // len(self.workers))
+            inflight = {c for c, _ in self.shared.get("inflight", ())}
+            moved = 0
+            while moved < fair:
+                donors = [ww for ww in self.workers if ww is not w and self._load_of(ww) > 0]
+                if not donors:
+                    break
+                donor = max(donors, key=self._load_of)
+                cands = [c for c, ww in self._assignment.items() if ww is donor]
+                # prefer quiescent clients: migrating one mid-request
+                # strands its reply until the retry (still exactly-once
+                # via the shared caches, but a needless latency spike)
+                cid = next((c for c in cands if c not in inflight), cands[0])
+                self._migrate(cid, donor, w)
+                moved += 1
+            return True
+
+    def shrink(self) -> bool:
+        with self._lock:
+            if len(self.workers) <= self.min_workers:
+                return False
+            w = self.workers.pop()  # youngest first: oldest workers are warmest
+        # quiet retire OUTSIDE the lock (it joins the serving thread):
+        # everything pending is answered before the channels move
+        w.retire()
+        with self._lock:
+            for cid, ww in list(self._assignment.items()):
+                if ww is w:
+                    dst = min(self.workers, key=self._load_of)
+                    self._migrate(cid, w, dst)
+        return True
+
+    def control_tick(self, now: Optional[float] = None) -> Optional[Dict[str, Any]]:
+        """One autoscaler tick off the pool's own measured load: queue
+        rows per worker >= ``queue_high`` is pressure, total queue <=
+        ``queue_low`` is slack.  Actuates the decision immediately."""
+        with self._lock:
+            n = len(self.workers)
+            depth = sum(len(w._pending) for w in self.workers)
+        pressure = depth >= self.queue_high * n
+        slack = depth <= self.queue_low
+        reason = f"queue_depth={depth}/{n}w"
+        decision = self.autoscaler.observe(n, pressure, slack, reason=reason, now=now)
+        if decision is None:
+            return None
+        if decision["action"] == "grow":
+            self.grow()
+        else:
+            self.shrink()
+        return decision
+
+    # ------------------------------------------------------------- plumbing
+    def swap_params(self, params, source: str = "direct") -> None:
+        with self._lock:
+            workers = list(self.workers)
+        for w in workers:
+            w.swap_params(params, source)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            workers = list(self.workers)
+            per_worker = [w.stats() for w in workers]
+        out: Dict[str, Any] = {
+            "role": "pool",
+            "workers": len(workers),
+            "rebalanced": self.rebalanced,
+            "queue_depth": sum(s.get("queue_depth", 0) for s in per_worker),
+            "requests": sum(s.get("requests", 0) for s in per_worker),
+            "acted": sum(s.get("acted", 0) for s in per_worker),
+            "dedup_hits": sum(s.get("dedup_hits", 0) for s in per_worker),
+            "autoscale": self.autoscaler.stats(),
+        }
+        if per_worker and "sessions" in per_worker[0]:
+            out["sessions"] = per_worker[0]["sessions"]  # shared cache: any worker's view
+        # merged batch histogram: the compile-surface audit reads this
+        hist: Dict[str, int] = {}
+        for s in per_worker:
+            for k, v in (s.get("batch_hist") or {}).items():
+                hist[k] = hist.get(k, 0) + v
+        out["batch_hist"] = hist
+        return out
+
+    def close(self, timeout: float = 10.0) -> None:
+        with self._lock:
+            workers = list(self.workers)
+            self.workers = []
+        for w in workers:
+            try:
+                w.close(timeout=timeout)
+            except Exception:
+                pass

@@ -1,0 +1,247 @@
+"""Saturation swarm: hundreds of threaded session clients vs one pool.
+
+The millions-of-users posture of a serving plane is not provable from a
+player loop — it needs a client population with realistic arrival
+statistics driven until the plane saturates.  :func:`run_swarm` is that
+harness: N threaded :class:`~sheeprl_tpu.serve.sessions.SessionClient`
+workers, each with a HEAVY-TAILED (lognormal) think time between steps
+(bursty arrivals, the property that makes deadline batching and
+autoscaling earn their keep), per-client latency recording, and a p99
+SLO verdict through the PR-16 tracker grammar.
+
+A coordinator thread ticks alongside the swarm: it feeds the rolling
+p99 to the SLO, and — when the caller passes ``control_tick`` (the
+:meth:`~sheeprl_tpu.scale.pool.ServePool.control_tick` bound method) —
+drives the autoscaler control loop at swarm cadence, so the grow/shrink
+trajectory in the report is MEASURED under load, not scripted.
+
+``scripts/swarm.py`` wraps this against a served checkpoint;
+``bench.py``'s ``swarm`` section and the scale chaos leg wrap it
+in-process.  Every run returns a :class:`SwarmReport` whose dict is the
+``benchmarks/results/swarm_*.json`` row format.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from sheeprl_tpu.serve.sessions import SessionClient
+
+__all__ = ["SwarmClient", "SwarmReport", "run_swarm"]
+
+
+class SwarmClient(threading.Thread):
+    """One synthetic user: think (lognormal), step the session, record."""
+
+    def __init__(
+        self,
+        client: SessionClient,
+        obs_fn: Callable[[np.random.Generator, int], list],
+        *,
+        steps: int,
+        rows: int,
+        think_mean_s: float,
+        think_sigma: float,
+        rng: np.random.Generator,
+        window: Optional[deque] = None,
+        window_lock: Optional[threading.Lock] = None,
+    ):
+        super().__init__(name=f"swarm-{client.client_id}", daemon=True)
+        self.client = client
+        self._obs_fn = obs_fn
+        self.steps = int(steps)
+        self.rows = int(rows)
+        # lognormal parameterized by its MEAN (not mu): heavy tail up,
+        # median below the mean — the think-time shape of real users
+        self._mu = math.log(max(think_mean_s, 1e-6)) - 0.5 * think_sigma**2
+        self._sigma = float(think_sigma)
+        self._rng = rng
+        self._window = window
+        self._window_lock = window_lock
+        self.latencies_s: List[float] = []
+        self.remote = 0
+        self.local = 0
+
+    def run(self) -> None:
+        for _ in range(self.steps):
+            time.sleep(float(self._rng.lognormal(self._mu, self._sigma)))
+            arrays = self._obs_fn(self._rng, self.rows)
+            t0 = time.monotonic()
+            _, source = self.client.step(arrays, self.rows)
+            lat = time.monotonic() - t0
+            if source == "remote":
+                self.remote += 1
+                self.latencies_s.append(lat)
+                if self._window is not None:
+                    with self._window_lock:
+                        self._window.append(lat)
+            else:
+                self.local += 1
+        self.client.close_session()
+
+    def percentiles(self) -> Dict[str, float]:
+        if not self.latencies_s:
+            return {}
+        arr = np.sort(np.asarray(self.latencies_s))
+        return {
+            "p50": round(float(np.percentile(arr, 50)) * 1e3, 3),
+            "p99": round(float(np.percentile(arr, 99)) * 1e3, 3),
+            "n": len(arr),
+        }
+
+
+class SwarmReport:
+    """The swarm run's result row (``as_dict`` is the benchmark JSON)."""
+
+    def __init__(self, data: Dict[str, Any]):
+        self.data = data
+
+    def __getitem__(self, k):
+        return self.data[k]
+
+    def as_dict(self) -> Dict[str, Any]:
+        return self.data
+
+    @property
+    def slo_ok(self) -> bool:
+        verdict = self.data.get("slo", {}).get("swarm_p99", {})
+        return verdict.get("state", "ok") == "ok"
+
+
+def _latency_histogram(latencies_ms: List[float]) -> Dict[str, int]:
+    """Log2-ms buckets ("<=1ms", "<=2ms", ... , ">1024ms")."""
+    hist: Dict[str, int] = {}
+    for ms in latencies_ms:
+        if ms > 1024:
+            label = ">1024ms"
+        else:
+            label = f"<={max(1, 2 ** max(0, math.ceil(math.log2(max(ms, 1e-3)))))}ms"
+        hist[label] = hist.get(label, 0) + 1
+    return {k: hist[k] for k in sorted(hist, key=lambda s: (s == ">1024ms", len(s), s))}
+
+
+def run_swarm(
+    channels: List[Any],
+    *,
+    steps: int = 50,
+    rows: int = 1,
+    obs_fn: Optional[Callable[[np.random.Generator, int], list]] = None,
+    obs_dim: int = 4,
+    obs_key: str = "state",
+    think_mean_ms: float = 2.0,
+    think_sigma: float = 1.0,
+    seed: int = 0,
+    client_kw: Optional[Dict[str, Any]] = None,
+    slo_target_ms: float = 250.0,
+    slo_budget: float = 0.05,
+    control_tick: Optional[Callable[[], Any]] = None,
+    tick_interval_s: float = 0.02,
+) -> SwarmReport:
+    """Drive one swarm to completion and return the report.
+
+    ``channels`` are the client ends of an already-attached transport
+    (one per swarm client — the server/pool side must be attached by
+    the caller).  ``control_tick`` runs at ``tick_interval_s`` cadence
+    on the coordinator thread while the swarm is up.
+    """
+    from sheeprl_tpu.obs.metrics import SLOTracker
+
+    if obs_fn is None:
+
+        def obs_fn(rng: np.random.Generator, r: int) -> list:
+            return [(obs_key, rng.standard_normal((r, obs_dim)).astype(np.float32))]
+
+    window: deque = deque(maxlen=256)
+    window_lock = threading.Lock()
+    clients: List[SwarmClient] = []
+    for i, ch in enumerate(channels):
+        sc = SessionClient(ch, i, seed=seed + i, **(client_kw or {}))
+        clients.append(
+            SwarmClient(
+                sc,
+                obs_fn,
+                steps=steps,
+                rows=rows,
+                think_mean_s=think_mean_ms / 1e3,
+                think_sigma=think_sigma,
+                rng=np.random.default_rng(seed * 100_003 + i),
+                window=window,
+                window_lock=window_lock,
+            )
+        )
+    tracker = SLOTracker(
+        slos=[
+            {
+                "name": "swarm_p99",
+                "key": "swarm.latency_ms",
+                "percentile": 99,
+                "target": float(slo_target_ms),
+                "budget": float(slo_budget),
+            }
+        ]
+    )
+    t0 = time.monotonic()
+    for c in clients:
+        c.start()
+
+    def _coordinate() -> None:
+        while any(c.is_alive() for c in clients):
+            if control_tick is not None:
+                try:
+                    control_tick()
+                except Exception:
+                    pass
+            with window_lock:
+                buf = list(window)
+            if len(buf) >= 8:
+                p99 = float(np.percentile(np.sort(np.asarray(buf)), 99)) * 1e3
+                tracker.observe({"swarm": {"latency_ms": {"p99": round(p99, 3)}}})
+            time.sleep(tick_interval_s)
+
+    coordinator = threading.Thread(target=_coordinate, name="swarm-coordinator", daemon=True)
+    coordinator.start()
+    for c in clients:
+        c.join()
+    coordinator.join(timeout=5.0)
+    wall_s = time.monotonic() - t0
+
+    all_lat_ms = [s * 1e3 for c in clients for s in c.latencies_s]
+    remote = sum(c.remote for c in clients)
+    local = sum(c.local for c in clients)
+    agg: Dict[str, Any] = {}
+    if all_lat_ms:
+        arr = np.sort(np.asarray(all_lat_ms))
+        agg = {
+            "p50": round(float(np.percentile(arr, 50)), 3),
+            "p95": round(float(np.percentile(arr, 95)), 3),
+            "p99": round(float(np.percentile(arr, 99)), 3),
+            "n": len(arr),
+        }
+    slo_sections = {s["name"]: s for s in ({"name": x.name, **x.section()} for x in tracker.slos)}
+    report = SwarmReport(
+        {
+            "clients": len(clients),
+            "steps_per_client": int(steps),
+            "rows": int(rows),
+            "think_mean_ms": float(think_mean_ms),
+            "think_sigma": float(think_sigma),
+            "wall_s": round(wall_s, 3),
+            "actions_per_s": round(remote * rows / wall_s, 1) if wall_s > 0 else 0.0,
+            "remote": remote,
+            "local_fallbacks": local,
+            "dropped": sum(c.steps for c in clients) - remote - local,  # must be 0
+            "session_losses": sum(c.client.session_losses for c in clients),
+            "session_reopens": sum(c.client.session_reopens for c in clients),
+            "latency_ms": agg,
+            "latency_hist": _latency_histogram(all_lat_ms),
+            "per_client": [c.percentiles() for c in clients],
+            "slo": slo_sections,
+        }
+    )
+    return report

@@ -23,27 +23,47 @@ checkpoint for offline/production serving.  See ``howto/serving.md``.
 
 from sheeprl_tpu.serve.client import CircuitBreaker, InferenceClient, RemoteActor
 from sheeprl_tpu.serve.policy import (
+    DREAMER_OUT_KEYS,
     PPO_OUT_KEYS,
+    RPPO_OUT_KEYS,
     SAC_OUT_KEYS,
     agent_params_loader,
+    make_dreamer_session_fns,
     make_ppo_policy_fn,
+    make_recurrent_ppo_session_fns,
     make_sac_policy_fn,
 )
 from sheeprl_tpu.serve.service import InferenceServer, bucket_for
+from sheeprl_tpu.serve.sessions import (
+    SessionCache,
+    SessionClient,
+    SessionInferenceServer,
+    build_server,
+    session_knobs,
+)
 
 __all__ = [
     "CircuitBreaker",
+    "DREAMER_OUT_KEYS",
     "InferenceClient",
     "InferenceServer",
     "PPO_OUT_KEYS",
+    "RPPO_OUT_KEYS",
     "RemoteActor",
     "SAC_OUT_KEYS",
+    "SessionCache",
+    "SessionClient",
+    "SessionInferenceServer",
     "agent_params_loader",
     "bucket_for",
+    "build_server",
     "inference_knobs",
     "inference_setting",
+    "make_dreamer_session_fns",
     "make_ppo_policy_fn",
+    "make_recurrent_ppo_session_fns",
     "make_sac_policy_fn",
+    "session_knobs",
 ]
 
 

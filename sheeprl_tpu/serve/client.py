@@ -129,6 +129,7 @@ class InferenceClient:
         self._next_id = 1
         self._last_arrays: List[Tuple[str, np.ndarray]] = []  # hedge resend payload
         self._last_rows = 0
+        self._last_reply_extra: tuple = ()  # session tier reads reply flags here
         self._server_stopped = False  # server sent its drain "stop" frame
         # counters (the telemetry audit surface)
         self.requests = 0
@@ -150,6 +151,13 @@ class InferenceClient:
             timeout=self.request_timeout_s,
         )
 
+    def _hedge_send(self, req_id: int, timeout: float) -> None:
+        # same id: the server dedupes, the extra reply drops here (the
+        # session client overrides this to re-ship its session envelope)
+        self._chan.send(INFER_REQ_TAG, arrays=self._last_arrays,
+                        extra=(self.client_id, self._last_rows),
+                        seq=req_id, timeout=timeout)
+
     def _await_reply(self, req_id: int, timeout: float) -> Optional[Dict[str, np.ndarray]]:
         """Wait for the reply to EXACTLY ``req_id``; hedge-duplicates and
         late replies to earlier ids are dropped by seq."""
@@ -163,10 +171,7 @@ class InferenceClient:
                 hedged = True
                 self.hedges += 1
                 try:
-                    # same id: the server dedupes, the extra reply drops here
-                    self._chan.send(INFER_REQ_TAG, arrays=self._last_arrays,
-                                    extra=(self.client_id, self._last_rows),
-                                    seq=req_id, timeout=remaining)
+                    self._hedge_send(req_id, remaining)
                 except Exception:
                     pass  # a failed hedge is just a missing optimization
             try:
@@ -183,6 +188,7 @@ class InferenceClient:
                 self.stale_replies += 1
                 frame.release()
                 continue
+            self._last_reply_extra = tuple(frame.extra or ())
             out = frame.arrays_copy()
             frame.release()
             return out

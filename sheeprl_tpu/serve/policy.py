@@ -16,9 +16,13 @@ from typing import Any, Callable, Dict, Sequence
 import numpy as np
 
 __all__ = [
+    "DREAMER_OUT_KEYS",
     "PPO_OUT_KEYS",
+    "RPPO_OUT_KEYS",
     "SAC_OUT_KEYS",
+    "make_dreamer_session_fns",
     "make_ppo_policy_fn",
+    "make_recurrent_ppo_session_fns",
     "make_sac_policy_fn",
     "agent_params_loader",
 ]
@@ -26,6 +30,8 @@ __all__ = [
 # reply-array vocabulary, in the order of the local players' return tuples
 PPO_OUT_KEYS = ("flat_actions", "real_actions", "logprobs", "values")
 SAC_OUT_KEYS = ("actions",)
+RPPO_OUT_KEYS = ("flat_actions", "real_actions", "logprobs", "values")
+DREAMER_OUT_KEYS = ("flat_actions",)
 
 
 def make_ppo_policy_fn(
@@ -75,6 +81,160 @@ def make_sac_policy_fn(
         return {SAC_OUT_KEYS[0]: np.asarray(apply(params, prepared, key))}
 
     return policy_fn
+
+
+def _row_keys(rows: int, seed: int):
+    """Per-row PRNG keys: fold the row index into the session seed.  The
+    key stream is PER SESSION ROW, so a session's sampling never depends
+    on which other sessions share its batch (bit-identical serving)."""
+    import jax
+    import jax.numpy as jnp
+
+    base = jax.random.PRNGKey(int(seed))
+    return np.asarray(
+        jax.vmap(lambda i: jax.random.fold_in(base, i))(jnp.arange(int(rows), dtype=jnp.uint32))
+    )
+
+
+def make_recurrent_ppo_session_fns(module, *, greedy: bool = False):
+    """``(session_policy_fn, init_state_fn)`` for the session tier
+    (serve/sessions.py): recurrent-PPO acting with server-side (hx, cx,
+    prev_actions) state.  The step is a per-row ``vmap`` with a per-row
+    key stream, so each session's action and state transition is
+    bit-independent of batch composition and bucket padding — the golden
+    parity tests assert exactly this."""
+    import jax
+    import jax.numpy as jnp
+
+    from sheeprl_tpu.algos.ppo_recurrent.agent import sample_actions
+
+    hidden = int(module.rnn_hidden_size)
+    act_dim = int(sum(module.actions_dim))
+
+    def _row(params, obs_row, st):
+        new_key, use = jax.random.split(st["_key"])
+        obs = {k: v[None, None] for k, v in obs_row.items()}  # (T=1, B=1, ...)
+        flat, real, logprob, value, (hx, cx) = sample_actions(
+            module, params, obs, st["prev_actions"][None, None], st["hx"][None], st["cx"][None],
+            use, greedy,
+        )
+        flat_row = flat.reshape(act_dim)
+        out = {
+            "flat_actions": flat_row,
+            "real_actions": real.reshape(-1),
+            "logprobs": logprob.reshape(-1),
+            "values": value.reshape(-1),
+        }
+        new_st = {
+            "hx": hx.reshape(hidden),
+            "cx": cx.reshape(hidden),
+            "prev_actions": flat_row,
+            "_key": new_key,
+        }
+        return out, new_st
+
+    stepped = jax.jit(jax.vmap(_row, in_axes=(None, 0, 0)))
+
+    def session_policy_fn(params, obs: Dict[str, np.ndarray], state: Dict[str, np.ndarray]):
+        out, new_state = stepped(params, obs, state)
+        return (
+            {k: np.asarray(v) for k, v in out.items()},
+            {k: np.asarray(v) for k, v in new_state.items()},
+        )
+
+    def init_state_fn(rows: int, seed: int, params) -> Dict[str, np.ndarray]:
+        return {
+            "hx": np.zeros((rows, hidden), np.float32),
+            "cx": np.zeros((rows, hidden), np.float32),
+            "prev_actions": np.zeros((rows, act_dim), np.float32),
+            "_key": _row_keys(rows, seed),
+        }
+
+    return session_policy_fn, init_state_fn
+
+
+def make_dreamer_session_fns(
+    world_model,
+    actor,
+    *,
+    actions_dim: Sequence[int],
+    stochastic_size: int,
+    discrete_size: int,
+    recurrent_state_size: int,
+    decoupled_rssm: bool = False,
+    greedy: bool = False,
+):
+    """``(session_policy_fn, init_state_fn)`` for Dreamer-family serving:
+    the PlayerDV3 step (encoder -> RSSM recurrent step -> representation
+    -> actor) with the (actions, recurrent_state, stochastic_state)
+    latent carried SERVER-side per session.  Per-row ``vmap`` + per-row
+    keys, same bit-independence contract as the recurrent-PPO adapter."""
+    import jax
+    import jax.numpy as jnp
+
+    from sheeprl_tpu.algos.dreamer_v3.agent import RSSM
+
+    act_dim = int(np.sum(np.asarray(actions_dim)))
+    stoch_flat = int(stochastic_size) * int(discrete_size)
+    rec_size = int(recurrent_state_size)
+
+    def _row(params, obs_row, st):
+        new_key, use = jax.random.split(st["_key"])
+        obs = {k: v[None, None] for k, v in obs_row.items()}  # (1, 1, ...)
+        prev_actions = st["actions"][None, None]
+        rec = st["recurrent_state"][None, None]
+        stoch_in = st["stochastic_state"][None, None]
+        embedded = world_model.encoder.apply(params["world_model"]["encoder"], obs)
+        rec2 = world_model.rssm.apply(
+            params["world_model"]["rssm"],
+            jnp.concatenate([stoch_in, prev_actions], -1),
+            rec,
+            method=RSSM.recurrent_step,
+        )
+        k1, k2 = jax.random.split(use)
+        if decoupled_rssm:
+            _, stoch = world_model.rssm.apply(
+                params["world_model"]["rssm"], embedded, k1, method=RSSM._representation
+            )
+        else:
+            _, stoch = world_model.rssm.apply(
+                params["world_model"]["rssm"], embedded, k1, rec2, method=RSSM._representation
+            )
+        stoch2 = stoch.reshape(stoch.shape[:-2] + (stoch_flat,))
+        actions, _ = actor.apply(
+            params["actor"], jnp.concatenate([stoch2, rec2], -1), greedy, k2
+        )
+        flat = jnp.concatenate(actions, -1).reshape(act_dim)
+        out = {"flat_actions": flat}
+        new_st = {
+            "actions": flat,
+            "recurrent_state": rec2.reshape(rec_size),
+            "stochastic_state": stoch2.reshape(stoch_flat),
+            "_key": new_key,
+        }
+        return out, new_st
+
+    stepped = jax.jit(jax.vmap(_row, in_axes=(None, 0, 0)))
+
+    def session_policy_fn(params, obs: Dict[str, np.ndarray], state: Dict[str, np.ndarray]):
+        out, new_state = stepped(params, obs, state)
+        return (
+            {k: np.asarray(v) for k, v in out.items()},
+            {k: np.asarray(v) for k, v in new_state.items()},
+        )
+
+    def init_state_fn(rows: int, seed: int, params) -> Dict[str, np.ndarray]:
+        rec, stoch = world_model.rssm.apply(
+            params["world_model"]["rssm"], (int(rows),), method=RSSM.get_initial_states
+        )
+        return {
+            "actions": np.zeros((rows, act_dim), np.float32),
+            "recurrent_state": np.asarray(rec, np.float32).reshape(rows, rec_size),
+            "stochastic_state": np.asarray(stoch, np.float32).reshape(rows, stoch_flat),
+            "_key": _row_keys(rows, seed),
+        }
+
+    return session_policy_fn, init_state_fn
 
 
 def agent_params_loader(subtree: str = "agent") -> Callable[[str], Any]:

@@ -117,6 +117,7 @@ class InferenceServer:
         self._pending: List[_Request] = []
         self._stop = threading.Event()
         self._drain = threading.Event()
+        self._retire = threading.Event()  # pool shrink: drain WITHOUT stop-framing clients
         self._dead: Optional[str] = None
         self._thread: Optional[threading.Thread] = None
         self._recover_until = 0.0  # drain-recover window after a respawn
@@ -209,6 +210,31 @@ class InferenceServer:
         serving; scripts/serve_policy.py installs the handler.)"""
         self._drain.set()
 
+    def detach(self, client_id: int):
+        """Unregister one client's channel (pool rebalancing: the channel
+        moves to another worker loop; nothing is sent).  Returns the
+        channel, or None when the id was unknown."""
+        with self._lock:
+            return self._channels.pop(int(client_id), None)
+
+    def set_capacity(self, max_batch: int) -> None:
+        """Grow/shrink the batching capacity between batches (the
+        autoscaler's serve actuation).  Clamped to the constructed bucket
+        set so every dispatch still lands on an existing XLA trace —
+        scaling never retraces."""
+        with self._lock:
+            self.max_batch = max(1, min(int(max_batch), int(self.buckets[-1])))
+
+    def retire(self, timeout: float = 10.0) -> None:
+        """Stop this serving loop WITHOUT stop-framing its clients (pool
+        shrink: the survivors keep serving them): everything pending is
+        answered, then the loop exits and the channels stay open for
+        whoever adopts them."""
+        self._retire.set()
+        t = self._thread
+        if t is not None and t.is_alive() and t is not threading.current_thread():
+            t.join(timeout=timeout)
+
     def close(self, timeout: float = 10.0) -> None:
         self._drain.set()
         t = self._thread
@@ -227,11 +253,16 @@ class InferenceServer:
     def _serve_loop(self) -> None:
         try:
             while not self._stop.is_set():
-                got = self._poll_requests()
+                # a retiring worker stops ACCEPTING: frames left unread in
+                # the channels belong to whoever adopts them (the pool
+                # migrates the channel; the shared caches keep dedupe)
+                got = 0 if self._retire.is_set() else self._poll_requests()
                 recovering = time.monotonic() < self._recover_until
                 if recovering and got:
                     self.recovered_backlog += got
-                batch = self._form_batch(force=self._drain.is_set() or recovering)
+                batch = self._form_batch(
+                    force=self._drain.is_set() or self._retire.is_set() or recovering
+                )
                 if batch:
                     inj = get_injector()
                     if inj.armed and inj.fire("server_exit"):
@@ -249,6 +280,8 @@ class InferenceServer:
                 elif self._drain.is_set() and not self._pending:
                     self._send_stops()
                     return
+                elif self._retire.is_set() and not self._pending:
+                    return  # quiet exit: clients belong to the pool's survivors
                 else:
                     self._maybe_hot_swap()
                     if not got:

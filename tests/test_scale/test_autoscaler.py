@@ -1,0 +1,269 @@
+"""Autoscaler decision engine (ISSUE 20): hysteresis windows, cooldowns,
+bounds, and the scale-event budget — all under a fake clock — plus the
+``PlayerSupervisor.autoscale_signal()`` edge cases the engine's caller
+keys on (budget exhausted, deaths pending respawn, clean idle pool,
+firing alert NAMES)."""
+
+import queue
+import time
+
+import pytest
+from sheeprl_tpu.config.compose import dotdict
+
+from sheeprl_tpu.parallel.transport import FanIn, QueueChannel
+from sheeprl_tpu.resilience.supervisor import PlayerSupervisor
+from sheeprl_tpu.scale import Autoscaler, autoscaler_knobs
+
+pytestmark = pytest.mark.swarm
+
+
+def _scaler(**kw):
+    kw.setdefault("min_size", 1)
+    kw.setdefault("max_size", 4)
+    kw.setdefault("up_window_s", 1.0)
+    kw.setdefault("down_window_s", 2.0)
+    kw.setdefault("up_cooldown_s", 5.0)
+    kw.setdefault("down_cooldown_s", 5.0)
+    return Autoscaler(**kw)
+
+
+# ---------------------------------------------------------- hysteresis
+def test_single_noisy_tick_never_scales():
+    sc = _scaler()
+    assert sc.observe(2, True, False, now=0.0) is None
+    assert sc.observe(2, False, True, now=0.1) is None
+    assert sc.grows == 0 and sc.shrinks == 0
+
+
+def test_grow_fires_after_sustained_pressure_window():
+    sc = _scaler()
+    assert sc.observe(2, True, False, now=0.0) is None
+    assert sc.observe(2, True, False, now=0.5) is None  # window not held yet
+    d = sc.observe(2, True, False, now=1.1)
+    assert d == {
+        "action": "grow",
+        "size": 2,
+        "target": 3,
+        "reason": "pressure",
+        "budget_remaining": 15,
+    }
+    assert sc.grows == 1
+
+
+def test_contradicting_tick_resets_the_window():
+    sc = _scaler()
+    sc.observe(2, True, False, now=0.0)
+    sc.observe(2, False, False, now=0.9)  # neutral tick: run broken
+    assert sc.observe(2, True, False, now=1.5) is None  # fresh run from 1.5
+    assert sc.observe(2, True, False, now=2.6)["action"] == "grow"
+
+
+def test_shrink_fires_after_sustained_slack_window():
+    sc = _scaler()
+    sc.observe(3, False, True, now=0.0)
+    assert sc.observe(3, False, True, now=1.0) is None  # down window is longer
+    d = sc.observe(3, False, True, now=2.1)
+    assert d["action"] == "shrink" and d["target"] == 2
+
+
+def test_pressure_overrides_slack_on_a_contradictory_tick():
+    sc = _scaler()
+    sc.observe(2, True, True, now=0.0)
+    d = sc.observe(2, True, True, now=1.1)
+    assert d["action"] == "grow"  # growing is the safe error
+    assert sc.shrinks == 0
+
+
+# ------------------------------------------------------------ cooldowns
+def test_up_cooldown_blocks_back_to_back_grows():
+    sc = _scaler()
+    sc.observe(2, True, False, now=0.0)
+    assert sc.observe(2, True, False, now=1.1)["action"] == "grow"
+    # pressure holds: a second full window elapses inside the cooldown
+    sc.observe(3, True, False, now=1.2)
+    assert sc.observe(3, True, False, now=2.4) is None
+    assert sc.observe(3, True, False, now=6.2)["action"] == "grow"  # cooldown over
+
+
+def test_opposite_directions_do_not_share_a_cooldown():
+    sc = _scaler(down_window_s=1.0)
+    sc.observe(2, True, False, now=0.0)
+    assert sc.observe(2, True, False, now=1.1)["action"] == "grow"
+    # a bad grow can be undone promptly: slack right after the grow
+    sc.observe(3, False, True, now=1.2)
+    assert sc.observe(3, False, True, now=2.3)["action"] == "shrink"
+
+
+# --------------------------------------------------------------- bounds
+def test_bounds_clamp_both_directions():
+    sc = _scaler(min_size=1, max_size=2)
+    sc.observe(2, True, False, now=0.0)
+    assert sc.observe(2, True, False, now=1.1) is None  # at max: no grow
+    sc2 = _scaler(min_size=1, max_size=4, down_window_s=1.0)
+    sc2.observe(1, False, True, now=0.0)
+    assert sc2.observe(1, False, True, now=1.1) is None  # at min: no shrink
+
+
+# --------------------------------------------------------------- budget
+def test_event_budget_makes_the_scaler_quiescent_not_thrashing():
+    sc = _scaler(event_budget=2, up_cooldown_s=0.0)
+    now = 0.0
+    for _ in range(2):
+        sc.observe(1, True, False, now=now)
+        now += 1.1
+        assert sc.observe(1, True, False, now=now)["action"] == "grow"
+        now += 0.1
+    # budget spent: sustained pressure decides nothing more, forever
+    sc.observe(1, True, False, now=now)
+    assert sc.observe(1, True, False, now=now + 50.0) is None
+    st = sc.stats(now=now + 50.0)
+    assert st["budget_exhausted"] == 1 and st["events_used"] == 2
+    assert st["last_decision"]["budget_remaining"] == 0
+
+
+def test_stats_shape_for_the_telemetry_panel():
+    sc = _scaler(name="player_pool")
+    sc.observe(2, True, False, now=0.0)
+    st = sc.stats(now=0.4)
+    assert st["name"] == "player_pool"
+    assert st["min"] == 1 and st["max"] == 4
+    assert st["window"]["pressure_held_s"] == pytest.approx(0.4)
+    assert st["window"]["slack_held_s"] == 0.0
+    assert st["budget_exhausted"] == 0
+
+
+# ----------------------------------------------------------- knobs
+def test_autoscaler_knobs_defaults_and_overrides():
+    k = autoscaler_knobs(dotdict({"algo": {}}))
+    assert k["enabled"] is False and k["min_players"] == 1 and k["max_players"] == 0
+    assert k["alert_pressure_names"] == ["serve_p99_slo", "breaker_open"]
+    k = autoscaler_knobs(
+        dotdict(
+            {"algo": {"autoscaler": {"enabled": True, "min_players": 2, "event_budget": 4}}}
+        )
+    )
+    assert k["enabled"] is True and k["min_players"] == 2 and k["event_budget"] == 4
+
+
+# ------------------------------------------- supervisor signal surface
+class _FakeProc:
+    def __init__(self, alive=True, exitcode=None):
+        self._alive = alive
+        self.exitcode = exitcode
+
+    def is_alive(self):
+        return self._alive
+
+    def start(self):
+        self._alive = True
+        self.exitcode = None
+
+
+class _FakeCtx:
+    def Process(self, target=None, args=(), daemon=False):
+        return _FakeProc()
+
+
+class _FakeHub:
+    backend = "queue"
+
+    def __init__(self, channels):
+        self._channels = channels
+
+    def respawn_spec(self, pid):
+        return f"spec-{pid}"
+
+    def channel(self, pid, timeout=0, peer_alive=None):
+        return self._channels[pid]
+
+
+def _supervised(n=2, budget=3, backoff=0.01):
+    chans = {}
+    for pid in range(n):
+        a, b = queue.Queue(8), queue.Queue(8)
+        chans[pid] = QueueChannel(b, a)
+    fanin = FanIn(chans)
+    procs = {pid: _FakeProc() for pid in range(n)}
+    sup = PlayerSupervisor(
+        _FakeCtx(),
+        _FakeHub(chans),
+        fanin,
+        target=lambda *a: None,
+        make_args=lambda pid, spec: (pid, spec, True),
+        procs=procs,
+        restart_budget=budget,
+        backoff_base=backoff,
+        backoff_max=0.5,
+    )
+    return sup, fanin, procs
+
+
+def test_signal_clean_idle_pool():
+    sup, fanin, procs = _supervised(n=3)
+    sig = sup.autoscale_signal()
+    assert sig["live_players"] == 3 and sig["pool_size"] == 3
+    assert sig["pending_restarts"] == 0
+    assert sig["restart_budget_remaining"] == 3
+    # no live metrics plane in this process: the alert surface says so
+    # explicitly instead of masquerading as "no alerts firing"
+    assert sig["alerts"] == [] and sig["alert_names"] == []
+    assert sig["alerts_available"] is False
+
+
+def test_signal_death_pending_respawn():
+    sup, fanin, procs = _supervised(n=2, backoff=60.0)  # backoff far in the future
+    procs[1]._alive = False
+    procs[1].exitcode = 13
+    sup.poll()  # death detected, restart scheduled, not yet executed
+    sig = sup.autoscale_signal()
+    assert sig["live_players"] == 1  # the dead player left the fan-in
+    assert sig["pending_restarts"] == 1
+    # the budget is spent when the restart LAUNCHES, not when it is
+    # scheduled — a pending entry still shows the full remaining budget
+    assert sig["restart_budget_remaining"] == 3
+    # the caller must read this as CHURN, not slack: ppo_decoupled
+    # refuses to shrink while pending_restarts > 0
+
+
+def test_signal_restart_budget_exhausted():
+    sup, fanin, procs = _supervised(n=2, budget=1, backoff=0.01)
+    procs[1]._alive = False
+    procs[1].exitcode = 13
+    sup.poll()
+    time.sleep(0.05)
+    assert sup.poll() == 1  # the one budgeted restart
+    # the replacement dies too — nothing left to spend
+    procs[1]._alive = False
+    procs[1].exitcode = 13
+    fanin.joining.pop(1, None)
+    fanin.dead.pop(1, None)
+    sup.poll()
+    time.sleep(0.05)
+    assert sup.poll() == 0
+    sig = sup.autoscale_signal()
+    assert sig["restart_budget_remaining"] == 0
+    assert sig["pending_restarts"] == 0  # exhausted budget schedules nothing
+    assert not sup.recoverable()
+
+
+def test_signal_reports_firing_alert_names(monkeypatch):
+    """Satellite (a): the signal carries the firing rule NAMES — the
+    autoscaler keys on specific rules (serve_p99_slo, breaker_open), not
+    a bare count."""
+    from sheeprl_tpu.obs import fleet
+
+    class _Alerts:
+        def active(self):
+            return [{"name": "breaker_open", "severity": "warn"}, {"name": "lag_p99"}]
+
+    class _Plane:
+        alerts = _Alerts()
+
+    monkeypatch.setattr(fleet, "get_live", lambda: _Plane())
+    sup, fanin, procs = _supervised(n=2)
+    sig = sup.autoscale_signal()
+    assert sig["alerts_available"] is True
+    assert sig["alert_names"] == ["breaker_open", "lag_p99"]
+    st = sup.stats()
+    assert st["alerts_firing"] == 2
+    assert st["alerts_firing_names"] == ["breaker_open", "lag_p99"]

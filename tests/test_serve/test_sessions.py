@@ -1,0 +1,515 @@
+"""Session tier of the serving plane (ISSUE 20 tentpole): SessionCache
+LRU/TTL bounds, the open/step/close protocol riding the PR-8 frames,
+the exactly-once contract under duplicate resends and respawn, the
+``build_server`` off-gate TYPE identity, and the golden session-parity
+suite — recurrent PPO and Dreamer v3 served through the session cache
+BIT-IDENTICAL to a local in-process roll with the same seed, including
+across a retry/hedge duplicate, a server respawn, and an eviction-forced
+session replay."""
+
+import multiprocessing as mp
+import queue
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.parallel.transport import INFER_REP_TAG, INFER_REQ_TAG, make_transport
+from sheeprl_tpu.serve import (
+    InferenceClient,
+    InferenceServer,
+    SessionCache,
+    SessionClient,
+    SessionInferenceServer,
+    build_server,
+    session_knobs,
+)
+from sheeprl_tpu.serve.sessions import (
+    REPLY_LOST,
+    REPLY_OPENED,
+    SESSION_OPEN,
+    SESSION_STEP,
+)
+
+pytestmark = [pytest.mark.serve, pytest.mark.swarm]
+
+
+# ------------------------------------------------------------ cache units
+def test_cache_lru_evicts_oldest_untouched_session():
+    c = SessionCache(capacity=2, idle_ttl_s=0)
+    s1 = c.open(1, {"h": np.zeros(1)})
+    s2 = c.open(1, {"h": np.zeros(1)})
+    assert c.lookup(s1) is not None  # touch: s1 is now the MRU
+    s3 = c.open(1, {"h": np.zeros(1)})  # evicts s2, not s1
+    assert c.lookup(s2) is None and c.lookup(s1) is not None and c.lookup(s3) is not None
+    assert c.evictions_lru == 1 and c.misses == 1
+    assert len(c) == 2
+
+
+def test_cache_idle_ttl_sweep_only_evicts_stale():
+    c = SessionCache(capacity=8, idle_ttl_s=10.0)
+    s1 = c.open(1, {"h": np.zeros(1)})
+    s2 = c.open(1, {"h": np.zeros(1)})
+    sess = c.lookup(s1)
+    sess.last_used -= 60.0  # s1 idles past the TTL
+    assert c.sweep_idle() == 1
+    assert c.lookup(s1) is None and c.lookup(s2) is not None
+    assert c.evictions_ttl == 1
+
+
+def test_cache_close_update_and_stats():
+    c = SessionCache(capacity=4, idle_ttl_s=0)
+    sid = c.open(2, {"h": np.zeros((2, 1))})
+    c.update(sid, {"h": np.ones((2, 1))})
+    sess = c.lookup(sid)
+    assert sess.steps == 1 and (sess.state["h"] == 1).all()
+    assert c.close(sid) and not c.close(sid)
+    st = c.stats()
+    assert st["entries"] == 0 and st["opened"] == 1 and st["closed"] == 1
+    assert st["capacity"] == 4 and st["hit_rate"] == 1.0
+
+
+# ---------------------------------------------------------- construction
+def _toy_session_fns():
+    """Numpy-only session step: action = obs_sum + h, h advances by one
+    per step (so the reply value proves EXACTLY how often a session
+    stepped); h starts at the session seed."""
+
+    def session_fn(params, obs, state):
+        h = state["h"]
+        out = {"actions": obs["state"].sum(axis=1, keepdims=True) + h}
+        return out, {"h": h + 1.0}
+
+    def init_fn(rows, seed, params):
+        return {"h": np.full((rows, 1), float(seed), np.float32)}
+
+    return session_fn, init_fn
+
+
+def test_build_server_off_gate_is_type_identical_pr8_server():
+    """Session knobs off -> the PRE-PR server class runs, not a decorated
+    equivalent (the bit-exactness anchor for local inference)."""
+    session_fn, init_fn = _toy_session_fns()
+    srv = build_server(
+        lambda p, o, k: {}, None,
+        session={"enabled": False, "capacity": 8, "idle_ttl_s": 1.0},
+        session_policy_fn=session_fn, init_state_fn=init_fn,
+    )
+    assert type(srv) is InferenceServer
+    # enabled but WITHOUT the stateful adapter pair: still undecorated
+    assert type(build_server(lambda p, o, k: {}, None, session={"enabled": True})) is InferenceServer
+    on = build_server(
+        None, None,
+        session={"enabled": True, "capacity": 8, "idle_ttl_s": 1.0},
+        session_policy_fn=session_fn, init_state_fn=init_fn,
+    )
+    assert isinstance(on, SessionInferenceServer)
+    assert on.sessions.capacity == 8 and on.sessions.idle_ttl_s == 1.0
+
+
+def test_session_knobs_resolve_defaults():
+    from sheeprl_tpu.config.compose import dotdict
+
+    k = session_knobs(dotdict({"algo": {}}))
+    assert k == {"enabled": False, "capacity": 1024, "idle_ttl_s": 300.0}
+    k = session_knobs(
+        dotdict({"algo": {"serve": {"sessions": {"enabled": True, "capacity": 9}}}})
+    )
+    assert k["enabled"] is True and k["capacity"] == 9
+
+
+def test_shared_dict_makes_pool_siblings_share_exactly_once_state():
+    session_fn, init_fn = _toy_session_fns()
+    shared = {}
+    a = SessionInferenceServer(
+        None, None, session_policy_fn=session_fn, init_state_fn=init_fn, shared=shared
+    )
+    b = SessionInferenceServer(
+        None, None, session_policy_fn=session_fn, init_state_fn=init_fn, shared=shared
+    )
+    assert a.sessions is b.sessions
+    assert a._acted is b._acted and a._inflight is b._inflight and a._reply_meta is b._reply_meta
+
+
+# -------------------------------------------------------------- protocol
+def _session_rig(n_clients=1, **server_kw):
+    ctx = mp.get_context("spawn")
+    hub, specs = make_transport(ctx, "queue", n_clients, window=8, min_bytes=0)
+    session_fn, init_fn = _toy_session_fns()
+    server_kw.setdefault("deadline_ms", 2.0)
+    server_kw.setdefault("max_batch", 8)
+    srv = SessionInferenceServer(
+        None, None, session_policy_fn=session_fn, init_state_fn=init_fn, **server_kw
+    )
+    player_chs = [s.player_channel() for s in specs]
+    for i in range(n_clients):
+        srv.attach(i, hub.channel(i, timeout=5))
+    return srv, player_chs, hub
+
+
+def _obs(rows, fill=1.0):
+    return [("state", np.full((rows, 3), fill, np.float32))]
+
+
+def test_open_step_lifecycle_advances_state_once_per_step():
+    srv, (pc,), hub = _session_rig()
+    srv.start()
+    c = SessionClient(pc, 0, seed=5, request_timeout_s=5.0)
+    try:
+        for i in range(3):
+            out, src = c.step(_obs(2), 2)
+            assert src == "remote"
+            # h = seed + i at dispatch time: the reply value counts steps
+            np.testing.assert_allclose(out["actions"], np.full((2, 1), 3.0 + 5.0 + i))
+        assert c.sessions_opened == 1 and c.session_id > 0
+        c.close_session()
+        assert c.session_id == 0
+        time.sleep(0.05)
+        assert len(srv.sessions) == 0 and srv.sessions.closed == 1
+    finally:
+        srv.close()
+        c.close()
+        hub.close()
+
+
+def test_session_lost_is_replayed_transparently_with_fresh_state():
+    srv, (pc,), hub = _session_rig()
+    srv.start()
+    c = SessionClient(pc, 0, seed=5, request_timeout_s=5.0)
+    try:
+        c.step(_obs(2), 2)
+        c.step(_obs(2), 2)
+        srv.sessions.close(c.session_id)  # eviction / cold replacement server
+        out, src = c.step(_obs(2), 2)
+        assert src == "remote"
+        # the replay reopened: state restarted from the session seed
+        np.testing.assert_allclose(out["actions"], np.full((2, 1), 3.0 + 5.0))
+        assert c.session_losses == 1 and c.session_reopens == 1 and c.sessions_opened == 2
+        assert srv.session_losses == 1
+    finally:
+        srv.close()
+        c.close()
+        hub.close()
+
+
+def test_duplicate_resends_advance_the_session_exactly_once():
+    """The hedge/retry hazard, driven raw: the SAME request id sent
+    twice must step the recurrent state once — whichever side of the act
+    the duplicate lands on (pending-drop or acted-cache answer)."""
+    srv, (pc,), hub = _session_rig(deadline_ms=20.0)
+    srv.start()
+    try:
+        pc.send(INFER_REQ_TAG, arrays=_obs(1), extra=(0, 1, SESSION_OPEN, 0, 7), seq=1)
+        f = pc.recv(timeout=5)
+        assert f.extra[1] == REPLY_OPENED
+        sid = int(f.extra[2])
+        f.release()
+        # step 2, sent twice back-to-back (a hedge resend)
+        for _ in range(2):
+            pc.send(INFER_REQ_TAG, arrays=_obs(1), extra=(0, 1, SESSION_STEP, sid, 7), seq=2)
+        got2 = []
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not got2:
+            try:
+                f = pc.recv(timeout=0.2)
+            except queue.Empty:
+                continue
+            got2.append(np.asarray(f.arrays_copy()["actions"]).copy())
+            f.release()
+        np.testing.assert_allclose(got2[0], np.full((1, 1), 3.0 + 7.0 + 1))
+        # drain a possible second (cache-answered) copy, then step 3
+        time.sleep(0.1)
+        try:
+            while True:
+                f = pc.recv(timeout=0.05)
+                np.testing.assert_allclose(np.asarray(f.arrays_copy()["actions"]), got2[0])
+                f.release()
+        except queue.Empty:
+            pass
+        pc.send(INFER_REQ_TAG, arrays=_obs(1), extra=(0, 1, SESSION_STEP, sid, 7), seq=3)
+        f = pc.recv(timeout=5)
+        # h advanced exactly once between seq 2 and seq 3
+        np.testing.assert_allclose(np.asarray(f.arrays_copy()["actions"]), np.full((1, 1), 3.0 + 7.0 + 2))
+        f.release()
+        assert srv.dup_pending_dropped + srv.dedup_hits >= 1
+    finally:
+        srv.close()
+        hub.close()
+
+
+def test_respawn_clears_the_pending_guard_but_keeps_sessions():
+    """After a drain-recover respawn the guarded ids died with the old
+    loop: their RETRIES must be admitted (not dropped as duplicates),
+    while the session cache itself survives with the process."""
+    srv, (pc,), hub = _session_rig()
+    sid = srv.sessions.open(1, {"h": np.zeros((1, 1), np.float32)})
+    pc.send(INFER_REQ_TAG, arrays=_obs(1), extra=(0, 1, SESSION_STEP, sid, 0), seq=9)
+    assert srv._poll_requests() == 1
+    assert (0, 9) in srv._inflight
+    # the duplicate of a PENDING id is dropped...
+    pc.send(INFER_REQ_TAG, arrays=_obs(1), extra=(0, 1, SESSION_STEP, sid, 0), seq=9)
+    assert srv._poll_requests() == 0 and srv.dup_pending_dropped == 1
+    srv.respawn()  # drain-recovers: the reborn loop answers the backlog
+    try:
+        assert srv.respawns == 1
+        assert srv.sessions.lookup(sid) is not None  # cache survived
+        # ...and the retry of the same id after the respawn is ADMITTED
+        # (answered live or from the acted cache), never double-stepped
+        pc.send(INFER_REQ_TAG, arrays=_obs(1), extra=(0, 1, SESSION_STEP, sid, 0), seq=9)
+        replies = []
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not replies:
+            try:
+                f = pc.recv(timeout=0.2)
+            except queue.Empty:
+                continue
+            replies.append(np.asarray(f.arrays_copy()["actions"]).copy())
+            f.release()
+        # every copy of seq 9's reply carries the h=0 action
+        for r in replies:
+            np.testing.assert_allclose(r, np.full((1, 1), 3.0))
+        # drain stragglers, then the NEXT id proves h advanced exactly once
+        time.sleep(0.1)
+        try:
+            while True:
+                f = pc.recv(timeout=0.05)
+                np.testing.assert_allclose(np.asarray(f.arrays_copy()["actions"]), np.full((1, 1), 3.0))
+                f.release()
+        except queue.Empty:
+            pass
+        pc.send(INFER_REQ_TAG, arrays=_obs(1), extra=(0, 1, SESSION_STEP, sid, 0), seq=10)
+        f = pc.recv(timeout=5)
+        np.testing.assert_allclose(np.asarray(f.arrays_copy()["actions"]), np.full((1, 1), 4.0))
+        f.release()
+    finally:
+        srv.close()
+        hub.close()
+
+
+def test_stateless_requests_refused_without_a_stateless_policy():
+    srv, (pc,), hub = _session_rig()
+    srv.start()
+    c = InferenceClient(pc, 0, request_timeout_s=0.3, max_retries=0)
+    try:
+        out, src = c.infer(_obs(1), 1)
+        assert out is None and src == "local"
+        t0 = time.monotonic()
+        while srv.stateless_refused == 0 and time.monotonic() - t0 < 5:
+            time.sleep(0.01)
+        assert srv.stateless_refused >= 1
+    finally:
+        srv.close()
+        c.close()
+        hub.close()
+
+
+# ------------------------------------------------------- golden parity
+class _DupChannel:
+    """A channel proxy that sends every frame TWICE — the permanent
+    hedge/retry hazard.  Parity through this proxy proves duplicates
+    never double-step a session."""
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def send(self, tag, **kw):
+        self._inner.send(tag, **kw)
+        try:
+            self._inner.send(tag, **kw)
+        except Exception:
+            pass
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def _serve_and_roll(
+    session_fn,
+    init_fn,
+    params,
+    obs_maker,
+    *,
+    rows_a=1,
+    rows_b=2,
+    steps=4,
+    dup=False,
+    respawn_after=None,
+    evict_after=None,
+):
+    """Serve client A (rows_a) and client B (rows_b) CONCURRENTLY through
+    one SessionInferenceServer — their rows coalesce into shared padded
+    buckets — and return (remote outs for A, local outs for A, server).
+    The local comparator steps the SAME adapter fns in-process for A's
+    rows alone, reinitializing at the eviction point exactly like the
+    client's reopen-and-replay."""
+    ctx = mp.get_context("spawn")
+    hub, specs = make_transport(ctx, "queue", 2, window=8, min_bytes=0)
+    srv = SessionInferenceServer(
+        None,
+        params,
+        session_policy_fn=session_fn,
+        init_state_fn=init_fn,
+        deadline_ms=30.0,
+        max_batch=8,
+    )
+    for i in range(2):
+        srv.attach(i, hub.channel(i, timeout=5))
+    srv.start()
+    ch_a = specs[0].player_channel()
+    if dup:
+        ch_a = _DupChannel(ch_a)
+    ca = SessionClient(ch_a, 0, seed=11, request_timeout_s=5.0)
+    cb = SessionClient(specs[1].player_channel(), 1, seed=22, request_timeout_s=5.0)
+    obs_a = [obs_maker(rows_a, 0.1 * (t + 1)) for t in range(steps)]
+    obs_b = [obs_maker(rows_b, -0.2 * (t + 1)) for t in range(steps)]
+    remote = []
+    try:
+        for t in range(steps):
+            res = {}
+
+            def fire(c, obs, rows, tag):
+                res[tag] = c.step(obs, rows)
+
+            ts = [
+                threading.Thread(target=fire, args=(ca, obs_a[t], rows_a, "a")),
+                threading.Thread(target=fire, args=(cb, obs_b[t], rows_b, "b")),
+            ]
+            for th in ts:
+                th.start()
+            for th in ts:
+                th.join()
+            out, src = res["a"]
+            assert src == "remote" and res["b"][1] == "remote"
+            remote.append(out)
+            if respawn_after is not None and t == respawn_after:
+                srv.respawn()
+            if evict_after is not None and t == evict_after:
+                srv.sessions.close(ca.session_id)
+        stats = srv.stats()
+        losses = ca.session_losses
+    finally:
+        srv.close()
+        ca.close()
+        cb.close()
+        hub.close()
+    # local comparator: A's rows alone, same seed, in-process state
+    st = init_fn(rows_a, 11, params)
+    local = []
+    for t in range(steps):
+        if evict_after is not None and t == evict_after + 1:
+            st = init_fn(rows_a, 11, params)  # the reopen restarts from seed
+        out, st = session_fn(params, dict(obs_a[t]), st)
+        local.append(out)
+    return remote, local, stats, losses
+
+
+def _assert_bit_equal(remote, local):
+    assert len(remote) == len(local)
+    for t, (r, l) in enumerate(zip(remote, local)):
+        assert set(r.keys()) == set(l.keys())
+        for k in l:
+            np.testing.assert_array_equal(
+                np.asarray(r[k]), np.asarray(l[k]), err_msg=f"step {t} key {k}"
+            )
+
+
+def _rppo_parts():
+    from scripts.swarm import synthetic_session_parts
+
+    params, session_fn, init_fn, obs_key, obs_dim = synthetic_session_parts(seed=3)
+    return params, session_fn, init_fn, lambda rows, fill: [
+        (obs_key, np.full((rows, obs_dim), fill, np.float32))
+    ]
+
+
+def _dreamer_parts():
+    import jax
+
+    from sheeprl_tpu.algos.dreamer_v3.agent import build_agent
+    from sheeprl_tpu.config import compose
+    from sheeprl_tpu.parallel.mesh import MeshRuntime
+    from sheeprl_tpu.serve import make_dreamer_session_fns
+
+    import gymnasium as gym
+
+    cfg = compose(
+        overrides=[
+            "exp=dreamer_v3",
+            "env=dummy",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.mlp_keys.decoder=[state]",
+            "algo.cnn_keys.encoder=[]",
+            "algo.cnn_keys.decoder=[]",
+            "algo.dense_units=8",
+            "algo.mlp_layers=1",
+            "algo.world_model.recurrent_model.recurrent_state_size=8",
+            "algo.world_model.representation_model.hidden_size=8",
+            "algo.world_model.transition_model.hidden_size=8",
+            "algo.world_model.stochastic_size=4",
+            "algo.world_model.discrete_size=4",
+            "algo.world_model.reward_model.bins=15",
+            "algo.critic.bins=15",
+            "env.screen_size=16",
+        ]
+    )
+    obs_space = gym.spaces.Dict(
+        {"state": gym.spaces.Box(low=-np.inf, high=np.inf, shape=(4,), dtype=np.float32)}
+    )
+    runtime = MeshRuntime(devices=1, accelerator="cpu", precision="32-true")
+    runtime.launch()
+    world_model, actor, _, params = build_agent(runtime, (2,), False, cfg, obs_space)
+    wm_cfg = cfg.algo.world_model
+    session_fn, init_fn = make_dreamer_session_fns(
+        world_model,
+        actor,
+        actions_dim=(2,),
+        stochastic_size=wm_cfg.stochastic_size,
+        discrete_size=wm_cfg.discrete_size,
+        recurrent_state_size=wm_cfg.recurrent_model.recurrent_state_size,
+        decoupled_rssm=bool(wm_cfg.decoupled_rssm),
+    )
+    params = {"world_model": params["world_model"], "actor": params["actor"]}
+    return params, session_fn, init_fn, lambda rows, fill: [
+        ("state", np.full((rows, 4), fill, np.float32))
+    ]
+
+
+def test_golden_parity_rppo_mixed_batches_bit_exact():
+    params, session_fn, init_fn, obs_maker = _rppo_parts()
+    remote, local, stats, _ = _serve_and_roll(session_fn, init_fn, params, obs_maker)
+    _assert_bit_equal(remote, local)
+    # the two clients really did share padded buckets
+    assert stats["batches"] >= 1
+    assert {int(k) for k in stats["batch_hist"]} <= {1, 2, 4, 8}
+
+
+def test_golden_parity_rppo_under_duplicates_respawn_and_eviction():
+    """The full hazard gauntlet in one serve: client A's every frame is
+    SENT TWICE, the server drain-recover-respawns mid-sequence, and A's
+    session is evicted mid-sequence forcing a reopen-and-replay — the
+    served actions stay bit-identical to the local roll that mirrors
+    only the eviction restart."""
+    params, session_fn, init_fn, obs_maker = _rppo_parts()
+    remote, local, stats, losses = _serve_and_roll(
+        session_fn, init_fn, params, obs_maker, steps=5, dup=True, respawn_after=1, evict_after=2
+    )
+    _assert_bit_equal(remote, local)
+    assert losses == 1
+    assert stats["dup_pending_dropped"] + stats["dedup_hits"] >= 1
+
+
+def test_golden_parity_dreamer_mixed_batches_bit_exact():
+    params, session_fn, init_fn, obs_maker = _dreamer_parts()
+    remote, local, stats, _ = _serve_and_roll(session_fn, init_fn, params, obs_maker, steps=3)
+    _assert_bit_equal(remote, local)
+    assert stats["sessions"]["opened"] >= 2
+
+
+def test_golden_parity_dreamer_survives_eviction_replay():
+    params, session_fn, init_fn, obs_maker = _dreamer_parts()
+    remote, local, stats, losses = _serve_and_roll(
+        session_fn, init_fn, params, obs_maker, steps=4, evict_after=1
+    )
+    _assert_bit_equal(remote, local)
+    assert losses == 1 and stats["session_losses"] == 1
